@@ -1,0 +1,19 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh before jax imports.
+
+Multi-chip hardware is not available in CI; sharding tests run over
+``--xla_force_host_platform_device_count=8`` as the driver's dryrun does.
+Must run before anything imports jax, hence module-level in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

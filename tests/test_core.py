@@ -1,0 +1,83 @@
+"""Unit tests for the execution core: serialize, task model, execute_fn."""
+
+import pytest
+
+from tpu_faas.core import (
+    TaskStatus,
+    deserialize,
+    execute_fn,
+    new_task_id,
+    serialize,
+)
+from tpu_faas.core.executor import pack_params
+from tpu_faas.workloads import arithmetic, failing_task, make_workload
+
+
+def test_serialize_roundtrip_builtin_types():
+    for obj in [42, "hi", [1, 2, 3], {"a": (1, 2)}, None, 3.14, {1, 2}]:
+        assert deserialize(serialize(obj)) == obj
+
+
+def test_serialize_roundtrip_function():
+    f = deserialize(serialize(arithmetic))
+    assert f(10) == arithmetic(10)
+
+
+def test_serialize_roundtrip_lambda_and_closure():
+    k = 7
+    f = deserialize(serialize(lambda x: x + k))
+    assert f(1) == 8
+
+
+def test_serialize_is_ascii_string():
+    s = serialize({"payload": b"\x00\xff"})
+    assert isinstance(s, str)
+    s.encode("ascii")  # must not raise
+
+
+def test_execute_fn_completed():
+    tid = new_task_id()
+    out = execute_fn(tid, serialize(arithmetic), pack_params(100))
+    assert out.task_id == tid
+    assert out.status == "COMPLETED"
+    assert deserialize(out.result) == arithmetic(100)
+
+
+def test_execute_fn_kwargs_contract():
+    out = execute_fn("t", serialize(arithmetic), serialize(((), {"n": 50})))
+    assert out.status == "COMPLETED"
+    assert deserialize(out.result) == arithmetic(50)
+
+
+def test_execute_fn_failed_on_raise():
+    out = execute_fn("t", serialize(failing_task), pack_params("kaput"))
+    assert out.status == "FAILED"
+    exc = deserialize(out.result)
+    assert isinstance(exc, ValueError)
+    assert "kaput" in str(exc)
+
+
+def test_execute_fn_failed_on_garbage_payloads():
+    # malformed function payload
+    assert execute_fn("t", "not-base64!!!", pack_params()).status == "FAILED"
+    # malformed params payload
+    assert execute_fn("t", serialize(arithmetic), "junk").status == "FAILED"
+    # params not an (args, kwargs) pair
+    assert execute_fn("t", serialize(arithmetic), serialize(42)).status == "FAILED"
+
+
+def test_status_enum():
+    assert str(TaskStatus.QUEUED) == "QUEUED"
+    assert TaskStatus("COMPLETED").is_terminal()
+    assert TaskStatus("FAILED").is_terminal()
+    assert not TaskStatus("RUNNING").is_terminal()
+    with pytest.raises(ValueError):
+        TaskStatus("NOPE")
+
+
+def test_workload_determinism():
+    fn1, p1 = make_workload("sort_numbers", 3, 10, seed=1)
+    fn2, p2 = make_workload("sort_numbers", 3, 10, seed=1)
+    assert p1 == p2
+    args, kwargs = p1[0]
+    assert fn1(*args, **kwargs) == sorted(args[0])

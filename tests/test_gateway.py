@@ -1,0 +1,86 @@
+"""REST-contract tests for the gateway (analog of reference test_suit.py)."""
+
+import pytest
+import requests
+
+from tpu_faas.core.serialize import serialize
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store import MemoryStore
+from tpu_faas.workloads import arithmetic
+
+VALID_STATUSES = ["QUEUED", "RUNNING", "COMPLETED", "FAILED"]
+
+
+@pytest.fixture()
+def gw():
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    yield handle, store
+    handle.stop()
+
+
+def test_register_and_execute_schema(gw):
+    handle, store = gw
+    r = requests.post(
+        f"{handle.url}/register_function",
+        json={"name": "arithmetic", "payload": serialize(arithmetic)},
+    )
+    assert r.status_code == 200
+    fid = r.json()["function_id"]
+    assert isinstance(fid, str) and fid
+
+    r = requests.post(
+        f"{handle.url}/execute_function",
+        json={"function_id": fid, "payload": serialize(((10,), {}))},
+    )
+    assert r.status_code == 200
+    tid = r.json()["task_id"]
+    assert isinstance(tid, str) and tid
+
+    # store-side contract: full hash written + QUEUED
+    fields = store.hgetall(tid)
+    assert fields["status"] == "QUEUED"
+    assert fields["fn_payload"] == serialize(arithmetic)
+    assert fields["param_payload"] == serialize(((10,), {}))
+    assert fields["result"] == "None"
+
+    r = requests.get(f"{handle.url}/status/{tid}")
+    assert r.status_code == 200
+    assert r.json() == {"task_id": tid, "status": "QUEUED"}
+    assert r.json()["status"] in VALID_STATUSES
+
+    r = requests.get(f"{handle.url}/result/{tid}")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["task_id"] == tid and body["status"] == "QUEUED"
+
+
+def test_execute_announces_on_channel(gw):
+    handle, store = gw
+    sub = store.subscribe("tasks")
+    fid = requests.post(
+        f"{handle.url}/register_function",
+        json={"name": "f", "payload": serialize(arithmetic)},
+    ).json()["function_id"]
+    tid = requests.post(
+        f"{handle.url}/execute_function",
+        json={"function_id": fid, "payload": serialize(((5,), {}))},
+    ).json()["task_id"]
+    assert sub.get_message(timeout=2.0) == tid
+
+
+def test_error_paths(gw):
+    handle, _ = gw
+    assert (
+        requests.post(f"{handle.url}/register_function", json={"nope": 1}).status_code
+        == 400
+    )
+    assert (
+        requests.post(
+            f"{handle.url}/execute_function",
+            json={"function_id": "ghost", "payload": "x"},
+        ).status_code
+        == 404
+    )
+    assert requests.get(f"{handle.url}/status/ghost").status_code == 404
+    assert requests.get(f"{handle.url}/result/ghost").status_code == 404

@@ -1,0 +1,97 @@
+"""LocalDispatcher unit tests against the in-proc store, including the
+failure paths the reference leaks on (SURVEY §2 LocalDispatcher: a dead pool
+child permanently loses a slot there)."""
+
+import os
+import threading
+
+import pytest
+
+from tpu_faas.core.executor import pack_params
+from tpu_faas.core.serialize import deserialize, serialize
+from tpu_faas.dispatch.local import LocalDispatcher
+from tpu_faas.store import MemoryStore
+from tpu_faas.workloads import arithmetic
+
+
+def _child_killer():
+    os._exit(17)  # simulates user code hard-killing the pool child
+
+
+@pytest.fixture()
+def dispatcher_stack():
+    store = MemoryStore()
+    d = LocalDispatcher(num_workers=2, store=store)
+    t = threading.Thread(target=d.start, daemon=True)
+    t.start()
+    yield store, d
+    d.stop()
+    t.join(timeout=15)
+
+
+def _submit(store, tid, fn, *args):
+    store.create_task(tid, serialize(fn), pack_params(*args))
+
+
+def _wait_terminal(store, tid, timeout=30.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        status = store.get_status(tid)
+        if status in ("COMPLETED", "FAILED"):
+            return status
+        time.sleep(0.01)
+    raise TimeoutError(f"{tid} stuck at {store.get_status(tid)}")
+
+
+def test_completes_tasks(dispatcher_stack):
+    store, _ = dispatcher_stack
+    _submit(store, "t1", arithmetic, 100)
+    assert _wait_terminal(store, "t1") == "COMPLETED"
+    assert deserialize(store.get_result("t1")[1]) == arithmetic(100)
+
+
+def test_child_death_marks_failed_and_recovers(dispatcher_stack):
+    store, _ = dispatcher_stack
+    _submit(store, "killer", _child_killer)
+    assert _wait_terminal(store, "killer", timeout=60) == "FAILED"
+    # pool recovered: subsequent tasks complete on all slots
+    for i in range(4):
+        _submit(store, f"after-{i}", arithmetic, 50)
+    for i in range(4):
+        assert _wait_terminal(store, f"after-{i}", timeout=60) == "COMPLETED"
+
+
+def test_unpicklable_exception_degrades_to_repr(dispatcher_stack):
+    store, _ = dispatcher_stack
+
+    def raise_unpicklable():
+        import threading as th
+
+        class Evil(Exception):
+            def __init__(self):
+                super().__init__("evil")
+                self.lock = th.Lock()  # unpicklable attribute
+
+        raise Evil()
+
+    _submit(store, "evil", raise_unpicklable)
+    assert _wait_terminal(store, "evil", timeout=60) == "FAILED"
+    exc = deserialize(store.get_result("evil")[1])
+    assert isinstance(exc, Exception)
+
+
+def test_stale_announce_does_not_stall_intake():
+    store = MemoryStore()
+    d = LocalDispatcher(num_workers=2, store=store)
+    # two announces whose hashes are gone, then a real one behind them
+    store.publish("tasks", "ghost-1")
+    store.publish("tasks", "ghost-2")
+    store.create_task("real", serialize(arithmetic), pack_params(10))
+    t = threading.Thread(target=d.start, kwargs={"max_tasks": 1}, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert store.get_status("real") == "COMPLETED"
+    d.stop()

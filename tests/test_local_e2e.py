@@ -1,0 +1,62 @@
+"""Minimum end-to-end slice: client -> gateway -> store server -> local
+dispatcher -> pool -> result poll (analog of reference test_roundtrip,
+test_suit.py:62-92, and test_local, test_client.py:209-219)."""
+
+import threading
+
+import pytest
+
+from tpu_faas.client import FaaSClient, TaskFailedError
+from tpu_faas.dispatch.local import LocalDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import arithmetic, failing_task, make_workload
+
+
+@pytest.fixture()
+def stack():
+    """Full stack over real TCP: RESP store server + gateway + local dispatcher."""
+    store_handle = start_store_thread()
+    gw_store = make_store(store_handle.url)
+    gw = start_gateway_thread(gw_store)
+    dispatcher = LocalDispatcher(num_workers=4, store=make_store(store_handle.url))
+    thread = threading.Thread(target=dispatcher.start, daemon=True)
+    thread.start()
+    client = FaaSClient(gw.url)
+    yield client
+    dispatcher.stop()
+    thread.join(timeout=10)
+    gw.stop()
+    store_handle.stop()
+
+
+def test_roundtrip_single(stack):
+    client = stack
+    fid = client.register(arithmetic)
+    handle = client.submit(fid, 1000)
+    assert handle.result(timeout=30) == arithmetic(1000)
+
+
+def test_roundtrip_many_tasks_verified_against_local_oracle(stack):
+    client = stack
+    fn, params = make_workload("sort_numbers", 20, 50, seed=1)
+    fid = client.register(fn)
+    handles = [client.submit(fid, *args, **kwargs) for args, kwargs in params]
+    for handle, (args, kwargs) in zip(handles, params):
+        assert handle.result(timeout=60) == fn(*args, **kwargs)
+
+
+def test_failed_task_surfaces_exception(stack):
+    client = stack
+    fid = client.register(failing_task)
+    handle = client.submit(fid, "kaput")
+    with pytest.raises(TaskFailedError) as ei:
+        handle.result(timeout=30)
+    assert isinstance(ei.value.cause, ValueError)
+    assert "kaput" in str(ei.value.cause)
+
+
+def test_lambda_roundtrip(stack):
+    client = stack
+    k = 5
+    assert client.run(lambda x: x * k, 8, timeout=30) == 40

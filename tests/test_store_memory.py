@@ -1,0 +1,101 @@
+"""Unit tests for the in-process task store + announce bus."""
+
+import threading
+
+from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS, TaskStatus
+from tpu_faas.store import MemoryStore
+from tpu_faas.store.base import TASKS_CHANNEL
+
+
+def test_hash_ops():
+    s = MemoryStore()
+    s.hset("k", {"a": "1", "b": "2"})
+    s.hset("k", {"b": "3"})
+    assert s.hget("k", "a") == "1"
+    assert s.hget("k", "b") == "3"
+    assert s.hget("k", "missing") is None
+    assert s.hget("nokey", "a") is None
+    assert s.hgetall("k") == {"a": "1", "b": "3"}
+    assert s.keys() == ["k"]
+    s.delete("k")
+    assert s.hgetall("k") == {}
+
+
+def test_create_task_contract_and_announce():
+    s = MemoryStore()
+    sub = s.subscribe(TASKS_CHANNEL)
+    s.create_task("tid-1", "FN", "PARAMS")
+    fields = s.hgetall("tid-1")
+    assert fields == {
+        "status": "QUEUED",
+        "fn_payload": "FN",
+        "param_payload": "PARAMS",
+        "result": "None",
+    }
+    assert sub.get_message() == "tid-1"
+    assert sub.get_message() is None
+
+
+def test_task_lifecycle_helpers():
+    s = MemoryStore()
+    s.create_task("t", "FN", "P")
+    assert s.get_payloads("t") == ("FN", "P")
+    s.set_status("t", TaskStatus.RUNNING)
+    assert s.get_status("t") == "RUNNING"
+    s.finish_task("t", TaskStatus.COMPLETED, "RES")
+    assert s.get_result("t") == ("COMPLETED", "RES")
+    assert s.hget("t", FIELD_STATUS) == "COMPLETED"
+    assert s.hget("t", FIELD_RESULT) == "RES"
+
+
+def test_pubsub_fire_and_forget_and_fanout():
+    s = MemoryStore()
+    s.publish("tasks", "lost")  # nobody listening -> dropped
+    a = s.subscribe("tasks")
+    b = s.subscribe("tasks")
+    s.publish("tasks", "m1")
+    assert a.get_message() == "m1"
+    assert b.get_message() == "m1"
+    a.close()
+    s.publish("tasks", "m2")
+    assert a.get_message() is None  # closed
+    assert b.get_message() == "m2"
+
+
+def test_subscription_blocking_timeout():
+    s = MemoryStore()
+    sub = s.subscribe("tasks")
+    t = threading.Timer(0.05, lambda: s.publish("tasks", "late"))
+    t.start()
+    assert sub.get_message(timeout=2.0) == "late"
+    t.join()
+
+
+def test_flush_keeps_subscriptions():
+    s = MemoryStore()
+    sub = s.subscribe("tasks")
+    s.hset("k", {"a": "1"})
+    s.flush()
+    assert s.keys() == []
+    s.publish("tasks", "still-works")
+    assert sub.get_message() == "still-works"
+
+
+def test_thread_safety_smoke():
+    s = MemoryStore()
+    sub = s.subscribe("tasks")
+
+    def writer(i):
+        for j in range(100):
+            s.create_task(f"t-{i}-{j}", "F", "P")
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    seen = 0
+    while sub.get_message() is not None:
+        seen += 1
+    assert seen == 800
+    assert len(s.keys()) == 800

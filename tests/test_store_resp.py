@@ -1,0 +1,142 @@
+"""RESP protocol + server + client tests (same contract as MemoryStore)."""
+
+import threading
+
+import pytest
+
+from tpu_faas.store import resp
+from tpu_faas.store.launch import make_store, start_store_thread
+
+
+# -- pure protocol tests ----------------------------------------------------
+
+
+def test_encode_command():
+    assert resp.encode_command("HGET", "k", "f") == (
+        b"*3\r\n$4\r\nHGET\r\n$1\r\nk\r\n$1\r\nf\r\n"
+    )
+
+
+def test_parser_incremental_feed():
+    p = resp.RespParser()
+    payload = b"*2\r\n$2\r\nhi\r\n:42\r\n+OK\r\n"
+    for i in range(len(payload)):
+        p2 = resp.RespParser()
+        p2.feed(payload[:i])
+        # never raises on partial input; just returns NEED_MORE at some point
+        p2.pop_all()
+    p.feed(payload)
+    assert p.pop_all() == [["hi", 42], "OK"]
+
+
+def test_parser_nil_and_error():
+    p = resp.RespParser()
+    p.feed(b"$-1\r\n-ERR nope\r\n")
+    items = p.pop_all()
+    assert items[0] is None
+    assert isinstance(items[1], resp.RespError)
+
+
+def test_parser_bulk_with_crlf_in_body():
+    body = "a\r\nb"
+    p = resp.RespParser()
+    p.feed(b"$4\r\n" + body.encode() + b"\r\n")
+    assert p.pop() == body
+
+
+# -- server/client integration ---------------------------------------------
+
+
+@pytest.fixture()
+def store_server():
+    handle = start_store_thread()
+    yield handle
+    handle.stop()
+
+
+def test_resp_store_contract(store_server):
+    s = make_store(store_server.url)
+    assert s.ping()
+    s.hset("k", {"a": "1", "b": "2"})
+    assert s.hget("k", "a") == "1"
+    assert s.hget("k", "zzz") is None
+    assert s.hgetall("k") == {"a": "1", "b": "2"}
+    assert s.keys() == ["k"]
+    s.delete("k")
+    assert s.hgetall("k") == {}
+    s.flush()
+    s.close()
+
+
+def test_resp_pubsub_and_task_lifecycle(store_server):
+    s = make_store(store_server.url)
+    sub = s.subscribe("tasks")
+    s.create_task("t1", "FN", "PARAMS")
+    assert sub.get_message(timeout=2.0) == "t1"
+    assert sub.get_message() is None
+    assert s.get_payloads("t1") == ("FN", "PARAMS")
+    s.set_status("t1", "RUNNING")
+    s.finish_task("t1", "COMPLETED", "RES")
+    assert s.get_result("t1") == ("COMPLETED", "RES")
+    sub.close()
+    s.close()
+
+
+def test_resp_pubsub_fanout_and_fire_and_forget(store_server):
+    s = make_store(store_server.url)
+    s.publish("tasks", "lost")  # no subscribers yet
+    a = s.subscribe("tasks")
+    b = s.subscribe("tasks")
+    s.publish("tasks", "m1")
+    assert a.get_message(timeout=2.0) == "m1"
+    assert b.get_message(timeout=2.0) == "m1"
+    a.close()
+    s.publish("tasks", "m2")
+    assert b.get_message(timeout=2.0) == "m2"
+    b.close()
+    s.close()
+
+
+def test_resp_store_multithreaded_clients(store_server):
+    s = make_store(store_server.url)
+    sub = s.subscribe("tasks")
+
+    def writer(i):
+        c = make_store(store_server.url)
+        for j in range(50):
+            c.create_task(f"t-{i}-{j}", "F", "P")
+        c.close()
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    seen = set()
+    while True:
+        m = sub.get_message(timeout=0.5)
+        if m is None:
+            break
+        seen.add(m)
+    assert len(seen) == 200
+    assert len(s.keys()) == 200
+    sub.close()
+    s.close()
+
+
+def test_large_payload_roundtrip(store_server):
+    s = make_store(store_server.url)
+    big = "x" * 1_000_000
+    s.hset("big", {"v": big})
+    assert s.hget("big", "v") == big
+    s.close()
+
+
+def test_make_store_memory_shared():
+    a = make_store("memory://")
+    b = make_store("memory://")
+    a.hset("k", {"f": "v"})
+    assert b.hget("k", "f") == "v"
+    c = make_store("memory://fresh")
+    assert c.hget("k", "f") is None
+    a.flush()

@@ -1,0 +1,25 @@
+"""tpu-faas: a TPU-native distributed Function-as-a-Service framework.
+
+Capability parity with the reference system mshalimay/Distributed-FaaS
+(see SURVEY.md): clients register arbitrary Python functions over REST and
+invoke them; tasks flow through a hash-per-task store + announce bus into a
+dispatcher (local / pull / push / tpu-push modes) and out to multiprocessing
+worker nodes over ZeroMQ. Where the reference makes its per-tick placement
+decision by greedily walking a Python list (reference task_dispatcher.py:297-322),
+this framework computes placement, heartbeat-timeout detection, and
+work-redistribution as one batched JAX device step (tpu_faas.sched).
+
+Layer map (mirrors SURVEY.md §1, rebuilt TPU-first):
+
+    client SDK / benchmarks          tpu_faas.client, bench/
+    REST gateway (aiohttp)           tpu_faas.gateway
+    task store + announce bus        tpu_faas.store  (native C++ or in-proc)
+    dispatch / scheduling            tpu_faas.dispatch + tpu_faas.sched (TPU)
+    worker runtime                   tpu_faas.worker
+    execution core                   tpu_faas.core
+    transport                        ZeroMQ / RESP-TCP / HTTP
+"""
+
+from tpu_faas.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,5 @@
+"""Client SDK for the REST gateway."""
+
+from tpu_faas.client.sdk import FaaSClient, TaskHandle, TaskFailedError
+
+__all__ = ["FaaSClient", "TaskHandle", "TaskFailedError"]

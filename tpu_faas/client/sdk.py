@@ -1,0 +1,103 @@
+"""Python client SDK.
+
+The reference has no SDK — every test/benchmark hand-rolls requests + dill
+(e.g. test_client.py:95-129). This wraps the four REST endpoints (SURVEY §0.1)
+plus serialization and result polling into an ergonomic client, while keeping
+the raw wire format identical so hand-rolled clients interoperate.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import requests
+
+from tpu_faas.core.executor import pack_params
+from tpu_faas.core.serialize import deserialize, serialize
+from tpu_faas.core.task import TaskStatus
+
+
+class TaskFailedError(Exception):
+    def __init__(self, task_id: str, cause: object) -> None:
+        super().__init__(f"task {task_id} FAILED: {cause!r}")
+        self.task_id = task_id
+        self.cause = cause
+
+
+@dataclass
+class TaskHandle:
+    client: "FaaSClient"
+    task_id: str
+
+    def status(self) -> str:
+        return self.client.status(self.task_id)
+
+    def done(self) -> bool:
+        return TaskStatus(self.status()).is_terminal()
+
+    def result(self, timeout: float = 60.0, poll_interval: float = 0.01) -> Any:
+        """Poll until terminal; return the deserialized value or raise
+        :class:`TaskFailedError` with the deserialized exception."""
+        deadline = time.monotonic() + timeout
+        while True:
+            status, payload = self.client.raw_result(self.task_id)
+            if TaskStatus(status).is_terminal():
+                value = deserialize(payload)
+                if status == str(TaskStatus.FAILED):
+                    raise TaskFailedError(self.task_id, value)
+                return value
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"task {self.task_id} still {status} after {timeout}s"
+                )
+            time.sleep(poll_interval)
+
+
+class FaaSClient:
+    def __init__(self, base_url: str = "http://127.0.0.1:8000") -> None:
+        self.base_url = base_url.rstrip("/")
+        self.http = requests.Session()
+
+    # -- raw endpoints (wire format identical to SURVEY §0.1) --------------
+    def register_payload(self, name: str, payload: str) -> str:
+        r = self.http.post(
+            f"{self.base_url}/register_function",
+            json={"name": name, "payload": payload},
+        )
+        r.raise_for_status()
+        return r.json()["function_id"]
+
+    def execute_payload(self, function_id: str, payload: str) -> str:
+        r = self.http.post(
+            f"{self.base_url}/execute_function",
+            json={"function_id": function_id, "payload": payload},
+        )
+        r.raise_for_status()
+        return r.json()["task_id"]
+
+    def status(self, task_id: str) -> str:
+        r = self.http.get(f"{self.base_url}/status/{task_id}")
+        r.raise_for_status()
+        return r.json()["status"]
+
+    def raw_result(self, task_id: str) -> tuple[str, str]:
+        r = self.http.get(f"{self.base_url}/result/{task_id}")
+        r.raise_for_status()
+        body = r.json()
+        return body["status"], body["result"]
+
+    # -- ergonomic layer ---------------------------------------------------
+    def register(self, fn: Callable, name: str | None = None) -> str:
+        return self.register_payload(name or fn.__name__, serialize(fn))
+
+    def submit(self, function_id: str, *args: Any, **kwargs: Any) -> TaskHandle:
+        payload = pack_params(*args, **kwargs)
+        return TaskHandle(self, self.execute_payload(function_id, payload))
+
+    def run(
+        self, fn: Callable, *args: Any, timeout: float = 60.0, **kwargs: Any
+    ) -> Any:
+        """Register + submit + wait, in one call."""
+        return self.submit(self.register(fn), *args, **kwargs).result(timeout)

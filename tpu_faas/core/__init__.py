@@ -1,0 +1,19 @@
+"""Execution core: serialization, task model, and the function executor.
+
+Equivalent capability surface to the reference's helper_functions.py
+(serialize/deserialize/execute_fn, reference helper_functions.py:5-28).
+"""
+
+from tpu_faas.core.serialize import serialize, deserialize
+from tpu_faas.core.task import TaskStatus, Task, new_task_id
+from tpu_faas.core.executor import execute_fn, ExecutionResult
+
+__all__ = [
+    "serialize",
+    "deserialize",
+    "TaskStatus",
+    "Task",
+    "new_task_id",
+    "execute_fn",
+    "ExecutionResult",
+]

@@ -1,0 +1,59 @@
+"""The function executor: deserialize, call, catch everything, reserialize.
+
+Capability contract (reference helper_functions.py:11-28):
+
+- params decode to a pair ``(args_tuple, kwargs_dict)`` and the call is
+  ``fn(*args, **kwargs)``;
+- ANY exception — raised while deserializing the function, deserializing the
+  params, or running the function — yields status FAILED with the serialized
+  exception as the result; success yields COMPLETED with the serialized
+  return value;
+- the return triple ``(task_id, status, ser_result)`` is what worker pools
+  hand back to their drain loops.
+
+This function is the unit every execution backend shares: the local
+dispatcher pool, pull workers, and push workers all ``apply_async`` it
+(reference task_dispatcher.py:83-86, pull_worker.py:63-72, push_worker.py:117-123).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from tpu_faas.core.serialize import deserialize, serialize
+from tpu_faas.core.task import TaskStatus
+
+
+class ExecutionResult(NamedTuple):
+    task_id: str
+    status: str  # plain string: "COMPLETED" | "FAILED" (wire/store form)
+    result: str  # serialized payload (value or exception)
+
+
+def execute_fn(task_id: str, ser_fn: str, ser_params: str) -> ExecutionResult:
+    """Execute one task; never raises.
+
+    Runs in worker pool child processes — keep it dependency-light and make
+    sure every outcome is expressible as a serializable (status, result) pair.
+    """
+    try:
+        fn = deserialize(ser_fn)
+        params = deserialize(ser_params)
+        args, kwargs = params  # contract: (args_tuple, kwargs_dict)
+        result = fn(*args, **kwargs)
+        return ExecutionResult(task_id, str(TaskStatus.COMPLETED), serialize(result))
+    except Exception as exc:  # catch-all FAILED semantics
+        try:
+            payload = serialize(exc)
+            deserialize(payload)  # exception must round-trip for the client
+        except Exception:
+            # exception not round-trippable (holds a lock/socket, or is a
+            # class the consumer can't reconstruct): degrade to its repr
+            # rather than hand the client an unloadable payload
+            payload = serialize(RuntimeError(repr(exc)))
+        return ExecutionResult(task_id, str(TaskStatus.FAILED), payload)
+
+
+def pack_params(*args: object, **kwargs: object) -> str:
+    """Serialize a call's params in the wire format ``(args_tuple, kwargs_dict)``."""
+    return serialize((args, kwargs))

@@ -1,0 +1,30 @@
+"""Object <-> string serialization for functions, params, results, and messages.
+
+Capability contract (reference helper_functions.py:5-9): any Python object is
+dill-pickled and base64-encoded into a plain ASCII string; the inverse decodes.
+Everything on the wire — registered functions, call params, results, and every
+ZMQ message body — travels as such strings. A deliberate consequence (reference
+SURVEY §3.3): because payloads cross multiprocessing pipes as *strings*, lambdas
+and closures survive the pool boundary even though the stdlib pickler used by
+multiprocessing cannot pickle them directly.
+"""
+
+from __future__ import annotations
+
+import base64
+
+import dill
+
+
+def serialize(obj: object) -> str:
+    """Serialize any Python object to an ASCII-safe string (dill -> base64)."""
+    return base64.b64encode(dill.dumps(obj, recurse=True)).decode("ascii")
+
+
+def deserialize(payload: str) -> object:
+    """Inverse of :func:`serialize`.
+
+    Raises whatever dill/base64 raise on malformed input; callers that need
+    the catch-all FAILED semantics wrap this (see core.executor.execute_fn).
+    """
+    return dill.loads(base64.b64decode(payload.encode("ascii")))

@@ -1,0 +1,73 @@
+"""Task model: ids, lifecycle statuses, and the per-task record.
+
+Lifecycle contract (reference SURVEY §0.1; status enum observed at reference
+test_suit.py:19): QUEUED -> RUNNING -> COMPLETED | FAILED. Statuses are plain
+strings on the wire and in the store.
+"""
+
+from __future__ import annotations
+
+import enum
+import uuid
+from dataclasses import dataclass, field
+
+
+class TaskStatus(str, enum.Enum):
+    QUEUED = "QUEUED"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    FAILED = "FAILED"
+
+    def is_terminal(self) -> bool:
+        return self in (TaskStatus.COMPLETED, TaskStatus.FAILED)
+
+    def __str__(self) -> str:  # plain string on the wire
+        return self.value
+
+
+#: Store hash field names, one hash per task (reference contract demonstrated
+#: by old/client_debug.py:40-45 and read back at task_dispatcher.py:48-52).
+FIELD_STATUS = "status"
+FIELD_FN = "fn_payload"
+FIELD_PARAMS = "param_payload"
+FIELD_RESULT = "result"
+
+
+def new_task_id() -> str:
+    return str(uuid.uuid4())
+
+
+def new_function_id() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class Task:
+    """In-memory view of one task's store hash."""
+
+    task_id: str
+    status: TaskStatus = TaskStatus.QUEUED
+    fn_payload: str = ""
+    param_payload: str = ""
+    result: str = "None"
+    #: Scheduler-side metadata (not part of the reference contract): an
+    #: estimated execution cost used to build the tasks x workers cost matrix.
+    cost_estimate: float = field(default=1.0, compare=False)
+
+    def to_fields(self) -> dict[str, str]:
+        return {
+            FIELD_STATUS: str(self.status),
+            FIELD_FN: self.fn_payload,
+            FIELD_PARAMS: self.param_payload,
+            FIELD_RESULT: self.result,
+        }
+
+    @classmethod
+    def from_fields(cls, task_id: str, fields: dict[str, str]) -> "Task":
+        return cls(
+            task_id=task_id,
+            status=TaskStatus(fields.get(FIELD_STATUS, "QUEUED")),
+            fn_payload=fields.get(FIELD_FN, ""),
+            param_payload=fields.get(FIELD_PARAMS, ""),
+            result=fields.get(FIELD_RESULT, "None"),
+        )

@@ -1,0 +1,77 @@
+"""Dispatcher CLI (analog of reference task_dispatcher.py:474-545).
+
+    python -m tpu_faas.dispatch -m local -w 4 --store resp://127.0.0.1:6380
+    python -m tpu_faas.dispatch -m pull -p 5555
+    python -m tpu_faas.dispatch -m push -p 5555 [--hb] [--plb]
+    python -m tpu_faas.dispatch -m tpu-push -p 5555
+
+Modes pull/push/tpu-push are added by their respective milestones; the CLI
+rejects modes whose implementation is not present yet rather than silently
+doing nothing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from tpu_faas.utils.config import Config
+from tpu_faas.utils.logging import get_logger
+
+log = get_logger("dispatch.cli")
+
+
+def main(argv: list[str] | None = None) -> None:
+    cfg = Config.load()
+    ap = argparse.ArgumentParser(description="tpu-faas task dispatcher")
+    ap.add_argument(
+        "-m",
+        "--mode",
+        required=True,
+        choices=["local", "pull", "push", "tpu-push"],
+    )
+    ap.add_argument(
+        "-p", "--port", type=int, default=cfg.dispatcher_port,
+        help="worker-facing port",
+    )
+    ap.add_argument("-i", "--ip", default=cfg.dispatcher_ip, help="worker-facing bind ip")
+    ap.add_argument("-w", "--num-workers", type=int, default=4, help="local pool size")
+    ap.add_argument("--store", default=cfg.store_url)
+    ap.add_argument("--hb", action="store_true", help="push: heartbeat mode")
+    ap.add_argument("--plb", action="store_true", help="push: process-level balancing")
+    ap.add_argument(
+        "-d", "--delay", type=float, default=0.0, help="startup delay seconds"
+    )
+    ns = ap.parse_args(argv)
+    if ns.delay:
+        time.sleep(ns.delay)
+
+    if ns.mode == "local":
+        from tpu_faas.dispatch.local import LocalDispatcher
+
+        d = LocalDispatcher(num_workers=ns.num_workers, store_url=ns.store)
+        log.info("local dispatcher: pool=%d store=%s", ns.num_workers, ns.store)
+        d.start()
+        return
+
+    try:
+        if ns.mode == "pull":
+            from tpu_faas.dispatch.pull import PullDispatcher as cls
+        elif ns.mode == "push":
+            from tpu_faas.dispatch.push import PushDispatcher as cls
+        else:
+            from tpu_faas.dispatch.tpu_push import TpuPushDispatcher as cls
+    except ImportError as exc:
+        sys.exit(f"dispatcher mode {ns.mode!r} is not available: {exc}")
+
+    kwargs = dict(ip=ns.ip, port=ns.port, store_url=ns.store)
+    if ns.mode == "push":
+        kwargs.update(heartbeat=ns.hb, process_lb=ns.plb)
+    d = cls(**kwargs)
+    log.info("%s dispatcher on %s:%d", ns.mode, ns.ip, ns.port)
+    d.start()
+
+
+if __name__ == "__main__":
+    main()

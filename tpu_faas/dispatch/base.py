@@ -1,0 +1,104 @@
+"""Dispatcher base: store connection, announce subscription, task intake.
+
+Equivalent role to the reference's TaskDispatcher base class (reference
+task_dispatcher.py:27-52): owns the store client plus a subscription to the
+announce channel, and turns one announce message into a (task_id, fn_payload,
+param_payload) triple.
+
+Differences from the reference, by design:
+
+- the store is injected by URL, not hard-coded (reference hard-codes Redis
+  localhost:6379 db=1 at task_dispatcher.py:32 despite config keys);
+- `poll_next_task` can batch-drain up to ``max_n`` announcements per tick —
+  the reference reads at most one message per loop iteration
+  (task_dispatcher.py:75,170,299), which caps dispatch throughput at one task
+  per tick; batching is what lets the TPU backend schedule thousands of
+  pending tasks in one device step;
+- a clean ``stop()`` for tests (the reference loops forever).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
+from tpu_faas.store.launch import make_store
+from tpu_faas.core.task import TaskStatus
+from tpu_faas.utils.logging import get_logger
+
+
+@dataclass
+class PendingTask:
+    task_id: str
+    fn_payload: str
+    param_payload: str
+
+    @property
+    def size_estimate(self) -> float:
+        """Crude task-cost signal for the scheduler's cost matrix: payload
+        bytes (serialized params dominate for data-heavy tasks)."""
+        return float(len(self.fn_payload) + len(self.param_payload))
+
+
+class TaskDispatcher:
+    """Base: store + announce subscription + intake. Subclasses add a loop."""
+
+    def __init__(
+        self,
+        store_url: str = "memory://",
+        channel: str = TASKS_CHANNEL,
+        store: TaskStore | None = None,
+    ) -> None:
+        self.store = store if store is not None else make_store(store_url)
+        self.channel = channel
+        self.subscriber = self.store.subscribe(channel)
+        self.log = get_logger(type(self).__name__)
+        self._stop_event = threading.Event()
+
+    # -- intake ------------------------------------------------------------
+    def poll_next_task(self) -> PendingTask | None:
+        """Non-blocking: one announcement -> payload fetch (reference
+        query_redis, task_dispatcher.py:38-52). Announcements whose hash has
+        vanished (e.g. flushed store) are skipped, moving straight on to the
+        next buffered announcement — None strictly means "bus empty"."""
+        while True:
+            msg = self.subscriber.get_message()
+            if msg is None:
+                return None
+            try:
+                fn_payload, param_payload = self.store.get_payloads(msg)
+            except KeyError:
+                self.log.warning("announce for unknown task %s; skipping", msg)
+                continue
+            return PendingTask(msg, fn_payload, param_payload)
+
+    def poll_tasks(self, max_n: int) -> list[PendingTask]:
+        """Batch intake: drain up to max_n announcements."""
+        out: list[PendingTask] = []
+        for _ in range(max_n):
+            t = self.poll_next_task()
+            if t is None:
+                break
+            out.append(t)
+        return out
+
+    # -- store writes ------------------------------------------------------
+    def mark_running(self, task_id: str) -> None:
+        self.store.set_status(task_id, TaskStatus.RUNNING)
+
+    def record_result(self, task_id: str, status: str, result: str) -> None:
+        self.store.finish_task(task_id, status, result)
+
+    # -- lifecycle ---------------------------------------------------------
+    def stop(self) -> None:
+        self._stop_event.set()
+
+    @property
+    def stopping(self) -> bool:
+        return self._stop_event.is_set()
+
+    def close(self) -> None:
+        self.stop()
+        self.subscriber.close()
+        self.store.close()

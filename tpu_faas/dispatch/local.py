@@ -1,0 +1,117 @@
+"""Local dispatch mode: one in-process process pool, no network.
+
+Capability parity with reference LocalDispatcher (task_dispatcher.py:59-103):
+admission-controlled intake (only read the announce bus while the pool has a
+free slot), execute via ``execute_fn`` in pool children, write terminal
+status+result back to the store. Purpose: the no-network baseline that
+isolates communication overhead (reference README:41).
+
+Design differences:
+
+- completions land on a thread-safe queue via future done-callbacks instead
+  of the reference's deque-rotation scan (task_dispatcher.py:88-103) — O(1)
+  drain, no polling latency on results;
+- a ``ProcessPoolExecutor`` (forkserver context: never fork a multi-threaded
+  process) instead of ``mp.Pool``: if a child dies mid-task (user code calls
+  os._exit, OOM-kill), the broken pool surfaces as exceptions on in-flight
+  futures, which we convert to FAILED results and recover from by rebuilding
+  the pool — the reference would silently leak a pool slot forever.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue
+import time
+from concurrent.futures import Future, ProcessPoolExecutor
+from concurrent.futures.process import BrokenProcessPool
+
+from tpu_faas.core.executor import ExecutionResult, execute_fn
+from tpu_faas.core.serialize import serialize
+from tpu_faas.core.task import TaskStatus
+from tpu_faas.dispatch.base import TaskDispatcher
+
+
+class LocalDispatcher(TaskDispatcher):
+    def __init__(
+        self,
+        num_workers: int = 4,
+        store_url: str = "memory://",
+        store=None,
+        channel: str = "tasks",
+        idle_sleep: float = 0.001,
+    ) -> None:
+        super().__init__(store_url=store_url, channel=channel, store=store)
+        self.num_workers = num_workers
+        self.idle_sleep = idle_sleep
+        self._done: queue.Queue[tuple[str, Future]] = queue.Queue()
+        self._busy = 0
+
+    def _make_pool(self) -> ProcessPoolExecutor:
+        return ProcessPoolExecutor(
+            max_workers=self.num_workers,
+            mp_context=mp.get_context("forkserver"),
+        )
+
+    def _submit(self, pool: ProcessPoolExecutor, task) -> None:
+        self.mark_running(task.task_id)
+        fut = pool.submit(
+            execute_fn, task.task_id, task.fn_payload, task.param_payload
+        )
+        fut.add_done_callback(
+            lambda f, tid=task.task_id: self._done.put((tid, f))
+        )
+        self._busy += 1
+
+    def _drain_one(self) -> bool:
+        try:
+            task_id, fut = self._done.get_nowait()
+        except queue.Empty:
+            return False
+        exc = fut.exception()
+        if exc is None:
+            res: ExecutionResult = fut.result()
+            self.record_result(res.task_id, res.status, res.result)
+        else:
+            # child died or result transfer failed: the task is FAILED, the
+            # slot is reclaimed (reference leaks it — SURVEY §2 LocalDispatcher)
+            self.record_result(
+                task_id, str(TaskStatus.FAILED), serialize(RuntimeError(str(exc)))
+            )
+        self._busy -= 1
+        return True
+
+    def start(self, max_tasks: int | None = None) -> int:
+        """Run the dispatch loop; returns number of tasks completed.
+
+        ``max_tasks`` bounds the run for tests/benchmarks; None = run until
+        ``stop()``.
+        """
+        completed = 0
+        pool = self._make_pool()
+        try:
+            while not self.stopping:
+                progressed = False
+                # admission-controlled intake (reference task_dispatcher.py:73-75)
+                while self._busy < self.num_workers:
+                    task = self.poll_next_task()
+                    if task is None:
+                        break
+                    try:
+                        self._submit(pool, task)
+                    except BrokenProcessPool:
+                        pool.shutdown(wait=False, cancel_futures=True)
+                        pool = self._make_pool()
+                        self._submit(pool, task)
+                    progressed = True
+                # drain completions
+                while self._drain_one():
+                    completed += 1
+                    progressed = True
+                if max_tasks is not None and completed >= max_tasks:
+                    break
+                if not progressed:
+                    time.sleep(self.idle_sleep)
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+        return completed

@@ -1,0 +1,3 @@
+from tpu_faas.gateway.app import main
+
+main()

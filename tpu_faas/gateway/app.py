@@ -1,0 +1,211 @@
+"""aiohttp REST gateway.
+
+API surface (SURVEY §0.1, recovered from reference client usage
+test_client.py:98-126, test_suit.py:39-91):
+
+- ``POST /register_function``  {"name": str, "payload": ser_fn}
+    -> {"function_id": str}
+- ``POST /execute_function``   {"function_id": str, "payload": ser_params}
+    -> {"task_id": str}      (404 if function_id unknown)
+- ``GET /status/{task_id}``    -> {"task_id", "status"}
+- ``GET /result/{task_id}``    -> {"task_id", "status", "result"}
+
+Store-side contract on execute (reference old/client_debug.py:40-45): write the
+full task hash (status QUEUED, fn_payload, param_payload, result "None") then
+PUBLISH the task_id on the announce channel.
+
+Registered functions are stored under ``function:<id>`` hashes so any number of
+gateway replicas share one registry through the store. Store calls are blocking
+(RESP over local TCP); they run on the event loop's default executor so slow
+store I/O never stalls the accept loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import functools
+import threading
+from dataclasses import dataclass
+
+from aiohttp import web
+
+from tpu_faas.core.task import new_function_id, new_task_id
+from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
+from tpu_faas.store.launch import make_store
+from tpu_faas.utils.logging import get_logger
+
+log = get_logger("gateway")
+
+_FUNCTION_PREFIX = "function:"
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+async def _run_blocking(fn, *args):
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, functools.partial(fn, *args))
+
+
+@dataclass
+class GatewayContext:
+    store: TaskStore
+    channel: str = TASKS_CHANNEL
+
+
+CTX_KEY: web.AppKey["GatewayContext"] = web.AppKey("ctx", GatewayContext)
+
+
+def make_app(store: TaskStore, channel: str = TASKS_CHANNEL) -> web.Application:
+    ctx = GatewayContext(store=store, channel=channel)
+    app = web.Application(client_max_size=256 * 1024 * 1024)
+    app[CTX_KEY] = ctx
+    app.router.add_post("/register_function", register_function)
+    app.router.add_post("/execute_function", execute_function)
+    app.router.add_get("/status/{task_id}", get_status)
+    app.router.add_get("/result/{task_id}", get_result)
+    app.router.add_get("/healthz", healthz)
+    return app
+
+
+async def register_function(request: web.Request) -> web.Response:
+    ctx: GatewayContext = request.app[CTX_KEY]
+    try:
+        body = await request.json()
+        name, payload = body["name"], body["payload"]
+    except Exception:
+        return _json_error(400, "expected JSON body with 'name' and 'payload'")
+    function_id = new_function_id()
+    await _run_blocking(
+        ctx.store.hset,
+        _FUNCTION_PREFIX + function_id,
+        {"name": name, "payload": payload},
+    )
+    return web.json_response({"function_id": function_id})
+
+
+async def execute_function(request: web.Request) -> web.Response:
+    ctx: GatewayContext = request.app[CTX_KEY]
+    try:
+        body = await request.json()
+        function_id, param_payload = body["function_id"], body["payload"]
+    except Exception:
+        return _json_error(400, "expected JSON body with 'function_id' and 'payload'")
+    fn_payload = await _run_blocking(
+        ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
+    )
+    if fn_payload is None:
+        return _json_error(404, f"unknown function_id {function_id!r}")
+    task_id = new_task_id()
+
+    def write_task() -> None:
+        ctx.store.create_task(task_id, fn_payload, param_payload, ctx.channel)
+
+    await _run_blocking(write_task)
+    return web.json_response({"task_id": task_id})
+
+
+async def get_status(request: web.Request) -> web.Response:
+    ctx: GatewayContext = request.app[CTX_KEY]
+    task_id = request.match_info["task_id"]
+    status = await _run_blocking(ctx.store.get_status, task_id)
+    if status is None:
+        return _json_error(404, f"unknown task_id {task_id!r}")
+    return web.json_response({"task_id": task_id, "status": status})
+
+
+async def get_result(request: web.Request) -> web.Response:
+    ctx: GatewayContext = request.app[CTX_KEY]
+    task_id = request.match_info["task_id"]
+    status, result = await _run_blocking(ctx.store.get_result, task_id)
+    if status is None:
+        return _json_error(404, f"unknown task_id {task_id!r}")
+    return web.json_response(
+        {"task_id": task_id, "status": status, "result": result}
+    )
+
+
+async def healthz(request: web.Request) -> web.Response:
+    return web.json_response({"ok": True})
+
+
+# -- serving ----------------------------------------------------------------
+
+
+@dataclass
+class GatewayHandle:
+    host: str
+    port: int
+    thread: threading.Thread
+    _loop: asyncio.AbstractEventLoop
+    _stop: asyncio.Event
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._loop.call_soon_threadsafe(self._stop.set)
+        self.thread.join(timeout=10)
+
+
+def start_gateway_thread(
+    store: TaskStore,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    channel: str = TASKS_CHANNEL,
+) -> GatewayHandle:
+    """Serve the gateway in a daemon thread; returns once the port is bound."""
+    started = threading.Event()
+    holder: dict[str, object] = {}
+
+    def run() -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        stop = asyncio.Event()
+        holder["loop"], holder["stop"] = loop, stop
+
+        async def main() -> None:
+            runner = web.AppRunner(make_app(store, channel))
+            await runner.setup()
+            site = web.TCPSite(runner, host, port)
+            await site.start()
+            holder["port"] = runner.addresses[0][1]
+            started.set()
+            await stop.wait()
+            await runner.cleanup()
+
+        loop.run_until_complete(main())
+        loop.close()
+
+    thread = threading.Thread(target=run, name="tpu-faas-gateway", daemon=True)
+    thread.start()
+    if not started.wait(timeout=10):
+        raise RuntimeError("gateway failed to start")
+    return GatewayHandle(
+        host=host,
+        port=holder["port"],  # type: ignore[arg-type]
+        thread=thread,
+        _loop=holder["loop"],  # type: ignore[arg-type]
+        _stop=holder["stop"],  # type: ignore[arg-type]
+    )
+
+
+def main(argv: list[str] | None = None) -> None:
+    from tpu_faas.utils.config import Config
+
+    cfg = Config.load()
+    ap = argparse.ArgumentParser(description="tpu-faas REST gateway")
+    ap.add_argument("--host", default=cfg.gateway_host)
+    ap.add_argument("--port", type=int, default=cfg.gateway_port)
+    ap.add_argument("--store", default=cfg.store_url)
+    ns = ap.parse_args(argv)
+    store = make_store(ns.store)
+    log.info("gateway on %s:%d (store %s)", ns.host, ns.port, ns.store)
+    web.run_app(make_app(store), host=ns.host, port=ns.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

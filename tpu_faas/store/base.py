@@ -1,0 +1,148 @@
+"""Abstract task store + announce bus interface.
+
+Operations are the minimal set the reference exercises against Redis:
+
+- hash per task: HSET/HGET/HGETALL (reference task_dispatcher.py:48-52,
+  85-86, 153-156, 288-295; gateway side per SURVEY §0.1);
+- announce bus: PUBLISH task_id on a channel at submit time; the dispatcher
+  SUBSCRIBEs and drains at most one message per tick via a non-blocking
+  ``get_message()`` (reference task_dispatcher.py:75,170,299,394,452) so that
+  back-pressure stays implicit — unread announcements buffer in the
+  subscription;
+- FLUSHDB between benchmark runs (reference client_performance.py:152,253).
+
+Task-level conveniences (`create_task`, `finish_task`, ...) wrap the raw hash
+ops so call sites stay readable; both levels are part of the interface because
+the gateway writes the exact field contract while dispatchers read it.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Mapping
+
+from tpu_faas.core.task import (
+    FIELD_FN,
+    FIELD_PARAMS,
+    FIELD_RESULT,
+    FIELD_STATUS,
+    TaskStatus,
+)
+
+#: Default announce channel name (reference config.ini:7 `TASKS_CHANNEL=tasks`).
+TASKS_CHANNEL = "tasks"
+
+
+class Subscription(abc.ABC):
+    """A pub/sub subscription handle with a non-blocking drain."""
+
+    @abc.abstractmethod
+    def get_message(self, timeout: float = 0.0) -> str | None:
+        """Return the next published payload, or None if nothing is pending.
+
+        ``timeout`` > 0 blocks up to that many seconds. The default 0 makes a
+        dispatcher tick non-blocking, matching the reference's
+        ``subscriber.get_message()`` usage.
+        """
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    def __enter__(self) -> "Subscription":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.close()
+
+
+class TaskStore(abc.ABC):
+    """Hash-per-task store + announce bus."""
+
+    # -- raw hash ops ------------------------------------------------------
+    @abc.abstractmethod
+    def hset(self, key: str, fields: Mapping[str, str]) -> None: ...
+
+    @abc.abstractmethod
+    def hget(self, key: str, field: str) -> str | None: ...
+
+    @abc.abstractmethod
+    def hgetall(self, key: str) -> dict[str, str]: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> None: ...
+
+    @abc.abstractmethod
+    def keys(self) -> list[str]: ...
+
+    # -- announce bus ------------------------------------------------------
+    @abc.abstractmethod
+    def publish(self, channel: str, payload: str) -> None: ...
+
+    @abc.abstractmethod
+    def subscribe(self, channel: str) -> Subscription: ...
+
+    # -- admin -------------------------------------------------------------
+    @abc.abstractmethod
+    def flush(self) -> None:
+        """Drop all hashes (FLUSHDB equivalent). Subscriptions stay open."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    def ping(self) -> bool:
+        return True
+
+    # -- task-level conveniences ------------------------------------------
+    def create_task(
+        self,
+        task_id: str,
+        fn_payload: str,
+        param_payload: str,
+        channel: str = TASKS_CHANNEL,
+    ) -> None:
+        """Write the gateway-side contract: full hash then announce.
+
+        Field set and QUEUED initial status per SURVEY §0.1 (demonstrated in
+        the reference by old/client_debug.py:40-45).
+        """
+        self.hset(
+            task_id,
+            {
+                FIELD_STATUS: str(TaskStatus.QUEUED),
+                FIELD_FN: fn_payload,
+                FIELD_PARAMS: param_payload,
+                FIELD_RESULT: "None",
+            },
+        )
+        self.publish(channel, task_id)
+
+    def get_payloads(self, task_id: str) -> tuple[str, str]:
+        """Fetch (fn_payload, param_payload) in one round-trip —
+        dispatcher-side read (reference task_dispatcher.py:48-52 does two
+        HGETs; HGETALL halves the store RTTs on the intake hot path)."""
+        fields = self.hgetall(task_id)
+        if FIELD_FN not in fields or FIELD_PARAMS not in fields:
+            raise KeyError(f"unknown task {task_id!r}")
+        return fields[FIELD_FN], fields[FIELD_PARAMS]
+
+    def set_status(self, task_id: str, status: TaskStatus | str) -> None:
+        self.hset(task_id, {FIELD_STATUS: str(status)})
+
+    def get_status(self, task_id: str) -> str | None:
+        return self.hget(task_id, FIELD_STATUS)
+
+    def finish_task(self, task_id: str, status: TaskStatus | str, result: str) -> None:
+        """Record a terminal status + serialized result in one write
+        (reference task_dispatcher.py:153-156, 284-295)."""
+        self.hset(task_id, {FIELD_STATUS: str(status), FIELD_RESULT: result})
+
+    def get_result(self, task_id: str) -> tuple[str | None, str | None]:
+        """(status, result) in one round-trip — the client poll hot path."""
+        fields = self.hgetall(task_id)
+        return fields.get(FIELD_STATUS), fields.get(FIELD_RESULT)
+
+    def __enter__(self) -> "TaskStore":
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.close()

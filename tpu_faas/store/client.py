@@ -1,0 +1,156 @@
+"""Blocking TCP client for the RESP store servers (TaskStore implementation).
+
+Works against the Python asyncio server (store/server.py), the native C++
+server (native/store_server.cpp), or a real Redis. Mirrors the structure the
+reference gets from redis-py: one connection for commands, one dedicated
+connection per pub/sub subscription with a non-blocking ``get_message()``
+(reference task_dispatcher.py:34-36, 75).
+
+Thread-safety: command calls are serialized by a lock, so one RespStore can be
+shared across gateway/dispatcher threads; each Subscription owns its socket.
+"""
+
+from __future__ import annotations
+
+import select
+import socket
+import threading
+from typing import Mapping
+
+from tpu_faas.store import resp
+from tpu_faas.store.base import Subscription, TaskStore
+
+
+class _Conn:
+    """One blocking RESP connection."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0) -> None:
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.parser = resp.RespParser()
+
+    def send(self, *parts: str | bytes | int) -> None:
+        self.sock.sendall(resp.encode_command(*parts))
+
+    def recv_reply(self):
+        while True:
+            item = self.parser.pop()
+            if item is not resp.NEED_MORE:
+                if isinstance(item, resp.RespError):
+                    raise item
+                return item
+            data = self.sock.recv(65536)
+            if not data:
+                raise ConnectionError("store connection closed")
+            self.parser.feed(data)
+
+    def command(self, *parts: str | bytes | int):
+        self.send(*parts)
+        return self.recv_reply()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class _RespSubscription(Subscription):
+    """Dedicated connection subscribed to one channel."""
+
+    def __init__(self, host: str, port: int, channel: str) -> None:
+        self._conn = _Conn(host, port)
+        self._channel = channel
+        reply = self._conn.command("SUBSCRIBE", channel)
+        if not (isinstance(reply, list) and reply[0] == "subscribe"):
+            raise resp.RespError(f"unexpected SUBSCRIBE reply: {reply!r}")
+
+    def get_message(self, timeout: float = 0.0) -> str | None:
+        # First drain anything already parsed/buffered.
+        item = self._conn.parser.pop()
+        while item is not resp.NEED_MORE:
+            payload = self._decode_push(item)
+            if payload is not None:
+                return payload
+            item = self._conn.parser.pop()
+        # Then poll the socket.
+        deadline = None if timeout <= 0 else timeout
+        while True:
+            ready, _, _ = select.select([self._conn.sock], [], [], deadline or 0)
+            if not ready:
+                return None
+            data = self._conn.sock.recv(65536)
+            if not data:
+                raise ConnectionError("subscription connection closed")
+            self._conn.parser.feed(data)
+            item = self._conn.parser.pop()
+            while item is not resp.NEED_MORE:
+                payload = self._decode_push(item)
+                if payload is not None:
+                    return payload
+                item = self._conn.parser.pop()
+            # Partial message: keep waiting within the same timeout window.
+            # (Simplification: we don't decrement the deadline; pub/sub frames
+            # are tiny so a partial read resolves on the next recv.)
+
+    @staticmethod
+    def _decode_push(item) -> str | None:
+        if (
+            isinstance(item, list)
+            and len(item) == 3
+            and item[0] == "message"
+        ):
+            return item[2]
+        return None  # subscribe/unsubscribe confirmations etc.
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class RespStore(TaskStore):
+    def __init__(self, host: str = "127.0.0.1", port: int = 6380) -> None:
+        self.host = host
+        self.port = port
+        self._lock = threading.Lock()
+        self._conn = _Conn(host, port)
+
+    def _command(self, *parts: str | bytes | int):
+        with self._lock:
+            return self._conn.command(*parts)
+
+    # -- raw hash ops ------------------------------------------------------
+    def hset(self, key: str, fields: Mapping[str, str]) -> None:
+        flat: list[str] = []
+        for f, v in fields.items():
+            flat.extend((f, v))
+        self._command("HSET", key, *flat)
+
+    def hget(self, key: str, field: str) -> str | None:
+        return self._command("HGET", key, field)
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        flat = self._command("HGETALL", key)
+        return dict(zip(flat[0::2], flat[1::2]))
+
+    def delete(self, key: str) -> None:
+        self._command("DEL", key)
+
+    def keys(self) -> list[str]:
+        return self._command("KEYS", "*")
+
+    # -- announce bus ------------------------------------------------------
+    def publish(self, channel: str, payload: str) -> None:
+        self._command("PUBLISH", channel, payload)
+
+    def subscribe(self, channel: str) -> Subscription:
+        return _RespSubscription(self.host, self.port, channel)
+
+    # -- admin -------------------------------------------------------------
+    def flush(self) -> None:
+        self._command("FLUSHDB")
+
+    def ping(self) -> bool:
+        return self._command("PING") == "PONG"
+
+    def close(self) -> None:
+        self._conn.close()

@@ -1,0 +1,104 @@
+"""Store factory + in-thread server launcher.
+
+URL scheme used across CLIs and configs:
+
+- ``memory://``            in-process MemoryStore (single-process modes/tests)
+- ``resp://host:port``     TCP client to any RESP store server (ours or Redis)
+
+`start_store_thread` runs the Python asyncio server inside a daemon thread and
+returns a handle — used by tests and by single-machine deployments that don't
+want a separate store process. Production path is the native C++ server
+(tpu_faas.store.native) or any Redis-compatible endpoint.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from dataclasses import dataclass
+from urllib.parse import urlparse
+
+from tpu_faas.store.base import TaskStore
+from tpu_faas.store.client import RespStore
+from tpu_faas.store.memory import MemoryStore
+from tpu_faas.store.server import StoreServer
+
+_SHARED_MEMORY_STORE: MemoryStore | None = None
+_SHARED_LOCK = threading.Lock()
+
+
+def make_store(url: str) -> TaskStore:
+    """Create a TaskStore from a URL.
+
+    ``memory://`` returns a process-wide shared MemoryStore (so a gateway and
+    dispatcher running in one process see the same tasks); ``memory://fresh``
+    returns a private instance.
+    """
+    parsed = urlparse(url)
+    if parsed.scheme == "memory":
+        if parsed.netloc == "fresh" or parsed.path == "/fresh":
+            return MemoryStore()
+        global _SHARED_MEMORY_STORE
+        with _SHARED_LOCK:
+            if _SHARED_MEMORY_STORE is None:
+                _SHARED_MEMORY_STORE = MemoryStore()
+            return _SHARED_MEMORY_STORE
+    if parsed.scheme in ("resp", "redis", "tcp"):
+        host = parsed.hostname or "127.0.0.1"
+        port = parsed.port or 6380
+        return RespStore(host, port)
+    raise ValueError(f"unknown store url scheme: {url!r}")
+
+
+@dataclass
+class StoreServerHandle:
+    server: StoreServer
+    thread: threading.Thread
+    loop: asyncio.AbstractEventLoop
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    @property
+    def url(self) -> str:
+        return f"resp://{self.server.host}:{self.server.port}"
+
+    def stop(self) -> None:
+        async def _stop() -> None:
+            await self.server.stop()
+
+        try:
+            fut = asyncio.run_coroutine_threadsafe(_stop(), self.loop)
+            fut.result(timeout=5)
+        except Exception:
+            pass
+        self.thread.join(timeout=5)
+
+
+def start_store_thread(host: str = "127.0.0.1", port: int = 0) -> StoreServerHandle:
+    """Start the Python store server in a daemon thread; returns once bound."""
+    server = StoreServer(host, port)
+    started = threading.Event()
+    loop_holder: dict[str, asyncio.AbstractEventLoop] = {}
+
+    def run() -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop_holder["loop"] = loop
+
+        async def main() -> None:
+            await server.start()
+            started.set()
+            await server.serve_forever()
+
+        try:
+            loop.run_until_complete(main())
+        finally:
+            loop.close()
+
+    thread = threading.Thread(target=run, name="tpu-faas-store", daemon=True)
+    thread.start()
+    if not started.wait(timeout=10):
+        raise RuntimeError("store server failed to start")
+    return StoreServerHandle(server=server, thread=thread, loop=loop_holder["loop"])

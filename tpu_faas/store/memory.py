@@ -1,0 +1,92 @@
+"""In-process task store: dict-of-hashes + per-channel fan-out queues.
+
+Thread-safe so a gateway thread, dispatcher thread, and test driver can share
+one instance. Pub/sub preserves the reference's fire-and-forget semantics:
+messages published while nobody is subscribed are dropped, and each subscriber
+gets its own copy (Redis pub/sub behavior the dispatcher relies on — see
+SURVEY §5.4 on stranded announcements).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Mapping
+
+from tpu_faas.store.base import Subscription, TaskStore
+
+
+class _MemorySubscription(Subscription):
+    def __init__(self, store: "MemoryStore", channel: str) -> None:
+        self._store = store
+        self._channel = channel
+        self._queue: queue.Queue[str] = queue.Queue()
+        self._closed = False
+
+    def get_message(self, timeout: float = 0.0) -> str | None:
+        try:
+            if timeout > 0:
+                return self._queue.get(timeout=timeout)
+            return self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._store._unsubscribe(self._channel, self)
+
+
+class MemoryStore(TaskStore):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._hashes: dict[str, dict[str, str]] = {}
+        self._subs: dict[str, list[_MemorySubscription]] = {}
+
+    # -- raw hash ops ------------------------------------------------------
+    def hset(self, key: str, fields: Mapping[str, str]) -> None:
+        with self._lock:
+            self._hashes.setdefault(key, {}).update(fields)
+
+    def hget(self, key: str, field: str) -> str | None:
+        with self._lock:
+            return self._hashes.get(key, {}).get(field)
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        with self._lock:
+            return dict(self._hashes.get(key, {}))
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._hashes.pop(key, None)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._hashes)
+
+    # -- announce bus ------------------------------------------------------
+    def publish(self, channel: str, payload: str) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for sub in subs:
+            sub._queue.put(payload)
+
+    def subscribe(self, channel: str) -> Subscription:
+        sub = _MemorySubscription(self, channel)
+        with self._lock:
+            self._subs.setdefault(channel, []).append(sub)
+        return sub
+
+    def _unsubscribe(self, channel: str, sub: _MemorySubscription) -> None:
+        with self._lock:
+            subs = self._subs.get(channel)
+            if subs and sub in subs:
+                subs.remove(sub)
+
+    # -- admin -------------------------------------------------------------
+    def flush(self) -> None:
+        with self._lock:
+            self._hashes.clear()
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,235 @@
+"""Python asyncio task-store server speaking the RESP2 subset.
+
+This is the portable fallback for the native C++ server (native/store_server.cpp);
+both expose the identical protocol, so `RespStore` clients and a real Redis are
+interchangeable. One asyncio task per connection; state is a plain dict guarded
+by the event loop's single-threadedness.
+
+Supported commands (the set the framework + the reference's usage of Redis
+require): PING, SELECT (accepted, ignored — the reference pins db=1,
+task_dispatcher.py:32), HSET, HGET, HGETALL, DEL, KEYS, PUBLISH, SUBSCRIBE,
+UNSUBSCRIBE, FLUSHDB, QUIT, SHUTDOWN.
+
+Run: ``python -m tpu_faas.store.server --port 6380``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import fnmatch
+from typing import Iterable
+
+from tpu_faas.store import resp
+
+
+class StoreState:
+    def __init__(self) -> None:
+        self.hashes: dict[str, dict[str, str]] = {}
+        # channel -> set of subscriber StreamWriters
+        self.subs: dict[str, set[asyncio.StreamWriter]] = {}
+        # all open connections, so stop() can close them (Python 3.12's
+        # Server.wait_closed() blocks until every handler returns)
+        self.conns: set[asyncio.StreamWriter] = set()
+
+
+class StoreServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 6380) -> None:
+        self.host = host
+        self.port = port
+        self.state = StoreState()
+        self._server: asyncio.AbstractServer | None = None
+        self._shutdown = asyncio.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        # If port was 0, record the actual bound port.
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._shutdown.wait()
+
+    async def stop(self) -> None:
+        self._shutdown.set()
+        if self._server is not None:
+            self._server.close()
+        for w in list(self.state.conns):
+            w.close()
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    # -- connection handling ----------------------------------------------
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        parser = resp.RespParser()
+        subscribed: set[str] = set()
+        self.state.conns.add(writer)
+        try:
+            while not reader.at_eof():
+                data = await reader.read(65536)
+                if not data:
+                    break
+                parser.feed(data)
+                try:
+                    cmds = parser.pop_all()
+                except resp.ProtocolError as exc:
+                    # non-RESP bytes (health probe, stray HTTP, telnet):
+                    # reply with an error and drop the connection
+                    writer.write(resp.encode_error(str(exc)))
+                    await writer.drain()
+                    return
+                for cmd in cmds:
+                    if not isinstance(cmd, list) or not cmd:
+                        writer.write(resp.encode_error("protocol error"))
+                        continue
+                    keep_going = await self._dispatch(cmd, writer, subscribed)
+                    if not keep_going:
+                        await writer.drain()
+                        return
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+        finally:
+            self.state.conns.discard(writer)
+            for ch in subscribed:
+                self.state.subs.get(ch, set()).discard(writer)
+            writer.close()
+
+    async def _dispatch(
+        self,
+        cmd: list[str],
+        writer: asyncio.StreamWriter,
+        subscribed: set[str],
+    ) -> bool:
+        name, args = cmd[0].upper(), cmd[1:]
+        st = self.state
+        if name == "PING":
+            writer.write(resp.encode_simple("PONG"))
+        elif name == "SELECT":
+            writer.write(resp.encode_simple("OK"))
+        elif name == "HSET":
+            if len(args) < 3 or len(args) % 2 == 0:
+                writer.write(resp.encode_error("wrong number of arguments for HSET"))
+                return True
+            h = st.hashes.setdefault(args[0], {})
+            added = 0
+            for f, v in zip(args[1::2], args[2::2]):
+                if f not in h:
+                    added += 1
+                h[f] = v
+            writer.write(resp.encode_integer(added))
+        elif name == "HGET":
+            if len(args) != 2:
+                writer.write(resp.encode_error("wrong number of arguments for HGET"))
+                return True
+            writer.write(resp.encode_bulk(st.hashes.get(args[0], {}).get(args[1])))
+        elif name == "HGETALL":
+            h = st.hashes.get(args[0], {}) if args else {}
+            flat: list[bytes] = []
+            for f, v in h.items():
+                flat.append(resp.encode_bulk(f))
+                flat.append(resp.encode_bulk(v))
+            writer.write(resp.encode_array(flat))
+        elif name == "DEL":
+            n = 0
+            for k in args:
+                if st.hashes.pop(k, None) is not None:
+                    n += 1
+            writer.write(resp.encode_integer(n))
+        elif name == "KEYS":
+            pattern = args[0] if args else "*"
+            ks = [k for k in st.hashes if fnmatch.fnmatchcase(k, pattern)]
+            writer.write(resp.encode_array([resp.encode_bulk(k) for k in ks]))
+        elif name == "PUBLISH":
+            if len(args) != 2:
+                writer.write(resp.encode_error("wrong number of arguments for PUBLISH"))
+                return True
+            n = await self._publish(args[0], args[1])
+            writer.write(resp.encode_integer(n))
+        elif name == "SUBSCRIBE":
+            for ch in args:
+                subscribed.add(ch)
+                st.subs.setdefault(ch, set()).add(writer)
+                writer.write(
+                    resp.encode_array(
+                        [
+                            resp.encode_bulk("subscribe"),
+                            resp.encode_bulk(ch),
+                            resp.encode_integer(len(subscribed)),
+                        ]
+                    )
+                )
+        elif name == "UNSUBSCRIBE":
+            channels: Iterable[str] = args or list(subscribed)
+            for ch in channels:
+                subscribed.discard(ch)
+                st.subs.get(ch, set()).discard(writer)
+                writer.write(
+                    resp.encode_array(
+                        [
+                            resp.encode_bulk("unsubscribe"),
+                            resp.encode_bulk(ch),
+                            resp.encode_integer(len(subscribed)),
+                        ]
+                    )
+                )
+        elif name == "FLUSHDB":
+            st.hashes.clear()
+            writer.write(resp.encode_simple("OK"))
+        elif name == "QUIT":
+            writer.write(resp.encode_simple("OK"))
+            return False
+        elif name == "SHUTDOWN":
+            self._shutdown.set()
+            return False
+        else:
+            writer.write(resp.encode_error(f"unknown command '{name}'"))
+        return True
+
+    async def _publish(self, channel: str, payload: str) -> int:
+        """Fan a message out to subscribers; fire-and-forget like Redis pub/sub."""
+        receivers = list(self.state.subs.get(channel, ()))
+        msg = resp.encode_array(
+            [
+                resp.encode_bulk("message"),
+                resp.encode_bulk(channel),
+                resp.encode_bulk(payload),
+            ]
+        )
+        n = 0
+        for w in receivers:
+            if w.is_closing():
+                self.state.subs[channel].discard(w)
+                continue
+            try:
+                w.write(msg)
+                n += 1
+            except (ConnectionResetError, BrokenPipeError):
+                self.state.subs[channel].discard(w)
+        return n
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="tpu-faas task store server (Python)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6380)
+    ns = ap.parse_args(argv)
+
+    async def run() -> None:
+        server = StoreServer(ns.host, ns.port)
+        await server.start()
+        print(f"tpu-faas store listening on {server.host}:{server.port}", flush=True)
+        await server.serve_forever()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: config, logging/tracing."""

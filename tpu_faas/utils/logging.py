@@ -1,0 +1,67 @@
+"""Structured logging + lightweight tracing.
+
+The reference has no observability beyond commented-out prints (SURVEY §5.5).
+Here every component logs through stdlib logging with a shared format, and hot
+loops can record per-tick timings through :class:`TickTracer` — a bounded
+in-memory ring of (name, duration) spans with percentile summaries, cheap
+enough to leave on in production loops.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import deque
+from contextlib import contextmanager
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def get_logger(name: str, level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(f"tpu_faas.{name}")
+    if not logging.getLogger("tpu_faas").handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root = logging.getLogger("tpu_faas")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+    return logger
+
+
+class TickTracer:
+    """Bounded ring of timed spans for hot-loop instrumentation."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self._spans: dict[str, deque[float]] = {}
+        self._capacity = capacity
+
+    @contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._spans.setdefault(
+                name, deque(maxlen=self._capacity)
+            ).append(dt)
+
+    def record(self, name: str, seconds: float) -> None:
+        self._spans.setdefault(name, deque(maxlen=self._capacity)).append(seconds)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        out: dict[str, dict[str, float]] = {}
+        for name, xs in self._spans.items():
+            if not xs:
+                continue
+            data = sorted(xs)
+            n = len(data)
+            out[name] = {
+                "count": float(n),
+                "mean": sum(data) / n,
+                "p50": data[n // 2],
+                "p99": data[min(n - 1, int(n * 0.99))],
+                "max": data[-1],
+            }
+        return out
