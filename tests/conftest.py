@@ -1,19 +1,45 @@
-"""Test env: force JAX onto a virtual 8-device CPU mesh before jax imports.
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding tests run over
-``--xla_force_host_platform_device_count=8`` as the driver's dryrun does.
-Must run before anything imports jax, hence module-level in conftest.
+``xla_force_host_platform_device_count=8`` as the driver's dryrun does.
+
+NOTE: a pytest plugin imports jax before this conftest runs, so setting
+JAX_PLATFORMS in os.environ here is too late — jax snapshots env config at
+import. ``jax.config.update`` works post-import; XLA_FLAGS is read lazily at
+first backend init, which hasn't happened yet at collection time.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import sys
+import jax
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# TPU_FAAS_TEST_PLATFORM overrides (e.g. =tpu to run the suite on real
+# hardware); default is the 8-device virtual CPU mesh. JAX_PLATFORMS itself
+# can't express the default here because platform plugins rewrite it.
+_platform = os.environ.get("TPU_FAAS_TEST_PLATFORM", "cpu")
+jax.config.update("jax_platforms", _platform)
+# persistent XLA compile cache: the sched kernels cost ~1 min to compile cold
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.join(_repo, ".jax_cache")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+assert jax.default_backend() == _platform, (
+    f"backend is {jax.default_backend()!r}, wanted {_platform!r} — "
+    "a plugin initialized JAX before conftest could configure it"
+)
+if _platform == "cpu":
+    assert len(jax.devices()) >= 8, (
+        f"expected >= 8 virtual CPU devices, got {jax.devices()}"
+    )
+# on real hardware the mesh tests skip themselves if devices are scarce

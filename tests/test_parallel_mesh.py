@@ -1,0 +1,105 @@
+"""Sharded scheduler over a virtual 8-device CPU mesh: result parity with the
+single-device kernels + invariants."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_faas.parallel.mesh import (
+    make_mesh,
+    replicate,
+    shard_task_arrays,
+    sharded_scheduler_tick,
+    sharded_sinkhorn_placement,
+)
+from tpu_faas.sched.problem import PlacementProblem, check_assignment
+from tpu_faas.sched.sinkhorn import sinkhorn_placement
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices (virtual CPU mesh or a pod slice)")
+    return make_mesh(8)
+
+
+def _problem(seed, n_tasks=512, n_workers=32):
+    rng = np.random.default_rng(seed)
+    sizes = rng.uniform(0.5, 5.0, n_tasks).astype(np.float32)
+    speeds = rng.uniform(0.5, 4.0, n_workers).astype(np.float32)
+    free = rng.integers(0, 6, n_workers).astype(np.int32)
+    live = rng.random(n_workers) > 0.2
+    return sizes, speeds, free, live
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_sharded_sinkhorn_invariants(mesh, seed):
+    sizes, speeds, free, live = _problem(seed)
+    p = PlacementProblem.build(sizes, speeds, free, live, T=512, W=32)
+    ts, tv = shard_task_arrays(mesh, p.task_size, p.task_valid)
+    ws, wf, wl = replicate(mesh, p.worker_speed, p.worker_free, p.worker_live)
+    a = np.asarray(
+        sharded_sinkhorn_placement(mesh, ts, tv, ws, wf, wl, max_slots=4)
+    )
+    check_assignment(a, np.asarray(p.task_valid), np.asarray(p.worker_free),
+                     np.asarray(p.worker_live))
+    cap = int(np.minimum(free, 4)[live].sum())
+    assert (a >= 0).sum() == min(len(sizes), cap)
+
+
+def test_sharded_matches_single_device_plan(mesh):
+    """Same soft problem -> same placement count and near-identical cost as
+    the single-device sinkhorn kernel."""
+    sizes, speeds, free, live = _problem(5)
+    p = PlacementProblem.build(sizes, speeds, free, live, T=512, W=32)
+    ts, tv = shard_task_arrays(mesh, p.task_size, p.task_valid)
+    ws, wf, wl = replicate(mesh, p.worker_speed, p.worker_free, p.worker_live)
+    a_sharded = np.asarray(
+        sharded_sinkhorn_placement(mesh, ts, tv, ws, wf, wl, max_slots=4)
+    )
+    a_single = np.asarray(
+        sinkhorn_placement(
+            p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+            p.worker_live, max_slots=4,
+        ).assignment
+    )
+    placed_sh = a_sharded >= 0
+    placed_si = a_single >= 0
+    assert placed_sh.sum() == placed_si.sum()
+    cost_sh = float(np.sum(sizes[placed_sh[:512]] / speeds[a_sharded[placed_sh][: placed_sh.sum()]]))
+    cost_si = float(np.sum(sizes[placed_si[:512]] / speeds[a_single[placed_si][: placed_si.sum()]]))
+    assert abs(cost_sh - cost_si) <= 0.05 * max(cost_si, 1e-6)
+
+
+def test_sharded_full_tick(mesh):
+    import jax.numpy as jnp
+
+    sizes, speeds, free, live = _problem(7, n_tasks=256, n_workers=16)
+    p = PlacementProblem.build(sizes, speeds, free, live, T=256, W=16)
+    ts, tv = shard_task_arrays(mesh, p.task_size, p.task_valid)
+    active = np.ones(16, dtype=bool)
+    hb = np.zeros(16, dtype=np.float32)
+    hb[3] = -100.0  # worker 3 silent beyond expiry
+    inflight = np.full(64, -1, dtype=np.int32)
+    inflight[0] = 3  # one task in flight on the dead worker
+    (ws, wf, wa, lhb, pl, iw) = replicate(
+        mesh,
+        p.worker_speed,
+        p.worker_free,
+        jnp.asarray(active),
+        jnp.asarray(hb),
+        jnp.asarray(active),
+        jnp.asarray(inflight),
+    )
+    out = sharded_scheduler_tick(
+        mesh, ts, tv, ws, wf, wa, lhb, pl, iw,
+        jnp.float32(0.0), jnp.float32(10.0), max_slots=4,
+    )
+    live_out = np.asarray(out.live)
+    assert not live_out[3] and live_out[[0, 1, 2]].all()
+    assert np.asarray(out.purged)[3]
+    assert np.asarray(out.redispatch)[0]
+    a = np.asarray(out.assignment)
+    assert not (a == 3).any()  # nothing placed on the dead worker
+    counts = np.asarray(out.assigned_count)
+    assert counts.sum() == (a >= 0).sum()

@@ -1,0 +1,72 @@
+"""Auction kernel: invariants + optimality vs the scipy Hungarian oracle."""
+
+import numpy as np
+import pytest
+
+from tpu_faas.sched.auction import auction_placement
+from tpu_faas.sched.oracle import optimal_assignment
+from tpu_faas.sched.problem import PlacementProblem, check_assignment
+
+
+def _run(sizes, speeds, free, live, max_slots=4, eps=1e-4):
+    p = PlacementProblem.build(sizes, speeds, free, live, T=len(sizes) and None)
+    res = auction_placement(
+        p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+        p.worker_live, max_slots=max_slots, eps=eps,
+    )
+    return p, np.asarray(res.assignment), int(res.n_rounds)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_auction_invariants_random(seed):
+    rng = np.random.default_rng(seed)
+    sizes = rng.uniform(0.5, 5.0, 60).astype(np.float32)
+    speeds = rng.uniform(0.5, 4.0, 16).astype(np.float32)
+    free = rng.integers(0, 5, 16).astype(np.int32)
+    live = rng.random(16) > 0.2
+    p, a, rounds = _run(sizes, speeds, free, live)
+    check_assignment(
+        a, np.asarray(p.task_valid), np.asarray(p.worker_free),
+        np.asarray(p.worker_live),
+    )
+    cap = int(np.minimum(free, 4)[live].sum())
+    assert (a >= 0).sum() == min(len(sizes), cap)
+    assert rounds > 0
+
+
+def test_auction_matches_hungarian_total_cost():
+    """Near-optimality: total cost within n*eps of the exact assignment."""
+    rng = np.random.default_rng(7)
+    n_tasks, n_workers, max_slots = 40, 12, 4
+    sizes = rng.uniform(0.5, 8.0, n_tasks).astype(np.float32)
+    speeds = rng.uniform(0.5, 4.0, n_workers).astype(np.float32)
+    free = np.full(n_workers, max_slots, dtype=np.int32)
+    live = np.ones(n_workers, dtype=bool)
+    eps = 1e-4
+
+    _, a, _ = _run(sizes, speeds, free, live, max_slots=max_slots, eps=eps)
+    placed = a[: n_tasks] >= 0
+    assert placed.all()
+    cost_auction = float(np.sum(sizes[placed] / speeds[a[:n_tasks][placed]]))
+
+    _, cost_opt = optimal_assignment(sizes, speeds, free, live, max_slots)
+    assert cost_auction <= cost_opt + n_tasks * eps * 10 + 1e-3
+
+
+def test_auction_single_best_worker():
+    # one fast worker with capacity for everything -> all tasks land there
+    _, a, _ = _run([1.0, 2.0, 3.0], [10.0, 0.1], [4, 4], [True, True],
+                   max_slots=4)
+    assert (a[:3] == 0).all()
+
+
+def test_auction_excess_tasks_admitted_by_arrival():
+    # 2 slots, 4 tasks: the two earliest-arrival tasks get placed
+    _, a, _ = _run([5.0, 4.0, 3.0, 2.0], [1.0], [2], [True], max_slots=2)
+    assert (a[:2] >= 0).all()
+    assert (a[2:4] == -1).all()
+
+
+def test_auction_no_capacity():
+    _, a, _ = _run([1.0, 1.0], [1.0, 1.0], [0, 0], [True, True])
+    assert (a == -1).all()

@@ -1,0 +1,81 @@
+"""Sinkhorn placement kernel: invariants, marginals, heterogeneity behavior."""
+
+import numpy as np
+import pytest
+
+from tpu_faas.sched.oracle import optimal_assignment
+from tpu_faas.sched.problem import PlacementProblem, check_assignment
+from tpu_faas.sched.sinkhorn import sinkhorn_placement
+
+
+def _run(sizes, speeds, free, live, **kw):
+    p = PlacementProblem.build(sizes, speeds, free, live)
+    res = sinkhorn_placement(
+        p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+        p.worker_live, **kw,
+    )
+    return p, np.asarray(res.assignment), res
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sinkhorn_invariants_random(seed):
+    rng = np.random.default_rng(seed)
+    sizes = rng.uniform(0.5, 5.0, 80).astype(np.float32)
+    speeds = rng.uniform(0.5, 4.0, 24).astype(np.float32)
+    free = rng.integers(0, 6, 24).astype(np.int32)
+    live = rng.random(24) > 0.25
+    p, a, res = _run(sizes, speeds, free, live)
+    check_assignment(
+        a, np.asarray(p.task_valid), np.asarray(p.worker_free),
+        np.asarray(p.worker_live),
+    )
+    assert float(res.marginal_err) < 0.05
+
+
+def test_sinkhorn_full_placement_when_capacity_ample():
+    rng = np.random.default_rng(3)
+    sizes = rng.uniform(0.5, 5.0, 30).astype(np.float32)
+    speeds = rng.uniform(1.0, 2.0, 10).astype(np.float32)
+    free = np.full(10, 8, dtype=np.int32)
+    live = np.ones(10, dtype=bool)
+    _, a, _ = _run(sizes, speeds, free, live)
+    assert (a[:30] >= 0).all()
+
+
+def test_sinkhorn_overflow_stays_queued():
+    # 3 slots total, 10 tasks: exactly 3 placed
+    sizes = np.ones(10, dtype=np.float32)
+    _, a, _ = _run(sizes, [1.0, 1.0], [2, 1], [True, True])
+    assert (a[:10] >= 0).sum() == 3
+
+
+def test_sinkhorn_prefers_fast_workers():
+    # equal-size tasks, worker 0 4x faster, capacity not binding:
+    # the fast worker should receive more tasks
+    sizes = np.ones(12, dtype=np.float32)
+    _, a, _ = _run(sizes, [4.0, 1.0], [8, 8], [True, True], tau=0.05)
+    placed = a[:12]
+    assert (placed >= 0).all()
+    assert (placed == 0).sum() > (placed == 1).sum()
+
+
+def test_sinkhorn_near_oracle_cost():
+    """Total cost within a modest factor of the exact assignment (entropic
+    smoothing trades a little cost for spreading)."""
+    rng = np.random.default_rng(9)
+    n = 40
+    sizes = rng.uniform(0.5, 6.0, n).astype(np.float32)
+    speeds = rng.uniform(0.5, 4.0, 12).astype(np.float32)
+    free = np.full(12, 4, dtype=np.int32)
+    live = np.ones(12, dtype=bool)
+    _, a, _ = _run(sizes, speeds, free, live, tau=0.01, n_iters=200, max_slots=4)
+    placed = a[:n] >= 0
+    assert placed.all()
+    cost = float(np.sum(sizes[placed] / speeds[a[:n][placed]]))
+    _, cost_opt = optimal_assignment(sizes, speeds, free, live, max_slots=4)
+    assert cost <= cost_opt * 1.10
+
+
+def test_sinkhorn_dead_fleet():
+    _, a, _ = _run([1.0, 2.0], [1.0, 1.0], [4, 4], [False, False])
+    assert (a == -1).all()

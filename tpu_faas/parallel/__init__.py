@@ -1,0 +1,9 @@
+"""Multi-chip scaling: device meshes + sharded scheduler kernels."""
+
+from tpu_faas.parallel.mesh import (
+    make_mesh,
+    sharded_scheduler_tick,
+    sharded_sinkhorn_placement,
+)
+
+__all__ = ["make_mesh", "sharded_scheduler_tick", "sharded_sinkhorn_placement"]

@@ -1,0 +1,32 @@
+"""TPU scheduler kernels: batched task placement as a device decision step.
+
+The reference's PushDispatcher decides placement one task per tick by popping
+an LRU deque of free workers (reference task_dispatcher.py:297-322); its purge
+walk is O(W) Python per tick (241-249); failed workers' in-flight tasks are
+lost (SURVEY §5.3). This package reframes the whole per-tick decision —
+which pending tasks go to which live workers, which workers just died, which
+in-flight tasks need re-dispatch — as one jit-compiled JAX computation over
+fixed padded shapes:
+
+- :mod:`tpu_faas.sched.problem`   padded problem construction + masks
+- :mod:`tpu_faas.sched.greedy`    rank-matching placement kernel (the
+  <10 ms / 50k x 4k headline path) + host greedy reference
+- :mod:`tpu_faas.sched.auction`   Bertsekas auction assignment (optimal
+  placement for moderate sizes, BASELINE config 3)
+- :mod:`tpu_faas.sched.sinkhorn`  entropic OT placement for heterogeneous
+  fleets (BASELINE config 4)
+- :mod:`tpu_faas.sched.state`     the fused scheduler tick: liveness +
+  purge + placement + in-flight redistribution in one device step
+- :mod:`tpu_faas.sched.oracle`    scipy exact/LP oracles for tests & makespan
+"""
+
+from tpu_faas.sched.problem import PlacementProblem
+from tpu_faas.sched.greedy import rank_match_placement
+from tpu_faas.sched.state import SchedulerArrays, scheduler_tick
+
+__all__ = [
+    "PlacementProblem",
+    "rank_match_placement",
+    "SchedulerArrays",
+    "scheduler_tick",
+]

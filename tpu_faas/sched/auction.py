@@ -1,0 +1,164 @@
+"""Auction assignment kernel (Bertsekas forward auction, Jacobi bidding).
+
+Optimal (within n·ε) min-cost placement of pending tasks onto worker process
+slots, entirely on device: all unassigned tasks bid simultaneously each
+round (value = -size/speed - price), per-slot winners are resolved by one
+lexsort, and prices rise monotonically until every admitted task owns a slot.
+`lax.while_loop` keeps the round count data-dependent without leaving XLA;
+shapes stay static throughout — worker churn is a mask change.
+
+This is the placement used by BASELINE config 3 (1k workers x 10k tasks) and
+the optimality reference for the cheaper rank-matching kernel. When pending
+tasks outnumber free slots, earliest-arrival tasks are admitted to the
+auction (FaaS fairness: first-come-first-served) and the rest stay QUEUED —
+the per-tick partial-placement semantic the lifecycle already supports.
+
+Complexity per round: O(T·S) for bid values + O(T log T) for the winner
+sort; rounds bounded by price range / ε (ε-scaling keeps it small).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AuctionResult(NamedTuple):
+    assignment: jnp.ndarray  # i32[T] worker per task, -1 = stay queued
+    n_rounds: jnp.ndarray  # i32 scalar
+    prices: jnp.ndarray  # f32[S] final slot prices
+
+
+@partial(jax.jit, static_argnames=("max_slots", "max_rounds", "n_phases"))
+def auction_placement(
+    task_size: jnp.ndarray,  # f32[T]
+    task_valid: jnp.ndarray,  # bool[T]
+    worker_speed: jnp.ndarray,  # f32[W]
+    worker_free: jnp.ndarray,  # i32[W]
+    worker_live: jnp.ndarray,  # bool[W]
+    max_slots: int = 8,
+    eps: float = 1e-3,
+    max_rounds: int = 2000,
+    n_phases: int = 5,
+) -> AuctionResult:
+    T = task_size.shape[0]
+    W = worker_speed.shape[0]
+    S = W * max_slots
+
+    # -- slot expansion (same layout as greedy.rank_match_placement) -------
+    free = jnp.where(worker_live, worker_free, 0)
+    k = jnp.arange(max_slots, dtype=jnp.int32)
+    slot_valid = (k[None, :] < free[:, None]).reshape(S)
+    slot_worker = jnp.repeat(jnp.arange(W, dtype=jnp.int32), max_slots)
+    slot_speed = jnp.broadcast_to(worker_speed[:, None], (W, max_slots)).reshape(S)
+
+    # -- squaring: match exactly n = min(#tasks, #slots) -------------------
+    # Forward auction with persistent prices across eps-phases is only
+    # eps-optimal for SQUARE problems (leftover slots keep inflated prices
+    # and violate complementary slackness). Cost size/speed is monotone in
+    # slot speed, so the optimal matching provably uses the n fastest slots
+    # — trim slots to n, admit the n earliest-arrival tasks (FaaS FCFS).
+    n_slots_avail = slot_valid.sum()
+    n_valid_tasks = task_valid.sum()
+    n_match = jnp.minimum(n_slots_avail, n_valid_tasks)
+    speed_key = jnp.where(slot_valid, slot_speed, -jnp.inf)
+    slot_order_by_speed = jnp.argsort(-speed_key)
+    slot_rank = jnp.zeros(S, dtype=jnp.int32).at[slot_order_by_speed].set(
+        jnp.arange(S, dtype=jnp.int32)
+    )
+    slot_valid = slot_valid & (slot_rank < n_match)
+    arrival_rank = jnp.cumsum(task_valid.astype(jnp.int32)) - 1
+    admitted = task_valid & (arrival_rank < n_match)
+
+    # -- benefit matrix (negated cost), -inf on invalid slots --------------
+    neg_inf = jnp.float32(-jnp.inf)
+    benefit = -task_size[:, None] / jnp.maximum(slot_speed[None, :], 1e-6)
+    benefit = jnp.where(slot_valid[None, :], benefit, neg_inf)
+
+    task_ids = jnp.arange(T, dtype=jnp.int32)
+
+    # -- epsilon scaling: phases from coarse to fine prices ----------------
+    # Rounds-to-converge scales with (benefit range / eps); starting with a
+    # coarse eps and tightening geometrically keeps each phase short while
+    # the final phase delivers n*eps_final optimality (Bertsekas 1992).
+    finite = jnp.where(jnp.isfinite(benefit) & admitted[:, None], benefit, jnp.nan)
+    bmax = jnp.nanmax(finite)
+    bmin = jnp.nanmin(finite)
+    rng = jnp.where(jnp.isfinite(bmax - bmin), bmax - bmin, 0.0)
+    eps_final = jnp.float32(eps)
+    eps0 = jnp.maximum(rng / 2.0, eps_final)
+    # n_phases is static: guard the Python division (exponent 0 -> ratio 1)
+    exponent = 1.0 / (n_phases - 1) if n_phases > 1 else 0.0
+    ratio = (eps_final / eps0) ** exponent
+
+    def cond(carry):
+        price, owner, assigned_slot, rounds, eps_i = carry
+        unassigned = admitted & (assigned_slot < 0)
+        return jnp.logical_and(unassigned.any(), rounds < max_rounds)
+
+    def body(carry):
+        price, owner, assigned_slot, rounds, eps_i = carry
+        bidder = admitted & (assigned_slot < 0)
+
+        value = benefit - price[None, :]  # [T,S]
+        v1 = value.max(axis=1)
+        best = value.argmax(axis=1).astype(jnp.int32)
+        masked = jnp.where(
+            jax.nn.one_hot(best, S, dtype=bool), neg_inf, value
+        )
+        v2 = masked.max(axis=1)
+        # single valid slot: v2 = -inf -> bid caps at a large increment
+        incr = jnp.where(jnp.isfinite(v2), v1 - v2, 1.0) + eps_i
+        bid_price = price[best] + incr
+        bidder = bidder & jnp.isfinite(v1)
+
+        # -- per-slot winner: lexsort by (slot, -bid_price) ----------------
+        slot_key = jnp.where(bidder, best, S)  # non-bidders sink last
+        order = jnp.lexsort((-bid_price, slot_key))
+        s_sorted = slot_key[order]
+        first = jnp.concatenate(
+            [jnp.array([True]), s_sorted[1:] != s_sorted[:-1]]
+        )
+        win = first & (s_sorted < S)
+        win_task = jnp.where(win, task_ids[order], -1)
+        win_slot = jnp.where(win, s_sorted, S)  # S = scatter-to-padding
+        win_price = bid_price[order]
+
+        # evict previous owners of won slots (sentinel index T drops the
+        # write; owners never bid, so evict/install index sets are disjoint)
+        prev_owner = jnp.where(win, owner[jnp.clip(win_slot, 0, S - 1)], -1)
+        evict_idx = jnp.where(prev_owner >= 0, prev_owner, T)
+        assigned_slot = assigned_slot.at[evict_idx].set(-1, mode="drop")
+        # install winners (slot/task sentinel = dropped out-of-bounds scatter)
+        owner = owner.at[win_slot].set(win_task, mode="drop")
+        price = price.at[win_slot].set(win_price, mode="drop")
+        install_idx = jnp.where(win_task >= 0, win_task, T)
+        assigned_slot = assigned_slot.at[install_idx].set(
+            win_slot, mode="drop"
+        )
+        return price, owner, assigned_slot, rounds + 1, eps_i
+
+    def phase(i, carry):
+        price, _owner, _assigned, total_rounds = carry
+        eps_i = eps0 * ratio ** i.astype(jnp.float32)
+        owner0 = jnp.full(S, -1, dtype=jnp.int32)
+        assigned0 = jnp.full(T, -1, dtype=jnp.int32)
+        price, owner, assigned_slot, rounds, _ = jax.lax.while_loop(
+            cond, body, (price, owner0, assigned0, jnp.int32(0), eps_i)
+        )
+        return price, owner, assigned_slot, total_rounds + rounds
+
+    price0 = jnp.zeros(S, dtype=jnp.float32)
+    owner0 = jnp.full(S, -1, dtype=jnp.int32)
+    assigned0 = jnp.full(T, -1, dtype=jnp.int32)
+    price, owner, assigned_slot, rounds = jax.lax.fori_loop(
+        0, n_phases, phase, (price0, owner0, assigned0, jnp.int32(0))
+    )
+
+    assignment = jnp.where(
+        assigned_slot >= 0, slot_worker[jnp.clip(assigned_slot, 0)], -1
+    ).astype(jnp.int32)
+    return AuctionResult(assignment, rounds, price)

@@ -1,0 +1,231 @@
+"""The fused scheduler tick: liveness + purge + placement + redistribution.
+
+One jit-compiled device step computes everything the reference's push loop
+does in Python per tick — heartbeat-timeout detection (reference
+purge_workers, task_dispatcher.py:241-249, an O(W) host walk), placement
+(297-322, one task per tick), plus what the reference *doesn't* do: marking
+every in-flight task whose worker just died for re-dispatch (the reference
+drops them — SURVEY §5.3; BASELINE.json's north star requires recovery).
+
+Host side, :class:`SchedulerArrays` owns the mirrored numpy state (worker
+registry, heartbeat stamps, in-flight table) and feeds the tick; the device
+never owns the ground truth, so a dispatcher restart rebuilds state from the
+store + worker reconnects.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_faas.sched.greedy import rank_match_placement
+
+
+class TickOutput(NamedTuple):
+    assignment: jnp.ndarray  # i32[T] worker index per pending task, -1 queued
+    live: jnp.ndarray  # bool[W]
+    purged: jnp.ndarray  # bool[W] was live last tick, dead now
+    redispatch: jnp.ndarray  # bool[I] in-flight task needs re-queue
+    assigned_count: jnp.ndarray  # i32[W] tasks handed to each worker this tick
+
+
+@partial(jax.jit, static_argnames=("max_slots",))
+def scheduler_tick(
+    task_size: jnp.ndarray,  # f32[T]
+    task_valid: jnp.ndarray,  # bool[T]
+    worker_speed: jnp.ndarray,  # f32[W]
+    worker_free: jnp.ndarray,  # i32[W]
+    worker_active: jnp.ndarray,  # bool[W] registered
+    last_heartbeat: jnp.ndarray,  # f32[W] seconds (same clock as `now`)
+    prev_live: jnp.ndarray,  # bool[W]
+    inflight_worker: jnp.ndarray,  # i32[I] worker per in-flight slot, -1 empty
+    now: jnp.ndarray,  # f32 scalar
+    time_to_expire: jnp.ndarray,  # f32 scalar
+    max_slots: int = 8,
+) -> TickOutput:
+    # -- failure detection (reference purge_workers, device-side) ----------
+    fresh = (now - last_heartbeat) <= time_to_expire
+    live = worker_active & fresh
+    purged = prev_live & ~live
+
+    # -- in-flight redistribution (capability the reference lacks) ---------
+    iw = inflight_worker
+    occupied = iw >= 0
+    worker_of = jnp.clip(iw, 0)
+    redispatch = occupied & ~live[worker_of]
+
+    # -- batched placement -------------------------------------------------
+    assignment = rank_match_placement(
+        task_size, task_valid, worker_speed, worker_free, live,
+        max_slots=max_slots,
+    )
+    assigned_count = jnp.zeros_like(worker_free).at[
+        jnp.clip(assignment, 0)
+    ].add(jnp.where(assignment >= 0, 1, 0))
+
+    return TickOutput(assignment, live, purged, redispatch, assigned_count)
+
+
+@dataclass
+class SchedulerArrays:
+    """Host mirror of scheduler state, padded to static shapes.
+
+    Worker rows are allocated on register and recycled after purge+timeout;
+    the in-flight table maps slot -> (task_id, worker_row).
+    """
+
+    max_workers: int = 256
+    max_pending: int = 1024
+    max_inflight: int = 4096
+    max_slots: int = 8
+    time_to_expire: float = 10.0
+    clock: "callable" = time.monotonic
+
+    worker_speed: np.ndarray = field(init=False)
+    worker_free: np.ndarray = field(init=False)
+    worker_active: np.ndarray = field(init=False)
+    last_heartbeat: np.ndarray = field(init=False)
+    prev_live: np.ndarray = field(init=False)
+    worker_procs: np.ndarray = field(init=False)  # registered num_processes
+
+    def __post_init__(self) -> None:
+        W = self.max_workers
+        self.worker_speed = np.zeros(W, dtype=np.float32)
+        self.worker_free = np.zeros(W, dtype=np.int32)
+        self.worker_active = np.zeros(W, dtype=bool)
+        self.last_heartbeat = np.full(W, -np.inf, dtype=np.float32)
+        self.prev_live = np.zeros(W, dtype=bool)
+        self.worker_procs = np.zeros(W, dtype=np.int32)
+        # worker identity (e.g. zmq routing id) <-> row index
+        self.worker_ids: dict[bytes, int] = {}
+        self.row_ids: dict[int, bytes] = {}
+        # in-flight table
+        self.inflight_task: list[str | None] = [None] * self.max_inflight
+        self.inflight_worker: np.ndarray = np.full(
+            self.max_inflight, -1, dtype=np.int32
+        )
+        self._inflight_slot: dict[str, int] = {}  # task_id -> slot
+        self._free_inflight: list[int] = list(range(self.max_inflight - 1, -1, -1))
+
+    # -- membership (reference register/reconnect/purge semantics) ---------
+    def register(
+        self, worker_id: bytes, num_processes: int, speed: float = 1.0
+    ) -> int:
+        """New or returning worker announces itself with its capacity
+        (reference task_dispatcher.py:276-281, 347-353)."""
+        if worker_id in self.worker_ids:
+            row = self.worker_ids[worker_id]
+        else:
+            inactive = np.flatnonzero(~self.worker_active)
+            if len(inactive) == 0:
+                raise RuntimeError("worker table full; raise max_workers")
+            row = int(inactive[0])
+            self.worker_ids[worker_id] = row
+            self.row_ids[row] = worker_id
+        self.worker_active[row] = True
+        self.worker_speed[row] = speed
+        self.worker_procs[row] = num_processes
+        self.worker_free[row] = num_processes
+        self.last_heartbeat[row] = self.clock()
+        return row
+
+    def reconnect(self, worker_id: bytes, free_processes: int) -> int:
+        """Purged-but-alive worker rejoins with its current free capacity
+        (reference task_dispatcher.py:360-367). Total capacity is the best
+        known value: the previous registration's num_processes if the row
+        still exists, else the reported free count."""
+        prev_row = self.worker_ids.get(worker_id)
+        prev_procs = int(self.worker_procs[prev_row]) if prev_row is not None else 0
+        row = self.register(worker_id, max(free_processes, 0))
+        self.worker_procs[row] = max(prev_procs, free_processes)
+        self.worker_free[row] = free_processes
+        return row
+
+    def heartbeat(self, worker_id: bytes) -> None:
+        row = self.worker_ids.get(worker_id)
+        if row is not None:
+            self.last_heartbeat[row] = self.clock()
+
+    def deactivate(self, row: int) -> None:
+        """Purge bookkeeping after the tick reported the worker dead.
+
+        Drops the identity mapping too: the row may be recycled by the next
+        register(), and a zombie worker reappearing under the old identity
+        must NOT alias onto the recycled row — it re-registers fresh (its
+        reconnect carries its current free capacity, reference
+        task_dispatcher.py:356-367)."""
+        self.worker_active[row] = False
+        self.worker_free[row] = 0
+        wid = self.row_ids.pop(row, None)
+        if wid is not None:
+            self.worker_ids.pop(wid, None)
+
+    # -- in-flight table ---------------------------------------------------
+    def inflight_add(self, task_id: str, row: int) -> int:
+        if not self._free_inflight:
+            raise RuntimeError("inflight table full; raise max_inflight")
+        slot = self._free_inflight.pop()
+        self.inflight_task[slot] = task_id
+        self.inflight_worker[slot] = row
+        self._inflight_slot[task_id] = slot
+        return slot
+
+    def inflight_done(self, task_id: str) -> int | None:
+        """Result arrived: free the slot, return the worker row."""
+        slot = self._inflight_slot.pop(task_id, None)
+        if slot is None:
+            return None
+        row = int(self.inflight_worker[slot])
+        self.inflight_task[slot] = None
+        self.inflight_worker[slot] = -1
+        self._free_inflight.append(slot)
+        return row
+
+    def inflight_clear_slot(self, slot: int) -> str | None:
+        tid = self.inflight_task[slot]
+        self.inflight_task[slot] = None
+        self.inflight_worker[slot] = -1
+        if tid is not None:
+            self._inflight_slot.pop(tid, None)
+            self._free_inflight.append(slot)
+        return tid
+
+    # -- the tick ----------------------------------------------------------
+    def tick(
+        self,
+        task_sizes: np.ndarray,
+        now: float | None = None,
+    ) -> TickOutput:
+        """Run the fused device step for the current pending batch.
+
+        ``task_sizes`` is the un-padded vector of pending task cost
+        estimates; padding/masking to ``max_pending`` happens here.
+        """
+        n = len(task_sizes)
+        if n > self.max_pending:
+            raise ValueError(f"{n} pending > max_pending={self.max_pending}")
+        ts = np.zeros(self.max_pending, dtype=np.float32)
+        ts[:n] = task_sizes
+        tv = np.zeros(self.max_pending, dtype=bool)
+        tv[:n] = True
+        out = scheduler_tick(
+            jnp.asarray(ts),
+            jnp.asarray(tv),
+            jnp.asarray(self.worker_speed),
+            jnp.asarray(self.worker_free),
+            jnp.asarray(self.worker_active),
+            jnp.asarray(self.last_heartbeat),
+            jnp.asarray(self.prev_live),
+            jnp.asarray(self.inflight_worker),
+            jnp.float32(now if now is not None else self.clock()),
+            jnp.float32(self.time_to_expire),
+            max_slots=self.max_slots,
+        )
+        self.prev_live = np.asarray(out.live)
+        return out
