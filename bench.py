@@ -48,7 +48,6 @@ def main() -> None:
     d_speed = jnp.asarray(speed)
     d_free = jnp.asarray(procs)
     d_active = jnp.asarray(active)
-    d_hb = jnp.asarray(100.0 - hb_age)
     d_prev = jnp.asarray(active)
     d_inflight = jnp.asarray(inflight)
     tte = jnp.float32(10.0)
@@ -57,11 +56,14 @@ def main() -> None:
     task_valid[:N_TASKS] = True
     d_valid = jnp.asarray(task_valid)
 
-    def one_tick(sizes_host: np.ndarray, now: float):
-        d_sizes = jnp.asarray(sizes_host)  # per-tick host->device transfer
+    def one_tick(sizes_host: np.ndarray, ages_host: np.ndarray):
+        # per-tick host->device transfers: fresh pending sizes + hb ages,
+        # exactly what a live dispatcher ships each decision
+        d_sizes = jnp.asarray(sizes_host)
+        d_ages = jnp.asarray(ages_host)
         out = scheduler_tick(
-            d_sizes, d_valid, d_speed, d_free, d_active, d_hb, d_prev,
-            d_inflight, jnp.float32(now), tte, max_slots=MAX_SLOTS,
+            d_sizes, d_valid, d_speed, d_free, d_active, d_ages, d_prev,
+            d_inflight, tte, max_slots=MAX_SLOTS,
         )
         jax.block_until_ready(out)
         return out
@@ -73,8 +75,11 @@ def main() -> None:
     for b in batches:
         b[:N_TASKS] = rng.uniform(0.1, 10.0, N_TASKS).astype(np.float32)
 
+    age_batches = [
+        (hb_age + i * 0.001).astype(np.float32) for i in range(4)
+    ]
     t0 = time.perf_counter()
-    out = one_tick(batches[0], 100.0)  # compile
+    out = one_tick(batches[0], age_batches[0])  # compile
     compile_s = time.perf_counter() - t0
     print(f"compile: {compile_s:.1f}s", file=sys.stderr)
 
@@ -82,9 +87,9 @@ def main() -> None:
     times = []
     for i in range(n_reps):
         t0 = time.perf_counter()
-        # tiny clock drift so `now` differs per tick without expiring the
-        # whole fleet (hb ages stay 0..12s vs the 10s timeout)
-        out = one_tick(batches[i % len(batches)], 100.0 + i * 0.001)
+        out = one_tick(
+            batches[i % len(batches)], age_batches[i % len(age_batches)]
+        )
         times.append(time.perf_counter() - t0)
     tick_ms = float(np.median(times) * 1000)
 

@@ -78,22 +78,22 @@ def test_sharded_full_tick(mesh):
     p = PlacementProblem.build(sizes, speeds, free, live, T=256, W=16)
     ts, tv = shard_task_arrays(mesh, p.task_size, p.task_valid)
     active = np.ones(16, dtype=bool)
-    hb = np.zeros(16, dtype=np.float32)
-    hb[3] = -100.0  # worker 3 silent beyond expiry
+    hb_age = np.zeros(16, dtype=np.float32)
+    hb_age[3] = 100.0  # worker 3 silent beyond expiry
     inflight = np.full(64, -1, dtype=np.int32)
     inflight[0] = 3  # one task in flight on the dead worker
-    (ws, wf, wa, lhb, pl, iw) = replicate(
+    (ws, wf, wa, ages, pl, iw) = replicate(
         mesh,
         p.worker_speed,
         p.worker_free,
         jnp.asarray(active),
-        jnp.asarray(hb),
+        jnp.asarray(hb_age),
         jnp.asarray(active),
         jnp.asarray(inflight),
     )
     out = sharded_scheduler_tick(
-        mesh, ts, tv, ws, wf, wa, lhb, pl, iw,
-        jnp.float32(0.0), jnp.float32(10.0), max_slots=4,
+        mesh, ts, tv, ws, wf, wa, ages, pl, iw,
+        jnp.float32(10.0), max_slots=4,
     )
     live_out = np.asarray(out.live)
     assert not live_out[3] and live_out[[0, 1, 2]].all()

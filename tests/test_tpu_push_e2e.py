@@ -1,0 +1,140 @@
+"""TpuPushDispatcher integration: unmodified push workers, device-tick
+scheduling, crash recovery, and stranded-task recovery on startup."""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import sleep_task
+from tests.test_workers_e2e import _spawn_worker, service_test
+
+
+def _make_dispatcher(store_url, **kw):
+    defaults = dict(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(store_url),
+        max_workers=64,
+        max_pending=256,
+        max_inflight=512,
+        tick_period=0.01,
+    )
+    defaults.update(kw)
+    return TpuPushDispatcher(**defaults)
+
+
+def test_tpu_push_end_to_end():
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    try:
+        service_test(FaaSClient(gw.url), n_tasks=20)
+        assert disp.n_dispatched >= 20
+        stats = disp.tracer.summary().get("device_tick", {})
+        assert stats.get("count", 0) > 0
+    finally:
+        for w in workers:
+            w.kill()
+            w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_tpu_push_worker_crash_redispatch():
+    """Device-computed purge + redistribution: SIGKILL a worker holding
+    tasks; everything still completes on the survivor."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url, time_to_expire=1.5)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        handles = [client.submit(fid, 1.0) for _ in range(8)]
+        time.sleep(0.8)
+        workers[0].send_signal(signal.SIGKILL)
+        workers[0].wait()
+        for h in handles:
+            assert h.result(timeout=60.0) == 1.0
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_tpu_push_recovers_stranded_queued_tasks():
+    """Tasks submitted while NO dispatcher is running are stranded by
+    fire-and-forget pub/sub; a fresh TpuPushDispatcher adopts them from the
+    store on startup (the reference cannot — SURVEY §5.4)."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    client = FaaSClient(gw.url)
+    fid = client.register(sleep_task)
+    orphan = client.submit(fid, 0.1)  # announced into the void
+    time.sleep(0.2)
+    assert orphan.status() == "QUEUED"
+
+    disp = _make_dispatcher(store_handle.url)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    worker = _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+    try:
+        assert orphan.result(timeout=60.0) == 0.1
+    finally:
+        worker.kill()
+        worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_tick_overflow_does_not_crash():
+    """Pending queue beyond max_pending (e.g. purge re-queued into a full
+    queue) must defer, not crash the tick with a ValueError."""
+    from tpu_faas.dispatch.base import PendingTask
+    from tpu_faas.store import MemoryStore
+
+    store = MemoryStore()
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=store,
+        max_workers=4, max_pending=8, max_inflight=16, recover_queued=False,
+    )
+    try:
+        for i in range(20):  # 2.5x max_pending
+            disp.pending.append(PendingTask(f"t{i}", "F", "P"))
+        sent = disp.tick()  # no workers -> nothing sent, nothing lost
+        assert sent == 0
+        assert len(disp.pending) == 20
+        # ticking repeatedly stays stable
+        disp.tick()
+        assert len(disp.pending) == 20
+    finally:
+        disp.socket.close(linger=0)

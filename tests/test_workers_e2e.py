@@ -1,0 +1,142 @@
+"""Black-box integration tests for pull/push modes with real worker
+subprocesses (analog of reference test_client.py: spawn everything, submit a
+workload over REST, verify every result against local re-execution)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from contextlib import contextmanager
+
+import pytest
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.dispatch.pull import PullDispatcher
+from tpu_faas.dispatch.push import PushDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import make_workload, sleep_task
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_worker(kind: str, n_procs: int, url: str, *extra: str):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.Popen(
+        [sys.executable, "-m", f"tpu_faas.worker.{kind}", str(n_procs), url]
+        + list(extra),
+        env=env,
+        cwd=REPO,
+    )
+
+
+@contextmanager
+def stack(mode: str, n_workers: int = 2, n_procs: int = 2, **disp_kw):
+    """store server + gateway + dispatcher thread + worker subprocesses."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    if mode == "pull":
+        disp = PullDispatcher(
+            ip="127.0.0.1", port=0, store=make_store(store_handle.url), **disp_kw
+        )
+        worker_kind, extra = "pull_worker", ("--delay", "0.005")
+    else:
+        disp = PushDispatcher(
+            ip="127.0.0.1", port=0, store=make_store(store_handle.url), **disp_kw
+        )
+        worker_kind = "push_worker"
+        extra = ("--hb", "--hb-period", "0.3") if disp_kw.get("heartbeat") else ()
+    disp_thread = threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker(worker_kind, n_procs, url, *extra)
+        for _ in range(n_workers)
+    ]
+    try:
+        yield FaaSClient(gw.url), workers, disp
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        disp_thread.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def service_test(client: FaaSClient, n_tasks: int = 20, timeout: float = 90.0):
+    """The reference's correctness oracle (test_client.py:95-129): submit
+    n_tasks, poll all results, compare to local re-execution."""
+    fn, params = make_workload("arithmetic", n_tasks, 2000, seed=1)
+    fid = client.register(fn)
+    handles = [client.submit(fid, *a, **k) for a, k in params]
+    for handle, (a, k) in zip(handles, params):
+        assert handle.result(timeout=timeout) == fn(*a, **k)
+
+
+@pytest.mark.parametrize(
+    "mode,kw",
+    [
+        ("pull", {}),
+        ("push", {}),
+        ("push", {"process_lb": True}),
+        ("push", {"heartbeat": True}),
+    ],
+    ids=["pull", "push-lru", "push-plb", "push-hb"],
+)
+def test_mode_end_to_end(mode, kw):
+    with stack(mode, n_workers=2, n_procs=2, **kw) as (client, workers, _):
+        service_test(client, n_tasks=20)
+
+
+def test_push_hb_worker_crash_redispatches_inflight():
+    """The capability the reference lacks (SURVEY §5.3): killing a worker
+    with tasks in flight must not lose them — the dispatcher purges the
+    worker and re-queues its tasks onto the survivors."""
+    with stack(
+        "push", n_workers=2, n_procs=2, heartbeat=True, time_to_expire=1.5
+    ) as (client, workers, disp):
+        fid = client.register(sleep_task)
+        # enough slow tasks to occupy both workers fully, then some
+        handles = [client.submit(fid, 1.0) for _ in range(8)]
+        time.sleep(0.8)  # let tasks land on workers
+        workers[0].send_signal(signal.SIGKILL)  # hard crash, no goodbye
+        workers[0].wait()
+        for h in handles:
+            assert h.result(timeout=60.0) == 1.0
+
+
+def test_push_worker_reconnect_after_dispatcher_restart_message():
+    """A worker unknown to the dispatcher (e.g. after dispatcher restart)
+    gets a reconnect request and resumes serving."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = PushDispatcher(
+        ip="127.0.0.1", port=0, store=make_store(store_handle.url),
+        heartbeat=True, time_to_expire=5.0,
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    worker = _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+    client = FaaSClient(gw.url)
+    try:
+        service_test(client, n_tasks=4)
+        # simulate dispatcher restart: forget the worker entirely
+        disp.workers.clear()
+        disp.free_lru.clear()
+        # worker's next heartbeat triggers reconnect handshake; tasks flow again
+        service_test(client, n_tasks=4)
+    finally:
+        worker.kill()
+        worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

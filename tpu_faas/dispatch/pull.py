@@ -1,0 +1,84 @@
+"""Pull dispatch mode: demand-driven REP/REQ.
+
+Capability parity with reference PullDispatcher (task_dispatcher.py:105-187):
+a REP socket where workers come asking for work; the defining constraint is
+the REP/REQ lockstep — every received message MUST be answered in the same
+cycle (reference comment at 163-167) — so each worker request is answered
+with either a ``task`` or a ``wait``. The dispatcher reads the announce bus
+only when it has a requester to hand the task to, which is the pull mode's
+implicit back-pressure (SURVEY §2.3).
+
+Differences from the reference: the poll has a timeout so ``stop()`` works;
+``result`` messages are answered with another task when one is pending (the
+reference does this too via its inline re-listen — pull_worker.py:108-111 —
+here it falls out of the uniform reply rule).
+"""
+
+from __future__ import annotations
+
+import zmq
+
+from tpu_faas.dispatch.base import TaskDispatcher
+from tpu_faas.worker import messages as m
+
+
+class PullDispatcher(TaskDispatcher):
+    def __init__(
+        self,
+        ip: str = "0.0.0.0",
+        port: int = 5555,
+        store_url: str = "memory://",
+        store=None,
+        channel: str = "tasks",
+        poll_timeout_ms: int = 100,
+    ) -> None:
+        super().__init__(store_url=store_url, channel=channel, store=store)
+        self.ctx = zmq.Context.instance()
+        self.socket = self.ctx.socket(zmq.REP)
+        if port == 0:
+            port = self.socket.bind_to_random_port(f"tcp://{ip}")
+        else:
+            self.socket.bind(f"tcp://{ip}:{port}")
+        self.port = port
+        self.poll_timeout_ms = poll_timeout_ms
+        self.poller = zmq.Poller()
+        self.poller.register(self.socket, zmq.POLLIN)
+        self.workers: set[str] = set()
+
+    def start(self, max_results: int | None = None) -> int:
+        """Serve worker requests; returns results recorded (for tests)."""
+        n_results = 0
+        try:
+            while not self.stopping:
+                events = dict(self.poller.poll(self.poll_timeout_ms))
+                if self.socket not in events:
+                    continue
+                msg_type, data = m.decode(self.socket.recv())
+                if msg_type == m.REGISTER:
+                    self.workers.add(data.get("worker_id", "?"))
+                    self.log.info("pull worker registered: %s", data)
+                elif msg_type == m.RESULT:
+                    self.record_result(
+                        data["task_id"], data["status"], data["result"]
+                    )
+                    n_results += 1
+                # READY carries no state; any message type falls through to
+                # the mandatory reply:
+                task = self.poll_next_task()
+                if task is not None:
+                    self.mark_running(task.task_id)
+                    self.socket.send(
+                        m.encode(
+                            m.TASK,
+                            task_id=task.task_id,
+                            fn_payload=task.fn_payload,
+                            param_payload=task.param_payload,
+                        )
+                    )
+                else:
+                    self.socket.send(m.encode(m.WAIT))
+                if max_results is not None and n_results >= max_results:
+                    break
+        finally:
+            self.socket.close(linger=0)
+        return n_results

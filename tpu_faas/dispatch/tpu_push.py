@@ -1,0 +1,228 @@
+"""TPU push dispatcher: the ROUTER/DEALER protocol with every per-tick
+decision computed on device.
+
+This is the north-star component (BASELINE.json): same worker fleet, same
+wire protocol, same store contract as :class:`PushDispatcher` — but instead
+of Python walking an LRU deque one task at a time, each tick:
+
+1. drains worker messages (register/result/heartbeat/reconnect) into the
+   host-side mirror arrays (:class:`tpu_faas.sched.state.SchedulerArrays`);
+2. drains the announce bus into a bounded pending buffer;
+3. runs the fused device step ``scheduler_tick`` — heartbeat-timeout
+   detection, purge set, in-flight re-dispatch set, and a whole-batch
+   placement over all pending tasks at once;
+4. acts on the outputs: sends TASK messages per the assignment, re-queues
+   tasks whose worker died, deactivates purged rows.
+
+Workers are the unmodified :class:`tpu_faas.worker.push_worker.PushWorker`
+with heartbeats on — the TPU backend is invisible across the operator
+boundary, as BASELINE.json requires. On start, a store scan re-queues any
+QUEUED tasks whose announcements were published while no dispatcher was
+listening (fire-and-forget pub/sub strands them in the reference,
+SURVEY §5.4).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import numpy as np
+import zmq
+
+from tpu_faas.core.task import FIELD_STATUS, TaskStatus
+from tpu_faas.dispatch.base import PendingTask, TaskDispatcher
+from tpu_faas.sched.state import SchedulerArrays
+from tpu_faas.utils.logging import TickTracer
+from tpu_faas.worker import messages as m
+
+
+class TpuPushDispatcher(TaskDispatcher):
+    def __init__(
+        self,
+        ip: str = "0.0.0.0",
+        port: int = 5555,
+        store_url: str = "memory://",
+        store=None,
+        channel: str = "tasks",
+        time_to_expire: float = 10.0,
+        tick_period: float = 0.005,
+        max_workers: int = 4096,
+        max_pending: int = 8192,
+        max_inflight: int = 65536,
+        max_slots: int = 8,
+        recover_queued: bool = True,
+        clock=time.monotonic,
+    ) -> None:
+        super().__init__(store_url=store_url, channel=channel, store=store)
+        self.ctx = zmq.Context.instance()
+        self.socket = self.ctx.socket(zmq.ROUTER)
+        if port == 0:
+            port = self.socket.bind_to_random_port(f"tcp://{ip}")
+        else:
+            self.socket.bind(f"tcp://{ip}:{port}")
+        self.port = port
+        self.poller = zmq.Poller()
+        self.poller.register(self.socket, zmq.POLLIN)
+        self.clock = clock
+        self.tick_period = tick_period
+        self.arrays = SchedulerArrays(
+            max_workers=max_workers,
+            max_pending=max_pending,
+            max_inflight=max_inflight,
+            max_slots=max_slots,
+            time_to_expire=time_to_expire,
+            clock=clock,
+        )
+        self.pending: deque[PendingTask] = deque()
+        self.tracer = TickTracer()
+        self.n_results = 0
+        self.n_dispatched = 0
+        if recover_queued:
+            self._recover_stranded()
+
+    # -- startup recovery (capability the reference lacks) -----------------
+    def _recover_stranded(self) -> None:
+        """Scan the store for QUEUED tasks whose announce was lost (published
+        while no dispatcher was subscribed) and adopt them as pending."""
+        n = 0
+        for key in self.store.keys():
+            fields = self.store.hgetall(key)
+            if fields.get(FIELD_STATUS) == str(TaskStatus.QUEUED):
+                self.pending.append(
+                    PendingTask(
+                        key,
+                        fields.get("fn_payload", ""),
+                        fields.get("param_payload", ""),
+                    )
+                )
+                n += 1
+        if n:
+            self.log.info("recovered %d stranded QUEUED tasks", n)
+
+    # -- worker messages ---------------------------------------------------
+    def _handle(self, wid: bytes, msg_type: str, data: dict) -> None:
+        a = self.arrays
+        if msg_type == m.REGISTER:
+            a.register(wid, int(data["num_processes"]))
+            self.log.info("worker registered: %r %s", wid, data)
+            return
+        if wid not in a.worker_ids:
+            # unknown sender: reconnect handshake (reference :356-358);
+            # a zero-capacity row is created so its heartbeats count
+            a.register(wid, 0)
+            self.socket.send_multipart([wid, m.encode(m.RECONNECT)])
+            if msg_type not in (m.RECONNECT, m.RESULT):
+                return
+        if msg_type == m.RESULT:
+            task_id = data["task_id"]
+            self.record_result(task_id, data["status"], data["result"])
+            self.n_results += 1
+            row = a.inflight_done(task_id)
+            a.heartbeat(wid)
+            if row is not None and row in a.row_ids and a.row_ids[row] == wid:
+                a.worker_free[row] = min(
+                    a.worker_free[row] + 1, a.worker_procs[row]
+                )
+        elif msg_type == m.HEARTBEAT:
+            a.heartbeat(wid)
+        elif msg_type == m.RECONNECT:
+            a.reconnect(wid, int(data.get("free_processes", 0)))
+
+    # -- one scheduler tick ------------------------------------------------
+    def tick(self) -> int:
+        """Intake + device step + act on outputs. Returns tasks dispatched."""
+        a = self.arrays
+        # intake from the announce bus, bounded by the padded batch size
+        room = a.max_pending - len(self.pending)
+        if room > 0:
+            self.pending.extend(self.poll_tasks(room))
+
+        # the device batch is capped at max_pending; overflow (possible when
+        # a purge re-queued tasks into an already-full queue) waits its turn
+        batch = [
+            self.pending.popleft()
+            for _ in range(min(len(self.pending), a.max_pending))
+        ]
+        overflow = self.pending
+        self.pending = deque()
+        sizes = np.asarray(
+            [t.size_estimate for t in batch], dtype=np.float32
+        )
+        with self.tracer.span("device_tick"):
+            out = a.tick(sizes)
+
+        # act: reclaim in-flight tasks of dead workers (ahead of the queue)
+        requeued: deque[PendingTask] = deque()
+        for slot in np.flatnonzero(np.asarray(out.redispatch)):
+            task_id = a.inflight_clear_slot(int(slot))
+            if task_id is None:
+                continue
+            try:
+                fn_payload, param_payload = self.store.get_payloads(task_id)
+            except KeyError:
+                continue
+            requeued.append(PendingTask(task_id, fn_payload, param_payload))
+        for row in np.flatnonzero(np.asarray(out.purged)):
+            self.log.warning("purged worker row %d", int(row))
+            a.deactivate(int(row))
+
+        # act: send assignments
+        assignment = np.asarray(out.assignment)[: len(batch)]
+        sent = 0
+        still_pending: deque[PendingTask] = deque()
+        for task, row in zip(batch, assignment):
+            row = int(row)
+            if row < 0 or row not in a.row_ids:
+                still_pending.append(task)
+                continue
+            try:
+                # reserve tracking BEFORE sending: a task on the wire but
+                # absent from the inflight table could never be re-dispatched
+                a.inflight_add(task.task_id, row)
+            except RuntimeError:
+                still_pending.append(task)  # inflight table full: wait
+                continue
+            wid = a.row_ids[row]
+            self.socket.send_multipart(
+                [
+                    wid,
+                    m.encode(
+                        m.TASK,
+                        task_id=task.task_id,
+                        fn_payload=task.fn_payload,
+                        param_payload=task.param_payload,
+                    ),
+                ]
+            )
+            self.mark_running(task.task_id)
+            a.worker_free[row] -= 1
+            sent += 1
+            self.n_dispatched += 1
+        self.pending = requeued + still_pending + overflow
+        return sent
+
+    def start(self, max_results: int | None = None) -> int:
+        try:
+            last_tick = 0.0
+            while not self.stopping:
+                events = dict(self.poller.poll(max(1, int(self.tick_period * 1000))))
+                if self.socket in events:
+                    while True:
+                        try:
+                            wid, raw = self.socket.recv_multipart(
+                                flags=zmq.NOBLOCK
+                            )
+                        except zmq.Again:
+                            break
+                        msg_type, data = m.decode(raw)
+                        self._handle(wid, msg_type, data)
+                now = self.clock()
+                if now - last_tick >= self.tick_period:
+                    self.tick()
+                    last_tick = now
+                if max_results is not None and self.n_results >= max_results:
+                    break
+        finally:
+            self.socket.close(linger=0)
+        return self.n_results

@@ -160,10 +160,9 @@ def sharded_scheduler_tick(
     worker_speed: jnp.ndarray,
     worker_free: jnp.ndarray,
     worker_active: jnp.ndarray,
-    last_heartbeat: jnp.ndarray,
+    heartbeat_age: jnp.ndarray,  # f32[W] seconds since last heartbeat
     prev_live: jnp.ndarray,
     inflight_worker: jnp.ndarray,  # i32[I] sharded or replicated
-    now: jnp.ndarray,
     time_to_expire: jnp.ndarray,
     max_slots: int = 8,
     use_sinkhorn: bool = True,
@@ -171,7 +170,7 @@ def sharded_scheduler_tick(
     """The full fused tick (liveness + purge + placement + redistribution)
     with the pending-task axis sharded across the mesh. Semantics identical
     to sched.state.scheduler_tick."""
-    fresh = (now - last_heartbeat) <= time_to_expire
+    fresh = heartbeat_age <= time_to_expire
     live = worker_active & fresh
     purged = prev_live & ~live
 

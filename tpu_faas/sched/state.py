@@ -42,15 +42,17 @@ def scheduler_tick(
     worker_speed: jnp.ndarray,  # f32[W]
     worker_free: jnp.ndarray,  # i32[W]
     worker_active: jnp.ndarray,  # bool[W] registered
-    last_heartbeat: jnp.ndarray,  # f32[W] seconds (same clock as `now`)
+    heartbeat_age: jnp.ndarray,  # f32[W] seconds since last heartbeat
     prev_live: jnp.ndarray,  # bool[W]
     inflight_worker: jnp.ndarray,  # i32[I] worker per in-flight slot, -1 empty
-    now: jnp.ndarray,  # f32 scalar
     time_to_expire: jnp.ndarray,  # f32 scalar
     max_slots: int = 8,
 ) -> TickOutput:
     # -- failure detection (reference purge_workers, device-side) ----------
-    fresh = (now - last_heartbeat) <= time_to_expire
+    # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
+    # subtract before the device sees anything, so f32 quantization error is
+    # on a small number (the age), never on a large one (time since boot)
+    fresh = heartbeat_age <= time_to_expire
     live = worker_active & fresh
     purged = prev_live & ~live
 
@@ -99,7 +101,9 @@ class SchedulerArrays:
         self.worker_speed = np.zeros(W, dtype=np.float32)
         self.worker_free = np.zeros(W, dtype=np.int32)
         self.worker_active = np.zeros(W, dtype=bool)
-        self.last_heartbeat = np.full(W, -np.inf, dtype=np.float32)
+        # float64: absolute monotonic timestamps live host-side only; the
+        # device receives f32 *ages* (see scheduler_tick)
+        self.last_heartbeat = np.full(W, -np.inf, dtype=np.float64)
         self.prev_live = np.zeros(W, dtype=bool)
         self.worker_procs = np.zeros(W, dtype=np.int32)
         # worker identity (e.g. zmq routing id) <-> row index
@@ -214,16 +218,17 @@ class SchedulerArrays:
         ts[:n] = task_sizes
         tv = np.zeros(self.max_pending, dtype=bool)
         tv[:n] = True
+        now_f = now if now is not None else self.clock()
+        hb_age = (now_f - self.last_heartbeat).astype(np.float32)
         out = scheduler_tick(
             jnp.asarray(ts),
             jnp.asarray(tv),
             jnp.asarray(self.worker_speed),
             jnp.asarray(self.worker_free),
             jnp.asarray(self.worker_active),
-            jnp.asarray(self.last_heartbeat),
+            jnp.asarray(hb_age),
             jnp.asarray(self.prev_live),
             jnp.asarray(self.inflight_worker),
-            jnp.float32(now if now is not None else self.clock()),
             jnp.float32(self.time_to_expire),
             max_slots=self.max_slots,
         )

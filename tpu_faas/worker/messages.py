@@ -1,0 +1,44 @@
+"""Wire envelope for dispatcher <-> worker ZMQ messages.
+
+Same shape as the reference's vocabulary (SURVEY §2.3): every payload is a
+dict ``{"type": ..., "data": {...}}`` run through the core serializer, so
+arbitrary Python values (including results that are themselves serialized
+strings) travel safely.
+
+Message vocabulary:
+
+worker -> dispatcher:
+    REGISTER   data: worker_id (pull) | num_processes (push)
+    RESULT     data: task_id, status, result
+    READY      (pull only) data: worker_id
+    HEARTBEAT  (push hb) data: {}
+    RECONNECT  (push hb) data: free_processes
+
+dispatcher -> worker:
+    TASK       data: task_id, fn_payload, param_payload
+    WAIT       (pull only)
+    RECONNECT  (push hb; request for the worker to re-announce itself)
+"""
+
+from __future__ import annotations
+
+from tpu_faas.core.serialize import deserialize, serialize
+
+REGISTER = "register"
+RESULT = "result"
+READY = "ready"
+HEARTBEAT = "heartbeat"
+RECONNECT = "reconnect"
+TASK = "task"
+WAIT = "wait"
+
+
+def encode(msg_type: str, **data: object) -> bytes:
+    return serialize({"type": msg_type, "data": data}).encode("ascii")
+
+
+def decode(raw: bytes) -> tuple[str, dict]:
+    msg = deserialize(raw.decode("ascii"))
+    if not isinstance(msg, dict) or "type" not in msg:
+        raise ValueError(f"malformed worker message: {msg!r}")
+    return msg["type"], msg.get("data", {})

@@ -1,0 +1,86 @@
+"""Worker-side process pool with broken-pool recovery.
+
+Wraps a ProcessPoolExecutor (forkserver context) around `execute_fn` with the
+same failure semantics the local dispatcher has: a child killed by user code
+surfaces as a FAILED result for that task and the pool is rebuilt, instead of
+the reference's silent slot leak (its workers count busy slots in the parent
+and a vanished child never decrements, pull_worker.py:63-72).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import queue
+from concurrent.futures import Future, ProcessPoolExecutor
+from concurrent.futures.process import BrokenProcessPool
+
+from tpu_faas.core.executor import ExecutionResult, execute_fn
+from tpu_faas.core.serialize import serialize
+from tpu_faas.core.task import TaskStatus
+
+
+class TaskPool:
+    def __init__(self, num_processes: int) -> None:
+        self.num_processes = num_processes
+        self._done: queue.Queue[tuple[str, Future]] = queue.Queue()
+        self._busy = 0
+        self._executor = self._make()
+
+    def _make(self) -> ProcessPoolExecutor:
+        return ProcessPoolExecutor(
+            max_workers=self.num_processes,
+            mp_context=mp.get_context("forkserver"),
+        )
+
+    @property
+    def busy(self) -> int:
+        return self._busy
+
+    @property
+    def free(self) -> int:
+        return self.num_processes - self._busy
+
+    def submit(self, task_id: str, fn_payload: str, param_payload: str) -> None:
+        try:
+            fut = self._executor.submit(
+                execute_fn, task_id, fn_payload, param_payload
+            )
+        except BrokenProcessPool:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = self._make()
+            fut = self._executor.submit(
+                execute_fn, task_id, fn_payload, param_payload
+            )
+        fut.add_done_callback(lambda f, tid=task_id: self._done.put((tid, f)))
+        self._busy += 1
+
+    def drain(self) -> list[ExecutionResult]:
+        """Non-blocking: collect all finished results."""
+        out: list[ExecutionResult] = []
+        while True:
+            try:
+                task_id, fut = self._done.get_nowait()
+            except queue.Empty:
+                return out
+            self._busy -= 1
+            if fut.cancelled():
+                # future cancelled by a broken-pool rebuild: .exception()
+                # would RAISE CancelledError; report the task as FAILED
+                exc: BaseException | None = RuntimeError(
+                    "task cancelled: worker pool died and was rebuilt"
+                )
+            else:
+                exc = fut.exception()
+            if exc is None:
+                out.append(fut.result())
+            else:
+                out.append(
+                    ExecutionResult(
+                        task_id,
+                        str(TaskStatus.FAILED),
+                        serialize(RuntimeError(str(exc))),
+                    )
+                )
+
+    def close(self) -> None:
+        self._executor.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,109 @@
+"""Pull worker: REQ socket + local process pool.
+
+Capability parity with reference PullWorker (pull_worker.py:10-123): register
+with the dispatcher, then loop — pace by ``delay`` (load-bearing for REP/REQ
+fairness across many workers, reference :131-132), ask for work when a pool
+slot is free, ship finished results. Every request is answered with ``task``
+or ``wait`` (the REP/REQ lockstep), and a reply to a ``result`` message may
+itself carry the next task, so a busy fleet never wastes a round trip
+(the reference's inline re-listen trick, pull_worker.py:108-111, made
+structural here: every transaction handles its reply uniformly).
+
+CLI: ``python -m tpu_faas.worker.pull_worker N tcp://host:port [--delay s]``
+(reference pull_worker.py:126-137).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+import uuid
+
+import zmq
+
+from tpu_faas.utils.logging import get_logger
+from tpu_faas.worker import messages as m
+from tpu_faas.worker.pool import TaskPool
+
+log = get_logger("pull_worker")
+
+
+class PullWorker:
+    def __init__(
+        self,
+        num_processes: int,
+        dispatcher_url: str,
+        delay: float = 0.01,
+        recv_timeout_ms: int = 10_000,
+    ) -> None:
+        self.worker_id = str(uuid.uuid4())
+        self.num_processes = num_processes
+        self.delay = delay
+        self.pool = TaskPool(num_processes)
+        self.ctx = zmq.Context.instance()
+        self.socket = self.ctx.socket(zmq.REQ)
+        self.socket.setsockopt(zmq.RCVTIMEO, recv_timeout_ms)
+        self.socket.setsockopt(zmq.LINGER, 0)
+        # survive a dropped reply (dispatcher restart) without wedging the
+        # REQ state machine
+        self.socket.setsockopt(zmq.REQ_RELAXED, 1)
+        self.socket.setsockopt(zmq.REQ_CORRELATE, 1)
+        self.socket.connect(dispatcher_url)
+        self._stopping = False
+
+    def stop(self) -> None:
+        self._stopping = True
+
+    # -- one REQ/REP transaction ------------------------------------------
+    def _transact(self, msg_type: str, **data: object) -> None:
+        """Send one message, receive the mandatory reply, and if the reply
+        carries a task, put it on the pool."""
+        self.socket.send(m.encode(msg_type, **data))
+        reply_type, reply = m.decode(self.socket.recv())
+        if reply_type == m.TASK:
+            self.pool.submit(
+                reply["task_id"], reply["fn_payload"], reply["param_payload"]
+            )
+        # WAIT: nothing to do
+
+    def run(self, max_tasks: int | None = None) -> int:
+        """Main loop; returns number of results shipped (for tests)."""
+        shipped = 0
+        self._transact(m.REGISTER, worker_id=self.worker_id)
+        try:
+            while not self._stopping:
+                time.sleep(self.delay)
+                # ship every finished result; each reply may carry new work
+                for res in self.pool.drain():
+                    self._transact(
+                        m.RESULT,
+                        task_id=res.task_id,
+                        status=res.status,
+                        result=res.result,
+                    )
+                    shipped += 1
+                # ask for work while slots are free
+                if self.pool.free > 0:
+                    self._transact(m.READY, worker_id=self.worker_id)
+                if max_tasks is not None and shipped >= max_tasks:
+                    break
+        finally:
+            self.pool.close()
+            self.socket.close(linger=0)
+        return shipped
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="tpu-faas pull worker")
+    ap.add_argument("num_processes", type=int)
+    ap.add_argument("dispatcher_url")
+    ap.add_argument("-d", "--delay", type=float, default=0.01)
+    ns = ap.parse_args(argv)
+    log.info(
+        "pull worker: %d processes -> %s", ns.num_processes, ns.dispatcher_url
+    )
+    PullWorker(ns.num_processes, ns.dispatcher_url, ns.delay).run()
+
+
+if __name__ == "__main__":
+    main()

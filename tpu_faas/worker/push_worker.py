@@ -1,0 +1,131 @@
+"""Push worker: DEALER socket + local process pool.
+
+Capability parity with reference PushWorker (push_worker.py:10-140): register
+with ``num_processes`` (the dispatcher does admission control — the worker
+never refuses a task, reference README:231), execute whatever arrives, ship
+results as they finish. With ``--hb``: send a heartbeat every
+``heartbeat_period`` seconds and answer the dispatcher's ``reconnect``
+request with the current free-process count (reference push_worker.py:76-82).
+
+Reference bugs fixed, not copied (SURVEY §7.5): the heartbeat timestamp is
+actually updated after sending (the reference never updates
+``last_sent_heartbeat`` so it spams one per loop iteration,
+push_worker.py:61-62), and registration happens exactly once
+(the reference's start_heartbeat registers twice, :47+53).
+
+CLI: ``python -m tpu_faas.worker.push_worker N tcp://host:port [--hb]``
+(reference push_worker.py:143-166).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import zmq
+
+from tpu_faas.utils.logging import get_logger
+from tpu_faas.worker import messages as m
+from tpu_faas.worker.pool import TaskPool
+
+log = get_logger("push_worker")
+
+
+class PushWorker:
+    def __init__(
+        self,
+        num_processes: int,
+        dispatcher_url: str,
+        heartbeat: bool = False,
+        heartbeat_period: float = 1.0,
+        poll_timeout_ms: int = 10,
+    ) -> None:
+        self.num_processes = num_processes
+        self.heartbeat = heartbeat
+        self.heartbeat_period = heartbeat_period
+        self.poll_timeout_ms = poll_timeout_ms
+        self.pool = TaskPool(num_processes)
+        self.ctx = zmq.Context.instance()
+        self.socket = self.ctx.socket(zmq.DEALER)
+        self.socket.setsockopt(zmq.LINGER, 0)
+        self.socket.connect(dispatcher_url)
+        self.poller = zmq.Poller()
+        self.poller.register(self.socket, zmq.POLLIN)
+        self._stopping = False
+
+    def stop(self) -> None:
+        self._stopping = True
+
+    def register(self) -> None:
+        self.socket.send(m.encode(m.REGISTER, num_processes=self.num_processes))
+
+    def run(self, max_tasks: int | None = None) -> int:
+        shipped = 0
+        self.register()
+        last_heartbeat = time.monotonic()
+        try:
+            while not self._stopping:
+                now = time.monotonic()
+                if self.heartbeat and now - last_heartbeat >= self.heartbeat_period:
+                    self.socket.send(m.encode(m.HEARTBEAT))
+                    last_heartbeat = now  # the fix for reference :61-62
+                events = dict(self.poller.poll(self.poll_timeout_ms))
+                if self.socket in events:
+                    while True:
+                        try:
+                            raw = self.socket.recv(flags=zmq.NOBLOCK)
+                        except zmq.Again:
+                            break
+                        msg_type, data = m.decode(raw)
+                        if msg_type == m.TASK:
+                            # no admission gate: dispatcher controls load
+                            self.pool.submit(
+                                data["task_id"],
+                                data["fn_payload"],
+                                data["param_payload"],
+                            )
+                        elif msg_type == m.RECONNECT:
+                            self.socket.send(
+                                m.encode(
+                                    m.RECONNECT,
+                                    free_processes=self.pool.free,
+                                )
+                            )
+                for res in self.pool.drain():
+                    self.socket.send(
+                        m.encode(
+                            m.RESULT,
+                            task_id=res.task_id,
+                            status=res.status,
+                            result=res.result,
+                        )
+                    )
+                    shipped += 1
+                if max_tasks is not None and shipped >= max_tasks:
+                    break
+        finally:
+            self.pool.close()
+            self.socket.close(linger=0)
+        return shipped
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="tpu-faas push worker")
+    ap.add_argument("num_processes", type=int)
+    ap.add_argument("dispatcher_url")
+    ap.add_argument("--hb", action="store_true", help="enable heartbeats")
+    ap.add_argument(
+        "--hb-period", type=float, default=1.0, help="heartbeat period (s)"
+    )
+    ns = ap.parse_args(argv)
+    log.info(
+        "push worker: %d processes -> %s (hb=%s)",
+        ns.num_processes,
+        ns.dispatcher_url,
+        ns.hb,
+    )
+    PushWorker(ns.num_processes, ns.dispatcher_url, ns.hb, ns.hb_period).run()
+
+
+if __name__ == "__main__":
+    main()
