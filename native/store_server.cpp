@@ -1,0 +1,418 @@
+// tpu-faas native task store: a single-threaded RESP2-subset server.
+//
+// The framework's durable store + announce bus (hash per task, pub/sub
+// channel; see tpu_faas/store/base.py for the contract). Speaks the same
+// wire protocol as the Python fallback server (tpu_faas/store/server.py) and
+// any Redis, so clients are interchangeable. Design mirrors what the store
+// actually needs to be fast at: small HSET/HGETALL round trips and pub/sub
+// fan-out, served from one poll(2) event loop with per-connection buffers —
+// no threads, no locks, no allocation in the steady-state paths beyond the
+// hash tables themselves.
+//
+// Supported commands: PING, SELECT (ignored), HSET, HGET, HGETALL, DEL,
+// KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE, FLUSHDB, QUIT, SHUTDOWN.
+//
+// Build: make -C native   ->  native/build/tpu-faas-store
+// Run:   tpu-faas-store [--host 127.0.0.1] [--port 6380]
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <optional>
+#include <stdexcept>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Conn {
+  int fd = -1;
+  std::string inbuf;
+  std::string outbuf;
+  std::unordered_set<std::string> subscribed;
+  bool closing = false;
+};
+
+struct Store {
+  std::unordered_map<std::string,
+                     std::unordered_map<std::string, std::string>>
+      hashes;
+  // channel -> set of fds
+  std::unordered_map<std::string, std::unordered_set<int>> subs;
+};
+
+// ---------------------------------------------------------------- protocol
+
+void reply_simple(std::string& out, const char* s) {
+  out += '+';
+  out += s;
+  out += "\r\n";
+}
+
+void reply_error(std::string& out, const std::string& msg) {
+  out += "-ERR ";
+  out += msg;
+  out += "\r\n";
+}
+
+void reply_integer(std::string& out, long long n) {
+  out += ':';
+  out += std::to_string(n);
+  out += "\r\n";
+}
+
+void reply_bulk(std::string& out, const std::string& s) {
+  out += '$';
+  out += std::to_string(s.size());
+  out += "\r\n";
+  out += s;
+  out += "\r\n";
+}
+
+void reply_nil(std::string& out) { out += "$-1\r\n"; }
+
+void reply_array_header(std::string& out, size_t n) {
+  out += '*';
+  out += std::to_string(n);
+  out += "\r\n";
+}
+
+// Parse one client command (RESP array of bulk strings) from buf starting at
+// offset 0. Returns nullopt if incomplete; on success fills `cmd` and sets
+// `consumed`. Throws std::runtime_error on malformed input.
+std::optional<std::vector<std::string>> parse_command(const std::string& buf,
+                                                      size_t& consumed) {
+  size_t pos = 0;
+  auto read_line = [&](std::string& line) -> bool {
+    size_t end = buf.find("\r\n", pos);
+    if (end == std::string::npos) return false;
+    line.assign(buf, pos, end - pos);
+    pos = end + 2;
+    return true;
+  };
+  if (buf.empty()) return std::nullopt;
+  if (buf[0] != '*') throw std::runtime_error("expected RESP array");
+  std::string line;
+  if (!read_line(line)) return std::nullopt;
+  long n = std::strtol(line.c_str() + 1, nullptr, 10);
+  if (n < 0 || n > 1024 * 1024)
+    throw std::runtime_error("bad array length");
+  std::vector<std::string> cmd;
+  cmd.reserve(n);
+  for (long i = 0; i < n; i++) {
+    if (pos >= buf.size()) return std::nullopt;
+    if (buf[pos] != '$') throw std::runtime_error("expected bulk string");
+    if (!read_line(line)) return std::nullopt;
+    long len = std::strtol(line.c_str() + 1, nullptr, 10);
+    if (len < 0 || len > (1L << 30))
+      throw std::runtime_error("bad bulk length");
+    if (buf.size() < pos + static_cast<size_t>(len) + 2) return std::nullopt;
+    cmd.emplace_back(buf, pos, len);
+    pos += len + 2;
+  }
+  consumed = pos;
+  return cmd;
+}
+
+// glob match supporting * and ? (enough for KEYS patterns the clients use)
+bool glob_match(const char* pat, const char* str) {
+  if (*pat == '\0') return *str == '\0';
+  if (*pat == '*') return glob_match(pat + 1, str) ||
+                          (*str != '\0' && glob_match(pat, str + 1));
+  if (*str == '\0') return false;
+  if (*pat == '?' || *pat == *str) return glob_match(pat + 1, str + 1);
+  return false;
+}
+
+// ---------------------------------------------------------------- server
+
+class Server {
+ public:
+  Server(const std::string& host, int port) : host_(host), port_(port) {}
+
+  int run() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) { perror("socket"); return 1; }
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) != 1) {
+      fprintf(stderr, "bad host %s\n", host_.c_str());
+      return 1;
+    }
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      perror("bind");
+      return 1;
+    }
+    if (listen(listen_fd_, 512) < 0) { perror("listen"); return 1; }
+    if (port_ == 0) {
+      socklen_t len = sizeof(addr);
+      getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    set_nonblocking(listen_fd_);
+    printf("tpu-faas-store listening on %s:%d\n", host_.c_str(), port_);
+    fflush(stdout);
+
+    while (!shutdown_) {
+      std::vector<pollfd> fds;
+      fds.push_back({listen_fd_, POLLIN, 0});
+      for (auto& [fd, conn] : conns_) {
+        short ev = POLLIN;
+        if (!conn.outbuf.empty()) ev |= POLLOUT;
+        fds.push_back({fd, ev, 0});
+      }
+      int rc = ::poll(fds.data(), fds.size(), 1000);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        perror("poll");
+        break;
+      }
+      std::vector<int> to_close;
+      for (auto& p : fds) {
+        if (p.fd == listen_fd_) {
+          if (p.revents & POLLIN) accept_new();
+          continue;
+        }
+        auto it = conns_.find(p.fd);
+        if (it == conns_.end()) continue;
+        Conn& c = it->second;
+        if (p.revents & (POLLERR | POLLHUP)) {
+          to_close.push_back(p.fd);
+          continue;
+        }
+        if (p.revents & POLLIN) {
+          if (!read_from(c)) { to_close.push_back(p.fd); continue; }
+        }
+        if (!c.outbuf.empty()) flush(c);
+        if (c.closing && c.outbuf.empty()) to_close.push_back(p.fd);
+      }
+      for (int fd : to_close) close_conn(fd);
+    }
+    for (auto& [fd, conn] : conns_) ::close(fd);
+    ::close(listen_fd_);
+    return 0;
+  }
+
+ private:
+  static void set_nonblocking(int fd) {
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  }
+
+  void accept_new() {
+    while (true) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return;
+      set_nonblocking(fd);
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      conns_[fd].fd = fd;
+    }
+  }
+
+  bool read_from(Conn& c) {
+    char buf[65536];
+    while (true) {
+      ssize_t n = ::recv(c.fd, buf, sizeof(buf), 0);
+      if (n > 0) {
+        c.inbuf.append(buf, static_cast<size_t>(n));
+        if (c.inbuf.size() > (1UL << 31)) return false;  // runaway client
+        continue;
+      }
+      if (n == 0) return false;  // peer closed
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      return false;
+    }
+    // parse + execute every complete command in the buffer
+    try {
+      while (!c.inbuf.empty()) {
+        size_t consumed = 0;
+        auto cmd = parse_command(c.inbuf, consumed);
+        if (!cmd) break;
+        c.inbuf.erase(0, consumed);
+        execute(c, *cmd);
+        if (c.closing) break;
+      }
+    } catch (const std::exception& e) {
+      reply_error(c.outbuf, std::string("malformed RESP input: ") + e.what());
+      c.closing = true;
+    }
+    return true;
+  }
+
+  void flush(Conn& c) {
+    while (!c.outbuf.empty()) {
+      ssize_t n = ::send(c.fd, c.outbuf.data(), c.outbuf.size(), MSG_NOSIGNAL);
+      if (n > 0) {
+        c.outbuf.erase(0, static_cast<size_t>(n));
+        continue;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      c.closing = true;
+      c.outbuf.clear();
+      return;
+    }
+  }
+
+  void close_conn(int fd) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end()) return;
+    for (const auto& ch : it->second.subscribed) {
+      auto s = store_.subs.find(ch);
+      if (s != store_.subs.end()) s->second.erase(fd);
+    }
+    ::close(fd);
+    conns_.erase(it);
+  }
+
+  void execute(Conn& c, const std::vector<std::string>& cmd) {
+    if (cmd.empty()) { reply_error(c.outbuf, "empty command"); return; }
+    std::string name = cmd[0];
+    for (auto& ch : name) ch = static_cast<char>(toupper(ch));
+    const size_t argc = cmd.size() - 1;
+
+    if (name == "PING") {
+      reply_simple(c.outbuf, "PONG");
+    } else if (name == "SELECT") {
+      reply_simple(c.outbuf, "OK");
+    } else if (name == "HSET") {
+      if (argc < 3 || argc % 2 == 0) {
+        reply_error(c.outbuf, "wrong number of arguments for HSET");
+        return;
+      }
+      auto& h = store_.hashes[cmd[1]];
+      long long added = 0;
+      for (size_t i = 2; i + 1 < cmd.size(); i += 2) {
+        added += h.find(cmd[i]) == h.end() ? 1 : 0;
+        h[cmd[i]] = cmd[i + 1];
+      }
+      reply_integer(c.outbuf, added);
+    } else if (name == "HGET") {
+      if (argc != 2) {
+        reply_error(c.outbuf, "wrong number of arguments for HGET");
+        return;
+      }
+      auto h = store_.hashes.find(cmd[1]);
+      if (h == store_.hashes.end()) { reply_nil(c.outbuf); return; }
+      auto f = h->second.find(cmd[2]);
+      if (f == h->second.end()) { reply_nil(c.outbuf); return; }
+      reply_bulk(c.outbuf, f->second);
+    } else if (name == "HGETALL") {
+      auto h = argc >= 1 ? store_.hashes.find(cmd[1]) : store_.hashes.end();
+      if (h == store_.hashes.end()) {
+        reply_array_header(c.outbuf, 0);
+        return;
+      }
+      reply_array_header(c.outbuf, h->second.size() * 2);
+      for (const auto& [f, v] : h->second) {
+        reply_bulk(c.outbuf, f);
+        reply_bulk(c.outbuf, v);
+      }
+    } else if (name == "DEL") {
+      long long n = 0;
+      for (size_t i = 1; i < cmd.size(); i++) n += store_.hashes.erase(cmd[i]);
+      reply_integer(c.outbuf, n);
+    } else if (name == "KEYS") {
+      const std::string pat = argc >= 1 ? cmd[1] : "*";
+      std::vector<const std::string*> ks;
+      for (const auto& [k, _] : store_.hashes)
+        if (glob_match(pat.c_str(), k.c_str())) ks.push_back(&k);
+      reply_array_header(c.outbuf, ks.size());
+      for (auto* k : ks) reply_bulk(c.outbuf, *k);
+    } else if (name == "PUBLISH") {
+      if (argc != 2) {
+        reply_error(c.outbuf, "wrong number of arguments for PUBLISH");
+        return;
+      }
+      long long n = 0;
+      auto s = store_.subs.find(cmd[1]);
+      if (s != store_.subs.end()) {
+        std::string frame;
+        reply_array_header(frame, 3);
+        reply_bulk(frame, "message");
+        reply_bulk(frame, cmd[1]);
+        reply_bulk(frame, cmd[2]);
+        for (int fd : s->second) {
+          auto it = conns_.find(fd);
+          if (it == conns_.end()) continue;
+          it->second.outbuf += frame;
+          flush(it->second);
+          n++;
+        }
+      }
+      reply_integer(c.outbuf, n);
+    } else if (name == "SUBSCRIBE") {
+      for (size_t i = 1; i < cmd.size(); i++) {
+        c.subscribed.insert(cmd[i]);
+        store_.subs[cmd[i]].insert(c.fd);
+        reply_array_header(c.outbuf, 3);
+        reply_bulk(c.outbuf, "subscribe");
+        reply_bulk(c.outbuf, cmd[i]);
+        reply_integer(c.outbuf, static_cast<long long>(c.subscribed.size()));
+      }
+    } else if (name == "UNSUBSCRIBE") {
+      std::vector<std::string> channels(cmd.begin() + 1, cmd.end());
+      if (channels.empty())
+        channels.assign(c.subscribed.begin(), c.subscribed.end());
+      for (const auto& ch : channels) {
+        c.subscribed.erase(ch);
+        auto s = store_.subs.find(ch);
+        if (s != store_.subs.end()) s->second.erase(c.fd);
+        reply_array_header(c.outbuf, 3);
+        reply_bulk(c.outbuf, "unsubscribe");
+        reply_bulk(c.outbuf, ch);
+        reply_integer(c.outbuf, static_cast<long long>(c.subscribed.size()));
+      }
+    } else if (name == "FLUSHDB") {
+      store_.hashes.clear();
+      reply_simple(c.outbuf, "OK");
+    } else if (name == "QUIT") {
+      reply_simple(c.outbuf, "OK");
+      c.closing = true;
+    } else if (name == "SHUTDOWN") {
+      shutdown_ = true;
+      c.closing = true;
+    } else {
+      reply_error(c.outbuf, "unknown command '" + name + "'");
+    }
+  }
+
+  std::string host_;
+  int port_;
+  int listen_fd_ = -1;
+  bool shutdown_ = false;
+  Store store_;
+  std::unordered_map<int, Conn> conns_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  int port = 6380;
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    if (arg == "--host" && i + 1 < argc) host = argv[++i];
+    else if (arg == "--port" && i + 1 < argc) port = atoi(argv[++i]);
+    else {
+      fprintf(stderr, "usage: %s [--host H] [--port P]\n", argv[0]);
+      return 2;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+  return Server(host, port).run();
+}

@@ -1,0 +1,56 @@
+"""Simulated-fleet scheduler tests: scaled-down versions of BASELINE
+configs 3-5 (full-size versions live in the benchmark harness)."""
+
+import numpy as np
+import pytest
+
+from tpu_faas.sched.oracle import makespan_lower_bound
+from tpu_faas.sim import SimFleet
+
+
+def test_sim_drains_all_tasks_uniform():
+    """Config-3 shape (scaled): uniform cost, homogeneous fleet."""
+    rng = np.random.default_rng(0)
+    fleet = SimFleet(n_workers=64, max_pending=512, rng=rng, hetero=False)
+    sizes = np.ones(1000, dtype=np.float32)
+    res = fleet.run(sizes, dt=0.5)
+    assert res.completed == 1000
+    assert res.lost == 0
+
+
+def test_sim_heterogeneous_makespan_near_bound():
+    """Config-4 shape (scaled): heterogeneous speeds; end-to-end makespan
+    within a modest factor of the offline bound."""
+    rng = np.random.default_rng(1)
+    fleet = SimFleet(n_workers=32, max_pending=1024, rng=rng, hetero=True)
+    sizes = rng.uniform(0.5, 5.0, 600).astype(np.float32)
+    res = fleet.run(sizes, dt=0.25)
+    assert res.completed == 600
+    lb = makespan_lower_bound(
+        sizes,
+        fleet.speeds,
+        np.full(32, 4, dtype=np.int32),
+        np.ones(32, dtype=bool),
+        max_slots=8,
+    )
+    # dt quantization + waves make exact LP parity impossible; the bound
+    # check guards against gross scheduling regressions
+    assert res.makespan <= lb * 2.0 + 2.0
+
+
+@pytest.mark.parametrize("churn", [0.01, 0.05])
+def test_sim_churn_no_lost_tasks(churn):
+    """Config-5 shape (scaled): workers crash and rejoin every tick; the
+    device-computed redistribution must still complete every task."""
+    rng = np.random.default_rng(2)
+    fleet = SimFleet(
+        n_workers=48,
+        max_pending=512,
+        rng=rng,
+        hetero=True,
+        time_to_expire=1.0,  # purge quickly relative to dt
+    )
+    sizes = rng.uniform(0.5, 3.0, 400).astype(np.float32)
+    res = fleet.run(sizes, dt=0.5, churn=churn, max_ticks=4000)
+    assert res.lost == 0
+    assert res.completed == 400

@@ -47,9 +47,22 @@ def test_parser_bulk_with_crlf_in_body():
 # -- server/client integration ---------------------------------------------
 
 
-@pytest.fixture()
-def store_server():
-    handle = start_store_thread()
+@pytest.fixture(params=["python", "native"])
+def store_server(request):
+    """Run the full contract suite against BOTH store servers: the asyncio
+    fallback and the native C++ one (same RESP subset)."""
+    if request.param == "python":
+        handle = start_store_thread()
+    else:
+        from tpu_faas.store.native import (
+            NativeStoreUnavailable,
+            start_native_store,
+        )
+
+        try:
+            handle = start_native_store()
+        except NativeStoreUnavailable as exc:
+            pytest.skip(f"native store unavailable: {exc}")
     yield handle
     handle.stop()
 

@@ -1,0 +1,12 @@
+"""Benchmark harness: end-to-end service measurement + the BASELINE configs.
+
+The service harness reproduces the reference's client_performance.py metrics
+(throughput over the poll window, mean per-task latency, time-to-register,
+medians over simulations with a store flush between runs — BASELINE.md) with
+the unit bug fixed (the reference printed milliseconds labeled "ns",
+client_performance.py:301-302).
+"""
+
+from tpu_faas.bench.harness import BenchResult, measure_service
+
+__all__ = ["BenchResult", "measure_service"]

@@ -1,0 +1,60 @@
+"""Benchmark CLI.
+
+    python -m tpu_faas.bench -m push -w 8 -np 4 -t 10 -ns 3   # ad-hoc run
+    python -m tpu_faas.bench --config 1                        # BASELINE config
+    python -m tpu_faas.bench --config all
+
+Prints one JSON line per measurement (reference client_performance.py's role;
+units are honest seconds/ms — its ms-labeled-as-ns bug is not reproduced).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="tpu-faas benchmarks")
+    ap.add_argument("--config", help="BASELINE config number (1-5) or 'all'")
+    ap.add_argument(
+        "-m", "--mode", default="push",
+        choices=["local", "pull", "push", "push-hb", "push-plb", "tpu-push"],
+    )
+    ap.add_argument("-w", "--workers", type=int, default=8)
+    ap.add_argument("-np", "--procs", type=int, default=4)
+    ap.add_argument("-t", "--tasks-per-worker", type=int, default=10)
+    ap.add_argument("-ns", "--sims", type=int, default=3)
+    ap.add_argument("--workload", default="arithmetic")
+    ap.add_argument("--size", type=int, default=10_000)
+    ap.add_argument("--store", default="auto", choices=["auto", "native", "python"])
+    ns = ap.parse_args(argv)
+
+    if ns.config:
+        from tpu_faas.bench.configs import CONFIGS
+
+        keys = list(CONFIGS) if ns.config == "all" else [ns.config]
+        for key in keys:
+            if key not in CONFIGS:
+                sys.exit(f"unknown config {key!r}; choose from {list(CONFIGS)}")
+            print(json.dumps(CONFIGS[key]()), flush=True)
+        return
+
+    from tpu_faas.bench.harness import measure_service
+
+    res = measure_service(
+        mode=ns.mode,
+        n_workers=ns.workers,
+        n_procs=ns.procs,
+        tasks_per_worker=ns.tasks_per_worker,
+        workload=ns.workload,
+        size=ns.size,
+        n_sims=ns.sims,
+        store_backend=ns.store,
+    )
+    print(json.dumps(res.to_dict()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

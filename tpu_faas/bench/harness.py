@@ -1,0 +1,281 @@
+"""End-to-end service benchmark (reference client_performance.py analog).
+
+Spawns the full stack — store server (native C++ if buildable, else the
+Python fallback), REST gateway, a dispatcher in the chosen mode, N worker
+subprocesses — then measures, from the client side:
+
+- time_to_register_s: wall time to POST every execute_function call
+  (reference client_performance.py:109-116);
+- throughput_tps: n_tasks / wall time of the result-poll window
+  (reference :119-139);
+- avg_latency_s: mean(completion - submit) per task (reference :115,131,140);
+- correctness: every result equals the locally recomputed value
+  (reference test_client.py:121-126).
+
+Medians over ``n_sims`` runs with a FLUSHDB between (reference :162,253).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.utils.logging import get_logger
+from tpu_faas.workloads import make_workload
+
+log = get_logger("bench")
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@dataclass
+class BenchResult:
+    mode: str
+    n_workers: int
+    n_procs: int
+    n_tasks: int
+    throughput_tps: float
+    avg_latency_s: float
+    time_to_register_s: float
+    correctness_rate: float
+    sims: int = 1
+    extras: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "mode": self.mode,
+            "workers": self.n_workers,
+            "procs_per_worker": self.n_procs,
+            "n_tasks": self.n_tasks,
+            "throughput_tps": round(self.throughput_tps, 2),
+            "avg_latency_s": round(self.avg_latency_s, 4),
+            "time_to_register_s": round(self.time_to_register_s, 4),
+            "correctness_rate": self.correctness_rate,
+            "sims": self.sims,
+            **self.extras,
+        }
+
+
+def _spawn_worker(kind: str, n_procs: int, url: str, *extra: str):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.Popen(
+        [sys.executable, "-m", f"tpu_faas.worker.{kind}", str(n_procs), url]
+        + list(extra),
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+@contextmanager
+def full_stack(
+    mode: str,
+    n_workers: int,
+    n_procs: int,
+    store_backend: str = "auto",
+    time_to_expire: float = 10.0,
+):
+    """Spin up store + gateway + dispatcher + workers; yield (client, store)."""
+    native_handle = None
+    store_thread_handle = None
+    if store_backend in ("auto", "native"):
+        try:
+            from tpu_faas.store.native import start_native_store
+
+            native_handle = start_native_store()
+            store_url = native_handle.url
+        except Exception as exc:
+            if store_backend == "native":
+                raise
+            log.info("native store unavailable (%s); using Python server", exc)
+    if native_handle is None:
+        store_thread_handle = start_store_thread()
+        store_url = store_thread_handle.url
+
+    gw = start_gateway_thread(make_store(store_url))
+    admin_store = make_store(store_url)
+
+    disp = None
+    disp_thread = None
+    workers: list[subprocess.Popen] = []
+    local_equiv = None
+    try:
+        if mode == "local":
+            from tpu_faas.dispatch.local import LocalDispatcher
+
+            # local-equivalent sizing: one pool matching the whole remote
+            # fleet (reference client_performance.py:211-218)
+            local_equiv = n_workers * n_procs
+            disp = LocalDispatcher(
+                num_workers=local_equiv, store=make_store(store_url)
+            )
+            disp_thread = threading.Thread(target=disp.start, daemon=True)
+            disp_thread.start()
+        else:
+            if mode == "pull":
+                from tpu_faas.dispatch.pull import PullDispatcher
+
+                disp = PullDispatcher(
+                    ip="127.0.0.1", port=0, store=make_store(store_url)
+                )
+                worker_kind, extra = "pull_worker", ("--delay", "0.005")
+            elif mode in ("push", "push-hb", "push-plb"):
+                from tpu_faas.dispatch.push import PushDispatcher
+
+                disp = PushDispatcher(
+                    ip="127.0.0.1",
+                    port=0,
+                    store=make_store(store_url),
+                    heartbeat=(mode == "push-hb"),
+                    process_lb=(mode == "push-plb"),
+                    time_to_expire=time_to_expire,
+                )
+                worker_kind = "push_worker"
+                extra = (
+                    ("--hb", "--hb-period", "0.5") if mode == "push-hb" else ()
+                )
+            elif mode == "tpu-push":
+                from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+
+                disp = TpuPushDispatcher(
+                    ip="127.0.0.1",
+                    port=0,
+                    store=make_store(store_url),
+                    time_to_expire=time_to_expire,
+                )
+                worker_kind = "push_worker"
+                extra = ("--hb", "--hb-period", "0.5")
+            else:
+                raise ValueError(f"unknown mode {mode!r}")
+            disp_thread = threading.Thread(target=disp.start, daemon=True)
+            disp_thread.start()
+            url = f"tcp://127.0.0.1:{disp.port}"
+            workers = [
+                _spawn_worker(worker_kind, n_procs, url, *extra)
+                for _ in range(n_workers)
+            ]
+            time.sleep(1.0)  # let workers register
+        yield FaaSClient(gw.url), admin_store
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        if disp is not None:
+            disp.stop()
+        if disp_thread is not None:
+            disp_thread.join(timeout=10)
+        gw.stop()
+        admin_store.close()
+        if native_handle is not None:
+            native_handle.stop()
+        if store_thread_handle is not None:
+            store_thread_handle.stop()
+
+
+def _measure_once(
+    client: FaaSClient,
+    fn,
+    params: list,
+    expected: list,
+    timeout: float,
+) -> tuple[float, float, float, float]:
+    """One simulation: returns (throughput, avg_latency, t_register,
+    correctness_rate). ``expected`` is the precomputed local oracle (hoisted
+    out of the sim loop — recomputing a sleep workload would serially sleep
+    on the client); the function is (re-)registered here because the store
+    is flushed between sims."""
+    n_tasks = len(params)
+    fid = client.register(fn)
+
+    t0 = time.perf_counter()
+    submit_at: dict[str, float] = {}
+    handles = []
+    for a, k in params:
+        h = client.submit(fid, *a, **k)
+        submit_at[h.task_id] = time.perf_counter()
+        handles.append(h)
+    t_register = time.perf_counter() - t0
+
+    # rotating poll; throughput is measured over the POLL window only
+    # (reference client_performance.py:119-139)
+    from tpu_faas.core.serialize import deserialize
+
+    todo = deque(enumerate(handles))
+    done_at: dict[str, float] = {}
+    ok = 0
+    t_poll = time.perf_counter()
+    deadline = t_poll + timeout
+    while todo and time.perf_counter() < deadline:
+        i, h = todo.popleft()
+        status, payload = h.client.raw_result(h.task_id)
+        if status in ("COMPLETED", "FAILED"):
+            done_at[h.task_id] = time.perf_counter()
+            if status == "COMPLETED" and deserialize(payload) == expected[i]:
+                ok += 1
+        else:
+            todo.append((i, h))
+    if todo:
+        raise TimeoutError(f"{len(todo)} tasks unfinished after {timeout}s")
+    window = time.perf_counter() - t_poll
+    latencies = [done_at[tid] - submit_at[tid] for tid in done_at]
+    return (
+        n_tasks / window,
+        float(np.mean(latencies)),
+        t_register,
+        ok / n_tasks,
+    )
+
+
+def measure_service(
+    mode: str,
+    n_workers: int = 8,
+    n_procs: int = 4,
+    tasks_per_worker: int = 10,
+    workload: str = "arithmetic",
+    size: int = 10_000,
+    n_sims: int = 3,
+    timeout: float = 300.0,
+    store_backend: str = "auto",
+) -> BenchResult:
+    """Reference client_performance.py:98-148 equivalent: medians over sims."""
+    n_tasks = tasks_per_worker * n_workers
+    fn, params = make_workload(workload, n_tasks, size, seed=1)
+    expected = [fn(*a, **k) for a, k in params]  # local oracle, once
+    tps, lat, reg, corr = [], [], [], []
+    with full_stack(mode, n_workers, n_procs, store_backend) as (client, store):
+        for sim in range(n_sims):
+            t, l, r, c = _measure_once(client, fn, params, expected, timeout)
+            tps.append(t)
+            lat.append(l)
+            reg.append(r)
+            corr.append(c)
+            log.info(
+                "sim %d/%d: %.1f tasks/s, %.4fs avg latency", sim + 1, n_sims, t, l
+            )
+            store.flush()  # reference flushes between sims (:253)
+    return BenchResult(
+        mode=mode,
+        n_workers=n_workers,
+        n_procs=n_procs,
+        n_tasks=n_tasks,
+        throughput_tps=float(np.median(tps)),
+        avg_latency_s=float(np.median(lat)),
+        time_to_register_s=float(np.median(reg)),
+        correctness_rate=float(np.mean(corr)),
+        sims=n_sims,
+    )

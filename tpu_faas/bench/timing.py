@@ -1,0 +1,50 @@
+"""Device timing helpers shared by bench.py and the BASELINE configs.
+
+The pipeline-slope method: dispatch ``n`` in-order executions with fresh
+inputs and force one readback of the last output (a single device stream
+executes in order, so the readback implies all ``n`` completed), at two
+depths ``n1 < n2``; the slope ``(t(n2) - t(n1)) / (n2 - n1)`` isolates
+per-execution device time from the constant per-round-trip transport
+latency. This matters because dev environments may reach the TPU through an
+RPC tunnel with a ~70 ms round-trip floor that has nothing to do with the
+kernel (a production dispatcher holds the device locally and syncs in
+microseconds); naive per-call timing there misreports in BOTH directions —
+async dispatch under-reports, sync round trips over-report.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def pipeline_slope_ms(run, problems, n1: int, n2: int) -> float:
+    """Per-execution device time in ms. ``run(problem)`` must return a
+    structure whose first leaf is a device array; ``problems`` are cycled to
+    give each execution fresh inputs (defeats value-memoizing transports)."""
+    import jax
+
+    def pipelined(n: int) -> float:
+        seq = [problems[i % len(problems)] for i in range(n)]
+        t0 = time.perf_counter()
+        outs = [run(p) for p in seq]
+        np.asarray(jax.tree_util.tree_leaves(outs[-1])[0])
+        return time.perf_counter() - t0
+
+    return (pipelined(n2) - pipelined(n1)) / (n2 - n1) * 1e3
+
+
+def transport_floor_ms(reps: int = 5) -> float:
+    """Median round-trip cost of a trivial synchronous device call."""
+    import jax
+    import jax.numpy as jnp
+
+    trivial = jax.jit(lambda x, i: (x + i).sum())
+    float(trivial(jnp.zeros(16), 0.0))
+    floors = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        float(trivial(jnp.zeros(16), float(i + 1)))
+        floors.append(time.perf_counter() - t0)
+    return float(np.median(floors) * 1e3)

@@ -74,9 +74,16 @@ def auction_placement(
     admitted = task_valid & (arrival_rank < n_match)
 
     # -- benefit matrix (negated cost), -inf on invalid slots --------------
+    # A deterministic jitter (bounded by eps/4, so it costs at most n*eps/4
+    # of optimality) breaks ties: with uniform costs every bidder would
+    # otherwise argmax the SAME slot each round — one winner per round, i.e.
+    # O(n_slots) rounds for the degenerate-but-common all-equal case.
     neg_inf = jnp.float32(-jnp.inf)
     benefit = -task_size[:, None] / jnp.maximum(slot_speed[None, :], 1e-6)
-    benefit = jnp.where(slot_valid[None, :], benefit, neg_inf)
+    jitter = (eps * 0.25) * jax.random.uniform(
+        jax.random.PRNGKey(0), benefit.shape, dtype=jnp.float32
+    )
+    benefit = jnp.where(slot_valid[None, :], benefit + jitter, neg_inf)
 
     task_ids = jnp.arange(T, dtype=jnp.int32)
 

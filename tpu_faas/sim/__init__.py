@@ -1,0 +1,7 @@
+"""Simulated worker fleets: drive the scheduler at 1k-4k workers without
+sockets (SURVEY §7.7 — needed for the BASELINE configs the reference's
+localhost-subprocess testing could never reach)."""
+
+from tpu_faas.sim.fleet import SimFleet, SimResult
+
+__all__ = ["SimFleet", "SimResult"]
