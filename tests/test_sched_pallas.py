@@ -1,0 +1,158 @@
+"""Parity tests for the fused Pallas bidding kernel.
+
+CPU CI runs the kernel in interpret mode against the XLA matrix path. Both
+paths share the elementwise `_bid_block` formula, but compiler-dependent FMA
+contraction can perturb single values by ~1 ulp, so the contract is:
+values equal within a tight tolerance, and argmax indices equal wherever the
+top-2 gap exceeds that tolerance (a near-tie may legitimately flip). The
+auction-level test checks solver-level invariants across backends.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_faas.sched.auction import auction_placement
+from tpu_faas.sched.pallas_kernels import (
+    CHUNK_S,
+    TILE_T,
+    bid_top2_pallas,
+    bid_top2_xla,
+)
+from tpu_faas.sched.problem import PlacementProblem, check_assignment
+
+ATOL = 1e-5
+
+
+def _random_inputs(rng, T, S, frac_valid=0.8):
+    task_size = rng.uniform(0.1, 5.0, T).astype(np.float32)
+    inv_speed = (1.0 / rng.uniform(0.5, 4.0, S)).astype(np.float32)
+    valid = (rng.random(S) < frac_valid).astype(np.float32)
+    price = rng.uniform(0.0, 3.0, S).astype(np.float32)
+    return (
+        jnp.asarray(task_size),
+        jnp.asarray(inv_speed),
+        jnp.asarray(valid),
+        jnp.asarray(price),
+    )
+
+
+def _assert_top2_equiv(xla_out, pallas_out):
+    v1x, bx, v2x = (np.asarray(a) for a in xla_out)
+    v1p, bp, v2p = (np.asarray(a) for a in pallas_out)
+    np.testing.assert_allclose(v1x, v1p, rtol=0, atol=ATOL)
+    np.testing.assert_allclose(v2x, v2p, rtol=0, atol=ATOL)
+    decisive = np.isfinite(v1x) & ((v1x - v2x) > 2 * ATOL)
+    np.testing.assert_array_equal(bx[decisive], bp[decisive])
+
+
+@pytest.mark.parametrize(
+    "T,S",
+    [
+        (TILE_T, CHUNK_S),
+        (2 * TILE_T, CHUNK_S),
+        # multi-chunk: exercises the cross-chunk top-2 union + tie-keep in
+        # the kernel accumulator (j > 0 path)
+        (TILE_T, 3 * CHUNK_S),
+    ],
+)
+def test_bid_top2_parity(T, S):
+    rng = np.random.default_rng(0)
+    args = _random_inputs(rng, T, S)
+    scale = jnp.float32(2.5e-4)
+    _assert_top2_equiv(
+        bid_top2_xla(*args, scale),
+        bid_top2_pallas(*args, scale, interpret=True),
+    )
+
+
+def test_bid_top2_cross_chunk_duplicate_max():
+    """A max duplicated across two chunks must keep the earlier index and
+    report v2 == v1 (the XLA path excludes only the argmax-first position)."""
+    T, S = TILE_T, 2 * CHUNK_S
+    ts = jnp.ones(T, dtype=jnp.float32)
+    inv = jnp.ones(S, dtype=jnp.float32)
+    price = jnp.ones(S, dtype=jnp.float32)
+    # two identical standout slots, one per chunk; zero jitter keeps the tie
+    price = price.at[37].set(0.0).at[CHUNK_S + 911].set(0.0)
+    valid = jnp.ones(S, dtype=jnp.float32)
+    scale = jnp.float32(0.0)
+    v1x, bx, v2x = bid_top2_xla(ts, inv, valid, price, scale)
+    v1p, bp, v2p = bid_top2_pallas(ts, inv, valid, price, scale, interpret=True)
+    assert np.all(np.asarray(bx) == 37) and np.all(np.asarray(bp) == 37)
+    np.testing.assert_array_equal(np.asarray(v1x), np.asarray(v1p))
+    np.testing.assert_array_equal(np.asarray(v2x), np.asarray(v2p))
+    np.testing.assert_array_equal(np.asarray(v1p), np.asarray(v2p))
+
+
+def test_bid_top2_all_invalid_slots():
+    rng = np.random.default_rng(1)
+    ts, inv, _, price = _random_inputs(rng, TILE_T, CHUNK_S)
+    none = jnp.zeros(CHUNK_S, dtype=jnp.float32)
+    scale = jnp.float32(1e-4)
+    out_x = bid_top2_xla(ts, inv, none, price, scale)
+    out_p = bid_top2_pallas(ts, inv, none, price, scale, interpret=True)
+    assert np.all(np.asarray(out_x[0]) == -np.inf)
+    assert np.all(np.asarray(out_p[0]) == -np.inf)
+    assert np.all(np.asarray(out_p[2]) == -np.inf)
+
+
+def test_bid_top2_single_valid_slot():
+    """v2 must be -inf when exactly one slot is biddable (the auction caps
+    the bid increment at 1.0 in that case)."""
+    rng = np.random.default_rng(2)
+    ts, inv, _, price = _random_inputs(rng, TILE_T, CHUNK_S)
+    one = jnp.zeros(CHUNK_S, dtype=jnp.float32).at[137].set(1.0)
+    scale = jnp.float32(1e-4)
+    out_x = bid_top2_xla(ts, inv, one, price, scale)
+    out_p = bid_top2_pallas(ts, inv, one, price, scale, interpret=True)
+    for v1, b, v2 in (out_x, out_p):
+        assert np.all(np.asarray(b) == 137)
+        assert np.all(np.asarray(v2) == -np.inf)
+    _assert_top2_equiv(out_x, out_p)
+
+
+def test_auction_backend_invariant():
+    """Solver-level invariants must hold through either bid path, and the
+    two placements must agree in count and near-agree in cost (near-ties may
+    be broken differently under FMA contraction). Shapes meet the kernel's
+    tiling (T=1024, S=512*4=2048); the task count is small so the
+    interpreted kernel converges in few rounds."""
+    rng = np.random.default_rng(3)
+    n_tasks, n_workers, max_slots = 60, 300, 4
+    p = PlacementProblem.build(
+        rng.uniform(0.1, 5.0, n_tasks).astype(np.float32),
+        rng.uniform(0.5, 4.0, n_workers).astype(np.float32),
+        rng.integers(0, max_slots + 1, n_workers).astype(np.int32),
+        rng.random(n_workers) > 0.1,
+        T=TILE_T,
+        W=512,
+    )
+
+    def run(backend):
+        return auction_placement(
+            p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+            p.worker_live, max_slots=max_slots, backend=backend,
+        )
+
+    def cost(assign):
+        a = np.asarray(assign)
+        placed = a >= 0
+        return float(
+            (np.asarray(p.task_size)[placed]
+             / np.asarray(p.worker_speed)[a[placed]]).sum()
+        )
+
+    res_x = run("xla")
+    res_p = run("pallas_interpret")
+    ax = np.asarray(res_x.assignment)
+    ap = np.asarray(res_p.assignment)
+    for a in (ax, ap):
+        check_assignment(
+            a, np.asarray(p.task_valid),
+            np.minimum(np.asarray(p.worker_free), max_slots),
+            np.asarray(p.worker_live),
+        )
+    assert (ax >= 0).sum() == (ap >= 0).sum()
+    # both are eps-optimal: costs agree within the auction's optimality slack
+    assert abs(cost(ax) - cost(ap)) <= n_tasks * 1e-3 + 1e-4

@@ -25,6 +25,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from tpu_faas.sched.pallas_kernels import bid_top2
+
 
 class AuctionResult(NamedTuple):
     assignment: jnp.ndarray  # i32[T] worker per task, -1 = stay queued
@@ -32,7 +34,9 @@ class AuctionResult(NamedTuple):
     prices: jnp.ndarray  # f32[S] final slot prices
 
 
-@partial(jax.jit, static_argnames=("max_slots", "max_rounds", "n_phases"))
+@partial(
+    jax.jit, static_argnames=("max_slots", "max_rounds", "n_phases", "backend")
+)
 def auction_placement(
     task_size: jnp.ndarray,  # f32[T]
     task_valid: jnp.ndarray,  # bool[T]
@@ -43,6 +47,7 @@ def auction_placement(
     eps: float = 1e-3,
     max_rounds: int = 2000,
     n_phases: int = 5,
+    backend: str = "auto",
 ) -> AuctionResult:
     T = task_size.shape[0]
     W = worker_speed.shape[0]
@@ -73,17 +78,18 @@ def auction_placement(
     arrival_rank = jnp.cumsum(task_valid.astype(jnp.int32)) - 1
     admitted = task_valid & (arrival_rank < n_match)
 
-    # -- benefit matrix (negated cost), -inf on invalid slots --------------
-    # A deterministic jitter (bounded by eps/4, so it costs at most n*eps/4
-    # of optimality) breaks ties: with uniform costs every bidder would
+    # -- implicit benefit matrix, fused bid kernel -------------------------
+    # Benefit = -size/speed + jitter, -inf on invalid slots. Never
+    # materialized: the per-round top-2 over (benefit - price) is computed by
+    # tpu_faas.sched.pallas_kernels.bid_top2 from the 1-D inputs (a fused
+    # Pallas kernel on TPU, one XLA matrix op elsewhere). A deterministic
+    # hash jitter (bounded by eps/4, so it costs at most n*eps/4 of
+    # optimality) breaks ties: with uniform costs every bidder would
     # otherwise argmax the SAME slot each round — one winner per round, i.e.
     # O(n_slots) rounds for the degenerate-but-common all-equal case.
-    neg_inf = jnp.float32(-jnp.inf)
-    benefit = -task_size[:, None] / jnp.maximum(slot_speed[None, :], 1e-6)
-    jitter = (eps * 0.25) * jax.random.uniform(
-        jax.random.PRNGKey(0), benefit.shape, dtype=jnp.float32
-    )
-    benefit = jnp.where(slot_valid[None, :], benefit + jitter, neg_inf)
+    inv_speed = 1.0 / jnp.maximum(slot_speed, 1e-6)
+    valid_f = slot_valid.astype(jnp.float32)
+    jitter_scale = jnp.float32(eps * 0.25)
 
     task_ids = jnp.arange(T, dtype=jnp.int32)
 
@@ -91,10 +97,15 @@ def auction_placement(
     # Rounds-to-converge scales with (benefit range / eps); starting with a
     # coarse eps and tightening geometrically keeps each phase short while
     # the final phase delivers n*eps_final optimality (Bertsekas 1992).
-    finite = jnp.where(jnp.isfinite(benefit) & admitted[:, None], benefit, jnp.nan)
-    bmax = jnp.nanmax(finite)
-    bmin = jnp.nanmin(finite)
-    rng = jnp.where(jnp.isfinite(bmax - bmin), bmax - bmin, 0.0)
+    # Benefit is separable (-size·inv_speed), so its range over admitted
+    # tasks x valid slots comes from 1-D extrema — no [T,S] reduction.
+    inf = jnp.float32(jnp.inf)
+    size_min = jnp.min(jnp.where(admitted, task_size, inf))
+    size_max = jnp.max(jnp.where(admitted, task_size, -inf))
+    inv_min = jnp.min(jnp.where(slot_valid, inv_speed, inf))
+    inv_max = jnp.max(jnp.where(slot_valid, inv_speed, -inf))
+    rng = size_max * inv_max - size_min * inv_min
+    rng = jnp.where(jnp.isfinite(rng) & (rng > 0), rng, 0.0)
     eps_final = jnp.float32(eps)
     eps0 = jnp.maximum(rng / 2.0, eps_final)
     # n_phases is static: guard the Python division (exponent 0 -> ratio 1)
@@ -110,13 +121,10 @@ def auction_placement(
         price, owner, assigned_slot, rounds, eps_i = carry
         bidder = admitted & (assigned_slot < 0)
 
-        value = benefit - price[None, :]  # [T,S]
-        v1 = value.max(axis=1)
-        best = value.argmax(axis=1).astype(jnp.int32)
-        masked = jnp.where(
-            jax.nn.one_hot(best, S, dtype=bool), neg_inf, value
+        v1, best, v2 = bid_top2(
+            task_size, inv_speed, valid_f, price, jitter_scale,
+            backend=backend,
         )
-        v2 = masked.max(axis=1)
         # single valid slot: v2 = -inf -> bid caps at a large increment
         incr = jnp.where(jnp.isfinite(v2), v1 - v2, 1.0) + eps_i
         bid_price = price[best] + incr
