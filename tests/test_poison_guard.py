@@ -1,0 +1,174 @@
+"""Poison-task guard: a task that repeatedly takes its worker down is FAILED
+after ``max_task_retries`` reclaims instead of cycling through the fleet
+forever. (The reference *loses* such tasks outright — SURVEY §5.3; our
+re-dispatch upgrade needs this bound to stay safe against crash-looping
+payloads, e.g. a function that segfaults its pool process.)"""
+
+from __future__ import annotations
+
+from tpu_faas.core.serialize import deserialize
+from tpu_faas.dispatch.push import PushDispatcher
+from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+from tpu_faas.store import MemoryStore
+from tpu_faas.worker import messages as m
+
+
+def _drain_failed(store, task_id):
+    status, result = store.get_result(task_id)
+    assert status == "FAILED"
+    err = deserialize(result)
+    assert isinstance(err, RuntimeError)
+    assert "lost with its worker" in str(err)
+
+
+def test_push_hb_poison_task_fails_after_max_retries():
+    store = MemoryStore()
+    disp = PushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=store,
+        heartbeat=True,
+        time_to_expire=5.0,
+        max_task_retries=2,
+    )
+    try:
+        store.create_task("t1", "F", "P", "tasks")
+        for round_no in range(3):  # dispatch at retries 0, 1, 2; then FAILED
+            wid = f"w{round_no}".encode()
+            disp._handle(wid, m.REGISTER, {"num_processes": 1})
+            assert disp._dispatch_round() == 1
+            assert store.get_status("t1") == "RUNNING"
+            # the worker dies silently: age its heartbeat past expiry
+            disp.workers[wid].last_heartbeat -= 100.0
+            disp.purge_workers()
+        assert not disp.requeue  # nothing cycles after the guard trips
+        _drain_failed(store, "t1")
+    finally:
+        disp.socket.close(linger=0)
+
+
+def test_push_hb_result_clears_retry_count():
+    """A reclaim followed by a successful run must not leave stale retry
+    state that could fail a later unrelated reclaim early."""
+    store = MemoryStore()
+    disp = PushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=store,
+        heartbeat=True,
+        time_to_expire=5.0,
+        max_task_retries=1,
+    )
+    try:
+        store.create_task("t1", "F", "P", "tasks")
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 1})
+        assert disp._dispatch_round() == 1
+        disp.workers[b"w0"].last_heartbeat -= 100.0
+        disp.purge_workers()  # reclaim #1 (== max_task_retries: still OK)
+        disp._handle(b"w1", m.REGISTER, {"num_processes": 1})
+        assert disp._dispatch_round() == 1
+        # this time the worker finishes it
+        disp._handle(
+            b"w1", m.RESULT, {"task_id": "t1", "status": "COMPLETED", "result": "R"}
+        )
+        assert store.get_status("t1") == "COMPLETED"
+        assert not disp.workers[b"w1"].inflight_retries
+    finally:
+        disp.socket.close(linger=0)
+
+
+def test_push_hb_zombie_result_freezes_record():
+    """A heartbeat-silent worker whose task was reclaimed may still finish
+    it. Its late result must stick (first terminal write wins) and the
+    requeued copy must be dropped instead of regressing the record to
+    RUNNING and re-running the task."""
+    store = MemoryStore()
+    disp = PushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=store,
+        heartbeat=True,
+        time_to_expire=5.0,
+    )
+    try:
+        store.create_task("t1", "F", "P", "tasks")
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 1})
+        assert disp._dispatch_round() == 1
+        disp.workers[b"w0"].last_heartbeat -= 100.0
+        disp.purge_workers()  # t1 reclaimed into the requeue
+        assert len(disp.requeue) == 1
+        # the zombie was only slow — its result arrives after the purge
+        # (unknown sender path: the record was deleted with the purge)
+        disp._handle(
+            b"w0", m.RESULT, {"task_id": "t1", "status": "COMPLETED", "result": "R"}
+        )
+        assert store.get_result("t1") == ("COMPLETED", "R")
+        # a fresh worker must NOT receive the requeued copy
+        disp._handle(b"w1", m.REGISTER, {"num_processes": 1})
+        assert disp._dispatch_round() == 0
+        assert store.get_result("t1") == ("COMPLETED", "R")
+        assert not disp.requeue
+    finally:
+        disp.socket.close(linger=0)
+
+
+def test_tpu_push_zombie_result_freezes_record():
+    store = MemoryStore()
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=store,
+        max_workers=4,
+        max_pending=8,
+        max_inflight=16,
+        recover_queued=False,
+        time_to_expire=5.0,
+    )
+    try:
+        store.create_task("t1", "F", "P", "tasks")
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 1})
+        assert disp.tick() == 1
+        row = disp.arrays.worker_ids[b"w0"]
+        disp.arrays.last_heartbeat[row] -= 100.0
+        disp.tick()  # purge + reclaim into pending
+        assert len(disp.pending) == 1
+        disp._handle(
+            b"w0", m.RESULT, {"task_id": "t1", "status": "COMPLETED", "result": "R"}
+        )
+        assert store.get_result("t1") == ("COMPLETED", "R")
+        disp._handle(b"w1", m.REGISTER, {"num_processes": 1})
+        assert disp.tick() == 0  # requeued copy dropped at dispatch
+        assert store.get_result("t1") == ("COMPLETED", "R")
+        assert not disp.task_retries
+    finally:
+        disp.socket.close(linger=0)
+
+
+def test_tpu_push_poison_task_fails_after_max_retries():
+    store = MemoryStore()
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=store,
+        max_workers=4,
+        max_pending=8,
+        max_inflight=16,
+        recover_queued=False,
+        time_to_expire=5.0,
+        max_task_retries=2,
+    )
+    try:
+        store.create_task("t1", "F", "P", "tasks")
+        for round_no in range(3):
+            wid = f"w{round_no}".encode()
+            disp._handle(wid, m.REGISTER, {"num_processes": 1})
+            assert disp.tick() == 1
+            assert store.get_status("t1") == "RUNNING"
+            row = disp.arrays.worker_ids[wid]
+            disp.arrays.last_heartbeat[row] -= 100.0
+            disp.tick()  # purge + reclaim (or FAILED on the last round)
+        assert not disp.pending
+        assert not disp.task_retries
+        _drain_failed(store, "t1")
+    finally:
+        disp.socket.close(linger=0)

@@ -41,6 +41,14 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--hb", action="store_true", help="push: heartbeat mode")
     ap.add_argument("--plb", action="store_true", help="push: process-level balancing")
     ap.add_argument(
+        "--tte", type=float, default=cfg.time_to_expire,
+        help="seconds of heartbeat silence before a worker is purged",
+    )
+    ap.add_argument(
+        "--max-task-retries", type=int, default=3,
+        help="reclaims from dead workers before a task is FAILED (poison guard)",
+    )
+    ap.add_argument(
         "-d", "--delay", type=float, default=0.0, help="startup delay seconds"
     )
     ns = ap.parse_args(argv)
@@ -65,9 +73,19 @@ def main(argv: list[str] | None = None) -> None:
     except ImportError as exc:
         sys.exit(f"dispatcher mode {ns.mode!r} is not available: {exc}")
 
-    kwargs = dict(ip=ns.ip, port=ns.port, store_url=ns.store)
+    kwargs = dict(
+        ip=ns.ip,
+        port=ns.port,
+        store_url=ns.store,
+        time_to_expire=ns.tte,
+        max_task_retries=ns.max_task_retries,
+    )
     if ns.mode == "push":
         kwargs.update(heartbeat=ns.hb, process_lb=ns.plb)
+    elif ns.mode == "pull":
+        # pull workers have no heartbeat protocol (reference SURVEY §3.4)
+        kwargs.pop("time_to_expire")
+        kwargs.pop("max_task_retries")
     d = cls(**kwargs)
     log.info("%s dispatcher on %s:%d", ns.mode, ns.ip, ns.port)
     d.start()

@@ -22,9 +22,10 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass
 
+from tpu_faas.core.serialize import serialize
+from tpu_faas.core.task import TaskStatus
 from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
 from tpu_faas.store.launch import make_store
-from tpu_faas.core.task import TaskStatus
 from tpu_faas.utils.logging import get_logger
 
 
@@ -33,6 +34,10 @@ class PendingTask:
     task_id: str
     fn_payload: str
     param_payload: str
+    #: how many times this task has been reclaimed from a dead worker and
+    #: re-queued (poison-task guard: a task that keeps killing its workers is
+    #: FAILED after ``max_task_retries`` reclaims instead of cycling forever)
+    retries: int = 0
 
     @property
     def size_estimate(self) -> float:
@@ -87,8 +92,27 @@ class TaskDispatcher:
     def mark_running(self, task_id: str) -> None:
         self.store.set_status(task_id, TaskStatus.RUNNING)
 
-    def record_result(self, task_id: str, status: str, result: str) -> None:
-        self.store.finish_task(task_id, status, result)
+    def record_result(
+        self, task_id: str, status: str, result: str, first_wins: bool = False
+    ) -> None:
+        """``first_wins=True`` on paths where a second result for the same
+        task is possible (zombie worker of a re-dispatched task)."""
+        self.store.finish_task(task_id, status, result, first_wins=first_wins)
+
+    def fail_task(self, task_id: str, reason: str) -> None:
+        """Terminal FAILED write with a client-deserializable exception as the
+        result (same payload shape the executor's catch-all produces). Never
+        overwrites a real result that arrived first."""
+        self.record_result(
+            task_id,
+            str(TaskStatus.FAILED),
+            serialize(RuntimeError(reason)),
+            first_wins=True,
+        )
+
+    def task_is_terminal(self, task_id: str) -> bool:
+        status = self.store.get_status(task_id)
+        return status is not None and TaskStatus(status).is_terminal()
 
     # -- lifecycle ---------------------------------------------------------
     def stop(self) -> None:

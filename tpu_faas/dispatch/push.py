@@ -49,6 +49,9 @@ class WorkerRecord:
     free_processes: int
     last_heartbeat: float
     inflight: set[str] = field(default_factory=set)
+    #: prior reclaim count per in-flight task (nonzero only for tasks that
+    #: already survived a worker death) — consulted by the poison guard
+    inflight_retries: dict[str, int] = field(default_factory=dict)
 
     def is_alive(self, now: float, time_to_expire: float) -> bool:
         return (now - self.last_heartbeat) <= time_to_expire
@@ -66,6 +69,7 @@ class PushDispatcher(TaskDispatcher):
         process_lb: bool = False,
         time_to_expire: float = 10.0,
         poll_timeout_ms: int = 5,
+        max_task_retries: int = 3,
         clock=time.monotonic,
     ) -> None:
         super().__init__(store_url=store_url, channel=channel, store=store)
@@ -82,6 +86,7 @@ class PushDispatcher(TaskDispatcher):
         self.process_lb = process_lb
         self.time_to_expire = time_to_expire
         self.poll_timeout_ms = poll_timeout_ms
+        self.max_task_retries = max_task_retries
         self.clock = clock
 
         self.workers: dict[bytes, WorkerRecord] = {}
@@ -158,9 +163,19 @@ class PushDispatcher(TaskDispatcher):
         rec.last_heartbeat = now
         if msg_type == m.RESULT:
             task_id = data["task_id"]
-            self.record_result(task_id, data["status"], data["result"])
+            # suspicious = a second result is possible: the sender doesn't
+            # hold the task (zombie whose task was reclaimed), or the task
+            # was reclaimed at least once before reaching this worker
+            suspicious = (
+                task_id not in rec.inflight
+                or task_id in rec.inflight_retries
+            )
+            self.record_result(
+                task_id, data["status"], data["result"], first_wins=suspicious
+            )
             self.n_results += 1
             rec.inflight.discard(task_id)
+            rec.inflight_retries.pop(task_id, None)
             rec.free_processes = min(rec.free_processes + 1, rec.num_processes)
             if self.process_lb:
                 self.free_procs.appendleft(wid)
@@ -192,12 +207,29 @@ class PushDispatcher(TaskDispatcher):
             rec = self.workers.pop(wid)
             self._remove_free(wid)
             for task_id in rec.inflight:
+                retries = rec.inflight_retries.get(task_id, 0) + 1
+                if retries > self.max_task_retries:
+                    # poison guard: a task that has now taken down
+                    # max_task_retries workers is failed, not re-queued
+                    self.log.error(
+                        "task %s lost with its worker %d times; FAILED",
+                        task_id,
+                        retries,
+                    )
+                    self.fail_task(
+                        task_id,
+                        f"task lost with its worker {retries} times "
+                        f"(max_task_retries={self.max_task_retries})",
+                    )
+                    continue
                 try:
                     fn_payload, param_payload = self.store.get_payloads(task_id)
                 except KeyError:
                     continue
                 self.requeue.append(
-                    PendingTask(task_id, fn_payload, param_payload)
+                    PendingTask(
+                        task_id, fn_payload, param_payload, retries=retries
+                    )
                 )
             if rec.inflight:
                 self.log.warning(
@@ -209,8 +241,14 @@ class PushDispatcher(TaskDispatcher):
 
     # -- dispatch ----------------------------------------------------------
     def _next_task(self) -> PendingTask | None:
-        if self.requeue:
-            return self.requeue.popleft()
+        while self.requeue:
+            task = self.requeue.popleft()
+            # a reclaimed task may have been finished meanwhile by its zombie
+            # worker; re-dispatching it would mark a terminal record RUNNING
+            # and re-run it — drop it instead
+            if self.task_is_terminal(task.task_id):
+                continue
+            return task
         return self.poll_next_task()
 
     def _dispatch_round(self) -> int:
@@ -240,6 +278,8 @@ class PushDispatcher(TaskDispatcher):
             )
             self.mark_running(task.task_id)
             rec.inflight.add(task.task_id)
+            if task.retries:
+                rec.inflight_retries[task.task_id] = task.retries
             rec.free_processes -= 1
             sent += 1
             self.n_dispatched += 1

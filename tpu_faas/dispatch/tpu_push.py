@@ -52,6 +52,7 @@ class TpuPushDispatcher(TaskDispatcher):
         max_inflight: int = 65536,
         max_slots: int = 8,
         recover_queued: bool = True,
+        max_task_retries: int = 3,
         clock=time.monotonic,
     ) -> None:
         super().__init__(store_url=store_url, channel=channel, store=store)
@@ -76,6 +77,10 @@ class TpuPushDispatcher(TaskDispatcher):
         )
         self.pending: deque[PendingTask] = deque()
         self.tracer = TickTracer()
+        self.max_task_retries = max_task_retries
+        # reclaim count per task (poison guard); entries exist only for tasks
+        # that have survived >= 1 worker death, cleared on their result
+        self.task_retries: dict[str, int] = {}
         self.n_results = 0
         self.n_dispatched = 0
         if recover_queued:
@@ -116,8 +121,21 @@ class TpuPushDispatcher(TaskDispatcher):
                 return
         if msg_type == m.RESULT:
             task_id = data["task_id"]
-            self.record_result(task_id, data["status"], data["result"])
+            owner = a.inflight_owner(task_id)
+            from_owner = (
+                owner is not None
+                and owner in a.row_ids
+                and a.row_ids[owner] == wid
+            )
+            # suspicious = a second result is possible: sender is not the
+            # task's current owner (zombie after a reclaim), or the task was
+            # reclaimed at least once on its way to this worker
+            suspicious = not from_owner or task_id in self.task_retries
+            self.record_result(
+                task_id, data["status"], data["result"], first_wins=suspicious
+            )
             self.n_results += 1
+            self.task_retries.pop(task_id, None)
             row = a.inflight_done(task_id)
             a.heartbeat(wid)
             if row is not None and row in a.row_ids and a.row_ids[row] == wid:
@@ -158,11 +176,34 @@ class TpuPushDispatcher(TaskDispatcher):
             task_id = a.inflight_clear_slot(int(slot))
             if task_id is None:
                 continue
+            retries = self.task_retries.get(task_id, 0) + 1
+            if retries > self.max_task_retries:
+                # poison guard: this task has now taken down
+                # max_task_retries workers — fail it, don't cycle it
+                self.task_retries.pop(task_id, None)
+                self.log.error(
+                    "task %s lost with its worker %d times; FAILED",
+                    task_id,
+                    retries,
+                )
+                self.fail_task(
+                    task_id,
+                    f"task lost with its worker {retries} times "
+                    f"(max_task_retries={self.max_task_retries})",
+                )
+                continue
             try:
                 fn_payload, param_payload = self.store.get_payloads(task_id)
             except KeyError:
+                # payloads vanished (store flushed): nothing to re-dispatch,
+                # and leaving a retry entry would haunt a future task that
+                # reuses the id
+                self.task_retries.pop(task_id, None)
                 continue
-            requeued.append(PendingTask(task_id, fn_payload, param_payload))
+            self.task_retries[task_id] = retries
+            requeued.append(
+                PendingTask(task_id, fn_payload, param_payload, retries=retries)
+            )
         for row in np.flatnonzero(np.asarray(out.purged)):
             self.log.warning("purged worker row %d", int(row))
             a.deactivate(int(row))
@@ -175,6 +216,11 @@ class TpuPushDispatcher(TaskDispatcher):
             row = int(row)
             if row < 0 or row not in a.row_ids:
                 still_pending.append(task)
+                continue
+            if task.retries and self.task_is_terminal(task.task_id):
+                # reclaimed task finished meanwhile by its zombie worker:
+                # re-dispatching would regress the record to RUNNING
+                self.task_retries.pop(task.task_id, None)
                 continue
             try:
                 # reserve tracking BEFORE sending: a task on the wire but

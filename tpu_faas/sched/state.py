@@ -180,6 +180,11 @@ class SchedulerArrays:
         self._inflight_slot[task_id] = slot
         return slot
 
+    def inflight_owner(self, task_id: str) -> int | None:
+        """Worker row currently holding this task, or None if not in flight."""
+        slot = self._inflight_slot.get(task_id)
+        return None if slot is None else int(self.inflight_worker[slot])
+
     def inflight_done(self, task_id: str) -> int | None:
         """Result arrived: free the slot, return the worker row."""
         slot = self._inflight_slot.pop(task_id, None)

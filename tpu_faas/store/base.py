@@ -131,9 +131,29 @@ class TaskStore(abc.ABC):
     def get_status(self, task_id: str) -> str | None:
         return self.hget(task_id, FIELD_STATUS)
 
-    def finish_task(self, task_id: str, status: TaskStatus | str, result: str) -> None:
+    def finish_task(
+        self,
+        task_id: str,
+        status: TaskStatus | str,
+        result: str,
+        first_wins: bool = False,
+    ) -> None:
         """Record a terminal status + serialized result in one write
-        (reference task_dispatcher.py:153-156, 284-295)."""
+        (reference task_dispatcher.py:153-156, 284-295).
+
+        With ``first_wins`` the record is frozen once terminal: a second
+        result cannot overwrite what a client may already have observed. The
+        re-dispatch upgrade makes two results for one task possible (zombie
+        worker + replacement both finish it), so dispatchers pass
+        ``first_wins=True`` exactly on those suspicious paths — the common
+        path (first result from the task's current worker) stays one write,
+        one RTT. The read-then-write pair is not atomic, but all result
+        writes flow through the single dispatcher process, so there is no
+        concurrent writer to race with."""
+        if first_wins:
+            current = self.get_status(task_id)
+            if current is not None and TaskStatus(current).is_terminal():
+                return
         self.hset(task_id, {FIELD_STATUS: str(status), FIELD_RESULT: result})
 
     def get_result(self, task_id: str) -> tuple[str | None, str | None]:

@@ -11,12 +11,17 @@ from __future__ import annotations
 
 import multiprocessing as mp
 import queue
-from concurrent.futures import Future, ProcessPoolExecutor
+from concurrent.futures import Future, ProcessPoolExecutor, wait
 from concurrent.futures.process import BrokenProcessPool
 
 from tpu_faas.core.executor import ExecutionResult, execute_fn
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import TaskStatus
+
+
+def _warm() -> None:
+    """No-op run in each child to force its spawn (must be module-level to
+    pickle)."""
 
 
 class TaskPool:
@@ -39,6 +44,19 @@ class TaskPool:
     @property
     def free(self) -> int:
         return self.num_processes - self._busy
+
+    def warmup(self, timeout: float = 120.0) -> None:
+        """Force the lazy child-process spawn NOW, off the serving path.
+
+        The executor spawns children on first submit; with forkserver that
+        first submit blocks for seconds (forkserver boot + module re-import).
+        A worker that pays this inside its serving loop goes heartbeat-silent
+        long enough to be falsely purged — so workers warm up BEFORE
+        registering with the dispatcher."""
+        wait(
+            [self._executor.submit(_warm) for _ in range(self.num_processes)],
+            timeout=timeout,
+        )
 
     def submit(self, task_id: str, fn_payload: str, param_payload: str) -> None:
         try:

@@ -69,6 +69,7 @@ class PullWorker:
     def run(self, max_tasks: int | None = None) -> int:
         """Main loop; returns number of results shipped (for tests)."""
         shipped = 0
+        self.pool.warmup()  # pay the child-spawn cost before taking work
         self._transact(m.REGISTER, worker_id=self.worker_id)
         try:
             while not self._stopping:

@@ -61,6 +61,10 @@ class PushWorker:
 
     def run(self, max_tasks: int | None = None) -> int:
         shipped = 0
+        # spawn pool children BEFORE announcing capacity: the first pool use
+        # otherwise blocks the loop for seconds and the heartbeat silence
+        # gets the worker falsely purged
+        self.pool.warmup()
         self.register()
         last_heartbeat = time.monotonic()
         try:
