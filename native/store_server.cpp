@@ -10,10 +10,17 @@
 // hash tables themselves.
 //
 // Supported commands: PING, SELECT (ignored), HSET, HGET, HGETALL, DEL,
-// KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE, FLUSHDB, QUIT, SHUTDOWN.
+// KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE, FLUSHDB, SAVE, QUIT, SHUTDOWN.
+//
+// Checkpoint/resume: --snapshot PATH loads PATH at startup and writes it on
+// SAVE / SHUTDOWN and every --autosave seconds while dirty. The snapshot is
+// a replayable RESP HSET command log (tpu_faas/store/snapshot.py defines the
+// format; both servers read/write identical files). Writes are atomic
+// (tmp + rename).
 //
 // Build: make -C native   ->  native/build/tpu-faas-store
 // Run:   tpu-faas-store [--host 127.0.0.1] [--port 6380]
+//                       [--snapshot PATH] [--autosave SECS]
 
 #include <arpa/inet.h>
 #include <fcntl.h>
@@ -27,7 +34,10 @@
 #include <cerrno>
 #include <cstdio>
 #include <cstring>
+#include <ctime>
+#include <fstream>
 #include <optional>
+#include <sstream>
 #include <stdexcept>
 #include <string>
 #include <unordered_map>
@@ -35,6 +45,11 @@
 #include <vector>
 
 namespace {
+
+// SIGTERM/SIGINT request a graceful exit so a configured snapshot is written
+// (NativeStoreHandle.stop() terminates; in-flight state must not be lost).
+volatile sig_atomic_t g_stop = 0;
+void on_signal(int) { g_stop = 1; }
 
 struct Conn {
   int fd = -1;
@@ -89,11 +104,13 @@ void reply_array_header(std::string& out, size_t n) {
 }
 
 // Parse one client command (RESP array of bulk strings) from buf starting at
-// offset 0. Returns nullopt if incomplete; on success fills `cmd` and sets
-// `consumed`. Throws std::runtime_error on malformed input.
+// `start`. Returns nullopt if incomplete; on success fills `cmd` and sets
+// `consumed` (bytes past `start`). Throws std::runtime_error on malformed
+// input.
 std::optional<std::vector<std::string>> parse_command(const std::string& buf,
-                                                      size_t& consumed) {
-  size_t pos = 0;
+                                                      size_t& consumed,
+                                                      size_t start = 0) {
+  size_t pos = start;
   auto read_line = [&](std::string& line) -> bool {
     size_t end = buf.find("\r\n", pos);
     if (end == std::string::npos) return false;
@@ -101,8 +118,8 @@ std::optional<std::vector<std::string>> parse_command(const std::string& buf,
     pos = end + 2;
     return true;
   };
-  if (buf.empty()) return std::nullopt;
-  if (buf[0] != '*') throw std::runtime_error("expected RESP array");
+  if (pos >= buf.size()) return std::nullopt;
+  if (buf[pos] != '*') throw std::runtime_error("expected RESP array");
   std::string line;
   if (!read_line(line)) return std::nullopt;
   long n = std::strtol(line.c_str() + 1, nullptr, 10);
@@ -121,8 +138,93 @@ std::optional<std::vector<std::string>> parse_command(const std::string& buf,
     cmd.emplace_back(buf, pos, len);
     pos += len + 2;
   }
-  consumed = pos;
+  consumed = pos - start;
   return cmd;
+}
+
+// ------------------------------------------------------------- snapshotting
+
+// Serialize all hashes as a replayable RESP HSET log (snapshot.py format).
+std::string dump_hashes(const Store& store) {
+  std::string out;
+  for (const auto& [key, fields] : store.hashes) {
+    if (fields.empty()) continue;
+    std::string frame;
+    reply_array_header(frame, 2 + fields.size() * 2);
+    reply_bulk(frame, "HSET");
+    reply_bulk(frame, key);
+    for (const auto& [f, v] : fields) {
+      reply_bulk(frame, f);
+      reply_bulk(frame, v);
+    }
+    out += frame;
+  }
+  return out;
+}
+
+// Atomic + durable write: tmp file in the same directory, fsync the data
+// before rename so a crash can never replace a good snapshot with a
+// truncated one (matches the Python save_file: flush + fsync + os.replace).
+bool save_snapshot(const Store& store, const std::string& path) {
+  const std::string tmp = path + ".tmp." + std::to_string(getpid());
+  const std::string data = dump_hashes(store);
+  int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return false;
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = ::write(fd, data.data() + off, data.size() - off);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      ::close(fd);
+      ::unlink(tmp.c_str());
+      return false;
+    }
+    off += static_cast<size_t>(n);
+  }
+  const bool synced = ::fsync(fd) == 0;
+  const bool closed = ::close(fd) == 0;  // close even when fsync failed
+  if (!synced || !closed) {
+    ::unlink(tmp.c_str());
+    return false;
+  }
+  if (::rename(tmp.c_str(), path.c_str()) != 0) {
+    ::unlink(tmp.c_str());
+    return false;
+  }
+  return true;
+}
+
+// Load a snapshot by replaying its HSET commands. Missing file = empty store
+// (first boot); malformed content is fatal — better to refuse to start than
+// to silently serve half a database.
+bool load_snapshot(Store& store, const std::string& path) {
+  std::ifstream fh(path, std::ios::binary);
+  if (!fh) return true;  // no snapshot yet
+  std::stringstream ss;
+  ss << fh.rdbuf();
+  const std::string data = ss.str();
+  size_t offset = 0;  // offset walk keeps the replay O(N), no per-entry erase
+  try {
+    while (offset < data.size()) {
+      size_t consumed = 0;
+      auto cmd = parse_command(data, consumed, offset);
+      if (!cmd) {
+        fprintf(stderr, "snapshot %s: truncated entry\n", path.c_str());
+        return false;
+      }
+      offset += consumed;
+      if (cmd->size() < 4 || cmd->size() % 2 != 0 || (*cmd)[0] != "HSET") {
+        fprintf(stderr, "snapshot %s: non-HSET entry\n", path.c_str());
+        return false;
+      }
+      auto& h = store.hashes[(*cmd)[1]];
+      for (size_t i = 2; i + 1 < cmd->size(); i += 2) h[(*cmd)[i]] = (*cmd)[i + 1];
+    }
+  } catch (const std::exception& e) {
+    fprintf(stderr, "snapshot %s: %s\n", path.c_str(), e.what());
+    return false;
+  }
+  return true;
 }
 
 // glob match supporting * and ? (enough for KEYS patterns the clients use)
@@ -139,9 +241,16 @@ bool glob_match(const char* pat, const char* str) {
 
 class Server {
  public:
-  Server(const std::string& host, int port) : host_(host), port_(port) {}
+  Server(const std::string& host, int port, std::string snapshot_path = "",
+         double autosave_secs = 0.0)
+      : host_(host),
+        port_(port),
+        snapshot_path_(std::move(snapshot_path)),
+        autosave_secs_(autosave_secs) {}
 
   int run() {
+    if (!snapshot_path_.empty() && !load_snapshot(store_, snapshot_path_))
+      return 1;
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) { perror("socket"); return 1; }
     int one = 1;
@@ -168,7 +277,7 @@ class Server {
     printf("tpu-faas-store listening on %s:%d\n", host_.c_str(), port_);
     fflush(stdout);
 
-    while (!shutdown_) {
+    while (!shutdown_ && !g_stop) {
       std::vector<pollfd> fds;
       fds.push_back({listen_fd_, POLLIN, 0});
       for (auto& [fd, conn] : conns_) {
@@ -182,6 +291,7 @@ class Server {
         perror("poll");
         break;
       }
+      maybe_autosave();
       std::vector<int> to_close;
       for (auto& p : fds) {
         if (p.fd == listen_fd_) {
@@ -203,6 +313,7 @@ class Server {
       }
       for (int fd : to_close) close_conn(fd);
     }
+    save_if_configured();
     for (auto& [fd, conn] : conns_) ::close(fd);
     ::close(listen_fd_);
     return 0;
@@ -211,6 +322,27 @@ class Server {
  private:
   static void set_nonblocking(int fd) {
     fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  }
+
+  static double now_secs() {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<double>(ts.tv_sec) + static_cast<double>(ts.tv_nsec) * 1e-9;
+  }
+
+  void save_if_configured() {
+    if (snapshot_path_.empty()) return;
+    if (save_snapshot(store_, snapshot_path_)) dirty_ = false;
+    else fprintf(stderr, "snapshot save to %s failed\n", snapshot_path_.c_str());
+  }
+
+  void maybe_autosave() {
+    if (snapshot_path_.empty() || autosave_secs_ <= 0 || !dirty_) return;
+    const double now = now_secs();
+    if (now - last_save_ >= autosave_secs_) {
+      save_if_configured();
+      last_save_ = now;
+    }
   }
 
   void accept_new() {
@@ -300,6 +432,7 @@ class Server {
         added += h.find(cmd[i]) == h.end() ? 1 : 0;
         h[cmd[i]] = cmd[i + 1];
       }
+      dirty_ = true;
       reply_integer(c.outbuf, added);
     } else if (name == "HGET") {
       if (argc != 2) {
@@ -325,6 +458,7 @@ class Server {
     } else if (name == "DEL") {
       long long n = 0;
       for (size_t i = 1; i < cmd.size(); i++) n += store_.hashes.erase(cmd[i]);
+      dirty_ = dirty_ || n > 0;
       reply_integer(c.outbuf, n);
     } else if (name == "KEYS") {
       const std::string pat = argc >= 1 ? cmd[1] : "*";
@@ -379,6 +513,19 @@ class Server {
       }
     } else if (name == "FLUSHDB") {
       store_.hashes.clear();
+      dirty_ = true;
+      reply_simple(c.outbuf, "OK");
+    } else if (name == "SAVE") {
+      const std::string target = argc >= 1 ? cmd[1] : snapshot_path_;
+      if (target.empty()) {
+        reply_error(c.outbuf, "SAVE needs a path (no --snapshot configured)");
+        return;
+      }
+      if (!save_snapshot(store_, target)) {
+        reply_error(c.outbuf, "SAVE failed: " + target);
+        return;
+      }
+      if (target == snapshot_path_) dirty_ = false;
       reply_simple(c.outbuf, "OK");
     } else if (name == "QUIT") {
       reply_simple(c.outbuf, "OK");
@@ -393,6 +540,10 @@ class Server {
 
   std::string host_;
   int port_;
+  std::string snapshot_path_;
+  double autosave_secs_ = 0.0;
+  double last_save_ = 0.0;
+  bool dirty_ = false;
   int listen_fd_ = -1;
   bool shutdown_ = false;
   Store store_;
@@ -404,15 +555,24 @@ class Server {
 int main(int argc, char** argv) {
   std::string host = "127.0.0.1";
   int port = 6380;
+  std::string snapshot_path;
+  double autosave = 0.0;
   for (int i = 1; i < argc; i++) {
     std::string arg = argv[i];
     if (arg == "--host" && i + 1 < argc) host = argv[++i];
     else if (arg == "--port" && i + 1 < argc) port = atoi(argv[++i]);
+    else if (arg == "--snapshot" && i + 1 < argc) snapshot_path = argv[++i];
+    else if (arg == "--autosave" && i + 1 < argc) autosave = atof(argv[++i]);
     else {
-      fprintf(stderr, "usage: %s [--host H] [--port P]\n", argv[0]);
+      fprintf(stderr,
+              "usage: %s [--host H] [--port P] [--snapshot PATH] "
+              "[--autosave SECS]\n",
+              argv[0]);
       return 2;
     }
   }
   signal(SIGPIPE, SIG_IGN);
-  return Server(host, port).run();
+  signal(SIGTERM, on_signal);
+  signal(SIGINT, on_signal);
+  return Server(host, port, snapshot_path, autosave).run();
 }

@@ -172,3 +172,54 @@ def test_tpu_push_poison_task_fails_after_max_retries():
         _drain_failed(store, "t1")
     finally:
         disp.socket.close(linger=0)
+
+
+def test_tpu_push_zombie_result_does_not_leak_new_owner_capacity():
+    """A zombie's late result for a task that was already re-dispatched must
+    not release the NEW owner's in-flight slot: only the owner's own result
+    frees its process, otherwise the fleet's capacity drains under churn."""
+    store = MemoryStore()
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=store,
+        max_workers=4,
+        max_pending=8,
+        max_inflight=16,
+        recover_queued=False,
+        time_to_expire=5.0,
+    )
+    try:
+        store.create_task("t1", "F", "P", "tasks")
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 1})
+        assert disp.tick() == 1  # t1 -> w0
+        a = disp.arrays
+        a.last_heartbeat[a.worker_ids[b"w0"]] -= 100.0
+        disp._handle(b"w1", m.REGISTER, {"num_processes": 1})
+        disp.tick()  # purge w0, reclaim t1 into pending
+        assert disp.tick() == 1  # re-dispatch t1 -> w1
+        row1 = a.worker_ids[b"w1"]
+        assert a.inflight_owner("t1") == row1
+        assert a.worker_free[row1] == 0
+
+        # zombie w0 finishes t1 late: record freezes, but w1 still holds it
+        disp._handle(
+            b"w0", m.RESULT, {"task_id": "t1", "status": "COMPLETED", "result": "R"}
+        )
+        assert store.get_result("t1") == ("COMPLETED", "R")
+        assert a.inflight_owner("t1") == row1, "zombie must not pop w1's slot"
+        assert a.worker_free[row1] == 0, "zombie must not free w1's process"
+
+        # the owner's own result releases the slot exactly once
+        disp._handle(
+            b"w1", m.RESULT, {"task_id": "t1", "status": "COMPLETED", "result": "R2"}
+        )
+        assert a.inflight_owner("t1") is None
+        assert a.worker_free[row1] == 1
+        assert store.get_result("t1") == ("COMPLETED", "R"), "first write won"
+
+        # capacity intact: w1 can take the next task
+        store.create_task("t2", "F", "P", "tasks")
+        assert disp.tick() == 1
+    finally:
+        disp.socket.close(linger=0)

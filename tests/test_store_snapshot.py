@@ -1,0 +1,181 @@
+"""Checkpoint/resume for the task store.
+
+The reference has no durability at all (SURVEY §5.4: restarted store loses
+every task hash). These tests cover the snapshot format (a replayable RESP
+HSET log), the in-proc MemoryStore, the Python asyncio server, and the
+native C++ server — all of which read and write the identical file.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_faas.store import resp, snapshot
+from tpu_faas.store.client import RespStore
+from tpu_faas.store.launch import start_store_thread
+from tpu_faas.store.memory import MemoryStore
+
+WEIRD = {
+    "task-1": {"status": "QUEUED", "payload": "with\r\ncrlf", "empty": ""},
+    "täsk-2": {"ünïcode": "välue", "b64": "aGVsbG8=" * 100},
+    "k": {"f": "v"},
+}
+
+
+def test_dump_load_roundtrip():
+    assert snapshot.load_hashes(snapshot.dump_hashes(WEIRD)) == WEIRD
+
+
+def test_dump_load_empty():
+    assert snapshot.load_hashes(b"") == {}
+    assert snapshot.dump_hashes({}) == b""
+
+
+def test_load_rejects_garbage():
+    with pytest.raises(resp.ProtocolError):
+        snapshot.load_hashes(b"not a snapshot")
+    # a non-HSET RESP command must be rejected, not silently skipped
+    with pytest.raises(resp.ProtocolError):
+        snapshot.load_hashes(resp.encode_command("DEL", "k", "f", "v"))
+
+
+def test_load_missing_file_is_empty(tmp_path):
+    assert snapshot.load_file(str(tmp_path / "nope.snap")) == {}
+
+
+def test_memory_store_save_load(tmp_path):
+    path = str(tmp_path / "mem.snap")
+    a = MemoryStore()
+    for key, fields in WEIRD.items():
+        a.hset(key, fields)
+    a.save(path)
+
+    b = MemoryStore()
+    b.hset("stale", {"x": "y"})  # load() replaces, not merges
+    b.load(path)
+    assert sorted(b.keys()) == sorted(WEIRD)
+    for key, fields in WEIRD.items():
+        assert b.hgetall(key) == fields
+
+
+def test_python_server_restart_resumes(tmp_path):
+    path = str(tmp_path / "py.snap")
+
+    h1 = start_store_thread(snapshot_path=path)
+    try:
+        c1 = RespStore(port=h1.port)
+        c1.hset("task-a", {"status": "COMPLETED", "result": "42"})
+        c1.hset("task-b", {"status": "QUEUED"})
+        c1.close()
+    finally:
+        h1.stop()  # stop() checkpoints
+
+    h2 = start_store_thread(snapshot_path=path)
+    try:
+        c2 = RespStore(port=h2.port)
+        assert c2.hgetall("task-a") == {"status": "COMPLETED", "result": "42"}
+        assert c2.hget("task-b", "status") == "QUEUED"
+        c2.close()
+    finally:
+        h2.stop()
+
+
+def test_python_server_explicit_save_command(tmp_path):
+    path = str(tmp_path / "explicit.snap")
+    h = start_store_thread()  # no --snapshot configured
+    try:
+        c = RespStore(port=h.port)
+        # SAVE without a path must error when no snapshot path is configured
+        with pytest.raises(resp.RespError):
+            c.save()
+        c.hset("k", {"f": "v"})
+        c.save(path)
+        c.close()
+    finally:
+        h.stop()
+    assert snapshot.load_file(path) == {"k": {"f": "v"}}
+
+
+def test_native_server_restart_resumes(tmp_path):
+    native = pytest.importorskip("tpu_faas.store.native")
+    try:
+        native.build_native_store()
+    except native.NativeStoreUnavailable as exc:
+        pytest.skip(f"native store unavailable: {exc}")
+
+    path = str(tmp_path / "native.snap")
+    h1 = native.start_native_store(snapshot_path=path)
+    try:
+        c1 = RespStore(port=h1.port)
+        for key, fields in WEIRD.items():
+            c1.hset(key, fields)
+        c1.save()  # explicit checkpoint to the configured path
+        c1.close()
+    finally:
+        h1.stop()
+
+    h2 = native.start_native_store(snapshot_path=path)
+    try:
+        c2 = RespStore(port=h2.port)
+        for key, fields in WEIRD.items():
+            assert c2.hgetall(key) == fields
+        c2.close()
+    finally:
+        h2.stop()
+
+
+def test_cross_server_snapshot_compat(tmp_path):
+    """A snapshot written by the Python server loads in the native server."""
+    native = pytest.importorskip("tpu_faas.store.native")
+    try:
+        native.build_native_store()
+    except native.NativeStoreUnavailable as exc:
+        pytest.skip(f"native store unavailable: {exc}")
+
+    path = str(tmp_path / "cross.snap")
+    h1 = start_store_thread(snapshot_path=path)
+    try:
+        c1 = RespStore(port=h1.port)
+        c1.hset("task-x", {"status": "RUNNING", "blob": "x" * 10_000})
+        c1.close()
+    finally:
+        h1.stop()
+
+    h2 = native.start_native_store(snapshot_path=path)
+    try:
+        c2 = RespStore(port=h2.port)
+        assert c2.hgetall("task-x") == {"status": "RUNNING", "blob": "x" * 10_000}
+        c2.close()
+    finally:
+        h2.stop()
+
+
+def test_client_reconnects_after_server_restart(tmp_path):
+    """A store restart must not wedge long-lived clients: commands reconnect
+    transparently, subscriptions resubscribe (missed messages are lost by
+    design), and snapshot state is visible through the same client object."""
+    path = str(tmp_path / "reconnect.snap")
+    h1 = start_store_thread(port=0, snapshot_path=path)
+    port = h1.port
+    c = RespStore(port=port)
+    sub = c.subscribe("tasks")
+    c.hset("persist", {"status": "COMPLETED"})
+    h1.stop()  # checkpoint + close every connection
+
+    h2 = start_store_thread(port=port, snapshot_path=path)
+    try:
+        # command connection heals and sees the snapshot
+        assert c.hget("persist", "status") == "COMPLETED"
+        # subscription heals: first call absorbs the dead socket, then a
+        # fresh publish is delivered on the re-established subscription
+        sub.get_message()
+        deadline = __import__("time").monotonic() + 5
+        got = None
+        while got is None and __import__("time").monotonic() < deadline:
+            c.publish("tasks", "hello-again")
+            got = sub.get_message(timeout=0.2)
+        assert got == "hello-again"
+        sub.close()
+        c.close()
+    finally:
+        h2.stop()

@@ -18,9 +18,11 @@ Deliberate upgrades over the reference (SURVEY §5.3, §7):
 - **in-flight tracking + re-dispatch**: every dispatched task is recorded;
   purging a worker re-queues its in-flight tasks ahead of the announce bus,
   so a worker crash delays tasks instead of losing them (the reference
-  drops them; its README admits this at 262-264). Exactly-once-ish: a
-  result arriving later from a zombie for an already-re-dispatched task is
-  accepted only once (terminal store writes are idempotent last-wins).
+  drops them; its README admits this at 262-264). Exactly-once-ish: once a
+  second result becomes possible (a zombie's task was reclaimed, or a task
+  was re-dispatched at least once) the first terminal store write wins and
+  the record is frozen, so a late duplicate can never flip a delivered
+  result.
 - **batched dispatch**: drains the announce bus up to the fleet's free
   capacity each round instead of the reference's one task per tick.
 - the worker-side heartbeat timer bug (reference push_worker.py:61-62 sends
@@ -174,13 +176,19 @@ class PushDispatcher(TaskDispatcher):
                 task_id, data["status"], data["result"], first_wins=suspicious
             )
             self.n_results += 1
-            rec.inflight.discard(task_id)
-            rec.inflight_retries.pop(task_id, None)
-            rec.free_processes = min(rec.free_processes + 1, rec.num_processes)
-            if self.process_lb:
-                self.free_procs.appendleft(wid)
-            else:
-                self._add_free(wid)
+            # Only a result for a task this worker actually holds releases a
+            # process slot: a zombie's stale result (its task was reclaimed
+            # and it re-registered) must not over-commit its pool.
+            if task_id in rec.inflight:
+                rec.inflight.discard(task_id)
+                rec.inflight_retries.pop(task_id, None)
+                rec.free_processes = min(
+                    rec.free_processes + 1, rec.num_processes
+                )
+                if self.process_lb:
+                    self.free_procs.appendleft(wid)
+                else:
+                    self._add_free(wid)
         elif msg_type == m.RECONNECT:
             # zombie rejoining: trust its reported current capacity and put
             # it at the LRU front (reference :360-367)

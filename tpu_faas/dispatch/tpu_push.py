@@ -135,13 +135,18 @@ class TpuPushDispatcher(TaskDispatcher):
                 task_id, data["status"], data["result"], first_wins=suspicious
             )
             self.n_results += 1
-            self.task_retries.pop(task_id, None)
-            row = a.inflight_done(task_id)
             a.heartbeat(wid)
-            if row is not None and row in a.row_ids and a.row_ids[row] == wid:
-                a.worker_free[row] = min(
-                    a.worker_free[row] + 1, a.worker_procs[row]
-                )
+            # Only the current owner's result releases the in-flight slot:
+            # a zombie's late result must not pop the NEW owner's entry (that
+            # would leak one process of the new owner's capacity forever,
+            # since its own result would then find nothing to release).
+            if from_owner:
+                self.task_retries.pop(task_id, None)
+                row = a.inflight_done(task_id)
+                if row is not None:
+                    a.worker_free[row] = min(
+                        a.worker_free[row] + 1, a.worker_procs[row]
+                    )
         elif msg_type == m.HEARTBEAT:
             a.heartbeat(wid)
         elif msg_type == m.RECONNECT:

@@ -92,6 +92,13 @@ class TaskStore(abc.ABC):
     def ping(self) -> bool:
         return True
 
+    def save(self, path: str | None = None) -> None:
+        """Checkpoint the store (see tpu_faas/store/snapshot.py).
+
+        `path=None` means "the backend's configured snapshot target"
+        (a server's --snapshot file). Backends without durability raise."""
+        raise NotImplementedError(f"{type(self).__name__} cannot checkpoint")
+
     # -- task-level conveniences ------------------------------------------
     def create_task(
         self,

@@ -56,16 +56,48 @@ class _Conn:
 
 
 class _RespSubscription(Subscription):
-    """Dedicated connection subscribed to one channel."""
+    """Dedicated connection subscribed to one channel.
+
+    Survives a store restart: on connection loss the next ``get_message``
+    reconnects and resubscribes. Messages published while disconnected are
+    lost — exactly the fire-and-forget pub/sub contract the dispatchers
+    already handle (reference SURVEY §5.4: stranded announcements)."""
 
     def __init__(self, host: str, port: int, channel: str) -> None:
-        self._conn = _Conn(host, port)
+        self._host = host
+        self._port = port
         self._channel = channel
-        reply = self._conn.command("SUBSCRIBE", channel)
+        self._conn: _Conn | None = None
+        self._connect()  # initial failure propagates: caller wants a live bus
+
+    def _connect(self) -> None:
+        self._conn = _Conn(self._host, self._port)
+        reply = self._conn.command("SUBSCRIBE", self._channel)
         if not (isinstance(reply, list) and reply[0] == "subscribe"):
             raise resp.RespError(f"unexpected SUBSCRIBE reply: {reply!r}")
 
+    def _reconnect(self) -> bool:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+        try:
+            self._connect()
+            return True
+        except OSError:
+            return False
+
     def get_message(self, timeout: float = 0.0) -> str | None:
+        if self._conn is None and not self._reconnect():
+            return None
+        try:
+            return self._get_message(timeout)
+        except (ConnectionError, OSError):
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None  # reconnect on the next call
+            return None
+
+    def _get_message(self, timeout: float) -> str | None:
         # First drain anything already parsed/buffered.
         item = self._conn.parser.pop()
         while item is not resp.NEED_MORE:
@@ -104,7 +136,8 @@ class _RespSubscription(Subscription):
         return None  # subscribe/unsubscribe confirmations etc.
 
     def close(self) -> None:
-        self._conn.close()
+        if self._conn is not None:
+            self._conn.close()
 
 
 class RespStore(TaskStore):
@@ -115,8 +148,21 @@ class RespStore(TaskStore):
         self._conn = _Conn(host, port)
 
     def _command(self, *parts: str | bytes | int):
+        """Run one command; transparently reconnect once if the server
+        restarted (matches redis-py's retry-on-ConnectionError the reference
+        relies on — without it a store restart would permanently wedge every
+        gateway/dispatcher holding a connection).
+
+        Only ConnectionError retries: a timeout is ambiguous (the command may
+        have been applied — retrying a PUBLISH would announce a task twice),
+        exactly redis-py's default."""
         with self._lock:
-            return self._conn.command(*parts)
+            try:
+                return self._conn.command(*parts)
+            except ConnectionError:
+                self._conn.close()
+                self._conn = _Conn(self.host, self.port)
+                return self._conn.command(*parts)
 
     # -- raw hash ops ------------------------------------------------------
     def hset(self, key: str, fields: Mapping[str, str]) -> None:
@@ -146,6 +192,14 @@ class RespStore(TaskStore):
         return _RespSubscription(self.host, self.port, channel)
 
     # -- admin -------------------------------------------------------------
+    def save(self, path: str | None = None) -> None:
+        """Ask the server to checkpoint (to `path`, or its configured
+        --snapshot file when omitted). Raises RespError if neither exists."""
+        if path is None:
+            self._command("SAVE")
+        else:
+            self._command("SAVE", path)
+
     def flush(self) -> None:
         self._command("FLUSHDB")
 

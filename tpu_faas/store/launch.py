@@ -76,9 +76,16 @@ class StoreServerHandle:
         self.thread.join(timeout=5)
 
 
-def start_store_thread(host: str = "127.0.0.1", port: int = 0) -> StoreServerHandle:
+def start_store_thread(
+    host: str = "127.0.0.1",
+    port: int = 0,
+    snapshot_path: str | None = None,
+    autosave_interval: float = 0.0,
+) -> StoreServerHandle:
     """Start the Python store server in a daemon thread; returns once bound."""
-    server = StoreServer(host, port)
+    server = StoreServer(
+        host, port, snapshot_path=snapshot_path, autosave_interval=autosave_interval
+    )
     started = threading.Event()
     loop_holder: dict[str, asyncio.AbstractEventLoop] = {}
 

@@ -38,10 +38,13 @@ class _MemorySubscription(Subscription):
 
 
 class MemoryStore(TaskStore):
-    def __init__(self) -> None:
+    def __init__(self, snapshot_path: str | None = None) -> None:
         self._lock = threading.RLock()
         self._hashes: dict[str, dict[str, str]] = {}
         self._subs: dict[str, list[_MemorySubscription]] = {}
+        self.snapshot_path = snapshot_path
+        if snapshot_path is not None:
+            self.load(snapshot_path)
 
     # -- raw hash ops ------------------------------------------------------
     def hset(self, key: str, fields: Mapping[str, str]) -> None:
@@ -82,6 +85,28 @@ class MemoryStore(TaskStore):
             subs = self._subs.get(channel)
             if subs and sub in subs:
                 subs.remove(sub)
+
+    # -- checkpoint/resume -------------------------------------------------
+    def save(self, path: str | None = None) -> None:
+        """Checkpoint all hashes (snapshot.py RESP-log format) to `path`, or
+        to the configured ``snapshot_path`` when omitted — same contract as
+        RespStore.save() so backends stay URL-swappable."""
+        from tpu_faas.store import snapshot
+
+        target = path if path is not None else self.snapshot_path
+        if target is None:
+            raise ValueError("save() needs a path (no snapshot_path configured)")
+        with self._lock:
+            hashes = {k: dict(v) for k, v in self._hashes.items()}
+        snapshot.save_file(target, hashes)
+
+    def load(self, path: str) -> None:
+        """Replace contents with a snapshot file (missing file = empty)."""
+        from tpu_faas.store import snapshot
+
+        hashes = snapshot.load_file(path)
+        with self._lock:
+            self._hashes = hashes
 
     # -- admin -------------------------------------------------------------
     def flush(self) -> None:

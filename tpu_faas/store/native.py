@@ -76,15 +76,24 @@ class NativeStoreHandle:
 
 
 def start_native_store(
-    host: str = "127.0.0.1", port: int = 0, timeout: float = 10.0
+    host: str = "127.0.0.1",
+    port: int = 0,
+    timeout: float = 10.0,
+    snapshot_path: str | None = None,
+    autosave_interval: float = 0.0,
 ) -> NativeStoreHandle:
     """Build (if needed) and launch the native store; blocks until it accepts
     connections."""
     binary = build_native_store()
     if port == 0:
         port = _free_port()
+    argv = [binary, "--host", host, "--port", str(port)]
+    if snapshot_path is not None:
+        argv += ["--snapshot", snapshot_path]
+    if autosave_interval > 0:
+        argv += ["--autosave", str(autosave_interval)]
     proc = subprocess.Popen(
-        [binary, "--host", host, "--port", str(port)],
+        argv,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,

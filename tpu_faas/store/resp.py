@@ -94,6 +94,10 @@ class RespParser:
         del self._buf[:consumed]
         return result
 
+    def pending(self) -> int:
+        """Bytes buffered but not yet parsed into a complete reply."""
+        return len(self._buf)
+
     def pop_all(self) -> list:
         out = []
         while True:

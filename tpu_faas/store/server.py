@@ -8,7 +8,12 @@ by the event loop's single-threadedness.
 Supported commands (the set the framework + the reference's usage of Redis
 require): PING, SELECT (accepted, ignored — the reference pins db=1,
 task_dispatcher.py:32), HSET, HGET, HGETALL, DEL, KEYS, PUBLISH, SUBSCRIBE,
-UNSUBSCRIBE, FLUSHDB, QUIT, SHUTDOWN.
+UNSUBSCRIBE, FLUSHDB, SAVE, QUIT, SHUTDOWN.
+
+Checkpoint/resume: ``--snapshot PATH`` loads PATH at startup and saves to it
+on SAVE (no path argument), on SHUTDOWN/stop, and every ``--autosave`` seconds
+while dirty. Format: tpu_faas/store/snapshot.py (replayable RESP HSET log,
+shared with the native server).
 
 Run: ``python -m tpu_faas.store.server --port 6380``.
 """
@@ -18,9 +23,10 @@ from __future__ import annotations
 import argparse
 import asyncio
 import fnmatch
+import signal
 from typing import Iterable
 
-from tpu_faas.store import resp
+from tpu_faas.store import resp, snapshot
 
 
 class StoreState:
@@ -34,20 +40,34 @@ class StoreState:
 
 
 class StoreServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 6380) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6380,
+        snapshot_path: str | None = None,
+        autosave_interval: float = 0.0,
+    ) -> None:
         self.host = host
         self.port = port
+        self.snapshot_path = snapshot_path
+        self.autosave_interval = autosave_interval
         self.state = StoreState()
         self._server: asyncio.AbstractServer | None = None
         self._shutdown = asyncio.Event()
+        self._dirty = False
+        self._autosave_task: asyncio.Task | None = None
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
+        if self.snapshot_path is not None:
+            self.state.hashes = snapshot.load_file(self.snapshot_path)
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port
         )
         # If port was 0, record the actual bound port.
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.snapshot_path is not None and self.autosave_interval > 0:
+            self._autosave_task = asyncio.create_task(self._autosave_loop())
 
     async def serve_forever(self) -> None:
         if self._server is None:
@@ -56,6 +76,12 @@ class StoreServer:
             await self._shutdown.wait()
 
     async def stop(self) -> None:
+        try:
+            self._save_if_configured()
+        except OSError as exc:
+            print(f"shutdown snapshot save failed: {exc}", flush=True)
+        if self._autosave_task is not None:
+            self._autosave_task.cancel()
         self._shutdown.set()
         if self._server is not None:
             self._server.close()
@@ -63,6 +89,23 @@ class StoreServer:
             w.close()
         if self._server is not None:
             await self._server.wait_closed()
+
+    # -- checkpointing -----------------------------------------------------
+    def _save_if_configured(self) -> None:
+        if self.snapshot_path is not None:
+            snapshot.save_file(self.snapshot_path, self.state.hashes)
+            self._dirty = False
+
+    async def _autosave_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.autosave_interval)
+            if self._dirty:
+                try:
+                    self._save_if_configured()
+                except OSError as exc:
+                    # transient failure (disk full, dir unwritable) must not
+                    # kill autosave for the rest of the server's life
+                    print(f"autosave failed (will retry): {exc}", flush=True)
 
     # -- connection handling ----------------------------------------------
     async def _handle_conn(
@@ -124,6 +167,7 @@ class StoreServer:
                 if f not in h:
                     added += 1
                 h[f] = v
+            self._dirty = True
             writer.write(resp.encode_integer(added))
         elif name == "HGET":
             if len(args) != 2:
@@ -142,6 +186,7 @@ class StoreServer:
             for k in args:
                 if st.hashes.pop(k, None) is not None:
                     n += 1
+            self._dirty = self._dirty or n > 0
             writer.write(resp.encode_integer(n))
         elif name == "KEYS":
             pattern = args[0] if args else "*"
@@ -182,11 +227,28 @@ class StoreServer:
                 )
         elif name == "FLUSHDB":
             st.hashes.clear()
+            self._dirty = True
+            writer.write(resp.encode_simple("OK"))
+        elif name == "SAVE":
+            target = args[0] if args else self.snapshot_path
+            if target is None:
+                writer.write(
+                    resp.encode_error("SAVE needs a path (no --snapshot configured)")
+                )
+                return True
+            try:
+                snapshot.save_file(target, st.hashes)
+            except OSError as exc:
+                writer.write(resp.encode_error(f"SAVE failed: {exc}"))
+                return True
+            if target == self.snapshot_path:
+                self._dirty = False
             writer.write(resp.encode_simple("OK"))
         elif name == "QUIT":
             writer.write(resp.encode_simple("OK"))
             return False
         elif name == "SHUTDOWN":
+            self._save_if_configured()
             self._shutdown.set()
             return False
         else:
@@ -220,11 +282,32 @@ def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description="tpu-faas task store server (Python)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6380)
+    ap.add_argument(
+        "--snapshot",
+        default=None,
+        help="checkpoint file: loaded at startup, written on SAVE/SHUTDOWN",
+    )
+    ap.add_argument(
+        "--autosave",
+        type=float,
+        default=0.0,
+        help="seconds between automatic snapshots while dirty (0 = off)",
+    )
     ns = ap.parse_args(argv)
 
     async def run() -> None:
-        server = StoreServer(ns.host, ns.port)
+        server = StoreServer(
+            ns.host, ns.port, snapshot_path=ns.snapshot, autosave_interval=ns.autosave
+        )
         await server.start()
+        # graceful kill/Ctrl-C must checkpoint, like the native server's
+        # SIGTERM/SIGINT handlers — otherwise everything since the last
+        # autosave is lost on `systemctl stop`
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(
+                sig, lambda: asyncio.ensure_future(server.stop())
+            )
         print(f"tpu-faas store listening on {server.host}:{server.port}", flush=True)
         await server.serve_forever()
 

@@ -1,0 +1,91 @@
+"""Store snapshot format: checkpoint/resume for the task store.
+
+The reference has no durability story at all — a restarted Redis (or a
+restarted store) loses every task hash, and SURVEY §5.4 records
+checkpoint/resume as absent. Here the store can checkpoint its entire
+hash table to a file and reload it at startup, so task statuses and
+results survive a store restart.
+
+Format: the snapshot file is a plain sequence of RESP-encoded
+``HSET key field value [field value ...]`` commands — i.e. a replayable
+command log, like a one-shot Redis AOF. Because it *is* the wire
+protocol, the identical file is written and read by the Python asyncio
+server (tpu_faas/store/server.py), the native C++ server
+(native/store_server.cpp), and the in-proc MemoryStore, with no second
+serialization scheme to keep in sync. Writes are atomic
+(tmp-file + rename), so a crash mid-save leaves the previous snapshot
+intact.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping
+
+from tpu_faas.store import resp
+
+
+def dump_hashes(hashes: Mapping[str, Mapping[str, str]]) -> bytes:
+    """Serialize a dict-of-hashes as replayable RESP HSET commands."""
+    out: list[bytes] = []
+    for key, fields in hashes.items():
+        if not fields:
+            continue  # HSET needs >=1 pair; empty hashes are unreachable anyway
+        flat: list[str] = []
+        for f, v in fields.items():
+            flat.extend((f, v))
+        out.append(resp.encode_command("HSET", key, *flat))
+    return b"".join(out)
+
+
+def load_hashes(data: bytes) -> dict[str, dict[str, str]]:
+    """Replay a snapshot byte string into a dict-of-hashes.
+
+    Raises :class:`resp.ProtocolError` on malformed bytes or non-HSET
+    commands — a corrupt snapshot should fail loudly at startup, not load
+    half a database silently.
+    """
+    parser = resp.RespParser()
+    parser.feed(data)
+    hashes: dict[str, dict[str, str]] = {}
+    while True:
+        item = parser.pop()
+        if item is resp.NEED_MORE:
+            if parser.pending():
+                raise resp.ProtocolError(
+                    f"snapshot ends with {parser.pending()} trailing bytes "
+                    "(truncated entry)"
+                )
+            break
+        if (
+            not isinstance(item, list)
+            or len(item) < 4
+            or len(item) % 2 != 0
+            or item[0].upper() != "HSET"
+        ):
+            raise resp.ProtocolError(f"snapshot contains non-HSET entry: {item!r}")
+        h = hashes.setdefault(item[1], {})
+        for f, v in zip(item[2::2], item[3::2]):
+            h[f] = v
+    return hashes
+
+
+def save_file(path: str, hashes: Mapping[str, Mapping[str, str]]) -> None:
+    """Atomically write a snapshot: write tmp in the same dir, fsync, rename."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    data = dump_hashes(hashes)
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def load_file(path: str) -> dict[str, dict[str, str]]:
+    """Load a snapshot file; a missing file is an empty store (first boot)."""
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except FileNotFoundError:
+        return {}
+    return load_hashes(data)
