@@ -313,7 +313,10 @@ class Server {
       }
       for (int fd : to_close) close_conn(fd);
     }
-    save_if_configured();
+    // dirty_ guard: a SHUTDOWN command already checkpointed before setting
+    // shutdown_, so this exit-path save (SIGTERM/SIGINT) only runs when
+    // there is actually unsaved state — not a second identical write
+    if (dirty_) save_if_configured();
     for (auto& [fd, conn] : conns_) ::close(fd);
     ::close(listen_fd_);
     return 0;
@@ -531,6 +534,14 @@ class Server {
       reply_simple(c.outbuf, "OK");
       c.closing = true;
     } else if (name == "SHUTDOWN") {
+      // Save BEFORE committing to exit, like the Python server: a failed
+      // checkpoint aborts the shutdown and the client is told, instead of
+      // exiting 0 with everything since the last autosave lost.
+      if (!snapshot_path_.empty() && !save_snapshot(store_, snapshot_path_)) {
+        reply_error(c.outbuf, "SHUTDOWN aborted, save failed: " + snapshot_path_);
+        return;
+      }
+      dirty_ = false;
       shutdown_ = true;
       c.closing = true;
     } else {

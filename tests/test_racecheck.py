@@ -1,0 +1,194 @@
+"""Race detector (store/racecheck.py): unit tests of the lifecycle state
+machine, offline trace replay, and an end-to-end run under the monitor.
+
+The reference has no race detection (SURVEY §5.2 — safety "by construction");
+this framework's re-dispatch upgrade creates a real zombie-vs-replacement
+race, so the protocol is machine-checked instead."""
+
+import threading
+
+import pytest
+
+from tpu_faas.core.executor import pack_params
+from tpu_faas.core.serialize import serialize
+from tpu_faas.dispatch.local import LocalDispatcher
+from tpu_faas.store import MemoryStore
+from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor, check_trace
+from tpu_faas.workloads import arithmetic
+
+S, R = "status", "result"
+
+
+def _mon() -> RaceMonitor:
+    return RaceMonitor()
+
+
+def _lifecycle(m: RaceMonitor, tid: str = "t", actor: str = "d") -> None:
+    m.observe("gw", "create", tid, {S: "QUEUED", R: "None"})
+    m.observe(actor, "status", tid, {S: "RUNNING"})
+    m.observe(actor, "finish", tid, {S: "COMPLETED", R: "42"})
+
+
+def test_clean_lifecycle_has_no_violations():
+    m = _mon()
+    _lifecycle(m)
+    m.assert_clean()
+    assert m.unfinished() == []
+
+
+def test_terminal_overwrite_is_error():
+    m = _mon()
+    _lifecycle(m)
+    m.observe("zombie", "finish", "t", {S: "COMPLETED", R: "43"})
+    assert [v.kind for v in m.errors] == ["terminal-overwrite"]
+    assert "zombie" in str(m.errors[0])
+
+
+def test_idempotent_terminal_rewrite_is_clean():
+    """Same terminal status + same result payload: benign (a retried store
+    write), not a race."""
+    m = _mon()
+    _lifecycle(m)
+    m.observe("d", "finish", "t", {S: "COMPLETED", R: "42"})
+    m.assert_clean()
+
+
+def test_terminal_to_running_is_error():
+    m = _mon()
+    _lifecycle(m)
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    assert [v.kind for v in m.errors] == ["terminal-overwrite"]
+
+
+def test_create_as_running_is_illegal():
+    m = _mon()
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    assert [v.kind for v in m.errors] == ["illegal-transition"]
+
+
+def test_double_dispatch_warns_but_declared_redispatch_does_not():
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    m.observe("d", "status", "t", {S: "RUNNING"})  # undeclared: warn
+    assert [v.kind for v in m.warnings] == ["double-dispatch"]
+
+    m2 = _mon()
+    m2.observe("gw", "create", "t", {S: "QUEUED"})
+    m2.observe("d", "status", "t", {S: "RUNNING"})
+    m2.expect_redispatch("t")
+    m2.observe("d", "status", "t", {S: "RUNNING"})  # declared: clean
+    m2.assert_clean()
+
+
+def test_result_without_dispatch_warns():
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("d", "finish", "t", {S: "COMPLETED", R: "1"})
+    assert [v.kind for v in m.warnings] == ["result-without-dispatch"]
+    assert not m.errors
+
+
+def test_unfinished_reports_lost_tasks_only():
+    m = _mon()
+    m.observe("gw", "create", "lost", {S: "QUEUED"})
+    m.observe("gw", "status", "lost", {S: "RUNNING"})
+    _lifecycle(m, "done")
+    # a status-less key (function-registry hash) is not a task
+    m.observe("gw", "status", "fn-registry-key", {"payload": "blob"})
+    assert m.unfinished() == ["lost"]
+
+
+def test_strict_mode_flags_unknown_task_writes():
+    m = RaceMonitor(strict=True)
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    kinds = {v.kind for v in m.warnings}
+    assert "unknown-task" in kinds
+
+
+def test_flush_resets_state():
+    m = _mon()
+    _lifecycle(m)
+    m.observe_flush("bench")
+    _lifecycle(m)  # same task id, fresh lifecycle: clean
+    m.assert_clean()
+
+
+def test_offline_replay_reproduces_verdict():
+    m = _mon()
+    _lifecycle(m)
+    m.observe("zombie", "finish", "t", {S: "FAILED", R: "boom"})
+    replayed = check_trace(list(m.events))
+    assert [v.kind for v in replayed] == [v.kind for v in m.violations]
+    assert any(v.kind == "terminal-overwrite" for v in replayed)
+
+
+def test_monitor_is_thread_safe_under_concurrent_writers():
+    m = _mon()
+
+    def writer(i: int) -> None:
+        for j in range(200):
+            tid = f"t-{i}-{j}"
+            m.observe("gw", "create", tid, {S: "QUEUED"})
+            m.observe(f"d{i}", "status", tid, {S: "RUNNING"})
+            m.observe(f"d{i}", "finish", tid, {S: "COMPLETED", R: "ok"})
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    m.assert_clean()
+    assert m.unfinished() == []
+    # seq numbers are unique and dense
+    seqs = [e.seq for e in m.events]
+    assert len(set(seqs)) == len(seqs)
+
+
+# -- store wrapper + live dispatcher under the monitor ----------------------
+
+
+def test_wrapped_store_classifies_ops_and_first_wins_guard_holds():
+    inner = MemoryStore()
+    m = _mon()
+    gw = RaceCheckStore(inner, m, actor="gateway")
+    d = RaceCheckStore(inner, m, actor="dispatcher")
+
+    gw.create_task("t", serialize(arithmetic), pack_params(5))
+    d.set_status("t", "RUNNING")
+    d.finish_task("t", "COMPLETED", "first")
+    # zombie result behind the first_wins guard: write is suppressed before
+    # the store, so the monitor correctly observes nothing
+    d.finish_task("t", "FAILED", "late-zombie", first_wins=True)
+    m.assert_clean()
+    assert inner.get_result("t") == ("COMPLETED", "first")
+
+    # the same write WITHOUT the guard is the bug the detector exists for
+    d.finish_task("t", "FAILED", "late-zombie")
+    assert [v.kind for v in m.errors] == ["terminal-overwrite"]
+
+
+def test_local_dispatcher_e2e_is_race_clean():
+    inner = MemoryStore()
+    m = _mon()
+    disp = LocalDispatcher(
+        num_workers=2, store=RaceCheckStore(inner, m, actor="dispatcher")
+    )
+    client_store = RaceCheckStore(inner, m, actor="gateway")
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    try:
+        for i in range(10):
+            client_store.create_task(
+                f"t{i}", serialize(arithmetic), pack_params(100 + i)
+            )
+        import time
+
+        deadline = time.monotonic() + 60
+        while m.unfinished() and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        disp.stop()
+        t.join(timeout=15)
+    assert m.unfinished() == []
+    m.assert_clean()

@@ -179,3 +179,42 @@ def test_client_reconnects_after_server_restart(tmp_path):
         c.close()
     finally:
         h2.stop()
+
+
+def test_python_server_shutdown_exits_despite_attached_subscriber(tmp_path):
+    """SHUTDOWN must checkpoint and terminate the process even while another
+    client holds an open subscription: since Python 3.12,
+    ``Server.wait_closed()`` waits for every live connection handler, so the
+    server has to drop idle clients itself or hang forever."""
+    import re
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "sd.snap")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_faas.store.server",
+            "--port", "0", "--snapshot", path,
+        ],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        port = int(re.search(r":(\d+)\s*$", line).group(1))
+        sub_holder = RespStore(port=port)
+        sub = sub_holder.subscribe("tasks")  # idle connection held open
+        writer = RespStore(port=port)
+        writer.hset("k", {"f": "v"})
+        try:
+            writer._command("SHUTDOWN")
+        except ConnectionError:
+            pass  # server may die before writing any reply
+        assert proc.wait(timeout=15) == 0
+        assert snapshot.load_file(path) == {"k": {"f": "v"}}
+        sub.close()
+        sub_holder.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

@@ -19,13 +19,14 @@ def _make_dispatcher(store_url, **kw):
     defaults = dict(
         ip="127.0.0.1",
         port=0,
-        store=make_store(store_url),
         max_workers=64,
         max_pending=256,
         max_inflight=512,
         tick_period=0.01,
     )
     defaults.update(kw)
+    if "store" not in defaults:  # an explicit store= must not leak a default
+        defaults["store"] = make_store(store_url)
     return TpuPushDispatcher(**defaults)
 
 
@@ -57,10 +58,24 @@ def test_tpu_push_end_to_end():
 
 def test_tpu_push_worker_crash_redispatch():
     """Device-computed purge + redistribution: SIGKILL a worker holding
-    tasks; everything still completes on the survivor."""
+    tasks; everything still completes on the survivor — and the whole run is
+    race-clean under the protocol monitor (store/racecheck.py): the declared
+    re-dispatch is not a double-dispatch, and no zombie result overwrites a
+    terminal record."""
+    from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+
+    monitor = RaceMonitor()
     store_handle = start_store_thread()
-    gw = start_gateway_thread(make_store(store_handle.url))
-    disp = _make_dispatcher(store_handle.url, time_to_expire=1.5)
+    gw = start_gateway_thread(
+        RaceCheckStore(make_store(store_handle.url), monitor, actor="gateway")
+    )
+    disp = _make_dispatcher(
+        store_handle.url,
+        time_to_expire=1.5,
+        store=RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="dispatcher"
+        ),
+    )
     t = threading.Thread(target=disp.start, daemon=True)
     t.start()
     url = f"tcp://127.0.0.1:{disp.port}"
@@ -77,6 +92,8 @@ def test_tpu_push_worker_crash_redispatch():
         workers[0].wait()
         for h in handles:
             assert h.result(timeout=60.0) == 1.0
+        monitor.assert_clean()
+        assert monitor.unfinished() == []
     finally:
         for w in workers:
             if w.poll() is None:
@@ -138,3 +155,95 @@ def test_tick_overflow_does_not_crash():
         assert len(disp.pending) == 20
     finally:
         disp.socket.close(linger=0)
+
+
+def test_tpu_push_midrun_rescan_adopts_stranded_task():
+    """A task whose hash exists but whose announce was lost WHILE the
+    dispatcher is already serving (store restart eats the PUBLISH — the
+    client deliberately never replays it) is adopted by the periodic
+    stranded rescan, without a dispatcher restart."""
+    from tpu_faas.core.executor import pack_params
+    from tpu_faas.core.serialize import serialize
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url, rescan_period=0.3)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    worker = _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+    client = FaaSClient(gw.url)
+    raw = make_store(store_handle.url)
+    try:
+        # healthy path first, proving the dispatcher is live
+        fid = client.register(sleep_task)
+        assert client.submit(fid, 0.05).result(timeout=60.0) == 0.05
+        # now a task hash written with NO announce (the lost-PUBLISH shape)
+        raw.hset(
+            "orphan-midrun",
+            {
+                "status": "QUEUED",
+                "fn_payload": serialize(sleep_task),
+                "param_payload": pack_params(0.05),
+                "result": "None",
+            },
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            status, _result = raw.get_result("orphan-midrun")
+            if status == "COMPLETED":
+                break
+            time.sleep(0.1)
+        assert status == "COMPLETED"
+    finally:
+        raw.close()
+        worker.kill()
+        worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_tpu_push_survives_store_outage_and_defers_results(tmp_path):
+    """Kill the store WHILE a task is running; the dispatcher must degrade
+    (not crash — a store-outage ConnectionError used to propagate out of
+    start()), buffer the worker's result, and replay it once the store is
+    back on the same port."""
+    snap = str(tmp_path / "outage.snap")
+    h1 = start_store_thread(snapshot_path=snap)
+    port = h1.port
+    gw = start_gateway_thread(make_store(h1.url))
+    disp = _make_dispatcher(h1.url, rescan_period=0.5)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    worker = _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        assert client.submit(fid, 0.05).result(timeout=60.0) == 0.05
+
+        slow = client.submit(fid, 2.0)
+        deadline = time.monotonic() + 10
+        while slow.status() != "RUNNING" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert slow.status() == "RUNNING"
+
+        h1.stop()  # store dies mid-task (stop() checkpoints to snap)
+        time.sleep(3.0)  # worker finishes during the outage; result deferred
+        assert t.is_alive(), "dispatcher crashed during store outage"
+
+        h2 = start_store_thread(port=port, snapshot_path=snap)
+        try:
+            assert slow.result(timeout=30.0) == 2.0  # deferred write replayed
+            # and the stack still serves new work
+            assert client.submit(fid, 0.05).result(timeout=30.0) == 0.05
+        finally:
+            h2.stop()
+    finally:
+        worker.kill()
+        worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()

@@ -20,13 +20,20 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from dataclasses import dataclass
 
 from tpu_faas.core.serialize import serialize
-from tpu_faas.core.task import TaskStatus
+from tpu_faas.core.task import FIELD_FN, FIELD_PARAMS, FIELD_STATUS, TaskStatus
 from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import get_logger
+
+#: Exceptions treated as a transient store outage (restart, network blip).
+#: Deliberately NOT plain OSError: zmq.ZMQError subclasses OSError, and a
+#: broken worker socket must stay fatal rather than be retried as an outage.
+STORE_OUTAGE_ERRORS = (ConnectionError, TimeoutError)
 
 
 @dataclass
@@ -60,6 +67,16 @@ class TaskDispatcher:
         self.subscriber = self.store.subscribe(channel)
         self.log = get_logger(type(self).__name__)
         self._stop_event = threading.Event()
+        #: result writes that hit a store outage, replayed by
+        #: flush_deferred_results() once the store is back — a worker's
+        #: finished result must survive a store restart, not evaporate
+        self.deferred_results: deque[tuple[str, str, str, bool]] = deque()
+        #: announcements consumed from the subscription whose payload fetch
+        #: hit an outage; re-tried before reading the bus again (the bus is
+        #: fire-and-forget, so dropping a consumed announce loses the task)
+        self._announce_backlog: deque[str] = deque()
+        self._store_down = False
+        self._last_flush_attempt = 0.0
 
     # -- intake ------------------------------------------------------------
     def poll_next_task(self) -> PendingTask | None:
@@ -68,15 +85,32 @@ class TaskDispatcher:
         vanished (e.g. flushed store) are skipped, moving straight on to the
         next buffered announcement — None strictly means "bus empty"."""
         while True:
-            msg = self.subscriber.get_message()
-            if msg is None:
-                return None
+            if self._announce_backlog:
+                msg, from_backlog = self._announce_backlog[0], True
+            else:
+                msg, from_backlog = self.subscriber.get_message(), False
+                if msg is None:
+                    return None
             try:
-                fn_payload, param_payload = self.store.get_payloads(msg)
-            except KeyError:
+                fields = self.store.hgetall(msg)
+            except STORE_OUTAGE_ERRORS:
+                # the announce is already consumed from the bus; park it so
+                # the task isn't silently lost when the store comes back
+                if not from_backlog:
+                    self._announce_backlog.append(msg)
+                raise
+            if from_backlog:
+                self._announce_backlog.popleft()
+            if FIELD_FN not in fields or FIELD_PARAMS not in fields:
                 self.log.warning("announce for unknown task %s; skipping", msg)
                 continue
-            return PendingTask(msg, fn_payload, param_payload)
+            if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
+                # duplicate or stale announce: the task was already picked up
+                # (RUNNING — e.g. adopted by a stranded-task rescan) or even
+                # finished; dispatching it again would run it twice
+                self.log.debug("announce for non-QUEUED task %s; skipping", msg)
+                continue
+            return PendingTask(msg, fields[FIELD_FN], fields[FIELD_PARAMS])
 
     def poll_tasks(self, max_n: int) -> list[PendingTask]:
         """Batch intake: drain up to max_n announcements."""
@@ -89,7 +123,14 @@ class TaskDispatcher:
         return out
 
     # -- store writes ------------------------------------------------------
-    def mark_running(self, task_id: str) -> None:
+    def mark_running(self, task_id: str, *, redispatch: bool = False) -> None:
+        """``redispatch=True`` on the recovery path (task reclaimed from a
+        purged worker, re-sent to a replacement) — it declares the second
+        RUNNING write through the store's protocol-checker hook so an
+        attached race monitor (store/racecheck.py) can tell deliberate
+        re-dispatch from double-dispatch."""
+        if redispatch:
+            self.store.declare_redispatch(task_id)
         self.store.set_status(task_id, TaskStatus.RUNNING)
 
     def record_result(
@@ -98,6 +139,66 @@ class TaskDispatcher:
         """``first_wins=True`` on paths where a second result for the same
         task is possible (zombie worker of a re-dispatched task)."""
         self.store.finish_task(task_id, status, result, first_wins=first_wins)
+
+    def record_result_safe(
+        self, task_id: str, status: str, result: str, first_wins: bool = False
+    ) -> bool:
+        """Like record_result, but a store outage defers the write instead of
+        raising: the result was already computed and received — losing it
+        would leave the task RUNNING forever on a live worker (never purged,
+        never re-dispatched). Returns False when deferred."""
+        try:
+            self.record_result(task_id, status, result, first_wins=first_wins)
+            self.note_store_up()
+            return True
+        except STORE_OUTAGE_ERRORS as exc:
+            # pause=0: this runs inside the worker-message drain loop, where
+            # a per-message sleep would stall the fleet; backoff belongs to
+            # the outer serve loop
+            self.deferred_results.append((task_id, status, result, first_wins))
+            self.note_store_outage(exc, pause=0)
+            return False
+
+    def flush_deferred_results(self) -> int:
+        """Replay writes deferred during an outage; stops (keeping order) the
+        moment the store fails again. Call once per loop iteration — while
+        the store is known down, actual attempts are rate-limited so a
+        slow-to-fail connect (packet black hole) can't stall every tick."""
+        if (
+            self._store_down
+            and time.monotonic() - self._last_flush_attempt < 0.5
+        ):
+            return 0
+        self._last_flush_attempt = time.monotonic()
+        n = 0
+        while self.deferred_results:
+            task_id, status, result, first_wins = self.deferred_results[0]
+            try:
+                self.record_result(task_id, status, result, first_wins=first_wins)
+            except STORE_OUTAGE_ERRORS as exc:
+                self.note_store_outage(exc)
+                break
+            self.deferred_results.popleft()
+            n += 1
+        if n:
+            self.note_store_up()
+            self.log.info("replayed %d result writes deferred during outage", n)
+        return n
+
+    # -- store outage tracking ----------------------------------------------
+    def note_store_outage(self, exc: BaseException, pause: float = 0.2) -> None:
+        """Log (once per outage, not per tick) and back off briefly so a
+        down store doesn't turn the serve loop into a reconnect spin."""
+        if not self._store_down:
+            self._store_down = True
+            self.log.warning("store unreachable (%s); degrading until it returns", exc)
+        if pause > 0:
+            self._stop_event.wait(pause)  # interruptible sleep
+
+    def note_store_up(self) -> None:
+        if self._store_down:
+            self._store_down = False
+            self.log.info("store reachable again")
 
     def fail_task(self, task_id: str, reason: str) -> None:
         """Terminal FAILED write with a client-deserializable exception as the

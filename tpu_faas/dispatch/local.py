@@ -29,7 +29,7 @@ from concurrent.futures.process import BrokenProcessPool
 from tpu_faas.core.executor import ExecutionResult, execute_fn
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import TaskStatus
-from tpu_faas.dispatch.base import TaskDispatcher
+from tpu_faas.dispatch.base import STORE_OUTAGE_ERRORS, TaskDispatcher
 
 
 class LocalDispatcher(TaskDispatcher):
@@ -54,7 +54,13 @@ class LocalDispatcher(TaskDispatcher):
         )
 
     def _submit(self, pool: ProcessPoolExecutor, task) -> None:
-        self.mark_running(task.task_id)
+        try:
+            self.mark_running(task.task_id)
+        except STORE_OUTAGE_ERRORS as exc:
+            # still execute: the announce is already consumed, and the
+            # terminal result write (deferred if needed) supersedes the
+            # missing RUNNING mark
+            self.note_store_outage(exc, pause=0)
         fut = pool.submit(
             execute_fn, task.task_id, task.fn_payload, task.param_payload
         )
@@ -71,11 +77,11 @@ class LocalDispatcher(TaskDispatcher):
         exc = fut.exception()
         if exc is None:
             res: ExecutionResult = fut.result()
-            self.record_result(res.task_id, res.status, res.result)
+            self.record_result_safe(res.task_id, res.status, res.result)
         else:
             # child died or result transfer failed: the task is FAILED, the
             # slot is reclaimed (reference leaks it — SURVEY §2 LocalDispatcher)
-            self.record_result(
+            self.record_result_safe(
                 task_id, str(TaskStatus.FAILED), serialize(RuntimeError(str(exc)))
             )
         self._busy -= 1
@@ -92,9 +98,15 @@ class LocalDispatcher(TaskDispatcher):
         try:
             while not self.stopping:
                 progressed = False
+                if self.deferred_results:
+                    self.flush_deferred_results()
                 # admission-controlled intake (reference task_dispatcher.py:73-75)
                 while self._busy < self.num_workers:
-                    task = self.poll_next_task()
+                    try:
+                        task = self.poll_next_task()
+                    except STORE_OUTAGE_ERRORS as exc:
+                        self.note_store_outage(exc)
+                        break
                     if task is None:
                         break
                     try:

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import zmq
 
-from tpu_faas.dispatch.base import TaskDispatcher
+from tpu_faas.dispatch.base import STORE_OUTAGE_ERRORS, TaskDispatcher
 from tpu_faas.worker import messages as m
 
 
@@ -50,6 +50,8 @@ class PullDispatcher(TaskDispatcher):
         n_results = 0
         try:
             while not self.stopping:
+                if self.deferred_results:
+                    self.flush_deferred_results()
                 events = dict(self.poller.poll(self.poll_timeout_ms))
                 if self.socket not in events:
                     continue
@@ -58,15 +60,26 @@ class PullDispatcher(TaskDispatcher):
                     self.workers.add(data.get("worker_id", "?"))
                     self.log.info("pull worker registered: %s", data)
                 elif msg_type == m.RESULT:
-                    self.record_result(
+                    self.record_result_safe(
                         data["task_id"], data["status"], data["result"]
                     )
                     n_results += 1
                 # READY carries no state; any message type falls through to
-                # the mandatory reply:
-                task = self.poll_next_task()
+                # the mandatory reply — which MUST go out even mid-outage,
+                # or the REP/REQ state machine wedges every worker
+                try:
+                    task = self.poll_next_task()
+                except STORE_OUTAGE_ERRORS as exc:
+                    self.note_store_outage(exc, pause=0)
+                    task = None
                 if task is not None:
-                    self.mark_running(task.task_id)
+                    try:
+                        self.mark_running(task.task_id)
+                    except STORE_OUTAGE_ERRORS as exc:
+                        # worker still gets the task; the terminal result
+                        # write (deferred if needed) supersedes the missing
+                        # RUNNING mark
+                        self.note_store_outage(exc, pause=0)
                     self.socket.send(
                         m.encode(
                             m.TASK,

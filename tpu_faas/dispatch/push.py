@@ -38,7 +38,11 @@ from dataclasses import dataclass, field
 
 import zmq
 
-from tpu_faas.dispatch.base import PendingTask, TaskDispatcher
+from tpu_faas.dispatch.base import (
+    STORE_OUTAGE_ERRORS,
+    PendingTask,
+    TaskDispatcher,
+)
 from tpu_faas.worker import messages as m
 
 
@@ -172,7 +176,7 @@ class PushDispatcher(TaskDispatcher):
                 task_id not in rec.inflight
                 or task_id in rec.inflight_retries
             )
-            self.record_result(
+            self.record_result_safe(
                 task_id, data["status"], data["result"], first_wins=suspicious
             )
             self.n_results += 1
@@ -212,13 +216,17 @@ class PushDispatcher(TaskDispatcher):
             if not rec.is_alive(now, self.time_to_expire)
         ]
         for wid in dead:
-            rec = self.workers.pop(wid)
-            self._remove_free(wid)
+            rec = self.workers[wid]
+            # phase 1 — store I/O only: a store outage raises out of here
+            # with the worker record untouched, so the next purge round
+            # simply retries it (nothing reclaimed is lost half-way)
+            reclaims: list[PendingTask] = []
             for task_id in rec.inflight:
                 retries = rec.inflight_retries.get(task_id, 0) + 1
                 if retries > self.max_task_retries:
                     # poison guard: a task that has now taken down
                     # max_task_retries workers is failed, not re-queued
+                    # (first_wins makes a retried fail_task idempotent)
                     self.log.error(
                         "task %s lost with its worker %d times; FAILED",
                         task_id,
@@ -234,11 +242,15 @@ class PushDispatcher(TaskDispatcher):
                     fn_payload, param_payload = self.store.get_payloads(task_id)
                 except KeyError:
                     continue
-                self.requeue.append(
+                reclaims.append(
                     PendingTask(
                         task_id, fn_payload, param_payload, retries=retries
                     )
                 )
+            # phase 2 — bookkeeping only, cannot raise
+            self.workers.pop(wid)
+            self._remove_free(wid)
+            self.requeue.extend(reclaims)
             if rec.inflight:
                 self.log.warning(
                     "purged %r; re-queued %d in-flight tasks",
@@ -284,7 +296,13 @@ class PushDispatcher(TaskDispatcher):
                     param_payload=task.param_payload,
                 ),
             )
-            self.mark_running(task.task_id)
+            try:
+                self.mark_running(task.task_id, redispatch=bool(task.retries))
+            except STORE_OUTAGE_ERRORS as exc:
+                # task already sent: keep the bookkeeping consistent (it IS
+                # in flight); the terminal result write supersedes the
+                # missing RUNNING mark
+                self.note_store_outage(exc, pause=0)
             rec.inflight.add(task.task_id)
             if task.retries:
                 rec.inflight_retries[task.task_id] = task.retries
@@ -316,9 +334,16 @@ class PushDispatcher(TaskDispatcher):
                             break
                         msg_type, data = m.decode(raw)
                         self._handle(wid, msg_type, data)
-                if self.heartbeat:
-                    self.purge_workers()
-                self._dispatch_round()
+                # store ops degrade (and retry next round) during an outage
+                # instead of crashing the dispatcher
+                try:
+                    if self.heartbeat:
+                        self.purge_workers()
+                    if self.deferred_results:
+                        self.flush_deferred_results()
+                    self._dispatch_round()
+                except STORE_OUTAGE_ERRORS as exc:
+                    self.note_store_outage(exc)
                 if max_results is not None and self.n_results >= max_results:
                     break
         finally:

@@ -31,7 +31,11 @@ import numpy as np
 import zmq
 
 from tpu_faas.core.task import FIELD_STATUS, TaskStatus
-from tpu_faas.dispatch.base import PendingTask, TaskDispatcher
+from tpu_faas.dispatch.base import (
+    STORE_OUTAGE_ERRORS,
+    PendingTask,
+    TaskDispatcher,
+)
 from tpu_faas.sched.state import SchedulerArrays
 from tpu_faas.utils.logging import TickTracer
 from tpu_faas.worker import messages as m
@@ -52,6 +56,7 @@ class TpuPushDispatcher(TaskDispatcher):
         max_inflight: int = 65536,
         max_slots: int = 8,
         recover_queued: bool = True,
+        rescan_period: float = 10.0,
         max_task_retries: int = 3,
         clock=time.monotonic,
     ) -> None:
@@ -83,25 +88,51 @@ class TpuPushDispatcher(TaskDispatcher):
         self.task_retries: dict[str, int] = {}
         self.n_results = 0
         self.n_dispatched = 0
+        #: seconds between stranded-task rescans while running (0 disables);
+        #: the startup scan below always runs when recover_queued is set
+        self.rescan_period = rescan_period if recover_queued else 0.0
         if recover_queued:
             self._recover_stranded()
 
-    # -- startup recovery (capability the reference lacks) -----------------
+    # -- stranded-task recovery (capability the reference lacks) -----------
     def _recover_stranded(self) -> None:
-        """Scan the store for QUEUED tasks whose announce was lost (published
-        while no dispatcher was subscribed) and adopt them as pending."""
+        """Scan the store for QUEUED tasks whose announce was lost and adopt
+        them as pending. Runs at startup (announce published while no
+        dispatcher was subscribed) and every ``rescan_period`` seconds while
+        serving (announce lost to a store restart mid-run — the store client
+        deliberately never replays a PUBLISH, see store/client.py).
+
+        Duplicate-dispatch safety: ids already pending or in flight are
+        skipped here, and the announce intake path skips non-QUEUED tasks
+        (dispatch/base.py poll_next_task), so a task adopted by a rescan
+        whose announce later arrives anyway is dropped at intake once it is
+        RUNNING. The only remaining overlap — announce still buffered in the
+        subscription while a rescan adopts the same QUEUED task — is closed
+        by the pending-id check at intake (tick())."""
+        a = self.arrays
+        known = {t.task_id for t in self.pending}
         n = 0
         for key in self.store.keys():
+            if key in known or a.inflight_owner(key) is not None:
+                continue
+            # status-only probe first: the store holds every task that ever
+            # ran (plus function-registry hashes), and pulling each one's
+            # full fn/param payloads over HGETALL just to read the status
+            # would make the rescan cost grow with history, stalling the
+            # serve loop long enough to miss heartbeats
+            if self.store.hget(key, FIELD_STATUS) != str(TaskStatus.QUEUED):
+                continue
             fields = self.store.hgetall(key)
-            if fields.get(FIELD_STATUS) == str(TaskStatus.QUEUED):
-                self.pending.append(
-                    PendingTask(
-                        key,
-                        fields.get("fn_payload", ""),
-                        fields.get("param_payload", ""),
-                    )
+            if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
+                continue  # finished between the two reads
+            self.pending.append(
+                PendingTask(
+                    key,
+                    fields.get("fn_payload", ""),
+                    fields.get("param_payload", ""),
                 )
-                n += 1
+            )
+            n += 1
         if n:
             self.log.info("recovered %d stranded QUEUED tasks", n)
 
@@ -131,7 +162,7 @@ class TpuPushDispatcher(TaskDispatcher):
             # task's current owner (zombie after a reclaim), or the task was
             # reclaimed at least once on its way to this worker
             suspicious = not from_owner or task_id in self.task_retries
-            self.record_result(
+            self.record_result_safe(
                 task_id, data["status"], data["result"], first_wins=suspicious
             )
             self.n_results += 1
@@ -156,10 +187,18 @@ class TpuPushDispatcher(TaskDispatcher):
     def tick(self) -> int:
         """Intake + device step + act on outputs. Returns tasks dispatched."""
         a = self.arrays
-        # intake from the announce bus, bounded by the padded batch size
+        # intake from the announce bus, bounded by the padded batch size;
+        # ids already pending (e.g. adopted by a stranded rescan while the
+        # same announce sat buffered in the subscription) are dropped so a
+        # task is never dispatched twice
         room = a.max_pending - len(self.pending)
         if room > 0:
-            self.pending.extend(self.poll_tasks(room))
+            seen = {t.task_id for t in self.pending}
+            for t in self.poll_tasks(room):
+                if t.task_id in seen:
+                    continue
+                seen.add(t.task_id)
+                self.pending.append(t)
 
         # the device batch is capped at max_pending; overflow (possible when
         # a purge re-queued tasks into an already-full queue) waits its turn
@@ -169,94 +208,150 @@ class TpuPushDispatcher(TaskDispatcher):
         ]
         overflow = self.pending
         self.pending = deque()
-        sizes = np.asarray(
-            [t.size_estimate for t in batch], dtype=np.float32
-        )
-        with self.tracer.span("device_tick"):
-            out = a.tick(sizes)
-
-        # act: reclaim in-flight tasks of dead workers (ahead of the queue)
         requeued: deque[PendingTask] = deque()
-        for slot in np.flatnonzero(np.asarray(out.redispatch)):
-            task_id = a.inflight_clear_slot(int(slot))
-            if task_id is None:
-                continue
-            retries = self.task_retries.get(task_id, 0) + 1
-            if retries > self.max_task_retries:
-                # poison guard: this task has now taken down
-                # max_task_retries workers — fail it, don't cycle it
-                self.task_retries.pop(task_id, None)
-                self.log.error(
-                    "task %s lost with its worker %d times; FAILED",
-                    task_id,
-                    retries,
-                )
-                self.fail_task(
-                    task_id,
-                    f"task lost with its worker {retries} times "
-                    f"(max_task_retries={self.max_task_retries})",
-                )
-                continue
-            try:
-                fn_payload, param_payload = self.store.get_payloads(task_id)
-            except KeyError:
-                # payloads vanished (store flushed): nothing to re-dispatch,
-                # and leaving a retry entry would haunt a future task that
-                # reuses the id
-                self.task_retries.pop(task_id, None)
-                continue
-            self.task_retries[task_id] = retries
-            requeued.append(
-                PendingTask(task_id, fn_payload, param_payload, retries=retries)
-            )
-        for row in np.flatnonzero(np.asarray(out.purged)):
-            self.log.warning("purged worker row %d", int(row))
-            a.deactivate(int(row))
-
-        # act: send assignments
-        assignment = np.asarray(out.assignment)[: len(batch)]
-        sent = 0
         still_pending: deque[PendingTask] = deque()
-        for task, row in zip(batch, assignment):
-            row = int(row)
-            if row < 0 or row not in a.row_ids:
-                still_pending.append(task)
-                continue
-            if task.retries and self.task_is_terminal(task.task_id):
-                # reclaimed task finished meanwhile by its zombie worker:
-                # re-dispatching would regress the record to RUNNING
-                self.task_retries.pop(task.task_id, None)
-                continue
-            try:
-                # reserve tracking BEFORE sending: a task on the wire but
-                # absent from the inflight table could never be re-dispatched
-                a.inflight_add(task.task_id, row)
-            except RuntimeError:
-                still_pending.append(task)  # inflight table full: wait
-                continue
-            wid = a.row_ids[row]
-            self.socket.send_multipart(
-                [
-                    wid,
-                    m.encode(
-                        m.TASK,
-                        task_id=task.task_id,
-                        fn_payload=task.fn_payload,
-                        param_payload=task.param_payload,
-                    ),
-                ]
+        sent = 0
+        # Exception safety: a store outage may raise anywhere below. The
+        # finally-block reassembles the queue so no popped task is ever
+        # dropped, and the reclaim phase does its store reads BEFORE touching
+        # the inflight table so an aborted tick simply retries next tick.
+        restore_from = 0  # first batch index NOT yet handled (or on the wire)
+        try:
+            sizes = np.asarray(
+                [t.size_estimate for t in batch], dtype=np.float32
             )
-            self.mark_running(task.task_id)
-            a.worker_free[row] -= 1
-            sent += 1
-            self.n_dispatched += 1
-        self.pending = requeued + still_pending + overflow
+            with self.tracer.span("device_tick"):
+                out = a.tick(sizes)
+
+            # reclaim in-flight tasks of dead workers (ahead of the queue) —
+            # phase 1: store I/O only, no bookkeeping mutation
+            reclaims: list[tuple[int, str, int, str, str]] = []
+            drops: list[tuple[int, str]] = []  # failed or vanished
+            for slot in np.flatnonzero(np.asarray(out.redispatch)):
+                slot = int(slot)
+                task_id = a.inflight_task[slot]
+                if task_id is None:
+                    continue
+                retries = self.task_retries.get(task_id, 0) + 1
+                if retries > self.max_task_retries:
+                    # poison guard: this task has now taken down
+                    # max_task_retries workers — fail it, don't cycle it
+                    self.log.error(
+                        "task %s lost with its worker %d times; FAILED",
+                        task_id,
+                        retries,
+                    )
+                    self.fail_task(
+                        task_id,
+                        f"task lost with its worker {retries} times "
+                        f"(max_task_retries={self.max_task_retries})",
+                    )
+                    drops.append((slot, task_id))
+                    continue
+                try:
+                    fn_payload, param_payload = self.store.get_payloads(task_id)
+                except KeyError:
+                    # payloads vanished (store flushed): nothing to
+                    # re-dispatch, and leaving a retry entry would haunt a
+                    # future task that reuses the id
+                    drops.append((slot, task_id))
+                    continue
+                reclaims.append(
+                    (slot, task_id, retries, fn_payload, param_payload)
+                )
+            # phase 2: bookkeeping only, cannot raise
+            for slot, task_id in drops:
+                a.inflight_clear_slot(slot)
+                self.task_retries.pop(task_id, None)
+            for slot, task_id, retries, fn_payload, param_payload in reclaims:
+                a.inflight_clear_slot(slot)
+                self.task_retries[task_id] = retries
+                requeued.append(
+                    PendingTask(
+                        task_id, fn_payload, param_payload, retries=retries
+                    )
+                )
+            for row in np.flatnonzero(np.asarray(out.purged)):
+                self.log.warning("purged worker row %d", int(row))
+                a.deactivate(int(row))
+
+            # act: send assignments
+            assignment = np.asarray(out.assignment)[: len(batch)]
+            for idx, (task, row) in enumerate(zip(batch, assignment)):
+                restore_from = idx
+                row = int(row)
+                if row < 0 or row not in a.row_ids:
+                    still_pending.append(task)
+                    restore_from = idx + 1
+                    continue
+                if task.retries and self.task_is_terminal(task.task_id):
+                    # reclaimed task finished meanwhile by its zombie worker:
+                    # re-dispatching would regress the record to RUNNING
+                    self.task_retries.pop(task.task_id, None)
+                    restore_from = idx + 1
+                    continue
+                try:
+                    # reserve tracking BEFORE sending: a task on the wire but
+                    # absent from the inflight table could never be
+                    # re-dispatched
+                    a.inflight_add(task.task_id, row)
+                except RuntimeError:
+                    still_pending.append(task)  # inflight table full: wait
+                    restore_from = idx + 1
+                    continue
+                wid = a.row_ids[row]
+                self.socket.send_multipart(
+                    [
+                        wid,
+                        m.encode(
+                            m.TASK,
+                            task_id=task.task_id,
+                            fn_payload=task.fn_payload,
+                            param_payload=task.param_payload,
+                        ),
+                    ]
+                )
+                # on the wire + tracked: must NOT be restored on an outage
+                restore_from = idx + 1
+                try:
+                    self.mark_running(
+                        task.task_id, redispatch=bool(task.retries)
+                    )
+                except STORE_OUTAGE_ERRORS as exc:
+                    # worker already has the task and it IS in the inflight
+                    # table; the (deferred-capable) terminal result write
+                    # supersedes the missing RUNNING mark
+                    self.note_store_outage(exc, pause=0)
+                a.worker_free[row] -= 1
+                sent += 1
+                self.n_dispatched += 1
+        except STORE_OUTAGE_ERRORS:
+            for t in batch[restore_from:]:
+                still_pending.append(t)
+            raise  # start() logs + backs off
+        finally:
+            self.pending = requeued + still_pending + overflow
         return sent
 
     def start(self, max_results: int | None = None) -> int:
         try:
             last_tick = 0.0
+            last_rescan = self.clock()
             while not self.stopping:
+                # a store outage must degrade the dispatcher (workers keep
+                # heartbeating, results buffer), never crash it — everything
+                # below retries next iteration once the store is back
+                try:
+                    if self.deferred_results:
+                        self.flush_deferred_results()
+                    if (
+                        self.rescan_period > 0
+                        and self.clock() - last_rescan >= self.rescan_period
+                    ):
+                        self._recover_stranded()
+                        last_rescan = self.clock()
+                except STORE_OUTAGE_ERRORS as exc:
+                    self.note_store_outage(exc)
                 events = dict(self.poller.poll(max(1, int(self.tick_period * 1000))))
                 if self.socket in events:
                     while True:
@@ -270,7 +365,10 @@ class TpuPushDispatcher(TaskDispatcher):
                         self._handle(wid, msg_type, data)
                 now = self.clock()
                 if now - last_tick >= self.tick_period:
-                    self.tick()
+                    try:
+                        self.tick()
+                    except STORE_OUTAGE_ERRORS as exc:
+                        self.note_store_outage(exc)
                     last_tick = now
                 if max_results is not None and self.n_results >= max_results:
                     break

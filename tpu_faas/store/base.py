@@ -168,6 +168,12 @@ class TaskStore(abc.ABC):
         fields = self.hgetall(task_id)
         return fields.get(FIELD_STATUS), fields.get(FIELD_RESULT)
 
+    def declare_redispatch(self, task_id: str) -> None:
+        """Protocol-checker hook: the caller is about to re-mark ``task_id``
+        RUNNING because it was reclaimed from a purged worker. No-op on real
+        stores; ``racecheck.RaceCheckStore`` overrides it so its monitor can
+        tell deliberate re-dispatch from a double-dispatch bug."""
+
     def __enter__(self) -> "TaskStore":
         return self
 

@@ -20,6 +20,12 @@ from typing import Mapping
 from tpu_faas.store import resp
 from tpu_faas.store.base import Subscription, TaskStore
 
+#: Commands that must not be replayed after an ambiguous connection loss —
+#: replaying a PUBLISH announces (and therefore dispatches) a task twice, and
+#: both servers apply SHUTDOWN then close without replying, so a retry would
+#: shut down the supervisor-restarted replacement too.
+_NON_IDEMPOTENT = frozenset({"PUBLISH", "SHUTDOWN"})
+
 
 class _Conn:
     """One blocking RESP connection."""
@@ -68,6 +74,7 @@ class _RespSubscription(Subscription):
         self._port = port
         self._channel = channel
         self._conn: _Conn | None = None
+        self._closed = False
         self._connect()  # initial failure propagates: caller wants a live bus
 
     def _connect(self) -> None:
@@ -77,6 +84,8 @@ class _RespSubscription(Subscription):
             raise resp.RespError(f"unexpected SUBSCRIBE reply: {reply!r}")
 
     def _reconnect(self) -> bool:
+        if self._closed:
+            return False
         if self._conn is not None:
             self._conn.close()
             self._conn = None
@@ -87,7 +96,7 @@ class _RespSubscription(Subscription):
             return False
 
     def get_message(self, timeout: float = 0.0) -> str | None:
-        if self._conn is None and not self._reconnect():
+        if self._closed or (self._conn is None and not self._reconnect()):
             return None
         try:
             return self._get_message(timeout)
@@ -136,6 +145,10 @@ class _RespSubscription(Subscription):
         return None  # subscribe/unsubscribe confirmations etc.
 
     def close(self) -> None:
+        # mark closed FIRST: a dispatch loop mid-get_message on another
+        # thread would otherwise resurrect the subscription (reconnect +
+        # re-SUBSCRIBE) after its owner already closed it
+        self._closed = True
         if self._conn is not None:
             self._conn.close()
 
@@ -145,7 +158,8 @@ class RespStore(TaskStore):
         self.host = host
         self.port = port
         self._lock = threading.Lock()
-        self._conn = _Conn(host, port)
+        self._closed = False
+        self._conn: _Conn | None = _Conn(host, port)
 
     def _command(self, *parts: str | bytes | int):
         """Run one command; transparently reconnect once if the server
@@ -153,16 +167,41 @@ class RespStore(TaskStore):
         relies on — without it a store restart would permanently wedge every
         gateway/dispatcher holding a connection).
 
-        Only ConnectionError retries: a timeout is ambiguous (the command may
-        have been applied — retrying a PUBLISH would announce a task twice),
-        exactly redis-py's default."""
+        Retry is restricted to idempotent commands. A ConnectionError is
+        ambiguous too (the server may have applied the command and died
+        before replying), and replaying a PUBLISH would announce the same
+        task twice — dispatching it to two workers. Hash writes replay to the
+        same end state, so they retry; PUBLISH raises to the caller, whose
+        announce is at-most-once (a stranded QUEUED task is recoverable — the
+        tpu-push dispatcher rescans for stranded tasks at startup and every
+        ``rescan_period`` seconds while serving; double execution is not).
+
+        ``self._conn`` is None between a failed reconnect and the next call:
+        if the replacement connection can't be made immediately (server still
+        restarting), the client must not keep using the CLOSED old socket —
+        that would turn every later ConnectionError into a plain
+        EBADF OSError that nothing retries, wedging the client forever.
+        Instead the broken connection is dropped and each subsequent call
+        retries the connect lazily until the server is back."""
         with self._lock:
+            if self._closed:
+                # a serve thread racing close() must not resurrect the
+                # connection (same guard as _RespSubscription.close)
+                raise ConnectionError("store client is closed")
+            if self._conn is None:
+                # previous reconnect failed; retry it now (raises if the
+                # server is still down, leaving _conn None for next time)
+                self._conn = _Conn(self.host, self.port)
             try:
                 return self._conn.command(*parts)
             except ConnectionError:
                 self._conn.close()
-                self._conn = _Conn(self.host, self.port)
-                return self._conn.command(*parts)
+                self._conn = None
+                conn = _Conn(self.host, self.port)  # may raise: _conn stays None
+                self._conn = conn
+                if str(parts[0]).upper() in _NON_IDEMPOTENT:
+                    raise
+                return conn.command(*parts)
 
     # -- raw hash ops ------------------------------------------------------
     def hset(self, key: str, fields: Mapping[str, str]) -> None:
@@ -207,4 +246,8 @@ class RespStore(TaskStore):
         return self._command("PING") == "PONG"
 
     def close(self) -> None:
-        self._conn.close()
+        self._closed = True  # before taking the lock: fail fast either way
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None

@@ -1,0 +1,360 @@
+"""Protocol race detector for the task lifecycle.
+
+The reference has no race detection; its safety is "by construction" —
+single-threaded event loops plus message passing (SURVEY §5.2). That argument
+broke the moment this framework added what the reference lacks: re-dispatch of
+in-flight tasks from purged workers. Now two agents can race on one task
+record (the zombie worker's late result vs the replacement's result), and the
+gateway and dispatcher write the same hashes from different processes.
+
+This module makes the implicit protocol checkable:
+
+- :class:`RaceMonitor` owns the task-lifecycle state machine
+  (QUEUED -> RUNNING -> COMPLETED | FAILED) plus the re-dispatch extension
+  (RUNNING -> RUNNING is legal only when declared), validates every observed
+  write online, and collects :class:`Violation` records instead of raising —
+  a detector, not an enforcer.
+- :class:`RaceCheckStore` wraps any :class:`TaskStore` and feeds every write
+  through a shared monitor. Wrap each agent's handle with its own ``actor``
+  label and violations name who raced with whom.
+- :func:`check_trace` replays a recorded event list through a fresh monitor
+  for offline/post-mortem analysis.
+
+Used by the test suite (wrap the store, run a full E2E stack, assert no
+errors) and available in production at ~one dict update per store write.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping
+
+from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS, TaskStatus
+from tpu_faas.store.base import Subscription, TaskStore
+
+#: Legal status transitions. ``None`` is "task does not exist yet".
+#: RUNNING -> RUNNING appears here because re-dispatch re-marks a task on its
+#: replacement worker; the monitor still flags it unless the dispatcher
+#: declared the re-dispatch (see RaceMonitor.expect_redispatch).
+_LEGAL: frozenset[tuple[str | None, str]] = frozenset(
+    {
+        (None, "QUEUED"),
+        ("QUEUED", "QUEUED"),  # idempotent gateway retry
+        ("QUEUED", "RUNNING"),
+        ("RUNNING", "RUNNING"),
+        ("RUNNING", "COMPLETED"),
+        ("RUNNING", "FAILED"),
+        # QUEUED -> terminal: legal but suspicious (result without dispatch);
+        # reported as a warning, see _transition_kind.
+        ("QUEUED", "COMPLETED"),
+        ("QUEUED", "FAILED"),
+    }
+)
+
+
+@dataclass(frozen=True)
+class Event:
+    """One observed store write, in global observation order."""
+
+    seq: int
+    time: float
+    actor: str
+    op: str  # create | status | finish | delete | flush
+    task_id: str
+    from_status: str | None
+    #: status carried by this write; None means the write had no status field
+    to_status: str | None
+    #: result payload accompanying a terminal write (None otherwise)
+    result: str | None = None
+
+
+@dataclass(frozen=True)
+class Violation:
+    kind: str
+    severity: str  # "error" | "warning"
+    task_id: str
+    detail: str
+    events: tuple[Event, ...] = ()
+
+    def __str__(self) -> str:
+        return f"[{self.severity}] {self.kind} on {self.task_id}: {self.detail}"
+
+
+@dataclass
+class _TaskState:
+    status: str | None = None
+    result: str | None = None
+    last_writer: str = "?"
+    last_event: Event | None = None
+    redispatch_credits: int = 0
+
+
+class RaceMonitor:
+    """Thread-safe online checker of the task-lifecycle protocol.
+
+    Error kinds
+    -----------
+    - ``terminal-overwrite`` — a write changed a terminal status or replaced
+      a terminal result with a different value (the zombie-vs-replacement
+      race; ``finish_task(first_wins=True)`` exists to prevent exactly this).
+    - ``illegal-transition`` — any transition outside the state machine
+      (e.g. COMPLETED -> RUNNING).
+
+    Warning kinds
+    -------------
+    - ``double-dispatch`` — RUNNING -> RUNNING without a declared re-dispatch:
+      two workers may hold the same task.
+    - ``result-without-dispatch`` — terminal write on a task never marked
+      RUNNING.
+    - ``unknown-task`` — write to a task id with no observed create (only
+      with ``strict=True``; otherwise the task is adopted silently, since a
+      checker attached mid-run legitimately misses earlier creates).
+    """
+
+    def __init__(self, *, strict: bool = False, max_events: int = 100_000) -> None:
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._tasks: dict[str, _TaskState] = {}
+        self._strict = strict
+        self.events: deque[Event] = deque(maxlen=max_events)
+        self.violations: list[Violation] = []
+
+    # -- declarations ------------------------------------------------------
+    def expect_redispatch(self, task_id: str) -> None:
+        """Declare that the next RUNNING -> RUNNING write on ``task_id`` is a
+        deliberate re-dispatch (purged worker's task moved to a replacement),
+        not a double-dispatch bug."""
+        with self._lock:
+            self._state(task_id).redispatch_credits += 1
+
+    # -- observation -------------------------------------------------------
+    def observe(
+        self,
+        actor: str,
+        op: str,
+        task_id: str,
+        fields: Mapping[str, str] | None = None,
+    ) -> Event:
+        """Record one store write and validate it. Returns the event."""
+        fields = fields or {}
+        with self._lock:
+            state = self._tasks.get(task_id)
+            if state is None:
+                if self._strict and op not in ("create", "flush"):
+                    self._flag(
+                        "unknown-task",
+                        "warning",
+                        task_id,
+                        f"{actor} wrote {op} to a task never created",
+                    )
+                state = self._state(task_id)
+
+            event = Event(
+                seq=next(self._seq),
+                time=time.time(),
+                actor=actor,
+                op=op,
+                task_id=task_id,
+                from_status=state.status,
+                to_status=fields.get(FIELD_STATUS),
+                result=fields.get(FIELD_RESULT),
+            )
+            self.events.append(event)
+
+            if op == "delete":
+                self._tasks.pop(task_id, None)
+                return event
+            if event.to_status is not None:
+                self._check_transition(state, event)
+                state.status = event.to_status
+            if event.result is not None:
+                state.result = event.result
+            state.last_writer = actor
+            state.last_event = event
+            return event
+
+    def observe_flush(self, actor: str) -> None:
+        with self._lock:
+            self.events.append(
+                Event(next(self._seq), time.time(), actor, "flush", "*", None, None)
+            )
+            self._tasks.clear()
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def errors(self) -> list[Violation]:
+        return [v for v in self.violations if v.severity == "error"]
+
+    @property
+    def warnings(self) -> list[Violation]:
+        return [v for v in self.violations if v.severity == "warning"]
+
+    def unfinished(self) -> list[str]:
+        """Task ids observed but not terminal — call after the run drains to
+        detect lost tasks (the reference loses in-flight tasks on purge,
+        SURVEY §5.3; this framework must not)."""
+        with self._lock:
+            # keys that never carried a status are not tasks (e.g. the
+            # gateway's function-registry hashes share the store)
+            return [
+                tid
+                for tid, s in self._tasks.items()
+                if s.status is not None and not TaskStatus(s.status).is_terminal()
+            ]
+
+    def assert_clean(self, *, allow_warnings: bool = False) -> None:
+        bad = self.violations if not allow_warnings else self.errors
+        if bad:
+            raise AssertionError(
+                "race detector found:\n" + "\n".join(str(v) for v in bad)
+            )
+
+    # -- internals ---------------------------------------------------------
+    def _state(self, task_id: str) -> _TaskState:
+        return self._tasks.setdefault(task_id, _TaskState())
+
+    def _flag(
+        self,
+        kind: str,
+        severity: str,
+        task_id: str,
+        detail: str,
+        events: tuple[Event, ...] = (),
+    ) -> None:
+        self.violations.append(Violation(kind, severity, task_id, detail, events))
+
+    def _check_transition(self, state: _TaskState, event: Event) -> None:
+        frm, to = state.status, event.to_status
+        assert to is not None
+        prior = (state.last_event,) if state.last_event else ()
+        if frm is not None and TaskStatus(frm).is_terminal():
+            same = frm == to and (
+                event.result is None or event.result == state.result
+            )
+            if not same:
+                self._flag(
+                    "terminal-overwrite",
+                    "error",
+                    event.task_id,
+                    f"{event.actor} wrote {to} over terminal {frm} "
+                    f"(prev writer {state.last_writer})",
+                    prior + (event,),
+                )
+            return
+        if (frm, to) not in _LEGAL:
+            self._flag(
+                "illegal-transition",
+                "error",
+                event.task_id,
+                f"{event.actor}: {frm} -> {to}",
+                prior + (event,),
+            )
+            return
+        if frm == "RUNNING" and to == "RUNNING":
+            if state.redispatch_credits > 0:
+                state.redispatch_credits -= 1
+            else:
+                self._flag(
+                    "double-dispatch",
+                    "warning",
+                    event.task_id,
+                    f"{event.actor} re-marked RUNNING without a declared "
+                    f"re-dispatch (prev writer {state.last_writer})",
+                    prior + (event,),
+                )
+        elif frm == "QUEUED" and to in ("COMPLETED", "FAILED"):
+            self._flag(
+                "result-without-dispatch",
+                "warning",
+                event.task_id,
+                f"{event.actor} wrote {to} on a task never marked RUNNING",
+                prior + (event,),
+            )
+
+
+class RaceCheckStore(TaskStore):
+    """Transparent :class:`TaskStore` wrapper feeding a :class:`RaceMonitor`.
+
+    Wrap each agent's handle separately so the monitor can attribute writes:
+
+        monitor = RaceMonitor()
+        gw_store = RaceCheckStore(make_store(url), monitor, actor="gateway")
+        disp_store = RaceCheckStore(make_store(url), monitor, actor="dispatcher")
+
+    Only writes are intercepted; reads and the announce bus pass straight
+    through (the bus is fire-and-forget by design — nothing to check).
+    """
+
+    def __init__(self, inner: TaskStore, monitor: RaceMonitor, actor: str) -> None:
+        self.inner = inner
+        self.monitor = monitor
+        self.actor = actor
+
+    # -- intercepted writes ------------------------------------------------
+    def hset(self, key: str, fields: Mapping[str, str]) -> None:
+        op = "finish" if FIELD_RESULT in fields else "status"
+        if FIELD_STATUS in fields and fields[FIELD_STATUS] == str(
+            TaskStatus.QUEUED
+        ):
+            op = "create"
+        self.monitor.observe(self.actor, op, key, fields)
+        self.inner.hset(key, fields)
+
+    def delete(self, key: str) -> None:
+        self.monitor.observe(self.actor, "delete", key)
+        self.inner.delete(key)
+
+    def declare_redispatch(self, task_id: str) -> None:
+        self.monitor.expect_redispatch(task_id)
+        self.inner.declare_redispatch(task_id)
+
+    def flush(self) -> None:
+        self.monitor.observe_flush(self.actor)
+        self.inner.flush()
+
+    # -- pass-through ------------------------------------------------------
+    def hget(self, key: str, field: str) -> str | None:
+        return self.inner.hget(key, field)
+
+    def hgetall(self, key: str) -> dict[str, str]:
+        return self.inner.hgetall(key)
+
+    def keys(self) -> list[str]:
+        return self.inner.keys()
+
+    def publish(self, channel: str, payload: str) -> None:
+        self.inner.publish(channel, payload)
+
+    def subscribe(self, channel: str) -> Subscription:
+        return self.inner.subscribe(channel)
+
+    def ping(self) -> bool:
+        return self.inner.ping()
+
+    def save(self, path: str | None = None) -> None:
+        self.inner.save(path)
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+def check_trace(events: Iterable[Event], *, strict: bool = False) -> list[Violation]:
+    """Replay a recorded event trace through a fresh monitor (offline /
+    post-mortem mode). Events may come from ``RaceMonitor.events`` of a live
+    run or be reconstructed from logs."""
+    monitor = RaceMonitor(strict=strict)
+    for e in sorted(events, key=lambda e: e.seq):
+        if e.op == "flush":
+            monitor.observe_flush(e.actor)
+            continue
+        fields: dict[str, str] = {}
+        if e.to_status is not None:
+            fields[FIELD_STATUS] = e.to_status
+        if e.result is not None:
+            fields[FIELD_RESULT] = e.result
+        monitor.observe(e.actor, e.op, e.task_id, fields)
+    return monitor.violations

@@ -74,6 +74,14 @@ class StoreServer:
             await self.start()
         async with self._server:
             await self._shutdown.wait()
+            # Drop live client connections before the async-with closes the
+            # server: since Python 3.12 Server.wait_closed() waits for every
+            # connection handler to finish, so a SHUTDOWN with an idle
+            # subscriber still attached would hang the process forever.
+            if self._autosave_task is not None:
+                self._autosave_task.cancel()
+            for w in list(self.state.conns):
+                w.close()
 
     async def stop(self) -> None:
         try:
@@ -248,7 +256,16 @@ class StoreServer:
             writer.write(resp.encode_simple("OK"))
             return False
         elif name == "SHUTDOWN":
-            self._save_if_configured()
+            try:
+                self._save_if_configured()
+            except OSError as exc:
+                # like Redis: a failed save aborts the shutdown and the
+                # client is told, rather than dying with unsaved state or
+                # silently staying up
+                writer.write(
+                    resp.encode_error(f"SHUTDOWN aborted, save failed: {exc}")
+                )
+                return True
             self._shutdown.set()
             return False
         else:
