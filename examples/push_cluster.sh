@@ -1,0 +1,39 @@
+#!/bin/sh
+# A real multi-process push-mode cluster on one machine: native C++ store,
+# REST gateway, TPU-scheduled push dispatcher, and two 4-process worker
+# nodes. The same commands spread across machines by changing the URLs.
+#
+# Run from the repo root:  sh examples/push_cluster.sh
+set -e
+
+make -C native >/dev/null
+mkdir -p /tmp/tpu-faas-demo
+
+native/build/tpu-faas-store --port 6380 --snapshot /tmp/tpu-faas-demo/store.snap &
+STORE=$!
+sleep 1
+
+python -m tpu_faas.gateway --port 8000 --store resp://127.0.0.1:6380 &
+GW=$!
+python -m tpu_faas.dispatch -m tpu-push -p 5555 --store resp://127.0.0.1:6380 &
+DISP=$!
+sleep 2
+
+python -m tpu_faas.worker.push_worker 4 tcp://127.0.0.1:5555 --hb &
+W1=$!
+python -m tpu_faas.worker.push_worker 4 tcp://127.0.0.1:5555 --hb &
+W2=$!
+sleep 2
+
+python - <<'PY'
+from tpu_faas.client import FaaSClient
+
+client = FaaSClient("http://127.0.0.1:8000")
+fid = client.register(lambda n: sum(i * i for i in range(n)))
+handles = [client.submit(fid, 10_000 + i) for i in range(32)]
+print("32 tasks across 2 workers:", [h.result(timeout=120) for h in handles][:4], "...")
+PY
+
+kill $W1 $W2 $DISP $GW $STORE 2>/dev/null
+wait 2>/dev/null || true
+echo "done"

@@ -1,0 +1,54 @@
+"""Single-process quickstart: the whole stack in one Python process.
+
+Store server (thread) + REST gateway (thread) + local dispatcher (thread),
+then the client SDK registering and invoking functions over real HTTP.
+This is the smallest end-to-end tpu-faas program; for a real deployment the
+three services run as separate processes (see examples/push_cluster.sh).
+
+Run:  python examples/quickstart.py
+"""
+
+import threading
+
+from tpu_faas.client import FaaSClient, TaskFailedError
+from tpu_faas.dispatch.local import LocalDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+
+
+def fib(n: int) -> int:
+    a, b = 0, 1
+    for _ in range(n):
+        a, b = b, a + b
+    return a
+
+
+def main() -> None:
+    store = start_store_thread()
+    gateway = start_gateway_thread(make_store(store.url))
+    dispatcher = LocalDispatcher(num_workers=4, store=make_store(store.url))
+    threading.Thread(target=dispatcher.start, daemon=True).start()
+
+    client = FaaSClient(gateway.url)
+
+    # one-shot: register + submit + wait
+    print("fib(30) =", client.run(fib, 30))
+
+    # explicit handles: submit many, collect later
+    fid = client.register(fib)
+    handles = [client.submit(fid, n) for n in range(10, 20)]
+    print("batch   =", [h.result() for h in handles])
+
+    # failures come back as exceptions, not hung polls
+    try:
+        client.run(lambda: 1 / 0)
+    except TaskFailedError as e:
+        print("failure =", repr(e.cause))
+
+    dispatcher.stop()
+    gateway.stop()
+    store.stop()
+
+
+if __name__ == "__main__":
+    main()

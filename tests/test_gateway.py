@@ -84,3 +84,28 @@ def test_error_paths(gw):
     )
     assert requests.get(f"{handle.url}/status/ghost").status_code == 404
     assert requests.get(f"{handle.url}/result/ghost").status_code == 404
+
+
+def test_healthz_and_metrics(gw):
+    handle, store = gw
+    base = handle.url
+    assert requests.get(f"{base}/healthz").json() == {"ok": True}
+
+    fid = requests.post(
+        f"{base}/register_function",
+        json={"name": "arith", "payload": serialize(arithmetic)},
+    ).json()["function_id"]
+    requests.post(
+        f"{base}/execute_function",
+        json={"function_id": fid, "payload": serialize(((10,), {}))},
+    )
+
+    m = requests.get(f"{base}/metrics").json()
+    assert m["store_ok"] is True
+    assert m["functions_registered"] == 1
+    assert m["tasks_submitted"] == 1
+    assert m["uptime_s"] >= 0
+    # per-route latency stats exist for the endpoints just hit
+    assert "POST /register_function" in m["requests"]
+    assert "POST /execute_function" in m["requests"]
+    assert m["requests"]["POST /register_function"]["count"] == 1.0

@@ -192,3 +192,17 @@ def test_local_dispatcher_e2e_is_race_clean():
         t.join(timeout=15)
     assert m.unfinished() == []
     m.assert_clean()
+
+
+def test_non_enum_status_is_flagged_not_crashed():
+    """A corrupt status string must produce violations, never a ValueError
+    out of observe() (the monitor is a detector, not an enforcer)."""
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("x", "status", "t", {S: "BOGUS"})
+    m.observe("d", "status", "t", {S: "RUNNING"})  # from BOGUS: also illegal
+    m.observe("d", "finish", "t", {S: "COMPLETED", R: "1"})
+    kinds = [v.kind for v in m.errors]
+    assert "illegal-transition" in kinds
+    # and the task tracker still works
+    assert m.unfinished() == []

@@ -25,7 +25,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _spawn_worker(kind: str, n_procs: int, url: str, *extra: str):
-    env = dict(os.environ, PYTHONPATH=REPO)
+    # extend, don't replace: PYTHONPATH may carry platform plugins
+    existing = os.environ.get("PYTHONPATH", "")
+    env = dict(
+        os.environ, PYTHONPATH=f"{REPO}:{existing}" if existing else REPO
+    )
     return subprocess.Popen(
         [sys.executable, "-m", f"tpu_faas.worker.{kind}", str(n_procs), url]
         + list(extra),

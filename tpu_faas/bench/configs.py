@@ -95,8 +95,12 @@ def config_3_auction_1k_10k() -> dict:
     out = run_auction(problems[0])  # compile
     a = np.asarray(out.assignment)[:n_tasks]
     r = np.asarray(run_rank(problems[0]))[:n_tasks]
-    auction_ms = _pipeline_slope_ms(run_auction, problems, 1, 3)
-    rank_ms = _pipeline_slope_ms(run_rank, problems, 5, 25)
+    # depth >=10: at ~10 ms/exec the tunnel's per-round-trip jitter swamps
+    # a shallow pipeline, making the slope estimate noisy by >10x
+    auction_ms = _pipeline_slope_ms(run_auction, problems, 2, 10)
+    # the rank kernel is sub-ms: go deep enough that tunnel jitter (which is
+    # per-round-trip, not per-execution) can't drive the slope negative
+    rank_ms = max(0.0, _pipeline_slope_ms(run_rank, problems, 20, 120))
     cap = int(free.sum())
     sizes0 = np.full(n_tasks, 1.0, dtype=np.float32)
     return {

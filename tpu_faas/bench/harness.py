@@ -70,7 +70,12 @@ class BenchResult:
 
 
 def _spawn_worker(kind: str, n_procs: int, url: str, *extra: str):
-    env = dict(os.environ, PYTHONPATH=REPO)
+    # extend, don't replace: PYTHONPATH may carry platform plugins
+    # (e.g. the TPU PJRT plugin lives there in some environments)
+    existing = os.environ.get("PYTHONPATH", "")
+    env = dict(
+        os.environ, PYTHONPATH=f"{REPO}:{existing}" if existing else REPO
+    )
     return subprocess.Popen(
         [sys.executable, "-m", f"tpu_faas.worker.{kind}", str(n_procs), url]
         + list(extra),

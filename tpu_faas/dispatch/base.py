@@ -113,10 +113,19 @@ class TaskDispatcher:
             return PendingTask(msg, fields[FIELD_FN], fields[FIELD_PARAMS])
 
     def poll_tasks(self, max_n: int) -> list[PendingTask]:
-        """Batch intake: drain up to max_n announcements."""
+        """Batch intake: drain up to max_n announcements. If a store outage
+        strikes mid-batch, the tasks already fetched are DELIVERED (their
+        announces are consumed; dropping them would lose tasks) and the
+        failing announce is parked in the backlog by poll_next_task; only an
+        outage with nothing fetched yet propagates."""
         out: list[PendingTask] = []
         for _ in range(max_n):
-            t = self.poll_next_task()
+            try:
+                t = self.poll_next_task()
+            except STORE_OUTAGE_ERRORS:
+                if out:
+                    return out
+                raise
             if t is None:
                 break
             out.append(t)
@@ -139,6 +148,18 @@ class TaskDispatcher:
         """``first_wins=True`` on paths where a second result for the same
         task is possible (zombie worker of a re-dispatched task)."""
         self.store.finish_task(task_id, status, result, first_wins=first_wins)
+
+    def mark_running_safe(self, task_id: str, *, redispatch: bool = False) -> bool:
+        """mark_running that degrades on a store outage instead of raising:
+        callers use it when the task is already (or imminently) on its way to
+        a worker — the terminal result write, which is deferred-capable,
+        supersedes a missing RUNNING mark. Returns False when skipped."""
+        try:
+            self.mark_running(task_id, redispatch=redispatch)
+            return True
+        except STORE_OUTAGE_ERRORS as exc:
+            self.note_store_outage(exc, pause=0)
+            return False
 
     def record_result_safe(
         self, task_id: str, status: str, result: str, first_wins: bool = False

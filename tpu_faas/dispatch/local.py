@@ -54,13 +54,7 @@ class LocalDispatcher(TaskDispatcher):
         )
 
     def _submit(self, pool: ProcessPoolExecutor, task) -> None:
-        try:
-            self.mark_running(task.task_id)
-        except STORE_OUTAGE_ERRORS as exc:
-            # still execute: the announce is already consumed, and the
-            # terminal result write (deferred if needed) supersedes the
-            # missing RUNNING mark
-            self.note_store_outage(exc, pause=0)
+        self.mark_running_safe(task.task_id)
         fut = pool.submit(
             execute_fn, task.task_id, task.fn_payload, task.param_payload
         )

@@ -73,13 +73,7 @@ class PullDispatcher(TaskDispatcher):
                     self.note_store_outage(exc, pause=0)
                     task = None
                 if task is not None:
-                    try:
-                        self.mark_running(task.task_id)
-                    except STORE_OUTAGE_ERRORS as exc:
-                        # worker still gets the task; the terminal result
-                        # write (deferred if needed) supersedes the missing
-                        # RUNNING mark
-                        self.note_store_outage(exc, pause=0)
+                    self.mark_running_safe(task.task_id)
                     self.socket.send(
                         m.encode(
                             m.TASK,

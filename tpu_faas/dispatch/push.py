@@ -296,13 +296,7 @@ class PushDispatcher(TaskDispatcher):
                     param_payload=task.param_payload,
                 ),
             )
-            try:
-                self.mark_running(task.task_id, redispatch=bool(task.retries))
-            except STORE_OUTAGE_ERRORS as exc:
-                # task already sent: keep the bookkeeping consistent (it IS
-                # in flight); the terminal result write supersedes the
-                # missing RUNNING mark
-                self.note_store_outage(exc, pause=0)
+            self.mark_running_safe(task.task_id, redispatch=bool(task.retries))
             rec.inflight.add(task.task_id)
             if task.retries:
                 rec.inflight_retries[task.task_id] = task.retries

@@ -111,6 +111,9 @@ class TpuPushDispatcher(TaskDispatcher):
         by the pending-id check at intake (tick())."""
         a = self.arrays
         known = {t.task_id for t in self.pending}
+        # tasks whose (terminal) writes sit in the deferred buffer still read
+        # as QUEUED/RUNNING from the store — adopting them would re-execute
+        known.update(item[0] for item in self.deferred_results)
         n = 0
         for key in self.store.keys():
             if key in known or a.inflight_owner(key) is not None:
@@ -313,15 +316,9 @@ class TpuPushDispatcher(TaskDispatcher):
                 )
                 # on the wire + tracked: must NOT be restored on an outage
                 restore_from = idx + 1
-                try:
-                    self.mark_running(
-                        task.task_id, redispatch=bool(task.retries)
-                    )
-                except STORE_OUTAGE_ERRORS as exc:
-                    # worker already has the task and it IS in the inflight
-                    # table; the (deferred-capable) terminal result write
-                    # supersedes the missing RUNNING mark
-                    self.note_store_outage(exc, pause=0)
+                self.mark_running_safe(
+                    task.task_id, redispatch=bool(task.retries)
+                )
                 a.worker_free[row] -= 1
                 sent += 1
                 self.n_dispatched += 1
@@ -344,8 +341,14 @@ class TpuPushDispatcher(TaskDispatcher):
                 try:
                     if self.deferred_results:
                         self.flush_deferred_results()
+                    # no rescan while results are deferred or the store is
+                    # down: a task whose COMPLETED write is waiting in
+                    # deferred_results still reads QUEUED from the store, so
+                    # a rescan would adopt and RE-EXECUTE it
                     if (
                         self.rescan_period > 0
+                        and not self.deferred_results
+                        and not self._store_down
                         and self.clock() - last_rescan >= self.rescan_period
                     ):
                         self._recover_stranded()

@@ -26,14 +26,15 @@ import argparse
 import asyncio
 import functools
 import threading
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 
 from aiohttp import web
 
 from tpu_faas.core.task import new_function_id, new_task_id
 from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
 from tpu_faas.store.launch import make_store
-from tpu_faas.utils.logging import get_logger
+from tpu_faas.utils.logging import TickTracer, get_logger
 
 log = get_logger("gateway")
 
@@ -53,20 +54,46 @@ async def _run_blocking(fn, *args):
 class GatewayContext:
     store: TaskStore
     channel: str = TASKS_CHANNEL
+    #: request/latency counters by endpoint (reference has no observability —
+    #: SURVEY §5.5); TickTracer is thread-safe enough for GIL-serialized
+    #: appends and cheap enough to leave on
+    tracer: TickTracer = field(default_factory=TickTracer)
+    started_at: float = field(default_factory=time.time)
+    n_functions: int = 0
+    n_tasks: int = 0
 
 
 CTX_KEY: web.AppKey["GatewayContext"] = web.AppKey("ctx", GatewayContext)
 
 
+@web.middleware
+async def _metrics_middleware(request: web.Request, handler):
+    ctx: GatewayContext = request.app[CTX_KEY]
+    t0 = time.perf_counter()
+    try:
+        return await handler(request)
+    finally:
+        resource = request.match_info.route.resource
+        # unmatched paths collapse into one bucket: keying by raw path would
+        # let a URL scanner grow the span table without bound
+        route = resource.canonical if resource is not None else "UNMATCHED"
+        ctx.tracer.record(
+            f"{request.method} {route}", time.perf_counter() - t0
+        )
+
+
 def make_app(store: TaskStore, channel: str = TASKS_CHANNEL) -> web.Application:
     ctx = GatewayContext(store=store, channel=channel)
-    app = web.Application(client_max_size=256 * 1024 * 1024)
+    app = web.Application(
+        client_max_size=256 * 1024 * 1024, middlewares=[_metrics_middleware]
+    )
     app[CTX_KEY] = ctx
     app.router.add_post("/register_function", register_function)
     app.router.add_post("/execute_function", execute_function)
     app.router.add_get("/status/{task_id}", get_status)
     app.router.add_get("/result/{task_id}", get_result)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
     return app
 
 
@@ -83,6 +110,7 @@ async def register_function(request: web.Request) -> web.Response:
         _FUNCTION_PREFIX + function_id,
         {"name": name, "payload": payload},
     )
+    ctx.n_functions += 1
     return web.json_response({"function_id": function_id})
 
 
@@ -104,6 +132,7 @@ async def execute_function(request: web.Request) -> web.Response:
         ctx.store.create_task(task_id, fn_payload, param_payload, ctx.channel)
 
     await _run_blocking(write_task)
+    ctx.n_tasks += 1
     return web.json_response({"task_id": task_id})
 
 
@@ -129,6 +158,32 @@ async def get_result(request: web.Request) -> web.Response:
 
 async def healthz(request: web.Request) -> web.Response:
     return web.json_response({"ok": True})
+
+
+async def metrics(request: web.Request) -> web.Response:
+    """Observability endpoint: per-route request counts + latency
+    percentiles, submission counters, and store reachability."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+
+    def safe_ping() -> bool:
+        try:
+            return bool(ctx.store.ping())
+        except Exception:
+            return False
+
+    store_ok = await _run_blocking(safe_ping)
+    return web.json_response(
+        {
+            "uptime_s": round(time.time() - ctx.started_at, 1),
+            "functions_registered": ctx.n_functions,
+            "tasks_submitted": ctx.n_tasks,
+            "store_ok": store_ok,
+            "requests": {
+                name: {k: round(v, 6) for k, v in stats.items()}
+                for name, stats in ctx.tracer.summary().items()
+            },
+        }
+    )
 
 
 # -- serving ----------------------------------------------------------------

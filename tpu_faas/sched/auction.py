@@ -46,9 +46,17 @@ def auction_placement(
     max_slots: int = 8,
     eps: float = 1e-3,
     max_rounds: int = 2000,
-    n_phases: int = 5,
+    n_phases: int = 10,
     backend: str = "auto",
 ) -> AuctionResult:
+    """``n_phases`` trades phase count against rounds-per-phase: each phase
+    reset must repair prices to the finer eps, costing ~n/ratio rounds, so a
+    too-steep eps ratio (few phases over a wide benefit range) can exhaust
+    ``max_rounds`` and leave stragglers unplaced. 10 phases converges on
+    benefit ranges spanning ~4 decades; identical-eps phases are free (warm
+    start below), so a larger value only costs compile-time constants. For
+    separable costs prefer rank_match_placement — provably optimal and two
+    orders of magnitude cheaper; the auction is the general-cost solver."""
     T = task_size.shape[0]
     W = worker_speed.shape[0]
     S = W * max_slots
@@ -157,20 +165,34 @@ def auction_placement(
         return price, owner, assigned_slot, rounds + 1, eps_i
 
     def phase(i, carry):
-        price, _owner, _assigned, total_rounds = carry
+        price, owner, assigned_slot, total_rounds, eps_prev = carry
         eps_i = eps0 * ratio ** i.astype(jnp.float32)
-        owner0 = jnp.full(S, -1, dtype=jnp.int32)
-        assigned0 = jnp.full(T, -1, dtype=jnp.int32)
+        # The per-phase assignment reset is required only when this phase's
+        # eps is actually FINER than the last (eps-complementary-slackness
+        # must be re-established at the new tolerance). When the benefit
+        # range is ~0 — uniform costs, the degenerate-but-common FaaS case —
+        # eps0 == eps_final and every phase has the same eps; re-solving the
+        # whole matching from scratch n_phases times is pure waste. Warm-
+        # starting with the previous phase's matching makes such a phase's
+        # while_loop exit in zero rounds.
+        finer = eps_i < eps_prev * jnp.float32(1.0 - 1e-6)
+        owner0 = jnp.where(finer, jnp.full(S, -1, dtype=jnp.int32), owner)
+        assigned0 = jnp.where(
+            finer, jnp.full(T, -1, dtype=jnp.int32), assigned_slot
+        )
         price, owner, assigned_slot, rounds, _ = jax.lax.while_loop(
             cond, body, (price, owner0, assigned0, jnp.int32(0), eps_i)
         )
-        return price, owner, assigned_slot, total_rounds + rounds
+        return price, owner, assigned_slot, total_rounds + rounds, eps_i
 
     price0 = jnp.zeros(S, dtype=jnp.float32)
     owner0 = jnp.full(S, -1, dtype=jnp.int32)
     assigned0 = jnp.full(T, -1, dtype=jnp.int32)
-    price, owner, assigned_slot, rounds = jax.lax.fori_loop(
-        0, n_phases, phase, (price0, owner0, assigned0, jnp.int32(0))
+    price, owner, assigned_slot, rounds, _ = jax.lax.fori_loop(
+        0,
+        n_phases,
+        phase,
+        (price0, owner0, assigned0, jnp.int32(0), jnp.float32(jnp.inf)),
     )
 
     assignment = jnp.where(

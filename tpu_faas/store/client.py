@@ -194,7 +194,11 @@ class RespStore(TaskStore):
                 self._conn = _Conn(self.host, self.port)
             try:
                 return self._conn.command(*parts)
-            except ConnectionError:
+            except (ConnectionError, TimeoutError):
+                # TimeoutError too: the reply may still arrive later, so the
+                # old connection is DESYNCHRONIZED (a future command would
+                # read the stale reply as its own) — it must be dropped, and
+                # any retry must go through a fresh connection
                 self._conn.close()
                 self._conn = None
                 conn = _Conn(self.host, self.port)  # may raise: _conn stays None

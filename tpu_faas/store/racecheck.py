@@ -84,6 +84,18 @@ class Violation:
         return f"[{self.severity}] {self.kind} on {self.task_id}: {self.detail}"
 
 
+def _is_terminal(status: str | None) -> bool:
+    """Terminal check that tolerates non-enum garbage: the monitor is a
+    detector, not an enforcer — a corrupt status string must be FLAGGED
+    (illegal-transition fires via the _LEGAL table), never crash observe()."""
+    if status is None:
+        return False
+    try:
+        return TaskStatus(status).is_terminal()
+    except ValueError:
+        return False
+
+
 @dataclass
 class _TaskState:
     status: str | None = None
@@ -203,7 +215,7 @@ class RaceMonitor:
             return [
                 tid
                 for tid, s in self._tasks.items()
-                if s.status is not None and not TaskStatus(s.status).is_terminal()
+                if s.status is not None and not _is_terminal(s.status)
             ]
 
     def assert_clean(self, *, allow_warnings: bool = False) -> None:
@@ -231,7 +243,7 @@ class RaceMonitor:
         frm, to = state.status, event.to_status
         assert to is not None
         prior = (state.last_event,) if state.last_event else ()
-        if frm is not None and TaskStatus(frm).is_terminal():
+        if _is_terminal(frm):
             same = frm == to and (
                 event.result is None or event.result == state.result
             )
