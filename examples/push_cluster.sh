@@ -6,6 +6,10 @@
 # Run from the repo root:  sh examples/push_cluster.sh
 set -e
 
+STORE=""; GW=""; DISP=""; W1=""; W2=""
+cleanup() { kill $W1 $W2 $DISP $GW $STORE 2>/dev/null || true; }
+trap cleanup EXIT  # a failing step must not orphan the background services
+
 make -C native >/dev/null
 mkdir -p /tmp/tpu-faas-demo
 
@@ -34,6 +38,4 @@ handles = [client.submit(fid, 10_000 + i) for i in range(32)]
 print("32 tasks across 2 workers:", [h.result(timeout=120) for h in handles][:4], "...")
 PY
 
-kill $W1 $W2 $DISP $GW $STORE 2>/dev/null
-wait 2>/dev/null || true
 echo "done"

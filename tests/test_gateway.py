@@ -108,4 +108,54 @@ def test_healthz_and_metrics(gw):
     # per-route latency stats exist for the endpoints just hit
     assert "POST /register_function" in m["requests"]
     assert "POST /execute_function" in m["requests"]
-    assert m["requests"]["POST /register_function"]["count"] == 1.0
+    reg = m["requests"]["POST /register_function"]
+    assert reg["count"] == 1  # monotonic counter, not the latency ring
+    assert reg["latency"]["p50"] > 0
+
+
+def test_many_completed_full_stack():
+    """100 tasks through the REST contract, each verified against local
+    re-execution (analog of the reference's extended suite:
+    examples/process_pool_example/test_suit.py:133-171 test_many_completed)."""
+    import threading
+    import time
+
+    from tpu_faas.dispatch.local import LocalDispatcher
+
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    disp = LocalDispatcher(num_workers=4, store=store)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    base = handle.url
+    try:
+        fid = requests.post(
+            f"{base}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        tids = [
+            requests.post(
+                f"{base}/execute_function",
+                json={"function_id": fid, "payload": serialize(((n,), {}))},
+            ).json()["task_id"]
+            for n in range(100, 200)
+        ]
+        deadline = time.monotonic() + 120
+        expected = {tid: arithmetic(n) for tid, n in zip(tids, range(100, 200))}
+        pending = set(tids)
+        while pending and time.monotonic() < deadline:
+            for tid in list(pending):
+                body = requests.get(f"{base}/result/{tid}").json()
+                if body["status"] == "COMPLETED":
+                    from tpu_faas.core.serialize import deserialize
+
+                    assert deserialize(body["result"]) == expected[tid]
+                    pending.discard(tid)
+                else:
+                    assert body["status"] in ("QUEUED", "RUNNING")
+            time.sleep(0.05)
+        assert not pending, f"{len(pending)} tasks never completed"
+    finally:
+        disp.stop()
+        t.join(timeout=15)
+        handle.stop()

@@ -153,7 +153,9 @@ def config_4_sinkhorn_hetero() -> dict:
         )
 
     out = run(p)  # compile
-    placement_ms = _pipeline_slope_ms(run, problems, 2, 10)
+    # deep pipeline like bench.py's headline: shallow depths let tunnel
+    # round-trip jitter (~tens of ms) swamp the slope for ~ms kernels
+    placement_ms = max(0.0, _pipeline_slope_ms(run, problems, 10, 60))
     a = np.asarray(out.assignment)[:n_tasks]
     greedy = np.asarray(
         host_greedy_reference(sizes, speeds, np.minimum(free, max_slots), live)

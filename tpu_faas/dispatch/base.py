@@ -232,6 +232,15 @@ class TaskDispatcher:
             first_wins=True,
         )
 
+    def stats(self) -> dict:
+        """Observability snapshot (subclasses extend); cheap enough to call
+        from a metrics poller."""
+        return {
+            "store_down": self._store_down,
+            "deferred_results": len(self.deferred_results),
+            "announce_backlog": len(self._announce_backlog),
+        }
+
     def task_is_terminal(self, task_id: str) -> bool:
         status = self.store.get_status(task_id)
         return status is not None and TaskStatus(status).is_terminal()

@@ -136,6 +136,9 @@ class TpuPushDispatcher(TaskDispatcher):
                 )
             )
             n += 1
+        # reads succeeded: the store is reachable (an idle dispatcher has no
+        # result writes to clear the outage flag otherwise)
+        self.note_store_up()
         if n:
             self.log.info("recovered %d stranded QUEUED tasks", n)
 
@@ -185,6 +188,18 @@ class TpuPushDispatcher(TaskDispatcher):
             a.heartbeat(wid)
         elif msg_type == m.RECONNECT:
             a.reconnect(wid, int(data.get("free_processes", 0)))
+
+    def stats(self) -> dict:
+        a = self.arrays
+        return {
+            **super().stats(),
+            "n_dispatched": self.n_dispatched,
+            "n_results": self.n_results,
+            "pending": len(self.pending),
+            "inflight": a.n_inflight,
+            "workers_registered": len(a.worker_ids),
+            "device_tick": self.tracer.summary().get("device_tick", {}),
+        }
 
     # -- one scheduler tick ------------------------------------------------
     def tick(self) -> int:
@@ -341,14 +356,17 @@ class TpuPushDispatcher(TaskDispatcher):
                 try:
                     if self.deferred_results:
                         self.flush_deferred_results()
-                    # no rescan while results are deferred or the store is
-                    # down: a task whose COMPLETED write is waiting in
-                    # deferred_results still reads QUEUED from the store, so
-                    # a rescan would adopt and RE-EXECUTE it
+                    # no rescan while results are deferred: a task whose
+                    # COMPLETED write is waiting in deferred_results still
+                    # reads QUEUED from the store, so a rescan would adopt
+                    # and RE-EXECUTE it. (Deliberately NOT gated on
+                    # _store_down — that flag is only cleared by successful
+                    # writes, so an idle dispatcher would never rescan again;
+                    # a rescan attempt against a dead store just raises into
+                    # the outer handler and doubles as the recovery probe.)
                     if (
                         self.rescan_period > 0
                         and not self.deferred_results
-                        and not self._store_down
                         and self.clock() - last_rescan >= self.rescan_period
                     ):
                         self._recover_stranded()

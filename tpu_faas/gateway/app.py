@@ -61,6 +61,9 @@ class GatewayContext:
     started_at: float = field(default_factory=time.time)
     n_functions: int = 0
     n_tasks: int = 0
+    #: monotonic per-route request totals — the tracer's ring is bounded
+    #: (correct for latency percentiles, WRONG as a counter once saturated)
+    route_counts: dict = field(default_factory=dict)
 
 
 CTX_KEY: web.AppKey["GatewayContext"] = web.AppKey("ctx", GatewayContext)
@@ -77,9 +80,9 @@ async def _metrics_middleware(request: web.Request, handler):
         # unmatched paths collapse into one bucket: keying by raw path would
         # let a URL scanner grow the span table without bound
         route = resource.canonical if resource is not None else "UNMATCHED"
-        ctx.tracer.record(
-            f"{request.method} {route}", time.perf_counter() - t0
-        )
+        name = f"{request.method} {route}"
+        ctx.route_counts[name] = ctx.route_counts.get(name, 0) + 1
+        ctx.tracer.record(name, time.perf_counter() - t0)
 
 
 def make_app(store: TaskStore, channel: str = TASKS_CHANNEL) -> web.Application:
@@ -179,7 +182,15 @@ async def metrics(request: web.Request) -> web.Response:
             "tasks_submitted": ctx.n_tasks,
             "store_ok": store_ok,
             "requests": {
-                name: {k: round(v, 6) for k, v in stats.items()}
+                name: {
+                    "count": ctx.route_counts.get(name, 0),
+                    "latency": {
+                        k: round(v, 6)
+                        for k, v in stats.items()
+                        if k != "count"  # ring-bounded; the monotonic
+                        # counter above is the true total
+                    },
+                }
                 for name, stats in ctx.tracer.summary().items()
             },
         }

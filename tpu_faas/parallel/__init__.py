@@ -1,9 +1,16 @@
-"""Multi-chip scaling: device meshes + sharded scheduler kernels."""
+"""Multi-chip / multi-host scaling: device meshes, sharded scheduler
+kernels, and the multi-process runtime glue."""
 
+from tpu_faas.parallel.distributed import initialize_multihost
 from tpu_faas.parallel.mesh import (
     make_mesh,
     sharded_scheduler_tick,
     sharded_sinkhorn_placement,
 )
 
-__all__ = ["make_mesh", "sharded_scheduler_tick", "sharded_sinkhorn_placement"]
+__all__ = [
+    "initialize_multihost",
+    "make_mesh",
+    "sharded_scheduler_tick",
+    "sharded_sinkhorn_placement",
+]

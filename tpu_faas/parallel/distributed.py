@@ -1,0 +1,79 @@
+"""Multi-host (multi-process) mesh formation.
+
+One JAX process per host; `jax.distributed.initialize` wires them into one
+runtime so `jax.devices()` returns the GLOBAL device list and the mesh in
+`tpu_faas.parallel.mesh.make_mesh` spans hosts — the same sharded kernels
+then emit collectives that ride ICI within a slice and DCN across slices,
+with zero code changes in the scheduler.
+
+The reference has no multi-host story at all (one dispatcher process is the
+design; SURVEY §3.2), so this module is new capability: a pod-slice
+deployment runs one `TpuPushDispatcher` per host, each owning the worker
+sockets of its region, while the placement problem itself is solved
+collectively on the global mesh.
+
+On Cloud TPU the three parameters are discovered from the environment, so
+``initialize_multihost()`` with no arguments is the common call. Idempotent:
+a second call is a no-op instead of an error, so libraries can call it
+defensively.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from tpu_faas.utils.logging import get_logger
+
+log = get_logger("parallel.distributed")
+
+_initialized = False
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join this process into the global JAX runtime.
+
+    Arguments default to auto-discovery (TPU metadata / cluster env vars).
+    Returns True if initialization happened, False if it was already done
+    or this is a single-process run that doesn't need it.
+    """
+    global _initialized
+    if _initialized:
+        return False
+    if num_processes == 1:
+        # explicit single-process: nothing to join
+        _initialized = True
+        return False
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError) as exc:
+        if explicit:
+            # the operator named a cluster: silently degrading to a local
+            # mesh would compute placement over the wrong device set; do not
+            # latch either, so a boot-race retry can succeed
+            raise
+        # full auto-discovery on a non-cluster machine: single-process mode
+        # (make_mesh still works over this process's local devices)
+        log.info("single-process mode (no cluster discovered: %s)", exc)
+        _initialized = True
+        return False
+    _initialized = True
+    log.info(
+        "distributed runtime up: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+    return True

@@ -171,6 +171,10 @@ class SchedulerArrays:
             self.worker_ids.pop(wid, None)
 
     # -- in-flight table ---------------------------------------------------
+    @property
+    def n_inflight(self) -> int:
+        return len(self._inflight_slot)
+
     def inflight_add(self, task_id: str, row: int) -> int:
         if not self._free_inflight:
             raise RuntimeError("inflight table full; raise max_inflight")
