@@ -1,0 +1,12 @@
+"""Path shim shared by the example scripts: running one directly from a
+source checkout puts `examples/` (this directory) on sys.path, not the repo
+root, so `tpu_faas` only resolves if the package is installed. Importing
+this module from an example adds the repo root as a fallback."""
+
+import os
+import sys
+
+try:  # installed package, or repo root already on the path
+    import tpu_faas  # noqa: F401
+except ModuleNotFoundError:  # source checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -8,6 +8,8 @@ three services run as separate processes (see examples/push_cluster.sh).
 Run:  python examples/quickstart.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root path shim)
+
 import threading
 
 from tpu_faas.client import FaaSClient, TaskFailedError
@@ -38,6 +40,9 @@ def main() -> None:
     fid = client.register(fib)
     handles = [client.submit(fid, n) for n in range(10, 20)]
     print("batch   =", [h.result() for h in handles])
+
+    # or Pool.map-style, in input order
+    print("map     =", client.map(fib, range(20, 26)))
 
     # failures come back as exceptions, not hung polls
     try:

@@ -8,6 +8,8 @@ Run:  python examples/scheduler_kernels.py
 (CPU works; on a TPU host the kernels run on device.)
 """
 
+import _bootstrap  # noqa: F401  (repo-root path shim)
+
 import numpy as np
 
 from tpu_faas.sched.auction import auction_placement

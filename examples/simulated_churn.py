@@ -9,6 +9,8 @@ on-device failure detection + work-redistribution actually works.
 Run:  python examples/simulated_churn.py
 """
 
+import _bootstrap  # noqa: F401  (repo-root path shim)
+
 import numpy as np
 
 from tpu_faas.sim import SimFleet

@@ -60,3 +60,12 @@ def test_lambda_roundtrip(stack):
     client = stack
     k = 5
     assert client.run(lambda x: x * k, 8, timeout=30) == 40
+
+
+def test_client_map_in_order_and_failure_raises(stack):
+    client = stack
+    assert client.map(arithmetic, range(10, 30)) == [
+        arithmetic(n) for n in range(10, 30)
+    ]
+    with pytest.raises(TaskFailedError):
+        client.map(failing_task, ["a", "b"])

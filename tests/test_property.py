@@ -1,0 +1,174 @@
+"""Property-based tests (hypothesis) for the invariants that seeded tests
+can only spot-check: serialization totality, RESP wire framing, placement
+feasibility under arbitrary fleet states, and the race monitor's soundness
+on legal histories (SURVEY §4: the reference has no property layer at all).
+
+JIT discipline: placement properties use ONE fixed padded shape and vary
+only array contents, so the kernel compiles once per process, not once per
+hypothesis example.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from tpu_faas.core.executor import execute_fn, pack_params
+from tpu_faas.core.serialize import deserialize, serialize
+from tpu_faas.store import resp
+from tpu_faas.store.memory import MemoryStore
+from tpu_faas.store.racecheck import RaceMonitor
+
+SET = settings(
+    max_examples=50,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+# -- serialization: total on picklable values, exact roundtrip ---------------
+
+VALUES = st.recursive(
+    st.none()
+    | st.booleans()
+    | st.integers(-(2**63), 2**63)
+    | st.floats(allow_nan=False)
+    | st.text(max_size=50)
+    | st.binary(max_size=50),
+    lambda children: st.lists(children, max_size=4)
+    | st.tuples(children, children)
+    | st.dictionaries(st.text(max_size=8), children, max_size=4),
+    max_leaves=10,
+)
+
+
+@SET
+@given(VALUES)
+def test_serialize_roundtrip(value):
+    payload = serialize(value)
+    assert isinstance(payload, str)
+    assert deserialize(payload) == value
+
+
+@SET
+@given(st.lists(st.integers(-1000, 1000), max_size=20))
+def test_executor_roundtrip_through_wire_format(xs):
+    tid, status, result = execute_fn("t", serialize(sorted), pack_params(xs))
+    assert (tid, status) == ("t", "COMPLETED")
+    assert deserialize(result) == sorted(xs)
+
+
+# -- RESP framing: any strings survive encode -> parse -----------------------
+
+WIRE_TEXT = st.text(max_size=64)  # includes \r\n, unicode, empty
+
+
+@SET
+@given(st.lists(WIRE_TEXT, min_size=1, max_size=6))
+def test_resp_command_framing_roundtrip(parts):
+    parser = resp.RespParser()
+    parser.feed(resp.encode_command(*parts))
+    got = parser.pop()
+    assert got == parts
+    assert parser.pop() is resp.NEED_MORE
+
+
+@SET
+@given(
+    st.dictionaries(WIRE_TEXT, WIRE_TEXT, min_size=0, max_size=6),
+    st.dictionaries(WIRE_TEXT, WIRE_TEXT, min_size=0, max_size=3),
+)
+def test_memory_store_hash_semantics(first, second):
+    """HSET merge + HGETALL echo for arbitrary field names/values."""
+    store = MemoryStore()
+    if first:
+        store.hset("k", first)
+    if second:
+        store.hset("k", second)
+    assert store.hgetall("k") == {**first, **second}
+    store.close()
+
+
+# -- placement feasibility under arbitrary fleet state -----------------------
+
+T_PAD, W_PAD, MAX_SLOTS = 64, 16, 4
+
+FLEETS = st.tuples(
+    st.lists(
+        st.floats(0.01, 100.0, allow_nan=False), min_size=T_PAD, max_size=T_PAD
+    ),
+    st.lists(st.booleans(), min_size=T_PAD, max_size=T_PAD),
+    st.lists(
+        st.floats(0.1, 10.0, allow_nan=False), min_size=W_PAD, max_size=W_PAD
+    ),
+    st.lists(st.integers(0, MAX_SLOTS + 2), min_size=W_PAD, max_size=W_PAD),
+    st.lists(st.booleans(), min_size=W_PAD, max_size=W_PAD),
+)
+
+
+@SET
+@given(FLEETS)
+def test_rank_match_feasible_on_arbitrary_fleets(fleet):
+    from tpu_faas.sched.greedy import rank_match_placement
+
+    sizes, valid, speeds, free, live = (np.asarray(x) for x in fleet)
+    a = np.asarray(
+        rank_match_placement(
+            sizes.astype(np.float32),
+            valid,
+            speeds.astype(np.float32),
+            free.astype(np.int32),
+            live,
+            max_slots=MAX_SLOTS,
+        )
+    )
+    # invalid tasks never placed
+    assert (a[~valid] == -1).all()
+    # placements target live workers only
+    placed_workers = a[a >= 0]
+    assert live[placed_workers].all() if placed_workers.size else True
+    # per-worker load never exceeds its effective capacity
+    cap = np.where(live, np.minimum(free, MAX_SLOTS), 0)
+    load = np.bincount(placed_workers, minlength=W_PAD)
+    assert (load <= cap).all()
+    # work-conserving: placed count == min(valid tasks, total capacity)
+    assert (a >= 0).sum() == min(int(valid.sum()), int(cap.sum()))
+
+
+# -- race monitor: legal histories are clean ---------------------------------
+
+
+@SET
+@given(
+    st.lists(
+        st.tuples(
+            st.integers(0, 7),  # task index
+            st.sampled_from(["advance", "redispatch"]),
+        ),
+        max_size=40,
+    )
+)
+def test_race_monitor_accepts_all_legal_histories(script):
+    """Drive tasks through arbitrary interleavings of legal transitions
+    (QUEUED -> RUNNING -> terminal, with declared re-dispatches): the
+    monitor must stay silent — no false positives."""
+    m = RaceMonitor()
+    stage: dict[str, int] = {}
+    for idx, op in script:
+        tid = f"t{idx}"
+        s = stage.get(tid, 0)
+        if op == "redispatch":
+            if s == 2:  # RUNNING: a declared re-mark is legal
+                m.expect_redispatch(tid)
+                m.observe("d", "status", tid, {"status": "RUNNING"})
+            continue
+        if s == 0:
+            m.observe("gw", "create", tid, {"status": "QUEUED", "result": "None"})
+            stage[tid] = 1
+        elif s == 1:
+            m.observe("d", "status", tid, {"status": "RUNNING"})
+            stage[tid] = 2
+        elif s == 2:
+            m.observe("d", "finish", tid, {"status": "COMPLETED", "result": "r"})
+            stage[tid] = 3
+    m.assert_clean()

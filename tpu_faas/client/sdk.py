@@ -101,3 +101,40 @@ class FaaSClient:
     ) -> Any:
         """Register + submit + wait, in one call."""
         return self.submit(self.register(fn), *args, **kwargs).result(timeout)
+
+    def map(
+        self,
+        fn: Callable,
+        iterable,
+        timeout: float = 120.0,
+        poll_interval: float = 0.01,
+    ) -> list[Any]:
+        """Pool.map-style batch: register once, submit every item, then poll
+        handles in rotation (the reference's clients hand-roll exactly this
+        loop — test_client.py:109-128); results come back in input order,
+        and any FAILED task raises its TaskFailedError."""
+        fid = self.register(fn)
+        handles = [self.submit(fid, item) for item in iterable]
+        deadline = time.monotonic() + timeout
+        results: dict[int, Any] = {}
+        pending = set(range(len(handles)))
+        while pending:
+            for i in list(pending):
+                # one round-trip per poll: /result carries both status and
+                # payload (a done()/result() pair would double the requests)
+                status, payload = self.raw_result(handles[i].task_id)
+                if not TaskStatus(status).is_terminal():
+                    continue
+                value = deserialize(payload)
+                if status == str(TaskStatus.FAILED):
+                    raise TaskFailedError(handles[i].task_id, value)
+                results[i] = value
+                pending.discard(i)
+            if pending:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{len(pending)} of {len(handles)} tasks still "
+                        f"running after {timeout}s"
+                    )
+                time.sleep(poll_interval)
+        return [results[i] for i in range(len(handles))]
