@@ -8,7 +8,10 @@ three services run as separate processes (see examples/push_cluster.sh).
 Run:  python examples/quickstart.py
 """
 
-import _bootstrap  # noqa: F401  (repo-root path shim)
+try:
+    import _bootstrap  # noqa: F401  (repo-root path shim, script mode)
+except ModuleNotFoundError:
+    pass  # module mode (python -m examples.x): cwd already on sys.path
 
 import threading
 

@@ -8,7 +8,10 @@ Run:  python examples/scheduler_kernels.py
 (CPU works; on a TPU host the kernels run on device.)
 """
 
-import _bootstrap  # noqa: F401  (repo-root path shim)
+try:
+    import _bootstrap  # noqa: F401  (repo-root path shim, script mode)
+except ModuleNotFoundError:
+    pass  # module mode (python -m examples.x): cwd already on sys.path
 
 import numpy as np
 

@@ -9,7 +9,10 @@ on-device failure detection + work-redistribution actually works.
 Run:  python examples/simulated_churn.py
 """
 
-import _bootstrap  # noqa: F401  (repo-root path shim)
+try:
+    import _bootstrap  # noqa: F401  (repo-root path shim, script mode)
+except ModuleNotFoundError:
+    pass  # module mode (python -m examples.x): cwd already on sys.path
 
 import numpy as np
 

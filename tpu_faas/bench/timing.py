@@ -19,10 +19,16 @@ import time
 import numpy as np
 
 
-def pipeline_slope_ms(run, problems, n1: int, n2: int) -> float:
+def pipeline_slope_ms(run, problems, n1: int, n2: int, points: int = 5) -> float:
     """Per-execution device time in ms. ``run(problem)`` must return a
     structure whose first leaf is a device array; ``problems`` are cycled to
-    give each execution fresh inputs (defeats value-memoizing transports)."""
+    give each execution fresh inputs (defeats value-memoizing transports).
+
+    Rather than a two-point difference — where ONE jittery timing window
+    (host load, tunnel hiccup) corrupts the slope in either direction, even
+    to negative values — this times ``points`` depths between n1 and n2 and
+    takes the Theil-Sen estimate (median of all pairwise slopes), which
+    tolerates up to ~29% corrupted windows."""
     import jax
 
     def pipelined(n: int) -> float:
@@ -32,7 +38,16 @@ def pipeline_slope_ms(run, problems, n1: int, n2: int) -> float:
         np.asarray(jax.tree_util.tree_leaves(outs[-1])[0])
         return time.perf_counter() - t0
 
-    return (pipelined(n2) - pipelined(n1)) / (n2 - n1) * 1e3
+    depths = sorted({int(round(d)) for d in np.linspace(n1, n2, max(points, 2))})
+    if len(depths) < 2:
+        raise ValueError(f"need two distinct depths, got n1={n1}, n2={n2}")
+    times = [(n, pipelined(n)) for n in depths]
+    slopes = [
+        (tb - ta) / (nb - na)
+        for i, (na, ta) in enumerate(times)
+        for nb, tb in times[i + 1 :]
+    ]
+    return float(np.median(slopes) * 1e3)
 
 
 def transport_floor_ms(reps: int = 5) -> float:

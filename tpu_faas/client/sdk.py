@@ -43,16 +43,25 @@ class TaskHandle:
         deadline = time.monotonic() + timeout
         while True:
             status, payload = self.client.raw_result(self.task_id)
-            if TaskStatus(status).is_terminal():
-                value = deserialize(payload)
-                if status == str(TaskStatus.FAILED):
-                    raise TaskFailedError(self.task_id, value)
+            done, value = _unwrap_terminal(self.task_id, status, payload)
+            if done:
                 return value
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"task {self.task_id} still {status} after {timeout}s"
                 )
             time.sleep(poll_interval)
+
+
+def _unwrap_terminal(task_id: str, status: str, payload: str):
+    """(done, value) for one /result poll — the single place that knows the
+    terminal-status protocol (FAILED carries a serialized exception)."""
+    if not TaskStatus(status).is_terminal():
+        return False, None
+    value = deserialize(payload)
+    if status == str(TaskStatus.FAILED):
+        raise TaskFailedError(task_id, value)
+    return True, value
 
 
 class FaaSClient:
@@ -123,13 +132,12 @@ class FaaSClient:
                 # one round-trip per poll: /result carries both status and
                 # payload (a done()/result() pair would double the requests)
                 status, payload = self.raw_result(handles[i].task_id)
-                if not TaskStatus(status).is_terminal():
-                    continue
-                value = deserialize(payload)
-                if status == str(TaskStatus.FAILED):
-                    raise TaskFailedError(handles[i].task_id, value)
-                results[i] = value
-                pending.discard(i)
+                done, value = _unwrap_terminal(
+                    handles[i].task_id, status, payload
+                )
+                if done:
+                    results[i] = value
+                    pending.discard(i)
             if pending:
                 if time.monotonic() > deadline:
                     raise TimeoutError(
