@@ -159,3 +159,22 @@ def test_many_completed_full_stack():
         disp.stop()
         t.join(timeout=15)
         handle.stop()
+
+
+def test_delete_task_lifecycle(gw):
+    handle, store = gw
+    base = handle.url
+    import requests as rq
+
+    # unknown -> 404
+    assert rq.delete(f"{base}/task/nope").status_code == 404
+    # live task -> 409 (the dispatcher still owns it)
+    store.create_task("t-live", "F", "P")
+    assert rq.delete(f"{base}/task/t-live").status_code == 409
+    # terminal -> deleted, then reads 404
+    store.finish_task("t-live", "COMPLETED", "r")
+    assert rq.delete(f"{base}/task/t-live").json() == {
+        "task_id": "t-live",
+        "deleted": True,
+    }
+    assert rq.get(f"{base}/status/t-live").status_code == 404

@@ -69,3 +69,13 @@ def test_client_map_in_order_and_failure_raises(stack):
     ]
     with pytest.raises(TaskFailedError):
         client.map(failing_task, ["a", "b"])
+
+
+def test_handle_forget_frees_store(stack):
+    client = stack
+    handle = client.submit(client.register(arithmetic), 500)
+    assert handle.result(timeout=30) == arithmetic(500)
+    handle.forget()
+    import requests as rq
+
+    assert rq.get(f"{client.base_url}/status/{handle.task_id}").status_code == 404

@@ -99,3 +99,16 @@ def test_thread_safety_smoke():
         seen += 1
     assert seen == 800
     assert len(s.keys()) == 800
+
+
+def test_first_wins_does_not_resurrect_deleted_record():
+    """A zombie's late result must not recreate a record the client already
+    deleted (DELETE /task): absent counts as frozen on first_wins paths."""
+    store = MemoryStore()
+    store.create_task("t", "F", "P")
+    store.set_status("t", "RUNNING")
+    store.finish_task("t", "COMPLETED", "real")
+    store.delete("t")
+    store.finish_task("t", "FAILED", "zombie-late", first_wins=True)
+    assert store.hgetall("t") == {}
+    store.close()

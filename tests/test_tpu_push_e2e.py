@@ -247,3 +247,24 @@ def test_tpu_push_survives_store_outage_and_defers_results(tmp_path):
         disp.stop()
         t.join(timeout=10)
         gw.stop()
+
+
+def test_stats_endpoint_serves_dispatcher_state():
+    from tpu_faas.store import MemoryStore
+    import requests as rq
+
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=MemoryStore(),
+        max_workers=4, max_pending=8, max_inflight=16, recover_queued=False,
+    )
+    server = disp.serve_stats(port=0)
+    try:
+        port = server.server_address[1]
+        assert rq.get(f"http://127.0.0.1:{port}/healthz").json() == {"ok": True}
+        s = rq.get(f"http://127.0.0.1:{port}/stats").json()
+        assert s["pending"] == 0 and s["workers_registered"] == 0
+        assert s["store_down"] is False
+        assert rq.get(f"http://127.0.0.1:{port}/other").status_code == 404
+    finally:
+        disp.stop()  # shuts down + closes the stats server's socket too
+        disp.socket.close(linger=0)

@@ -37,6 +37,11 @@ class TaskHandle:
     def done(self) -> bool:
         return TaskStatus(self.status()).is_terminal()
 
+    def forget(self) -> None:
+        """Delete this task's store record once terminal (frees the store;
+        the gateway refuses with 409 while the task is still live)."""
+        self.client.delete_task(self.task_id)
+
     def result(self, timeout: float = 60.0, poll_interval: float = 0.01) -> Any:
         """Poll until terminal; return the deserialized value or raise
         :class:`TaskFailedError` with the deserialized exception."""
@@ -90,6 +95,10 @@ class FaaSClient:
         r = self.http.get(f"{self.base_url}/status/{task_id}")
         r.raise_for_status()
         return r.json()["status"]
+
+    def delete_task(self, task_id: str) -> None:
+        r = self.http.delete(f"{self.base_url}/task/{task_id}")
+        r.raise_for_status()
 
     def raw_result(self, task_id: str) -> tuple[str, str]:
         r = self.http.get(f"{self.base_url}/result/{task_id}")

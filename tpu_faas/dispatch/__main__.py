@@ -51,6 +51,10 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument(
         "-d", "--delay", type=float, default=0.0, help="startup delay seconds"
     )
+    ap.add_argument(
+        "--stats-port", type=int, default=0,
+        help="serve GET /stats (JSON) on this port; 0 = off",
+    )
     ns = ap.parse_args(argv)
     if ns.delay:
         time.sleep(ns.delay)
@@ -60,6 +64,8 @@ def main(argv: list[str] | None = None) -> None:
 
         d = LocalDispatcher(num_workers=ns.num_workers, store_url=ns.store)
         log.info("local dispatcher: pool=%d store=%s", ns.num_workers, ns.store)
+        if ns.stats_port:
+            d.serve_stats(ns.stats_port)
         d.start()
         return
 
@@ -88,6 +94,8 @@ def main(argv: list[str] | None = None) -> None:
         kwargs.pop("max_task_retries")
     d = cls(**kwargs)
     log.info("%s dispatcher on %s:%d", ns.mode, ns.ip, ns.port)
+    if ns.stats_port:
+        d.serve_stats(ns.stats_port)
     d.start()
 
 

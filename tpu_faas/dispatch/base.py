@@ -77,6 +77,7 @@ class TaskDispatcher:
         self._announce_backlog: deque[str] = deque()
         self._store_down = False
         self._last_flush_attempt = 0.0
+        self._stats_server = None
 
     # -- intake ------------------------------------------------------------
     def poll_next_task(self) -> PendingTask | None:
@@ -245,9 +246,52 @@ class TaskDispatcher:
         status = self.store.get_status(task_id)
         return status is not None and TaskStatus(status).is_terminal()
 
+    def serve_stats(self, port: int, host: str = "127.0.0.1"):
+        """Serve ``stats()`` as JSON over HTTP (``GET /stats``, plus
+        ``/healthz``) from a daemon thread — the dispatcher-side analog of
+        the gateway's /metrics, so operators can watch queue depth, outage
+        state, and device-tick percentiles without attaching a debugger.
+        Returns the server (port 0 picks a free one —
+        ``server.server_address[1]``); ``stop()`` shuts it down and closes
+        the listening socket."""
+        import json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        dispatcher = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+                if self.path == "/healthz":
+                    body = b'{"ok": true}'
+                elif self.path == "/stats":
+                    body = json.dumps(dispatcher.stats()).encode()
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: object) -> None:
+                pass  # stats polls must not spam the dispatcher log
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(
+            target=server.serve_forever, name="dispatcher-stats", daemon=True
+        ).start()
+        self.log.info("stats endpoint on http://%s:%d/stats", host, server.server_address[1])
+        self._stats_server = server
+        return server
+
     # -- lifecycle ---------------------------------------------------------
     def stop(self) -> None:
         self._stop_event.set()
+        if self._stats_server is not None:
+            self._stats_server.shutdown()
+            self._stats_server.server_close()  # release the bound port now
+            self._stats_server = None
 
     @property
     def stopping(self) -> bool:

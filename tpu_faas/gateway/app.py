@@ -10,6 +10,9 @@ test_client.py:98-126, test_suit.py:39-91):
 - ``GET /status/{task_id}``    -> {"task_id", "status"}
 - ``GET /result/{task_id}``    -> {"task_id", "status", "result"}
 
+Beyond the reference surface: ``DELETE /task/{task_id}`` (drop a terminal
+task's record), ``GET /healthz``, ``GET /metrics``.
+
 Store-side contract on execute (reference old/client_debug.py:40-45): write the
 full task hash (status QUEUED, fn_payload, param_payload, result "None") then
 PUBLISH the task_id on the announce channel.
@@ -31,7 +34,7 @@ from dataclasses import dataclass, field
 
 from aiohttp import web
 
-from tpu_faas.core.task import new_function_id, new_task_id
+from tpu_faas.core.task import TaskStatus, new_function_id, new_task_id
 from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import TickTracer, get_logger
@@ -95,6 +98,7 @@ def make_app(store: TaskStore, channel: str = TASKS_CHANNEL) -> web.Application:
     app.router.add_post("/execute_function", execute_function)
     app.router.add_get("/status/{task_id}", get_status)
     app.router.add_get("/result/{task_id}", get_result)
+    app.router.add_delete("/task/{task_id}", delete_task)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     return app
@@ -157,6 +161,23 @@ async def get_result(request: web.Request) -> web.Response:
     return web.json_response(
         {"task_id": task_id, "status": status, "result": result}
     )
+
+
+async def delete_task(request: web.Request) -> web.Response:
+    """Drop a finished task's record (result + payloads). Beyond the
+    reference's surface (its store grows until FLUSHDB): clients that have
+    consumed a result can free the store, which also keeps the dispatcher's
+    stranded-task rescans proportional to LIVE work. Deleting a QUEUED or
+    RUNNING task is refused — the dispatcher still owns it."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+    task_id = request.match_info["task_id"]
+    status = await _run_blocking(ctx.store.get_status, task_id)
+    if status is None:
+        return _json_error(404, f"unknown task_id {task_id!r}")
+    if not TaskStatus(status).is_terminal():
+        return _json_error(409, f"task {task_id!r} is {status}, not terminal")
+    await _run_blocking(ctx.store.delete, task_id)
+    return web.json_response({"task_id": task_id, "deleted": True})
 
 
 async def healthz(request: web.Request) -> web.Response:

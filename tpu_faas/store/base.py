@@ -159,7 +159,11 @@ class TaskStore(abc.ABC):
         concurrent writer to race with."""
         if first_wins:
             current = self.get_status(task_id)
-            if current is not None and TaskStatus(current).is_terminal():
+            # absent counts as frozen too: a record deleted by the client
+            # (DELETE /task after consuming the result) must not be
+            # resurrected as a partial status+result hash by a zombie's
+            # late write
+            if current is None or TaskStatus(current).is_terminal():
                 return
         self.hset(task_id, {FIELD_STATUS: str(status), FIELD_RESULT: result})
 

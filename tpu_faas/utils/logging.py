@@ -10,6 +10,7 @@ enough to leave on in production loops.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from collections import deque
 from contextlib import contextmanager
@@ -35,6 +36,10 @@ class TickTracer:
     def __init__(self, capacity: int = 4096) -> None:
         self._spans: dict[str, deque[float]] = {}
         self._capacity = capacity
+        # summary() may be called from a stats/metrics thread while the hot
+        # loop records; unlocked dict/deque iteration would intermittently
+        # raise "mutated during iteration"
+        self._lock = threading.Lock()
 
     @contextmanager
     def span(self, name: str):
@@ -42,17 +47,19 @@ class TickTracer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self._spans.setdefault(
-                name, deque(maxlen=self._capacity)
-            ).append(dt)
+            self.record(name, time.perf_counter() - t0)
 
     def record(self, name: str, seconds: float) -> None:
-        self._spans.setdefault(name, deque(maxlen=self._capacity)).append(seconds)
+        with self._lock:
+            self._spans.setdefault(
+                name, deque(maxlen=self._capacity)
+            ).append(seconds)
 
     def summary(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            snapshot = {name: list(xs) for name, xs in self._spans.items()}
         out: dict[str, dict[str, float]] = {}
-        for name, xs in self._spans.items():
+        for name, xs in snapshot.items():
             if not xs:
                 continue
             data = sorted(xs)
