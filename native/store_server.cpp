@@ -424,6 +424,17 @@ class Server {
       reply_simple(c.outbuf, "PONG");
     } else if (name == "SELECT") {
       reply_simple(c.outbuf, "OK");
+    } else if (name == "INFO") {
+      // Redis-style ops introspection, same line format as the Python server
+      size_t n_subs = 0;
+      for (const auto& [ch, fds] : store_.subs) n_subs += fds.size();
+      std::string body = "server:tpu-faas-store-native";
+      body += "\nkeys:" + std::to_string(store_.hashes.size());
+      body += "\nsubscribers:" + std::to_string(n_subs);
+      body += "\nchannels:" + std::to_string(store_.subs.size());
+      body += "\ndirty:" + std::to_string(dirty_ ? 1 : 0);
+      body += "\nsnapshot_path:" + snapshot_path_;
+      reply_bulk(c.outbuf, body);
     } else if (name == "HSET") {
       if (argc < 3 || argc % 2 == 0) {
         reply_error(c.outbuf, "wrong number of arguments for HSET");

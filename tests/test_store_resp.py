@@ -5,6 +5,7 @@ import threading
 import pytest
 
 from tpu_faas.store import resp
+from tpu_faas.store.client import RespStore
 from tpu_faas.store.launch import make_store, start_store_thread
 
 
@@ -153,3 +154,44 @@ def test_make_store_memory_shared():
     c = make_store("memory://fresh")
     assert c.hget("k", "f") is None
     a.flush()
+
+
+def _start_info_server(kind: str, snapshot_path: str):
+    if kind == "python":
+        from tpu_faas.store.launch import start_store_thread
+
+        return start_store_thread(snapshot_path=snapshot_path)
+    from tpu_faas.store.native import start_native_store
+
+    return start_native_store(snapshot_path=snapshot_path)
+
+
+@pytest.mark.parametrize("kind", ["python", "native"])
+def test_info_command(kind, tmp_path):
+    """INFO returns the same "key:value" introspection lines from the Python
+    and native servers; counters reflect live state."""
+    try:
+        handle = _start_info_server(kind, str(tmp_path / f"{kind}.snap"))
+    except Exception as exc:
+        if kind == "native":
+            pytest.skip(f"native store unavailable: {exc}")
+        raise
+    c = None
+    sub = None
+    try:
+        c = RespStore(port=handle.port)
+        c.hset("k1", {"f": "v"})
+        c.hset("k2", {"f": "v"})
+        sub = c.subscribe("tasks")
+        info = c.info()
+        assert info["server"] == f"tpu-faas-store-{kind}"
+        assert info["keys"] == "2", info
+        assert info["subscribers"] == "1", info
+        assert info["dirty"] == "1", info
+        assert info["snapshot_path"].endswith(".snap"), info
+    finally:
+        if sub is not None:
+            sub.close()
+        if c is not None:
+            c.close()
+        handle.stop()

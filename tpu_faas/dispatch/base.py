@@ -242,9 +242,16 @@ class TaskDispatcher:
             "announce_backlog": len(self._announce_backlog),
         }
 
-    def task_is_terminal(self, task_id: str) -> bool:
+    def task_is_finished(self, task_id: str) -> bool:
+        """Re-dispatch guard: True when a reclaimed task must NOT be sent
+        out again — its record is terminal, or GONE. Absent counts as
+        finished: the only way a tracked task's record disappears is the
+        client consuming its result and deleting it (DELETE /task), and
+        re-dispatching then would re-run the side effects and resurrect the
+        deleted record as a partial status-only hash (the same hole
+        finish_task's first_wins guard closes on the write side)."""
         status = self.store.get_status(task_id)
-        return status is not None and TaskStatus(status).is_terminal()
+        return status is None or TaskStatus(status).is_terminal()
 
     def serve_stats(self, port: int, host: str = "127.0.0.1"):
         """Serve ``stats()`` as JSON over HTTP (``GET /stats``, plus

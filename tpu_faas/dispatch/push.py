@@ -266,7 +266,7 @@ class PushDispatcher(TaskDispatcher):
             # a reclaimed task may have been finished meanwhile by its zombie
             # worker; re-dispatching it would mark a terminal record RUNNING
             # and re-run it — drop it instead
-            if self.task_is_terminal(task.task_id):
+            if self.task_is_finished(task.task_id):
                 continue
             return task
         return self.poll_next_task()

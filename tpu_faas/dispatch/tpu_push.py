@@ -302,7 +302,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     still_pending.append(task)
                     restore_from = idx + 1
                     continue
-                if task.retries and self.task_is_terminal(task.task_id):
+                if task.retries and self.task_is_finished(task.task_id):
                     # reclaimed task finished meanwhile by its zombie worker:
                     # re-dispatching would regress the record to RUNNING
                     self.task_retries.pop(task.task_id, None)

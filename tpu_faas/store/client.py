@@ -249,6 +249,17 @@ class RespStore(TaskStore):
     def ping(self) -> bool:
         return self._command("PING") == "PONG"
 
+    def info(self) -> dict[str, str]:
+        """Server introspection: parse INFO's "key:value" lines (both the
+        Python and native servers emit the same format)."""
+        raw = self._command("INFO") or ""
+        out: dict[str, str] = {}
+        for line in raw.split("\n"):
+            key, sep, value = line.partition(":")
+            if sep:
+                out[key] = value
+        return out
+
     def close(self) -> None:
         self._closed = True  # before taking the lock: fail fast either way
         with self._lock:

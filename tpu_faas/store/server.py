@@ -165,6 +165,18 @@ class StoreServer:
             writer.write(resp.encode_simple("PONG"))
         elif name == "SELECT":
             writer.write(resp.encode_simple("OK"))
+        elif name == "INFO":
+            # Redis-style ops introspection: "key:value" lines in one bulk
+            n_subs = sum(len(ws) for ws in st.subs.values())
+            lines = [
+                "server:tpu-faas-store-python",
+                f"keys:{len(st.hashes)}",
+                f"subscribers:{n_subs}",
+                f"channels:{len(st.subs)}",
+                f"dirty:{int(self._dirty)}",
+                f"snapshot_path:{self.snapshot_path or ''}",
+            ]
+            writer.write(resp.encode_bulk("\n".join(lines)))
         elif name == "HSET":
             if len(args) < 3 or len(args) % 2 == 0:
                 writer.write(resp.encode_error("wrong number of arguments for HSET"))
