@@ -1,0 +1,87 @@
+"""Graceful worker drain: SIGTERM finishes in-flight tasks and deregisters,
+instead of dropping them for heartbeat-timeout purge + re-dispatch to
+recover. time_to_expire is set high in these tests, so if drain were broken
+the killed worker's tasks could not complete within the poll timeout — the
+crash-recovery path cannot silently stand in for the drain path.
+
+(The reference has no graceful shutdown at all: its workers die mid-task and
+its dispatcher loses the work, SURVEY §5.3.)
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import sleep_task
+from tests.test_tpu_push_e2e import _make_dispatcher
+from tests.test_workers_e2e import _spawn_worker, stack
+
+
+def _drain_scenario(client: FaaSClient, workers: list) -> None:
+    """Submit slow tasks, SIGTERM worker[0] once tasks are RUNNING (i.e. the
+    workers are fully up — a signal during interpreter startup is the crash
+    path, not the drain path), require every result AND a clean worker exit
+    well before any timeout-based recovery."""
+    fid = client.register(sleep_task)
+    handles = [client.submit(fid, 2.0) for _ in range(8)]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        running = sum(h.status() == "RUNNING" for h in handles)
+        if running >= 3:  # both 2-proc workers necessarily hold tasks
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("tasks never started RUNNING")
+    workers[0].send_signal(signal.SIGTERM)
+    for h in handles:
+        assert h.result(timeout=40.0) == 2.0
+    assert workers[0].wait(timeout=10.0) == 0
+
+
+def test_push_hb_graceful_drain():
+    with stack(
+        "push", n_workers=2, n_procs=2, heartbeat=True, time_to_expire=60.0
+    ) as (client, workers, disp):
+        _drain_scenario(client, workers)
+        # drained worker's record is gone without any purge
+        assert len(disp.workers) == 1
+
+
+def test_tpu_push_graceful_drain():
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url, time_to_expire=60.0)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    try:
+        _drain_scenario(FaaSClient(gw.url), workers)
+        assert disp.arrays.n_inflight == 0
+        # exactly one row (the drained worker's) had its capacity zeroed by
+        # the DEREGISTER handler; the survivor keeps its 2 processes
+        rows = list(disp.arrays.worker_ids.values())
+        procs = [int(disp.arrays.worker_procs[r]) for r in rows]
+        assert sorted(procs) == [0, 2], procs
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_pull_graceful_drain():
+    with stack("pull", n_workers=2, n_procs=2) as (client, workers, _disp):
+        _drain_scenario(client, workers)

@@ -66,12 +66,16 @@ class PullDispatcher(TaskDispatcher):
                     n_results += 1
                 # READY carries no state; any message type falls through to
                 # the mandatory reply — which MUST go out even mid-outage,
-                # or the REP/REQ state machine wedges every worker
-                try:
-                    task = self.poll_next_task()
-                except STORE_OUTAGE_ERRORS as exc:
-                    self.note_store_outage(exc, pause=0)
+                # or the REP/REQ state machine wedges every worker. A
+                # draining worker flags no_task: its reply must be WAIT.
+                if data.get("no_task"):
                     task = None
+                else:
+                    try:
+                        task = self.poll_next_task()
+                    except STORE_OUTAGE_ERRORS as exc:
+                        self.note_store_outage(exc, pause=0)
+                        task = None
                 if task is not None:
                     self.mark_running_safe(task.task_id)
                     self.socket.send(

@@ -167,6 +167,19 @@ class PushDispatcher(TaskDispatcher):
             else:
                 return
         rec.last_heartbeat = now
+        if msg_type == m.DEREGISTER:
+            # graceful drain: stop assigning to this worker; its in-flight
+            # results still arrive below, and the record is dropped as soon
+            # as the last one lands (or by purge if it dies mid-drain)
+            rec.num_processes = 0
+            rec.free_processes = 0
+            self._remove_free(wid)
+            self.log.info(
+                "worker %r draining (%d in flight)", wid, len(rec.inflight)
+            )
+            if not rec.inflight:
+                self.workers.pop(wid, None)
+            return
         if msg_type == m.RESULT:
             task_id = data["task_id"]
             # suspicious = a second result is possible: the sender doesn't
@@ -186,6 +199,11 @@ class PushDispatcher(TaskDispatcher):
             if task_id in rec.inflight:
                 rec.inflight.discard(task_id)
                 rec.inflight_retries.pop(task_id, None)
+                if rec.num_processes == 0:
+                    # draining worker: last in-flight result drops the record
+                    if not rec.inflight:
+                        self.workers.pop(wid, None)
+                    return
                 rec.free_processes = min(
                     rec.free_processes + 1, rec.num_processes
                 )
@@ -262,12 +280,17 @@ class PushDispatcher(TaskDispatcher):
     # -- dispatch ----------------------------------------------------------
     def _next_task(self) -> PendingTask | None:
         while self.requeue:
-            task = self.requeue.popleft()
+            # peek, don't pop: the status check can raise mid store outage,
+            # and a popped reclaimed task would be lost forever (its record
+            # is RUNNING — no rescan ever re-adopts it)
+            task = self.requeue[0]
             # a reclaimed task may have been finished meanwhile by its zombie
             # worker; re-dispatching it would mark a terminal record RUNNING
             # and re-run it — drop it instead
             if self.task_is_finished(task.task_id):
+                self.requeue.popleft()
                 continue
+            self.requeue.popleft()
             return task
         return self.poll_next_task()
 
@@ -278,7 +301,16 @@ class PushDispatcher(TaskDispatcher):
             wid = self._pick_worker()
             if wid is None:
                 break
-            task = self._next_task()
+            try:
+                task = self._next_task()
+            except STORE_OUTAGE_ERRORS:
+                # restore the picked worker before surfacing the outage, or
+                # an idle worker vanishes from rotation until its next message
+                if self.process_lb:
+                    self.free_procs.appendleft(wid)
+                else:
+                    self._add_free(wid, front=True)
+                raise
             if task is None:
                 # nothing pending: put back exactly what was popped
                 if self.process_lb:

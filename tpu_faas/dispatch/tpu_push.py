@@ -188,6 +188,15 @@ class TpuPushDispatcher(TaskDispatcher):
             a.heartbeat(wid)
         elif msg_type == m.RECONNECT:
             a.reconnect(wid, int(data.get("free_processes", 0)))
+        elif msg_type == m.DEREGISTER:
+            # graceful drain: zero the row's capacity so placement skips it;
+            # in-flight results keep arriving (the row stays live while it
+            # heartbeats) and the purge reaps the row once the worker exits
+            row = a.worker_ids.get(wid)
+            if row is not None:
+                a.worker_free[row] = 0
+                a.worker_procs[row] = 0
+                self.log.info("worker row %d draining", int(row))
 
     def stats(self) -> dict:
         a = self.arrays

@@ -9,10 +9,13 @@ Message vocabulary:
 
 worker -> dispatcher:
     REGISTER   data: worker_id (pull) | num_processes (push)
-    RESULT     data: task_id, status, result
+    RESULT     data: task_id, status, result [, no_task=True while draining
+               (pull): the mandatory reply must be WAIT, never a new task]
     READY      (pull only) data: worker_id
     HEARTBEAT  (push hb) data: {}
     RECONNECT  (push hb) data: free_processes
+    DEREGISTER (push) data: {} — graceful drain: stop assigning to me; my
+               in-flight results still follow, then I exit
 
 dispatcher -> worker:
     TASK       data: task_id, fn_payload, param_payload
@@ -25,6 +28,7 @@ from __future__ import annotations
 from tpu_faas.core.serialize import deserialize, serialize
 
 REGISTER = "register"
+DEREGISTER = "deregister"
 RESULT = "result"
 READY = "ready"
 HEARTBEAT = "heartbeat"

@@ -50,9 +50,16 @@ class PullWorker:
         self.socket.setsockopt(zmq.REQ_CORRELATE, 1)
         self.socket.connect(dispatcher_url)
         self._stopping = False
+        self._draining = False
 
     def stop(self) -> None:
         self._stopping = True
+
+    def drain(self) -> None:
+        """Graceful shutdown: stop asking for work (and flag result messages
+        ``no_task`` so their mandatory replies are WAIT, never a new task),
+        ship what's in flight, then exit."""
+        self._draining = True
 
     # -- one REQ/REP transaction ------------------------------------------
     def _transact(self, msg_type: str, **data: object) -> None:
@@ -75,19 +82,23 @@ class PullWorker:
             while not self._stopping:
                 time.sleep(self.delay)
                 # ship every finished result; each reply may carry new work
+                # (unless draining, where no_task forces a WAIT reply)
                 for res in self.pool.drain():
                     self._transact(
                         m.RESULT,
                         task_id=res.task_id,
                         status=res.status,
                         result=res.result,
+                        no_task=self._draining,
                     )
                     shipped += 1
                 # ask for work while slots are free
-                if self.pool.free > 0:
+                if not self._draining and self.pool.free > 0:
                     self._transact(m.READY, worker_id=self.worker_id)
                 if max_tasks is not None and shipped >= max_tasks:
                     break
+                if self._draining and self.pool.busy == 0:
+                    break  # REQ/REP is synchronous: nothing can be in flight
         finally:
             self.pool.close()
             self.socket.close(linger=0)
@@ -103,7 +114,11 @@ def main(argv: list[str] | None = None) -> None:
     log.info(
         "pull worker: %d processes -> %s", ns.num_processes, ns.dispatcher_url
     )
-    PullWorker(ns.num_processes, ns.dispatcher_url, ns.delay).run()
+    from tpu_faas.worker.drain import install_drain_signals
+
+    worker = PullWorker(ns.num_processes, ns.dispatcher_url, ns.delay)
+    install_drain_signals(worker)
+    worker.run()
 
 
 if __name__ == "__main__":

@@ -52,9 +52,17 @@ class PushWorker:
         self.poller = zmq.Poller()
         self.poller.register(self.socket, zmq.POLLIN)
         self._stopping = False
+        self._draining = False
 
     def stop(self) -> None:
         self._stopping = True
+
+    def drain(self) -> None:
+        """Graceful shutdown: deregister (dispatcher stops assigning), keep
+        serving until every in-flight task's result has shipped, then exit.
+        Contrast with a hard kill, where in-flight tasks are recovered only
+        by heartbeat-timeout purge + re-dispatch."""
+        self._draining = True
 
     def register(self) -> None:
         self.socket.send(m.encode(m.REGISTER, num_processes=self.num_processes))
@@ -67,10 +75,25 @@ class PushWorker:
         self.pool.warmup()
         self.register()
         last_heartbeat = time.monotonic()
+        deregistered = False
+        quiet_since: float | None = None
         try:
             while not self._stopping:
+                if self._draining and not deregistered:
+                    self.socket.send(m.encode(m.DEREGISTER))
+                    deregistered = True
+                    log.info(
+                        "draining: %d task(s) in flight", self.pool.busy
+                    )
                 now = time.monotonic()
-                if self.heartbeat and now - last_heartbeat >= self.heartbeat_period:
+                # no heartbeats once deregistered: they would make the
+                # dispatcher's unknown-sender handshake resurrect the record
+                # this drain just retired
+                if (
+                    self.heartbeat
+                    and not deregistered
+                    and now - last_heartbeat >= self.heartbeat_period
+                ):
                     self.socket.send(m.encode(m.HEARTBEAT))
                     last_heartbeat = now  # the fix for reference :61-62
                 events = dict(self.poller.poll(self.poll_timeout_ms))
@@ -89,10 +112,14 @@ class PushWorker:
                                 data["param_payload"],
                             )
                         elif msg_type == m.RECONNECT:
+                            # a draining worker reports zero capacity: it
+                            # must not be handed new work
                             self.socket.send(
                                 m.encode(
                                     m.RECONNECT,
-                                    free_processes=self.pool.free,
+                                    free_processes=(
+                                        0 if self._draining else self.pool.free
+                                    ),
                                 )
                             )
                 for res in self.pool.drain():
@@ -107,6 +134,17 @@ class PushWorker:
                     shipped += 1
                 if max_tasks is not None and shipped >= max_tasks:
                     break
+                if deregistered and self.pool.busy == 0:
+                    # linger briefly: a TASK dispatched before the
+                    # dispatcher processed our DEREGISTER may still be on
+                    # the wire (anything later falls back to the normal
+                    # purge + re-dispatch recovery)
+                    if quiet_since is None:
+                        quiet_since = now
+                    elif now - quiet_since >= 0.25:
+                        break
+                else:
+                    quiet_since = None
         finally:
             self.pool.close()
             self.socket.close(linger=0)
@@ -128,7 +166,11 @@ def main(argv: list[str] | None = None) -> None:
         ns.dispatcher_url,
         ns.hb,
     )
-    PushWorker(ns.num_processes, ns.dispatcher_url, ns.hb, ns.hb_period).run()
+    from tpu_faas.worker.drain import install_drain_signals
+
+    worker = PushWorker(ns.num_processes, ns.dispatcher_url, ns.hb, ns.hb_period)
+    install_drain_signals(worker)
+    worker.run()
 
 
 if __name__ == "__main__":
