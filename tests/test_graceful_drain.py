@@ -85,3 +85,24 @@ def test_tpu_push_graceful_drain():
 def test_pull_graceful_drain():
     with stack("pull", n_workers=2, n_procs=2) as (client, workers, _disp):
         _drain_scenario(client, workers)
+
+
+def test_push_hb_drain_longer_than_time_to_expire_does_not_purge():
+    """A drain outlasting time_to_expire must NOT be purged: the draining
+    worker keeps heartbeating while tasks are in flight (silence would mean
+    false purge + duplicate execution — the churn drain exists to avoid)."""
+    with stack(
+        "push", n_workers=2, n_procs=2, heartbeat=True, time_to_expire=1.5
+    ) as (client, workers, disp):
+        fid = client.register(sleep_task)
+        handles = [client.submit(fid, 4.0) for _ in range(8)]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sum(h.status() == "RUNNING" for h in handles) >= 3:
+                break
+            time.sleep(0.05)
+        workers[0].send_signal(signal.SIGTERM)
+        for h in handles:
+            assert h.result(timeout=40.0) == 4.0
+        assert workers[0].wait(timeout=10.0) == 0
+        assert disp.n_purged == 0, "draining worker was falsely purged"

@@ -79,3 +79,12 @@ def test_handle_forget_frees_store(stack):
     import requests as rq
 
     assert rq.get(f"{client.base_url}/status/{handle.task_id}").status_code == 404
+
+
+def test_submit_many_batch_endpoint(stack):
+    client = stack
+    fid = client.register(arithmetic)
+    handles = client.submit_many(fid, [((n,), {}) for n in range(50, 70)])
+    assert [h.result(timeout=60) for h in handles] == [
+        arithmetic(n) for n in range(50, 70)
+    ]

@@ -195,3 +195,62 @@ def test_info_command(kind, tmp_path):
         if c is not None:
             c.close()
         handle.stop()
+
+
+@pytest.mark.parametrize("kind", ["python", "native"])
+def test_pipeline_one_round_trip_semantics(kind, tmp_path):
+    """N commands, one write, N in-order replies; error replies come back
+    in place without masking the rest — on BOTH servers."""
+    try:
+        handle = _start_info_server(kind, str(tmp_path / "pl.snap"))
+    except Exception as exc:
+        if kind == "native":
+            pytest.skip(f"native store unavailable: {exc}")
+        raise
+    c = RespStore(port=handle.port)
+    try:
+        replies = c.pipeline(
+            [
+                ("HSET", "pk", "f", "1"),
+                ("HGET", "pk", "f"),
+                ("BOGUS-CMD",),
+                ("HGET", "pk", "f"),
+            ]
+        )
+        assert replies[0] == 1  # fields added
+        assert replies[1] == "1"
+        assert isinstance(replies[2], resp.RespError)
+        assert replies[3] == "1"
+        assert c.pipeline([]) == []
+    finally:
+        c.close()
+        handle.stop()
+
+
+def test_create_tasks_pipelined_announces_after_writes():
+    """Batch create: every hash readable, every announce delivered, and no
+    announce precedes its hash (subscriber sees ids whose payloads exist)."""
+    handle = start_store_thread()
+    c = RespStore(port=handle.port)
+    reader = RespStore(port=handle.port)
+    try:
+        sub = reader.subscribe("tasks")
+        c.create_tasks([(f"bt{i}", f"F{i}", f"P{i}") for i in range(20)])
+        seen = []
+        for _ in range(20):
+            msg = sub.get_message(timeout=5.0)
+            assert msg is not None
+            # the announced task's payloads are already readable
+            assert reader.get_payloads(msg) == (
+                f"F{msg[2:]}", f"P{msg[2:]}"
+            )
+            seen.append(msg)
+        assert sorted(seen) == sorted(f"bt{i}" for i in range(20))
+        assert reader.hget_many([f"bt{i}" for i in range(20)], "status") == [
+            "QUEUED"
+        ] * 20
+        sub.close()
+    finally:
+        c.close()
+        reader.close()
+        handle.stop()

@@ -114,6 +114,24 @@ class FaaSClient:
         payload = pack_params(*args, **kwargs)
         return TaskHandle(self, self.execute_payload(function_id, payload))
 
+    def submit_many(
+        self, function_id: str, params_list: list[tuple[tuple, dict]]
+    ) -> list[TaskHandle]:
+        """Batch submit over ONE HTTP call (+ one pipelined store round
+        trip): ``params_list`` holds (args, kwargs) pairs. N single submits
+        cost N round trips on both hops — this is the bulk path."""
+        r = self.http.post(
+            f"{self.base_url}/execute_batch",
+            json={
+                "function_id": function_id,
+                "payloads": [
+                    pack_params(*args, **kwargs) for args, kwargs in params_list
+                ],
+            },
+        )
+        r.raise_for_status()
+        return [TaskHandle(self, tid) for tid in r.json()["task_ids"]]
+
     def run(
         self, fn: Callable, *args: Any, timeout: float = 60.0, **kwargs: Any
     ) -> Any:

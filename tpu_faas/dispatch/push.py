@@ -105,6 +105,7 @@ class PushDispatcher(TaskDispatcher):
         self.requeue: deque[PendingTask] = deque()
         self.n_dispatched = 0
         self.n_results = 0
+        self.n_purged = 0
 
     # -- free-capacity bookkeeping ----------------------------------------
     def _add_free(self, wid: bytes, front: bool = False) -> None:
@@ -269,6 +270,7 @@ class PushDispatcher(TaskDispatcher):
             self.workers.pop(wid)
             self._remove_free(wid)
             self.requeue.extend(reclaims)
+            self.n_purged += 1
             if rec.inflight:
                 self.log.warning(
                     "purged %r; re-queued %d in-flight tasks",

@@ -88,6 +88,7 @@ class TpuPushDispatcher(TaskDispatcher):
         self.task_retries: dict[str, int] = {}
         self.n_results = 0
         self.n_dispatched = 0
+        self.n_purged = 0
         #: seconds between stranded-task rescans while running (0 disables);
         #: the startup scan below always runs when recover_queued is set
         self.rescan_period = rescan_period if recover_queued else 0.0
@@ -114,16 +115,19 @@ class TpuPushDispatcher(TaskDispatcher):
         # tasks whose (terminal) writes sit in the deferred buffer still read
         # as QUEUED/RUNNING from the store — adopting them would re-execute
         known.update(item[0] for item in self.deferred_results)
+        candidates = [
+            key
+            for key in self.store.keys()
+            if key not in known and a.inflight_owner(key) is None
+        ]
+        # status-only probe first, pipelined: the store holds every task
+        # that ever ran (plus function-registry hashes), so per-key round
+        # trips — let alone full HGETALLs — would make the rescan cost grow
+        # with history and stall the serve loop past heartbeat deadlines
+        statuses = self.store.hget_many(candidates, FIELD_STATUS)
         n = 0
-        for key in self.store.keys():
-            if key in known or a.inflight_owner(key) is not None:
-                continue
-            # status-only probe first: the store holds every task that ever
-            # ran (plus function-registry hashes), and pulling each one's
-            # full fn/param payloads over HGETALL just to read the status
-            # would make the rescan cost grow with history, stalling the
-            # serve loop long enough to miss heartbeats
-            if self.store.hget(key, FIELD_STATUS) != str(TaskStatus.QUEUED):
+        for key, status in zip(candidates, statuses):
+            if status != str(TaskStatus.QUEUED):
                 continue
             fields = self.store.hgetall(key)
             if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
@@ -204,6 +208,7 @@ class TpuPushDispatcher(TaskDispatcher):
             **super().stats(),
             "n_dispatched": self.n_dispatched,
             "n_results": self.n_results,
+            "n_purged": self.n_purged,
             "pending": len(self.pending),
             "inflight": a.n_inflight,
             "workers_registered": len(a.worker_ids),
@@ -301,6 +306,7 @@ class TpuPushDispatcher(TaskDispatcher):
             for row in np.flatnonzero(np.asarray(out.purged)):
                 self.log.warning("purged worker row %d", int(row))
                 a.deactivate(int(row))
+                self.n_purged += 1
 
             # act: send assignments
             assignment = np.asarray(out.assignment)[: len(batch)]

@@ -123,6 +123,24 @@ class TaskStore(abc.ABC):
         )
         self.publish(channel, task_id)
 
+    def hget_many(self, keys: list[str], field: str) -> list[str | None]:
+        """One field from many hashes. Default: a loop (one round trip per
+        key); the RESP client overrides with a pipelined single round trip —
+        this is what keeps the dispatcher's stranded-task rescan cheap as
+        task history grows."""
+        return [self.hget(k, field) for k in keys]
+
+    def create_tasks(
+        self,
+        tasks: list[tuple[str, str, str]],  # (task_id, fn_payload, params)
+        channel: str = TASKS_CHANNEL,
+    ) -> None:
+        """Batch create_task. Default: a loop; the RESP client pipelines all
+        writes + announces into one round trip (the gateway's batch-submit
+        path)."""
+        for task_id, fn_payload, param_payload in tasks:
+            self.create_task(task_id, fn_payload, param_payload, channel)
+
     def get_payloads(self, task_id: str) -> tuple[str, str]:
         """Fetch (fn_payload, param_payload) in one round-trip —
         dispatcher-side read (reference task_dispatcher.py:48-52 does two

@@ -18,7 +18,7 @@ import threading
 from typing import Mapping
 
 from tpu_faas.store import resp
-from tpu_faas.store.base import Subscription, TaskStore
+from tpu_faas.store.base import TASKS_CHANNEL, Subscription, TaskStore
 
 #: Commands that must not be replayed after an ambiguous connection loss —
 #: replaying a PUBLISH announces (and therefore dispatches) a task twice, and
@@ -49,6 +49,13 @@ class _Conn:
             if not data:
                 raise ConnectionError("store connection closed")
             self.parser.feed(data)
+
+    def send_many(self, commands) -> None:
+        """RESP pipelining: every command in one write; replies follow in
+        order."""
+        self.sock.sendall(
+            b"".join(resp.encode_command(*c) for c in commands)
+        )
 
     def command(self, *parts: str | bytes | int):
         self.send(*parts)
@@ -207,6 +214,37 @@ class RespStore(TaskStore):
                     raise
                 return conn.command(*parts)
 
+    def pipeline(self, commands: list[tuple]) -> list:
+        """Run many commands over one round trip (RESP pipelining) and
+        return their replies in order; error replies come back as
+        :class:`resp.RespError` values in place rather than raising, so one
+        bad command cannot mask the other N-1 results.
+
+        No automatic retry: after a mid-pipeline connection loss there is no
+        telling which commands were applied, so the connection is dropped
+        and the outage surfaces to the caller."""
+        if not commands:
+            return []
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("store client is closed")
+            if self._conn is None:
+                self._conn = _Conn(self.host, self.port)
+            conn = self._conn
+            try:
+                conn.send_many(commands)
+                out: list = []
+                for _ in commands:
+                    try:
+                        out.append(conn.recv_reply())
+                    except resp.RespError as exc:
+                        out.append(exc)
+                return out
+            except (ConnectionError, TimeoutError):
+                conn.close()
+                self._conn = None
+                raise
+
     # -- raw hash ops ------------------------------------------------------
     def hset(self, key: str, fields: Mapping[str, str]) -> None:
         flat: list[str] = []
@@ -223,6 +261,42 @@ class RespStore(TaskStore):
 
     def delete(self, key: str) -> None:
         self._command("DEL", key)
+
+    # -- pipelined batch ops ----------------------------------------------
+    def hget_many(self, keys, field: str):
+        return self.pipeline([("HGET", k, field) for k in keys])
+
+    def create_tasks(self, tasks, channel: str = TASKS_CHANNEL) -> None:
+        from tpu_faas.core.task import (
+            FIELD_FN,
+            FIELD_PARAMS,
+            FIELD_RESULT,
+            FIELD_STATUS,
+            TaskStatus,
+        )
+
+        commands: list[tuple] = []
+        for task_id, fn_payload, param_payload in tasks:
+            commands.append(
+                (
+                    "HSET", task_id,
+                    FIELD_STATUS, str(TaskStatus.QUEUED),
+                    FIELD_FN, fn_payload,
+                    FIELD_PARAMS, param_payload,
+                    FIELD_RESULT, "None",
+                )
+            )
+        # announces AFTER every hash write: a dispatcher must never receive
+        # an announce for a task whose payloads aren't readable yet
+        for task_id, _, _ in tasks:
+            commands.append(("PUBLISH", channel, task_id))
+        replies = self.pipeline(commands)
+        # pipeline() returns error replies in place; swallowing one here
+        # would hand the caller task_ids for tasks that were never written
+        # (announced ghosts) or never announced (stranded until a rescan)
+        errors = [r for r in replies if isinstance(r, resp.RespError)]
+        if errors:
+            raise errors[0]
 
     def keys(self) -> list[str]:
         return self._command("KEYS", "*")

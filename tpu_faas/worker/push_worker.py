@@ -86,12 +86,17 @@ class PushWorker:
                         "draining: %d task(s) in flight", self.pool.busy
                     )
                 now = time.monotonic()
-                # no heartbeats once deregistered: they would make the
-                # dispatcher's unknown-sender handshake resurrect the record
-                # this drain just retired
+                # Keep heartbeating WHILE TASKS ARE IN FLIGHT even after
+                # deregistering — going silent would let a drain longer than
+                # time_to_expire trigger a false purge + duplicate execution
+                # (the dispatcher's record still exists until the last
+                # result lands, so these heartbeats only refresh it). Only
+                # once the pool is empty do heartbeats stop: the record is
+                # dropped with the final result, and a further heartbeat
+                # would make the unknown-sender handshake resurrect it.
                 if (
                     self.heartbeat
-                    and not deregistered
+                    and (not deregistered or self.pool.busy > 0)
                     and now - last_heartbeat >= self.heartbeat_period
                 ):
                     self.socket.send(m.encode(m.HEARTBEAT))
