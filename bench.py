@@ -96,11 +96,11 @@ def main() -> None:
     from tpu_faas.bench.timing import pipeline_slope_ms
 
     n1, n2 = 10, 60
-    # median of 3 Theil-Sen slope estimates (each itself robust to jittery
+    # median of 5 Theil-Sen slope estimates (each itself robust to jittery
     # timing windows) — a shared machine contaminates single measurements in
     # both directions
     reps = [
-        pipeline_slope_ms(tick, batches[1:], n1, n2) for _ in range(3)
+        pipeline_slope_ms(tick, batches[1:], n1, n2) for _ in range(5)
     ]
     tick_ms = float(np.median(reps))
     print(

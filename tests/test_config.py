@@ -1,0 +1,46 @@
+"""Config system (utils/config.py): defaults, INI override, env override —
+loaded explicitly, no import-time side effects, no dead keys (the reference
+loads config.ini at import with a cwd change and then hard-codes half the
+values anyway, SURVEY §5.6)."""
+
+from __future__ import annotations
+
+from tpu_faas.utils.config import Config
+
+
+def test_defaults():
+    cfg = Config.load(ini_path=None, env=False)
+    assert cfg.time_to_expire == 10.0  # reference config.ini:4
+    assert cfg.tasks_channel == "tasks"  # reference config.ini:7
+    assert cfg.dispatcher_ip == "0.0.0.0"
+
+
+def test_ini_override(tmp_path):
+    ini = tmp_path / "cfg.ini"
+    ini.write_text(
+        "[dispatcher]\n"
+        "time_to_expire = 2.5\n"
+        "dispatcher_port = 7777\n"
+        "[redis]\n"
+        "tasks_channel = jobs\n"
+    )
+    cfg = Config.load(ini_path=str(ini), env=False)
+    assert cfg.time_to_expire == 2.5
+    assert cfg.dispatcher_port == 7777
+    assert cfg.tasks_channel == "jobs"
+    assert cfg.store_url == Config().store_url  # untouched keys keep defaults
+
+
+def test_env_overrides_ini(tmp_path, monkeypatch):
+    ini = tmp_path / "cfg.ini"
+    ini.write_text("[dispatcher]\ntime_to_expire = 2.5\n")
+    monkeypatch.setenv("TPU_FAAS_TIME_TO_EXPIRE", "7.0")
+    monkeypatch.setenv("TPU_FAAS_STORE_URL", "resp://10.0.0.9:6400")
+    cfg = Config.load(ini_path=str(ini))
+    assert cfg.time_to_expire == 7.0  # env beats ini
+    assert cfg.store_url == "resp://10.0.0.9:6400"
+
+
+def test_missing_ini_is_defaults(tmp_path):
+    cfg = Config.load(ini_path=str(tmp_path / "nope.ini"), env=False)
+    assert cfg == Config()

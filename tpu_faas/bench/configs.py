@@ -1,4 +1,5 @@
-"""The five BASELINE benchmark configs (BASELINE.md / BASELINE.json configs[]).
+"""The five BASELINE benchmark configs (BASELINE.md / BASELINE.json
+configs[]) plus one framework-extra:
 
 1. PushDispatcher greedy load-balance, 8 PushWorkers, sleep-N tasks
 2. PullDispatcher REP/REQ, 8 PullWorkers, mixed-duration tasks
@@ -6,10 +7,12 @@
 4. Heterogeneous workers + task-size estimates, Sinkhorn placement
 5. Heartbeat churn: 4k workers, 5% fail/rejoin per tick, on-device
    task redistribution
+6. (extra, no BASELINE analog) time-to-register: batch /execute_batch +
+   pipelined store writes vs one POST per task
 
-Configs 1-2 run the real socket stack; 3-5 run the device kernels at scales
-the socket stack can't reach on one box (the reference had no analog — its
-harness topped out at localhost subprocesses, SURVEY §4).
+Configs 1-2 and 6 run the real socket stack; 3-5 run the device kernels at
+scales the socket stack can't reach on one box (the reference had no analog
+— its harness topped out at localhost subprocesses, SURVEY §4).
 Each config returns a dict and is printed as one JSON line by the CLI.
 """
 
@@ -209,6 +212,48 @@ def config_5_churn_4k() -> dict:
     }
 
 
+def config_6_batch_register() -> dict:
+    """Time-to-register, batch vs single (beyond the five BASELINE configs):
+    the reference's registration cost is one POST per task; /execute_batch +
+    store pipelining registers a whole batch in one HTTP call and one store
+    round trip. Full real stack: native/python store server over TCP,
+    gateway, SDK."""
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.executor import pack_params
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+
+    n_tasks, n_sims = 100, 3
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register_payload("noop", "unused")
+        payloads = [((i,), {}) for i in range(n_tasks)]
+        single_s, batch_s = [], []
+        for _ in range(n_sims):
+            # symmetric timing: both windows include parameter packing
+            t0 = time.perf_counter()
+            for args, kwargs in payloads:
+                client.execute_payload(fid, pack_params(*args, **kwargs))
+            single_s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            client.submit_many(fid, payloads)
+            batch_s.append(time.perf_counter() - t0)
+        single_ms = float(np.median(single_s) * 1e3)
+        batch_ms = float(np.median(batch_s) * 1e3)
+        return {
+            "config": "batch-register-100",
+            "n_tasks": n_tasks,
+            "single_posts_ms": round(single_ms, 2),
+            "batch_post_ms": round(batch_ms, 2),
+            "speedup": round(single_ms / batch_ms, 1),
+        }
+    finally:
+        gw.stop()
+        store_handle.stop()
+
+
 def _time_host(fn) -> float:
     t0 = time.perf_counter()
     fn()
@@ -221,4 +266,5 @@ CONFIGS = {
     "3": config_3_auction_1k_10k,
     "4": config_4_sinkhorn_hetero,
     "5": config_5_churn_4k,
+    "6": config_6_batch_register,
 }
