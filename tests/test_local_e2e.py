@@ -88,3 +88,42 @@ def test_submit_many_batch_endpoint(stack):
     assert [h.result(timeout=60) for h in handles] == [
         arithmetic(n) for n in range(50, 70)
     ]
+
+
+def test_async_client_end_to_end(stack):
+    """AsyncFaaSClient: register, concurrent submits, batch submit, failure
+    surfaced as the task's exception — all multiplexed on one event loop."""
+    import asyncio
+
+    from tpu_faas.client import AsyncFaaSClient
+
+    sync_client = stack
+
+    async def scenario() -> None:
+        async with AsyncFaaSClient(sync_client.base_url) as client:
+            fid = await client.register(arithmetic)
+            handles = await asyncio.gather(
+                *(client.submit(fid, n) for n in range(100, 110))
+            )
+            values = await asyncio.gather(
+                *(h.result(timeout=60) for h in handles)
+            )
+            assert values == [arithmetic(n) for n in range(100, 110)]
+
+            batch = await client.submit_many(
+                fid, [((n,), {}) for n in range(200, 210)]
+            )
+            values = await asyncio.gather(
+                *(h.result(timeout=60) for h in batch)
+            )
+            assert values == [arithmetic(n) for n in range(200, 210)]
+
+            with pytest.raises(TaskFailedError):
+                await client.run(failing_task, "nope", timeout=30)
+
+            # async task GC mirrors the sync surface
+            done = await client.submit(fid, 7)
+            assert await done.result(timeout=30) == arithmetic(7)
+            await done.forget()
+
+    asyncio.run(scenario())

@@ -17,7 +17,11 @@ import sys
 
 def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description="tpu-faas benchmarks")
-    ap.add_argument("--config", help="BASELINE config number (1-5) or 'all'")
+    ap.add_argument(
+        "--config",
+        help="benchmark config: 1-5 (BASELINE) or 6 (batch register), "
+        "or 'all'",
+    )
     ap.add_argument(
         "-m", "--mode", default="push",
         choices=["local", "pull", "push", "push-hb", "push-plb", "tpu-push"],

@@ -1,5 +1,14 @@
-"""Client SDK for the REST gateway."""
+"""Client SDK for the REST gateway (sync; async lives in
+tpu_faas.client.aio, imported lazily so sync users don't pay for aiohttp)."""
 
 from tpu_faas.client.sdk import FaaSClient, TaskHandle, TaskFailedError
 
-__all__ = ["FaaSClient", "TaskHandle", "TaskFailedError"]
+__all__ = ["FaaSClient", "TaskHandle", "TaskFailedError", "AsyncFaaSClient"]
+
+
+def __getattr__(name: str):
+    if name == "AsyncFaaSClient":
+        from tpu_faas.client.aio import AsyncFaaSClient
+
+        return AsyncFaaSClient
+    raise AttributeError(name)

@@ -1,0 +1,158 @@
+"""Async client SDK (aiohttp) — the concurrent-submitter counterpart of
+:mod:`tpu_faas.client.sdk`.
+
+Same wire format as the sync client (SURVEY §0.1 endpoints + the batch
+extension), but every call is a coroutine and result polling multiplexes on
+one event loop — a single process can drive thousands of outstanding tasks
+without a thread per poll. The sync ``FaaSClient`` remains the default for
+scripts; this is for gateway-scale load generators and services embedding
+the client in an async stack.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import aiohttp
+
+from tpu_faas.client.sdk import TaskFailedError, _unwrap_terminal
+from tpu_faas.core.executor import pack_params
+from tpu_faas.core.serialize import serialize
+
+
+@dataclass
+class AsyncTaskHandle:
+    client: "AsyncFaaSClient"
+    task_id: str
+
+    async def status(self) -> str:
+        async with self.client.http.get(
+            f"{self.client.base_url}/status/{self.task_id}"
+        ) as r:
+            r.raise_for_status()
+            return (await r.json())["status"]
+
+    async def result(
+        self, timeout: float = 60.0, poll_interval: float = 0.01
+    ) -> Any:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while True:
+            async with self.client.http.get(
+                f"{self.client.base_url}/result/{self.task_id}"
+            ) as r:
+                r.raise_for_status()
+                body = await r.json()
+            done, value = _unwrap_terminal(
+                self.task_id, body["status"], body["result"]
+            )
+            if done:
+                return value
+            if loop.time() > deadline:
+                raise TimeoutError(
+                    f"task {self.task_id} still {body['status']} "
+                    f"after {timeout}s"
+                )
+            await asyncio.sleep(poll_interval)
+
+    async def forget(self) -> None:
+        """Delete this task's store record once terminal."""
+        await self.client.delete_task(self.task_id)
+
+
+class AsyncFaaSClient:
+    """Use as an async context manager:
+
+        async with AsyncFaaSClient(url) as client:
+            fid = await client.register(fn)
+            handles = await client.submit_many(fid, params)
+            values = await asyncio.gather(*(h.result() for h in handles))
+    """
+
+    def __init__(self, base_url: str = "http://127.0.0.1:8000") -> None:
+        self.base_url = base_url.rstrip("/")
+        self._http: aiohttp.ClientSession | None = None
+
+    @property
+    def http(self) -> aiohttp.ClientSession:
+        if self._http is None:
+            raise RuntimeError(
+                "AsyncFaaSClient must be entered first: "
+                "`async with AsyncFaaSClient(url) as client: ...`"
+            )
+        return self._http
+
+    async def __aenter__(self) -> "AsyncFaaSClient":
+        self._http = aiohttp.ClientSession()
+        return self
+
+    async def __aexit__(self, *exc: object) -> None:
+        if self._http is not None:
+            await self._http.close()
+            self._http = None
+
+    async def register(self, fn: Callable, name: str | None = None) -> str:
+        # serialization is CPU work: off the event loop, like all packing
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(None, serialize, fn)
+        async with self.http.post(
+            f"{self.base_url}/register_function",
+            json={"name": name or fn.__name__, "payload": payload},
+        ) as r:
+            r.raise_for_status()
+            return (await r.json())["function_id"]
+
+    async def submit(
+        self, function_id: str, *args: Any, **kwargs: Any
+    ) -> AsyncTaskHandle:
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(
+            None, lambda: pack_params(*args, **kwargs)
+        )
+        async with self.http.post(
+            f"{self.base_url}/execute_function",
+            json={"function_id": function_id, "payload": payload},
+        ) as r:
+            r.raise_for_status()
+            return AsyncTaskHandle(self, (await r.json())["task_id"])
+
+    async def submit_many(
+        self, function_id: str, params_list: list[tuple[tuple, dict]]
+    ) -> list[AsyncTaskHandle]:
+        # dill-packing thousands of payloads inline would stall the event
+        # loop (and every concurrently polling handle) — do it in a worker
+        # thread
+        loop = asyncio.get_running_loop()
+        payloads = await loop.run_in_executor(
+            None,
+            lambda: [
+                pack_params(*args, **kwargs) for args, kwargs in params_list
+            ],
+        )
+        async with self.http.post(
+            f"{self.base_url}/execute_batch",
+            json={"function_id": function_id, "payloads": payloads},
+        ) as r:
+            r.raise_for_status()
+            return [
+                AsyncTaskHandle(self, tid)
+                for tid in (await r.json())["task_ids"]
+            ]
+
+    async def delete_task(self, task_id: str) -> None:
+        """Free a terminal task's store record (409 while it is live)."""
+        async with self.http.delete(
+            f"{self.base_url}/task/{task_id}"
+        ) as r:
+            r.raise_for_status()
+
+    async def run(
+        self, fn: Callable, *args: Any, timeout: float = 60.0, **kwargs: Any
+    ) -> Any:
+        handle = await self.submit(await self.register(fn), *args, **kwargs)
+        return await handle.result(timeout)
+
+
+__all__ = ["AsyncFaaSClient", "AsyncTaskHandle", "TaskFailedError"]
