@@ -3,12 +3,15 @@ tpu_faas.client.aio, imported lazily so sync users don't pay for aiohttp)."""
 
 from tpu_faas.client.sdk import FaaSClient, TaskHandle, TaskFailedError
 
-__all__ = ["FaaSClient", "TaskHandle", "TaskFailedError", "AsyncFaaSClient"]
+# async names stay OUT of __all__: `import *` must not eagerly pull aiohttp
+__all__ = ["FaaSClient", "TaskHandle", "TaskFailedError"]
+
+_LAZY_ASYNC = ("AsyncFaaSClient", "AsyncTaskHandle")
 
 
 def __getattr__(name: str):
-    if name == "AsyncFaaSClient":
-        from tpu_faas.client.aio import AsyncFaaSClient
+    if name in _LAZY_ASYNC:
+        from tpu_faas.client import aio
 
-        return AsyncFaaSClient
+        return getattr(aio, name)
     raise AttributeError(name)

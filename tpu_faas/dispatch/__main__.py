@@ -55,6 +55,22 @@ def main(argv: list[str] | None = None) -> None:
         "--stats-port", type=int, default=0,
         help="serve GET /stats (JSON) on this port; 0 = off",
     )
+    ap.add_argument(
+        "--rescan", type=float, default=10.0,
+        help="tpu-push: seconds between stranded-task rescans (0 = off)",
+    )
+    ap.add_argument(
+        "--tick-period", type=float, default=cfg.tick_period,
+        help="tpu-push: scheduler tick period (s)",
+    )
+    ap.add_argument(
+        "--max-pending", type=int, default=cfg.max_pending,
+        help="tpu-push: padded device batch size (tasks per tick)",
+    )
+    ap.add_argument(
+        "--max-fleet", type=int, default=cfg.max_workers,
+        help="tpu-push: padded worker-fleet size",
+    )
     ns = ap.parse_args(argv)
     if ns.delay:
         time.sleep(ns.delay)
@@ -88,6 +104,13 @@ def main(argv: list[str] | None = None) -> None:
     )
     if ns.mode == "push":
         kwargs.update(heartbeat=ns.hb, process_lb=ns.plb)
+    elif ns.mode == "tpu-push":
+        kwargs.update(
+            rescan_period=ns.rescan,
+            tick_period=ns.tick_period,
+            max_pending=ns.max_pending,
+            max_workers=ns.max_fleet,
+        )
     elif ns.mode == "pull":
         # pull workers have no heartbeat protocol (reference SURVEY §3.4)
         kwargs.pop("time_to_expire")
