@@ -268,3 +268,50 @@ def test_stats_endpoint_serves_dispatcher_state():
     finally:
         disp.stop()  # shuts down + closes the stats server's socket too
         disp.socket.close(linger=0)
+
+
+def test_tpu_push_scale_16_workers_500_tasks():
+    """Scale shake-out on the real socket fabric: 16 worker processes x 2
+    procs, 500 tasks submitted in batches, every result verified. Catches
+    what tiny-fleet tests cannot: LRU/placement fairness across a wider
+    fleet, announce-bus throughput, and batch intake under sustained load."""
+    from tpu_faas.workloads import arithmetic
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url, max_workers=64, max_pending=1024)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.5")
+        for _ in range(16)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        # wait for the WHOLE fleet to register before submitting: 16 fresh
+        # interpreters (each warming a 2-child forkserver pool before its
+        # REGISTER) start at very different speeds on a loaded box, and
+        # near-instant tasks would otherwise drain before stragglers join
+        deadline = time.monotonic() + 180
+        while (
+            len(disp.arrays.worker_ids) < 16 and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert len(disp.arrays.worker_ids) == 16
+        fid = client.register(arithmetic)
+        handles = client.submit_many(
+            fid, [((100 + i,), {}) for i in range(500)]
+        )
+        results = [h.result(timeout=180.0) for h in handles]
+        assert results == [arithmetic(100 + i) for i in range(500)]
+        assert disp.n_results >= 500
+        assert disp.n_purged == 0  # healthy fleet: nobody falsely purged
+    finally:
+        for w in workers:
+            w.kill()
+            w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
