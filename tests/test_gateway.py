@@ -178,3 +178,73 @@ def test_delete_task_lifecycle(gw):
         "deleted": True,
     }
     assert rq.get(f"{base}/status/t-live").status_code == 404
+
+
+def test_result_long_poll(gw):
+    """?wait=N holds the request until terminal or deadline; completion
+    mid-poll returns early."""
+    import threading
+    import time
+
+    handle, store = gw
+    base = handle.url
+    store.create_task("lp", "F", "P")
+
+    t0 = time.monotonic()
+    body = requests.get(f"{base}/result/lp", params={"wait": 0.5}).json()
+    held = time.monotonic() - t0
+    assert body["status"] == "QUEUED"
+    assert held >= 0.45, held  # parked at the gateway, not an instant reply
+
+    threading.Timer(
+        0.3, lambda: store.finish_task("lp", "COMPLETED", "r")
+    ).start()
+    t0 = time.monotonic()
+    body = requests.get(f"{base}/result/lp", params={"wait": 10}).json()
+    early = time.monotonic() - t0
+    assert body["status"] == "COMPLETED" and body["result"] == "r"
+    assert early < 5.0, early  # returned on completion, not at the deadline
+
+    # invalid wait -> 400; wait on unknown task -> 404 immediately
+    assert (
+        requests.get(f"{base}/result/lp", params={"wait": "x"}).status_code
+        == 400
+    )
+    t0 = time.monotonic()
+    assert (
+        requests.get(f"{base}/result/ghost", params={"wait": 5}).status_code
+        == 404
+    )
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_long_poll_nan_rejected_and_stop_releases_waiters():
+    """wait=nan must 400 (not bypass the cap), and gateway stop() must not
+    hang behind a parked 30s long-poll."""
+    import threading
+    import time
+
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    base = handle.url
+    store.create_task("parked", "F", "P")
+    assert (
+        requests.get(f"{base}/result/parked", params={"wait": "nan"}).status_code
+        == 400
+    )
+    # park a waiter for the full cap, then stop the gateway mid-poll
+    replies = []
+    waiter = threading.Thread(
+        target=lambda: replies.append(
+            requests.get(f"{base}/result/parked", params={"wait": 30}).json()
+        ),
+        daemon=True,
+    )
+    waiter.start()
+    time.sleep(0.5)
+    t0 = time.monotonic()
+    handle.stop()
+    stopped_in = time.monotonic() - t0
+    assert stopped_in < 10.0, f"stop() hung {stopped_in:.1f}s behind a waiter"
+    waiter.join(timeout=5)
+    assert replies and replies[0]["status"] == "QUEUED"

@@ -40,8 +40,10 @@ class AsyncTaskHandle:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         while True:
+            remaining = max(0.0, min(deadline - loop.time(), 5.0))
             async with self.client.http.get(
-                f"{self.client.base_url}/result/{self.task_id}"
+                f"{self.client.base_url}/result/{self.task_id}",
+                params={"wait": remaining} if remaining > 0 else None,
             ) as r:
                 r.raise_for_status()
                 body = await r.json()

@@ -43,11 +43,17 @@ class TaskHandle:
         self.client.delete_task(self.task_id)
 
     def result(self, timeout: float = 60.0, poll_interval: float = 0.01) -> Any:
-        """Poll until terminal; return the deserialized value or raise
-        :class:`TaskFailedError` with the deserialized exception."""
+        """Wait until terminal; return the deserialized value or raise
+        :class:`TaskFailedError` with the deserialized exception. Uses the
+        gateway's long-poll (``?wait=``) so each round trip parks at the
+        gateway instead of hammering it; ``poll_interval`` only paces the
+        rare retry after an empty long-poll."""
         deadline = time.monotonic() + timeout
         while True:
-            status, payload = self.client.raw_result(self.task_id)
+            remaining = deadline - time.monotonic()
+            status, payload = self.client.raw_result(
+                self.task_id, wait=max(0.0, min(remaining, 5.0))
+            )
             done, value = _unwrap_terminal(self.task_id, status, payload)
             if done:
                 return value
@@ -100,8 +106,10 @@ class FaaSClient:
         r = self.http.delete(f"{self.base_url}/task/{task_id}")
         r.raise_for_status()
 
-    def raw_result(self, task_id: str) -> tuple[str, str]:
-        r = self.http.get(f"{self.base_url}/result/{task_id}")
+    def raw_result(self, task_id: str, wait: float = 0.0) -> tuple[str, str]:
+        """``wait`` > 0 long-polls at the gateway (capped server-side)."""
+        params = {"wait": wait} if wait > 0 else None
+        r = self.http.get(f"{self.base_url}/result/{task_id}", params=params)
         r.raise_for_status()
         body = r.json()
         return body["status"], body["result"]

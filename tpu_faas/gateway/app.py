@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import functools
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -57,6 +58,9 @@ async def _run_blocking(fn, *args):
 class GatewayContext:
     store: TaskStore
     channel: str = TASKS_CHANNEL
+    #: set on app shutdown so parked long-polls reply immediately instead of
+    #: holding the server (and its stop()) for up to _MAX_WAIT_S
+    stopping: asyncio.Event = field(default_factory=asyncio.Event)
     #: request/latency counters by endpoint (reference has no observability —
     #: SURVEY §5.5); TickTracer is thread-safe enough for GIL-serialized
     #: appends and cheap enough to leave on
@@ -102,6 +106,11 @@ def make_app(store: TaskStore, channel: str = TASKS_CHANNEL) -> web.Application:
     app.router.add_delete("/task/{task_id}", delete_task)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+
+    async def _release_waiters(_app: web.Application) -> None:
+        ctx.stopping.set()
+
+    app.on_shutdown.append(_release_waiters)
     return app
 
 
@@ -192,15 +201,46 @@ async def get_status(request: web.Request) -> web.Response:
     return web.json_response({"task_id": task_id, "status": status})
 
 
+#: Long-poll cap: bounds handler lifetime (proxies and LB idle timeouts
+#: commonly sit at 30-60 s).
+_MAX_WAIT_S = 30.0
+_WAIT_POLL_S = 0.02
+_WAIT_POLL_MAX_S = 0.25
+
+
 async def get_result(request: web.Request) -> web.Response:
+    """``?wait=N`` long-polls: hold the request up to N seconds (capped)
+    until the task is terminal, then reply immediately — one request
+    replaces hundreds of 10 ms polls per task. ``wait`` absent or 0 keeps
+    the reference's immediate-reply contract."""
     ctx: GatewayContext = request.app[CTX_KEY]
     task_id = request.match_info["task_id"]
-    status, result = await _run_blocking(ctx.store.get_result, task_id)
-    if status is None:
-        return _json_error(404, f"unknown task_id {task_id!r}")
-    return web.json_response(
-        {"task_id": task_id, "status": status, "result": result}
-    )
+    try:
+        wait_s = float(request.query.get("wait", 0) or 0)
+    except ValueError:
+        wait_s = math.nan
+    if not (0.0 <= wait_s):  # rejects NaN too (any NaN compare is False)
+        return _json_error(400, "'wait' must be a non-negative number")
+    wait_s = min(wait_s, _MAX_WAIT_S)
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + wait_s
+    poll_s = _WAIT_POLL_S
+    while True:
+        status, result = await _run_blocking(ctx.store.get_result, task_id)
+        if status is None:
+            return _json_error(404, f"unknown task_id {task_id!r}")
+        try:
+            terminal = TaskStatus(status).is_terminal()
+        except ValueError:
+            terminal = True  # unknown status string: reply, don't 500/hang
+        if terminal or loop.time() >= deadline or ctx.stopping.is_set():
+            return web.json_response(
+                {"task_id": task_id, "status": status, "result": result}
+            )
+        await asyncio.sleep(poll_s)
+        # backoff: parked waiters must not saturate the shared executor
+        # (each poll is a blocking store call on the default thread pool)
+        poll_s = min(poll_s * 1.5, _WAIT_POLL_MAX_S)
 
 
 async def delete_task(request: web.Request) -> web.Response:
