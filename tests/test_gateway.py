@@ -248,3 +248,44 @@ def test_long_poll_nan_rejected_and_stop_releases_waiters():
     assert stopped_in < 10.0, f"stop() hung {stopped_in:.1f}s behind a waiter"
     waiter.join(timeout=5)
     assert replies and replies[0]["status"] == "QUEUED"
+
+
+def test_execute_batch_schema_and_memory_store_path(gw):
+    """Batch endpoint contract on the in-proc store (default loop-based
+    create_tasks, vs the RESP client's pipelined override)."""
+    handle, store = gw
+    base = handle.url
+    fid = requests.post(
+        f"{base}/register_function",
+        json={"name": "f", "payload": serialize(arithmetic)},
+    ).json()["function_id"]
+    sub = store.subscribe("tasks")
+    r = requests.post(
+        f"{base}/execute_batch",
+        json={
+            "function_id": fid,
+            "payloads": [serialize(((n,), {})) for n in range(5)],
+        },
+    )
+    assert r.status_code == 200
+    tids = r.json()["task_ids"]
+    assert len(tids) == 5
+    for tid in tids:
+        assert store.hgetall(tid)["status"] == "QUEUED"
+    announced = {sub.get_message(timeout=2.0) for _ in range(5)}
+    assert announced == set(tids)
+    # error paths
+    assert (
+        requests.post(
+            f"{base}/execute_batch",
+            json={"function_id": "ghost", "payloads": ["x"]},
+        ).status_code
+        == 404
+    )
+    assert (
+        requests.post(
+            f"{base}/execute_batch",
+            json={"function_id": fid, "payloads": [5]},
+        ).status_code
+        == 400
+    )

@@ -44,6 +44,9 @@ class AsyncTaskHandle:
             async with self.client.http.get(
                 f"{self.client.base_url}/result/{self.task_id}",
                 params={"wait": remaining} if remaining > 0 else None,
+                # parked request + wedged gateway must not block past the
+                # caller's deadline (aiohttp's 300s default would)
+                timeout=aiohttp.ClientTimeout(total=remaining + 15.0),
             ) as r:
                 r.raise_for_status()
                 body = await r.json()

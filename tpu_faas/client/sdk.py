@@ -107,9 +107,16 @@ class FaaSClient:
         r.raise_for_status()
 
     def raw_result(self, task_id: str, wait: float = 0.0) -> tuple[str, str]:
-        """``wait`` > 0 long-polls at the gateway (capped server-side)."""
+        """``wait`` > 0 long-polls at the gateway (capped server-side). The
+        HTTP read timeout is wait + margin — a parked request against a
+        wedged gateway must fail instead of blocking past the caller's own
+        deadline forever."""
         params = {"wait": wait} if wait > 0 else None
-        r = self.http.get(f"{self.base_url}/result/{task_id}", params=params)
+        r = self.http.get(
+            f"{self.base_url}/result/{task_id}",
+            params=params,
+            timeout=(5.0, wait + 15.0),
+        )
         r.raise_for_status()
         body = r.json()
         return body["status"], body["result"]
@@ -163,10 +170,19 @@ class FaaSClient:
         results: dict[int, Any] = {}
         pending = set(range(len(handles)))
         while pending:
-            for i in list(pending):
-                # one round-trip per poll: /result carries both status and
-                # payload (a done()/result() pair would double the requests)
-                status, payload = self.raw_result(handles[i].task_id)
+            # LONG-poll the lowest pending handle (parks at the gateway —
+            # most of a rotation is spent there, not issuing requests), then
+            # sweep the rest with immediate polls to catch the wave of tasks
+            # that completed meanwhile; one /result round-trip each carries
+            # both status and payload
+            first = min(pending)
+            for i in sorted(pending):
+                wait = (
+                    min(2.0, max(0.0, deadline - time.monotonic()))
+                    if i == first
+                    else 0.0
+                )
+                status, payload = self.raw_result(handles[i].task_id, wait=wait)
                 done, value = _unwrap_terminal(
                     handles[i].task_id, status, payload
                 )

@@ -88,6 +88,10 @@ async def _metrics_middleware(request: web.Request, handler):
         # let a URL scanner grow the span table without bound
         route = resource.canonical if resource is not None else "UNMATCHED"
         name = f"{request.method} {route}"
+        # parked long-polls measure wait time, not serving latency — keep
+        # them out of the route's real latency distribution
+        if request.query.get("wait") not in (None, "", "0"):
+            name += " (long-poll)"
         ctx.route_counts[name] = ctx.route_counts.get(name, 0) + 1
         ctx.tracer.record(name, time.perf_counter() - t0)
 
