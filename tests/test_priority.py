@@ -1,0 +1,256 @@
+"""Task priority + cost hints, end to end.
+
+New capability (no reference analog — the reference's dispatch order is
+strictly FCFS off the announce channel): clients may tag a task with an
+integer ``priority`` (higher admitted first under overload, FCFS within a
+class) and a float ``cost`` (estimated run-cost, refines largest-task <->
+fastest-slot pairing). The hints ride optional store hash fields, so
+reference-style clients that never send them see identical behavior.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import requests
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.core.serialize import serialize
+from tpu_faas.core.task import FIELD_COST, FIELD_PRIORITY, TaskStatus
+from tpu_faas.dispatch.base import PendingTask
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.sched.greedy import rank_match_placement
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import sleep_task
+from tests.test_tpu_push_e2e import _make_dispatcher
+from tests.test_workers_e2e import _spawn_worker
+
+
+# -- PendingTask parsing -----------------------------------------------------
+
+
+def test_pending_task_from_fields_parses_hints():
+    t = PendingTask.from_fields(
+        "t1",
+        {
+            "fn_payload": "F",
+            "param_payload": "P",
+            FIELD_PRIORITY: "7",
+            FIELD_COST: "2.5",
+        },
+    )
+    assert t.priority == 7
+    assert t.cost == 2.5
+    assert t.size_estimate == 2.5  # cost hint wins over payload bytes
+
+
+def test_pending_task_defaults_and_malformed_hints():
+    t = PendingTask.from_fields(
+        "t2", {"fn_payload": "FF", "param_payload": "PP"}
+    )
+    assert t.priority == 0 and t.cost is None
+    assert t.size_estimate == 4.0  # payload bytes
+    # a rogue producer writing a huge priority straight into the store must
+    # not OverflowError the dispatcher's int32 batch build — clamp, don't die
+    t = PendingTask.from_fields(
+        "t2b",
+        {"fn_payload": "F", "param_payload": "P", FIELD_PRIORITY: str(2**40)},
+    )
+    assert t.priority == 2**30
+    assert int(np.int32(-t.priority)) == -(2**30)  # negation-safe on device
+    # malformed / out-of-domain hints degrade to defaults, never raise
+    for prio, cost in [("high", "-1"), ("1.5", "nan"), ("", "oops")]:
+        t = PendingTask.from_fields(
+            "t3",
+            {
+                "fn_payload": "F",
+                "param_payload": "P",
+                FIELD_PRIORITY: prio,
+                FIELD_COST: cost,
+            },
+        )
+        assert t.priority == 0 and t.cost is None
+
+
+# -- kernel admission --------------------------------------------------------
+
+
+def test_rank_match_priority_admission_under_overload():
+    T = 10
+    sizes = jnp.ones(T, dtype=jnp.float32)
+    valid = jnp.ones(T, dtype=bool)
+    prio = np.zeros(T, dtype=np.int32)
+    prio[6:] = 5  # the LAST four arrivals carry high priority
+    a = np.asarray(
+        rank_match_placement(
+            sizes,
+            valid,
+            jnp.ones(1, dtype=jnp.float32),
+            jnp.asarray([4], dtype=jnp.int32),
+            jnp.ones(1, dtype=bool),
+            max_slots=4,
+            task_priority=jnp.asarray(prio),
+        )
+    )
+    # capacity is 4: exactly the high-priority tasks are admitted, despite
+    # arriving after six low-priority ones
+    assert set(np.flatnonzero(a >= 0)) == {6, 7, 8, 9}
+
+
+def test_rank_match_priority_tie_breaks_fcfs():
+    T = 8
+    sizes = jnp.asarray(np.linspace(1.0, 2.0, T), dtype=jnp.float32)
+    valid = jnp.ones(T, dtype=bool)
+    workers = (
+        jnp.ones(2, dtype=jnp.float32),
+        jnp.asarray([1, 2], dtype=jnp.int32),
+        jnp.ones(2, dtype=bool),
+    )
+    base = np.asarray(
+        rank_match_placement(sizes, valid, *workers, max_slots=4)
+    )
+    uniform = np.asarray(
+        rank_match_placement(
+            sizes,
+            valid,
+            *workers,
+            max_slots=4,
+            task_priority=jnp.zeros(T, dtype=jnp.int32),
+        )
+    )
+    # uniform priorities admit exactly the FCFS set (the no-priority path)
+    assert set(np.flatnonzero(uniform >= 0)) == set(np.flatnonzero(base >= 0))
+
+
+# -- gateway contract --------------------------------------------------------
+
+
+def test_gateway_stores_hints_and_validates():
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    probe = make_store(store_handle.url)
+    try:
+        fid = requests.post(
+            f"{gw.url}/register_function",
+            json={"name": "sleep", "payload": serialize(sleep_task)},
+        ).json()["function_id"]
+        params = serialize(((0.0,), {}))
+
+        r = requests.post(
+            f"{gw.url}/execute_function",
+            json={
+                "function_id": fid,
+                "payload": params,
+                "priority": 3,
+                "cost": 1.25,
+            },
+        )
+        assert r.status_code == 200
+        fields = probe.hgetall(r.json()["task_id"])
+        assert fields[FIELD_PRIORITY] == "3"
+        assert float(fields[FIELD_COST]) == 1.25
+        assert fields["status"] == str(TaskStatus.QUEUED)
+
+        # hints omitted -> fields absent (wire parity with the reference)
+        r = requests.post(
+            f"{gw.url}/execute_function",
+            json={"function_id": fid, "payload": params},
+        )
+        fields = probe.hgetall(r.json()["task_id"])
+        assert FIELD_PRIORITY not in fields and FIELD_COST not in fields
+
+        # batch with parallel hint lists
+        r = requests.post(
+            f"{gw.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": [params, params],
+                "priorities": [2, None],
+                "costs": [None, 0.5],
+            },
+        )
+        assert r.status_code == 200
+        t0, t1 = r.json()["task_ids"]
+        assert probe.hgetall(t0).get(FIELD_PRIORITY) == "2"
+        assert FIELD_COST not in probe.hgetall(t0)
+        assert float(probe.hgetall(t1).get(FIELD_COST)) == 0.5
+
+        # validation: 400s, nothing written
+        bad = [
+            {"priority": "high"},
+            {"priority": True},
+            {"priority": 2**40},  # out of the kernel's int32-safe range
+            {"cost": -1.0},
+            {"cost": "x"},
+        ]
+        for extra in bad:
+            r = requests.post(
+                f"{gw.url}/execute_function",
+                json={"function_id": fid, "payload": params, **extra},
+            )
+            assert r.status_code == 400, extra
+        r = requests.post(
+            f"{gw.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": [params, params],
+                "priorities": [1],  # wrong length
+            },
+        )
+        assert r.status_code == 400
+    finally:
+        gw.stop()
+        store_handle.stop()
+
+
+# -- end to end through the TPU push dispatcher ------------------------------
+
+
+def test_tpu_push_priority_ordering_e2e():
+    """One single-process worker, five pre-queued sleep tasks submitted with
+    ascending priorities: the dispatcher must start them in descending
+    priority order (the reverse of submission order)."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    client = FaaSClient(gw.url)
+    fid = client.register(sleep_task)
+    handles = client.submit_many(
+        fid,
+        [((0.25,), {}) for _ in range(5)],
+        priorities=[0, 1, 2, 3, 4],
+    )
+    by_id = {h.task_id: i for i, h in enumerate(handles)}
+
+    # dispatcher created AFTER submission: the startup rescan adopts all five
+    # as pending, so the first dispatch decision sees the full batch
+    disp = _make_dispatcher(store_handle.url)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    worker = _spawn_worker("push_worker", 1, url, "--hb", "--hb-period", "0.3")
+
+    probe = make_store(store_handle.url)
+    started_order: list[int] = []
+    try:
+        deadline = time.monotonic() + 30
+        while len(started_order) < 5 and time.monotonic() < deadline:
+            for tid, idx in by_id.items():
+                if idx in started_order:
+                    continue
+                status = probe.get_status(tid)
+                if status is not None and status != str(TaskStatus.QUEUED):
+                    started_order.append(idx)
+            time.sleep(0.02)
+        assert started_order == [4, 3, 2, 1, 0], started_order
+        for h in handles:
+            h.result(timeout=30)
+    finally:
+        worker.kill()
+        worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

@@ -123,8 +123,39 @@ class AsyncFaaSClient:
             r.raise_for_status()
             return AsyncTaskHandle(self, (await r.json())["task_id"])
 
+    async def submit_with(
+        self,
+        function_id: str,
+        args: tuple = (),
+        kwargs: dict | None = None,
+        *,
+        priority: int | None = None,
+        cost: float | None = None,
+    ) -> AsyncTaskHandle:
+        """submit() plus scheduling hints (mirrors the sync SDK): higher
+        ``priority`` is admitted first under overload; ``cost`` is the
+        estimated run-cost used for task<->worker pairing."""
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(
+            None, lambda: pack_params(*args, **(kwargs or {}))
+        )
+        body: dict = {"function_id": function_id, "payload": payload}
+        if priority is not None:
+            body["priority"] = priority
+        if cost is not None:
+            body["cost"] = cost
+        async with self.http.post(
+            f"{self.base_url}/execute_function", json=body
+        ) as r:
+            r.raise_for_status()
+            return AsyncTaskHandle(self, (await r.json())["task_id"])
+
     async def submit_many(
-        self, function_id: str, params_list: list[tuple[tuple, dict]]
+        self,
+        function_id: str,
+        params_list: list[tuple[tuple, dict]],
+        priorities: list[int] | None = None,
+        costs: list[float] | None = None,
     ) -> list[AsyncTaskHandle]:
         # dill-packing thousands of payloads inline would stall the event
         # loop (and every concurrently polling handle) — do it in a worker
@@ -136,9 +167,13 @@ class AsyncFaaSClient:
                 pack_params(*args, **kwargs) for args, kwargs in params_list
             ],
         )
+        body: dict = {"function_id": function_id, "payloads": payloads}
+        if priorities is not None:
+            body["priorities"] = priorities
+        if costs is not None:
+            body["costs"] = costs
         async with self.http.post(
-            f"{self.base_url}/execute_batch",
-            json={"function_id": function_id, "payloads": payloads},
+            f"{self.base_url}/execute_batch", json=body
         ) as r:
             r.raise_for_status()
             return [

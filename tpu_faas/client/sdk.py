@@ -89,11 +89,19 @@ class FaaSClient:
         r.raise_for_status()
         return r.json()["function_id"]
 
-    def execute_payload(self, function_id: str, payload: str) -> str:
-        r = self.http.post(
-            f"{self.base_url}/execute_function",
-            json={"function_id": function_id, "payload": payload},
-        )
+    def execute_payload(
+        self,
+        function_id: str,
+        payload: str,
+        priority: int | None = None,
+        cost: float | None = None,
+    ) -> str:
+        body: dict = {"function_id": function_id, "payload": payload}
+        if priority is not None:
+            body["priority"] = priority
+        if cost is not None:
+            body["cost"] = cost
+        r = self.http.post(f"{self.base_url}/execute_function", json=body)
         r.raise_for_status()
         return r.json()["task_id"]
 
@@ -129,21 +137,51 @@ class FaaSClient:
         payload = pack_params(*args, **kwargs)
         return TaskHandle(self, self.execute_payload(function_id, payload))
 
+    def submit_with(
+        self,
+        function_id: str,
+        args: tuple = (),
+        kwargs: dict | None = None,
+        *,
+        priority: int | None = None,
+        cost: float | None = None,
+    ) -> TaskHandle:
+        """submit() plus scheduling hints. The hints can't ride submit()
+        itself — its **kwargs belong to the remote function — so args/kwargs
+        are explicit here. ``priority``: higher is admitted first under
+        overload (FCFS within a class); ``cost``: estimated run-cost, used to
+        pair expensive tasks with fast workers."""
+        payload = pack_params(*args, **(kwargs or {}))
+        return TaskHandle(
+            self,
+            self.execute_payload(
+                function_id, payload, priority=priority, cost=cost
+            ),
+        )
+
     def submit_many(
-        self, function_id: str, params_list: list[tuple[tuple, dict]]
+        self,
+        function_id: str,
+        params_list: list[tuple[tuple, dict]],
+        priorities: list[int] | None = None,
+        costs: list[float] | None = None,
     ) -> list[TaskHandle]:
         """Batch submit over ONE HTTP call (+ one pipelined store round
         trip): ``params_list`` holds (args, kwargs) pairs. N single submits
-        cost N round trips on both hops — this is the bulk path."""
-        r = self.http.post(
-            f"{self.base_url}/execute_batch",
-            json={
-                "function_id": function_id,
-                "payloads": [
-                    pack_params(*args, **kwargs) for args, kwargs in params_list
-                ],
-            },
-        )
+        cost N round trips on both hops — this is the bulk path.
+        ``priorities``/``costs`` are optional scheduling-hint lists parallel
+        to ``params_list``."""
+        body: dict = {
+            "function_id": function_id,
+            "payloads": [
+                pack_params(*args, **kwargs) for args, kwargs in params_list
+            ],
+        }
+        if priorities is not None:
+            body["priorities"] = priorities
+        if costs is not None:
+            body["costs"] = costs
+        r = self.http.post(f"{self.base_url}/execute_batch", json=body)
         r.raise_for_status()
         return [TaskHandle(self, tid) for tid in r.json()["task_ids"]]
 

@@ -31,6 +31,11 @@ FIELD_STATUS = "status"
 FIELD_FN = "fn_payload"
 FIELD_PARAMS = "param_payload"
 FIELD_RESULT = "result"
+#: Optional scheduling hints, written by the gateway only when the client
+#: supplied them (the reference contract has no analog; absent fields keep
+#: hand-rolled reference-style clients fully interoperable).
+FIELD_PRIORITY = "priority"  # int as str; higher = admitted first
+FIELD_COST = "cost"  # float as str; estimated run-cost (scheduler pairing)
 
 
 def new_task_id() -> str:

@@ -25,7 +25,14 @@ from collections import deque
 from dataclasses import dataclass
 
 from tpu_faas.core.serialize import serialize
-from tpu_faas.core.task import FIELD_FN, FIELD_PARAMS, FIELD_STATUS, TaskStatus
+from tpu_faas.core.task import (
+    FIELD_COST,
+    FIELD_FN,
+    FIELD_PARAMS,
+    FIELD_PRIORITY,
+    FIELD_STATUS,
+    TaskStatus,
+)
 from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import get_logger
@@ -45,12 +52,54 @@ class PendingTask:
     #: re-queued (poison-task guard: a task that keeps killing its workers is
     #: FAILED after ``max_task_retries`` reclaims instead of cycling forever)
     retries: int = 0
+    #: client-supplied scheduling hints (gateway 'priority'/'cost' fields);
+    #: priority orders admission under overload, cost refines the pairing
+    priority: int = 0
+    cost: float | None = None
 
     @property
     def size_estimate(self) -> float:
-        """Crude task-cost signal for the scheduler's cost matrix: payload
-        bytes (serialized params dominate for data-heavy tasks)."""
+        """Task-cost signal for the scheduler: the client's cost hint when
+        given, else payload bytes (serialized params dominate for data-heavy
+        tasks)."""
+        if self.cost is not None:
+            return self.cost
         return float(len(self.fn_payload) + len(self.param_payload))
+
+    @classmethod
+    def from_fields(
+        cls, task_id: str, fields: dict[str, str], retries: int = 0
+    ) -> "PendingTask":
+        """Build from a task's store hash (intake + stranded-rescan + reclaim
+        paths share this parse); malformed hint fields degrade to defaults
+        rather than wedging the dispatch loop on one bad task."""
+        try:
+            priority = int(fields.get(FIELD_PRIORITY, 0))
+        except ValueError:
+            priority = 0
+        # clamp into the device kernel's safe range (int32 with negation
+        # headroom): the gateway rejects out-of-range values, but the store
+        # is writable by other producers and one huge value must not
+        # OverflowError the dispatch loop's int32 batch build
+        priority = max(-(2**30), min(2**30, priority))
+        cost: float | None = None
+        raw_cost = fields.get(FIELD_COST)
+        if raw_cost is not None:
+            try:
+                cost = float(raw_cost)
+            except ValueError:
+                cost = None
+            else:
+                if not (cost > 0.0):  # rejects NaN and non-positive
+                    cost = None
+        return cls(
+            task_id,
+            fields.get(FIELD_FN, ""),
+            fields.get(FIELD_PARAMS, ""),
+            retries=retries,
+            priority=priority,
+            cost=cost,
+        )
 
 
 class TaskDispatcher:
@@ -111,7 +160,7 @@ class TaskDispatcher:
                 # finished; dispatching it again would run it twice
                 self.log.debug("announce for non-QUEUED task %s; skipping", msg)
                 continue
-            return PendingTask(msg, fields[FIELD_FN], fields[FIELD_PARAMS])
+            return PendingTask.from_fields(msg, fields)
 
     def poll_tasks(self, max_n: int) -> list[PendingTask]:
         """Batch intake: drain up to max_n announcements. If a store outage

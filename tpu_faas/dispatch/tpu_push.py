@@ -30,7 +30,7 @@ from collections import deque
 import numpy as np
 import zmq
 
-from tpu_faas.core.task import FIELD_STATUS, TaskStatus
+from tpu_faas.core.task import FIELD_FN, FIELD_PARAMS, FIELD_STATUS, TaskStatus
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
     PendingTask,
@@ -132,13 +132,7 @@ class TpuPushDispatcher(TaskDispatcher):
             fields = self.store.hgetall(key)
             if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
                 continue  # finished between the two reads
-            self.pending.append(
-                PendingTask(
-                    key,
-                    fields.get("fn_payload", ""),
-                    fields.get("param_payload", ""),
-                )
-            )
+            self.pending.append(PendingTask.from_fields(key, fields))
             n += 1
         # reads succeeded: the store is reachable (an idle dispatcher has no
         # result writes to clear the outage flag otherwise)
@@ -252,12 +246,17 @@ class TpuPushDispatcher(TaskDispatcher):
             sizes = np.asarray(
                 [t.size_estimate for t in batch], dtype=np.float32
             )
+            # only build (and pay for) the priority lane when some task in
+            # the batch actually carries a non-default priority
+            prios = None
+            if any(t.priority for t in batch):
+                prios = np.asarray([t.priority for t in batch], dtype=np.int32)
             with self.tracer.span("device_tick"):
-                out = a.tick(sizes)
+                out = a.tick(sizes, task_priorities=prios)
 
             # reclaim in-flight tasks of dead workers (ahead of the queue) —
             # phase 1: store I/O only, no bookkeeping mutation
-            reclaims: list[tuple[int, str, int, str, str]] = []
+            reclaims: list[tuple[int, str, int, dict[str, str]]] = []
             drops: list[tuple[int, str]] = []  # failed or vanished
             for slot in np.flatnonzero(np.asarray(out.redispatch)):
                 slot = int(slot)
@@ -280,28 +279,23 @@ class TpuPushDispatcher(TaskDispatcher):
                     )
                     drops.append((slot, task_id))
                     continue
-                try:
-                    fn_payload, param_payload = self.store.get_payloads(task_id)
-                except KeyError:
+                fields = self.store.hgetall(task_id)
+                if FIELD_FN not in fields or FIELD_PARAMS not in fields:
                     # payloads vanished (store flushed): nothing to
                     # re-dispatch, and leaving a retry entry would haunt a
                     # future task that reuses the id
                     drops.append((slot, task_id))
                     continue
-                reclaims.append(
-                    (slot, task_id, retries, fn_payload, param_payload)
-                )
+                reclaims.append((slot, task_id, retries, fields))
             # phase 2: bookkeeping only, cannot raise
             for slot, task_id in drops:
                 a.inflight_clear_slot(slot)
                 self.task_retries.pop(task_id, None)
-            for slot, task_id, retries, fn_payload, param_payload in reclaims:
+            for slot, task_id, retries, fields in reclaims:
                 a.inflight_clear_slot(slot)
                 self.task_retries[task_id] = retries
                 requeued.append(
-                    PendingTask(
-                        task_id, fn_payload, param_payload, retries=retries
-                    )
+                    PendingTask.from_fields(task_id, fields, retries=retries)
                 )
             for row in np.flatnonzero(np.asarray(out.purged)):
                 self.log.warning("purged worker row %d", int(row))

@@ -7,6 +7,9 @@ test_client.py:98-126, test_suit.py:39-91):
     -> {"function_id": str}
 - ``POST /execute_function``   {"function_id": str, "payload": ser_params}
     -> {"task_id": str}      (404 if function_id unknown)
+    optional scheduling hints: "priority" (int, higher admitted first under
+    overload) and "cost" (float > 0, estimated run-cost); /execute_batch
+    takes parallel "priorities"/"costs" lists (None entries = no hint)
 - ``GET /status/{task_id}``    -> {"task_id", "status"}
 - ``GET /result/{task_id}``    -> {"task_id", "status", "result"}
 
@@ -35,7 +38,13 @@ from dataclasses import dataclass, field
 
 from aiohttp import web
 
-from tpu_faas.core.task import TaskStatus, new_function_id, new_task_id
+from tpu_faas.core.task import (
+    FIELD_COST,
+    FIELD_PRIORITY,
+    TaskStatus,
+    new_function_id,
+    new_task_id,
+)
 from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import TickTracer, get_logger
@@ -135,6 +144,40 @@ async def register_function(request: web.Request) -> web.Response:
     return web.json_response({"function_id": function_id})
 
 
+#: Priority bound: fits int32 with headroom for negation on device, and far
+#: beyond any sane number of priority classes. Shared with the dispatcher's
+#: defensive clamp (dispatch/base.py PendingTask.from_fields).
+_PRIORITY_BOUND = 2**30
+
+
+def _parse_hints(priority, cost) -> dict[str, str]:
+    """Validate the optional scheduling hints into store hash fields.
+
+    Raises ValueError with a client-facing message. Bounds: priority is an
+    int (bool rejected — it JSON-decodes from true/false and is almost
+    certainly a client bug); cost a finite positive float.
+    """
+    extra: dict[str, str] = {}
+    if priority is not None:
+        if isinstance(priority, bool) or not isinstance(priority, int):
+            raise ValueError("'priority' must be an integer")
+        if not -_PRIORITY_BOUND <= priority <= _PRIORITY_BOUND:
+            raise ValueError(
+                f"'priority' must be within +/-{_PRIORITY_BOUND}"
+            )
+        extra[FIELD_PRIORITY] = str(priority)
+    if cost is not None:
+        if (
+            isinstance(cost, bool)
+            or not isinstance(cost, (int, float))
+            or not math.isfinite(cost)
+            or cost <= 0
+        ):
+            raise ValueError("'cost' must be a finite positive number")
+        extra[FIELD_COST] = repr(float(cost))
+    return extra
+
+
 async def execute_function(request: web.Request) -> web.Response:
     ctx: GatewayContext = request.app[CTX_KEY]
     try:
@@ -142,6 +185,10 @@ async def execute_function(request: web.Request) -> web.Response:
         function_id, param_payload = body["function_id"], body["payload"]
     except Exception:
         return _json_error(400, "expected JSON body with 'function_id' and 'payload'")
+    try:
+        extra = _parse_hints(body.get("priority"), body.get("cost"))
+    except ValueError as exc:
+        return _json_error(400, str(exc))
     fn_payload = await _run_blocking(
         ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
     )
@@ -150,7 +197,9 @@ async def execute_function(request: web.Request) -> web.Response:
     task_id = new_task_id()
 
     def write_task() -> None:
-        ctx.store.create_task(task_id, fn_payload, param_payload, ctx.channel)
+        ctx.store.create_task(
+            task_id, fn_payload, param_payload, ctx.channel, extra or None
+        )
 
     await _run_blocking(write_task)
     ctx.n_tasks += 1
@@ -175,6 +224,26 @@ async def execute_batch(request: web.Request) -> web.Response:
         isinstance(p, str) for p in payloads
     ):
         return _json_error(400, "'payloads' must be a list of strings")
+    # optional parallel hint lists; None entries mean "no hint for this task"
+    priorities = body.get("priorities")
+    costs = body.get("costs")
+    for name, lst in (("priorities", priorities), ("costs", costs)):
+        if lst is not None and (
+            not isinstance(lst, list) or len(lst) != len(payloads)
+        ):
+            return _json_error(
+                400, f"'{name}' must be a list parallel to 'payloads'"
+            )
+    try:
+        extras = [
+            _parse_hints(
+                priorities[i] if priorities else None,
+                costs[i] if costs else None,
+            )
+            for i in range(len(payloads))
+        ]
+    except ValueError as exc:
+        return _json_error(400, str(exc))
     fn_payload = await _run_blocking(
         ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
     )
@@ -185,8 +254,10 @@ async def execute_batch(request: web.Request) -> web.Response:
     def write_tasks() -> None:
         ctx.store.create_tasks(
             [
-                (tid, fn_payload, param_payload)
-                for tid, param_payload in zip(task_ids, payloads)
+                (tid, fn_payload, param_payload, extra or None)
+                for tid, param_payload, extra in zip(
+                    task_ids, payloads, extras
+                )
             ],
             ctx.channel,
         )

@@ -166,10 +166,14 @@ def sharded_scheduler_tick(
     time_to_expire: jnp.ndarray,
     max_slots: int = 8,
     use_sinkhorn: bool = True,
+    task_priority: jnp.ndarray | None = None,  # i32[T] sharded like tasks
 ) -> TickOutput:
     """The full fused tick (liveness + purge + placement + redistribution)
     with the pending-task axis sharded across the mesh. Semantics identical
-    to sched.state.scheduler_tick."""
+    to sched.state.scheduler_tick. ``task_priority`` orders admission on the
+    rank-match path (the global stable sort lowers to a collective exchange);
+    the Sinkhorn path ignores it — entropic admission is soft by
+    construction, so hard priority classes belong to the rank-match branch."""
     fresh = heartbeat_age <= time_to_expire
     live = worker_active & fresh
     purged = prev_live & ~live
@@ -185,7 +189,7 @@ def sharded_scheduler_tick(
     else:
         assignment = rank_match_placement(
             task_size, task_valid, worker_speed, worker_free, live,
-            max_slots=max_slots,
+            max_slots=max_slots, task_priority=task_priority,
         )
     assigned_count = jnp.zeros_like(worker_free).at[
         jnp.clip(assignment, 0)

@@ -37,6 +37,7 @@ def rank_match_placement(
     worker_free: jnp.ndarray,  # i32[W]
     worker_live: jnp.ndarray,  # bool[W]
     max_slots: int = 8,
+    task_priority: jnp.ndarray | None = None,  # i32[T], higher first
 ) -> jnp.ndarray:
     """Return assignment i32[T]: worker index per task, -1 = stay queued."""
     T = task_size.shape[0]
@@ -56,13 +57,32 @@ def rank_match_placement(
     slot_order = jnp.argsort(-slot_speed)
     slot_worker_sorted = slot_worker[slot_order]
 
-    # admission is FCFS (same policy as the auction kernel): under overload
-    # the earliest-arrival tasks are admitted, so small tasks can't be
-    # starved forever by a stream of larger ones. Pairing within the
-    # admitted set is still largest-task <-> fastest-slot.
+    # admission: FCFS by default (same policy as the auction kernel) — under
+    # overload the earliest-arrival tasks are admitted, so small tasks can't
+    # be starved forever by a stream of larger ones. With task_priority the
+    # order becomes (priority desc, arrival asc): the stable sort keeps FCFS
+    # as the tie-break, so equal-priority traffic behaves exactly as before.
+    # Pairing within the admitted set is still largest-task <-> fastest-slot.
     n_slots = slot_valid.sum()
-    arrival_rank = jnp.cumsum(task_valid.astype(jnp.int32)) - 1
-    admitted = task_valid & (arrival_rank < n_slots)
+    if task_priority is None:
+        arrival_rank = jnp.cumsum(task_valid.astype(jnp.int32)) - 1
+        admitted = task_valid & (arrival_rank < n_slots)
+    else:
+        # integer key: a float32 key would collapse priorities differing
+        # above 2**24; invalid tasks sink to the end via int32 max (real
+        # priorities are clamped to +/-2**30 upstream, so negation is safe)
+        adm_key = jnp.where(
+            task_valid,
+            -task_priority.astype(jnp.int32),
+            jnp.iinfo(jnp.int32).max,
+        )
+        adm_order = jnp.argsort(adm_key, stable=True)
+        adm_rank = (
+            jnp.zeros(T, dtype=jnp.int32)
+            .at[adm_order]
+            .set(jnp.arange(T, dtype=jnp.int32))
+        )
+        admitted = task_valid & (adm_rank < n_slots)
 
     # largest admitted tasks first (non-admitted sink to the end)
     task_key = jnp.where(admitted, task_size, -jnp.inf)

@@ -47,6 +47,7 @@ def scheduler_tick(
     inflight_worker: jnp.ndarray,  # i32[I] worker per in-flight slot, -1 empty
     time_to_expire: jnp.ndarray,  # f32 scalar
     max_slots: int = 8,
+    task_priority: jnp.ndarray | None = None,  # i32[T], higher admitted first
 ) -> TickOutput:
     # -- failure detection (reference purge_workers, device-side) ----------
     # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
@@ -65,7 +66,7 @@ def scheduler_tick(
     # -- batched placement -------------------------------------------------
     assignment = rank_match_placement(
         task_size, task_valid, worker_speed, worker_free, live,
-        max_slots=max_slots,
+        max_slots=max_slots, task_priority=task_priority,
     )
     assigned_count = jnp.zeros_like(worker_free).at[
         jnp.clip(assignment, 0)
@@ -214,11 +215,14 @@ class SchedulerArrays:
         self,
         task_sizes: np.ndarray,
         now: float | None = None,
+        task_priorities: np.ndarray | None = None,
     ) -> TickOutput:
         """Run the fused device step for the current pending batch.
 
         ``task_sizes`` is the un-padded vector of pending task cost
         estimates; padding/masking to ``max_pending`` happens here.
+        ``task_priorities`` (optional, parallel to ``task_sizes``) orders
+        admission under overload — higher first, FCFS within a priority.
         """
         n = len(task_sizes)
         if n > self.max_pending:
@@ -227,6 +231,11 @@ class SchedulerArrays:
         ts[:n] = task_sizes
         tv = np.zeros(self.max_pending, dtype=bool)
         tv[:n] = True
+        prio = None
+        if task_priorities is not None:
+            prio = np.zeros(self.max_pending, dtype=np.int32)
+            prio[:n] = task_priorities
+            prio = jnp.asarray(prio)
         now_f = now if now is not None else self.clock()
         hb_age = (now_f - self.last_heartbeat).astype(np.float32)
         out = scheduler_tick(
@@ -240,6 +249,7 @@ class SchedulerArrays:
             jnp.asarray(self.inflight_worker),
             jnp.float32(self.time_to_expire),
             max_slots=self.max_slots,
+            task_priority=prio,
         )
         self.prev_live = np.asarray(out.live)
         return out

@@ -106,15 +106,19 @@ class TaskStore(abc.ABC):
         fn_payload: str,
         param_payload: str,
         channel: str = TASKS_CHANNEL,
+        extra_fields: dict[str, str] | None = None,
     ) -> None:
         """Write the gateway-side contract: full hash then announce.
 
         Field set and QUEUED initial status per SURVEY §0.1 (demonstrated in
-        the reference by old/client_debug.py:40-45).
+        the reference by old/client_debug.py:40-45). ``extra_fields`` carries
+        optional scheduling hints (FIELD_PRIORITY/FIELD_COST); the core four
+        fields win on any name collision.
         """
         self.hset(
             task_id,
             {
+                **(extra_fields or {}),
                 FIELD_STATUS: str(TaskStatus.QUEUED),
                 FIELD_FN: fn_payload,
                 FIELD_PARAMS: param_payload,
@@ -132,14 +136,17 @@ class TaskStore(abc.ABC):
 
     def create_tasks(
         self,
-        tasks: list[tuple[str, str, str]],  # (task_id, fn_payload, params)
+        tasks: list[tuple],  # (task_id, fn_payload, params[, extra_fields])
         channel: str = TASKS_CHANNEL,
     ) -> None:
-        """Batch create_task. Default: a loop; the RESP client pipelines all
-        writes + announces into one round trip (the gateway's batch-submit
-        path)."""
-        for task_id, fn_payload, param_payload in tasks:
-            self.create_task(task_id, fn_payload, param_payload, channel)
+        """Batch create_task. Each tuple is (task_id, fn_payload,
+        param_payload) with an optional 4th element of extra hash fields.
+        Default: a loop; the RESP client pipelines all writes + announces
+        into one round trip (the gateway's batch-submit path)."""
+        for task in tasks:
+            task_id, fn_payload, param_payload = task[:3]
+            extra = task[3] if len(task) > 3 else None
+            self.create_task(task_id, fn_payload, param_payload, channel, extra)
 
     def get_payloads(self, task_id: str) -> tuple[str, str]:
         """Fetch (fn_payload, param_payload) in one round-trip —

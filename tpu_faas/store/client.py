@@ -276,10 +276,16 @@ class RespStore(TaskStore):
         )
 
         commands: list[tuple] = []
-        for task_id, fn_payload, param_payload in tasks:
+        for task in tasks:
+            task_id, fn_payload, param_payload = task[:3]
+            extra = task[3] if len(task) > 3 else None
+            extra_args: list[str] = []
+            for k, v in (extra or {}).items():
+                extra_args += [k, v]
             commands.append(
                 (
                     "HSET", task_id,
+                    *extra_args,
                     FIELD_STATUS, str(TaskStatus.QUEUED),
                     FIELD_FN, fn_payload,
                     FIELD_PARAMS, param_payload,
@@ -288,8 +294,8 @@ class RespStore(TaskStore):
             )
         # announces AFTER every hash write: a dispatcher must never receive
         # an announce for a task whose payloads aren't readable yet
-        for task_id, _, _ in tasks:
-            commands.append(("PUBLISH", channel, task_id))
+        for task in tasks:
+            commands.append(("PUBLISH", channel, task[0]))
         replies = self.pipeline(commands)
         # pipeline() returns error replies in place; swallowing one here
         # would hand the caller task_ids for tasks that were never written
