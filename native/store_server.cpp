@@ -9,7 +9,7 @@
 // no threads, no locks, no allocation in the steady-state paths beyond the
 // hash tables themselves.
 //
-// Supported commands: PING, SELECT (ignored), HSET, HGET, HGETALL, DEL,
+// Supported commands: PING, SELECT (ignored), HSET, HGET, HMGET, HGETALL, DEL,
 // KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE, FLUSHDB, SAVE, QUIT, SHUTDOWN.
 //
 // Checkpoint/resume: --snapshot PATH loads PATH at startup and writes it on
@@ -458,6 +458,19 @@ class Server {
       auto f = h->second.find(cmd[2]);
       if (f == h->second.end()) { reply_nil(c.outbuf); return; }
       reply_bulk(c.outbuf, f->second);
+    } else if (name == "HMGET") {
+      if (argc < 2) {
+        reply_error(c.outbuf, "wrong number of arguments for HMGET");
+        return;
+      }
+      auto h = store_.hashes.find(cmd[1]);
+      reply_array_header(c.outbuf, argc - 1);
+      for (size_t i = 2; i < cmd.size(); i++) {
+        if (h == store_.hashes.end()) { reply_nil(c.outbuf); continue; }
+        auto f = h->second.find(cmd[i]);
+        if (f == h->second.end()) reply_nil(c.outbuf);
+        else reply_bulk(c.outbuf, f->second);
+      }
     } else if (name == "HGETALL") {
       auto h = argc >= 1 ? store_.hashes.find(cmd[1]) : store_.hashes.end();
       if (h == store_.hashes.end()) {

@@ -1,0 +1,91 @@
+"""Benchmark-harness tests: the timing estimator's math and a smoke run of
+the measure_service pipeline (reference client_performance.py analog).
+
+The reference's harness was untested and shipped a units bug (ms printed as
+"ns", client_performance.py:301-302); these tests pin ours down.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import tpu_faas.bench.timing as timing
+
+
+class _FakePipeline:
+    """Deterministic stand-in for a device stream: each run() advances a
+    virtual clock by ``per_exec``; every measurement window's closing
+    perf_counter read pays a constant ``transport`` (the readback round
+    trip). The slope estimator must recover per_exec exactly and ignore
+    transport."""
+
+    def __init__(self, per_exec: float, transport: float):
+        self.per_exec = per_exec
+        self.transport = transport
+        self.t = 0.0
+        self.calls = 0
+        self.jitter: dict[int, float] = {}  # window index -> extra seconds
+        self.window = -1
+
+    def run(self, problem):
+        self.t += self.per_exec
+        return np.zeros(1)
+
+    def perf_counter(self) -> float:
+        self.calls += 1
+        if self.calls % 2 == 1:  # window opens
+            self.window += 1
+            return self.t
+        return self.t + self.transport + self.jitter.get(self.window, 0.0)
+
+
+def test_pipeline_slope_recovers_per_exec_time(monkeypatch):
+    fake = _FakePipeline(per_exec=0.002, transport=0.070)
+    monkeypatch.setattr(timing.time, "perf_counter", fake.perf_counter)
+    ms = timing.pipeline_slope_ms(fake.run, [object()], 10, 60)
+    # 70 ms of per-window transport, 2 ms/exec device time: the slope sees
+    # only the device time
+    assert ms == pytest.approx(2.0, abs=1e-9)
+
+
+def test_pipeline_slope_survives_one_corrupt_window(monkeypatch):
+    fake = _FakePipeline(per_exec=0.0015, transport=0.070)
+    fake.jitter[2] = 0.5  # one window (a tunnel hiccup) is wildly slow
+    monkeypatch.setattr(timing.time, "perf_counter", fake.perf_counter)
+    ms = timing.pipeline_slope_ms(fake.run, [object()], 10, 60)
+    # Theil-Sen: the median of pairwise slopes sheds the corrupted windows
+    assert ms == pytest.approx(1.5, abs=1e-9)
+
+
+def test_pipeline_slope_rejects_degenerate_depths():
+    with pytest.raises(ValueError):
+        timing.pipeline_slope_ms(lambda p: np.zeros(1), [object()], 7, 7)
+
+
+def test_transport_floor_is_positive():
+    assert timing.transport_floor_ms(reps=2) > 0.0
+
+
+def test_measure_service_local_smoke():
+    """One tiny local-mode simulation through the real store + gateway +
+    dispatcher stack: sane metrics, perfect correctness."""
+    from tpu_faas.bench.harness import measure_service
+
+    res = measure_service(
+        mode="local",
+        n_workers=2,
+        n_procs=2,
+        tasks_per_worker=2,
+        workload="arithmetic",
+        size=100,
+        n_sims=1,
+        timeout=60.0,
+    )
+    assert res.n_tasks == 4
+    assert res.correctness_rate == 1.0
+    assert res.throughput_tps > 0
+    assert res.avg_latency_s > 0
+    assert res.time_to_register_s > 0
+    d = res.to_dict()
+    assert d["mode"] == "local" and d["sims"] == 1

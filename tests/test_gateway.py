@@ -289,3 +289,39 @@ def test_execute_batch_schema_and_memory_store_path(gw):
         ).status_code
         == 400
     )
+
+
+def test_gateway_replicas_share_registry_through_store():
+    """Two gateway replicas over one store: a function registered via
+    replica A is invocable via replica B, and either replica serves the
+    result — the registry lives in the store (function:<id> hashes), not in
+    gateway memory, so gateways scale horizontally behind a load balancer."""
+    from tpu_faas.core.executor import execute_fn
+    from tpu_faas.core.task import TaskStatus
+
+    store = MemoryStore()
+    a = start_gateway_thread(store)
+    b = start_gateway_thread(store)
+    try:
+        fid = requests.post(
+            f"{a.url}/register_function",
+            json={"name": "arithmetic", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        r = requests.post(
+            f"{b.url}/execute_function",
+            json={"function_id": fid, "payload": serialize(((7,), {}))},
+        )
+        assert r.status_code == 200
+        tid = r.json()["task_id"]
+        # finish the task out-of-band (no dispatcher in this test)
+        fields = store.hgetall(tid)
+        _, status, result = execute_fn(
+            tid, fields["fn_payload"], fields["param_payload"]
+        )
+        store.finish_task(tid, status, result)
+        for url in (a.url, b.url):
+            body = requests.get(f"{url}/result/{tid}").json()
+            assert body["status"] == str(TaskStatus.COMPLETED)
+    finally:
+        a.stop()
+        b.stop()

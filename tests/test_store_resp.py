@@ -75,6 +75,9 @@ def test_resp_store_contract(store_server):
     assert s.hget("k", "a") == "1"
     assert s.hget("k", "zzz") is None
     assert s.hgetall("k") == {"a": "1", "b": "2"}
+    # HMGET: one round trip, None per missing field, missing key -> all None
+    assert s.hmget("k", ["b", "nope", "a"]) == ["2", None, "1"]
+    assert s.hmget("ghost", ["a", "b"]) == [None, None]
     assert s.keys() == ["k"]
     s.delete("k")
     assert s.hgetall("k") == {}

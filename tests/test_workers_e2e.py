@@ -24,17 +24,41 @@ from tpu_faas.workloads import make_workload, sleep_task
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+class _GroupPopen(subprocess.Popen):
+    """Popen whose kill()/terminate() signal the whole process group.
+
+    A worker owns a multiprocessing pool (children + a resource_tracker
+    helper). Crash tests SIGKILL the worker pid; with a plain Popen the
+    helpers are orphaned to pid 1 and ACCUMULATE across test runs — hundreds
+    of them were measured saturating a CI box (load >19), starving later
+    tests. start_new_session=True puts every helper in the worker's group so
+    one killpg reaps the lot."""
+
+    def kill(self) -> None:
+        try:
+            os.killpg(self.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            super().kill()
+
+    def terminate(self) -> None:
+        try:
+            os.killpg(self.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            super().terminate()
+
+
 def _spawn_worker(kind: str, n_procs: int, url: str, *extra: str):
     # extend, don't replace: PYTHONPATH may carry platform plugins
     existing = os.environ.get("PYTHONPATH", "")
     env = dict(
         os.environ, PYTHONPATH=f"{REPO}:{existing}" if existing else REPO
     )
-    return subprocess.Popen(
+    return _GroupPopen(
         [sys.executable, "-m", f"tpu_faas.worker.{kind}", str(n_procs), url]
         + list(extra),
         env=env,
         cwd=REPO,
+        start_new_session=True,
     )
 
 

@@ -19,6 +19,7 @@ Differences from the reference, by design:
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
@@ -90,7 +91,10 @@ class PendingTask:
             except ValueError:
                 cost = None
             else:
-                if not (cost > 0.0):  # rejects NaN and non-positive
+                # finite positive only: cost=inf from a rogue producer would
+                # poison the float32 sizes batch and pin the task to the
+                # fastest slot forever (NaN fails the comparison too)
+                if not (math.isfinite(cost) and cost > 0.0):
                     cost = None
         return cls(
             task_id,

@@ -30,7 +30,14 @@ from collections import deque
 import numpy as np
 import zmq
 
-from tpu_faas.core.task import FIELD_FN, FIELD_PARAMS, FIELD_STATUS, TaskStatus
+from tpu_faas.core.task import (
+    FIELD_COST,
+    FIELD_FN,
+    FIELD_PARAMS,
+    FIELD_PRIORITY,
+    FIELD_STATUS,
+    TaskStatus,
+)
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
     PendingTask,
@@ -39,6 +46,9 @@ from tpu_faas.dispatch.base import (
 from tpu_faas.sched.state import SchedulerArrays
 from tpu_faas.utils.logging import TickTracer
 from tpu_faas.worker import messages as m
+
+#: What a reclaim needs to rebuild a PendingTask — everything BUT the result
+_RECLAIM_FIELDS = [FIELD_FN, FIELD_PARAMS, FIELD_PRIORITY, FIELD_COST]
 
 
 class TpuPushDispatcher(TaskDispatcher):
@@ -279,7 +289,13 @@ class TpuPushDispatcher(TaskDispatcher):
                     )
                     drops.append((slot, task_id))
                     continue
-                fields = self.store.hgetall(task_id)
+                # hmget, not hgetall: the hash may already hold a huge
+                # result blob (zombie wrote it before the purge) that a
+                # mass-reclaim tick must not drag across the store wire
+                vals = self.store.hmget(task_id, _RECLAIM_FIELDS)
+                fields = {
+                    f: v for f, v in zip(_RECLAIM_FIELDS, vals) if v is not None
+                }
                 if FIELD_FN not in fields or FIELD_PARAMS not in fields:
                     # payloads vanished (store flushed): nothing to
                     # re-dispatch, and leaving a retry entry would haunt a

@@ -127,6 +127,13 @@ class TaskStore(abc.ABC):
         )
         self.publish(channel, task_id)
 
+    def hmget(self, key: str, fields: list[str]) -> list[str | None]:
+        """Several fields of one hash, None per missing field. Default: a
+        loop; the RESP client sends one HMGET round trip — the dispatcher's
+        reclaim path uses this so re-queuing a dead worker's task never
+        drags the (possibly huge) result blob over the wire."""
+        return [self.hget(key, f) for f in fields]
+
     def hget_many(self, keys: list[str], field: str) -> list[str | None]:
         """One field from many hashes. Default: a loop (one round trip per
         key); the RESP client overrides with a pipelined single round trip —

@@ -259,6 +259,9 @@ class RespStore(TaskStore):
         flat = self._command("HGETALL", key)
         return dict(zip(flat[0::2], flat[1::2]))
 
+    def hmget(self, key: str, fields: list[str]) -> list[str | None]:
+        return self._command("HMGET", key, *fields)
+
     def delete(self, key: str) -> None:
         self._command("DEL", key)
 

@@ -1,0 +1,226 @@
+"""Worker-fleet deployer: spawn, supervise, and cleanly stop N worker nodes.
+
+The reference kept a throwaway multi-worker launcher with SIGINT cleanup in
+its scrap heap (reference old/deploy_workers.py:9-108, including an inverted
+``--nh`` flag at :34 — not reproduced); this is the production version:
+
+- spawns N worker subprocesses (push or pull protocol) against one
+  dispatcher URL;
+- optional supervision (``--restart``): a worker that *crashes* is respawned
+  after a short backoff — combined with heartbeat purge + in-flight
+  re-dispatch on the dispatcher side this gives the fleet self-healing the
+  reference lacks (its dead workers stay dead, SURVEY §5.3);
+- SIGTERM/SIGINT forward a graceful drain to every worker (deregister,
+  finish in-flight, exit 0 — worker/drain.py) and wait; workers that ignore
+  the drain are killed after ``--stop-grace`` seconds. A worker that exits 0
+  on its own (e.g. drained by an operator) is NOT respawned.
+
+Usage::
+
+    python -m tpu_faas.worker.deploy 4 2 tcp://host:5555 --hb --restart
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from tpu_faas.utils.logging import get_logger
+
+log = get_logger("worker.deploy")
+
+
+class WorkerFleet:
+    """Owns N worker subprocesses. Not thread-safe; drive from one thread."""
+
+    def __init__(
+        self,
+        n_workers: int,
+        num_processes: int,
+        dispatcher_url: str,
+        protocol: str = "push",
+        heartbeat: bool = False,
+        hb_period: float = 1.0,
+        delay: float = 0.01,
+        restart: bool = False,
+        restart_backoff: float = 1.0,
+        stop_grace: float = 10.0,
+    ) -> None:
+        if protocol not in ("push", "pull"):
+            raise ValueError(f"unknown protocol {protocol!r}")
+        self.n_workers = n_workers
+        self.num_processes = num_processes
+        self.dispatcher_url = dispatcher_url
+        self.protocol = protocol
+        self.heartbeat = heartbeat
+        self.hb_period = hb_period
+        self.delay = delay
+        self.restart = restart
+        self.restart_backoff = restart_backoff
+        self.stop_grace = stop_grace
+        self.procs: list[subprocess.Popen | None] = [None] * n_workers
+        self.restarts = 0
+        self._stopping = False
+        #: slot -> monotonic time when its crashed worker may respawn;
+        #: non-blocking backoff, so shutdown never waits behind N sleeps
+        self._respawn_at: dict[int, float] = {}
+
+    def _command(self) -> list[str]:
+        mod = f"tpu_faas.worker.{self.protocol}_worker"
+        cmd = [sys.executable, "-m", mod, str(self.num_processes), self.dispatcher_url]
+        if self.protocol == "push":
+            if self.heartbeat:
+                cmd += ["--hb", "--hb-period", str(self.hb_period)]
+        else:
+            cmd += ["--delay", str(self.delay)]
+        return cmd
+
+    def _spawn(self, slot: int) -> subprocess.Popen:
+        # own process group per worker: its pool children + mp helper
+        # processes can all be reaped with one killpg if it crashes (a bare
+        # SIGKILL on the leader orphans them to pid 1, where they pile up)
+        p = subprocess.Popen(
+            self._command(), cwd=os.getcwd(), start_new_session=True
+        )
+        log.info("worker[%d] pid %d: %s", slot, p.pid, " ".join(self._command()))
+        self.procs[slot] = p
+        return p
+
+    @staticmethod
+    def _killpg(p: subprocess.Popen) -> None:
+        """SIGKILL a worker's whole process group (children + helpers); the
+        group persists while any member lives, even after the leader died."""
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            if p.poll() is None:
+                p.kill()
+
+    def start(self) -> None:
+        for i in range(self.n_workers):
+            self._spawn(i)
+
+    def poll(self) -> int:
+        """Reap exited workers; respawn crashed ones (after their backoff)
+        when supervising. Returns the number of currently-live workers."""
+        now = time.monotonic()
+        for slot in list(self._respawn_at):
+            if self._stopping or not self.restart:
+                del self._respawn_at[slot]
+            elif now >= self._respawn_at[slot]:
+                del self._respawn_at[slot]
+                self.restarts += 1
+                self._spawn(slot)
+        live = 0
+        for i, p in enumerate(self.procs):
+            if p is None:
+                continue
+            rc = p.poll()
+            if rc is None:
+                live += 1
+                continue
+            self.procs[i] = None
+            if rc == 0 or self._stopping or not self.restart:
+                # clean exit (operator drained it) or shutdown: don't revive
+                log.info("worker[%d] exited rc=%d", i, rc)
+                continue
+            log.warning(
+                "worker[%d] crashed rc=%d; respawning in %.1fs",
+                i, rc, self.restart_backoff,
+            )
+            self._killpg(p)  # reap the dead leader's orphaned pool/helpers
+            self._respawn_at[i] = now + self.restart_backoff
+        return live
+
+    def stop(self) -> None:
+        """Graceful drain: SIGTERM everyone (workers deregister + finish
+        in-flight), wait up to stop_grace, then SIGKILL stragglers."""
+        self._stopping = True
+        self._respawn_at.clear()
+        for p in self.procs:
+            if p is not None and p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + self.stop_grace
+        for p in self.procs:
+            if p is None:
+                continue
+            remaining = max(0.0, deadline - time.monotonic())
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                log.warning("worker pid %d ignored drain; killing", p.pid)
+                self._killpg(p)
+                p.wait()
+        self.procs = [None] * self.n_workers
+
+    @property
+    def n_live(self) -> int:
+        return sum(1 for p in self.procs if p is not None and p.poll() is None)
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description="tpu-faas worker fleet deployer")
+    ap.add_argument("n_workers", type=int, help="worker nodes to spawn")
+    ap.add_argument("num_processes", type=int, help="pool size per worker")
+    ap.add_argument("dispatcher_url", help="tcp://host:port of the dispatcher")
+    ap.add_argument("--protocol", choices=["push", "pull"], default="push")
+    ap.add_argument("--hb", action="store_true", help="push: heartbeats on")
+    ap.add_argument("--hb-period", type=float, default=1.0)
+    ap.add_argument("--delay", type=float, default=0.01, help="pull pacing")
+    ap.add_argument(
+        "--restart", action="store_true",
+        help="respawn crashed (non-zero-exit) workers",
+    )
+    ap.add_argument("--restart-backoff", type=float, default=1.0)
+    ap.add_argument("--stop-grace", type=float, default=10.0)
+    ns = ap.parse_args(argv)
+
+    fleet = WorkerFleet(
+        ns.n_workers,
+        ns.num_processes,
+        ns.dispatcher_url,
+        protocol=ns.protocol,
+        heartbeat=ns.hb,
+        hb_period=ns.hb_period,
+        delay=ns.delay,
+        restart=ns.restart,
+        restart_backoff=ns.restart_backoff,
+        stop_grace=ns.stop_grace,
+    )
+
+    stop_requested = False
+
+    def on_signal(signum, frame):
+        nonlocal stop_requested
+        stop_requested = True
+        # a foreground Ctrl-C delivers SIGINT to the whole process group:
+        # the workers die with rc!=0 at the same instant, and a poll() racing
+        # this handler must treat those as shutdown, not crashes to respawn
+        fleet._stopping = True
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    fleet.start()
+    log.info(
+        "%d %s workers x %d processes -> %s (restart=%s)",
+        ns.n_workers, ns.protocol, ns.num_processes, ns.dispatcher_url,
+        ns.restart,
+    )
+    try:
+        while not stop_requested:
+            if fleet.poll() == 0 and not ns.restart:
+                log.info("all workers exited; deployer done")
+                return
+            time.sleep(0.2)
+    finally:
+        log.info("draining fleet (%d live)", fleet.n_live)
+        fleet.stop()
+
+
+if __name__ == "__main__":
+    main()
