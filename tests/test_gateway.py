@@ -325,3 +325,38 @@ def test_gateway_replicas_share_registry_through_store():
     finally:
         a.stop()
         b.stop()
+
+
+def test_long_poll_wakes_on_result_publish():
+    """A parked ``/result?wait=`` request must return almost immediately
+    after finish_task lands — woken by the results-channel announce, not by
+    the coarse fallback re-read (0.5 s+)."""
+    import threading
+    import time
+
+    from tpu_faas.core.task import TaskStatus
+
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    try:
+        store.create_task("wk1", "F", "P")
+        got = {}
+
+        def parked():
+            r = requests.get(
+                f"{handle.url}/result/wk1", params={"wait": 10}, timeout=15
+            )
+            got["at"] = time.monotonic()
+            got["body"] = r.json()
+
+        th = threading.Thread(target=parked)
+        th.start()
+        time.sleep(0.6)  # past the first fallback window, request is parked
+        t_finish = time.monotonic()
+        store.finish_task("wk1", "COMPLETED", serialize(42))
+        th.join(timeout=5)
+        assert got["body"]["status"] == str(TaskStatus.COMPLETED)
+        wake_latency = got["at"] - t_finish
+        assert wake_latency < 0.4, f"woke by fallback, not publish: {wake_latency:.3f}s"
+    finally:
+        handle.stop()

@@ -210,3 +210,29 @@ def test_zombie_identity_does_not_alias_recycled_row():
     # zombie re-register with a full table raises rather than stealing the row
     with pytest.raises(RuntimeError):
         s.register(b"old", num_processes=4)
+
+
+@pytest.mark.parametrize("placement", ["auction", "sinkhorn"])
+def test_scheduler_arrays_placement_kernels_live(placement):
+    """The fused tick can serve the auction/Sinkhorn kernels in place of
+    rank-match (dispatcher --placement knob): same fleet bookkeeping, full
+    capacity placed, only live rows used."""
+    arrays = SchedulerArrays(
+        max_workers=8,
+        max_pending=32,
+        max_inflight=16,
+        max_slots=2,
+        placement=placement,
+    )
+    rows = [arrays.register(f"w{i}".encode(), 2) for i in range(4)]
+    out = arrays.tick(np.ones(10, dtype=np.float32))
+    a = np.asarray(out.assignment)[:10]
+    assert (a >= 0).sum() == 8  # 4 workers x 2 free slots
+    assert set(a[a >= 0]) <= set(rows)
+
+
+def test_scheduler_tick_rejects_unknown_placement():
+    arrays = SchedulerArrays(max_workers=4, max_pending=8, placement="magic")
+    arrays.register(b"w0", 2)
+    with pytest.raises(ValueError, match="unknown placement"):
+        arrays.tick(np.ones(2, dtype=np.float32))

@@ -78,6 +78,19 @@ def test_resp_store_contract(store_server):
     # HMGET: one round trip, None per missing field, missing key -> all None
     assert s.hmget("k", ["b", "nope", "a"]) == ["2", None, "1"]
     assert s.hmget("ghost", ["a", "b"]) == [None, None]
+    # finish_task announces the terminal write on the results channel
+    from tpu_faas.store.base import RESULTS_CHANNEL
+
+    with s.subscribe(RESULTS_CHANNEL) as rsub:
+        s.create_task("rt1", "F", "P")
+        s.finish_task("rt1", "COMPLETED", "R")
+        assert rsub.get_message(timeout=2.0) == "rt1"
+        assert s.get_result("rt1") == ("COMPLETED", "R")
+        # frozen first_wins write: no second announce
+        s.finish_task("rt1", "FAILED", "X", first_wins=True)
+        assert rsub.get_message(timeout=0.3) is None
+        assert s.get_result("rt1") == ("COMPLETED", "R")
+        s.delete("rt1")
     assert s.keys() == ["k"]
     s.delete("k")
     assert s.hgetall("k") == {}

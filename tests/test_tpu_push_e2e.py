@@ -315,3 +315,35 @@ def test_tpu_push_scale_16_workers_500_tasks():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def test_tpu_push_auction_placement_e2e():
+    """The --placement auction kernel serving live traffic: unmodified
+    workers, every result correct."""
+    from tpu_faas.workloads import arithmetic
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url, placement="auction")
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(arithmetic)
+        handles = client.submit_many(fid, [((50 + i,), {}) for i in range(10)])
+        assert [h.result(timeout=120) for h in handles] == [
+            arithmetic(50 + i) for i in range(10)
+        ]
+    finally:
+        for w in workers:
+            w.kill()
+            w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

@@ -71,6 +71,12 @@ def main(argv: list[str] | None = None) -> None:
         "--max-fleet", type=int, default=cfg.max_workers,
         help="tpu-push: padded worker-fleet size",
     )
+    ap.add_argument(
+        "--placement", choices=["rank", "auction", "sinkhorn"], default="rank",
+        help="tpu-push: placement kernel (rank = Monge-optimal default with "
+        "priority classes; auction = general costs; sinkhorn = soft "
+        "heterogeneous balancing)",
+    )
     ns = ap.parse_args(argv)
     if ns.delay:
         time.sleep(ns.delay)
@@ -110,6 +116,7 @@ def main(argv: list[str] | None = None) -> None:
             tick_period=ns.tick_period,
             max_pending=ns.max_pending,
             max_workers=ns.max_fleet,
+            placement=ns.placement,
         )
     elif ns.mode == "pull":
         # pull workers have no heartbeat protocol (reference SURVEY §3.4)

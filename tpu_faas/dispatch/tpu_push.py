@@ -69,6 +69,8 @@ class TpuPushDispatcher(TaskDispatcher):
         rescan_period: float = 10.0,
         max_task_retries: int = 3,
         clock=time.monotonic,
+        placement: str = "rank",
+        liveness_period: float | None = None,
     ) -> None:
         super().__init__(store_url=store_url, channel=channel, store=store)
         self.ctx = zmq.Context.instance()
@@ -89,8 +91,22 @@ class TpuPushDispatcher(TaskDispatcher):
             max_slots=max_slots,
             time_to_expire=time_to_expire,
             clock=clock,
+            placement=placement,
         )
         self.pending: deque[PendingTask] = deque()
+        #: max seconds between device ticks when there is nothing to place.
+        #: The device step also performs liveness detection (purge +
+        #: in-flight redistribution), which must keep running on an idle or
+        #: saturated fleet — but at heartbeat granularity, not tick_period:
+        #: a synchronous device call blocks the recv loop (over a tunneled
+        #: dev transport, ~100 ms each), so an idle dispatcher ticking every
+        #: 5 ms would burn the device AND starve worker messages for
+        #: nothing. Default: time_to_expire/4 capped at 1 s.
+        self.liveness_period = (
+            liveness_period
+            if liveness_period is not None
+            else min(1.0, time_to_expire / 4.0)
+        )
         self.tracer = TickTracer()
         self.max_task_retries = max_task_retries
         # reclaim count per task (poison guard); entries exist only for tasks
@@ -220,14 +236,12 @@ class TpuPushDispatcher(TaskDispatcher):
         }
 
     # -- one scheduler tick ------------------------------------------------
-    def tick(self) -> int:
-        """Intake + device step + act on outputs. Returns tasks dispatched."""
-        a = self.arrays
-        # intake from the announce bus, bounded by the padded batch size;
-        # ids already pending (e.g. adopted by a stranded rescan while the
-        # same announce sat buffered in the subscription) are dropped so a
-        # task is never dispatched twice
-        room = a.max_pending - len(self.pending)
+    def _intake(self) -> None:
+        """Drain the announce bus into the pending buffer, bounded by the
+        padded batch size; ids already pending (e.g. adopted by a stranded
+        rescan while the same announce sat buffered in the subscription) are
+        dropped so a task is never dispatched twice."""
+        room = self.arrays.max_pending - len(self.pending)
         if room > 0:
             seen = {t.task_id for t in self.pending}
             for t in self.poll_tasks(room):
@@ -235,6 +249,16 @@ class TpuPushDispatcher(TaskDispatcher):
                     continue
                 seen.add(t.task_id)
                 self.pending.append(t)
+
+    def tick(self, intake: bool = True) -> int:
+        """Intake + device step + act on outputs. Returns tasks dispatched.
+
+        ``intake=False`` when the caller just drained the bus itself (the
+        serve loop does, to evaluate the device-step gate) — a second drain
+        microseconds later would only rebuild the seen-set for nothing."""
+        a = self.arrays
+        if intake:
+            self._intake()
 
         # the device batch is capped at max_pending; overflow (possible when
         # a purge re-queued tasks into an already-full queue) waits its turn
@@ -373,6 +397,7 @@ class TpuPushDispatcher(TaskDispatcher):
     def start(self, max_results: int | None = None) -> int:
         try:
             last_tick = 0.0
+            last_device = 0.0  # 0 forces a first tick (seeds prev_live)
             last_rescan = self.clock()
             while not self.stopping:
                 # a store outage must degrade the dispatcher (workers keep
@@ -412,7 +437,21 @@ class TpuPushDispatcher(TaskDispatcher):
                 now = self.clock()
                 if now - last_tick >= self.tick_period:
                     try:
-                        self.tick()
+                        self._intake()
+                        a = self.arrays
+                        # gate the device step: a synchronous device call
+                        # blocks this loop, so only pay for it when there is
+                        # something to place AND somewhere to put it, or the
+                        # periodic liveness check is due (purge/redispatch
+                        # happen inside the device step)
+                        free_any = bool(
+                            np.any(a.worker_active & (a.worker_free > 0))
+                        )
+                        if (self.pending and free_any) or (
+                            now - last_device >= self.liveness_period
+                        ):
+                            self.tick(intake=False)
+                            last_device = now
                     except STORE_OUTAGE_ERRORS as exc:
                         self.note_store_outage(exc)
                     last_tick = now

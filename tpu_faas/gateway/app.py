@@ -45,7 +45,7 @@ from tpu_faas.core.task import (
     new_function_id,
     new_task_id,
 )
-from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
+from tpu_faas.store.base import RESULTS_CHANNEL, TASKS_CHANNEL, TaskStore
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import TickTracer, get_logger
 
@@ -63,10 +63,92 @@ async def _run_blocking(fn, *args):
     return await loop.run_in_executor(None, functools.partial(fn, *args))
 
 
+class _ResultWaiters:
+    """Wakes parked /result long-polls when the store announces a terminal
+    write on RESULTS_CHANNEL.
+
+    A pump thread (its own store subscription — a dedicated connection, so
+    it never interleaves with handler traffic) drains the channel and sets
+    the matching task's waiter events via the app loop. Each parked handler
+    owns a PRIVATE asyncio.Event (one fire sets them all): a shared event
+    would let one handler's clear() erase a wake another handler hadn't
+    consumed yet. Handlers drop their event on exit, fired or not, so
+    abandoned waits can't leak entries. The channel is fire-and-forget:
+    handlers keep a coarse fallback re-read, and a pump that loses its
+    subscription (store restart) just resubscribes."""
+
+    def __init__(self, store: TaskStore):
+        self.store = store
+        self._events: dict[str, list[asyncio.Event]] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self, loop: asyncio.AbstractEventLoop) -> None:
+        self._loop = loop
+        self._thread = threading.Thread(
+            target=self._pump, name="gateway-result-wakeups", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Blocking (joins the pump — which may itself sit in a connect
+        timeout against a dead store); call off-loop."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def acquire(self, task_id: str) -> asyncio.Event:
+        ev = asyncio.Event()
+        self._events.setdefault(task_id, []).append(ev)
+        return ev
+
+    def release(self, task_id: str, event: asyncio.Event) -> None:
+        waiters = self._events.get(task_id)
+        if waiters is None:
+            return
+        try:
+            waiters.remove(event)
+        except ValueError:
+            pass
+        if not waiters:
+            self._events.pop(task_id, None)
+
+    def _fire(self, task_id: str) -> None:
+        for ev in self._events.get(task_id, ()):
+            ev.set()
+
+    def fire_all(self) -> None:
+        """Shutdown: wake every parked poll NOW (each re-checks ctx.stopping
+        and replies) instead of letting them ride out the fallback timeout."""
+        for waiters in self._events.values():
+            for ev in waiters:
+                ev.set()
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            try:
+                with self.store.subscribe(RESULTS_CHANNEL) as sub:
+                    while not self._stop.is_set():
+                        msg = sub.get_message(timeout=0.5)
+                        if msg is not None and self._loop is not None:
+                            self._loop.call_soon_threadsafe(self._fire, msg)
+            except Exception as exc:
+                if self._stop.is_set():
+                    return
+                log.warning(
+                    "result-wakeup subscription lost (%s); parked polls fall "
+                    "back to store re-reads until it resubscribes", exc
+                )
+                self._stop.wait(1.0)
+
+
 @dataclass
 class GatewayContext:
     store: TaskStore
     channel: str = TASKS_CHANNEL
+    #: wake-on-publish for parked long-polls; started on app startup
+    waiters: "_ResultWaiters | None" = None
     #: set on app shutdown so parked long-polls reply immediately instead of
     #: holding the server (and its stop()) for up to _MAX_WAIT_S
     stopping: asyncio.Event = field(default_factory=asyncio.Event)
@@ -120,9 +202,22 @@ def make_app(store: TaskStore, channel: str = TASKS_CHANNEL) -> web.Application:
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
 
+    async def _start_wakeups(_app: web.Application) -> None:
+        ctx.waiters = _ResultWaiters(store)
+        ctx.waiters.start(asyncio.get_running_loop())
+
     async def _release_waiters(_app: web.Application) -> None:
         ctx.stopping.set()
+        if ctx.waiters is not None:
+            ctx.waiters.fire_all()
+            # stop() blocks on the pump-thread join (which can sit in a
+            # connect timeout against a dead store) — run it off-loop so the
+            # just-woken parked polls can actually send their replies
+            await asyncio.get_running_loop().run_in_executor(
+                None, ctx.waiters.stop
+            )
 
+    app.on_startup.append(_start_wakeups)
     app.on_shutdown.append(_release_waiters)
     return app
 
@@ -279,15 +374,21 @@ async def get_status(request: web.Request) -> web.Response:
 #: Long-poll cap: bounds handler lifetime (proxies and LB idle timeouts
 #: commonly sit at 30-60 s).
 _MAX_WAIT_S = 30.0
-_WAIT_POLL_S = 0.02
-_WAIT_POLL_MAX_S = 0.25
+#: Fallback re-read cadence for parked long-polls. The fast path is the
+#: RESULTS_CHANNEL wake-up (_ResultWaiters) — these re-reads only catch a
+#: lost publish (fire-and-forget bus, subscription reconnect gap), so they
+#: can be coarse: parked waiters must not saturate the shared executor
+#: (each re-read is a blocking store call on the default thread pool).
+_WAIT_POLL_S = 0.5
+_WAIT_POLL_MAX_S = 2.0
 
 
 async def get_result(request: web.Request) -> web.Response:
     """``?wait=N`` long-polls: hold the request up to N seconds (capped)
     until the task is terminal, then reply immediately — one request
-    replaces hundreds of 10 ms polls per task. ``wait`` absent or 0 keeps
-    the reference's immediate-reply contract."""
+    replaces hundreds of 10 ms polls per task. Parked requests are woken by
+    the store's terminal-write announce the moment the result lands;
+    ``wait`` absent or 0 keeps the reference's immediate-reply contract."""
     ctx: GatewayContext = request.app[CTX_KEY]
     task_id = request.match_info["task_id"]
     try:
@@ -300,22 +401,39 @@ async def get_result(request: web.Request) -> web.Response:
     loop = asyncio.get_running_loop()
     deadline = loop.time() + wait_s
     poll_s = _WAIT_POLL_S
-    while True:
-        status, result = await _run_blocking(ctx.store.get_result, task_id)
-        if status is None:
-            return _json_error(404, f"unknown task_id {task_id!r}")
-        try:
-            terminal = TaskStatus(status).is_terminal()
-        except ValueError:
-            terminal = True  # unknown status string: reply, don't 500/hang
-        if terminal or loop.time() >= deadline or ctx.stopping.is_set():
-            return web.json_response(
-                {"task_id": task_id, "status": status, "result": result}
-            )
-        await asyncio.sleep(poll_s)
-        # backoff: parked waiters must not saturate the shared executor
-        # (each poll is a blocking store call on the default thread pool)
-        poll_s = min(poll_s * 1.5, _WAIT_POLL_MAX_S)
+    waiters = ctx.waiters
+    event = waiters.acquire(task_id) if waiters is not None and wait_s > 0 else None
+    try:
+        while True:
+            # clear BEFORE the read: an announce landing between the read
+            # and the wait then leaves the event set, so the wait returns at
+            # once and the next read observes the terminal record — the
+            # wake-up can be consumed spuriously but never lost
+            if event is not None:
+                event.clear()
+            status, result = await _run_blocking(ctx.store.get_result, task_id)
+            if status is None:
+                return _json_error(404, f"unknown task_id {task_id!r}")
+            try:
+                terminal = TaskStatus(status).is_terminal()
+            except ValueError:
+                terminal = True  # unknown status string: reply, don't 500/hang
+            if terminal or loop.time() >= deadline or ctx.stopping.is_set():
+                return web.json_response(
+                    {"task_id": task_id, "status": status, "result": result}
+                )
+            pause = min(poll_s, max(0.0, deadline - loop.time()))
+            if event is not None:
+                try:
+                    await asyncio.wait_for(event.wait(), timeout=pause)
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                await asyncio.sleep(pause)
+            poll_s = min(poll_s * 1.5, _WAIT_POLL_MAX_S)
+    finally:
+        if event is not None and waiters is not None:
+            waiters.release(task_id, event)
 
 
 async def delete_task(request: web.Request) -> web.Response:

@@ -35,7 +35,7 @@ class TickOutput(NamedTuple):
     assigned_count: jnp.ndarray  # i32[W] tasks handed to each worker this tick
 
 
-@partial(jax.jit, static_argnames=("max_slots",))
+@partial(jax.jit, static_argnames=("max_slots", "placement"))
 def scheduler_tick(
     task_size: jnp.ndarray,  # f32[T]
     task_valid: jnp.ndarray,  # bool[T]
@@ -48,6 +48,7 @@ def scheduler_tick(
     time_to_expire: jnp.ndarray,  # f32 scalar
     max_slots: int = 8,
     task_priority: jnp.ndarray | None = None,  # i32[T], higher admitted first
+    placement: str = "rank",  # rank | auction | sinkhorn
 ) -> TickOutput:
     # -- failure detection (reference purge_workers, device-side) ----------
     # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
@@ -64,10 +65,32 @@ def scheduler_tick(
     redispatch = occupied & ~live[worker_of]
 
     # -- batched placement -------------------------------------------------
-    assignment = rank_match_placement(
-        task_size, task_valid, worker_speed, worker_free, live,
-        max_slots=max_slots, task_priority=task_priority,
-    )
+    # rank is the production default (Monge-optimal for the size/speed cost,
+    # cheapest, and the only one with hard priority classes); auction and
+    # Sinkhorn serve live for operators whose cost structure needs them
+    # (general costs / heterogeneous soft balancing) — they ignore
+    # task_priority, whose admission-ordering contract is rank-specific
+    if placement == "rank":
+        assignment = rank_match_placement(
+            task_size, task_valid, worker_speed, worker_free, live,
+            max_slots=max_slots, task_priority=task_priority,
+        )
+    elif placement == "auction":
+        from tpu_faas.sched.auction import auction_placement
+
+        assignment = auction_placement(
+            task_size, task_valid, worker_speed, worker_free, live,
+            max_slots=max_slots,
+        ).assignment
+    elif placement == "sinkhorn":
+        from tpu_faas.sched.sinkhorn import sinkhorn_placement
+
+        assignment = sinkhorn_placement(
+            task_size, task_valid, worker_speed, worker_free, live,
+            max_slots=max_slots,
+        ).assignment
+    else:
+        raise ValueError(f"unknown placement kernel {placement!r}")
     assigned_count = jnp.zeros_like(worker_free).at[
         jnp.clip(assignment, 0)
     ].add(jnp.where(assignment >= 0, 1, 0))
@@ -89,6 +112,8 @@ class SchedulerArrays:
     max_slots: int = 8
     time_to_expire: float = 10.0
     clock: "callable" = time.monotonic
+    #: placement kernel for the tick: rank (default) | auction | sinkhorn
+    placement: str = "rank"
 
     worker_speed: np.ndarray = field(init=False)
     worker_free: np.ndarray = field(init=False)
@@ -250,6 +275,7 @@ class SchedulerArrays:
             jnp.float32(self.time_to_expire),
             max_slots=self.max_slots,
             task_priority=prio,
+            placement=self.placement,
         )
         self.prev_live = np.asarray(out.live)
         return out

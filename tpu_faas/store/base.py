@@ -31,6 +31,12 @@ from tpu_faas.core.task import (
 
 #: Default announce channel name (reference config.ini:7 `TASKS_CHANNEL=tasks`).
 TASKS_CHANNEL = "tasks"
+#: Results channel: finish_task announces every terminal write here so the
+#: gateway can wake parked /result long-polls instantly instead of polling
+#: the store. No reference analog (its clients poll, SURVEY §3.1); the
+#: channel is fire-and-forget like the task bus — consumers must keep a
+#: fallback re-read, never rely on delivery.
+RESULTS_CHANNEL = "results"
 
 
 class Subscription(abc.ABC):
@@ -188,16 +194,22 @@ class TaskStore(abc.ABC):
         path (first result from the task's current worker) stays one write,
         one RTT. The read-then-write pair is not atomic, but all result
         writes flow through the single dispatcher process, so there is no
-        concurrent writer to race with."""
-        if first_wins:
-            current = self.get_status(task_id)
-            # absent counts as frozen too: a record deleted by the client
-            # (DELETE /task after consuming the result) must not be
-            # resurrected as a partial status+result hash by a zombie's
-            # late write
-            if current is None or TaskStatus(current).is_terminal():
-                return
+        concurrent writer to race with.
+
+        After the write the task_id is announced on RESULTS_CHANNEL (after,
+        so a woken subscriber always reads the terminal record)."""
+        if first_wins and self._result_frozen(task_id):
+            return
         self.hset(task_id, {FIELD_STATUS: str(status), FIELD_RESULT: result})
+        self.publish(RESULTS_CHANNEL, task_id)
+
+    def _result_frozen(self, task_id: str) -> bool:
+        """first_wins guard: True when the record must not be overwritten —
+        already terminal, or absent (a record the client consumed and
+        DELETEd must not be resurrected as a partial status+result hash by a
+        zombie's late write)."""
+        current = self.get_status(task_id)
+        return current is None or TaskStatus(current).is_terminal()
 
     def get_result(self, task_id: str) -> tuple[str | None, str | None]:
         """(status, result) in one round-trip — the client poll hot path."""

@@ -18,7 +18,12 @@ import threading
 from typing import Mapping
 
 from tpu_faas.store import resp
-from tpu_faas.store.base import TASKS_CHANNEL, Subscription, TaskStore
+from tpu_faas.store.base import (
+    RESULTS_CHANNEL,
+    TASKS_CHANNEL,
+    Subscription,
+    TaskStore,
+)
 
 #: Commands that must not be replayed after an ambiguous connection loss —
 #: replaying a PUBLISH announces (and therefore dispatches) a task twice, and
@@ -261,6 +266,44 @@ class RespStore(TaskStore):
 
     def hmget(self, key: str, fields: list[str]) -> list[str | None]:
         return self._command("HMGET", key, *fields)
+
+    def finish_task(
+        self,
+        task_id: str,
+        status,
+        result: str,
+        first_wins: bool = False,
+    ) -> None:
+        """Base semantics (terminal write + RESULTS_CHANNEL announce), but
+        the write and the announce ride ONE pipelined round trip — the
+        result path is the dispatcher's per-task hot path and must not grow
+        a second RTT for the wake-up feature."""
+        from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS
+
+        if first_wins and self._result_frozen(task_id):
+            return
+        cmds = [
+            (
+                "HSET", task_id,
+                FIELD_STATUS, str(status),
+                FIELD_RESULT, result,
+            ),
+            ("PUBLISH", RESULTS_CHANNEL, task_id),
+        ]
+        try:
+            replies = self.pipeline(cmds)
+        except (ConnectionError, TimeoutError):
+            # retry once on a fresh connection (pipeline() dropped the dead
+            # one), preserving the transparent reconnect result writes had
+            # via _command before pipelining. Unlike the task-announce
+            # PUBLISH (non-idempotent: a replay dispatches a task twice),
+            # replaying THIS pair is safe — HSET lands the same end state
+            # and a duplicate RESULTS_CHANNEL publish is just a spurious
+            # wake the gateway handlers tolerate by design.
+            replies = self.pipeline(cmds)
+        errors = [r for r in replies if isinstance(r, resp.RespError)]
+        if errors:
+            raise errors[0]
 
     def delete(self, key: str) -> None:
         self._command("DEL", key)

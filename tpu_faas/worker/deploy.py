@@ -124,6 +124,12 @@ class WorkerFleet:
                 live += 1
                 continue
             self.procs[i] = None
+            if rc != 0:
+                # ANY crash (supervised or not) reaps the dead leader's
+                # orphaned pool children/helpers — the pid-1 pile-up this
+                # module exists to prevent; a clean exit (rc=0) drained its
+                # own pool and needs no group kill
+                self._killpg(p)
             if rc == 0 or self._stopping or not self.restart:
                 # clean exit (operator drained it) or shutdown: don't revive
                 log.info("worker[%d] exited rc=%d", i, rc)
@@ -132,7 +138,6 @@ class WorkerFleet:
                 "worker[%d] crashed rc=%d; respawning in %.1fs",
                 i, rc, self.restart_backoff,
             )
-            self._killpg(p)  # reap the dead leader's orphaned pool/helpers
             self._respawn_at[i] = now + self.restart_backoff
         return live
 
@@ -155,6 +160,10 @@ class WorkerFleet:
                 log.warning("worker pid %d ignored drain; killing", p.pid)
                 self._killpg(p)
                 p.wait()
+            if p.returncode != 0:
+                # leader died before (or during) the drain without cleaning
+                # up: reap its surviving group members too
+                self._killpg(p)
         self.procs = [None] * self.n_workers
 
     @property
