@@ -126,4 +126,22 @@ def test_async_client_end_to_end(stack):
             assert await done.result(timeout=30) == arithmetic(7)
             await done.forget()
 
+            # scheduling hints mirror the sync surface (submit_with +
+            # submit_many parallel lists); local mode ignores them, but the
+            # gateway must accept and store the fields
+            h = await client.submit_with(
+                fid, args=(11,), priority=3, cost=1.5
+            )
+            assert await h.result(timeout=30) == arithmetic(11)
+            hinted = await client.submit_many(
+                fid,
+                [((n,), {}) for n in range(300, 303)],
+                priorities=[2, 1, 0],
+                costs=[1.0, 2.0, 3.0],
+            )
+            values = await asyncio.gather(
+                *(x.result(timeout=60) for x in hinted)
+            )
+            assert values == [arithmetic(n) for n in range(300, 303)]
+
     asyncio.run(scenario())

@@ -154,13 +154,15 @@ def test_scheduler_tick_liveness_purge_redistribution():
     assert np.asarray(out.assignment)[0] >= 0
 
 
-def test_scheduler_tick_assigned_count_matches_assignment():
+def test_scheduler_tick_assigned_counts_host_side():
+    """Per-worker counts come from the host-side bincount helper (the
+    device tick deliberately doesn't scatter-add them — TickOutput note)."""
     s = SchedulerArrays(max_workers=4, max_pending=8, clock=FakeClock(0.0))
     s.register(b"a", 3)
     s.register(b"b", 1)
     out = s.tick(np.array([1.0, 1.0, 1.0, 1.0], dtype=np.float32))
     a = np.asarray(out.assignment)
-    counts = np.asarray(out.assigned_count)
+    counts = SchedulerArrays.assigned_counts(a, 4)
     for w in range(4):
         assert counts[w] == (a == w).sum()
     assert counts.sum() == 4
@@ -231,8 +233,8 @@ def test_scheduler_arrays_placement_kernels_live(placement):
     assert set(a[a >= 0]) <= set(rows)
 
 
-def test_scheduler_tick_rejects_unknown_placement():
-    arrays = SchedulerArrays(max_workers=4, max_pending=8, placement="magic")
-    arrays.register(b"w0", 2)
+def test_scheduler_arrays_rejects_unknown_placement_at_construction():
+    # fail fast: a dispatcher must not bind its port and adopt tasks only
+    # to die on the first device tick of a typo'd kernel name
     with pytest.raises(ValueError, match="unknown placement"):
-        arrays.tick(np.ones(2, dtype=np.float32))
+        SchedulerArrays(max_workers=4, max_pending=8, placement="magic")

@@ -126,9 +126,13 @@ class _ResultWaiters:
                 ev.set()
 
     def _pump(self) -> None:
+        down = False  # log once per outage, not once per retry
         while not self._stop.is_set():
             try:
                 with self.store.subscribe(RESULTS_CHANNEL) as sub:
+                    if down:
+                        down = False
+                        log.info("result-wakeup subscription restored")
                     while not self._stop.is_set():
                         msg = sub.get_message(timeout=0.5)
                         if msg is not None and self._loop is not None:
@@ -136,10 +140,13 @@ class _ResultWaiters:
             except Exception as exc:
                 if self._stop.is_set():
                     return
-                log.warning(
-                    "result-wakeup subscription lost (%s); parked polls fall "
-                    "back to store re-reads until it resubscribes", exc
-                )
+                if not down:
+                    down = True
+                    log.warning(
+                        "result-wakeup subscription lost (%s); parked polls "
+                        "fall back to store re-reads until it resubscribes",
+                        exc,
+                    )
                 self._stop.wait(1.0)
 
 

@@ -191,10 +191,7 @@ def sharded_scheduler_tick(
             task_size, task_valid, worker_speed, worker_free, live,
             max_slots=max_slots, task_priority=task_priority,
         )
-    assigned_count = jnp.zeros_like(worker_free).at[
-        jnp.clip(assignment, 0)
-    ].add(jnp.where(assignment >= 0, 1, 0))
-    return TickOutput(assignment, live, purged, redispatch, assigned_count)
+    return TickOutput(assignment, live, purged, redispatch)
 
 
 def shard_task_arrays(mesh: Mesh, *arrays: jnp.ndarray):

@@ -160,10 +160,12 @@ class WorkerFleet:
                 log.warning("worker pid %d ignored drain; killing", p.pid)
                 self._killpg(p)
                 p.wait()
-            if p.returncode != 0:
-                # leader died before (or during) the drain without cleaning
-                # up: reap its surviving group members too
-                self._killpg(p)
+            else:
+                if p.returncode != 0:
+                    # leader died before the drain without cleaning up:
+                    # reap its surviving group members too (the timeout
+                    # branch above already group-killed)
+                    self._killpg(p)
         self.procs = [None] * self.n_workers
 
     @property
