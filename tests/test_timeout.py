@@ -1,0 +1,104 @@
+"""Per-task execution timeouts: a runaway task FAILs and frees its slot.
+
+No reference analog: in the reference a task that never returns occupies a
+pool process forever, silently shrinking the fleet (and its dispatcher-side
+poison guard only covers worker DEATH, not worker wedging). The budget is
+client-supplied (the ``timeout`` hint), rides the store hash and the TASK
+wire message, and is enforced inside the pool child with SIGALRM.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tpu_faas.client import FaaSClient, TaskFailedError
+from tpu_faas.core.executor import TaskTimeout, execute_fn, pack_params
+from tpu_faas.core.serialize import deserialize, serialize
+from tpu_faas.core.task import TaskStatus
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.worker.pool import TaskPool
+from tpu_faas.workloads import arithmetic, sleep_task
+from tests.test_tpu_push_e2e import _make_dispatcher
+from tests.test_workers_e2e import _spawn_worker
+
+
+def test_execute_fn_enforces_budget():
+    res = execute_fn(
+        "t1", serialize(sleep_task), pack_params(30.0), timeout=0.3
+    )
+    assert res.status == str(TaskStatus.FAILED)
+    exc = deserialize(res.result)
+    assert isinstance(exc, TaskTimeout)
+    # the itimer is disarmed: nothing fires afterwards
+    time.sleep(0.4)
+
+
+def test_execute_fn_fast_task_unaffected_by_budget():
+    res = execute_fn(
+        "t2", serialize(arithmetic), pack_params(100), timeout=30.0
+    )
+    assert res.status == str(TaskStatus.COMPLETED)
+    assert deserialize(res.result) == arithmetic(100)
+    time.sleep(0.05)  # no stray alarm
+
+
+def test_pool_slot_freed_after_timeout():
+    """The point of the feature: after a task times out, the SAME slot runs
+    the next task (without enforcement the pool would be wedged forever)."""
+    pool = TaskPool(1)
+    pool.warmup()
+    try:
+        pool.submit("slow", serialize(sleep_task), pack_params(60.0), timeout=0.5)
+        deadline = time.monotonic() + 15
+        results = []
+        while not results and time.monotonic() < deadline:
+            results = pool.drain()
+            time.sleep(0.02)
+        assert results and results[0].status == str(TaskStatus.FAILED)
+        assert isinstance(deserialize(results[0].result), TaskTimeout)
+        assert pool.free == 1  # slot reclaimed
+
+        pool.submit("ok", serialize(arithmetic), pack_params(50))
+        results = []
+        deadline = time.monotonic() + 15
+        while not results and time.monotonic() < deadline:
+            results = pool.drain()
+            time.sleep(0.02)
+        assert results and results[0].status == str(TaskStatus.COMPLETED)
+        assert deserialize(results[0].result) == arithmetic(50)
+    finally:
+        pool.close()
+
+
+def test_timeout_hint_end_to_end_push():
+    """timeout hint over the full stack: gateway -> store -> tpu-push
+    dispatcher -> unmodified push worker -> SIGALRM in the pool child. The
+    single-process worker then completes a normal task, proving the slot
+    came back."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    worker = _spawn_worker("push_worker", 1, url, "--hb", "--hb-period", "0.3")
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        h = client.submit_with(fid, args=(60.0,), timeout=0.5)
+        try:
+            h.result(timeout=60)
+            raise AssertionError("expected TaskFailedError")
+        except TaskFailedError as exc:
+            assert isinstance(exc.cause, TaskTimeout)
+        fid2 = client.register(arithmetic)
+        assert client.submit(fid2, 7).result(timeout=60) == arithmetic(7)
+    finally:
+        worker.kill()
+        worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

@@ -131,10 +131,12 @@ class AsyncFaaSClient:
         *,
         priority: int | None = None,
         cost: float | None = None,
+        timeout: float | None = None,
     ) -> AsyncTaskHandle:
         """submit() plus scheduling hints (mirrors the sync SDK): higher
         ``priority`` is admitted first under overload; ``cost`` is the
-        estimated run-cost used for task<->worker pairing."""
+        estimated run-cost used for task<->worker pairing; ``timeout`` is
+        the execution budget enforced inside the worker's pool child."""
         loop = asyncio.get_running_loop()
         payload = await loop.run_in_executor(
             None, lambda: pack_params(*args, **(kwargs or {}))
@@ -144,6 +146,8 @@ class AsyncFaaSClient:
             body["priority"] = priority
         if cost is not None:
             body["cost"] = cost
+        if timeout is not None:
+            body["timeout"] = timeout
         async with self.http.post(
             f"{self.base_url}/execute_function", json=body
         ) as r:
@@ -156,6 +160,7 @@ class AsyncFaaSClient:
         params_list: list[tuple[tuple, dict]],
         priorities: list[int] | None = None,
         costs: list[float] | None = None,
+        timeouts: list[float] | None = None,
     ) -> list[AsyncTaskHandle]:
         # dill-packing thousands of payloads inline would stall the event
         # loop (and every concurrently polling handle) — do it in a worker
@@ -172,6 +177,8 @@ class AsyncFaaSClient:
             body["priorities"] = priorities
         if costs is not None:
             body["costs"] = costs
+        if timeouts is not None:
+            body["timeouts"] = timeouts
         async with self.http.post(
             f"{self.base_url}/execute_batch", json=body
         ) as r:

@@ -95,12 +95,15 @@ class FaaSClient:
         payload: str,
         priority: int | None = None,
         cost: float | None = None,
+        timeout: float | None = None,
     ) -> str:
         body: dict = {"function_id": function_id, "payload": payload}
         if priority is not None:
             body["priority"] = priority
         if cost is not None:
             body["cost"] = cost
+        if timeout is not None:
+            body["timeout"] = timeout
         r = self.http.post(f"{self.base_url}/execute_function", json=body)
         r.raise_for_status()
         return r.json()["task_id"]
@@ -145,17 +148,25 @@ class FaaSClient:
         *,
         priority: int | None = None,
         cost: float | None = None,
+        timeout: float | None = None,
     ) -> TaskHandle:
         """submit() plus scheduling hints. The hints can't ride submit()
         itself — its **kwargs belong to the remote function — so args/kwargs
         are explicit here. ``priority``: higher is admitted first under
         overload (FCFS within a class); ``cost``: estimated run-cost, used to
-        pair expensive tasks with fast workers."""
+        pair expensive tasks with fast workers; ``timeout``: execution time
+        budget in seconds, enforced inside the worker's pool child — the
+        task FAILs with TaskTimeout instead of eating a process slot
+        forever."""
         payload = pack_params(*args, **(kwargs or {}))
         return TaskHandle(
             self,
             self.execute_payload(
-                function_id, payload, priority=priority, cost=cost
+                function_id,
+                payload,
+                priority=priority,
+                cost=cost,
+                timeout=timeout,
             ),
         )
 
@@ -165,12 +176,13 @@ class FaaSClient:
         params_list: list[tuple[tuple, dict]],
         priorities: list[int] | None = None,
         costs: list[float] | None = None,
+        timeouts: list[float] | None = None,
     ) -> list[TaskHandle]:
         """Batch submit over ONE HTTP call (+ one pipelined store round
         trip): ``params_list`` holds (args, kwargs) pairs. N single submits
         cost N round trips on both hops — this is the bulk path.
-        ``priorities``/``costs`` are optional scheduling-hint lists parallel
-        to ``params_list``."""
+        ``priorities``/``costs``/``timeouts`` are optional scheduling-hint
+        lists parallel to ``params_list``."""
         body: dict = {
             "function_id": function_id,
             "payloads": [
@@ -181,6 +193,8 @@ class FaaSClient:
             body["priorities"] = priorities
         if costs is not None:
             body["costs"] = costs
+        if timeouts is not None:
+            body["timeouts"] = timeouts
         r = self.http.post(f"{self.base_url}/execute_batch", json=body)
         r.raise_for_status()
         return [TaskHandle(self, tid) for tid in r.json()["task_ids"]]

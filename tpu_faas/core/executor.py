@@ -18,6 +18,8 @@ dispatcher pool, pull workers, and push workers all ``apply_async`` it
 
 from __future__ import annotations
 
+import signal
+import threading
 from typing import NamedTuple
 
 from tpu_faas.core.serialize import deserialize, serialize
@@ -30,19 +32,58 @@ class ExecutionResult(NamedTuple):
     result: str  # serialized payload (value or exception)
 
 
-def execute_fn(task_id: str, ser_fn: str, ser_params: str) -> ExecutionResult:
+class TaskTimeout(Exception):
+    """Raised inside a pool child when a task exceeds its time budget."""
+
+
+def execute_fn(
+    task_id: str,
+    ser_fn: str,
+    ser_params: str,
+    timeout: float | None = None,
+) -> ExecutionResult:
     """Execute one task; never raises.
 
     Runs in worker pool child processes — keep it dependency-light and make
     sure every outcome is expressible as a serializable (status, result) pair.
+
+    ``timeout`` (seconds, client's ``timeout`` hint) bounds the call with a
+    SIGALRM-based interrupt in the child: a runaway pure-Python task raises
+    :class:`TaskTimeout` -> FAILED and RELEASES its process slot (without
+    this, one infinite loop permanently eats a slot — a capacity leak the
+    dispatcher's poison guard can't see, since the worker stays alive and
+    heartbeating). Limitations, by design: POSIX main-thread only (elsewhere
+    it degrades to no enforcement), and C-extension code that never yields
+    to the interpreter can't be interrupted — that residual case needs an
+    operator killing the worker (purge + re-dispatch then recover the task).
     """
+    timer_armed = False
+    if timeout is not None and timeout > 0:
+        if threading.current_thread() is threading.main_thread() and hasattr(
+            signal, "setitimer"
+        ):
+            def _alarm(signum, frame):
+                raise TaskTimeout(
+                    f"task {task_id} exceeded its {timeout}s time budget"
+                )
+
+            signal.signal(signal.SIGALRM, _alarm)
+            signal.setitimer(signal.ITIMER_REAL, timeout)
+            timer_armed = True
     try:
         fn = deserialize(ser_fn)
         params = deserialize(ser_params)
         args, kwargs = params  # contract: (args_tuple, kwargs_dict)
         result = fn(*args, **kwargs)
+        # disarm BEFORE serializing: a late alarm firing inside the success
+        # path would turn a finished task into a spurious FAILED
+        if timer_armed:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            timer_armed = False
         return ExecutionResult(task_id, str(TaskStatus.COMPLETED), serialize(result))
     except Exception as exc:  # catch-all FAILED semantics
+        if timer_armed:
+            signal.setitimer(signal.ITIMER_REAL, 0)
         try:
             payload = serialize(exc)
             deserialize(payload)  # exception must round-trip for the client

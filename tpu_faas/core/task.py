@@ -36,6 +36,7 @@ FIELD_RESULT = "result"
 #: hand-rolled reference-style clients fully interoperable).
 FIELD_PRIORITY = "priority"  # int as str; higher = admitted first
 FIELD_COST = "cost"  # float as str; estimated run-cost (scheduler pairing)
+FIELD_TIMEOUT = "timeout"  # float as str; execution budget enforced in-child
 
 
 def new_task_id() -> str:

@@ -32,6 +32,7 @@ from tpu_faas.core.task import (
     FIELD_PARAMS,
     FIELD_PRIORITY,
     FIELD_STATUS,
+    FIELD_TIMEOUT,
     TaskStatus,
 )
 from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
@@ -42,6 +43,19 @@ from tpu_faas.utils.logging import get_logger
 #: Deliberately NOT plain OSError: zmq.ZMQError subclasses OSError, and a
 #: broken worker socket must stay fatal rather than be retried as an outage.
 STORE_OUTAGE_ERRORS = (ConnectionError, TimeoutError)
+
+
+def _parse_positive_finite(raw: str | None) -> float | None:
+    """Defensive hint parse: a malformed, non-finite, or non-positive value
+    from the store degrades to None (no hint) rather than wedging the
+    dispatch loop on one bad task."""
+    if raw is None:
+        return None
+    try:
+        value = float(raw)
+    except ValueError:
+        return None
+    return value if math.isfinite(value) and value > 0.0 else None
 
 
 @dataclass
@@ -57,6 +71,21 @@ class PendingTask:
     #: priority orders admission under overload, cost refines the pairing
     priority: int = 0
     cost: float | None = None
+    #: execution time budget (gateway 'timeout' field), enforced in the pool
+    #: child (core/executor.py) so a runaway task can't eat a slot forever
+    timeout: float | None = None
+
+    def task_message_kwargs(self) -> dict:
+        """The TASK wire message's payload fields (timeout rides along so
+        the WORKER can enforce it; priority/cost are dispatcher-side only)."""
+        out = {
+            "task_id": self.task_id,
+            "fn_payload": self.fn_payload,
+            "param_payload": self.param_payload,
+        }
+        if self.timeout is not None:
+            out["timeout"] = self.timeout
+        return out
 
     @property
     def size_estimate(self) -> float:
@@ -83,19 +112,11 @@ class PendingTask:
         # is writable by other producers and one huge value must not
         # OverflowError the dispatch loop's int32 batch build
         priority = max(-(2**30), min(2**30, priority))
-        cost: float | None = None
-        raw_cost = fields.get(FIELD_COST)
-        if raw_cost is not None:
-            try:
-                cost = float(raw_cost)
-            except ValueError:
-                cost = None
-            else:
-                # finite positive only: cost=inf from a rogue producer would
-                # poison the float32 sizes batch and pin the task to the
-                # fastest slot forever (NaN fails the comparison too)
-                if not (math.isfinite(cost) and cost > 0.0):
-                    cost = None
+        # finite positive only: cost=inf from a rogue producer would poison
+        # the float32 sizes batch and pin the task to the fastest slot
+        # forever; a non-finite timeout would wedge setitimer
+        cost = _parse_positive_finite(fields.get(FIELD_COST))
+        timeout = _parse_positive_finite(fields.get(FIELD_TIMEOUT))
         return cls(
             task_id,
             fields.get(FIELD_FN, ""),
@@ -103,6 +124,7 @@ class PendingTask:
             retries=retries,
             priority=priority,
             cost=cost,
+            timeout=timeout,
         )
 
 

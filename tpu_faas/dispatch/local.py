@@ -56,7 +56,11 @@ class LocalDispatcher(TaskDispatcher):
     def _submit(self, pool: ProcessPoolExecutor, task) -> None:
         self.mark_running_safe(task.task_id)
         fut = pool.submit(
-            execute_fn, task.task_id, task.fn_payload, task.param_payload
+            execute_fn,
+            task.task_id,
+            task.fn_payload,
+            task.param_payload,
+            task.timeout,
         )
         fut.add_done_callback(
             lambda f, tid=task.task_id: self._done.put((tid, f))

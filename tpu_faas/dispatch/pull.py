@@ -79,12 +79,7 @@ class PullDispatcher(TaskDispatcher):
                 if task is not None:
                     self.mark_running_safe(task.task_id)
                     self.socket.send(
-                        m.encode(
-                            m.TASK,
-                            task_id=task.task_id,
-                            fn_payload=task.fn_payload,
-                            param_payload=task.param_payload,
-                        )
+                        m.encode(m.TASK, **task.task_message_kwargs())
                     )
                 else:
                     self.socket.send(m.encode(m.WAIT))

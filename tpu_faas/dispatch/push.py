@@ -38,6 +38,7 @@ from dataclasses import dataclass, field
 
 import zmq
 
+from tpu_faas.core.task import FIELD_FN, FIELD_PARAMS
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
     PendingTask,
@@ -257,14 +258,14 @@ class PushDispatcher(TaskDispatcher):
                         f"(max_task_retries={self.max_task_retries})",
                     )
                     continue
-                try:
-                    fn_payload, param_payload = self.store.get_payloads(task_id)
-                except KeyError:
-                    continue
+                # full hint rebuild (from_fields), not just the payloads: a
+                # re-dispatched runaway must keep its timeout budget, and a
+                # high-priority task its admission class
+                fields = self.store.hgetall(task_id)
+                if FIELD_FN not in fields or FIELD_PARAMS not in fields:
+                    continue  # payloads vanished (store flushed)
                 reclaims.append(
-                    PendingTask(
-                        task_id, fn_payload, param_payload, retries=retries
-                    )
+                    PendingTask.from_fields(task_id, fields, retries=retries)
                 )
             # phase 2 — bookkeeping only, cannot raise
             self.workers.pop(wid)
@@ -322,13 +323,7 @@ class PushDispatcher(TaskDispatcher):
                 break
             rec = self.workers[wid]
             self._send(
-                wid,
-                m.encode(
-                    m.TASK,
-                    task_id=task.task_id,
-                    fn_payload=task.fn_payload,
-                    param_payload=task.param_payload,
-                ),
+                wid, m.encode(m.TASK, **task.task_message_kwargs())
             )
             self.mark_running_safe(task.task_id, redispatch=bool(task.retries))
             rec.inflight.add(task.task_id)

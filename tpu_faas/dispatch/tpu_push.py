@@ -36,6 +36,7 @@ from tpu_faas.core.task import (
     FIELD_PARAMS,
     FIELD_PRIORITY,
     FIELD_STATUS,
+    FIELD_TIMEOUT,
     TaskStatus,
 )
 from tpu_faas.dispatch.base import (
@@ -48,7 +49,13 @@ from tpu_faas.utils.logging import TickTracer
 from tpu_faas.worker import messages as m
 
 #: What a reclaim needs to rebuild a PendingTask — everything BUT the result
-_RECLAIM_FIELDS = [FIELD_FN, FIELD_PARAMS, FIELD_PRIORITY, FIELD_COST]
+_RECLAIM_FIELDS = [
+    FIELD_FN,
+    FIELD_PARAMS,
+    FIELD_PRIORITY,
+    FIELD_COST,
+    FIELD_TIMEOUT,
+]
 
 
 class TpuPushDispatcher(TaskDispatcher):
@@ -368,15 +375,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     continue
                 wid = a.row_ids[row]
                 self.socket.send_multipart(
-                    [
-                        wid,
-                        m.encode(
-                            m.TASK,
-                            task_id=task.task_id,
-                            fn_payload=task.fn_payload,
-                            param_payload=task.param_payload,
-                        ),
-                    ]
+                    [wid, m.encode(m.TASK, **task.task_message_kwargs())]
                 )
                 # on the wire + tracked: must NOT be restored on an outage
                 restore_from = idx + 1

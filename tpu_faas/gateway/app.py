@@ -41,6 +41,7 @@ from aiohttp import web
 from tpu_faas.core.task import (
     FIELD_COST,
     FIELD_PRIORITY,
+    FIELD_TIMEOUT,
     TaskStatus,
     new_function_id,
     new_task_id,
@@ -252,12 +253,12 @@ async def register_function(request: web.Request) -> web.Response:
 _PRIORITY_BOUND = 2**30
 
 
-def _parse_hints(priority, cost) -> dict[str, str]:
+def _parse_hints(priority, cost, timeout=None) -> dict[str, str]:
     """Validate the optional scheduling hints into store hash fields.
 
     Raises ValueError with a client-facing message. Bounds: priority is an
     int (bool rejected — it JSON-decodes from true/false and is almost
-    certainly a client bug); cost a finite positive float.
+    certainly a client bug); cost and timeout finite positive floats.
     """
     extra: dict[str, str] = {}
     if priority is not None:
@@ -268,15 +269,20 @@ def _parse_hints(priority, cost) -> dict[str, str]:
                 f"'priority' must be within +/-{_PRIORITY_BOUND}"
             )
         extra[FIELD_PRIORITY] = str(priority)
-    if cost is not None:
+    for name, field_name, value in (
+        ("cost", FIELD_COST, cost),
+        ("timeout", FIELD_TIMEOUT, timeout),
+    ):
+        if value is None:
+            continue
         if (
-            isinstance(cost, bool)
-            or not isinstance(cost, (int, float))
-            or not math.isfinite(cost)
-            or cost <= 0
+            isinstance(value, bool)
+            or not isinstance(value, (int, float))
+            or not math.isfinite(value)
+            or value <= 0
         ):
-            raise ValueError("'cost' must be a finite positive number")
-        extra[FIELD_COST] = repr(float(cost))
+            raise ValueError(f"'{name}' must be a finite positive number")
+        extra[field_name] = repr(float(value))
     return extra
 
 
@@ -288,7 +294,9 @@ async def execute_function(request: web.Request) -> web.Response:
     except Exception:
         return _json_error(400, "expected JSON body with 'function_id' and 'payload'")
     try:
-        extra = _parse_hints(body.get("priority"), body.get("cost"))
+        extra = _parse_hints(
+            body.get("priority"), body.get("cost"), body.get("timeout")
+        )
     except ValueError as exc:
         return _json_error(400, str(exc))
     fn_payload = await _run_blocking(
@@ -329,7 +337,12 @@ async def execute_batch(request: web.Request) -> web.Response:
     # optional parallel hint lists; None entries mean "no hint for this task"
     priorities = body.get("priorities")
     costs = body.get("costs")
-    for name, lst in (("priorities", priorities), ("costs", costs)):
+    timeouts = body.get("timeouts")
+    for name, lst in (
+        ("priorities", priorities),
+        ("costs", costs),
+        ("timeouts", timeouts),
+    ):
         if lst is not None and (
             not isinstance(lst, list) or len(lst) != len(payloads)
         ):
@@ -341,6 +354,7 @@ async def execute_batch(request: web.Request) -> web.Response:
             _parse_hints(
                 priorities[i] if priorities else None,
                 costs[i] if costs else None,
+                timeouts[i] if timeouts else None,
             )
             for i in range(len(payloads))
         ]

@@ -18,7 +18,9 @@ worker -> dispatcher:
                in-flight results still follow, then I exit
 
 dispatcher -> worker:
-    TASK       data: task_id, fn_payload, param_payload
+    TASK       data: task_id, fn_payload, param_payload [, timeout: float —
+               execution budget the worker enforces in its pool child
+               (SIGALRM); absent = unbounded, the reference contract]
     WAIT       (pull only)
     RECONNECT  (push hb; request for the worker to re-announce itself)
 """

@@ -58,16 +58,22 @@ class TaskPool:
             timeout=timeout,
         )
 
-    def submit(self, task_id: str, fn_payload: str, param_payload: str) -> None:
+    def submit(
+        self,
+        task_id: str,
+        fn_payload: str,
+        param_payload: str,
+        timeout: float | None = None,
+    ) -> None:
         try:
             fut = self._executor.submit(
-                execute_fn, task_id, fn_payload, param_payload
+                execute_fn, task_id, fn_payload, param_payload, timeout
             )
         except BrokenProcessPool:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = self._make()
             fut = self._executor.submit(
-                execute_fn, task_id, fn_payload, param_payload
+                execute_fn, task_id, fn_payload, param_payload, timeout
             )
         fut.add_done_callback(lambda f, tid=task_id: self._done.put((tid, f)))
         self._busy += 1

@@ -69,7 +69,10 @@ class PullWorker:
         reply_type, reply = m.decode(self.socket.recv())
         if reply_type == m.TASK:
             self.pool.submit(
-                reply["task_id"], reply["fn_payload"], reply["param_payload"]
+                reply["task_id"],
+                reply["fn_payload"],
+                reply["param_payload"],
+                timeout=reply.get("timeout"),
             )
         # WAIT: nothing to do
 

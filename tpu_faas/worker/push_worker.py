@@ -115,6 +115,7 @@ class PushWorker:
                                 data["task_id"],
                                 data["fn_payload"],
                                 data["param_payload"],
+                                timeout=data.get("timeout"),
                             )
                         elif msg_type == m.RECONNECT:
                             # a draining worker reports zero capacity: it
