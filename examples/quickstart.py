@@ -53,6 +53,21 @@ def main() -> None:
     except TaskFailedError as e:
         print("failure =", repr(e.cause))
 
+    # scheduling hints: priority (admission order under overload), cost
+    # (task<->worker pairing), timeout (execution budget — a runaway task
+    # FAILs with TaskTimeout instead of eating a process slot forever)
+    def stall(seconds):
+        import time
+        time.sleep(seconds)
+        return "finished"
+
+    sid = client.register(stall)
+    print("hinted  =", client.submit_with(sid, args=(0.01,), priority=5).result())
+    try:
+        client.submit_with(sid, args=(60,), timeout=0.5).result()
+    except TaskFailedError as e:
+        print("timeout =", repr(e.cause))
+
     dispatcher.stop()
     gateway.stop()
     store.stop()

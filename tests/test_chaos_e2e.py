@@ -89,3 +89,79 @@ def test_chaos_worker_kill_plus_store_restart(tmp_path):
         t.join(timeout=10)
         gw.stop()
         store_handle[0].stop()
+
+
+def _spawn_dispatcher(port: int, store_url: str, *extra: str):
+    """A tpu-push dispatcher as a real subprocess (so it can be SIGKILLed)."""
+    import os
+    import subprocess
+    import sys
+
+    from tests.test_workers_e2e import REPO
+
+    existing = os.environ.get("PYTHONPATH", "")
+    env = dict(
+        os.environ, PYTHONPATH=f"{REPO}:{existing}" if existing else REPO
+    )
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_faas.dispatch",
+            "-m", "tpu-push", "-p", str(port), "-i", "127.0.0.1",
+            "--store", store_url, "--rescan", "0.5", "--tte", "2.0",
+        ]
+        + list(extra),
+        env=env,
+        cwd=REPO,
+    )
+
+
+def test_dispatcher_crash_restart_mid_run():
+    """SIGKILL the dispatcher with tasks in flight; a replacement on the
+    same port recovers everything: workers rejoin via the reconnect
+    handshake, results computed during the outage are delivered to the NEW
+    dispatcher (DEALER re-delivers over the reconnected socket), and tasks
+    stranded QUEUED by announce loss are adopted by the startup rescan.
+    Durable state lives in the store, so a dispatcher is disposable — the
+    reference's dispatcher is a single process whose death loses the fleet
+    (SURVEY §5.4: QUEUED tasks announced during downtime are stranded
+    forever)."""
+    import socket as socketlib
+
+    probe = socketlib.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp_a = _spawn_dispatcher(port, store_handle.url)
+    url = f"tcp://127.0.0.1:{port}"
+    worker = _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+    client = FaaSClient(gw.url)
+    disp_b = None
+    try:
+        fid = client.register(sleep_task)
+        first = [client.submit(fid, 0.5) for _ in range(4)]
+        time.sleep(1.2)  # some RUNNING on the worker
+
+        disp_a.kill()  # hard crash, no goodbye
+        disp_a.wait()
+        # tasks submitted while no dispatcher is listening: their announce
+        # is lost (fire-and-forget) — only the rescan can save them
+        during = [client.submit(fid, 0.2) for _ in range(4)]
+        time.sleep(0.5)
+
+        disp_b = _spawn_dispatcher(port, store_handle.url)
+        # every task completes with its actual return value (sleep_task
+        # returns its argument) — none lost, none FAILED
+        assert [h.result(timeout=90) for h in first] == [0.5] * 4
+        assert [h.result(timeout=90) for h in during] == [0.2] * 4
+    finally:
+        worker.kill()
+        worker.wait()
+        for d in (disp_a, disp_b):
+            if d is not None and d.poll() is None:
+                d.kill()
+                d.wait()
+        gw.stop()
+        store_handle.stop()

@@ -254,3 +254,36 @@ def test_tpu_push_priority_ordering_e2e():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def test_priority_admission_matches_oracle_randomized():
+    """Property: for random (priorities, validity, capacity), the admitted
+    set equals the top-capacity tasks ordered by (priority desc, arrival
+    asc) — checked against a plain-numpy oracle."""
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        T = int(rng.integers(5, 200))
+        W = int(rng.integers(1, 20))
+        K = int(rng.integers(1, 5))
+        valid = rng.random(T) > 0.3
+        prio = rng.integers(-3, 4, T).astype(np.int32)
+        free = rng.integers(0, K + 1, W).astype(np.int32)
+        live = rng.random(W) > 0.2
+        a = np.asarray(
+            rank_match_placement(
+                jnp.asarray(rng.uniform(0.1, 5, T).astype(np.float32)),
+                jnp.asarray(valid),
+                jnp.asarray(rng.uniform(0.5, 2, W).astype(np.float32)),
+                jnp.asarray(free),
+                jnp.asarray(live),
+                max_slots=K,
+                task_priority=jnp.asarray(prio),
+            )
+        )
+        cap = int(np.minimum(free, K)[live].sum())
+        # oracle: stable sort of valid tasks by priority desc (arrival is
+        # the tie-break via stability)
+        valid_idx = np.flatnonzero(valid)
+        order = valid_idx[np.argsort(-prio[valid_idx], kind="stable")]
+        expect = set(order[: min(cap, len(order))].tolist())
+        assert set(np.flatnonzero(a >= 0).tolist()) == expect

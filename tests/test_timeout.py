@@ -102,3 +102,42 @@ def test_timeout_hint_end_to_end_push():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def _stubborn(horizon: float = 60.0) -> str:
+    """The classic runaway shape: a retry loop that swallows Exceptions."""
+    import time as t
+
+    t0 = t.monotonic()
+    while t.monotonic() - t0 < horizon:
+        try:
+            t.sleep(0.02)
+        except Exception:
+            continue  # an Exception-derived timeout would be eaten here
+    return "survived"
+
+
+def test_timeout_survives_user_catch_all():
+    """TaskTimeout derives from BaseException precisely so the ubiquitous
+    'except Exception: continue' retry loop cannot swallow the one-shot
+    alarm and wedge the slot anyway."""
+    res = execute_fn(
+        "t-stubborn", serialize(_stubborn), pack_params(60.0), timeout=0.4
+    )
+    assert res.status == str(TaskStatus.FAILED)
+    assert isinstance(deserialize(res.result), TaskTimeout)
+
+
+def test_absurd_timeout_values_never_escape():
+    """never-raises contract under hostile budgets: setitimer overflow
+    values are clamped, microscopic budgets whose alarm fires before user
+    code starts still produce a clean FAILED."""
+    res = execute_fn(
+        "t-huge", serialize(arithmetic), pack_params(10), timeout=1e12
+    )
+    assert res.status == str(TaskStatus.COMPLETED)  # clamp, then run
+    res = execute_fn(
+        "t-tiny", serialize(sleep_task), pack_params(5.0), timeout=1e-6
+    )
+    assert res.status == str(TaskStatus.FAILED)
+    assert isinstance(deserialize(res.result), TaskTimeout)

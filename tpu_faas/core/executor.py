@@ -32,8 +32,22 @@ class ExecutionResult(NamedTuple):
     result: str  # serialized payload (value or exception)
 
 
-class TaskTimeout(Exception):
-    """Raised inside a pool child when a task exceeds its time budget."""
+class TaskTimeout(BaseException):
+    """Raised inside a pool child when a task exceeds its time budget.
+
+    Deliberately a BaseException: runaway tasks are very often shaped like
+    ``while True: try: work() except Exception: continue`` — an
+    Exception-derived timeout would be swallowed by that loop (and the
+    one-shot itimer never fires again), silently re-creating the wedged
+    slot the feature exists to prevent. User code that catches
+    BaseException defeats this, like it defeats KeyboardInterrupt; that
+    residual case is the operator-kill path.
+    """
+
+
+#: Arm-time cap (~194 days): setitimer raises OverflowError far above this
+#: (platform time_t), and no task budget is legitimately this long.
+_MAX_TIMEOUT_S = float(2**24)
 
 
 def execute_fn(
@@ -57,20 +71,37 @@ def execute_fn(
     to the interpreter can't be interrupted — that residual case needs an
     operator killing the worker (purge + re-dispatch then recover the task).
     """
-    timer_armed = False
-    if timeout is not None and timeout > 0:
-        if threading.current_thread() is threading.main_thread() and hasattr(
-            signal, "setitimer"
-        ):
-            def _alarm(signum, frame):
-                raise TaskTimeout(
-                    f"task {task_id} exceeded its {timeout}s time budget"
-                )
-
-            signal.signal(signal.SIGALRM, _alarm)
-            signal.setitimer(signal.ITIMER_REAL, timeout)
-            timer_armed = True
     try:
+        return _execute_guarded(task_id, ser_fn, ser_params, timeout)
+    except TaskTimeout as exc:
+        # the alarm landed in the narrow window between an exception being
+        # caught and the timer disarm: still a clean FAILED, never a raise
+        return ExecutionResult(task_id, str(TaskStatus.FAILED), serialize(exc))
+
+
+def _execute_guarded(
+    task_id: str, ser_fn: str, ser_params: str, timeout: float | None
+) -> ExecutionResult:
+    timer_armed = False
+    try:
+        # arming INSIDE the try: setitimer itself can raise (OverflowError
+        # on absurd values — additionally clamped here), and a tiny budget's
+        # alarm may fire before the user code even starts; both must follow
+        # the normal FAILED path, not escape
+        if timeout is not None and timeout > 0:
+            if threading.current_thread() is threading.main_thread() and hasattr(
+                signal, "setitimer"
+            ):
+                def _alarm(signum, frame):
+                    raise TaskTimeout(
+                        f"task {task_id} exceeded its {timeout}s time budget"
+                    )
+
+                signal.signal(signal.SIGALRM, _alarm)
+                signal.setitimer(
+                    signal.ITIMER_REAL, min(timeout, _MAX_TIMEOUT_S)
+                )
+                timer_armed = True
         fn = deserialize(ser_fn)
         params = deserialize(ser_params)
         args, kwargs = params  # contract: (args_tuple, kwargs_dict)
@@ -81,7 +112,7 @@ def execute_fn(
             signal.setitimer(signal.ITIMER_REAL, 0)
             timer_armed = False
         return ExecutionResult(task_id, str(TaskStatus.COMPLETED), serialize(result))
-    except Exception as exc:  # catch-all FAILED semantics
+    except (Exception, TaskTimeout) as exc:  # catch-all FAILED semantics
         if timer_armed:
             signal.setitimer(signal.ITIMER_REAL, 0)
         try:

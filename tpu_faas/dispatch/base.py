@@ -44,6 +44,16 @@ from tpu_faas.utils.logging import get_logger
 #: broken worker socket must stay fatal rather than be retried as an outage.
 STORE_OUTAGE_ERRORS = (ConnectionError, TimeoutError)
 
+#: What a dead-worker reclaim needs to rebuild a PendingTask — everything
+#: BUT the result (see TaskDispatcher.fetch_reclaim).
+RECLAIM_FIELDS = [
+    FIELD_FN,
+    FIELD_PARAMS,
+    FIELD_PRIORITY,
+    FIELD_COST,
+    FIELD_TIMEOUT,
+]
+
 
 def _parse_positive_finite(raw: str | None) -> float | None:
     """Defensive hint parse: a malformed, non-finite, or non-positive value
@@ -316,6 +326,20 @@ class TaskDispatcher:
             "deferred_results": len(self.deferred_results),
             "announce_backlog": len(self._announce_backlog),
         }
+
+    def fetch_reclaim(self, task_id: str, retries: int) -> PendingTask | None:
+        """Rebuild a PendingTask for a task reclaimed from a dead worker.
+
+        hmget over exactly the rebuild fields, not hgetall: the hash may
+        already hold a huge result blob (the zombie wrote it before the
+        purge) that a mass-reclaim tick must not drag across the store
+        wire. Returns None when the payloads vanished (store flushed) —
+        nothing to re-dispatch."""
+        vals = self.store.hmget(task_id, RECLAIM_FIELDS)
+        fields = {f: v for f, v in zip(RECLAIM_FIELDS, vals) if v is not None}
+        if FIELD_FN not in fields or FIELD_PARAMS not in fields:
+            return None
+        return PendingTask.from_fields(task_id, fields, retries=retries)
 
     def task_is_finished(self, task_id: str) -> bool:
         """Re-dispatch guard: True when a reclaimed task must NOT be sent

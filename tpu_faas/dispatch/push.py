@@ -38,7 +38,6 @@ from dataclasses import dataclass, field
 
 import zmq
 
-from tpu_faas.core.task import FIELD_FN, FIELD_PARAMS
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
     PendingTask,
@@ -258,15 +257,14 @@ class PushDispatcher(TaskDispatcher):
                         f"(max_task_retries={self.max_task_retries})",
                     )
                     continue
-                # full hint rebuild (from_fields), not just the payloads: a
-                # re-dispatched runaway must keep its timeout budget, and a
-                # high-priority task its admission class
-                fields = self.store.hgetall(task_id)
-                if FIELD_FN not in fields or FIELD_PARAMS not in fields:
+                # full hint rebuild, not just the payloads: a re-dispatched
+                # runaway must keep its timeout budget, a high-priority task
+                # its admission class (fetch_reclaim hmgets exactly those
+                # fields — never the possibly-huge result blob)
+                pt = self.fetch_reclaim(task_id, retries)
+                if pt is None:
                     continue  # payloads vanished (store flushed)
-                reclaims.append(
-                    PendingTask.from_fields(task_id, fields, retries=retries)
-                )
+                reclaims.append(pt)
             # phase 2 — bookkeeping only, cannot raise
             self.workers.pop(wid)
             self._remove_free(wid)

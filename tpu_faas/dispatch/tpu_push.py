@@ -30,15 +30,7 @@ from collections import deque
 import numpy as np
 import zmq
 
-from tpu_faas.core.task import (
-    FIELD_COST,
-    FIELD_FN,
-    FIELD_PARAMS,
-    FIELD_PRIORITY,
-    FIELD_STATUS,
-    FIELD_TIMEOUT,
-    TaskStatus,
-)
+from tpu_faas.core.task import FIELD_STATUS, TaskStatus
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
     PendingTask,
@@ -47,15 +39,6 @@ from tpu_faas.dispatch.base import (
 from tpu_faas.sched.state import SchedulerArrays
 from tpu_faas.utils.logging import TickTracer
 from tpu_faas.worker import messages as m
-
-#: What a reclaim needs to rebuild a PendingTask — everything BUT the result
-_RECLAIM_FIELDS = [
-    FIELD_FN,
-    FIELD_PARAMS,
-    FIELD_PRIORITY,
-    FIELD_COST,
-    FIELD_TIMEOUT,
-]
 
 
 class TpuPushDispatcher(TaskDispatcher):
@@ -297,7 +280,7 @@ class TpuPushDispatcher(TaskDispatcher):
 
             # reclaim in-flight tasks of dead workers (ahead of the queue) —
             # phase 1: store I/O only, no bookkeeping mutation
-            reclaims: list[tuple[int, str, int, dict[str, str]]] = []
+            reclaims: list[tuple[int, PendingTask]] = []
             drops: list[tuple[int, str]] = []  # failed or vanished
             for slot in np.flatnonzero(np.asarray(out.redispatch)):
                 slot = int(slot)
@@ -320,30 +303,22 @@ class TpuPushDispatcher(TaskDispatcher):
                     )
                     drops.append((slot, task_id))
                     continue
-                # hmget, not hgetall: the hash may already hold a huge
-                # result blob (zombie wrote it before the purge) that a
-                # mass-reclaim tick must not drag across the store wire
-                vals = self.store.hmget(task_id, _RECLAIM_FIELDS)
-                fields = {
-                    f: v for f, v in zip(_RECLAIM_FIELDS, vals) if v is not None
-                }
-                if FIELD_FN not in fields or FIELD_PARAMS not in fields:
+                pt = self.fetch_reclaim(task_id, retries)
+                if pt is None:
                     # payloads vanished (store flushed): nothing to
                     # re-dispatch, and leaving a retry entry would haunt a
                     # future task that reuses the id
                     drops.append((slot, task_id))
                     continue
-                reclaims.append((slot, task_id, retries, fields))
+                reclaims.append((slot, pt))
             # phase 2: bookkeeping only, cannot raise
             for slot, task_id in drops:
                 a.inflight_clear_slot(slot)
                 self.task_retries.pop(task_id, None)
-            for slot, task_id, retries, fields in reclaims:
+            for slot, pt in reclaims:
                 a.inflight_clear_slot(slot)
-                self.task_retries[task_id] = retries
-                requeued.append(
-                    PendingTask.from_fields(task_id, fields, retries=retries)
-                )
+                self.task_retries[pt.task_id] = pt.retries
+                requeued.append(pt)
             for row in np.flatnonzero(np.asarray(out.purged)):
                 self.log.warning("purged worker row %d", int(row))
                 a.deactivate(int(row))
