@@ -1,0 +1,90 @@
+"""Child process for the multi-host integration test (test_multihost.py).
+
+Joins a two-process gloo-backed CPU "pod" via initialize_multihost, runs the
+full sharded scheduler tick over the GLOBAL 8-device mesh (4 local devices
+per process), and prints a deterministic summary line the parent compares
+across ranks. Run: python tests/_multihost_child.py <rank> <coordinator_port>
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> None:
+    rank, port = int(sys.argv[1]), sys.argv[2]
+
+    from tpu_faas.parallel.distributed import initialize_multihost
+
+    assert initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=rank,
+        cpu_devices_per_process=4,
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8, jax.devices()
+
+    from tpu_faas.parallel.mesh import (
+        make_mesh,
+        replicate,
+        shard_task_arrays,
+        sharded_scheduler_tick,
+    )
+
+    mesh = make_mesh(8)
+    T, W, I = 64, 16, 32
+    rng = np.random.default_rng(5)  # same seed every rank: global arrays
+    task_size, task_valid = shard_task_arrays(
+        mesh,
+        jnp.asarray(rng.uniform(0.1, 5.0, T).astype(np.float32)),
+        jnp.asarray(rng.random(T) > 0.2),
+    )
+    speed, free, active, hb_age, prev_live, inflight = replicate(
+        mesh,
+        jnp.asarray(rng.uniform(0.5, 4.0, W).astype(np.float32)),
+        jnp.asarray(rng.integers(0, 4, W).astype(np.int32)),
+        jnp.ones(W, dtype=bool),
+        jnp.asarray(rng.uniform(0.0, 15.0, W).astype(np.float32)),
+        jnp.ones(W, dtype=bool),
+        jnp.asarray(rng.integers(-1, W, I).astype(np.int32)),
+    )
+    out = sharded_scheduler_tick(
+        mesh,
+        task_size,
+        task_valid,
+        speed,
+        free,
+        active,
+        hb_age,
+        prev_live,
+        inflight,
+        jnp.float32(10.0),
+        max_slots=4,
+        use_sinkhorn=True,
+    )
+    jax.block_until_ready(out)
+    # replicate the (process-spanning) assignment onto every host so each
+    # rank can print the full result for the parent's cross-rank comparison
+    gather = jax.jit(
+        lambda a: a, out_shardings=NamedSharding(mesh, PartitionSpec())
+    )
+    a = np.asarray(gather(out.assignment))
+    cap = int(np.minimum(np.asarray(free), 4).sum())
+    placed = int((a >= 0).sum())
+    assert placed <= cap
+    print(
+        f"MULTIHOST rank={rank} placed={placed} "
+        f"checksum={int(a.sum())} purged={int(np.asarray(out.purged).sum())}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,9 @@ if _platform == "cpu":
         f"backend is {jax.default_backend()!r}, wanted 'cpu' — "
         "a plugin initialized JAX before conftest could configure it"
     )
+    assert len(jax.devices()) >= 8, (
+        f"expected >= 8 virtual CPU devices, got {jax.devices()}"
+    )
 else:
     # hardware platform plugins may register under a different backend name
     # than their platform string (e.g. a tunneled-TPU plugin selected as
@@ -46,9 +49,5 @@ else:
     # fallback to CPU
     assert jax.default_backend() != "cpu", (
         f"requested platform {_platform!r} but fell back to CPU"
-    )
-if _platform == "cpu":
-    assert len(jax.devices()) >= 8, (
-        f"expected >= 8 virtual CPU devices, got {jax.devices()}"
     )
 # on real hardware the mesh tests skip themselves if devices are scarce

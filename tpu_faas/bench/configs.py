@@ -127,8 +127,6 @@ def config_3_auction_1k_10k() -> dict:
 def config_4_sinkhorn_hetero() -> dict:
     """Sinkhorn placement: heterogeneous fleet, sized tasks; quality vs the
     offline bound and the host greedy."""
-    import jax
-
     from tpu_faas.sched.greedy import host_greedy_reference, makespan
     from tpu_faas.sched.oracle import makespan_lower_bound
     from tpu_faas.sched.problem import PlacementProblem

@@ -33,16 +33,28 @@ def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    cpu_devices_per_process: int | None = None,
 ) -> bool:
     """Join this process into the global JAX runtime.
 
     Arguments default to auto-discovery (TPU metadata / cluster env vars).
     Returns True if initialization happened, False if it was already done
     or this is a single-process run that doesn't need it.
+
+    ``cpu_devices_per_process`` enables the CPU simulation of a pod: each
+    process contributes that many virtual CPU devices and cross-process
+    collectives run over gloo — the same sharded kernels then execute on a
+    REAL multi-process global mesh without TPU hardware (this is how the
+    multi-host path is integration-tested; see tests/test_multihost.py).
+    Must be set before any other JAX backend use in the process.
     """
     global _initialized
     if _initialized:
         return False
+    if cpu_devices_per_process is not None:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", cpu_devices_per_process)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     if num_processes == 1:
         # explicit single-process: nothing to join
         _initialized = True

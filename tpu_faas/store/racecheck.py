@@ -335,6 +335,11 @@ class RaceCheckStore(TaskStore):
     def hgetall(self, key: str) -> dict[str, str]:
         return self.inner.hgetall(key)
 
+    def hmget(self, key: str, fields: list[str]) -> list[str | None]:
+        # pass through, not the base loop-of-hget default: the reclaim path
+        # relies on hmget being ONE round trip on RESP backends
+        return self.inner.hmget(key, fields)
+
     def keys(self) -> list[str]:
         return self.inner.keys()
 
