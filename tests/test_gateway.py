@@ -360,3 +360,68 @@ def test_long_poll_wakes_on_result_publish():
         assert wake_latency < 0.4, f"woke by fallback, not publish: {wake_latency:.3f}s"
     finally:
         handle.stop()
+
+
+def test_result_ttl_sweeper_expires_only_old_terminal_records():
+    """--result-ttl ages out consumed results (the reference's store grows
+    until a manual FLUSHDB): only terminal records older than the TTL go;
+    live tasks, fresh results, and the function registry survive."""
+    import time
+
+    from tpu_faas.core.task import FIELD_FINISHED_AT
+    from tpu_faas.gateway.app import _sweep_expired_results
+
+    store = MemoryStore()
+    now = time.time()
+    store.hset("function:f1", {"name": "f", "payload": "P"})
+    store.create_task("queued", "F", "P")
+    store.create_task("old-done", "F", "P")
+    store.finish_task("old-done", "COMPLETED", "R")
+    store.hset("old-done", {FIELD_FINISHED_AT: repr(now - 100)})
+    store.create_task("fresh-done", "F", "P")
+    store.finish_task("fresh-done", "COMPLETED", "R")
+    store.create_task("unstamped", "F", "P")
+    store.hset("unstamped", {"status": "COMPLETED", "result": "R"})
+
+    n = _sweep_expired_results(store, ttl=30.0, now=now)
+    assert n == 1
+    assert store.get_status("old-done") is None  # expired
+    assert store.get_status("queued") == "QUEUED"  # live: untouched
+    assert store.get_status("fresh-done") == "COMPLETED"  # within TTL
+    assert store.get_status("unstamped") == "COMPLETED"  # no stamp: kept
+    assert store.hgetall("function:f1")  # registry never swept
+
+
+def test_result_ttl_end_to_end():
+    """A gateway with a short TTL: the record exists right after completion
+    and 404s after the sweep."""
+    import time
+
+    from tpu_faas.core.executor import execute_fn
+
+    store = MemoryStore()
+    handle = start_gateway_thread(store, result_ttl=1.0)
+    try:
+        fid = requests.post(
+            f"{handle.url}/register_function",
+            json={"name": "arithmetic", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        tid = requests.post(
+            f"{handle.url}/execute_function",
+            json={"function_id": fid, "payload": serialize(((5,), {}))},
+        ).json()["task_id"]
+        fields = store.hgetall(tid)
+        _, status, result = execute_fn(
+            tid, fields["fn_payload"], fields["param_payload"]
+        )
+        store.finish_task(tid, status, result)
+        assert requests.get(f"{handle.url}/result/{tid}").status_code == 200
+        deadline = time.monotonic() + 10
+        while (
+            requests.get(f"{handle.url}/result/{tid}").status_code != 404
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert requests.get(f"{handle.url}/result/{tid}").status_code == 404
+    finally:
+        handle.stop()

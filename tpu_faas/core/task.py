@@ -37,6 +37,10 @@ FIELD_RESULT = "result"
 FIELD_PRIORITY = "priority"  # int as str; higher = admitted first
 FIELD_COST = "cost"  # float as str; estimated run-cost (scheduler pairing)
 FIELD_TIMEOUT = "timeout"  # float as str; execution budget enforced in-child
+#: Written by finish_task alongside every terminal write (epoch seconds as
+#: str) — lets the gateway's optional result-TTL sweeper age out consumed
+#: records without a per-task client DELETE.
+FIELD_FINISHED_AT = "finished_at"
 
 
 def new_task_id() -> str:

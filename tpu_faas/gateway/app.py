@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import functools
 import math
 import threading
@@ -40,7 +41,9 @@ from aiohttp import web
 
 from tpu_faas.core.task import (
     FIELD_COST,
+    FIELD_FINISHED_AT,
     FIELD_PRIORITY,
+    FIELD_STATUS,
     FIELD_TIMEOUT,
     TaskStatus,
     new_function_id,
@@ -173,6 +176,9 @@ class GatewayContext:
 
 
 CTX_KEY: web.AppKey["GatewayContext"] = web.AppKey("ctx", GatewayContext)
+SWEEPER_KEY: web.AppKey["asyncio.Task"] = web.AppKey(
+    "result_ttl_sweeper", asyncio.Task
+)
 
 
 @web.middleware
@@ -195,7 +201,50 @@ async def _metrics_middleware(request: web.Request, handler):
         ctx.tracer.record(name, time.perf_counter() - t0)
 
 
-def make_app(store: TaskStore, channel: str = TASKS_CHANNEL) -> web.Application:
+def _sweep_expired_results(
+    store: TaskStore, ttl: float, now: float | None = None
+) -> int:
+    """Delete terminal task records older than ``ttl`` seconds (their
+    FIELD_FINISHED_AT stamp). Returns records deleted. Pipelined status +
+    stamp probes so the sweep stays one round trip per phase, not per key;
+    live (QUEUED/RUNNING) tasks, unstamped records, and the function
+    registry are never touched."""
+    now_f = now if now is not None else time.time()
+    keys = [k for k in store.keys() if not k.startswith(_FUNCTION_PREFIX)]
+    if not keys:
+        return 0
+    statuses = store.hget_many(keys, FIELD_STATUS)
+    terminal = []
+    for key, status in zip(keys, statuses):
+        if status is None:
+            continue
+        try:
+            if TaskStatus(status).is_terminal():
+                terminal.append(key)
+        except ValueError:
+            continue
+    if not terminal:
+        return 0
+    stamps = store.hget_many(terminal, FIELD_FINISHED_AT)
+    expired = []
+    for key, stamp in zip(terminal, stamps):
+        if stamp is None:
+            continue  # pre-stamp record (or foreign producer): never expire
+        try:
+            finished_at = float(stamp)
+        except ValueError:
+            continue
+        if now_f - finished_at > ttl:
+            expired.append(key)
+    store.delete_many(expired)  # one variadic DEL on RESP backends
+    return len(expired)
+
+
+def make_app(
+    store: TaskStore,
+    channel: str = TASKS_CHANNEL,
+    result_ttl: float | None = None,
+) -> web.Application:
     ctx = GatewayContext(store=store, channel=channel)
     app = web.Application(
         client_max_size=256 * 1024 * 1024, middlewares=[_metrics_middleware]
@@ -213,9 +262,46 @@ def make_app(store: TaskStore, channel: str = TASKS_CHANNEL) -> web.Application:
     async def _start_wakeups(_app: web.Application) -> None:
         ctx.waiters = _ResultWaiters(store)
         ctx.waiters.start(asyncio.get_running_loop())
+        if result_ttl is not None and result_ttl > 0:
+            async def sweeper() -> None:
+                """Age out consumed results (reference behavior — the store
+                grows until a manual FLUSHDB — is the default; this runs
+                only when the operator sets --result-ttl). Clients that
+                still need a result poll it before the TTL; late pollers
+                get a 404, same as after an explicit DELETE /task."""
+                # each sweep is a full KEYS walk (the RESP subset has no
+                # SCAN): floor the period near the TTL itself so a small
+                # TTL can't turn the sweeper into a keyspace-scan loop that
+                # competes with the dispatcher on the store
+                period = max(result_ttl / 4.0, min(result_ttl, 30.0))
+                while not ctx.stopping.is_set():
+                    try:
+                        n = await _run_blocking(
+                            _sweep_expired_results, ctx.store, result_ttl
+                        )
+                        if n:
+                            log.info("result-ttl sweep: %d records expired", n)
+                    except Exception as exc:
+                        log.warning("result-ttl sweep failed (%s); retrying", exc)
+                    try:
+                        await asyncio.wait_for(
+                            ctx.stopping.wait(), timeout=period
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+
+            _app[SWEEPER_KEY] = asyncio.create_task(sweeper())
 
     async def _release_waiters(_app: web.Application) -> None:
         ctx.stopping.set()
+        sweeper_task = _app.get(SWEEPER_KEY)
+        if sweeper_task is not None:
+            # the sweep period can be hours; don't wait it out on shutdown —
+            # but DO await the cancellation, or the loop may close with the
+            # task pending ('Task was destroyed but it is pending!')
+            sweeper_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await sweeper_task
         if ctx.waiters is not None:
             ctx.waiters.fire_all()
             # stop() blocks on the pump-thread join (which can sit in a
@@ -537,6 +623,7 @@ def start_gateway_thread(
     host: str = "127.0.0.1",
     port: int = 0,
     channel: str = TASKS_CHANNEL,
+    result_ttl: float | None = None,
 ) -> GatewayHandle:
     """Serve the gateway in a daemon thread; returns once the port is bound."""
     started = threading.Event()
@@ -549,7 +636,7 @@ def start_gateway_thread(
         holder["loop"], holder["stop"] = loop, stop
 
         async def main() -> None:
-            runner = web.AppRunner(make_app(store, channel))
+            runner = web.AppRunner(make_app(store, channel, result_ttl))
             await runner.setup()
             site = web.TCPSite(runner, host, port)
             await site.start()
@@ -582,10 +669,20 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--host", default=cfg.gateway_host)
     ap.add_argument("--port", type=int, default=cfg.gateway_port)
     ap.add_argument("--store", default=cfg.store_url)
+    ap.add_argument(
+        "--result-ttl", type=float, default=None,
+        help="seconds to keep terminal task records before the sweeper "
+        "deletes them (default: keep forever, the reference behavior)",
+    )
     ns = ap.parse_args(argv)
     store = make_store(ns.store)
     log.info("gateway on %s:%d (store %s)", ns.host, ns.port, ns.store)
-    web.run_app(make_app(store), host=ns.host, port=ns.port, print=None)
+    web.run_app(
+        make_app(store, result_ttl=ns.result_ttl),
+        host=ns.host,
+        port=ns.port,
+        print=None,
+    )
 
 
 if __name__ == "__main__":

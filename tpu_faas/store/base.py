@@ -19,9 +19,11 @@ the gateway writes the exact field contract while dispatchers read it.
 from __future__ import annotations
 
 import abc
+import time
 from typing import Mapping
 
 from tpu_faas.core.task import (
+    FIELD_FINISHED_AT,
     FIELD_FN,
     FIELD_PARAMS,
     FIELD_RESULT,
@@ -140,6 +142,12 @@ class TaskStore(abc.ABC):
         drags the (possibly huge) result blob over the wire."""
         return [self.hget(key, f) for f in fields]
 
+    def delete_many(self, keys: list[str]) -> None:
+        """Batch delete. Default: a loop; the RESP client sends one DEL
+        with all keys (the TTL sweeper's backlog purge)."""
+        for key in keys:
+            self.delete(key)
+
     def hget_many(self, keys: list[str], field: str) -> list[str | None]:
         """One field from many hashes. Default: a loop (one round trip per
         key); the RESP client overrides with a pipelined single round trip —
@@ -197,10 +205,19 @@ class TaskStore(abc.ABC):
         concurrent writer to race with.
 
         After the write the task_id is announced on RESULTS_CHANNEL (after,
-        so a woken subscriber always reads the terminal record)."""
+        so a woken subscriber always reads the terminal record). The write
+        also stamps FIELD_FINISHED_AT (epoch seconds) so a result-TTL
+        sweeper can age the record out."""
         if first_wins and self._result_frozen(task_id):
             return
-        self.hset(task_id, {FIELD_STATUS: str(status), FIELD_RESULT: result})
+        self.hset(
+            task_id,
+            {
+                FIELD_STATUS: str(status),
+                FIELD_RESULT: result,
+                FIELD_FINISHED_AT: repr(time.time()),
+            },
+        )
         self.publish(RESULTS_CHANNEL, task_id)
 
     def _result_frozen(self, task_id: str) -> bool:

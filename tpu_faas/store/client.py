@@ -15,6 +15,7 @@ from __future__ import annotations
 import select
 import socket
 import threading
+import time
 from typing import Mapping
 
 from tpu_faas.store import resp
@@ -278,7 +279,11 @@ class RespStore(TaskStore):
         the write and the announce ride ONE pipelined round trip — the
         result path is the dispatcher's per-task hot path and must not grow
         a second RTT for the wake-up feature."""
-        from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS
+        from tpu_faas.core.task import (
+            FIELD_FINISHED_AT,
+            FIELD_RESULT,
+            FIELD_STATUS,
+        )
 
         if first_wins and self._result_frozen(task_id):
             return
@@ -287,6 +292,7 @@ class RespStore(TaskStore):
                 "HSET", task_id,
                 FIELD_STATUS, str(status),
                 FIELD_RESULT, result,
+                FIELD_FINISHED_AT, repr(time.time()),
             ),
             ("PUBLISH", RESULTS_CHANNEL, task_id),
         ]
@@ -307,6 +313,10 @@ class RespStore(TaskStore):
 
     def delete(self, key: str) -> None:
         self._command("DEL", key)
+
+    def delete_many(self, keys: list[str]) -> None:
+        if keys:
+            self._command("DEL", *keys)  # one round trip, variadic DEL
 
     # -- pipelined batch ops ----------------------------------------------
     def hget_many(self, keys, field: str):
