@@ -425,3 +425,83 @@ def test_result_ttl_end_to_end():
         assert requests.get(f"{handle.url}/result/{tid}").status_code == 404
     finally:
         handle.stop()
+
+
+def test_client_connect_retry_bridges_gateway_restart():
+    """The SDK retries CONNECTION failures (gateway restarting behind a
+    stable address): a request issued while the port is briefly dark
+    succeeds once the replacement gateway binds. Read/status errors are
+    never retried — re-sending a possibly-applied POST could run a task
+    twice."""
+    import socket
+    import threading
+    import time
+
+    from tpu_faas.client import FaaSClient
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    store = MemoryStore()
+    holder = {}
+
+    def bring_up_late():
+        time.sleep(0.7)  # first connect attempt(s) must fail
+        holder["gw"] = start_gateway_thread(store, port=port)
+
+    th = threading.Thread(target=bring_up_late)
+    th.start()
+    try:
+        client = FaaSClient(f"http://127.0.0.1:{port}")
+        # issued while the port is still dark; connect retries bridge it
+        fid = client.register(arithmetic)
+        assert isinstance(fid, str) and fid
+        assert store.hgetall(f"function:{fid}")  # actually registered
+    finally:
+        th.join()
+        gw = holder.get("gw")
+        if gw is not None:
+            gw.stop()
+
+
+def test_async_client_connect_retry_bridges_gateway_restart():
+    """The async SDK's request() helper mirrors the sync adapter: connect
+    failures during a gateway restart are retried, anything after the
+    request reaches the wire is not."""
+    import asyncio
+    import socket
+    import threading
+    import time
+
+    from tpu_faas.client import AsyncFaaSClient
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    store = MemoryStore()
+    holder = {}
+
+    def bring_up_late():
+        time.sleep(0.7)
+        holder["gw"] = start_gateway_thread(store, port=port)
+
+    th = threading.Thread(target=bring_up_late)
+    th.start()
+
+    async def scenario():
+        async with AsyncFaaSClient(f"http://127.0.0.1:{port}") as client:
+            return await client.register(arithmetic)
+
+    try:
+        fid = asyncio.run(scenario())
+        assert isinstance(fid, str) and fid
+        assert store.hgetall(f"function:{fid}")
+    finally:
+        th.join()
+        gw = holder.get("gw")
+        if gw is not None:
+            gw.stop()

@@ -12,6 +12,7 @@ the client in an async stack.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -28,8 +29,8 @@ class AsyncTaskHandle:
     task_id: str
 
     async def status(self) -> str:
-        async with self.client.http.get(
-            f"{self.client.base_url}/status/{self.task_id}"
+        async with self.client.request(
+            "GET", f"{self.client.base_url}/status/{self.task_id}"
         ) as r:
             r.raise_for_status()
             return (await r.json())["status"]
@@ -41,7 +42,8 @@ class AsyncTaskHandle:
         deadline = loop.time() + timeout
         while True:
             remaining = max(0.0, min(deadline - loop.time(), 5.0))
-            async with self.client.http.get(
+            async with self.client.request(
+                "GET",
                 f"{self.client.base_url}/result/{self.task_id}",
                 params={"wait": remaining} if remaining > 0 else None,
                 # parked request + wedged gateway must not block past the
@@ -76,9 +78,35 @@ class AsyncFaaSClient:
             values = await asyncio.gather(*(h.result() for h in handles))
     """
 
-    def __init__(self, base_url: str = "http://127.0.0.1:8000") -> None:
+    def __init__(
+        self,
+        base_url: str = "http://127.0.0.1:8000",
+        connect_retries: int = 5,
+    ) -> None:
         self.base_url = base_url.rstrip("/")
+        self.connect_retries = connect_retries
         self._http: aiohttp.ClientSession | None = None
+
+    @contextlib.asynccontextmanager
+    async def request(self, method: str, url: str, **kw):
+        """All SDK HTTP rides through here: CONNECTION-establishment
+        failures retry with backoff (gateway restarting behind a stable
+        address — mirrors the sync client's adapter). Nothing has reached
+        the wire on a connector error, so the retry is safe even for
+        POSTs; errors after the request is sent are never retried."""
+        delay = 0.3
+        attempt = 0
+        while True:
+            try:
+                async with self.http.request(method, url, **kw) as r:
+                    yield r
+                return
+            except aiohttp.ClientConnectorError:
+                if attempt >= self.connect_retries:
+                    raise
+                attempt += 1
+                await asyncio.sleep(delay)
+                delay *= 2
 
     @property
     def http(self) -> aiohttp.ClientSession:
@@ -102,7 +130,8 @@ class AsyncFaaSClient:
         # serialization is CPU work: off the event loop, like all packing
         loop = asyncio.get_running_loop()
         payload = await loop.run_in_executor(None, serialize, fn)
-        async with self.http.post(
+        async with self.request(
+            "POST",
             f"{self.base_url}/register_function",
             json={"name": name or fn.__name__, "payload": payload},
         ) as r:
@@ -116,7 +145,8 @@ class AsyncFaaSClient:
         payload = await loop.run_in_executor(
             None, lambda: pack_params(*args, **kwargs)
         )
-        async with self.http.post(
+        async with self.request(
+            "POST",
             f"{self.base_url}/execute_function",
             json={"function_id": function_id, "payload": payload},
         ) as r:
@@ -148,8 +178,8 @@ class AsyncFaaSClient:
             body["cost"] = cost
         if timeout is not None:
             body["timeout"] = timeout
-        async with self.http.post(
-            f"{self.base_url}/execute_function", json=body
+        async with self.request(
+            "POST", f"{self.base_url}/execute_function", json=body
         ) as r:
             r.raise_for_status()
             return AsyncTaskHandle(self, (await r.json())["task_id"])
@@ -179,8 +209,8 @@ class AsyncFaaSClient:
             body["costs"] = costs
         if timeouts is not None:
             body["timeouts"] = timeouts
-        async with self.http.post(
-            f"{self.base_url}/execute_batch", json=body
+        async with self.request(
+            "POST", f"{self.base_url}/execute_batch", json=body
         ) as r:
             r.raise_for_status()
             return [
@@ -190,8 +220,8 @@ class AsyncFaaSClient:
 
     async def delete_task(self, task_id: str) -> None:
         """Free a terminal task's store record (409 while it is live)."""
-        async with self.http.delete(
-            f"{self.base_url}/task/{task_id}"
+        async with self.request(
+            "DELETE", f"{self.base_url}/task/{task_id}"
         ) as r:
             r.raise_for_status()
 

@@ -13,6 +13,7 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 import requests
+from requests.adapters import HTTPAdapter, Retry
 
 from tpu_faas.core.executor import pack_params
 from tpu_faas.core.serialize import deserialize, serialize
@@ -76,9 +77,36 @@ def _unwrap_terminal(task_id: str, status: str, payload: str):
 
 
 class FaaSClient:
-    def __init__(self, base_url: str = "http://127.0.0.1:8000") -> None:
+    def __init__(
+        self,
+        base_url: str = "http://127.0.0.1:8000",
+        connect_retries: int = 5,
+    ) -> None:
         self.base_url = base_url.rstrip("/")
         self.http = requests.Session()
+        # retry CONNECTION-establishment failures only (gateway restarting
+        # behind a load balancer): nothing has reached the wire yet, so the
+        # retry is safe even for POSTs — re-sending an /execute_function
+        # whose first attempt may have been APPLIED would run the task
+        # twice, so read/status errors are deliberately never retried
+        adapter = HTTPAdapter(
+            max_retries=Retry(
+                total=None,
+                connect=connect_retries,
+                read=0,
+                status=0,
+                # 'other' (SSL/proxy errors) must be 0 too: urllib3 treats a
+                # None counter as unbounded, which would retry a bad cert
+                # forever instead of raising
+                other=0,
+                # window must outlast a COLD gateway start (interpreter +
+                # aiohttp import is seconds, measured live), not just a
+                # socket blip: 5 retries at 0.5 back off ~7.5 s total
+                backoff_factor=0.5,
+            )
+        )
+        self.http.mount("http://", adapter)
+        self.http.mount("https://", adapter)
 
     # -- raw endpoints (wire format identical to SURVEY §0.1) --------------
     def register_payload(self, name: str, payload: str) -> str:

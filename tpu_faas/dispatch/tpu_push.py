@@ -222,6 +222,12 @@ class TpuPushDispatcher(TaskDispatcher):
             "pending": len(self.pending),
             "inflight": a.n_inflight,
             "workers_registered": len(a.worker_ids),
+            "free_slots": int(
+                np.where(a.worker_active, a.worker_free, 0).sum()
+            ),
+            "placement": a.placement,
+            "liveness_period_s": self.liveness_period,
+            "tasks_on_retry": len(self.task_retries),
             "device_tick": self.tracer.summary().get("device_tick", {}),
         }
 
