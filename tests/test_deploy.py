@@ -73,3 +73,93 @@ def test_fleet_spawn_crash_respawn_drain():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def test_autoscaler_policy_unit():
+    """Pure policy: up on backlog, down only after a sustained quiet
+    period, always within [min, max] — driven with fake stats, no HTTP."""
+    from tpu_faas.worker.deploy import AutoScaler
+
+    class FakeFleet:
+        def __init__(self):
+            self.n_live = 2
+
+        def scale_up(self):
+            self.n_live += 1
+
+        def scale_down(self):
+            self.n_live -= 1
+            return self.n_live
+
+    fleet = FakeFleet()
+    sc = AutoScaler(fleet, min_workers=1, max_workers=4, idle_decisions=3)
+
+    assert sc.step({"pending": 10, "inflight": 0}) == "up"
+    assert sc.step({"pending": 10, "inflight": 0}) == "up"
+    assert fleet.n_live == 4
+    assert sc.step({"pending": 10, "inflight": 0}) is None  # at max
+
+    # busy-but-not-backlogged: hold steady, idle streak resets
+    assert sc.step({"pending": 0, "inflight": 3}) is None
+    assert sc.step({"pending": 0, "inflight": 0}) is None  # idle 1
+    assert sc.step({"pending": 0, "inflight": 1}) is None  # reset
+    for _ in range(2):
+        assert sc.step({"pending": 0, "inflight": 0}) is None
+    assert sc.step({"pending": 0, "inflight": 0}) == "down"  # idle 3
+    assert fleet.n_live == 3
+    # streak restarts after a shrink: no immediate second drain
+    assert sc.step({"pending": 0, "inflight": 0}) is None
+
+
+def test_autoscaler_end_to_end_grows_and_shrinks():
+    """Real stack: a burst of slow tasks grows the fleet from 1 toward max;
+    a sustained quiet period drains it back down — gracefully, so every
+    result still lands."""
+    from tpu_faas.worker.deploy import AutoScaler, _fetch_stats
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url)
+    stats_server = disp.serve_stats(port=0)
+    stats_url = f"http://127.0.0.1:{stats_server.server_address[1]}/stats"
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+
+    fleet = WorkerFleet(1, 1, url, heartbeat=True, hb_period=0.3)
+    scaler = AutoScaler(fleet, min_workers=1, max_workers=3, idle_decisions=4)
+    client = FaaSClient(gw.url)
+    try:
+        fleet.start()
+        from tpu_faas.workloads import sleep_task
+
+        fid = client.register(sleep_task)
+        handles = client.submit_many(fid, [((0.8,), {}) for _ in range(8)])
+
+        deadline = time.monotonic() + 60
+        while fleet.n_live < 3 and time.monotonic() < deadline:
+            fleet.poll()
+            stats = _fetch_stats(stats_url)
+            if stats:
+                scaler.step(stats)
+            time.sleep(0.3)
+        assert fleet.n_live == 3, "backlog did not grow the fleet"
+        assert scaler.scale_ups >= 2
+
+        assert [h.result(timeout=60) for h in handles] == [0.8] * 8
+
+        deadline = time.monotonic() + 60
+        while fleet.n_live > 1 and time.monotonic() < deadline:
+            fleet.poll()
+            stats = _fetch_stats(stats_url)
+            if stats:
+                scaler.step(stats)
+            time.sleep(0.2)
+        assert fleet.n_live == 1, "quiet fleet did not shrink to the floor"
+        assert scaler.scale_downs >= 2
+    finally:
+        fleet.stop()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

@@ -45,9 +45,11 @@ class AsyncTaskHandle:
             async with self.client.request(
                 "GET",
                 f"{self.client.base_url}/result/{self.task_id}",
+                # retry sleeps AND the parked request itself are bounded by
+                # the caller's deadline: a dark or wedged gateway must not
+                # block result(timeout=T) far past T
+                retry_budget=max(0.5, deadline - loop.time()),
                 params={"wait": remaining} if remaining > 0 else None,
-                # parked request + wedged gateway must not block past the
-                # caller's deadline (aiohttp's 300s default would)
                 timeout=aiohttp.ClientTimeout(total=remaining + 15.0),
             ) as r:
                 r.raise_for_status()
@@ -88,12 +90,22 @@ class AsyncFaaSClient:
         self._http: aiohttp.ClientSession | None = None
 
     @contextlib.asynccontextmanager
-    async def request(self, method: str, url: str, **kw):
+    async def request(
+        self, method: str, url: str, retry_budget: float | None = None, **kw
+    ):
         """All SDK HTTP rides through here: CONNECTION-establishment
         failures retry with backoff (gateway restarting behind a stable
         address — mirrors the sync client's adapter). Nothing has reached
         the wire on a connector error, so the retry is safe even for
-        POSTs; errors after the request is sent are never retried."""
+        POSTs; errors after the request is sent are never retried.
+
+        ``retry_budget`` caps the total seconds spent in retry sleeps —
+        deadline-bound callers (AsyncTaskHandle.result) pass their
+        remaining time so the retry loop can't blow past their timeout."""
+        loop = asyncio.get_running_loop()
+        give_up_at = (
+            loop.time() + retry_budget if retry_budget is not None else None
+        )
         delay = 0.3
         attempt = 0
         while True:
@@ -104,6 +116,11 @@ class AsyncFaaSClient:
             except aiohttp.ClientConnectorError:
                 if attempt >= self.connect_retries:
                     raise
+                if give_up_at is not None:
+                    remaining = give_up_at - loop.time()
+                    if remaining <= 0:
+                        raise
+                    delay = min(delay, remaining)
                 attempt += 1
                 await asyncio.sleep(delay)
                 delay *= 2

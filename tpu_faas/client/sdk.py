@@ -100,8 +100,10 @@ class FaaSClient:
                 # forever instead of raising
                 other=0,
                 # window must outlast a COLD gateway start (interpreter +
-                # aiohttp import is seconds, measured live), not just a
-                # socket blip: 5 retries at 0.5 back off ~7.5 s total
+                # aiohttp import is seconds), not just a socket blip.
+                # urllib3 sleeps factor*2^(n-1) per retry: 0+1+2+4+8 ~= 15 s
+                # worst case against a dead gateway; a measured live cold
+                # start bridged at ~7 s
                 backoff_factor=0.5,
             )
         )

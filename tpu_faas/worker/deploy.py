@@ -13,11 +13,16 @@ its scrap heap (reference old/deploy_workers.py:9-108, including an inverted
 - SIGTERM/SIGINT forward a graceful drain to every worker (deregister,
   finish in-flight, exit 0 — worker/drain.py) and wait; workers that ignore
   the drain are killed after ``--stop-grace`` seconds. A worker that exits 0
-  on its own (e.g. drained by an operator) is NOT respawned.
+  on its own (e.g. drained by an operator) is NOT respawned;
+- optional queue-driven autoscaling (``--stats-url`` + ``--min``/``--max``):
+  the fleet grows one node per decision while the dispatcher reports
+  pending work and gracefully drains a node after a sustained quiet period
+  (:class:`AutoScaler`).
 
 Usage::
 
-    python -m tpu_faas.worker.deploy 4 2 tcp://host:5555 --hb --restart
+    python -m tpu_faas.worker.deploy 4 2 tcp://host:5555 --hb --restart \
+        --stats-url http://127.0.0.1:9100/stats --min 2 --max 16
 """
 
 from __future__ import annotations
@@ -68,6 +73,8 @@ class WorkerFleet:
         #: slot -> monotonic time when its crashed worker may respawn;
         #: non-blocking backoff, so shutdown never waits behind N sleeps
         self._respawn_at: dict[int, float] = {}
+        #: slot -> drain deadline (scale_down escalation bookkeeping)
+        self._draining: dict[int, float] = {}
 
     def _command(self) -> list[str]:
         mod = f"tpu_faas.worker.{self.protocol}_worker"
@@ -103,6 +110,48 @@ class WorkerFleet:
     def start(self) -> None:
         for i in range(self.n_workers):
             self._spawn(i)
+
+    # -- elastic sizing (used by AutoScaler) --------------------------------
+    def scale_up(self) -> int:
+        """Add one worker node NOW; returns its slot index. Reuses a free
+        slot if one exists, else grows the table."""
+        for i, p in enumerate(self.procs):
+            if p is None and i not in self._respawn_at:
+                self._spawn(i)
+                return i
+        self.procs.append(None)
+        slot = len(self.procs) - 1
+        self._spawn(slot)
+        return slot
+
+    def scale_down(self) -> int | None:
+        """Gracefully drain one worker (SIGTERM -> deregister + finish
+        in-flight + exit 0, which poll() does NOT respawn). Returns the
+        drained slot, or None if nothing (new) could be drained.
+
+        Slots already draining are skipped — re-terminating the same
+        wedged worker forever would both block further shrink and inflate
+        the caller's counters — and a drain that outlives ``stop_grace``
+        escalates to a group kill."""
+        now = time.monotonic()
+        for slot, deadline in list(self._draining.items()):
+            p = self.procs[slot] if slot < len(self.procs) else None
+            if p is None or p.poll() is not None:
+                del self._draining[slot]  # exited; poll() reaps it
+            elif now >= deadline:
+                log.warning(
+                    "scale-down: worker[%d] ignored drain; killing", slot
+                )
+                self._killpg(p)
+                del self._draining[slot]
+        for i in range(len(self.procs) - 1, -1, -1):
+            p = self.procs[i]
+            if p is not None and p.poll() is None and i not in self._draining:
+                p.terminate()
+                self._draining[i] = now + self.stop_grace
+                log.info("scale-down: draining worker[%d] pid %d", i, p.pid)
+                return i
+        return None
 
     def poll(self) -> int:
         """Reap exited workers; respawn crashed ones (after their backoff)
@@ -166,11 +215,110 @@ class WorkerFleet:
                     # reap its surviving group members too (the timeout
                     # branch above already group-killed)
                     self._killpg(p)
-        self.procs = [None] * self.n_workers
+        self.procs = [None] * len(self.procs)
 
     @property
     def n_live(self) -> int:
         return sum(1 for p in self.procs if p is not None and p.poll() is None)
+
+
+class AutoScaler:
+    """Queue-driven elastic sizing on top of a :class:`WorkerFleet`.
+
+    Policy (deliberately simple and oscillation-resistant):
+
+    - scale UP one node per decision when the dispatcher reports pending
+      work (``pending > 0``) and the fleet is below ``max_workers`` — the
+      backlog signal already accounts for free capacity, because the
+      dispatcher drains pending into free slots before stats are read;
+    - scale DOWN one node after ``idle_decisions`` consecutive observations
+      of a completely quiet system (no pending, nothing in flight) while
+      above ``min_workers`` — draining is graceful (SIGTERM), so shrink
+      never kills running work.
+
+    ``step(stats)`` takes the dispatcher's ``/stats`` JSON (see
+    ``TaskDispatcher.serve_stats``) and returns "up", "down", or None, so
+    the policy is unit-testable without HTTP; the CLI feeds it from
+    ``--stats-url`` each supervision loop.
+    """
+
+    def __init__(
+        self,
+        fleet: WorkerFleet,
+        min_workers: int,
+        max_workers: int,
+        idle_decisions: int = 5,
+    ) -> None:
+        if not 0 < min_workers <= max_workers:
+            raise ValueError("need 0 < min_workers <= max_workers")
+        self.fleet = fleet
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.idle_decisions = idle_decisions
+        self._idle_streak = 0
+        self._warned_no_queue_stats = False
+        self.scale_ups = 0
+        self.scale_downs = 0
+
+    def step(self, stats: dict) -> str | None:
+        if "pending" not in stats or "inflight" not in stats:
+            # stats from a dispatcher that doesn't report queue depth (the
+            # classic push/pull modes serve only the base dict): treating
+            # absent as 0 would read a loaded fleet as idle and drain it —
+            # refuse to decide instead
+            if not self._warned_no_queue_stats:
+                self._warned_no_queue_stats = True
+                log.warning(
+                    "stats endpoint reports no pending/inflight (not a "
+                    "tpu-push dispatcher?); autoscaling is inert"
+                )
+            return None
+        live = self.fleet.n_live
+        pending = int(stats.get("pending", 0))
+        inflight = int(stats.get("inflight", 0))
+        if live < self.min_workers:
+            # enforce the floor even while idle (a crashed worker without
+            # --restart must not leave the fleet below --min forever)
+            self.fleet.scale_up()
+            self.scale_ups += 1
+            log.info("autoscale floor: live=%d->%d", live, live + 1)
+            return "up"
+        if pending > 0:
+            self._idle_streak = 0
+            if live < self.max_workers:
+                self.fleet.scale_up()
+                self.scale_ups += 1
+                log.info(
+                    "autoscale up: pending=%d live=%d->%d",
+                    pending, live, live + 1,
+                )
+                return "up"
+            return None
+        if inflight > 0:
+            self._idle_streak = 0
+            return None
+        self._idle_streak += 1
+        if self._idle_streak >= self.idle_decisions and live > self.min_workers:
+            self._idle_streak = 0
+            if self.fleet.scale_down() is not None:
+                self.scale_downs += 1
+                log.info("autoscale down: idle, live=%d->%d", live, live - 1)
+                return "down"
+        return None
+
+
+def _fetch_stats(url: str) -> dict | None:
+    """GET the dispatcher stats JSON; None on any failure (the autoscaler
+    simply skips that decision — a dispatcher restart must not kill the
+    supervisor)."""
+    import json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=2.0) as r:
+            return json.loads(r.read())
+    except Exception:
+        return None
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -188,6 +336,17 @@ def main(argv: list[str] | None = None) -> None:
     )
     ap.add_argument("--restart-backoff", type=float, default=1.0)
     ap.add_argument("--stop-grace", type=float, default=10.0)
+    ap.add_argument(
+        "--stats-url",
+        help="dispatcher stats endpoint (http://host:port/stats) — enables "
+        "queue-driven autoscaling between --min and --max workers",
+    )
+    ap.add_argument("--min", type=int, default=None, help="autoscale floor")
+    ap.add_argument("--max", type=int, default=None, help="autoscale ceiling")
+    ap.add_argument(
+        "--scale-period", type=float, default=2.0,
+        help="seconds between autoscale decisions",
+    )
     ns = ap.parse_args(argv)
 
     fleet = WorkerFleet(
@@ -216,17 +375,32 @@ def main(argv: list[str] | None = None) -> None:
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
 
+    scaler = None
+    if ns.stats_url:
+        scaler = AutoScaler(
+            fleet,
+            min_workers=ns.min if ns.min is not None else ns.n_workers,
+            max_workers=ns.max if ns.max is not None else ns.n_workers * 4,
+        )
+
     fleet.start()
     log.info(
-        "%d %s workers x %d processes -> %s (restart=%s)",
+        "%d %s workers x %d processes -> %s (restart=%s, autoscale=%s)",
         ns.n_workers, ns.protocol, ns.num_processes, ns.dispatcher_url,
-        ns.restart,
+        ns.restart, bool(scaler),
     )
+    last_scale = 0.0
     try:
         while not stop_requested:
-            if fleet.poll() == 0 and not ns.restart:
+            live = fleet.poll()
+            if live == 0 and not ns.restart and scaler is None:
                 log.info("all workers exited; deployer done")
                 return
+            if scaler is not None and time.monotonic() - last_scale >= ns.scale_period:
+                stats = _fetch_stats(ns.stats_url)
+                if stats is not None:
+                    scaler.step(stats)
+                last_scale = time.monotonic()
             time.sleep(0.2)
     finally:
         log.info("draining fleet (%d live)", fleet.n_live)
