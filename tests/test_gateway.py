@@ -505,3 +505,121 @@ def test_async_client_connect_retry_bridges_gateway_restart():
         gw = holder.get("gw")
         if gw is not None:
             gw.stop()
+
+
+def test_idempotency_key_dedupes_resubmits():
+    """A client-supplied idempotency key makes submits safely retryable:
+    the same (function, key) always addresses the same task — a lost
+    response re-sent runs NOTHING twice — while different keys (or no key)
+    still create distinct tasks, and re-submitting after the task finished
+    returns the completed record instead of re-running it."""
+    import threading
+    import time
+
+    from tpu_faas.core.serialize import deserialize
+    from tpu_faas.dispatch.local import LocalDispatcher
+
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    disp = LocalDispatcher(num_workers=2, store=store)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    base = handle.url
+    try:
+        fid = requests.post(
+            f"{base}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        payload = serialize(((123,), {}))
+        body = {"function_id": fid, "payload": payload, "idempotency_key": "job-42"}
+
+        r1 = requests.post(f"{base}/execute_function", json=body).json()
+        r2 = requests.post(f"{base}/execute_function", json=body).json()
+        assert r1["task_id"] == r2["task_id"]
+        assert r2.get("deduplicated") is True
+
+        # distinct keys and keyless submits create distinct tasks
+        other = requests.post(
+            f"{base}/execute_function", json={**body, "idempotency_key": "job-43"}
+        ).json()
+        assert other["task_id"] != r1["task_id"]
+        free = requests.post(
+            f"{base}/execute_function",
+            json={"function_id": fid, "payload": payload},
+        ).json()
+        assert free["task_id"] != r1["task_id"]
+
+        # wait for completion, then re-submit the SAME key: same (finished)
+        # task back, not a re-execution
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            b = requests.get(f"{base}/result/{r1['task_id']}").json()
+            if b["status"] == "COMPLETED":
+                break
+            time.sleep(0.05)
+        assert deserialize(b["result"]) == arithmetic(123)
+        r3 = requests.post(f"{base}/execute_function", json=body).json()
+        assert r3["task_id"] == r1["task_id"] and r3.get("deduplicated") is True
+        b = requests.get(f"{base}/result/{r1['task_id']}").json()
+        assert b["status"] == "COMPLETED"  # record untouched (not re-QUEUED)
+
+        # validation
+        bad = requests.post(
+            f"{base}/execute_function", json={**body, "idempotency_key": ""}
+        )
+        assert bad.status_code == 400
+    finally:
+        disp.stop()
+        t.join(timeout=15)
+        handle.stop()
+
+
+def test_idempotency_key_payload_mismatch_409():
+    """Reusing a key with DIFFERENT params must 409, not silently hand back
+    another request's task/result."""
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    try:
+        fid = requests.post(
+            f"{handle.url}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        body = {
+            "function_id": fid,
+            "payload": serialize(((1,), {})),
+            "idempotency_key": "k1",
+        }
+        first = requests.post(f"{handle.url}/execute_function", json=body)
+        assert first.status_code == 200
+        clash = requests.post(
+            f"{handle.url}/execute_function",
+            json={**body, "payload": serialize(((2,), {}))},
+        )
+        assert clash.status_code == 409
+    finally:
+        handle.stop()
+
+
+def test_store_claim_flag_atomic():
+    """claim_flag: exactly one winner — concurrently on the memory store,
+    sequentially on both RESP servers (single-threaded server => HSET
+    added-count is the atomic arbiter)."""
+    import concurrent.futures
+
+    from tpu_faas.store.launch import make_store, start_store_thread
+
+    mem = MemoryStore()
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        wins = list(
+            pool.map(lambda _: mem.claim_flag("k", "claim"), range(32))
+        )
+    assert sum(wins) == 1
+
+    h = start_store_thread()
+    try:
+        s = make_store(h.url)
+        assert s.claim_flag("k", "claim") is True
+        assert s.claim_flag("k", "claim") is False
+        s.close()
+    finally:
+        h.stop()

@@ -179,11 +179,14 @@ class AsyncFaaSClient:
         priority: int | None = None,
         cost: float | None = None,
         timeout: float | None = None,
+        idempotency_key: str | None = None,
     ) -> AsyncTaskHandle:
         """submit() plus scheduling hints (mirrors the sync SDK): higher
         ``priority`` is admitted first under overload; ``cost`` is the
         estimated run-cost used for task<->worker pairing; ``timeout`` is
-        the execution budget enforced inside the worker's pool child."""
+        the execution budget enforced inside the worker's pool child;
+        ``idempotency_key`` makes the submit safely retryable (a re-send
+        addresses the same task instead of running it twice)."""
         loop = asyncio.get_running_loop()
         payload = await loop.run_in_executor(
             None, lambda: pack_params(*args, **(kwargs or {}))
@@ -195,6 +198,8 @@ class AsyncFaaSClient:
             body["cost"] = cost
         if timeout is not None:
             body["timeout"] = timeout
+        if idempotency_key is not None:
+            body["idempotency_key"] = idempotency_key
         async with self.request(
             "POST", f"{self.base_url}/execute_function", json=body
         ) as r:

@@ -126,6 +126,7 @@ class FaaSClient:
         priority: int | None = None,
         cost: float | None = None,
         timeout: float | None = None,
+        idempotency_key: str | None = None,
     ) -> str:
         body: dict = {"function_id": function_id, "payload": payload}
         if priority is not None:
@@ -134,6 +135,8 @@ class FaaSClient:
             body["cost"] = cost
         if timeout is not None:
             body["timeout"] = timeout
+        if idempotency_key is not None:
+            body["idempotency_key"] = idempotency_key
         r = self.http.post(f"{self.base_url}/execute_function", json=body)
         r.raise_for_status()
         return r.json()["task_id"]
@@ -179,6 +182,7 @@ class FaaSClient:
         priority: int | None = None,
         cost: float | None = None,
         timeout: float | None = None,
+        idempotency_key: str | None = None,
     ) -> TaskHandle:
         """submit() plus scheduling hints. The hints can't ride submit()
         itself — its **kwargs belong to the remote function — so args/kwargs
@@ -187,7 +191,9 @@ class FaaSClient:
         pair expensive tasks with fast workers; ``timeout``: execution time
         budget in seconds, enforced inside the worker's pool child — the
         task FAILs with TaskTimeout instead of eating a process slot
-        forever."""
+        forever; ``idempotency_key``: a client-chosen string making this
+        submit safely retryable — a re-send (lost response, impatient
+        caller) addresses the SAME task instead of running it twice."""
         payload = pack_params(*args, **(kwargs or {}))
         return TaskHandle(
             self,
@@ -197,6 +203,7 @@ class FaaSClient:
                 priority=priority,
                 cost=cost,
                 timeout=timeout,
+                idempotency_key=idempotency_key,
             ),
         )
 

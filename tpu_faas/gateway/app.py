@@ -8,8 +8,13 @@ test_client.py:98-126, test_suit.py:39-91):
 - ``POST /execute_function``   {"function_id": str, "payload": ser_params}
     -> {"task_id": str}      (404 if function_id unknown)
     optional scheduling hints: "priority" (int, higher admitted first under
-    overload) and "cost" (float > 0, estimated run-cost); /execute_batch
-    takes parallel "priorities"/"costs" lists (None entries = no hint)
+    overload), "cost" (float > 0, estimated run-cost), "timeout" (float > 0,
+    execution budget); /execute_batch takes parallel "priorities"/"costs"/
+    "timeouts" lists (None entries = no hint). Optional "idempotency_key"
+    (non-empty str): the same (function, key) always maps to the same task —
+    a duplicate submit returns {"task_id", "deduplicated": true} and writes
+    nothing, so submits become safely retryable. The dedup window is the
+    record's lifetime (a swept/DELETEd record re-runs).
 - ``GET /status/{task_id}``    -> {"task_id", "status"}
 - ``GET /result/{task_id}``    -> {"task_id", "status", "result"}
 
@@ -35,6 +40,7 @@ import functools
 import math
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 
 from aiohttp import web
@@ -42,6 +48,7 @@ from aiohttp import web
 from tpu_faas.core.task import (
     FIELD_COST,
     FIELD_FINISHED_AT,
+    FIELD_PARAMS,
     FIELD_PRIORITY,
     FIELD_STATUS,
     FIELD_TIMEOUT,
@@ -56,6 +63,12 @@ from tpu_faas.utils.logging import TickTracer, get_logger
 log = get_logger("gateway")
 
 _FUNCTION_PREFIX = "function:"
+#: Namespace for idempotency-key -> task-id derivation (uuid5). Any fixed
+#: UUID works; it just keys the hash.
+_IDEMPOTENCY_NS = uuid.UUID("2f1aa4f6-0d8e-4cf1-9e65-6d54e6f1c0aa")
+#: Hash field atomically claimed by the FIRST submit for an idempotent task
+#: id; losers dedup instead of writing (see execute_function).
+_IDEM_CLAIM_FIELD = "idem_claim"
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -372,6 +385,13 @@ def _parse_hints(priority, cost, timeout=None) -> dict[str, str]:
     return extra
 
 
+def _idempotent_task_id(function_id: str, key: str) -> str:
+    """Deterministic task id for (function, idempotency key): a client that
+    re-sends the same submit — e.g. after a response was lost — addresses
+    the SAME task record instead of creating a duplicate execution."""
+    return str(uuid.uuid5(_IDEMPOTENCY_NS, f"{function_id}\x00{key}"))
+
+
 async def execute_function(request: web.Request) -> web.Response:
     ctx: GatewayContext = request.app[CTX_KEY]
     try:
@@ -385,12 +405,43 @@ async def execute_function(request: web.Request) -> web.Response:
         )
     except ValueError as exc:
         return _json_error(400, str(exc))
+    idem_key = body.get("idempotency_key")
+    if idem_key is not None and (
+        not isinstance(idem_key, str) or not idem_key
+    ):
+        return _json_error(400, "'idempotency_key' must be a non-empty string")
     fn_payload = await _run_blocking(
         ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
     )
     if fn_payload is None:
         return _json_error(404, f"unknown function_id {function_id!r}")
-    task_id = new_task_id()
+    if idem_key is not None:
+        task_id = _idempotent_task_id(function_id, idem_key)
+        # atomic claim (store-side: exactly one of N concurrent claimers
+        # wins — a get-then-create here would let two in-flight duplicates
+        # both create+announce and run the task twice)
+        claimed = await _run_blocking(
+            ctx.store.claim_flag, task_id, _IDEM_CLAIM_FIELD
+        )
+        if not claimed:
+            # duplicate submit: write nothing, announce nothing. Guard
+            # against key REUSE with different params (silently handing
+            # back another request's result would be wrong data): compare
+            # payloads once the winner's write has landed.
+            stored = await _run_blocking(
+                ctx.store.hget, task_id, FIELD_PARAMS
+            )
+            if stored is not None and stored != param_payload:
+                return _json_error(
+                    409,
+                    "idempotency_key was already used with a different "
+                    "payload",
+                )
+            return web.json_response(
+                {"task_id": task_id, "deduplicated": True}
+            )
+    else:
+        task_id = new_task_id()
 
     def write_task() -> None:
         ctx.store.create_task(

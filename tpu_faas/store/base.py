@@ -142,6 +142,21 @@ class TaskStore(abc.ABC):
         drags the (possibly huge) result blob over the wire."""
         return [self.hget(key, f) for f in fields]
 
+    def claim_flag(self, key: str, field: str) -> bool:
+        """Atomically set ``field`` on ``key`` and report whether THIS call
+        created it — the mutual-exclusion primitive behind idempotent
+        submits (exactly one of N concurrent claimers wins).
+
+        Backends override with a genuinely atomic form: the RESP client
+        uses HSET's added-field count (servers are single-threaded), the
+        memory store its lock. This base default is check-then-set and only
+        safe single-threaded — concrete stores used in production override
+        it."""
+        if self.hget(key, field) is not None:
+            return False
+        self.hset(key, {field: "1"})
+        return True
+
     def delete_many(self, keys: list[str]) -> None:
         """Batch delete. Default: a loop; the RESP client sends one DEL
         with all keys (the TTL sweeper's backlog purge)."""

@@ -318,6 +318,12 @@ class RespStore(TaskStore):
         if keys:
             self._command("DEL", *keys)  # one round trip, variadic DEL
 
+    def claim_flag(self, key: str, field: str) -> bool:
+        # atomic at the server: HSET replies with the number of NEWLY added
+        # fields, and both store servers process commands single-threadedly
+        # — exactly one concurrent claimer sees 1
+        return self._command("HSET", key, field, "1") == 1
+
     # -- pipelined batch ops ----------------------------------------------
     def hget_many(self, keys, field: str):
         return self.pipeline([("HGET", k, field) for k in keys])

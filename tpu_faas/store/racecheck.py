@@ -340,6 +340,11 @@ class RaceCheckStore(TaskStore):
         # relies on hmget being ONE round trip on RESP backends
         return self.inner.hmget(key, fields)
 
+    def claim_flag(self, key: str, field: str) -> bool:
+        # pass through for atomicity; not a lifecycle write the monitor
+        # models (the claim precedes the task's create)
+        return self.inner.claim_flag(key, field)
+
     def keys(self) -> list[str]:
         return self.inner.keys()
 
