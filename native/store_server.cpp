@@ -9,7 +9,7 @@
 // no threads, no locks, no allocation in the steady-state paths beyond the
 // hash tables themselves.
 //
-// Supported commands: PING, SELECT (ignored), HSET, HGET, HMGET, HGETALL, DEL,
+// Supported commands: PING, SELECT (ignored), HSET, HSETNX, HGET, HMGET, HGETALL, DEL,
 // KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE, FLUSHDB, SAVE, QUIT, SHUTDOWN.
 //
 // Checkpoint/resume: --snapshot PATH loads PATH at startup and writes it on
@@ -458,6 +458,19 @@ class Server {
       auto f = h->second.find(cmd[2]);
       if (f == h->second.end()) { reply_nil(c.outbuf); return; }
       reply_bulk(c.outbuf, f->second);
+    } else if (name == "HSETNX") {
+      if (argc != 3) {
+        reply_error(c.outbuf, "wrong number of arguments for HSETNX");
+        return;
+      }
+      auto& h = store_.hashes[cmd[1]];
+      if (h.find(cmd[2]) != h.end()) {
+        reply_integer(c.outbuf, 0);
+      } else {
+        h[cmd[2]] = cmd[3];
+        dirty_ = true;
+        reply_integer(c.outbuf, 1);
+      }
     } else if (name == "HMGET") {
       if (argc < 2) {
         reply_error(c.outbuf, "wrong number of arguments for HMGET");

@@ -623,3 +623,52 @@ def test_store_claim_flag_atomic():
         s.close()
     finally:
         h.stop()
+
+
+def test_batch_idempotency_keys():
+    """The batch endpoint honors per-item idempotency keys with one
+    pipelined claim round trip: duplicates dedup item-wise, mixed
+    None/keyed entries work, and a key clash 409s."""
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    try:
+        fid = requests.post(
+            f"{handle.url}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        p1, p2 = serialize(((1,), {})), serialize(((2,), {}))
+        body = {
+            "function_id": fid,
+            "payloads": [p1, p2],
+            "idempotency_keys": ["a", None],
+        }
+        r1 = requests.post(f"{handle.url}/execute_batch", json=body).json()
+        assert r1["deduplicated"] == [False, False]
+        r2 = requests.post(f"{handle.url}/execute_batch", json=body).json()
+        assert r2["task_ids"][0] == r1["task_ids"][0]  # keyed: same task
+        assert r2["task_ids"][1] != r1["task_ids"][1]  # keyless: new task
+        assert r2["deduplicated"] == [True, False]
+        # only non-deduplicated items were (re)written/announced: the keyed
+        # record kept its original payload
+        assert store.hgetall(r1["task_ids"][0])["param_payload"] == p1
+
+        clash = requests.post(
+            f"{handle.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": [p2],
+                "idempotency_keys": ["a"],  # reuse with different payload
+            },
+        )
+        assert clash.status_code == 409
+        bad = requests.post(
+            f"{handle.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": [p1],
+                "idempotency_keys": ["a", "b"],  # wrong length
+            },
+        )
+        assert bad.status_code == 400
+    finally:
+        handle.stop()

@@ -213,6 +213,7 @@ class AsyncFaaSClient:
         priorities: list[int] | None = None,
         costs: list[float] | None = None,
         timeouts: list[float] | None = None,
+        idempotency_keys: list[str | None] | None = None,
     ) -> list[AsyncTaskHandle]:
         # dill-packing thousands of payloads inline would stall the event
         # loop (and every concurrently polling handle) — do it in a worker
@@ -231,6 +232,8 @@ class AsyncFaaSClient:
             body["costs"] = costs
         if timeouts is not None:
             body["timeouts"] = timeouts
+        if idempotency_keys is not None:
+            body["idempotency_keys"] = idempotency_keys
         async with self.request(
             "POST", f"{self.base_url}/execute_batch", json=body
         ) as r:

@@ -214,6 +214,7 @@ class FaaSClient:
         priorities: list[int] | None = None,
         costs: list[float] | None = None,
         timeouts: list[float] | None = None,
+        idempotency_keys: list[str | None] | None = None,
     ) -> list[TaskHandle]:
         """Batch submit over ONE HTTP call (+ one pipelined store round
         trip): ``params_list`` holds (args, kwargs) pairs. N single submits
@@ -232,6 +233,8 @@ class FaaSClient:
             body["costs"] = costs
         if timeouts is not None:
             body["timeouts"] = timeouts
+        if idempotency_keys is not None:
+            body["idempotency_keys"] = idempotency_keys
         r = self.http.post(f"{self.base_url}/execute_batch", json=body)
         r.raise_for_status()
         return [TaskHandle(self, tid) for tid in r.json()["task_ids"]]

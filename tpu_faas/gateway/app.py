@@ -497,27 +497,79 @@ async def execute_batch(request: web.Request) -> web.Response:
         ]
     except ValueError as exc:
         return _json_error(400, str(exc))
+    idem_keys = body.get("idempotency_keys")
+    if idem_keys is not None:
+        if not isinstance(idem_keys, list) or len(idem_keys) != len(payloads):
+            return _json_error(
+                400, "'idempotency_keys' must be a list parallel to 'payloads'"
+            )
+        for k in idem_keys:
+            if k is not None and (not isinstance(k, str) or not k):
+                return _json_error(
+                    400,
+                    "'idempotency_keys' entries must be non-empty strings "
+                    "or null",
+                )
     fn_payload = await _run_blocking(
         ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
     )
     if fn_payload is None:
         return _json_error(404, f"unknown function_id {function_id!r}")
-    task_ids = [new_task_id() for _ in payloads]
+
+    task_ids: list[str] = []
+    dedup: list[bool] = [False] * len(payloads)
+    if idem_keys is None:
+        task_ids = [new_task_id() for _ in payloads]
+        to_create = list(range(len(payloads)))
+    else:
+        # same semantics as the single endpoint, batched: one pipelined
+        # round trip claims every keyed id atomically; losers dedup
+        keyed = [i for i, k in enumerate(idem_keys) if k is not None]
+        claim_ids = {
+            i: _idempotent_task_id(function_id, idem_keys[i]) for i in keyed
+        }
+        wins = await _run_blocking(
+            ctx.store.claim_flags,
+            [claim_ids[i] for i in keyed],
+            _IDEM_CLAIM_FIELD,
+        )
+        won = {i: w for i, w in zip(keyed, wins)}
+        to_create = []
+        for i in range(len(payloads)):
+            if idem_keys[i] is None:
+                task_ids.append(new_task_id())
+                to_create.append(i)
+            elif won[i]:
+                task_ids.append(claim_ids[i])
+                to_create.append(i)
+            else:
+                stored = await _run_blocking(
+                    ctx.store.hget, claim_ids[i], FIELD_PARAMS
+                )
+                if stored is not None and stored != payloads[i]:
+                    return _json_error(
+                        409,
+                        f"idempotency_keys[{i}] was already used with a "
+                        "different payload",
+                    )
+                task_ids.append(claim_ids[i])
+                dedup[i] = True
 
     def write_tasks() -> None:
         ctx.store.create_tasks(
             [
-                (tid, fn_payload, param_payload, extra or None)
-                for tid, param_payload, extra in zip(
-                    task_ids, payloads, extras
-                )
+                (task_ids[i], fn_payload, payloads[i], extras[i] or None)
+                for i in to_create
             ],
             ctx.channel,
         )
 
     await _run_blocking(write_tasks)
-    ctx.n_tasks += len(task_ids)
-    return web.json_response({"task_ids": task_ids})
+    ctx.n_tasks += len(to_create)
+    resp = {"task_ids": task_ids}
+    if idem_keys is not None:
+        resp["deduplicated"] = dedup
+    return web.json_response(resp)
 
 
 async def get_status(request: web.Request) -> web.Response:

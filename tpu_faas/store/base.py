@@ -142,20 +142,32 @@ class TaskStore(abc.ABC):
         drags the (possibly huge) result blob over the wire."""
         return [self.hget(key, f) for f in fields]
 
-    def claim_flag(self, key: str, field: str) -> bool:
-        """Atomically set ``field`` on ``key`` and report whether THIS call
-        created it — the mutual-exclusion primitive behind idempotent
-        submits (exactly one of N concurrent claimers wins).
+    def setnx_field(
+        self, key: str, field: str, value: str
+    ) -> tuple[bool, str]:
+        """Set ``field`` on ``key`` only if absent; return (created,
+        current_value) — the mutual-exclusion primitive behind idempotent
+        submits. Exactly one of N concurrent callers creates the field, and
+        EVERY caller walks away with the winning value, so losers can
+        compare payloads without a not-yet-written window.
 
         Backends override with a genuinely atomic form: the RESP client
-        uses HSET's added-field count (servers are single-threaded), the
-        memory store its lock. This base default is check-then-set and only
-        safe single-threaded — concrete stores used in production override
-        it."""
-        if self.hget(key, field) is not None:
-            return False
-        self.hset(key, {field: "1"})
-        return True
+        sends HSETNX+HGET (safe because claimed fields are write-once —
+        the winner's later full-record write repeats the same value), the
+        memory store uses its lock. This base default is check-then-set and
+        only single-thread safe — production stores override it."""
+        existing = self.hget(key, field)
+        if existing is not None:
+            return False, existing
+        self.hset(key, {field: value})
+        return True, value
+
+    def setnx_fields(
+        self, items: list[tuple[str, str]], field: str
+    ) -> list[tuple[bool, str]]:
+        """setnx_field over many (key, value) pairs. Default: a loop; the
+        RESP client pipelines everything into one round trip."""
+        return [self.setnx_field(key, field, value) for key, value in items]
 
     def delete_many(self, keys: list[str]) -> None:
         """Batch delete. Default: a loop; the RESP client sends one DEL

@@ -318,11 +318,32 @@ class RespStore(TaskStore):
         if keys:
             self._command("DEL", *keys)  # one round trip, variadic DEL
 
-    def claim_flag(self, key: str, field: str) -> bool:
-        # atomic at the server: HSET replies with the number of NEWLY added
-        # fields, and both store servers process commands single-threadedly
-        # — exactly one concurrent claimer sees 1
-        return self._command("HSET", key, field, "1") == 1
+    def setnx_field(
+        self, key: str, field: str, value: str
+    ) -> tuple[bool, str]:
+        # HSETNX is atomic at the single-threaded server; the HGET read-back
+        # is correct even if another command interleaves, because a claimed
+        # field is write-once (the winner's full-record HSET repeats the
+        # same value and nothing else ever mutates it)
+        created, current = self.pipeline(
+            [("HSETNX", key, field, value), ("HGET", key, field)]
+        )
+        return created == 1, current
+
+    def setnx_fields(
+        self, items: list[tuple[str, str]], field: str
+    ) -> list[tuple[bool, str]]:
+        if not items:
+            return []
+        cmds: list[tuple] = []
+        for key, value in items:
+            cmds.append(("HSETNX", key, field, value))
+            cmds.append(("HGET", key, field))
+        replies = self.pipeline(cmds)
+        return [
+            (replies[2 * i] == 1, replies[2 * i + 1])
+            for i in range(len(items))
+        ]
 
     # -- pipelined batch ops ----------------------------------------------
     def hget_many(self, keys, field: str):

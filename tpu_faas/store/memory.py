@@ -51,15 +51,17 @@ class MemoryStore(TaskStore):
         with self._lock:
             self._hashes.setdefault(key, {}).update(fields)
 
-    def claim_flag(self, key: str, field: str) -> bool:
+    def setnx_field(
+        self, key: str, field: str, value: str
+    ) -> tuple[bool, str]:
         # atomic under the store lock (the base default's check-then-set
         # would race between gateway executor threads)
         with self._lock:
             h = self._hashes.setdefault(key, {})
             if field in h:
-                return False
-            h[field] = "1"
-            return True
+                return False, h[field]
+            h[field] = value
+            return True, value
 
     def hget(self, key: str, field: str) -> str | None:
         with self._lock:

@@ -340,10 +340,13 @@ class RaceCheckStore(TaskStore):
         # relies on hmget being ONE round trip on RESP backends
         return self.inner.hmget(key, fields)
 
-    def claim_flag(self, key: str, field: str) -> bool:
+    def setnx_field(self, key: str, field: str, value: str) -> tuple[bool, str]:
         # pass through for atomicity; not a lifecycle write the monitor
         # models (the claim precedes the task's create)
-        return self.inner.claim_flag(key, field)
+        return self.inner.setnx_field(key, field, value)
+
+    def setnx_fields(self, items, field: str):
+        return self.inner.setnx_fields(items, field)
 
     def keys(self) -> list[str]:
         return self.inner.keys()
