@@ -383,13 +383,29 @@ def test_result_ttl_sweeper_expires_only_old_terminal_records():
     store.create_task("unstamped", "F", "P")
     store.hset("unstamped", {"status": "COMPLETED", "result": "R"})
 
+    # claim-only hashes (idempotency winner died between claim and create):
+    # the claim value's embedded timestamp dates them — old ones go, fresh
+    # ones (winner may be in flight) and foreign status-less hashes stay
+    from tpu_faas.gateway.app import _IDEM_CLAIM_FIELD, _idem_claim_value
+
+    store.hset(
+        "old-claim", {_IDEM_CLAIM_FIELD: _idem_claim_value("P", now - 100)}
+    )
+    store.hset(
+        "fresh-claim", {_IDEM_CLAIM_FIELD: _idem_claim_value("P", now)}
+    )
+    store.hset("foreign", {"someone": "elses data"})
+
     n = _sweep_expired_results(store, ttl=30.0, now=now)
-    assert n == 1
+    assert n == 2
     assert store.get_status("old-done") is None  # expired
     assert store.get_status("queued") == "QUEUED"  # live: untouched
     assert store.get_status("fresh-done") == "COMPLETED"  # within TTL
     assert store.get_status("unstamped") == "COMPLETED"  # no stamp: kept
     assert store.hgetall("function:f1")  # registry never swept
+    assert not store.hgetall("old-claim")  # abandoned claim: GC'd
+    assert store.hgetall("fresh-claim")  # recent claim: kept
+    assert store.hgetall("foreign")  # not ours: never touched
 
 
 def test_result_ttl_end_to_end():
@@ -600,9 +616,10 @@ def test_idempotency_key_payload_mismatch_409():
         handle.stop()
 
 
-def test_store_claim_flag_atomic():
-    """claim_flag: exactly one winner — concurrently on the memory store,
-    sequentially on both RESP servers (single-threaded server => HSET
+def test_store_setnx_field_atomic():
+    """setnx_field: exactly one creator, and EVERY caller (winner or loser)
+    walks away with the winning value — concurrently on the memory store,
+    sequentially on the RESP server (single-threaded server => HSETNX
     added-count is the atomic arbiter)."""
     import concurrent.futures
 
@@ -610,19 +627,144 @@ def test_store_claim_flag_atomic():
 
     mem = MemoryStore()
     with concurrent.futures.ThreadPoolExecutor(8) as pool:
-        wins = list(
-            pool.map(lambda _: mem.claim_flag("k", "claim"), range(32))
+        results = list(
+            pool.map(
+                lambda i: mem.setnx_field("k", "claim", f"v{i}"), range(32)
+            )
         )
-    assert sum(wins) == 1
+    assert sum(created for created, _ in results) == 1
+    winning = mem.hget("k", "claim")
+    assert all(current == winning for _, current in results)
 
     h = start_store_thread()
     try:
         s = make_store(h.url)
-        assert s.claim_flag("k", "claim") is True
-        assert s.claim_flag("k", "claim") is False
+        assert s.setnx_field("k", "claim", "first") == (True, "first")
+        assert s.setnx_field("k", "claim", "second") == (False, "first")
+        assert s.setnx_fields(
+            [("k", "third"), ("k2", "fresh")], "claim"
+        ) == [(False, "first"), (True, "fresh")]
         s.close()
     finally:
         h.stop()
+
+
+def test_idempotency_abandoned_claim_adopted():
+    """A claim whose winner died between claim and create (claim field
+    exists, no task record) must not strand retries: the dedup loser adopts
+    the claim and creates the record itself, so /status works immediately."""
+    from tpu_faas.gateway.app import _IDEM_CLAIM_FIELD, _idem_claim_value
+
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    try:
+        fid = requests.post(
+            f"{handle.url}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        payload = serialize(((7,), {}))
+        body = {
+            "function_id": fid,
+            "payload": payload,
+            "idempotency_key": "dead-winner",
+        }
+        # simulate the dead winner: write the claim exactly as a crashed
+        # gateway would have, with NO task record behind it
+        from tpu_faas.gateway.app import _idempotent_task_id
+
+        tid = _idempotent_task_id(fid, "dead-winner")
+        store.hset(tid, {_IDEM_CLAIM_FIELD: _idem_claim_value(payload)})
+
+        r = requests.post(f"{handle.url}/execute_function", json=body)
+        assert r.status_code == 200
+        got = r.json()
+        assert got["task_id"] == tid and got.get("deduplicated") is True
+        # the record now exists (adoption created it) — no stranded 404
+        s = requests.get(f"{handle.url}/status/{tid}")
+        assert s.status_code == 200 and s.json()["status"] == "QUEUED"
+
+        # mismatch against a claim-only hash is still a 409 (the claim
+        # value carries the payload hash; no record needed to compare)
+        clash = requests.post(
+            f"{handle.url}/execute_function",
+            json={**body, "payload": serialize(((8,), {}))},
+        )
+        assert clash.status_code == 409
+    finally:
+        handle.stop()
+
+
+def test_batch_duplicate_idempotency_keys_rejected():
+    """Two items with one idempotency_key in a single batch is a client
+    error (400) — the claim round would silently dedup the second against
+    the first before its payload is even written."""
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    try:
+        fid = requests.post(
+            f"{handle.url}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        r = requests.post(
+            f"{handle.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": [serialize(((1,), {})), serialize(((2,), {}))],
+                "idempotency_keys": ["same", "same"],
+            },
+        )
+        assert r.status_code == 400
+        assert "duplicate" in r.json()["error"]
+    finally:
+        handle.stop()
+
+
+def test_batch_mismatch_409_does_not_burn_other_claims():
+    """A batch 409 (one key reused with a different payload) must not leave
+    the OTHER items' keys unusable: validation happens before any claim is
+    written, so a follow-up batch with the bad item fixed fully succeeds."""
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    try:
+        fid = requests.post(
+            f"{handle.url}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        pa, pb, pc = (serialize(((n,), {})) for n in (1, 2, 3))
+        # seed key "a" with payload pa
+        first = requests.post(
+            f"{handle.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": [pa],
+                "idempotency_keys": ["a"],
+            },
+        ).json()
+        # now a batch where "a" clashes and "b" is fresh -> 409, no claims
+        clash = requests.post(
+            f"{handle.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": [pb, pc],
+                "idempotency_keys": ["a", "b"],
+            },
+        )
+        assert clash.status_code == 409
+        # "b" was NOT burned: submitting it again creates a real task
+        retry = requests.post(
+            f"{handle.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": [pc],
+                "idempotency_keys": ["b"],
+            },
+        ).json()
+        assert retry["deduplicated"] == [False]
+        tid = retry["task_ids"][0]
+        assert store.hgetall(tid).get("param_payload") == pc
+        assert first["task_ids"][0] != tid
+    finally:
+        handle.stop()
 
 
 def test_batch_idempotency_keys():

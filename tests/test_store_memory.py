@@ -112,3 +112,29 @@ def test_first_wins_does_not_resurrect_deleted_record():
     store.finish_task("t", "FAILED", "zombie-late", first_wins=True)
     assert store.hgetall("t") == {}
     store.close()
+
+
+def test_create_task_if_absent_never_regresses():
+    """The keyed-create primitive: one creator wins; a late second create
+    cannot reset an already-RUNNING (or terminal) record back to QUEUED —
+    and a predecessor that died between its status claim and its field
+    write is repaired in place."""
+    from tpu_faas.core.task import FIELD_PARAMS, FIELD_STATUS
+    from tpu_faas.store.memory import MemoryStore
+
+    s = MemoryStore()
+    sub = s.subscribe("tasks")
+    assert s.create_task_if_absent("t1", "F", "P") is True
+    assert sub.get_message() == "t1"
+    # simulate dispatch: RUNNING; a very late duplicate create must not
+    # regress the status or re-announce
+    s.set_status("t1", "RUNNING")
+    assert s.create_task_if_absent("t1", "F", "P") is False
+    assert s.get_status("t1") == "RUNNING"
+    assert sub.get_message() is None
+
+    # repair path: status claimed but the field write never landed
+    s.hset("t2", {FIELD_STATUS: "QUEUED"})
+    assert s.create_task_if_absent("t2", "F2", "P2") is True
+    assert s.hget("t2", FIELD_PARAMS) == "P2"
+    assert sub.get_message() == "t2"

@@ -37,6 +37,7 @@ import argparse
 import asyncio
 import contextlib
 import functools
+import hashlib
 import math
 import threading
 import time
@@ -67,8 +68,42 @@ _FUNCTION_PREFIX = "function:"
 #: UUID works; it just keys the hash.
 _IDEMPOTENCY_NS = uuid.UUID("2f1aa4f6-0d8e-4cf1-9e65-6d54e6f1c0aa")
 #: Hash field atomically claimed by the FIRST submit for an idempotent task
-#: id; losers dedup instead of writing (see execute_function).
+#: id; losers dedup instead of writing (see execute_function). The claim
+#: VALUE is "<sha256(param_payload)>:<unix_ts>": carrying the payload hash
+#: makes key-reuse-with-different-payload detectable atomically at claim
+#: time (no dependence on the winner's later record write), and the
+#: timestamp lets the TTL sweeper age out claim-only hashes abandoned by a
+#: gateway that died between claim and create.
 _IDEM_CLAIM_FIELD = "idem_claim"
+
+#: How long a dedup loser waits for the claim winner's record write to land
+#: before adopting the claim (creating the record itself). Covers both the
+#: in-flight winner (record appears within ms) and the dead winner (record
+#: never appears; the retry must not be stranded against a task that does
+#: not exist).
+_IDEM_ADOPT_WAIT_S = 1.5
+
+
+def _idem_claim_value(param_payload: str, now: float | None = None) -> str:
+    h = hashlib.sha256(param_payload.encode()).hexdigest()
+    ts = int(now if now is not None else time.time())
+    return f"{h}:{ts}"
+
+
+def _idem_claim_hash(claim_value: str) -> str:
+    return claim_value.split(":", 1)[0]
+
+
+def _idem_claim_age(claim_value: str, now: float) -> float | None:
+    """Seconds since the claim was written, or None if unparseable (foreign
+    producer wrote the field) — unparseable claims are never swept."""
+    parts = claim_value.split(":", 1)
+    if len(parts) != 2:
+        return None
+    try:
+        return now - float(parts[1])
+    except ValueError:
+        return None
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -228,27 +263,52 @@ def _sweep_expired_results(
         return 0
     statuses = store.hget_many(keys, FIELD_STATUS)
     terminal = []
+    statusless = []
     for key, status in zip(keys, statuses):
         if status is None:
+            statusless.append(key)
             continue
         try:
             if TaskStatus(status).is_terminal():
                 terminal.append(key)
         except ValueError:
             continue
-    if not terminal:
-        return 0
-    stamps = store.hget_many(terminal, FIELD_FINISHED_AT)
     expired = []
-    for key, stamp in zip(terminal, stamps):
-        if stamp is None:
-            continue  # pre-stamp record (or foreign producer): never expire
-        try:
-            finished_at = float(stamp)
-        except ValueError:
-            continue
-        if now_f - finished_at > ttl:
-            expired.append(key)
+    if terminal:
+        stamps = store.hget_many(terminal, FIELD_FINISHED_AT)
+        for key, stamp in zip(terminal, stamps):
+            if stamp is None:
+                continue  # pre-stamp record (foreign producer): never expire
+            try:
+                finished_at = float(stamp)
+            except ValueError:
+                continue
+            if now_f - finished_at > ttl:
+                expired.append(key)
+    if statusless:
+        # claim-only hashes: an idempotency claim whose winner died between
+        # claim and create, never adopted by a retry. The claim value's
+        # timestamp dates it; without this they would leak forever
+        # (invisible to the terminal sweep — they have no status).
+        claims = store.hget_many(statusless, _IDEM_CLAIM_FIELD)
+        stale_claims = []
+        for key, claim in zip(statusless, claims):
+            if claim is None:
+                continue  # not ours (foreign producer hash): never touch
+            age = _idem_claim_age(claim, now_f)
+            if age is not None and age > max(ttl, 10 * _IDEM_ADOPT_WAIT_S):
+                stale_claims.append(key)
+        if stale_claims:
+            # re-probe right before deleting: a retry may have ADOPTED the
+            # claim (created the real task record) since the snapshot above
+            # — deleting then would vaporize an acknowledged submit. The
+            # re-read shrinks the race to the sub-ms gap between these two
+            # commands, against an adoption window that opens only after
+            # the claim sat unadopted for minutes.
+            recheck = store.hget_many(stale_claims, FIELD_STATUS)
+            expired.extend(
+                k for k, s in zip(stale_claims, recheck) if s is None
+            )
     store.delete_many(expired)  # one variadic DEL on RESP backends
     return len(expired)
 
@@ -415,40 +475,74 @@ async def execute_function(request: web.Request) -> web.Response:
     )
     if fn_payload is None:
         return _json_error(404, f"unknown function_id {function_id!r}")
+    def write_task(task_id: str) -> None:
+        ctx.store.create_task(
+            task_id, fn_payload, param_payload, ctx.channel, extra or None
+        )
+
+    def write_task_nx(task_id: str) -> bool:
+        # keyed creates only: winner and adopter can both believe the
+        # deterministic task id is theirs to write; a plain create racing
+        # an already-dispatched copy would reset RUNNING back to QUEUED
+        # and run the task twice
+        return ctx.store.create_task_if_absent(
+            task_id, fn_payload, param_payload, ctx.channel, extra or None
+        )
+
     if idem_key is not None:
         task_id = _idempotent_task_id(function_id, idem_key)
         # atomic claim (store-side: exactly one of N concurrent claimers
         # wins — a get-then-create here would let two in-flight duplicates
-        # both create+announce and run the task twice)
-        claimed = await _run_blocking(
-            ctx.store.claim_flag, task_id, _IDEM_CLAIM_FIELD
+        # both create+announce and run the task twice). The claim value
+        # carries the payload hash, so key-reuse-with-different-payload is
+        # caught right here without waiting for the winner's record write.
+        claim = _idem_claim_value(param_payload)
+        created, current = await _run_blocking(
+            ctx.store.setnx_field, task_id, _IDEM_CLAIM_FIELD, claim
         )
-        if not claimed:
-            # duplicate submit: write nothing, announce nothing. Guard
-            # against key REUSE with different params (silently handing
-            # back another request's result would be wrong data): compare
-            # payloads once the winner's write has landed.
-            stored = await _run_blocking(
-                ctx.store.hget, task_id, FIELD_PARAMS
-            )
-            if stored is not None and stored != param_payload:
+        if not created:
+            if _idem_claim_hash(current) != _idem_claim_hash(claim):
                 return _json_error(
                     409,
                     "idempotency_key was already used with a different "
                     "payload",
                 )
+            # duplicate submit: normally write nothing, announce nothing.
+            # But the record must EXIST before we acknowledge, or the
+            # client's next GET /status 404s for a submit we just accepted
+            # — and if the winner died between claim and create, nobody
+            # would ever create it. Wait briefly for the in-flight winner;
+            # past the deadline, adopt the claim and create the record
+            # ourselves (safe: the task id is deterministic and create_task
+            # writes the identical payload in one atomic HSET; a duplicate
+            # announce is deduped by dispatcher intake, which skips
+            # non-QUEUED tasks and same-batch repeats).
+            deadline = time.monotonic() + _IDEM_ADOPT_WAIT_S
+            pause = 0.02
+            while True:
+                stored = await _run_blocking(
+                    ctx.store.hget, task_id, FIELD_PARAMS
+                )
+                if stored is not None or time.monotonic() >= deadline:
+                    break
+                await asyncio.sleep(pause)
+                pause = min(pause * 2, 0.25)
+            if stored is None:
+                log.warning(
+                    "adopting abandoned idempotency claim for task %s",
+                    task_id,
+                )
+                if await _run_blocking(write_task_nx, task_id):
+                    ctx.n_tasks += 1
             return web.json_response(
                 {"task_id": task_id, "deduplicated": True}
             )
-    else:
-        task_id = new_task_id()
+        await _run_blocking(write_task_nx, task_id)
+        ctx.n_tasks += 1
+        return web.json_response({"task_id": task_id})
 
-    def write_task() -> None:
-        ctx.store.create_task(
-            task_id, fn_payload, param_payload, ctx.channel, extra or None
-        )
-
-    await _run_blocking(write_task)
+    task_id = new_task_id()
+    await _run_blocking(write_task, task_id)
     ctx.n_tasks += 1
     return web.json_response({"task_id": task_id})
 
@@ -503,13 +597,25 @@ async def execute_batch(request: web.Request) -> web.Response:
             return _json_error(
                 400, "'idempotency_keys' must be a list parallel to 'payloads'"
             )
+        seen_keys: set[str] = set()
         for k in idem_keys:
-            if k is not None and (not isinstance(k, str) or not k):
+            if k is None:
+                continue
+            if not isinstance(k, str) or not k:
                 return _json_error(
                     400,
                     "'idempotency_keys' entries must be non-empty strings "
                     "or null",
                 )
+            if k in seen_keys:
+                # two items with one key cannot both be honored — and the
+                # claim round would silently dedup the second against the
+                # first mid-flight, before its payload is even comparable
+                return _json_error(
+                    400,
+                    f"duplicate idempotency_key {k!r} within one batch",
+                )
+            seen_keys.add(k)
     fn_payload = await _run_blocking(
         ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
     )
@@ -522,47 +628,121 @@ async def execute_batch(request: web.Request) -> web.Response:
         task_ids = [new_task_id() for _ in payloads]
         to_create = list(range(len(payloads)))
     else:
-        # same semantics as the single endpoint, batched: one pipelined
-        # round trip claims every keyed id atomically; losers dedup
+        # same semantics as the single endpoint, batched. Validation comes
+        # BEFORE any claim is written: a 409 discovered after claiming
+        # other items would leave their fresh claims without task records
+        # (burned keys). The pre-read catches every already-stored
+        # mismatch; only a mismatch racing in between the pre-read and the
+        # claim round can still 409 after claims, and those orphaned claims
+        # are self-healing (adopted by the next retry, or aged out by the
+        # TTL sweeper via the claim timestamp).
         keyed = [i for i, k in enumerate(idem_keys) if k is not None]
         claim_ids = {
             i: _idempotent_task_id(function_id, idem_keys[i]) for i in keyed
         }
-        wins = await _run_blocking(
-            ctx.store.claim_flags,
+        claims = {i: _idem_claim_value(payloads[i]) for i in keyed}
+        existing = await _run_blocking(
+            ctx.store.hget_many,
             [claim_ids[i] for i in keyed],
             _IDEM_CLAIM_FIELD,
         )
-        won = {i: w for i, w in zip(keyed, wins)}
+        for i, current in zip(keyed, existing):
+            if current is not None and _idem_claim_hash(
+                current
+            ) != _idem_claim_hash(claims[i]):
+                return _json_error(
+                    409,
+                    f"idempotency_keys[{i}] was already used with a "
+                    "different payload",
+                )
+        # one pipelined round trip claims every keyed id atomically
+        results = await _run_blocking(
+            ctx.store.setnx_fields,
+            [(claim_ids[i], claims[i]) for i in keyed],
+            _IDEM_CLAIM_FIELD,
+        )
+        won: dict[int, bool] = {}
+        for i, (created, current) in zip(keyed, results):
+            if not created and _idem_claim_hash(
+                current
+            ) != _idem_claim_hash(claims[i]):
+                return _json_error(
+                    409,
+                    f"idempotency_keys[{i}] was already used with a "
+                    "different payload",
+                )
+            won[i] = created
+        # Dedup losers still need their records to EXIST before we ack
+        # (claim winner may be in flight — or dead). One collective bounded
+        # wait, then adopt whatever never appeared.
+        losers = [i for i in keyed if not won[i]]
+        missing: list[int] = []
+        if losers:
+            deadline = time.monotonic() + _IDEM_ADOPT_WAIT_S
+            pause = 0.02
+            while True:
+                stored = await _run_blocking(
+                    ctx.store.hget_many,
+                    [claim_ids[i] for i in losers],
+                    FIELD_PARAMS,
+                )
+                missing = [
+                    i for i, s in zip(losers, stored) if s is None
+                ]
+                if not missing or time.monotonic() >= deadline:
+                    break
+                losers = missing
+                await asyncio.sleep(pause)
+                pause = min(pause * 2, 0.25)
+            if missing:
+                log.warning(
+                    "adopting %d abandoned idempotency claims", len(missing)
+                )
+        adopt = set(missing)
         to_create = []
         for i in range(len(payloads)):
             if idem_keys[i] is None:
                 task_ids.append(new_task_id())
                 to_create.append(i)
-            elif won[i]:
+            elif won[i] or i in adopt:
                 task_ids.append(claim_ids[i])
                 to_create.append(i)
+                dedup[i] = not won[i]
             else:
-                stored = await _run_blocking(
-                    ctx.store.hget, claim_ids[i], FIELD_PARAMS
-                )
-                if stored is not None and stored != payloads[i]:
-                    return _json_error(
-                        409,
-                        f"idempotency_keys[{i}] was already used with a "
-                        "different payload",
-                    )
                 task_ids.append(claim_ids[i])
                 dedup[i] = True
 
     def write_tasks() -> None:
-        ctx.store.create_tasks(
-            [
-                (task_ids[i], fn_payload, payloads[i], extras[i] or None)
-                for i in to_create
-            ],
-            ctx.channel,
-        )
+        if idem_keys is None:
+            ctx.store.create_tasks(
+                [
+                    (task_ids[i], fn_payload, payloads[i], extras[i] or None)
+                    for i in to_create
+                ],
+                ctx.channel,
+            )
+            return
+        # keyed items use the regression-proof create (see write_task_nx in
+        # execute_function); unkeyed items in the same batch keep the one-
+        # round-trip pipelined create
+        unkeyed = [i for i in to_create if idem_keys[i] is None]
+        if unkeyed:
+            ctx.store.create_tasks(
+                [
+                    (task_ids[i], fn_payload, payloads[i], extras[i] or None)
+                    for i in unkeyed
+                ],
+                ctx.channel,
+            )
+        for i in to_create:
+            if idem_keys[i] is not None:
+                ctx.store.create_task_if_absent(
+                    task_ids[i],
+                    fn_payload,
+                    payloads[i],
+                    ctx.channel,
+                    extras[i] or None,
+                )
 
     await _run_blocking(write_tasks)
     ctx.n_tasks += len(to_create)

@@ -135,6 +135,48 @@ class TaskStore(abc.ABC):
         )
         self.publish(channel, task_id)
 
+    def create_task_if_absent(
+        self,
+        task_id: str,
+        fn_payload: str,
+        param_payload: str,
+        channel: str = TASKS_CHANNEL,
+        extra_fields: dict[str, str] | None = None,
+    ) -> bool:
+        """create_task that can NEVER regress an existing record: the status
+        field is claimed with setnx, so a concurrent (or very late) second
+        creator writes nothing — a plain create_task racing an already-
+        dispatched copy of the same deterministic task id would reset
+        RUNNING back to QUEUED and get the task executed twice. Used by the
+        gateway for every idempotency-keyed create, where winner and
+        adopter can both believe the record is theirs to write.
+
+        Returns True when this call created (and announced) the record.
+        A predecessor that died between its status claim and its field
+        write (status QUEUED, no params) is repaired in place — same
+        values, write-once — and re-announced; duplicate announces are
+        deduped at dispatcher intake.
+        """
+        created, current = self.setnx_field(
+            task_id, FIELD_STATUS, str(TaskStatus.QUEUED)
+        )
+        if not created and not (
+            current == str(TaskStatus.QUEUED)
+            and self.hget(task_id, FIELD_PARAMS) is None
+        ):
+            return False
+        self.hset(
+            task_id,
+            {
+                **(extra_fields or {}),
+                FIELD_FN: fn_payload,
+                FIELD_PARAMS: param_payload,
+                FIELD_RESULT: "None",
+            },
+        )
+        self.publish(channel, task_id)
+        return True
+
     def hmget(self, key: str, fields: list[str]) -> list[str | None]:
         """Several fields of one hash, None per missing field. Default: a
         loop; the RESP client sends one HMGET round trip — the dispatcher's
@@ -205,8 +247,26 @@ class TaskStore(abc.ABC):
             raise KeyError(f"unknown task {task_id!r}")
         return fields[FIELD_FN], fields[FIELD_PARAMS]
 
-    def set_status(self, task_id: str, status: TaskStatus | str) -> None:
-        self.hset(task_id, {FIELD_STATUS: str(status)})
+    def set_status(
+        self,
+        task_id: str,
+        status: TaskStatus | str,
+        extra_fields: Mapping[str, str] | None = None,
+    ) -> None:
+        """``extra_fields`` ride in the same hash write (one round trip) —
+        the RUNNING mark uses this to stamp its ownership lease."""
+        fields = {FIELD_STATUS: str(status)}
+        if extra_fields:
+            fields.update(extra_fields)
+        self.hset(task_id, fields)
+
+    def hset_many(self, items: list[tuple[str, Mapping[str, str]]]) -> None:
+        """Field writes across many hashes. Default: a loop; the RESP client
+        pipelines one round trip — the dispatcher's in-flight lease renewal
+        touches every in-flight task each period and must not pay a round
+        trip per task."""
+        for key, fields in items:
+            self.hset(key, fields)
 
     def get_status(self, task_id: str) -> str | None:
         return self.hget(task_id, FIELD_STATUS)

@@ -318,6 +318,20 @@ class RespStore(TaskStore):
         if keys:
             self._command("DEL", *keys)  # one round trip, variadic DEL
 
+    def hset_many(self, items) -> None:
+        """Pipelined multi-hash HSET: the lease-renewal path touches every
+        in-flight task once per period — one round trip, not one per task."""
+        if not items:
+            return
+        cmds = [
+            ("HSET", key, *(p for kv in fields.items() for p in kv))
+            for key, fields in items
+        ]
+        replies = self.pipeline(cmds)
+        errors = [r for r in replies if isinstance(r, resp.RespError)]
+        if errors:
+            raise errors[0]
+
     def setnx_field(
         self, key: str, field: str, value: str
     ) -> tuple[bool, str]:
