@@ -105,3 +105,36 @@ def test_sharded_full_tick(mesh):
 
     counts = SchedulerArrays.assigned_counts(a, 4)
     assert counts.sum() == (a >= 0).sum()
+
+
+def test_scheduler_arrays_mesh_matches_single_device(mesh):
+    """The mesh-backed SchedulerArrays tick and the single-device tick make
+    IDENTICAL rank-placement decisions on identical inputs (the sharded
+    global sort is a collective exchange, not a different algorithm)."""
+    from tpu_faas.sched.state import SchedulerArrays
+
+    def build(mesh_devices):
+        a = SchedulerArrays(
+            max_workers=32, max_pending=256, mesh_devices=mesh_devices,
+            clock=lambda: 100.0,
+        )
+        rng = np.random.default_rng(11)
+        for i in range(12):
+            a.register(f"w{i}".encode(), int(rng.integers(1, 6)))
+            a.worker_speed[a.worker_ids[f"w{i}".encode()]] = float(
+                rng.uniform(0.5, 3.0)
+            )
+        return a
+
+    rng = np.random.default_rng(12)
+    sizes = rng.uniform(0.1, 9.0, 200).astype(np.float32)
+    prios = rng.integers(-2, 3, 200).astype(np.int32)
+    single, meshed = build(None), build(8)
+    out_s = single.tick(sizes, task_priorities=prios)
+    out_m = meshed.tick(sizes, task_priorities=prios)
+    np.testing.assert_array_equal(
+        np.asarray(out_s.assignment)[:200], np.asarray(out_m.assignment)[:200]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_s.live), np.asarray(out_m.live)
+    )

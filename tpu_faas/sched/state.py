@@ -114,6 +114,12 @@ class SchedulerArrays:
     clock: "callable" = time.monotonic
     #: placement kernel for the tick: rank (default) | auction | sinkhorn
     placement: str = "rank"
+    #: shard the pending-task axis over this many devices (0/None = single
+    #: device). The tick then runs parallel.mesh.sharded_scheduler_tick:
+    #: task arrays carry a NamedSharding over the "tasks" axis, fleet state
+    #: is replicated, and the placement's global reductions ride ICI
+    #: collectives. Semantics are identical to the single-device tick.
+    mesh_devices: int | None = None
 
     worker_speed: np.ndarray = field(init=False)
     worker_free: np.ndarray = field(init=False)
@@ -128,6 +134,31 @@ class SchedulerArrays:
             # dispatcher must not bind its port and adopt QUEUED tasks only
             # to die on the jit trace of a typo'd kernel name
             raise ValueError(f"unknown placement kernel {self.placement!r}")
+        self.mesh = None
+        if self.mesh_devices:
+            if self.placement == "auction":
+                # the auction's bidding loop is all-to-all over workers, not
+                # tasks; no sharded variant exists — fail at construction
+                raise ValueError(
+                    "mesh_devices requires placement 'rank' or 'sinkhorn'"
+                )
+            from tpu_faas.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(self.mesh_devices)
+            if self.mesh.size != self.mesh_devices:
+                # make_mesh truncates to the devices actually present —
+                # running silently on fewer chips than the operator asked
+                # for is a misconfiguration, not a fallback
+                raise ValueError(
+                    f"mesh_devices={self.mesh_devices} but only "
+                    f"{self.mesh.size} JAX devices are available"
+                )
+            if self.max_pending % self.mesh_devices:
+                # shard_map needs the task axis evenly divisible; round up
+                # rather than reject — max_pending is a padding size anyway
+                self.max_pending += self.mesh_devices - (
+                    self.max_pending % self.mesh_devices
+                )
         W = self.max_workers
         self.worker_speed = np.zeros(W, dtype=np.float32)
         self.worker_free = np.zeros(W, dtype=np.int32)
@@ -272,12 +303,51 @@ class SchedulerArrays:
         if task_priorities is not None:
             prio = np.zeros(self.max_pending, dtype=np.int32)
             prio[:n] = task_priorities
-            prio = jnp.asarray(prio)
         now_f = now if now is not None else self.clock()
         hb_age = (now_f - self.last_heartbeat).astype(np.float32)
-        out = scheduler_tick(
-            jnp.asarray(ts),
-            jnp.asarray(tv),
+        if self.mesh is not None:
+            out = self._tick_sharded(ts, tv, hb_age, prio)
+        else:
+            out = scheduler_tick(
+                jnp.asarray(ts),
+                jnp.asarray(tv),
+                jnp.asarray(self.worker_speed),
+                jnp.asarray(self.worker_free),
+                jnp.asarray(self.worker_active),
+                jnp.asarray(hb_age),
+                jnp.asarray(self.prev_live),
+                jnp.asarray(self.inflight_worker),
+                jnp.float32(self.time_to_expire),
+                max_slots=self.max_slots,
+                task_priority=None if prio is None else jnp.asarray(prio),
+                placement=self.placement,
+            )
+        self.prev_live = np.asarray(out.live)
+        return out
+
+    def _tick_sharded(
+        self,
+        ts: np.ndarray,
+        tv: np.ndarray,
+        hb_age: np.ndarray,
+        prio: np.ndarray | None,
+    ) -> TickOutput:
+        """The mesh-backed tick: task arrays sharded over the task axis,
+        fleet state replicated, identical semantics to scheduler_tick."""
+        from tpu_faas.parallel.mesh import (
+            replicate,
+            shard_task_arrays,
+            sharded_scheduler_tick,
+        )
+
+        task_arrays = [jnp.asarray(ts), jnp.asarray(tv)]
+        if prio is not None:
+            task_arrays.append(jnp.asarray(prio))
+        sharded = shard_task_arrays(self.mesh, *task_arrays)
+        ts_d, tv_d = sharded[0], sharded[1]
+        prio_d = sharded[2] if prio is not None else None
+        ws, wf, wa, hb, pl, iw, tte = replicate(
+            self.mesh,
             jnp.asarray(self.worker_speed),
             jnp.asarray(self.worker_free),
             jnp.asarray(self.worker_active),
@@ -285,9 +355,19 @@ class SchedulerArrays:
             jnp.asarray(self.prev_live),
             jnp.asarray(self.inflight_worker),
             jnp.float32(self.time_to_expire),
-            max_slots=self.max_slots,
-            task_priority=prio,
-            placement=self.placement,
         )
-        self.prev_live = np.asarray(out.live)
-        return out
+        return sharded_scheduler_tick(
+            self.mesh,
+            ts_d,
+            tv_d,
+            ws,
+            wf,
+            wa,
+            hb,
+            pl,
+            iw,
+            tte,
+            max_slots=self.max_slots,
+            use_sinkhorn=(self.placement == "sinkhorn"),
+            task_priority=prio_d,
+        )
