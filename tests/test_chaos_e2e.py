@@ -165,3 +165,111 @@ def test_dispatcher_crash_restart_mid_run():
                 d.wait()
         gw.stop()
         store_handle.stop()
+
+
+def test_dispatcher_and_worker_die_together():
+    """The RUNNING-recovery hole (VERDICT r1 item 3): a task RUNNING on a
+    worker that dies while the dispatcher is ALSO down has no process left
+    that knows about it — only the lease stamped on the RUNNING record can
+    save it. A replacement dispatcher's rescan adopts RUNNING tasks whose
+    lease went stale and re-dispatches them; every task completes."""
+    import socket as socketlib
+
+    probe = socketlib.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    lease = ("--lease-timeout", "2.0")
+    disp_a = _spawn_dispatcher(port, store_handle.url, *lease)
+    url = f"tcp://127.0.0.1:{port}"
+    worker_a = _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+    client = FaaSClient(gw.url)
+    disp_b = worker_b = None
+    try:
+        fid = client.register(sleep_task)
+        handles = [client.submit(fid, 1.0) for _ in range(4)]
+        deadline = time.monotonic() + 30
+        # wait until some tasks are genuinely RUNNING on worker_a
+        while time.monotonic() < deadline:
+            if any(h.status() == "RUNNING" for h in handles):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("no task ever reached RUNNING")
+
+        # both die together: nobody holds the in-flight table anymore
+        worker_a.kill()
+        worker_a.wait()
+        disp_a.kill()
+        disp_a.wait()
+
+        disp_b = _spawn_dispatcher(port, store_handle.url, *lease)
+        worker_b = _spawn_worker(
+            "push_worker", 2, url, "--hb", "--hb-period", "0.3"
+        )
+        # adoption needs the lease (renewed until the kill) to age past
+        # 2 s, then a rescan pass — well within this timeout
+        assert [h.result(timeout=90) for h in handles] == [1.0] * 4
+    finally:
+        for w in (worker_a, worker_b):
+            if w is not None and w.poll() is None:
+                w.kill()
+                w.wait()
+        for d in (disp_a, disp_b):
+            if d is not None and d.poll() is None:
+                d.kill()
+                d.wait()
+        gw.stop()
+        store_handle.stop()
+
+
+def test_pull_worker_kill_loses_no_tasks():
+    """Pull-mode in-flight tracking (VERDICT r1 item 3): the reference's
+    pull dispatcher keeps only a worker-id list — kill a pull worker holding
+    tasks and they are RUNNING forever. Here the dispatcher tracks what it
+    handed to whom, treats request silence as death, and re-queues the dead
+    worker's tasks for the survivor. Every task completes."""
+    from tpu_faas.dispatch.pull import PullDispatcher
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = PullDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(store_handle.url),
+        time_to_expire=1.5,
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("pull_worker", 2, url, "--delay", "0.01")
+        for _ in range(2)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        handles = [client.submit(fid, 0.8) for _ in range(8)]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if sum(h.status() == "RUNNING" for h in handles) >= 2:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("tasks never started on the pull fleet")
+        workers[0].send_signal(signal.SIGKILL)
+        workers[0].wait()
+        assert [h.result(timeout=90) for h in handles] == [0.8] * 8
+        assert disp.n_reclaimed > 0  # the recovery path actually ran
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

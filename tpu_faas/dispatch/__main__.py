@@ -77,6 +77,18 @@ def main(argv: list[str] | None = None) -> None:
         "priority classes; auction = general costs; sinkhorn = soft "
         "heterogeneous balancing)",
     )
+    ap.add_argument(
+        "--mesh", type=int, default=0, metavar="N",
+        help="tpu-push: shard the pending-task axis over N devices "
+        "(jax.sharding.Mesh; placement must be rank or sinkhorn); 0 = "
+        "single device",
+    )
+    ap.add_argument(
+        "--lease-timeout", type=float, default=30.0,
+        help="tpu-push: seconds before a RUNNING task whose owner stopped "
+        "renewing its lease (dispatcher AND worker both dead) is adopted "
+        "by the rescan",
+    )
     ns = ap.parse_args(argv)
     if ns.delay:
         time.sleep(ns.delay)
@@ -117,11 +129,9 @@ def main(argv: list[str] | None = None) -> None:
             max_pending=ns.max_pending,
             max_workers=ns.max_fleet,
             placement=ns.placement,
+            mesh_devices=ns.mesh or None,
+            lease_timeout=ns.lease_timeout,
         )
-    elif ns.mode == "pull":
-        # pull workers have no heartbeat protocol (reference SURVEY §3.4)
-        kwargs.pop("time_to_expire")
-        kwargs.pop("max_task_retries")
     d = cls(**kwargs)
     log.info("%s dispatcher on %s:%d", ns.mode, ns.ip, ns.port)
     if ns.stats_port:

@@ -29,8 +29,10 @@ from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import (
     FIELD_COST,
     FIELD_FN,
+    FIELD_LEASE_AT,
     FIELD_PARAMS,
     FIELD_PRIORITY,
+    FIELD_RECLAIMS,
     FIELD_STATUS,
     FIELD_TIMEOUT,
     TaskStatus,
@@ -205,6 +207,7 @@ class TaskDispatcher:
         failing announce is parked in the backlog by poll_next_task; only an
         outage with nothing fetched yet propagates."""
         out: list[PendingTask] = []
+        seen: set[str] = set()
         for _ in range(max_n):
             try:
                 t = self.poll_next_task()
@@ -214,19 +217,36 @@ class TaskDispatcher:
                 raise
             if t is None:
                 break
+            if t.task_id in seen:
+                # duplicate announce inside one drain: both copies still read
+                # status QUEUED (the non-QUEUED skip in poll_next_task only
+                # protects across rounds, after mark_running lands), e.g. a
+                # dedup-loser's claim adoption racing the winner's create.
+                # Dispatching both would run the task twice.
+                continue
+            seen.add(t.task_id)
             out.append(t)
         return out
 
     # -- store writes ------------------------------------------------------
-    def mark_running(self, task_id: str, *, redispatch: bool = False) -> None:
+    def mark_running(
+        self, task_id: str, *, redispatch: bool = False, retries: int = 0
+    ) -> None:
         """``redispatch=True`` on the recovery path (task reclaimed from a
         purged worker, re-sent to a replacement) — it declares the second
         RUNNING write through the store's protocol-checker hook so an
         attached race monitor (store/racecheck.py) can tell deliberate
-        re-dispatch from double-dispatch."""
+        re-dispatch from double-dispatch. ``retries`` is persisted on that
+        path so the poison guard survives dispatcher restarts."""
         if redispatch:
             self.store.declare_redispatch(task_id)
-        self.store.set_status(task_id, TaskStatus.RUNNING)
+        # the lease stamp rides the same write: a RUNNING record whose lease
+        # goes stale (worker AND dispatcher died before the result) is
+        # adoptable by a later rescan instead of stranded forever
+        extra = {FIELD_LEASE_AT: repr(time.time())}
+        if redispatch:
+            extra[FIELD_RECLAIMS] = str(retries)
+        self.store.set_status(task_id, TaskStatus.RUNNING, extra_fields=extra)
 
     def record_result(
         self, task_id: str, status: str, result: str, first_wins: bool = False
@@ -235,13 +255,15 @@ class TaskDispatcher:
         task is possible (zombie worker of a re-dispatched task)."""
         self.store.finish_task(task_id, status, result, first_wins=first_wins)
 
-    def mark_running_safe(self, task_id: str, *, redispatch: bool = False) -> bool:
+    def mark_running_safe(
+        self, task_id: str, *, redispatch: bool = False, retries: int = 0
+    ) -> bool:
         """mark_running that degrades on a store outage instead of raising:
         callers use it when the task is already (or imminently) on its way to
         a worker — the terminal result write, which is deferred-capable,
         supersedes a missing RUNNING mark. Returns False when skipped."""
         try:
-            self.mark_running(task_id, redispatch=redispatch)
+            self.mark_running(task_id, redispatch=redispatch, retries=retries)
             return True
         except STORE_OUTAGE_ERRORS as exc:
             self.note_store_outage(exc, pause=0)
@@ -326,6 +348,49 @@ class TaskDispatcher:
             "deferred_results": len(self.deferred_results),
             "announce_backlog": len(self._announce_backlog),
         }
+
+    def reclaim_or_fail(
+        self, task_id: str, prior_retries: int, max_retries: int
+    ) -> PendingTask | None:
+        """Phase-1 (store I/O only) half of a dead-worker reclaim, shared by
+        every mode that tracks in-flight tasks: bump the retry count, FAIL
+        the task if it has now taken down more than ``max_retries`` workers
+        (poison guard; first_wins makes a retried fail_task idempotent),
+        else rebuild its PendingTask with hints intact. Returns None when
+        there is nothing to re-queue (failed, or payloads vanished). Raises
+        on a store outage — callers mutate bookkeeping only afterwards, so
+        an aborted purge retries cleanly."""
+        retries = prior_retries + 1
+        if retries > max_retries:
+            self.log.error(
+                "task %s lost with its worker %d times; FAILED",
+                task_id,
+                retries,
+            )
+            self.fail_task(
+                task_id,
+                f"task lost with its worker {retries} times "
+                f"(max_task_retries={max_retries})",
+            )
+            return None
+        return self.fetch_reclaim(task_id, retries)
+
+    #: How often a dispatcher re-stamps the lease of its in-flight tasks.
+    #: Must stay well under any rescanner's lease_timeout (tpu-push default
+    #: 30 s): EVERY dispatcher mode renews — a push/pull dispatcher sharing
+    #: a store with a tpu-push one would otherwise see its long-running
+    #: tasks adopted out from under it (stamped once at RUNNING, never
+    #: renewed, stale after lease_timeout even with everyone alive).
+    LEASE_RENEW_PERIOD = 10.0
+
+    def renew_leases(self, task_ids) -> None:
+        """Re-stamp the ownership lease of every given in-flight task in one
+        pipelined round trip; while these writes keep landing, no rescan
+        will adopt them."""
+        stamp = repr(time.time())
+        items = [(tid, {FIELD_LEASE_AT: stamp}) for tid in task_ids]
+        if items:
+            self.store.hset_many(items)
 
     def fetch_reclaim(self, task_id: str, retries: int) -> PendingTask | None:
         """Rebuild a PendingTask for a task reclaimed from a dead worker.

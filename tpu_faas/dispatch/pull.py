@@ -11,14 +11,28 @@ implicit back-pressure (SURVEY §2.3).
 Differences from the reference: the poll has a timeout so ``stop()`` works;
 ``result`` messages are answered with another task when one is pending (the
 reference does this too via its inline re-listen — pull_worker.py:108-111 —
-here it falls out of the uniform reply rule).
+here it falls out of the uniform reply rule); and tasks handed out are
+TRACKED per worker. The reference's pull mode keeps only a worker-id list
+(task_dispatcher.py:150-151) — a pull worker that dies mid-task loses the
+task exactly like its push mode does (README:262-264). Here every request
+doubles as a liveness signal (workers poll on a delay cadence, and send a
+keepalive even when saturated): a worker silent past ``time_to_expire`` is
+presumed dead and its in-flight tasks are re-queued ahead of the bus, with
+the same poison guard and first-wins result freezing as the push modes.
 """
 
 from __future__ import annotations
 
+import time
+from collections import deque
+
 import zmq
 
-from tpu_faas.dispatch.base import STORE_OUTAGE_ERRORS, TaskDispatcher
+from tpu_faas.dispatch.base import (
+    STORE_OUTAGE_ERRORS,
+    PendingTask,
+    TaskDispatcher,
+)
 from tpu_faas.worker import messages as m
 
 
@@ -31,6 +45,9 @@ class PullDispatcher(TaskDispatcher):
         store=None,
         channel: str = "tasks",
         poll_timeout_ms: int = 100,
+        time_to_expire: float = 10.0,
+        max_task_retries: int = 3,
+        clock=time.monotonic,
     ) -> None:
         super().__init__(store_url=store_url, channel=channel, store=store)
         self.ctx = zmq.Context.instance()
@@ -43,41 +60,174 @@ class PullDispatcher(TaskDispatcher):
         self.poll_timeout_ms = poll_timeout_ms
         self.poller = zmq.Poller()
         self.poller.register(self.socket, zmq.POLLIN)
+        self.clock = clock
+        self.time_to_expire = time_to_expire
+        self.max_task_retries = max_task_retries
         self.workers: set[str] = set()
+        #: liveness: every request stamps its sender (demand IS the
+        #: heartbeat in pull mode — a healthy worker polls constantly)
+        self.last_seen: dict[str, float] = {}
+        #: in-flight tracking, the capability the reference's pull mode
+        #: lacks entirely: task_id -> (owner worker_id, PendingTask)
+        self.inflight: dict[str, tuple[str, PendingTask]] = {}
+        self.worker_tasks: dict[str, set[str]] = {}
+        #: tasks reclaimed from dead workers, served ahead of the bus
+        self.requeued: deque[PendingTask] = deque()
+        self.task_retries: dict[str, int] = {}
+        self.n_reclaimed = 0
+
+    def stats(self) -> dict:
+        return {
+            **super().stats(),
+            "workers_registered": len(self.workers),
+            "inflight": len(self.inflight),
+            "requeued": len(self.requeued),
+            "n_reclaimed": self.n_reclaimed,
+        }
+
+    # -- dead-worker reclaim ----------------------------------------------
+    def _purge_dead_workers(self) -> None:
+        """Re-queue the in-flight tasks of workers silent past
+        ``time_to_expire``. Store I/O first (fetch_reclaim raises on an
+        outage), bookkeeping after, so an aborted purge simply retries."""
+        now = self.clock()
+        # every silent worker is purged, including ones holding nothing —
+        # skipping idle deaths would leak a last_seen/workers entry per
+        # autoscaler churn cycle forever
+        dead = [
+            wid
+            for wid, seen in self.last_seen.items()
+            if now - seen > self.time_to_expire
+        ]
+        for wid in dead:
+            tasks = self.worker_tasks.get(wid, set())
+            # phase 1 — store I/O only (poison-fail writes + payload
+            # refetches, via the shared reclaim helper): an outage raises
+            # out of here with every dict untouched, so the next purge
+            # round retries the whole worker cleanly
+            reclaims: list[PendingTask] = []
+            for task_id in tasks:
+                pt = self.reclaim_or_fail(
+                    task_id,
+                    self.task_retries.get(task_id, 0),
+                    self.max_task_retries,
+                )
+                if pt is not None:
+                    reclaims.append(pt)
+            # phase 2 — bookkeeping only, cannot raise
+            self.log.warning(
+                "pull worker %s silent for %.1fs: re-queueing %d tasks",
+                wid,
+                now - self.last_seen.get(wid, now),
+                len(reclaims),
+            )
+            for pt in reclaims:
+                self.task_retries[pt.task_id] = pt.retries
+                self.requeued.append(pt)
+                self.n_reclaimed += 1
+            for task_id in tasks:
+                # incl. poison-failed + vanished records: drop tracking
+                self.inflight.pop(task_id, None)
+                if not any(p.task_id == task_id for p in reclaims):
+                    self.task_retries.pop(task_id, None)
+            self.worker_tasks.pop(wid, None)
+            self.last_seen.pop(wid, None)
+            self.workers.discard(wid)
+
+    def _next_task(self) -> PendingTask | None:
+        """Reclaimed tasks first (they have already waited once), then the
+        bus. A reclaimed task that meanwhile finished (zombie worker beat
+        the purge) is skipped — re-dispatching would regress its record."""
+        while self.requeued:
+            # peek, don't pop: task_is_finished is a store read that can
+            # raise mid-outage — a popped task would be gone forever (pull
+            # mode has no rescanner to find it again); peeked, it simply
+            # waits for the next request (same pattern as push.py)
+            pt = self.requeued[0]
+            if self.task_is_finished(pt.task_id):
+                self.requeued.popleft()
+                self.task_retries.pop(pt.task_id, None)
+                continue
+            self.requeued.popleft()
+            return pt
+        return self.poll_next_task()
 
     def start(self, max_results: int | None = None) -> int:
         """Serve worker requests; returns results recorded (for tests)."""
         n_results = 0
+        last_renew = self.clock()
         try:
             while not self.stopping:
                 if self.deferred_results:
                     self.flush_deferred_results()
+                try:
+                    self._purge_dead_workers()
+                    if (
+                        self.clock() - last_renew >= self.LEASE_RENEW_PERIOD
+                        and self.inflight
+                    ):
+                        self.renew_leases(self.inflight)
+                        last_renew = self.clock()
+                except STORE_OUTAGE_ERRORS as exc:
+                    self.note_store_outage(exc, pause=0)
                 events = dict(self.poller.poll(self.poll_timeout_ms))
                 if self.socket not in events:
                     continue
                 msg_type, data = m.decode(self.socket.recv())
+                wid = data.get("worker_id")
+                if wid is not None:
+                    self.last_seen[wid] = self.clock()
                 if msg_type == m.REGISTER:
-                    self.workers.add(data.get("worker_id", "?"))
+                    self.workers.add(wid or "?")
                     self.log.info("pull worker registered: %s", data)
                 elif msg_type == m.RESULT:
+                    task_id = data["task_id"]
+                    owner_entry = self.inflight.get(task_id)
+                    owner = owner_entry[0] if owner_entry else None
+                    # a second result is possible when the task was ever
+                    # re-dispatched, or this sender is not the tracked owner
+                    # (zombie worker that outlived its purge)
+                    suspicious = task_id in self.task_retries or (
+                        owner is not None and owner != wid
+                    )
                     self.record_result_safe(
-                        data["task_id"], data["status"], data["result"]
+                        data["task_id"],
+                        data["status"],
+                        data["result"],
+                        first_wins=suspicious,
                     )
                     n_results += 1
+                    if owner is None or owner == wid:
+                        self.inflight.pop(task_id, None)
+                        self.task_retries.pop(task_id, None)
+                        if owner is not None:
+                            self.worker_tasks.get(owner, set()).discard(
+                                task_id
+                            )
                 # READY carries no state; any message type falls through to
                 # the mandatory reply — which MUST go out even mid-outage,
                 # or the REP/REQ state machine wedges every worker. A
-                # draining worker flags no_task: its reply must be WAIT.
+                # draining (or merely keepalive-ing) worker flags no_task:
+                # its reply must be WAIT.
                 if data.get("no_task"):
                     task = None
                 else:
                     try:
-                        task = self.poll_next_task()
+                        task = self._next_task()
                     except STORE_OUTAGE_ERRORS as exc:
                         self.note_store_outage(exc, pause=0)
                         task = None
                 if task is not None:
-                    self.mark_running_safe(task.task_id)
+                    self.mark_running_safe(
+                        task.task_id,
+                        redispatch=bool(task.retries),
+                        retries=task.retries,
+                    )
+                    if wid is not None:
+                        self.inflight[task.task_id] = (wid, task)
+                        self.worker_tasks.setdefault(wid, set()).add(
+                            task.task_id
+                        )
                     self.socket.send(
                         m.encode(m.TASK, **task.task_message_kwargs())
                     )

@@ -241,30 +241,16 @@ class PushDispatcher(TaskDispatcher):
             # simply retries it (nothing reclaimed is lost half-way)
             reclaims: list[PendingTask] = []
             for task_id in rec.inflight:
-                retries = rec.inflight_retries.get(task_id, 0) + 1
-                if retries > self.max_task_retries:
-                    # poison guard: a task that has now taken down
-                    # max_task_retries workers is failed, not re-queued
-                    # (first_wins makes a retried fail_task idempotent)
-                    self.log.error(
-                        "task %s lost with its worker %d times; FAILED",
-                        task_id,
-                        retries,
-                    )
-                    self.fail_task(
-                        task_id,
-                        f"task lost with its worker {retries} times "
-                        f"(max_task_retries={self.max_task_retries})",
-                    )
-                    continue
-                # full hint rebuild, not just the payloads: a re-dispatched
-                # runaway must keep its timeout budget, a high-priority task
-                # its admission class (fetch_reclaim hmgets exactly those
-                # fields — never the possibly-huge result blob)
-                pt = self.fetch_reclaim(task_id, retries)
-                if pt is None:
-                    continue  # payloads vanished (store flushed)
-                reclaims.append(pt)
+                # shared poison-guard + full hint rebuild (a re-dispatched
+                # runaway keeps its timeout budget, a high-priority task its
+                # admission class); None = failed or payloads vanished
+                pt = self.reclaim_or_fail(
+                    task_id,
+                    rec.inflight_retries.get(task_id, 0),
+                    self.max_task_retries,
+                )
+                if pt is not None:
+                    reclaims.append(pt)
             # phase 2 — bookkeeping only, cannot raise
             self.workers.pop(wid)
             self._remove_free(wid)
@@ -323,7 +309,11 @@ class PushDispatcher(TaskDispatcher):
             self._send(
                 wid, m.encode(m.TASK, **task.task_message_kwargs())
             )
-            self.mark_running_safe(task.task_id, redispatch=bool(task.retries))
+            self.mark_running_safe(
+                task.task_id,
+                redispatch=bool(task.retries),
+                retries=task.retries,
+            )
             rec.inflight.add(task.task_id)
             if task.retries:
                 rec.inflight_retries[task.task_id] = task.retries
@@ -341,6 +331,7 @@ class PushDispatcher(TaskDispatcher):
         return sent
 
     def start(self, max_results: int | None = None) -> int:
+        last_renew = time.monotonic()
         try:
             while not self.stopping:
                 events = dict(self.poller.poll(self.poll_timeout_ms))
@@ -362,6 +353,15 @@ class PushDispatcher(TaskDispatcher):
                         self.purge_workers()
                     if self.deferred_results:
                         self.flush_deferred_results()
+                    now = time.monotonic()
+                    if now - last_renew >= self.LEASE_RENEW_PERIOD:
+                        inflight = [
+                            tid
+                            for rec in self.workers.values()
+                            for tid in rec.inflight
+                        ]
+                        self.renew_leases(inflight)
+                        last_renew = now
                     self._dispatch_round()
                 except STORE_OUTAGE_ERRORS as exc:
                     self.note_store_outage(exc)

@@ -30,7 +30,13 @@ from collections import deque
 import numpy as np
 import zmq
 
-from tpu_faas.core.task import FIELD_STATUS, TaskStatus
+from tpu_faas.core.task import (
+    FIELD_LEASE_AT,
+    FIELD_PARAMS,
+    FIELD_RECLAIMS,
+    FIELD_STATUS,
+    TaskStatus,
+)
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
     PendingTask,
@@ -61,6 +67,8 @@ class TpuPushDispatcher(TaskDispatcher):
         clock=time.monotonic,
         placement: str = "rank",
         liveness_period: float | None = None,
+        mesh_devices: int | None = None,
+        lease_timeout: float = 30.0,
     ) -> None:
         super().__init__(store_url=store_url, channel=channel, store=store)
         self.ctx = zmq.Context.instance()
@@ -82,6 +90,7 @@ class TpuPushDispatcher(TaskDispatcher):
             time_to_expire=time_to_expire,
             clock=clock,
             placement=placement,
+            mesh_devices=mesh_devices,
         )
         self.pending: deque[PendingTask] = deque()
         #: max seconds between device ticks when there is nothing to place.
@@ -108,6 +117,16 @@ class TpuPushDispatcher(TaskDispatcher):
         #: seconds between stranded-task rescans while running (0 disables);
         #: the startup scan below always runs when recover_queued is set
         self.rescan_period = rescan_period if recover_queued else 0.0
+        #: a RUNNING record whose lease is older than this has no live
+        #: owner (its worker AND the dispatcher renewing for it are gone) —
+        #: the rescan adopts it. Renewals run at lease_timeout/3 or the
+        #: rescan period, whichever is tighter, so a live owner can miss
+        #: two renewals before its tasks become adoptable.
+        self.lease_timeout = lease_timeout
+        self._lease_renew_period = min(
+            max(rescan_period, 1.0), lease_timeout / 3.0
+        )
+        self._last_lease_renew = self.clock()
         if recover_queued:
             self._recover_stranded()
 
@@ -141,20 +160,87 @@ class TpuPushDispatcher(TaskDispatcher):
         # trips — let alone full HGETALLs — would make the rescan cost grow
         # with history and stall the serve loop past heartbeat deadlines
         statuses = self.store.hget_many(candidates, FIELD_STATUS)
-        n = 0
+        running = [
+            key
+            for key, status in zip(candidates, statuses)
+            if status == str(TaskStatus.RUNNING)
+        ]
+        # RUNNING + stale lease = orphaned in flight: its worker died while
+        # no dispatcher was around to reclaim it (both down together). A
+        # RUNNING task with a FRESH lease has a live owner renewing it —
+        # hands off. (This dispatcher's own in-flight tasks were excluded
+        # above, so every adoption here is of some dead predecessor's task.)
+        expired: dict[str, int] = {}  # task -> persisted reclaim count
+        if running:
+            now_wall = time.time()
+            leases = self.store.hget_many(running, FIELD_LEASE_AT)
+            stale = [
+                key
+                for key, lease in zip(running, leases)
+                if self._lease_age(lease, now_wall) > self.lease_timeout
+            ]
+            if stale:
+                # prior generations' reclaim counts (persisted on each
+                # re-dispatch RUNNING mark): without them, a task that
+                # keeps killing worker+dispatcher together would reset its
+                # poison counter every generation and cycle forever
+                counts = self.store.hget_many(stale, FIELD_RECLAIMS)
+                for key, raw in zip(stale, counts):
+                    try:
+                        expired[key] = max(int(raw), 0)
+                    except (TypeError, ValueError):
+                        expired[key] = 0
+        n = n_adopted = 0
         for key, status in zip(candidates, statuses):
-            if status != str(TaskStatus.QUEUED):
-                continue
-            fields = self.store.hgetall(key)
-            if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
-                continue  # finished between the two reads
-            self.pending.append(PendingTask.from_fields(key, fields))
-            n += 1
+            if status == str(TaskStatus.QUEUED):
+                fields = self.store.hgetall(key)
+                if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
+                    continue  # finished between the two reads
+                if FIELD_PARAMS not in fields:
+                    # a keyed create's status claim landed but its field
+                    # write hasn't yet (create_task_if_absent, store/base):
+                    # adopting now would dispatch an empty payload — the
+                    # creator (or the next rescan) will finish it
+                    continue
+                self.pending.append(PendingTask.from_fields(key, fields))
+                n += 1
+            elif key in expired:
+                # adopt with the persisted count bumped: the dispatch path
+                # then declares the re-dispatch to the race monitor and
+                # freezes the result first-wins, so a zombie worker's late
+                # result for the same task cannot double-deliver; the
+                # shared helper FAILs it if it has now exceeded the poison
+                # budget across generations
+                pt = self.reclaim_or_fail(
+                    key, expired[key], self.max_task_retries
+                )
+                if pt is None:
+                    continue  # poison-failed, finished, or vanished
+                self.task_retries[key] = pt.retries
+                self.pending.append(pt)
+                n_adopted += 1
         # reads succeeded: the store is reachable (an idle dispatcher has no
         # result writes to clear the outage flag otherwise)
         self.note_store_up()
-        if n:
-            self.log.info("recovered %d stranded QUEUED tasks", n)
+        if n or n_adopted:
+            self.log.info(
+                "recovered %d stranded QUEUED tasks, adopted %d orphaned "
+                "RUNNING tasks (stale lease)",
+                n,
+                n_adopted,
+            )
+
+    @staticmethod
+    def _lease_age(lease: str | None, now_wall: float) -> float:
+        """Seconds since the lease stamp; no/garbled stamp = infinitely
+        stale (nobody is renewing it)."""
+        try:
+            return now_wall - float(lease)
+        except (TypeError, ValueError):
+            return float("inf")
+
+    def _renew_leases(self) -> None:
+        self.renew_leases(self.arrays._inflight_slot)
 
     # -- worker messages ---------------------------------------------------
     def _handle(self, wid: bytes, msg_type: str, data: dict) -> None:
@@ -293,27 +379,15 @@ class TpuPushDispatcher(TaskDispatcher):
                 task_id = a.inflight_task[slot]
                 if task_id is None:
                     continue
-                retries = self.task_retries.get(task_id, 0) + 1
-                if retries > self.max_task_retries:
-                    # poison guard: this task has now taken down
-                    # max_task_retries workers — fail it, don't cycle it
-                    self.log.error(
-                        "task %s lost with its worker %d times; FAILED",
-                        task_id,
-                        retries,
-                    )
-                    self.fail_task(
-                        task_id,
-                        f"task lost with its worker {retries} times "
-                        f"(max_task_retries={self.max_task_retries})",
-                    )
-                    drops.append((slot, task_id))
-                    continue
-                pt = self.fetch_reclaim(task_id, retries)
+                pt = self.reclaim_or_fail(
+                    task_id,
+                    self.task_retries.get(task_id, 0),
+                    self.max_task_retries,
+                )
                 if pt is None:
-                    # payloads vanished (store flushed): nothing to
-                    # re-dispatch, and leaving a retry entry would haunt a
-                    # future task that reuses the id
+                    # poison-failed, or payloads vanished (store flushed):
+                    # nothing to re-dispatch, and leaving a retry entry
+                    # would haunt a future task that reuses the id
                     drops.append((slot, task_id))
                     continue
                 reclaims.append((slot, pt))
@@ -361,7 +435,9 @@ class TpuPushDispatcher(TaskDispatcher):
                 # on the wire + tracked: must NOT be restored on an outage
                 restore_from = idx + 1
                 self.mark_running_safe(
-                    task.task_id, redispatch=bool(task.retries)
+                    task.task_id,
+                    redispatch=bool(task.retries),
+                    retries=task.retries,
                 )
                 a.worker_free[row] -= 1
                 sent += 1
@@ -401,6 +477,12 @@ class TpuPushDispatcher(TaskDispatcher):
                     ):
                         self._recover_stranded()
                         last_rescan = self.clock()
+                    if (
+                        self.clock() - self._last_lease_renew
+                        >= self._lease_renew_period
+                    ):
+                        self._renew_leases()
+                        self._last_lease_renew = self.clock()
                 except STORE_OUTAGE_ERRORS as exc:
                     self.note_store_outage(exc)
                 events = dict(self.poller.poll(max(1, int(self.tick_period * 1000))))

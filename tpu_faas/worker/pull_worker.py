@@ -35,8 +35,12 @@ class PullWorker:
         dispatcher_url: str,
         delay: float = 0.01,
         recv_timeout_ms: int = 10_000,
+        keepalive_period: float = 1.0,
     ) -> None:
         self.worker_id = str(uuid.uuid4())
+        #: max silence while saturated before sending a WAIT-bound keepalive
+        #: (must be well under the dispatcher's time_to_expire)
+        self.keepalive_period = keepalive_period
         self.num_processes = num_processes
         self.delay = delay
         self.pool = TaskPool(num_processes)
@@ -81,6 +85,7 @@ class PullWorker:
         shipped = 0
         self.pool.warmup()  # pay the child-spawn cost before taking work
         self._transact(m.REGISTER, worker_id=self.worker_id)
+        last_transact = time.monotonic()
         try:
             while not self._stopping:
                 time.sleep(self.delay)
@@ -89,15 +94,29 @@ class PullWorker:
                 for res in self.pool.drain():
                     self._transact(
                         m.RESULT,
+                        worker_id=self.worker_id,
                         task_id=res.task_id,
                         status=res.status,
                         result=res.result,
                         no_task=self._draining,
                     )
                     shipped += 1
+                    last_transact = time.monotonic()
                 # ask for work while slots are free
                 if not self._draining and self.pool.free > 0:
                     self._transact(m.READY, worker_id=self.worker_id)
+                    last_transact = time.monotonic()
+                elif (
+                    time.monotonic() - last_transact > self.keepalive_period
+                ):
+                    # saturated on long tasks: demand is the liveness signal
+                    # in pull mode, so a worker that stops asking looks dead
+                    # and would get its in-flight tasks re-queued under it.
+                    # no_task forces a WAIT reply (we have no free slot).
+                    self._transact(
+                        m.READY, worker_id=self.worker_id, no_task=True
+                    )
+                    last_transact = time.monotonic()
                 if max_tasks is not None and shipped >= max_tasks:
                     break
                 if self._draining and self.pool.busy == 0:
