@@ -76,6 +76,9 @@ def sharded_sinkhorn_placement(
         cmax_local = jnp.max(jnp.where(mask, cost, 0.0))
         cmax = jax.lax.pmax(cmax_local, TASK_AXIS)
         slack_cost = cmax + 1.0
+        # scale-free smoothing: tau relative to the (global) cost magnitude,
+        # matching the single-device kernels (sched/sinkhorn.py)
+        tau_eff = tau * jnp.maximum(cmax, 1e-30)
 
         # columns: W real + 1 slack (absorbs tasks beyond capacity)
         cost_all = jnp.concatenate(
@@ -87,14 +90,14 @@ def sharded_sinkhorn_placement(
         )  # [Tl, W+1]
         b = jnp.concatenate([cap, jnp.maximum(n_tasks - total_cap, 0.0)[None]])
         # slack row (unused capacity) has cost 0 to every real worker: its
-        # contribution to each column's logsumexp is f_slack/tau, tracked as
+        # contribution to each column's logsumexp is f_slack/tau_eff, tracked as
         # a replicated scalar on every device.
         a_slack = jnp.maximum(total_cap - n_tasks, 0.0)
 
         loga = jnp.where(tv_local, 0.0, -inf)  # log(1) per valid task
         loga_slack = jnp.where(a_slack > 0, jnp.log(jnp.maximum(a_slack, 1e-30)), -inf)
         logb = jnp.where(b > 0, jnp.log(jnp.maximum(b, 1e-30)), -inf)
-        negc = -cost_all / tau  # [Tl, W+1]
+        negc = -cost_all / tau_eff  # [Tl, W+1]
         # slack-row costs: 0 to real workers, inf to slack col
         negc_slack = jnp.concatenate(
             [jnp.where(cap > 0, 0.0, -inf), jnp.array([-inf])]
@@ -103,27 +106,27 @@ def sharded_sinkhorn_placement(
         def body(_, fg):
             f, f_slack, g = fg
             # f-update (rows): local, no communication
-            f = tau * (
-                loga - jax.nn.logsumexp(negc + g[None, :] / tau, axis=1)
+            f = tau_eff * (
+                loga - jax.nn.logsumexp(negc + g[None, :] / tau_eff, axis=1)
             )
             f = jnp.where(jnp.isfinite(loga), f, -inf)
-            f_slack = tau * (
-                loga_slack - jax.nn.logsumexp(negc_slack + g / tau)
+            f_slack = tau_eff * (
+                loga_slack - jax.nn.logsumexp(negc_slack + g / tau_eff)
             )
             f_slack = jnp.where(jnp.isfinite(loga_slack), f_slack, -inf)
             # g-update (cols): distributed logsumexp over the task axis
-            z = negc + f[:, None] / tau  # [Tl, W+1]
+            z = negc + f[:, None] / tau_eff  # [Tl, W+1]
             zmax_local = jnp.max(z, axis=0)
             zmax = jax.lax.pmax(zmax_local, TASK_AXIS)
-            zmax_s = jnp.maximum(zmax, negc_slack + f_slack / tau)
+            zmax_s = jnp.maximum(zmax, negc_slack + f_slack / tau_eff)
             zmax_safe = jnp.where(jnp.isfinite(zmax_s), zmax_s, 0.0)
             expsum_local = jnp.sum(jnp.exp(z - zmax_safe[None, :]), axis=0)
             expsum = jax.lax.psum(expsum_local, TASK_AXIS) + jnp.exp(
-                negc_slack + f_slack / tau - zmax_safe
+                negc_slack + f_slack / tau_eff - zmax_safe
             )
             lse = zmax_safe + jnp.log(jnp.maximum(expsum, 1e-30))
             lse = jnp.where(jnp.isfinite(zmax_s), lse, -inf)
-            g = tau * (logb - lse)
+            g = tau_eff * (logb - lse)
             g = jnp.where(jnp.isfinite(logb), g, -inf)
             return f, f_slack, g
 
@@ -133,7 +136,7 @@ def sharded_sinkhorn_placement(
             0, n_iters, body, (f0, jnp.float32(0.0), g0)
         )
         # local soft plan over real workers + slack mass per task
-        logp = negc + (f[:, None] + g[None, :]) / tau
+        logp = negc + (f[:, None] + g[None, :]) / tau_eff
         plan_local = jnp.exp(logp)  # [Tl, W+1]
         return plan_local
 

@@ -86,12 +86,26 @@ def scheduler_tick(
             max_slots=max_slots,
         ).assignment
     elif placement == "sinkhorn":
-        from tpu_faas.sched.sinkhorn import sinkhorn_placement
+        T, W = task_size.shape[0], worker_speed.shape[0]
+        if T * W > 2**24:
+            # headline scale: the dense kernel's [T+1, W+1] buffers exceed a
+            # chip (~800 MB each at 50k x 4k) — the bucketed kernel
+            # compresses the task axis via the rank-one cost structure and
+            # matches it to <0.01% in placement cost (tests/test_sched_
+            # sinkhorn.py) at ~25x less work
+            from tpu_faas.sched.sinkhorn import sinkhorn_placement_bucketed
 
-        assignment = sinkhorn_placement(
-            task_size, task_valid, worker_speed, worker_free, live,
-            max_slots=max_slots,
-        ).assignment
+            assignment = sinkhorn_placement_bucketed(
+                task_size, task_valid, worker_speed, worker_free, live,
+                max_slots=max_slots,
+            ).assignment
+        else:
+            from tpu_faas.sched.sinkhorn import sinkhorn_placement
+
+            assignment = sinkhorn_placement(
+                task_size, task_valid, worker_speed, worker_free, live,
+                max_slots=max_slots,
+            ).assignment
     else:
         raise ValueError(f"unknown placement kernel {placement!r}")
 
