@@ -27,6 +27,58 @@ import numpy as np
 from tpu_faas.sched.greedy import rank_match_placement
 
 
+@jax.jit
+def _scatter_set_i32(arr, idx, vals):
+    return arr.at[idx].set(vals)
+
+
+@partial(jax.jit, static_argnames=("T", "W", "max_slots", "placement"))
+def _packed_tick(
+    packed,  # f32[T + 2W]: sizes ++ heartbeat ages ++ free counts
+    n_valid,  # i32 scalar: first n rows of the batch are real tasks
+    worker_speed,
+    worker_active,
+    prev_live,
+    inflight_worker,
+    time_to_expire,
+    task_priority,
+    *,
+    T: int,
+    W: int,
+    max_slots: int,
+    placement: str,
+):
+    """scheduler_tick behind a transfer-minimal calling convention.
+
+    Everything that changes every tick (the sizes batch, heartbeat ages,
+    free counts) rides ONE packed host->device transfer, and the valid
+    mask is computed on device from a scalar. The rest of the state is
+    device-resident between ticks (cached fleet arrays, delta-scattered
+    inflight table, fed-back prev_live). This is what keeps the INTEGRATED
+    tick near the bare-kernel time: per-call device-op dispatches are
+    ~1 ms each over tunneled dev transports (and even locally each put is
+    a separate transfer), so the tick issues two device ops total instead
+    of ~ten."""
+    task_size = packed[:T]
+    hb_age = packed[T : T + W]
+    worker_free = packed[T + W :].astype(jnp.int32)
+    task_valid = jnp.arange(T, dtype=jnp.int32) < n_valid
+    return scheduler_tick(
+        task_size,
+        task_valid,
+        worker_speed,
+        worker_free,
+        worker_active,
+        hb_age,
+        prev_live,
+        inflight_worker,
+        time_to_expire,
+        max_slots=max_slots,
+        task_priority=task_priority,
+        placement=placement,
+    )
+
+
 class TickOutput(NamedTuple):
     assignment: jnp.ndarray  # i32[T] worker index per pending task, -1 queued
     live: jnp.ndarray  # bool[W]
@@ -192,6 +244,20 @@ class SchedulerArrays:
         )
         self._inflight_slot: dict[str, int] = {}  # task_id -> slot
         self._free_inflight: list[int] = list(range(self.max_inflight - 1, -1, -1))
+        # device mirror of inflight_worker, updated by small scatters: the
+        # full table is 256 KB at max_inflight=65536 and changes by only a
+        # handful of slots per tick — re-uploading it whole every tick is
+        # the single largest transfer on the integrated-tick path
+        self._d_inflight = None
+        self._inflight_delta: dict[int, int] = {}
+        # device cache of rarely-changing fleet arrays, keyed by name; each
+        # tick compares the live host array against the cached copy (a few
+        # microseconds for [W]) and re-uploads only on change — direct
+        # external mutation (tests/benches assign worker_speed[...] in
+        # place) is therefore picked up without any dirty-flag protocol
+        self._dev_cache: dict[str, tuple[np.ndarray, "jnp.ndarray"]] = {}
+        self._d_tte = None
+        self._tte_host: float | None = None
 
     # -- membership (reference register/reconnect/purge semantics) ---------
     def register(
@@ -251,12 +317,18 @@ class SchedulerArrays:
     def n_inflight(self) -> int:
         return len(self._inflight_slot)
 
+    def _note_inflight(self, slot: int, row: int) -> None:
+        """Record a slot write for the device mirror's next delta scatter."""
+        if self._d_inflight is not None:
+            self._inflight_delta[slot] = row
+
     def inflight_add(self, task_id: str, row: int) -> int:
         if not self._free_inflight:
             raise RuntimeError("inflight table full; raise max_inflight")
         slot = self._free_inflight.pop()
         self.inflight_task[slot] = task_id
         self.inflight_worker[slot] = row
+        self._note_inflight(slot, row)
         self._inflight_slot[task_id] = slot
         return slot
 
@@ -273,6 +345,7 @@ class SchedulerArrays:
         row = int(self.inflight_worker[slot])
         self.inflight_task[slot] = None
         self.inflight_worker[slot] = -1
+        self._note_inflight(slot, -1)
         self._free_inflight.append(slot)
         return row
 
@@ -287,10 +360,58 @@ class SchedulerArrays:
         tid = self.inflight_task[slot]
         self.inflight_task[slot] = None
         self.inflight_worker[slot] = -1
+        self._note_inflight(slot, -1)
         if tid is not None:
             self._inflight_slot.pop(tid, None)
             self._free_inflight.append(slot)
         return tid
+
+    def _device_inflight(self):
+        """The inflight table as a device array, maintained incrementally:
+        full upload when absent or when too much changed, else one small
+        scatter of the dirty slots (indices padded to a power of two so the
+        jit'd scatter compiles a bounded set of shapes)."""
+        # scatter wins until the delta stops being sparse: k entries cost
+        # 8k bytes of index+value upload vs 4*max_inflight for the full
+        # table, so the crossover sits near half the table
+        if (
+            self._d_inflight is None
+            or len(self._inflight_delta) > self.max_inflight // 2
+        ):
+            self._inflight_delta.clear()
+            self._d_inflight = jnp.asarray(self.inflight_worker)
+        elif self._inflight_delta:
+            slots = np.fromiter(
+                self._inflight_delta.keys(), np.int32,
+                len(self._inflight_delta),
+            )
+            vals = np.fromiter(
+                self._inflight_delta.values(), np.int32, len(slots)
+            )
+            self._inflight_delta.clear()
+            k = 1 << int(len(slots) - 1).bit_length()
+            pad = k - len(slots)
+            if pad:
+                # duplicate index + SAME value: scatter order is undefined
+                # for duplicates, but identical values make it a no-op race
+                slots = np.concatenate(
+                    [slots, np.full(pad, slots[0], np.int32)]
+                )
+                vals = np.concatenate([vals, np.full(pad, vals[0], np.int32)])
+            self._d_inflight = _scatter_set_i32(
+                self._d_inflight, jnp.asarray(slots), jnp.asarray(vals)
+            )
+        return self._d_inflight
+
+    def _cached_dev(self, name: str, host: np.ndarray):
+        """Device copy of a host fleet array, re-uploaded only when the
+        host content actually changed (cheap [W] compare per tick)."""
+        entry = self._dev_cache.get(name)
+        if entry is not None and np.array_equal(entry[0], host):
+            return entry[1]
+        dev = jnp.asarray(host)
+        self._dev_cache[name] = (host.copy(), dev)
+        return dev
 
     # -- the tick ----------------------------------------------------------
     def tick(
@@ -309,10 +430,6 @@ class SchedulerArrays:
         n = len(task_sizes)
         if n > self.max_pending:
             raise ValueError(f"{n} pending > max_pending={self.max_pending}")
-        ts = np.zeros(self.max_pending, dtype=np.float32)
-        ts[:n] = task_sizes
-        tv = np.zeros(self.max_pending, dtype=bool)
-        tv[:n] = True
         prio = None
         if task_priorities is not None:
             prio = np.zeros(self.max_pending, dtype=np.int32)
@@ -320,23 +437,47 @@ class SchedulerArrays:
         now_f = now if now is not None else self.clock()
         hb_age = (now_f - self.last_heartbeat).astype(np.float32)
         if self.mesh is not None:
+            ts = np.zeros(self.max_pending, dtype=np.float32)
+            ts[:n] = task_sizes
+            tv = np.zeros(self.max_pending, dtype=bool)
+            tv[:n] = True
             out = self._tick_sharded(ts, tv, hb_age, prio)
         else:
-            out = scheduler_tick(
-                jnp.asarray(ts),
-                jnp.asarray(tv),
-                jnp.asarray(self.worker_speed),
-                jnp.asarray(self.worker_free),
-                jnp.asarray(self.worker_active),
-                jnp.asarray(hb_age),
-                jnp.asarray(self.prev_live),
-                jnp.asarray(self.inflight_worker),
-                jnp.float32(self.time_to_expire),
+            # one packed upload carries everything that changes every tick
+            # (sizes ++ hb ages ++ free counts); the rest is device-resident
+            # — see _packed_tick for why dispatch COUNT, not bytes, is the
+            # integrated tick's budget
+            T, W = self.max_pending, self.max_workers
+            packed = np.zeros(T + 2 * W, dtype=np.float32)
+            packed[:n] = task_sizes
+            packed[T : T + W] = hb_age
+            packed[T + W :] = self.worker_free
+            # compare-and-refresh, not cache-once: time_to_expire is a
+            # plain attribute operators (and tests) mutate at runtime, and
+            # a frozen device copy would silently keep dead workers alive
+            if self._tte_host != self.time_to_expire:
+                self._d_tte = jnp.float32(self.time_to_expire)
+                self._tte_host = self.time_to_expire
+            out = _packed_tick(
+                jnp.asarray(packed),
+                jnp.int32(n),
+                self._cached_dev("speed", self.worker_speed),
+                self._cached_dev("active", self.worker_active),
+                self.prev_live,
+                self._device_inflight(),
+                self._d_tte,
+                None if prio is None else jnp.asarray(prio),
+                T=T,
+                W=W,
                 max_slots=self.max_slots,
-                task_priority=None if prio is None else jnp.asarray(prio),
                 placement=self.placement,
             )
-        self.prev_live = np.asarray(out.live)
+        # keep prev_live DEVICE-resident: it is only ever fed back into the
+        # next tick, and forcing it to host here would put a synchronous
+        # device->host round trip inside every tick (over a tunneled dev
+        # transport that is ~100 ms of pure transport per tick; even locally
+        # it forbids pipelining consecutive ticks)
+        self.prev_live = out.live
         return out
 
     def _tick_sharded(
