@@ -156,3 +156,23 @@ def test_auction_backend_invariant():
     assert (ax >= 0).sum() == (ap >= 0).sum()
     # both are eps-optimal: costs agree within the auction's optimality slack
     assert abs(cost(ax) - cost(ap)) <= n_tasks * 1e-3 + 1e-4
+
+
+def test_auto_backend_routing_by_problem_size():
+    """'auto' resolves to the XLA matrix path where the [T, S] matrix fits
+    comfortably and to the streaming kernel past XLA_CELL_BUDGET (the
+    regime where the XLA path OOMs a real chip — measured, bench config 7).
+    Tiling misfits fall back to XLA regardless of size."""
+    from tpu_faas.sched.pallas_kernels import (
+        CHUNK_S,
+        TILE_T,
+        XLA_CELL_BUDGET,
+        resolve_backend,
+    )
+
+    assert resolve_backend(10_240, 8_192) == "xla"  # config-3 scale
+    big_T, big_S = 50 * TILE_T, 16 * CHUNK_S  # headline-ish, tiled
+    assert big_T * big_S > XLA_CELL_BUDGET
+    assert resolve_backend(big_T, big_S) == "pallas"
+    # same size but misaligned tiling: pallas can't run it -> xla
+    assert resolve_backend(big_T + 1, big_S) == "xla"

@@ -9,16 +9,26 @@ running top-2 per row across the slot-chunk grid: HBM traffic per round is
 O(T+S) regardless of problem size, and device memory never holds the
 matrix.
 
-Measured on the round-1 bench chip (dependent-chain timing, tunnel
-memoization defeated): XLA's fused matrix path wins — 0.34 ms vs 0.51 ms
-per round at 10k x 8k, 5.9 ms vs 11.6 ms at 50k x 32k — because XLA hoists
-the loop-invariant ``-size·inv_speed + jitter`` base matrix into HBM once
-per solve and then rides memory bandwidth, while this kernel recomputes the
-integer-hash jitter every round and is VPU-bound. The ``auto`` backend
-therefore picks XLA; the Pallas path stays as a selectable backend for
-memory-constrained deployments (the hoisted base matrix costs O(T·S) HBM —
-6.7 GB at headline scale — which the streaming kernel reduces to zero) and
-as the template for further fused scheduler kernels.
+Measured on a v5e chip (round 2; pipeline-slope timing over 13 distinct
+input batches, both legs jitted — reproducible as bench config 7):
+
+- config-3 scale (10k x 8k, 320 MB matrix): near-parity, XLA slightly
+  ahead (~1.35 vs ~1.44 ms/round) — the fused matrix path rides memory
+  bandwidth while this kernel recomputes the jitter hash per round.
+- headline scale (50k x 32k, 6.7 GB matrix): speed parity within
+  run-to-run noise (~10-17 ms/round both). The difference is WORKING SET:
+  the fused XLA path still materializes multi-GB [T, S] intermediates per
+  round (and the UN-jitted XLA path — eager debugging — simply OOMs the
+  16 GB chip), while this kernel holds O(T+S).
+
+``auto`` therefore resolves by problem size: the XLA matrix path below
+``XLA_CELL_BUDGET`` cells (marginally faster, matrix footprint
+irrelevant), this kernel above it (speed parity, gigabytes of HBM
+headroom returned to the rest of the dispatcher) — see
+``resolve_backend``. Caveat at headline scale: the bidding ROUNDS needed
+for an auction to converge grow with demand/supply imbalance —
+tick-latency-critical deployments should use the rank or Sinkhorn kernels
+there (sched/state.py defaults); the auction is the general-cost solver.
 
 Tie-breaking jitter is a deterministic integer hash of (row, col) — not a
 PRNG — so the XLA reference path (`bid_top2_xla`) and the Pallas path
@@ -232,6 +242,25 @@ def pallas_ok(T: int, S: int) -> bool:
     return _HAVE_PALLAS and T % TILE_T == 0 and S % CHUNK_S == 0
 
 
+#: Above this many [T, S] cells 'auto' stops paying for the XLA matrix
+#: path's working set: its per-round intermediates are 4 bytes/cell each —
+#: gigabytes at headline scale on a 16 GB chip that also holds the rest of
+#: the dispatcher's device state — while measured per-round SPEED is at
+#: parity there (bench config 7: ~10-17 ms/round both at 50k x 32k).
+#: 2^29 cells = a 2 GB matrix, leaving comfortable headroom.
+XLA_CELL_BUDGET = 2**29
+
+
+def resolve_backend(T: int, S: int) -> str:
+    """What ``backend='auto'`` runs for a [T, S] bid problem: the XLA
+    matrix path while the matrix comfortably fits (marginally faster
+    there), the streaming Pallas kernel in the memory-bound regime (speed
+    parity, O(T+S) working set)."""
+    if T * S > XLA_CELL_BUDGET and pallas_ok(T, S):
+        return "pallas"
+    return "xla"
+
+
 def bid_top2(
     task_size: jnp.ndarray,
     slot_inv_speed: jnp.ndarray,
@@ -241,11 +270,12 @@ def bid_top2(
     backend: str = "auto",
 ):
     """Backend-dispatching top-2 bid. ``backend``: auto | xla | pallas |
-    pallas_interpret. 'auto' resolves at trace time to the XLA matrix path —
-    measured faster than the streaming kernel on current hardware (module
-    docstring) — keeping Pallas one flag away for memory-bound regimes."""
+    pallas_interpret. 'auto' resolves at trace time by problem size
+    (``resolve_backend``): the XLA matrix path where the [T, S] matrix
+    fits comfortably (faster there), the streaming kernel in the
+    memory-bound regime where XLA's hoisted matrix OOMs the chip."""
     if backend == "auto":
-        backend = "xla"
+        backend = resolve_backend(task_size.shape[0], slot_inv_speed.shape[0])
     if backend == "xla":
         return bid_top2_xla(
             task_size, slot_inv_speed, slot_valid, price, jitter_scale
