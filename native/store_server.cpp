@@ -9,8 +9,9 @@
 // no threads, no locks, no allocation in the steady-state paths beyond the
 // hash tables themselves.
 //
-// Supported commands: PING, SELECT (ignored), HSET, HSETNX, HGET, HMGET, HGETALL, DEL,
-// KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE, FLUSHDB, SAVE, QUIT, SHUTDOWN.
+// Supported commands: PING, SELECT (ignored), HSET, HSETNX, HGET, HMGET, HDEL,
+// HGETALL, DEL, KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE, FLUSHDB, SAVE, QUIT,
+// SHUTDOWN.
 //
 // Checkpoint/resume: --snapshot PATH loads PATH at startup and writes it on
 // SAVE / SHUTDOWN and every --autosave seconds while dirty. The snapshot is
@@ -471,6 +472,21 @@ class Server {
         dirty_ = true;
         reply_integer(c.outbuf, 1);
       }
+    } else if (name == "HDEL") {
+      if (argc < 2) {
+        reply_error(c.outbuf, "wrong number of arguments for HDEL");
+        return;
+      }
+      auto h = store_.hashes.find(cmd[1]);
+      long long removed = 0;
+      if (h != store_.hashes.end()) {
+        for (size_t i = 2; i < cmd.size(); i++)
+          removed += h->second.erase(cmd[i]);
+        if (h->second.empty())  // Redis semantics: empty hash = absent key
+          store_.hashes.erase(h);
+      }
+      dirty_ = dirty_ || removed > 0;
+      reply_integer(c.outbuf, removed);
     } else if (name == "HMGET") {
       if (argc < 2) {
         reply_error(c.outbuf, "wrong number of arguments for HMGET");

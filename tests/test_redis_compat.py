@@ -1,0 +1,146 @@
+"""The store client against a REAL Redis server (skip-if-absent).
+
+store/client.py:1-11 promises the RESP client speaks a strict subset of the
+Redis protocol so a real Redis drops in for the bundled servers. This suite
+backs that claim with an actual redis-server when one is installed on the
+host; environments without the binary skip (the claim is then exercised
+only against the two in-repo servers, which implement the same subset).
+"""
+
+from __future__ import annotations
+
+import shutil
+import socket
+import subprocess
+import time
+
+import pytest
+
+from tpu_faas.store.launch import make_store
+
+REDIS = shutil.which("redis-server")
+
+pytestmark = pytest.mark.skipif(
+    REDIS is None, reason="redis-server not installed on this host"
+)
+
+
+@pytest.fixture()
+def redis_url():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    proc = subprocess.Popen(
+        [REDIS, "--port", str(port), "--save", "", "--appendonly", "no"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                s = make_store(f"resp://127.0.0.1:{port}")
+                if s.ping():
+                    s.close()
+                    break
+            except OSError:
+                time.sleep(0.05)
+        else:
+            raise RuntimeError("redis-server did not come up")
+        yield f"resp://127.0.0.1:{port}"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_store_contract_against_real_redis(redis_url):
+    """The full task-store contract — create/announce, status, idempotent
+    claims, finish+wake, live index, TTL-sweeper primitives — against
+    stock Redis."""
+    from tpu_faas.store.base import LIVE_INDEX_KEY
+
+    s = make_store(redis_url)
+    try:
+        sub = s.subscribe("tasks")
+        wake = s.subscribe("results")
+        time.sleep(0.1)  # real redis: subscribe is asynchronous
+        s.create_task("t1", "FN", "PAR", channel="tasks", extra_fields={"priority": "2"})
+        deadline = time.monotonic() + 5
+        msg = None
+        while msg is None and time.monotonic() < deadline:
+            msg = sub.get_message(timeout=0.2)
+        assert msg == "t1"
+        assert s.get_status("t1") == "QUEUED"
+        assert s.get_payloads("t1") == ("FN", "PAR")
+        assert s.hget("t1", "priority") == "2"
+        assert s.hgetall(LIVE_INDEX_KEY) == {"t1": "1"}
+
+        # idempotency primitive
+        assert s.setnx_field("t1", "claim", "a") == (True, "a")
+        assert s.setnx_field("t1", "claim", "b") == (False, "a")
+        assert s.setnx_fields([("t1", "c"), ("t2x", "d")], "claim") == [
+            (False, "a"),
+            (True, "d"),
+        ]
+        s.delete("t2x")
+
+        # pipelined batch ops
+        s.create_tasks([("t2", "FN", "P2"), ("t3", "FN", "P3")])
+        assert s.hget_many(["t1", "t2", "t3"], "status") == [
+            "QUEUED", "QUEUED", "QUEUED",
+        ]
+        s.hset_many([("t2", {"lease_at": "1.0"}), ("t3", {"lease_at": "2.0"})])
+        assert s.hmget("t2", ["status", "lease_at"]) == ["QUEUED", "1.0"]
+
+        # terminal write: result + wake + index removal in one round trip
+        s.finish_task("t1", "COMPLETED", "RES")
+        deadline = time.monotonic() + 5
+        msg = None
+        while msg is None and time.monotonic() < deadline:
+            msg = wake.get_message(timeout=0.2)
+        assert msg == "t1"
+        assert s.get_result("t1") == ("COMPLETED", "RES")
+        assert set(s.hgetall(LIVE_INDEX_KEY)) == {"t2", "t3"}
+
+        s.delete_many(["t2", "t3"])
+        assert s.get_status("t2") is None
+    finally:
+        s.close()
+
+
+def test_local_dispatch_e2e_against_real_redis(redis_url):
+    """A local dispatcher serving real traffic out of stock Redis."""
+    import threading
+
+    from tpu_faas.core.serialize import deserialize, serialize
+    from tpu_faas.dispatch.local import LocalDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+
+    gw = start_gateway_thread(make_store(redis_url))
+    disp = LocalDispatcher(num_workers=2, store=make_store(redis_url))
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    try:
+        import requests
+
+        fid = requests.post(
+            f"{gw.url}/register_function",
+            json={"name": "sq", "payload": serialize(lambda x: x * x)},
+        ).json()["function_id"]
+        tid = requests.post(
+            f"{gw.url}/execute_function",
+            json={"function_id": fid, "payload": serialize(((6,), {}))},
+        ).json()["task_id"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            body = requests.get(f"{gw.url}/result/{tid}").json()
+            if body["status"] in ("COMPLETED", "FAILED"):
+                break
+            time.sleep(0.1)
+        assert body["status"] == "COMPLETED"
+        assert deserialize(body["result"]) == 36
+    finally:
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()

@@ -4,7 +4,7 @@ import threading
 
 from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS, TaskStatus
 from tpu_faas.store import MemoryStore
-from tpu_faas.store.base import TASKS_CHANNEL
+from tpu_faas.store.base import LIVE_INDEX_KEY, TASKS_CHANNEL
 
 
 def test_hash_ops():
@@ -98,7 +98,8 @@ def test_thread_safety_smoke():
     while sub.get_message() is not None:
         seen += 1
     assert seen == 800
-    assert len(s.keys()) == 800
+    # +1: the live-task index hash rides alongside the task records
+    assert len([k for k in s.keys() if k != LIVE_INDEX_KEY]) == 800
 
 
 def test_first_wins_does_not_resurrect_deleted_record():
@@ -138,3 +139,24 @@ def test_create_task_if_absent_never_regresses():
     assert s.create_task_if_absent("t2", "F2", "P2") is True
     assert s.hget("t2", FIELD_PARAMS) == "P2"
     assert sub.get_message() == "t2"
+
+
+def test_live_index_tracks_task_lifecycle():
+    """tasks:index holds exactly the live (non-terminal) task ids: added on
+    every create variant, removed on the terminal write — the stranded-task
+    rescan reads this instead of KEYS-walking all history."""
+    from tpu_faas.store.base import LIVE_INDEX_KEY
+    from tpu_faas.store.memory import MemoryStore
+
+    s = MemoryStore()
+    s.create_task("t1", "F", "P")
+    s.create_tasks([("t2", "F", "P"), ("t3", "F", "P", {"priority": "1"})])
+    assert s.create_task_if_absent("t4", "F", "P") is True
+    assert set(s.hgetall(LIVE_INDEX_KEY)) == {"t1", "t2", "t3", "t4"}
+    s.finish_task("t2", "COMPLETED", "R")
+    s.finish_task("t4", "FAILED", "E")
+    assert set(s.hgetall(LIVE_INDEX_KEY)) == {"t1", "t3"}
+    # hdel removes the hash entirely once empty (Redis semantics)
+    s.finish_task("t1", "COMPLETED", "R")
+    s.finish_task("t3", "COMPLETED", "R")
+    assert s.hgetall(LIVE_INDEX_KEY) == {}

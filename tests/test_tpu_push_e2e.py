@@ -383,3 +383,55 @@ def test_tpu_push_mesh_dispatcher_e2e():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def test_rescan_uses_live_index_and_gcs_stale_entries():
+    """Rescan cost is O(live tasks), not O(history): indexed passes read
+    tasks:index (and GC entries whose record finished or vanished); every
+    10th pass is a full KEYS scan that also catches foreign-producer tasks
+    written without the index (the raw reference contract)."""
+    from tpu_faas.core.task import TaskStatus
+    from tpu_faas.store.base import LIVE_INDEX_KEY
+    from tpu_faas.store.memory import MemoryStore
+
+    store = MemoryStore()
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=store, recover_queued=False
+    )
+    try:
+        disp._rescan_count = 1  # force the next pass to be indexed
+        store.create_task("idx-task", "F", "P")
+        while disp.subscriber.get_message() is not None:
+            pass  # drop the announce: the task is now stranded
+        # a create in flight (index written, record not yet): must NOT be
+        # GC'd — deleting it would hide the task from indexed rescans
+        store.hset(LIVE_INDEX_KEY, {"mid-create": "1"})
+        # a terminal record whose finish-path HDEL was lost: must be GC'd
+        store.create_task("finished", "F", "P")
+        store.hset("finished", {"status": str(TaskStatus.COMPLETED)})
+        while disp.subscriber.get_message() is not None:
+            pass
+        # foreign producer: task record only, no index entry
+        store.hset(
+            "foreign",
+            {
+                "status": str(TaskStatus.QUEUED),
+                "fn_payload": "F",
+                "param_payload": "P",
+                "result": "None",
+            },
+        )
+        disp._recover_stranded()
+        ids = {t.task_id for t in disp.pending}
+        assert "idx-task" in ids  # found via the index
+        assert "foreign" not in ids  # invisible to an indexed pass
+        index = set(store.hgetall(LIVE_INDEX_KEY))
+        assert "finished" not in index  # terminal leftover: GC'd
+        assert "mid-create" in index  # status-None entry: kept
+
+        disp._rescan_count = 10  # next pass: full-scan fallback
+        disp._recover_stranded()
+        ids = {t.task_id for t in disp.pending}
+        assert "foreign" in ids  # the fallback catches it
+    finally:
+        disp.socket.close(linger=0)

@@ -43,6 +43,7 @@ from tpu_faas.dispatch.base import (
     TaskDispatcher,
 )
 from tpu_faas.sched.state import SchedulerArrays
+from tpu_faas.store.base import LIVE_INDEX_KEY
 from tpu_faas.utils.logging import TickTracer
 from tpu_faas.worker import messages as m
 
@@ -127,6 +128,8 @@ class TpuPushDispatcher(TaskDispatcher):
             max(rescan_period, 1.0), lease_timeout / 3.0
         )
         self._last_lease_renew = self.clock()
+        self._rescan_count = 0
+        self._warned_priority = False
         if recover_queued:
             self._recover_stranded()
 
@@ -150,16 +153,47 @@ class TpuPushDispatcher(TaskDispatcher):
         # tasks whose (terminal) writes sit in the deferred buffer still read
         # as QUEUED/RUNNING from the store — adopting them would re-execute
         known.update(item[0] for item in self.deferred_results)
+        # Candidate source: the live-task index (O(live tasks)) on most
+        # passes — a KEYS walk costs O(every task that EVER ran) and grows
+        # with history. Every 10th pass (and the startup pass, count 0)
+        # falls back to the full scan: it catches tasks created by foreign
+        # producers that don't maintain the index (the raw reference
+        # contract) and pre-index snapshots.
+        full_scan = self._rescan_count % 10 == 0
+        self._rescan_count += 1
+        if full_scan:
+            universe = self.store.keys()
+        else:
+            universe = list(self.store.hgetall(LIVE_INDEX_KEY))
         candidates = [
             key
-            for key in self.store.keys()
-            if key not in known and a.inflight_owner(key) is None
+            for key in universe
+            if key not in known
+            and key != LIVE_INDEX_KEY
+            and a.inflight_owner(key) is None
         ]
-        # status-only probe first, pipelined: the store holds every task
-        # that ever ran (plus function-registry hashes), so per-key round
-        # trips — let alone full HGETALLs — would make the rescan cost grow
-        # with history and stall the serve loop past heartbeat deadlines
+        # status-only probe first, pipelined: per-key round trips — let
+        # alone full HGETALLs — would make the rescan stall the serve loop
+        # past heartbeat deadlines
         statuses = self.store.hget_many(candidates, FIELD_STATUS)
+        if not full_scan:
+            # index GC: entries whose record went TERMINAL without the
+            # HDEL landing (producer died mid-finish) must not make every
+            # future rescan re-probe them. Status-None entries are left
+            # alone: create_task writes the index BEFORE the record, so a
+            # None probe may be a create in flight — deleting it would
+            # make that task invisible to indexed rescans if its announce
+            # is then lost. None entries are rare (crashed creates only)
+            # and merely cost a re-probe per pass.
+            stale_index_entries = [
+                key
+                for key, status in zip(candidates, statuses)
+                if status is not None
+                and status
+                in (str(TaskStatus.COMPLETED), str(TaskStatus.FAILED))
+            ]
+            if stale_index_entries:
+                self.store.hdel(LIVE_INDEX_KEY, *stale_index_entries)
         running = [
             key
             for key, status in zip(candidates, statuses)
@@ -174,18 +208,18 @@ class TpuPushDispatcher(TaskDispatcher):
         if running:
             now_wall = time.time()
             leases = self.store.hget_many(running, FIELD_LEASE_AT)
-            stale = [
+            stale_leases = [
                 key
                 for key, lease in zip(running, leases)
                 if self._lease_age(lease, now_wall) > self.lease_timeout
             ]
-            if stale:
+            if stale_leases:
                 # prior generations' reclaim counts (persisted on each
                 # re-dispatch RUNNING mark): without them, a task that
                 # keeps killing worker+dispatcher together would reset its
                 # poison counter every generation and cycle forever
-                counts = self.store.hget_many(stale, FIELD_RECLAIMS)
-                for key, raw in zip(stale, counts):
+                counts = self.store.hget_many(stale_leases, FIELD_RECLAIMS)
+                for key, raw in zip(stale_leases, counts):
                     try:
                         expired[key] = max(int(raw), 0)
                     except (TypeError, ValueError):
@@ -367,6 +401,16 @@ class TpuPushDispatcher(TaskDispatcher):
             prios = None
             if any(t.priority for t in batch):
                 prios = np.asarray([t.priority for t in batch], dtype=np.int32)
+                if a.placement != "rank" and not self._warned_priority:
+                    # don't silently downgrade: entropic/auction admission
+                    # is soft by construction, so the hint is dropped there
+                    self.log.warning(
+                        "clients are sending 'priority' hints but placement "
+                        "%r ignores them — hard priority classes need "
+                        "--placement rank",
+                        a.placement,
+                    )
+                    self._warned_priority = True
             with self.tracer.span("device_tick"):
                 out = a.tick(sizes, task_priorities=prios)
 

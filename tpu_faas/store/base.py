@@ -33,6 +33,17 @@ from tpu_faas.core.task import (
 
 #: Default announce channel name (reference config.ini:7 `TASKS_CHANNEL=tasks`).
 TASKS_CHANNEL = "tasks"
+
+#: Index hash of live (non-terminal) task ids: field = task_id, value "1".
+#: Written with every create, removed with every terminal write, so a
+#: stranded-task rescan reads O(live tasks) instead of KEYS-walking the
+#: full keyspace — whose size grows with HISTORY (every task that ever
+#: ran) unless a TTL sweeper prunes it. Stale entries are harmless (the
+#: rescan status-probes each candidate anyway) and are garbage-collected
+#: by the rescan itself; MISSING entries (foreign producers writing the
+#: raw reference contract, pre-index snapshots) are covered by the
+#: rescan's periodic full-scan fallback.
+LIVE_INDEX_KEY = "tasks:index"
 #: Results channel: finish_task announces every terminal write here so the
 #: gateway can wake parked /result long-polls instantly instead of polling
 #: the store. No reference analog (its clients poll, SURVEY §3.1); the
@@ -75,6 +86,11 @@ class TaskStore(abc.ABC):
 
     @abc.abstractmethod
     def hgetall(self, key: str) -> dict[str, str]: ...
+
+    @abc.abstractmethod
+    def hdel(self, key: str, *fields: str) -> None:
+        """Remove fields from a hash (standard Redis HDEL; a key whose last
+        field is removed disappears). The live-task index depends on it."""
 
     @abc.abstractmethod
     def delete(self, key: str) -> None: ...
@@ -123,6 +139,10 @@ class TaskStore(abc.ABC):
         optional scheduling hints (FIELD_PRIORITY/FIELD_COST); the core four
         fields win on any name collision.
         """
+        # index first: a crash after the index write leaves a stale entry
+        # (filtered by the rescan's status probe); the opposite order would
+        # leave a live task invisible to indexed rescans
+        self.hset(LIVE_INDEX_KEY, {task_id: "1"})
         self.hset(
             task_id,
             {
@@ -165,6 +185,7 @@ class TaskStore(abc.ABC):
             and self.hget(task_id, FIELD_PARAMS) is None
         ):
             return False
+        self.hset(LIVE_INDEX_KEY, {task_id: "1"})
         self.hset(
             task_id,
             {
@@ -305,6 +326,7 @@ class TaskStore(abc.ABC):
                 FIELD_FINISHED_AT: repr(time.time()),
             },
         )
+        self.hdel(LIVE_INDEX_KEY, task_id)
         self.publish(RESULTS_CHANNEL, task_id)
 
     def _result_frozen(self, task_id: str) -> bool:

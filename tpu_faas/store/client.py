@@ -20,6 +20,7 @@ from typing import Mapping
 
 from tpu_faas.store import resp
 from tpu_faas.store.base import (
+    LIVE_INDEX_KEY,
     RESULTS_CHANNEL,
     TASKS_CHANNEL,
     Subscription,
@@ -294,6 +295,7 @@ class RespStore(TaskStore):
                 FIELD_RESULT, result,
                 FIELD_FINISHED_AT, repr(time.time()),
             ),
+            ("HDEL", LIVE_INDEX_KEY, task_id),  # drop from the live index
             ("PUBLISH", RESULTS_CHANNEL, task_id),
         ]
         try:
@@ -310,6 +312,10 @@ class RespStore(TaskStore):
         errors = [r for r in replies if isinstance(r, resp.RespError)]
         if errors:
             raise errors[0]
+
+    def hdel(self, key: str, *fields: str) -> None:
+        if fields:
+            self._command("HDEL", key, *fields)
 
     def delete(self, key: str) -> None:
         self._command("DEL", key)
@@ -373,6 +379,15 @@ class RespStore(TaskStore):
         )
 
         commands: list[tuple] = []
+        if tasks:
+            # live-index entries first (same ordering rationale as
+            # base.create_task), all ids in one variadic HSET
+            commands.append(
+                (
+                    "HSET", LIVE_INDEX_KEY,
+                    *(p for task in tasks for p in (task[0], "1")),
+                )
+            )
         for task in tasks:
             task_id, fn_payload, param_payload = task[:3]
             extra = task[3] if len(task) > 3 else None

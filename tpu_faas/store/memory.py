@@ -71,6 +71,16 @@ class MemoryStore(TaskStore):
         with self._lock:
             return dict(self._hashes.get(key, {}))
 
+    def hdel(self, key: str, *fields: str) -> None:
+        with self._lock:
+            h = self._hashes.get(key)
+            if h is None:
+                return
+            for f in fields:
+                h.pop(f, None)
+            if not h:  # Redis semantics: empty hash = absent key
+                self._hashes.pop(key, None)
+
     def delete(self, key: str) -> None:
         with self._lock:
             self._hashes.pop(key, None)

@@ -34,7 +34,7 @@ from dataclasses import dataclass, field
 from typing import Iterable, Mapping
 
 from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS, TaskStatus
-from tpu_faas.store.base import Subscription, TaskStore
+from tpu_faas.store.base import LIVE_INDEX_KEY, Subscription, TaskStore
 
 #: Legal status transitions. ``None`` is "task does not exist yet".
 #: RUNNING -> RUNNING appears here because re-dispatch re-marks a task on its
@@ -308,6 +308,11 @@ class RaceCheckStore(TaskStore):
 
     # -- intercepted writes ------------------------------------------------
     def hset(self, key: str, fields: Mapping[str, str]) -> None:
+        if key == LIVE_INDEX_KEY:
+            # bookkeeping hash, not a task record: its fields are task IDS,
+            # which the lifecycle monitor must not mistake for task fields
+            self.inner.hset(key, fields)
+            return
         op = "finish" if FIELD_RESULT in fields else "status"
         if FIELD_STATUS in fields and fields[FIELD_STATUS] == str(
             TaskStatus.QUEUED
@@ -315,6 +320,9 @@ class RaceCheckStore(TaskStore):
             op = "create"
         self.monitor.observe(self.actor, op, key, fields)
         self.inner.hset(key, fields)
+
+    def hdel(self, key: str, *fields: str) -> None:
+        return self.inner.hdel(key, *fields)
 
     def delete(self, key: str) -> None:
         self.monitor.observe(self.actor, "delete", key)

@@ -207,6 +207,22 @@ class StoreServer:
                 h[args[1]] = args[2]
                 self._dirty = True
                 writer.write(resp.encode_integer(1))
+        elif name == "HDEL":
+            if len(args) < 2:
+                writer.write(resp.encode_error("wrong number of arguments for HDEL"))
+                return True
+            h = st.hashes.get(args[0])
+            removed = 0
+            if h is not None:
+                for f in args[1:]:
+                    if f in h:
+                        del h[f]
+                        removed += 1
+                if not h:  # Redis semantics: empty hash = absent key
+                    del st.hashes[args[0]]
+            if removed:
+                self._dirty = True
+            writer.write(resp.encode_integer(removed))
         elif name == "HMGET":
             if len(args) < 2:
                 writer.write(resp.encode_error("wrong number of arguments for HMGET"))
