@@ -1,0 +1,182 @@
+"""Shared-fleet mode: several dispatchers on one store+channel, each task
+executed by exactly one of them; a dead sibling's tasks migrate via
+lease/claim adoption. The reference architecturally cannot do this — its
+single dispatcher IS the fleet (SURVEY §3.2)."""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.core.task import claim_field_for
+from tpu_faas.dispatch.base import PendingTask, TaskDispatcher
+from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.store.memory import MemoryStore
+from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+from tpu_faas.workloads import arithmetic, sleep_task
+from tests.test_workers_e2e import _spawn_worker
+
+
+def test_claim_for_dispatch_partitions_batches():
+    """Two dispatchers claiming overlapping batches: every task is kept by
+    exactly one (and re-claiming your own keeps it)."""
+    store = MemoryStore()
+    a = TaskDispatcher(store=store, shared=True)
+    b = TaskDispatcher(store=store, shared=True)
+    tasks = [PendingTask(f"t{i}", "F", "P") for i in range(20)]
+    for t in tasks:
+        store.create_task(t.task_id, "F", "P")
+    kept_a = a.claim_for_dispatch(tasks)
+    kept_b = b.claim_for_dispatch(tasks)
+    ids_a = {t.task_id for t in kept_a}
+    ids_b = {t.task_id for t in kept_b}
+    assert ids_a == {t.task_id for t in tasks}  # a claimed everything first
+    assert ids_b == set()  # b lost every claim
+    # re-claim of your own batch is idempotent
+    assert {t.task_id for t in a.claim_for_dispatch(tasks)} == ids_a
+    # adoption arbitration: one winner per generation, takeover once stale
+    assert a.claim_adoption("t0", 1, stale_after=60.0) is True
+    assert b.claim_adoption("t0", 1, stale_after=60.0) is False
+    # a LIVE owner's claim is never stolen, however old the claim stamp is
+    # (claims are stamped once, not renewed; liveness comes from the
+    # dispatcher heartbeat registry)
+    from tpu_faas.core.task import claim_field_for as cff
+
+    store.hset("t1", {cff(2): f"{a.dispatcher_id}:0.0"})  # ancient stamp
+    assert b.claim_adoption("t1", 2, stale_after=60.0) is False
+    assert b.claim_adoption("t0", 1, stale_after=-1.0) is True  # stale -> take
+    # unshared dispatchers never pay any of this
+    c = TaskDispatcher(store=store, shared=False)
+    assert c.claim_for_dispatch(tasks) is tasks
+
+
+def test_two_shared_dispatchers_run_each_task_exactly_once():
+    """Two tpu-push dispatchers, one store+channel, separate worker fleets:
+    40 tasks all complete, the race monitor sees no double-dispatch, and
+    BOTH dispatchers did real work (the claim split is live, not one
+    dispatcher winning everything)."""
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(
+        RaceCheckStore(make_store(store_handle.url), monitor, actor="gateway")
+    )
+
+    def make_disp(name):
+        return TpuPushDispatcher(
+            ip="127.0.0.1",
+            port=0,
+            store=RaceCheckStore(
+                make_store(store_handle.url), monitor, actor=name
+            ),
+            max_workers=32,
+            max_pending=128,
+            max_inflight=256,
+            tick_period=0.01,
+            time_to_expire=2.0,
+            rescan_period=0.5,
+            shared=True,
+        )
+
+    d1, d2 = make_disp("disp-1"), make_disp("disp-2")
+    threads = [
+        threading.Thread(target=d.start, daemon=True) for d in (d1, d2)
+    ]
+    for t in threads:
+        t.start()
+    workers = [
+        _spawn_worker(
+            "push_worker", 2, f"tcp://127.0.0.1:{d.port}", "--hb",
+            "--hb-period", "0.3",
+        )
+        for d in (d1, d2)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(arithmetic)
+        handles = client.submit_many(fid, [((i,), {}) for i in range(40)])
+        assert [h.result(timeout=120) for h in handles] == [
+            arithmetic(i) for i in range(40)
+        ]
+        # exactly-once: every task dispatched by exactly one dispatcher
+        assert d1.n_dispatched + d2.n_dispatched == 40
+        assert d1.n_dispatched > 0 and d2.n_dispatched > 0
+        monitor.assert_clean()
+        assert monitor.unfinished() == []
+    finally:
+        for w in workers:
+            w.kill()
+            w.wait()
+        d1.stop()
+        d2.stop()
+        for t in threads:
+            t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_shared_dispatcher_death_migrates_tasks_to_sibling():
+    """Kill one shared dispatcher AND its whole worker fleet mid-run: the
+    surviving sibling adopts the dead one's tasks (QUEUED via claim-owner
+    death, RUNNING via stale lease) and everything completes."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+
+    def make_disp():
+        return TpuPushDispatcher(
+            ip="127.0.0.1",
+            port=0,
+            store=make_store(store_handle.url),
+            max_workers=32,
+            max_pending=128,
+            max_inflight=256,
+            tick_period=0.01,
+            time_to_expire=1.5,
+            rescan_period=0.5,
+            lease_timeout=3.0,
+            shared=True,
+        )
+
+    d1, d2 = make_disp(), make_disp()
+    t1 = threading.Thread(target=d1.start, daemon=True)
+    t2 = threading.Thread(target=d2.start, daemon=True)
+    t1.start()
+    t2.start()
+    w1 = _spawn_worker(
+        "push_worker", 2, f"tcp://127.0.0.1:{d1.port}", "--hb",
+        "--hb-period", "0.3",
+    )
+    w2 = _spawn_worker(
+        "push_worker", 2, f"tcp://127.0.0.1:{d2.port}", "--hb",
+        "--hb-period", "0.3",
+    )
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        handles = [client.submit(fid, 0.5) for _ in range(16)]
+        # wait until d1 actually owns some work, then kill it + its fleet
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and d1.n_dispatched == 0:
+            time.sleep(0.05)
+        assert d1.n_dispatched > 0
+        w1.send_signal(signal.SIGKILL)
+        w1.wait()
+        d1.stop()
+        t1.join(timeout=10)
+        # d2 must finish EVERYTHING: d1's queued claims (owner heartbeat
+        # gone stale) and its in-flight tasks (leases no longer renewed)
+        assert [h.result(timeout=120) for h in handles] == [0.5] * 16
+    finally:
+        for w in (w1, w2):
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        d1.stop()
+        d2.stop()
+        t1.join(timeout=5)
+        t2.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

@@ -57,6 +57,27 @@ FIELD_LEASE_AT = "lease_at"
 #: every dispatcher generation and cycle forever instead of FAILing.
 FIELD_RECLAIMS = "reclaim_count"
 
+#: Atomic dispatch-ownership claim for SHARED fleets (several dispatchers
+#: on one store+channel — each receives every announce, and without a
+#: claim each would dispatch every task). Value is
+#: "<dispatcher_id>:<epoch seconds>"; exactly one of N concurrent
+#: dispatchers wins the setnx and dispatches. Adoptions of an owner that
+#: died re-arbitrate on generation-scoped fields (``claim_field_for``).
+FIELD_DISPATCH_CLAIM = "dispatch_claim"
+
+
+def claim_field_for(generation: int) -> str:
+    """The dispatch-claim hash field for reclaim generation ``generation``
+    (0 = the initial announce-time claim). Each generation is a fresh
+    write-once field, so N dispatchers racing to ADOPT the same orphaned
+    task arbitrate with the same setnx primitive as the initial dispatch —
+    exactly one wins generation g."""
+    return (
+        FIELD_DISPATCH_CLAIM
+        if generation == 0
+        else f"{FIELD_DISPATCH_CLAIM}:g{generation}"
+    )
+
 
 def new_task_id() -> str:
     return str(uuid.uuid4())

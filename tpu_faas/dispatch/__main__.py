@@ -89,6 +89,14 @@ def main(argv: list[str] | None = None) -> None:
         "renewing its lease (dispatcher AND worker both dead) is adopted "
         "by the rescan",
     )
+    ap.add_argument(
+        "--shared", action="store_true",
+        help="several dispatchers share this store+channel: each claims "
+        "tasks atomically before dispatching (exactly one runs each "
+        "task). Adoption of a DEAD sibling's tasks is done by tpu-push "
+        "rescans — include at least one tpu-push dispatcher in a shared "
+        "fleet for automatic failover",
+    )
     ns = ap.parse_args(argv)
     if ns.delay:
         time.sleep(ns.delay)
@@ -96,7 +104,9 @@ def main(argv: list[str] | None = None) -> None:
     if ns.mode == "local":
         from tpu_faas.dispatch.local import LocalDispatcher
 
-        d = LocalDispatcher(num_workers=ns.num_workers, store_url=ns.store)
+        d = LocalDispatcher(
+            num_workers=ns.num_workers, store_url=ns.store, shared=ns.shared
+        )
         log.info("local dispatcher: pool=%d store=%s", ns.num_workers, ns.store)
         if ns.stats_port:
             d.serve_stats(ns.stats_port)
@@ -119,6 +129,7 @@ def main(argv: list[str] | None = None) -> None:
         store_url=ns.store,
         time_to_expire=ns.tte,
         max_task_retries=ns.max_task_retries,
+        shared=ns.shared,
     )
     if ns.mode == "push":
         kwargs.update(heartbeat=ns.hb, process_lb=ns.plb)

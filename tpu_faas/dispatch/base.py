@@ -22,6 +22,7 @@ from __future__ import annotations
 import math
 import threading
 import time
+import uuid
 from collections import deque
 from dataclasses import dataclass
 
@@ -36,8 +37,9 @@ from tpu_faas.core.task import (
     FIELD_STATUS,
     FIELD_TIMEOUT,
     TaskStatus,
+    claim_field_for,
 )
-from tpu_faas.store.base import TASKS_CHANNEL, TaskStore
+from tpu_faas.store.base import DISPATCHERS_KEY, TASKS_CHANNEL, TaskStore
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import get_logger
 
@@ -148,12 +150,29 @@ class TaskDispatcher:
         store_url: str = "memory://",
         channel: str = TASKS_CHANNEL,
         store: TaskStore | None = None,
+        shared: bool = False,
     ) -> None:
         self.store = store if store is not None else make_store(store_url)
         self.channel = channel
         self.subscriber = self.store.subscribe(channel)
         self.log = get_logger(type(self).__name__)
+        #: shared-fleet mode: several dispatchers on one store+channel.
+        #: Every dispatcher receives every announce, so intake must CLAIM
+        #: each task (one pipelined setnx round per batch) before
+        #: dispatching it; losers drop the task — it is some sibling's.
+        #: Off by default: a single dispatcher should not pay the extra
+        #: round trip per batch.
+        self.shared = shared
+        self.dispatcher_id = uuid.uuid4().hex[:12]
         self._stop_event = threading.Event()
+        if shared:
+            # announce liveness IMMEDIATELY: siblings treat claims whose
+            # owner has no fresh heartbeat as adoptable, and the first
+            # periodic renewal is a renew-period away
+            try:
+                self.renew_leases([])
+            except STORE_OUTAGE_ERRORS:
+                pass  # the serve loop's renewals will retry
         #: result writes that hit a store outage, replayed by
         #: flush_deferred_results() once the store is back — a worker's
         #: finished result must survive a store restart, not evaporate
@@ -162,6 +181,11 @@ class TaskDispatcher:
         #: hit an outage; re-tried before reading the bus again (the bus is
         #: fire-and-forget, so dropping a consumed announce loses the task)
         self._announce_backlog: deque[str] = deque()
+        #: polled tasks whose shared-mode claim round hit a store outage:
+        #: their announces are spent, so they park here and the claim
+        #: retries when the store returns (dispatching unclaimed could
+        #: double against a sibling; dropping loses the task)
+        self._unclaimed: deque[PendingTask] = deque()
         self._store_down = False
         self._last_flush_attempt = 0.0
         self._stats_server = None
@@ -227,6 +251,144 @@ class TaskDispatcher:
             seen.add(t.task_id)
             out.append(t)
         return out
+
+    # -- shared-fleet dispatch claims --------------------------------------
+    def _claim_value(self) -> str:
+        return f"{self.dispatcher_id}:{time.time()}"
+
+    @staticmethod
+    def claim_age(claim: str | None, now_wall: float) -> float:
+        """Seconds since a dispatch claim was written; missing/garbled =
+        infinitely stale (nobody live owns it)."""
+        if claim is None:
+            return float("inf")
+        parts = claim.rsplit(":", 1)
+        try:
+            return now_wall - float(parts[1])
+        except (IndexError, ValueError):
+            return float("inf")
+
+    def claim_for_dispatch(
+        self, tasks: list[PendingTask]
+    ) -> list[PendingTask]:
+        """Shared mode: keep only the tasks THIS dispatcher owns.
+
+        One pipelined setnx round claims every task in the batch
+        atomically; a loser's task belongs to a sibling dispatcher and is
+        dropped here (its copy of the announce is spent — the owner has
+        its own). A claim that already belongs to us (re-poll of our own
+        claimed task, e.g. after an outage-aborted tick) is kept.
+        In single-dispatcher mode this is the identity function."""
+        if not self.shared or not tasks:
+            return tasks
+        value = self._claim_value()
+        results = self.store.setnx_fields(
+            [
+                (t.task_id, value)
+                for t in tasks
+            ],
+            claim_field_for(0),
+        )
+        kept = []
+        for t, (created, current) in zip(tasks, results):
+            if created or current.startswith(self.dispatcher_id + ":"):
+                kept.append(t)
+        if len(kept) != len(tasks):
+            self.log.debug(
+                "dispatch claims: kept %d/%d (rest owned by siblings)",
+                len(kept),
+                len(tasks),
+            )
+        return kept
+
+    def poll_next_claimed(self) -> PendingTask | None:
+        """poll_next_task + the shared-mode ownership claim, outage-safe:
+        a task whose claim round fails mid-outage parks in ``_unclaimed``
+        (its announce is spent) and is re-tried first on the next call —
+        never dropped, never dispatched unclaimed. The single-task analog
+        of tpu-push's batched intake; identity behavior when not shared."""
+        while self._unclaimed:
+            t = self._unclaimed[0]  # peek: the claim below may raise
+            if self.claim_for_dispatch([t]):
+                self._unclaimed.popleft()
+                return t
+            self._unclaimed.popleft()  # a sibling's after all
+        while True:
+            t = self.poll_next_task()
+            if t is None:
+                return None
+            try:
+                kept = self.claim_for_dispatch([t])
+            except STORE_OUTAGE_ERRORS:
+                self._unclaimed.append(t)
+                raise
+            if kept:
+                return t
+
+    def claim_adoption(
+        self,
+        task_id: str,
+        generation: int,
+        stale_after: float,
+        alive: set[str] | None = None,
+    ) -> bool:
+        """Arbitrate an ADOPTION of an orphaned task among sibling
+        dispatchers: exactly one wins the write-once claim field for this
+        reclaim generation. If the generation's winner ITSELF died before
+        re-dispatching (its claim aged past ``stale_after`` without the
+        generation counter advancing AND its owner is not in ``alive``),
+        take the claim over — a bounded overwrite race between two takers
+        is possible there, and the result write's first_wins freezing
+        keeps delivery single even if execution doubles. A claim held by a
+        LIVE owner is never taken, however old: claim fields are stamped
+        once, not renewed, so age alone cannot distinguish a dead owner
+        from a busy one. Single-dispatcher mode always wins."""
+        if not self.shared:
+            return True
+        field = claim_field_for(generation)
+        created, current = self.store.setnx_field(
+            task_id, field, self._claim_value()
+        )
+        if created or current.startswith(self.dispatcher_id + ":"):
+            return True
+        owner = self.claim_owner(current)
+        if alive is None:
+            alive = self.read_live_dispatchers(stale_after)
+        if owner in alive:
+            return False
+        if self.claim_age(current, time.time()) > stale_after:
+            self.store.hset(task_id, {field: self._claim_value()})
+            return True
+        return False
+
+    def read_live_dispatchers(self, stale_after: float) -> set[str]:
+        """Dispatcher ids whose liveness heartbeat (DISPATCHERS_KEY) is
+        fresher than ``stale_after`` seconds. Long-dead entries (every
+        restart mints a fresh id, nothing else removes them) are GC'd in
+        passing so the registry — read whole on every rescan — stays
+        bounded by the live fleet, not by restarts-ever."""
+        now_wall = time.time()
+        alive: set[str] = set()
+        ancient: list[str] = []
+        for did, stamp in self.store.hgetall(DISPATCHERS_KEY).items():
+            try:
+                age = now_wall - float(stamp)
+            except ValueError:
+                ancient.append(did)
+                continue
+            if age <= stale_after:
+                alive.add(did)
+            elif age > 20 * max(stale_after, 1.0):
+                ancient.append(did)
+        if ancient:
+            self.store.hdel(DISPATCHERS_KEY, *ancient)
+        return alive
+
+    @staticmethod
+    def claim_owner(claim: str | None) -> str | None:
+        if claim is None:
+            return None
+        return claim.rsplit(":", 1)[0]
 
     # -- store writes ------------------------------------------------------
     def mark_running(
@@ -386,9 +548,14 @@ class TaskDispatcher:
     def renew_leases(self, task_ids) -> None:
         """Re-stamp the ownership lease of every given in-flight task in one
         pipelined round trip; while these writes keep landing, no rescan
-        will adopt them."""
+        will adopt them. In shared mode the dispatcher's own liveness
+        heartbeat rides the same round trip (DISPATCHERS_KEY) — siblings
+        use it to tell a dead claim owner from a merely busy one; unshared
+        dispatchers don't pollute the registry."""
         stamp = repr(time.time())
         items = [(tid, {FIELD_LEASE_AT: stamp}) for tid in task_ids]
+        if self.shared:
+            items.append((DISPATCHERS_KEY, {self.dispatcher_id: stamp}))
         if items:
             self.store.hset_many(items)
 

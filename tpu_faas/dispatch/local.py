@@ -40,12 +40,16 @@ class LocalDispatcher(TaskDispatcher):
         store=None,
         channel: str = "tasks",
         idle_sleep: float = 0.001,
+        shared: bool = False,
     ) -> None:
-        super().__init__(store_url=store_url, channel=channel, store=store)
+        super().__init__(
+            store_url=store_url, channel=channel, store=store, shared=shared
+        )
         self.num_workers = num_workers
         self.idle_sleep = idle_sleep
         self._done: queue.Queue[tuple[str, Future]] = queue.Queue()
         self._busy = 0
+        self._running: set[str] = set()
 
     def _make_pool(self) -> ProcessPoolExecutor:
         return ProcessPoolExecutor(
@@ -65,6 +69,7 @@ class LocalDispatcher(TaskDispatcher):
         fut.add_done_callback(
             lambda f, tid=task.task_id: self._done.put((tid, f))
         )
+        self._running.add(task.task_id)
         self._busy += 1
 
     def _drain_one(self) -> bool:
@@ -72,6 +77,7 @@ class LocalDispatcher(TaskDispatcher):
             task_id, fut = self._done.get_nowait()
         except queue.Empty:
             return False
+        self._running.discard(task_id)
         exc = fut.exception()
         if exc is None:
             res: ExecutionResult = fut.result()
@@ -92,6 +98,7 @@ class LocalDispatcher(TaskDispatcher):
         ``stop()``.
         """
         completed = 0
+        last_renew = time.monotonic()
         pool = self._make_pool()
         try:
             while not self.stopping:
@@ -101,7 +108,9 @@ class LocalDispatcher(TaskDispatcher):
                 # admission-controlled intake (reference task_dispatcher.py:73-75)
                 while self._busy < self.num_workers:
                     try:
-                        task = self.poll_next_task()
+                        # shared mode: only run tasks we claimed (outage-
+                        # safe: an unclaimed poll parks and retries)
+                        task = self.poll_next_claimed()
                     except STORE_OUTAGE_ERRORS as exc:
                         self.note_store_outage(exc)
                         break
@@ -118,6 +127,16 @@ class LocalDispatcher(TaskDispatcher):
                 while self._drain_one():
                     completed += 1
                     progressed = True
+                if self.shared and (
+                    time.monotonic() - last_renew >= self.LEASE_RENEW_PERIOD
+                ):
+                    # keep our claims + in-pool tasks from being adopted by
+                    # sibling dispatchers (liveness heartbeat rides along)
+                    try:
+                        self.renew_leases(self._running)
+                    except STORE_OUTAGE_ERRORS as exc:
+                        self.note_store_outage(exc, pause=0)
+                    last_renew = time.monotonic()
                 if max_tasks is not None and completed >= max_tasks:
                     break
                 if not progressed:

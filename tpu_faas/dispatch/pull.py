@@ -48,8 +48,11 @@ class PullDispatcher(TaskDispatcher):
         time_to_expire: float = 10.0,
         max_task_retries: int = 3,
         clock=time.monotonic,
+        shared: bool = False,
     ) -> None:
-        super().__init__(store_url=store_url, channel=channel, store=store)
+        super().__init__(
+            store_url=store_url, channel=channel, store=store, shared=shared
+        )
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.REP)
         if port == 0:
@@ -150,7 +153,9 @@ class PullDispatcher(TaskDispatcher):
                 continue
             self.requeued.popleft()
             return pt
-        return self.poll_next_task()
+        # bus tasks must be CLAIMED in shared mode (requeued ones above
+        # are already ours); outage-safe via the base parking helper
+        return self.poll_next_claimed()
 
     def start(self, max_results: int | None = None) -> int:
         """Serve worker requests; returns results recorded (for tests)."""
@@ -162,10 +167,12 @@ class PullDispatcher(TaskDispatcher):
                     self.flush_deferred_results()
                 try:
                     self._purge_dead_workers()
-                    if (
-                        self.clock() - last_renew >= self.LEASE_RENEW_PERIOD
-                        and self.inflight
+                    if self.clock() - last_renew >= self.LEASE_RENEW_PERIOD and (
+                        self.inflight or self.shared
                     ):
+                        # shared mode renews even while idle: the liveness
+                        # heartbeat rides this write, and a silent sibling
+                        # gets its claims adopted out from under it
                         self.renew_leases(self.inflight)
                         last_renew = self.clock()
                 except STORE_OUTAGE_ERRORS as exc:

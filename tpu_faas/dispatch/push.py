@@ -77,8 +77,11 @@ class PushDispatcher(TaskDispatcher):
         poll_timeout_ms: int = 5,
         max_task_retries: int = 3,
         clock=time.monotonic,
+        shared: bool = False,
     ) -> None:
-        super().__init__(store_url=store_url, channel=channel, store=store)
+        super().__init__(
+            store_url=store_url, channel=channel, store=store, shared=shared
+        )
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.ROUTER)
         if port == 0:
@@ -279,7 +282,9 @@ class PushDispatcher(TaskDispatcher):
                 continue
             self.requeue.popleft()
             return task
-        return self.poll_next_task()
+        # bus tasks must be CLAIMED in shared mode (requeued ones above
+        # are already ours); outage-safe via the base parking helper
+        return self.poll_next_claimed()
 
     def _dispatch_round(self) -> int:
         """Hand out tasks while there is free capacity and pending work."""

@@ -36,6 +36,7 @@ from tpu_faas.core.task import (
     FIELD_RECLAIMS,
     FIELD_STATUS,
     TaskStatus,
+    claim_field_for,
 )
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
@@ -70,8 +71,11 @@ class TpuPushDispatcher(TaskDispatcher):
         liveness_period: float | None = None,
         mesh_devices: int | None = None,
         lease_timeout: float = 30.0,
+        shared: bool = False,
     ) -> None:
-        super().__init__(store_url=store_url, channel=channel, store=store)
+        super().__init__(
+            store_url=store_url, channel=channel, store=store, shared=shared
+        )
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.ROUTER)
         if port == 0:
@@ -150,6 +154,7 @@ class TpuPushDispatcher(TaskDispatcher):
         by the pending-id check at intake (tick())."""
         a = self.arrays
         known = {t.task_id for t in self.pending}
+        known.update(t.task_id for t in self._unclaimed)
         # tasks whose (terminal) writes sit in the deferred buffer still read
         # as QUEUED/RUNNING from the store — adopting them would re-execute
         known.update(item[0] for item in self.deferred_results)
@@ -224,9 +229,51 @@ class TpuPushDispatcher(TaskDispatcher):
                         expired[key] = max(int(raw), 0)
                     except (TypeError, ValueError):
                         expired[key] = 0
+        # shared fleets: per-candidate ownership data, one pipelined read —
+        # a QUEUED task claimed by a LIVE sibling is in that sibling's
+        # pending queue (possibly waiting out an overload), not stranded
+        alive: set[str] = set()
+        claims0: dict[str, str | None] = {}
+        if self.shared:
+            alive = self.read_live_dispatchers(self.lease_timeout)
+            queued_keys = [
+                key
+                for key, status in zip(candidates, statuses)
+                if status == str(TaskStatus.QUEUED)
+            ]
+            if queued_keys:
+                claims0 = dict(
+                    zip(
+                        queued_keys,
+                        self.store.hget_many(
+                            queued_keys, claim_field_for(0)
+                        ),
+                    )
+                )
         n = n_adopted = 0
         for key, status in zip(candidates, statuses):
             if status == str(TaskStatus.QUEUED):
+                if self.shared:
+                    claim = claims0.get(key)
+                    owner = self.claim_owner(claim)
+                    if owner is not None and owner != self.dispatcher_id:
+                        if owner in alive:
+                            continue  # a live sibling's task: hands off
+                        if (
+                            self.claim_age(claim, time.time())
+                            <= self.lease_timeout
+                        ):
+                            # claim too fresh to steal: its owner may have
+                            # just started (heartbeat not yet visible) or
+                            # just died (give the grace period)
+                            continue
+                    # unclaimed -> arbitrate the normal intake claim;
+                    # claimed-by-the-dead -> arbitrate adoption gen 1
+                    generation = 0 if owner is None else 1
+                    if not self.claim_adoption(
+                        key, generation, self.lease_timeout, alive=alive
+                    ):
+                        continue  # another adopter won this task
                 fields = self.store.hgetall(key)
                 if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
                     continue  # finished between the two reads
@@ -239,6 +286,12 @@ class TpuPushDispatcher(TaskDispatcher):
                 self.pending.append(PendingTask.from_fields(key, fields))
                 n += 1
             elif key in expired:
+                # among sibling dispatchers, exactly one wins this reclaim
+                # generation (single-dispatcher mode always wins)
+                if not self.claim_adoption(
+                    key, expired[key] + 1, self.lease_timeout, alive=alive
+                ):
+                    continue
                 # adopt with the persisted count bumped: the dispatch path
                 # then declares the re-dispatch to the race monitor and
                 # freezes the result first-wins, so a zombie worker's late
@@ -360,11 +413,30 @@ class TpuPushDispatcher(TaskDispatcher):
         room = self.arrays.max_pending - len(self.pending)
         if room > 0:
             seen = {t.task_id for t in self.pending}
-            for t in self.poll_tasks(room):
+            # tasks whose claim round hit an outage last time go first —
+            # their announces are long consumed, dropping them loses tasks
+            batch = []
+            while self._unclaimed and len(batch) < room:
+                t = self._unclaimed.popleft()
+                if t.task_id not in seen:
+                    seen.add(t.task_id)
+                    batch.append(t)
+            for t in self.poll_tasks(max(room - len(batch), 0)):
                 if t.task_id in seen:
                     continue
                 seen.add(t.task_id)
-                self.pending.append(t)
+                batch.append(t)
+            # shared fleets: one pipelined claim round decides which of
+            # these announces are OURS to dispatch (identity when not
+            # shared)
+            try:
+                self.pending.extend(self.claim_for_dispatch(batch))
+            except STORE_OUTAGE_ERRORS:
+                # park UNCLAIMED: dispatching without a claim could double
+                # against a sibling; the claim retries when the store is
+                # back (siblings are equally stuck, so nothing races ahead)
+                self._unclaimed.extend(batch)
+                raise
 
     def tick(self, intake: bool = True) -> int:
         """Intake + device step + act on outputs. Returns tasks dispatched.

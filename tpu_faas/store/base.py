@@ -44,6 +44,13 @@ TASKS_CHANNEL = "tasks"
 #: raw reference contract, pre-index snapshots) are covered by the
 #: rescan's periodic full-scan fallback.
 LIVE_INDEX_KEY = "tasks:index"
+
+#: Dispatcher liveness registry: field = dispatcher_id, value = epoch
+#: seconds of its last lease-renewal pass. Shared-fleet adoption decisions
+#: key off this — a task claim is only stealable once its OWNER's
+#: heartbeat here has gone stale (a merely-overloaded sibling keeps
+#: renewing and keeps its claims).
+DISPATCHERS_KEY = "dispatchers:alive"
 #: Results channel: finish_task announces every terminal write here so the
 #: gateway can wake parked /result long-polls instantly instead of polling
 #: the store. No reference analog (its clients poll, SURVEY §3.1); the
