@@ -168,3 +168,38 @@ def test_push_worker_reconnect_after_dispatcher_restart_message():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def test_process_lb_free_tokens_lazy_and_bounded():
+    """Process-LB free-list maintenance is O(1) per event: stale tokens are
+    discarded lazily by _pick_worker's validation, and a reconnect storm
+    triggers a (rare, amortized) compaction instead of unbounded growth."""
+    from tpu_faas.dispatch.push import PushDispatcher
+    from tpu_faas.store.memory import MemoryStore
+
+    d = PushDispatcher(
+        ip="127.0.0.1", port=0, store=MemoryStore(), process_lb=True,
+        heartbeat=True,
+    )
+    try:
+        d._handle(b"w1", "register", {"num_processes": 2})
+        d._handle(b"w2", "register", {"num_processes": 2})
+        assert len(d.free_procs) == 4
+        # a reconnect storm: re-register w1 fifty times — tokens stay
+        # bounded by the compaction guard (4x real capacity)
+        for _ in range(50):
+            d._handle(b"w1", "register", {"num_processes": 2})
+        assert len(d.free_procs) <= 4 * 4
+        # every pick still lands on a worker with real capacity, and total
+        # picks cannot exceed true capacity
+        picks = []
+        while True:
+            wid = d._pick_worker()
+            if wid is None:
+                break
+            d.workers[wid].free_processes -= 1  # what dispatch would do
+            picks.append(wid)
+        assert len(picks) == 4  # 2+2 real process slots, stale tokens skipped
+        assert picks.count(b"w1") == 2 and picks.count(b"w2") == 2
+    finally:
+        d.socket.close(linger=0)

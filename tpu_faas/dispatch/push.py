@@ -103,18 +103,41 @@ class PushDispatcher(TaskDispatcher):
         # gives O(1) move-to-front/pop like reference :327)
         self.free_lru: OrderedDict[bytes, None] = OrderedDict()
         # process-LB variant: one entry per free process slot
-        self.free_procs: deque[bytes] = deque()
+        # a LIST, not a deque: the per-round shuffle swaps by position
+        # (O(n^2) on a deque), and because the order is random anyway,
+        # O(1) push/pop at the END replace popleft/appendleft
+        self.free_procs: list[bytes] = []
+        # cached fleet capacity for the compaction guard; refreshed on
+        # membership/capacity events only, so _add_free stays O(1)
+        self._fleet_procs = 0
         # tasks reclaimed from purged workers; dispatched before new intake
         self.requeue: deque[PendingTask] = deque()
         self.n_dispatched = 0
         self.n_results = 0
         self.n_purged = 0
 
+    def _refresh_fleet_procs(self) -> None:
+        """Recompute cached total capacity; called on the rare membership /
+        capacity events (register, reconnect, purge, drain-drop) so the
+        per-dispatch compaction guard stays O(1)."""
+        self._fleet_procs = sum(
+            r.num_processes for r in self.workers.values()
+        )
+
     # -- free-capacity bookkeeping ----------------------------------------
     def _add_free(self, wid: bytes, front: bool = False) -> None:
         if self.process_lb:
             rec = self.workers[wid]
             self.free_procs.extend([wid] * rec.free_processes)
+            # stale tokens are deleted lazily (_remove_free); a reconnect
+            # storm could pile them up, so compact — O(fleet) — only on the
+            # rare occasions the deque outgrows real capacity several-fold
+            if len(self.free_procs) > 4 * max(self._fleet_procs, 1):
+                self.free_procs = [
+                    w
+                    for w, r in self.workers.items()
+                    for _ in range(r.free_processes)
+                ]
         else:
             if wid not in self.free_lru:
                 self.free_lru[wid] = None
@@ -123,14 +146,18 @@ class PushDispatcher(TaskDispatcher):
 
     def _remove_free(self, wid: bytes) -> None:
         self.free_lru.pop(wid, None)
-        if self.process_lb and wid in self.free_procs:
-            self.free_procs = deque(w for w in self.free_procs if w != wid)
+        # process-LB tokens are removed LAZILY: _pick_worker re-validates
+        # every popped token against the live record (worker gone, or no
+        # free process left -> token discarded), so eagerly rebuilding the
+        # deque here — O(fleet processes) on every result/purge/register —
+        # buys nothing. Stale tokens are self-cleaning: each is consumed
+        # the first time it is popped.
 
     def _pick_worker(self) -> bytes | None:
         """Next worker with a free process, per the active balancing mode."""
         if self.process_lb:
             while self.free_procs:
-                wid = self.free_procs.popleft()
+                wid = self.free_procs.pop()
                 rec = self.workers.get(wid)
                 if rec is not None and rec.free_processes > 0:
                     return wid
@@ -152,6 +179,7 @@ class PushDispatcher(TaskDispatcher):
                 free_processes=int(data["num_processes"]),
                 last_heartbeat=now,
             )
+            self._refresh_fleet_procs()
             self._remove_free(wid)
             self._add_free(wid, front=True)
             self.log.info("push worker registered: %r x%s", wid, data)
@@ -177,6 +205,7 @@ class PushDispatcher(TaskDispatcher):
             # as the last one lands (or by purge if it dies mid-drain)
             rec.num_processes = 0
             rec.free_processes = 0
+            self._refresh_fleet_procs()
             self._remove_free(wid)
             self.log.info(
                 "worker %r draining (%d in flight)", wid, len(rec.inflight)
@@ -207,12 +236,13 @@ class PushDispatcher(TaskDispatcher):
                     # draining worker: last in-flight result drops the record
                     if not rec.inflight:
                         self.workers.pop(wid, None)
+                        self._refresh_fleet_procs()
                     return
                 rec.free_processes = min(
                     rec.free_processes + 1, rec.num_processes
                 )
                 if self.process_lb:
-                    self.free_procs.appendleft(wid)
+                    self.free_procs.append(wid)
                 else:
                     self._add_free(wid)
         elif msg_type == m.RECONNECT:
@@ -220,6 +250,7 @@ class PushDispatcher(TaskDispatcher):
             # it at the LRU front (reference :360-367)
             rec.free_processes = int(data.get("free_processes", 0))
             rec.num_processes = max(rec.num_processes, rec.free_processes)
+            self._refresh_fleet_procs()
             self._remove_free(wid)
             if rec.free_processes > 0:
                 self._add_free(wid, front=True)
@@ -256,6 +287,7 @@ class PushDispatcher(TaskDispatcher):
                     reclaims.append(pt)
             # phase 2 — bookkeeping only, cannot raise
             self.workers.pop(wid)
+            self._refresh_fleet_procs()
             self._remove_free(wid)
             self.requeue.extend(reclaims)
             self.n_purged += 1
@@ -299,14 +331,14 @@ class PushDispatcher(TaskDispatcher):
                 # restore the picked worker before surfacing the outage, or
                 # an idle worker vanishes from rotation until its next message
                 if self.process_lb:
-                    self.free_procs.appendleft(wid)
+                    self.free_procs.append(wid)
                 else:
                     self._add_free(wid, front=True)
                 raise
             if task is None:
                 # nothing pending: put back exactly what was popped
                 if self.process_lb:
-                    self.free_procs.appendleft(wid)
+                    self.free_procs.append(wid)
                 else:
                     self._add_free(wid, front=True)
                 break
