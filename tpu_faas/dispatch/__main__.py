@@ -13,6 +13,7 @@ doing nothing.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -100,6 +101,18 @@ def main(argv: list[str] | None = None) -> None:
     ns = ap.parse_args(argv)
     if ns.delay:
         time.sleep(ns.delay)
+
+    platform = os.environ.get("TPU_FAAS_PLATFORM")
+    if platform:
+        # Pin the JAX backend explicitly (e.g. TPU_FAAS_PLATFORM=cpu with
+        # XLA_FLAGS=--xla_force_host_platform_device_count=N for a virtual
+        # mesh on a dev box). JAX_PLATFORMS alone is NOT enough: platform
+        # plugins rewrite it at import, and the silent fallback used to
+        # make `--mesh 8` run on one device without saying so — the
+        # SchedulerArrays device-count validation now fails fast instead.
+        import jax
+
+        jax.config.update("jax_platforms", platform)
 
     if ns.mode == "local":
         from tpu_faas.dispatch.local import LocalDispatcher

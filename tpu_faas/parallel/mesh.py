@@ -159,7 +159,7 @@ def sharded_sinkhorn_placement(
 def sharded_scheduler_tick(
     mesh: Mesh,
     task_size: jnp.ndarray,  # f32[T]
-    task_valid: jnp.ndarray,  # bool[T]
+    task_valid: jnp.ndarray | None,  # bool[T]; None = first n_valid rows
     worker_speed: jnp.ndarray,
     worker_free: jnp.ndarray,
     worker_active: jnp.ndarray,
@@ -170,6 +170,7 @@ def sharded_scheduler_tick(
     max_slots: int = 8,
     use_sinkhorn: bool = True,
     task_priority: jnp.ndarray | None = None,  # i32[T] sharded like tasks
+    n_valid: jnp.ndarray | None = None,  # i32 scalar, with task_valid=None
 ) -> TickOutput:
     """The full fused tick (liveness + purge + placement + redistribution)
     with the pending-task axis sharded across the mesh. Semantics identical
@@ -177,6 +178,14 @@ def sharded_scheduler_tick(
     rank-match path (the global stable sort lowers to a collective exchange);
     the Sinkhorn path ignores it — entropic admission is soft by
     construction, so hard priority classes belong to the rank-match branch."""
+    if task_valid is None:
+        # valid mask computed on DEVICE from a scalar (the live
+        # dispatcher's calling convention: saves a [T]-bool upload AND a
+        # separate mask dispatch per tick); XLA partitions it along with
+        # everything else under this jit
+        task_valid = (
+            jnp.arange(task_size.shape[0], dtype=jnp.int32) < n_valid
+        )
     fresh = heartbeat_age <= time_to_expire
     live = worker_active & fresh
     purged = prev_live & ~live

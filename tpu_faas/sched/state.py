@@ -32,6 +32,8 @@ def _scatter_set_i32(arr, idx, vals):
     return arr.at[idx].set(vals)
 
 
+
+
 @partial(jax.jit, static_argnames=("T", "W", "max_slots", "placement"))
 def _packed_tick(
     packed,  # f32[T + 2W]: sizes ++ heartbeat ages ++ free counts
@@ -403,13 +405,19 @@ class SchedulerArrays:
             )
         return self._d_inflight
 
-    def _cached_dev(self, name: str, host: np.ndarray):
+    def _cached_dev(self, name: str, host: np.ndarray, sharding=None):
         """Device copy of a host fleet array, re-uploaded only when the
-        host content actually changed (cheap [W] compare per tick)."""
+        host content actually changed (cheap compare per tick). With
+        ``sharding`` the copy is placed with it (the mesh path caches
+        REPLICATED fleet arrays the same way the single-device path caches
+        committed ones)."""
         entry = self._dev_cache.get(name)
         if entry is not None and np.array_equal(entry[0], host):
             return entry[1]
-        dev = jnp.asarray(host)
+        if sharding is None:
+            dev = jnp.asarray(host)
+        else:
+            dev = jax.device_put(host, sharding)
         self._dev_cache[name] = (host.copy(), dev)
         return dev
 
@@ -439,9 +447,7 @@ class SchedulerArrays:
         if self.mesh is not None:
             ts = np.zeros(self.max_pending, dtype=np.float32)
             ts[:n] = task_sizes
-            tv = np.zeros(self.max_pending, dtype=bool)
-            tv[:n] = True
-            out = self._tick_sharded(ts, tv, hb_age, prio)
+            out = self._tick_sharded(ts, n, hb_age, prio)
         else:
             # one packed upload carries everything that changes every tick
             # (sizes ++ hb ages ++ free counts); the rest is device-resident
@@ -483,38 +489,59 @@ class SchedulerArrays:
     def _tick_sharded(
         self,
         ts: np.ndarray,
-        tv: np.ndarray,
+        n_valid: int,
         hb_age: np.ndarray,
         prio: np.ndarray | None,
     ) -> TickOutput:
         """The mesh-backed tick: task arrays sharded over the task axis,
-        fleet state replicated, identical semantics to scheduler_tick."""
-        from tpu_faas.parallel.mesh import (
-            replicate,
-            shard_task_arrays,
-            sharded_scheduler_tick,
-        )
+        fleet state replicated, identical semantics to scheduler_tick.
 
-        task_arrays = [jnp.asarray(ts), jnp.asarray(tv)]
-        if prio is not None:
-            task_arrays.append(jnp.asarray(prio))
-        sharded = shard_task_arrays(self.mesh, *task_arrays)
-        ts_d, tv_d = sharded[0], sharded[1]
-        prio_d = sharded[2] if prio is not None else None
-        ws, wf, wa, hb, pl, iw, tte = replicate(
-            self.mesh,
-            jnp.asarray(self.worker_speed),
-            jnp.asarray(self.worker_free),
-            jnp.asarray(self.worker_active),
-            jnp.asarray(hb_age),
-            jnp.asarray(self.prev_live),
-            jnp.asarray(self.inflight_worker),
-            jnp.float32(self.time_to_expire),
-        )
+        The same per-tick transfer discipline as the single-device path:
+        the sizes batch is the only big upload (sharded); the valid mask is
+        computed on device from a scalar; slow-changing fleet arrays (speed,
+        active, the inflight table) are cached replicated behind host
+        compares; only the genuinely per-tick vectors (heartbeat ages, free
+        counts) are re-replicated each call."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_faas.parallel.mesh import TASK_AXIS, sharded_scheduler_tick
+
+        task_sh = NamedSharding(self.mesh, P(TASK_AXIS))
+        repl = NamedSharding(self.mesh, P())
+        ts_d = jax.device_put(ts, task_sh)
+        prio_d = None if prio is None else jax.device_put(prio, task_sh)
+        hb = jax.device_put(hb_age, repl)
+        wf = jax.device_put(self.worker_free, repl)
+        ws = self._cached_dev("speed@mesh", self.worker_speed, repl)
+        wa = self._cached_dev("active@mesh", self.worker_active, repl)
+        # the delta-maintained single-device mirror is the source of truth;
+        # it is re-broadcast to the mesh only when its identity changed (no
+        # deltas -> same object -> no transfer, and never a host copy)
+        src = self._device_inflight()
+        mesh_entry = self._dev_cache.get("inflight@mesh")
+        if mesh_entry is None or mesh_entry[0] is not src:
+            self._dev_cache["inflight@mesh"] = (
+                src,
+                jax.device_put(src, repl),
+            )
+        iw = self._dev_cache["inflight@mesh"][1]
+        if (
+            self._tte_host != self.time_to_expire
+            or "tte@mesh" not in self._dev_cache
+        ):
+            self._dev_cache["tte@mesh"] = (
+                np.float32(self.time_to_expire),
+                jax.device_put(jnp.float32(self.time_to_expire), repl),
+            )
+            self._tte_host = self.time_to_expire
+        tte = self._dev_cache["tte@mesh"][1]
+        pl = self.prev_live
+        if isinstance(pl, np.ndarray):
+            pl = jax.device_put(pl, repl)
         return sharded_scheduler_tick(
             self.mesh,
             ts_d,
-            tv_d,
+            None,  # valid mask computed in-kernel from n_valid
             ws,
             wf,
             wa,
@@ -525,4 +552,5 @@ class SchedulerArrays:
             max_slots=self.max_slots,
             use_sinkhorn=(self.placement == "sinkhorn"),
             task_priority=prio_d,
+            n_valid=jnp.int32(n_valid),
         )
