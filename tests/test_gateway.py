@@ -814,3 +814,53 @@ def test_batch_idempotency_keys():
         assert bad.status_code == 400
     finally:
         handle.stop()
+
+
+def test_two_gateway_replicas_dedupe_concurrent_keyed_submits():
+    """Gateway replicas share one store, so the idempotency claim must
+    arbitrate across processes: hammer the SAME key through two replicas
+    concurrently — exactly one task record is created, every response
+    agrees on the task id, and the task runs once."""
+    import concurrent.futures
+
+    from tpu_faas.store.launch import make_store, start_store_thread
+
+    store_handle = start_store_thread()
+    gw1 = start_gateway_thread(make_store(store_handle.url))
+    gw2 = start_gateway_thread(make_store(store_handle.url))
+    try:
+        fid = requests.post(
+            f"{gw1.url}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        payload = serialize(((5,), {}))
+        body = {
+            "function_id": fid,
+            "payload": payload,
+            "idempotency_key": "xgw",
+        }
+
+        def submit(base):
+            return requests.post(f"{base}/execute_function", json=body).json()
+
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            results = list(
+                pool.map(
+                    submit,
+                    [gw1.url, gw2.url] * 8,
+                )
+            )
+        ids = {r["task_id"] for r in results}
+        assert len(ids) == 1, ids
+        # exactly one submit was the winner (created the record); the rest
+        # deduplicated against it
+        dedups = sum(bool(r.get("deduplicated")) for r in results)
+        assert dedups == len(results) - 1
+        # one live record in the store, QUEUED exactly once
+        s = make_store(store_handle.url)
+        assert s.get_status(next(iter(ids))) == "QUEUED"
+        s.close()
+    finally:
+        gw1.stop()
+        gw2.stop()
+        store_handle.stop()

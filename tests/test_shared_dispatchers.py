@@ -21,6 +21,22 @@ from tpu_faas.workloads import arithmetic, sleep_task
 from tests.test_workers_e2e import _spawn_worker
 
 
+def _wait_until_hot(*dispatchers, timeout: float = 120.0):
+    """Block until every dispatcher has run its first device tick (paying
+    the jit compile) and has at least one registered worker — the timing
+    assertions in these tests are structural only once both loops are hot."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(
+            d.tracer.summary().get("device_tick", {}).get("count", 0) >= 1
+            and len(d.arrays.worker_ids) >= 1
+            for d in dispatchers
+        ):
+            return
+        time.sleep(0.1)
+    raise AssertionError("dispatchers never became hot")
+
+
 def test_claim_for_dispatch_partitions_batches():
     """Two dispatchers claiming overlapping batches: every task is kept by
     exactly one (and re-claiming your own keeps it)."""
@@ -73,7 +89,10 @@ def test_two_shared_dispatchers_run_each_task_exactly_once():
                 make_store(store_handle.url), monitor, actor=name
             ),
             max_workers=32,
-            max_pending=128,
+            # small pending window: neither dispatcher can swallow the whole
+            # queue into its buffer, so BOTH must do real work — making the
+            # both-active assertion below deterministic, not a timing race
+            max_pending=8,
             max_inflight=256,
             tick_period=0.01,
             time_to_expire=2.0,
@@ -96,13 +115,17 @@ def test_two_shared_dispatchers_run_each_task_exactly_once():
     ]
     client = FaaSClient(gw.url)
     try:
-        fid = client.register(arithmetic)
-        handles = client.submit_many(fid, [((i,), {}) for i in range(40)])
-        assert [h.result(timeout=120) for h in handles] == [
-            arithmetic(i) for i in range(40)
-        ]
+        _wait_until_hot(d1, d2)
+
+        fid = client.register(sleep_task)
+        handles = client.submit_many(
+            fid, [((0.3,), {}) for _ in range(40)]
+        )
+        assert [h.result(timeout=180) for h in handles] == [0.3] * 40
         # exactly-once: every task dispatched by exactly one dispatcher
         assert d1.n_dispatched + d2.n_dispatched == 40
+        # with both loops hot, 40 x 0.3 s tasks cannot drain through one
+        # 8-deep window + 2-slot fleet before the sibling claims some
         assert d1.n_dispatched > 0 and d2.n_dispatched > 0
         monitor.assert_clean()
         assert monitor.unfinished() == []
@@ -155,10 +178,12 @@ def test_shared_dispatcher_death_migrates_tasks_to_sibling():
     )
     client = FaaSClient(gw.url)
     try:
+        _wait_until_hot(d1, d2)
+
         fid = client.register(sleep_task)
         handles = [client.submit(fid, 0.5) for _ in range(16)]
         # wait until d1 actually owns some work, then kill it + its fleet
-        deadline = time.monotonic() + 30
+        deadline = time.monotonic() + 60
         while time.monotonic() < deadline and d1.n_dispatched == 0:
             time.sleep(0.05)
         assert d1.n_dispatched > 0

@@ -13,7 +13,6 @@ doing nothing.
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 import time
 
@@ -102,18 +101,6 @@ def main(argv: list[str] | None = None) -> None:
     if ns.delay:
         time.sleep(ns.delay)
 
-    platform = os.environ.get("TPU_FAAS_PLATFORM")
-    if platform:
-        # Pin the JAX backend explicitly (e.g. TPU_FAAS_PLATFORM=cpu with
-        # XLA_FLAGS=--xla_force_host_platform_device_count=N for a virtual
-        # mesh on a dev box). JAX_PLATFORMS alone is NOT enough: platform
-        # plugins rewrite it at import, and the silent fallback used to
-        # make `--mesh 8` run on one device without saying so — the
-        # SchedulerArrays device-count validation now fails fast instead.
-        import jax
-
-        jax.config.update("jax_platforms", platform)
-
     if ns.mode == "local":
         from tpu_faas.dispatch.local import LocalDispatcher
 
@@ -132,6 +119,19 @@ def main(argv: list[str] | None = None) -> None:
         elif ns.mode == "push":
             from tpu_faas.dispatch.push import PushDispatcher as cls
         else:
+            if cfg.platform:
+                # Pin the JAX backend BEFORE the tpu-push import pulls jax
+                # in (e.g. TPU_FAAS_PLATFORM=cpu + XLA_FLAGS=--xla_force_
+                # host_platform_device_count=N for a virtual mesh on a dev
+                # box). JAX_PLATFORMS alone is NOT enough: platform plugins
+                # rewrite it at import, and the silent fallback used to run
+                # `--mesh 8` on one device without saying so — the
+                # SchedulerArrays device-count validation now fails fast.
+                # Only this mode pays the jax import; pull/push/local never
+                # touch it.
+                import jax
+
+                jax.config.update("jax_platforms", cfg.platform)
             from tpu_faas.dispatch.tpu_push import TpuPushDispatcher as cls
     except ImportError as exc:
         sys.exit(f"dispatcher mode {ns.mode!r} is not available: {exc}")

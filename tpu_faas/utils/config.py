@@ -37,6 +37,11 @@ class Config:
     tick_period: float = 0.005
     max_workers: int = 4096
     max_pending: int = 8192
+    # JAX backend pin for the tpu-push dispatcher ("" = whatever JAX picks).
+    # Needed because platform plugins rewrite JAX_PLATFORMS at import: e.g.
+    # TPU_FAAS_PLATFORM=cpu + XLA_FLAGS=--xla_force_host_platform_device_
+    # count=N runs a virtual CPU mesh on a dev box.
+    platform: str = ""
 
     @classmethod
     def load(cls, ini_path: str | None = None, env: bool = True) -> "Config":
