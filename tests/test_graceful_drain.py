@@ -29,7 +29,7 @@ def _drain_scenario(client: FaaSClient, workers: list) -> None:
     well before any timeout-based recovery."""
     fid = client.register(sleep_task)
     handles = [client.submit(fid, 2.0) for _ in range(8)]
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 60
     while time.monotonic() < deadline:
         running = sum(h.status() == "RUNNING" for h in handles)
         if running >= 3:  # both 2-proc workers necessarily hold tasks

@@ -34,25 +34,31 @@ class _GroupPopen(subprocess.Popen):
     tests. start_new_session=True puts every helper in the worker's group so
     one killpg reaps the lot."""
 
-    def kill(self) -> None:
-        try:
-            os.killpg(self.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            super().kill()
-
-    def terminate(self) -> None:
-        try:
-            os.killpg(self.pid, signal.SIGTERM)
-        except (ProcessLookupError, PermissionError):
-            super().terminate()
+    def send_signal(self, sig) -> None:
+        # The single signal-routing point (POSIX Popen.kill()/terminate()
+        # both funnel here): SIGKILL goes to the GROUP — a plain Popen
+        # delivers it to the leader only, re-orphaning the pool helpers
+        # this class exists to reap (94 of them measured after one
+        # full-suite run, load >9, flaking the scale tests). Every other
+        # signal (notably SIGTERM) stays leader-only ON PURPOSE:
+        # graceful-drain tests SIGTERM the worker and need its pool
+        # children alive to finish their in-flight tasks.
+        if sig == signal.SIGKILL:
+            try:
+                os.killpg(self.pid, sig)
+                return
+            except (ProcessLookupError, PermissionError):
+                pass
+        super().send_signal(sig)
 
 
 def _spawn_worker(kind: str, n_procs: int, url: str, *extra: str):
-    # extend, don't replace: PYTHONPATH may carry platform plugins
-    existing = os.environ.get("PYTHONPATH", "")
-    env = dict(
-        os.environ, PYTHONPATH=f"{REPO}:{existing}" if existing else REPO
-    )
+    # shared env builder: repo on PYTHONPATH, jax-importing sitecustomize
+    # dirs stripped (see cpu_worker_env's docstring for the cold-start
+    # numbers behind this)
+    from tpu_faas.bench.harness import cpu_worker_env
+
+    env = cpu_worker_env()
     return _GroupPopen(
         [sys.executable, "-m", f"tpu_faas.worker.{kind}", str(n_procs), url]
         + list(extra),

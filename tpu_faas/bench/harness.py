@@ -69,13 +69,24 @@ class BenchResult:
         }
 
 
-def _spawn_worker(kind: str, n_procs: int, url: str, *extra: str):
-    # extend, don't replace: PYTHONPATH may carry platform plugins
-    # (e.g. the TPU PJRT plugin lives there in some environments)
+def cpu_worker_env() -> dict:
+    """Environment for spawning a PURE-CPU worker subprocess: the repo on
+    PYTHONPATH, minus sitecustomize dirs (e.g. ".axon_site") that import
+    JAX into every interpreter on dev boxes — a worker + its forkserver +
+    each pool child paying a ~2 s jax import stretches worker cold-start
+    to ~10 s, flaking timing-sensitive e2e tests and inflating measured
+    time_to_register. Shared by the bench harness and the test spawners."""
     existing = os.environ.get("PYTHONPATH", "")
-    env = dict(
-        os.environ, PYTHONPATH=f"{REPO}:{existing}" if existing else REPO
-    )
+    kept = [
+        p
+        for p in existing.split(":")
+        if p and not os.path.basename(p.rstrip("/")).endswith("_site")
+    ]
+    return dict(os.environ, PYTHONPATH=":".join([REPO, *kept]))
+
+
+def _spawn_worker(kind: str, n_procs: int, url: str, *extra: str):
+    env = cpu_worker_env()
     return subprocess.Popen(
         [sys.executable, "-m", f"tpu_faas.worker.{kind}", str(n_procs), url]
         + list(extra),
