@@ -31,6 +31,61 @@ import time
 import numpy as np
 
 
+def _reset_backend() -> None:
+    """Discard the cached (partially initialized) backend registry so the
+    next ``jax.devices()`` genuinely re-attempts platform init. Needed
+    because on an accelerator-plugin failure jax caches the backend dict it
+    built so far (CPU only); without clearing, every subsequent call
+    'succeeds' on CPU and never retries the accelerator."""
+    import jax
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+    jax.clear_caches()
+
+
+def _init_backend_with_retry(max_attempts: int = 5) -> None:
+    """First touch of the JAX backend, with bounded retry.
+
+    The dev TPU sits behind an RPC tunnel whose transient outages surface as
+    ``UNAVAILABLE`` at the first ``jax.devices()`` call (this exact traceback
+    cost round 2 its bench artifact). Retry with backoff; re-raise after the
+    last attempt so the JSON-error path in ``run()`` still emits a parseable
+    line. A retry that comes back CPU-only is treated as still-failing: the
+    first failure proves a non-CPU platform was expected, and silently
+    benchmarking the 50k x 4k tick on host CPU would record a wildly wrong
+    number as the round's TPU headline artifact — worse than no number.
+    """
+    import jax
+
+    delay = 5.0
+    failed_once = False
+    for attempt in range(1, max_attempts + 1):
+        try:
+            if failed_once:
+                _reset_backend()
+            devices = jax.devices()
+            if failed_once and jax.default_backend() == "cpu":
+                raise RuntimeError(
+                    "backend came back CPU-only after an accelerator init "
+                    "failure — refusing to record a CPU run as the TPU "
+                    "headline"
+                )
+            print(f"devices: {devices}", file=sys.stderr)
+            return
+        except Exception as e:  # jax.errors.JaxRuntimeError et al.
+            failed_once = True
+            if attempt == max_attempts:
+                raise
+            print(
+                f"backend init attempt {attempt}/{max_attempts} failed "
+                f"({type(e).__name__}: {e}); retrying in {delay:.0f}s",
+                file=sys.stderr,
+            )
+            time.sleep(delay)
+            delay = min(delay * 2, 40.0)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -42,7 +97,7 @@ def main() -> None:
     T, W, I, MAX_SLOTS = 51_200, 4_096, 65_536, 8
     rng = np.random.default_rng(42)
 
-    print(f"devices: {jax.devices()}", file=sys.stderr)
+    _init_backend_with_retry()
 
     # fleet state (device-resident across ticks in a live dispatcher)
     speed = rng.uniform(0.5, 4.0, W).astype(np.float32)
@@ -242,5 +297,33 @@ def main() -> None:
     )
 
 
+def run() -> int:
+    """main() with the artifact guarantee: even a failed run leaves ONE
+    parseable JSON line on stdout (the driver records stdout as the round's
+    bench artifact — round 2's rc=1 traceback-only output lost the round's
+    scoreboard evidence)."""
+    try:
+        main()
+        return 0
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as e:  # noqa: BLE001 — the driver parses stdout
+        import traceback
+
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": "scheduler_tick_latency_50k_tasks_x_4k_workers",
+                    "value": None,
+                    "unit": "ms",
+                    "vs_baseline": None,
+                    "error": f"{type(e).__name__}: {e}",
+                }
+            )
+        )
+        return 1
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(run())

@@ -89,3 +89,81 @@ def test_measure_service_local_smoke():
     assert res.time_to_register_s > 0
     d = res.to_dict()
     assert d["mode"] == "local" and d["sims"] == 1
+
+
+def test_bench_run_emits_parseable_json_line_on_failure(monkeypatch, capsys):
+    """The driver records bench stdout as the round's artifact; a crashed
+    run must still leave one parseable JSON line with an error field
+    (round 2's artifact was an rc=1 traceback with no JSON — scoreboard
+    evidence lost)."""
+    import json
+
+    import bench
+
+    def boom():
+        raise RuntimeError("UNAVAILABLE: tunnel down")
+
+    monkeypatch.setattr(bench, "main", boom)
+    assert bench.run() == 1
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "scheduler_tick_latency_50k_tasks_x_4k_workers"
+    assert rec["value"] is None
+    assert "UNAVAILABLE" in rec["error"]
+
+
+def test_bench_backend_init_retries_transient_unavailable(monkeypatch):
+    """First-touch UNAVAILABLE from a flapping tunnel is retried with
+    backoff instead of killing the run — and each retry clears the cached
+    backend registry so the accelerator is genuinely re-attempted."""
+    import jax
+
+    import bench
+
+    calls = {"n": 0, "resets": 0}
+
+    def flaky_devices():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: backend not ready")
+        return ["tpu0"]
+
+    monkeypatch.setattr(jax, "devices", flaky_devices)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        bench, "_reset_backend",
+        lambda: calls.__setitem__("resets", calls["resets"] + 1),
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench._init_backend_with_retry()
+    assert calls["n"] == 3
+    assert calls["resets"] == 2  # cleared before every re-attempt
+
+    # a permanently-down backend still raises after the attempt budget
+    calls["n"] = -100
+    with pytest.raises(RuntimeError):
+        bench._init_backend_with_retry(max_attempts=2)
+
+
+def test_bench_refuses_cpu_fallback_after_accelerator_failure(monkeypatch):
+    """JAX caches a partially-initialized (CPU-only) backend dict when an
+    accelerator plugin fails to init; a later jax.devices() 'succeeds' on
+    it. The retry must not record that CPU run as the TPU headline."""
+    import jax
+
+    import bench
+
+    calls = {"n": 0}
+
+    def flaky_devices():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("UNAVAILABLE: tunnel down")
+        return ["cpu0"]  # the cached CPU-only registry
+
+    monkeypatch.setattr(jax, "devices", flaky_devices)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    monkeypatch.setattr(bench, "_reset_backend", lambda: None)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    with pytest.raises(RuntimeError, match="CPU"):
+        bench._init_backend_with_retry(max_attempts=3)

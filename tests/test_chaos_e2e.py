@@ -93,16 +93,15 @@ def test_chaos_worker_kill_plus_store_restart(tmp_path):
 
 def _spawn_dispatcher(port: int, store_url: str, *extra: str):
     """A tpu-push dispatcher as a real subprocess (so it can be SIGKILLed)."""
-    import os
     import subprocess
     import sys
 
     from tests.test_workers_e2e import REPO
+    from tpu_faas.bench.harness import cpu_worker_env
 
-    existing = os.environ.get("PYTHONPATH", "")
-    env = dict(
-        os.environ, PYTHONPATH=f"{REPO}:{existing}" if existing else REPO
-    )
+    # cpu_worker_env pins TPU_FAAS_PLATFORM so the child never initializes
+    # the (possibly unreachable) tunneled-TPU backend
+    env = cpu_worker_env()
     return subprocess.Popen(
         [
             sys.executable, "-m", "tpu_faas.dispatch",

@@ -1,0 +1,124 @@
+"""Fleet-wide lease-cadence coordination.
+
+Advisor r2: a tpu-push rescanner started with a tight ``--lease-timeout``
+(at or below ~2-3x the siblings' fixed 10 s renew period) could adopt tasks
+whose push/pull/local owner is alive and renewing — double execution in
+mixed fleets. The fix is coordination through the store (LEASE_CONF_KEY):
+the rescanner publishes its adoption horizon, every dispatcher folds
+timeout/3 into its renew cadence, and renewals re-read the key so
+late-joining rescanners reach already-running dispatchers.
+"""
+
+import threading
+import time
+
+from tpu_faas.core.executor import pack_params
+from tpu_faas.core.serialize import serialize
+from tpu_faas.dispatch.base import TaskDispatcher
+from tpu_faas.dispatch.local import LocalDispatcher
+from tpu_faas.store import MemoryStore
+from tpu_faas.core.task import FIELD_LEASE_AT
+from tpu_faas.workloads import sleep_task
+
+
+def test_publish_tightens_sibling_renew_cadence():
+    store = MemoryStore()
+    rescanner = TaskDispatcher(store=store)
+    rescanner.publish_lease_timeout(3.0)
+    assert rescanner.lease_renew_period == 1.0  # folds into its own cadence
+    # a dispatcher connecting afterwards adapts at construction
+    sibling = TaskDispatcher(store=store)
+    assert sibling.lease_renew_period == 1.0
+
+
+def test_publish_keeps_tightest_value_on_concurrent_rescanners():
+    store = MemoryStore()
+    d = TaskDispatcher(store=store)
+    d.publish_lease_timeout(3.0)
+    d.publish_lease_timeout(9.0)  # a slacker rescanner must not loosen it
+    other = TaskDispatcher(store=store)
+    assert other.lease_renew_period == 1.0
+
+
+def test_late_joining_rescanner_reaches_running_dispatcher():
+    store = MemoryStore()
+    sibling = TaskDispatcher(store=store)
+    assert sibling.lease_renew_period == TaskDispatcher.LEASE_RENEW_PERIOD
+    rescanner = TaskDispatcher(store=store)
+    rescanner.publish_lease_timeout(6.0)
+    # the sibling picks the new horizon up on its next renewal round trip
+    sibling.renew_leases([])
+    assert sibling.lease_renew_period == 2.0
+
+
+def test_unshared_local_dispatcher_renews_running_leases():
+    """A NON-shared local dispatcher must renew leases of in-pool tasks
+    (advisor r2: it renewed only when shared=True, so any task running
+    longer than a co-located rescanner's lease_timeout was adopted and
+    re-executed)."""
+    store = MemoryStore()
+    d = LocalDispatcher(num_workers=1, store=store)
+    assert not d.shared
+    d.lease_renew_period = 0.05
+    t = threading.Thread(target=d.start, daemon=True)
+    t.start()
+    try:
+        store.create_task(
+            "slow", serialize(sleep_task), pack_params(1.0)
+        )
+        # collect two lease stamps while the task is RUNNING
+        deadline = time.monotonic() + 30
+        stamps = set()
+        while time.monotonic() < deadline and len(stamps) < 2:
+            if store.get_status("slow") == "COMPLETED":
+                break
+            stamp = store.hget("slow", FIELD_LEASE_AT)
+            if stamp is not None:
+                stamps.add(stamp)
+            time.sleep(0.02)
+        assert len(stamps) >= 2, (
+            f"lease never renewed while running: {stamps}"
+        )
+    finally:
+        d.stop()
+        t.join(timeout=15)
+
+
+def test_concurrent_publishers_converge_on_min():
+    """Value-keyed setnx publication: the LARGER value landing last must
+    not overwrite the smaller one (a single shared field with
+    read-modify-write would lose that race)."""
+    store = MemoryStore()
+    a = TaskDispatcher(store=store)
+    b = TaskDispatcher(store=store)
+    a.publish_lease_timeout(5.0)
+    b.publish_lease_timeout(30.0)  # lands after: must not win
+    assert a.read_fleet_lease_conf()[0] == 5.0
+    assert b.read_fleet_lease_conf()[0] == 5.0
+    assert b.lease_renew_period == 5.0 / 3.0
+
+
+def test_adoption_horizon_grace_window_after_fresh_publication():
+    """A rescanner must not adopt against a freshly-published tight
+    horizon: siblings renewing at the old (default 10 s) cadence can have
+    stamps up to 10 s old on perfectly live owners. Until one old-cadence
+    renewal has elapsed since first publication, adoption is floored at
+    2.5x LEASE_RENEW_PERIOD; afterwards the tight horizon applies."""
+    import time as _time
+
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+
+    store = MemoryStore()
+    d = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=store, max_workers=4, max_pending=8,
+        max_inflight=8, lease_timeout=2.0,
+    )
+    try:
+        # publication just happened (in the constructor)
+        assert d._adoption_horizon() == 2.5 * d.LEASE_RENEW_PERIOD
+        # age the publication past the window: the tight horizon applies
+        value, _published = d._fleet_lease_conf
+        d._fleet_lease_conf = (value, _time.time() - 2 * d.LEASE_RENEW_PERIOD)
+        assert d._adoption_horizon() == 2.0
+    finally:
+        d.socket.close(0)

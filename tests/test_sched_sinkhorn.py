@@ -134,6 +134,27 @@ def test_memory_bounded_kernels_match_dense(dist, kernel):
     assert float(other.marginal_err) < 0.05
 
 
+def test_bucketed_col_err_meaningful_with_excess_capacity():
+    """With total capacity far above the task count the slack ROW carries
+    the leftover column mass; the convergence metric must fold it in —
+    before the fix a perfectly converged run read marginal_err ~1.0 here
+    (advisor r2), making the metric useless for alarming."""
+    from tpu_faas.sched.sinkhorn import sinkhorn_placement_bucketed
+
+    rng = np.random.default_rng(11)
+    T, W = 64, 128  # 64 tasks on 512 slots
+    res = sinkhorn_placement_bucketed(
+        np.asarray(rng.uniform(0.1, 5.0, T), dtype=np.float32),
+        np.ones(T, dtype=bool),
+        np.asarray(rng.uniform(0.5, 4.0, W), dtype=np.float32),
+        np.full(W, 4, dtype=np.int32),
+        np.ones(W, dtype=bool),
+        max_slots=8,
+    )
+    assert (np.asarray(res.assignment) >= 0).sum() == T
+    assert float(res.marginal_err) < 0.05
+
+
 def test_scheduler_tick_uses_bucketed_at_headline_scale():
     """placement='sinkhorn' must stay runnable at shapes where the dense
     plan would not fit one chip: the tick's branch on T*W routes to the

@@ -82,7 +82,19 @@ def cpu_worker_env() -> dict:
         for p in existing.split(":")
         if p and not os.path.basename(p.rstrip("/")).endswith("_site")
     ]
-    return dict(os.environ, PYTHONPATH=":".join([REPO, *kept]))
+    # Pin the JAX backend of every spawned child: a tpu-push dispatcher
+    # subprocess that initializes the default (tunneled-TPU) backend hangs
+    # indefinitely when the tunnel is down, turning an unrelated outage into
+    # a red suite (cost round 2 one e2e test). Default cpu, following the
+    # suite-wide TPU_FAAS_TEST_PLATFORM override when set.
+    platform = os.environ.get("TPU_FAAS_PLATFORM") or os.environ.get(
+        "TPU_FAAS_TEST_PLATFORM", "cpu"
+    )
+    return dict(
+        os.environ,
+        PYTHONPATH=":".join([REPO, *kept]),
+        TPU_FAAS_PLATFORM=platform,
+    )
 
 
 def _spawn_worker(kind: str, n_procs: int, url: str, *extra: str):

@@ -39,7 +39,12 @@ from tpu_faas.core.task import (
     TaskStatus,
     claim_field_for,
 )
-from tpu_faas.store.base import DISPATCHERS_KEY, TASKS_CHANNEL, TaskStore
+from tpu_faas.store.base import (
+    DISPATCHERS_KEY,
+    LEASE_CONF_KEY,
+    TASKS_CHANNEL,
+    TaskStore,
+)
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import get_logger
 
@@ -165,6 +170,13 @@ class TaskDispatcher:
         self.shared = shared
         self.dispatcher_id = uuid.uuid4().hex[:12]
         self._stop_event = threading.Event()
+        #: instance renew cadence, tightened to any rescanner's published
+        #: lease_timeout/3 (LEASE_CONF_KEY) — see refresh_lease_renew_period
+        self.lease_renew_period = float(self.LEASE_RENEW_PERIOD)
+        #: cached (min lease_timeout, published_at) from LEASE_CONF_KEY,
+        #: refreshed on every renewal round trip
+        self._fleet_lease_conf: tuple[float, float] | None = None
+        self.refresh_lease_renew_period()  # outage-safe; renewals retry
         if shared:
             # announce liveness IMMEDIATELY: siblings treat claims whose
             # owner has no fresh heartbeat as adoptable, and the first
@@ -551,13 +563,69 @@ class TaskDispatcher:
         will adopt them. In shared mode the dispatcher's own liveness
         heartbeat rides the same round trip (DISPATCHERS_KEY) — siblings
         use it to tell a dead claim owner from a merely busy one; unshared
-        dispatchers don't pollute the registry."""
+        dispatchers don't pollute the registry.
+
+        Each call also re-reads the fleet lease config (one extra hget per
+        renew period — negligible) so a rescanner that joins with a tight
+        ``--lease-timeout`` AFTER this dispatcher started still tightens
+        our cadence within one renew period."""
         stamp = repr(time.time())
         items = [(tid, {FIELD_LEASE_AT: stamp}) for tid in task_ids]
         if self.shared:
             items.append((DISPATCHERS_KEY, {self.dispatcher_id: stamp}))
         if items:
             self.store.hset_many(items)
+        self.refresh_lease_renew_period()
+
+    def read_fleet_lease_conf(self) -> tuple[float, float] | None:
+        """The fleet's tightest published adoption horizon, as
+        (lease_timeout, published_at_wall_seconds), or None if no rescanner
+        ever published. Each publisher writes its horizon under a
+        value-keyed field via setnx (see publish_lease_timeout), so the
+        minimum over fields is exact under any concurrent interleaving —
+        there is no read-modify-write to race on."""
+        entries = self.store.hgetall(LEASE_CONF_KEY)
+        best: tuple[float, float] | None = None
+        for fld, stamp in entries.items():
+            if not fld.startswith("t:"):
+                continue
+            try:
+                value, published = float(fld[2:]), float(stamp)
+            except ValueError:
+                continue
+            if value > 0 and (best is None or value < best[0]):
+                best = (value, published)
+        return best
+
+    def refresh_lease_renew_period(self) -> None:
+        """Fold the fleet's published minimum lease_timeout into this
+        dispatcher's renew cadence: renew at timeout/3 when that is tighter
+        than the current period, so a live owner can miss two renewals
+        before any rescanner's adoption horizon. Monotonically tightening —
+        a rescanner leaving the fleet never re-slackens siblings (extra
+        renewals are cheap; a missed adoption window is not)."""
+        try:
+            conf = self.read_fleet_lease_conf()
+        except STORE_OUTAGE_ERRORS:
+            return  # next renewal retries
+        self._fleet_lease_conf = conf
+        if conf is not None:
+            self.lease_renew_period = min(
+                self.lease_renew_period, conf[0] / 3.0
+            )
+
+    def publish_lease_timeout(self, lease_timeout: float) -> None:
+        """Announce this rescanner's adoption horizon fleet-wide. Each
+        distinct value gets its own write-once field ("t:<value>" ->
+        publication wall time, setnx): concurrent publishers of different
+        values both land and readers take the min, so the fleet converges
+        on the tightest horizon under any interleaving (a lost-update race
+        on a single shared field could leave the LARGER value standing).
+        The setnx also pins each value's FIRST publication time, which
+        read_fleet_lease_conf exposes for the adoption grace window."""
+        field = f"t:{float(lease_timeout)!r}"
+        self.store.setnx_field(LEASE_CONF_KEY, field, repr(time.time()))
+        self.refresh_lease_renew_period()
 
     def fetch_reclaim(self, task_id: str, retries: int) -> PendingTask | None:
         """Rebuild a PendingTask for a task reclaimed from a dead worker.

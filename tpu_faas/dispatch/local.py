@@ -127,11 +127,16 @@ class LocalDispatcher(TaskDispatcher):
                 while self._drain_one():
                     completed += 1
                     progressed = True
-                if self.shared and (
-                    time.monotonic() - last_renew >= self.LEASE_RENEW_PERIOD
+                if (self._running or self.shared) and (
+                    time.monotonic() - last_renew >= self.lease_renew_period
                 ):
-                    # keep our claims + in-pool tasks from being adopted by
-                    # sibling dispatchers (liveness heartbeat rides along)
+                    # keep in-pool tasks from being adopted: EVERY mode
+                    # renews (base.py LEASE_RENEW_PERIOD invariant) — an
+                    # unshared local dispatcher can still share a store with
+                    # a tpu-push rescanner, and a task running past
+                    # lease_timeout would be adopted and re-executed. In
+                    # shared mode the renewal also rides as the liveness
+                    # heartbeat, so it runs even while idle.
                     try:
                         self.renew_leases(self._running)
                     except STORE_OUTAGE_ERRORS as exc:

@@ -167,7 +167,7 @@ class PullDispatcher(TaskDispatcher):
                     self.flush_deferred_results()
                 try:
                     self._purge_dead_workers()
-                    if self.clock() - last_renew >= self.LEASE_RENEW_PERIOD and (
+                    if self.clock() - last_renew >= self.lease_renew_period and (
                         self.inflight or self.shared
                     ):
                         # shared mode renews even while idle: the liveness

@@ -391,7 +391,7 @@ class PushDispatcher(TaskDispatcher):
                     if self.deferred_results:
                         self.flush_deferred_results()
                     now = time.monotonic()
-                    if now - last_renew >= self.LEASE_RENEW_PERIOD:
+                    if now - last_renew >= self.lease_renew_period:
                         inflight = [
                             tid
                             for rec in self.workers.values()

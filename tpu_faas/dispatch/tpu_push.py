@@ -128,16 +128,49 @@ class TpuPushDispatcher(TaskDispatcher):
         #: rescan period, whichever is tighter, so a live owner can miss
         #: two renewals before its tasks become adoptable.
         self.lease_timeout = lease_timeout
-        self._lease_renew_period = min(
-            max(rescan_period, 1.0), lease_timeout / 3.0
+        self.lease_renew_period = min(
+            self.lease_renew_period, max(rescan_period, 1.0),
+            lease_timeout / 3.0,
         )
         self._last_lease_renew = self.clock()
         self._rescan_count = 0
         self._warned_priority = False
         if recover_queued:
+            # this process will ADOPT tasks whose lease exceeds
+            # lease_timeout: tell the fleet, so push/pull/local siblings
+            # renewing at the default 10 s cadence tighten to timeout/3
+            # instead of having live tasks adopted between renewals
+            try:
+                self.publish_lease_timeout(self.lease_timeout)
+            except STORE_OUTAGE_ERRORS as exc:
+                self.note_store_outage(exc, pause=0)
             self._recover_stranded()
 
     # -- stranded-task recovery (capability the reference lacks) -----------
+    def _adoption_horizon(self) -> float:
+        """Staleness horizon for THIS scan's adoption decisions.
+
+        Right after a tighter lease_timeout is FIRST published, siblings
+        may still be renewing at their previous cadence — a stamp can be a
+        full old renew period (default 10 s) old on a perfectly live owner.
+        Adopting against the tight horizon inside that window would steal
+        live owners' tasks (double execution), so until one old-cadence
+        renewal has elapsed since the publication, the horizon is floored
+        at 2.5x LEASE_RENEW_PERIOD (enough for a live owner to miss one
+        renewal and still be safe). After the window the published horizon
+        applies unmodified. The publication time comes from the store
+        (value-keyed setnx, read_fleet_lease_conf), so concurrently
+        started rescanners share one window instead of each opening a
+        fresh one."""
+        conf = self._fleet_lease_conf
+        if conf is not None:
+            _, published = conf
+            if time.time() - published < 1.25 * self.LEASE_RENEW_PERIOD:
+                return max(
+                    self.lease_timeout, 2.5 * self.LEASE_RENEW_PERIOD
+                )
+        return self.lease_timeout
+
     def _recover_stranded(self) -> None:
         """Scan the store for QUEUED tasks whose announce was lost and adopt
         them as pending. Runs at startup (announce published while no
@@ -153,6 +186,7 @@ class TpuPushDispatcher(TaskDispatcher):
         subscription while a rescan adopts the same QUEUED task — is closed
         by the pending-id check at intake (tick())."""
         a = self.arrays
+        horizon = self._adoption_horizon()
         known = {t.task_id for t in self.pending}
         known.update(t.task_id for t in self._unclaimed)
         # tasks whose (terminal) writes sit in the deferred buffer still read
@@ -216,7 +250,7 @@ class TpuPushDispatcher(TaskDispatcher):
             stale_leases = [
                 key
                 for key, lease in zip(running, leases)
-                if self._lease_age(lease, now_wall) > self.lease_timeout
+                if self._lease_age(lease, now_wall) > horizon
             ]
             if stale_leases:
                 # prior generations' reclaim counts (persisted on each
@@ -235,7 +269,7 @@ class TpuPushDispatcher(TaskDispatcher):
         alive: set[str] = set()
         claims0: dict[str, str | None] = {}
         if self.shared:
-            alive = self.read_live_dispatchers(self.lease_timeout)
+            alive = self.read_live_dispatchers(horizon)
             queued_keys = [
                 key
                 for key, status in zip(candidates, statuses)
@@ -261,7 +295,7 @@ class TpuPushDispatcher(TaskDispatcher):
                             continue  # a live sibling's task: hands off
                         if (
                             self.claim_age(claim, time.time())
-                            <= self.lease_timeout
+                            <= horizon
                         ):
                             # claim too fresh to steal: its owner may have
                             # just started (heartbeat not yet visible) or
@@ -271,7 +305,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     # claimed-by-the-dead -> arbitrate adoption gen 1
                     generation = 0 if owner is None else 1
                     if not self.claim_adoption(
-                        key, generation, self.lease_timeout, alive=alive
+                        key, generation, horizon, alive=alive
                     ):
                         continue  # another adopter won this task
                 fields = self.store.hgetall(key)
@@ -289,7 +323,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 # among sibling dispatchers, exactly one wins this reclaim
                 # generation (single-dispatcher mode always wins)
                 if not self.claim_adoption(
-                    key, expired[key] + 1, self.lease_timeout, alive=alive
+                    key, expired[key] + 1, horizon, alive=alive
                 ):
                     continue
                 # adopt with the persisted count bumped: the dispatch path
@@ -595,7 +629,7 @@ class TpuPushDispatcher(TaskDispatcher):
                         last_rescan = self.clock()
                     if (
                         self.clock() - self._last_lease_renew
-                        >= self._lease_renew_period
+                        >= self.lease_renew_period
                     ):
                         self._renew_leases()
                         self._last_lease_renew = self.clock()

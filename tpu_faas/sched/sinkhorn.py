@@ -510,7 +510,7 @@ def sinkhorn_placement_bucketed(
     loga = jnp.where(a > 0, jnp.log(jnp.maximum(a, 1e-30)), -inf)
     logb = jnp.where(b > 0, jnp.log(jnp.maximum(b, 1e-30)), -inf)
 
-    _, g = _sinkhorn_fg(loga, logb, negc, tau, n_iters)
+    f_b, g = _sinkhorn_fg(loga, logb, negc, tau, n_iters)
 
     # -- streamed per-task recovery + candidates ---------------------------
     n_chunks = -(-T // chunk)
@@ -549,7 +549,13 @@ def sinkhorn_placement_bucketed(
     # err would be vacuously ~0 even after a single iteration — what an
     # unconverged (or over-quantized) run actually violates is the column
     # marginals. Relative per open column, capped by b>=1 task-units.
-    col_total = col_sums.sum(axis=0)  # [W+1], plan mass per column
+    # The streamed chunks cover only the TASK rows; with excess fleet
+    # capacity (total_cap > n_tasks) the slack ROW carries the remaining
+    # column mass — omit it and a perfectly converged run reads err ~1.0.
+    # Its per-column plan mass is exp(negc[K] + (f_K + g)/tau) (negc is
+    # already -cost/tau; row K is 0 at open workers, -inf elsewhere).
+    slack_row_mass = jnp.exp(negc[K] + (f_b[K] + g) / tau)  # [W+1]
+    col_total = col_sums.sum(axis=0) + slack_row_mass  # plan mass per col
     col_err = jnp.max(
         jnp.where(b > 0, jnp.abs(col_total - b) / jnp.maximum(b, 1.0), 0.0)
     )

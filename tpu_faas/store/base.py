@@ -51,6 +51,18 @@ LIVE_INDEX_KEY = "tasks:index"
 #: heartbeat here has gone stale (a merely-overloaded sibling keeps
 #: renewing and keeps its claims).
 DISPATCHERS_KEY = "dispatchers:alive"
+#: Fleet-wide lease configuration. Each rescanning dispatcher publishes its
+#: adoption horizon as a write-once field "t:<lease_timeout>" -> wall time
+#: of first publication (setnx); the fleet's effective horizon is the MIN
+#: over fields (value-keyed so concurrent publishers can't lose updates to
+#: each other). Every dispatcher mode folds it into its lease-renew cadence
+#: (renew at timeout/3 when that is tighter than the default
+#: LEASE_RENEW_PERIOD), and rescanners grace-floor adoptions briefly after
+#: a value first appears. Without this, a mixed fleet where a rescanner
+#: runs ``--lease-timeout`` at or below ~2-3x the siblings' fixed renew
+#: period would adopt tasks whose owner is alive but between renewals —
+#: double execution.
+LEASE_CONF_KEY = "fleet:lease_conf"
 #: Results channel: finish_task announces every terminal write here so the
 #: gateway can wake parked /result long-polls instantly instead of polling
 #: the store. No reference analog (its clients poll, SURVEY §3.1); the
