@@ -51,3 +51,86 @@ else:
         f"requested platform {_platform!r} but fell back to CPU"
     )
 # on real hardware the mesh tests skip themselves if devices are scarce
+
+
+# -- suite-level orphan detection ------------------------------------------
+# The e2e tests SIGKILL workers/dispatchers constantly; a teardown bug that
+# orphans their multiprocessing helpers (resource_tracker, forkserver, pool
+# children) to pid 1 poisons the BOX, not just the run — each orphan burns
+# ~2.4% CPU forever and accumulated orphans once drove load past 19 and
+# flaked the scale tests. The leak was fixed at the source (process-group
+# spawns + group kills); this fixture keeps it fixed.
+
+
+# Unique per-session marker, inherited (and therefore visible in
+# /proc/<pid>/environ, which snapshots the EXEC-time environment) by every
+# child this suite spawns. Scopes the orphan check to processes this
+# session actually owns — a concurrent pytest session's helpers or a
+# developer's daemonized tpu_faas service on the same box must be neither
+# counted nor killed.
+_SESSION_MARKER = f"TPU_FAAS_TEST_SESSION={os.getpid()}-{os.urandom(4).hex()}"
+_mk, _, _mv = _SESSION_MARKER.partition("=")
+os.environ[_mk] = _mv
+
+
+def _orphan_pids() -> dict[int, str]:
+    """PID-1-parented processes carrying this session's env marker."""
+    marker = _SESSION_MARKER.encode()
+    orphans: dict[int, str] = {}
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        try:
+            with open(f"/proc/{pid}/stat", "rb") as f:
+                stat = f.read().decode("ascii", "replace")
+            # ppid is the 2nd field after the parenthesized comm (which may
+            # itself contain spaces/parens — split on the LAST ')')
+            ppid = int(stat.rsplit(")", 1)[1].split()[1])
+            if ppid != 1:
+                continue
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read()
+            if marker not in env.split(b"\x00"):
+                continue
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\x00", b" ").decode(
+                    "utf-8", "replace"
+                ).strip()
+            orphans[pid] = cmd
+        except (OSError, ValueError, IndexError):
+            continue  # process vanished mid-scan, or unreadable
+    return orphans
+
+
+import time as _time
+
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _no_orphaned_children():
+    before = set(_orphan_pids())
+    yield
+    # grace for children still winding down at session end
+    deadline = _time.monotonic() + 10
+    while True:
+        leaked = {
+            p: c for p, c in _orphan_pids().items() if p not in before
+        }
+        if not leaked:
+            return
+        if _time.monotonic() > deadline:
+            break
+        _time.sleep(0.5)
+    # sweep so one bad run doesn't poison the next, then fail loudly
+    for pid in leaked:
+        try:
+            os.kill(pid, 9)
+        except OSError:
+            pass
+    raise AssertionError(
+        f"suite leaked {len(leaked)} orphaned child processes "
+        f"(killed them just now):\n"
+        + "\n".join(f"  {p}: {c}" for p, c in leaked.items())
+    )

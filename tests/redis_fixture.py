@@ -1,0 +1,248 @@
+"""A Redis-reply-faithful RESP2 responder for compatibility tests.
+
+The drop-in-Redis claim (store/client.py:1-11) needs exercising even on
+hosts without a redis-server binary. This module implements the command
+subset the store client uses with REAL Redis's reply semantics — the
+places where a sloppy server would differ and our client must not care:
+
+- HSET replies ``:<number of NEW fields>`` (not ``+OK``)
+- HSETNX replies ``:1``/``:0``
+- HGETALL on a missing key replies ``*0`` (not nil)
+- HMGET on a missing key replies all-nils
+- HDEL/DEL reply with removal counts; a hash emptied by HDEL is deleted
+  (KEYS reflects it)
+- SUBSCRIBE pushes ``*3 [subscribe, <channel>, :1]``; published messages
+  arrive as ``*3 [message, <channel>, <payload>]``; PUBLISH replies with
+  the receiver count
+- command names are case-insensitive; unknown commands get ``-ERR``
+
+Reply framing is authored against the RESP2 spec and verified manually
+against redis-server 7.x behavior (the reference's redis-py dependency
+talks to exactly these shapes). Threaded blocking sockets; command
+pipelining falls out of sequential per-connection processing.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from tpu_faas.store import resp
+
+
+class RedisSemanticsServer:
+    """Threaded TCP server speaking the Redis subset with authentic replies."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._hashes: dict[str, dict[str, str]] = {}
+        self._subs: dict[socket.socket, set[str]] = {}
+        self._lock = threading.RLock()
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(32)
+        self.host, self.port = self._listener.getsockname()
+        self._stopping = False
+        self._conns: list[socket.socket] = []
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"resp://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            for c in self._conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    # -- plumbing ----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        parser = resp.RespParser()
+        try:
+            while not self._stopping:
+                try:
+                    data = conn.recv(65536)
+                except OSError:
+                    break
+                if not data:
+                    break
+                parser.feed(data)
+                out = []
+                while True:
+                    cmd = parser.pop()
+                    if cmd is resp.NEED_MORE:
+                        break
+                    out.append(self._dispatch(conn, cmd))
+                if out:
+                    try:
+                        conn.sendall(b"".join(out))
+                    except OSError:
+                        break
+        finally:
+            with self._lock:
+                self._subs.pop(conn, None)
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- command dispatch with real-Redis reply shapes ---------------------
+    def _dispatch(self, conn: socket.socket, cmd) -> bytes:
+        if not isinstance(cmd, list) or not cmd:
+            return resp.encode_error("protocol error")
+        name, args = cmd[0].upper(), cmd[1:]
+        with self._lock:
+            handler = getattr(self, f"_cmd_{name.lower()}", None)
+            if handler is None:
+                first = args[0] if args else ""
+                return (
+                    b"-ERR unknown command '" + name.encode()
+                    + b"', with args beginning with: '"
+                    + str(first).encode() + b"'\r\n"
+                )
+            return handler(conn, args)
+
+    def _cmd_ping(self, conn, args) -> bytes:
+        if args:
+            return resp.encode_bulk(args[0])
+        return b"+PONG\r\n"
+
+    def _cmd_hset(self, conn, args) -> bytes:
+        key, flat = args[0], args[1:]
+        if not flat or len(flat) % 2:
+            return (
+                b"-ERR wrong number of arguments for 'hset' command\r\n"
+            )
+        h = self._hashes.setdefault(key, {})
+        added = 0
+        for f, v in zip(flat[0::2], flat[1::2]):
+            added += f not in h
+            h[f] = v
+        return resp.encode_integer(added)
+
+    def _cmd_hsetnx(self, conn, args) -> bytes:
+        key, f, v = args
+        h = self._hashes.setdefault(key, {})
+        if f in h:
+            return resp.encode_integer(0)
+        h[f] = v
+        return resp.encode_integer(1)
+
+    def _cmd_hget(self, conn, args) -> bytes:
+        key, f = args
+        return resp.encode_bulk(self._hashes.get(key, {}).get(f))
+
+    def _cmd_hgetall(self, conn, args) -> bytes:
+        h = self._hashes.get(args[0], {})
+        items = []
+        for f, v in h.items():
+            items.append(resp.encode_bulk(f))
+            items.append(resp.encode_bulk(v))
+        return resp.encode_array(items)
+
+    def _cmd_hmget(self, conn, args) -> bytes:
+        key, fields = args[0], args[1:]
+        h = self._hashes.get(key, {})
+        return resp.encode_array(
+            [resp.encode_bulk(h.get(f)) for f in fields]
+        )
+
+    def _cmd_hdel(self, conn, args) -> bytes:
+        key, fields = args[0], args[1:]
+        h = self._hashes.get(key)
+        if h is None:
+            return resp.encode_integer(0)
+        removed = 0
+        for f in fields:
+            removed += h.pop(f, None) is not None
+        if not h:
+            del self._hashes[key]  # redis deletes empty hashes
+        return resp.encode_integer(removed)
+
+    def _cmd_del(self, conn, args) -> bytes:
+        removed = 0
+        for key in args:
+            removed += self._hashes.pop(key, None) is not None
+        return resp.encode_integer(removed)
+
+    def _cmd_exists(self, conn, args) -> bytes:
+        return resp.encode_integer(
+            sum(key in self._hashes for key in args)
+        )
+
+    def _cmd_keys(self, conn, args) -> bytes:
+        if args[0] != "*":
+            return resp.encode_error("only KEYS * is modeled")
+        return resp.encode_array(
+            [resp.encode_bulk(k) for k in self._hashes]
+        )
+
+    def _cmd_flushdb(self, conn, args) -> bytes:
+        self._hashes.clear()
+        return b"+OK\r\n"
+
+    def _cmd_info(self, conn, args) -> bytes:
+        body = (
+            "# Server\r\nredis_version:7.2.4\r\n"
+            "# Keyspace\r\n"
+            f"db0:keys={len(self._hashes)},expires=0\r\n"
+        )
+        return resp.encode_bulk(body)
+
+    def _cmd_subscribe(self, conn, args) -> bytes:
+        chans = self._subs.setdefault(conn, set())
+        out = []
+        for ch in args:
+            chans.add(ch)
+            out.append(
+                resp.encode_array(
+                    [
+                        resp.encode_bulk("subscribe"),
+                        resp.encode_bulk(ch),
+                        resp.encode_integer(len(chans)),
+                    ]
+                )
+            )
+        return b"".join(out)
+
+    def _cmd_publish(self, conn, args) -> bytes:
+        ch, payload = args
+        push = resp.encode_array(
+            [
+                resp.encode_bulk("message"),
+                resp.encode_bulk(ch),
+                resp.encode_bulk(payload),
+            ]
+        )
+        n = 0
+        for sub_conn, chans in list(self._subs.items()):
+            if ch in chans:
+                try:
+                    sub_conn.sendall(push)
+                    n += 1
+                except OSError:
+                    self._subs.pop(sub_conn, None)
+        return resp.encode_integer(n)

@@ -122,3 +122,30 @@ def test_adoption_horizon_grace_window_after_fresh_publication():
         assert d._adoption_horizon() == 2.0
     finally:
         d.socket.close(0)
+
+
+def test_lease_conf_republished_after_store_data_loss():
+    """A store that comes back without LEASE_CONF_KEY (crash without
+    snapshot, FLUSHDB) must not permanently silence the tight horizon:
+    every rescan re-issues the idempotent publish, and the recreated key
+    re-opens the grace window so siblings re-tighten before adoptions
+    resume."""
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.store.base import LEASE_CONF_KEY
+
+    store = MemoryStore()
+    d = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=store, max_workers=4, max_pending=8,
+        max_inflight=8, lease_timeout=2.0,
+    )
+    try:
+        assert d.read_fleet_lease_conf() is not None
+        store.delete(LEASE_CONF_KEY)  # simulated data loss
+        assert d.read_fleet_lease_conf() is None
+        d._recover_stranded()  # any later rescan republishes
+        conf = d.read_fleet_lease_conf()
+        assert conf is not None and conf[0] == 2.0
+        # fresh publication time -> the grace floor applies again
+        assert d._adoption_horizon() == 2.5 * d.LEASE_RENEW_PERIOD
+    finally:
+        d.socket.close(0)

@@ -1,10 +1,21 @@
-"""The store client against a REAL Redis server (skip-if-absent).
+"""The store client against Redis: real server when installed, protocol
+fixtures everywhere.
 
 store/client.py:1-11 promises the RESP client speaks a strict subset of the
-Redis protocol so a real Redis drops in for the bundled servers. This suite
-backs that claim with an actual redis-server when one is installed on the
-host; environments without the binary skip (the claim is then exercised
-only against the two in-repo servers, which implement the same subset).
+Redis protocol so a real Redis drops in for the bundled servers. Two layers
+back the claim:
+
+1. The full task-store contract runs against a backend parametrization that
+   always includes :class:`tests.redis_fixture.RedisSemanticsServer` — a
+   responder with REAL Redis's reply shapes (integer HSET replies, ``*0``
+   HGETALL on missing keys, pub/sub push frames, case-insensitive names) —
+   and additionally against an actual redis-server when one is installed
+   (the parameter is only generated when the binary exists, so environments
+   without it run the fixture backend with zero skips).
+2. Byte-level wire pins: `encode_command` must emit the exact request bytes
+   redis-server parses, and `RespParser` must decode authentic Redis reply
+   bytes — including nil bulks/arrays, empty bulks, pushed message frames,
+   errors, and replies split at arbitrary byte boundaries.
 """
 
 from __future__ import annotations
@@ -16,17 +27,13 @@ import time
 
 import pytest
 
+from tpu_faas.store import resp
 from tpu_faas.store.launch import make_store
 
 REDIS = shutil.which("redis-server")
 
-pytestmark = pytest.mark.skipif(
-    REDIS is None, reason="redis-server not installed on this host"
-)
 
-
-@pytest.fixture()
-def redis_url():
+def _real_redis_server():
     sock = socket.socket()
     sock.bind(("127.0.0.1", 0))
     port = sock.getsockname()[1]
@@ -36,28 +43,49 @@ def redis_url():
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
     )
-    try:
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
-            try:
-                s = make_store(f"resp://127.0.0.1:{port}")
-                if s.ping():
-                    s.close()
-                    break
-            except OSError:
-                time.sleep(0.05)
-        else:
-            raise RuntimeError("redis-server did not come up")
-        yield f"resp://127.0.0.1:{port}"
-    finally:
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            s = make_store(f"resp://127.0.0.1:{port}")
+            if s.ping():
+                s.close()
+                break
+        except OSError:
+            time.sleep(0.05)
+    else:
         proc.kill()
-        proc.wait()
+        raise RuntimeError("redis-server did not come up")
+    return proc, f"resp://127.0.0.1:{port}"
 
 
-def test_store_contract_against_real_redis(redis_url):
+# "real" is only a parameter when the binary exists: the contract must
+# execute (not skip) in every environment, via the fixture backend
+BACKENDS = ["fixture"] + (["real"] if REDIS else [])
+
+
+@pytest.fixture(params=BACKENDS)
+def redis_url(request):
+    if request.param == "real":
+        proc, url = _real_redis_server()
+        try:
+            yield url
+        finally:
+            proc.kill()
+            proc.wait()
+    else:
+        from tests.redis_fixture import RedisSemanticsServer
+
+        server = RedisSemanticsServer()
+        try:
+            yield server.url
+        finally:
+            server.stop()
+
+
+def test_store_contract_against_redis(redis_url):
     """The full task-store contract — create/announce, status, idempotent
     claims, finish+wake, live index, TTL-sweeper primitives — against
-    stock Redis."""
+    Redis reply semantics."""
     from tpu_faas.store.base import LIVE_INDEX_KEY
 
     s = make_store(redis_url)
@@ -92,6 +120,9 @@ def test_store_contract_against_real_redis(redis_url):
         ]
         s.hset_many([("t2", {"lease_at": "1.0"}), ("t3", {"lease_at": "2.0"})])
         assert s.hmget("t2", ["status", "lease_at"]) == ["QUEUED", "1.0"]
+        # missing key/fields: all-nil array, not an error
+        assert s.hmget("nope", ["a", "b"]) == [None, None]
+        assert s.hgetall("nope") == {}
 
         # terminal write: result + wake + index removal in one round trip
         s.finish_task("t1", "COMPLETED", "RES")
@@ -109,8 +140,9 @@ def test_store_contract_against_real_redis(redis_url):
         s.close()
 
 
-def test_local_dispatch_e2e_against_real_redis(redis_url):
-    """A local dispatcher serving real traffic out of stock Redis."""
+def test_local_dispatch_e2e_against_redis(redis_url):
+    """A local dispatcher serving real traffic out of a Redis-semantics
+    store."""
     import threading
 
     from tpu_faas.core.serialize import deserialize, serialize
@@ -144,3 +176,86 @@ def test_local_dispatch_e2e_against_real_redis(redis_url):
         disp.stop()
         t.join(timeout=10)
         gw.stop()
+
+
+# -- byte-level wire pins ---------------------------------------------------
+
+def test_encode_command_exact_request_bytes():
+    """Requests must be byte-identical to what redis-server parses: arrays
+    of bulk strings with exact length prefixes (binary payloads counted in
+    BYTES, not characters)."""
+    assert resp.encode_command("PING") == b"*1\r\n$4\r\nPING\r\n"
+    assert resp.encode_command("HSET", "k", "f", "v") == (
+        b"*4\r\n$4\r\nHSET\r\n$1\r\nk\r\n$1\r\nf\r\n$1\r\nv\r\n"
+    )
+    # integers are sent as bulk strings of their decimal form
+    assert resp.encode_command("DEL", 42) == b"*2\r\n$3\r\nDEL\r\n$2\r\n42\r\n"
+    # utf-8 payloads: $-length counts bytes
+    assert resp.encode_command("HSET", "k", "f", "é") == (
+        b"*4\r\n$4\r\nHSET\r\n$1\r\nk\r\n$1\r\nf\r\n$2\r\n\xc3\xa9\r\n"
+    )
+
+
+# authentic redis-server reply bytes -> expected decoded value
+WIRE_REPLIES = [
+    (b"+PONG\r\n", "PONG"),
+    (b"+OK\r\n", "OK"),
+    (b":0\r\n", 0),
+    (b":1\r\n", 1),
+    (b":-1\r\n", -1),
+    (b"$-1\r\n", None),  # nil bulk (HGET miss)
+    (b"$0\r\n\r\n", ""),  # empty bulk
+    (b"$5\r\nhello\r\n", "hello"),
+    (b"$7\r\na\r\nb\r\nc\r\n", "a\r\nb\r\nc"),  # CRLF inside a bulk body
+    (b"*0\r\n", []),  # HGETALL miss
+    (b"*-1\r\n", None),  # nil array (BLPOP timeout style)
+    (b"*2\r\n$1\r\nf\r\n$1\r\nv\r\n", ["f", "v"]),
+    (b"*3\r\n$1\r\na\r\n$-1\r\n$1\r\nc\r\n", ["a", None, "c"]),  # HMGET
+    (  # SUBSCRIBE confirmation push
+        b"*3\r\n$9\r\nsubscribe\r\n$5\r\ntasks\r\n:1\r\n",
+        ["subscribe", "tasks", 1],
+    ),
+    (  # published message push
+        b"*3\r\n$7\r\nmessage\r\n$5\r\ntasks\r\n$2\r\nt9\r\n",
+        ["message", "tasks", "t9"],
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "wire,expected",
+    WIRE_REPLIES,
+    ids=[w[:16].decode("ascii", "replace").replace("\r\n", "~") for w, _ in WIRE_REPLIES],
+)
+def test_parser_decodes_authentic_reply_bytes(wire, expected):
+    p = resp.RespParser()
+    p.feed(wire)
+    assert p.pop() == expected
+    assert p.pop() is resp.NEED_MORE
+    assert p.pending() == 0
+
+
+def test_parser_decodes_error_reply():
+    p = resp.RespParser()
+    p.feed(b"-ERR unknown command 'FOO', with args beginning with: \r\n")
+    err = p.pop()
+    assert isinstance(err, resp.RespError)
+    assert "unknown command" in str(err)
+
+
+def test_parser_handles_arbitrary_split_boundaries():
+    """TCP gives no framing guarantees: a pipelined reply stream fed one
+    byte at a time must decode identically."""
+    stream = b"".join(w for w, _ in WIRE_REPLIES)
+    expected = [e for _, e in WIRE_REPLIES]
+    p = resp.RespParser()
+    got = []
+    for i in range(len(stream)):
+        p.feed(stream[i : i + 1])
+        while True:
+            item = p.pop()
+            if item is resp.NEED_MORE:
+                break
+            got.append(item)
+    assert got == expected
+    assert p.pending() == 0

@@ -186,6 +186,15 @@ class TpuPushDispatcher(TaskDispatcher):
         subscription while a rescan adopts the same QUEUED task — is closed
         by the pending-id check at intake (tick())."""
         a = self.arrays
+        # Re-publish every pass (one idempotent setnx): a startup outage
+        # that swallowed the constructor's publish, or a store that came
+        # back without LEASE_CONF_KEY (crash without snapshot, FLUSHDB),
+        # would otherwise leave the fleet renewing at the slack default
+        # while this scan adopts at the tight horizon. setnx preserves the
+        # FIRST publication time, so an already-published value does not
+        # re-open the grace window — but a recreated key does, giving
+        # siblings time to re-tighten before adoptions resume.
+        self.publish_lease_timeout(self.lease_timeout)
         horizon = self._adoption_horizon()
         known = {t.task_id for t in self.pending}
         known.update(t.task_id for t in self._unclaimed)
