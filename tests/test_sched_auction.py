@@ -70,3 +70,153 @@ def test_auction_excess_tasks_admitted_by_arrival():
 def test_auction_no_capacity():
     _, a, _ = _run([1.0, 1.0], [1.0, 1.0], [0, 0], [True, True])
     assert (a == -1).all()
+
+
+def _warm_run(p, max_slots, eps, init_price):
+    res = auction_placement(
+        p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+        p.worker_live, max_slots=max_slots, eps=eps, init_price=init_price,
+    )
+    return np.asarray(res.assignment), int(res.n_rounds), res.prices
+
+
+def test_auction_warm_start_converges_faster_and_stays_optimal():
+    """Steady-state dispatcher model: consecutive ticks solve similar
+    problems; warm prices must cut rounds sharply without costing
+    optimality (the n*eps bound holds for any initial prices)."""
+    rng = np.random.default_rng(11)
+    n_tasks, n_workers, max_slots, eps = 48, 12, 4, 1e-4
+    speeds = rng.uniform(0.5, 4.0, n_workers).astype(np.float32)
+    free = np.full(n_workers, max_slots, dtype=np.int32)
+    live = np.ones(n_workers, dtype=bool)
+    sizes = rng.uniform(0.5, 8.0, n_tasks).astype(np.float32)
+
+    p0 = PlacementProblem.build(sizes, speeds, free, live)
+    res0 = auction_placement(
+        p0.task_size, p0.task_valid, p0.worker_speed, p0.worker_free,
+        p0.worker_live, max_slots=max_slots, eps=eps,
+    )
+    cold_rounds = int(res0.n_rounds)
+
+    # next tick: same fleet, slightly perturbed task sizes (a realistic
+    # tick-over-tick delta), warm-started from last tick's prices
+    sizes2 = (sizes * (1.0 + rng.uniform(-0.01, 0.01, n_tasks))).astype(
+        np.float32
+    )
+    p1 = PlacementProblem.build(sizes2, speeds, free, live)
+    a1, warm_rounds, _ = _warm_run(p1, max_slots, eps, res0.prices)
+
+    check_assignment(
+        a1, np.asarray(p1.task_valid), np.asarray(p1.worker_free),
+        np.asarray(p1.worker_live),
+    )
+    assert (a1[:n_tasks] >= 0).all()
+    cost_warm = float(np.sum(sizes2[: n_tasks] / speeds[a1[:n_tasks]]))
+    _, cost_opt = optimal_assignment(sizes2, speeds, free, live, max_slots)
+    assert cost_warm <= cost_opt + n_tasks * eps * 10 + 1e-3
+    assert warm_rounds < cold_rounds, (warm_rounds, cold_rounds)
+
+
+def test_auction_warm_start_from_garbage_prices_strands_then_recovers():
+    """Adversarial starting prices may exhaust the warm round budget; the
+    kernel must keep the partial assignment LEGAL, raise `stranded`, and a
+    cold re-solve (what SchedulerArrays does on seeing the flag) completes.
+    """
+    rng = np.random.default_rng(13)
+    sizes = rng.uniform(0.5, 5.0, 30).astype(np.float32)
+    speeds = rng.uniform(0.5, 4.0, 8).astype(np.float32)
+    free = np.full(8, 4, dtype=np.int32)
+    live = np.ones(8, dtype=bool)
+    p = PlacementProblem.build(sizes, speeds, free, live)
+    S = p.worker_speed.shape[0] * 4
+    garbage = np.asarray(rng.uniform(0.0, 50.0, S), dtype=np.float32)
+    import jax.numpy as jnp
+
+    res = auction_placement(
+        p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+        p.worker_live, max_slots=4, eps=1e-4,
+        init_price=jnp.asarray(garbage),
+    )
+    a = np.asarray(res.assignment)
+    check_assignment(
+        a, np.asarray(p.task_valid), np.asarray(p.worker_free),
+        np.asarray(p.worker_live),
+    )
+    complete = (a >= 0).sum() == min(30, int(free.sum()))
+    assert complete or bool(res.stranded)
+    if bool(res.stranded):
+        cold = auction_placement(
+            p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+            p.worker_live, max_slots=4, eps=1e-4,
+        )
+        ac = np.asarray(cold.assignment)
+        assert (ac >= 0).sum() == min(30, int(free.sum()))
+        assert not bool(cold.stranded)
+
+
+def test_scheduler_arrays_resets_prices_after_stranding(monkeypatch):
+    """Product path: a stranded warm tick makes the NEXT tick re-solve
+    cold (init_price=None), so tasks never stay queued more than one extra
+    tick. A spy on the packed-tick entry records the price argument each
+    tick actually ran with — asserting on attributes alone could not
+    detect a removed reset, since every auction tick repopulates them."""
+    import jax.numpy as jnp
+
+    from tpu_faas.sched import state as state_mod
+    from tpu_faas.sched.state import SchedulerArrays
+
+    price_args = []
+    real = state_mod._packed_tick
+
+    def spy(packed, n_valid, ws, wa, pl, iw, tte, prio, price, **kw):
+        price_args.append(price)
+        return real(packed, n_valid, ws, wa, pl, iw, tte, prio, price, **kw)
+
+    monkeypatch.setattr(state_mod, "_packed_tick", spy)
+
+    rng = np.random.default_rng(19)
+    arr = SchedulerArrays(
+        max_workers=8, max_pending=64, max_slots=4, placement="auction",
+        clock=lambda: 100.0,
+    )
+    for i in range(6):
+        arr.register(b"w%d" % i, 4, speed=float(1.0 + i % 3))
+    sizes = rng.uniform(0.5, 5.0, 24).astype(np.float32)
+    arr.tick(sizes)  # cold: seeds warm prices
+    assert price_args[0] is None
+    # force the stranded flag (as a warm tick whose budget ran out would)
+    arr._d_auction_stranded = jnp.asarray(True)
+    out = arr.tick(sizes)
+    # the reset must have made THIS tick cold again
+    assert price_args[1] is None
+    a = np.asarray(out.assignment)
+    assert (a >= 0).sum() == min(24, 6 * 4)
+    # and an un-stranded tick warm-starts from the previous prices
+    arr.tick(sizes)
+    assert price_args[2] is not None
+
+
+def test_scheduler_arrays_auction_carries_prices_across_ticks():
+    """The product path: SchedulerArrays(placement='auction') feeds each
+    tick's prices into the next (device-resident warm start)."""
+    from tpu_faas.sched.state import SchedulerArrays
+
+    rng = np.random.default_rng(17)
+    arr = SchedulerArrays(
+        max_workers=8, max_pending=64, max_slots=4, placement="auction",
+        clock=lambda: 100.0,
+    )
+    for i in range(6):
+        arr.register(b"w%d" % i, 4, speed=float(1.0 + i % 3))
+    assert arr._d_auction_price is None
+    sizes = rng.uniform(0.5, 5.0, 40).astype(np.float32)
+    out1 = arr.tick(sizes)
+    assert arr._d_auction_price is not None
+    a1 = np.asarray(out1.assignment)
+    assert (a1 >= 0).sum() == min(40, 6 * 4)
+    # second tick warm-starts; placement stays legal and complete
+    out2 = arr.tick(sizes * 1.01)
+    a2 = np.asarray(out2.assignment)
+    assert (a2 >= 0).sum() == min(40, 6 * 4)
+    used, counts = np.unique(a2[a2 >= 0], return_counts=True)
+    assert (counts <= 4).all() and (used < 6).all()

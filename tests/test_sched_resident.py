@@ -1,0 +1,223 @@
+"""ResidentScheduler: device-resident pending set, delta uploads, compacted
+readbacks — semantics must match the one-shot SchedulerArrays.tick path."""
+
+import numpy as np
+import pytest
+
+from tpu_faas.sched.resident import ResidentScheduler
+from tpu_faas.sched.state import SchedulerArrays
+
+
+def _mk(max_workers=16, max_pending=64, max_inflight=32, **kw):
+    clock_box = [100.0]
+    r = ResidentScheduler(
+        max_workers=max_workers,
+        max_pending=max_pending,
+        max_inflight=max_inflight,
+        max_slots=4,
+        time_to_expire=10.0,
+        clock=lambda: clock_box[0],
+        **kw,
+    )
+    r._clock_box = clock_box
+    return r
+
+
+def _drain(r):
+    out = []
+    while True:
+        res = r.resolve_next()
+        if res is None:
+            return out
+        out.append(res)
+
+
+def test_resident_places_like_oneshot():
+    r = _mk()
+    plain = SchedulerArrays(
+        max_workers=16, max_pending=64, max_slots=4, time_to_expire=10.0,
+        clock=lambda: 100.0,
+    )
+    rng = np.random.default_rng(0)
+    speeds = rng.uniform(0.5, 4.0, 6)
+    for i in range(6):
+        r.register(b"w%d" % i, 1 + i % 3, speed=float(speeds[i]))
+        plain.register(b"w%d" % i, 1 + i % 3, speed=float(speeds[i]))
+    sizes = rng.uniform(0.5, 5.0, 20).astype(np.float32)
+    for i, s in enumerate(sizes):
+        r.pending_add(f"t{i}", float(s))
+    out = r.tick_resident()
+    res = _drain(r)[-1]
+    ref = plain.tick(sizes)
+    ref_a = np.asarray(ref.assignment)[:20]
+    cap = sum(min(1 + i % 3, 4) for i in range(6))
+    assert len(res.placed) == (ref_a >= 0).sum() == min(20, cap)
+    # identical placement decision task-for-task (same kernel, same inputs;
+    # resident arrival slots are allocated in index order so slot i == task i)
+    placed_map = dict(res.placed)
+    for i in range(20):
+        if ref_a[i] >= 0:
+            assert placed_map[f"t{i}"] == ref_a[i]
+        else:
+            assert f"t{i}" not in placed_map
+    assert res.n_pending == 20 - len(res.placed)
+
+
+def test_resident_multi_tick_steady_state():
+    r = _mk()
+    for i in range(4):
+        r.register(b"w%d" % i, 2, speed=1.0)
+    # tick 1: 8 tasks, capacity 8
+    for i in range(8):
+        r.pending_add(f"a{i}", 1.0)
+    r.tick_resident()
+    placed = _drain(r)[-1].placed
+    assert len(placed) == 8
+    # resolve_next already consumed capacity (device + mirrors); the caller
+    # only tracks the dispatch itself
+    assert r.worker_free[:4].sum() == 0
+    for tid, row in placed:
+        r.inflight_add(tid, row)
+    # tick 2: nothing free, 4 new tasks stay pending
+    for i in range(4):
+        r.pending_add(f"b{i}", 1.0)
+    r.tick_resident()
+    res = _drain(r)[-1]
+    assert len(res.placed) == 0 and res.n_pending == 4
+    # results for 4 tasks: slots free up; tick 3 places the 4 queued
+    for tid, row in placed[:4]:
+        row2 = r.inflight_done(tid)
+        r.worker_free[row2] += 1
+    r._clock_box[0] += 1.0
+    for i in range(4):
+        r.heartbeat(b"w%d" % i)
+    r.tick_resident()
+    res = _drain(r)[-1]
+    assert len(res.placed) == 4
+    assert {t for t, _ in res.placed} == {f"b{i}" for i in range(4)}
+    assert res.n_pending == 0
+
+
+def test_resident_purge_and_redispatch():
+    r = _mk()
+    for i in range(2):
+        r.register(b"w%d" % i, 2, speed=1.0)
+    for i in range(4):
+        r.pending_add(f"t{i}", 1.0)
+    r.tick_resident()
+    placed = _drain(r)[-1].placed
+    assert len(placed) == 4
+    slots = {}
+    for tid, row in placed:
+        slots[tid] = r.inflight_add(tid, row)
+    # w0 goes silent past time_to_expire; w1 keeps heartbeating
+    r._clock_box[0] += 11.0
+    r.heartbeat(b"w1")
+    r.tick_resident()
+    res = _drain(r)[-1]
+    assert list(res.purged_rows) == [0]
+    dead_slots = {slots[t] for t, row in placed if row == 0}
+    assert set(res.redispatch_slots) == dead_slots
+
+
+def test_resident_overflow_flush_path():
+    # KA=4 forces the flush-kernel path for a 19-task burst
+    r = _mk(KA=4)
+    for i in range(8):
+        r.register(b"w%d" % i, 4, speed=1.0)
+    for i in range(19):
+        r.pending_add(f"t{i}", 1.0 + i)
+    r.tick_resident()
+    results = _drain(r)
+    placed = [p for res in results for p in res.placed]
+    assert len(placed) == 19  # capacity 32 >= 19: everything lands this tick
+    assert {t for t, _ in placed} == {f"t{i}" for i in range(19)}
+
+
+def test_resident_buffer_full_rejects_and_requeues():
+    r = _mk(max_pending=8, max_workers=4)
+    r.register(b"w0", 1, speed=1.0)
+    for i in range(12):
+        r.pending_add(f"t{i}", 1.0)
+    r.tick_resident()
+    res = _drain(r)[-1]
+    # 8 fit in the buffer; 1 placed (capacity); 4 bounced and re-queued
+    assert res.rejected == 4
+    assert len(res.placed) == 1
+    assert r.n_pending_host == 12 - 1
+    # next tick re-attempts the bounced arrivals (1 more placed after a free)
+    r.worker_free[0] = 1
+    r.tick_resident()
+    res = _drain(r)[-1]
+    assert len(res.placed) == 1
+
+
+def test_resident_kp_compaction_replaces_surplus_next_tick():
+    # KP=2 < placements=6: only 2 reported per tick, surplus stays valid
+    r = _mk(KP=2)
+    for i in range(3):
+        r.register(b"w%d" % i, 2, speed=1.0)
+    for i in range(6):
+        r.pending_add(f"t{i}", 1.0)
+    seen = []
+    for _ in range(3):
+        r.tick_resident()
+        seen += _drain(r)[-1].placed
+    assert len(seen) == 6
+    assert {t for t, _ in seen} == {f"t{i}" for i in range(6)}
+
+
+def test_resident_priority_admission():
+    r = _mk(use_priority=True, max_workers=4)
+    r.register(b"w0", 2, speed=1.0)
+    # capacity 2; the two high-priority late arrivals must win admission
+    r.pending_add("lo1", 1.0, priority=0)
+    r.pending_add("lo2", 1.0, priority=0)
+    r.pending_add("hi1", 1.0, priority=5)
+    r.pending_add("hi2", 1.0, priority=5)
+    r.tick_resident()
+    res = _drain(r)[-1]
+    assert {t for t, _ in res.placed} == {"hi1", "hi2"}
+
+
+def test_resident_pipelined_ticks_never_double_book():
+    """Two ticks issued back-to-back WITHOUT resolving the first: the
+    device decrements its own free counts for reported placements, so the
+    second tick must not re-book the same capacity."""
+    r = _mk()
+    for i in range(2):
+        r.register(b"w%d" % i, 2, speed=1.0)  # capacity 4 total
+    for i in range(4):
+        r.pending_add(f"a{i}", 1.0)
+    r.tick_resident()
+    for i in range(4):
+        r.pending_add(f"b{i}", 1.0)
+    r.tick_resident()  # issued before any resolve
+    first = r.resolve_next()
+    second = r.resolve_next()
+    assert len(first.placed) == 4
+    assert len(second.placed) == 0  # no capacity left on device
+    # per-worker totals never exceed the 2 slots each worker has
+    counts = {}
+    for _, row in first.placed + second.placed:
+        counts[row] = counts.get(row, 0) + 1
+    assert all(c <= 2 for c in counts.values())
+    assert r.worker_free[:2].sum() == 0
+
+
+def test_resident_rejected_arrivals_keep_fcfs_order():
+    """Bounced arrivals re-queue at the front in original order."""
+    r = _mk(max_pending=4, max_workers=4)
+    r.register(b"w0", 1, speed=1.0)
+    for i in range(8):
+        r.pending_add(f"t{i}", 1.0)
+    r.tick_resident()
+    res = _drain(r)[-1]
+    assert res.rejected == 4
+    # the re-queued arrivals must be t4..t7 in that order
+    assert [a.task_id for a in r._arrivals] == [f"t{i}" for i in range(4, 8)]
+
+
+def test_resident_rejects_auction_and_mesh():
+    with pytest.raises(ValueError):
+        ResidentScheduler(max_workers=4, max_pending=8, placement="auction")

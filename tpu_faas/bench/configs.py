@@ -61,32 +61,65 @@ def config_3_auction_1k_10k() -> dict:
     vs the rank-matching kernel on the identical problem.
 
     With separable cost (size/speed) the matrix satisfies the Monge
-    property, so sorted pairing is provably optimal — the auction serves as
-    the on-device exact solver for GENERAL costs and as a cross-check here;
-    rank-match is the production path. Inputs are perturbed per rep so
-    execution-memoizing device tunnels can't fake the timing.
+    property, so sorted pairing is provably optimal — rank-match is the
+    production path and carries this config; the auction is the on-device
+    exact solver for GENERAL costs. Its live cost is the WARM-started one:
+    a dispatcher solves a sequence of similar problems, feeding each tick's
+    equilibrium prices into the next (auction_placement init_price), so the
+    cold number below is paid once at startup, not per tick. Both are
+    measured. Inputs are perturbed per rep so execution-memoizing device
+    tunnels can't fake the timing.
     """
-    import jax
-
     from tpu_faas.sched.auction import auction_placement
     from tpu_faas.sched.greedy import host_greedy_reference, rank_match_placement
     from tpu_faas.sched.problem import PlacementProblem
+
+    import dataclasses
+
+    import jax.numpy as jnp
 
     n_tasks, n_workers, max_slots = 10_000, 1_000, 4
     speeds = np.ones(n_workers, dtype=np.float32)
     free = np.full(n_workers, max_slots, dtype=np.int32)
     live = np.ones(n_workers, dtype=bool)
-    problems = []
-    for i in range(3):
-        sizes = np.full(n_tasks, 1.0 + i * 1e-6, dtype=np.float32)
-        problems.append(
-            PlacementProblem.build(sizes, speeds, free, live, T=10_240, W=1_024)
+    # One padded template, then DISTINCT size vectors per execution — a
+    # deep pipeline over a small cycled set would let execution-memoizing
+    # dev tunnels replay repeated (executable, args) pairs for free and
+    # fake the slope. 512 covers the deepest rank window below; only the
+    # 40 KB size vector varies, the fleet arrays are shared.
+    template = PlacementProblem.build(
+        np.full(n_tasks, 1.0, dtype=np.float32), speeds, free, live,
+        T=10_240, W=1_024,
+    )
+    base = np.asarray(template.task_size)
+    problems = [
+        dataclasses.replace(
+            template,
+            task_size=jnp.asarray(base + np.float32((i + 1) * 1e-6)),
         )
+        for i in range(512)
+    ]
 
     def run_auction(p):
         return auction_placement(
             p.task_size, p.task_valid, p.worker_speed, p.worker_free,
             p.worker_live, max_slots=max_slots, eps=1e-3,
+        )
+
+    # Steady-state warm tick: init_price = the converged equilibrium from
+    # the cold solve. A live dispatcher chains each tick's prices into the
+    # next; the measurement uses a FIXED pre-staged price buffer instead
+    # because chaining device outputs into the next call's inputs defeats
+    # pipelining over tunneled dev transports (measured: +66 ms/call of
+    # pure round-trip, none of it device time — a production-local chip
+    # chains for free). Same rounds executed either way.
+    warm_price = [None]  # seeded after the cold compile below
+
+    def run_auction_warm(p):
+        return auction_placement(
+            p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+            p.worker_live, max_slots=max_slots, eps=1e-3,
+            init_price=warm_price[0],
         )
 
     def run_rank(p):
@@ -97,21 +130,36 @@ def config_3_auction_1k_10k() -> dict:
 
     out = run_auction(problems[0])  # compile
     a = np.asarray(out.assignment)[:n_tasks]
+    warm_price[0] = out.prices  # the equilibrium a live dispatcher carries
+    out_w = run_auction_warm(problems[1])  # compile the warm trace
+    warm_rounds = int(out_w.n_rounds)
+    aw = np.asarray(out_w.assignment)[:n_tasks]
     r = np.asarray(run_rank(problems[0]))[:n_tasks]
     # depth >=10: at ~10 ms/exec the tunnel's per-round-trip jitter swamps
     # a shallow pipeline, making the slope estimate noisy by >10x
     auction_ms = _pipeline_slope_ms(run_auction, problems, 2, 10)
-    # the rank kernel is sub-ms: go deep enough that tunnel jitter (which is
-    # per-round-trip, not per-execution) can't drive the slope negative
-    rank_ms = max(0.0, _pipeline_slope_ms(run_rank, problems, 20, 120))
+    auction_warm_ms = _pipeline_slope_ms(run_auction_warm, problems, 2, 10)
+    # the rank kernel is ~0.1 ms: a DEEP pipeline (hundreds of execs) so
+    # the signal clears tunnel jitter, and a median over 5 independent
+    # slope estimates for real resolution (the r2 artifact's clamped
+    # "0.0" quantified nothing)
+    rank_reps = [
+        max(0.0, _pipeline_slope_ms(run_rank, problems, 50, 450))
+        for _ in range(5)
+    ]
+    rank_ms = float(np.median(rank_reps))
     cap = int(free.sum())
     sizes0 = np.full(n_tasks, 1.0, dtype=np.float32)
     return {
         "config": "auction-1k-workers-10k-tasks",
-        "auction_ms": round(auction_ms, 3),
-        "auction_rounds": int(out.n_rounds),
-        "rank_match_ms": round(rank_ms, 3),
+        "auction_cold_ms": round(auction_ms, 3),
+        "auction_cold_rounds": int(out.n_rounds),
+        "auction_warm_ms": round(auction_warm_ms, 3),
+        "auction_warm_rounds": warm_rounds,
+        "rank_match_ms": round(rank_ms, 4),
+        "rank_match_reps_ms": [round(x, 4) for x in rank_reps],
         "placed_auction": int((a >= 0).sum()),
+        "placed_auction_warm": int((aw >= 0).sum()),
         "placed_rank_match": int((r >= 0).sum()),
         "expected_placed": min(n_tasks, cap),
         "greedy_host_ms": round(

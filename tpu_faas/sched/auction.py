@@ -32,10 +32,18 @@ class AuctionResult(NamedTuple):
     assignment: jnp.ndarray  # i32[T] worker per task, -1 = stay queued
     n_rounds: jnp.ndarray  # i32 scalar
     prices: jnp.ndarray  # f32[S] final slot prices
+    #: bool scalar: admitted tasks left unassigned (round budget exhausted —
+    #: possible only warm-started from stale prices, or at max_rounds).
+    #: The caller's contract: drop the warm prices and re-solve cold next
+    #: tick (SchedulerArrays does this automatically)
+    stranded: jnp.ndarray = None
 
 
 @partial(
-    jax.jit, static_argnames=("max_slots", "max_rounds", "n_phases", "backend")
+    jax.jit,
+    static_argnames=(
+        "max_slots", "max_rounds", "n_phases", "backend", "warm_rounds"
+    ),
 )
 def auction_placement(
     task_size: jnp.ndarray,  # f32[T]
@@ -48,6 +56,8 @@ def auction_placement(
     max_rounds: int = 2000,
     n_phases: int = 10,
     backend: str = "auto",
+    init_price: jnp.ndarray | None = None,  # f32[W * max_slots]
+    warm_rounds: int = 256,
 ) -> AuctionResult:
     """``n_phases`` trades phase count against rounds-per-phase: each phase
     reset must repair prices to the finer eps, costing ~n/ratio rounds, so a
@@ -56,7 +66,29 @@ def auction_placement(
     benefit ranges spanning ~4 decades; identical-eps phases are free (warm
     start below), so a larger value only costs compile-time constants. For
     separable costs prefer rank_match_placement — provably optimal and two
-    orders of magnitude cheaper; the auction is the general-cost solver."""
+    orders of magnitude cheaper; the auction is the general-cost solver.
+
+    ``init_price`` warm-starts the slot prices — pass the previous tick's
+    ``AuctionResult.prices``. A live dispatcher solves a SEQUENCE of similar
+    problems (same fleet, fresh-but-similarly-distributed tasks), so last
+    tick's equilibrium prices are already near this tick's: bidding resumes
+    directly at ``eps`` (the coarse-to-fine phase ladder exists only to
+    reach equilibrium from nothing, so it is skipped) and converges in a
+    handful of rounds instead of re-solving from scratch. eps-optimality is
+    unaffected: forward-auction eps-complementary-slackness is established
+    pair-by-pair as bids win, for ANY starting prices (Bertsekas 1992). If
+    the warm attempt doesn't complete within ``warm_rounds`` (prices too
+    stale — fleet upheaval, workload shift), the result carries
+    ``stranded=True`` and the caller re-solves cold next tick (an in-kernel
+    ladder fallback was tried and rejected: compiling the ladder a second
+    time inside a lax.cond multiplied XLA compile time by minutes at
+    dispatcher shapes, for a branch that near-equilibrium steady state
+    almost never takes; stranded tasks just stay QUEUED one extra tick,
+    which the FaaS lifecycle already makes free). Prices are re-based on
+    entry by the smallest POSITIVE price (clamped at 0) — bids compare
+    price *differences*, so the translation is free, and shifting by the
+    positive floor rather than the global min keeps the re-base effective
+    in padded fleets where unused slots pin the global min to 0 forever."""
     T = task_size.shape[0]
     W = worker_speed.shape[0]
     S = W * max_slots
@@ -185,17 +217,59 @@ def auction_placement(
         )
         return price, owner, assigned_slot, total_rounds + rounds, eps_i
 
-    price0 = jnp.zeros(S, dtype=jnp.float32)
     owner0 = jnp.full(S, -1, dtype=jnp.int32)
     assigned0 = jnp.full(T, -1, dtype=jnp.int32)
-    price, owner, assigned_slot, rounds, _ = jax.lax.fori_loop(
-        0,
-        n_phases,
-        phase,
-        (price0, owner0, assigned0, jnp.int32(0), jnp.float32(jnp.inf)),
-    )
 
+    def ladder(price0):
+        return jax.lax.fori_loop(
+            0,
+            n_phases,
+            phase,
+            (price0, owner0, assigned0, jnp.int32(0), jnp.float32(jnp.inf)),
+        )
+
+    if init_price is None:
+        price, owner, assigned_slot, rounds, _ = ladder(
+            jnp.zeros(S, dtype=jnp.float32)
+        )
+    else:
+        # Warm attempt: bid directly at eps_final from last tick's prices,
+        # under a small round budget. Near equilibrium (the steady-state
+        # tick-over-tick case) this converges in a handful of rounds; stale
+        # prices whose disequilibrium / eps quotient exceeds the budget
+        # would grind in eps-sized increments for thousands of rounds, so
+        # the loop stops and reports `stranded` instead (see docstring).
+        def cond_warm(carry):
+            _, _, assigned_slot, r, _ = carry
+            unassigned = admitted & (assigned_slot < 0)
+            return jnp.logical_and(unassigned.any(), r < warm_rounds)
+
+        # Drift re-base: warm prices grow monotonically across a long tick
+        # sequence (every win raises a price by >= eps) until price + eps
+        # rounds to price in f32 and bidding stalls. A plain min() rebase is
+        # a no-op in any padded fleet (unused slots sit at exactly 0
+        # forever), so shift by the smallest POSITIVE price — the floor the
+        # actually-bid-on slots have reached — clamped at 0 so never-bid
+        # slots stay cheapest. Translation changes no bid comparisons among
+        # shifted slots, and eps-CS holds from any starting prices anyway.
+        pos_min = jnp.min(
+            jnp.where(init_price > 0, init_price, jnp.inf)
+        )
+        shift = jnp.where(jnp.isfinite(pos_min), pos_min, 0.0)
+        price, owner, assigned_slot, rounds, _ = jax.lax.while_loop(
+            cond_warm,
+            body,
+            (
+                jnp.maximum(init_price - shift, 0.0),
+                owner0,
+                assigned0,
+                jnp.int32(0),
+                eps_final,
+            ),
+        )
+
+    stranded = (admitted & (assigned_slot < 0)).any()
     assignment = jnp.where(
         assigned_slot >= 0, slot_worker[jnp.clip(assigned_slot, 0)], -1
     ).astype(jnp.int32)
-    return AuctionResult(assignment, rounds, price)
+    return AuctionResult(assignment, rounds, price, stranded)

@@ -44,6 +44,7 @@ def _packed_tick(
     inflight_worker,
     time_to_expire,
     task_priority,
+    auction_price,
     *,
     T: int,
     W: int,
@@ -78,6 +79,7 @@ def _packed_tick(
         max_slots=max_slots,
         task_priority=task_priority,
         placement=placement,
+        auction_price=auction_price,
     )
 
 
@@ -86,6 +88,14 @@ class TickOutput(NamedTuple):
     live: jnp.ndarray  # bool[W]
     purged: jnp.ndarray  # bool[W] was live last tick, dead now
     redispatch: jnp.ndarray  # bool[I] in-flight task needs re-queue
+    #: f32[W*max_slots] final slot prices (auction placement only, else
+    #: None): fed back as next tick's warm start, device-resident between
+    #: ticks — never read to host
+    auction_price: jnp.ndarray | None = None
+    #: bool scalar (auction only): warm attempt left admitted tasks
+    #: unassigned; the NEXT tick must re-solve cold (host checks this one
+    #: tick late, when the value is long since computed — no extra sync)
+    auction_stranded: jnp.ndarray | None = None
     # NOTE deliberately NO per-worker assigned-count output: a T-wide
     # scatter-add with colliding indices measured ~0.5 ms of the ~1 ms tick
     # on v5e — and the host gets the full assignment vector anyway, where
@@ -106,6 +116,7 @@ def scheduler_tick(
     max_slots: int = 8,
     task_priority: jnp.ndarray | None = None,  # i32[T], higher admitted first
     placement: str = "rank",  # rank | auction | sinkhorn
+    auction_price: jnp.ndarray | None = None,  # f32[W*max_slots] warm start
 ) -> TickOutput:
     # -- failure detection (reference purge_workers, device-side) ----------
     # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
@@ -135,10 +146,14 @@ def scheduler_tick(
     elif placement == "auction":
         from tpu_faas.sched.auction import auction_placement
 
-        assignment = auction_placement(
+        res = auction_placement(
             task_size, task_valid, worker_speed, worker_free, live,
-            max_slots=max_slots,
-        ).assignment
+            max_slots=max_slots, init_price=auction_price,
+        )
+        return TickOutput(
+            res.assignment, live, purged, redispatch, res.prices,
+            res.stranded,
+        )
     elif placement == "sinkhorn":
         T, W = task_size.shape[0], worker_speed.shape[0]
         if T * W > 2**24:
@@ -260,6 +275,12 @@ class SchedulerArrays:
         self._dev_cache: dict[str, tuple[np.ndarray, "jnp.ndarray"]] = {}
         self._d_tte = None
         self._tte_host: float | None = None
+        # auction placement: last tick's slot prices, fed back as the next
+        # tick's warm start (device-resident, never read to host; see
+        # auction_placement's init_price). _d_auction_stranded is the
+        # previous tick's completeness flag, checked one tick late
+        self._d_auction_price = None
+        self._d_auction_stranded = None
 
     # -- membership (reference register/reconnect/purge semantics) ---------
     def register(
@@ -444,6 +465,14 @@ class SchedulerArrays:
             prio[:n] = task_priorities
         now_f = now if now is not None else self.clock()
         hb_age = (now_f - self.last_heartbeat).astype(np.float32)
+        if self._d_auction_stranded is not None and bool(
+            self._d_auction_stranded
+        ):
+            # last warm attempt exhausted its round budget (stale prices —
+            # fleet upheaval / workload shift): re-solve cold this tick.
+            # The bool() sync is on a value computed a whole tick ago.
+            self._d_auction_price = None
+        self._d_auction_stranded = None
         if self.mesh is not None:
             ts = np.zeros(self.max_pending, dtype=np.float32)
             ts[:n] = task_sizes
@@ -473,11 +502,15 @@ class SchedulerArrays:
                 self._device_inflight(),
                 self._d_tte,
                 None if prio is None else jnp.asarray(prio),
+                self._d_auction_price,
                 T=T,
                 W=W,
                 max_slots=self.max_slots,
                 placement=self.placement,
             )
+            if self.placement == "auction":
+                self._d_auction_price = out.auction_price
+                self._d_auction_stranded = out.auction_stranded
         # keep prev_live DEVICE-resident: it is only ever fed back into the
         # next tick, and forcing it to host here would put a synchronous
         # device->host round trip inside every tick (over a tunneled dev
