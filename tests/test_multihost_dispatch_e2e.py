@@ -1,0 +1,116 @@
+"""Operator-reachable multi-host: the `--multihost` dispatcher CLI, end to
+end.
+
+tests/test_multihost.py proves the bare sharded kernels over a two-process
+gloo pod; THIS test proves the product: two `python -m tpu_faas.dispatch
+-m tpu-push --multihost` processes form the global 8-device mesh (2 OS
+processes x 4 virtual CPU devices), process 0 serves a REAL stack — store,
+gateway, ZMQ push worker — and places real tasks with every tick running
+collectively over the global mesh (broadcast + sharded tick + allgather,
+parallel/multihost_tick.py). Shutdown is part of the contract: SIGTERM to
+the lead must release the follower from its blocking collective via the
+stop broadcast — both processes exit cleanly.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tests.test_workers_e2e import _spawn_worker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_dispatcher(rank: int, coord: int, zmq_port: int, store_url: str):
+    from tpu_faas.bench.harness import cpu_worker_env
+
+    env = cpu_worker_env()
+    # the processes form their OWN CPU pod (jax_num_cpu_devices + gloo);
+    # the parent suite's virtual-device flags would fight that config
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    args = [
+        sys.executable, "-m", "tpu_faas.dispatch",
+        "-m", "tpu-push",
+        "-i", "127.0.0.1",
+        "-p", str(zmq_port),
+        "--multihost",
+        "--coordinator", f"127.0.0.1:{coord}",
+        "--process-id", str(rank),
+        "--num-processes", "2",
+        "--cpu-pod-devices", "4",
+        "--max-pending", "64",
+        "--max-fleet", "16",
+        "--tick-period", "0.05",
+        "--store", store_url,
+    ]
+    return subprocess.Popen(
+        args, env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True,
+    )
+
+
+def test_multihost_dispatcher_serves_and_stops():
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    coord, zmq_port = _free_port(), _free_port()
+    follower = _spawn_dispatcher(1, coord, zmq_port, store_handle.url)
+    lead = _spawn_dispatcher(0, coord, zmq_port, store_handle.url)
+    worker = None
+    try:
+        worker = _spawn_worker(
+            "push_worker", 4, f"tcp://127.0.0.1:{zmq_port}",
+            "--hb", "--hb-period", "0.3",
+        )
+        client = FaaSClient(gw.url)
+        fid = client.register(lambda x: x + 100, name="add100")
+        handles = [client.submit(fid, i) for i in range(12)]
+        deadline = time.time() + 180  # two cold jax compiles in children
+        done = {}
+        while len(done) < 12 and time.time() < deadline:
+            for i, h in enumerate(handles):
+                if i in done:
+                    continue
+                st = h.status()
+                if st in ("COMPLETED", "FAILED"):
+                    assert st == "COMPLETED", (i, st)
+                    done[i] = h.result(timeout=5.0)
+            time.sleep(0.2)
+        assert len(done) == 12, f"only {len(done)}/12 completed"
+        assert all(done[i] == i + 100 for i in range(12))
+
+        # -- shutdown contract: SIGTERM the lead; the stop broadcast must
+        # release the follower from its blocking collective
+        os.kill(lead.pid, signal.SIGTERM)
+        lead_out, _ = lead.communicate(timeout=60)
+        assert lead.returncode == 0, lead_out[-2000:]
+        follower_out, _ = follower.communicate(timeout=60)
+        assert follower.returncode == 0, follower_out[-2000:]
+        assert "stop after" in follower_out
+    finally:
+        if worker is not None:
+            worker.kill()
+            worker.wait()
+        for p in (lead, follower):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        gw.stop()
+        store_handle.stop()

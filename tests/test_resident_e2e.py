@@ -1,0 +1,133 @@
+"""TpuPushDispatcher --resident end to end: the device-resident pending set
+behind the REAL stack — store, gateway, ZMQ push workers — including worker
+crash + redistribution and priority admission through the resident kernel.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import sleep_task
+from tests.test_tpu_push_e2e import _make_dispatcher
+from tests.test_workers_e2e import _spawn_worker, service_test
+
+
+def _resident_stack(store_url, **kw):
+    disp = _make_dispatcher(store_url, resident=True, **kw)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    return disp, t
+
+
+def test_resident_end_to_end():
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp, t = _resident_stack(store_handle.url)
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    try:
+        service_test(FaaSClient(gw.url), n_tasks=20)
+        assert disp.n_dispatched >= 20
+        assert disp.resident
+        # the device pending set drained fully
+        assert not disp._resident_tasks
+        assert disp.arrays.n_pending_host == 0
+    finally:
+        for w in workers:
+            w.kill()
+            w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_resident_worker_crash_redispatch():
+    """SIGKILL a worker holding tasks: the resident tick's compacted
+    redispatch readback must reclaim and re-dispatch them to the survivor,
+    race-clean under the protocol monitor."""
+    from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(
+        RaceCheckStore(make_store(store_handle.url), monitor, actor="gateway")
+    )
+    disp, t = _resident_stack(
+        store_handle.url,
+        time_to_expire=1.5,
+        store=RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="dispatcher"
+        ),
+    )
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        handles = [client.submit(fid, 1.0) for _ in range(8)]
+        time.sleep(0.8)
+        workers[0].send_signal(signal.SIGKILL)
+        workers[0].wait()
+        for h in handles:
+            assert h.result(timeout=60.0) == 1.0
+        monitor.assert_clean()
+        assert monitor.unfinished() == []
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_resident_priority_admission_e2e():
+    """Priority hints flow through the resident kernel: with one
+    single-slot worker, a high-priority late submit runs before earlier
+    low-priority tasks."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    # hold the tick until all submits land so admission is one batch
+    disp, t = _resident_stack(store_handle.url, tick_period=1.0)
+    url = f"tcp://127.0.0.1:{disp.port}"
+    worker = _spawn_worker("push_worker", 1, url, "--hb", "--hb-period", "0.3")
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        lows = [
+            client.submit_with(fid, args=(0.4,), priority=0) for _ in range(3)
+        ]
+        hi = client.submit_with(fid, args=(0.4,), priority=9)
+        order: list[str] = []
+        deadline = time.time() + 60
+        pending = {h.task_id: h for h in lows + [hi]}
+        while pending and time.time() < deadline:
+            for tid, h in list(pending.items()):
+                if h.status() == "COMPLETED":
+                    order.append(tid)
+                    del pending[tid]
+            time.sleep(0.05)
+        assert not pending, f"{len(pending)} tasks never finished"
+        # the high-priority task finished before at least two of the lows
+        assert order.index(hi.task_id) <= 1, order
+    finally:
+        worker.kill()
+        worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

@@ -206,7 +206,7 @@ def test_resident_pipelined_ticks_never_double_book():
 
 
 def test_resident_rejected_arrivals_keep_fcfs_order():
-    """Bounced arrivals re-queue at the front in original order."""
+    """Bounced arrivals re-queue for the next tick in original order."""
     r = _mk(max_pending=4, max_workers=4)
     r.register(b"w0", 1, speed=1.0)
     for i in range(8):
@@ -214,8 +214,33 @@ def test_resident_rejected_arrivals_keep_fcfs_order():
     r.tick_resident()
     res = _drain(r)[-1]
     assert res.rejected == 4
-    # the re-queued arrivals must be t4..t7 in that order
-    assert [a.task_id for a in r._arrivals] == [f"t{i}" for i in range(4, 8)]
+    # the bounced arrivals must be t4..t7 in that order
+    assert [a.task_id for a in r._rejected] == [f"t{i}" for i in range(4, 8)]
+
+
+def test_resident_rejected_fcfs_across_multiple_packets():
+    """A burst split over flush + main packets, ALL bounced: the retry
+    order must stay t0..t(n-1) — per-packet front-insertion would put the
+    later packet's rejects ahead of the earlier packet's."""
+    # KA=4 splits 10 arrivals into 2 flush packets + 1 main packet;
+    # max_pending=8 with all 8 slots occupied bounces every arrival
+    r = _mk(max_pending=8, max_workers=4, KA=4)
+    r.register(b"w0", 0, speed=1.0)  # no capacity: occupants never leave
+    for i in range(8):
+        r.pending_add(f"occ{i}", 1.0)
+    r.tick_resident()
+    _drain(r)
+    assert len(r.slot_task) == 8  # buffer full
+    for i in range(10):
+        r.pending_add(f"t{i}", 1.0)
+    r.tick_resident()
+    results = _drain(r)
+    assert sum(res.rejected for res in results) == 10
+    assert [a.task_id for a in r._rejected] == [f"t{i}" for i in range(10)]
+    # and the next tick retries them in that same order
+    r.tick_resident()
+    _drain(r)
+    assert [a.task_id for a in r._rejected] == [f"t{i}" for i in range(10)]
 
 
 def test_resident_rejects_auction_and_mesh():

@@ -22,6 +22,22 @@ from tpu_faas.utils.logging import get_logger
 log = get_logger("dispatch.cli")
 
 
+def _install_stop_signals(dispatcher) -> None:
+    """SIGTERM/SIGINT -> graceful stop: the serve loop exits at its next
+    poll timeout, so shutdown work in its ``finally`` (closing sockets,
+    releasing multihost followers from their blocking collective via the
+    stop broadcast) actually runs. A bare SIGTERM default would kill the
+    process mid-collective and strand every follower in the fleet."""
+    import signal
+
+    def handler(signum, frame):
+        log.info("signal %d: stopping dispatcher", signum)
+        dispatcher.stop()
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+
+
 def main(argv: list[str] | None = None) -> None:
     cfg = Config.load()
     ap = argparse.ArgumentParser(description="tpu-faas task dispatcher")
@@ -78,10 +94,49 @@ def main(argv: list[str] | None = None) -> None:
         "heterogeneous balancing)",
     )
     ap.add_argument(
+        "--resident", action="store_true",
+        help="tpu-push: keep ALL scheduler state (pending set, heartbeat "
+        "stamps, free counts, in-flight table) device-resident between "
+        "ticks; each tick uploads one small delta packet instead of the "
+        "whole batch. The steady-state high-throughput path; "
+        "single-device (excludes --mesh/--multihost)",
+    )
+    ap.add_argument(
         "--mesh", type=int, default=0, metavar="N",
         help="tpu-push: shard the pending-task axis over N devices "
         "(jax.sharding.Mesh; placement must be rank or sinkhorn); 0 = "
         "single device",
+    )
+    mh = ap.add_argument_group(
+        "multihost",
+        "tpu-push: span the placement mesh across several OS processes "
+        "(pod-slice hosts). Start one process per host with the SAME "
+        "shape flags; process 0 becomes the serving dispatcher (the "
+        "lead), the rest join as mesh followers and exit when the lead "
+        "stops. On Cloud TPU the coordinator/process-id/num-processes "
+        "triple is auto-discovered; off-TPU pass all three.",
+    )
+    mh.add_argument(
+        "--multihost", action="store_true",
+        help="join/form the multi-process global mesh before serving",
+    )
+    mh.add_argument(
+        "--coordinator", default=None, metavar="HOST:PORT",
+        help="jax.distributed coordinator address (default: auto-discover)",
+    )
+    mh.add_argument(
+        "--process-id", type=int, default=None,
+        help="this process's rank (default: auto-discover)",
+    )
+    mh.add_argument(
+        "--num-processes", type=int, default=None,
+        help="total processes in the fleet (default: auto-discover)",
+    )
+    mh.add_argument(
+        "--cpu-pod-devices", type=int, default=0, metavar="N",
+        help="simulate a pod on CPUs: contribute N virtual CPU devices "
+        "from this process, collectives over gloo (for testing/dev; "
+        "0 = real accelerator devices)",
     )
     ap.add_argument(
         "--lease-timeout", type=float, default=30.0,
@@ -110,6 +165,7 @@ def main(argv: list[str] | None = None) -> None:
         log.info("local dispatcher: pool=%d store=%s", ns.num_workers, ns.store)
         if ns.stats_port:
             d.serve_stats(ns.stats_port)
+        _install_stop_signals(d)
         d.start()
         return
 
@@ -132,6 +188,52 @@ def main(argv: list[str] | None = None) -> None:
                 import jax
 
                 jax.config.update("jax_platforms", cfg.platform)
+            if ns.multihost:
+                # Validate flag combinations HERE, before any process joins
+                # the collective runtime: the lead's constructor also
+                # rejects these, but by then the followers are already
+                # blocked in a collective and a lead that exits without
+                # serving never sends the stop broadcast — every follower
+                # in the fleet would hang forever on an operator typo.
+                if ns.placement == "auction":
+                    sys.exit(
+                        "--multihost placement must be rank or sinkhorn "
+                        "(the auction has no sharded variant)"
+                    )
+                if ns.mesh:
+                    sys.exit("--multihost owns the global mesh; drop --mesh")
+                if ns.resident:
+                    sys.exit(
+                        "--resident is single-device; it does not compose "
+                        "with --multihost"
+                    )
+                # join the global runtime BEFORE any other backend use;
+                # followers never reach the dispatcher construction below
+                from tpu_faas.parallel.distributed import initialize_multihost
+
+                initialize_multihost(
+                    coordinator_address=ns.coordinator,
+                    num_processes=ns.num_processes,
+                    process_id=ns.process_id,
+                    cpu_devices_per_process=ns.cpu_pod_devices or None,
+                )
+                import jax
+
+                if jax.process_index() != 0:
+                    from tpu_faas.parallel.multihost_tick import MultihostTick
+
+                    log.info(
+                        "multihost follower %d/%d: %d global devices",
+                        jax.process_index(), jax.process_count(),
+                        len(jax.devices()),
+                    )
+                    MultihostTick(
+                        max_pending=ns.max_pending,
+                        max_workers=ns.max_fleet,
+                        max_inflight=65536,
+                        use_sinkhorn=(ns.placement == "sinkhorn"),
+                    ).follow_loop()
+                    return
             from tpu_faas.dispatch.tpu_push import TpuPushDispatcher as cls
     except ImportError as exc:
         sys.exit(f"dispatcher mode {ns.mode!r} is not available: {exc}")
@@ -155,11 +257,14 @@ def main(argv: list[str] | None = None) -> None:
             placement=ns.placement,
             mesh_devices=ns.mesh or None,
             lease_timeout=ns.lease_timeout,
+            multihost=ns.multihost,
+            resident=ns.resident,
         )
     d = cls(**kwargs)
     log.info("%s dispatcher on %s:%d", ns.mode, ns.ip, ns.port)
     if ns.stats_port:
         d.serve_stats(ns.stats_port)
+    _install_stop_signals(d)
     d.start()
 
 

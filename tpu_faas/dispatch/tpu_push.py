@@ -72,6 +72,8 @@ class TpuPushDispatcher(TaskDispatcher):
         mesh_devices: int | None = None,
         lease_timeout: float = 30.0,
         shared: bool = False,
+        multihost: bool = False,
+        resident: bool = False,
     ) -> None:
         super().__init__(
             store_url=store_url, channel=channel, store=store, shared=shared
@@ -87,16 +89,70 @@ class TpuPushDispatcher(TaskDispatcher):
         self.poller.register(self.socket, zmq.POLLIN)
         self.clock = clock
         self.tick_period = tick_period
-        self.arrays = SchedulerArrays(
-            max_workers=max_workers,
-            max_pending=max_pending,
-            max_inflight=max_inflight,
-            max_slots=max_slots,
-            time_to_expire=time_to_expire,
-            clock=clock,
-            placement=placement,
-            mesh_devices=mesh_devices,
-        )
+        if multihost and mesh_devices:
+            raise ValueError(
+                "--multihost owns the global mesh; --mesh is single-process"
+            )
+        if multihost and placement == "auction":
+            raise ValueError(
+                "multihost placement must be rank or sinkhorn (the auction "
+                "has no sharded variant)"
+            )
+        if resident and (multihost or mesh_devices):
+            raise ValueError(
+                "--resident is the single-device steady-state path; it "
+                "composes with neither --mesh nor --multihost"
+            )
+        self.resident = resident
+        if resident:
+            from tpu_faas.sched.resident import ResidentScheduler
+
+            # the steady-state path: pending set, heartbeat stamps, free
+            # counts and in-flight table all device-resident between ticks;
+            # per tick ONE small delta upload + one fused kernel + a
+            # compacted readback (sched/resident.py). use_priority keeps
+            # client priority hints working (all-zero priorities reduce to
+            # plain FCFS, so the flag costs one [T] argsort, not semantics)
+            self.arrays = ResidentScheduler(
+                max_workers=max_workers,
+                max_pending=max_pending,
+                max_inflight=max_inflight,
+                max_slots=max_slots,
+                time_to_expire=time_to_expire,
+                clock=clock,
+                placement=placement,
+                use_priority=True,
+            )
+            #: tasks currently living in the device pending set (or queued
+            #: into it): task_id -> PendingTask, the payload source at
+            #: dispatch time
+            self._resident_tasks: dict[str, PendingTask] = {}
+        else:
+            self.arrays = SchedulerArrays(
+                max_workers=max_workers,
+                max_pending=max_pending,
+                max_inflight=max_inflight,
+                max_slots=max_slots,
+                time_to_expire=time_to_expire,
+                clock=clock,
+                placement=placement,
+                mesh_devices=mesh_devices,
+            )
+            self._resident_tasks = {}
+        if multihost:
+            # this process is the LEAD of a multi-process dispatcher fleet:
+            # followers (started with the same --multihost flags, nonzero
+            # process id) sit in MultihostTick.follow_loop and participate
+            # in every tick's collectives over the global mesh
+            from tpu_faas.parallel.multihost_tick import MultihostTick
+
+            self.arrays.multihost = MultihostTick(
+                max_pending=max_pending,
+                max_workers=max_workers,
+                max_inflight=max_inflight,
+                max_slots=max_slots,
+                use_sinkhorn=(placement == "sinkhorn"),
+            )
         self.pending: deque[PendingTask] = deque()
         #: max seconds between device ticks when there is nothing to place.
         #: The device step also performs liveness detection (purge +
@@ -198,6 +254,7 @@ class TpuPushDispatcher(TaskDispatcher):
         horizon = self._adoption_horizon()
         known = {t.task_id for t in self.pending}
         known.update(t.task_id for t in self._unclaimed)
+        known.update(self._resident_tasks)
         # tasks whose (terminal) writes sit in the deferred buffer still read
         # as QUEUED/RUNNING from the store — adopting them would re-execute
         known.update(item[0] for item in self.deferred_results)
@@ -435,7 +492,7 @@ class TpuPushDispatcher(TaskDispatcher):
             "n_dispatched": self.n_dispatched,
             "n_results": self.n_results,
             "n_purged": self.n_purged,
-            "pending": len(self.pending),
+            "pending": len(self.pending) + len(self._resident_tasks),
             "inflight": a.n_inflight,
             "workers_registered": len(a.worker_ids),
             "free_slots": int(
@@ -453,9 +510,12 @@ class TpuPushDispatcher(TaskDispatcher):
         padded batch size; ids already pending (e.g. adopted by a stranded
         rescan while the same announce sat buffered in the subscription) are
         dropped so a task is never dispatched twice."""
-        room = self.arrays.max_pending - len(self.pending)
+        room = self.arrays.max_pending - len(self.pending) - len(
+            self._resident_tasks
+        )
         if room > 0:
             seen = {t.task_id for t in self.pending}
+            seen.update(self._resident_tasks)
             # tasks whose claim round hit an outage last time go first —
             # their announces are long consumed, dropping them loses tasks
             batch = []
@@ -487,6 +547,8 @@ class TpuPushDispatcher(TaskDispatcher):
         ``intake=False`` when the caller just drained the bus itself (the
         serve loop does, to evaluate the device-step gate) — a second drain
         microseconds later would only rebuild the seen-set for nothing."""
+        if self.resident:
+            return self._tick_resident(intake)
         a = self.arrays
         if intake:
             self._intake()
@@ -609,6 +671,139 @@ class TpuPushDispatcher(TaskDispatcher):
             self.pending = requeued + still_pending + overflow
         return sent
 
+    def _tick_resident(self, intake: bool = True) -> int:
+        """The --resident tick: the pending set stays on device between
+        ticks (sched/resident.py), so this method moves newly-claimed tasks
+        INTO the device set, runs the fused delta tick, and acts on the
+        compacted readback. self.pending remains the host-side staging
+        queue every producer (intake, rescan adoption, reclaim) already
+        appends to — tasks flow pending -> device -> dispatch, and any
+        failed dispatch flows back to pending."""
+        a = self.arrays
+        if intake:
+            self._intake()
+        while self.pending:
+            t = self.pending.popleft()
+            if t.task_id in self._resident_tasks:
+                continue  # already queued device-side (rescan overlap)
+            self._resident_tasks[t.task_id] = t
+            a.pending_add(t.task_id, t.size_estimate, t.priority or 0)
+
+        sent = 0
+        with self.tracer.span("device_tick"):
+            out = a.tick_resident()
+        # Drain EVERY unresolved entry, not just one: an arrival burst
+        # beyond KA makes tick_resident emit several flush packets plus the
+        # main tick, and resolving one-per-call would put the dispatcher
+        # permanently behind — acting on stale redispatch slots against a
+        # since-recycled inflight table is a double-execution bug, and
+        # unmirrored free decrements double-book capacity.
+        while True:
+            res = a.resolve_next()
+            if res is None:
+                break
+            sent += self._act_on_resolved(res)
+        return sent
+
+    def _act_on_resolved(self, res) -> int:
+        """Apply one resolved resident tick: reclaims, purges, dispatches."""
+        a = self.arrays
+        sent = 0
+
+        # The device already cleared the placed slots and consumed their
+        # capacity (resolve_next mirrored the free decrement), so a
+        # placement this tick does NOT dispatch must flow back explicitly:
+        # re-queue the task and return the worker's slot (the free-count
+        # diff carries the correction to the device next tick).
+        def undo(task: PendingTask, row: int) -> None:
+            self.pending.append(task)
+            if 0 <= row < len(a.worker_free):
+                a.worker_free[row] = min(
+                    a.worker_free[row] + 1, int(a.worker_procs[row])
+                )
+
+        # -- reclaim in-flight tasks of dead workers (store reads first,
+        # bookkeeping second). An outage aborts the whole tick: nothing is
+        # mutated yet except the resolve itself, so the placements must be
+        # re-queued before re-raising — redispatch slots are simply
+        # recomputed next tick (the workers stay dead).
+        reclaims: list[tuple[int, PendingTask]] = []
+        drops: list[tuple[int, str]] = []
+        try:
+            for slot in res.redispatch_slots:
+                task_id = a.inflight_task[slot]
+                if task_id is None:
+                    continue
+                pt = self.reclaim_or_fail(
+                    task_id,
+                    self.task_retries.get(task_id, 0),
+                    self.max_task_retries,
+                )
+                if pt is None:
+                    drops.append((slot, task_id))
+                else:
+                    reclaims.append((slot, pt))
+        except STORE_OUTAGE_ERRORS:
+            for task_id, row in res.placed:
+                task = self._resident_tasks.pop(task_id, None)
+                if task is not None:
+                    undo(task, row)
+            raise
+        for slot, task_id in drops:
+            a.inflight_clear_slot(slot)
+            self.task_retries.pop(task_id, None)
+        for slot, pt in reclaims:
+            a.inflight_clear_slot(slot)
+            self.task_retries[pt.task_id] = pt.retries
+            self.pending.append(pt)
+        for row in res.purged_rows:
+            self.log.warning("purged worker row %d", int(row))
+            a.deactivate(int(row))
+            self.n_purged += 1
+
+        # -- act on placements (per-task outage degradation: a task whose
+        # zombie-finished probe can't be answered flows back instead of
+        # aborting the loop; mark_running_safe never raises) ---------------
+        for task_id, row in res.placed:
+            task = self._resident_tasks.pop(task_id, None)
+            if task is None:
+                continue
+            if row not in a.row_ids:
+                undo(task, row)
+                continue
+            if task.retries:
+                try:
+                    finished = self.task_is_finished(task.task_id)
+                except STORE_OUTAGE_ERRORS as exc:
+                    self.note_store_outage(exc, pause=0)
+                    undo(task, row)
+                    continue
+                if finished:
+                    # reclaimed task finished meanwhile by its zombie
+                    # worker: re-dispatching would regress the record
+                    self.task_retries.pop(task.task_id, None)
+                    a.worker_free[row] = min(
+                        a.worker_free[row] + 1, int(a.worker_procs[row])
+                    )
+                    continue
+            try:
+                a.inflight_add(task.task_id, row)
+            except RuntimeError:
+                undo(task, row)  # inflight table full: wait a tick
+                continue
+            wid = a.row_ids[row]
+            self.socket.send_multipart(
+                [wid, m.encode(m.TASK, **task.task_message_kwargs())]
+            )
+            self.mark_running_safe(
+                task.task_id,
+                redispatch=bool(task.retries),
+                retries=task.retries,
+            )
+            sent += 1
+            self.n_dispatched += 1
+        return sent
+
     def start(self, max_results: int | None = None) -> int:
         try:
             last_tick = 0.0
@@ -668,7 +863,10 @@ class TpuPushDispatcher(TaskDispatcher):
                         free_any = bool(
                             np.any(a.worker_active & (a.worker_free > 0))
                         )
-                        if (self.pending and free_any) or (
+                        placeable = bool(self.pending) or bool(
+                            self._resident_tasks
+                        )
+                        if (placeable and free_any) or (
                             now - last_device >= self.liveness_period
                         ):
                             self.tick(intake=False)
@@ -679,5 +877,12 @@ class TpuPushDispatcher(TaskDispatcher):
                 if max_results is not None and self.n_results >= max_results:
                     break
         finally:
+            if self.arrays.multihost is not None:
+                # release the followers before the sockets: they block in a
+                # collective and would hang their processes forever
+                try:
+                    self.arrays.multihost.lead_stop()
+                except Exception:
+                    self.log.exception("multihost stop broadcast failed")
             self.socket.close(linger=0)
         return self.n_results

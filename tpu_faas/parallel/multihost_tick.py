@@ -1,0 +1,211 @@
+"""Collective scheduler tick for a multi-process (multi-host) dispatcher.
+
+One dispatcher fleet, one global device mesh: process 0 (the LEAD) runs the
+real serve loop — sockets, store, workers — while every other process is a
+FOLLOWER that contributes its local devices to the mesh and participates in
+the tick's collectives. JAX multi-controller semantics require every process
+to execute the same program on its addressable shard, so the lead broadcasts
+each tick's host inputs (one packed f32 buffer) with
+``multihost_utils.broadcast_one_to_all``, all processes run the identical
+``sharded_scheduler_tick`` over the global mesh, and the task-sharded
+assignment is re-assembled everywhere with ``process_allgather`` (the lead
+acts on it; followers discard). A stop flag in the same buffer shuts the
+followers down with the lead.
+
+This is the operator-facing multi-host path (``--multihost`` on the
+dispatcher CLI) promised by SURVEY §2.3: the reference's design tops out at
+one dispatcher process (task_dispatcher.py has no multi-node scheduler
+state at all); here the placement problem itself spans hosts, with XLA
+collectives riding ICI within a slice and DCN across slices.
+
+Determinism note: followers never see host scheduler state except through
+the broadcast buffer, and the kernel is deterministic, so per-process
+carried state (prev_live) stays bit-identical without synchronization.
+
+Transfer note: the buffer re-broadcasts the full fleet + inflight vectors
+every tick (~0.3 MB at default caps). That is deliberate v1 simplicity —
+correctness first; the delta-packet discipline the single-host resident
+path uses (sched/resident.py) composes with this design if DCN broadcast
+ever shows up in a profile.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_faas.utils.logging import get_logger
+
+log = get_logger("parallel.multihost")
+
+_HEADER = 4  # stop, n_valid, time_to_expire, (reserved)
+
+
+class MultihostTick:
+    """Lead/follower collective tick over the global mesh.
+
+    Construct with identical parameters in every process (they define the
+    broadcast buffer layout and compiled shapes); then the lead calls
+    :meth:`lead_tick` per scheduler tick and :meth:`lead_stop` on shutdown,
+    while followers sit in :meth:`follow_loop`.
+    """
+
+    def __init__(
+        self,
+        max_pending: int,
+        max_workers: int,
+        max_inflight: int,
+        max_slots: int = 8,
+        use_sinkhorn: bool = False,
+    ) -> None:
+        import jax
+
+        from tpu_faas.parallel.mesh import make_mesh
+
+        self.T = int(max_pending)
+        self.W = int(max_workers)
+        self.I = int(max_inflight)
+        self.max_slots = int(max_slots)
+        self.use_sinkhorn = bool(use_sinkhorn)
+        n_dev = len(jax.devices())
+        if self.T % n_dev:
+            self.T += n_dev - (self.T % n_dev)
+        self.mesh = make_mesh(n_dev)
+        if self.mesh.size != n_dev:
+            raise RuntimeError(
+                f"global mesh got {self.mesh.size} devices, expected {n_dev}"
+            )
+        # buffer layout: header ++ sizes[T] ++ speed[W] ++ free[W] ++
+        # active[W] ++ hb_age[W] ++ inflight[I]
+        self.buflen = _HEADER + self.T + 4 * self.W + self.I
+        self._prev_live = None  # device, replicated; carried across ticks
+        self.process_index = jax.process_index()
+
+    # -- shared execution --------------------------------------------------
+    def _run(self, buf: np.ndarray):
+        """Execute one collective tick from a broadcast buffer. Returns the
+        host-view TickOutput, or None when the buffer carries the stop
+        flag. Every process calls this with the identical buffer."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_faas.parallel.mesh import TASK_AXIS, sharded_scheduler_tick
+        from tpu_faas.sched.state import TickOutput
+
+        if buf[0] > 0.5:
+            return None
+        T, W, I = self.T, self.W, self.I
+        n_valid = int(buf[1])
+        tte = np.float32(buf[2])
+        off = _HEADER
+        sizes = buf[off : off + T]; off += T
+        speed = buf[off : off + W]; off += W
+        free = buf[off : off + W].astype(np.int32); off += W
+        active = buf[off : off + W] > 0.5; off += W
+        hb_age = buf[off : off + W]; off += W
+        inflight = buf[off : off + I].astype(np.int32)
+
+        task_sh = NamedSharding(self.mesh, P(TASK_AXIS))
+        repl = NamedSharding(self.mesh, P())
+
+        def put(host, sharding):
+            # every process holds the same full host copy (it came off the
+            # broadcast), so each can materialize its addressable shards
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx]
+            )
+
+        ts = put(sizes, task_sh)
+        d_speed = put(speed, repl)
+        d_free = put(free, repl)
+        d_active = put(active, repl)
+        d_hb = put(hb_age, repl)
+        d_infl = put(inflight, repl)
+        if self._prev_live is None:
+            self._prev_live = put(np.zeros(W, dtype=bool), repl)
+
+        out = sharded_scheduler_tick(
+            self.mesh,
+            ts,
+            None,
+            d_speed,
+            d_free,
+            d_active,
+            d_hb,
+            self._prev_live,
+            d_infl,
+            jnp.float32(tte),
+            max_slots=self.max_slots,
+            use_sinkhorn=self.use_sinkhorn,
+            n_valid=jnp.int32(n_valid),
+        )
+        self._prev_live = out.live  # replicated; identical in every process
+        # task-sharded assignment -> full copy everywhere (a collective:
+        # every process participates, only the lead acts on the result)
+        assignment = multihost_utils.process_allgather(
+            out.assignment, tiled=True
+        )
+        return TickOutput(
+            np.asarray(assignment),
+            np.asarray(out.live),  # replicated outputs read locally
+            np.asarray(out.purged),
+            np.asarray(out.redispatch),
+        )
+
+    # -- lead side ---------------------------------------------------------
+    def _broadcast(self, buf: np.ndarray) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.broadcast_one_to_all(buf))
+
+    def lead_tick(
+        self,
+        task_sizes: np.ndarray,  # f32[n] un-padded
+        worker_speed: np.ndarray,
+        worker_free: np.ndarray,
+        worker_active: np.ndarray,
+        hb_age: np.ndarray,
+        inflight_worker: np.ndarray,
+        time_to_expire: float,
+    ):
+        n = len(task_sizes)
+        if n > self.T:
+            raise ValueError(f"{n} pending > padded {self.T}")
+        buf = np.zeros(self.buflen, dtype=np.float32)
+        buf[1] = n
+        buf[2] = time_to_expire
+        off = _HEADER
+        buf[off : off + n] = task_sizes
+        off += self.T
+        buf[off : off + self.W] = worker_speed; off += self.W
+        buf[off : off + self.W] = worker_free; off += self.W
+        buf[off : off + self.W] = worker_active; off += self.W
+        buf[off : off + self.W] = hb_age; off += self.W
+        buf[off : off + self.I] = inflight_worker
+        return self._run(self._broadcast(buf))
+
+    def lead_stop(self) -> None:
+        buf = np.zeros(self.buflen, dtype=np.float32)
+        buf[0] = 1.0
+        self._broadcast(buf)
+        log.info("multihost stop broadcast sent")
+
+    # -- follower side -----------------------------------------------------
+    def follow_loop(self) -> None:
+        """Participate in broadcast + tick collectives until the lead sends
+        the stop flag. Blocks inside the broadcast between ticks."""
+        log.info(
+            "multihost follower %d: joined, waiting for ticks",
+            self.process_index,
+        )
+        ticks = 0
+        while True:
+            buf = self._broadcast(np.zeros(self.buflen, dtype=np.float32))
+            if self._run(buf) is None:
+                log.info(
+                    "multihost follower %d: stop after %d ticks",
+                    self.process_index, ticks,
+                )
+                return
+            ticks += 1

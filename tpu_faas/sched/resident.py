@@ -310,6 +310,12 @@ class ResidentScheduler(SchedulerArrays):
         self.use_priority = bool(use_priority)
         self._epoch = self.clock()
         self._arrivals: deque[_Arrival] = deque()
+        # arrivals bounced by a full pending buffer, in original arrival
+        # order; re-fronted onto _arrivals at the next tick. A separate
+        # queue (rather than extendleft per resolved packet) keeps FCFS
+        # across MULTIPLE resolved packets: per-packet front-insertion
+        # would put a later packet's rejects ahead of an earlier packet's
+        self._rejected: deque[_Arrival] = deque()
         self.slot_task: dict[int, str] = {}
         self._slot_meta: dict[int, _Arrival] = {}
         self._unresolved: deque[tuple[list[_Arrival], ResidentTickOutput]] = (
@@ -323,6 +329,38 @@ class ResidentScheduler(SchedulerArrays):
     def pending_add(self, task_id: str, size: float, priority: int = 0) -> None:
         self._arrivals.append(_Arrival(task_id, float(size), int(priority)))
 
+    def pending_bulk_load(
+        self,
+        ids: list[str],
+        sizes: np.ndarray,
+        priorities: np.ndarray | None = None,
+    ) -> None:
+        """Seed the device pending set with one full upload — the cold-start
+        path (dispatcher restart re-adopting thousands of QUEUED tasks at
+        once would otherwise drip through ceil(n/KA) delta packets). Only
+        valid on an empty pending state; steady-state arrivals use
+        pending_add."""
+        if self.slot_task or self._arrivals or self._unresolved:
+            raise RuntimeError("bulk load requires an empty pending state")
+        n = len(ids)
+        if n > self.max_pending:
+            raise ValueError(f"{n} tasks > max_pending={self.max_pending}")
+        self._ensure_state()
+        T = self.max_pending
+        s = np.zeros(T, dtype=np.float32)
+        s[:n] = np.asarray(sizes, dtype=np.float32)
+        v = np.zeros(T, dtype=bool)
+        v[:n] = True
+        p = np.zeros(T, dtype=np.int32)
+        if priorities is not None:
+            p[:n] = np.asarray(priorities, dtype=np.int32)
+        self._r_state = self._r_state._replace(
+            sizes=jnp.asarray(s), valid=jnp.asarray(v), prio=jnp.asarray(p)
+        )
+        for i, tid in enumerate(ids):
+            self.slot_task[i] = tid
+            self._slot_meta[i] = _Arrival(tid, float(s[i]), int(p[i]))
+
     @property
     def n_pending_host(self) -> int:
         """Tasks the host still considers pending (device slots + queued
@@ -330,6 +368,7 @@ class ResidentScheduler(SchedulerArrays):
         return (
             len(self.slot_task)
             + len(self._arrivals)
+            + len(self._rejected)
             + sum(len(a) for a, _ in self._unresolved)
         )
 
@@ -412,6 +451,11 @@ class ResidentScheduler(SchedulerArrays):
     # -- the tick ----------------------------------------------------------
     def tick_resident(self, now: float | None = None) -> ResidentTickOutput:
         self._ensure_state()
+        if self._rejected:
+            # bounced arrivals retry ahead of newer traffic, in their
+            # original order (_rejected is FCFS; extendleft reverses)
+            self._arrivals.extendleft(reversed(self._rejected))
+            self._rejected.clear()
         now_rel = (now if now is not None else self.clock()) - self._epoch
         hb_idx, hb_val, fr_idx, fr_val, if_idx, if_val = self._diff_deltas()
         if self._tte_host != self.time_to_expire:
@@ -497,11 +541,11 @@ class ResidentScheduler(SchedulerArrays):
                 else:
                     self.slot_task[slot] = a.task_id
                     self._slot_meta[slot] = a
-            # re-queue bounced arrivals at the FRONT in their original
-            # relative order (extendleft reverses, hence reversed()):
-            # admission is documented FCFS, a later task must not jump an
-            # earlier one just because both bounced
-            self._arrivals.extendleft(reversed(rejects))
+            # bounced arrivals queue for the next tick in FCFS order via
+            # _rejected (NOT front-inserted here: with several packets
+            # resolved in sequence, per-packet front-insertion would put a
+            # later packet's rejects ahead of an earlier packet's)
+            self._rejected.extend(rejects)
             rejected = len(rejects)
         if isinstance(out, _FlushOnly):
             return ResolvedTick([], [], np.empty(0, np.int64), rejected,

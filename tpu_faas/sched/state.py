@@ -197,6 +197,11 @@ class SchedulerArrays:
     clock: "callable" = time.monotonic
     #: placement kernel for the tick: rank (default) | auction | sinkhorn
     placement: str = "rank"
+    #: multi-process collective tick (parallel.multihost_tick.MultihostTick)
+    #: — when set, tick() routes through its lead_tick over the GLOBAL mesh
+    #: instead of the local device path; mutually exclusive with
+    #: mesh_devices (the MultihostTick owns the mesh)
+    multihost: "object | None" = None
     #: shard the pending-task axis over this many devices (0/None = single
     #: device). The tick then runs parallel.mesh.sharded_scheduler_tick:
     #: task arrays carry a NamedSharding over the "tasks" axis, fleet state
@@ -465,6 +470,21 @@ class SchedulerArrays:
             prio[:n] = task_priorities
         now_f = now if now is not None else self.clock()
         hb_age = (now_f - self.last_heartbeat).astype(np.float32)
+        if self.multihost is not None:
+            # collective tick over the global multi-process mesh; returns
+            # host-view arrays (the allgathered assignment). Priorities are
+            # not in the broadcast protocol (rank-path soft FCFS applies).
+            out = self.multihost.lead_tick(
+                np.asarray(task_sizes, dtype=np.float32),
+                self.worker_speed,
+                self.worker_free,
+                self.worker_active,
+                hb_age,
+                self.inflight_worker,
+                self.time_to_expire,
+            )
+            self.prev_live = out.live
+            return out
         if self._d_auction_stranded is not None and bool(
             self._d_auction_stranded
         ):
