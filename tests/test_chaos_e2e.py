@@ -22,6 +22,17 @@ from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
 from tpu_faas.workloads import sleep_task
 from tests.test_workers_e2e import _spawn_worker
 
+
+def _free_port() -> int:
+    """An ephemeral port for a dispatcher a test will (re)spawn on."""
+    import socket as socketlib
+
+    probe = socketlib.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
 N_TASKS = 40
 
 
@@ -124,12 +135,7 @@ def test_dispatcher_crash_restart_mid_run():
     reference's dispatcher is a single process whose death loses the fleet
     (SURVEY §5.4: QUEUED tasks announced during downtime are stranded
     forever)."""
-    import socket as socketlib
-
-    probe = socketlib.socket()
-    probe.bind(("127.0.0.1", 0))
-    port = probe.getsockname()[1]
-    probe.close()
+    port = _free_port()
 
     store_handle = start_store_thread()
     gw = start_gateway_thread(make_store(store_handle.url))
@@ -172,12 +178,7 @@ def test_dispatcher_and_worker_die_together():
     that knows about it — only the lease stamped on the RUNNING record can
     save it. A replacement dispatcher's rescan adopts RUNNING tasks whose
     lease went stale and re-dispatches them; every task completes."""
-    import socket as socketlib
-
-    probe = socketlib.socket()
-    probe.bind(("127.0.0.1", 0))
-    port = probe.getsockname()[1]
-    probe.close()
+    port = _free_port()
 
     store_handle = start_store_thread()
     gw = start_gateway_thread(make_store(store_handle.url))
@@ -270,5 +271,49 @@ def test_pull_worker_kill_loses_no_tasks():
                 w.wait()
         disp.stop()
         t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_resident_dispatcher_crash_restart_mid_run():
+    """Same disposable-dispatcher contract for --resident: the pending set
+    lives in DEVICE memory, which dies with the process — so the restart
+    must rebuild everything from the store (reconnects + startup rescan),
+    proving no task's fate ever depends on the resident device state."""
+    port = _free_port()
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp_a = _spawn_dispatcher(
+        port, store_handle.url, "--resident",
+        "--max-pending", "256", "--max-fleet", "64",
+    )
+    url = f"tcp://127.0.0.1:{port}"
+    worker = _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+    client = FaaSClient(gw.url)
+    disp_b = None
+    try:
+        fid = client.register(sleep_task)
+        first = [client.submit(fid, 0.5) for _ in range(4)]
+        time.sleep(1.2)
+
+        disp_a.kill()  # device-resident pending state dies here
+        disp_a.wait()
+        during = [client.submit(fid, 0.2) for _ in range(4)]
+        time.sleep(0.5)
+
+        disp_b = _spawn_dispatcher(
+            port, store_handle.url, "--resident",
+            "--max-pending", "256", "--max-fleet", "64",
+        )
+        assert [h.result(timeout=90) for h in first] == [0.5] * 4
+        assert [h.result(timeout=90) for h in during] == [0.2] * 4
+    finally:
+        worker.kill()
+        worker.wait()
+        for d in (disp_a, disp_b):
+            if d is not None and d.poll() is None:
+                d.kill()
+                d.wait()
         gw.stop()
         store_handle.stop()

@@ -148,6 +148,29 @@ def config_3_auction_1k_10k() -> dict:
         for _ in range(5)
     ]
     rank_ms = float(np.median(rank_reps))
+
+    # Heterogeneous leg: lognormal task costs over a mixed-speed fleet —
+    # the regime where the classic cold eps-ladder measured 18.7 k rounds
+    # (~18 s) on this chip. The analytic rank-dual seed + bounded rounds +
+    # rank spill (sched/auction.py) solve it complete in warm_rounds.
+    rng_h = np.random.default_rng(33)
+    speeds_h = rng_h.uniform(0.5, 4.0, n_workers).astype(np.float32)
+    base_h = rng_h.lognormal(0.0, 1.0, n_tasks).astype(np.float32)
+    hetero_template = PlacementProblem.build(
+        base_h, speeds_h, free, live, T=10_240, W=1_024
+    )
+    hetero = [
+        dataclasses.replace(
+            hetero_template,
+            task_size=jnp.asarray(
+                np.pad(base_h * (1 + i * 1e-4), (0, 10_240 - n_tasks))
+            ),
+        )
+        for i in range(12)
+    ]
+    out_h = run_auction(hetero[0])  # same trace as the uniform leg
+    ah = np.asarray(out_h.assignment)[:n_tasks]
+    hetero_ms = _pipeline_slope_ms(run_auction, hetero, 2, 10)
     cap = int(free.sum())
     sizes0 = np.full(n_tasks, 1.0, dtype=np.float32)
     return {
@@ -158,6 +181,9 @@ def config_3_auction_1k_10k() -> dict:
         "auction_warm_rounds": warm_rounds,
         "rank_match_ms": round(rank_ms, 4),
         "rank_match_reps_ms": [round(x, 4) for x in rank_reps],
+        "auction_hetero_ms": round(hetero_ms, 3),
+        "auction_hetero_rounds": int(out_h.n_rounds),
+        "placed_auction_hetero": int((ah >= 0).sum()),
         "placed_auction": int((a >= 0).sum()),
         "placed_auction_warm": int((aw >= 0).sum()),
         "placed_rank_match": int((r >= 0).sum()),

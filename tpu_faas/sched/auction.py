@@ -32,17 +32,21 @@ class AuctionResult(NamedTuple):
     assignment: jnp.ndarray  # i32[T] worker per task, -1 = stay queued
     n_rounds: jnp.ndarray  # i32 scalar
     prices: jnp.ndarray  # f32[S] final slot prices
-    #: bool scalar: admitted tasks left unassigned (round budget exhausted —
-    #: possible only warm-started from stale prices, or at max_rounds).
-    #: The caller's contract: drop the warm prices and re-solve cold next
-    #: tick (SchedulerArrays does this automatically)
+    #: bool scalar: the BIDDING budget ran out with admitted tasks still
+    #: unassigned. On the default seeded cold path the rank spill then
+    #: completes the assignment anyway (stranded=True + full placement =
+    #: "the near-tied tail was spilled"); on warm/ladder paths the
+    #: stragglers genuinely stay unassigned (QUEUED). Caller's contract
+    #: either way: drop any warm prices and re-solve cold next tick
+    #: (SchedulerArrays does this automatically)
     stranded: jnp.ndarray = None
 
 
 @partial(
     jax.jit,
     static_argnames=(
-        "max_slots", "max_rounds", "n_phases", "backend", "warm_rounds"
+        "max_slots", "max_rounds", "n_phases", "backend", "warm_rounds",
+        "seed_from_rank",
     ),
 )
 def auction_placement(
@@ -57,7 +61,8 @@ def auction_placement(
     n_phases: int = 10,
     backend: str = "auto",
     init_price: jnp.ndarray | None = None,  # f32[W * max_slots]
-    warm_rounds: int = 256,
+    warm_rounds: int = 64,
+    seed_from_rank: bool = True,
 ) -> AuctionResult:
     """``n_phases`` trades phase count against rounds-per-phase: each phase
     reset must repair prices to the finer eps, costing ~n/ratio rounds, so a
@@ -88,7 +93,15 @@ def auction_placement(
     entry by the smallest POSITIVE price (clamped at 0) — bids compare
     price *differences*, so the translation is free, and shifting by the
     positive floor rather than the global min keeps the re-base effective
-    in padded fleets where unused slots pin the global min to 0 forever."""
+    in padded fleets where unused slots pin the global min to 0 forever.
+
+    ``seed_from_rank`` (default): a COLD start opens from the analytic
+    dual prices of the rank matching (closed form for this separable
+    cost — see rank_dual_seed below) instead of climbing the eps ladder
+    from zero; on wide benefit ranges this is the difference between a
+    few rounds and tens of thousands. ``seed_from_rank=False`` keeps the
+    classic Bertsekas ladder (the general-cost machinery, and the
+    cross-check in tests)."""
     T = task_size.shape[0]
     W = worker_speed.shape[0]
     S = W * max_slots
@@ -228,7 +241,74 @@ def auction_placement(
             (price0, owner0, assigned0, jnp.int32(0), jnp.float32(jnp.inf)),
         )
 
-    if init_price is None:
+    def rank_dual_seed():
+        """Analytic near-equilibrium prices from the rank matching.
+
+        This kernel's cost is separable (size * inv_speed), so the optimal
+        matching pairs the k-th largest admitted task with the k-th
+        fastest valid slot, and adjacent-pair stability pins each price
+        step p_k - p_(k+1) to the interval
+            [size_(k+1) * d_k,  size_k * d_k],   d_k = inv_(k+1) - inv_(k)
+        (sorted indices; p of the slowest matched slot = 0; unmatched
+        slots = 0). The seed takes the MIDPOINT of each interval — one
+        sort + one reversed cumsum, no iteration — because the midpoint
+        gives BOTH neighbors a strict preference for their own slot:
+        bidding then opens at equilibrium and every task wins its slot in
+        round one (ties only within equal-size/equal-speed groups, where
+        any permutation is equally optimal and jitter resolves). The
+        endpoints are exactly indifferent and measurably catastrophic: a
+        minimal-dual seed left one straggler whose eviction chain crawled
+        eps-sized steps for the full 2000-round budget on a 10k x 4k-slot
+        lognormal problem, and the no-seed eps-ladder took 18.7k rounds /
+        ~18 s on the same input. eps-optimality is unaffected: any
+        starting prices preserve forward-auction eps-CS."""
+        inv_sorted = 1.0 / jnp.maximum(speed_key[slot_order_by_speed], 1e-6)
+        tkey = jnp.where(admitted, task_size, -inf)
+        size_sorted = jnp.maximum(jnp.sort(-tkey) * -1.0, 0.0)  # desc, >=0
+        j = jnp.arange(S, dtype=jnp.int32)
+        size_mid = jnp.zeros(S, dtype=jnp.float32)
+        # position j's contribution reads task j+1 and slot j+1: bounded by
+        # both array lengths (the n_match guard below masks the dynamic tail)
+        take = max(0, min(T - 1, S - 1))
+        if take > 0:
+            size_mid = size_mid.at[:take].set(
+                0.5 * (size_sorted[:take] + size_sorted[1 : take + 1])
+            )
+        diff = jnp.concatenate(
+            [inv_sorted[1:] - inv_sorted[:-1], jnp.zeros(1, jnp.float32)]
+        )
+        contrib = jnp.where(
+            j + 1 < n_match, size_mid * jnp.maximum(diff, 0.0), 0.0
+        )
+        p_sorted = jnp.cumsum(contrib[::-1])[::-1]
+        return jnp.zeros(S, dtype=jnp.float32).at[slot_order_by_speed].set(
+            p_sorted
+        )
+
+    def budget_cond(limit):
+        def cond_b(carry):
+            _, _, assigned_slot, r, _ = carry
+            unassigned = admitted & (assigned_slot < 0)
+            return jnp.logical_and(unassigned.any(), r < limit)
+
+        return cond_b
+
+    # the rank spill below is sound ONLY on the seeded cold path (its
+    # leftovers are near-indifferent by construction); warm/ladder paths
+    # keep the leave-QUEUED semantic for their stragglers
+    do_spill = init_price is None and seed_from_rank
+    if init_price is None and seed_from_rank:
+        # cold start, seeded: run the fine-eps loop directly from the
+        # analytic duals under the same bounded budget as a warm start —
+        # the bulk assigns in the first rounds (strict midpoint-dual
+        # preferences), and the near-tied tail that would otherwise crawl
+        # is closed by the rank spill below
+        price, owner, assigned_slot, rounds, _ = jax.lax.while_loop(
+            budget_cond(warm_rounds),
+            body,
+            (rank_dual_seed(), owner0, assigned0, jnp.int32(0), eps_final),
+        )
+    elif init_price is None:
         price, owner, assigned_slot, rounds, _ = ladder(
             jnp.zeros(S, dtype=jnp.float32)
         )
@@ -239,11 +319,6 @@ def auction_placement(
         # prices whose disequilibrium / eps quotient exceeds the budget
         # would grind in eps-sized increments for thousands of rounds, so
         # the loop stops and reports `stranded` instead (see docstring).
-        def cond_warm(carry):
-            _, _, assigned_slot, r, _ = carry
-            unassigned = admitted & (assigned_slot < 0)
-            return jnp.logical_and(unassigned.any(), r < warm_rounds)
-
         # Drift re-base: warm prices grow monotonically across a long tick
         # sequence (every win raises a price by >= eps) until price + eps
         # rounds to price in f32 and bidding stalls. A plain min() rebase is
@@ -257,7 +332,7 @@ def auction_placement(
         )
         shift = jnp.where(jnp.isfinite(pos_min), pos_min, 0.0)
         price, owner, assigned_slot, rounds, _ = jax.lax.while_loop(
-            cond_warm,
+            budget_cond(warm_rounds),
             body,
             (
                 jnp.maximum(init_price - shift, 0.0),
@@ -268,8 +343,42 @@ def auction_placement(
             ),
         )
 
+    # -- rank spill (seeded cold path only): close the near-tied tail ------
+    # On the SEEDED path, any admitted task still unassigned when the
+    # round budget ran out is, by construction, near-indifferent across
+    # the remaining free slots (bidding opened at analytic equilibrium, so
+    # tasks with a strict preference won in the opening rounds; what
+    # crawls is the eps-sized tie-breaking among ~equal candidates —
+    # measured: one straggler burned a 2000-round budget at 10k x 4k slots
+    # while the rest placed almost immediately). Pair leftovers rank-for-
+    # rank (largest task <-> fastest free slot, the Monge-optimal rule for
+    # this cost), which is exactly optimal WITHIN the leftover subproblem;
+    # the composition is not formally n*eps-optimal, but the measured
+    # total-cost delta vs full convergence is ~0.04% (see tests/test_
+    # sched_auction.py::test_auction_spill_cost_near_converged), bounded
+    # by the leftover count x the leftover price spread — small precisely
+    # because the seed makes leftovers near-tied. The warm and ladder
+    # paths do NOT spill: their stragglers carry no near-indifference
+    # guarantee (stale prices can be arbitrarily wrong), so they keep the
+    # leave-QUEUED semantic and the caller's cold re-solve handles them
+    # optimally one tick later.
     stranded = (admitted & (assigned_slot < 0)).any()
+    if do_spill:
+        leftover_task = admitted & (assigned_slot < 0)
+        leftover_slot = slot_valid & (owner < 0)
+        n_spill = jnp.minimum(leftover_task.sum(), leftover_slot.sum())
+        t_ord = jnp.argsort(-jnp.where(leftover_task, task_size, -inf))
+        s_ord = jnp.argsort(-jnp.where(leftover_slot, slot_speed, -inf))
+        Lsp = min(T, S)
+        ok = jnp.arange(Lsp) < n_spill
+        sp_tasks = jnp.where(ok, t_ord[:Lsp], T)
+        sp_slots = jnp.where(ok, s_ord[:Lsp], S)
+        assigned_slot = assigned_slot.at[sp_tasks].set(
+            sp_slots.astype(jnp.int32), mode="drop"
+        )
     assignment = jnp.where(
-        assigned_slot >= 0, slot_worker[jnp.clip(assigned_slot, 0)], -1
+        assigned_slot >= 0,
+        slot_worker[jnp.clip(assigned_slot, 0, S - 1)],
+        -1,
     ).astype(jnp.int32)
     return AuctionResult(assignment, rounds, price, stranded)
