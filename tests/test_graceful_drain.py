@@ -65,6 +65,20 @@ def test_tpu_push_graceful_drain():
     ]
     try:
         _drain_scenario(FaaSClient(gw.url), workers)
+
+        # The result handler writes the store record (which is what
+        # unblocks _drain_scenario's client polls) BEFORE popping the
+        # in-flight entry, and the drained worker's DEREGISTER may still
+        # sit in the recv queue — so these table states trail the client's
+        # view by one handler invocation. Poll briefly instead of racing.
+        def settled():
+            rows = list(disp.arrays.worker_ids.values())
+            procs = sorted(int(disp.arrays.worker_procs[r]) for r in rows)
+            return disp.arrays.n_inflight == 0 and procs == [0, 2]
+
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not settled():
+            time.sleep(0.02)
         assert disp.arrays.n_inflight == 0
         # exactly one row (the drained worker's) had its capacity zeroed by
         # the DEREGISTER handler; the survivor keeps its 2 processes

@@ -114,3 +114,31 @@ def test_multihost_dispatcher_serves_and_stops():
                 p.wait()
         gw.stop()
         store_handle.stop()
+
+
+def test_lead_failure_before_serving_releases_followers():
+    """The lead crashing BEFORE its serve loop (here: ZMQ bind on an
+    already-occupied port) must still broadcast the follower stop — a
+    stranded follower blocks forever inside a collective."""
+    store_handle = start_store_thread()
+    coord = _free_port()
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    taken_port = blocker.getsockname()[1]
+    blocker.listen(1)  # keep the port occupied for the lead's bind
+    follower = _spawn_dispatcher(1, coord, taken_port, store_handle.url)
+    lead = _spawn_dispatcher(0, coord, taken_port, store_handle.url)
+    try:
+        lead_out, _ = lead.communicate(timeout=120)
+        assert lead.returncode != 0  # it crashed, as arranged
+        assert "released multihost followers" in lead_out, lead_out[-2000:]
+        follower_out, _ = follower.communicate(timeout=60)
+        assert follower.returncode == 0, follower_out[-2000:]
+        assert "stop after" in follower_out
+    finally:
+        blocker.close()
+        for p in (lead, follower):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        store_handle.stop()

@@ -246,3 +246,35 @@ def test_resident_rejected_fcfs_across_multiple_packets():
 def test_resident_rejects_auction_and_mesh():
     with pytest.raises(ValueError):
         ResidentScheduler(max_workers=4, max_pending=8, placement="auction")
+
+
+def test_resident_dispatcher_bulk_loads_cold_backlog():
+    """A restart/adoption backlog bigger than one delta packet enters the
+    EMPTY device pending set via one bulk upload, not ceil(n/KA) flush
+    dispatches (and everything still places correctly)."""
+    from tpu_faas.dispatch.base import PendingTask
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.store import MemoryStore
+
+    d = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=MemoryStore(),
+        max_workers=16, max_pending=256, max_inflight=128,
+        resident=True, recover_queued=False,
+    )
+    try:
+        a = d.arrays
+        assert a.KA == 256  # clamped to max_pending; backlog must exceed it
+        for i in range(4):
+            a.register(b"w%d" % i, 4)
+        for i in range(300):
+            d.store.create_task(f"t{i}", "F", "P")
+            d.pending.append(PendingTask(f"t{i}", "F", "P"))
+        d.tick(intake=False)
+        # bulk path: the device set was filled by ONE upload (no flush
+        # packets queued), placements all went to the 16 free slots
+        assert len(a._unresolved) == 0  # tick drained them all
+        assert d.n_dispatched == 16  # 4 workers x 4 slots placed
+        assert len(d._resident_tasks) + d.n_dispatched == 300
+    finally:
+        d.close()
+        d.socket.close(linger=0)

@@ -88,6 +88,16 @@ def main(argv: list[str] | None = None) -> None:
         help="tpu-push: padded worker-fleet size",
     )
     ap.add_argument(
+        "--max-inflight", type=int, default=65536,
+        help="tpu-push: in-flight table capacity (multihost: part of the "
+        "shape contract every fleet process must agree on)",
+    )
+    ap.add_argument(
+        "--max-slots", type=int, default=8,
+        help="tpu-push: per-worker process slots considered per tick "
+        "(multihost: part of the shape contract)",
+    )
+    ap.add_argument(
         "--placement", choices=["rank", "auction", "sinkhorn"], default="rank",
         help="tpu-push: placement kernel (rank = Monge-optimal default with "
         "priority classes; auction = general costs; sinkhorn = soft "
@@ -227,10 +237,15 @@ def main(argv: list[str] | None = None) -> None:
                         jax.process_index(), jax.process_count(),
                         len(jax.devices()),
                     )
+                    # shape args mirror the lead's dispatcher kwargs below —
+                    # the broadcast buffer layout must agree byte-for-byte
+                    # in every process, which is why max-inflight/max-slots
+                    # are CLI flags rather than buried constructor defaults
                     MultihostTick(
                         max_pending=ns.max_pending,
                         max_workers=ns.max_fleet,
-                        max_inflight=65536,
+                        max_inflight=ns.max_inflight,
+                        max_slots=ns.max_slots,
                         use_sinkhorn=(ns.placement == "sinkhorn"),
                     ).follow_loop()
                     return
@@ -254,12 +269,57 @@ def main(argv: list[str] | None = None) -> None:
             tick_period=ns.tick_period,
             max_pending=ns.max_pending,
             max_workers=ns.max_fleet,
+            max_inflight=ns.max_inflight,
+            max_slots=ns.max_slots,
             placement=ns.placement,
             mesh_devices=ns.mesh or None,
             lease_timeout=ns.lease_timeout,
             multihost=ns.multihost,
             resident=ns.resident,
         )
+    if ns.mode == "tpu-push" and ns.multihost:
+        # Lead-side failure containment: once the followers joined the
+        # runtime they sit in a blocking collective, and ONLY the serve
+        # loop's finally releases them (lead_stop inside start()). Any
+        # failure before start() — ZMQ bind on a busy port, store refusal,
+        # a busy stats port — would otherwise exit the lead and strand
+        # every follower in the fleet forever.
+        d = None
+        serving = False
+        try:
+            d = cls(**kwargs)
+            log.info("%s dispatcher on %s:%d", ns.mode, ns.ip, ns.port)
+            if ns.stats_port:
+                d.serve_stats(ns.stats_port)
+            _install_stop_signals(d)
+            serving = True
+            d.start()  # its finally broadcasts the follower stop
+        except BaseException:
+            if not serving:
+                try:
+                    mt = getattr(getattr(d, "arrays", None), "multihost", None)
+                    if mt is None:
+                        from tpu_faas.parallel.multihost_tick import (
+                            MultihostTick,
+                        )
+
+                        mt = MultihostTick(
+                            max_pending=ns.max_pending,
+                            max_workers=ns.max_fleet,
+                            max_inflight=ns.max_inflight,
+                            max_slots=ns.max_slots,
+                            use_sinkhorn=(ns.placement == "sinkhorn"),
+                        )
+                    mt.lead_stop()
+                    log.info("released multihost followers before exiting")
+                except Exception:
+                    log.exception(
+                        "could not release multihost followers — they must "
+                        "be killed manually"
+                    )
+            raise
+        return
+
     d = cls(**kwargs)
     log.info("%s dispatcher on %s:%d", ns.mode, ns.ip, ns.port)
     if ns.stats_port:

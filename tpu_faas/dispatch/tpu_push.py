@@ -682,6 +682,33 @@ class TpuPushDispatcher(TaskDispatcher):
         a = self.arrays
         if intake:
             self._intake()
+        if (
+            len(self.pending) > a.KA
+            and not a.slot_task
+            and not a._arrivals
+            and not a._unresolved
+        ):
+            # cold-start/adoption backlog into an EMPTY device pending set:
+            # one full upload (pending_bulk_load) instead of dripping
+            # ceil(n/KA) delta flush dispatches through one tick
+            take = min(len(self.pending), a.max_pending)
+            batch = []
+            for _ in range(take):
+                t = self.pending.popleft()
+                if t.task_id in self._resident_tasks:
+                    continue
+                self._resident_tasks[t.task_id] = t
+                batch.append(t)
+            if batch:
+                a.pending_bulk_load(
+                    [t.task_id for t in batch],
+                    np.asarray(
+                        [t.size_estimate for t in batch], dtype=np.float32
+                    ),
+                    priorities=np.asarray(
+                        [t.priority or 0 for t in batch], dtype=np.int32
+                    ),
+                )
         while self.pending:
             t = self.pending.popleft()
             if t.task_id in self._resident_tasks:

@@ -85,6 +85,21 @@ def _unpack_header(packed):
 _HEADER = 5
 
 
+def _first_k_indices(mask, K: int):
+    """Indices of the first K set bits of ``mask``, in index order, -1
+    padded — one cumsum + one scatter, O(N), where the obvious stable
+    argsort costs O(N log N) (it shows: the redispatch compaction alone
+    sorted the 65k-row in-flight table every tick)."""
+    N = mask.shape[0]
+    pos = jnp.cumsum(mask) - 1
+    idx = jnp.where(mask & (pos < K), pos, K)
+    return (
+        jnp.full(K, -1, dtype=jnp.int32)
+        .at[idx]
+        .set(jnp.arange(N, dtype=jnp.int32), mode="drop")
+    )
+
+
 def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
                   use_priority):
     """Scatter one delta packet into the carried state. Traced helper shared
@@ -117,15 +132,15 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     )
 
     # -- arrivals into the first free pending slots ------------------------
-    # Stable argsort of the valid mask lists invalid slots first in index
-    # order — the device chooses slots deterministically, so the host can
-    # stay several unresolved ticks behind without a sync.
-    order = jnp.argsort(st.valid, stable=True)
+    # The device chooses slots deterministically (first invalid slots in
+    # index order), so the host can stay several unresolved ticks behind
+    # without a sync.
+    free_slots = _first_k_indices(~st.valid, KA)
     n_invalid = T - st.valid.sum().astype(jnp.int32)
     accept = jnp.minimum(n_arr, n_invalid)  # never overwrite live pending
     j = jnp.arange(KA, dtype=jnp.int32)
     ok = j < accept
-    slots = jnp.where(ok, order[:KA], T)
+    slots = jnp.where(ok, free_slots, T)
     sizes = st.sizes.at[slots].set(
         jnp.where(ok, arr_sizes, 0.0), mode="drop"
     )
@@ -133,7 +148,7 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     prio = st.prio
     if use_priority:
         prio = prio.at[slots].set(jnp.where(ok, arr_prio, 0), mode="drop")
-    arrival_slots = jnp.where(ok, order[:KA], -1).astype(jnp.int32)
+    arrival_slots = jnp.where(ok, free_slots, -1).astype(jnp.int32)
     return (
         _ResidentState(sizes, valid, prio, last_hb, free, inflight,
                        st.prev_live),
@@ -197,14 +212,18 @@ def _resident_tick(
 
     # -- compact placements to KP (slot, row) pairs ------------------------
     placed = out.assignment >= 0
-    porder = jnp.argsort(~placed, stable=True)  # placed slots first, by index
-    psl = porder[:KP]
-    pok = placed[psl]
-    placed_slots = jnp.where(pok, psl, -1).astype(jnp.int32)
-    placed_rows = jnp.where(pok, out.assignment[psl], -1)
+    placed_slots = _first_k_indices(placed, KP)
+    pok = placed_slots >= 0
+    placed_rows = jnp.where(
+        pok, out.assignment[jnp.clip(placed_slots, 0)], -1
+    )
     # clear ONLY reported placements; an over-KP surplus stays valid and is
     # re-placed (and reported) next tick
-    reported = jnp.zeros(T, dtype=bool).at[psl].set(pok)
+    reported = (
+        jnp.zeros(T, dtype=bool)
+        .at[jnp.where(pok, placed_slots, T)]
+        .set(True, mode="drop")
+    )
     valid_next = st.valid & ~reported
     # consume the reported placements' capacity ON DEVICE: a second tick
     # issued before the host resolves this one (the whole point of the
@@ -218,10 +237,7 @@ def _resident_tick(
     )
 
     # -- compact redispatch to KR in-flight slots --------------------------
-    rorder = jnp.argsort(~out.redispatch, stable=True)
-    rsl = rorder[:KR]
-    rok = out.redispatch[rsl]
-    redispatch_slots = jnp.where(rok, rsl, -1).astype(jnp.int32)
+    redispatch_slots = _first_k_indices(out.redispatch, KR)
 
     new_state = _ResidentState(
         st.sizes, valid_next, st.prio, st.last_hb, free_next, st.inflight,

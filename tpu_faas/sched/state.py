@@ -473,7 +473,20 @@ class SchedulerArrays:
         if self.multihost is not None:
             # collective tick over the global multi-process mesh; returns
             # host-view arrays (the allgathered assignment). Priorities are
-            # not in the broadcast protocol (rank-path soft FCFS applies).
+            # not in the broadcast protocol (rank-path soft FCFS applies) —
+            # say so ONCE rather than silently narrowing behavior vs the
+            # single-host path
+            if prio is not None and not getattr(
+                self, "_warned_multihost_priority", False
+            ):
+                from tpu_faas.utils.logging import get_logger
+
+                get_logger("sched.state").warning(
+                    "task priority hints are not part of the multihost "
+                    "broadcast protocol and are ignored — admission is "
+                    "FCFS under --multihost"
+                )
+                self._warned_multihost_priority = True
             out = self.multihost.lead_tick(
                 np.asarray(task_sizes, dtype=np.float32),
                 self.worker_speed,
