@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# One dispatcher fleet across TWO OS processes sharing a global device mesh
+# (the --multihost mode; parallel/multihost_tick.py). Here the "pod" is
+# simulated on CPUs (--cpu-pod-devices 4 per process, gloo collectives) so
+# the demo runs on any dev box; on Cloud TPU pod slices drop the
+# --coordinator/--process-id/--num-processes/--cpu-pod-devices flags — the
+# runtime auto-discovers them — and start one process per host.
+#
+# Process 0 (the lead) serves the real stack; process 1 contributes its
+# devices and follows the tick collectives. SIGTERM to the lead releases
+# the follower via the stop broadcast.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PIDS=()
+cleanup() {
+    # kill everything on ANY exit: a follower left behind blocks forever
+    # inside a collective and the ports stay held, breaking re-runs
+    kill -TERM "${PIDS[@]}" 2>/dev/null || true
+    sleep 1
+    kill -KILL "${PIDS[@]}" 2>/dev/null || true
+}
+trap cleanup EXIT
+
+python -m tpu_faas.store.server --port 6380 &
+STORE=$!; PIDS+=("$STORE")
+sleep 1
+python -m tpu_faas.gateway --port 8000 --store resp://127.0.0.1:6380 &
+GW=$!; PIDS+=("$GW")
+
+COMMON=(-m tpu-push --multihost --coordinator 127.0.0.1:7733
+        --num-processes 2 --cpu-pod-devices 4
+        --max-pending 64 --max-fleet 16 --tick-period 0.05
+        -p 5555 --store resp://127.0.0.1:6380)
+
+python -m tpu_faas.dispatch "${COMMON[@]}" --process-id 1 &
+FOLLOWER=$!; PIDS+=("$FOLLOWER")
+python -m tpu_faas.dispatch "${COMMON[@]}" --process-id 0 &
+LEAD=$!; PIDS+=("$LEAD")
+sleep 8
+
+python -m tpu_faas.worker.push_worker 4 tcp://127.0.0.1:5555 --hb &
+W1=$!; PIDS+=("$W1")
+sleep 2
+
+python - <<'PY'
+from tpu_faas.client import FaaSClient
+
+client = FaaSClient("http://127.0.0.1:8000")
+fid = client.register(lambda n: n * n)
+handles = [client.submit(fid, i) for i in range(16)]
+print("16 tasks over the 2-process global mesh:",
+      [h.result(timeout=120) for h in handles][:5], "...")
+PY
+
+kill -TERM "$LEAD"          # stop broadcast releases the follower
+wait "$LEAD" "$FOLLOWER" || true
+echo "done"                 # trap cleans up the rest

@@ -57,3 +57,48 @@ def test_two_process_global_mesh_sharded_tick():
     # both ranks computed the identical global placement
     assert lines[0] == lines[1], lines
     assert "placed=" in lines[0]
+
+
+def test_multihost_tick_host_side_redispatch_matches_kernel():
+    """lead_tick computes redispatch HOST-side (the in-flight table no
+    longer rides the broadcast); it must stay bit-identical to the device
+    kernel's formula on the same inputs. Runs single-process (a 1-process
+    'fleet' degenerates broadcast/allgather to identity), over the
+    suite's 8 virtual CPU devices."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tpu_faas.parallel.multihost_tick import MultihostTick
+    from tpu_faas.sched.state import scheduler_tick
+
+    T, W, I = 64, 16, 48
+    rng = np.random.default_rng(9)
+    mt = MultihostTick(max_pending=T, max_workers=W, max_slots=4)
+    sizes = rng.uniform(0.1, 5.0, 40).astype(np.float32)
+    speed = rng.uniform(0.5, 4.0, W).astype(np.float32)
+    free = rng.integers(0, 4, W).astype(np.int32)
+    active = np.ones(W, dtype=bool)
+    hb_age = rng.uniform(0.0, 15.0, W).astype(np.float32)  # some dead
+    inflight = rng.integers(-1, W, I).astype(np.int32)
+
+    out = mt.lead_tick(sizes, speed, free, active, hb_age, inflight, 10.0)
+
+    padded = np.zeros(mt.T, dtype=np.float32)
+    padded[:40] = sizes
+    ref = scheduler_tick(
+        jnp.asarray(padded),
+        jnp.arange(mt.T) < 40,
+        jnp.asarray(speed),
+        jnp.asarray(free),
+        jnp.asarray(active),
+        jnp.asarray(hb_age),
+        jnp.zeros(W, dtype=bool),  # prev_live: first tick on both sides
+        jnp.asarray(inflight),
+        jnp.float32(10.0),
+        max_slots=4,
+    )
+    np.testing.assert_array_equal(out.live, np.asarray(ref.live))
+    np.testing.assert_array_equal(
+        out.redispatch, np.asarray(ref.redispatch)
+    )
+    assert out.redispatch.any()  # the case is non-trivial

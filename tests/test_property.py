@@ -172,3 +172,30 @@ def test_race_monitor_accepts_all_legal_histories(script):
             m.observe("d", "finish", tid, {"status": "COMPLETED", "result": "r"})
             stage[tid] = 3
     m.assert_clean()
+
+
+def test_first_k_indices_matches_numpy_reference():
+    """sched.resident._first_k_indices == np.flatnonzero(mask)[:K] (with
+    -1 padding), across random masks, K sizes, and edge cases."""
+    import jax.numpy as jnp
+
+    from tpu_faas.sched.resident import _first_k_indices
+
+    rng = np.random.default_rng(41)
+    cases = [
+        (np.zeros(16, bool), 4),
+        (np.ones(16, bool), 4),
+        (np.ones(16, bool), 16),
+        (np.zeros(1, bool), 1),
+    ] + [
+        (rng.random(int(rng.integers(1, 300))) < p, int(rng.integers(1, 64)))
+        for p in (0.01, 0.2, 0.5, 0.9)
+        for _ in range(4)
+    ]
+    for mask, K in cases:
+        K = min(K, len(mask))
+        got = np.asarray(_first_k_indices(jnp.asarray(mask), K))
+        want = np.full(K, -1, dtype=np.int32)
+        idx = np.flatnonzero(mask)[:K]
+        want[: len(idx)] = idx
+        np.testing.assert_array_equal(got, want, err_msg=f"K={K} n={len(mask)}")

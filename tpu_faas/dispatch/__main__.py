@@ -89,8 +89,8 @@ def main(argv: list[str] | None = None) -> None:
     )
     ap.add_argument(
         "--max-inflight", type=int, default=65536,
-        help="tpu-push: in-flight table capacity (multihost: part of the "
-        "shape contract every fleet process must agree on)",
+        help="tpu-push: in-flight table capacity (lead-local: the table "
+        "never rides the multihost broadcast)",
     )
     ap.add_argument(
         "--max-slots", type=int, default=8,
@@ -238,13 +238,12 @@ def main(argv: list[str] | None = None) -> None:
                         len(jax.devices()),
                     )
                     # shape args mirror the lead's dispatcher kwargs below —
-                    # the broadcast buffer layout must agree byte-for-byte
-                    # in every process, which is why max-inflight/max-slots
-                    # are CLI flags rather than buried constructor defaults
+                    # the broadcast buffer layout and the kernel's statics
+                    # must agree in every process, which is why max-slots is
+                    # a CLI flag rather than a buried constructor default
                     MultihostTick(
                         max_pending=ns.max_pending,
                         max_workers=ns.max_fleet,
-                        max_inflight=ns.max_inflight,
                         max_slots=ns.max_slots,
                         use_sinkhorn=(ns.placement == "sinkhorn"),
                     ).follow_loop()

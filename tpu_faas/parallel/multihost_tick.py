@@ -22,11 +22,15 @@ Determinism note: followers never see host scheduler state except through
 the broadcast buffer, and the kernel is deterministic, so per-process
 carried state (prev_live) stays bit-identical without synchronization.
 
-Transfer note: the buffer re-broadcasts the full fleet + inflight vectors
-every tick (~0.3 MB at default caps). That is deliberate v1 simplicity —
-correctness first; the delta-packet discipline the single-host resident
-path uses (sched/resident.py) composes with this design if DCN broadcast
-ever shows up in a profile.
+Transfer note: the buffer re-broadcasts the pending sizes + per-worker
+vectors every tick (~64 KB at default caps). The in-flight table is NOT
+broadcast: redispatch = occupied & ~live[owner] is elementwise in `live`,
+which the collective tick returns replicated — so the lead computes it
+host-side from its own table, saving the largest buffer section (256 KB
+at default caps) and the kernel's gather over it. The delta-packet
+discipline the single-host resident path uses (sched/resident.py)
+composes with this design if the remaining DCN broadcast ever shows up
+in a profile.
 """
 
 from __future__ import annotations
@@ -53,7 +57,7 @@ class MultihostTick:
         self,
         max_pending: int,
         max_workers: int,
-        max_inflight: int,
+        max_inflight: int | None = None,  # unused: kept for call symmetry
         max_slots: int = 8,
         use_sinkhorn: bool = False,
     ) -> None:
@@ -63,7 +67,6 @@ class MultihostTick:
 
         self.T = int(max_pending)
         self.W = int(max_workers)
-        self.I = int(max_inflight)
         self.max_slots = int(max_slots)
         self.use_sinkhorn = bool(use_sinkhorn)
         n_dev = len(jax.devices())
@@ -75,8 +78,8 @@ class MultihostTick:
                 f"global mesh got {self.mesh.size} devices, expected {n_dev}"
             )
         # buffer layout: header ++ sizes[T] ++ speed[W] ++ free[W] ++
-        # active[W] ++ hb_age[W] ++ inflight[I]
-        self.buflen = _HEADER + self.T + 4 * self.W + self.I
+        # active[W] ++ hb_age[W]  (no inflight section — see module doc)
+        self.buflen = _HEADER + self.T + 4 * self.W
         self._prev_live = None  # device, replicated; carried across ticks
         self.process_index = jax.process_index()
 
@@ -95,7 +98,7 @@ class MultihostTick:
 
         if buf[0] > 0.5:
             return None
-        T, W, I = self.T, self.W, self.I
+        T, W = self.T, self.W
         n_valid = int(buf[1])
         tte = np.float32(buf[2])
         off = _HEADER
@@ -103,8 +106,7 @@ class MultihostTick:
         speed = buf[off : off + W]; off += W
         free = buf[off : off + W].astype(np.int32); off += W
         active = buf[off : off + W] > 0.5; off += W
-        hb_age = buf[off : off + W]; off += W
-        inflight = buf[off : off + I].astype(np.int32)
+        hb_age = buf[off : off + W]
 
         task_sh = NamedSharding(self.mesh, P(TASK_AXIS))
         repl = NamedSharding(self.mesh, P())
@@ -121,7 +123,11 @@ class MultihostTick:
         d_free = put(free, repl)
         d_active = put(active, repl)
         d_hb = put(hb_age, repl)
-        d_infl = put(inflight, repl)
+        # redispatch is computed by the LEAD from its own in-flight table
+        # (elementwise in the returned live vector) — the kernel's gather
+        # runs over a length-1 dummy so the collective never carries the
+        # table
+        d_infl = put(np.full(1, -1, dtype=np.int32), repl)
         if self._prev_live is None:
             self._prev_live = put(np.zeros(W, dtype=bool), repl)
 
@@ -150,7 +156,7 @@ class MultihostTick:
             np.asarray(assignment),
             np.asarray(out.live),  # replicated outputs read locally
             np.asarray(out.purged),
-            np.asarray(out.redispatch),
+            None,  # lead fills redispatch from its own table (lead_tick)
         )
 
     # -- lead side ---------------------------------------------------------
@@ -181,9 +187,13 @@ class MultihostTick:
         buf[off : off + self.W] = worker_speed; off += self.W
         buf[off : off + self.W] = worker_free; off += self.W
         buf[off : off + self.W] = worker_active; off += self.W
-        buf[off : off + self.W] = hb_age; off += self.W
-        buf[off : off + self.I] = inflight_worker
-        return self._run(self._broadcast(buf))
+        buf[off : off + self.W] = hb_age
+        out = self._run(self._broadcast(buf))
+        # redispatch host-side from the lead's own table: elementwise in
+        # the replicated live vector, identical to the kernel's formula
+        occupied = inflight_worker >= 0
+        redispatch = occupied & ~out.live[np.clip(inflight_worker, 0, None)]
+        return out._replace(redispatch=redispatch)
 
     def lead_stop(self) -> None:
         buf = np.zeros(self.buflen, dtype=np.float32)
