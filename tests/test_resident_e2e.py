@@ -131,3 +131,67 @@ def test_resident_priority_admission_e2e():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def test_resident_and_plain_shared_dispatchers_exactly_once():
+    """The last untested mode pairing: a --resident dispatcher and a plain
+    tpu-push dispatcher SHARING one store+channel. Claims partition the
+    stream (every task runs exactly once), and both make progress."""
+    from tests.test_shared_dispatchers import _wait_until_hot
+    from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(
+        RaceCheckStore(make_store(store_handle.url), monitor, actor="gateway")
+    )
+
+    def make(name, **kw):
+        from tests.test_tpu_push_e2e import _make_dispatcher
+
+        return _make_dispatcher(
+            store_handle.url,
+            store=RaceCheckStore(
+                make_store(store_handle.url), monitor, actor=name
+            ),
+            max_pending=8,  # small window: both must claim (see
+            # test_shared_dispatchers for the determinism argument)
+            tick_period=0.01,
+            shared=True,
+            **kw,
+        )
+
+    d1 = make("resident-disp", resident=True)
+    d2 = make("plain-disp")
+    threads = [
+        threading.Thread(target=d.start, daemon=True) for d in (d1, d2)
+    ]
+    for t in threads:
+        t.start()
+    workers = [
+        _spawn_worker(
+            "push_worker", 2, f"tcp://127.0.0.1:{d.port}", "--hb",
+            "--hb-period", "0.3",
+        )
+        for d in (d1, d2)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        _wait_until_hot(d1, d2)
+        fid = client.register(sleep_task)
+        handles = [client.submit(fid, 0.3) for _ in range(40)]
+        assert [h.result(timeout=180) for h in handles] == [0.3] * 40
+        assert d1.n_dispatched + d2.n_dispatched == 40
+        assert d1.n_dispatched > 0 and d2.n_dispatched > 0
+        monitor.assert_clean()
+        assert monitor.unfinished() == []
+    finally:
+        for w in workers:
+            w.kill()
+            w.wait()
+        d1.stop()
+        d2.stop()
+        for t in threads:
+            t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
