@@ -278,3 +278,22 @@ def test_resident_dispatcher_bulk_loads_cold_backlog():
     finally:
         d.close()
         d.socket.close(linger=0)
+
+
+def test_resident_sinkhorn_placement():
+    """--resident composes with placement=sinkhorn: the fused delta tick
+    runs the entropic kernel and placements stay legal and complete."""
+    r = _mk(placement="sinkhorn")
+    rng = np.random.default_rng(7)
+    for i in range(6):
+        r.register(b"w%d" % i, 2, speed=float(rng.uniform(0.5, 4.0)))
+    for i in range(10):
+        r.pending_add(f"t{i}", float(rng.uniform(0.5, 5.0)))
+    r.tick_resident()
+    res = _drain(r)[-1]
+    assert len(res.placed) == 10  # capacity 12 >= 10
+    counts = {}
+    for _, row in res.placed:
+        counts[row] = counts.get(row, 0) + 1
+    assert all(c <= 2 for c in counts.values())
+    assert res.n_pending == 0
