@@ -542,7 +542,17 @@ class ResidentScheduler(SchedulerArrays):
         """Consume the oldest unresolved tick: map its arrivals to slots,
         its reported placements to task ids. MUST be called in tick order
         (enforced by the internal queue). Returns None when nothing is
-        outstanding. Forces a device sync for that tick's outputs."""
+        outstanding. Forces a device sync for that tick's outputs.
+
+        Known bounded edge: between a tick's device-side free decrement
+        and this resolve's host mirror of it, an unrelated host free-count
+        change on the same worker row (a result arriving during a
+        store-outage-interrupted drain) diffs the host's HIGHER absolute
+        value onto the device, transiently restoring capacity the device
+        had consumed. Worst case a worker is handed more tasks than free
+        process slots for one such window; push workers queue excess work
+        in their pool rather than failing (they have no admission gate by
+        protocol design), and the counts reconcile at the next resolve."""
         if not self._unresolved:
             return None
         arrivals, out = self._unresolved.popleft()
