@@ -58,6 +58,7 @@ def _spawn_dispatcher(rank: int, coord: int, zmq_port: int, store_url: str):
         "--max-pending", "64",
         "--max-fleet", "16",
         "--tick-period", "0.05",
+        "--tte", "2.0",  # fast purge so the crash leg stays snappy
         "--store", store_url,
     ]
     return subprocess.Popen(
@@ -73,12 +74,15 @@ def test_multihost_dispatcher_serves_and_stops():
     coord, zmq_port = _free_port(), _free_port()
     follower = _spawn_dispatcher(1, coord, zmq_port, store_handle.url)
     lead = _spawn_dispatcher(0, coord, zmq_port, store_handle.url)
-    worker = None
+    workers = []
     try:
-        worker = _spawn_worker(
-            "push_worker", 4, f"tcp://127.0.0.1:{zmq_port}",
-            "--hb", "--hb-period", "0.3",
-        )
+        workers = [
+            _spawn_worker(
+                "push_worker", 2, f"tcp://127.0.0.1:{zmq_port}",
+                "--hb", "--hb-period", "0.3",
+            )
+            for _ in range(2)
+        ]
         client = FaaSClient(gw.url)
         fid = client.register(lambda x: x + 100, name="add100")
         handles = [client.submit(fid, i) for i in range(12)]
@@ -96,6 +100,19 @@ def test_multihost_dispatcher_serves_and_stops():
         assert len(done) == 12, f"only {len(done)}/12 completed"
         assert all(done[i] == i + 100 for i in range(12))
 
+        # -- worker crash under multihost: redispatch is computed by the
+        # LEAD host-side (the table no longer rides the broadcast); SIGKILL
+        # a worker holding slow tasks and everything must still complete
+        # on the survivor within the fleet's purge + re-dispatch machinery
+        from tpu_faas.workloads import sleep_task
+
+        fid2 = client.register(sleep_task)
+        slow = [client.submit(fid2, 1.0) for _ in range(6)]
+        time.sleep(1.0)  # some land on each 2-slot worker
+        workers[0].send_signal(signal.SIGKILL)
+        workers[0].wait()
+        assert [h.result(timeout=120.0) for h in slow] == [1.0] * 6
+
         # -- shutdown contract: SIGTERM the lead; the stop broadcast must
         # release the follower from its blocking collective
         os.kill(lead.pid, signal.SIGTERM)
@@ -105,9 +122,10 @@ def test_multihost_dispatcher_serves_and_stops():
         assert follower.returncode == 0, follower_out[-2000:]
         assert "stop after" in follower_out
     finally:
-        if worker is not None:
-            worker.kill()
-            worker.wait()
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
         for p in (lead, follower):
             if p.poll() is None:
                 p.kill()
