@@ -205,6 +205,66 @@ def test_resident_pipelined_ticks_never_double_book():
     assert r.worker_free[:2].sum() == 0
 
 
+def test_result_arrival_between_tick_and_resolve_cannot_overbook():
+    """The interleaving dd15b99 documented as a bounded over-booking
+    window: a tick's device-side placement decrement, then a host-side
+    result arrival on the SAME worker row before the host resolves the
+    tick. With the additive-delta free protocol the next tick must see
+    only the result's +1, never an absolute value resurrecting the slot
+    the device consumed."""
+    r = _mk()
+    r.register(b"w0", 2)  # 2 process slots
+    # one task in flight occupies a slot; the other is free
+    r.inflight_add("busy", 0)
+    r.worker_free[0] = 1
+    # tick 1: place one task into the last free slot (device free 1 -> 0)
+    r.pending_add("a", 1.0)
+    r.tick_resident()
+    # BEFORE resolving, the in-flight result arrives host-side and frees
+    # its slot — the host (still unaware of 'a') now believes free == 2
+    row = r.inflight_done("busy")
+    r.worker_free[row] = min(r.worker_free[row] + 1, int(r.worker_procs[row]))
+    # tick 2: two more pending tasks, but TRUE remaining capacity is one
+    # slot ('a' holds one, the result freed one)
+    r.pending_add("b", 1.0)
+    r.pending_add("c", 1.0)
+    r.tick_resident()
+    resolved = _drain(r)
+    placed = [p for res in resolved for p in res.placed]
+    names = sorted(tid for tid, _ in placed)
+    # 'a' plus exactly ONE of b/c — an absolute-value upload would have
+    # set device free to 2 and booked all three onto two process slots
+    assert len(placed) == 2
+    assert "a" in names
+
+
+def test_heartbeat_epoch_rebase_keeps_deltas_flowing():
+    """Past EPOCH_REBASE_S of uptime the epoch re-bases and every stamp
+    re-uploads, so f32 stamp spacing never approaches heartbeat
+    granularity (advisor finding, round 3)."""
+    r = _mk()
+    r.register(b"w0", 2)
+    r.pending_add("a", 1.0)
+    r.tick_resident()
+    _drain(r)
+    epoch0 = r._epoch
+    # jump far past the re-base horizon; the worker keeps heartbeating
+    r._clock_box[0] += ResidentScheduler.EPOCH_REBASE_S + 12_345.0
+    r.heartbeat(b"w0")
+    r.pending_add("b", 1.0)
+    out = r.tick_resident()
+    res = _drain(r)[-1]
+    assert r._epoch > epoch0  # re-based
+    assert not np.asarray(out.purged).any()  # fresh heartbeat survived
+    assert len(res.placed) == 1  # and placement still works
+    # subsequent sub-second heartbeats produce small, well-resolved ages
+    r._clock_box[0] += 0.25
+    r.heartbeat(b"w0")
+    out2 = r.tick_resident()
+    _drain(r)
+    assert not np.asarray(out2.purged).any()
+
+
 def test_resident_rejected_arrivals_keep_fcfs_order():
     """Bounced arrivals re-queue for the next tick in original order."""
     r = _mk(max_pending=4, max_workers=4)

@@ -122,8 +122,18 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     last_hb = st.last_hb.at[jnp.where(m, hb_idx, W)].set(
         jnp.where(m, hb_val, 0.0), mode="drop"
     )
+    # free counts travel as ADDITIVE deltas, not absolute values. The device
+    # itself decrements free for every placement it reports (see
+    # _resident_tick), possibly several ticks before the host resolves the
+    # readback and mirrors the decrement. An absolute ``set`` here could
+    # interleave wrong: a host-side free change (result arrival) diffed
+    # between the device's decrement and the host's mirror would upload the
+    # host's HIGHER absolute value and resurrect capacity the device had
+    # already consumed — the over-booking window commit dd15b99 documented.
+    # Additive deltas commute with the device's own decrements, so both
+    # sides converge to the same count under ANY interleaving.
     m = jnp.arange(KF) < n_free
-    free = st.free.at[jnp.where(m, free_idx, W)].set(
+    free = st.free.at[jnp.where(m, free_idx, W)].add(
         jnp.where(m, free_val, 0), mode="drop"
     )
     m = jnp.arange(KI) < n_infl
@@ -292,6 +302,10 @@ class ResidentScheduler(SchedulerArrays):
     KP: int = 2048  # reported placements / tick
     KR: int = 512  # reported redispatches / tick
     use_priority: bool = False
+    #: dispatcher uptime (seconds) after which the heartbeat epoch is
+    #: re-based — f32 epoch-relative stamps must never approach the ~2^23 s
+    #: regime where their spacing reaches heartbeat granularity
+    EPOCH_REBASE_S: float = float(1 << 20)
 
     def __init__(
         self,
@@ -421,9 +435,16 @@ class ResidentScheduler(SchedulerArrays):
         hb_idx = np.flatnonzero(hb != self._hb_sent)
         hb_val = hb[hb_idx]
         self._hb_sent[hb_idx] = hb_val
+        # free counts: ship the DIFFERENCE since the last packet (the device
+        # adds it — see _apply_deltas for why set-semantics would race with
+        # the device's own placement decrements). _free_sent is thus "the
+        # host-side view the device has been told about": the device's true
+        # value is _free_sent minus its unmirrored placement decrements.
         fr_idx = np.flatnonzero(self.worker_free != self._free_sent)
-        fr_val = self.worker_free[fr_idx]
-        self._free_sent[fr_idx] = fr_val
+        fr_val = (self.worker_free[fr_idx] - self._free_sent[fr_idx]).astype(
+            np.int64
+        )
+        self._free_sent[fr_idx] = self.worker_free[fr_idx]
         if self._inflight_delta:
             if_idx = np.fromiter(
                 self._inflight_delta.keys(), np.int64,
@@ -472,7 +493,24 @@ class ResidentScheduler(SchedulerArrays):
             # original order (_rejected is FCFS; extendleft reverses)
             self._arrivals.extendleft(reversed(self._rejected))
             self._rejected.clear()
-        now_rel = (now if now is not None else self.clock()) - self._epoch
+        now_abs = now if now is not None else self.clock()
+        if now_abs - self._epoch > self.EPOCH_REBASE_S:
+            # Heartbeat stamps cross the wire as f32 epoch-RELATIVE seconds;
+            # past ~2^23 s of uptime f32 spacing reaches 1 s and sub-second
+            # heartbeat updates can round onto the previously-sent stamp,
+            # producing no delta — hb_age then inflates until live workers
+            # are spuriously purged. Re-base the epoch long before that
+            # (2^20 s ≈ 12 days) and force a stamp re-upload: NaN compares
+            # unequal to everything, so every invalidated row diffs, and
+            # the overflow flush below drains the surplus in KH-sized
+            # packets within this same tick. Only FINITE stamps re-upload:
+            # -inf (never-heard rows) is identical under any epoch, and a
+            # sparsely-populated large fleet must not pay a full-table
+            # flush for rows that hold nothing.
+            self._epoch = now_abs
+            if self._hb_sent is not None:
+                self._hb_sent[np.isfinite(self._hb_sent)] = np.nan
+        now_rel = now_abs - self._epoch
         hb_idx, hb_val, fr_idx, fr_val, if_idx, if_val = self._diff_deltas()
         if self._tte_host != self.time_to_expire:
             self._d_tte = jnp.float32(self.time_to_expire)
@@ -544,15 +582,18 @@ class ResidentScheduler(SchedulerArrays):
         (enforced by the internal queue). Returns None when nothing is
         outstanding. Forces a device sync for that tick's outputs.
 
-        Known bounded edge: between a tick's device-side free decrement
-        and this resolve's host mirror of it, an unrelated host free-count
-        change on the same worker row (a result arriving during a
-        store-outage-interrupted drain) diffs the host's HIGHER absolute
-        value onto the device, transiently restoring capacity the device
-        had consumed. Worst case a worker is handed more tasks than free
-        process slots for one such window; push workers queue excess work
-        in their pool rather than failing (they have no admission gate by
-        protocol design), and the counts reconcile at the next resolve."""
+        Capacity consistency: the device already decremented worker_free
+        for every placement reported here, so this resolve mirrors the
+        decrement into BOTH the live host array and the sent-copy (no diff
+        is emitted for it). Because the wire protocol ships free counts as
+        additive deltas (_diff_deltas), a host-side free change landing
+        BETWEEN the device's decrement and this mirror — a result arriving
+        during a store-outage-interrupted drain — uploads only its own +1,
+        never an absolute value that would resurrect the consumed slot:
+        the over-booking window the absolute-set protocol had (documented
+        in commit dd15b99, provoked by tests/test_sched_resident.py::
+        test_result_arrival_between_tick_and_resolve_cannot_overbook)
+        cannot occur."""
         if not self._unresolved:
             return None
         arrivals, out = self._unresolved.popleft()
@@ -584,17 +625,25 @@ class ResidentScheduler(SchedulerArrays):
                 break  # compaction puts pads last
             slot = int(slot)
             row = int(row)
-            # mirror the kernel's capacity decrement into BOTH the live
-            # array and the sent-copy: the device already consumed this
-            # slot, so the diff must not re-send it. A caller that decides
-            # NOT to dispatch a placement increments worker_free normally
-            # and the diff carries the correction up.
-            self.worker_free[row] -= 1
-            self._free_sent[row] -= 1
             tid = self.slot_task.pop(slot, None)
             self._slot_meta.pop(slot, None)
             if tid is not None:
+                # mirror the kernel's capacity decrement into BOTH the live
+                # array and the sent-copy: the device already consumed this
+                # slot, so the diff must not re-send it. A caller that
+                # decides NOT to dispatch a placement increments worker_free
+                # normally and the diff carries the correction up.
+                self.worker_free[row] -= 1
+                self._free_sent[row] -= 1
                 placed.append((tid, row))
+            else:
+                # no host mapping for the reported slot (defensive — slots
+                # are mapped at arrival resolve, in tick order): nothing
+                # will dispatch, so the device's consumed slot must come
+                # back. Mirror into the sent-copy ONLY; the next diff then
+                # carries worker_free - _free_sent = +1 up to the device,
+                # exactly the dispatcher's undo path.
+                self._free_sent[row] -= 1
         rd = np.asarray(out.redispatch_slots)
         redisp = [int(s) for s in rd if s >= 0]
         purged_rows = np.flatnonzero(np.asarray(out.purged))
