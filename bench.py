@@ -286,20 +286,48 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    # baseline: reference-style host greedy on the identical problem.
-    # 9 reps, median: the Python walk is at the mercy of host load and a
-    # 3-rep median wobbled the reported speedup by ~40% between captures
+    # baseline: the PINNED vs_baseline denominator is the numpy-vectorized
+    # host greedy (bit-identical policy to the reference's walk, equality
+    # pinned in tests) — the pure-Python heap walk's wall time swings with
+    # host load, and round-3 captures of the same build wobbled 24-35x on
+    # its account. The Python walk is still timed and reported as context:
+    # it is what the reference actually pays per decision.
+    from tpu_faas.sched.greedy import host_greedy_vectorized
+
     live = active & (hb_age <= 10.0)
-    bt = []
+    bt, bt_py = [], []
     for i in range(9):
         sizes_host = np.asarray(batches[i % len(batches)][:N_TASKS])
+        t0 = time.perf_counter()
+        host_greedy_vectorized(
+            sizes_host, speed, np.minimum(procs, MAX_SLOTS), live
+        )
+        bt.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
         host_greedy_reference(
             sizes_host, speed, np.minimum(procs, MAX_SLOTS), live
         )
-        bt.append(time.perf_counter() - t0)
+        bt_py.append(time.perf_counter() - t0)
     base_ms = float(np.median(bt) * 1000)
-    print(f"host greedy baseline: {base_ms:.1f} ms", file=sys.stderr)
+    base_spread_ms = [round(float(x * 1000), 3) for x in sorted(bt)]
+    base_py_ms = float(np.median(bt_py) * 1000)
+    print(
+        f"host greedy baseline: vectorized {base_ms:.2f} ms "
+        f"(spread {base_spread_ms[0]}-{base_spread_ms[-1]}), "
+        f"python walk {base_py_ms:.1f} ms",
+        file=sys.stderr,
+    )
+
+    import shutil
+
+    redis_interop = {
+        "real_redis_server": shutil.which("redis-server") is not None,
+        "note": (
+            "contract suite runs against a Redis-reply-shape fixture plus "
+            "byte-level wire pins; the real-server leg runs only where "
+            "redis-server is installed (tests/test_redis_compat.py)"
+        ),
+    }
 
     print(
         json.dumps(
@@ -307,7 +335,15 @@ def main() -> None:
                 "metric": "scheduler_tick_latency_50k_tasks_x_4k_workers",
                 "value": round(tick_ms, 3),
                 "unit": "ms",
+                # pinned denominator: numpy-vectorized greedy (identical
+                # policy, deterministic timing); the reference's actual
+                # pure-Python walk is reported alongside as context
                 "vs_baseline": round(base_ms / tick_ms, 2),
+                "baseline_vectorized_ms": round(base_ms, 3),
+                "baseline_vectorized_spread_ms": base_spread_ms,
+                "baseline_python_walk_ms": round(base_py_ms, 1),
+                "vs_python_walk": round(base_py_ms / tick_ms, 2),
+                "redis_interop": redis_interop,
                 "kernel_reps_ms": [round(r, 3) for r in reps],
                 "integrated_tick_50k_ms": round(integrated_ms, 3),
                 "integrated_path": "resident",

@@ -102,3 +102,45 @@ def test_multihost_tick_host_side_redispatch_matches_kernel():
         out.redispatch, np.asarray(ref.redispatch)
     )
     assert out.redispatch.any()  # the case is non-trivial
+
+
+def test_lead_mid_tick_failure_marks_fleet_broken(monkeypatch):
+    """A lead failure AFTER the broadcast leaves followers inside that
+    tick's collectives: the tick must mark the fleet broken, later ticks
+    must refuse immediately, and lead_stop must NOT issue the (mismatched)
+    stop broadcast that would hang the lead's own shutdown."""
+    import numpy as np
+    import pytest
+
+    from tpu_faas.parallel.multihost_tick import MultihostTick
+
+    mt = MultihostTick(max_pending=32, max_workers=8, max_slots=2)
+    broadcasts = []
+    monkeypatch.setattr(
+        mt, "_broadcast", lambda buf: broadcasts.append(1) or buf
+    )
+
+    def boom(buf):
+        raise RuntimeError("kernel error mid-tick")
+
+    monkeypatch.setattr(mt, "_run", boom)
+    args = (
+        np.ones(4, dtype=np.float32),
+        np.ones(8, dtype=np.float32),
+        np.ones(8, dtype=np.int32),
+        np.ones(8, dtype=bool),
+        np.zeros(8, dtype=np.float32),
+        np.full(4, -1, dtype=np.int32),
+        10.0,
+    )
+    with pytest.raises(RuntimeError, match="kernel error"):
+        mt.lead_tick(*args)
+    assert mt._broken
+    n_broadcasts = len(broadcasts)
+    # subsequent ticks refuse before broadcasting anything
+    with pytest.raises(RuntimeError, match="restarted"):
+        mt.lead_tick(*args)
+    assert len(broadcasts) == n_broadcasts
+    # and the stop path skips its broadcast instead of hanging
+    mt.lead_stop()
+    assert len(broadcasts) == n_broadcasts

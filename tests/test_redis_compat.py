@@ -259,3 +259,16 @@ def test_parser_handles_arbitrary_split_boundaries():
             got.append(item)
     assert got == expected
     assert p.pending() == 0
+
+
+def test_real_redis_interop_leg_visibility():
+    """The real-server interop leg must never vanish SILENTLY: when
+    redis-server is absent this shows up as an explicit skip in the run
+    summary (and bench.py records the same fact in its JSON artifact), so
+    'any Redis drops in' is never claimed on fixture evidence alone
+    without saying so."""
+    if REDIS is None:
+        pytest.skip(
+            "redis-server not installed: real-server interop leg NOT run "
+            "(contract verified against reply-shape fixture + wire pins)"
+        )

@@ -238,3 +238,22 @@ def test_scheduler_arrays_rejects_unknown_placement_at_construction():
     # to die on the first device tick of a typo'd kernel name
     with pytest.raises(ValueError, match="unknown placement"):
         SchedulerArrays(max_workers=4, max_pending=8, placement="magic")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_host_greedy_vectorized_matches_heap(seed):
+    """The numpy grant-order greedy is bit-identical to the heap walk it
+    vectorizes (the bench's pinned vs_baseline denominator)."""
+    from tpu_faas.sched.greedy import host_greedy_reference, host_greedy_vectorized
+
+    rng = np.random.default_rng(seed)
+    n_tasks = int(rng.integers(0, 500))
+    n_workers = int(rng.integers(1, 60))
+    sizes = rng.uniform(0.1, 5.0, n_tasks).astype(np.float32)
+    speeds = rng.uniform(0.5, 4.0, n_workers).astype(np.float32)
+    free = rng.integers(0, 5, n_workers).astype(np.int32)
+    live = rng.random(n_workers) > 0.2
+    np.testing.assert_array_equal(
+        host_greedy_vectorized(sizes, speeds, free, live),
+        host_greedy_reference(sizes, speeds, free, live),
+    )

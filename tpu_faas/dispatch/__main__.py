@@ -131,6 +131,13 @@ def main(argv: list[str] | None = None) -> None:
         help="join/form the multi-process global mesh before serving",
     )
     mh.add_argument(
+        "--follower-watchdog", type=float, default=900.0, metavar="S",
+        help="followers: hard-exit if one tick's collectives block longer "
+        "than this (lead died mid-tick — a blocked collective is not "
+        "interruptible). Set above the first tick's cold-compile time; "
+        "0 disables",
+    )
+    mh.add_argument(
         "--coordinator", default=None, metavar="HOST:PORT",
         help="jax.distributed coordinator address (default: auto-discover)",
     )
@@ -185,6 +192,29 @@ def main(argv: list[str] | None = None) -> None:
         elif ns.mode == "push":
             from tpu_faas.dispatch.push import PushDispatcher as cls
         else:
+            # persistent XLA compile cache (same pattern as bench.py): the
+            # tpu-push kernels cost tens of seconds of cold compile per
+            # (shape, placement) combination, and a restarting dispatcher
+            # that pays it again serves nothing for that whole window —
+            # worker registrations queue behind the first blocked tick.
+            # Cached, a restart re-adopts its queue and is placing within
+            # seconds. Opt out / relocate with TPU_FAAS_COMPILE_CACHE
+            # ("" disables; default ~/.cache/tpu_faas_xla).
+            import os
+
+            cache_dir = os.environ.get(
+                "TPU_FAAS_COMPILE_CACHE",
+                os.path.join(
+                    os.path.expanduser("~"), ".cache", "tpu_faas_xla"
+                ),
+            )
+            if cache_dir:
+                import jax
+
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0
+                )
             if cfg.platform:
                 # Pin the JAX backend BEFORE the tpu-push import pulls jax
                 # in (e.g. TPU_FAAS_PLATFORM=cpu + XLA_FLAGS=--xla_force_
@@ -246,7 +276,9 @@ def main(argv: list[str] | None = None) -> None:
                         max_workers=ns.max_fleet,
                         max_slots=ns.max_slots,
                         use_sinkhorn=(ns.placement == "sinkhorn"),
-                    ).follow_loop()
+                    ).follow_loop(
+                        watchdog_timeout=ns.follower_watchdog or None
+                    )
                     return
             from tpu_faas.dispatch.tpu_push import TpuPushDispatcher as cls
     except ImportError as exc:

@@ -82,6 +82,11 @@ class MultihostTick:
         self.buflen = _HEADER + self.T + 4 * self.W
         self._prev_live = None  # device, replicated; carried across ticks
         self.process_index = jax.process_index()
+        #: set when a lead tick failed AFTER its broadcast: the followers
+        #: are (or will be) blocked inside that tick's device collectives,
+        #: and any further collective from the lead — including the stop
+        #: broadcast — would be mismatched and hang this process too
+        self._broken = False
 
     # -- shared execution --------------------------------------------------
     def _run(self, buf: np.ndarray):
@@ -188,7 +193,36 @@ class MultihostTick:
         buf[off : off + self.W] = worker_free; off += self.W
         buf[off : off + self.W] = worker_active; off += self.W
         buf[off : off + self.W] = hb_age
-        out = self._run(self._broadcast(buf))
+        if self._broken:
+            raise RuntimeError(
+                "multihost tick previously failed mid-collective; the "
+                "fleet must be restarted (followers killed)"
+            )
+        # the broadcast itself stays OUTSIDE the broken-marking guard: if
+        # IT fails, the followers are still parked in their matching
+        # broadcast call — not in tick collectives — and a later stop
+        # broadcast remains matched and safe
+        shared = self._broadcast(buf)
+        try:
+            out = self._run(shared)
+        except Exception:
+            # The broadcast committed every follower to this tick's device
+            # collectives; a lead failure here (array placement, kernel
+            # error) leaves them blocked with no collective partner. There
+            # is no safe collective to issue from a diverged program — mark
+            # the fleet broken so lead_stop doesn't hang this process too,
+            # and tell the operator followers need killing (their
+            # --follower-watchdog self-exits them if enabled; a dead lead
+            # process also takes the coordination service with it, which
+            # fails follower heartbeats within the runtime's timeout).
+            self._broken = True
+            log.critical(
+                "multihost lead tick failed AFTER the broadcast: followers "
+                "are blocked in this tick's collectives and will not "
+                "receive a stop — kill them (or rely on their watchdog / "
+                "coordinator-heartbeat timeout) and restart the fleet"
+            )
+            raise
         # redispatch host-side from the lead's own table: elementwise in
         # the replicated live vector, identical to the kernel's formula
         occupied = inflight_worker >= 0
@@ -196,23 +230,69 @@ class MultihostTick:
         return out._replace(redispatch=redispatch)
 
     def lead_stop(self) -> None:
+        if self._broken:
+            # followers are stuck inside a failed tick's collectives, not
+            # parked in the broadcast — a stop broadcast here would be a
+            # MISMATCHED collective and hang the lead's shutdown as well
+            log.warning(
+                "multihost stop skipped: fleet marked broken by a failed "
+                "mid-tick collective (followers must be killed)"
+            )
+            return
         buf = np.zeros(self.buflen, dtype=np.float32)
         buf[0] = 1.0
         self._broadcast(buf)
         log.info("multihost stop broadcast sent")
 
     # -- follower side -----------------------------------------------------
-    def follow_loop(self) -> None:
+    def follow_loop(self, watchdog_timeout: float | None = None) -> None:
         """Participate in broadcast + tick collectives until the lead sends
-        the stop flag. Blocks inside the broadcast between ticks."""
+        the stop flag. Blocks inside the broadcast between ticks.
+
+        ``watchdog_timeout``: seconds a single tick's collectives may take
+        before this follower assumes the lead died mid-tick (see
+        lead_tick's failure note — a blocked collective is not
+        interruptible from Python) and hard-exits the process. Pick it
+        well above the first tick's cold-compile time. None/0 disables."""
         log.info(
             "multihost follower %d: joined, waiting for ticks",
             self.process_index,
         )
         ticks = 0
+        in_tick_since: list[float | None] = [None]
+        if watchdog_timeout:
+            import os
+            import threading
+            import time as _time
+
+            def watch() -> None:
+                while True:
+                    _time.sleep(min(watchdog_timeout / 4.0, 30.0))
+                    t0 = in_tick_since[0]
+                    if t0 is not None and (
+                        _time.monotonic() - t0 > watchdog_timeout
+                    ):
+                        log.critical(
+                            "multihost follower %d: tick stuck > %.0fs "
+                            "(lead died mid-collective?); exiting",
+                            self.process_index, watchdog_timeout,
+                        )
+                        os._exit(2)
+
+            threading.Thread(
+                target=watch, name="multihost-watchdog", daemon=True
+            ).start()
         while True:
+            # the idle park between ticks is the broadcast itself — only
+            # the tick's collectives are under the watchdog
             buf = self._broadcast(np.zeros(self.buflen, dtype=np.float32))
-            if self._run(buf) is None:
+            if watchdog_timeout:
+                import time as _time
+
+                in_tick_since[0] = _time.monotonic()
+            stopped = self._run(buf) is None
+            in_tick_since[0] = None
+            if stopped:
                 log.info(
                     "multihost follower %d: stop after %d ticks",
                     self.process_index, ticks,

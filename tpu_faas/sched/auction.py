@@ -32,14 +32,22 @@ class AuctionResult(NamedTuple):
     assignment: jnp.ndarray  # i32[T] worker per task, -1 = stay queued
     n_rounds: jnp.ndarray  # i32 scalar
     prices: jnp.ndarray  # f32[S] final slot prices
-    #: bool scalar: the BIDDING budget ran out with admitted tasks still
-    #: unassigned. On the default seeded cold path the rank spill then
-    #: completes the assignment anyway (stranded=True + full placement =
-    #: "the near-tied tail was spilled"); on warm/ladder paths the
-    #: stragglers genuinely stay unassigned (QUEUED). Caller's contract
-    #: either way: drop any warm prices and re-solve cold next tick
-    #: (SchedulerArrays does this automatically)
+    #: bool scalar: admitted tasks left unassigned AFTER the rank spill —
+    #: i.e. genuinely still QUEUED this tick. The spill runs on every
+    #: path, so this is the degenerate-inputs flag, not the common case.
     stranded: jnp.ndarray = None
+    #: bool scalar: the caller should DROP any carried warm prices and
+    #: re-solve cold next tick — raised when the bidding budget ran out
+    #: AND the spilled tail was a meaningful fraction of the matching
+    #: (stale prices: fleet upheaval / workload shift), or when tasks
+    #: stayed unassigned outright. A small spilled tail does NOT raise it:
+    #: near-equilibrium prices with a near-tied remainder are exactly the
+    #: state warm starts exist for (round-3 advisor finding: conflating
+    #: "budget ran out" with "placement incomplete" meant warm bidding
+    #: never engaged on workloads that routinely leave a tied tail).
+    refresh: jnp.ndarray = None
+    #: i32 scalar: tasks the rank spill placed after bidding stopped
+    n_spilled: jnp.ndarray = None
 
 
 @partial(
@@ -83,13 +91,13 @@ def auction_placement(
     unaffected: forward-auction eps-complementary-slackness is established
     pair-by-pair as bids win, for ANY starting prices (Bertsekas 1992). If
     the warm attempt doesn't complete within ``warm_rounds`` (prices too
-    stale — fleet upheaval, workload shift), the result carries
-    ``stranded=True`` and the caller re-solves cold next tick (an in-kernel
-    ladder fallback was tried and rejected: compiling the ladder a second
-    time inside a lax.cond multiplied XLA compile time by minutes at
-    dispatcher shapes, for a branch that near-equilibrium steady state
-    almost never takes; stranded tasks just stay QUEUED one extra tick,
-    which the FaaS lifecycle already makes free). Prices are re-based on
+    stale — fleet upheaval, workload shift), the rank spill completes the
+    placement IN-TICK and the result carries ``refresh=True`` so the
+    caller re-solves cold next tick (an in-kernel ladder fallback was
+    tried and rejected: compiling the ladder a second time inside a
+    lax.cond multiplied XLA compile time by minutes at dispatcher shapes,
+    for a branch that near-equilibrium steady state almost never takes).
+    Prices are re-based on
     entry by the smallest POSITIVE price (clamped at 0) — bids compare
     price *differences*, so the translation is free, and shifting by the
     positive floor rather than the global min keeps the re-base effective
@@ -293,10 +301,6 @@ def auction_placement(
 
         return cond_b
 
-    # the rank spill below is sound ONLY on the seeded cold path (its
-    # leftovers are near-indifferent by construction); warm/ladder paths
-    # keep the leave-QUEUED semantic for their stragglers
-    do_spill = init_price is None and seed_from_rank
     if init_price is None and seed_from_rank:
         # cold start, seeded: run the fine-eps loop directly from the
         # analytic duals under the same bounded budget as a warm start —
@@ -343,42 +347,49 @@ def auction_placement(
             ),
         )
 
-    # -- rank spill (seeded cold path only): close the near-tied tail ------
-    # On the SEEDED path, any admitted task still unassigned when the
-    # round budget ran out is, by construction, near-indifferent across
-    # the remaining free slots (bidding opened at analytic equilibrium, so
-    # tasks with a strict preference won in the opening rounds; what
-    # crawls is the eps-sized tie-breaking among ~equal candidates —
-    # measured: one straggler burned a 2000-round budget at 10k x 4k slots
-    # while the rest placed almost immediately). Pair leftovers rank-for-
-    # rank (largest task <-> fastest free slot, the Monge-optimal rule for
-    # this cost), which is exactly optimal WITHIN the leftover subproblem;
-    # the composition is not formally n*eps-optimal, but the measured
-    # total-cost delta vs full convergence is ~0.04% (see tests/test_
-    # sched_auction.py::test_auction_spill_cost_near_converged), bounded
-    # by the leftover count x the leftover price spread — small precisely
-    # because the seed makes leftovers near-tied. The warm and ladder
-    # paths do NOT spill: their stragglers carry no near-indifference
-    # guarantee (stale prices can be arbitrarily wrong), so they keep the
-    # leave-QUEUED semantic and the caller's cold re-solve handles them
-    # optimally one tick later.
+    # -- rank spill (every path): close the leftover tail IN-TICK ----------
+    # An exhausted bidding budget leaves a leftover set; pairing it
+    # rank-for-rank (largest task <-> fastest free slot) is the
+    # Monge-optimal rule for this separable cost WITHIN the leftover
+    # subproblem, so the tick's placement always completes — no task waits
+    # a tick for the cold re-solve (round-3 verdict: the previous
+    # leave-QUEUED-then-re-solve semantic cost a full tick of placement
+    # stall exactly during fleet upheaval, when latency matters most).
+    # Composition quality differs by where the leftovers came from: on the
+    # SEEDED cold path they are near-indifferent by construction (bidding
+    # opened at analytic equilibrium) and the measured total-cost delta vs
+    # full convergence is ~0.04% (tests/test_sched_auction.py::
+    # test_auction_spill_cost_near_converged); on a warm path with STALE
+    # prices the split between bid-assigned and spilled sets can be worse
+    # — which is what the `refresh` flag repairs: the next tick re-solves
+    # cold, and this tick's placement is still complete, legal, and
+    # rank-optimal within each set.
+    budget_exhausted = (admitted & (assigned_slot < 0)).any()
+    leftover_task = admitted & (assigned_slot < 0)
+    leftover_slot = slot_valid & (owner < 0)
+    n_spill = jnp.minimum(leftover_task.sum(), leftover_slot.sum())
+    t_ord = jnp.argsort(-jnp.where(leftover_task, task_size, -inf))
+    s_ord = jnp.argsort(-jnp.where(leftover_slot, slot_speed, -inf))
+    Lsp = min(T, S)
+    ok = jnp.arange(Lsp) < n_spill
+    sp_tasks = jnp.where(ok, t_ord[:Lsp], T)
+    sp_slots = jnp.where(ok, s_ord[:Lsp], S)
+    assigned_slot = assigned_slot.at[sp_tasks].set(
+        sp_slots.astype(jnp.int32), mode="drop"
+    )
     stranded = (admitted & (assigned_slot < 0)).any()
-    if do_spill:
-        leftover_task = admitted & (assigned_slot < 0)
-        leftover_slot = slot_valid & (owner < 0)
-        n_spill = jnp.minimum(leftover_task.sum(), leftover_slot.sum())
-        t_ord = jnp.argsort(-jnp.where(leftover_task, task_size, -inf))
-        s_ord = jnp.argsort(-jnp.where(leftover_slot, slot_speed, -inf))
-        Lsp = min(T, S)
-        ok = jnp.arange(Lsp) < n_spill
-        sp_tasks = jnp.where(ok, t_ord[:Lsp], T)
-        sp_slots = jnp.where(ok, s_ord[:Lsp], S)
-        assigned_slot = assigned_slot.at[sp_tasks].set(
-            sp_slots.astype(jnp.int32), mode="drop"
-        )
+    # drop warm prices when they demonstrably went stale: the spilled tail
+    # exceeded 5% of the matching (with a small-problem floor so a 2-task
+    # tail on a 20-task tick doesn't thrash the warm start), or placement
+    # is STILL incomplete
+    refresh = stranded | (
+        budget_exhausted
+        & (n_spill * 20 > jnp.maximum(n_match, 1))
+        & (n_spill > 8)
+    )
     assignment = jnp.where(
         assigned_slot >= 0,
         slot_worker[jnp.clip(assigned_slot, 0, S - 1)],
         -1,
     ).astype(jnp.int32)
-    return AuctionResult(assignment, rounds, price, stranded)
+    return AuctionResult(assignment, rounds, price, stranded, refresh, n_spill)

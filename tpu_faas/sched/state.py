@@ -92,10 +92,11 @@ class TickOutput(NamedTuple):
     #: None): fed back as next tick's warm start, device-resident between
     #: ticks — never read to host
     auction_price: jnp.ndarray | None = None
-    #: bool scalar (auction only): warm attempt left admitted tasks
-    #: unassigned; the NEXT tick must re-solve cold (host checks this one
-    #: tick late, when the value is long since computed — no extra sync)
-    auction_stranded: jnp.ndarray | None = None
+    #: bool scalar (auction only): the warm prices went demonstrably
+    #: stale (large spilled tail or incomplete placement) — the NEXT tick
+    #: must re-solve cold (host checks this one tick late, when the value
+    #: is long since computed — no extra sync)
+    auction_refresh: jnp.ndarray | None = None
     # NOTE deliberately NO per-worker assigned-count output: a T-wide
     # scatter-add with colliding indices measured ~0.5 ms of the ~1 ms tick
     # on v5e — and the host gets the full assignment vector anyway, where
@@ -152,7 +153,7 @@ def scheduler_tick(
         )
         return TickOutput(
             res.assignment, live, purged, redispatch, res.prices,
-            res.stranded,
+            res.refresh,
         )
     elif placement == "sinkhorn":
         T, W = task_size.shape[0], worker_speed.shape[0]
@@ -282,10 +283,10 @@ class SchedulerArrays:
         self._tte_host: float | None = None
         # auction placement: last tick's slot prices, fed back as the next
         # tick's warm start (device-resident, never read to host; see
-        # auction_placement's init_price). _d_auction_stranded is the
-        # previous tick's completeness flag, checked one tick late
+        # auction_placement's init_price). _d_auction_refresh is the
+        # previous tick's price-staleness flag, checked one tick late
         self._d_auction_price = None
-        self._d_auction_stranded = None
+        self._d_auction_refresh = None
 
     # -- membership (reference register/reconnect/purge semantics) ---------
     def register(
@@ -498,14 +499,16 @@ class SchedulerArrays:
             )
             self.prev_live = out.live
             return out
-        if self._d_auction_stranded is not None and bool(
-            self._d_auction_stranded
+        if self._d_auction_refresh is not None and bool(
+            self._d_auction_refresh
         ):
-            # last warm attempt exhausted its round budget (stale prices —
-            # fleet upheaval / workload shift): re-solve cold this tick.
-            # The bool() sync is on a value computed a whole tick ago.
+            # last warm attempt's prices went stale (budget exhausted with
+            # a large spilled tail — fleet upheaval / workload shift):
+            # re-solve cold this tick. The bool() sync is on a value
+            # computed a whole tick ago. A SMALL spilled tail does not
+            # land here: the prices stay warm and keep converging.
             self._d_auction_price = None
-        self._d_auction_stranded = None
+        self._d_auction_refresh = None
         if self.mesh is not None:
             ts = np.zeros(self.max_pending, dtype=np.float32)
             ts[:n] = task_sizes
@@ -543,7 +546,7 @@ class SchedulerArrays:
             )
             if self.placement == "auction":
                 self._d_auction_price = out.auction_price
-                self._d_auction_stranded = out.auction_stranded
+                self._d_auction_refresh = out.auction_refresh
         # keep prev_live DEVICE-resident: it is only ever fed back into the
         # next tick, and forcing it to host here would put a synchronous
         # device->host round trip inside every tick (over a tunneled dev
