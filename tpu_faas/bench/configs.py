@@ -413,6 +413,76 @@ def config_7_bid_headline() -> dict:
     return out
 
 
+def config_8_estimation() -> dict:
+    """Placement quality with NO client hints: unhinted (all-1.0) vs
+    operator-hinted (true sizes/speeds) vs LEARNED (the estimation loop,
+    sched/estimator.py) on one mixed fleet + mixed workload. The learned
+    column is the round-4 capability: the reference is size-blind
+    (task_dispatcher.py:297-322) and rounds 1-3 only matched hints."""
+    from tpu_faas.sched.estimator import RuntimeEstimator, fn_digest
+    from tpu_faas.sched.greedy import makespan, rank_match_placement
+
+    rng = np.random.default_rng(8)
+    n_workers, n_fns, max_slots = 256, 32, 4
+    n_tasks = n_workers * max_slots  # one full wave: makespans comparable
+    true_speeds = rng.uniform(0.5, 4.0, n_workers).astype(np.float32)
+    fn_sizes = rng.lognormal(0.0, 1.0, n_fns).astype(np.float32)
+
+    # learning phase: the observations a live dispatcher would collect
+    # (worker-measured elapsed = size/speed, with runtime jitter)
+    est = RuntimeEstimator()
+    wids = [f"w{i}".encode() for i in range(n_workers)]
+    digests = [fn_digest(f"fn{i}") for i in range(n_fns)]
+    n_obs = 4096
+    for _ in range(n_obs):
+        f = int(rng.integers(n_fns))
+        w = int(rng.integers(n_workers))
+        est.observe(
+            digests[f],
+            float(fn_sizes[f] / true_speeds[w] * rng.uniform(0.95, 1.05)),
+            wids[w],
+        )
+
+    task_fn = rng.integers(0, n_fns, n_tasks)
+    true_sizes = fn_sizes[task_fn].astype(np.float32)
+    valid = np.ones(n_tasks, dtype=bool)
+    free = np.full(n_workers, max_slots, dtype=np.int32)
+    live = np.ones(n_workers, dtype=bool)
+    learned_sizes = np.array(
+        [est.size_for(digests[int(f)]) or est.default_size()
+         for f in task_fn],
+        dtype=np.float32,
+    )
+    learned_speeds = np.array(
+        [est.speed_for(w) for w in wids], dtype=np.float32
+    )
+
+    def place(sizes, speeds):
+        a = np.asarray(
+            rank_match_placement(
+                np.asarray(sizes, dtype=np.float32), valid,
+                np.asarray(speeds, dtype=np.float32), free, live,
+                max_slots=max_slots,
+            )
+        )
+        return makespan(a, true_sizes, true_speeds, max_slots=max_slots)
+
+    ms_blind = place(np.ones(n_tasks), np.ones(n_workers))
+    ms_hinted = place(true_sizes, true_speeds)
+    ms_learned = place(learned_sizes, learned_speeds)
+    return {
+        "config": "estimation-unhinted-vs-hinted-vs-learned",
+        "n_workers": n_workers,
+        "n_tasks": n_tasks,
+        "n_observations": n_obs,
+        "makespan_unhinted": round(ms_blind, 3),
+        "makespan_hinted": round(ms_hinted, 3),
+        "makespan_learned": round(ms_learned, 3),
+        "learned_vs_unhinted": round(ms_blind / ms_learned, 2),
+        "learned_vs_hinted": round(ms_learned / ms_hinted, 3),
+    }
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -421,4 +491,5 @@ CONFIGS = {
     "5": config_5_churn_4k,
     "6": config_6_batch_register,
     "7": config_7_bid_headline,
+    "8": config_8_estimation,
 }

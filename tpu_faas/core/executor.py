@@ -30,6 +30,12 @@ class ExecutionResult(NamedTuple):
     task_id: str
     status: str  # plain string: "COMPLETED" | "FAILED" (wire/store form)
     result: str  # serialized payload (value or exception)
+    #: wall seconds the execution took IN THE POOL CHILD (deserialize +
+    #: call + serialize), measured at the source so it carries no pool
+    #: queueing or transport time; rides the RESULT message as `elapsed`
+    #: and feeds the dispatcher's runtime estimator (sched/estimator.py).
+    #: None on paths that never executed (cancelled futures, broken pools).
+    elapsed: float | None = None
 
 
 class TaskTimeout(BaseException):
@@ -71,12 +77,16 @@ def execute_fn(
     to the interpreter can't be interrupted — that residual case needs an
     operator killing the worker (purge + re-dispatch then recover the task).
     """
+    import time
+
+    t0 = time.perf_counter()
     try:
-        return _execute_guarded(task_id, ser_fn, ser_params, timeout)
+        res = _execute_guarded(task_id, ser_fn, ser_params, timeout)
     except TaskTimeout as exc:
         # the alarm landed in the narrow window between an exception being
         # caught and the timer disarm: still a clean FAILED, never a raise
-        return ExecutionResult(task_id, str(TaskStatus.FAILED), serialize(exc))
+        res = ExecutionResult(task_id, str(TaskStatus.FAILED), serialize(exc))
+    return res._replace(elapsed=time.perf_counter() - t0)
 
 
 def _execute_guarded(

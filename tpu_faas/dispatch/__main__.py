@@ -104,6 +104,12 @@ def main(argv: list[str] | None = None) -> None:
         "heterogeneous balancing)",
     )
     ap.add_argument(
+        "--no-runtime-learning", action="store_true",
+        help="tpu-push: disable the runtime-estimation loop (learned "
+        "per-function sizes + per-worker speeds feeding the placement "
+        "cost matrix; on by default)",
+    )
+    ap.add_argument(
         "--resident", action="store_true",
         help="tpu-push: keep ALL scheduler state (pending set, heartbeat "
         "stamps, free counts, in-flight table) device-resident between "
@@ -307,6 +313,7 @@ def main(argv: list[str] | None = None) -> None:
             lease_timeout=ns.lease_timeout,
             multihost=ns.multihost,
             resident=ns.resident,
+            estimate_runtimes=not ns.no_runtime_learning,
         )
     if ns.mode == "tpu-push" and ns.multihost:
         # Lead-side failure containment: once the followers joined the

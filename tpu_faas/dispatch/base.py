@@ -93,6 +93,10 @@ class PendingTask:
     #: execution time budget (gateway 'timeout' field), enforced in the pool
     #: child (core/executor.py) so a runaway task can't eat a slot forever
     timeout: float | None = None
+    #: dispatcher-learned size estimate (sched/estimator.py EWMA over
+    #: observed runtimes), stamped at batch-build time; an explicit client
+    #: cost hint still wins — the operator knows things the EWMA can't
+    learned: float | None = None
 
     def task_message_kwargs(self) -> dict:
         """The TASK wire message's payload fields (timeout rides along so
@@ -108,11 +112,16 @@ class PendingTask:
 
     @property
     def size_estimate(self) -> float:
-        """Task-cost signal for the scheduler: the client's cost hint when
-        given, else payload bytes (serialized params dominate for data-heavy
-        tasks)."""
+        """Task-cost signal for the scheduler, by trust order: the client's
+        explicit cost hint; else the dispatcher-learned runtime estimate
+        (stamped by the estimator at batch build); else payload bytes
+        (serialized params dominate for data-heavy tasks — and with no
+        learning data at all, bytes are at least a consistent scale across
+        the whole batch)."""
         if self.cost is not None:
             return self.cost
+        if self.learned is not None:
+            return self.learned
         return float(len(self.fn_payload) + len(self.param_payload))
 
     @classmethod

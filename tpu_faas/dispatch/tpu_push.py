@@ -43,6 +43,7 @@ from tpu_faas.dispatch.base import (
     PendingTask,
     TaskDispatcher,
 )
+from tpu_faas.sched.estimator import RuntimeEstimator, fn_digest
 from tpu_faas.sched.state import SchedulerArrays
 from tpu_faas.store.base import LIVE_INDEX_KEY
 from tpu_faas.utils.logging import TickTracer
@@ -74,10 +75,22 @@ class TpuPushDispatcher(TaskDispatcher):
         shared: bool = False,
         multihost: bool = False,
         resident: bool = False,
+        estimate_runtimes: bool = True,
     ) -> None:
         super().__init__(
             store_url=store_url, channel=channel, store=store, shared=shared
         )
+        # the estimation loop (sched/estimator.py): learned per-function
+        # sizes stamp un-hinted tasks at batch build, learned per-worker
+        # speeds feed SchedulerArrays.worker_speed — so the heterogeneous
+        # placement machinery engages on the LIVE path with zero client
+        # hints (round-3 verdict item 1; the reference is size-blind,
+        # task_dispatcher.py:297-322)
+        self.estimator = (
+            RuntimeEstimator(store=self.store) if estimate_runtimes else None
+        )
+        #: task_id -> fn digest, stamped at batch build, popped at result
+        self._task_digest: dict[str, str] = {}
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.ROUTER)
         if port == 0:
@@ -429,17 +442,62 @@ class TpuPushDispatcher(TaskDispatcher):
     def _renew_leases(self) -> None:
         self.renew_leases(self.arrays._inflight_slot)
 
+    # -- the estimation loop -----------------------------------------------
+    def _stamp_estimate(self, task: PendingTask) -> None:
+        """Batch-build hook: give an un-hinted task its learned size (or
+        the fleet prior for a never-seen function) and remember its fn
+        digest for the result-path observation."""
+        est = self.estimator
+        if est is None:
+            return
+        d = fn_digest(task.fn_payload)
+        self._task_digest[task.task_id] = d
+        if task.cost is None:
+            task.learned = est.size_for(d)
+            if task.learned is None:
+                task.learned = est.default_size()
+
+    def _apply_learned_speed(self, wid: bytes, row: int) -> None:
+        """Registration/reconnect re-applies the learned speed the plain
+        register() just reset to 1.0 (same identity = same process = same
+        machine)."""
+        if self.estimator is not None:
+            self.arrays.worker_speed[row] = self.estimator.speed_for(wid)
+
+    def _observe_result(self, wid: bytes, row: int, task_id: str, data: dict) -> None:
+        """Fold a completed result's worker-measured runtime into the
+        estimators and refresh the row's speed (quantized: tiny EWMA moves
+        must not dirty the device's cached [W] speed array every tick)."""
+        est = self.estimator
+        digest = self._task_digest.pop(task_id, None)
+        if est is None:
+            return
+        elapsed = data.get("elapsed")
+        if (
+            digest is None
+            or not isinstance(elapsed, (int, float))
+            or data.get("status") != str(TaskStatus.COMPLETED)
+        ):
+            return
+        est.observe(digest, float(elapsed), wid)
+        new_speed = est.speed_for(wid)
+        cur = float(self.arrays.worker_speed[row])
+        if abs(new_speed - cur) > 0.05 * max(cur, 1e-6):
+            self.arrays.worker_speed[row] = new_speed
+
     # -- worker messages ---------------------------------------------------
     def _handle(self, wid: bytes, msg_type: str, data: dict) -> None:
         a = self.arrays
         if msg_type == m.REGISTER:
-            a.register(wid, int(data["num_processes"]))
+            row = a.register(wid, int(data["num_processes"]))
+            self._apply_learned_speed(wid, row)
             self.log.info("worker registered: %r %s", wid, data)
             return
         if wid not in a.worker_ids:
             # unknown sender: reconnect handshake (reference :356-358);
             # a zero-capacity row is created so its heartbeats count
-            a.register(wid, 0)
+            row = a.register(wid, 0)
+            self._apply_learned_speed(wid, row)
             self.socket.send_multipart([wid, m.encode(m.RECONNECT)])
             if msg_type not in (m.RECONNECT, m.RESULT):
                 return
@@ -471,10 +529,14 @@ class TpuPushDispatcher(TaskDispatcher):
                     a.worker_free[row] = min(
                         a.worker_free[row] + 1, a.worker_procs[row]
                     )
+                    self._observe_result(wid, row, task_id, data)
+            else:
+                self._task_digest.pop(task_id, None)
         elif msg_type == m.HEARTBEAT:
             a.heartbeat(wid)
         elif msg_type == m.RECONNECT:
-            a.reconnect(wid, int(data.get("free_processes", 0)))
+            row = a.reconnect(wid, int(data.get("free_processes", 0)))
+            self._apply_learned_speed(wid, row)
         elif msg_type == m.DEREGISTER:
             # graceful drain: zero the row's capacity so placement skips it;
             # in-flight results keep arriving (the row stays live while it
@@ -502,6 +564,9 @@ class TpuPushDispatcher(TaskDispatcher):
             "liveness_period_s": self.liveness_period,
             "tasks_on_retry": len(self.task_retries),
             "device_tick": self.tracer.summary().get("device_tick", {}),
+            "estimator": (
+                self.estimator.stats() if self.estimator is not None else None
+            ),
         }
 
     # -- one scheduler tick ------------------------------------------------
@@ -570,6 +635,8 @@ class TpuPushDispatcher(TaskDispatcher):
         # the inflight table so an aborted tick simply retries next tick.
         restore_from = 0  # first batch index NOT yet handled (or on the wire)
         try:
+            for t in batch:
+                self._stamp_estimate(t)
             sizes = np.asarray(
                 [t.size_estimate for t in batch], dtype=np.float32
             )
@@ -616,13 +683,17 @@ class TpuPushDispatcher(TaskDispatcher):
             for slot, task_id in drops:
                 a.inflight_clear_slot(slot)
                 self.task_retries.pop(task_id, None)
+                self._task_digest.pop(task_id, None)
             for slot, pt in reclaims:
                 a.inflight_clear_slot(slot)
                 self.task_retries[pt.task_id] = pt.retries
                 requeued.append(pt)
             for row in np.flatnonzero(np.asarray(out.purged)):
                 self.log.warning("purged worker row %d", int(row))
+                wid_p = a.row_ids.get(int(row))
                 a.deactivate(int(row))
+                if wid_p is not None and self.estimator is not None:
+                    self.estimator.forget_worker(wid_p)
                 self.n_purged += 1
 
             # act: send assignments
@@ -638,6 +709,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     # reclaimed task finished meanwhile by its zombie worker:
                     # re-dispatching would regress the record to RUNNING
                     self.task_retries.pop(task.task_id, None)
+                    self._task_digest.pop(task.task_id, None)
                     restore_from = idx + 1
                     continue
                 try:
@@ -697,6 +769,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 t = self.pending.popleft()
                 if t.task_id in self._resident_tasks:
                     continue
+                self._stamp_estimate(t)
                 self._resident_tasks[t.task_id] = t
                 batch.append(t)
             if batch:
@@ -713,6 +786,7 @@ class TpuPushDispatcher(TaskDispatcher):
             t = self.pending.popleft()
             if t.task_id in self._resident_tasks:
                 continue  # already queued device-side (rescan overlap)
+            self._stamp_estimate(t)
             self._resident_tasks[t.task_id] = t
             a.pending_add(t.task_id, t.size_estimate, t.priority or 0)
 
@@ -779,13 +853,17 @@ class TpuPushDispatcher(TaskDispatcher):
         for slot, task_id in drops:
             a.inflight_clear_slot(slot)
             self.task_retries.pop(task_id, None)
+            self._task_digest.pop(task_id, None)
         for slot, pt in reclaims:
             a.inflight_clear_slot(slot)
             self.task_retries[pt.task_id] = pt.retries
             self.pending.append(pt)
         for row in res.purged_rows:
             self.log.warning("purged worker row %d", int(row))
+            wid_p = a.row_ids.get(int(row))
             a.deactivate(int(row))
+            if wid_p is not None and self.estimator is not None:
+                self.estimator.forget_worker(wid_p)
             self.n_purged += 1
 
         # -- act on placements (per-task outage degradation: a task whose
@@ -809,6 +887,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     # reclaimed task finished meanwhile by its zombie
                     # worker: re-dispatching would regress the record
                     self.task_retries.pop(task.task_id, None)
+                    self._task_digest.pop(task.task_id, None)
                     a.worker_free[row] = min(
                         a.worker_free[row] + 1, int(a.worker_procs[row])
                     )
@@ -864,6 +943,10 @@ class TpuPushDispatcher(TaskDispatcher):
                     ):
                         self._renew_leases()
                         self._last_lease_renew = self.clock()
+                    if self.estimator is not None:
+                        # write-behind of learned runtimes (no-op between
+                        # persist periods; internally outage-tolerant)
+                        self.estimator.maybe_persist()
                 except STORE_OUTAGE_ERRORS as exc:
                     self.note_store_outage(exc)
                 events = dict(self.poller.poll(max(1, int(self.tick_period * 1000))))
@@ -904,6 +987,11 @@ class TpuPushDispatcher(TaskDispatcher):
                 if max_results is not None and self.n_results >= max_results:
                     break
         finally:
+            if self.estimator is not None:
+                try:
+                    self.estimator.maybe_persist(force=True)
+                except Exception:
+                    pass  # shutdown flush is best-effort
             if self.arrays.multihost is not None:
                 # release the followers before the sockets: they block in a
                 # collective and would hang their processes forever

@@ -1,0 +1,211 @@
+"""Runtime estimation: learned task sizes and worker speeds for the cost
+matrix.
+
+BASELINE.json's north star defines placement cost over "task-size estimates,
+worker capacity, and heartbeat-derived liveness". Capacity and liveness are
+measured; this module closes the loop on the remaining two inputs, which
+round 3 left as client-supplied hints defaulting to 1.0:
+
+- **per-function runtime** (the task-size axis): an EWMA over observed
+  execution times, keyed by a digest of the serialized function payload —
+  tasks calling the same function are the same workload, whoever produced
+  them (the reference has no function identity below the gateway either;
+  its dispatch is size-blind LRU, task_dispatcher.py:297-322);
+- **per-worker speed** (the worker axis): an EWMA of (estimated size /
+  observed execution time) keyed by worker identity, so a heterogeneous
+  fleet separates into fast and slow rows without any operator input.
+
+The two estimates are mutually referential (a runtime observation is
+``size / speed``), which is resolved the standard alternating way: a size
+observation is normalized by the CURRENT speed estimate of the worker that
+ran it, and speed observations only begin once a function's size estimate
+has a few samples behind it. The absolute scale is a gauge freedom — the
+rank/auction/Sinkhorn kernels are invariant to a global rescale of sizes or
+speeds — so no normalization pass is needed; speeds are clamped to a sane
+band to keep the gauge from drifting on pathological inputs.
+
+Observations use the WORKER-measured execution time (`elapsed` on the
+RESULT message, measured around the user call in the pool child): the
+dispatcher-side dispatch->result interval would fold in pool queueing and
+transport, which under saturation says more about backlog than about the
+function. FAILED results are not observed — failures often short-circuit
+(deserialization errors, poison inputs) and would drag estimates toward
+zero.
+
+Estimates survive restarts through the store (one hash, pipelined
+write-behind, best-effort under outages): a dispatcher that restarts
+mid-day re-learns nothing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+from tpu_faas.utils.logging import get_logger
+
+log = get_logger("sched.estimator")
+
+#: store hash holding fn_digest -> "est:count" (seconds at unit speed)
+FN_STATS_KEY = "faas:fn_stats"
+
+#: speed estimates are confined to this band: a worker 400x faster or
+#: slower than the fleet median is a measurement artifact (clock glitch,
+#: empty-function timing noise), and an unbounded EWMA would let the
+#: size/speed gauge run away
+_SPEED_LO, _SPEED_HI = 0.05, 20.0
+
+
+def fn_digest(fn_payload: str) -> str:
+    """Stable identity for "the same function": a short digest of the
+    serialized payload. Collision-safe at 16 hex chars for any plausible
+    function count; identical across producers, restarts, and hosts."""
+    return hashlib.blake2b(
+        fn_payload.encode("ascii", "replace"), digest_size=8
+    ).hexdigest()
+
+
+class RuntimeEstimator:
+    """Joint EWMA estimation of function runtimes and worker speeds.
+
+    All methods are cheap dict operations on the dispatcher's serve loop;
+    persistence batches into one pipelined store write per
+    ``persist_period`` seconds.
+    """
+
+    def __init__(
+        self,
+        store=None,
+        alpha: float = 0.25,
+        speed_alpha: float = 0.1,
+        speed_min_samples: int = 3,
+        persist_period: float = 10.0,
+        clock=time.monotonic,
+    ) -> None:
+        self.store = store
+        self.alpha = float(alpha)
+        self.speed_alpha = float(speed_alpha)
+        #: observations a function needs before its estimate is trusted to
+        #: grade WORKERS (speed updates divide by it)
+        self.speed_min_samples = int(speed_min_samples)
+        self.persist_period = float(persist_period)
+        self.clock = clock
+        self._fn_est: dict[str, float] = {}
+        self._fn_count: dict[str, int] = {}
+        self._speed_est: dict[bytes, float] = {}
+        self._dirty: set[str] = set()
+        self._last_persist = clock()
+        self.n_observations = 0
+        if store is not None:
+            self._load()
+
+    # -- persistence -------------------------------------------------------
+    def _load(self) -> None:
+        try:
+            fields = self.store.hgetall(FN_STATS_KEY)
+        except Exception as exc:  # outage at startup: learn from scratch
+            log.warning("fn-stats load skipped (%s)", exc)
+            return
+        for key, raw in fields.items():
+            try:
+                est_s, count_s = raw.split(":", 1)
+                est, count = float(est_s), int(count_s)
+            except ValueError:
+                continue
+            if est > 0 and count > 0:
+                self._fn_est[key] = est
+                self._fn_count[key] = count
+        if self._fn_est:
+            log.info(
+                "loaded %d persisted function-runtime estimates",
+                len(self._fn_est),
+            )
+
+    def maybe_persist(self, force: bool = False) -> int:
+        """Write-behind dirty estimates; call from the serve loop (cheap
+        no-op between periods). Returns entries written. Best-effort: an
+        outage drops nothing — entries stay dirty for the next period.
+        ``force`` skips the period gate — the graceful-shutdown flush, so
+        a restart loses at most a crash's final window, not every clean
+        stop's."""
+        if self.store is None or not self._dirty:
+            return 0
+        if not force and self.clock() - self._last_persist < self.persist_period:
+            return 0
+        items = {
+            key: f"{self._fn_est[key]:.6g}:{self._fn_count[key]}"
+            for key in self._dirty
+            if key in self._fn_est
+        }
+        try:
+            self.store.hset(FN_STATS_KEY, items)
+        except Exception as exc:
+            log.debug("fn-stats persist deferred (%s)", exc)
+            return 0
+        self._last_persist = self.clock()
+        self._dirty.clear()
+        return len(items)
+
+    # -- queries (intake path) ---------------------------------------------
+    def size_for(self, digest: str) -> float | None:
+        """Learned size for this function, or None when unobserved."""
+        return self._fn_est.get(digest)
+
+    def default_size(self) -> float | None:
+        """Prior for a function with no observations yet: the mean of the
+        known estimates, so unknown tasks rank mid-field rather than
+        polluting the batch with payload-byte magnitudes. None while
+        nothing at all has been learned (callers then keep the round-3
+        payload-bytes fallback — a consistent scale within the batch)."""
+        if not self._fn_est:
+            return None
+        return sum(self._fn_est.values()) / len(self._fn_est)
+
+    def speed_for(self, worker_id: bytes) -> float:
+        """Current speed estimate for a worker identity (1.0 prior)."""
+        return self._speed_est.get(worker_id, 1.0)
+
+    # -- observations (result path) ----------------------------------------
+    def observe(
+        self, digest: str, elapsed: float, worker_id: bytes
+    ) -> None:
+        """Fold one completed execution into both estimates."""
+        if not (elapsed > 0.0) or elapsed != elapsed:  # NaN guard
+            return
+        self.n_observations += 1
+        speed = self._speed_est.get(worker_id, 1.0)
+        size_obs = elapsed * speed
+        prev = self._fn_est.get(digest)
+        count = self._fn_count.get(digest, 0)
+        if prev is None:
+            self._fn_est[digest] = size_obs
+        else:
+            self._fn_est[digest] = (
+                self.alpha * size_obs + (1.0 - self.alpha) * prev
+            )
+        self._fn_count[digest] = count + 1
+        self._dirty.add(digest)
+        # grade the worker only against a settled size estimate, and not
+        # against the very observation that just moved it (use prev)
+        if prev is not None and count >= self.speed_min_samples:
+            speed_obs = prev / elapsed
+            speed_new = (
+                self.speed_alpha * speed_obs
+                + (1.0 - self.speed_alpha) * speed
+            )
+            self._speed_est[worker_id] = min(
+                max(speed_new, _SPEED_LO), _SPEED_HI
+            )
+
+    def forget_worker(self, worker_id: bytes) -> None:
+        """Purged worker: a rejoining process re-registers under a fresh
+        identity, so the stale entry would never be read again — drop it
+        to keep the dict bounded by the live fleet."""
+        self._speed_est.pop(worker_id, None)
+
+    def stats(self) -> dict:
+        return {
+            "functions_learned": len(self._fn_est),
+            "workers_graded": len(self._speed_est),
+            "observations": self.n_observations,
+        }

@@ -9,7 +9,10 @@ Message vocabulary:
 
 worker -> dispatcher:
     REGISTER   data: worker_id (pull) | num_processes (push)
-    RESULT     data: task_id, status, result [, no_task=True while draining
+    RESULT     data: task_id, status, result [, elapsed: float — execution
+               wall seconds measured in the pool child, feeding the
+               dispatcher's runtime estimator; absent from reference-era
+               workers and handled as such] [, no_task=True while draining
                (pull): the mandatory reply must be WAIT, never a new task]
     READY      (pull only) data: worker_id
     HEARTBEAT  (push hb) data: {}

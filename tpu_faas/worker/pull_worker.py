@@ -98,6 +98,7 @@ class PullWorker:
                         task_id=res.task_id,
                         status=res.status,
                         result=res.result,
+                        elapsed=res.elapsed,
                         no_task=self._draining,
                     )
                     shipped += 1

@@ -135,6 +135,7 @@ class PushWorker:
                             task_id=res.task_id,
                             status=res.status,
                             result=res.result,
+                            elapsed=res.elapsed,
                         )
                     )
                     shipped += 1
