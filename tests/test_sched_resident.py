@@ -303,9 +303,51 @@ def test_resident_rejected_fcfs_across_multiple_packets():
     assert [a.task_id for a in r._rejected] == [f"t{i}" for i in range(10)]
 
 
-def test_resident_rejects_auction_and_mesh():
+def test_resident_rejects_auction():
     with pytest.raises(ValueError):
         ResidentScheduler(max_workers=4, max_pending=8, placement="auction")
+
+
+def _mesh_scenario(r):
+    """Registrations, prioritized arrivals, heartbeats, a result freeing a
+    slot, late arrivals — resolved tick-for-tick."""
+    rng = np.random.default_rng(0)
+    speeds = rng.uniform(0.5, 4.0, 6)
+    for i in range(6):
+        r.register(b"w%d" % i, 1 + i % 3, speed=float(speeds[i]))
+    for i, s in enumerate(rng.uniform(0.5, 5.0, 20)):
+        r.pending_add(f"t{i}", float(s), priority=i % 3)
+    r.tick_resident()
+    outs = _drain(r)
+    r._clock_box[0] += 1.0
+    for i in range(6):
+        r.heartbeat(b"w%d" % i)
+    r.pending_add("late1", 2.0)
+    r.pending_add("late2", 0.3)
+    r.tick_resident()
+    outs += _drain(r)
+    return [(sorted(res.placed), res.n_pending) for res in outs]
+
+
+@pytest.mark.parametrize("placement", ["rank", "sinkhorn"])
+def test_resident_mesh_matches_single_device(placement):
+    """--resident composes with --mesh: the SAME delta packets applied to
+    task-sharded resident state must resolve like the single-device
+    resident path (round-4: the fast path and the multi-chip path are the
+    same path). The deterministic rank path must match PLACEMENT-FOR-
+    PLACEMENT; the entropic path matches on placed counts and pending
+    totals (its soft plan's argmax tie-breaks shift with f32 reduction
+    order across sharding layouts — same caveat as the sharded one-shot
+    tick in __graft_entry__.py)."""
+    single = _mk(placement=placement, use_priority=True)
+    mesh = _mk(placement=placement, use_priority=True, mesh_devices=8)
+    assert mesh.mesh is not None and mesh.mesh.size == 8
+    a = _mesh_scenario(single)
+    b = _mesh_scenario(mesh)
+    if placement == "rank":
+        assert a == b
+    else:
+        assert [(len(p), n) for p, n in a] == [(len(p), n) for p, n in b]
 
 
 def test_resident_dispatcher_bulk_loads_cold_backlog():

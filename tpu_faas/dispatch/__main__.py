@@ -112,10 +112,11 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument(
         "--resident", action="store_true",
         help="tpu-push: keep ALL scheduler state (pending set, heartbeat "
-        "stamps, free counts, in-flight table) device-resident between "
-        "ticks; each tick uploads one small delta packet instead of the "
-        "whole batch. The steady-state high-throughput path; "
-        "single-device (excludes --mesh/--multihost)",
+        "stamps, free counts, worker speed/active, in-flight table) "
+        "device-resident between ticks; each tick uploads one small delta "
+        "packet instead of the whole batch. The steady-state "
+        "high-throughput path; composes with --mesh (task axis of the "
+        "resident state sharded over the devices), not yet --multihost",
     )
     ap.add_argument(
         "--mesh", type=int, default=0, metavar="N",
@@ -250,8 +251,8 @@ def main(argv: list[str] | None = None) -> None:
                     sys.exit("--multihost owns the global mesh; drop --mesh")
                 if ns.resident:
                     sys.exit(
-                        "--resident is single-device; it does not compose "
-                        "with --multihost"
+                        "--resident composes with --mesh (sharded resident "
+                        "state) but not yet with --multihost"
                     )
                 # join the global runtime BEFORE any other backend use;
                 # followers never reach the dispatcher construction below

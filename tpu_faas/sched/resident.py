@@ -27,9 +27,14 @@ actually reported (first KP), so an over-KP burst keeps the surplus valid
 and re-places it next tick; redispatch slots beyond KR are recomputed next
 tick from the same liveness state. Nothing is ever silently dropped.
 
-Replaces nothing: `SchedulerArrays.tick` remains the one-shot/batch path
-(and the mesh path). `ResidentScheduler` is the steady-state product path
-used by TpuPushDispatcher --resident and by bench.py's integrated headline.
+Replaces nothing: `SchedulerArrays.tick` remains the one-shot/batch path.
+`ResidentScheduler` is the steady-state product path used by
+TpuPushDispatcher --resident and by bench.py's integrated headline. With
+``mesh_devices=N`` the task axis of the resident state carries a
+NamedSharding over the mesh and the identical delta packets drive the
+sharded tick — the fast path IS the multi-chip path (the placement's
+global sorts lower to collective exchanges, same as parallel/mesh.py's
+one-shot tick).
 
 Reference parity note: this is the TPU-native answer to the reference's
 per-tick host loop (task_dispatcher.py:251-322) at scales where even
@@ -70,6 +75,8 @@ class _ResidentState(NamedTuple):
     free: jnp.ndarray  # i32[W]
     inflight: jnp.ndarray  # i32[I]
     prev_live: jnp.ndarray  # bool[W]
+    speed: jnp.ndarray  # f32[W] (delta-scattered; learned speeds ride it)
+    active: jnp.ndarray  # bool[W]
 
 
 def _unpack_header(packed):
@@ -79,10 +86,14 @@ def _unpack_header(packed):
         packed[2].astype(jnp.int32),  # n_hb deltas
         packed[3].astype(jnp.int32),  # n_free deltas
         packed[4].astype(jnp.int32),  # n_inflight deltas
+        packed[5].astype(jnp.int32),  # n_speed deltas
+        packed[6].astype(jnp.int32),  # n_active deltas
     )
 
 
-_HEADER = 5
+# header slots: the 7 counts above + one reserved flag word (multihost
+# stop rides it so the broadcast stays a single fixed-shape buffer)
+_HEADER = 8
 
 
 def _first_k_indices(mask, K: int):
@@ -101,11 +112,13 @@ def _first_k_indices(mask, K: int):
 
 
 def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
-                  use_priority):
+                  KS, KB, use_priority):
     """Scatter one delta packet into the carried state. Traced helper shared
     by the flush kernel and the fused tick kernel. Returns (state,
     arrival_slots i32[KA])."""
-    now, n_arr, n_hb, n_free, n_infl = _unpack_header(packed)
+    now, n_arr, n_hb, n_free, n_infl, n_speed, n_active = _unpack_header(
+        packed
+    )
     off = _HEADER
     arr_sizes = packed[off : off + KA]; off += KA
     if use_priority:
@@ -116,6 +129,10 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     free_val = packed[off : off + KF].astype(jnp.int32); off += KF
     infl_idx = packed[off : off + KI].astype(jnp.int32); off += KI
     infl_val = packed[off : off + KI].astype(jnp.int32); off += KI
+    sp_idx = packed[off : off + KS].astype(jnp.int32); off += KS
+    sp_val = packed[off : off + KS]; off += KS
+    ac_idx = packed[off : off + KB].astype(jnp.int32); off += KB
+    ac_val = packed[off : off + KB]; off += KB
 
     # -- per-worker / in-flight scatters (sentinel index = dropped write) --
     m = jnp.arange(KH) < n_hb
@@ -140,6 +157,17 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     inflight = st.inflight.at[jnp.where(m, infl_idx, I)].set(
         jnp.where(m, infl_val, -1), mode="drop"
     )
+    # worker speed / active ride the SAME delta discipline (round 4): the
+    # estimation loop rewrites speeds continuously, and re-uploading the
+    # whole [W] array per change was the one remaining non-delta transfer
+    m = jnp.arange(KS) < n_speed
+    speed = st.speed.at[jnp.where(m, sp_idx, W)].set(
+        jnp.where(m, sp_val, 0.0), mode="drop"
+    )
+    m = jnp.arange(KB) < n_active
+    active = st.active.at[jnp.where(m, ac_idx, W)].set(
+        jnp.where(m, ac_val > 0.5, False), mode="drop"
+    )
 
     # -- arrivals into the first free pending slots ------------------------
     # The device chooses slots deterministically (first invalid slots in
@@ -161,7 +189,7 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     arrival_slots = jnp.where(ok, free_slots, -1).astype(jnp.int32)
     return (
         _ResidentState(sizes, valid, prio, last_hb, free, inflight,
-                       st.prev_live),
+                       st.prev_live, speed, active),
         arrival_slots,
         now,
     )
@@ -169,16 +197,19 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
 
 @partial(
     jax.jit,
-    static_argnames=("T", "W", "I", "KA", "KH", "KF", "KI", "use_priority"),
+    static_argnames=(
+        "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "use_priority",
+    ),
 )
-def _flush_kernel(packed, st, *, T, W, I, KA, KH, KF, KI, use_priority):
+def _flush_kernel(packed, st, *, T, W, I, KA, KH, KF, KI, KS, KB,
+                  use_priority):
     """Delta application alone — used when a tick's deltas exceed one
     packet's capacity (mass registration, adoption bursts): the overflow is
     drained in extra small dispatches, the final packet rides the fused
     tick."""
     st, arrival_slots, _ = _apply_deltas(
-        packed, st, T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI,
-        use_priority=use_priority,
+        packed, st, T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS,
+        KB=KB, use_priority=use_priority,
     )
     return st, arrival_slots
 
@@ -186,31 +217,29 @@ def _flush_kernel(packed, st, *, T, W, I, KA, KH, KF, KI, use_priority):
 @partial(
     jax.jit,
     static_argnames=(
-        "T", "W", "I", "KA", "KH", "KF", "KI", "KP", "KR",
+        "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP", "KR",
         "max_slots", "placement", "use_priority",
     ),
 )
 def _resident_tick(
     packed,
     st: _ResidentState,
-    speed,
-    active,
     tte,
     *,
-    T, W, I, KA, KH, KF, KI, KP, KR,
+    T, W, I, KA, KH, KF, KI, KS, KB, KP, KR,
     max_slots, placement, use_priority,
 ):
     st, arrival_slots, now = _apply_deltas(
-        packed, st, T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI,
-        use_priority=use_priority,
+        packed, st, T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS,
+        KB=KB, use_priority=use_priority,
     )
     hb_age = now - st.last_hb
     out = scheduler_tick(
         st.sizes,
         st.valid,
-        speed,
+        st.speed,
         st.free,
-        active,
+        st.active,
         hb_age,
         st.prev_live,
         st.inflight,
@@ -251,7 +280,7 @@ def _resident_tick(
 
     new_state = _ResidentState(
         st.sizes, valid_next, st.prio, st.last_hb, free_next, st.inflight,
-        out.live,
+        out.live, st.speed, st.active,
     )
     res = ResidentTickOutput(
         placed_slots,
@@ -299,6 +328,8 @@ class ResidentScheduler(SchedulerArrays):
     KH: int = 512  # heartbeat scatters
     KF: int = 1024  # free-count scatters
     KI: int = 1024  # in-flight scatters
+    KS: int = 512  # worker-speed scatters (the estimation loop writes these)
+    KB: int = 256  # worker-active scatters
     KP: int = 2048  # reported placements / tick
     KR: int = 512  # reported redispatches / tick
     use_priority: bool = False
@@ -315,13 +346,15 @@ class ResidentScheduler(SchedulerArrays):
         KH: int | None = None,
         KF: int | None = None,
         KI: int | None = None,
+        KS: int | None = None,
+        KB: int | None = None,
         KP: int | None = None,
         KR: int | None = None,
         **kw,
     ):
         super().__init__(*args, **kw)
         for name, v in (("KA", KA), ("KH", KH), ("KF", KF), ("KI", KI),
-                        ("KP", KP), ("KR", KR)):
+                        ("KS", KS), ("KB", KB), ("KP", KP), ("KR", KR)):
             if v is not None:
                 setattr(self, name, int(v))
         # packet capacities can't exceed the arrays they scatter into
@@ -329,14 +362,14 @@ class ResidentScheduler(SchedulerArrays):
         self.KP = min(self.KP, self.max_pending)
         self.KH = min(self.KH, self.max_workers)
         self.KF = min(self.KF, self.max_workers)
+        self.KS = min(self.KS, self.max_workers)
+        self.KB = min(self.KB, self.max_workers)
         self.KI = min(self.KI, self.max_inflight)
         self.KR = min(self.KR, self.max_inflight)
         if self.placement == "auction":
             # auction needs its price state threaded through the resident
             # carry; not wired yet — rank/sinkhorn are the resident paths
             raise ValueError("resident mode supports placement rank|sinkhorn")
-        if self.mesh is not None:
-            raise ValueError("resident mode is single-device (no --mesh)")
         self.use_priority = bool(use_priority)
         self._epoch = self.clock()
         self._arrivals: deque[_Arrival] = deque()
@@ -354,6 +387,8 @@ class ResidentScheduler(SchedulerArrays):
         self._r_state: _ResidentState | None = None
         self._hb_sent: np.ndarray | None = None
         self._free_sent: np.ndarray | None = None
+        self._speed_sent: np.ndarray | None = None
+        self._active_sent: np.ndarray | None = None
 
     # -- pending interface -------------------------------------------------
     def pending_add(self, task_id: str, size: float, priority: int = 0) -> None:
@@ -385,7 +420,9 @@ class ResidentScheduler(SchedulerArrays):
         if priorities is not None:
             p[:n] = np.asarray(priorities, dtype=np.int32)
         self._r_state = self._r_state._replace(
-            sizes=jnp.asarray(s), valid=jnp.asarray(v), prio=jnp.asarray(p)
+            sizes=self._put_task(s),
+            valid=self._put_task(v),
+            prio=self._put_task(p),
         )
         for i, tid in enumerate(ids):
             self.slot_task[i] = tid
@@ -407,22 +444,45 @@ class ResidentScheduler(SchedulerArrays):
         # -inf stamps (never heard from) stay -inf; ages come out +inf
         return (self.last_heartbeat - self._epoch).astype(np.float32)
 
+    def _put_task(self, a):
+        """Place a task-axis array: sharded over the mesh when present."""
+        if self.mesh is None:
+            return jnp.asarray(a)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_faas.parallel.mesh import TASK_AXIS
+
+        return jax.device_put(a, NamedSharding(self.mesh, P(TASK_AXIS)))
+
+    def _put_repl(self, a):
+        """Place a fleet/packet array: replicated over the mesh when
+        present (a plain committed copy otherwise)."""
+        if self.mesh is None:
+            return jnp.asarray(a)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(a, NamedSharding(self.mesh, P()))
+
     def _ensure_state(self) -> None:
         if self._r_state is not None:
             return
         T, W = self.max_pending, self.max_workers
         hb = self._hb_rel()
         self._r_state = _ResidentState(
-            jnp.zeros(T, dtype=jnp.float32),
-            jnp.zeros(T, dtype=bool),
-            jnp.zeros(T, dtype=jnp.int32),
-            jnp.asarray(hb),
-            jnp.asarray(self.worker_free),
-            jnp.asarray(self.inflight_worker),
-            jnp.asarray(self.prev_live),
+            self._put_task(np.zeros(T, dtype=np.float32)),
+            self._put_task(np.zeros(T, dtype=bool)),
+            self._put_task(np.zeros(T, dtype=np.int32)),
+            self._put_repl(hb),
+            self._put_repl(self.worker_free),
+            self._put_repl(self.inflight_worker),
+            self._put_repl(self.prev_live),
+            self._put_repl(self.worker_speed),
+            self._put_repl(self.worker_active),
         )
         self._hb_sent = hb.copy()
         self._free_sent = self.worker_free.copy()
+        self._speed_sent = self.worker_speed.copy()
+        self._active_sent = self.worker_active.copy()
         # route inflight mutations into _inflight_delta (see _note_inflight)
         self._d_inflight = self._r_state.inflight
         self._inflight_delta.clear()
@@ -456,24 +516,41 @@ class ResidentScheduler(SchedulerArrays):
             self._inflight_delta.clear()
         else:
             if_idx = if_val = np.empty(0, dtype=np.int64)
-        return hb_idx, hb_val, fr_idx, fr_val, if_idx, if_val
+        sp_idx = np.flatnonzero(self.worker_speed != self._speed_sent)
+        sp_val = self.worker_speed[sp_idx]
+        self._speed_sent[sp_idx] = sp_val
+        ac_idx = np.flatnonzero(self.worker_active != self._active_sent)
+        ac_val = self.worker_active[ac_idx].astype(np.float32)
+        self._active_sent[ac_idx] = self.worker_active[ac_idx]
+        return (hb_idx, hb_val, fr_idx, fr_val, if_idx, if_val,
+                sp_idx, sp_val, ac_idx, ac_val)
 
-    def _pack(self, now_rel, arrivals, hb, fr, infl) -> np.ndarray:
+    def packet_len(self) -> int:
+        return (
+            _HEADER
+            + self.KA * (2 if self.use_priority else 1)
+            + 2 * (self.KH + self.KF + self.KI + self.KS + self.KB)
+        )
+
+    def _pack(self, now_rel, arrivals, hb, fr, infl, sp, ac) -> np.ndarray:
         KA, KH, KF, KI = self.KA, self.KH, self.KF, self.KI
-        n = _HEADER + KA * (2 if self.use_priority else 1) + 2 * (KH + KF + KI)
-        p = np.zeros(n, dtype=np.float32)
+        KS, KB = self.KS, self.KB
+        p = np.zeros(self.packet_len(), dtype=np.float32)
         p[0] = now_rel
         p[1] = len(arrivals)
         p[2] = len(hb[0])
         p[3] = len(fr[0])
         p[4] = len(infl[0])
+        p[5] = len(sp[0])
+        p[6] = len(ac[0])
         off = _HEADER
         p[off : off + len(arrivals)] = [a.size for a in arrivals]; off += KA
         if self.use_priority:
             p[off : off + len(arrivals)] = [a.priority for a in arrivals]
             off += KA
         for idx, val, K in ((hb[0], hb[1], KH), (fr[0], fr[1], KF),
-                            (infl[0], infl[1], KI)):
+                            (infl[0], infl[1], KI), (sp[0], sp[1], KS),
+                            (ac[0], ac[1], KB)):
             p[off : off + len(idx)] = idx; off += K
             p[off : off + len(val)] = val; off += K
         return p
@@ -481,8 +558,8 @@ class ResidentScheduler(SchedulerArrays):
     def _statics(self) -> dict:
         return dict(
             T=self.max_pending, W=self.max_workers, I=self.max_inflight,
-            KA=self.KA, KH=self.KH, KF=self.KF, KI=self.KI,
-            use_priority=self.use_priority,
+            KA=self.KA, KH=self.KH, KF=self.KF, KI=self.KI, KS=self.KS,
+            KB=self.KB, use_priority=self.use_priority,
         )
 
     # -- the tick ----------------------------------------------------------
@@ -511,9 +588,10 @@ class ResidentScheduler(SchedulerArrays):
             if self._hb_sent is not None:
                 self._hb_sent[np.isfinite(self._hb_sent)] = np.nan
         now_rel = now_abs - self._epoch
-        hb_idx, hb_val, fr_idx, fr_val, if_idx, if_val = self._diff_deltas()
+        (hb_idx, hb_val, fr_idx, fr_val, if_idx, if_val,
+         sp_idx, sp_val, ac_idx, ac_val) = self._diff_deltas()
         if self._tte_host != self.time_to_expire:
-            self._d_tte = jnp.float32(self.time_to_expire)
+            self._d_tte = self._put_repl(np.float32(self.time_to_expire))
             self._tte_host = self.time_to_expire
 
         # overflow: drain surplus deltas in standalone flush dispatches so
@@ -523,6 +601,8 @@ class ResidentScheduler(SchedulerArrays):
             or len(hb_idx) > self.KH
             or len(fr_idx) > self.KF
             or len(if_idx) > self.KI
+            or len(sp_idx) > self.KS
+            or len(ac_idx) > self.KB
         ):
             take = [
                 self._arrivals.popleft()
@@ -534,12 +614,16 @@ class ResidentScheduler(SchedulerArrays):
                 (hb_idx[: self.KH], hb_val[: self.KH]),
                 (fr_idx[: self.KF], fr_val[: self.KF]),
                 (if_idx[: self.KI], if_val[: self.KI]),
+                (sp_idx[: self.KS], sp_val[: self.KS]),
+                (ac_idx[: self.KB], ac_val[: self.KB]),
             )
             hb_idx, hb_val = hb_idx[self.KH :], hb_val[self.KH :]
             fr_idx, fr_val = fr_idx[self.KF :], fr_val[self.KF :]
             if_idx, if_val = if_idx[self.KI :], if_val[self.KI :]
+            sp_idx, sp_val = sp_idx[self.KS :], sp_val[self.KS :]
+            ac_idx, ac_val = ac_idx[self.KB :], ac_val[self.KB :]
             st, arrival_slots = _flush_kernel(
-                jnp.asarray(packet), self._r_state, **self._statics()
+                self._put_repl(packet), self._r_state, **self._statics()
             )
             self._r_state = st
             self._d_inflight = st.inflight
@@ -555,13 +639,11 @@ class ResidentScheduler(SchedulerArrays):
         ]
         packet = self._pack(
             now_rel, take, (hb_idx, hb_val), (fr_idx, fr_val),
-            (if_idx, if_val),
+            (if_idx, if_val), (sp_idx, sp_val), (ac_idx, ac_val),
         )
         out, st = _resident_tick(
-            jnp.asarray(packet),
+            self._put_repl(packet),
             self._r_state,
-            self._cached_dev("speed", self.worker_speed),
-            self._cached_dev("active", self.worker_active),
             self._d_tte,
             **self._statics(),
             KP=self.KP,
