@@ -67,7 +67,7 @@ def main() -> None:
         inflight,
         jnp.float32(10.0),
         max_slots=4,
-        use_sinkhorn=True,
+        placement="sinkhorn",
     )
     jax.block_until_ready(out)
     # replicate the (process-spanning) assignment onto every host so each
@@ -84,6 +84,70 @@ def main() -> None:
         f"checksum={int(a.sum())} purged={int(np.asarray(out.purged).sum())}",
         flush=True,
     )
+
+    # -- rank + PRIORITIES over the 2-process mesh (round 4) ---------------
+    # deterministic: the parent recomputes the same tick single-device and
+    # compares the full assignment fingerprint — priority admission order
+    # must match the single-host path exactly across processes
+    rng2 = np.random.default_rng(6)  # fresh seed: parent replays it
+    prio = shard_task_arrays(
+        mesh, jnp.asarray(rng2.integers(-2, 3, T).astype(np.int32))
+    )[0]
+    out_p = sharded_scheduler_tick(
+        mesh, task_size, task_valid, speed, free, active, hb_age,
+        prev_live, inflight, jnp.float32(10.0), max_slots=4,
+        placement="rank", task_priority=prio,
+    )
+    ap = np.asarray(gather(out_p.assignment))
+    fp = int((ap * np.arange(1, T + 1)).sum())
+    print(f"PRIO rank={rank} fingerprint={fp}", flush=True)
+
+    # -- auction over the 2-process mesh (round 4) -------------------------
+    out_a = sharded_scheduler_tick(
+        mesh, task_size, task_valid, speed, free, active, hb_age,
+        prev_live, inflight, jnp.float32(10.0), max_slots=4,
+        placement="auction",
+    )
+    aa = np.asarray(gather(out_a.assignment))
+    fa = int((aa * np.arange(1, T + 1)).sum())
+    print(f"AUCTION rank={rank} fingerprint={fa}", flush=True)
+
+    # -- WARM auction through the MultihostTick PROTOCOL (round 4) ---------
+    # Two consecutive ticks through the production lead/follower path: the
+    # second tick warm-starts from per-process carried prices, whose
+    # refresh decision must stay in lockstep across ranks. Fingerprints
+    # from tick 2 are compared across ranks and against the single-host
+    # SchedulerArrays product path by the parent.
+    from tpu_faas.parallel.multihost_tick import MultihostTick
+
+    mt = MultihostTick(
+        max_pending=32, max_workers=8, max_slots=2, placement="auction"
+    )
+    rng3 = np.random.default_rng(8)
+    sizes_w = rng3.uniform(0.5, 5.0, 20).astype(np.float32)
+    speed_w = rng3.uniform(0.5, 4.0, 8).astype(np.float32)
+    free_w = np.full(8, 2, dtype=np.int32)
+    active_w = np.ones(8, dtype=bool)
+    hb_w = np.zeros(8, dtype=np.float32)
+    infl_w = np.full(4, -1, dtype=np.int32)
+    if rank == 0:
+        mt.lead_tick(sizes_w, speed_w, free_w, active_w, hb_w, infl_w, 10.0)
+        out2 = mt.lead_tick(
+            sizes_w * 1.01, speed_w, free_w, active_w, hb_w, infl_w, 10.0
+        )
+        a2 = np.asarray(out2.assignment)
+        mt.lead_stop()
+    else:
+        for _ in range(2):
+            out2 = mt._run(
+                mt._broadcast(np.zeros(mt.buflen, dtype=np.float32))
+            )
+        a2 = np.asarray(out2.assignment)
+        assert mt._run(
+            mt._broadcast(np.zeros(mt.buflen, dtype=np.float32))
+        ) is None
+    f2 = int((a2 * np.arange(1, len(a2) + 1)).sum())
+    print(f"WARMAUCTION rank={rank} fingerprint={f2}", flush=True)
 
 
 if __name__ == "__main__":

@@ -315,7 +315,7 @@ def test_gateway_replicas_share_registry_through_store():
         tid = r.json()["task_id"]
         # finish the task out-of-band (no dispatcher in this test)
         fields = store.hgetall(tid)
-        _, status, result = execute_fn(
+        _, status, result, _ = execute_fn(
             tid, fields["fn_payload"], fields["param_payload"]
         )
         store.finish_task(tid, status, result)
@@ -427,7 +427,7 @@ def test_result_ttl_end_to_end():
             json={"function_id": fid, "payload": serialize(((5,), {}))},
         ).json()["task_id"]
         fields = store.hgetall(tid)
-        _, status, result = execute_fn(
+        _, status, result, _ = execute_fn(
             tid, fields["fn_payload"], fields["param_payload"]
         )
         store.finish_task(tid, status, result)

@@ -58,6 +58,77 @@ def test_two_process_global_mesh_sharded_tick():
     assert lines[0] == lines[1], lines
     assert "placed=" in lines[0]
 
+    # priority + auction legs (round 4): ranks agree with each other...
+    prio_fps = [
+        int(re.search(r"PRIO rank=\d fingerprint=(-?\d+)", out).group(1))
+        for out in outs
+    ]
+    auction_fps = [
+        int(re.search(r"AUCTION rank=\d fingerprint=(-?\d+)", out).group(1))
+        for out in outs
+    ]
+    assert prio_fps[0] == prio_fps[1]
+    assert auction_fps[0] == auction_fps[1]
+    # ...and with the SINGLE-HOST tick on the identical inputs (rebuilt
+    # from the child's seeds): priority admission order and the auction's
+    # assignment do not change when the problem spans processes
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_faas.sched.state import scheduler_tick
+
+    T, W, I = 64, 16, 32
+    rng = np.random.default_rng(5)
+    task_size = jnp.asarray(rng.uniform(0.1, 5.0, T).astype(np.float32))
+    task_valid = jnp.asarray(rng.random(T) > 0.2)
+    speed = jnp.asarray(rng.uniform(0.5, 4.0, W).astype(np.float32))
+    free = jnp.asarray(rng.integers(0, 4, W).astype(np.int32))
+    hb_age = jnp.asarray(rng.uniform(0.0, 15.0, W).astype(np.float32))
+    inflight = jnp.asarray(rng.integers(-1, W, I).astype(np.int32))
+    ones = jnp.ones(W, dtype=bool)
+    prio = jnp.asarray(
+        np.random.default_rng(6).integers(-2, 3, T).astype(np.int32)
+    )
+    out_p = scheduler_tick(
+        task_size, task_valid, speed, free, ones, hb_age, ones, inflight,
+        jnp.float32(10.0), max_slots=4, placement="rank",
+        task_priority=prio,
+    )
+    ap = np.asarray(out_p.assignment)
+    assert int((ap * np.arange(1, T + 1)).sum()) == prio_fps[0]
+    out_a = scheduler_tick(
+        task_size, task_valid, speed, free, ones, hb_age, ones, inflight,
+        jnp.float32(10.0), max_slots=4, placement="auction",
+    )
+    aa = np.asarray(out_a.assignment)
+    assert int((aa * np.arange(1, T + 1)).sum()) == auction_fps[0]
+
+    # warm-auction leg: the MultihostTick protocol's per-process price
+    # carry (tick 2 warm-starts from tick 1's prices) stays in lockstep
+    # across ranks and matches the single-host product path
+    warm_fps = [
+        int(
+            re.search(r"WARMAUCTION rank=\d fingerprint=(-?\d+)", out).group(1)
+        )
+        for out in outs
+    ]
+    assert warm_fps[0] == warm_fps[1]
+    from tpu_faas.sched.state import SchedulerArrays
+
+    arr = SchedulerArrays(
+        max_workers=8, max_pending=32, max_slots=2, placement="auction",
+        clock=lambda: 100.0,
+    )
+    rng3 = np.random.default_rng(8)
+    sizes_w = rng3.uniform(0.5, 5.0, 20).astype(np.float32)
+    speed_w = rng3.uniform(0.5, 4.0, 8).astype(np.float32)
+    for i in range(8):
+        arr.register(f"w{i}".encode(), 2, speed=float(speed_w[i]))
+    arr.tick(sizes_w)
+    out2 = arr.tick(sizes_w * 1.01)
+    a2 = np.asarray(out2.assignment)
+    assert int((a2 * np.arange(1, len(a2) + 1)).sum()) == warm_fps[0]
+
 
 def test_multihost_tick_host_side_redispatch_matches_kernel():
     """lead_tick computes redispatch HOST-side (the in-flight table no

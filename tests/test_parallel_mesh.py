@@ -138,3 +138,38 @@ def test_scheduler_arrays_mesh_matches_single_device(mesh):
     np.testing.assert_array_equal(
         np.asarray(out_s.live), np.asarray(out_m.live)
     )
+
+
+def test_scheduler_arrays_mesh_auction_matches_single_device(mesh):
+    """The general-cost auction runs SHARDED (round-4: sched/state.py used
+    to reject mesh+auction at construction): per-round bids are elementwise
+    in the sharded task axis, the winner lexsort lowers to collective
+    exchanges, and both the cold seeded solve and the warm price-carried
+    tick must be bit-identical to the single-device solver."""
+    from tpu_faas.sched.state import SchedulerArrays
+
+    def build(mesh_devices):
+        a = SchedulerArrays(
+            max_workers=16, max_pending=64, max_slots=4,
+            placement="auction", mesh_devices=mesh_devices,
+            clock=lambda: 100.0,
+        )
+        rng = np.random.default_rng(7)
+        for i in range(8):
+            a.register(
+                f"w{i}".encode(), int(1 + i % 4),
+                speed=float(rng.uniform(0.5, 4.0)),
+            )
+        return a
+
+    rng = np.random.default_rng(9)
+    sizes = rng.uniform(0.5, 5.0, 24).astype(np.float32)
+    single, meshed = build(None), build(8)
+    cold_s = np.asarray(single.tick(sizes).assignment)
+    cold_m = np.asarray(meshed.tick(sizes).assignment)
+    np.testing.assert_array_equal(cold_s, cold_m)
+    assert (cold_s >= 0).sum() == 20  # min(24 tasks, capacity)
+    # warm tick: both carry their own device-resident prices
+    warm_s = np.asarray(single.tick(sizes * 1.01).assignment)
+    warm_m = np.asarray(meshed.tick(sizes * 1.01).assignment)
+    np.testing.assert_array_equal(warm_s, warm_m)

@@ -121,8 +121,8 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument(
         "--mesh", type=int, default=0, metavar="N",
         help="tpu-push: shard the pending-task axis over N devices "
-        "(jax.sharding.Mesh; placement must be rank or sinkhorn); 0 = "
-        "single device",
+        "(jax.sharding.Mesh; all placements — rank, sinkhorn, auction — "
+        "run sharded); 0 = single device",
     )
     mh = ap.add_argument_group(
         "multihost",
@@ -242,11 +242,6 @@ def main(argv: list[str] | None = None) -> None:
                 # blocked in a collective and a lead that exits without
                 # serving never sends the stop broadcast — every follower
                 # in the fleet would hang forever on an operator typo.
-                if ns.placement == "auction":
-                    sys.exit(
-                        "--multihost placement must be rank or sinkhorn "
-                        "(the auction has no sharded variant)"
-                    )
                 if ns.mesh:
                     sys.exit("--multihost owns the global mesh; drop --mesh")
                 if ns.resident:
@@ -282,7 +277,7 @@ def main(argv: list[str] | None = None) -> None:
                         max_pending=ns.max_pending,
                         max_workers=ns.max_fleet,
                         max_slots=ns.max_slots,
-                        use_sinkhorn=(ns.placement == "sinkhorn"),
+                        placement=ns.placement,
                     ).follow_loop(
                         watchdog_timeout=ns.follower_watchdog or None
                     )
@@ -347,7 +342,7 @@ def main(argv: list[str] | None = None) -> None:
                             max_workers=ns.max_fleet,
                             max_inflight=ns.max_inflight,
                             max_slots=ns.max_slots,
-                            use_sinkhorn=(ns.placement == "sinkhorn"),
+                            placement=ns.placement,
                         )
                     mt.lead_stop()
                     log.info("released multihost followers before exiting")

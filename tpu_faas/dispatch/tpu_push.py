@@ -106,11 +106,6 @@ class TpuPushDispatcher(TaskDispatcher):
             raise ValueError(
                 "--multihost owns the global mesh; --mesh is single-process"
             )
-        if multihost and placement == "auction":
-            raise ValueError(
-                "multihost placement must be rank or sinkhorn (the auction "
-                "has no sharded variant)"
-            )
         if resident and multihost:
             raise ValueError(
                 "--resident composes with --mesh (sharded resident state) "
@@ -169,7 +164,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 max_workers=max_workers,
                 max_inflight=max_inflight,
                 max_slots=max_slots,
-                use_sinkhorn=(placement == "sinkhorn"),
+                placement=placement,
             )
         self.pending: deque[PendingTask] = deque()
         #: max seconds between device ticks when there is nothing to place.

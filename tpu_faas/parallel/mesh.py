@@ -155,7 +155,7 @@ def sharded_sinkhorn_placement(
     )
 
 
-@partial(jax.jit, static_argnames=("mesh", "max_slots", "use_sinkhorn"))
+@partial(jax.jit, static_argnames=("mesh", "max_slots", "placement"))
 def sharded_scheduler_tick(
     mesh: Mesh,
     task_size: jnp.ndarray,  # f32[T]
@@ -168,16 +168,26 @@ def sharded_scheduler_tick(
     inflight_worker: jnp.ndarray,  # i32[I] sharded or replicated
     time_to_expire: jnp.ndarray,
     max_slots: int = 8,
-    use_sinkhorn: bool = True,
+    placement: str = "sinkhorn",  # rank | auction | sinkhorn
     task_priority: jnp.ndarray | None = None,  # i32[T] sharded like tasks
     n_valid: jnp.ndarray | None = None,  # i32 scalar, with task_valid=None
+    auction_price: jnp.ndarray | None = None,  # f32[W*max_slots] warm start
 ) -> TickOutput:
     """The full fused tick (liveness + purge + placement + redistribution)
     with the pending-task axis sharded across the mesh. Semantics identical
     to sched.state.scheduler_tick. ``task_priority`` orders admission on the
     rank-match path (the global stable sort lowers to a collective exchange);
     the Sinkhorn path ignores it — entropic admission is soft by
-    construction, so hard priority classes belong to the rank-match branch."""
+    construction, so hard priority classes belong to the rank-match branch.
+
+    ``placement="auction"`` (round 4) runs the general-cost Bertsekas
+    solver over the sharded task axis: the per-round bids are elementwise
+    in the (sharded) task dimension, and the per-slot winner lexsort is a
+    global sort XLA lowers to collective exchanges — no hand-written
+    distributed bidding protocol needed, and the round structure (a
+    deterministic `lax.while_loop`) is identical on every device. Warm
+    prices thread through ``auction_price`` exactly as on the
+    single-device path."""
     if task_valid is None:
         # valid mask computed on DEVICE from a scalar (the live
         # dispatcher's calling convention: saves a [T]-bool upload AND a
@@ -193,10 +203,21 @@ def sharded_scheduler_tick(
     occupied = inflight_worker >= 0
     redispatch = occupied & ~live[jnp.clip(inflight_worker, 0)]
 
-    if use_sinkhorn:
+    if placement == "sinkhorn":
         assignment = sharded_sinkhorn_placement(
             mesh, task_size, task_valid, worker_speed, worker_free, live,
             max_slots=max_slots,
+        )
+    elif placement == "auction":
+        from tpu_faas.sched.auction import auction_placement
+
+        res = auction_placement(
+            task_size, task_valid, worker_speed, worker_free, live,
+            max_slots=max_slots, init_price=auction_price,
+        )
+        return TickOutput(
+            res.assignment, live, purged, redispatch, res.prices,
+            res.refresh,
         )
     else:
         assignment = rank_match_placement(

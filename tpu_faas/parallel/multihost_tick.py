@@ -41,7 +41,7 @@ from tpu_faas.utils.logging import get_logger
 
 log = get_logger("parallel.multihost")
 
-_HEADER = 4  # stop, n_valid, time_to_expire, (reserved)
+_HEADER = 4  # stop, n_valid, time_to_expire, has_prio
 
 
 class MultihostTick:
@@ -59,7 +59,8 @@ class MultihostTick:
         max_workers: int,
         max_inflight: int | None = None,  # unused: kept for call symmetry
         max_slots: int = 8,
-        use_sinkhorn: bool = False,
+        use_sinkhorn: bool = False,  # legacy alias for placement="sinkhorn"
+        placement: str | None = None,
     ) -> None:
         import jax
 
@@ -68,7 +69,7 @@ class MultihostTick:
         self.T = int(max_pending)
         self.W = int(max_workers)
         self.max_slots = int(max_slots)
-        self.use_sinkhorn = bool(use_sinkhorn)
+        self.placement = placement or ("sinkhorn" if use_sinkhorn else "rank")
         n_dev = len(jax.devices())
         if self.T % n_dev:
             self.T += n_dev - (self.T % n_dev)
@@ -77,10 +78,29 @@ class MultihostTick:
             raise RuntimeError(
                 f"global mesh got {self.mesh.size} devices, expected {n_dev}"
             )
-        # buffer layout: header ++ sizes[T] ++ speed[W] ++ free[W] ++
-        # active[W] ++ hb_age[W]  (no inflight section — see module doc)
-        self.buflen = _HEADER + self.T + 4 * self.W
+        # buffer layout: header ++ sizes[T] [++ prio[T]] ++ speed[W] ++
+        # free[W] ++ active[W] ++ hb_age[W]  (no inflight section — see
+        # module doc). Priorities ride the broadcast since round 4
+        # (verdict item 3): admission order under --multihost matches the
+        # single-host dispatcher instead of silently degrading to FCFS.
+        # Only the rank placement HAS hard priority classes (auction and
+        # sinkhorn admission is soft by construction, matching the
+        # single-host contract), so the prio section — T floats of mostly
+        # zeros otherwise — exists exactly when placement == "rank". The
+        # section's presence is derived from constructor parameters every
+        # process already shares, so the layouts agree by construction.
+        self.prio_section = self.placement == "rank"
+        self.buflen = (
+            _HEADER + (2 if self.prio_section else 1) * self.T + 4 * self.W
+        )
         self._prev_live = None  # device, replicated; carried across ticks
+        # auction warm prices: carried PER PROCESS as device state. The
+        # collective tick's outputs are replicated and bit-identical in
+        # every process, so each process's carry (and its refresh
+        # decision, checked one tick late like SchedulerArrays') stays in
+        # lockstep without any extra communication.
+        self._prev_price = None
+        self._price_refresh = None
         self.process_index = jax.process_index()
         #: set when a lead tick failed AFTER its broadcast: the followers
         #: are (or will be) blocked inside that tick's device collectives,
@@ -106,8 +126,14 @@ class MultihostTick:
         T, W = self.T, self.W
         n_valid = int(buf[1])
         tte = np.float32(buf[2])
+        has_prio = buf[3] > 0.5
         off = _HEADER
         sizes = buf[off : off + T]; off += T
+        prio = None
+        if self.prio_section:
+            # f32 carries the (clamped) priorities exactly: lead_tick
+            # clips to +/-2^24, inside f32's integer-exact range
+            prio = buf[off : off + T].astype(np.int32); off += T
         speed = buf[off : off + W]; off += W
         free = buf[off : off + W].astype(np.int32); off += W
         active = buf[off : off + W] > 0.5; off += W
@@ -135,7 +161,17 @@ class MultihostTick:
         d_infl = put(np.full(1, -1, dtype=np.int32), repl)
         if self._prev_live is None:
             self._prev_live = put(np.zeros(W, dtype=bool), repl)
+        prio_d = (
+            put(prio, task_sh) if (self.prio_section and has_prio) else None
+        )
 
+        if self._price_refresh is not None and bool(self._price_refresh):
+            # last warm attempt's prices went stale: cold re-solve this
+            # tick. The bool() sync reads a REPLICATED value computed a
+            # whole tick ago — same decision in every process, no
+            # communication.
+            self._prev_price = None
+        self._price_refresh = None
         out = sharded_scheduler_tick(
             self.mesh,
             ts,
@@ -148,10 +184,15 @@ class MultihostTick:
             d_infl,
             jnp.float32(tte),
             max_slots=self.max_slots,
-            use_sinkhorn=self.use_sinkhorn,
+            placement=self.placement,
+            task_priority=prio_d,
             n_valid=jnp.int32(n_valid),
+            auction_price=self._prev_price,
         )
         self._prev_live = out.live  # replicated; identical in every process
+        if self.placement == "auction":
+            self._prev_price = out.auction_price
+            self._price_refresh = out.auction_refresh
         # task-sharded assignment -> full copy everywhere (a collective:
         # every process participates, only the lead acts on the result)
         assignment = multihost_utils.process_allgather(
@@ -179,6 +220,7 @@ class MultihostTick:
         hb_age: np.ndarray,
         inflight_worker: np.ndarray,
         time_to_expire: float,
+        task_priorities: np.ndarray | None = None,  # i32[n] un-padded
     ):
         n = len(task_sizes)
         if n > self.T:
@@ -186,9 +228,19 @@ class MultihostTick:
         buf = np.zeros(self.buflen, dtype=np.float32)
         buf[1] = n
         buf[2] = time_to_expire
+        buf[3] = 0.0 if task_priorities is None else 1.0
         off = _HEADER
         buf[off : off + n] = task_sizes
         off += self.T
+        if self.prio_section:
+            if task_priorities is not None:
+                # clip into f32's integer-exact range so the broadcast
+                # cannot merge distinct priorities (PendingTask clamps to
+                # +/-2^30; 2^24 levels is beyond any real admission policy)
+                buf[off : off + n] = np.clip(
+                    task_priorities, -(2**24), 2**24
+                ).astype(np.float32)
+            off += self.T
         buf[off : off + self.W] = worker_speed; off += self.W
         buf[off : off + self.W] = worker_free; off += self.W
         buf[off : off + self.W] = worker_active; off += self.W

@@ -448,20 +448,18 @@ class ResidentScheduler(SchedulerArrays):
         """Place a task-axis array: sharded over the mesh when present."""
         if self.mesh is None:
             return jnp.asarray(a)
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from tpu_faas.parallel.mesh import shard_task_arrays
 
-        from tpu_faas.parallel.mesh import TASK_AXIS
-
-        return jax.device_put(a, NamedSharding(self.mesh, P(TASK_AXIS)))
+        return shard_task_arrays(self.mesh, jnp.asarray(a))[0]
 
     def _put_repl(self, a):
         """Place a fleet/packet array: replicated over the mesh when
         present (a plain committed copy otherwise)."""
         if self.mesh is None:
             return jnp.asarray(a)
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from tpu_faas.parallel.mesh import replicate
 
-        return jax.device_put(a, NamedSharding(self.mesh, P()))
+        return replicate(self.mesh, jnp.asarray(a))[0]
 
     def _ensure_state(self) -> None:
         if self._r_state is not None:

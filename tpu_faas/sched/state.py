@@ -162,12 +162,17 @@ def scheduler_tick(
             # chip (~800 MB each at 50k x 4k) — the bucketed kernel
             # compresses the task axis via the rank-one cost structure and
             # matches it to <0.01% in placement cost (tests/test_sched_
-            # sinkhorn.py) at ~25x less work
+            # sinkhorn.py) at ~25x less work. The LIVE tick also rounds at
+            # bucket level (rounding="bucket", round 4): the exact rounding
+            # pass costs two T x W streams that dominate the solve (~11.5
+            # ms of the measured ~11.7 ms at 50k x 4k regardless of
+            # n_iters), while bucket rounding is one [K, W] argmax + O(T)
+            # gathers with test-pinned equal placement quality
             from tpu_faas.sched.sinkhorn import sinkhorn_placement_bucketed
 
             assignment = sinkhorn_placement_bucketed(
                 task_size, task_valid, worker_speed, worker_free, live,
-                max_slots=max_slots,
+                max_slots=max_slots, n_iters=20, rounding="bucket",
             ).assignment
         else:
             from tpu_faas.sched.sinkhorn import sinkhorn_placement
@@ -225,12 +230,6 @@ class SchedulerArrays:
             raise ValueError(f"unknown placement kernel {self.placement!r}")
         self.mesh = None
         if self.mesh_devices:
-            if self.placement == "auction":
-                # the auction's bidding loop is all-to-all over workers, not
-                # tasks; no sharded variant exists — fail at construction
-                raise ValueError(
-                    "mesh_devices requires placement 'rank' or 'sinkhorn'"
-                )
             from tpu_faas.parallel.mesh import make_mesh
 
             self.mesh = make_mesh(self.mesh_devices)
@@ -473,21 +472,9 @@ class SchedulerArrays:
         hb_age = (now_f - self.last_heartbeat).astype(np.float32)
         if self.multihost is not None:
             # collective tick over the global multi-process mesh; returns
-            # host-view arrays (the allgathered assignment). Priorities are
-            # not in the broadcast protocol (rank-path soft FCFS applies) —
-            # say so ONCE rather than silently narrowing behavior vs the
-            # single-host path
-            if prio is not None and not getattr(
-                self, "_warned_multihost_priority", False
-            ):
-                from tpu_faas.utils.logging import get_logger
-
-                get_logger("sched.state").warning(
-                    "task priority hints are not part of the multihost "
-                    "broadcast protocol and are ignored — admission is "
-                    "FCFS under --multihost"
-                )
-                self._warned_multihost_priority = True
+            # host-view arrays (the allgathered assignment). Priorities
+            # ride the broadcast since round 4 — admission order matches
+            # the single-host path.
             out = self.multihost.lead_tick(
                 np.asarray(task_sizes, dtype=np.float32),
                 self.worker_speed,
@@ -496,6 +483,10 @@ class SchedulerArrays:
                 hb_age,
                 self.inflight_worker,
                 self.time_to_expire,
+                task_priorities=(
+                    None if task_priorities is None
+                    else np.asarray(task_priorities, dtype=np.int32)
+                ),
             )
             self.prev_live = out.live
             return out
@@ -513,6 +504,9 @@ class SchedulerArrays:
             ts = np.zeros(self.max_pending, dtype=np.float32)
             ts[:n] = task_sizes
             out = self._tick_sharded(ts, n, hb_age, prio)
+            if self.placement == "auction":
+                self._d_auction_price = out.auction_price
+                self._d_auction_refresh = out.auction_refresh
         else:
             # one packed upload carries everything that changes every tick
             # (sizes ++ hb ages ++ free counts); the rest is device-resident
@@ -619,7 +613,8 @@ class SchedulerArrays:
             iw,
             tte,
             max_slots=self.max_slots,
-            use_sinkhorn=(self.placement == "sinkhorn"),
+            placement=self.placement,
             task_priority=prio_d,
             n_valid=jnp.int32(n_valid),
+            auction_price=self._d_auction_price,
         )
