@@ -1,0 +1,81 @@
+"""Child for the multihost RESIDENT protocol test: two gloo processes run
+MultihostResidentScheduler — the lead drives registrations, arrivals,
+result churn, and ticks; the follower mirrors packets. Prints placement
+fingerprints and exits via the stop protocol.
+
+Run: python tests/_multihost_resident_child.py <rank> <coordinator_port>
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main() -> None:
+    rank, port = int(sys.argv[1]), sys.argv[2]
+
+    from tpu_faas.parallel.distributed import initialize_multihost
+
+    assert initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=rank,
+        cpu_devices_per_process=4,
+    )
+    import numpy as np
+
+    from tpu_faas.parallel.multihost_resident import MultihostResidentScheduler
+
+    clock = [100.0]
+    r = MultihostResidentScheduler(
+        max_workers=16,
+        max_pending=64,
+        max_inflight=128,
+        max_slots=4,
+        time_to_expire=10.0,
+        clock=lambda: clock[0],
+        use_priority=True,
+    )
+    if rank != 0:
+        r.follow_loop()
+        print("MHRES follower done", flush=True)
+        return
+
+    rng = np.random.default_rng(0)
+    speeds = rng.uniform(0.5, 4.0, 8)
+    for i in range(8):
+        r.register(b"w%d" % i, 2, speed=float(speeds[i]))
+    placed_all = []
+    arrival = 0
+    for tick in range(12):
+        clock[0] += 0.5
+        for i in range(8):
+            r.heartbeat(b"w%d" % i)
+        for _ in range(4):
+            r.pending_add(f"t{arrival}", float(rng.uniform(0.5, 5.0)),
+                          priority=arrival % 3)
+            arrival += 1
+        r.tick_resident()
+        while True:
+            res = r.resolve_next()
+            if res is None:
+                break
+            for tid, row in res.placed:
+                placed_all.append((tid, row))
+                # model a result arriving immediately: slot frees
+                r.worker_free[row] = min(
+                    r.worker_free[row] + 1, int(r.worker_procs[row])
+                )
+    r.lead_stop()
+    import zlib
+
+    fp = sum(
+        zlib.crc32(t.encode()) * (int(w) + 1) % 1000003 for t, w in placed_all
+    )
+    print(
+        f"MHRES lead placed={len(placed_all)} fingerprint={fp}", flush=True
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -37,7 +37,9 @@ def _free_port() -> int:
     return port
 
 
-def _spawn_dispatcher(rank: int, coord: int, zmq_port: int, store_url: str):
+def _spawn_dispatcher(
+    rank: int, coord: int, zmq_port: int, store_url: str, *extra: str
+):
     from tpu_faas.bench.harness import cpu_worker_env
 
     env = cpu_worker_env()
@@ -60,6 +62,7 @@ def _spawn_dispatcher(rank: int, coord: int, zmq_port: int, store_url: str):
         "--tick-period", "0.05",
         "--tte", "2.0",  # fast purge so the crash leg stays snappy
         "--store", store_url,
+        *extra,
     ]
     return subprocess.Popen(
         args, env=env, cwd=REPO,
@@ -159,4 +162,68 @@ def test_lead_failure_before_serving_releases_followers():
             if p.poll() is None:
                 p.kill()
                 p.wait()
+        store_handle.stop()
+
+
+def test_multihost_resident_dispatcher_serves_and_stops():
+    """The UNIFIED path (`--resident --multihost`): per-tick DCN traffic is
+    the resident delta packet, resident state shards over the global
+    2-process mesh — and the full real stack still serves, and the stop
+    broadcast still releases the follower (round-4; round 3 made resident
+    and multihost mutually exclusive)."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    coord, zmq_port = _free_port(), _free_port()
+    follower = _spawn_dispatcher(
+        1, coord, zmq_port, store_handle.url, "--resident"
+    )
+    lead = _spawn_dispatcher(
+        0, coord, zmq_port, store_handle.url, "--resident"
+    )
+    workers = []
+    try:
+        workers = [
+            _spawn_worker(
+                "push_worker", 2, f"tcp://127.0.0.1:{zmq_port}",
+                "--hb", "--hb-period", "0.3",
+            )
+            for _ in range(2)
+        ]
+        client = FaaSClient(gw.url)
+        fid = client.register(lambda x: x * 11, name="mul11")
+        handles = [client.submit(fid, i) for i in range(12)]
+        deadline = time.time() + 180
+        done = {}
+        while len(done) < 12 and time.time() < deadline:
+            for i, h in enumerate(handles):
+                if i in done:
+                    continue
+                st = h.status()
+                if st in ("COMPLETED", "FAILED"):
+                    assert st == "COMPLETED", (i, st)
+                    done[i] = h.result(timeout=5.0)
+            time.sleep(0.2)
+        assert len(done) == 12, f"only {len(done)}/12 completed"
+        assert all(done[i] == i * 11 for i in range(12))
+
+        # shutdown contract: SIGTERM the lead right after activity (the
+        # timing that once collided a mismatched stop broadcast); the
+        # resident stop packet must release the follower cleanly
+        os.kill(lead.pid, signal.SIGTERM)
+        lead_out, _ = lead.communicate(timeout=60)
+        assert lead.returncode == 0, lead_out[-2000:]
+        assert "stop broadcast sent" in lead_out, lead_out[-2000:]
+        follower_out, _ = follower.communicate(timeout=60)
+        assert follower.returncode == 0, follower_out[-2000:]
+        assert "stop after" in follower_out, follower_out[-1500:]
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        for p in (lead, follower):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        gw.stop()
         store_handle.stop()

@@ -1,0 +1,118 @@
+"""resident + multihost: the delta-packet protocol over a REAL two-process
+gloo pod (parallel/multihost_resident.py).
+
+The child (tests/_multihost_resident_child.py) drives registrations,
+prioritized arrivals, result churn, 12 ticks, and the stop broadcast
+through MultihostResidentScheduler; the follower mirrors every packet.
+This parent asserts both ranks exit cleanly through the STOP protocol (not
+coordinator-death containment) and that the lead's placements are
+IDENTICAL to a single-process ResidentScheduler fed the same scenario —
+the packet protocol adds no semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _single_process_reference() -> tuple[int, int]:
+    """The child's exact scenario on a plain single-device
+    ResidentScheduler; returns (n_placed, fingerprint)."""
+    from tpu_faas.sched.resident import ResidentScheduler
+
+    clock = [100.0]
+    r = ResidentScheduler(
+        max_workers=16,
+        max_pending=64,
+        max_inflight=128,
+        max_slots=4,
+        time_to_expire=10.0,
+        clock=lambda: clock[0],
+        use_priority=True,
+    )
+    rng = np.random.default_rng(0)
+    speeds = rng.uniform(0.5, 4.0, 8)
+    for i in range(8):
+        r.register(b"w%d" % i, 2, speed=float(speeds[i]))
+    placed_all = []
+    arrival = 0
+    for _ in range(12):
+        clock[0] += 0.5
+        for i in range(8):
+            r.heartbeat(b"w%d" % i)
+        for _ in range(4):
+            r.pending_add(
+                f"t{arrival}", float(rng.uniform(0.5, 5.0)),
+                priority=arrival % 3,
+            )
+            arrival += 1
+        r.tick_resident()
+        while True:
+            res = r.resolve_next()
+            if res is None:
+                break
+            for tid, row in res.placed:
+                placed_all.append((tid, row))
+                r.worker_free[row] = min(
+                    r.worker_free[row] + 1, int(r.worker_procs[row])
+                )
+    import zlib
+
+    fp = sum(
+        zlib.crc32(t.encode()) * (int(w) + 1) % 1000003 for t, w in placed_all
+    )
+    return len(placed_all), fp
+
+
+def test_two_process_resident_packet_protocol():
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    existing = os.environ.get("PYTHONPATH", "")
+    env = dict(
+        os.environ, PYTHONPATH=f"{REPO}:{existing}" if existing else REPO
+    )
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "tests/_multihost_resident_child.py",
+                str(rank), str(port),
+            ],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, out[-2000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    m = re.search(r"MHRES lead placed=(\d+) fingerprint=(\d+)", outs[0])
+    assert m, outs[0][-1500:]
+    placed, fp = int(m.group(1)), int(m.group(2))
+    # follower exited through the STOP protocol, not containment
+    assert "MHRES follower done" in outs[1], outs[1][-1500:]
+    assert "Terminating process" not in outs[1]
+    # the packet protocol changes nothing: single-process resident makes
+    # the identical placements
+    ref_placed, ref_fp = _single_process_reference()
+    assert (placed, fp) == (ref_placed, ref_fp)

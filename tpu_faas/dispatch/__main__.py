@@ -116,7 +116,9 @@ def main(argv: list[str] | None = None) -> None:
         "device-resident between ticks; each tick uploads one small delta "
         "packet instead of the whole batch. The steady-state "
         "high-throughput path; composes with --mesh (task axis of the "
-        "resident state sharded over the devices), not yet --multihost",
+        "resident state sharded over the devices) AND --multihost (the "
+        "delta packet becomes the per-tick broadcast; state shards over "
+        "the global mesh)",
     )
     ap.add_argument(
         "--mesh", type=int, default=0, metavar="N",
@@ -244,10 +246,9 @@ def main(argv: list[str] | None = None) -> None:
                 # in the fleet would hang forever on an operator typo.
                 if ns.mesh:
                     sys.exit("--multihost owns the global mesh; drop --mesh")
-                if ns.resident:
+                if ns.resident and ns.placement == "auction":
                     sys.exit(
-                        "--resident composes with --mesh (sharded resident "
-                        "state) but not yet with --multihost"
+                        "--resident supports placement rank|sinkhorn"
                     )
                 # join the global runtime BEFORE any other backend use;
                 # followers never reach the dispatcher construction below
@@ -262,17 +263,34 @@ def main(argv: list[str] | None = None) -> None:
                 import jax
 
                 if jax.process_index() != 0:
-                    from tpu_faas.parallel.multihost_tick import MultihostTick
-
                     log.info(
                         "multihost follower %d/%d: %d global devices",
                         jax.process_index(), jax.process_count(),
                         len(jax.devices()),
                     )
                     # shape args mirror the lead's dispatcher kwargs below —
-                    # the broadcast buffer layout and the kernel's statics
-                    # must agree in every process, which is why max-slots is
-                    # a CLI flag rather than a buried constructor default
+                    # the broadcast buffer/packet layout and the kernel's
+                    # statics must agree in every process, which is why
+                    # max-slots is a CLI flag rather than a buried
+                    # constructor default
+                    if ns.resident:
+                        from tpu_faas.parallel.multihost_resident import (
+                            MultihostResidentScheduler,
+                        )
+
+                        MultihostResidentScheduler.from_shape(
+                            max_workers=ns.max_fleet,
+                            max_pending=ns.max_pending,
+                            max_inflight=ns.max_inflight,
+                            max_slots=ns.max_slots,
+                            time_to_expire=ns.tte,
+                            placement=ns.placement,
+                        ).follow_loop(
+                            watchdog_timeout=ns.follower_watchdog or None
+                        )
+                        return
+                    from tpu_faas.parallel.multihost_tick import MultihostTick
+
                     MultihostTick(
                         max_pending=ns.max_pending,
                         max_workers=ns.max_fleet,
@@ -331,7 +349,23 @@ def main(argv: list[str] | None = None) -> None:
         except BaseException:
             if not serving:
                 try:
-                    mt = getattr(getattr(d, "arrays", None), "multihost", None)
+                    arrays = getattr(d, "arrays", None)
+                    mt = getattr(arrays, "multihost", None)
+                    if mt is None and hasattr(arrays, "lead_stop"):
+                        mt = arrays  # resident+multihost: arrays is the lead
+                    if mt is None and ns.resident:
+                        from tpu_faas.parallel.multihost_resident import (
+                            MultihostResidentScheduler,
+                        )
+
+                        mt = MultihostResidentScheduler.from_shape(
+                            max_workers=ns.max_fleet,
+                            max_pending=ns.max_pending,
+                            max_inflight=ns.max_inflight,
+                            max_slots=ns.max_slots,
+                            time_to_expire=ns.tte,
+                            placement=ns.placement,
+                        )
                     if mt is None:
                         from tpu_faas.parallel.multihost_tick import (
                             MultihostTick,

@@ -106,13 +106,27 @@ class TpuPushDispatcher(TaskDispatcher):
             raise ValueError(
                 "--multihost owns the global mesh; --mesh is single-process"
             )
-        if resident and multihost:
-            raise ValueError(
-                "--resident composes with --mesh (sharded resident state) "
-                "but not yet with --multihost"
-            )
         self.resident = resident
-        if resident:
+        if resident and multihost:
+            # the unified fast+multihost path: the resident delta packet IS
+            # the per-tick broadcast, resident state shards over the GLOBAL
+            # mesh (parallel/multihost_resident.py). This process is the
+            # lead; followers run MultihostResidentScheduler.follow_loop.
+            from tpu_faas.parallel.multihost_resident import (
+                MultihostResidentScheduler,
+            )
+
+            self.arrays = MultihostResidentScheduler.from_shape(
+                max_workers=max_workers,
+                max_pending=max_pending,
+                max_inflight=max_inflight,
+                max_slots=max_slots,
+                time_to_expire=time_to_expire,
+                placement=placement,
+                clock=clock,
+            )
+            self._resident_tasks = {}
+        elif resident:
             from tpu_faas.sched.resident import ResidentScheduler
 
             # the steady-state path: pending set, heartbeat stamps, free
@@ -152,11 +166,17 @@ class TpuPushDispatcher(TaskDispatcher):
                 mesh_devices=mesh_devices,
             )
             self._resident_tasks = {}
-        if multihost:
+        if multihost and not resident:
             # this process is the LEAD of a multi-process dispatcher fleet:
             # followers (started with the same --multihost flags, nonzero
             # process id) sit in MultihostTick.follow_loop and participate
-            # in every tick's collectives over the global mesh
+            # in every tick's collectives over the global mesh. The
+            # resident+multihost combination does NOT attach this object:
+            # its packet protocol lives on the arrays themselves
+            # (MultihostResidentScheduler), and a second tick object here
+            # would broadcast a DIFFERENT buffer shape at shutdown — a
+            # collective mismatch that crashes the fleet at the one moment
+            # it should be draining cleanly
             from tpu_faas.parallel.multihost_tick import MultihostTick
 
             self.arrays.multihost = MultihostTick(
@@ -756,6 +776,7 @@ class TpuPushDispatcher(TaskDispatcher):
             self._intake()
         if (
             len(self.pending) > a.KA
+            and a.supports_bulk_load
             and not a.slot_task
             and not a._arrivals
             and not a._unresolved
@@ -992,11 +1013,16 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.estimator.maybe_persist(force=True)
                 except Exception:
                     pass  # shutdown flush is best-effort
-            if self.arrays.multihost is not None:
-                # release the followers before the sockets: they block in a
-                # collective and would hang their processes forever
+            # release followers before the sockets: they block in a
+            # collective and would hang their processes forever. Either
+            # the classic multihost tick owns them, or (resident+multihost)
+            # the arrays object itself is the lead.
+            stopper = self.arrays.multihost
+            if stopper is None and hasattr(self.arrays, "lead_stop"):
+                stopper = self.arrays
+            if stopper is not None:
                 try:
-                    self.arrays.multihost.lead_stop()
+                    stopper.lead_stop()
                 except Exception:
                     self.log.exception("multihost stop broadcast failed")
             self.socket.close(linger=0)

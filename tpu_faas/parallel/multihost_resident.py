@@ -1,0 +1,342 @@
+"""Multihost RESIDENT tick: the delta-packet fast path over a global mesh.
+
+Round 3 forced a choice: the `--resident` path (device-resident state, one
+small delta packet per tick) was single-process, and `--multihost`
+re-broadcast O(T + 4W) of full vectors every tick. This module is the
+unification: the dispatcher fleet's per-tick DCN traffic becomes the
+resident DELTA PACKET — a fixed-shape buffer of a few tens of KB bounded
+by per-tick churn capacities (KA/KH/KF/KI/KS/KB), independent of how many
+tasks are pending or how big the fleet is — and the resident state itself
+is sharded over the GLOBAL mesh.
+
+How it works: the resident state is a pure function of the packet
+sequence (sched/resident.py keeps every mutable input in the packet,
+time_to_expire included), so perfectly replicated state across processes
+needs nothing but identical packets. The LEAD runs the normal
+ResidentScheduler host logic and broadcasts each packet (flush or fused
+tick) with ``broadcast_one_to_all`` before dispatching the kernel; every
+FOLLOWER applies the identical kernel to its shards of the same global
+arrays. Task-axis arrays are sharded over the global mesh (the placement's
+global sorts lower to collective exchanges, ICI within a slice, DCN
+across); fleet arrays replicate; kernel OUTPUTS are forced replicated via
+``out_shardings`` so the lead reads the compacted results directly. The
+packet's opcode header slot distinguishes tick / flush / stop, so the
+broadcast stays a single fixed shape and followers always know what to
+run.
+
+Cold-start note: ``pending_bulk_load`` (a host-side full upload) is not
+part of the packet protocol — a restart backlog drips through arrival
+packets instead (ceil(n/KA) broadcasts, one-time; raise KA for faster
+adoption). The dispatcher handles this automatically.
+
+Reference parity: the reference has no multi-node dispatcher at all
+(SURVEY §3.2); this is the TPU-native scale-out story — one dispatcher
+fleet whose scheduler state and placement problem span hosts, with
+per-tick coordination cost O(churn), not O(state).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_faas.sched.resident import (
+    _OP_FLUSH,
+    _OP_STOP,
+    _OP_TICK,
+    _flush_kernel,
+    _resident_tick,
+    ResidentScheduler,
+    _ResidentState,
+    ResidentTickOutput,
+)
+from tpu_faas.utils.logging import get_logger
+
+log = get_logger("parallel.multihost_resident")
+
+
+class MultihostResidentScheduler(ResidentScheduler):
+    """ResidentScheduler whose kernels run collectively over the global
+    multi-process mesh.
+
+    Construct with IDENTICAL shape/capacity parameters in every process
+    (they define the packet layout and compiled shapes). The lead (process
+    0) uses it exactly like a ResidentScheduler — the dispatcher's host
+    logic is unchanged — and calls :meth:`lead_stop` on shutdown.
+    Followers call :meth:`follow_loop`.
+    """
+
+    @classmethod
+    def from_shape(
+        cls,
+        *,
+        max_workers: int,
+        max_pending: int,
+        max_inflight: int,
+        max_slots: int,
+        time_to_expire: float,
+        placement: str,
+        clock=None,
+    ):
+        """The ONE constructor every process uses. The packet layout and
+        kernel statics must agree fleet-wide; keeping the kwargs (and the
+        use_priority pin) here makes lead/follower/crash-path drift
+        impossible — three call sites, one shape contract."""
+        kw = dict(
+            max_workers=max_workers,
+            max_pending=max_pending,
+            max_inflight=max_inflight,
+            max_slots=max_slots,
+            time_to_expire=time_to_expire,
+            placement=placement,
+            use_priority=True,
+        )
+        if clock is not None:
+            kw["clock"] = clock
+        return cls(**kw)
+
+    def __init__(self, *args, **kw):
+        import jax
+
+        kw.setdefault("mesh_devices", len(jax.devices()))
+        super().__init__(*args, **kw)
+        if self.mesh.size != len(jax.devices()):
+            raise ValueError(
+                "multihost resident mode owns the GLOBAL mesh; do not pass "
+                "a smaller mesh_devices"
+            )
+        self.process_index = jax.process_index()
+        self._out_jits = None
+        self._broken = False
+
+    # -- placement over the global mesh ------------------------------------
+    # jax.device_put cannot place host data onto a sharding that spans
+    # OTHER processes' devices; make_array_from_callback materializes the
+    # locally-addressable shards from the (identical) host copy instead.
+    def _put_task(self, a):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_faas.parallel.mesh import TASK_AXIS
+
+        a = np.asarray(a)
+        return jax.make_array_from_callback(
+            a.shape, NamedSharding(self.mesh, P(TASK_AXIS)),
+            lambda idx: a[idx],
+        )
+
+    def _put_repl(self, a):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        a = np.asarray(a)
+        return jax.make_array_from_callback(
+            a.shape, NamedSharding(self.mesh, P()), lambda idx: a[idx]
+        )
+
+    # -- collective kernel dispatch ----------------------------------------
+    def _jits(self):
+        """The tick/flush kernels re-jitted with explicit out_shardings:
+        outputs replicated (the lead must read them whole; followers get
+        bit-identical copies), state keeping its task-sharded/replicated
+        layout so the carry stays stable across ticks."""
+        if self._out_jits is not None:
+            return self._out_jits
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_faas.parallel.mesh import TASK_AXIS
+
+        task_sh = NamedSharding(self.mesh, P(TASK_AXIS))
+        repl = NamedSharding(self.mesh, P())
+        state_sh = _ResidentState(
+            sizes=task_sh, valid=task_sh, prio=task_sh,
+            last_hb=repl, free=repl, inflight=repl, prev_live=repl,
+            speed=repl, active=repl,
+        )
+        out_sh = ResidentTickOutput(
+            placed_slots=repl, placed_rows=repl, arrival_slots=repl,
+            redispatch_slots=repl, purged=repl, live=repl, n_pending=repl,
+        )
+        tick = jax.jit(
+            _resident_tick.__wrapped__,
+            static_argnames=(
+                "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP",
+                "KR", "max_slots", "placement", "use_priority",
+            ),
+            out_shardings=(out_sh, state_sh),
+        )
+        flush = jax.jit(
+            _flush_kernel.__wrapped__,
+            static_argnames=(
+                "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB",
+                "use_priority",
+            ),
+            out_shardings=(state_sh, repl),
+        )
+        self._out_jits = (tick, flush)
+        return self._out_jits
+
+    def _broadcast(self, buf: np.ndarray) -> np.ndarray:
+        import jax
+        from jax.experimental import multihost_utils
+
+        # STRICT ALTERNATION with the kernel computations: the broadcast
+        # is itself a collective, and on backends that execute independent
+        # computations concurrently (the CPU pod used for dev/testing) it
+        # could otherwise interleave with a still-in-flight tick's
+        # collectives on the same gloo pairs — observed as a gloo
+        # "received data size doesn't match" crash at shutdown. Blocking
+        # on the state chain first guarantees at most one collective group
+        # is in flight fleet-wide. (On TPU runtimes per-device execution
+        # is already ordered; this wait then costs only the tail of the
+        # previous tick, which the next broadcast would wait on anyway.)
+        if self._r_state is not None:
+            jax.block_until_ready(self._r_state)
+        return np.asarray(multihost_utils.broadcast_one_to_all(buf))
+
+    def _apply_packet(self, packet: np.ndarray):
+        """Run the kernel a packet's opcode names — identical in every
+        process."""
+        tick, flush = self._jits()
+        if packet[7] == _OP_FLUSH:
+            return flush(
+                self._put_repl(packet), self._r_state, **self._statics()
+            )
+        return tick(
+            self._put_repl(packet),
+            self._r_state,
+            **self._statics(),
+            KP=self.KP,
+            KR=self.KR,
+            max_slots=self.max_slots,
+            placement=self.placement,
+        )
+
+    def _dispatch(self, packet: np.ndarray, op: float):
+        """Broadcast one packet and apply it — the whole containment
+        contract in one place (both kernel entry points share it)."""
+        packet[7] = op
+        if self._broken:
+            raise RuntimeError(
+                "multihost resident tick previously failed mid-collective; "
+                "restart the fleet"
+            )
+        shared = self._broadcast(packet)
+        try:
+            return self._apply_packet(shared)
+        except Exception:
+            self._mark_broken()
+            raise
+
+    def _run_flush(self, packet: np.ndarray):
+        return self._dispatch(packet, _OP_FLUSH)
+
+    def _run_tick(self, packet: np.ndarray):
+        return self._dispatch(packet, _OP_TICK)
+
+    def _mark_broken(self) -> None:
+        # same containment contract as MultihostTick.lead_tick: after a
+        # post-broadcast failure the followers sit inside this packet's
+        # collectives; any further collective (the stop broadcast
+        # included) would be mismatched
+        self._broken = True
+        log.critical(
+            "multihost resident kernel failed AFTER its broadcast: "
+            "followers are blocked in this packet's collectives — kill "
+            "them (watchdog / coordinator-heartbeat timeout also applies) "
+            "and restart the fleet"
+        )
+
+    supports_bulk_load = False
+
+    def pending_bulk_load(self, *a, **kw):  # pragma: no cover - guard
+        raise RuntimeError(
+            "pending_bulk_load is host-local and cannot ride the multihost "
+            "packet protocol; cold backlogs drip through arrival packets "
+            "(raise KA to speed adoption)"
+        )
+
+    # -- lead shutdown / follower side -------------------------------------
+    def lead_stop(self) -> None:
+        if self._broken:
+            log.warning(
+                "multihost resident stop skipped: fleet marked broken"
+            )
+            return
+        buf = np.zeros(self.packet_len(), dtype=np.float32)
+        buf[7] = _OP_STOP
+        self._broadcast(buf)
+        # rendezvous before anyone exits: a follower that returns from its
+        # loop and tears down the process while the stop broadcast's
+        # transport tail (or the runtime's own shutdown barrier) is still
+        # streaming collides ops on the gloo pairs — observed as a
+        # "received data size doesn't match" terminate at shutdown
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("mh_resident_stop")
+        log.info("multihost resident stop broadcast sent")
+
+    def follow_loop(self, watchdog_timeout: float | None = None) -> None:
+        """Mirror the lead's packet stream until the stop opcode. The
+        state evolves bit-identically from the packets alone; outputs are
+        discarded. ``watchdog_timeout`` hard-exits the process if one
+        packet's collectives block longer than that (lead died mid-tick;
+        see MultihostTick.follow_loop for the rationale)."""
+        self._ensure_state()
+        log.info(
+            "multihost resident follower %d: joined, waiting for packets",
+            self.process_index,
+        )
+        n = 0
+        in_tick_since: list[float | None] = [None]
+        if watchdog_timeout:
+            import os
+            import threading
+            import time as _time
+
+            def watch() -> None:
+                while True:
+                    _time.sleep(min(watchdog_timeout / 4.0, 30.0))
+                    t0 = in_tick_since[0]
+                    if t0 is not None and (
+                        _time.monotonic() - t0 > watchdog_timeout
+                    ):
+                        log.critical(
+                            "multihost resident follower %d: packet stuck "
+                            "> %.0fs; exiting",
+                            self.process_index, watchdog_timeout,
+                        )
+                        os._exit(2)
+
+            threading.Thread(
+                target=watch, name="mh-resident-watchdog", daemon=True
+            ).start()
+        while True:
+            packet = self._broadcast(
+                np.zeros(self.packet_len(), dtype=np.float32)
+            )
+            if packet[7] == _OP_STOP:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("mh_resident_stop")
+                log.info(
+                    "multihost resident follower %d: stop after %d packets",
+                    self.process_index, n,
+                )
+                return
+            if watchdog_timeout:
+                import time as _time
+
+                in_tick_since[0] = _time.monotonic()
+            res = self._apply_packet(packet)
+            # flush returns (state, arrival_slots); tick returns (out, state)
+            st = res[0] if isinstance(res[0], _ResidentState) else res[1]
+            self._r_state = st
+            # force the WHOLE result (outputs included) before re-entering
+            # the broadcast: every collective this packet launched must be
+            # fully drained before the next one starts
+            import jax
+
+            jax.block_until_ready(res)
+            in_tick_since[0] = None
+            n += 1

@@ -34,7 +34,8 @@ TpuPushDispatcher --resident and by bench.py's integrated headline. With
 NamedSharding over the mesh and the identical delta packets drive the
 sharded tick — the fast path IS the multi-chip path (the placement's
 global sorts lower to collective exchanges, same as parallel/mesh.py's
-one-shot tick).
+one-shot tick). parallel/multihost_resident.py extends the same design
+across OS processes: the packet becomes the per-tick broadcast.
 
 Reference parity note: this is the TPU-native answer to the reference's
 per-tick host loop (task_dispatcher.py:251-322) at scales where even
@@ -91,9 +92,12 @@ def _unpack_header(packed):
     )
 
 
-# header slots: the 7 counts above + one reserved flag word (multihost
-# stop rides it so the broadcast stays a single fixed-shape buffer)
-_HEADER = 8
+# header slots: the 7 counts above, one opcode word (multihost resident:
+# 0 = fused tick, 1 = flush, 2 = stop — so the broadcast stays a single
+# fixed-shape buffer), and time_to_expire (in the packet rather than a
+# separate device scalar so followers see tte changes deterministically)
+_OP_TICK, _OP_FLUSH, _OP_STOP = 0.0, 1.0, 2.0
+_HEADER = 9
 
 
 def _first_k_indices(mask, K: int):
@@ -224,7 +228,6 @@ def _flush_kernel(packed, st, *, T, W, I, KA, KH, KF, KI, KS, KB,
 def _resident_tick(
     packed,
     st: _ResidentState,
-    tte,
     *,
     T, W, I, KA, KH, KF, KI, KS, KB, KP, KR,
     max_slots, placement, use_priority,
@@ -243,7 +246,7 @@ def _resident_tick(
         hb_age,
         st.prev_live,
         st.inflight,
-        tte,
+        packed[8],  # time_to_expire rides the packet header
         max_slots=max_slots,
         task_priority=st.prio if use_priority else None,
         placement=placement,
@@ -389,6 +392,10 @@ class ResidentScheduler(SchedulerArrays):
         self._free_sent: np.ndarray | None = None
         self._speed_sent: np.ndarray | None = None
         self._active_sent: np.ndarray | None = None
+
+    #: whether pending_bulk_load's host-side full upload is available
+    #: (the multihost packet protocol can't carry it — subclass overrides)
+    supports_bulk_load: bool = True
 
     # -- pending interface -------------------------------------------------
     def pending_add(self, task_id: str, size: float, priority: int = 0) -> None:
@@ -541,6 +548,8 @@ class ResidentScheduler(SchedulerArrays):
         p[4] = len(infl[0])
         p[5] = len(sp[0])
         p[6] = len(ac[0])
+        p[7] = _OP_TICK  # _run_flush overwrites for flush packets
+        p[8] = self.time_to_expire
         off = _HEADER
         p[off : off + len(arrivals)] = [a.size for a in arrivals]; off += KA
         if self.use_priority:
@@ -558,6 +567,25 @@ class ResidentScheduler(SchedulerArrays):
             T=self.max_pending, W=self.max_workers, I=self.max_inflight,
             KA=self.KA, KH=self.KH, KF=self.KF, KI=self.KI, KS=self.KS,
             KB=self.KB, use_priority=self.use_priority,
+        )
+
+    # -- kernel dispatch (multihost-resident overrides these to broadcast
+    # the packet to follower processes first) ------------------------------
+    def _run_flush(self, packet: np.ndarray):
+        packet[7] = _OP_FLUSH
+        return _flush_kernel(
+            self._put_repl(packet), self._r_state, **self._statics()
+        )
+
+    def _run_tick(self, packet: np.ndarray):
+        return _resident_tick(
+            self._put_repl(packet),
+            self._r_state,
+            **self._statics(),
+            KP=self.KP,
+            KR=self.KR,
+            max_slots=self.max_slots,
+            placement=self.placement,
         )
 
     # -- the tick ----------------------------------------------------------
@@ -588,9 +616,6 @@ class ResidentScheduler(SchedulerArrays):
         now_rel = now_abs - self._epoch
         (hb_idx, hb_val, fr_idx, fr_val, if_idx, if_val,
          sp_idx, sp_val, ac_idx, ac_val) = self._diff_deltas()
-        if self._tte_host != self.time_to_expire:
-            self._d_tte = self._put_repl(np.float32(self.time_to_expire))
-            self._tte_host = self.time_to_expire
 
         # overflow: drain surplus deltas in standalone flush dispatches so
         # the fused tick always sees one in-capacity packet
@@ -620,9 +645,7 @@ class ResidentScheduler(SchedulerArrays):
             if_idx, if_val = if_idx[self.KI :], if_val[self.KI :]
             sp_idx, sp_val = sp_idx[self.KS :], sp_val[self.KS :]
             ac_idx, ac_val = ac_idx[self.KB :], ac_val[self.KB :]
-            st, arrival_slots = _flush_kernel(
-                self._put_repl(packet), self._r_state, **self._statics()
-            )
+            st, arrival_slots = self._run_flush(packet)
             self._r_state = st
             self._d_inflight = st.inflight
             if take:
@@ -639,16 +662,7 @@ class ResidentScheduler(SchedulerArrays):
             now_rel, take, (hb_idx, hb_val), (fr_idx, fr_val),
             (if_idx, if_val), (sp_idx, sp_val), (ac_idx, ac_val),
         )
-        out, st = _resident_tick(
-            self._put_repl(packet),
-            self._r_state,
-            self._d_tte,
-            **self._statics(),
-            KP=self.KP,
-            KR=self.KR,
-            max_slots=self.max_slots,
-            placement=self.placement,
-        )
+        out, st = self._run_tick(packet)
         self._r_state = st
         self._d_inflight = st.inflight
         self.prev_live = st.prev_live
