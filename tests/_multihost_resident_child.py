@@ -4,6 +4,7 @@ result churn, and ticks; the follower mirrors packets. Prints placement
 fingerprints and exits via the stop protocol.
 
 Run: python tests/_multihost_resident_child.py <rank> <coordinator_port>
+     [placement]
 """
 
 from __future__ import annotations
@@ -13,6 +14,7 @@ import sys
 
 def main() -> None:
     rank, port = int(sys.argv[1]), sys.argv[2]
+    placement = sys.argv[3] if len(sys.argv) > 3 else "rank"
 
     from tpu_faas.parallel.distributed import initialize_multihost
 
@@ -27,14 +29,14 @@ def main() -> None:
     from tpu_faas.parallel.multihost_resident import MultihostResidentScheduler
 
     clock = [100.0]
-    r = MultihostResidentScheduler(
+    r = MultihostResidentScheduler.from_shape(
         max_workers=16,
         max_pending=64,
         max_inflight=128,
         max_slots=4,
         time_to_expire=10.0,
+        placement=placement,
         clock=lambda: clock[0],
-        use_priority=True,
     )
     if rank != 0:
         r.follow_loop()

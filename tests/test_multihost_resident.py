@@ -19,11 +19,12 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _single_process_reference() -> tuple[int, int]:
+def _single_process_reference(placement: str) -> tuple[int, int]:
     """The child's exact scenario on a plain single-device
     ResidentScheduler; returns (n_placed, fingerprint)."""
     from tpu_faas.sched.resident import ResidentScheduler
@@ -37,6 +38,7 @@ def _single_process_reference() -> tuple[int, int]:
         time_to_expire=10.0,
         clock=lambda: clock[0],
         use_priority=True,
+        placement=placement,
     )
     rng = np.random.default_rng(0)
     speeds = rng.uniform(0.5, 4.0, 8)
@@ -72,7 +74,11 @@ def _single_process_reference() -> tuple[int, int]:
     return len(placed_all), fp
 
 
-def test_two_process_resident_packet_protocol():
+@pytest.mark.parametrize("placement", ["rank", "auction"])
+def test_two_process_resident_packet_protocol(placement):
+    """rank: the default path. auction: the round-4 price/refresh carry —
+    two extra replicated state fields whose out-sharding and broadcast
+    lockstep only engage with this placement."""
     probe = socket.socket()
     probe.bind(("127.0.0.1", 0))
     port = probe.getsockname()[1]
@@ -88,7 +94,7 @@ def test_two_process_resident_packet_protocol():
         subprocess.Popen(
             [
                 sys.executable, "tests/_multihost_resident_child.py",
-                str(rank), str(port),
+                str(rank), str(port), placement,
             ],
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True,
@@ -114,5 +120,5 @@ def test_two_process_resident_packet_protocol():
     assert "Terminating process" not in outs[1]
     # the packet protocol changes nothing: single-process resident makes
     # the identical placements
-    ref_placed, ref_fp = _single_process_reference()
+    ref_placed, ref_fp = _single_process_reference(placement)
     assert (placed, fp) == (ref_placed, ref_fp)

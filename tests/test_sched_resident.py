@@ -303,9 +303,51 @@ def test_resident_rejected_fcfs_across_multiple_packets():
     assert [a.task_id for a in r._rejected] == [f"t{i}" for i in range(10)]
 
 
-def test_resident_rejects_auction():
-    with pytest.raises(ValueError):
-        ResidentScheduler(max_workers=4, max_pending=8, placement="auction")
+def test_resident_auction_matches_oneshot_across_ticks():
+    """Resident auction (round 4): the in-kernel price carry makes tick 1
+    open from the analytic dual seed (== the one-shot cold solve) and
+    tick 2 from the carried equilibrium (== the one-shot warm solve) —
+    placements must match the SchedulerArrays auction product path
+    tick-for-tick."""
+    r = _mk(placement="auction")
+    plain = SchedulerArrays(
+        max_workers=16, max_pending=64, max_slots=4, time_to_expire=10.0,
+        clock=lambda: 100.0, placement="auction",
+    )
+    rng = np.random.default_rng(5)
+    speeds = rng.uniform(0.5, 4.0, 6)
+    for i in range(6):
+        r.register(b"w%d" % i, 2, speed=float(speeds[i]))
+        plain.register(b"w%d" % i, 2, speed=float(speeds[i]))
+    sizes = rng.uniform(0.5, 5.0, 10).astype(np.float32)
+    for i, sz in enumerate(sizes):
+        r.pending_add(f"t{i}", float(sz))
+    r.tick_resident()
+    res1 = _drain(r)[-1]
+    ref1 = np.asarray(plain.tick(sizes).assignment)[:10]
+    assert dict(res1.placed) == {
+        f"t{i}": int(w) for i, w in enumerate(ref1) if w >= 0
+    }
+    # tick 2: results free the slots; perturbed re-submissions warm-start
+    # from carried prices on BOTH paths
+    for tid, row in res1.placed:
+        r.worker_free[row] = min(
+            r.worker_free[row] + 1, int(r.worker_procs[row])
+        )
+    plain.worker_free[:6] = 2
+    r._clock_box[0] += 0.5
+    for i in range(6):
+        r.heartbeat(b"w%d" % i)
+        plain.heartbeat(b"w%d" % i)
+    sizes2 = (sizes * 1.01).astype(np.float32)
+    for i, sz in enumerate(sizes2):
+        r.pending_add(f"u{i}", float(sz))
+    r.tick_resident()
+    res2 = _drain(r)[-1]
+    ref2 = np.asarray(plain.tick(sizes2).assignment)[:10]
+    assert dict(res2.placed) == {
+        f"u{i}": int(w) for i, w in enumerate(ref2) if w >= 0
+    }
 
 
 def _mesh_scenario(r):
@@ -329,7 +371,7 @@ def _mesh_scenario(r):
     return [(sorted(res.placed), res.n_pending) for res in outs]
 
 
-@pytest.mark.parametrize("placement", ["rank", "sinkhorn"])
+@pytest.mark.parametrize("placement", ["rank", "sinkhorn", "auction"])
 def test_resident_mesh_matches_single_device(placement):
     """--resident composes with --mesh: the SAME delta packets applied to
     task-sharded resident state must resolve like the single-device
@@ -344,7 +386,8 @@ def test_resident_mesh_matches_single_device(placement):
     assert mesh.mesh is not None and mesh.mesh.size == 8
     a = _mesh_scenario(single)
     b = _mesh_scenario(mesh)
-    if placement == "rank":
+    if placement in ("rank", "auction"):
+        # deterministic solvers: placement-for-placement equality
         assert a == b
     else:
         assert [(len(p), n) for p, n in a] == [(len(p), n) for p, n in b]

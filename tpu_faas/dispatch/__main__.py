@@ -246,10 +246,6 @@ def main(argv: list[str] | None = None) -> None:
                 # in the fleet would hang forever on an operator typo.
                 if ns.mesh:
                     sys.exit("--multihost owns the global mesh; drop --mesh")
-                if ns.resident and ns.placement == "auction":
-                    sys.exit(
-                        "--resident supports placement rank|sinkhorn"
-                    )
                 # join the global runtime BEFORE any other backend use;
                 # followers never reach the dispatcher construction below
                 from tpu_faas.parallel.distributed import initialize_multihost

@@ -151,7 +151,7 @@ class MultihostResidentScheduler(ResidentScheduler):
         state_sh = _ResidentState(
             sizes=task_sh, valid=task_sh, prio=task_sh,
             last_hb=repl, free=repl, inflight=repl, prev_live=repl,
-            speed=repl, active=repl,
+            speed=repl, active=repl, price=repl, refresh=repl,
         )
         out_sh = ResidentTickOutput(
             placed_slots=repl, placed_rows=repl, arrival_slots=repl,

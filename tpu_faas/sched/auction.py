@@ -71,6 +71,7 @@ def auction_placement(
     init_price: jnp.ndarray | None = None,  # f32[W * max_slots]
     warm_rounds: int = 64,
     seed_from_rank: bool = True,
+    carry_refresh: jnp.ndarray | None = None,  # bool scalar (resident carry)
 ) -> AuctionResult:
     """``n_phases`` trades phase count against rounds-per-phase: each phase
     reset must repair prices to the finer eps, costing ~n/ratio rounds, so a
@@ -293,6 +294,15 @@ def auction_placement(
             p_sorted
         )
 
+    def rebase(prices):
+        """Drift re-base shared by the warm and resident-carry paths:
+        shift by the smallest POSITIVE price, clamped at 0 — see the warm
+        branch's docstring for why the positive floor (padded fleets pin
+        the global min to 0 forever) and why translation is free."""
+        pos_min = jnp.min(jnp.where(prices > 0, prices, jnp.inf))
+        shift = jnp.where(jnp.isfinite(pos_min), pos_min, 0.0)
+        return jnp.maximum(prices - shift, 0.0)
+
     def budget_cond(limit):
         def cond_b(carry):
             _, _, assigned_slot, r, _ = carry
@@ -301,7 +311,24 @@ def auction_placement(
 
         return cond_b
 
-    if init_price is None and seed_from_rank:
+    if carry_refresh is not None:
+        # -- resident-carry path (round 4): ONE compiled branch for both
+        # cold and warm ticks. The device-resident scheduler cannot switch
+        # between differently-compiled cold/warm solvers per tick (a
+        # lax.cond over both multiplies compile time by minutes at
+        # dispatcher shapes — see above), but it doesn't need to: the
+        # seeded cold start IS "warm bidding from the analytic rank-dual
+        # prices", so cold-vs-warm is just a `where` on the OPENING
+        # prices — the carried equilibrium when fresh, the re-computed
+        # analytic seed when last tick flagged refresh. init_price is
+        # required here (the carried state array).
+        price0 = jnp.where(carry_refresh, rank_dual_seed(), rebase(init_price))
+        price, owner, assigned_slot, rounds, _ = jax.lax.while_loop(
+            budget_cond(warm_rounds),
+            body,
+            (price0, owner0, assigned0, jnp.int32(0), eps_final),
+        )
+    elif init_price is None and seed_from_rank:
         # cold start, seeded: run the fine-eps loop directly from the
         # analytic duals under the same bounded budget as a warm start —
         # the bulk assigns in the first rounds (strict midpoint-dual
@@ -331,20 +358,10 @@ def auction_placement(
         # actually-bid-on slots have reached — clamped at 0 so never-bid
         # slots stay cheapest. Translation changes no bid comparisons among
         # shifted slots, and eps-CS holds from any starting prices anyway.
-        pos_min = jnp.min(
-            jnp.where(init_price > 0, init_price, jnp.inf)
-        )
-        shift = jnp.where(jnp.isfinite(pos_min), pos_min, 0.0)
         price, owner, assigned_slot, rounds, _ = jax.lax.while_loop(
             budget_cond(warm_rounds),
             body,
-            (
-                jnp.maximum(init_price - shift, 0.0),
-                owner0,
-                assigned0,
-                jnp.int32(0),
-                eps_final,
-            ),
+            (rebase(init_price), owner0, assigned0, jnp.int32(0), eps_final),
         )
 
     # -- rank spill (every path): close the leftover tail IN-TICK ----------

@@ -78,6 +78,12 @@ class _ResidentState(NamedTuple):
     prev_live: jnp.ndarray  # bool[W]
     speed: jnp.ndarray  # f32[W] (delta-scattered; learned speeds ride it)
     active: jnp.ndarray  # bool[W]
+    #: f32[W*max_slots] auction slot prices carried tick-over-tick (zeros
+    #: when placement != auction) — see auction_placement's carry_refresh
+    price: jnp.ndarray
+    #: bool scalar: last tick flagged the prices stale (next tick opens
+    #: from the analytic dual seed instead); starts True (cold start)
+    refresh: jnp.ndarray
 
 
 def _unpack_header(packed):
@@ -193,7 +199,7 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     arrival_slots = jnp.where(ok, free_slots, -1).astype(jnp.int32)
     return (
         _ResidentState(sizes, valid, prio, last_hb, free, inflight,
-                       st.prev_live, speed, active),
+                       st.prev_live, speed, active, st.price, st.refresh),
         arrival_slots,
         now,
     )
@@ -237,6 +243,7 @@ def _resident_tick(
         KB=KB, use_priority=use_priority,
     )
     hb_age = now - st.last_hb
+    auction = placement == "auction"
     out = scheduler_tick(
         st.sizes,
         st.valid,
@@ -250,6 +257,8 @@ def _resident_tick(
         max_slots=max_slots,
         task_priority=st.prio if use_priority else None,
         placement=placement,
+        auction_price=st.price if auction else None,
+        auction_refresh=st.refresh if auction else None,
     )
 
     # -- compact placements to KP (slot, row) pairs ------------------------
@@ -284,6 +293,8 @@ def _resident_tick(
     new_state = _ResidentState(
         st.sizes, valid_next, st.prio, st.last_hb, free_next, st.inflight,
         out.live, st.speed, st.active,
+        out.auction_price if auction else st.price,
+        out.auction_refresh if auction else st.refresh,
     )
     res = ResidentTickOutput(
         placed_slots,
@@ -369,10 +380,6 @@ class ResidentScheduler(SchedulerArrays):
         self.KB = min(self.KB, self.max_workers)
         self.KI = min(self.KI, self.max_inflight)
         self.KR = min(self.KR, self.max_inflight)
-        if self.placement == "auction":
-            # auction needs its price state threaded through the resident
-            # carry; not wired yet — rank/sinkhorn are the resident paths
-            raise ValueError("resident mode supports placement rank|sinkhorn")
         self.use_priority = bool(use_priority)
         self._epoch = self.clock()
         self._arrivals: deque[_Arrival] = deque()
@@ -483,6 +490,13 @@ class ResidentScheduler(SchedulerArrays):
             self._put_repl(self.prev_live),
             self._put_repl(self.worker_speed),
             self._put_repl(self.worker_active),
+            # auction carry: prices start at zero with refresh=True, so
+            # the first tick opens from the analytic dual seed (the cold
+            # start IS a warm start from analytic prices)
+            self._put_repl(
+                np.zeros(W * self.max_slots, dtype=np.float32)
+            ),
+            self._put_repl(np.asarray(True)),
         )
         self._hb_sent = hb.copy()
         self._free_sent = self.worker_free.copy()

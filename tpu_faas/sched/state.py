@@ -118,6 +118,7 @@ def scheduler_tick(
     task_priority: jnp.ndarray | None = None,  # i32[T], higher admitted first
     placement: str = "rank",  # rank | auction | sinkhorn
     auction_price: jnp.ndarray | None = None,  # f32[W*max_slots] warm start
+    auction_refresh: jnp.ndarray | None = None,  # bool scalar: resident carry
 ) -> TickOutput:
     # -- failure detection (reference purge_workers, device-side) ----------
     # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
@@ -150,6 +151,7 @@ def scheduler_tick(
         res = auction_placement(
             task_size, task_valid, worker_speed, worker_free, live,
             max_slots=max_slots, init_price=auction_price,
+            carry_refresh=auction_refresh,
         )
         return TickOutput(
             res.assignment, live, purged, redispatch, res.prices,
