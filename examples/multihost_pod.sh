@@ -9,6 +9,10 @@
 # Process 0 (the lead) serves the real stack; process 1 contributes its
 # devices and follows the tick collectives. SIGTERM to the lead releases
 # the follower via the stop broadcast.
+#
+# Add --resident to BOTH processes for the unified fast path: the per-tick
+# broadcast becomes the resident delta packet (O(churn) DCN bytes) and the
+# scheduler state shards over the global mesh (parallel/multihost_resident).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -13,4 +13,7 @@ __all__ = [
     "make_mesh",
     "sharded_scheduler_tick",
     "sharded_sinkhorn_placement",
+    # imported lazily by name to keep `import tpu_faas.parallel` light:
+    # MultihostTick (multihost_tick), MultihostResidentScheduler
+    # (multihost_resident) pull jax collectives machinery on import
 ]
