@@ -71,6 +71,33 @@ def _spawn_dispatcher(
     )
 
 
+def _crash_worker_and_expect_redispatch(client, workers):
+    """SIGKILL workers[0] while it provably holds in-flight tasks; all
+    submissions must still complete on the survivor via the fleet's
+    purge + reclaim machinery. The kill waits until >= 4 tasks report
+    RUNNING: that is both 2-slot workers completely full, so the killed
+    worker's slots really were occupied
+    (a fixed pre-kill sleep could fire before anything dispatched on a
+    loaded box and make the reclaim vacuous) — and 2.5 s tasks cannot
+    have completed inside the poll's exit window. The caller additionally
+    pins the lead's "purged worker row" / "reclaimed ... in-flight" log
+    lines at shutdown."""
+    from tpu_faas.workloads import sleep_task
+
+    fid = client.register(sleep_task)
+    slow = [client.submit(fid, 2.5) for _ in range(6)]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if sum(1 for h in slow if h.status() == "RUNNING") >= 4:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("tasks never reached RUNNING on both workers")
+    workers[0].send_signal(signal.SIGKILL)
+    workers[0].wait()
+    assert [h.result(timeout=120.0) for h in slow] == [2.5] * 6
+
+
 def test_multihost_dispatcher_serves_and_stops():
     store_handle = start_store_thread()
     gw = start_gateway_thread(make_store(store_handle.url))
@@ -104,23 +131,16 @@ def test_multihost_dispatcher_serves_and_stops():
         assert all(done[i] == i + 100 for i in range(12))
 
         # -- worker crash under multihost: redispatch is computed by the
-        # LEAD host-side (the table no longer rides the broadcast); SIGKILL
-        # a worker holding slow tasks and everything must still complete
-        # on the survivor within the fleet's purge + re-dispatch machinery
-        from tpu_faas.workloads import sleep_task
-
-        fid2 = client.register(sleep_task)
-        slow = [client.submit(fid2, 1.0) for _ in range(6)]
-        time.sleep(1.0)  # some land on each 2-slot worker
-        workers[0].send_signal(signal.SIGKILL)
-        workers[0].wait()
-        assert [h.result(timeout=120.0) for h in slow] == [1.0] * 6
+        # LEAD host-side (the table no longer rides the broadcast)
+        _crash_worker_and_expect_redispatch(client, workers)
 
         # -- shutdown contract: SIGTERM the lead; the stop broadcast must
         # release the follower from its blocking collective
         os.kill(lead.pid, signal.SIGTERM)
         lead_out, _ = lead.communicate(timeout=60)
         assert lead.returncode == 0, lead_out[-2000:]
+        assert "purged worker row" in lead_out, lead_out[-2000:]
+        assert "reclaimed" in lead_out, lead_out[-2000:]
         follower_out, _ = follower.communicate(timeout=60)
         assert follower.returncode == 0, follower_out[-2000:]
         assert "stop after" in follower_out
@@ -206,12 +226,20 @@ def test_multihost_resident_dispatcher_serves_and_stops():
         assert len(done) == 12, f"only {len(done)}/12 completed"
         assert all(done[i] == i * 11 for i in range(12))
 
+        # -- worker crash on the UNIFIED path: purge + in-flight
+        # redistribution must ride the delta packet (heartbeat section ages
+        # the dead row out on-device; the redispatch slots come back in the
+        # compacted output)
+        _crash_worker_and_expect_redispatch(client, workers)
+
         # shutdown contract: SIGTERM the lead right after activity (the
         # timing that once collided a mismatched stop broadcast); the
         # resident stop packet must release the follower cleanly
         os.kill(lead.pid, signal.SIGTERM)
         lead_out, _ = lead.communicate(timeout=60)
         assert lead.returncode == 0, lead_out[-2000:]
+        assert "purged worker row" in lead_out, lead_out[-2000:]
+        assert "reclaimed" in lead_out, lead_out[-2000:]
         assert "stop broadcast sent" in lead_out, lead_out[-2000:]
         follower_out, _ = follower.communicate(timeout=60)
         assert follower.returncode == 0, follower_out[-2000:]

@@ -678,43 +678,14 @@ class TpuPushDispatcher(TaskDispatcher):
             with self.tracer.span("device_tick"):
                 out = a.tick(sizes, task_priorities=prios)
 
-            # reclaim in-flight tasks of dead workers (ahead of the queue) —
-            # phase 1: store I/O only, no bookkeeping mutation
-            reclaims: list[tuple[int, PendingTask]] = []
-            drops: list[tuple[int, str]] = []  # failed or vanished
-            for slot in np.flatnonzero(np.asarray(out.redispatch)):
-                slot = int(slot)
-                task_id = a.inflight_task[slot]
-                if task_id is None:
-                    continue
-                pt = self.reclaim_or_fail(
-                    task_id,
-                    self.task_retries.get(task_id, 0),
-                    self.max_task_retries,
-                )
-                if pt is None:
-                    # poison-failed, or payloads vanished (store flushed):
-                    # nothing to re-dispatch, and leaving a retry entry
-                    # would haunt a future task that reuses the id
-                    drops.append((slot, task_id))
-                    continue
-                reclaims.append((slot, pt))
-            # phase 2: bookkeeping only, cannot raise
-            for slot, task_id in drops:
-                a.inflight_clear_slot(slot)
-                self.task_retries.pop(task_id, None)
-                self._task_digest.pop(task_id, None)
-            for slot, pt in reclaims:
-                a.inflight_clear_slot(slot)
-                self.task_retries[pt.task_id] = pt.retries
-                requeued.append(pt)
-            for row in np.flatnonzero(np.asarray(out.purged)):
-                self.log.warning("purged worker row %d", int(row))
-                wid_p = a.row_ids.get(int(row))
-                a.deactivate(int(row))
-                if wid_p is not None and self.estimator is not None:
-                    self.estimator.forget_worker(wid_p)
-                self.n_purged += 1
+            # reclaim in-flight tasks of dead workers (ahead of the queue)
+            # and deactivate the purged rows; an outage raise propagates
+            # with no bookkeeping mutated (the whole tick aborts)
+            self._reap_dead_workers(
+                np.flatnonzero(np.asarray(out.redispatch)),
+                np.flatnonzero(np.asarray(out.purged)),
+                requeued.append,
+            )
 
             # act: send assignments
             assignment = np.asarray(out.assignment)[: len(batch)]
@@ -827,6 +798,58 @@ class TpuPushDispatcher(TaskDispatcher):
             sent += self._act_on_resolved(res)
         return sent
 
+    def _reap_dead_workers(self, redispatch_slots, purged_rows, requeue):
+        """Reclaim the in-flight tasks of dead workers and deactivate the
+        purged rows — shared by the batch tick and the resident resolve.
+
+        Phase 1 is store I/O only (``reclaim_or_fail``) with NO bookkeeping
+        mutation, so a store-outage raise leaves the dispatcher state
+        untouched and the caller's abort path sound; phase 2 is bookkeeping
+        only and cannot raise. ``requeue`` receives each reclaimed
+        PendingTask (the batch tick interleaves into its in-progress
+        requeue list, the resident path appends to the pending deque)."""
+        a = self.arrays
+        reclaims: list[tuple[int, PendingTask]] = []
+        drops: list[tuple[int, str]] = []  # failed or vanished
+        for slot in redispatch_slots:
+            slot = int(slot)
+            task_id = a.inflight_task[slot]
+            if task_id is None:
+                continue
+            pt = self.reclaim_or_fail(
+                task_id,
+                self.task_retries.get(task_id, 0),
+                self.max_task_retries,
+            )
+            if pt is None:
+                # poison-failed, or payloads vanished (store flushed):
+                # nothing to re-dispatch, and leaving a retry entry
+                # would haunt a future task that reuses the id
+                drops.append((slot, task_id))
+                continue
+            reclaims.append((slot, pt))
+        # phase 2: bookkeeping only, cannot raise
+        for slot, task_id in drops:
+            a.inflight_clear_slot(slot)
+            self.task_retries.pop(task_id, None)
+            self._task_digest.pop(task_id, None)
+        for slot, pt in reclaims:
+            a.inflight_clear_slot(slot)
+            self.task_retries[pt.task_id] = pt.retries
+            requeue(pt)
+        if reclaims:
+            self.log.warning(
+                "reclaimed %d in-flight tasks from dead workers",
+                len(reclaims),
+            )
+        for row in purged_rows:
+            self.log.warning("purged worker row %d", int(row))
+            wid_p = a.row_ids.get(int(row))
+            a.deactivate(int(row))
+            if wid_p is not None and self.estimator is not None:
+                self.estimator.forget_worker(wid_p)
+            self.n_purged += 1
+
     def _act_on_resolved(self, res) -> int:
         """Apply one resolved resident tick: reclaims, purges, dispatches."""
         a = self.arrays
@@ -844,48 +867,21 @@ class TpuPushDispatcher(TaskDispatcher):
                     a.worker_free[row] + 1, int(a.worker_procs[row])
                 )
 
-        # -- reclaim in-flight tasks of dead workers (store reads first,
-        # bookkeeping second). An outage aborts the whole tick: nothing is
-        # mutated yet except the resolve itself, so the placements must be
-        # re-queued before re-raising — redispatch slots are simply
-        # recomputed next tick (the workers stay dead).
-        reclaims: list[tuple[int, PendingTask]] = []
-        drops: list[tuple[int, str]] = []
+        # -- reclaim in-flight tasks of dead workers + purge their rows.
+        # An outage aborts the whole tick: the helper's phase split
+        # guarantees nothing is mutated yet except the resolve itself, so
+        # the placements must be re-queued before re-raising — redispatch
+        # slots are simply recomputed next tick (the workers stay dead).
         try:
-            for slot in res.redispatch_slots:
-                task_id = a.inflight_task[slot]
-                if task_id is None:
-                    continue
-                pt = self.reclaim_or_fail(
-                    task_id,
-                    self.task_retries.get(task_id, 0),
-                    self.max_task_retries,
-                )
-                if pt is None:
-                    drops.append((slot, task_id))
-                else:
-                    reclaims.append((slot, pt))
+            self._reap_dead_workers(
+                res.redispatch_slots, res.purged_rows, self.pending.append
+            )
         except STORE_OUTAGE_ERRORS:
             for task_id, row in res.placed:
                 task = self._resident_tasks.pop(task_id, None)
                 if task is not None:
                     undo(task, row)
             raise
-        for slot, task_id in drops:
-            a.inflight_clear_slot(slot)
-            self.task_retries.pop(task_id, None)
-            self._task_digest.pop(task_id, None)
-        for slot, pt in reclaims:
-            a.inflight_clear_slot(slot)
-            self.task_retries[pt.task_id] = pt.retries
-            self.pending.append(pt)
-        for row in res.purged_rows:
-            self.log.warning("purged worker row %d", int(row))
-            wid_p = a.row_ids.get(int(row))
-            a.deactivate(int(row))
-            if wid_p is not None and self.estimator is not None:
-                self.estimator.forget_worker(wid_p)
-            self.n_purged += 1
 
         # -- act on placements (per-task outage degradation: a task whose
         # zombie-finished probe can't be answered flows back instead of
