@@ -1,0 +1,392 @@
+"""Queued-only task cancellation, across every layer that honors it.
+
+Beyond the reference surface (a submitted task there can only run): the
+gateway's POST /cancel/{task_id} transitions QUEUED -> CANCELLED (terminal),
+dispatchers evict the task from any pending structure via the announce-bus
+control message (store/base.py cancel_task, dispatch/base.py
+note_cancelled), and a RUNNING task is refused — cancellation never yanks a
+worker. Covered here: the store protocol, the race-monitor lifecycle
+extension, the gateway HTTP contract + SDK surface, and both tpu-push
+dispatch paths end-to-end (classic batch and device-resident), including
+capacity restoration for placements resolved against cancelled tasks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tpu_faas.client import FaaSClient, TaskCancelledError
+from tpu_faas.core.task import TaskStatus
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.base import CANCEL_ANNOUNCE_PREFIX
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.store.memory import MemoryStore
+from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+from tpu_faas.workloads import sleep_task
+from tests.test_tpu_push_e2e import _make_dispatcher
+from tests.test_workers_e2e import _spawn_worker
+
+
+# -- store protocol ---------------------------------------------------------
+def test_store_cancel_semantics():
+    s = MemoryStore()
+    sub = s.subscribe("tasks")
+    assert s.cancel_task("nope") is None  # unknown task
+
+    s.create_task("t1", "fn", "p", "tasks")
+    assert sub.get_message() == "t1"
+    assert s.cancel_task("t1") == "CANCELLED"
+    assert s.get_status("t1") == "CANCELLED"
+    # the control message follows the create announce on the same channel
+    assert sub.get_message() == CANCEL_ANNOUNCE_PREFIX + "t1"
+    assert s.cancel_task("t1") == "CANCELLED"  # idempotent
+
+    s.create_task("t2", "fn", "p", "tasks")
+    s.set_status("t2", TaskStatus.RUNNING)
+    assert s.cancel_task("t2") == "RUNNING"  # refused: too late
+    s.finish_task("t2", "COMPLETED", "r")
+    assert s.cancel_task("t2") == "COMPLETED"  # terminal: unchanged
+
+    # truth wins over CANCELLED: a result can only reach a CANCELLED
+    # record if the cancel lost its race and the task actually executed
+    # (nothing can produce a result for a never-dispatched task), so a
+    # first_wins write is ADMITTED rather than frozen
+    s.create_task("t3", "fn", "p", "tasks")
+    s.cancel_task("t3")
+    s.finish_task("t3", "COMPLETED", "r", first_wins=True)
+    assert s.get_status("t3") == "COMPLETED"
+    # ...while a DELETEd record stays frozen (no partial resurrection)
+    s.delete("t3")
+    s.finish_task("t3", "COMPLETED", "r2", first_wins=True)
+    assert s.get_status("t3") is None
+
+
+def test_cancel_repairs_clobbered_terminal_record():
+    """The sub-millisecond-task interleaving: a result lands inside
+    cancel_task's read->write window, so its CANCELLED write clobbers the
+    landed terminal record — the post-write repair must restore the true
+    status (from the redundant final_status stamp) and report it instead
+    of claiming the cancel succeeded."""
+
+    from tpu_faas.core.task import FIELD_STATUS
+
+    class StaleReadStore(MemoryStore):
+        """cancel_task's pre-read (hmget of status+params) lies QUEUED
+        exactly once for a COMPLETED record — the stale read that opens
+        the window."""
+
+        def __init__(self):
+            super().__init__()
+            self.lie_once = False
+
+        def hmget(self, key, fields):
+            vals = super().hmget(key, fields)
+            if self.lie_once and fields and fields[0] == FIELD_STATUS:
+                self.lie_once = False
+                return ["QUEUED", *vals[1:]]
+            return vals
+
+    s = StaleReadStore()
+    s.create_task("t", "fn", "p", "tasks")
+    s.finish_task("t", "COMPLETED", "the-result")
+    s.lie_once = True
+    assert s.cancel_task("t") == "COMPLETED"  # repaired, truth reported
+    status, result = s.get_result("t")
+    assert (status, result) == ("COMPLETED", "the-result")
+
+
+def test_duplicate_announce_does_not_eat_cancel_note():
+    """A duplicate announce for a CANCELLED task (dedup-loser adoption,
+    stale-bus replay) must not consume the cancel note while the task
+    still sits in a pending structure — else the cancelled task would
+    dispatch anyway."""
+    from tpu_faas.dispatch.base import TaskDispatcher
+
+    s = MemoryStore()
+    d = TaskDispatcher(store=s)
+    s.create_task("x", "fn", "p", "tasks")
+    assert [t.task_id for t in d.poll_tasks(10)] == ["x"]  # x now "pending"
+    s.cancel_task("x")
+    s.publish("tasks", "x")  # duplicate announce AFTER the cancel
+    assert d.poll_tasks(10) == []  # control msg noted; dup announce skipped
+    assert d.drop_if_cancelled("x") is True  # note survived the skip
+
+
+def test_cancel_refuses_claim_only_mid_create_hash():
+    """A claim-only hash (idempotency path: status setnx landed, payload
+    fields still in flight) must read as unknown to cancel — writing into
+    the creator's window could strand its record status-less."""
+    from tpu_faas.core.task import FIELD_STATUS
+
+    s = MemoryStore()
+    s.hset("t", {FIELD_STATUS: "QUEUED"})  # claim only, no payload yet
+    assert s.cancel_task("t") is None
+    assert s.hget("t", FIELD_STATUS) == "QUEUED"  # untouched
+
+
+def test_cancel_deletes_its_own_ghost_after_mid_window_delete():
+    """The ran-finished-consumed-DELETEd-inside-the-window interleaving:
+    cancel_task's write resurrects the deleted hash as a partial ghost —
+    the post-write probe must detect the missing payload fields, delete
+    the ghost, and report the task unknown (a lingering ghost would
+    swallow a later idempotency-keyed resubmit of the same id)."""
+
+    from tpu_faas.core.task import FIELD_STATUS
+
+    class StaleReadStore(MemoryStore):
+        def __init__(self):
+            super().__init__()
+            self.lie_once = False
+
+        def hmget(self, key, fields):
+            if self.lie_once and fields and fields[0] == FIELD_STATUS:
+                self.lie_once = False
+                # stale pre-read for a record already DELETEd: a fully
+                # created QUEUED record (status + payload both present)
+                return ["QUEUED", "p"]
+            return super().hmget(key, fields)
+
+    s = StaleReadStore()
+    s.create_task("t", "fn", "p", "tasks")
+    s.finish_task("t", "COMPLETED", "r")
+    s.delete("t")  # client consumed the result and forgot the task
+    s.lie_once = True
+    assert s.cancel_task("t") is None  # ghost detected and removed
+    assert s.hgetall("t") == {}
+    # the same id can now be resubmitted cleanly
+    assert s.create_task_if_absent("t", "fn", "p", "tasks") is True
+    assert s.get_status("t") == "QUEUED"
+
+
+def test_stale_cancel_note_does_not_drop_resubmitted_task():
+    """An idempotency-keyed resubmit after DELETE reuses the SAME
+    deterministic task id. A cancel note left over from the first
+    incarnation must not drop the fresh QUEUED task — drop sites verify
+    the record really reads CANCELLED before dropping."""
+    from tpu_faas.dispatch.base import TaskDispatcher
+
+    s = MemoryStore()
+    d = TaskDispatcher(store=s)
+    s.create_task("idem-1", "fn", "p", "tasks")
+    assert [t.task_id for t in d.poll_tasks(10)] == ["idem-1"]
+    s.cancel_task("idem-1")
+    assert d.poll_tasks(10) == []  # note recorded
+    # client consumes the CANCELLED record, then resubmits the same key
+    s.delete("idem-1")
+    s.create_task("idem-1", "fn", "p", "tasks")
+    # the fresh incarnation must dispatch: the note is stale
+    assert d.drop_if_cancelled("idem-1") is False
+    assert [t.task_id for t in d.poll_tasks(10)] == ["idem-1"]
+    assert d.stats()["cancelled_dropped"] == 0
+
+
+def test_cancel_wakes_result_subscribers():
+    """CANCELLED is terminal: the results channel must announce it so
+    parked /result long-polls wake instead of sleeping out their budget."""
+    from tpu_faas.store.base import RESULTS_CHANNEL
+
+    s = MemoryStore()
+    sub = s.subscribe(RESULTS_CHANNEL)
+    s.create_task("t", "fn", "p", "tasks")
+    s.cancel_task("t")
+    assert sub.get_message() == "t"
+
+
+def test_dispatcher_intake_skips_and_evicts_cancelled():
+    """Both eviction signals: a cancel BEFORE intake is dropped by the
+    non-QUEUED announce skip; a cancel AFTER intake is dropped at the
+    dispatch site via the noted control message."""
+    from tpu_faas.dispatch.base import TaskDispatcher
+
+    s = MemoryStore()
+    d = TaskDispatcher(store=s)
+    s.create_task("a", "fn", "p", "tasks")
+    s.create_task("b", "fn", "p", "tasks")
+    assert [t.task_id for t in d.poll_tasks(10)] == ["a", "b"]
+    s.cancel_task("b")  # b already sits in dispatcher-local state
+    assert d.poll_tasks(10) == []  # drains the control message
+    assert d.drop_if_cancelled("b") is True
+    assert d.drop_if_cancelled("b") is False  # note consumed
+    assert d.drop_if_cancelled("a") is False
+
+    s.create_task("c", "fn", "p", "tasks")
+    s.cancel_task("c")  # cancel lands before this dispatcher ever drains c
+    assert d.poll_tasks(10) == []  # announce skipped: status is CANCELLED
+    assert d.stats()["cancelled_dropped"] == 1
+
+
+# -- race-monitor lifecycle -------------------------------------------------
+def test_racecheck_cancel_transitions():
+    mon = RaceMonitor()
+    store = RaceCheckStore(MemoryStore(), mon, actor="t")
+    # clean queued-only cancel: no violations at all
+    store.create_task("ok", "fn", "p", "tasks")
+    store.cancel_task("ok")
+    mon.assert_clean(allow_warnings=False)
+
+    # cancel racing dispatch, both lawful interleavings = warnings only
+    store.create_task("race", "fn", "p", "tasks")
+    store.set_status("race", TaskStatus.RUNNING)
+    store.hset("race", {"status": "CANCELLED"})  # conditional write lost
+    store.finish_task("race", "COMPLETED", "r")  # reality converges
+    assert mon.errors == []
+    kinds = {v.kind for v in mon.warnings}
+    assert "cancel-after-dispatch" in kinds
+    assert "late-cancel-race" in kinds
+    # a genuinely illegal overwrite still errors
+    store.hset("race", {"status": "RUNNING"})
+    assert any(v.kind == "terminal-overwrite" for v in mon.errors)
+
+
+# -- gateway contract + SDK -------------------------------------------------
+def test_gateway_cancel_contract():
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    raw = make_store(store_handle.url)
+    client = FaaSClient(gw.url)
+    try:
+        r = client.http.post(f"{gw.url}/cancel/ghost")
+        assert r.status_code == 404
+
+        # queued (no dispatcher running) -> cancelled; idempotent repeat
+        fid = client.register(lambda x: x, name="ident")
+        h = client.submit(fid, 1)
+        assert h.cancel() is True
+        assert h.status() == "CANCELLED"
+        assert h.cancel() is True
+        with pytest.raises(TaskCancelledError):
+            h.result(timeout=5.0)
+        # CANCELLED is terminal: DELETE /task accepts it
+        h.forget()
+        r = client.http.get(f"{gw.url}/status/{h.task_id}")
+        assert r.status_code == 404
+
+        # running -> 409, SDK maps to False
+        h2 = client.submit(fid, 2)
+        raw.set_status(h2.task_id, TaskStatus.RUNNING)
+        r = client.http.post(f"{gw.url}/cancel/{h2.task_id}")
+        assert r.status_code == 409
+        assert h2.cancel() is False
+
+        # terminal -> no-op reporting the terminal status
+        raw.finish_task(h2.task_id, "COMPLETED", "r")
+        r = client.http.post(f"{gw.url}/cancel/{h2.task_id}")
+        assert r.status_code == 200
+        body = r.json()
+        assert body == {
+            "task_id": h2.task_id, "status": "COMPLETED", "cancelled": False,
+        }
+        assert h2.cancel() is False
+    finally:
+        gw.stop()
+        store_handle.stop()
+
+
+def test_cancel_wakes_parked_long_poll():
+    """A client parked in GET /result?wait= must wake the moment the task
+    is cancelled, not after its full wait budget."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(lambda x: x, name="ident")
+        h = client.submit(fid, 1)
+        threading.Timer(0.5, h.cancel).start()
+        t0 = time.monotonic()
+        status, _ = client.raw_result(h.task_id, wait=20.0)
+        assert status == "CANCELLED"
+        assert time.monotonic() - t0 < 10.0  # woke early, not at the cap
+    finally:
+        gw.stop()
+        store_handle.stop()
+
+
+# -- tpu-push end-to-end (classic batch + device-resident paths) ------------
+def _cancel_e2e(resident: bool) -> None:
+    """One 1-process worker saturated by a slow blocker; tasks cancelled
+    while QUEUED must end CANCELLED without ever running, capacity
+    consumed by their (resident) placements must come back, and the whole
+    run must be race-clean with zero warnings — cancellation here never
+    races dispatch, because the blocker pins the only slot."""
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(
+        RaceCheckStore(make_store(store_handle.url), monitor, actor="gateway")
+    )
+    disp = _make_dispatcher(
+        store_handle.url,
+        resident=resident,
+        store=RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="dispatcher"
+        ),
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    worker = _spawn_worker(
+        "push_worker", 1, f"tcp://127.0.0.1:{disp.port}",
+        "--hb", "--hb-period", "0.3",
+    )
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        blocker = client.submit(fid, 2.5)
+        deadline = time.time() + 60
+        while blocker.status() != "RUNNING" and time.time() < deadline:
+            time.sleep(0.05)
+        assert blocker.status() == "RUNNING"
+
+        queued = [client.submit(fid, 0.01) for _ in range(4)]
+        # cancel only once the dispatcher provably HOLDS all four (drained
+        # off the bus into pending / the resident mirror): a cancel landing
+        # before intake is honored by the announce skip instead of a drop
+        # site, and the ==4 drop-counter assertion below would flake
+        tids = {h.task_id for h in queued}
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:  # serve thread mutates both structures concurrently
+                held = {t.task_id for t in disp.pending}
+                held.update(disp._resident_tasks)
+            except RuntimeError:
+                continue
+            if tids <= held:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("queued tasks never reached the dispatcher")
+        assert all(h.cancel() for h in queued)
+
+        # follow-up work after the cancels: proves the slot capacity
+        # consumed by any resident placements of cancelled tasks came back
+        followup = [client.submit(fid, 0.01) for _ in range(2)]
+        assert blocker.result(timeout=60.0) == 2.5
+        assert [h.result(timeout=60.0) for h in followup] == [0.01] * 2
+        for h in queued:
+            assert h.status() == "CANCELLED"
+            with pytest.raises(TaskCancelledError):
+                h.result(timeout=5.0)
+        # every cancelled task was dropped by a dispatch site (they were
+        # all pending dispatcher-side when cancelled)
+        deadline = time.time() + 30
+        while disp.n_cancelled_dropped < 4 and time.time() < deadline:
+            time.sleep(0.05)
+        assert disp.n_cancelled_dropped == 4
+        monitor.assert_clean(allow_warnings=False)
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_tpu_push_cancel_e2e():
+    _cancel_e2e(resident=False)
+
+
+def test_resident_cancel_e2e():
+    _cancel_e2e(resident=True)

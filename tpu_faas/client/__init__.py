@@ -1,10 +1,17 @@
 """Client SDK for the REST gateway (sync; async lives in
 tpu_faas.client.aio, imported lazily so sync users don't pay for aiohttp)."""
 
-from tpu_faas.client.sdk import FaaSClient, TaskHandle, TaskFailedError
+from tpu_faas.client.sdk import (
+    FaaSClient,
+    TaskCancelledError,
+    TaskFailedError,
+    TaskHandle,
+)
 
 # async names stay OUT of __all__: `import *` must not eagerly pull aiohttp
-__all__ = ["FaaSClient", "TaskHandle", "TaskFailedError"]
+__all__ = [
+    "FaaSClient", "TaskHandle", "TaskCancelledError", "TaskFailedError",
+]
 
 _LAZY_ASYNC = ("AsyncFaaSClient", "AsyncTaskHandle")
 

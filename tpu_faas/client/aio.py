@@ -18,7 +18,11 @@ from typing import Any, Callable
 
 import aiohttp
 
-from tpu_faas.client.sdk import TaskFailedError, _unwrap_terminal
+from tpu_faas.client.sdk import (
+    TaskCancelledError,
+    TaskFailedError,
+    _unwrap_terminal,
+)
 from tpu_faas.core.executor import pack_params
 from tpu_faas.core.serialize import serialize
 
@@ -69,6 +73,12 @@ class AsyncTaskHandle:
     async def forget(self) -> None:
         """Delete this task's store record once terminal."""
         await self.client.delete_task(self.task_id)
+
+    async def cancel(self) -> bool:
+        """Best-effort queued-only cancel; True = the record now reads
+        CANCELLED, which a lost dispatch race can still overwrite (see
+        sync TaskHandle.cancel for the full contract)."""
+        return await self.client.cancel(self.task_id)
 
 
 class AsyncFaaSClient:
@@ -250,6 +260,18 @@ class AsyncFaaSClient:
         ) as r:
             r.raise_for_status()
 
+    async def cancel(self, task_id: str) -> bool:
+        """POST /cancel/{task_id}; True when the task is now CANCELLED.
+        409 (RUNNING) maps to False — "too late" is an answer, not an
+        error (sync FaaSClient.cancel)."""
+        async with self.request(
+            "POST", f"{self.base_url}/cancel/{task_id}"
+        ) as r:
+            if r.status == 409:
+                return False
+            r.raise_for_status()
+            return bool((await r.json()).get("cancelled"))
+
     async def run(
         self, fn: Callable, *args: Any, timeout: float = 60.0, **kwargs: Any
     ) -> Any:
@@ -257,4 +279,9 @@ class AsyncFaaSClient:
         return await handle.result(timeout)
 
 
-__all__ = ["AsyncFaaSClient", "AsyncTaskHandle", "TaskFailedError"]
+__all__ = [
+    "AsyncFaaSClient",
+    "AsyncTaskHandle",
+    "TaskCancelledError",
+    "TaskFailedError",
+]

@@ -27,6 +27,14 @@ class TaskFailedError(Exception):
         self.cause = cause
 
 
+class TaskCancelledError(Exception):
+    """Raised by result() when the task was cancelled before it ran."""
+
+    def __init__(self, task_id: str) -> None:
+        super().__init__(f"task {task_id} was CANCELLED before it ran")
+        self.task_id = task_id
+
+
 @dataclass
 class TaskHandle:
     client: "FaaSClient"
@@ -42,6 +50,16 @@ class TaskHandle:
         """Delete this task's store record once terminal (frees the store;
         the gateway refuses with 409 while the task is still live)."""
         self.client.delete_task(self.task_id)
+
+    def cancel(self) -> bool:
+        """Best-effort queued-only cancel; True when the record now reads
+        CANCELLED. False when it could not be cancelled — already RUNNING
+        or already terminal. True is best-effort, not a guarantee the
+        function never executes: a cancel racing a concurrent dispatch can
+        lose (store/base.py cancel_task), in which case the task runs and
+        the record converges to COMPLETED/FAILED — poll status() before
+        relying on side effects having been suppressed."""
+        return self.client.cancel(self.task_id)
 
     def result(self, timeout: float = 60.0, poll_interval: float = 0.01) -> Any:
         """Wait until terminal; return the deserialized value or raise
@@ -67,9 +85,12 @@ class TaskHandle:
 
 def _unwrap_terminal(task_id: str, status: str, payload: str):
     """(done, value) for one /result poll — the single place that knows the
-    terminal-status protocol (FAILED carries a serialized exception)."""
+    terminal-status protocol (FAILED carries a serialized exception;
+    CANCELLED carries no result at all)."""
     if not TaskStatus(status).is_terminal():
         return False, None
+    if status == str(TaskStatus.CANCELLED):
+        raise TaskCancelledError(task_id)
     value = deserialize(payload)
     if status == str(TaskStatus.FAILED):
         raise TaskFailedError(task_id, value)
@@ -149,6 +170,17 @@ class FaaSClient:
     def delete_task(self, task_id: str) -> None:
         r = self.http.delete(f"{self.base_url}/task/{task_id}")
         r.raise_for_status()
+
+    def cancel(self, task_id: str) -> bool:
+        """POST /cancel/{task_id}; True when the task is now CANCELLED.
+        409 (RUNNING — the gateway refuses) maps to False rather than an
+        exception: "too late to cancel" is an expected answer, not an
+        error."""
+        r = self.http.post(f"{self.base_url}/cancel/{task_id}")
+        if r.status_code == 409:
+            return False
+        r.raise_for_status()
+        return bool(r.json().get("cancelled"))
 
     def raw_result(self, task_id: str, wait: float = 0.0) -> tuple[str, str]:
         """``wait`` > 0 long-polls at the gateway (capped server-side). The

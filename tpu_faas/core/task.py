@@ -3,6 +3,13 @@
 Lifecycle contract (reference SURVEY §0.1; status enum observed at reference
 test_suit.py:19): QUEUED -> RUNNING -> COMPLETED | FAILED. Statuses are plain
 strings on the wire and in the store.
+
+Beyond the reference surface: QUEUED -> CANCELLED (terminal), written by the
+gateway's POST /cancel/{task_id}. Cancellation is queued-only and
+best-effort: a task already RUNNING keeps running (the gateway refuses with
+409), and the rare cancel that loses its race against dispatch simply runs
+to completion — the record then reads COMPLETED/FAILED, never a lie. See
+store/base.py cancel_task for the protocol.
 """
 
 from __future__ import annotations
@@ -17,9 +24,13 @@ class TaskStatus(str, enum.Enum):
     RUNNING = "RUNNING"
     COMPLETED = "COMPLETED"
     FAILED = "FAILED"
+    #: terminal "never ran, never will": queued-only cancellation
+    CANCELLED = "CANCELLED"
 
     def is_terminal(self) -> bool:
-        return self in (TaskStatus.COMPLETED, TaskStatus.FAILED)
+        return self in (
+            TaskStatus.COMPLETED, TaskStatus.FAILED, TaskStatus.CANCELLED
+        )
 
     def __str__(self) -> str:  # plain string on the wire
         return self.value
@@ -41,6 +52,14 @@ FIELD_TIMEOUT = "timeout"  # float as str; execution budget enforced in-child
 #: str) — lets the gateway's optional result-TTL sweeper age out consumed
 #: records without a per-task client DELETE.
 FIELD_FINISHED_AT = "finished_at"
+#: Redundant copy of the result's terminal status, written by finish_task in
+#: the same hash write as FIELD_STATUS. Exists for exactly one interleaving:
+#: a cancel whose pre-write status read said QUEUED while a sub-millisecond
+#: task ran to completion inside the read->write window would otherwise
+#: clobber the landed COMPLETED/FAILED forever (the status field alone
+#: can't say what it was). cancel_task re-reads this field after its write
+#: and restores the record — see store/base.py cancel_task.
+FIELD_FINAL_STATUS = "final_status"
 
 #: Written (epoch seconds as str) with every RUNNING mark and refreshed
 #: periodically by the dispatcher that owns the task's worker. A RUNNING

@@ -40,6 +40,7 @@ from tpu_faas.core.task import (
     claim_field_for,
 )
 from tpu_faas.store.base import (
+    CANCEL_ANNOUNCE_PREFIX,
     DISPATCHERS_KEY,
     LEASE_CONF_KEY,
     TASKS_CHANNEL,
@@ -210,6 +211,76 @@ class TaskDispatcher:
         self._store_down = False
         self._last_flush_attempt = 0.0
         self._stats_server = None
+        #: task_id -> note-time for cancel control messages consumed from
+        #: the bus (store/base.py cancel_task). Entries are consumed when
+        #: the matching task is dropped at a dispatch site; entries whose
+        #: task this dispatcher never held (shared-fleet siblings) age out.
+        self.cancelled: dict[str, float] = {}
+        self.n_cancelled_dropped = 0
+
+    #: cancel notes older than this are discarded by the cap sweep below
+    #: (correctness never rides on a note — drop sites verify against the
+    #: store — so the TTL only bounds memory, and only needs to fire when
+    #: the dict is actually large)
+    CANCEL_NOTE_TTL = 900.0
+    _CANCEL_NOTE_CAP = 200_000
+
+    # -- cancellation ------------------------------------------------------
+    def note_cancelled(self, task_id: str) -> None:
+        """A cancel control message arrived: remember it so dispatch sites
+        can drop the task if it is sitting in a pending structure. Bounded:
+        TTL-pruned opportunistically, hard-capped against a rogue
+        publisher flooding the channel."""
+        now = time.monotonic()
+        self.cancelled[task_id] = now
+        if len(self.cancelled) > self._CANCEL_NOTE_CAP:
+            cutoff = now - self.CANCEL_NOTE_TTL
+            self.cancelled = {
+                t: ts for t, ts in self.cancelled.items() if ts > cutoff
+            }
+            # evict to a LOW watermark (oldest-first; dicts iterate in
+            # insertion order), not just below the cap: trimming one entry
+            # would make a sustained flood pay the full O(cap) rebuild on
+            # every subsequent message
+            while len(self.cancelled) > self._CANCEL_NOTE_CAP // 2:
+                self.cancelled.pop(next(iter(self.cancelled)))
+
+    def drop_if_cancelled(self, task_id: str) -> bool:
+        """True when ``task_id`` was cancelled — the dispatch site must
+        drop the task instead of dispatching it (its record already reads
+        CANCELLED; no store write is needed). Consumes the note.
+
+        The note alone is NOT trusted: the drop is verified against the
+        store, because a note can go stale while the task id stays live —
+        an idempotency-keyed resubmit after DELETE reuses the SAME
+        deterministic id, and dropping that fresh QUEUED task on a stale
+        note would strand it forever. Notes are rare (one per cancel), so
+        the verification read is off the hot path. A store outage skips
+        the drop instead of raising: the task dispatches, and if it really
+        was cancelled this is the documented lost-race convergence (the
+        result overwrites the stale CANCELLED) — never a wedged loop."""
+        if self.cancelled.pop(task_id, None) is None:
+            return False
+        try:
+            status = self.store.get_status(task_id)
+        except STORE_OUTAGE_ERRORS:
+            return False
+        if status is not None and status != str(TaskStatus.CANCELLED):
+            # stale note, live record: the id was resubmitted
+            # (idempotency-key reuse after a DELETE) — dispatch normally;
+            # THIS pending copy is that fresh incarnation, delivered by
+            # its own create announce
+            return False
+        # CANCELLED — or vanished entirely (cancelled then DELETEd while
+        # still pending here): both mean this copy must never dispatch.
+        # Running a vanished one would resurrect the deleted hash as a
+        # partial record via the RUNNING mark — the exact resurrection
+        # _result_frozen guards against on the result path. A resubmitted
+        # incarnation is never lost by this drop: it re-enters pending via
+        # its own announce.
+        self.n_cancelled_dropped += 1
+        self.log.info("dropped cancelled task %s before dispatch", task_id)
+        return True
 
     # -- intake ------------------------------------------------------------
     def poll_next_task(self) -> PendingTask | None:
@@ -224,6 +295,13 @@ class TaskDispatcher:
                 msg, from_backlog = self.subscriber.get_message(), False
                 if msg is None:
                     return None
+            if msg.startswith(CANCEL_ANNOUNCE_PREFIX):
+                # cancel control message, not a task announce: no store
+                # read, so it can't hit an outage — never parked
+                self.note_cancelled(msg[len(CANCEL_ANNOUNCE_PREFIX):])
+                if from_backlog:
+                    self._announce_backlog.popleft()
+                continue
             try:
                 fields = self.store.hgetall(msg)
             except STORE_OUTAGE_ERRORS:
@@ -239,8 +317,15 @@ class TaskDispatcher:
                 continue
             if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
                 # duplicate or stale announce: the task was already picked up
-                # (RUNNING — e.g. adopted by a stranded-task rescan) or even
-                # finished; dispatching it again would run it twice
+                # (RUNNING — e.g. adopted by a stranded-task rescan), even
+                # finished, or CANCELLED before this dispatcher ever drained
+                # its announce; dispatching it would run it twice (or at
+                # all). Deliberately does NOT consume a cancel note here: a
+                # DUPLICATE announce for a task still held in a pending
+                # structure would eat the note and let the cancelled task
+                # dispatch — the note is consumed only at drop sites
+                # (store-verified there), and a never-matched note is
+                # pruned by note_cancelled's cap sweep
                 self.log.debug("announce for non-QUEUED task %s; skipping", msg)
                 continue
             return PendingTask.from_fields(msg, fields)
@@ -530,6 +615,7 @@ class TaskDispatcher:
             "store_down": self._store_down,
             "deferred_results": len(self.deferred_results),
             "announce_backlog": len(self._announce_backlog),
+            "cancelled_dropped": self.n_cancelled_dropped,
         }
 
     def reclaim_or_fail(

@@ -147,6 +147,10 @@ class PullDispatcher(TaskDispatcher):
             # mode has no rescanner to find it again); peeked, it simply
             # waits for the next request (same pattern as push.py)
             pt = self.requeued[0]
+            if self.drop_if_cancelled(pt.task_id):
+                self.requeued.popleft()
+                self.task_retries.pop(pt.task_id, None)
+                continue
             if self.task_is_finished(pt.task_id):
                 self.requeued.popleft()
                 self.task_retries.pop(pt.task_id, None)

@@ -306,6 +306,9 @@ class PushDispatcher(TaskDispatcher):
             # and a popped reclaimed task would be lost forever (its record
             # is RUNNING — no rescan ever re-adopts it)
             task = self.requeue[0]
+            if self.drop_if_cancelled(task.task_id):
+                self.requeue.popleft()
+                continue
             # a reclaimed task may have been finished meanwhile by its zombie
             # worker; re-dispatching it would mark a terminal record RUNNING
             # and re-run it — drop it instead

@@ -323,12 +323,15 @@ class TpuPushDispatcher(TaskDispatcher):
             # make that task invisible to indexed rescans if its announce
             # is then lost. None entries are rare (crashed creates only)
             # and merely cost a re-probe per pass.
+            def _terminal(status: str) -> bool:
+                try:  # covers CANCELLED and any future terminal status
+                    return TaskStatus(status).is_terminal()
+                except ValueError:
+                    return False  # foreign status string: leave the entry
             stale_index_entries = [
                 key
                 for key, status in zip(candidates, statuses)
-                if status is not None
-                and status
-                in (str(TaskStatus.COMPLETED), str(TaskStatus.FAILED))
+                if status is not None and _terminal(status)
             ]
             if stale_index_entries:
                 self.store.hdel(LIVE_INDEX_KEY, *stale_index_entries)
@@ -546,9 +549,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 self.task_retries.pop(task_id, None)
                 row = a.inflight_done(task_id)
                 if row is not None:
-                    a.worker_free[row] = min(
-                        a.worker_free[row] + 1, a.worker_procs[row]
-                    )
+                    a.release_slot(row)
                     self._observe_result(wid, row, task_id, data)
             else:
                 self._task_digest.pop(task_id, None)
@@ -640,10 +641,13 @@ class TpuPushDispatcher(TaskDispatcher):
 
         # the device batch is capped at max_pending; overflow (possible when
         # a purge re-queued tasks into an already-full queue) waits its turn
-        batch = [
-            self.pending.popleft()
-            for _ in range(min(len(self.pending), a.max_pending))
-        ]
+        batch = []
+        while self.pending and len(batch) < a.max_pending:
+            t = self.pending.popleft()
+            if self.drop_if_cancelled(t.task_id):
+                self._forget_task_state(t.task_id)
+                continue
+            batch.append(t)
         overflow = self.pending
         self.pending = deque()
         requeued: deque[PendingTask] = deque()
@@ -699,8 +703,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 if task.retries and self.task_is_finished(task.task_id):
                     # reclaimed task finished meanwhile by its zombie worker:
                     # re-dispatching would regress the record to RUNNING
-                    self.task_retries.pop(task.task_id, None)
-                    self._task_digest.pop(task.task_id, None)
+                    self._forget_task_state(task.task_id)
                     restore_from = idx + 1
                     continue
                 try:
@@ -761,6 +764,9 @@ class TpuPushDispatcher(TaskDispatcher):
                 t = self.pending.popleft()
                 if t.task_id in self._resident_tasks:
                     continue
+                if self.drop_if_cancelled(t.task_id):
+                    self._forget_task_state(t.task_id)
+                    continue
                 self._stamp_estimate(t)
                 self._resident_tasks[t.task_id] = t
                 batch.append(t)
@@ -778,6 +784,9 @@ class TpuPushDispatcher(TaskDispatcher):
             t = self.pending.popleft()
             if t.task_id in self._resident_tasks:
                 continue  # already queued device-side (rescan overlap)
+            if self.drop_if_cancelled(t.task_id):
+                self._forget_task_state(t.task_id)
+                continue
             self._stamp_estimate(t)
             self._resident_tasks[t.task_id] = t
             a.pending_add(t.task_id, t.size_estimate, t.priority or 0)
@@ -797,6 +806,15 @@ class TpuPushDispatcher(TaskDispatcher):
                 break
             sent += self._act_on_resolved(res)
         return sent
+
+    def _forget_task_state(self, task_id: str) -> None:
+        """Per-task dispatcher state cleanup when a task leaves this
+        dispatcher WITHOUT a result flowing through _observe_result —
+        cancelled-and-dropped, zombie-finished, or reclaim-failed. ONE
+        place, so a future per-task map can't be forgotten at a subset of
+        the sites (as _task_digest once was)."""
+        self.task_retries.pop(task_id, None)
+        self._task_digest.pop(task_id, None)
 
     def _reap_dead_workers(self, redispatch_slots, purged_rows, requeue):
         """Reclaim the in-flight tasks of dead workers and deactivate the
@@ -831,8 +849,7 @@ class TpuPushDispatcher(TaskDispatcher):
         # phase 2: bookkeeping only, cannot raise
         for slot, task_id in drops:
             a.inflight_clear_slot(slot)
-            self.task_retries.pop(task_id, None)
-            self._task_digest.pop(task_id, None)
+            self._forget_task_state(task_id)
         for slot, pt in reclaims:
             a.inflight_clear_slot(slot)
             self.task_retries[pt.task_id] = pt.retries
@@ -862,10 +879,7 @@ class TpuPushDispatcher(TaskDispatcher):
         # diff carries the correction to the device next tick).
         def undo(task: PendingTask, row: int) -> None:
             self.pending.append(task)
-            if 0 <= row < len(a.worker_free):
-                a.worker_free[row] = min(
-                    a.worker_free[row] + 1, int(a.worker_procs[row])
-                )
+            a.release_slot(row)
 
         # -- reclaim in-flight tasks of dead workers + purge their rows.
         # An outage aborts the whole tick: the helper's phase split
@@ -890,6 +904,14 @@ class TpuPushDispatcher(TaskDispatcher):
             task = self._resident_tasks.pop(task_id, None)
             if task is None:
                 continue
+            if self.drop_if_cancelled(task_id):
+                # cancelled while device-pending: the kernel already
+                # consumed the slot, so return the capacity (the free diff
+                # carries the correction up) — but never dispatch, and
+                # never re-queue
+                self._forget_task_state(task_id)
+                a.release_slot(row)
+                continue
             if row not in a.row_ids:
                 undo(task, row)
                 continue
@@ -903,11 +925,8 @@ class TpuPushDispatcher(TaskDispatcher):
                 if finished:
                     # reclaimed task finished meanwhile by its zombie
                     # worker: re-dispatching would regress the record
-                    self.task_retries.pop(task.task_id, None)
-                    self._task_digest.pop(task.task_id, None)
-                    a.worker_free[row] = min(
-                        a.worker_free[row] + 1, int(a.worker_procs[row])
-                    )
+                    self._forget_task_state(task.task_id)
+                    a.release_slot(row)
                     continue
             try:
                 a.inflight_add(task.task_id, row)

@@ -18,8 +18,10 @@ test_client.py:98-126, test_suit.py:39-91):
 - ``GET /status/{task_id}``    -> {"task_id", "status"}
 - ``GET /result/{task_id}``    -> {"task_id", "status", "result"}
 
-Beyond the reference surface: ``DELETE /task/{task_id}`` (drop a terminal
-task's record), ``GET /healthz``, ``GET /metrics``.
+Beyond the reference surface: ``POST /cancel/{task_id}`` (queued-only
+best-effort cancel: QUEUED -> CANCELLED terminal, RUNNING refused with 409 —
+see cancel_task below), ``DELETE /task/{task_id}`` (drop a terminal task's
+record), ``GET /healthz``, ``GET /metrics``.
 
 Store-side contract on execute (reference old/client_debug.py:40-45): write the
 full task hash (status QUEUED, fn_payload, param_payload, result "None") then
@@ -328,6 +330,7 @@ def make_app(
     app.router.add_post("/execute_batch", execute_batch)
     app.router.add_get("/status/{task_id}", get_status)
     app.router.add_get("/result/{task_id}", get_result)
+    app.router.add_post("/cancel/{task_id}", cancel_task)
     app.router.add_delete("/task/{task_id}", delete_task)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
@@ -824,6 +827,33 @@ async def get_result(request: web.Request) -> web.Response:
     finally:
         if event is not None and waiters is not None:
             waiters.release(task_id, event)
+
+
+async def cancel_task(request: web.Request) -> web.Response:
+    """Queued-only, best-effort cancellation (beyond the reference surface;
+    the reference can only let a submitted task run). QUEUED ->
+    CANCELLED (terminal); a RUNNING task is refused with 409 — it keeps its
+    worker and completes normally; cancelling an already-terminal task is
+    an idempotent no-op reporting the terminal status. The store-level
+    protocol (conditional write + dispatcher eviction via the announce
+    bus + the one benign race) is documented at store/base.py
+    cancel_task."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+    task_id = request.match_info["task_id"]
+    status = await _run_blocking(ctx.store.cancel_task, task_id, ctx.channel)
+    if status is None:
+        return _json_error(404, f"unknown task_id {task_id!r}")
+    if status == str(TaskStatus.RUNNING):
+        return _json_error(
+            409, f"task {task_id!r} is RUNNING and cannot be cancelled"
+        )
+    return web.json_response(
+        {
+            "task_id": task_id,
+            "status": status,
+            "cancelled": status == str(TaskStatus.CANCELLED),
+        }
+    )
 
 
 async def delete_task(request: web.Request) -> web.Response:

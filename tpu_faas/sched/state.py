@@ -367,6 +367,18 @@ class SchedulerArrays:
         slot = self._inflight_slot.get(task_id)
         return None if slot is None else int(self.inflight_worker[slot])
 
+    def release_slot(self, row: int) -> None:
+        """Return one process slot to a worker row, clamped to the row's
+        registered capacity. The single capacity-restore rule for every
+        host-side give-back: a result arriving, a placement the dispatcher
+        decided not to send (row deregistered, inflight table full), and a
+        cancelled task's resolved placement all route here. Out-of-range
+        rows are ignored (a purged row's late give-back has nowhere to go)."""
+        if 0 <= row < len(self.worker_free):
+            self.worker_free[row] = min(
+                self.worker_free[row] + 1, int(self.worker_procs[row])
+            )
+
     def inflight_done(self, task_id: str) -> int | None:
         """Result arrived: free the slot, return the worker row."""
         slot = self._inflight_slot.pop(task_id, None)

@@ -23,6 +23,7 @@ import time
 from typing import Mapping
 
 from tpu_faas.core.task import (
+    FIELD_FINAL_STATUS,
     FIELD_FINISHED_AT,
     FIELD_FN,
     FIELD_PARAMS,
@@ -69,6 +70,14 @@ LEASE_CONF_KEY = "fleet:lease_conf"
 #: channel is fire-and-forget like the task bus — consumers must keep a
 #: fallback re-read, never rely on delivery.
 RESULTS_CHANNEL = "results"
+
+#: Control message on the TASKS announce channel: "<prefix><task_id>" tells
+#: dispatchers to drop the task from any pending structure they hold (the
+#: gateway publishes it only AFTER it actually wrote CANCELLED). Plain
+#: create-announces are bare task ids, which never start with this prefix;
+#: a reference-style consumer that treats it as a task id just finds no
+#: record and skips — the bus stays wire-compatible.
+CANCEL_ANNOUNCE_PREFIX = "!cancel:"
 
 
 class Subscription(abc.ABC):
@@ -341,6 +350,10 @@ class TaskStore(abc.ABC):
             task_id,
             {
                 FIELD_STATUS: str(status),
+                # redundant status copy, same write: lets a racing cancel
+                # that clobbers this terminal record restore it exactly
+                # (see cancel_task's post-write repair)
+                FIELD_FINAL_STATUS: str(status),
                 FIELD_RESULT: result,
                 FIELD_FINISHED_AT: repr(time.time()),
             },
@@ -348,13 +361,120 @@ class TaskStore(abc.ABC):
         self.hdel(LIVE_INDEX_KEY, task_id)
         self.publish(RESULTS_CHANNEL, task_id)
 
+    def cancel_task(
+        self, task_id: str, channel: str = TASKS_CHANNEL
+    ) -> str | None:
+        """Best-effort queued-only cancellation: QUEUED -> CANCELLED.
+
+        Returns the record's status AFTER the attempt — "CANCELLED" when
+        this (or an earlier) call cancelled it, the unchanged status when
+        the task is RUNNING or already terminal, None when unknown. Built
+        from plain hash primitives so any Redis-compatible backend supports
+        it; the read-then-write pair is not atomic, and both racy
+        interleavings against a concurrent dispatch resolve to the truth:
+
+        - dispatch wins, result lands AFTER this write: the finish_task
+          overwrite replaces the stale CANCELLED — transiently wrong,
+          converges forward;
+        - dispatch wins, result lands INSIDE the read->write window (a
+          sub-millisecond task): this write clobbers the landed terminal
+          record, so the post-write repair below re-reads the redundant
+          FIELD_FINAL_STATUS stamp (written by every finish_task in the
+          same hash write as its status) and restores the record exactly —
+          returning the true terminal status, not "CANCELLED".
+
+        A record mid-create (idempotency path: status claimed by setnx,
+        payload fields still in flight) is reported unknown rather than
+        cancelled — there is nothing dispatchable to cancel yet, and
+        writing into the creator's window could strand its record.
+
+        Dispatchers honor the cancel through two independent signals,
+        either of which suffices: intake skips any announce whose record is
+        no longer QUEUED, and the "<CANCEL_ANNOUNCE_PREFIX><task_id>"
+        control message published here evicts the task from pending
+        structures already drained from the bus (dispatch/base.py
+        note_cancelled).
+
+        The terminal write stamps FIELD_FINISHED_AT (result-TTL sweeper
+        ages cancelled records like any other terminal record), drops the
+        live-index entry, and announces on RESULTS_CHANNEL so parked
+        /result long-polls wake immediately."""
+        current, params = self.hmget(task_id, [FIELD_STATUS, FIELD_PARAMS])
+        if current is None:
+            return None
+        if current != str(TaskStatus.QUEUED):
+            return current
+        if params is None:
+            # status QUEUED but no payload: a claim-only hash mid-create
+            # (create_task_if_absent claims status via setnx, then writes
+            # the fields in a second command). Writing CANCELLED here would
+            # race the creator's field write — and the ghost cleanup below
+            # could strip the claimed status out from under it, leaving a
+            # status-less stranded record. Nothing dispatchable exists yet:
+            # report unknown; the caller may retry once the create lands.
+            return None
+        self.hset(
+            task_id,
+            {
+                FIELD_STATUS: str(TaskStatus.CANCELLED),
+                FIELD_FINISHED_AT: repr(time.time()),
+            },
+        )
+        p_params, final = self.hmget(
+            task_id, [FIELD_PARAMS, FIELD_FINAL_STATUS]
+        )
+        if p_params is None:
+            # the record was DELETEd inside the read->write window (ran,
+            # finished, was consumed and forgotten — all sub-ms): this
+            # write just resurrected it as a partial ghost, which would
+            # poison a later idempotency-keyed resubmit of the same id
+            # (create_task_if_absent would see the ghost and swallow the
+            # new submission). Remove OUR OWN fields — not DEL the key —
+            # and report unknown: a recreate requires the status field to
+            # be absent (create_task_if_absent claims it with setnx), so
+            # field-level removal cannot destroy a record a resubmit
+            # managed to recreate, while a DELETE landing after this probe
+            # removes the whole hash itself, ghost included. A concurrent
+            # idempotency CLAIM landing between probe and removal survives
+            # as a claim-only hash, which the gateway's adoption wait and
+            # the TTL sweeper's stale-claim GC already handle.
+            self.hdel(task_id, FIELD_STATUS, FIELD_FINISHED_AT)
+            return None
+        if final is not None:
+            # a result landed inside the read->write window and this write
+            # just clobbered it: restore the true terminal status (the
+            # result payload was never touched — our write carries no
+            # FIELD_RESULT)
+            self.hset(task_id, {FIELD_STATUS: final})
+            self.publish(RESULTS_CHANNEL, task_id)
+            return final
+        self.hdel(LIVE_INDEX_KEY, task_id)
+        self.publish(channel, CANCEL_ANNOUNCE_PREFIX + task_id)
+        self.publish(RESULTS_CHANNEL, task_id)
+        return str(TaskStatus.CANCELLED)
+
     def _result_frozen(self, task_id: str) -> bool:
         """first_wins guard: True when the record must not be overwritten —
         already terminal, or absent (a record the client consumed and
         DELETEd must not be resurrected as a partial status+result hash by a
-        zombie's late write)."""
+        zombie's late write).
+
+        CANCELLED does NOT freeze: a result can only reach a CANCELLED
+        record when the cancel LOST its race and the task actually executed
+        (a genuinely-cancelled task never dispatches, so nothing can
+        produce a result for it) — e.g. the lost-race task's worker was
+        purged, the reclaimed copy correctly dropped, and the zombie then
+        delivered the genuine result via a first_wins path. Truth wins:
+        freezing would pin 'never ran' over real side effects."""
         current = self.get_status(task_id)
-        return current is None or TaskStatus(current).is_terminal()
+        if current is None:
+            return True
+        if current == str(TaskStatus.CANCELLED):
+            return False
+        try:
+            return TaskStatus(current).is_terminal()
+        except ValueError:
+            return True  # foreign status string: never overwrite
 
     def get_result(self, task_id: str) -> tuple[str | None, str | None]:
         """(status, result) in one round-trip — the client poll hot path."""

@@ -281,6 +281,7 @@ class RespStore(TaskStore):
         result path is the dispatcher's per-task hot path and must not grow
         a second RTT for the wake-up feature."""
         from tpu_faas.core.task import (
+            FIELD_FINAL_STATUS,
             FIELD_FINISHED_AT,
             FIELD_RESULT,
             FIELD_STATUS,
@@ -292,6 +293,9 @@ class RespStore(TaskStore):
             (
                 "HSET", task_id,
                 FIELD_STATUS, str(status),
+                # redundant stamp powering cancel_task's clobber repair
+                # (base.finish_task writes the same field)
+                FIELD_FINAL_STATUS, str(status),
                 FIELD_RESULT, result,
                 FIELD_FINISHED_AT, repr(time.time()),
             ),

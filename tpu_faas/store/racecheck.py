@@ -52,6 +52,13 @@ _LEGAL: frozenset[tuple[str | None, str]] = frozenset(
         # reported as a warning, see _transition_kind.
         ("QUEUED", "COMPLETED"),
         ("QUEUED", "FAILED"),
+        # queued-only cancellation (gateway POST /cancel, store cancel_task)
+        ("QUEUED", "CANCELLED"),
+        # the cancel's conditional write racing a concurrent RUNNING mark:
+        # lawful per the protocol (the task runs; its result overwrites the
+        # stale CANCELLED later) but worth surfacing — warning, see
+        # _check_transition
+        ("RUNNING", "CANCELLED"),
     }
 )
 
@@ -125,6 +132,13 @@ class RaceMonitor:
     - ``unknown-task`` — write to a task id with no observed create (only
       with ``strict=True``; otherwise the task is adopted silently, since a
       checker attached mid-run legitimately misses earlier creates).
+    - ``cancel-after-dispatch`` / ``cancel-after-finish`` /
+      ``late-cancel-race`` — the lawful interleavings of the queued-only
+      cancel's conditional write racing a concurrent dispatch
+      (store/base.py cancel_task): CANCELLED lands over RUNNING, CANCELLED
+      transiently clobbers a just-landed terminal record (repaired from
+      the final_status stamp), and the true status lands over the stale
+      CANCELLED.
     """
 
     def __init__(self, *, strict: bool = False, max_events: int = 100_000) -> None:
@@ -247,6 +261,38 @@ class RaceMonitor:
             same = frm == to and (
                 event.result is None or event.result == state.result
             )
+            if frm == "CANCELLED" and to in (
+                "RUNNING", "COMPLETED", "FAILED"
+            ):
+                # the one lawful terminal overwrite: a cancel that LOST its
+                # race against dispatch (store/base.py cancel_task) — the
+                # task ran anyway and reality overwrites the stale record
+                # (includes cancel_task's own post-write repair restoring a
+                # clobbered terminal status)
+                self._flag(
+                    "late-cancel-race",
+                    "warning",
+                    event.task_id,
+                    f"{event.actor} wrote {to} over CANCELLED: the cancel "
+                    f"raced dispatch and lost; the task ran",
+                    prior + (event,),
+                )
+                return
+            if to == "CANCELLED" and frm in ("COMPLETED", "FAILED"):
+                # the sub-millisecond-task interleaving: the result landed
+                # inside the cancel's read->write window and the cancel
+                # write transiently clobbered it — lawful because
+                # cancel_task's post-write repair (keyed on the redundant
+                # final_status stamp) restores the record immediately
+                self._flag(
+                    "cancel-after-finish",
+                    "warning",
+                    event.task_id,
+                    f"{event.actor} wrote CANCELLED over terminal {frm}; "
+                    f"cancel_task's repair restores it from final_status",
+                    prior + (event,),
+                )
+                return
             if not same:
                 self._flag(
                     "terminal-overwrite",
@@ -284,6 +330,16 @@ class RaceMonitor:
                 "warning",
                 event.task_id,
                 f"{event.actor} wrote {to} on a task never marked RUNNING",
+                prior + (event,),
+            )
+        elif frm == "RUNNING" and to == "CANCELLED":
+            self._flag(
+                "cancel-after-dispatch",
+                "warning",
+                event.task_id,
+                f"{event.actor} wrote CANCELLED over RUNNING: the "
+                f"conditional cancel raced a concurrent dispatch; the "
+                f"record converges when the result lands",
                 prior + (event,),
             )
 
