@@ -9,7 +9,7 @@
 // no threads, no locks, no allocation in the steady-state paths beyond the
 // hash tables themselves.
 //
-// Supported commands: PING, SELECT (ignored), HSET, HSETNX, HGET, HMGET, HDEL,
+// Supported commands: PING, SELECT (ignored), HSET, HSETNX, HGET, HEXISTS, HMGET, HDEL,
 // HGETALL, DEL, KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE, FLUSHDB, SAVE, QUIT,
 // SHUTDOWN.
 //
@@ -459,6 +459,17 @@ class Server {
       auto f = h->second.find(cmd[2]);
       if (f == h->second.end()) { reply_nil(c.outbuf); return; }
       reply_bulk(c.outbuf, f->second);
+    } else if (name == "HEXISTS") {
+      if (argc != 2) {
+        reply_error(c.outbuf, "wrong number of arguments for HEXISTS");
+        return;
+      }
+      auto h = store_.hashes.find(cmd[1]);
+      reply_integer(c.outbuf,
+                    h != store_.hashes.end() &&
+                            h->second.find(cmd[2]) != h->second.end()
+                        ? 1
+                        : 0);
     } else if (name == "HSETNX") {
       if (argc != 3) {
         reply_error(c.outbuf, "wrong number of arguments for HSETNX");

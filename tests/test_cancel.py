@@ -71,31 +71,34 @@ def test_cancel_repairs_clobbered_terminal_record():
     status (from the redundant final_status stamp) and report it instead
     of claiming the cancel succeeded."""
 
-    from tpu_faas.core.task import FIELD_STATUS
-
     class StaleReadStore(MemoryStore):
-        """cancel_task's pre-read (hmget of status+params) lies QUEUED
-        exactly once for a COMPLETED record — the stale read that opens
-        the window."""
+        """cancel_task's status pre-read lies QUEUED exactly once for a
+        COMPLETED record — the stale read that opens the window."""
 
         def __init__(self):
             super().__init__()
             self.lie_once = False
 
-        def hmget(self, key, fields):
-            vals = super().hmget(key, fields)
-            if self.lie_once and fields and fields[0] == FIELD_STATUS:
+        def get_status(self, task_id):
+            s = super().get_status(task_id)
+            if self.lie_once and s == "COMPLETED":
                 self.lie_once = False
-                return ["QUEUED", *vals[1:]]
-            return vals
+                return "QUEUED"
+            return s
+
+    from tpu_faas.core.task import FIELD_FINISHED_AT
 
     s = StaleReadStore()
     s.create_task("t", "fn", "p", "tasks")
     s.finish_task("t", "COMPLETED", "the-result")
+    finished_at = s.hget("t", FIELD_FINISHED_AT)
     s.lie_once = True
     assert s.cancel_task("t") == "COMPLETED"  # repaired, truth reported
     status, result = s.get_result("t")
     assert (status, result) == ("COMPLETED", "the-result")
+    # the finish STAMP is restored too (not the cancel's own timestamp):
+    # the TTL sweeper must age the record from when it actually finished
+    assert s.hget("t", FIELD_FINISHED_AT) == finished_at
 
 
 def test_duplicate_announce_does_not_eat_cancel_note():
@@ -134,20 +137,29 @@ def test_cancel_deletes_its_own_ghost_after_mid_window_delete():
     the ghost, and report the task unknown (a lingering ghost would
     swallow a later idempotency-keyed resubmit of the same id)."""
 
-    from tpu_faas.core.task import FIELD_STATUS
+    from tpu_faas.core.task import FIELD_PARAMS
 
     class StaleReadStore(MemoryStore):
+        """Both pre-reads lie exactly once: status QUEUED and params
+        present for a record that was in fact already DELETEd."""
+
         def __init__(self):
             super().__init__()
             self.lie_once = False
+            self._lie_params = False
 
-        def hmget(self, key, fields):
-            if self.lie_once and fields and fields[0] == FIELD_STATUS:
+        def get_status(self, task_id):
+            if self.lie_once:
                 self.lie_once = False
-                # stale pre-read for a record already DELETEd: a fully
-                # created QUEUED record (status + payload both present)
-                return ["QUEUED", "p"]
-            return super().hmget(key, fields)
+                self._lie_params = True
+                return "QUEUED"
+            return super().get_status(task_id)
+
+        def hexists(self, key, field):
+            if self._lie_params and field == FIELD_PARAMS:
+                self._lie_params = False
+                return True
+            return super().hexists(key, field)
 
     s = StaleReadStore()
     s.create_task("t", "fn", "p", "tasks")
@@ -216,6 +228,28 @@ def test_dispatcher_intake_skips_and_evicts_cancelled():
     s.cancel_task("c")  # cancel lands before this dispatcher ever drains c
     assert d.poll_tasks(10) == []  # announce skipped: status is CANCELLED
     assert d.stats()["cancelled_dropped"] == 1
+
+
+def test_shared_fleet_cancel_note_reaches_every_sibling():
+    """Shared mode: every dispatcher on the channel receives the cancel
+    control message; whichever sibling CLAIMED the task drops it at its
+    dispatch site (store-verified), and the others' notes age out
+    harmlessly rather than being load-bearing."""
+    from tpu_faas.dispatch.base import TaskDispatcher
+
+    s = MemoryStore()
+    a = TaskDispatcher(store=s, shared=True)
+    b = TaskDispatcher(store=s, shared=True)
+    s.create_task("t", "fn", "p", "tasks")
+    kept_a = a.claim_for_dispatch(a.poll_tasks(10))
+    kept_b = b.claim_for_dispatch(b.poll_tasks(10))
+    assert len(kept_a) + len(kept_b) == 1  # exactly one sibling owns it
+    s.cancel_task("t")
+    a.poll_tasks(10)
+    b.poll_tasks(10)  # both drain the control message
+    assert "t" in a.cancelled and "t" in b.cancelled
+    owner = a if kept_a else b
+    assert owner.drop_if_cancelled("t") is True
 
 
 # -- race-monitor lifecycle -------------------------------------------------

@@ -111,6 +111,96 @@ def test_autoscaler_policy_unit():
     assert sc.step({"pending": 0, "inflight": 0}) is None
 
 
+def test_autoscaler_backlog_estimate_sizes_the_jump():
+    """With a learned-runtime backlog estimate the scaler adds ENOUGH
+    nodes to hit the drain target in one decision (bounded by max), and
+    falls back to one-node steps when the estimate is absent/None."""
+    from tpu_faas.worker.deploy import AutoScaler
+
+    class FakeFleet:
+        def __init__(self):
+            self.n_live = 2
+
+        def scale_up(self):
+            self.n_live += 1
+
+        def scale_down(self):
+            self.n_live -= 1
+            return self.n_live
+
+    fleet = FakeFleet()
+    sc = AutoScaler(
+        fleet, min_workers=1, max_workers=16, idle_decisions=3,
+        drain_target_s=30.0,
+    )
+    # 2 registered nodes drain in 90s -> want 3x total -> +4 nodes at once
+    assert sc.step(
+        {"pending": 50, "inflight": 0, "backlog_est_s": 90.0,
+         "workers_registered": 2}
+    ) == "up"
+    assert fleet.n_live == 6
+    # SAME stats next decision (spawned nodes not yet registered): the
+    # desired total is computed from workers_registered, so the jump does
+    # NOT compound toward max while registration is in flight
+    assert sc.step(
+        {"pending": 50, "inflight": 0, "backlog_est_s": 90.0,
+         "workers_registered": 2}
+    ) is None
+    assert fleet.n_live == 6
+    # below the target: a single-node nudge
+    assert sc.step(
+        {"pending": 5, "inflight": 0, "backlog_est_s": 10.0,
+         "workers_registered": 6}
+    ) == "up"
+    assert fleet.n_live == 7
+    # estimator off (None): classic one-node policy
+    assert sc.step(
+        {"pending": 5, "inflight": 0, "backlog_est_s": None}
+    ) == "up"
+    assert fleet.n_live == 8
+    # the jump is capped at max_workers
+    assert sc.step(
+        {"pending": 500, "inflight": 0, "backlog_est_s": 3600.0,
+         "workers_registered": 8}
+    ) == "up"
+    assert fleet.n_live == 16
+    assert sc.step(
+        {"pending": 500, "inflight": 0, "backlog_est_s": 3600.0,
+         "workers_registered": 16}
+    ) is None  # at max
+
+
+def test_dispatcher_backlog_estimate():
+    """tpu-push serves backlog_est_s from learned runtimes: None before
+    anything is learned, then pending-work seconds over the fleet's
+    procs x speed rate."""
+    from tpu_faas.dispatch.base import PendingTask
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.store.memory import MemoryStore
+
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, max_workers=8, max_pending=32,
+        max_inflight=32, store=MemoryStore(),
+    )
+    try:
+        est = disp.estimator
+        assert est is not None
+        assert disp._backlog_estimate_s() is None  # nothing learned yet
+        for _ in range(4):
+            est.observe("digest-a", 2.0, b"w0")  # runtime 2 s at speed 1
+        a = disp.arrays
+        a.register(b"w0", 2)  # one worker, 2 procs, speed 1.0
+        disp.pending.extend(
+            PendingTask(f"t{i}", "F", "P", learned=2.0) for i in range(6)
+        )
+        # 6 tasks x 2 s over rate 2 procs x 1.0 = 6 s
+        assert abs(disp._backlog_estimate_s() - 6.0) < 1e-6
+        assert disp.stats()["backlog_est_s"] == 6.0
+    finally:
+        disp.socket.close(linger=0)  # never served: close the bind directly
+        disp.close()
+
+
 def test_autoscaler_end_to_end_grows_and_shrinks():
     """Real stack: a burst of slow tasks grows the fleet from 1 toward max;
     a sustained quiet period drains it back down — gracefully, so every

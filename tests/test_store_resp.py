@@ -79,6 +79,10 @@ def test_resp_store_contract(store_server):
     # HMGET: one round trip, None per missing field, missing key -> all None
     assert s.hmget("k", ["b", "nope", "a"]) == ["2", None, "1"]
     assert s.hmget("ghost", ["a", "b"]) == [None, None]
+    # HEXISTS: presence without transferring the value (cancel_task probes)
+    assert s.hexists("k", "a") is True
+    assert s.hexists("k", "zzz") is False
+    assert s.hexists("ghost", "a") is False
     # finish_task announces the terminal write on the results channel
     from tpu_faas.store.base import RESULTS_CHANNEL
 

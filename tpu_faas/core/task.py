@@ -32,6 +32,21 @@ class TaskStatus(str, enum.Enum):
             TaskStatus.COMPLETED, TaskStatus.FAILED, TaskStatus.CANCELLED
         )
 
+    @classmethod
+    def terminal_str(cls, status: str | None, *, unknown: bool = False) -> bool:
+        """``is_terminal`` over a raw store/wire string. ``unknown`` is the
+        answer for None or a foreign status string — callers pick their
+        safe side (a result-freeze guard wants True: never overwrite what
+        it can't parse; a drop/GC site wants False: leave it alone). The
+        ValueError policy lives HERE so every consumer of raw status
+        strings agrees on it."""
+        if status is None:
+            return unknown
+        try:
+            return cls(status).is_terminal()
+        except ValueError:
+            return unknown
+
     def __str__(self) -> str:  # plain string on the wire
         return self.value
 
@@ -52,14 +67,16 @@ FIELD_TIMEOUT = "timeout"  # float as str; execution budget enforced in-child
 #: str) — lets the gateway's optional result-TTL sweeper age out consumed
 #: records without a per-task client DELETE.
 FIELD_FINISHED_AT = "finished_at"
-#: Redundant copy of the result's terminal status, written by finish_task in
-#: the same hash write as FIELD_STATUS. Exists for exactly one interleaving:
-#: a cancel whose pre-write status read said QUEUED while a sub-millisecond
-#: task ran to completion inside the read->write window would otherwise
-#: clobber the landed COMPLETED/FAILED forever (the status field alone
-#: can't say what it was). cancel_task re-reads this field after its write
-#: and restores the record — see store/base.py cancel_task.
+#: Redundant copies of the result's terminal status and finish time,
+#: written by finish_task in the same hash write as FIELD_STATUS /
+#: FIELD_FINISHED_AT. They exist for exactly one interleaving: a cancel
+#: whose pre-write status read said QUEUED while a sub-millisecond task ran
+#: to completion inside the read->write window would otherwise clobber the
+#: landed COMPLETED/FAILED (and its finish stamp) forever — the primary
+#: fields alone can't say what they were. cancel_task re-reads these after
+#: its write and restores the record — see store/base.py cancel_task.
 FIELD_FINAL_STATUS = "final_status"
+FIELD_FINAL_AT = "final_finished_at"
 
 #: Written (epoch seconds as str) with every RUNNING mark and refreshed
 #: periodically by the dispatcher that owns the task's worker. A RUNNING

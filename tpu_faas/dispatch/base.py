@@ -255,16 +255,15 @@ class TaskDispatcher:
         an idempotency-keyed resubmit after DELETE reuses the SAME
         deterministic id, and dropping that fresh QUEUED task on a stale
         note would strand it forever. Notes are rare (one per cancel), so
-        the verification read is off the hot path. A store outage skips
-        the drop instead of raising: the task dispatches, and if it really
-        was cancelled this is the documented lost-race convergence (the
-        result overwrites the stale CANCELLED) — never a wedged loop."""
-        if self.cancelled.pop(task_id, None) is None:
+        the verification read is off the hot path. Peek-don't-pop, same
+        convention as every other store-read drop site: a store outage
+        RAISES with the note intact, so a cleanly-cancelled task cannot
+        slip out and execute just because the verification read landed
+        mid-outage — callers keep the task pending and retry next tick."""
+        if task_id not in self.cancelled:
             return False
-        try:
-            status = self.store.get_status(task_id)
-        except STORE_OUTAGE_ERRORS:
-            return False
+        status = self.store.get_status(task_id)  # raises on outage
+        self.cancelled.pop(task_id, None)
         if status is not None and status != str(TaskStatus.CANCELLED):
             # stale note, live record: the id was resubmitted
             # (idempotency-key reuse after a DELETE) — dispatch normally;
@@ -744,8 +743,12 @@ class TaskDispatcher:
         re-dispatching then would re-run the side effects and resurrect the
         deleted record as a partial status-only hash (the same hole
         finish_task's first_wins guard closes on the write side)."""
-        status = self.store.get_status(task_id)
-        return status is None or TaskStatus(status).is_terminal()
+        # unknown=True: absent counts as finished (above), and a foreign
+        # status string must not crash the serve loop — not re-dispatching
+        # is the safe side (an unparseable record isn't ours to run)
+        return TaskStatus.terminal_str(
+            self.store.get_status(task_id), unknown=True
+        )
 
     def serve_stats(self, port: int, host: str = "127.0.0.1"):
         """Serve ``stats()`` as JSON over HTTP (``GET /stats``, plus

@@ -323,15 +323,11 @@ class TpuPushDispatcher(TaskDispatcher):
             # make that task invisible to indexed rescans if its announce
             # is then lost. None entries are rare (crashed creates only)
             # and merely cost a re-probe per pass.
-            def _terminal(status: str) -> bool:
-                try:  # covers CANCELLED and any future terminal status
-                    return TaskStatus(status).is_terminal()
-                except ValueError:
-                    return False  # foreign status string: leave the entry
             stale_index_entries = [
                 key
                 for key, status in zip(candidates, statuses)
-                if status is not None and _terminal(status)
+                # unknown=False: foreign status strings keep their entry
+                if status is not None and TaskStatus.terminal_str(status)
             ]
             if stale_index_entries:
                 self.store.hdel(LIVE_INDEX_KEY, *stale_index_entries)
@@ -568,10 +564,76 @@ class TpuPushDispatcher(TaskDispatcher):
                 a.worker_procs[row] = 0
                 self.log.info("worker row %d draining", int(row))
 
+    def _backlog_estimate_s(self) -> float | None:
+        """Estimated seconds to drain the pending backlog at the current
+        fleet's aggregate rate — learned per-function runtimes over
+        procs x learned speed. None until the estimator has observations
+        (before that, task sizes are payload BYTES, a different unit — a
+        byte-sum over a speed-sum would be a meaningless number, and the
+        autoscaler falls back to its queue-depth policy). Served from the
+        stats thread while the serve loop mutates both pending structures:
+        a concurrent-mutation race just skips this decision (None)."""
+        est = self.estimator
+        if est is None:
+            return None
+        try:
+            default = est.default_size()
+        except RuntimeError:  # estimator dict mutated mid-iteration
+            return None
+        if default is None:
+            return None
+        a = self.arrays
+        rate = float(
+            np.where(
+                a.worker_active, a.worker_procs * a.worker_speed, 0.0
+            ).sum()
+        )
+        if rate <= 0.0:
+            # no active capacity (fleet mid-restart / all draining): there
+            # is no meaningful drain time — None keeps the autoscaler on
+            # its one-node fallback instead of an astronomically large
+            # estimate jumping it straight to max_workers
+            return None
+        try:
+            resident = dict(self._resident_tasks)
+            # rescan overlap can hold the same id in BOTH structures (the
+            # move-to-device loops dedup for the same reason); count once
+            host_only = [
+                t for t in list(self.pending) if t.task_id not in resident
+            ]
+            total = 0.0
+            for t in host_only + list(resident.values()):
+                if t.cost is not None:
+                    total += t.cost
+                elif t.learned is not None:
+                    total += t.learned
+                else:
+                    total += default
+        except RuntimeError:  # deque/dict mutated mid-iteration
+            return None
+        if total == 0.0:
+            return 0.0
+        return total / rate
+
+    #: backlog_est_s recompute floor: the estimate is an O(pending) walk
+    #: on the stats thread; scrapes inside this window reuse the last value
+    #: (the autoscaler polls every ~2 s — sub-second freshness buys nothing)
+    _BACKLOG_EST_TTL_S = 1.0
+
     def stats(self) -> dict:
         a = self.arrays
+        now = self.clock()
+        cached = getattr(self, "_backlog_cache", None)
+        if cached is not None and now - cached[1] < self._BACKLOG_EST_TTL_S:
+            backlog_s = cached[0]
+        else:
+            backlog_s = self._backlog_estimate_s()
+            self._backlog_cache = (backlog_s, now)
         return {
             **super().stats(),
+            "backlog_est_s": (
+                None if backlog_s is None else round(backlog_s, 3)
+            ),
             "n_dispatched": self.n_dispatched,
             "n_results": self.n_results,
             "n_purged": self.n_purged,
@@ -644,8 +706,10 @@ class TpuPushDispatcher(TaskDispatcher):
         batch = []
         while self.pending and len(batch) < a.max_pending:
             t = self.pending.popleft()
-            if self.drop_if_cancelled(t.task_id):
-                self._forget_task_state(t.task_id)
+            dropped = self._drop_cancelled_or_park(t)
+            if dropped is None:
+                break  # outage: t parked; the batch built so far still runs
+            if dropped:
                 continue
             batch.append(t)
         overflow = self.pending
@@ -764,8 +828,10 @@ class TpuPushDispatcher(TaskDispatcher):
                 t = self.pending.popleft()
                 if t.task_id in self._resident_tasks:
                     continue
-                if self.drop_if_cancelled(t.task_id):
-                    self._forget_task_state(t.task_id)
+                dropped = self._drop_cancelled_or_park(t)
+                if dropped is None:
+                    break  # outage: t parked for next tick
+                if dropped:
                     continue
                 self._stamp_estimate(t)
                 self._resident_tasks[t.task_id] = t
@@ -784,8 +850,10 @@ class TpuPushDispatcher(TaskDispatcher):
             t = self.pending.popleft()
             if t.task_id in self._resident_tasks:
                 continue  # already queued device-side (rescan overlap)
-            if self.drop_if_cancelled(t.task_id):
-                self._forget_task_state(t.task_id)
+            dropped = self._drop_cancelled_or_park(t)
+            if dropped is None:
+                break  # outage: t parked for next tick
+            if dropped:
                 continue
             self._stamp_estimate(t)
             self._resident_tasks[t.task_id] = t
@@ -806,6 +874,23 @@ class TpuPushDispatcher(TaskDispatcher):
                 break
             sent += self._act_on_resolved(res)
         return sent
+
+    def _drop_cancelled_or_park(self, t) -> bool | None:
+        """drop_if_cancelled with the pending-loop outage policy in ONE
+        place: True = dropped (state forgotten), False = keep the task,
+        None = the verification read hit a store outage — the task is
+        parked back at the head of pending (with the cancel note intact)
+        and the caller must stop filtering this tick."""
+        try:
+            dropped = self.drop_if_cancelled(t.task_id)
+        except STORE_OUTAGE_ERRORS as exc:
+            self.note_store_outage(exc, pause=0)
+            self.pending.appendleft(t)
+            return None
+        if dropped:
+            self._forget_task_state(t.task_id)
+            return True
+        return False
 
     def _forget_task_state(self, task_id: str) -> None:
         """Per-task dispatcher state cleanup when a task leaves this
@@ -904,7 +989,15 @@ class TpuPushDispatcher(TaskDispatcher):
             task = self._resident_tasks.pop(task_id, None)
             if task is None:
                 continue
-            if self.drop_if_cancelled(task_id):
+            try:
+                dropped = self.drop_if_cancelled(task_id)
+            except STORE_OUTAGE_ERRORS as exc:
+                # same degradation as the zombie-finished probe below: the
+                # placement flows back and is recomputed next tick
+                self.note_store_outage(exc, pause=0)
+                undo(task, row)
+                continue
+            if dropped:
                 # cancelled while device-pending: the kernel already
                 # consumed the slot, so return the capacity (the free diff
                 # carries the correction up) — but never dispatch, and

@@ -523,20 +523,37 @@ async def execute_function(request: web.Request) -> web.Response:
             deadline = time.monotonic() + _IDEM_ADOPT_WAIT_S
             pause = 0.02
             while True:
-                stored = await _run_blocking(
-                    ctx.store.hget, task_id, FIELD_PARAMS
+                # presence probe only (hexists): params can be multi-MB
+                # and this loop may poll a dozen times while the winner's
+                # create is in flight — never drag the payload to ask "is
+                # it there yet"
+                present = await _run_blocking(
+                    ctx.store.hexists, task_id, FIELD_PARAMS
                 )
-                if stored is not None or time.monotonic() >= deadline:
+                if present or time.monotonic() >= deadline:
                     break
                 await asyncio.sleep(pause)
                 pause = min(pause * 2, 0.25)
-            if stored is None:
+            if not present:
                 log.warning(
                     "adopting abandoned idempotency claim for task %s",
                     task_id,
                 )
                 if await _run_blocking(write_task_nx, task_id):
                     ctx.n_tasks += 1
+            elif (
+                await _run_blocking(ctx.store.hget, task_id, FIELD_STATUS)
+                is None
+            ):
+                # payload present but status stripped: a cancel aimed at a
+                # PREVIOUS incarnation of this deterministic id had its
+                # ghost cleanup race the winner's create (store/base.py
+                # cancel_task). write_task_nx re-claims the absent status
+                # and re-announces — identical values, write-once
+                log.warning(
+                    "repairing status-stripped record for task %s", task_id
+                )
+                await _run_blocking(write_task_nx, task_id)
             return web.json_response(
                 {"task_id": task_id, "deduplicated": True}
             )

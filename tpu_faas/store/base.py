@@ -23,6 +23,7 @@ import time
 from typing import Mapping
 
 from tpu_faas.core.task import (
+    FIELD_FINAL_AT,
     FIELD_FINAL_STATUS,
     FIELD_FINISHED_AT,
     FIELD_FN,
@@ -224,7 +225,28 @@ class TaskStore(abc.ABC):
             },
         )
         self.publish(channel, task_id)
+        # claim-loss repair: a concurrent cancel aimed at the PREVIOUS
+        # incarnation of this deterministic id can clobber the setnx'd
+        # QUEUED with CANCELLED and then have its ghost cleanup strip the
+        # status field entirely (cancel_task's probe saw no params yet) —
+        # leaving this freshly-written record status-less, which intake
+        # skips forever. Re-claim and re-announce; a duplicate announce is
+        # deduped at intake. Deliberate cost: one small-field read per
+        # keyed create (status is bytes, never a payload) buys out a
+        # stranded acknowledged submit — the one failure in this family
+        # that no retry or sweeper would ever repair.
+        if self.hget(task_id, FIELD_STATUS) is None:
+            self.hset(task_id, {FIELD_STATUS: str(TaskStatus.QUEUED)})
+            self.publish(channel, task_id)
         return True
+
+    def hexists(self, key: str, field: str) -> bool:
+        """Field presence WITHOUT transferring the value (standard Redis
+        HEXISTS). Default: an hget — correct everywhere; the RESP client
+        overrides with the real command so a multi-MB payload field isn't
+        dragged over the wire just to test existence (cancel_task's record-
+        completeness probes)."""
+        return self.hget(key, field) is not None
 
     def hmget(self, key: str, fields: list[str]) -> list[str | None]:
         """Several fields of one hash, None per missing field. Default: a
@@ -346,16 +368,18 @@ class TaskStore(abc.ABC):
         sweeper can age the record out."""
         if first_wins and self._result_frozen(task_id):
             return
+        now = repr(time.time())
         self.hset(
             task_id,
             {
                 FIELD_STATUS: str(status),
-                # redundant status copy, same write: lets a racing cancel
-                # that clobbers this terminal record restore it exactly
-                # (see cancel_task's post-write repair)
+                # redundant status + stamp copies, same write: let a racing
+                # cancel that clobbers this terminal record restore it
+                # exactly (see cancel_task's post-write repair)
                 FIELD_FINAL_STATUS: str(status),
+                FIELD_FINAL_AT: now,
                 FIELD_RESULT: result,
-                FIELD_FINISHED_AT: repr(time.time()),
+                FIELD_FINISHED_AT: now,
             },
         )
         self.hdel(LIVE_INDEX_KEY, task_id)
@@ -399,12 +423,15 @@ class TaskStore(abc.ABC):
         ages cancelled records like any other terminal record), drops the
         live-index entry, and announces on RESULTS_CHANNEL so parked
         /result long-polls wake immediately."""
-        current, params = self.hmget(task_id, [FIELD_STATUS, FIELD_PARAMS])
+        current = self.get_status(task_id)
         if current is None:
             return None
         if current != str(TaskStatus.QUEUED):
             return current
-        if params is None:
+        # presence probes only (hexists): the payload fields can be
+        # multi-MB and must not ride the wire just to prove the record is
+        # fully created
+        if not self.hexists(task_id, FIELD_PARAMS):
             # status QUEUED but no payload: a claim-only hash mid-create
             # (create_task_if_absent claims status via setnx, then writes
             # the fields in a second command). Writing CANCELLED here would
@@ -420,10 +447,11 @@ class TaskStore(abc.ABC):
                 FIELD_FINISHED_AT: repr(time.time()),
             },
         )
-        p_params, final = self.hmget(
-            task_id, [FIELD_PARAMS, FIELD_FINAL_STATUS]
+        # both repair stamps in ONE round trip (small fields, never payload)
+        final, final_at = self.hmget(
+            task_id, [FIELD_FINAL_STATUS, FIELD_FINAL_AT]
         )
-        if p_params is None:
+        if not self.hexists(task_id, FIELD_PARAMS):
             # the record was DELETEd inside the read->write window (ran,
             # finished, was consumed and forgotten — all sub-ms): this
             # write just resurrected it as a partial ghost, which would
@@ -437,15 +465,26 @@ class TaskStore(abc.ABC):
             # removes the whole hash itself, ghost included. A concurrent
             # idempotency CLAIM landing between probe and removal survives
             # as a claim-only hash, which the gateway's adoption wait and
-            # the TTL sweeper's stale-claim GC already handle.
+            # the TTL sweeper's stale-claim GC already handle. The inverse
+            # order — a resubmit's claim landing BEFORE our CANCELLED write
+            # so this hdel strips it — is repaired from the CREATOR's side:
+            # create_task_if_absent re-checks its status after the field
+            # write and re-claims (see its claim-loss repair). The residual
+            # six-event interleaving (creator's re-check passing on OUR
+            # CANCELLED an instant before this hdel) leaves a record a
+            # client retry of the same key repairs via the same re-claim;
+            # accepted: it needs three actors inside two store RTTs.
             self.hdel(task_id, FIELD_STATUS, FIELD_FINISHED_AT)
             return None
         if final is not None:
             # a result landed inside the read->write window and this write
-            # just clobbered it: restore the true terminal status (the
-            # result payload was never touched — our write carries no
-            # FIELD_RESULT)
-            self.hset(task_id, {FIELD_STATUS: final})
+            # just clobbered it: restore the true terminal status AND its
+            # finish stamp (the result payload was never touched — our
+            # write carries no FIELD_RESULT)
+            restore = {FIELD_STATUS: final}
+            if final_at is not None:
+                restore[FIELD_FINISHED_AT] = final_at
+            self.hset(task_id, restore)
             self.publish(RESULTS_CHANNEL, task_id)
             return final
         self.hdel(LIVE_INDEX_KEY, task_id)
@@ -467,14 +506,11 @@ class TaskStore(abc.ABC):
         delivered the genuine result via a first_wins path. Truth wins:
         freezing would pin 'never ran' over real side effects."""
         current = self.get_status(task_id)
-        if current is None:
-            return True
         if current == str(TaskStatus.CANCELLED):
             return False
-        try:
-            return TaskStatus(current).is_terminal()
-        except ValueError:
-            return True  # foreign status string: never overwrite
+        # unknown=True: absent records and foreign status strings are
+        # frozen — never overwrite what can't be parsed
+        return TaskStatus.terminal_str(current, unknown=True)
 
     def get_result(self, task_id: str) -> tuple[str | None, str | None]:
         """(status, result) in one round-trip — the client poll hot path."""

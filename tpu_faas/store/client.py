@@ -281,6 +281,7 @@ class RespStore(TaskStore):
         result path is the dispatcher's per-task hot path and must not grow
         a second RTT for the wake-up feature."""
         from tpu_faas.core.task import (
+            FIELD_FINAL_AT,
             FIELD_FINAL_STATUS,
             FIELD_FINISHED_AT,
             FIELD_RESULT,
@@ -289,15 +290,17 @@ class RespStore(TaskStore):
 
         if first_wins and self._result_frozen(task_id):
             return
+        now = repr(time.time())
         cmds = [
             (
                 "HSET", task_id,
                 FIELD_STATUS, str(status),
-                # redundant stamp powering cancel_task's clobber repair
-                # (base.finish_task writes the same field)
+                # redundant stamps powering cancel_task's clobber repair
+                # (base.finish_task writes the same fields)
                 FIELD_FINAL_STATUS, str(status),
+                FIELD_FINAL_AT, now,
                 FIELD_RESULT, result,
-                FIELD_FINISHED_AT, repr(time.time()),
+                FIELD_FINISHED_AT, now,
             ),
             ("HDEL", LIVE_INDEX_KEY, task_id),  # drop from the live index
             ("PUBLISH", RESULTS_CHANNEL, task_id),
@@ -341,6 +344,9 @@ class RespStore(TaskStore):
         errors = [r for r in replies if isinstance(r, resp.RespError)]
         if errors:
             raise errors[0]
+
+    def hexists(self, key: str, field: str) -> bool:
+        return bool(self._command("HEXISTS", key, field))
 
     def setnx_field(
         self, key: str, field: str, value: str

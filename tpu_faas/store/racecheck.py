@@ -94,13 +94,10 @@ class Violation:
 def _is_terminal(status: str | None) -> bool:
     """Terminal check that tolerates non-enum garbage: the monitor is a
     detector, not an enforcer — a corrupt status string must be FLAGGED
-    (illegal-transition fires via the _LEGAL table), never crash observe()."""
-    if status is None:
-        return False
-    try:
-        return TaskStatus(status).is_terminal()
-    except ValueError:
-        return False
+    (illegal-transition fires via the _LEGAL table), never crash observe().
+    unknown=False: garbage is 'not terminal' here so the transition table
+    gets to see and flag it."""
+    return TaskStatus.terminal_str(status)
 
 
 @dataclass

@@ -7,7 +7,7 @@ by the event loop's single-threadedness.
 
 Supported commands (the set the framework + the reference's usage of Redis
 require): PING, SELECT (accepted, ignored — the reference pins db=1,
-task_dispatcher.py:32), HSET, HSETNX, HGET, HMGET, HGETALL, DEL, KEYS, PUBLISH, SUBSCRIBE,
+task_dispatcher.py:32), HSET, HSETNX, HGET, HEXISTS, HMGET, HGETALL, DEL, KEYS, PUBLISH, SUBSCRIBE,
 UNSUBSCRIBE, FLUSHDB, SAVE, QUIT, SHUTDOWN.
 
 Checkpoint/resume: ``--snapshot PATH`` loads PATH at startup and saves to it
@@ -194,6 +194,17 @@ class StoreServer:
                 writer.write(resp.encode_error("wrong number of arguments for HGET"))
                 return True
             writer.write(resp.encode_bulk(st.hashes.get(args[0], {}).get(args[1])))
+        elif name == "HEXISTS":
+            if len(args) != 2:
+                writer.write(
+                    resp.encode_error("wrong number of arguments for HEXISTS")
+                )
+                return True
+            writer.write(
+                resp.encode_integer(
+                    1 if args[1] in st.hashes.get(args[0], {}) else 0
+                )
+            )
         elif name == "HSETNX":
             if len(args) != 3:
                 writer.write(

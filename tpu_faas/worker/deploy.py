@@ -28,6 +28,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import math
 import os
 import signal
 import subprocess
@@ -227,10 +228,15 @@ class AutoScaler:
 
     Policy (deliberately simple and oscillation-resistant):
 
-    - scale UP one node per decision when the dispatcher reports pending
-      work (``pending > 0``) and the fleet is below ``max_workers`` — the
-      backlog signal already accounts for free capacity, because the
-      dispatcher drains pending into free slots before stats are read;
+    - scale UP when the dispatcher reports pending work (``pending > 0``)
+      and the fleet is below ``max_workers`` — the backlog signal already
+      accounts for free capacity, because the dispatcher drains pending
+      into free slots before stats are read. One node per decision by
+      default; when the dispatcher also reports ``backlog_est_s`` (the
+      estimator's learned-runtime drain time, tpu-push
+      ``_backlog_estimate_s``), enough nodes to drain the backlog within
+      ``drain_target_s`` are added at once — a 10-minute estimated backlog
+      should not grow the fleet one node per polling period;
     - scale DOWN one node after ``idle_decisions`` consecutive observations
       of a completely quiet system (no pending, nothing in flight) while
       above ``min_workers`` — draining is graceful (SIGTERM), so shrink
@@ -248,6 +254,7 @@ class AutoScaler:
         min_workers: int,
         max_workers: int,
         idle_decisions: int = 5,
+        drain_target_s: float = 30.0,
     ) -> None:
         if not 0 < min_workers <= max_workers:
             raise ValueError("need 0 < min_workers <= max_workers")
@@ -255,6 +262,9 @@ class AutoScaler:
         self.min_workers = min_workers
         self.max_workers = max_workers
         self.idle_decisions = idle_decisions
+        #: aim to drain a reported learned-runtime backlog within this many
+        #: seconds; only engages when the dispatcher serves backlog_est_s
+        self.drain_target_s = float(drain_target_s)
         self._idle_streak = 0
         self._warned_no_queue_stats = False
         self.scale_ups = 0
@@ -286,11 +296,46 @@ class AutoScaler:
         if pending > 0:
             self._idle_streak = 0
             if live < self.max_workers:
-                self.fleet.scale_up()
-                self.scale_ups += 1
+                # learned-runtime sizing: add enough nodes to drain the
+                # estimated backlog within drain_target_s, one node when
+                # the dispatcher reports no estimate (estimator off /
+                # nothing learned). The desired TOTAL is computed from the
+                # dispatcher's REGISTERED worker count — backlog_est_s is
+                # measured against registered capacity, while `live`
+                # counts locally-spawned processes that may not have
+                # registered yet; sizing against `live` would re-multiply
+                # an already-grown fleet every decision period until the
+                # new nodes register (spawn+register > scale-period jumps
+                # straight to max)
+                backlog_s = stats.get("backlog_est_s")
+                reg = stats.get("workers_registered")
+                n_up = 1
+                if (
+                    isinstance(backlog_s, (int, float))
+                    and backlog_s > self.drain_target_s
+                    and isinstance(reg, int)
+                    and reg > 0
+                ):
+                    want_total = math.ceil(
+                        reg * backlog_s / self.drain_target_s
+                    )
+                    # current capacity = max(registered, locally spawned):
+                    # reg < live while local spawns are still registering
+                    # (don't re-count them); reg > live when workers
+                    # OUTSIDE this supervisor are registered (don't spawn
+                    # the whole cluster's shortfall locally on top of them)
+                    n_up = want_total - max(reg, live)
+                n_up = min(n_up, self.max_workers - live)
+                if n_up <= 0:
+                    # provisioned ahead of the (stale) backlog estimate:
+                    # wait for the spawned nodes to register
+                    return None
+                for _ in range(n_up):
+                    self.fleet.scale_up()
+                self.scale_ups += n_up
                 log.info(
-                    "autoscale up: pending=%d live=%d->%d",
-                    pending, live, live + 1,
+                    "autoscale up: pending=%d backlog_est=%s live=%d->%d",
+                    pending, stats.get("backlog_est_s"), live, live + n_up,
                 )
                 return "up"
             return None
@@ -347,6 +392,12 @@ def main(argv: list[str] | None = None) -> None:
         "--scale-period", type=float, default=2.0,
         help="seconds between autoscale decisions",
     )
+    ap.add_argument(
+        "--drain-target", type=float, default=30.0,
+        help="autoscale sizing goal: drain the dispatcher's learned-"
+        "runtime backlog estimate within this many seconds (engages only "
+        "when the stats report backlog_est_s)",
+    )
     ns = ap.parse_args(argv)
 
     fleet = WorkerFleet(
@@ -381,6 +432,7 @@ def main(argv: list[str] | None = None) -> None:
             fleet,
             min_workers=ns.min if ns.min is not None else ns.n_workers,
             max_workers=ns.max if ns.max is not None else ns.n_workers * 4,
+            drain_target_s=ns.drain_target,
         )
 
     fleet.start()
