@@ -1,0 +1,131 @@
+"""Queued-only cancellation + the learned-runtime backlog signal, live.
+
+One process hosts the whole stack (store thread + gateway thread + a
+tpu-push dispatcher thread with the runtime estimator on), a saturated
+1-process push worker keeps a slow task RUNNING, and the script then:
+
+1. cancels tasks stuck QUEUED behind it — handle.cancel() returns True,
+   their records go terminal CANCELLED, result() raises
+   TaskCancelledError, and the dispatcher never runs them;
+2. shows that cancelling the RUNNING blocker is refused (False) — a
+   cancel never yanks a worker;
+3. reads the dispatcher's /stats-style backlog estimate
+   (``backlog_est_s``): after a few completions teach the estimator this
+   workload's runtime, the pending queue is priced in SECONDS — the same
+   signal `tpu-faas-deploy --stats-url ... --drain-target N` uses to size
+   scale-up jumps.
+
+Run:  python examples/cancel_and_backlog.py
+"""
+
+try:
+    import _bootstrap  # noqa: F401  (repo-root path shim, script mode)
+except ModuleNotFoundError:
+    pass  # module mode (python -m examples.x): cwd already on sys.path
+
+# This demo exercises the PROTOCOL (cancel + backlog pricing), not kernel
+# speed: pin the scheduler to CPU so a dev box with a remote/tunneled
+# accelerator isn't stalled by transport. On a production TPU host delete
+# these two lines. (Env-var JAX_PLATFORMS can be rewritten by platform
+# plugins; the config update after import is authoritative — see
+# tests/conftest.py.)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import threading
+import time
+
+from tpu_faas.client import FaaSClient, TaskCancelledError
+from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import sleep_task
+
+
+def main() -> None:
+    store = start_store_thread()
+    gw = start_gateway_thread(make_store(store.url))
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, max_workers=16, max_pending=128,
+        max_inflight=128, tick_period=0.02, store=make_store(store.url),
+    )
+    threading.Thread(target=disp.start, daemon=True).start()
+
+    import os
+    import subprocess
+    import sys
+
+    from tpu_faas.bench.harness import cpu_worker_env
+
+    # cpu_worker_env: repo on PYTHONPATH (script mode runs from examples/)
+    # and the child's JAX pinned to CPU like the parent — the same spawner
+    # env the tests and bench harness use
+    worker = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_faas.worker.push_worker",
+            "1", f"tcp://127.0.0.1:{disp.port}", "--hb",
+        ],
+        env=cpu_worker_env(),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+
+        # teach the estimator what this function costs (~0.3 s each)
+        for h in [client.submit(fid, 0.3) for _ in range(4)]:
+            h.result(timeout=60.0)
+        print("estimator taught: 4 observations of a ~0.3 s function")
+
+        # saturate the single slot, then queue work behind it
+        blocker = client.submit(fid, 4.0)
+        deadline = time.time() + 60
+        while blocker.status() == "QUEUED":
+            if worker.poll() is not None:
+                raise RuntimeError("worker process died during startup")
+            if time.time() > deadline:
+                raise RuntimeError("blocker never started")
+            time.sleep(0.05)
+        assert blocker.status() == "RUNNING", blocker.status()
+        queued = [client.submit(fid, 0.3) for _ in range(8)]
+        time.sleep(0.5)  # let the dispatcher drain the announces
+
+        stats = disp.stats()
+        print(
+            f"backlog: {stats['pending']} tasks pending ~= "
+            f"{stats['backlog_est_s']} s of learned work "
+            f"(the autoscaler's --drain-target signal)"
+        )
+
+        # cancel half the queue; the blocker itself refuses
+        for h in queued[:4]:
+            assert h.cancel() is True
+        assert blocker.cancel() is False
+        print("cancelled 4 queued tasks; RUNNING blocker refused (409)")
+
+        survivors = [h.result(timeout=60.0) for h in queued[4:]]
+        print(f"surviving queued tasks completed: {survivors}")
+        for h in queued[:4]:
+            assert h.status() == "CANCELLED"
+            try:
+                h.result(timeout=2.0)
+            except TaskCancelledError:
+                pass  # the advertised behavior
+            else:
+                raise AssertionError("result() should raise for a cancel")
+        print(
+            f"cancelled tasks stayed CANCELLED; dispatcher dropped "
+            f"{disp.stats()['cancelled_dropped']} before dispatch"
+        )
+        print(f"blocker finished untouched: {blocker.result(timeout=60.0)}")
+    finally:
+        worker.kill()
+        worker.wait()
+        disp.stop()
+        gw.stop()
+        store.stop()
+
+
+if __name__ == "__main__":
+    main()
