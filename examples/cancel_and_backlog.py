@@ -52,22 +52,12 @@ def main() -> None:
     )
     threading.Thread(target=disp.start, daemon=True).start()
 
-    import os
-    import subprocess
-    import sys
+    # the shared spawner: repo on the child's PYTHONPATH (script mode runs
+    # from examples/), JAX pinned to CPU like the parent, cwd = repo root
+    from tpu_faas.bench.harness import _spawn_worker
 
-    from tpu_faas.bench.harness import cpu_worker_env
-
-    # cpu_worker_env: repo on PYTHONPATH (script mode runs from examples/)
-    # and the child's JAX pinned to CPU like the parent — the same spawner
-    # env the tests and bench harness use
-    worker = subprocess.Popen(
-        [
-            sys.executable, "-m", "tpu_faas.worker.push_worker",
-            "1", f"tcp://127.0.0.1:{disp.port}", "--hb",
-        ],
-        env=cpu_worker_env(),
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    worker = _spawn_worker(
+        "push_worker", 1, f"tcp://127.0.0.1:{disp.port}", "--hb"
     )
     client = FaaSClient(gw.url)
     try:

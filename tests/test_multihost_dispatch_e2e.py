@@ -24,6 +24,7 @@ import time
 from tpu_faas.client import FaaSClient
 from tpu_faas.gateway import start_gateway_thread
 from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import sleep_task
 from tests.test_workers_e2e import _spawn_worker
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -82,8 +83,6 @@ def _crash_worker_and_expect_redispatch(client, workers):
     have completed inside the poll's exit window. The caller additionally
     pins the lead's "purged worker row" / "reclaimed ... in-flight" log
     lines at shutdown."""
-    from tpu_faas.workloads import sleep_task
-
     fid = client.register(sleep_task)
     slow = [client.submit(fid, 2.5) for _ in range(6)]
     deadline = time.time() + 60
@@ -232,6 +231,29 @@ def test_multihost_resident_dispatcher_serves_and_stops():
         # compacted output)
         _crash_worker_and_expect_redispatch(client, workers)
 
+        # -- cancellation on the UNIFIED path: a queued task cancelled
+        # while device-resident must be dropped at placement resolve (the
+        # capacity correction rides the next delta packet) — saturate the
+        # surviving 2-slot worker, cancel the tasks queued behind the
+        # blockers, and everything else still completes
+        fid3 = client.register(sleep_task, name="blocker")
+        blockers = [client.submit(fid3, 2.0) for _ in range(2)]
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if sum(1 for h in blockers if h.status() == "RUNNING") >= 2:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                "blockers never saturated the surviving worker"
+            )
+        victims = [client.submit(fid3, 0.5) for _ in range(2)]
+        time.sleep(0.5)  # reach the lead's resident state
+        assert all(h.cancel() for h in victims)
+        assert [h.result(timeout=60.0) for h in blockers] == [2.0, 2.0]
+        time.sleep(1.0)  # let cancelled placements resolve + drop
+        assert [h.status() for h in victims] == ["CANCELLED"] * 2
+
         # shutdown contract: SIGTERM the lead right after activity (the
         # timing that once collided a mismatched stop broadcast); the
         # resident stop packet must release the follower cleanly
@@ -240,6 +262,7 @@ def test_multihost_resident_dispatcher_serves_and_stops():
         assert lead.returncode == 0, lead_out[-2000:]
         assert "purged worker row" in lead_out, lead_out[-2000:]
         assert "reclaimed" in lead_out, lead_out[-2000:]
+        assert "dropped cancelled task" in lead_out, lead_out[-2000:]
         assert "stop broadcast sent" in lead_out, lead_out[-2000:]
         follower_out, _ = follower.communicate(timeout=60)
         assert follower.returncode == 0, follower_out[-2000:]
