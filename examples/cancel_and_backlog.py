@@ -52,12 +52,23 @@ def main() -> None:
     )
     threading.Thread(target=disp.start, daemon=True).start()
 
-    # the shared spawner: repo on the child's PYTHONPATH (script mode runs
-    # from examples/), JAX pinned to CPU like the parent, cwd = repo root
-    from tpu_faas.bench.harness import _spawn_worker
+    import os
+    import subprocess
+    import sys
 
-    worker = _spawn_worker(
-        "push_worker", 1, f"tcp://127.0.0.1:{disp.port}", "--hb"
+    from tpu_faas.bench.harness import cpu_worker_env
+
+    # cpu_worker_env is the shared child-env recipe (repo on PYTHONPATH for
+    # script mode, JAX pinned to CPU like the parent). The spawn itself
+    # stays inline with INHERITED stdio — unlike the bench harness's
+    # spawner, a demo must show the worker's own traceback if it dies
+    worker = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_faas.worker.push_worker",
+            "1", f"tcp://127.0.0.1:{disp.port}", "--hb",
+        ],
+        env=cpu_worker_env(),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     client = FaaSClient(gw.url)
     try:

@@ -314,6 +314,12 @@ def test_gateway_cancel_contract():
             "task_id": h2.task_id, "status": "COMPLETED", "cancelled": False,
         }
         assert h2.cancel() is False
+
+        # /metrics counts cancel CALLS that reported cancelled=true (the
+        # idempotent repeat counts again, by documented design); refused
+        # and no-op calls don't
+        m = client.http.get(f"{gw.url}/metrics").json()
+        assert m["cancel_calls"] == 2
     finally:
         gw.stop()
         store_handle.stop()

@@ -192,12 +192,13 @@ def test_multihost_resident_dispatcher_serves_and_stops():
     and multihost mutually exclusive)."""
     store_handle = start_store_thread()
     gw = start_gateway_thread(make_store(store_handle.url))
-    coord, zmq_port = _free_port(), _free_port()
+    coord, zmq_port, stats_port = _free_port(), _free_port(), _free_port()
     follower = _spawn_dispatcher(
         1, coord, zmq_port, store_handle.url, "--resident"
     )
     lead = _spawn_dispatcher(
-        0, coord, zmq_port, store_handle.url, "--resident"
+        0, coord, zmq_port, store_handle.url, "--resident",
+        "--stats-port", str(stats_port),
     )
     workers = []
     try:
@@ -248,7 +249,26 @@ def test_multihost_resident_dispatcher_serves_and_stops():
                 "blockers never saturated the surviving worker"
             )
         victims = [client.submit(fid3, 0.5) for _ in range(2)]
-        time.sleep(0.5)  # reach the lead's resident state
+        # cancel only once the lead provably HOLDS the victims (drained
+        # off the bus into its resident state): a cancel landing before
+        # intake is honored by the announce skip, which never emits the
+        # "dropped cancelled task" line asserted at shutdown
+        import json
+        import urllib.request
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{stats_port}/stats", timeout=2
+                ) as r:
+                    if json.loads(r.read()).get("pending", 0) >= 2:
+                        break
+            except OSError:
+                pass  # stats server still starting
+            time.sleep(0.1)
+        else:
+            raise AssertionError("victims never reached the lead's state")
         assert all(h.cancel() for h in victims)
         assert [h.result(timeout=60.0) for h in blockers] == [2.0, 2.0]
         time.sleep(1.0)  # let cancelled placements resolve + drop

@@ -143,15 +143,15 @@ def test_rank_match_feasible_on_arbitrary_fleets(fleet):
     st.lists(
         st.tuples(
             st.integers(0, 7),  # task index
-            st.sampled_from(["advance", "redispatch"]),
+            st.sampled_from(["advance", "redispatch", "cancel"]),
         ),
         max_size=40,
     )
 )
 def test_race_monitor_accepts_all_legal_histories(script):
     """Drive tasks through arbitrary interleavings of legal transitions
-    (QUEUED -> RUNNING -> terminal, with declared re-dispatches): the
-    monitor must stay silent — no false positives."""
+    (QUEUED -> RUNNING -> terminal, declared re-dispatches, queued-only
+    cancels): the monitor must stay silent — no false positives."""
     m = RaceMonitor()
     stage: dict[str, int] = {}
     for idx, op in script:
@@ -161,6 +161,11 @@ def test_race_monitor_accepts_all_legal_histories(script):
             if s == 2:  # RUNNING: a declared re-mark is legal
                 m.expect_redispatch(tid)
                 m.observe("d", "status", tid, {"status": "RUNNING"})
+            continue
+        if op == "cancel":
+            if s == 1:  # QUEUED: queued-only cancel is legal and silent
+                m.observe("gw", "status", tid, {"status": "CANCELLED"})
+                stage[tid] = 3
             continue
         if s == 0:
             m.observe("gw", "create", tid, {"status": "QUEUED", "result": "None"})

@@ -220,6 +220,7 @@ class GatewayContext:
     started_at: float = field(default_factory=time.time)
     n_functions: int = 0
     n_tasks: int = 0
+    n_cancelled: int = 0
     #: monotonic per-route request totals — the tracer's ring is bounded
     #: (correct for latency percentiles, WRONG as a counter once saturated)
     route_counts: dict = field(default_factory=dict)
@@ -864,12 +865,11 @@ async def cancel_task(request: web.Request) -> web.Response:
         return _json_error(
             409, f"task {task_id!r} is RUNNING and cannot be cancelled"
         )
+    cancelled = status == str(TaskStatus.CANCELLED)
+    if cancelled:
+        ctx.n_cancelled += 1
     return web.json_response(
-        {
-            "task_id": task_id,
-            "status": status,
-            "cancelled": status == str(TaskStatus.CANCELLED),
-        }
+        {"task_id": task_id, "status": status, "cancelled": cancelled}
     )
 
 
@@ -911,6 +911,12 @@ async def metrics(request: web.Request) -> web.Response:
             "uptime_s": round(time.time() - ctx.started_at, 1),
             "functions_registered": ctx.n_functions,
             "tasks_submitted": ctx.n_tasks,
+            # cancel CALLS that reported cancelled=true — an idempotent
+            # repeat on an already-CANCELLED task counts again (the store
+            # protocol cannot distinguish transitioned-now from
+            # already-cancelled without an extra read; call-count is the
+            # honest cheap metric)
+            "cancel_calls": ctx.n_cancelled,
             "store_ok": store_ok,
             "requests": {
                 name: {
