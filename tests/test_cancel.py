@@ -274,6 +274,21 @@ def test_racecheck_cancel_transitions():
     store.hset("race", {"status": "RUNNING"})
     assert any(v.kind == "terminal-overwrite" for v in mon.errors)
 
+    # force-cancel lifecycle: a worker's CANCELLED result is lawful-silent
+    # ONLY after an observed kill request; spontaneous ones are surfaced
+    store.create_task("f1", "fn", "p", "tasks")
+    store.set_status("f1", TaskStatus.RUNNING)
+    store.request_kill("f1")
+    store.finish_task("f1", "CANCELLED", "x")
+    assert not any(v.task_id == "f1" for v in mon.violations)
+    store.create_task("f2", "fn", "p", "tasks")
+    store.set_status("f2", TaskStatus.RUNNING)
+    store.finish_task("f2", "CANCELLED", "x")
+    assert any(
+        v.kind == "unrequested-cancel-result" and v.task_id == "f2"
+        for v in mon.warnings
+    )
+
 
 # -- gateway contract + SDK -------------------------------------------------
 def test_gateway_cancel_contract():
@@ -430,3 +445,145 @@ def test_tpu_push_cancel_e2e():
 
 def test_resident_cancel_e2e():
     _cancel_e2e(resident=True)
+
+
+# -- FORCE cancel: interrupt a RUNNING task ---------------------------------
+def test_pool_force_cancel_unit():
+    """The pool-level mechanism: a long sleeper is interrupted mid-run
+    (terminal CANCELLED, slot freed in place, no pool rebuild), a
+    queued-but-unstarted future cancels without a signal, and unknown /
+    finished tasks report False."""
+    from tpu_faas.core.executor import pack_params
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.worker.pool import TaskPool
+
+    pool = TaskPool(1)
+    pool.warmup()
+    try:
+        pool.submit("slow", serialize(sleep_task), pack_params(30.0))
+        # with ONE process, a second submit sits queued in the executor
+        pool.submit("queued", serialize(sleep_task), pack_params(30.0))
+        deadline = time.time() + 30
+        while "slow" not in pool._running_pids and time.time() < deadline:
+            pool._drain_events()
+            time.sleep(0.02)
+        assert pool.cancel("queued") is True  # future-level, no signal
+        assert pool.cancel("slow") is True  # mid-run interrupt
+        t0 = time.time()
+        res = {}
+        deadline = time.time() + 20
+        while len(res) < 2 and time.time() < deadline:
+            for r in pool.drain():
+                res[r.task_id] = r
+            time.sleep(0.02)
+        assert res["slow"].status == "CANCELLED"
+        assert res["queued"].status == "CANCELLED"
+        assert time.time() - t0 < 10.0  # interrupted, not waited out
+        assert pool.free == 1  # slot back without a rebuild
+        assert pool.cancel("slow") is False  # already drained
+        assert pool.cancel("ghost") is False
+    finally:
+        pool.close()
+
+
+def test_force_cancel_running_task_e2e():
+    """The full stack: a task RUNNING on a saturated worker is
+    force-cancelled — the gateway publishes the kill request, the
+    dispatcher relays CANCEL to the owning worker, the pool interrupts the
+    child mid-run, and the terminal CANCELLED result converges the record
+    in seconds instead of the task's natural 30. The freed slot then runs
+    a follow-up task, and the run is race-clean with zero warnings (a
+    worker-confirmed force cancel is a lawful silent transition)."""
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(
+        RaceCheckStore(make_store(store_handle.url), monitor, actor="gateway")
+    )
+    disp = _make_dispatcher(
+        store_handle.url,
+        store=RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="dispatcher"
+        ),
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    worker = _spawn_worker(
+        "push_worker", 1, f"tcp://127.0.0.1:{disp.port}",
+        "--hb", "--hb-period", "0.3",
+    )
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        h = client.submit(fid, 30.0)
+        deadline = time.time() + 60
+        while h.status() != "RUNNING" and time.time() < deadline:
+            time.sleep(0.05)
+        assert h.status() == "RUNNING"
+
+        t0 = time.time()
+        assert h.cancel() is False  # soft cancel refuses a RUNNING task
+        assert h.cancel(force=True) is False  # async: not CANCELLED *yet*
+        with pytest.raises(TaskCancelledError):
+            h.result(timeout=30.0)
+        assert time.time() - t0 < 25.0  # interrupted, not waited out
+        assert h.status() == "CANCELLED"
+
+        # the interrupted slot is free again: a follow-up completes fast
+        follow = client.submit(fid, 0.05)
+        assert follow.result(timeout=30.0) == 0.05
+        monitor.assert_clean(allow_warnings=False)
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_gateway_force_cancel_contract():
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    raw = make_store(store_handle.url)
+    client = FaaSClient(gw.url)
+    try:
+        r = client.http.post(f"{gw.url}/cancel/ghost", json={"force": True})
+        assert r.status_code == 404
+        # force on a QUEUED task is just a normal cancel
+        fid = client.register(lambda x: x, name="ident")
+        h = client.submit(fid, 1)
+        assert h.cancel(force=True) is True
+        assert h.status() == "CANCELLED"
+        # force on RUNNING: 202 + kill_requested, control published
+        from tpu_faas.store.base import KILL_ANNOUNCE_PREFIX
+
+        sub = raw.subscribe("tasks")
+        h2 = client.submit(fid, 2)
+        raw.set_status(h2.task_id, TaskStatus.RUNNING)
+        r = client.http.post(
+            f"{gw.url}/cancel/{h2.task_id}", json={"force": True}
+        )
+        assert r.status_code == 202
+        assert r.json()["kill_requested"] is True
+        msgs = []
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            msg = sub.get_message()
+            if msg is None:
+                time.sleep(0.02)
+                continue
+            msgs.append(msg)
+            if msg.startswith(KILL_ANNOUNCE_PREFIX):
+                break
+        assert KILL_ANNOUNCE_PREFIX + h2.task_id in msgs
+        # malformed body
+        r = client.http.post(
+            f"{gw.url}/cancel/{h2.task_id}",
+            data="not json",
+            headers={"Content-Type": "application/json"},
+        )
+        assert r.status_code == 400
+    finally:
+        gw.stop()
+        store_handle.stop()

@@ -74,11 +74,12 @@ class AsyncTaskHandle:
         """Delete this task's store record once terminal."""
         await self.client.delete_task(self.task_id)
 
-    async def cancel(self) -> bool:
-        """Best-effort queued-only cancel; True = the record now reads
-        CANCELLED, which a lost dispatch race can still overwrite (see
+    async def cancel(self, force: bool = False) -> bool:
+        """Best-effort cancel; True = the record now reads CANCELLED,
+        which a lost dispatch race can still overwrite. ``force=True``
+        asks a RUNNING task's worker to interrupt it mid-run (async; see
         sync TaskHandle.cancel for the full contract)."""
-        return await self.client.cancel(self.task_id)
+        return await self.client.cancel(self.task_id, force=force)
 
 
 class AsyncFaaSClient:
@@ -260,12 +261,15 @@ class AsyncFaaSClient:
         ) as r:
             r.raise_for_status()
 
-    async def cancel(self, task_id: str) -> bool:
+    async def cancel(self, task_id: str, force: bool = False) -> bool:
         """POST /cancel/{task_id}; True when the task is now CANCELLED.
         409 (RUNNING) maps to False — "too late" is an answer, not an
-        error (sync FaaSClient.cancel)."""
+        error. ``force=True`` requests a mid-run interrupt of a RUNNING
+        task (202, still False; sync FaaSClient.cancel)."""
         async with self.request(
-            "POST", f"{self.base_url}/cancel/{task_id}"
+            "POST",
+            f"{self.base_url}/cancel/{task_id}",
+            json={"force": True} if force else None,
         ) as r:
             if r.status == 409:
                 return False

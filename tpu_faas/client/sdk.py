@@ -51,15 +51,22 @@ class TaskHandle:
         the gateway refuses with 409 while the task is still live)."""
         self.client.delete_task(self.task_id)
 
-    def cancel(self) -> bool:
-        """Best-effort queued-only cancel; True when the record now reads
-        CANCELLED. False when it could not be cancelled — already RUNNING
-        or already terminal. True is best-effort, not a guarantee the
-        function never executes: a cancel racing a concurrent dispatch can
-        lose (store/base.py cancel_task), in which case the task runs and
-        the record converges to COMPLETED/FAILED — poll status() before
-        relying on side effects having been suppressed."""
-        return self.client.cancel(self.task_id)
+    def cancel(self, force: bool = False) -> bool:
+        """Best-effort cancel; True when the record now reads CANCELLED.
+        False when it could not be cancelled — already RUNNING or already
+        terminal. True is best-effort, not a guarantee the function never
+        executes: a cancel racing a concurrent dispatch can lose
+        (store/base.py cancel_task), in which case the task runs and the
+        record converges to COMPLETED/FAILED — poll status() before
+        relying on side effects having been suppressed.
+
+        ``force=True`` additionally asks a RUNNING task to stop: its
+        worker interrupts it mid-run and ships a terminal CANCELLED
+        result. Asynchronous — this call still returns False for a
+        RUNNING task; await the outcome via status()/result() (which
+        raises TaskCancelledError once the interrupt lands, or returns
+        the value if the task beat the signal)."""
+        return self.client.cancel(self.task_id, force=force)
 
     def result(self, timeout: float = 60.0, poll_interval: float = 0.01) -> Any:
         """Wait until terminal; return the deserialized value or raise
@@ -171,12 +178,17 @@ class FaaSClient:
         r = self.http.delete(f"{self.base_url}/task/{task_id}")
         r.raise_for_status()
 
-    def cancel(self, task_id: str) -> bool:
+    def cancel(self, task_id: str, force: bool = False) -> bool:
         """POST /cancel/{task_id}; True when the task is now CANCELLED.
         409 (RUNNING — the gateway refuses) maps to False rather than an
         exception: "too late to cancel" is an expected answer, not an
-        error."""
-        r = self.http.post(f"{self.base_url}/cancel/{task_id}")
+        error. ``force=True`` sends ``{"force": true}`` — a RUNNING task
+        gets a mid-run interrupt request (202, still False here; the
+        record converges via the result path)."""
+        r = self.http.post(
+            f"{self.base_url}/cancel/{task_id}",
+            json={"force": True} if force else None,
+        )
         if r.status_code == 409:
             return False
         r.raise_for_status()

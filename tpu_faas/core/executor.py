@@ -51,6 +51,14 @@ class TaskTimeout(BaseException):
     """
 
 
+class TaskCancelledInterrupt(BaseException):
+    """Raised inside a pool child when a FORCE cancel interrupts the task
+    mid-run (worker/pool.py's SIGUSR1 handler — the externally-triggered
+    sibling of the SIGALRM timeout above, same BaseException rationale).
+    Surfaces as a terminal CANCELLED result, not FAILED: the caller asked
+    for exactly this outcome."""
+
+
 #: Arm-time cap (~194 days): setitimer raises OverflowError far above this
 #: (platform time_t), and no task budget is legitimately this long.
 _MAX_TIMEOUT_S = float(2**24)
@@ -86,6 +94,20 @@ def execute_fn(
         # the alarm landed in the narrow window between an exception being
         # caught and the timer disarm: still a clean FAILED, never a raise
         res = ExecutionResult(task_id, str(TaskStatus.FAILED), serialize(exc))
+    except TaskCancelledInterrupt as exc:
+        # same narrow window for a force cancel's interrupt — and unlike a
+        # fired (one-shot, self-disarming) alarm, the itimer may still be
+        # ARMED here (the interrupt escaped between an exception being
+        # caught and _execute_guarded's disarm): a stale alarm firing into
+        # the child's NEXT task would fail it with the old task's budget
+        if hasattr(signal, "setitimer"):
+            try:
+                signal.setitimer(signal.ITIMER_REAL, 0)
+            except Exception:
+                pass
+        res = ExecutionResult(
+            task_id, str(TaskStatus.CANCELLED), serialize(exc)
+        )
     return res._replace(elapsed=time.perf_counter() - t0)
 
 
@@ -122,6 +144,15 @@ def _execute_guarded(
             signal.setitimer(signal.ITIMER_REAL, 0)
             timer_armed = False
         return ExecutionResult(task_id, str(TaskStatus.COMPLETED), serialize(result))
+    except TaskCancelledInterrupt as exc:
+        # a force cancel interrupted the call: terminal CANCELLED, slot
+        # freed — the one non-FAILED exceptional outcome (the caller asked
+        # for exactly this)
+        if timer_armed:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+        return ExecutionResult(
+            task_id, str(TaskStatus.CANCELLED), serialize(exc)
+        )
     except (Exception, TaskTimeout) as exc:  # catch-all FAILED semantics
         if timer_armed:
             signal.setitimer(signal.ITIMER_REAL, 0)

@@ -42,6 +42,7 @@ from tpu_faas.core.task import (
 from tpu_faas.store.base import (
     CANCEL_ANNOUNCE_PREFIX,
     DISPATCHERS_KEY,
+    KILL_ANNOUNCE_PREFIX,
     LEASE_CONF_KEY,
     TASKS_CHANNEL,
     TaskStore,
@@ -216,6 +217,12 @@ class TaskDispatcher:
         #: the matching task is dropped at a dispatch site; entries whose
         #: task this dispatcher never held (shared-fleet siblings) age out.
         self.cancelled: dict[str, float] = {}
+        #: task_id -> note-time for FORCE-cancel control messages (kill a
+        #: RUNNING task): push-family dispatchers relay a CANCEL to the
+        #: owning worker; modes that cannot reach workers (pull's REQ/REP)
+        #: let the notes age out. Same bounds as the cancel notes.
+        self.kill_requested: dict[str, float] = {}
+        self._last_kill_relay = 0.0
         self.n_cancelled_dropped = 0
 
     #: cancel notes older than this are discarded by the cap sweep below
@@ -226,24 +233,92 @@ class TaskDispatcher:
     _CANCEL_NOTE_CAP = 200_000
 
     # -- cancellation ------------------------------------------------------
-    def note_cancelled(self, task_id: str) -> None:
-        """A cancel control message arrived: remember it so dispatch sites
-        can drop the task if it is sitting in a pending structure. Bounded:
-        TTL-pruned opportunistically, hard-capped against a rogue
-        publisher flooding the channel."""
+    def _note(self, notes: dict[str, float], task_id: str) -> dict:
+        """Record a control-message note with the shared bounds: TTL-pruned
+        opportunistically, hard-capped against a rogue publisher flooding
+        the channel. Returns the (possibly rebuilt) dict."""
         now = time.monotonic()
-        self.cancelled[task_id] = now
-        if len(self.cancelled) > self._CANCEL_NOTE_CAP:
+        notes[task_id] = now
+        if len(notes) > self._CANCEL_NOTE_CAP:
             cutoff = now - self.CANCEL_NOTE_TTL
-            self.cancelled = {
-                t: ts for t, ts in self.cancelled.items() if ts > cutoff
-            }
+            notes = {t: ts for t, ts in notes.items() if ts > cutoff}
             # evict to a LOW watermark (oldest-first; dicts iterate in
             # insertion order), not just below the cap: trimming one entry
             # would make a sustained flood pay the full O(cap) rebuild on
             # every subsequent message
-            while len(self.cancelled) > self._CANCEL_NOTE_CAP // 2:
-                self.cancelled.pop(next(iter(self.cancelled)))
+            while len(notes) > self._CANCEL_NOTE_CAP // 2:
+                notes.pop(next(iter(notes)))
+        return notes
+
+    def note_cancelled(self, task_id: str) -> None:
+        """A cancel control message arrived: remember it so dispatch sites
+        can drop the task if it is sitting in a pending structure."""
+        self.cancelled = self._note(self.cancelled, task_id)
+
+    def note_kill(self, task_id: str) -> None:
+        """A force-cancel control message arrived: remember it so the
+        serve loop can relay a CANCEL to the owning worker."""
+        self.kill_requested = self._note(self.kill_requested, task_id)
+
+    #: drain_control_messages stops parking announces past this backlog
+    #: size — further messages stay in the transport buffer (exactly where
+    #: they would sit without the control drain), so a saturated fleet
+    #: under a submit flood cannot grow dispatcher memory without bound
+    _CONTROL_DRAIN_BACKLOG_CAP = 10_000
+
+    def drain_control_messages(self) -> None:
+        """Consume pending CONTROL messages (cancel/kill) from the bus even
+        while the dispatch loop isn't pulling tasks — a saturated fleet
+        stops calling poll_next_task exactly when a force-cancel matters
+        most (a long task hogging the slots). Real task announces
+        encountered here are parked in the announce backlog, which
+        poll_next_task serves FIRST, so intake order and at-most-once
+        semantics are preserved. No store reads: cannot hit an outage."""
+        while len(self._announce_backlog) < self._CONTROL_DRAIN_BACKLOG_CAP:
+            msg = self.subscriber.get_message()
+            if msg is None:
+                return
+            if msg.startswith(CANCEL_ANNOUNCE_PREFIX):
+                self.note_cancelled(msg[len(CANCEL_ANNOUNCE_PREFIX):])
+            elif msg.startswith(KILL_ANNOUNCE_PREFIX):
+                self.note_kill(msg[len(KILL_ANNOUNCE_PREFIX):])
+            else:
+                self._announce_backlog.append(msg)
+
+    #: relay_kills cadence + per-round scan cap: unmatched notes (shared-
+    #: fleet siblings', or a rogue '!kill:' flood) must not turn every
+    #: serve-loop iteration into an O(notes x fleet) ownership scan — the
+    #: cap examines notes oldest-first (dict insertion order; consumed and
+    #: expired entries pop, so the window slides each round)
+    _KILL_RELAY_PERIOD = 0.25
+    _KILL_RELAY_SCAN_CAP = 1_000
+
+    def relay_kills(self, find_owner, send) -> None:
+        """Shared force-cancel relay loop (push-family serve loops):
+        ``find_owner(task_id)`` returns an opaque worker address or None;
+        ``send(addr, task_id)`` transmits the CANCEL. Matched entries are
+        consumed; unmatched ones age out after CANCEL_NOTE_TTL (a
+        shared-fleet sibling may own the task, or it already finished).
+        Throttled + scan-capped (see above): worst-case kill latency is
+        _KILL_RELAY_PERIOD plus queueing behind the cap, paid only under
+        a note flood."""
+        if not self.kill_requested:
+            return
+        now = time.monotonic()
+        if now - self._last_kill_relay < self._KILL_RELAY_PERIOD:
+            return
+        self._last_kill_relay = now
+        for task_id in list(self.kill_requested)[: self._KILL_RELAY_SCAN_CAP]:
+            addr = find_owner(task_id)
+            if addr is not None:
+                send(addr, task_id)
+                self.log.info("relayed force-cancel for task %s", task_id)
+                self.kill_requested.pop(task_id, None)
+            elif (
+                now - self.kill_requested.get(task_id, now)
+                > self.CANCEL_NOTE_TTL
+            ):
+                self.kill_requested.pop(task_id, None)
 
     def drop_if_cancelled(self, task_id: str) -> bool:
         """True when ``task_id`` was cancelled — the dispatch site must
@@ -298,6 +373,11 @@ class TaskDispatcher:
                 # cancel control message, not a task announce: no store
                 # read, so it can't hit an outage — never parked
                 self.note_cancelled(msg[len(CANCEL_ANNOUNCE_PREFIX):])
+                if from_backlog:
+                    self._announce_backlog.popleft()
+                continue
+            if msg.startswith(KILL_ANNOUNCE_PREFIX):
+                self.note_kill(msg[len(KILL_ANNOUNCE_PREFIX):])
                 if from_backlog:
                     self._announce_backlog.popleft()
                 continue

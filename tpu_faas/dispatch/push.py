@@ -321,6 +321,24 @@ class PushDispatcher(TaskDispatcher):
         # are already ours); outage-safe via the base parking helper
         return self.poll_next_claimed()
 
+    def _relay_kills(self) -> None:
+        def owner(tid: str):
+            return next(
+                (
+                    wid
+                    for wid, rec in self.workers.items()
+                    if tid in rec.inflight
+                ),
+                None,
+            )
+
+        self.relay_kills(
+            owner,
+            lambda wid, tid: self._send(
+                wid, m.encode(m.CANCEL, task_id=tid)
+            ),
+        )
+
     def _dispatch_round(self) -> int:
         """Hand out tasks while there is free capacity and pending work."""
         sent = 0
@@ -403,6 +421,10 @@ class PushDispatcher(TaskDispatcher):
                         self.renew_leases(inflight)
                         last_renew = now
                     self._dispatch_round()
+                    # a saturated fleet stops polling the bus for tasks;
+                    # control messages must still flow
+                    self.drain_control_messages()
+                    self._relay_kills()
                 except STORE_OUTAGE_ERRORS as exc:
                     self.note_store_outage(exc)
                 if max_results is not None and self.n_results >= max_results:

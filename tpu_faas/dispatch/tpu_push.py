@@ -875,6 +875,20 @@ class TpuPushDispatcher(TaskDispatcher):
             sent += self._act_on_resolved(res)
         return sent
 
+    def _relay_kills(self) -> None:
+        a = self.arrays
+
+        def owner(tid: str):
+            row = a.inflight_owner(tid)
+            return a.row_ids.get(row) if row is not None else None
+
+        self.relay_kills(
+            owner,
+            lambda wid, tid: self.socket.send_multipart(
+                [wid, m.encode(m.CANCEL, task_id=tid)]
+            ),
+        )
+
     def _drop_cancelled_or_park(self, t) -> bool | None:
         """drop_if_cancelled with the pending-loop outage policy in ONE
         place: True = dropped (state forgotten), False = keep the task,
@@ -1093,6 +1107,11 @@ class TpuPushDispatcher(TaskDispatcher):
                 if now - last_tick >= self.tick_period:
                     try:
                         self._intake()
+                        # control messages must flow even when intake has
+                        # no room (pending full); then relay force-cancels
+                        # to the owning workers before placing
+                        self.drain_control_messages()
+                        self._relay_kills()
                         a = self.arrays
                         # gate the device step: a synchronous device call
                         # blocks this loop, so only pay for it when there is

@@ -848,29 +848,72 @@ async def get_result(request: web.Request) -> web.Response:
 
 
 async def cancel_task(request: web.Request) -> web.Response:
-    """Queued-only, best-effort cancellation (beyond the reference surface;
-    the reference can only let a submitted task run). QUEUED ->
-    CANCELLED (terminal); a RUNNING task is refused with 409 — it keeps its
-    worker and completes normally; cancelling an already-terminal task is
-    an idempotent no-op reporting the terminal status. The store-level
+    """Best-effort cancellation (beyond the reference surface; the
+    reference can only let a submitted task run). QUEUED -> CANCELLED
+    (terminal); a RUNNING task is refused with 409 by default — it keeps
+    its worker and completes normally; cancelling an already-terminal task
+    is an idempotent no-op reporting the terminal status. The store-level
     protocol (conditional write + dispatcher eviction via the announce
-    bus + the one benign race) is documented at store/base.py
-    cancel_task."""
+    bus + the one benign race) is documented at store/base.py cancel_task.
+
+    Optional JSON body ``{"force": true}``: a RUNNING task is ASKED to
+    stop — the owning dispatcher relays a CANCEL to its worker, which
+    interrupts the task mid-run (worker/pool.py force-cancel) and ships a
+    terminal CANCELLED result. Asynchronous and best-effort by nature
+    (the task may finish first, or be C code that never yields): the
+    response is 202 with ``kill_requested`` and the record converges via
+    the ordinary result path — poll /status."""
     ctx: GatewayContext = request.app[CTX_KEY]
     task_id = request.match_info["task_id"]
+    force = False
+    if request.can_read_body:
+        try:
+            raw_force = (await request.json()).get("force", False)
+        except Exception:
+            return _json_error(400, "body, when present, must be JSON")
+        # strict JSON boolean: truthiness would read {"force": "false"}
+        # as a request to interrupt a running task — a destructive action
+        # must never hinge on a string's non-emptiness
+        if not isinstance(raw_force, bool):
+            return _json_error(400, "'force' must be a JSON boolean")
+        force = raw_force
     status = await _run_blocking(ctx.store.cancel_task, task_id, ctx.channel)
     if status is None:
         return _json_error(404, f"unknown task_id {task_id!r}")
+    kill_requested = False
+    if force and status in (
+        str(TaskStatus.RUNNING), str(TaskStatus.CANCELLED)
+    ):
+        # publish the kill for CANCELLED too, not just RUNNING: the
+        # conditional cancel write can WIN while a concurrent dispatch
+        # also wins (the documented lost race) — the record reads
+        # CANCELLED but the task is executing, and without a kill it
+        # would run its full natural length despite an explicit force
+        # request. For a genuinely-queued cancel the note simply finds no
+        # in-flight owner and ages out.
+        await _run_blocking(ctx.store.request_kill, task_id, ctx.channel)
+        kill_requested = True
     if status == str(TaskStatus.RUNNING):
-        return _json_error(
-            409, f"task {task_id!r} is RUNNING and cannot be cancelled"
+        if not force:
+            return _json_error(
+                409, f"task {task_id!r} is RUNNING and cannot be cancelled"
+            )
+        return web.json_response(
+            {
+                "task_id": task_id,
+                "status": status,
+                "cancelled": False,
+                "kill_requested": True,
+            },
+            status=202,
         )
     cancelled = status == str(TaskStatus.CANCELLED)
     if cancelled:
         ctx.n_cancelled += 1
-    return web.json_response(
-        {"task_id": task_id, "status": status, "cancelled": cancelled}
-    )
+    body = {"task_id": task_id, "status": status, "cancelled": cancelled}
+    if force:
+        body["kill_requested"] = kill_requested
+    return web.json_response(body)
 
 
 async def delete_task(request: web.Request) -> web.Response:

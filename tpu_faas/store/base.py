@@ -79,6 +79,13 @@ RESULTS_CHANNEL = "results"
 #: a reference-style consumer that treats it as a task id just finds no
 #: record and skips — the bus stays wire-compatible.
 CANCEL_ANNOUNCE_PREFIX = "!cancel:"
+#: Control message requesting a FORCE cancel of a RUNNING task: whichever
+#: dispatcher holds it in flight relays a CANCEL to the owning worker,
+#: which interrupts the task mid-run (worker/pool.py SIGUSR1) and ships a
+#: terminal CANCELLED result through the ordinary result path. Best-effort:
+#: no store write happens here — the record converges when the worker's
+#: result lands (or stays RUNNING if the task finished first).
+KILL_ANNOUNCE_PREFIX = "!kill:"
 
 
 class Subscription(abc.ABC):
@@ -491,6 +498,13 @@ class TaskStore(abc.ABC):
         self.publish(channel, CANCEL_ANNOUNCE_PREFIX + task_id)
         self.publish(RESULTS_CHANNEL, task_id)
         return str(TaskStatus.CANCELLED)
+
+    def request_kill(
+        self, task_id: str, channel: str = TASKS_CHANNEL
+    ) -> None:
+        """Publish the force-cancel control message for a RUNNING task
+        (see KILL_ANNOUNCE_PREFIX). Fire-and-forget like every announce."""
+        self.publish(channel, KILL_ANNOUNCE_PREFIX + task_id)
 
     def _result_frozen(self, task_id: str) -> bool:
         """first_wins guard: True when the record must not be overwritten —

@@ -34,7 +34,12 @@ from dataclasses import dataclass, field
 from typing import Iterable, Mapping
 
 from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS, TaskStatus
-from tpu_faas.store.base import LIVE_INDEX_KEY, Subscription, TaskStore
+from tpu_faas.store.base import (
+    LIVE_INDEX_KEY,
+    TASKS_CHANNEL,
+    Subscription,
+    TaskStore,
+)
 
 #: Legal status transitions. ``None`` is "task does not exist yet".
 #: RUNNING -> RUNNING appears here because re-dispatch re-marks a task on its
@@ -107,6 +112,9 @@ class _TaskState:
     last_writer: str = "?"
     last_event: Event | None = None
     redispatch_credits: int = 0
+    #: a force-cancel (!kill) was requested for this task — a worker's
+    #: result-bearing CANCELLED write is lawful only with this set
+    kill_requested: bool = False
 
 
 class RaceMonitor:
@@ -147,6 +155,13 @@ class RaceMonitor:
         self.violations: list[Violation] = []
 
     # -- declarations ------------------------------------------------------
+    def expect_force_cancel(self, task_id: str) -> None:
+        """Declare a force-cancel request: the worker's eventual
+        result-bearing CANCELLED write for this task is lawful. Fed by
+        RaceCheckStore.request_kill."""
+        with self._lock:
+            self._state(task_id).kill_requested = True
+
     def expect_redispatch(self, task_id: str) -> None:
         """Declare that the next RUNNING -> RUNNING write on ``task_id`` is a
         deliberate re-dispatch (purged worker's task moved to a replacement),
@@ -330,6 +345,24 @@ class RaceMonitor:
                 prior + (event,),
             )
         elif frm == "RUNNING" and to == "CANCELLED":
+            if event.op == "finish":
+                if state.kill_requested:
+                    # result-bearing CANCELLED from the worker AFTER an
+                    # observed !kill request: a FORCE cancel confirmed by
+                    # the interrupt (worker/pool.py) — deliberate, lawful
+                    return
+                # a CANCELLED result nobody asked for: a stray signal or a
+                # misfire-repair bug shipped it — exactly what this
+                # monitor exists to surface
+                self._flag(
+                    "unrequested-cancel-result",
+                    "warning",
+                    event.task_id,
+                    f"{event.actor} shipped a CANCELLED result with no "
+                    f"observed force-cancel request",
+                    prior + (event,),
+                )
+                return
             self._flag(
                 "cancel-after-dispatch",
                 "warning",
@@ -384,6 +417,12 @@ class RaceCheckStore(TaskStore):
     def declare_redispatch(self, task_id: str) -> None:
         self.monitor.expect_redispatch(task_id)
         self.inner.declare_redispatch(task_id)
+
+    def request_kill(
+        self, task_id: str, channel: str = TASKS_CHANNEL
+    ) -> None:
+        self.monitor.expect_force_cancel(task_id)
+        self.inner.request_kill(task_id, channel)
 
     def flush(self) -> None:
         self.monitor.observe_flush(self.actor)

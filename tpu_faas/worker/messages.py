@@ -26,6 +26,14 @@ dispatcher -> worker:
                (SIGALRM); absent = unbounded, the reference contract]
     WAIT       (pull only)
     RECONNECT  (push hb; request for the worker to re-announce itself)
+    CANCEL     (push) data: task_id — force-cancel a dispatched task: the
+               worker interrupts it mid-run (pool SIGUSR1, the externally
+               triggered sibling of the timeout) or drops it pre-start,
+               and ships a normal RESULT with status CANCELLED; a task
+               that already finished just ships its real result. Best
+               effort by design — reference-era workers ignore unknown
+               message types and the record then converges via the
+               ordinary result path.
 """
 
 from __future__ import annotations
@@ -40,6 +48,7 @@ HEARTBEAT = "heartbeat"
 RECONNECT = "reconnect"
 TASK = "task"
 WAIT = "wait"
+CANCEL = "cancel"
 
 
 def encode(msg_type: str, **data: object) -> bytes:

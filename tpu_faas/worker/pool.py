@@ -1,22 +1,115 @@
-"""Worker-side process pool with broken-pool recovery.
+"""Worker-side process pool with broken-pool recovery and force-cancel.
 
 Wraps a ProcessPoolExecutor (forkserver context) around `execute_fn` with the
 same failure semantics the local dispatcher has: a child killed by user code
 surfaces as a FAILED result for that task and the pool is rebuilt, instead of
 the reference's silent slot leak (its workers count busy slots in the parent
 and a vanished child never decrements, pull_worker.py:63-72).
+
+Force-cancel (:meth:`TaskPool.cancel`): interrupt a task MID-RUN without
+killing its child process, by reusing the shape of the per-task SIGALRM
+timeout (core/executor.py) with SIGUSR1. Children report (task_id, pid)
+start/end events on a queue; the parent signals the pid its bookkeeping says
+runs the target, and the child's handler raises
+:class:`~tpu_faas.core.executor.TaskCancelledInterrupt` into whatever is
+currently running — producing a terminal CANCELLED result and freeing the
+slot in place (no pool rebuild). The event queue is necessarily a little
+stale, so a signal CAN land after the child switched tasks; the handler
+cannot know the parent's intent (signals carry no payload), so the caller
+repairs misfires: a CANCELLED result for a task nobody asked to cancel is
+resubmitted via :meth:`TaskPool.resubmit` — it never reported anything
+externally, so re-running it is invisible. Same reach limits as the
+timeout: POSIX main-thread children; C code that never yields can't be
+interrupted.
 """
 
 from __future__ import annotations
 
 import multiprocessing as mp
+import os
 import queue
+import signal as _signal
 from concurrent.futures import Future, ProcessPoolExecutor, wait
 from concurrent.futures.process import BrokenProcessPool
 
-from tpu_faas.core.executor import ExecutionResult, execute_fn
+from tpu_faas.core.executor import (
+    ExecutionResult,
+    TaskCancelledInterrupt,
+    execute_fn,
+)
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import TaskStatus
+from tpu_faas.utils.logging import get_logger
+
+log = get_logger("worker.pool")
+
+#: child-side: the task id currently executing in THIS child (None between
+#: tasks) — consulted by the SIGUSR1 handler, plain memory only (a signal
+#: handler must never do IPC)
+_CURRENT_TASK: str | None = None
+#: child-side: the start/end event queue back to the parent
+_EVENTS = None
+
+
+def _on_cancel_signal(signum, frame):
+    global _CURRENT_TASK
+    tid = _CURRENT_TASK
+    if tid is not None:
+        # close the window BEFORE raising: a duplicate signal (client
+        # retry, two relays racing) landing while the first interrupt is
+        # still unwinding must no-op — a raise inside _run_reported's
+        # except block would escape as the future's exception and turn a
+        # deliberate CANCELLED into a spurious FAILED
+        _CURRENT_TASK = None
+        raise TaskCancelledInterrupt(f"task {tid} force-cancelled mid-run")
+
+
+def _child_init(events) -> None:
+    """Pool-child initializer: stash the event queue, install the cancel
+    handler (main thread of the child; mirrors the SIGALRM arming in
+    execute_fn)."""
+    global _EVENTS
+    _EVENTS = events
+    if hasattr(_signal, "SIGUSR1"):
+        _signal.signal(_signal.SIGUSR1, _on_cancel_signal)
+
+
+def _run_reported(
+    task_id: str, ser_fn: str, ser_params: str, timeout: float | None
+) -> ExecutionResult:
+    """execute_fn wrapped with start/end reporting + the cancel window.
+
+    The WHOLE window — from opening `_CURRENT_TASK` through execute_fn's
+    return — sits inside one try, so an interrupt can never escape as the
+    future's exception (that would report FAILED, leak the child's window
+    permanently open, and let the next stray signal kill the executor's
+    worker loop). `_CURRENT_TASK` is set before the start event ships: a
+    deferred interrupt fired on seeing that event must find the window
+    open. An interrupt landing AFTER execute_fn returned keeps the real
+    result — the task beat the signal, and discarding a computed
+    COMPLETED for a raced CANCELLED would break the documented force-
+    cancel contract."""
+    global _CURRENT_TASK
+    res: ExecutionResult | None = None
+    try:
+        _CURRENT_TASK = task_id
+        if _EVENTS is not None:
+            _EVENTS.put(("start", task_id, os.getpid()))
+        # interrupts DURING the call are handled inside execute_fn itself
+        # (its except clauses return a CANCELLED result)
+        res = execute_fn(task_id, ser_fn, ser_params, timeout)
+    except TaskCancelledInterrupt as exc:
+        _CURRENT_TASK = None  # close the window before anything else
+        if res is None:
+            # landed before execute_fn produced anything: pre-start cancel
+            res = ExecutionResult(
+                task_id, str(TaskStatus.CANCELLED), serialize(exc)
+            )
+    finally:
+        _CURRENT_TASK = None
+        if _EVENTS is not None:
+            _EVENTS.put(("end", task_id, 0))
+    return res
 
 
 def _warm() -> None:
@@ -29,13 +122,84 @@ class TaskPool:
         self.num_processes = num_processes
         self._done: queue.Queue[tuple[str, Future]] = queue.Queue()
         self._busy = 0
+        #: parent-side mirror of the children's start/end events:
+        #: task_id -> child pid, maintained by _drain_events
+        self._running_pids: dict[str, int] = {}
+        #: in-flight bookkeeping for force-cancel: the future (so a task
+        #: still queued in the executor can be cancelled without a signal),
+        #: the submitted payloads (so a misfired interrupt can resubmit),
+        #: and which tasks a cancel was actually requested for
+        self._futures: dict[str, Future] = {}
+        self._args: dict[str, tuple[str, str, float | None]] = {}
+        self._want_cancel: set[str] = set()
+        #: cancels for tasks sitting in the executor's CALL QUEUE (future
+        #: no longer .cancel()-able, child not started): the interrupt is
+        #: deferred until the task's start event arrives
+        self._deferred_kill: set[str] = set()
         self._executor = self._make()
 
     def _make(self) -> ProcessPoolExecutor:
+        ctx = mp.get_context("forkserver")
+        self._events = ctx.SimpleQueue()
+        self._running_pids.clear()
         return ProcessPoolExecutor(
             max_workers=self.num_processes,
-            mp_context=mp.get_context("forkserver"),
+            mp_context=ctx,
+            initializer=_child_init,
+            initargs=(self._events,),
         )
+
+    def _drain_events(self) -> None:
+        while not self._events.empty():
+            kind, tid, pid = self._events.get()
+            if kind == "start":
+                self._running_pids[tid] = pid
+                if tid in self._deferred_kill:
+                    # a cancel arrived while this task sat in the call
+                    # queue: interrupt it the moment it starts (the child
+                    # opens its cancel window BEFORE shipping this event)
+                    self._deferred_kill.discard(tid)
+                    try:
+                        os.kill(pid, _signal.SIGUSR1)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+            else:
+                self._running_pids.pop(tid, None)
+
+    def cancel(self, task_id: str) -> bool:
+        """Best-effort force-cancel of ``task_id``. True when the task will
+        surface as a CANCELLED result from :meth:`drain` — either its
+        future was still queued in the executor (cancelled without a
+        signal) or an interrupt was sent to the child the event stream
+        says runs it. False when it is not held here (finished, shipped,
+        or never seen). The event stream lags reality by design, so an
+        interrupt CAN land on a child that already switched tasks; drain()
+        repairs such misfires internally by resubmitting the wrongly
+        interrupted task — see the module docstring."""
+        fut = self._futures.get(task_id)
+        if fut is not None and fut.cancel():
+            # never handed to a child: the done-callback queues the
+            # cancelled future and drain() reports terminal CANCELLED
+            self._want_cancel.add(task_id)
+            return True
+        if not hasattr(_signal, "SIGUSR1"):
+            return False
+        self._drain_events()
+        pid = self._running_pids.get(task_id)
+        if pid is None:
+            if fut is not None and not fut.done():
+                # in the executor's call queue: no child to signal yet —
+                # defer the interrupt to the task's start event
+                self._deferred_kill.add(task_id)
+                self._want_cancel.add(task_id)
+                return True
+            return False
+        try:
+            os.kill(pid, _signal.SIGUSR1)
+        except (ProcessLookupError, PermissionError):
+            return False
+        self._want_cancel.add(task_id)
+        return True
 
     @property
     def busy(self) -> int:
@@ -67,19 +231,28 @@ class TaskPool:
     ) -> None:
         try:
             fut = self._executor.submit(
-                execute_fn, task_id, fn_payload, param_payload, timeout
+                _run_reported, task_id, fn_payload, param_payload, timeout
             )
         except BrokenProcessPool:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = self._make()
             fut = self._executor.submit(
-                execute_fn, task_id, fn_payload, param_payload, timeout
+                _run_reported, task_id, fn_payload, param_payload, timeout
             )
         fut.add_done_callback(lambda f, tid=task_id: self._done.put((tid, f)))
+        self._futures[task_id] = fut
+        self._args[task_id] = (fn_payload, param_payload, timeout)
         self._busy += 1
 
     def drain(self) -> list[ExecutionResult]:
-        """Non-blocking: collect all finished results."""
+        """Non-blocking: collect all finished results. Force-cancel
+        semantics live here: a cancelled-before-start future becomes a
+        terminal CANCELLED result; a CANCELLED result nobody requested (an
+        interrupt that landed after its child switched tasks) is repaired
+        by resubmitting the task instead of delivering — the wrongly
+        interrupted run reported nothing externally, so the re-execution
+        is invisible to every consumer."""
+        self._drain_events()  # keep the task->pid mirror bounded + fresh
         out: list[ExecutionResult] = []
         while True:
             try:
@@ -87,7 +260,26 @@ class TaskPool:
             except queue.Empty:
                 return out
             self._busy -= 1
+            self._futures.pop(task_id, None)
+            self._deferred_kill.discard(task_id)
+            args = self._args.pop(task_id, None)
+            wanted = task_id in self._want_cancel
+            self._want_cancel.discard(task_id)
             if fut.cancelled():
+                if wanted:
+                    # deliberate pre-start cancel: terminal CANCELLED
+                    out.append(
+                        ExecutionResult(
+                            task_id,
+                            str(TaskStatus.CANCELLED),
+                            serialize(
+                                TaskCancelledInterrupt(
+                                    f"task {task_id} cancelled before start"
+                                )
+                            ),
+                        )
+                    )
+                    continue
                 # future cancelled by a broken-pool rebuild: .exception()
                 # would RAISE CancelledError; report the task as FAILED
                 exc: BaseException | None = RuntimeError(
@@ -96,7 +288,24 @@ class TaskPool:
             else:
                 exc = fut.exception()
             if exc is None:
-                out.append(fut.result())
+                res: ExecutionResult = fut.result()
+                if (
+                    res.status == str(TaskStatus.CANCELLED)
+                    and not wanted
+                    and args is not None
+                ):
+                    # misfire: the interrupt landed on this task after its
+                    # child switched away from the intended one — re-run
+                    # it. Logged: this is the one at-least-once execution
+                    # in the system, and an operator chasing doubled side
+                    # effects needs the trace.
+                    log.warning(
+                        "misfired cancel interrupt hit task %s; "
+                        "resubmitting it", task_id,
+                    )
+                    self.submit(task_id, *args)
+                    continue
+                out.append(res)
             else:
                 out.append(
                     ExecutionResult(

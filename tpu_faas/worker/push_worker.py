@@ -117,6 +117,15 @@ class PushWorker:
                                 data["param_payload"],
                                 timeout=data.get("timeout"),
                             )
+                        elif msg_type == m.CANCEL:
+                            # force-cancel: interrupt mid-run or drop
+                            # pre-start; the CANCELLED result ships via the
+                            # normal drain below. False = task not held
+                            # here (already finished — its real result
+                            # shipped or is about to; nothing to do)
+                            tid = data.get("task_id", "")
+                            if self.pool.cancel(tid):
+                                log.info("force-cancelling task %s", tid)
                         elif msg_type == m.RECONNECT:
                             # a draining worker reports zero capacity: it
                             # must not be handed new work
