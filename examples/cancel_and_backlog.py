@@ -1,4 +1,4 @@
-"""Queued-only cancellation + the learned-runtime backlog signal, live.
+"""Cancellation (soft + force) + the learned-runtime backlog signal, live.
 
 One process hosts the whole stack (store thread + gateway thread + a
 tpu-push dispatcher thread with the runtime estimator on), a saturated
@@ -9,7 +9,10 @@ tpu-push dispatcher thread with the runtime estimator on), a saturated
    TaskCancelledError, and the dispatcher never runs them;
 2. shows that cancelling the RUNNING blocker is refused (False) — a
    cancel never yanks a worker;
-3. reads the dispatcher's /stats-style backlog estimate
+3. FORCE-cancels a RUNNING task: the worker interrupts it mid-run the
+   way a `timeout` hint would, the slot frees in place, and the record
+   converges to CANCELLED in about a second;
+4. reads the dispatcher's /stats-style backlog estimate
    (``backlog_est_s``): after a few completions teach the estimator this
    workload's runtime, the pending queue is priced in SECONDS — the same
    signal `tpu-faas-deploy --stats-url ... --drain-target N` uses to size
@@ -120,6 +123,24 @@ def main() -> None:
             f"{disp.stats()['cancelled_dropped']} before dispatch"
         )
         print(f"blocker finished untouched: {blocker.result(timeout=60.0)}")
+
+        # FORCE cancel: a RUNNING task is interrupted mid-run — the pool
+        # signals the child like a `timeout` would, the slot frees in
+        # place, and the record converges to CANCELLED in ~a second
+        # instead of the task's natural 60
+        runaway = client.submit(fid, 60.0)
+        while runaway.status() != "RUNNING":
+            time.sleep(0.05)
+        t0 = time.time()
+        runaway.cancel(force=True)
+        try:
+            runaway.result(timeout=30.0)
+        except TaskCancelledError:
+            print(
+                f"force-cancel interrupted a 60 s task in "
+                f"{time.time() - t0:.1f} s; status "
+                f"{runaway.status()}"
+            )
     finally:
         worker.kill()
         worker.wait()

@@ -28,7 +28,9 @@ from tpu_faas.core.task import TaskStatus
 
 class ExecutionResult(NamedTuple):
     task_id: str
-    status: str  # plain string: "COMPLETED" | "FAILED" (wire/store form)
+    #: plain string, wire/store form: "COMPLETED" | "FAILED" | "CANCELLED"
+    #: (the last only from a force-cancel interrupt, worker/pool.py)
+    status: str
     result: str  # serialized payload (value or exception)
     #: wall seconds the execution took IN THE POOL CHILD (deserialize +
     #: call + serialize), measured at the source so it carries no pool

@@ -15,12 +15,12 @@ runs the target, and the child's handler raises
 currently running — producing a terminal CANCELLED result and freeing the
 slot in place (no pool rebuild). The event queue is necessarily a little
 stale, so a signal CAN land after the child switched tasks; the handler
-cannot know the parent's intent (signals carry no payload), so the caller
-repairs misfires: a CANCELLED result for a task nobody asked to cancel is
-resubmitted via :meth:`TaskPool.resubmit` — it never reported anything
-externally, so re-running it is invisible. Same reach limits as the
-timeout: POSIX main-thread children; C code that never yields can't be
-interrupted.
+cannot know the parent's intent (signals carry no payload), so
+:meth:`TaskPool.drain` repairs misfires internally: a CANCELLED result
+for a task nobody asked to cancel is resubmitted — it never reported
+anything externally, so re-running it is invisible. Same reach limits as
+the timeout: POSIX main-thread children; C code that never yields can't
+be interrupted.
 """
 
 from __future__ import annotations
@@ -91,23 +91,38 @@ def _run_reported(
     cancel contract."""
     global _CURRENT_TASK
     res: ExecutionResult | None = None
+    end_sent = False
     try:
-        _CURRENT_TASK = task_id
-        if _EVENTS is not None:
-            _EVENTS.put(("start", task_id, os.getpid()))
-        # interrupts DURING the call are handled inside execute_fn itself
-        # (its except clauses return a CANCELLED result)
-        res = execute_fn(task_id, ser_fn, ser_params, timeout)
+        try:
+            _CURRENT_TASK = task_id
+            if _EVENTS is not None:
+                _EVENTS.put(("start", task_id, os.getpid()))
+            # interrupts DURING the call are handled inside execute_fn
+            # itself (its except clauses return a CANCELLED result)
+            res = execute_fn(task_id, ser_fn, ser_params, timeout)
+        except TaskCancelledInterrupt as exc:
+            if res is None:
+                # landed before execute_fn produced anything: a pre-start
+                # cancel (the handler already closed the window)
+                res = ExecutionResult(
+                    task_id, str(TaskStatus.CANCELLED), serialize(exc)
+                )
+        finally:
+            _CURRENT_TASK = None
+            if _EVENTS is not None:
+                _EVENTS.put(("end", task_id, 0))
+                end_sent = True
     except TaskCancelledInterrupt as exc:
-        _CURRENT_TASK = None  # close the window before anything else
+        # the signal landed in the sliver between the try body completing
+        # and the finally's window close — the handler cleared the window
+        # before raising, so no further interrupt can arrive; keep the
+        # real result if one exists (the task beat the signal) and make
+        # sure the end event still ships
         if res is None:
-            # landed before execute_fn produced anything: pre-start cancel
             res = ExecutionResult(
                 task_id, str(TaskStatus.CANCELLED), serialize(exc)
             )
-    finally:
-        _CURRENT_TASK = None
-        if _EVENTS is not None:
+        if _EVENTS is not None and not end_sent:
             _EVENTS.put(("end", task_id, 0))
     return res
 
