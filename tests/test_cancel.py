@@ -542,6 +542,42 @@ def test_force_cancel_running_task_e2e():
         store_handle.stop()
 
 
+def test_local_dispatcher_force_cancel_e2e():
+    """Local mode rides the same TaskPool: a RUNNING task force-cancels
+    in place — the kill note feeds pool.cancel directly (no wire) — and
+    the freed slot runs a follow-up."""
+    import threading
+
+    from tpu_faas.dispatch.local import LocalDispatcher
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = LocalDispatcher(num_workers=1, store=make_store(store_handle.url))
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        h = client.submit(fid, 30.0)
+        deadline = time.time() + 60
+        while h.status() != "RUNNING" and time.time() < deadline:
+            time.sleep(0.05)
+        assert h.status() == "RUNNING"
+        t0 = time.time()
+        assert h.cancel(force=True) is False  # async kill request
+        with pytest.raises(TaskCancelledError):
+            h.result(timeout=30.0)
+        assert time.time() - t0 < 25.0
+        assert h.status() == "CANCELLED"
+        follow = client.submit(fid, 0.05)
+        assert follow.result(timeout=30.0) == 0.05
+    finally:
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
 def test_gateway_force_cancel_contract():
     store_handle = start_store_thread()
     gw = start_gateway_thread(make_store(store_handle.url))

@@ -8,28 +8,27 @@ isolates communication overhead (reference README:41).
 
 Design differences:
 
-- completions land on a thread-safe queue via future done-callbacks instead
-  of the reference's deque-rotation scan (task_dispatcher.py:88-103) — O(1)
-  drain, no polling latency on results;
-- a ``ProcessPoolExecutor`` (forkserver context: never fork a multi-threaded
-  process) instead of ``mp.Pool``: if a child dies mid-task (user code calls
-  os._exit, OOM-kill), the broken pool surfaces as exceptions on in-flight
-  futures, which we convert to FAILED results and recover from by rebuilding
-  the pool — the reference would silently leak a pool slot forever.
+- execution rides the SAME :class:`~tpu_faas.worker.pool.TaskPool` the
+  workers use (forkserver children, broken-pool recovery, force-cancel
+  interrupts) instead of a second hand-rolled executor: a child that dies
+  mid-task (user code calls os._exit, OOM-kill) surfaces as a FAILED
+  result and the pool rebuilds, where the reference silently leaks a pool
+  slot forever;
+- completions land on the pool's thread-safe done queue via future
+  done-callbacks instead of the reference's deque-rotation scan
+  (task_dispatcher.py:88-103) — O(1) drain, no polling latency;
+- cancellation works end to end: queued tasks are dropped at the submit
+  gate (store-verified cancel notes), and FORCE cancels interrupt a
+  running task in place — locally there is no wire to relay over, the
+  kill note feeds :meth:`TaskPool.cancel` directly.
 """
 
 from __future__ import annotations
 
-import multiprocessing as mp
-import queue
 import time
-from concurrent.futures import Future, ProcessPoolExecutor
-from concurrent.futures.process import BrokenProcessPool
 
-from tpu_faas.core.executor import ExecutionResult, execute_fn
-from tpu_faas.core.serialize import serialize
-from tpu_faas.core.task import TaskStatus
 from tpu_faas.dispatch.base import STORE_OUTAGE_ERRORS, TaskDispatcher
+from tpu_faas.worker.pool import TaskPool
 
 
 class LocalDispatcher(TaskDispatcher):
@@ -47,49 +46,12 @@ class LocalDispatcher(TaskDispatcher):
         )
         self.num_workers = num_workers
         self.idle_sleep = idle_sleep
-        self._done: queue.Queue[tuple[str, Future]] = queue.Queue()
-        self._busy = 0
         self._running: set[str] = set()
-
-    def _make_pool(self) -> ProcessPoolExecutor:
-        return ProcessPoolExecutor(
-            max_workers=self.num_workers,
-            mp_context=mp.get_context("forkserver"),
-        )
-
-    def _submit(self, pool: ProcessPoolExecutor, task) -> None:
-        self.mark_running_safe(task.task_id)
-        fut = pool.submit(
-            execute_fn,
-            task.task_id,
-            task.fn_payload,
-            task.param_payload,
-            task.timeout,
-        )
-        fut.add_done_callback(
-            lambda f, tid=task.task_id: self._done.put((tid, f))
-        )
-        self._running.add(task.task_id)
-        self._busy += 1
-
-    def _drain_one(self) -> bool:
-        try:
-            task_id, fut = self._done.get_nowait()
-        except queue.Empty:
-            return False
-        self._running.discard(task_id)
-        exc = fut.exception()
-        if exc is None:
-            res: ExecutionResult = fut.result()
-            self.record_result_safe(res.task_id, res.status, res.result)
-        else:
-            # child died or result transfer failed: the task is FAILED, the
-            # slot is reclaimed (reference leaks it — SURVEY §2 LocalDispatcher)
-            self.record_result_safe(
-                task_id, str(TaskStatus.FAILED), serialize(RuntimeError(str(exc)))
-            )
-        self._busy -= 1
-        return True
+        #: tasks admitted while their cancel-note verification read hit a
+        #: store outage: their record may actually be CANCELLED (or even
+        #: DELETEd), so their eventual result writes first_wins — a blind
+        #: write could resurrect a consumed record as a partial hash
+        self._suspect: set[str] = set()
 
     def start(self, max_tasks: int | None = None) -> int:
         """Run the dispatch loop; returns number of tasks completed.
@@ -99,14 +61,14 @@ class LocalDispatcher(TaskDispatcher):
         """
         completed = 0
         last_renew = time.monotonic()
-        pool = self._make_pool()
+        pool = TaskPool(self.num_workers)
         try:
             while not self.stopping:
                 progressed = False
                 if self.deferred_results:
                     self.flush_deferred_results()
                 # admission-controlled intake (reference task_dispatcher.py:73-75)
-                while self._busy < self.num_workers:
+                while pool.free > 0:
                     try:
                         # shared mode: only run tasks we claimed (outage-
                         # safe: an unclaimed poll parks and retries)
@@ -117,14 +79,44 @@ class LocalDispatcher(TaskDispatcher):
                     if task is None:
                         break
                     try:
-                        self._submit(pool, task)
-                    except BrokenProcessPool:
-                        pool.shutdown(wait=False, cancel_futures=True)
-                        pool = self._make_pool()
-                        self._submit(pool, task)
+                        if self.drop_if_cancelled(task.task_id):
+                            continue
+                    except STORE_OUTAGE_ERRORS as exc:
+                        # verification read mid-outage: run the task anyway
+                        # (the benign lost-race convergence) rather than
+                        # wedging intake — local holds no pending structure
+                        # to park it in. Its result write is demoted to
+                        # first_wins: the unverified record may be
+                        # CANCELLED or DELETEd, and a blind write would
+                        # resurrect it
+                        self.note_store_outage(exc, pause=0)
+                        self._suspect.add(task.task_id)
+                    self.mark_running_safe(task.task_id)
+                    pool.submit(
+                        task.task_id,
+                        task.fn_payload,
+                        task.param_payload,
+                        task.timeout,
+                    )
+                    self._running.add(task.task_id)
                     progressed = True
-                # drain completions
-                while self._drain_one():
+                # control messages flow even while the pool is saturated,
+                # and force-cancels feed the pool DIRECTLY (no wire here)
+                self.drain_control_messages()
+                self.relay_kills(
+                    lambda tid: tid if tid in self._running else None,
+                    lambda _addr, tid: pool.cancel(tid),
+                )
+                # drain completions (CANCELLED included — force cancels
+                # surface through the ordinary result path)
+                for res in pool.drain():
+                    self._running.discard(res.task_id)
+                    suspect = res.task_id in self._suspect
+                    self._suspect.discard(res.task_id)
+                    self.record_result_safe(
+                        res.task_id, res.status, res.result,
+                        first_wins=suspect,
+                    )
                     completed += 1
                     progressed = True
                 if (self._running or self.shared) and (
@@ -147,5 +139,5 @@ class LocalDispatcher(TaskDispatcher):
                 if not progressed:
                     time.sleep(self.idle_sleep)
         finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+            pool.close()
         return completed
