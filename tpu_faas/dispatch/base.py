@@ -218,9 +218,11 @@ class TaskDispatcher:
         #: task this dispatcher never held (shared-fleet siblings) age out.
         self.cancelled: dict[str, float] = {}
         #: task_id -> note-time for FORCE-cancel control messages (kill a
-        #: RUNNING task): push-family dispatchers relay a CANCEL to the
-        #: owning worker; modes that cannot reach workers (pull's REQ/REP)
-        #: let the notes age out. Same bounds as the cancel notes.
+        #: RUNNING task). Delivery per mode: push relays a CANCEL over the
+        #: wire, pull piggy-backs ``cancel_ids`` on the next mandatory
+        #: REQ/REP reply, local feeds the pool directly; notes for tasks a
+        #: sibling owns (shared fleets) age out. Same bounds as the
+        #: cancel notes.
         self.kill_requested: dict[str, float] = {}
         self._last_kill_relay = 0.0
         self.n_cancelled_dropped = 0

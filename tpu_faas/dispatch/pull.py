@@ -4,9 +4,12 @@ Capability parity with reference PullDispatcher (task_dispatcher.py:105-187):
 a REP socket where workers come asking for work; the defining constraint is
 the REP/REQ lockstep — every received message MUST be answered in the same
 cycle (reference comment at 163-167) — so each worker request is answered
-with either a ``task`` or a ``wait``. The dispatcher reads the announce bus
-only when it has a requester to hand the task to, which is the pull mode's
-implicit back-pressure (SURVEY §2.3).
+with either a ``task`` or a ``wait``. TASKS are read off the announce bus
+only when there is a requester to hand them to — the pull mode's implicit
+back-pressure (SURVEY §2.3); CONTROL messages (cancel/kill) are drained
+every loop regardless, with any task announces encountered parked in the
+intake backlog (a saturated fleet must still honor cancellation, and
+force-cancels ride the next mandatory reply as ``cancel_ids``).
 
 Differences from the reference: the poll has a timeout so ``stop()`` works;
 ``result`` messages are answered with another task when one is pending (the
@@ -161,6 +164,26 @@ class PullDispatcher(TaskDispatcher):
         # are already ours); outage-safe via the base parking helper
         return self.poll_next_claimed()
 
+    def _kills_for(self, wid) -> list[str]:
+        """Force-cancel ids among THIS worker's in-flight tasks, consumed
+        from the kill notes. Pull workers cannot be pushed to (REQ/REP),
+        so kills ride the next mandatory reply — TASK or WAIT — via the
+        ``cancel_ids`` field."""
+        if not self.kill_requested or wid is None:
+            return []
+        mine = self.worker_tasks.get(wid)
+        if not mine:
+            return []
+        # iterate the worker's small in-flight set, not the note dict: a
+        # shared fleet (or a '!kill:' flood) can hold up to the note cap
+        # of unmatched sibling entries, and an O(notes) walk per REQ/REP
+        # message is exactly the hazard base.relay_kills throttles against
+        hits = [t for t in mine if t in self.kill_requested]
+        for t in hits:
+            self.kill_requested.pop(t, None)
+            self.log.info("relayed force-cancel for task %s", t)
+        return hits
+
     def start(self, max_results: int | None = None) -> int:
         """Serve worker requests; returns results recorded (for tests)."""
         n_results = 0
@@ -169,6 +192,9 @@ class PullDispatcher(TaskDispatcher):
             while not self.stopping:
                 if self.deferred_results:
                     self.flush_deferred_results()
+                # control messages must flow even while no worker is
+                # asking for tasks (saturated fleet mid-long-tasks)
+                self.drain_control_messages()
                 try:
                     self._purge_dead_workers()
                     if self.clock() - last_renew >= self.lease_renew_period and (
@@ -209,6 +235,10 @@ class PullDispatcher(TaskDispatcher):
                     )
                     n_results += 1
                     if owner is None or owner == wid:
+                        # the OWNER's result makes a pending kill moot; a
+                        # zombie's stale result must NOT eat the kill for
+                        # the live re-dispatched copy
+                        self.kill_requested.pop(task_id, None)
                         self.inflight.pop(task_id, None)
                         self.task_retries.pop(task_id, None)
                         if owner is not None:
@@ -228,6 +258,8 @@ class PullDispatcher(TaskDispatcher):
                     except STORE_OUTAGE_ERRORS as exc:
                         self.note_store_outage(exc, pause=0)
                         task = None
+                kill_ids = self._kills_for(wid)
+                extra = {"cancel_ids": kill_ids} if kill_ids else {}
                 if task is not None:
                     self.mark_running_safe(
                         task.task_id,
@@ -240,10 +272,12 @@ class PullDispatcher(TaskDispatcher):
                             task.task_id
                         )
                     self.socket.send(
-                        m.encode(m.TASK, **task.task_message_kwargs())
+                        m.encode(
+                            m.TASK, **task.task_message_kwargs(), **extra
+                        )
                     )
                 else:
-                    self.socket.send(m.encode(m.WAIT))
+                    self.socket.send(m.encode(m.WAIT, **extra))
                 if max_results is not None and n_results >= max_results:
                     break
         finally:

@@ -24,7 +24,11 @@ dispatcher -> worker:
     TASK       data: task_id, fn_payload, param_payload [, timeout: float —
                execution budget the worker enforces in its pool child
                (SIGALRM); absent = unbounded, the reference contract]
-    WAIT       (pull only)
+               [, cancel_ids: list — pull only, see WAIT]
+    WAIT       (pull only) [, cancel_ids: list — force-cancels for tasks
+               THIS worker runs, piggy-backed on the mandatory reply
+               because a REQ/REP worker cannot be pushed to; a saturated
+               worker's keepalive transactions bound the delivery latency]
     RECONNECT  (push hb; request for the worker to re-announce itself)
     CANCEL     (push) data: task_id — force-cancel a dispatched task: the
                worker interrupts it mid-run (pool SIGUSR1, the externally
@@ -32,8 +36,8 @@ dispatcher -> worker:
                and ships a normal RESULT with status CANCELLED; a task
                that already finished just ships its real result. Best
                effort by design — reference-era workers ignore unknown
-               message types and the record then converges via the
-               ordinary result path.
+               message types and fields, and the record then converges
+               via the ordinary result path.
 """
 
 from __future__ import annotations

@@ -68,9 +68,15 @@ class PullWorker:
     # -- one REQ/REP transaction ------------------------------------------
     def _transact(self, msg_type: str, **data: object) -> None:
         """Send one message, receive the mandatory reply, and if the reply
-        carries a task, put it on the pool."""
+        carries a task, put it on the pool. Force-cancels ride the reply
+        too (``cancel_ids``): a pull worker cannot be pushed to, so the
+        dispatcher piggy-backs kill requests for tasks THIS worker runs on
+        whatever reply goes out next — TASK or WAIT."""
         self.socket.send(m.encode(msg_type, **data))
         reply_type, reply = m.decode(self.socket.recv())
+        for tid in reply.get("cancel_ids", ()):
+            if self.pool.cancel(tid):
+                log.info("force-cancelling task %s", tid)
         if reply_type == m.TASK:
             self.pool.submit(
                 reply["task_id"],
