@@ -578,6 +578,31 @@ def test_local_dispatcher_force_cancel_e2e():
         store_handle.stop()
 
 
+def test_push_dispatcher_force_cancel_e2e():
+    """Plain push mode (PushDispatcher, heartbeat fleet): the kill relays
+    over the ROUTER socket to the worker whose in-flight set holds the
+    task."""
+    from tests.test_workers_e2e import stack
+
+    with stack("push", n_workers=1, n_procs=1, heartbeat=True) as (
+        client, workers, disp,
+    ):
+        fid = client.register(sleep_task)
+        h = client.submit(fid, 30.0)
+        deadline = time.time() + 60
+        while h.status() != "RUNNING" and time.time() < deadline:
+            time.sleep(0.05)
+        assert h.status() == "RUNNING"
+        t0 = time.time()
+        assert h.cancel(force=True) is False
+        with pytest.raises(TaskCancelledError):
+            h.result(timeout=30.0)
+        assert time.time() - t0 < 25.0
+        assert h.status() == "CANCELLED"
+        follow = client.submit(fid, 0.05)
+        assert follow.result(timeout=30.0) == 0.05
+
+
 def test_pull_dispatcher_force_cancel_e2e():
     """Pull mode: the kill rides the worker's next mandatory reply
     (cancel_ids on TASK/WAIT — REQ/REP can't be pushed to). A RUNNING

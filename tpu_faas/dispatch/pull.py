@@ -178,9 +178,20 @@ class PullDispatcher(TaskDispatcher):
         # shared fleet (or a '!kill:' flood) can hold up to the note cap
         # of unmatched sibling entries, and an O(notes) walk per REQ/REP
         # message is exactly the hazard base.relay_kills throttles against
-        hits = [t for t in mine if t in self.kill_requested]
-        for t in hits:
+        now = time.monotonic()
+        hits: list[str] = []
+        for t in mine:
+            ts = self.kill_requested.get(t)
+            if ts is None:
+                continue
             self.kill_requested.pop(t, None)
+            if now - ts > self.CANCEL_NOTE_TTL:
+                # expired note (same TTL as base.relay_kills' age-out): an
+                # idempotency-keyed resubmission reuses the SAME task id,
+                # and a stale kill from a long-gone incarnation must never
+                # interrupt the fresh one
+                continue
+            hits.append(t)
             self.log.info("relayed force-cancel for task %s", t)
         return hits
 
