@@ -1,0 +1,310 @@
+"""Wire-parity certification: the REFERENCE's own binaries, unmodified,
+against this stack.
+
+Every other e2e suite has both wire ends implemented here; these tests
+replace one end (or both) with the reference implementation run straight
+from /root/reference:
+
+- ``pull_worker.py`` / ``push_worker.py`` (import only dill+zmq+stdlib —
+  pull_worker.py:1-8, push_worker.py:1-7) serve OUR dispatchers and pass
+  the service oracle, certifying the register/task/result/heartbeat/
+  reconnect envelopes byte-for-byte (push_worker.py:33-37 register,
+  helper_functions.py:5-9 dill+base64 serialization).
+- Reference workers receive but harmlessly IGNORE our protocol extensions
+  (CANCEL messages, per-task ``timeout`` fields) exactly as
+  worker/messages.py documents: the push worker's if/elif chain drops
+  unknown types (push_worker.py:68-82), and the record converges via the
+  ordinary result path.
+- The stretch leg runs the reference's own ``task_dispatcher.py``
+  (``import redis`` — task_dispatcher.py:2,31-36) against OUR store server
+  through the redis-py-surface shim (tpu_faas/compat/redis_shim), with a
+  reference worker on the other side: the full reference stack, storage
+  swapped for ours, our gateway/client doing the submitting.
+
+The reference workers busy-spin by design (poll(0) loops), so legs keep
+fleets small and workloads short.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from contextlib import contextmanager
+
+import pytest
+
+from tests.test_chaos_e2e import _free_port
+from tests.test_tpu_push_e2e import _make_dispatcher
+from tests.test_workers_e2e import _GroupPopen, _spawn_worker, service_test
+from tpu_faas.client import FaaSClient
+from tpu_faas.dispatch.pull import PullDispatcher
+from tpu_faas.dispatch.push import PushDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import sleep_task
+
+REFERENCE_DIR = "/root/reference"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SHIM_DIR = os.path.join(REPO, "tpu_faas", "compat", "redis_shim")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_DIR),
+    reason="reference checkout not present on this host",
+)
+
+
+def _ref_env() -> dict:
+    """Subprocess env for reference binaries: inherit, but strip
+    sitecustomize dirs that import jax into every interpreter (the
+    reference needs only dill+zmq; a multi-second jax import per pool
+    child flakes the timing-sensitive legs — see cpu_worker_env)."""
+    from tpu_faas.bench.harness import cpu_worker_env
+
+    env = cpu_worker_env()
+    # the reference needs nothing from the repo; PYTHONPATH stays anyway
+    # (harmless) so pool children resolve the same interpreter setup
+    return env
+
+
+def _spawn_reference_worker(kind: str, n_procs: int, url: str, *extra: str):
+    """Run /root/reference/{kind}.py UNMODIFIED (cwd = reference dir so its
+    ``from helper_functions import ...`` resolves)."""
+    return _GroupPopen(
+        [
+            sys.executable,
+            os.path.join(REFERENCE_DIR, f"{kind}.py"),
+            str(n_procs),
+            url,
+            *extra,
+        ],
+        env=_ref_env(),
+        cwd=REFERENCE_DIR,
+        start_new_session=True,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+
+
+def _stop_proc(proc) -> str:
+    """Kill a reference subprocess and return its captured stderr text."""
+    if proc.poll() is None:
+        proc.kill()
+    try:
+        _, err = proc.communicate(timeout=10)
+    except subprocess.TimeoutExpired:
+        return "<stderr unavailable: communicate timed out>"
+    return (err or b"").decode("utf-8", "replace")
+
+
+@contextmanager
+def _ref_worker_stack(mode: str, n_workers: int, n_procs: int, **disp_kw):
+    """Our store+gateway+dispatcher; REFERENCE workers on the wire."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    if mode == "pull":
+        disp = PullDispatcher(
+            ip="127.0.0.1", port=0, store=make_store(store_handle.url),
+            **disp_kw,
+        )
+        worker_kind, extra = "pull_worker", ("--delay", "0.005")
+    elif mode == "tpu_push":
+        disp = _make_dispatcher(store_handle.url, **disp_kw)
+        worker_kind = "push_worker"
+        extra = ("--hb",)
+    else:
+        disp = PushDispatcher(
+            ip="127.0.0.1", port=0, store=make_store(store_handle.url),
+            **disp_kw,
+        )
+        worker_kind = "push_worker"
+        extra = ("--hb",) if disp_kw.get("heartbeat") else ()
+    disp_thread = threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_reference_worker(worker_kind, n_procs, url, *extra)
+        for _ in range(n_workers)
+    ]
+    errs: list[str] = []
+    try:
+        yield FaaSClient(gw.url), workers, disp
+        for w in workers:
+            # a reference worker that crashed mid-test (protocol break)
+            # must fail the leg even if the oracle somehow passed
+            assert w.poll() is None, (
+                "reference worker exited early:\n" + _stop_proc(w)
+            )
+    finally:
+        for w in workers:
+            errs.append(_stop_proc(w))
+        disp.stop()
+        disp_thread.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+        for e in errs:
+            # surfaced (not asserted) so teardown noise from the kill
+            # itself — KeyboardInterrupt tracebacks etc. — doesn't flake
+            # the leg; inside the finally so a failing leg still shows the
+            # reference side's stderr
+            if e.strip():
+                print("reference worker stderr:", e[-2000:])
+
+
+def test_reference_worker_interop_pull():
+    """Reference pull workers (REQ lockstep, register/ready/result with no
+    worker_id on result — pull_worker.py:26-34,95-106) against our
+    PullDispatcher. Their messages carry no ``worker_id``, so handouts are
+    untracked — exactly the reference's own (lack of) in-flight semantics."""
+    with _ref_worker_stack("pull", n_workers=2, n_procs=2) as (
+        client, _workers, _disp,
+    ):
+        service_test(client, n_tasks=12)
+
+
+def test_reference_worker_interop_push():
+    """Reference push worker, plain mode (DEALER, no heartbeats) against
+    our PushDispatcher LRU mode."""
+    with _ref_worker_stack("push", n_workers=2, n_procs=2) as (
+        client, _workers, _disp,
+    ):
+        service_test(client, n_tasks=12)
+
+
+def test_reference_worker_interop_push_heartbeat():
+    """Heartbeat mode. The reference worker never resets its heartbeat
+    timer (push_worker.py:60-62 — a documented reference bug), flooding one
+    heartbeat per loop iteration after the first second; the dispatcher
+    must absorb the flood and keep serving."""
+    with _ref_worker_stack(
+        "push", n_workers=1, n_procs=2, heartbeat=True, time_to_expire=5.0
+    ) as (client, _workers, _disp):
+        service_test(client, n_tasks=10)
+
+
+def test_reference_worker_interop_tpu_push():
+    """The TPU device-tick dispatcher serving a reference worker: results
+    arrive WITHOUT the ``elapsed`` field (push_worker.py:88-95), so the
+    runtime estimator must fall back to its priors while scheduling and
+    service stays correct."""
+    with _ref_worker_stack("tpu_push", n_workers=1, n_procs=2) as (
+        client, _workers, disp,
+    ):
+        service_test(client, n_tasks=10)
+        assert disp.n_dispatched >= 10
+
+
+def test_reference_worker_interop_mixed_fleet():
+    """One reference worker and one of ours on the same dispatcher: the
+    protocol extensions are strictly additive, so both serve side by side
+    (ours ships ``elapsed``, the reference's doesn't)."""
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = PushDispatcher(
+        ip="127.0.0.1", port=0, store=make_store(store_handle.url),
+        heartbeat=True, time_to_expire=5.0,
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    ref_worker = _spawn_reference_worker("push_worker", 2, url, "--hb")
+    our_worker = _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+    try:
+        service_test(FaaSClient(gw.url), n_tasks=16)
+        assert ref_worker.poll() is None and our_worker.poll() is None
+    finally:
+        _stop_proc(ref_worker)
+        if our_worker.poll() is None:
+            our_worker.kill()
+            our_worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_reference_worker_ignores_cancel():
+    """worker/messages.py's compatibility claim, proven with the other
+    side's code: a force-cancel relayed to a reference worker is silently
+    dropped (unknown type falls through push_worker.py:68-82's if/elif),
+    the task runs to natural completion, and the record converges COMPLETED
+    via the ordinary result path — best-effort cancellation degrades to
+    exactly the reference's semantics."""
+    with _ref_worker_stack(
+        "push", n_workers=1, n_procs=1, heartbeat=True, time_to_expire=10.0
+    ) as (client, _workers, _disp):
+        fid = client.register(sleep_task)
+        h = client.submit(fid, 3.0)
+        deadline = time.time() + 60
+        while h.status() != "RUNNING" and time.time() < deadline:
+            time.sleep(0.05)
+        assert h.status() == "RUNNING"
+        t0 = time.time()
+        assert h.cancel(force=True) is False  # asked, not yet terminal
+        # the CANCEL reaches the worker and is ignored: the task completes
+        # at its natural pace with its real result
+        assert h.result(timeout=60.0) == 3.0
+        assert time.time() - t0 >= 2.0  # ran out the clock, not interrupted
+        assert h.status() == "COMPLETED"
+
+
+def test_reference_dispatcher_on_our_store():
+    """The full reference stack on our storage: the reference's OWN
+    ``task_dispatcher.py -m push`` (redis-py client surface, hardcoded
+    localhost:6379 — task_dispatcher.py:31-36) runs against our RESP store
+    server via the redis shim's env override, with an unmodified reference
+    push worker executing. Our gateway+client submit and collect — the
+    drop-in-Redis claim certified from the reference's side of the wire."""
+    store_handle = start_store_thread()
+    host, port_s = store_handle.url.split("://", 1)[1].rsplit(":", 1)
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp_port = _free_port()
+    env = dict(
+        _ref_env(),
+        PYTHONPATH=SHIM_DIR,  # `import redis` -> the shim, nothing else
+        REDIS_SHIM_HOST=host,
+        REDIS_SHIM_PORT=port_s,
+    )
+    dispatcher = _GroupPopen(
+        [
+            sys.executable,
+            os.path.join(REFERENCE_DIR, "task_dispatcher.py"),
+            "-m", "push", "-p", str(disp_port),
+        ],
+        env=env,
+        cwd=REFERENCE_DIR,
+        start_new_session=True,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    worker = _spawn_reference_worker(
+        "push_worker", 2, f"tcp://127.0.0.1:{disp_port}"
+    )
+    try:
+        # let the dispatcher subscribe to the tasks channel and the worker
+        # register before the first announce (the reference has no rescan
+        # for tasks announced pre-subscribe)
+        for _ in range(8):
+            if dispatcher.poll() is not None:
+                pytest.fail(
+                    "reference dispatcher exited at startup:\n"
+                    + _stop_proc(dispatcher)
+                )
+            time.sleep(0.25)
+        service_test(FaaSClient(gw.url), n_tasks=10, timeout=120.0)
+        assert dispatcher.poll() is None, (
+            "reference dispatcher died mid-test:\n" + _stop_proc(dispatcher)
+        )
+        assert worker.poll() is None, (
+            "reference worker died mid-test:\n" + _stop_proc(worker)
+        )
+    finally:
+        werr = _stop_proc(worker)
+        derr = _stop_proc(dispatcher)
+        gw.stop()
+        store_handle.stop()
+    for name, err in (("dispatcher", derr), ("worker", werr)):
+        if err.strip():
+            print(f"reference {name} stderr:", err[-2000:])
