@@ -672,3 +672,99 @@ def test_gateway_force_cancel_contract():
     finally:
         gw.stop()
         store_handle.stop()
+
+
+# -- stale kill notes vs resubmitted task ids (ADVICE r4 medium) ------------
+def test_stale_kill_note_invalidated_by_fresh_incarnation():
+    """A kill note that went unmatched (its task finished in the
+    publish->relay window) must not survive an idempotency-keyed resubmit
+    of the SAME task id: the fresh QUEUED incarnation's announce, consumed
+    at intake, invalidates the note — otherwise relay_kills would
+    interrupt the innocent fresh run for up to CANCEL_NOTE_TTL (900 s)."""
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.dispatch.pull import PullDispatcher
+
+    store = MemoryStore()
+    d = PullDispatcher(ip="127.0.0.1", port=0, store=store)
+    try:
+        fnp, pp = serialize(lambda: 1), serialize(((), {}))
+        # stale note first, then the resubmitted incarnation's create
+        # announce: intake must pop the note and still deliver the task
+        store.create_tasks([("reused-id", fnp, pp)])
+        d.note_kill("reused-id")
+        t = d.poll_next_task()
+        assert t is not None and t.task_id == "reused-id"
+        assert "reused-id" not in d.kill_requested
+
+        # a LIVE note (task already RUNNING — its announce was consumed
+        # long ago; only a duplicate/stale announce can arrive now) is
+        # kept: the non-QUEUED skip never reaches the invalidation
+        store.create_tasks([("running-id", fnp, pp)])
+        store.set_status("running-id", TaskStatus.RUNNING)
+        d.note_kill("running-id")
+        assert d.poll_next_task() is None  # duplicate announce skipped
+        assert "running-id" in d.kill_requested
+    finally:
+        d.socket.close(linger=0)
+
+
+def test_cancel_mid_create_claim_is_409_not_404():
+    """ADVICE r4: a claim-only record (idempotent submit mid-create: claim
+    field written, status not yet) is NOT an unknown id — its task_id was
+    just returned to the submitter. The gateway answers 409 'not yet
+    cancellable' (mapped to False by the SDK), reserving 404 for ids that
+    genuinely don't exist."""
+    store_handle = start_store_thread()
+    raw = make_store(store_handle.url)
+    gw = start_gateway_thread(make_store(store_handle.url))
+    client = FaaSClient(gw.url)
+    try:
+        # what submit's claim write leaves mid-create: the claim field
+        # alone, no status (gateway app.py _IDEM_CLAIM_FIELD)
+        raw.hset("mid-create", {"idem_claim": "somehash"})
+        r = client.http.post(f"{gw.url}/cancel/mid-create")
+        assert r.status_code == 409
+        assert client.cancel("mid-create") is False  # no HTTPError
+        r = client.http.post(f"{gw.url}/cancel/never-existed")
+        assert r.status_code == 404
+    finally:
+        gw.stop()
+        store_handle.stop()
+
+
+def test_misfire_counter_surfaces_in_dispatcher_stats():
+    """ADVICE r4: misfire repairs (the one at-least-once execution) ride
+    RESULT messages as a cumulative per-worker counter and surface in
+    /stats — operators detect doubled side effects without log scraping."""
+    from tpu_faas.dispatch.push import PushDispatcher
+
+    d = PushDispatcher(
+        ip="127.0.0.1", port=0, store=MemoryStore(), heartbeat=True
+    )
+    try:
+        d._handle(b"w1", "register", {"num_processes": 1})
+        assert d.stats()["worker_misfires"] == 0
+        d._handle(
+            b"w1",
+            "result",
+            {"task_id": "t", "status": "COMPLETED", "result": "x",
+             "misfires": 2},
+        )
+        assert d.stats()["worker_misfires"] == 2
+        # cumulative, not additive: the worker re-reports its total
+        d._handle(
+            b"w1",
+            "result",
+            {"task_id": "t2", "status": "COMPLETED", "result": "x",
+             "misfires": 2},
+        )
+        assert d.stats()["worker_misfires"] == 2
+        # reference-era workers carry no field: unchanged
+        d._handle(
+            b"w1",
+            "result",
+            {"task_id": "t3", "status": "COMPLETED", "result": "x"},
+        )
+        assert d.stats()["worker_misfires"] == 2
+    finally:
+        d.socket.close(linger=0)

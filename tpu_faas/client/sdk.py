@@ -28,10 +28,14 @@ class TaskFailedError(Exception):
 
 
 class TaskCancelledError(Exception):
-    """Raised by result() when the task was cancelled before it ran."""
+    """Raised by result() when the task's terminal status is CANCELLED —
+    either cancelled while still QUEUED (never ran, no side effects) or
+    force-cancelled mid-run (interrupted; side effects may have PARTIALLY
+    executed). The terminal record doesn't distinguish the two; callers
+    that care about side effects must not assume the task never started."""
 
     def __init__(self, task_id: str) -> None:
-        super().__init__(f"task {task_id} was CANCELLED before it ran")
+        super().__init__(f"task {task_id} was cancelled before completing")
         self.task_id = task_id
 
 

@@ -226,6 +226,13 @@ class TaskDispatcher:
         self.kill_requested: dict[str, float] = {}
         self._last_kill_relay = 0.0
         self.n_cancelled_dropped = 0
+        #: per-sender cumulative misfire-repair counters, as reported on
+        #: RESULT messages (worker/pool.py n_misfires): a misfired cancel
+        #: interrupt re-executes a bystander task whose side effects may
+        #: have partially run — the one at-least-once execution in the
+        #: system — so the count must be operator-visible in /stats, not
+        #: buried in a worker-side log line
+        self.worker_misfires: dict[object, int] = {}
 
     #: cancel notes older than this are discarded by the cap sweep below
     #: (correctness never rides on a note — drop sites verify against the
@@ -409,6 +416,24 @@ class TaskDispatcher:
                 # pruned by note_cancelled's cap sweep
                 self.log.debug("announce for non-QUEUED task %s; skipping", msg)
                 continue
+            if msg in self.kill_requested:
+                # a fresh QUEUED incarnation of this id is entering OUR
+                # pending set: any kill note still held must target a
+                # PREVIOUS incarnation (the task finished or was cancelled
+                # in the publish->relay window, then an idempotency-keyed
+                # resubmit reused the same deterministic id). Keeping the
+                # note would let relay_kills/_kills_for interrupt the
+                # innocent fresh run once it dispatches — for up to
+                # CANCEL_NOTE_TTL. Popping here is safe for legitimate
+                # kills: they target tasks ALREADY RUNNING, whose announces
+                # never reach this return (non-QUEUED skip above); only the
+                # narrow duplicate-QUEUED-announce x concurrent-cancel race
+                # can eat a live note, degrading force-cancel to its
+                # documented best effort.
+                self.kill_requested.pop(msg, None)
+                self.log.info(
+                    "dropped stale kill note for resubmitted task %s", msg
+                )
             return PendingTask.from_fields(msg, fields)
 
     def poll_tasks(self, max_n: int) -> list[PendingTask]:
@@ -697,7 +722,16 @@ class TaskDispatcher:
             "deferred_results": len(self.deferred_results),
             "announce_backlog": len(self._announce_backlog),
             "cancelled_dropped": self.n_cancelled_dropped,
+            "worker_misfires": sum(self.worker_misfires.values()),
         }
+
+    def note_worker_misfires(self, sender: object, data: dict) -> None:
+        """Track the cumulative ``misfires`` counter a RESULT message
+        carries (absent from reference-era workers). Keyed per sender
+        because each worker reports its own monotonic total."""
+        count = data.get("misfires")
+        if isinstance(count, int) and count > 0:
+            self.worker_misfires[sender] = count
 
     def reclaim_or_fail(
         self, task_id: str, prior_retries: int, max_retries: int

@@ -62,6 +62,7 @@ class LocalDispatcher(TaskDispatcher):
         completed = 0
         last_renew = time.monotonic()
         pool = TaskPool(self.num_workers)
+        misfire_base = self.worker_misfires.get("local-pool", 0)
         try:
             while not self.stopping:
                 progressed = False
@@ -78,6 +79,7 @@ class LocalDispatcher(TaskDispatcher):
                         break
                     if task is None:
                         break
+                    suspect = False
                     try:
                         if self.drop_if_cancelled(task.task_id):
                             continue
@@ -91,7 +93,16 @@ class LocalDispatcher(TaskDispatcher):
                         # resurrect it
                         self.note_store_outage(exc, pause=0)
                         self._suspect.add(task.task_id)
-                    self.mark_running_safe(task.task_id)
+                        suspect = True
+                    if not suspect:
+                        # a suspect task gets NO RUNNING mark: the store may
+                        # recover between the failed verification read and
+                        # this write, and set_status would then un-freeze a
+                        # terminal CANCELLED record (or recreate a DELETEd
+                        # hash) — defeating the very demotion above. The
+                        # deferred-capable first_wins result write is the
+                        # only store touch a suspect earns.
+                        self.mark_running_safe(task.task_id)
                     pool.submit(
                         task.task_id,
                         task.fn_payload,
@@ -108,7 +119,16 @@ class LocalDispatcher(TaskDispatcher):
                     lambda _addr, tid: pool.cancel(tid),
                 )
                 # drain completions (CANCELLED included — force cancels
-                # surface through the ordinary result path)
+                # surface through the ordinary result path); the pool's
+                # misfire-repair counter rides the shared stats surface
+                # (wire modes report it via RESULT `misfires`). Baseline
+                # offset: each start() builds a fresh pool whose counter
+                # restarts at 0, and the operator-facing total must not
+                # go backward across invocations.
+                if pool.n_misfires:
+                    self.worker_misfires["local-pool"] = (
+                        misfire_base + pool.n_misfires
+                    )
                 for res in pool.drain():
                     self._running.discard(res.task_id)
                     suspect = res.task_id in self._suspect
@@ -130,7 +150,12 @@ class LocalDispatcher(TaskDispatcher):
                     # shared mode the renewal also rides as the liveness
                     # heartbeat, so it runs even while idle.
                     try:
-                        self.renew_leases(self._running)
+                        # suspects excluded: their record may be CANCELLED
+                        # or DELETEd (unverified mid-outage admission), and
+                        # a blind lease write would recreate a deleted hash
+                        # as a permanent partial ghost — same rationale as
+                        # their skipped RUNNING mark above
+                        self.renew_leases(self._running - self._suspect)
                     except STORE_OUTAGE_ERRORS as exc:
                         self.note_store_outage(exc, pause=0)
                     last_renew = time.monotonic()

@@ -230,6 +230,7 @@ class PullDispatcher(TaskDispatcher):
                     self.log.info("pull worker registered: %s", data)
                 elif msg_type == m.RESULT:
                     task_id = data["task_id"]
+                    self.note_worker_misfires(wid, data)
                     owner_entry = self.inflight.get(task_id)
                     owner = owner_entry[0] if owner_entry else None
                     # a second result is possible when the task was ever

@@ -215,6 +215,7 @@ class PushDispatcher(TaskDispatcher):
             return
         if msg_type == m.RESULT:
             task_id = data["task_id"]
+            self.note_worker_misfires(wid, data)
             # suspicious = a second result is possible: the sender doesn't
             # hold the task (zombie whose task was reclaimed), or the task
             # was reclaimed at least once before reaching this worker

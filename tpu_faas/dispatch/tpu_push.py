@@ -522,6 +522,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 return
         if msg_type == m.RESULT:
             task_id = data["task_id"]
+            self.note_worker_misfires(wid, data)
             owner = a.inflight_owner(task_id)
             from_owner = (
                 owner is not None

@@ -879,6 +879,21 @@ async def cancel_task(request: web.Request) -> web.Response:
         force = raw_force
     status = await _run_blocking(ctx.store.cancel_task, task_id, ctx.channel)
     if status is None:
+        # no status field: either a genuinely unknown id, or a record
+        # MID-CREATE (idempotency path: claim field written, payloads and
+        # status still in flight). The latter's id was just handed to its
+        # submitter, so a 404 would be a lie — answer 409 "not yet
+        # cancellable" (the SDK maps 409 to False, not an HTTPError) and
+        # let the client retry once the create lands.
+        claim = await _run_blocking(
+            ctx.store.hget, task_id, _IDEM_CLAIM_FIELD
+        )
+        if claim is not None:
+            return _json_error(
+                409,
+                f"task {task_id!r} is still being created and not yet "
+                "cancellable; retry",
+            )
         return _json_error(404, f"unknown task_id {task_id!r}")
     kill_requested = False
     if force and status in (

@@ -151,6 +151,12 @@ class TaskPool:
         #: no longer .cancel()-able, child not started): the interrupt is
         #: deferred until the task's start event arrives
         self._deferred_kill: set[str] = set()
+        #: cumulative misfire repairs (a cancel interrupt that landed on a
+        #: bystander task, repaired by resubmission — the one at-least-once
+        #: execution in the system). Surfaced by the workers on their
+        #: RESULT messages and aggregated into dispatcher /stats, so
+        #: doubled side effects are operator-visible without log scraping.
+        self.n_misfires = 0
         self._executor = self._make()
 
     def _make(self) -> ProcessPoolExecutor:
@@ -318,6 +324,7 @@ class TaskPool:
                         "misfired cancel interrupt hit task %s; "
                         "resubmitting it", task_id,
                     )
+                    self.n_misfires += 1
                     self.submit(task_id, *args)
                     continue
                 out.append(res)

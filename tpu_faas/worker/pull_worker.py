@@ -105,6 +105,7 @@ class PullWorker:
                         status=res.status,
                         result=res.result,
                         elapsed=res.elapsed,
+                        misfires=self.pool.n_misfires,
                         no_task=self._draining,
                     )
                     shipped += 1

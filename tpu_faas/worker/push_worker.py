@@ -145,6 +145,7 @@ class PushWorker:
                             status=res.status,
                             result=res.result,
                             elapsed=res.elapsed,
+                            misfires=self.pool.n_misfires,
                         )
                     )
                     shipped += 1
