@@ -470,6 +470,52 @@ def config_8_estimation() -> dict:
     ms_blind = place(np.ones(n_tasks), np.ones(n_workers))
     ms_hinted = place(true_sizes, true_speeds)
     ms_learned = place(learned_sizes, learned_speeds)
+
+    # -- mixed-param leg (round 5): ONE function whose runtime varies 64x
+    # by parameter (the reference corpus shape — sleep_n/arithmetic(n),
+    # client_performance.py:19-92). The fn-level EWMA collapses every
+    # variant to the historical mean; the exact-param level recovers the
+    # per-variant runtime, and the makespans quantify the difference.
+    est_p = RuntimeEstimator()
+    d_mixed = fn_digest("mixed-fn")
+    variant_sizes = [0.125, 1.0, 8.0]
+    pdig = [fn_digest(f"variant{i}") for i in range(len(variant_sizes))]
+    for _ in range(n_obs // 2):
+        v = int(rng.integers(len(variant_sizes)))
+        w = int(rng.integers(n_workers))
+        est_p.observe(
+            d_mixed,
+            float(variant_sizes[v] / true_speeds[w] * rng.uniform(0.97, 1.03)),
+            wids[w],
+            pdig[v],
+            64,
+        )
+    task_v = rng.integers(0, len(variant_sizes), n_tasks)
+    true_sizes_p = np.array(
+        [variant_sizes[int(v)] for v in task_v], np.float32
+    )
+    param_aware = np.array(
+        [est_p.size_for(d_mixed, pdig[int(v)], 64) for v in task_v],
+        np.float32,
+    )
+    fn_collapsed = np.array(
+        [est_p.size_for(d_mixed) for _ in task_v], np.float32
+    )
+    speeds_p = np.array([est_p.speed_for(w) for w in wids], np.float32)
+
+    def place_p(sizes):
+        a = np.asarray(
+            rank_match_placement(
+                np.asarray(sizes, dtype=np.float32), valid, speeds_p,
+                np.full(n_workers, max_slots, np.int32), live,
+                max_slots=max_slots,
+            )
+        )
+        return makespan(a, true_sizes_p, true_speeds, max_slots=max_slots)
+
+    ms_param_aware = place_p(param_aware)
+    ms_fn_collapsed = place_p(fn_collapsed)
+
     return {
         "config": "estimation-unhinted-vs-hinted-vs-learned",
         "n_workers": n_workers,
@@ -480,6 +526,11 @@ def config_8_estimation() -> dict:
         "makespan_learned": round(ms_learned, 3),
         "learned_vs_unhinted": round(ms_blind / ms_learned, 2),
         "learned_vs_hinted": round(ms_learned / ms_hinted, 3),
+        "mixed_param_makespan_param_aware": round(ms_param_aware, 3),
+        "mixed_param_makespan_fn_collapsed": round(ms_fn_collapsed, 3),
+        "param_aware_vs_fn_collapsed": round(
+            ms_fn_collapsed / ms_param_aware, 2
+        ),
     }
 
 

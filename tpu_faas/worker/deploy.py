@@ -31,6 +31,7 @@ import argparse
 import math
 import os
 import signal
+import socket as _socket
 import subprocess
 import sys
 import time
@@ -77,12 +78,22 @@ class WorkerFleet:
         #: slot -> drain deadline (scale_down escalation bookkeeping)
         self._draining: dict[int, float] = {}
 
-    def _command(self) -> list[str]:
+    def _command(self, slot: int) -> list[str]:
         mod = f"tpu_faas.worker.{self.protocol}_worker"
         cmd = [sys.executable, "-m", mod, str(self.num_processes), self.dispatcher_url]
         if self.protocol == "push":
             if self.heartbeat:
                 cmd += ["--hb", "--hb-period", str(self.hb_period)]
+            # host-stable identity: a respawned worker — whether the crash
+            # was the worker's OR the whole supervisor's — re-registers
+            # under the SAME token, so the estimator's learned speed for
+            # this machine slot survives (sched/estimator.py worker
+            # grades) instead of relearning from the 1.0 prior
+            cmd += [
+                "--token",
+                f"{_socket.gethostname()}-{self.protocol}"
+                f"{self.num_processes}-slot{slot}",
+            ]
         else:
             cmd += ["--delay", str(self.delay)]
         return cmd
@@ -92,9 +103,9 @@ class WorkerFleet:
         # processes can all be reaped with one killpg if it crashes (a bare
         # SIGKILL on the leader orphans them to pid 1, where they pile up)
         p = subprocess.Popen(
-            self._command(), cwd=os.getcwd(), start_new_session=True
+            self._command(slot), cwd=os.getcwd(), start_new_session=True
         )
-        log.info("worker[%d] pid %d: %s", slot, p.pid, " ".join(self._command()))
+        log.info("worker[%d] pid %d: %s", slot, p.pid, " ".join(self._command(slot)))
         self.procs[slot] = p
         return p
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import time
+import uuid
 
 import zmq
 
@@ -39,8 +40,15 @@ class PushWorker:
         heartbeat: bool = False,
         heartbeat_period: float = 1.0,
         poll_timeout_ms: int = 10,
+        token: str | None = None,
     ) -> None:
         self.num_processes = num_processes
+        #: stable identity for the estimator's speed grades: carried on
+        #: REGISTER and RECONNECT so the grade survives socket churn and
+        #: dispatcher restarts; a supervisor (worker/deploy.py) passes a
+        #: slot-stable token so even a crash-respawned worker keeps the
+        #: machine's grade
+        self.token = token or uuid.uuid4().hex
         self.heartbeat = heartbeat
         self.heartbeat_period = heartbeat_period
         self.poll_timeout_ms = poll_timeout_ms
@@ -65,7 +73,13 @@ class PushWorker:
         self._draining = True
 
     def register(self) -> None:
-        self.socket.send(m.encode(m.REGISTER, num_processes=self.num_processes))
+        self.socket.send(
+            m.encode(
+                m.REGISTER,
+                num_processes=self.num_processes,
+                token=self.token,
+            )
+        )
 
     def run(self, max_tasks: int | None = None) -> int:
         shipped = 0
@@ -135,6 +149,7 @@ class PushWorker:
                                     free_processes=(
                                         0 if self._draining else self.pool.free
                                     ),
+                                    token=self.token,
                                 )
                             )
                 for res in self.pool.drain():
@@ -176,6 +191,12 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument(
         "--hb-period", type=float, default=1.0, help="heartbeat period (s)"
     )
+    ap.add_argument(
+        "--token",
+        default=None,
+        help="stable worker identity for persisted speed grades "
+        "(default: minted per process)",
+    )
     ns = ap.parse_args(argv)
     log.info(
         "push worker: %d processes -> %s (hb=%s)",
@@ -185,7 +206,10 @@ def main(argv: list[str] | None = None) -> None:
     )
     from tpu_faas.worker.drain import install_drain_signals
 
-    worker = PushWorker(ns.num_processes, ns.dispatcher_url, ns.hb, ns.hb_period)
+    worker = PushWorker(
+        ns.num_processes, ns.dispatcher_url, ns.hb, ns.hb_period,
+        token=ns.token,
+    )
     install_drain_signals(worker)
     worker.run()
 
