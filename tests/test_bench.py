@@ -107,7 +107,7 @@ def test_bench_run_emits_parseable_json_line_on_failure(monkeypatch, capsys):
     assert bench.run() == 1
     line = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(line)
-    assert rec["metric"] == "scheduler_tick_latency_50k_tasks_x_4k_workers"
+    assert rec["metric"] == "placement_quality_makespan_vs_lp_50k_x_4k"
     assert rec["value"] is None
     assert "UNAVAILABLE" in rec["error"]
 

@@ -274,6 +274,35 @@ def test_multihost_resident_dispatcher_serves_and_stops():
         time.sleep(1.0)  # let cancelled placements resolve + drop
         assert [h.status() for h in victims] == ["CANCELLED"] * 2
 
+        # -- FORCE cancel on the UNIFIED path (round-5, VERDICT r4 next
+        # #6): a task RUNNING on a worker placed by the 2-process resident
+        # mesh is interrupted mid-run — the kill note rides the lead's
+        # serve loop (drain_control_messages + _relay_kills between delta
+        # ticks), the worker's pool interrupt frees the slot in place, and
+        # the record converges to terminal CANCELLED in seconds, not the
+        # task's natural 30
+        from tpu_faas.client import TaskCancelledError
+
+        fid4 = client.register(sleep_task, name="long-victim")
+        long_h = client.submit(fid4, 30.0)
+        deadline = time.time() + 60
+        while long_h.status() != "RUNNING" and time.time() < deadline:
+            time.sleep(0.1)
+        assert long_h.status() == "RUNNING"
+        t0 = time.time()
+        assert long_h.cancel(force=True) is False  # async: not yet terminal
+        try:
+            long_h.result(timeout=30.0)
+            raise AssertionError("force-cancelled task returned a result")
+        except TaskCancelledError:
+            pass
+        assert time.time() - t0 < 25.0  # interrupted, not waited out
+        assert long_h.status() == "CANCELLED"
+        # the interrupted slot is free again on the resident mesh: a
+        # follow-up task completes promptly
+        follow = client.submit(fid4, 0.2)
+        assert follow.result(timeout=60.0) == 0.2
+
         # shutdown contract: SIGTERM the lead right after activity (the
         # timing that once collided a mismatched stop broadcast); the
         # resident stop packet must release the follower cleanly
@@ -283,6 +312,7 @@ def test_multihost_resident_dispatcher_serves_and_stops():
         assert "purged worker row" in lead_out, lead_out[-2000:]
         assert "reclaimed" in lead_out, lead_out[-2000:]
         assert "dropped cancelled task" in lead_out, lead_out[-2000:]
+        assert "relayed force-cancel" in lead_out, lead_out[-2000:]
         assert "stop broadcast sent" in lead_out, lead_out[-2000:]
         follower_out, _ = follower.communicate(timeout=60)
         assert follower.returncode == 0, follower_out[-2000:]

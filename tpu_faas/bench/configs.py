@@ -136,9 +136,29 @@ def config_3_auction_1k_10k() -> dict:
     aw = np.asarray(out_w.assignment)[:n_tasks]
     r = np.asarray(run_rank(problems[0]))[:n_tasks]
     # depth >=10: at ~10 ms/exec the tunnel's per-round-trip jitter swamps
-    # a shallow pipeline, making the slope estimate noisy by >10x
-    auction_ms = _pipeline_slope_ms(run_auction, problems, 2, 10)
-    auction_warm_ms = _pipeline_slope_ms(run_auction_warm, problems, 2, 10)
+    # a shallow pipeline, making the slope estimate noisy by >10x. Cold
+    # and warm are each the MEDIAN of 3 independent slope estimates: the
+    # r4 capture read warm (12.3 ms) above cold (11.4 ms) purely on
+    # single-estimate jitter — the deterministic round counts below are
+    # the ground truth the medians must agree with
+    def _median_of_valid(reps: list[float]):
+        """Non-positive slopes are physically impossible (anti-correlated
+        tunnel jitter across depths) and are EXCLUDED, not clamped — a
+        clamped 0.0 median would fabricate a perfect number (the r2
+        artifact's clamped \"0.0\" quantified nothing). None when no rep
+        survives."""
+        valid = [r for r in reps if r > 0.0]
+        return (float(np.median(valid)) if valid else None), reps
+
+    auction_ms, cold_reps = _median_of_valid(
+        [_pipeline_slope_ms(run_auction, problems, 2, 10) for _ in range(3)]
+    )
+    auction_warm_ms, warm_reps = _median_of_valid(
+        [
+            _pipeline_slope_ms(run_auction_warm, problems, 2, 10)
+            for _ in range(3)
+        ]
+    )
     # the rank kernel is ~0.1 ms: a DEEP pipeline (hundreds of execs) so
     # the signal clears tunnel jitter, and a median over 5 independent
     # slope estimates for real resolution (the r2 artifact's clamped
@@ -171,14 +191,66 @@ def config_3_auction_1k_10k() -> dict:
     out_h = run_auction(hetero[0])  # same trace as the uniform leg
     ah = np.asarray(out_h.assignment)[:n_tasks]
     hetero_ms = _pipeline_slope_ms(run_auction, hetero, 2, 10)
+
+    # Warm HETERO leg: THIS is where the price carry earns its keep. At
+    # the uniform shape the analytic rank-dual cold seed is already
+    # near-equilibrium (9 cold rounds), so warm has nothing to win there
+    # (r4's warm>cold capture was jitter on a no-op); heterogeneous
+    # lognormal costs are the regime where cold runs to the 64-round
+    # budget with rank spill — carrying the previous instance's
+    # equilibrium must beat that.
+    def run_hetero_warm(p, _price=out_h.prices):
+        return auction_placement(
+            p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+            p.worker_live, max_slots=max_slots, eps=1e-3,
+            init_price=_price,
+        )
+
+    out_hw = run_hetero_warm(hetero[1])  # compile warm-hetero trace
+    ahw = np.asarray(out_hw.assignment)[:n_tasks]
+    hetero_warm_ms = _pipeline_slope_ms(run_hetero_warm, hetero, 2, 10)
+
+    # Quality pin for the heterogeneous leg (round-5, VERDICT r4 #5): the
+    # auction is the one solver for non-separable costs, so its spilled
+    # assignment must carry a makespan number exactly as config 4 pins
+    # sinkhorn's — makespan on the placed subset vs the LP lower bound on
+    # that same subset.
+    from tpu_faas.sched.greedy import makespan
+    from tpu_faas.sched.oracle import makespan_lower_bound
+
+    def hetero_quality(assign):
+        placed = assign >= 0
+        ms = makespan(assign, base_h, speeds_h, max_slots)
+        lb = makespan_lower_bound(
+            base_h[placed], speeds_h, free, live, max_slots
+        )
+        return ms / lb
+
+    hetero_makespan_vs_lp = hetero_quality(ah)
+    hetero_warm_makespan_vs_lp = hetero_quality(ahw)
+
     cap = int(free.sum())
     sizes0 = np.full(n_tasks, 1.0, dtype=np.float32)
     return {
         "config": "auction-1k-workers-10k-tasks",
-        "auction_cold_ms": round(auction_ms, 3),
+        "auction_cold_ms": (
+            None if auction_ms is None else round(auction_ms, 3)
+        ),
+        "auction_cold_reps_ms": [round(x, 3) for x in cold_reps],
         "auction_cold_rounds": int(out.n_rounds),
-        "auction_warm_ms": round(auction_warm_ms, 3),
+        "auction_warm_ms": (
+            None if auction_warm_ms is None else round(auction_warm_ms, 3)
+        ),
+        "auction_warm_reps_ms": [round(x, 3) for x in warm_reps],
         "auction_warm_rounds": warm_rounds,
+        "warm_rounds_le_cold": bool(warm_rounds <= int(out.n_rounds)),
+        "auction_hetero_makespan_vs_lp": round(hetero_makespan_vs_lp, 4),
+        "auction_hetero_warm_ms": round(hetero_warm_ms, 3),
+        "auction_hetero_warm_rounds": int(out_hw.n_rounds),
+        "auction_hetero_warm_makespan_vs_lp": round(
+            hetero_warm_makespan_vs_lp, 4
+        ),
+        "placed_auction_hetero_warm": int((ahw >= 0).sum()),
         "rank_match_ms": round(rank_ms, 4),
         "rank_match_reps_ms": [round(x, 4) for x in rank_reps],
         "auction_hetero_ms": round(hetero_ms, 3),
