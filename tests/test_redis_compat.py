@@ -20,7 +20,6 @@ back the claim:
 
 from __future__ import annotations
 
-import shutil
 import socket
 import subprocess
 import time
@@ -30,7 +29,13 @@ import pytest
 from tpu_faas.store import resp
 from tpu_faas.store.launch import make_store
 
-REDIS = shutil.which("redis-server")
+#: a real redis-server binary: $PATH first, then the checksum-pinned local
+#: build (native/build_redis.sh) — environments without egress can drop
+#: the pinned tarball and build once to flip the "real" leg from skip to
+#: run. Shared discovery with bench.py's redis_interop field.
+from tpu_faas.store.launch import find_redis_server
+
+REDIS = find_redis_server()
 
 
 def _real_redis_server():
@@ -269,6 +274,11 @@ def test_real_redis_interop_leg_visibility():
     without saying so."""
     if REDIS is None:
         pytest.skip(
-            "redis-server not installed: real-server interop leg NOT run "
-            "(contract verified against reply-shape fixture + wire pins)"
+            "redis-server not installed and native/redis-server not built "
+            "(no egress to fetch the pinned tarball — run "
+            "native/build_redis.sh where egress or a tarball drop exists): "
+            "real-server interop leg NOT run (contract verified against "
+            "reply-shape fixture + wire pins; the reference's own "
+            "redis-client stack runs against OUR server in "
+            "tests/test_reference_worker_interop.py)"
         )

@@ -109,3 +109,25 @@ def start_store_thread(
     if not started.wait(timeout=10):
         raise RuntimeError("store server failed to start")
     return StoreServerHandle(server=server, thread=thread, loop=loop_holder["loop"])
+
+
+def find_redis_server() -> str | None:
+    """Locate a real redis-server binary for the drop-in-Redis interop
+    leg: $PATH first, then the checksum-pinned local build produced by
+    ``native/build_redis.sh`` (environments without egress drop the
+    pinned tarball and build once). One helper shared by
+    tests/test_redis_compat.py and bench.py's ``redis_interop`` artifact
+    field, so the two can never disagree about whether the real leg
+    runs."""
+    import os
+    import shutil
+
+    found = shutil.which("redis-server")
+    if found:
+        return found
+    local = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "native",
+        "redis-server",
+    )
+    return local if os.access(local, os.X_OK) else None
