@@ -27,6 +27,12 @@ WORK="${HERE}/.redis-build"
 TARBALL="${1:-${WORK}/redis-${VERSION}.tar.gz}"
 
 mkdir -p "${WORK}"
+if [[ $# -ge 1 && ! -f "${TARBALL}" ]]; then
+    # an explicitly-supplied path that doesn't exist is a typo, not a
+    # request to download next to it
+    echo "FATAL: tarball not found: ${TARBALL}" >&2
+    exit 1
+fi
 if [[ ! -f "${TARBALL}" ]]; then
     echo "fetching redis ${VERSION} (requires network egress)..."
     # download to a temp path and move only on success: an interrupted

@@ -393,3 +393,105 @@ def test_shared_siblings_adopt_each_others_grades():
     b.observe(d, 1.0, "tok-own", fn_digest("p"), 8)
     b.maybe_persist()
     assert b.speed_for("tok-x") == pytest.approx(graded)
+
+
+def test_quantized_speed_row_drifts_back_to_truth():
+    """VERDICT r4 weak #5: live speed updates into the device-cached row
+    are gated at 5% (dispatch/tpu_push.py) so tiny EWMA moves don't dirty
+    the cache every tick — but a row that starts WRONG must still converge.
+    Seed a persisted wrong grade, then feed correct observations: the
+    estimator drifts continuously and the quantized row follows in >5%
+    steps, ending within a gate-width of the estimator's value and far
+    from the wrong start."""
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.store.memory import MemoryStore
+
+    from tpu_faas.sched.estimator import WORKER_STATS_KEY
+
+    store = MemoryStore()
+    # a stale persisted grade: machine-X recorded SLOW (0.25) by an old
+    # session, but the hardware now runs 4x the fleet baseline
+    store.hset(WORKER_STATS_KEY, {"machine-X": "0.25"})
+    d = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=store, max_workers=8,
+        max_pending=32, max_inflight=64,
+    )
+    try:
+        d._handle(b"sx", "register", {"num_processes": 2,
+                                      "token": "machine-X"})
+        d._handle(b"sb", "register", {"num_processes": 2,
+                                      "token": "machine-base"})
+        row = d.arrays.worker_ids[b"sx"]
+        row_b = d.arrays.worker_ids[b"sb"]
+        assert float(d.arrays.worker_speed[row]) == pytest.approx(0.25)
+        fd = fn_digest("fn")
+        for i in range(160):
+            for sock, r, elapsed in ((b"sb", row_b, 1.0), (b"sx", row, 0.25)):
+                tid = f"q{i}-{elapsed}"
+                d._task_digest[tid] = (fd, fn_digest("p"), 8)
+                d._observe_result(sock, r, tid,
+                                  {"elapsed": elapsed,
+                                   "status": "COMPLETED"})
+        est_val = d.estimator.speed_for("machine-X")
+        row_val = float(d.arrays.worker_speed[row])
+        base_val = float(d.arrays.worker_speed[row_b])
+        # the grade climbed out of the wrong basin...
+        assert est_val / d.estimator.speed_for("machine-base") > 2.0
+        assert row_val / base_val > 2.0
+        # ...and the quantized row tracks the estimator within the 5% gate
+        assert abs(row_val - est_val) <= 0.05 * est_val + 1e-6
+    finally:
+        d.socket.close(linger=0)
+
+
+def test_dispatcher_learns_param_variants_end_to_end():
+    """Socket e2e for the param-aware axis: ONE function (sleep_task) run
+    with two parameterizations (~10x apart) through the real
+    gateway/dispatcher/worker stack — the estimator must hold separate
+    exact-param estimates under the single function digest, with the
+    ratio reflecting truth (the fn-level estimate collapses to one mean,
+    useless for mixed-param placement)."""
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.workloads import sleep_task
+    from tests.test_tpu_push_e2e import _make_dispatcher
+    from tests.test_workers_e2e import _spawn_worker
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url))
+    disp = _make_dispatcher(store_handle.url)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    worker = _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(sleep_task)
+        handles = []
+        for _ in range(5):
+            handles.append(client.submit(fid, 0.02))
+            handles.append(client.submit(fid, 0.2))
+        for h in handles:
+            h.result(timeout=60.0)
+        est = disp.estimator
+        # exactly one function learned, two exact-param variants under it
+        assert len(est._fn_est) == 1
+        (fn_d,) = est._fn_est
+        variants = sorted(
+            v for k, v in est._param_est.items()
+            if k.startswith(fn_d + ":")
+        )
+        assert len(variants) == 2, est._param_est
+        assert variants[1] / variants[0] > 3.0, variants
+        # the fn-level estimate sits between the two — the collapse the
+        # exact-param level exists to avoid
+        assert variants[0] < est._fn_est[fn_d] < variants[1]
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

@@ -122,12 +122,10 @@ def find_redis_server() -> str | None:
     import os
     import shutil
 
+    from tpu_faas.store.native import NATIVE_DIR
+
     found = shutil.which("redis-server")
     if found:
         return found
-    local = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-        "native",
-        "redis-server",
-    )
+    local = os.path.join(NATIVE_DIR, "redis-server")
     return local if os.access(local, os.X_OK) else None
