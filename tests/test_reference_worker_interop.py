@@ -38,6 +38,7 @@ import pytest
 
 from tests.test_chaos_e2e import _free_port
 from tests.test_tpu_push_e2e import _make_dispatcher
+from tpu_faas.core.serialize import serialize
 from tests.test_workers_e2e import _GroupPopen, _spawn_worker, service_test
 from tpu_faas.client import FaaSClient
 from tpu_faas.dispatch.pull import PullDispatcher
@@ -250,12 +251,12 @@ def test_reference_worker_ignores_cancel():
         assert h.status() == "COMPLETED"
 
 
-def test_reference_dispatcher_on_our_store():
+def _run_reference_stack(mode: str, worker_kind: str, *worker_extra: str):
     """The full reference stack on our storage: the reference's OWN
-    ``task_dispatcher.py -m push`` (redis-py client surface, hardcoded
+    ``task_dispatcher.py`` (redis-py client surface, hardcoded
     localhost:6379 — task_dispatcher.py:31-36) runs against our RESP store
     server via the redis shim's env override, with an unmodified reference
-    push worker executing. Our gateway+client submit and collect — the
+    worker executing. Our gateway+client submit and collect — the
     drop-in-Redis claim certified from the reference's side of the wire."""
     store_handle = start_store_thread()
     host, port_s = store_handle.url.split("://", 1)[1].rsplit(":", 1)
@@ -271,7 +272,7 @@ def test_reference_dispatcher_on_our_store():
         [
             sys.executable,
             os.path.join(REFERENCE_DIR, "task_dispatcher.py"),
-            "-m", "push", "-p", str(disp_port),
+            "-m", mode, "-p", str(disp_port),
         ],
         env=env,
         cwd=REFERENCE_DIR,
@@ -279,20 +280,62 @@ def test_reference_dispatcher_on_our_store():
         stdout=subprocess.DEVNULL,
         stderr=subprocess.PIPE,
     )
-    worker = _spawn_reference_worker(
-        "push_worker", 2, f"tcp://127.0.0.1:{disp_port}"
-    )
+    worker = None
     try:
-        # let the dispatcher subscribe to the tasks channel and the worker
-        # register before the first announce (the reference has no rescan
-        # for tasks announced pre-subscribe)
-        for _ in range(8):
+        # Readiness-probe the dispatcher BEFORE spawning the worker: the
+        # reference pull worker polls for each REP reply only ``delay``
+        # seconds after sending and, missing it, sends again — a REQ-state
+        # crash (pull_worker.py:112-123) that fires deterministically when
+        # it registers while the dispatcher is still importing. A probe
+        # REQ transaction (a 'ready' with no worker state, answered 'wait'
+        # while no tasks exist) proves the REP socket is serving. The push
+        # path needs no probe (DEALER sends don't require replies) but
+        # shares it harmlessly via a plain connect check.
+        import zmq as _zmq
+
+        ctx = _zmq.Context.instance()
+
+        def _make_probe():
+            p = ctx.socket(_zmq.REQ)
+            p.setsockopt(_zmq.LINGER, 0)
+            p.setsockopt(_zmq.RCVTIMEO, 500)
+            p.connect(f"tcp://127.0.0.1:{disp_port}")
+            return p
+
+        probe = _make_probe() if mode == "pull" else None
+        deadline = time.time() + 30
+        ready = False
+        waited = 0.0
+        while time.time() < deadline and not ready:
             if dispatcher.poll() is not None:
                 pytest.fail(
                     "reference dispatcher exited at startup:\n"
                     + _stop_proc(dispatcher)
                 )
-            time.sleep(0.25)
+            if mode == "pull":
+                try:
+                    probe.send(serialize({"type": "ready"}).encode("ascii"))
+                    probe.recv()
+                    ready = True
+                except _zmq.Again:
+                    # REQ wedged on the unanswered send: rebuild the probe
+                    probe.close(linger=0)
+                    probe = _make_probe()
+            else:
+                # push: DEALER sends don't need replies, so plain settling
+                # time suffices — but keep polling the process so a
+                # dispatcher dying mid-import still fails fast with its
+                # stderr instead of a generic service timeout
+                time.sleep(0.25)
+                waited += 0.25
+                ready = waited >= 2.0
+        if probe is not None:
+            probe.close(linger=0)
+        assert ready, "reference dispatcher never answered the REQ probe"
+        worker = _spawn_reference_worker(
+            worker_kind, 2, f"tcp://127.0.0.1:{disp_port}", *worker_extra
+        )
+        time.sleep(1.0)  # worker registration before the first announce
         service_test(FaaSClient(gw.url), n_tasks=10, timeout=120.0)
         assert dispatcher.poll() is None, (
             "reference dispatcher died mid-test:\n" + _stop_proc(dispatcher)
@@ -301,10 +344,31 @@ def test_reference_dispatcher_on_our_store():
             "reference worker died mid-test:\n" + _stop_proc(worker)
         )
     finally:
-        werr = _stop_proc(worker)
+        werr = _stop_proc(worker) if worker is not None else ""
         derr = _stop_proc(dispatcher)
         gw.stop()
         store_handle.stop()
-    for name, err in (("dispatcher", derr), ("worker", werr)):
-        if err.strip():
-            print(f"reference {name} stderr:", err[-2000:])
+        # inside the finally: a failing leg must still show the reference
+        # side's stderr (the one diagnostic this harness exists to capture)
+        for name, err in (("dispatcher", derr), ("worker", werr)):
+            if err.strip():
+                print(f"reference {name} stderr:", err[-2000:])
+
+
+def test_reference_dispatcher_on_our_store():
+    _run_reference_stack("push", "push_worker")
+
+
+def test_reference_pull_dispatcher_on_our_store():
+    """Same full-reference-stack certification over the pull protocol:
+    the reference's REP pull dispatcher (task_dispatcher.py:105-187) +
+    its REQ pull worker, storage swapped for ours.
+
+    ``--delay 0.05``: the reference worker polls for the REP reply only
+    ``delay`` seconds after each send and, missing it, SENDS again — a
+    REQ-state crash baked into pull_worker.py:112-123 that its own stack
+    dodges only because a local redis answers the dispatcher's pre-reply
+    store round trip in microseconds. The reference exposes the delay as
+    a CLI knob precisely for slower setups; 50 ms absorbs the shim's TCP
+    round trips without modifying the binary."""
+    _run_reference_stack("pull", "pull_worker", "--delay", "0.05")
