@@ -1,0 +1,104 @@
+"""Mixed-fleet migration demo: REFERENCE workers serving the tpu-faas stack.
+
+docs/MIGRATION.md step 2, runnable: our store + gateway + push dispatcher,
+with one of the reference's OWN push workers (unmodified, from a reference
+checkout) executing beside one of ours. The reference worker needs only
+dill + zmq; its missing protocol extensions (``elapsed``, ``token``)
+degrade gracefully, and work flows across both.
+
+Run:  python examples/migrate_from_reference.py [path-to-reference-checkout]
+      (default /root/reference; exits politely when no checkout exists)
+"""
+
+try:
+    import _bootstrap  # noqa: F401  (repo-root path shim, script mode)
+except ModuleNotFoundError:
+    pass
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.dispatch.push import PushDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+
+REFERENCE_DIR = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+
+
+def main() -> None:
+    if not os.path.isfile(os.path.join(REFERENCE_DIR, "push_worker.py")):
+        print(
+            f"no reference checkout at {REFERENCE_DIR} "
+            "(pass its path as argv[1]); nothing to demo"
+        )
+        return
+
+    store = start_store_thread()
+    gw = start_gateway_thread(make_store(store.url))
+    disp = PushDispatcher(
+        ip="127.0.0.1", port=0, store=make_store(store.url), heartbeat=True
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+
+    # plain-CPU worker env: strips sitecustomize dirs that import jax (and
+    # possibly touch an accelerator) into every spawned interpreter — a
+    # worker process needs none of that, and on dev boxes the import can
+    # stall the whole pool (see cpu_worker_env's docstring)
+    from tpu_faas.bench.harness import cpu_worker_env
+
+    env = cpu_worker_env()
+    reference_worker = subprocess.Popen(
+        [sys.executable, "push_worker.py", "2", url, "--hb"],
+        cwd=REFERENCE_DIR,
+        env=env,
+        start_new_session=True,
+    )
+    our_worker = subprocess.Popen(
+        [sys.executable, "-m", "tpu_faas.worker.push_worker", "2", url, "--hb"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+        start_new_session=True,
+    )
+    print(f"mixed fleet on {url}: reference worker + tpu-faas worker")
+
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(lambda x: x * x, name="square")
+        t0 = time.time()
+        handles = [client.submit(fid, i) for i in range(20)]
+        results = [h.result(timeout=60.0) for h in handles]
+        assert results == [i * i for i in range(20)]
+        print(
+            f"20 tasks completed across the mixed fleet "
+            f"in {time.time() - t0:.2f}s — results verified"
+        )
+        print(
+            "the reference worker never sent an `elapsed` or `token` "
+            "field; the dispatcher served it regardless"
+        )
+    finally:
+        for p in (reference_worker, our_worker):
+            if p.poll() is None:
+                # kill the GROUP: each worker owns multiprocessing pool
+                # children that a leader-only SIGKILL would orphan to
+                # pid 1 (the start_new_session above exists for this)
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    p.kill()
+                p.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -110,7 +110,12 @@ def _ref_worker_stack(mode: str, n_workers: int, n_procs: int, **disp_kw):
             ip="127.0.0.1", port=0, store=make_store(store_handle.url),
             **disp_kw,
         )
-        worker_kind, extra = "pull_worker", ("--delay", "0.005")
+        # --delay 0.05: the reference worker re-SENDS if a reply misses
+        # its delay-wide poll window (REQ crash, pull_worker.py:112-123);
+        # on a loaded box our sub-ms reply can land later than 5 ms, and a
+        # crashed ref pull worker's task is untracked by design (no
+        # worker_id on its messages) — lost exactly as in the reference
+        worker_kind, extra = "pull_worker", ("--delay", "0.05")
     elif mode == "tpu_push":
         disp = _make_dispatcher(store_handle.url, **disp_kw)
         worker_kind = "push_worker"
@@ -372,3 +377,30 @@ def test_reference_pull_dispatcher_on_our_store():
     a CLI knob precisely for slower setups; 50 ms absorbs the shim's TCP
     round trips without modifying the binary."""
     _run_reference_stack("pull", "pull_worker", "--delay", "0.05")
+
+
+def test_reference_worker_crash_recovery():
+    """Our recovery machinery covers REFERENCE workers too: SIGKILL a
+    reference push worker while it provably holds in-flight tasks — the
+    heartbeat purge reclaims them onto a surviving reference worker and
+    every submission still completes (the reference's own dispatcher
+    loses such tasks; its README documents it)."""
+    import signal
+
+    with _ref_worker_stack(
+        "push", n_workers=2, n_procs=2, heartbeat=True, time_to_expire=4.0
+    ) as (client, workers, _disp):
+        fid = client.register(sleep_task)
+        slow = [client.submit(fid, 2.5) for _ in range(6)]
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if sum(1 for h in slow if h.status() == "RUNNING") >= 4:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("tasks never saturated both ref workers")
+        workers[0].send_signal(signal.SIGKILL)
+        workers[0].wait()
+        assert [h.result(timeout=120.0) for h in slow] == [2.5] * 6
+        # teardown asserts workers alive; the killed one is expected dead
+        workers.pop(0)

@@ -234,6 +234,33 @@ class TaskDispatcher:
         #: buried in a worker-side log line
         self.worker_misfires: dict[object, int] = {}
 
+    #: max worker messages decoded per serve-loop round (push-family
+    #: ROUTER drains): a worker flooding messages faster than they
+    #: dill-decode — the reference worker's unthrottled-heartbeat bug
+    #: sends one per busy-loop iteration (push_worker.py:60-62) — must
+    #: not starve the purge/dispatch/tick steps; ZMQ buffers the excess
+    #: and the level-triggered poller re-fires immediately next round
+    _DRAIN_CAP = 2048
+
+    def drain_worker_messages(self, socket, handle) -> int:
+        """Bounded ROUTER drain shared by the push-family serve loops:
+        recv + decode up to ``_DRAIN_CAP`` worker messages, feeding each
+        to ``handle(wid, msg_type, data)``. Returns messages handled."""
+        import zmq
+
+        from tpu_faas.worker import messages as m
+
+        n = 0
+        for _ in range(self._DRAIN_CAP):
+            try:
+                wid, raw = socket.recv_multipart(flags=zmq.NOBLOCK)
+            except zmq.Again:
+                break
+            msg_type, data = m.decode(raw)
+            handle(wid, msg_type, data)
+            n += 1
+        return n
+
     #: cancel notes older than this are discarded by the cap sweep below
     #: (correctness never rides on a note — drop sites verify against the
     #: store — so the TTL only bounds memory, and only needs to fire when

@@ -395,16 +395,9 @@ class PushDispatcher(TaskDispatcher):
             while not self.stopping:
                 events = dict(self.poller.poll(self.poll_timeout_ms))
                 if self.socket in events:
-                    # drain every waiting worker message this round
-                    while True:
-                        try:
-                            wid, raw = self.socket.recv_multipart(
-                                flags=zmq.NOBLOCK
-                            )
-                        except zmq.Again:
-                            break
-                        msg_type, data = m.decode(raw)
-                        self._handle(wid, msg_type, data)
+                    # bounded drain (base.drain_worker_messages): a
+                    # flooding worker must not starve purge + dispatch
+                    self.drain_worker_messages(self.socket, self._handle)
                 # store ops degrade (and retry next round) during an outage
                 # instead of crashing the dispatcher
                 try:

@@ -1127,15 +1127,9 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.note_store_outage(exc)
                 events = dict(self.poller.poll(max(1, int(self.tick_period * 1000))))
                 if self.socket in events:
-                    while True:
-                        try:
-                            wid, raw = self.socket.recv_multipart(
-                                flags=zmq.NOBLOCK
-                            )
-                        except zmq.Again:
-                            break
-                        msg_type, data = m.decode(raw)
-                        self._handle(wid, msg_type, data)
+                    # bounded drain (base.drain_worker_messages): a
+                    # flooding worker must not starve the device tick
+                    self.drain_worker_messages(self.socket, self._handle)
                 now = self.clock()
                 if now - last_tick >= self.tick_period:
                     try:
