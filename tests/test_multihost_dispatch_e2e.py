@@ -253,19 +253,12 @@ def test_multihost_resident_dispatcher_serves_and_stops():
         # off the bus into its resident state): a cancel landing before
         # intake is honored by the announce skip, which never emits the
         # "dropped cancelled task" line asserted at shutdown
-        import json
-        import urllib.request
+        from tests.test_workers_e2e import poll_stats
 
         deadline = time.time() + 60
         while time.time() < deadline:
-            try:
-                with urllib.request.urlopen(
-                    f"http://127.0.0.1:{stats_port}/stats", timeout=2
-                ) as r:
-                    if json.loads(r.read()).get("pending", 0) >= 2:
-                        break
-            except OSError:
-                pass  # stats server still starting
+            if poll_stats(stats_port, timeout=5).get("pending", 0) >= 2:
+                break
             time.sleep(0.1)
         else:
             raise AssertionError("victims never reached the lead's state")

@@ -261,3 +261,21 @@ def test_bounded_drain_leaves_excess_for_next_round():
     finally:
         flooder.close(linger=0)
         d.socket.close(linger=0)
+
+
+def poll_stats(port: int, timeout: float = 30.0) -> dict:
+    """Poll a dispatcher's /stats endpoint until it answers (shared by the
+    chaos and multihost e2e suites — one copy of the retry loop)."""
+    import json
+    import urllib.request
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/stats", timeout=2
+            ) as r:
+                return json.loads(r.read())
+        except OSError:
+            time.sleep(0.2)
+    raise AssertionError(f"stats endpoint on port {port} never came up")
