@@ -1,0 +1,652 @@
+"""Per-rule fixtures for ``tpu_faas.analysis``: each checker both fires
+(exact rule id + line) and stays clean, plus suppression and baseline
+handling. Every snippet is written to a tmp dir and run through the real
+``run_paths`` entry point — the same code path the CLI and the tier-1 gate
+use."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tpu_faas.analysis import run_paths
+from tpu_faas.analysis.__main__ import main as analysis_main
+from tpu_faas.analysis.core import (
+    load_baseline,
+    subtract_baseline,
+    write_baseline,
+)
+
+
+def check(tmp_path: Path, src: str, name: str = "snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return run_paths([p])
+
+
+def hits(findings):
+    """(rule, line) pairs for exact assertions."""
+    return [(f.rule, f.line) for f in findings]
+
+
+# -- protocol ----------------------------------------------------------------
+
+
+def test_protocol_illegal_finish_status_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        def f(store, tid):
+            store.finish_task(tid, TaskStatus.QUEUED, "r")
+        """,
+    )
+    assert hits(findings) == [("protocol.illegal-finish-status", 4)]
+    assert findings[0].severity == "error"
+
+
+def test_protocol_unknown_status_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, tid):
+            store.set_status(tid, "DONE")
+        """,
+    )
+    assert hits(findings) == [("protocol.unknown-status", 2)]
+
+
+def test_protocol_terminal_set_status_fires_on_all_spellings(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        def f(store, tid):
+            store.set_status(tid, "COMPLETED")
+            store.set_status(tid, TaskStatus.FAILED)
+            store.set_status(tid, str(TaskStatus.CANCELLED))
+        """,
+    )
+    assert hits(findings) == [
+        ("protocol.terminal-set-status", 4),
+        ("protocol.terminal-set-status", 5),
+        ("protocol.terminal-set-status", 6),
+    ]
+
+
+def test_protocol_running_without_lease_warns(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        def f(store, tid):
+            store.set_status(tid, TaskStatus.RUNNING)
+        """,
+    )
+    assert hits(findings) == [("protocol.running-without-lease", 4)]
+    assert findings[0].severity == "warning"
+
+
+def test_protocol_raw_status_write_and_publish_fire(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import FIELD_STATUS
+        from tpu_faas.store.base import TASKS_CHANNEL
+
+        def f(store, tid):
+            store.hset(tid, {FIELD_STATUS: "RUNNING"})
+            store.hset(tid, {"result": "blob"})
+            store.publish(TASKS_CHANNEL, tid)
+            store.publish("results", tid)
+        """,
+    )
+    assert hits(findings) == [
+        ("protocol.raw-status-write", 5),
+        ("protocol.raw-status-write", 6),
+        ("protocol.raw-task-publish", 7),
+        ("protocol.raw-task-publish", 8),
+    ]
+
+
+def test_protocol_clean_fixture(tmp_path):
+    """The legal surface: conveniences with legal statuses, hset without
+    lifecycle fields, publish on a non-lifecycle channel, dynamic statuses
+    (out of static scope), and raw writes inside a store/ package path."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import FIELD_LEASE_AT, TaskStatus
+
+        def f(store, tid, status):
+            store.create_task(tid, "fn", "params")
+            store.set_status(tid, TaskStatus.RUNNING, {FIELD_LEASE_AT: "0"})
+            store.finish_task(tid, TaskStatus.COMPLETED, "r")
+            store.finish_task(tid, str(TaskStatus.FAILED), "r", first_wins=True)
+            store.cancel_task(tid)
+            store.hset(tid, {"dispatch_claim": "d1:0"})
+            store.hset("fleet:lease_conf", {"t:5": "now"})
+            store.publish("heartbeats", "hb")
+            store.finish_task(tid, status, "r")  # dynamic: not provable
+        """,
+    )
+    assert findings == []
+
+
+def test_protocol_store_package_is_exempt(tmp_path):
+    pkg = tmp_path / "tpu_faas" / "store"
+    pkg.mkdir(parents=True)
+    (pkg / "impl.py").write_text(
+        textwrap.dedent(
+            """\
+            def f(store, tid):
+                store.hset(tid, {"status": "QUEUED"})
+                store.publish("tasks", tid)
+            """
+        )
+    )
+    assert run_paths([pkg]) == []
+    # the exemption is decided on the ABSOLUTE path, so naming the file
+    # directly (different relpath anchor) must not change the verdict
+    assert run_paths([pkg / "impl.py"]) == []
+    # a random directory named "store" outside tpu_faas is NOT exempt
+    other = tmp_path / "store"
+    other.mkdir()
+    (other / "impl.py").write_text("def f(s, t):\n    s.publish('tasks', t)\n")
+    assert [f.rule for f in run_paths([other])] == ["protocol.raw-task-publish"]
+
+
+def test_protocol_store_file_named_directly_is_exempt():
+    """Regression: `python -m tpu_faas.analysis tpu_faas/store/base.py`
+    (a documented invocation) must stay clean — the store exemption cannot
+    depend on how the path was anchored."""
+    import tpu_faas.store.base as store_base
+
+    assert run_paths([Path(store_base.__file__)]) == []
+
+
+# -- trace-safety ------------------------------------------------------------
+
+
+def test_trace_hazards_fire_with_exact_lines(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import time, random
+        import jax
+        from functools import partial
+
+        _hits = {}
+
+        @partial(jax.jit, static_argnames=("n",))
+        def kern(x, n):
+            t = time.time()
+            r = random.random()
+            v = x.item()
+            f = float(x)
+            print("tracing")
+            _hits["k"] = 1
+            y = x + 1
+            if y > 0:
+                y = y * 2
+            return y + t + r + v + f
+        """,
+    )
+    assert hits(findings) == [
+        ("trace.host-time", 9),
+        ("trace.python-random", 10),
+        ("trace.host-sync", 11),
+        ("trace.host-sync", 12),
+        ("trace.print", 13),
+        ("trace.state-mutation", 14),
+        ("trace.data-dependent-branch", 16),
+    ]
+
+
+def test_trace_reaches_helpers_and_call_site_wraps(tmp_path):
+    """Hazards are found in undecorated helpers reachable from a jit site,
+    in jax.jit(...) call-site wraps, and in inline jitted lambdas."""
+    findings = check(
+        tmp_path,
+        """\
+        import time
+        import jax
+
+        def helper(z):
+            time.sleep(0.1)
+            return z
+
+        tick = jax.jit(lambda q: helper(q))
+        """,
+    )
+    assert hits(findings) == [("trace.host-time", 5)]
+
+
+def test_trace_nested_def_hazards_report_once_with_own_scope(tmp_path):
+    """A hazard inside a nested function reachable from a jit root is
+    reported exactly once, and writes through the NESTED function's own
+    params (pallas-style ref[...] = ...) are not mutation findings."""
+    findings = check(
+        tmp_path,
+        """\
+        import time
+        import jax
+
+        @jax.jit
+        def outer(x):
+            def scan_body(carry, t):
+                time.sleep(0.1)
+                return carry, t
+
+            def kernel(x_ref, o_ref):
+                o_ref[0] = x_ref[0]
+
+            kernel
+            return scan_body(x, x)
+        """,
+    )
+    assert hits(findings) == [("trace.host-time", 7)]
+
+
+def test_trace_same_named_functions_are_all_analyzed(tmp_path):
+    """A name collision (two classes with a same-named method, only the
+    second jitted) must not drop the jitted one from analysis."""
+    findings = check(
+        tmp_path,
+        """\
+        import time
+        import jax
+
+        class Plain:
+            def step(self, x):
+                return x
+
+        class Jitted:
+            @jax.jit
+            def step(self, x):
+                return x * time.time()
+        """,
+    )
+    assert hits(findings) == [("trace.host-time", 11)]
+
+
+def test_trace_static_argnums_indices_are_static(tmp_path):
+    """Regression: `static_argnums=(0,)` makes parameter 0 static — a
+    Python branch on it is legal, not a data-dependent-branch error."""
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(0,))
+        def f(n, x):
+            if n > 3:
+                x = x * 2
+            if x > 0:
+                x = x + 1
+            return x
+        """,
+    )
+    assert hits(findings) == [("trace.data-dependent-branch", 8)]
+
+
+def test_trace_jax_random_import_spellings_are_exempt(tmp_path):
+    """Regression: `from jax import random` (and aliases) is jax.random,
+    not stdlib random — the python-random rule must not fire on it."""
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        from jax import random
+        import jax.random as jrandom
+
+        @jax.jit
+        def f(x, key):
+            a = random.normal(key, x.shape)
+            b = jrandom.uniform(key, x.shape)
+            return x + a + b
+        """,
+    )
+    assert findings == []
+
+
+def test_trace_clean_fixture(tmp_path):
+    """Static-arg branches, `is None` probes, shape/len access, jax.random,
+    jax.debug.print, and host code OUTSIDE any jit are all legal."""
+    findings = check(
+        tmp_path,
+        """\
+        import time
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("mode", "n"))
+        def kern(x, key, mode, n, prio=None):
+            if mode == "greedy":
+                x = x * 2
+            if prio is None:
+                prio = jnp.zeros_like(x)
+            if x.shape[0] > 4 and len(x) > n:
+                x = x[:n]
+            noise = jax.random.uniform(key, x.shape)
+            jax.debug.print("step {}", n)
+            y = jnp.where(x > 0, x, 0.0)
+            return y + noise + prio
+
+        def host_loop(store):
+            while True:
+                time.sleep(0.5)
+                print(time.time())
+        """,
+    )
+    assert findings == []
+
+
+def test_trace_shard_map_and_pallas_call_are_roots(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import time
+        import jax
+        from jax.experimental import pallas as pl
+
+        def tick_kernel(x):
+            return x * time.time()
+
+        def body_kernel(ref):
+            ref[0] = time.perf_counter()
+
+        plan = jax.shard_map(tick_kernel, mesh=None, in_specs=None, out_specs=None)
+        out = pl.pallas_call(body_kernel, out_shape=None)
+        """,
+    )
+    assert hits(findings) == [
+        ("trace.host-time", 6),
+        ("trace.host-time", 9),
+    ]
+
+
+# -- locks -------------------------------------------------------------------
+
+
+def test_locks_blocking_call_under_lock_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import threading, time
+
+        _lock = threading.Lock()
+
+        def f(sock, store, tid):
+            with _lock:
+                time.sleep(1)
+                sock.recv()
+                store.hget(tid, "status")
+        """,
+    )
+    assert hits(findings) == [
+        ("locks.blocking-call-under-lock", 7),
+        ("locks.blocking-call-under-lock", 8),
+        ("locks.blocking-call-under-lock", 9),
+    ]
+    assert "store round trip" in findings[2].message
+    # messages are baseline identity: no line numbers allowed in them
+    # (baseline_key excludes `line` so entries survive line drift)
+    assert not any(any(ch.isdigit() for ch in f.message) for f in findings)
+
+
+def test_locks_clean_fixture(tmp_path):
+    """Pure-CPU critical sections, blocking calls outside the lock, and a
+    def under a lock (runs later, lock released) are all legal."""
+    findings = check(
+        tmp_path,
+        """\
+        import threading, time
+
+        _lock = threading.Lock()
+        _state = {}
+
+        def f(sock):
+            with _lock:
+                _state["n"] = _state.get("n", 0) + 1
+
+            time.sleep(1)
+            sock.recv()
+
+            with _lock:
+                def deferred():
+                    time.sleep(5)
+                return deferred
+        """,
+    )
+    assert findings == []
+
+
+def test_locks_order_inconsistency_across_modules(tmp_path):
+    (tmp_path / "one.py").write_text(
+        textwrap.dedent(
+            """\
+            def f(lock_a, lock_b):
+                with lock_a:
+                    with lock_b:
+                        pass
+            """
+        )
+    )
+    (tmp_path / "two.py").write_text(
+        textwrap.dedent(
+            """\
+            def g(lock_a, lock_b):
+                with lock_b:
+                    with lock_a:
+                        pass
+            """
+        )
+    )
+    findings = run_paths([tmp_path])
+    assert sorted(hits(findings)) == [
+        ("locks.lock-order-inconsistent", 3),
+        ("locks.lock-order-inconsistent", 3),
+    ]
+    assert {f.path.rsplit("/", 1)[-1] for f in findings} == {"one.py", "two.py"}
+    assert all("ABBA" in f.message for f in findings)
+
+
+def test_locks_consistent_order_is_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def f(lock_a, lock_b):
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def g(lock_a, lock_b):
+            with lock_a:
+                with lock_b:
+                    pass
+        """,
+    )
+    assert findings == []
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+def test_inline_allow_suppresses_exact_rule(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import threading, time
+
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                time.sleep(1)  # faas: allow(locks.blocking-call-under-lock)
+        """,
+    )
+    assert findings == []
+
+
+def test_inline_allow_checker_and_star_forms(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, tid):
+            store.set_status(tid, "COMPLETED")  # faas: allow(protocol)
+            store.set_status(tid, "FAILED")  # faas: allow(*)
+        """,
+    )
+    assert findings == []
+
+
+def test_allow_for_wrong_rule_does_not_suppress(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, tid):
+            store.set_status(tid, "COMPLETED")  # faas: allow(trace.print)
+        """,
+    )
+    assert hits(findings) == [("protocol.terminal-set-status", 2)]
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def test_baseline_roundtrip_absorbs_exactly_the_grandfathered_set(tmp_path):
+    src = """\
+    def f(store, tid):
+        store.set_status(tid, "COMPLETED")
+    """
+    findings = check(tmp_path, src)
+    assert len(findings) == 1
+
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(baseline_path, findings)
+    remaining = subtract_baseline(findings, load_baseline(baseline_path))
+    assert remaining == []
+
+    # a SECOND instance of the same (path, rule, message) is NOT absorbed:
+    # one baseline entry grandfathers one finding, never a class of them
+    doubled = check(
+        tmp_path,
+        """\
+        def f(store, tid):
+            store.set_status(tid, "COMPLETED")
+            store.set_status(tid, "COMPLETED")
+        """,
+    )
+    assert len(doubled) == 2
+    leftover = subtract_baseline(doubled, load_baseline(baseline_path))
+    assert len(leftover) == 1
+
+
+def test_baseline_rejects_unknown_version(tmp_path):
+    bad = tmp_path / "baseline.json"
+    bad.write_text(json.dumps({"version": 99, "findings": []}))
+    with pytest.raises(ValueError):
+        load_baseline(bad)
+
+
+# -- CLI gate ----------------------------------------------------------------
+
+BAD_SRC = """\
+def f(store, tid):
+    store.set_status(tid, "COMPLETED")
+"""
+
+WARN_SRC = """\
+from tpu_faas.core.task import TaskStatus
+
+def f(store, tid):
+    store.set_status(tid, TaskStatus.RUNNING)
+"""
+
+
+def test_cli_exits_nonzero_on_seeded_violation(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SRC)
+    assert analysis_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "protocol.terminal-set-status" in out
+
+
+def test_cli_baseline_gates_only_new_findings(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SRC)
+    baseline = tmp_path / "baseline.json"
+    assert analysis_main([str(bad), "--write-baseline", str(baseline)]) == 0
+    assert analysis_main([str(bad), "--baseline", str(baseline)]) == 0
+    bad.write_text(BAD_SRC + "    store.finish_task(tid, 'DONE', 'r')\n")
+    assert analysis_main([str(bad), "--baseline", str(baseline)]) == 1
+    out = capsys.readouterr().out
+    assert "protocol.unknown-status" in out
+
+
+def test_cli_warnings_pass_unless_strict(tmp_path, capsys):
+    warn = tmp_path / "warn.py"
+    warn.write_text(WARN_SRC)
+    assert analysis_main([str(warn)]) == 0
+    assert analysis_main([str(warn), "--strict"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_json_output_is_parseable(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SRC)
+    assert analysis_main([str(bad), "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload[0]["rule"] == "protocol.terminal-set-status"
+    assert payload[0]["line"] == 2
+
+
+def test_cli_rejects_nonexistent_and_empty_targets(tmp_path, capsys):
+    """A typo'd or empty target must fail the gate (exit 2), never pass it
+    vacuously with '0 error(s)'."""
+    assert analysis_main([str(tmp_path / "no_such_dir")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert analysis_main([str(empty)]) == 2
+    (tmp_path / "notpy.txt").write_text("hello")
+    assert analysis_main([str(tmp_path / "notpy.txt")]) == 2
+    capsys.readouterr()
+
+
+def test_finding_paths_are_cwd_independent(tmp_path, monkeypatch):
+    """Baseline keys must survive a working-directory change: the same scan
+    target yields the same finding paths from any cwd."""
+    pkg = tmp_path / "proj"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(BAD_SRC)
+
+    monkeypatch.chdir(tmp_path)
+    from_parent = run_paths([pkg])
+    monkeypatch.chdir(pkg)
+    from_inside = run_paths([tmp_path / "proj"])
+    assert [f.path for f in from_parent] == ["proj/bad.py"]
+    assert [f.baseline_key() for f in from_parent] == [
+        f.baseline_key() for f in from_inside
+    ]
+
+    baseline = tmp_path / "bl.json"
+    write_baseline(baseline, from_parent)
+    assert subtract_baseline(from_inside, load_baseline(baseline)) == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path, capsys):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    findings = run_paths([broken])
+    assert [f.rule for f in findings] == ["core.syntax-error"]
+    assert analysis_main([str(broken)]) == 1
+    capsys.readouterr()

@@ -123,6 +123,55 @@ def test_offline_replay_reproduces_verdict():
     assert any(v.kind == "terminal-overwrite" for v in replayed)
 
 
+def test_live_events_deque_roundtrips_through_check_trace():
+    """Post-mortem contract: feeding a live monitor's ``events`` deque (as
+    recorded through the RaceCheckStore write path, not hand-built observe
+    calls) into check_trace reproduces the online verdict EXACTLY — same
+    kinds, severities, task ids and detail strings, in the same order — for
+    a history mixing clean lifecycles, errors, warnings, deletes and a
+    flush. (Declared re-dispatches are not part of the event stream, so this
+    holds only for undeclared histories — the offline replay is strictly
+    more suspicious than the live run, never less.)"""
+    monitor = RaceMonitor()
+    store = RaceCheckStore(MemoryStore(), monitor, actor="gw")
+
+    # clean lifecycle + consume
+    store.hset("a", {S: "QUEUED", R: "None"})
+    store.hset("a", {S: "RUNNING"})
+    store.hset("a", {S: "COMPLETED", R: "1"})
+    store.delete("a")
+    # terminal-overwrite error (zombie second result)
+    store.hset("b", {S: "QUEUED"})
+    store.hset("b", {S: "RUNNING"})
+    store.hset("b", {S: "COMPLETED", R: "2"})
+    store.hset("b", {S: "FAILED", R: "boom"})
+    # illegal-transition error + result-without-dispatch warning
+    store.hset("c", {S: "RUNNING"})
+    store.hset("d", {S: "QUEUED"})
+    store.hset("d", {S: "COMPLETED", R: "4"})
+    # double-dispatch warning (undeclared RUNNING -> RUNNING)
+    store.hset("e", {S: "QUEUED"})
+    store.hset("e", {S: "RUNNING"})
+    store.hset("e", {S: "RUNNING"})
+    # flush resets the model mid-history; writes after it must re-validate
+    store.flush()
+    store.hset("f", {S: "QUEUED"})
+    store.hset("f", {S: "RUNNING"})
+
+    assert monitor.errors and monitor.warnings  # the scenario is non-trivial
+    replayed = check_trace(monitor.events)  # the deque itself, not a copy
+
+    def signature(violations):
+        return [(v.kind, v.severity, v.task_id, v.detail) for v in violations]
+
+    assert signature(replayed) == signature(monitor.violations)
+    # the replayed violations carry replayed events for the same task
+    for live, offline in zip(monitor.violations, replayed):
+        assert [e.task_id for e in live.events] == [
+            e.task_id for e in offline.events
+        ]
+
+
 def test_monitor_is_thread_safe_under_concurrent_writers():
     m = _mon()
 

@@ -1,0 +1,54 @@
+"""Static analysis for the tpu-faas codebase: prove at rest what
+``store/racecheck.py`` checks at runtime.
+
+The runtime monitor validates the interleavings a given run happens to hit;
+these AST passes see every code path. Three project-specific checkers ride a
+small shared framework (:mod:`tpu_faas.analysis.core`):
+
+- :mod:`tpu_faas.analysis.protocol` — every store write site that sets a
+  literal :class:`~tpu_faas.core.task.TaskStatus` is cross-checked against
+  the ``_LEGAL`` transition table imported from ``store/racecheck.py``, and
+  raw ``hset``/``publish`` calls that bypass the :class:`TaskStore`
+  conveniences (and therefore the monitor's model) are flagged.
+- :mod:`tpu_faas.analysis.tracesafety` — host-sync and impurity hazards in
+  any function reachable under a ``jax.jit`` / ``pjit`` / ``shard_map`` /
+  ``pallas_call`` trace.
+- :mod:`tpu_faas.analysis.locks` — blocking calls made while holding a
+  lock, and inconsistent multi-lock acquisition order across modules.
+
+Run ``python -m tpu_faas.analysis [paths]`` (exit 1 on non-baselined
+error-severity findings); suppress a deliberate site with a trailing
+``# faas: allow(<rule>)`` comment. See docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from tpu_faas.analysis.core import (
+    Checker,
+    Finding,
+    Module,
+    load_baseline,
+    run_paths,
+    subtract_baseline,
+    write_baseline,
+)
+from tpu_faas.analysis.locks import LockDisciplineChecker
+from tpu_faas.analysis.protocol import ProtocolChecker
+from tpu_faas.analysis.tracesafety import TraceSafetyChecker
+
+#: The default checker suite, in report order.
+ALL_CHECKERS = (ProtocolChecker, TraceSafetyChecker, LockDisciplineChecker)
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Checker",
+    "Finding",
+    "LockDisciplineChecker",
+    "Module",
+    "ProtocolChecker",
+    "TraceSafetyChecker",
+    "load_baseline",
+    "run_paths",
+    "subtract_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,119 @@
+"""CLI: ``python -m tpu_faas.analysis [paths] [options]``.
+
+Exit status is the gate contract: 0 when every error-severity finding is
+suppressed or baselined, 1 otherwise (2 on bad usage). Warnings never fail
+the gate unless ``--strict``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+import tpu_faas
+from tpu_faas.analysis import (
+    load_baseline,
+    run_paths,
+    subtract_baseline,
+    write_baseline,
+)
+from tpu_faas.analysis.core import iter_py_files
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_faas.analysis",
+        description="Static protocol / trace-safety / lock-discipline "
+        "checks for the tpu-faas tree (see docs/ANALYSIS.md).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to scan (default: the installed "
+        "tpu_faas package)",
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="FILE",
+        help="JSON baseline of grandfathered findings to subtract",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        help="write current error findings to FILE and exit 0",
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="warnings also fail the gate",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        dest="as_json",
+        help="emit findings as a JSON array instead of text",
+    )
+    args = parser.parse_args(argv)
+
+    paths = args.paths or [Path(tpu_faas.__file__).parent]
+    try:
+        if not iter_py_files(paths):
+            print(
+                f"no Python files found under {', '.join(map(str, paths))}",
+                file=sys.stderr,
+            )
+            return 2
+        findings = run_paths(paths)
+    except (FileNotFoundError, ValueError) as exc:
+        # a typo'd target must fail the gate, never pass it vacuously
+        print(f"tpu_faas.analysis: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        errors = sum(1 for f in findings if f.severity == "error")
+        print(f"baseline: {errors} error finding(s) -> {args.write_baseline}")
+        return 0
+
+    if args.baseline:
+        try:
+            findings = subtract_baseline(findings, load_baseline(args.baseline))
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"cannot load baseline {args.baseline}: {exc}", file=sys.stderr)
+            return 2
+
+    if args.as_json:
+        print(
+            json.dumps(
+                [
+                    {
+                        "path": f.path,
+                        "line": f.line,
+                        "rule": f.rule,
+                        "severity": f.severity,
+                        "message": f.message,
+                    }
+                    for f in findings
+                ],
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f)
+
+    errors = sum(1 for f in findings if f.severity == "error")
+    warnings = sum(1 for f in findings if f.severity == "warning")
+    if not args.as_json:
+        print(
+            f"tpu_faas.analysis: {errors} error(s), {warnings} warning(s)"
+            + (" (strict)" if args.strict else "")
+        )
+    failed = errors > 0 or (args.strict and warnings > 0)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,276 @@
+"""Protocol checker: every literal status write proven against racecheck.
+
+The runtime :class:`~tpu_faas.store.racecheck.RaceMonitor` models writers it
+can observe; this pass closes the other half of the argument — that every
+writer in the tree actually goes through an API the monitor models, and that
+every literal status it writes is one the ``_LEGAL`` transition table can
+reach through the API used:
+
+- ``finish_task`` may only write a terminal status S with RUNNING -> S legal
+  (``illegal-finish-status``) — a non-terminal "finish" would freeze the
+  record without a result contract;
+- ``set_status`` may never write a terminal status
+  (``terminal-set-status``) — terminal writes must flow through
+  ``finish_task``/``cancel_task``, which stamp FIELD_FINISHED_AT, drop the
+  live-index entry and announce on RESULTS_CHANNEL; a bare terminal
+  ``set_status`` leaks all three;
+- a RUNNING ``set_status`` without ``extra_fields`` carries no ownership
+  lease (``running-without-lease``, warning) — such a record is
+  unadoptable-forever if worker and dispatcher die (see FIELD_LEASE_AT);
+- any literal status outside the :class:`TaskStatus` enum is flagged
+  wherever it appears (``unknown-status``);
+- raw ``.hset()`` whose field-dict literal touches status/result, and raw
+  ``.publish()`` to the tasks/results channels, are flagged outside
+  ``tpu_faas/store/`` (``raw-status-write`` / ``raw-task-publish``): those
+  writes bypass the TaskStore conveniences, so the runtime monitor —
+  which models exactly that API — provably would not cover them.
+
+The legal-status sets are DERIVED from ``racecheck._LEGAL`` and
+``TaskStatus`` at import time, not copied: if the protocol grows a status or
+a transition, this pass follows automatically.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from tpu_faas.analysis.core import Checker, Finding, Module, dotted_name
+from tpu_faas.core.task import (
+    FIELD_RESULT,
+    FIELD_STATUS,
+    TaskStatus,
+)
+from tpu_faas.store.base import RESULTS_CHANNEL, TASKS_CHANNEL
+from tpu_faas.store.racecheck import _LEGAL
+
+#: All spellable statuses.
+STATUS_NAMES: frozenset[str] = frozenset(s.value for s in TaskStatus)
+#: Statuses with no legal way out (modulo the lawful-overwrite warnings the
+#: monitor reports separately).
+TERMINAL: frozenset[str] = frozenset(
+    s.value for s in TaskStatus if s.is_terminal()
+)
+#: What finish_task may write: terminal statuses reachable from RUNNING.
+LEGAL_FINISH: frozenset[str] = frozenset(
+    to for frm, to in _LEGAL if frm == "RUNNING" and to in TERMINAL
+)
+
+#: Field-name spellings that mark a dict literal as a task-record write.
+_STATUS_FIELD_NAMES = frozenset({"FIELD_STATUS", "FIELD_RESULT"})
+_STATUS_FIELD_STRINGS = frozenset({FIELD_STATUS, FIELD_RESULT})
+#: Channel spellings whose raw publish bypasses the store conveniences.
+_TASK_CHANNEL_NAMES = frozenset({"TASKS_CHANNEL", "RESULTS_CHANNEL"})
+_TASK_CHANNEL_STRINGS = frozenset({TASKS_CHANNEL, RESULTS_CHANNEL})
+
+
+def _status_literal(node: ast.AST) -> str | None:
+    """The status string a call argument pins down, or None when dynamic.
+
+    Understands the three spellings used across the tree: ``"RUNNING"``,
+    ``TaskStatus.RUNNING``, and ``str(TaskStatus.RUNNING)``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    dotted = dotted_name(node)
+    if dotted is not None and dotted.startswith("TaskStatus."):
+        return dotted.split(".", 1)[1]
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "str"
+        and len(node.args) == 1
+    ):
+        return _status_literal(node.args[0])
+    return None
+
+
+def _in_store_package(module: Module) -> bool:
+    """The store package implements the conveniences — its raw hash ops and
+    announces ARE the API, not a bypass of it. Decided on the module's
+    ABSOLUTE path (a ``tpu_faas/store`` directory pair, or the installed
+    ``tpu_faas.store`` package itself) so the verdict is identical whether
+    the file was scanned via its directory or named directly — relpath
+    anchoring must never change what the checker exempts."""
+    path = module.path.resolve()
+    try:
+        import tpu_faas.store as _store_pkg
+
+        if Path(_store_pkg.__file__).resolve().parent in path.parents:
+            return True
+    except ImportError:  # pragma: no cover - package always importable here
+        pass
+    parts = path.parts
+    return any(
+        parts[i] == "tpu_faas" and parts[i + 1] == "store"
+        for i in range(len(parts) - 1)
+    )
+
+
+class ProtocolChecker(Checker):
+    name = "protocol"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        store_internal = _in_store_package(module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            method = (
+                node.func.attr if isinstance(node.func, ast.Attribute) else None
+            )
+            if method == "finish_task":
+                yield from self._check_finish(module, node)
+            elif method == "set_status":
+                yield from self._check_set_status(module, node)
+            elif method in ("hset", "hset_many") and not store_internal:
+                yield from self._check_raw_hset(module, node)
+            elif method == "publish" and not store_internal:
+                yield from self._check_raw_publish(module, node)
+
+    # -- individual rules --------------------------------------------------
+    def _arg(self, call: ast.Call, index: int, keyword: str) -> ast.AST | None:
+        if len(call.args) > index:
+            return call.args[index]
+        for kw in call.keywords:
+            if kw.arg == keyword:
+                return kw.value
+        return None
+
+    def _check_status_value(
+        self, module: Module, node: ast.AST, status: str
+    ) -> Iterator[Finding]:
+        if status not in STATUS_NAMES:
+            yield self.finding(
+                module,
+                node,
+                "unknown-status",
+                "error",
+                f"status literal {status!r} is not a TaskStatus member "
+                f"(known: {', '.join(sorted(STATUS_NAMES))})",
+            )
+
+    def _check_finish(self, module: Module, call: ast.Call) -> Iterator[Finding]:
+        arg = self._arg(call, 1, "status")
+        status = _status_literal(arg) if arg is not None else None
+        if status is None:
+            return
+        if status not in STATUS_NAMES:
+            yield from self._check_status_value(module, call, status)
+            return
+        if status not in LEGAL_FINISH:
+            yield self.finding(
+                module,
+                call,
+                "illegal-finish-status",
+                "error",
+                f"finish_task writes {status}, but RUNNING -> {status} is "
+                f"not a legal terminal transition in racecheck._LEGAL "
+                f"(legal: {', '.join(sorted(LEGAL_FINISH))})",
+            )
+
+    def _check_set_status(
+        self, module: Module, call: ast.Call
+    ) -> Iterator[Finding]:
+        arg = self._arg(call, 1, "status")
+        status = _status_literal(arg) if arg is not None else None
+        if status is None:
+            return
+        if status not in STATUS_NAMES:
+            yield from self._check_status_value(module, call, status)
+            return
+        if status in TERMINAL:
+            yield self.finding(
+                module,
+                call,
+                "terminal-set-status",
+                "error",
+                f"set_status writes terminal {status}: terminal writes must "
+                f"go through finish_task/cancel_task (FINISHED_AT stamp, "
+                f"live-index removal, RESULTS_CHANNEL announce)",
+            )
+        elif status == "RUNNING" and self._arg(call, 2, "extra_fields") is None:
+            yield self.finding(
+                module,
+                call,
+                "running-without-lease",
+                "warning",
+                "RUNNING mark without extra_fields: no FIELD_LEASE_AT "
+                "ownership lease rides the write, so the record is "
+                "unadoptable if its worker and dispatcher both die",
+            )
+
+    def _dict_literals(self, call: ast.Call) -> Iterator[ast.Dict]:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Dict):
+                yield arg
+            elif isinstance(arg, (ast.List, ast.Tuple)):
+                for elt in arg.elts:
+                    if isinstance(elt, ast.Dict):
+                        yield elt
+                    elif isinstance(elt, ast.Tuple):
+                        for sub in elt.elts:
+                            if isinstance(sub, ast.Dict):
+                                yield sub
+
+    def _check_raw_hset(
+        self, module: Module, call: ast.Call
+    ) -> Iterator[Finding]:
+        for d in self._dict_literals(call):
+            for key, value in zip(d.keys, d.values):
+                if key is None:  # **spread: opaque, nothing provable
+                    continue
+                named = (
+                    isinstance(key, ast.Name) and key.id in _STATUS_FIELD_NAMES
+                )
+                literal = (
+                    isinstance(key, ast.Constant)
+                    and key.value in _STATUS_FIELD_STRINGS
+                )
+                if not (named or literal):
+                    continue
+                yield self.finding(
+                    module,
+                    call,
+                    "raw-status-write",
+                    "error",
+                    "raw hset writes a status/result field outside the "
+                    "TaskStore conveniences: the racecheck monitor models "
+                    "set_status/finish_task/cancel_task writers only, so "
+                    "this write is invisible to the protocol",
+                )
+                is_status_key = (
+                    isinstance(key, ast.Name) and key.id == "FIELD_STATUS"
+                ) or (isinstance(key, ast.Constant) and key.value == FIELD_STATUS)
+                if is_status_key:
+                    status = _status_literal(value)
+                    if status is not None:
+                        yield from self._check_status_value(
+                            module, value, status
+                        )
+                break  # one finding per dict literal
+
+    def _check_raw_publish(
+        self, module: Module, call: ast.Call
+    ) -> Iterator[Finding]:
+        channel = self._arg(call, 0, "channel")
+        if channel is None:
+            return
+        named = dotted_name(channel)
+        hit = (
+            isinstance(channel, ast.Constant)
+            and channel.value in _TASK_CHANNEL_STRINGS
+        ) or (
+            named is not None
+            and named.split(".")[-1] in _TASK_CHANNEL_NAMES
+        )
+        if hit:
+            yield self.finding(
+                module,
+                call,
+                "raw-task-publish",
+                "error",
+                "raw publish on a task lifecycle channel outside the store "
+                "package: announces must ride create_task/finish_task/"
+                "cancel_task so ordering guarantees (announce AFTER the "
+                "record write) hold",
+            )

@@ -1,0 +1,502 @@
+"""Trace-safety checker: host-sync and impurity hazards under JAX tracing.
+
+Scope discovery per module, no code execution:
+
+- roots: functions decorated ``@jax.jit`` / ``@pjit`` /
+  ``@(functools.)partial(jax.jit, ...)``, plus call-site wraps —
+  ``jax.jit(f)``, ``jit(f)``, ``pjit(f)``, ``shard_map(f, ...)``,
+  ``pallas_call(f, ...)`` where ``f`` names a module-level function (or its
+  ``.__wrapped__``), and inline ``jax.jit(lambda ...)`` bodies;
+- reachability: the transitive closure over module-level functions a traced
+  function references by name (an over-approximation: a reference is enough,
+  because functions passed to ``lax.scan``/``vmap`` etc. trace too).
+
+Hazards flagged inside traced code:
+
+- ``host-time`` (error): ``time.time``/``perf_counter``/``sleep``/
+  ``datetime.now`` — evaluated ONCE at trace time, then baked into the
+  compiled graph as a constant; every later call replays the stale value.
+- ``python-random`` (error): ``random.*`` / ``np.random.*`` — same
+  trace-time freeze; jitted code must thread ``jax.random`` keys.
+- ``host-sync`` (error): ``.item()`` / ``.tolist()`` / ``jax.device_get`` /
+  ``np.asarray``-on-traced, and ``float()/int()/bool()`` applied directly to
+  a non-static parameter — these force a device sync (or a
+  ConcretizationTypeError) inside the kernel.
+- ``state-mutation`` (error): ``global``/``nonlocal`` declarations, and
+  assignment through an attribute/subscript of a name NOT local to the
+  function — mutating captured state from traced code happens at trace
+  time, once, not per call.
+- ``data-dependent-branch`` (error): Python ``if``/``while`` on a value
+  derived from a non-static parameter — tracing picks ONE branch forever;
+  ``lax.cond``/``jnp.where`` is the device-side form. Only applied to
+  functions whose jit site is visible (so ``static_argnames`` is known);
+  helpers reached transitively skip this rule rather than guess staticness.
+  ``is None`` tests, ``.shape``/``.ndim``/``.dtype``/``.size`` access and
+  ``len()``/``isinstance()`` probes are understood to be static and exempt.
+- ``print`` (warning): trace-time-only output; ``jax.debug.print`` is the
+  traced form and is not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tpu_faas.analysis.core import Checker, Finding, Module, dotted_name
+
+#: Decorator / wrapper spellings that put a function under trace.
+_JIT_NAMES = frozenset({"jit", "pjit"})
+_WRAP_NAMES = frozenset({"jit", "pjit", "shard_map", "pallas_call"})
+
+_HOST_TIME = frozenset(
+    {
+        "time.time",
+        "time.perf_counter",
+        "time.monotonic",
+        "time.process_time",
+        "time.time_ns",
+        "time.sleep",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+    }
+)
+_HOST_SYNC_ATTRS = frozenset({"item", "tolist"})
+_HOST_SYNC_DOTTED = frozenset({"jax.device_get"})
+_NP_MATERIALIZE = frozenset({"np.asarray", "np.array", "numpy.asarray", "numpy.array"})
+_SHAPE_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+_STATIC_PROBES = frozenset({"len", "isinstance", "getattr", "hasattr", "type"})
+
+
+def _last(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _jax_bound_names(tree: ast.AST) -> frozenset[str]:
+    """Local names bound to jax (sub)modules by import statements — so
+    ``from jax import random`` makes a bare ``random.normal(...)`` exempt
+    from the python-random rule, matching the documented 'jax.random is
+    exempt' contract regardless of import spelling."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax" or alias.name.startswith("jax."):
+                    out.add(alias.asname or alias.name.split(".", 1)[0])
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.startswith("jax."):
+                for alias in node.names:
+                    out.add(alias.asname or alias.name)
+    return frozenset(out)
+
+
+def _static_spec(call: ast.Call) -> tuple[frozenset[str], frozenset[int]]:
+    """Constant ``static_argnames`` strings and ``static_argnums`` indices
+    spelled at a jit site. Indices are resolved to parameter names against
+    the target function by :func:`_resolve_static`."""
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        v = kw.value
+        elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                names.add(e.value)
+            elif isinstance(e, ast.Constant) and isinstance(e.value, int):
+                nums.add(e.value)
+    return frozenset(names), frozenset(nums)
+
+
+def _resolve_static(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda,
+    names: frozenset[str],
+    nums: frozenset[int],
+) -> frozenset[str]:
+    """The static parameter-name set for ``fn``: declared names plus
+    ``static_argnums`` indices mapped through its positional signature."""
+    positional = [p.arg for p in (*fn.args.posonlyargs, *fn.args.args)]
+    return names | frozenset(
+        positional[i] for i in nums if 0 <= i < len(positional)
+    )
+
+
+def _unwrap_target(node: ast.AST) -> str | None:
+    """The function name a jit/shard_map/pallas_call wrap targets:
+    ``f``, ``f.__wrapped__`` or ``partial(f, ...)``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and node.attr == "__wrapped__":
+        return node.value.id if isinstance(node.value, ast.Name) else None
+    if (
+        isinstance(node, ast.Call)
+        and (d := dotted_name(node.func)) is not None
+        and _last(d) == "partial"
+        and node.args
+    ):
+        return _unwrap_target(node.args[0])
+    return None
+
+
+class _FnInfo:
+    def __init__(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        self.node = node
+        self.traced = False
+        #: static_argnames when a jit site for this function is visible;
+        #: None means "reached transitively, staticness unknown".
+        self.static: frozenset[str] | None = None
+
+    def mark(self, static: frozenset[str] | None) -> None:
+        self.traced = True
+        if static is not None:
+            self.static = (self.static or frozenset()) | static
+
+
+class TraceSafetyChecker(Checker):
+    name = "trace"
+
+    #: names bound to jax modules in the module under check (set per module)
+    _jax_names: frozenset[str] = frozenset()
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        # every def keeps its own info; the name->infos multimap serves
+        # reachability, so two same-named functions (methods of sibling
+        # classes, same-named nested helpers) are BOTH analyzed — an
+        # over-approximation, never a silent drop
+        self._jax_names = _jax_bound_names(module.tree)
+        infos: list[_FnInfo] = []
+        by_name: dict[str, list[_FnInfo]] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _FnInfo(node)
+                infos.append(info)
+                by_name.setdefault(node.name, []).append(info)
+
+        lambdas: list[tuple[ast.Lambda, frozenset[str]]] = []
+
+        # roots from decorators
+        for info in infos:
+            for dec in info.node.decorator_list:
+                spec = self._jit_decorator(dec)
+                if spec is not None:
+                    info.mark(_resolve_static(info.node, *spec))
+
+        # roots from call-site wraps anywhere in the module
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is None or _last(d) not in _WRAP_NAMES:
+                continue
+            if not node.args:
+                continue
+            names, nums = _static_spec(node)
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                lambdas.append((target, _resolve_static(target, names, nums)))
+                continue
+            name = _unwrap_target(target)
+            for info in by_name.get(name or "", []):
+                # shard_map/pallas_call sites don't take static_argnames;
+                # a visible wrap still fixes "jit site known" semantics
+                info.mark(_resolve_static(info.node, names, nums))
+
+        # transitive closure: any module-level function a traced function
+        # (or traced lambda) references by name is traced too
+        # (staticness unknown)
+        for lam, _ in lambdas:
+            for ref in ast.walk(lam):
+                if isinstance(ref, ast.Name) and isinstance(ref.ctx, ast.Load):
+                    for info in by_name.get(ref.id, []):
+                        info.traced = True
+        changed = True
+        while changed:
+            changed = False
+            for src in [i for i in infos if i.traced]:
+                for ref in ast.walk(src.node):
+                    if (
+                        isinstance(ref, ast.Name)
+                        and isinstance(ref.ctx, ast.Load)
+                        and ref.id != src.node.name
+                    ):
+                        for info in by_name.get(ref.id, []):
+                            if not info.traced:
+                                info.traced = True
+                                changed = True
+
+        for info in infos:
+            if info.traced:
+                yield from self._check_traced(
+                    module, info.node, info.node.name, info.static
+                )
+        for lam, static in lambdas:
+            yield from self._check_traced(module, lam, "<lambda>", static)
+
+    # -- jit site detection ------------------------------------------------
+    def _jit_decorator(
+        self, dec: ast.AST
+    ) -> tuple[frozenset[str], frozenset[int]] | None:
+        """(static_argnames, static_argnums) when ``dec`` is a jit
+        decorator, else None."""
+        d = dotted_name(dec)
+        if d is not None and _last(d) in _JIT_NAMES:
+            return frozenset(), frozenset()
+        if isinstance(dec, ast.Call):
+            d = dotted_name(dec.func)
+            if d is None:
+                return None
+            if _last(d) in _JIT_NAMES:
+                return _static_spec(dec)
+            if _last(d) == "partial" and dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner is not None and _last(inner) in _JIT_NAMES:
+                    return _static_spec(dec)
+        return None
+
+    # -- hazard scan -------------------------------------------------------
+    def _check_traced(
+        self,
+        module: Module,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda,
+        fn_name: str,
+        static: frozenset[str] | None,
+    ) -> Iterator[Finding]:
+        where = f"in traced function {fn_name!r}"
+        params = self._params(fn)
+        local_names = params | self._assigned_names(fn)
+        tainted = (
+            self._taint(fn, params - static) if static is not None else None
+        )
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in self._walk_own_code(stmt):
+                if isinstance(node, ast.Global):
+                    yield self.finding(
+                        module, node, "state-mutation", "error",
+                        f"`global {', '.join(node.names)}` {where}: traced "
+                        f"code mutating module state runs at trace time only",
+                    )
+                elif isinstance(node, ast.Nonlocal):
+                    yield self.finding(
+                        module, node, "state-mutation", "error",
+                        f"`nonlocal {', '.join(node.names)}` {where}: traced "
+                        f"code mutating enclosing state runs at trace time only",
+                    )
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        base = self._subscript_or_attr_base(t)
+                        if base is not None and base not in local_names:
+                            yield self.finding(
+                                module, node, "state-mutation", "error",
+                                f"mutation of captured name {base!r} {where}: "
+                                f"happens once at trace time, not per call",
+                            )
+                elif isinstance(node, ast.Call):
+                    yield from self._check_call(
+                        module, node, where, params, static, tainted
+                    )
+                elif isinstance(node, (ast.If, ast.While)) and tainted:
+                    hazard = self._dynamic_names(node.test) & tainted
+                    if hazard:
+                        kind = "if" if isinstance(node, ast.If) else "while"
+                        yield self.finding(
+                            module, node, "data-dependent-branch", "error",
+                            f"Python `{kind}` on traced value(s) "
+                            f"{', '.join(sorted(hazard))} {where}: tracing "
+                            f"bakes in one branch; use lax.cond/jnp.where "
+                            f"(or declare the argument in static_argnames)",
+                        )
+
+    def _check_call(
+        self,
+        module: Module,
+        call: ast.Call,
+        where: str,
+        params: frozenset[str],
+        static: frozenset[str] | None,
+        tainted: frozenset[str] | None,
+    ) -> Iterator[Finding]:
+        d = dotted_name(call.func)
+        if d in _HOST_TIME:
+            yield self.finding(
+                module, call, "host-time", "error",
+                f"{d}() {where}: evaluated once at trace time and baked "
+                f"into the graph as a constant",
+            )
+            return
+        if d is not None and d.split(".", 1)[0] not in self._jax_names and (
+            d.split(".", 1)[0] == "random"
+            or d.startswith(("np.random.", "numpy.random."))
+        ):
+            yield self.finding(
+                module, call, "python-random", "error",
+                f"{d}() {where}: host randomness freezes at trace time; "
+                f"thread a jax.random key instead",
+            )
+            return
+        if d in _HOST_SYNC_DOTTED:
+            yield self.finding(
+                module, call, "host-sync", "error",
+                f"{d}() {where}: forces a device->host transfer inside "
+                f"the traced computation",
+            )
+            return
+        if d in _NP_MATERIALIZE and tainted:
+            names = set()
+            for a in call.args:
+                names |= self._dynamic_names(a)
+            if names & tainted:
+                yield self.finding(
+                    module, call, "host-sync", "error",
+                    f"{d}() on traced value {where}: materializing a tracer "
+                    f"as a numpy array raises ConcretizationTypeError",
+                )
+                return
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in _HOST_SYNC_ATTRS
+        ):
+            yield self.finding(
+                module, call, "host-sync", "error",
+                f".{call.func.attr}() {where}: forces a blocking "
+                f"device->host sync inside the traced computation",
+            )
+            return
+        if isinstance(call.func, ast.Name):
+            fname = call.func.id
+            if fname == "print":
+                yield self.finding(
+                    module, call, "print", "warning",
+                    f"print() {where} runs at trace time only; "
+                    f"jax.debug.print is the traced form",
+                )
+                return
+            if (
+                fname in ("float", "int", "bool")
+                and static is not None
+                and len(call.args) == 1
+                and isinstance(call.args[0], ast.Name)
+                and call.args[0].id in params
+                and call.args[0].id not in static
+            ):
+                yield self.finding(
+                    module, call, "host-sync", "error",
+                    f"{fname}({call.args[0].id}) {where}: concretizes a "
+                    f"traced argument (declare it in static_argnames if it "
+                    f"is genuinely host-side)",
+                )
+
+    # -- small AST utilities ----------------------------------------------
+    def _walk_own_code(self, node: ast.AST) -> Iterator[ast.AST]:
+        """ast.walk that does NOT descend into nested def bodies: a nested
+        function is discovered as its own traced function (via the
+        reachability closure) and checked with its OWN params — descending
+        here would double-report its hazards and mis-scope its locals.
+        Lambdas stay in scope: they can't be discovered independently
+        unless jit-wrapped directly."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk_own_code(child)
+
+    def _params(
+        self, fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    ) -> frozenset[str]:
+        a = fn.args
+        names = [
+            p.arg
+            for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)
+            if p.arg not in ("self", "cls")
+        ]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return frozenset(names)
+
+    def _assigned_names(self, fn: ast.AST) -> frozenset[str]:
+        names: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                names.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+        return frozenset(names)
+
+    def _subscript_or_attr_base(self, target: ast.AST) -> str | None:
+        """For ``a.b.c = ..`` / ``a[i] = ..`` targets: the root name."""
+        node = target
+        seen_container = False
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            seen_container = True
+            node = node.value
+        if seen_container and isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    def _taint(self, fn: ast.AST, seeds: frozenset[str]) -> frozenset[str]:
+        """Names derived from non-static parameters, by forward propagation
+        through simple assignments (fixpoint, bounded)."""
+        tainted = set(seeds)
+        for _ in range(10):
+            grew = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not (self._dynamic_names(node.value) & tainted):
+                    continue
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if (
+                            isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Store)
+                            and n.id not in tainted
+                        ):
+                            tainted.add(n.id)
+                            grew = True
+            if not grew:
+                break
+        return frozenset(tainted)
+
+    def _dynamic_names(self, expr: ast.AST) -> frozenset[str]:
+        """Name loads in ``expr`` that could carry traced VALUES — skipping
+        static probes: `x is None`, `x.shape`/`.ndim`/`.dtype`/`.size`,
+        `len(x)`, `isinstance(x, ..)`."""
+        out: set[str] = set()
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                return
+            if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+                return
+            if isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if d is not None and (
+                    d in _STATIC_PROBES or _last(d) in _SHAPE_ATTRS
+                ):
+                    return
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                return
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                out.add(node.id)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(expr)
+        return frozenset(out)

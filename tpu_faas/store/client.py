@@ -207,7 +207,9 @@ class RespStore(TaskStore):
                 # server is still down, leaving _conn None for next time)
                 self._conn = _Conn(self.host, self.port)
             try:
-                return self._conn.command(*parts)
+                # deliberate I/O under lock: this lock EXISTS to serialize
+                # use of the one connection (RESP replies are positional)
+                return self._conn.command(*parts)  # faas: allow(locks.blocking-call-under-lock)
             except (ConnectionError, TimeoutError):
                 # TimeoutError too: the reply may still arrive later, so the
                 # old connection is DESYNCHRONIZED (a future command would
@@ -219,7 +221,8 @@ class RespStore(TaskStore):
                 self._conn = conn
                 if str(parts[0]).upper() in _NON_IDEMPOTENT:
                     raise
-                return conn.command(*parts)
+                # same serialized-connection justification as above
+                return conn.command(*parts)  # faas: allow(locks.blocking-call-under-lock)
 
     def pipeline(self, commands: list[tuple]) -> list:
         """Run many commands over one round trip (RESP pipelining) and
@@ -239,11 +242,13 @@ class RespStore(TaskStore):
                 self._conn = _Conn(self.host, self.port)
             conn = self._conn
             try:
-                conn.send_many(commands)
+                # deliberate I/O under lock (see _command): one connection,
+                # positional replies — interleaved pipelines would desync
+                conn.send_many(commands)  # faas: allow(locks.blocking-call-under-lock)
                 out: list = []
                 for _ in commands:
                     try:
-                        out.append(conn.recv_reply())
+                        out.append(conn.recv_reply())  # faas: allow(locks.blocking-call-under-lock)
                     except resp.RespError as exc:
                         out.append(exc)
                 return out
