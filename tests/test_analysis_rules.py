@@ -115,6 +115,51 @@ def test_protocol_raw_status_write_and_publish_fire(tmp_path):
     ]
 
 
+def test_protocol_set_status_many_rules(tmp_path):
+    """The batched status write: its single shared status argument is held
+    to the same terminal/unknown rules as plain set_status — a RUNNING
+    batch (the dispatcher's coalesced act-phase flush) stays clean."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        def f(store, items):
+            store.set_status_many(TaskStatus.COMPLETED, items)
+            store.set_status_many("DONE", items)
+            store.set_status_many(TaskStatus.RUNNING, items)  # clean
+        """,
+    )
+    assert hits(findings) == [
+        ("protocol.terminal-set-status", 4),
+        ("protocol.unknown-status", 5),
+    ]
+
+
+def test_protocol_finish_task_many_rules(tmp_path):
+    """Batched terminal writes: literal item tuples have their status slot
+    checked against the legal finish set; dynamically built item lists
+    (statuses off the wire) are out of static scope and stay clean."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        def f(store, tid, results):
+            store.finish_task_many([(tid, TaskStatus.QUEUED, "r", False)])
+            store.finish_task_many([(tid, "DONE", "r", False)])
+            store.finish_task_many(
+                [(tid, TaskStatus.COMPLETED, "r", True)]  # clean
+            )
+            store.finish_task_many(results)  # dynamic: not provable
+        """,
+    )
+    assert hits(findings) == [
+        ("protocol.illegal-finish-status", 4),
+        ("protocol.unknown-status", 5),
+    ]
+
+
 def test_protocol_clean_fixture(tmp_path):
     """The legal surface: conveniences with legal statuses, hset without
     lifecycle fields, publish on a non-lifecycle channel, dynamic statuses

@@ -129,6 +129,38 @@ def test_store_contract_against_redis(redis_url):
         assert s.hmget("nope", ["a", "b"]) == [None, None]
         assert s.hgetall("nope") == {}
 
+        # pipelined data-plane forms (the dispatcher's batched intake /
+        # coalesced act writes / batched result path) against Redis reply
+        # semantics: *0 HGETALL for a missing key, in-order pipelining
+        recs = s.hgetall_many(["t2", "nope", "t3"])
+        assert recs[0]["param_payload"] == "P2"
+        assert recs[1] == {}
+        assert recs[2]["status"] == "QUEUED"
+        s.set_status_many(
+            "RUNNING", [("t2", {"lease_at": "3.0"}), ("t3", None)]
+        )
+        assert s.hget_many(["t2", "t3"], "status") == ["RUNNING", "RUNNING"]
+        assert s.hget("t2", "lease_at") == "3.0"
+        s.finish_task_many(
+            [
+                ("t2", "COMPLETED", "R2", False),
+                ("t2", "FAILED", "late", True),  # intra-batch first_wins
+                ("t3", "COMPLETED", "R3", False),
+            ]
+        )
+        assert s.get_result("t2") == ("COMPLETED", "R2")
+        assert s.get_result("t3") == ("COMPLETED", "R3")
+        assert s.hgetall(LIVE_INDEX_KEY) == {"t1": "1"}
+        # one wake per WRITTEN batch item (the skipped first_wins item
+        # announces nothing)
+        woken = []
+        deadline = time.monotonic() + 5
+        while len(woken) < 2 and time.monotonic() < deadline:
+            w = wake.get_message(timeout=0.2)
+            if w is not None:
+                woken.append(w)
+        assert woken == ["t2", "t3"]
+
         # terminal write: result + wake + index removal in one round trip
         s.finish_task("t1", "COMPLETED", "RES")
         deadline = time.monotonic() + 5
@@ -137,7 +169,9 @@ def test_store_contract_against_redis(redis_url):
             msg = wake.get_message(timeout=0.2)
         assert msg == "t1"
         assert s.get_result("t1") == ("COMPLETED", "RES")
-        assert set(s.hgetall(LIVE_INDEX_KEY)) == {"t2", "t3"}
+        # t2/t3 left the index with their batched terminal writes above;
+        # t1's single finish_task just removed the last entry
+        assert s.hgetall(LIVE_INDEX_KEY) == {}
 
         s.delete_many(["t2", "t3"])
         assert s.get_status("t2") is None

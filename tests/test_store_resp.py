@@ -249,6 +249,62 @@ def test_pipeline_one_round_trip_semantics(kind, tmp_path):
         handle.stop()
 
 
+def test_batched_data_plane_ops(store_server):
+    """The pipelined data-plane forms — hgetall_many / set_status_many /
+    finish_task_many — against BOTH store servers: reply shapes, missing-key
+    behavior, per-item extra fields, intra-batch first_wins, and the
+    announce-per-written-item contract on the results channel."""
+    from tpu_faas.store.base import RESULTS_CHANNEL
+
+    s = make_store(store_server.url)
+    try:
+        s.create_tasks([(f"b{i}", f"F{i}", f"P{i}") for i in range(3)])
+        # hgetall_many: one dict per key, {} for a missing key, order kept
+        recs = s.hgetall_many(["b0", "ghost", "b2"])
+        assert recs[0]["fn_payload"] == "F0" and recs[0]["status"] == "QUEUED"
+        assert recs[1] == {}
+        assert recs[2]["param_payload"] == "P2"
+        assert s.hgetall_many([]) == []
+        # set_status_many: one shared status, per-item extra fields
+        s.set_status_many(
+            "RUNNING", [("b0", {"lease_at": "1.5"}), ("b1", None)]
+        )
+        assert s.hget_many(["b0", "b1", "b2"], "status") == [
+            "RUNNING", "RUNNING", "QUEUED",
+        ]
+        assert s.hget("b0", "lease_at") == "1.5"
+        assert s.hget("b1", "lease_at") is None
+        with s.subscribe(RESULTS_CHANNEL) as rsub:
+            s.finish_task_many(
+                [
+                    ("b0", "COMPLETED", "r0", False),
+                    ("b1", "FAILED", "r1", False),
+                    # intra-batch first_wins: b0 is already terminal from
+                    # the item above — this write must be skipped, exactly
+                    # as if the items were applied sequentially
+                    ("b0", "FAILED", "late", True),
+                ]
+            )
+            # one announce per WRITTEN item, each after its record write
+            assert rsub.get_message(timeout=2.0) == "b0"
+            assert rsub.get_message(timeout=2.0) == "b1"
+            assert rsub.get_message(timeout=0.3) is None
+        assert s.get_result("b0") == ("COMPLETED", "r0")
+        assert s.get_result("b1") == ("FAILED", "r1")
+        # terminal writes dropped both ids from the live index
+        assert set(s.hgetall(LIVE_INDEX_KEY)) == {"b2"}
+        # store-state first_wins: a frozen record stays frozen in a batch
+        s.finish_task_many([("b1", "COMPLETED", "second", True)])
+        assert s.get_result("b1") == ("FAILED", "r1")
+        # ...but a plain (non-first_wins) batch item still overwrites,
+        # matching finish_task's sequential semantics
+        s.finish_task_many([("b2", "COMPLETED", "r2", False)])
+        assert s.get_result("b2") == ("COMPLETED", "r2")
+        s.flush()
+    finally:
+        s.close()
+
+
 def test_create_tasks_pipelined_announces_after_writes():
     """Batch create: every hash readable, every announce delivered, and no
     announce precedes its hash (subscriber sees ids whose payloads exist)."""

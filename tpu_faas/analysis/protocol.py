@@ -8,12 +8,16 @@ reach through the API used:
 
 - ``finish_task`` may only write a terminal status S with RUNNING -> S legal
   (``illegal-finish-status``) — a non-terminal "finish" would freeze the
-  record without a result contract;
+  record without a result contract; ``finish_task_many`` item tuples with a
+  literal status slot are held to the same rule;
 - ``set_status`` may never write a terminal status
   (``terminal-set-status``) — terminal writes must flow through
-  ``finish_task``/``cancel_task``, which stamp FIELD_FINISHED_AT, drop the
-  live-index entry and announce on RESULTS_CHANNEL; a bare terminal
-  ``set_status`` leaks all three;
+  ``finish_task``/``finish_task_many``/``cancel_task``, which stamp
+  FIELD_FINISHED_AT, drop the live-index entry and announce on
+  RESULTS_CHANNEL; a bare terminal ``set_status`` leaks all three. The
+  batched ``set_status_many`` carries ONE shared status as its first
+  argument precisely so this rule stays statically provable for the
+  dispatcher's coalesced RUNNING flush;
 - a RUNNING ``set_status`` without ``extra_fields`` carries no ownership
   lease (``running-without-lease``, warning) — such a record is
   unadoptable-forever if worker and dispatcher die (see FIELD_LEASE_AT);
@@ -122,6 +126,10 @@ class ProtocolChecker(Checker):
                 yield from self._check_finish(module, node)
             elif method == "set_status":
                 yield from self._check_set_status(module, node)
+            elif method == "set_status_many":
+                yield from self._check_set_status_many(module, node)
+            elif method == "finish_task_many":
+                yield from self._check_finish_many(module, node)
             elif method in ("hset", "hset_many") and not store_internal:
                 yield from self._check_raw_hset(module, node)
             elif method == "publish" and not store_internal:
@@ -198,6 +206,66 @@ class ProtocolChecker(Checker):
                 "ownership lease rides the write, so the record is "
                 "unadoptable if its worker and dispatcher both die",
             )
+
+    def _check_set_status_many(
+        self, module: Module, call: ast.Call
+    ) -> Iterator[Finding]:
+        """The batched status write carries ONE shared status as its first
+        argument precisely so this check works like plain set_status's:
+        never terminal, always a known member. (The per-item extra_fields
+        — where the RUNNING lease stamps ride — are built dynamically, so
+        the lease warning is out of static reach for the batch form; the
+        runtime race monitor still observes every item.)"""
+        arg = self._arg(call, 0, "status")
+        status = _status_literal(arg) if arg is not None else None
+        if status is None:
+            return
+        if status not in STATUS_NAMES:
+            yield from self._check_status_value(module, call, status)
+            return
+        if status in TERMINAL:
+            yield self.finding(
+                module,
+                call,
+                "terminal-set-status",
+                "error",
+                f"set_status_many writes terminal {status}: terminal writes "
+                f"must go through finish_task/finish_task_many/cancel_task "
+                f"(FINISHED_AT stamp, live-index removal, RESULTS_CHANNEL "
+                f"announce)",
+            )
+
+    def _check_finish_many(
+        self, module: Module, call: ast.Call
+    ) -> Iterator[Finding]:
+        """finish_task_many takes (task_id, status, result, first_wins)
+        tuples; wherever an items list is a literal, each tuple's status
+        slot is checked against the legal finish set. Dynamically built
+        item lists (the dispatcher's drain buffer) are out of static scope
+        — those statuses come off the wire and are validated by the
+        runtime race monitor instead."""
+        items = self._arg(call, 0, "items")
+        if not isinstance(items, (ast.List, ast.Tuple)):
+            return
+        for elt in items.elts:
+            if not isinstance(elt, ast.Tuple) or len(elt.elts) < 2:
+                continue
+            status = _status_literal(elt.elts[1])
+            if status is None:
+                continue
+            if status not in STATUS_NAMES:
+                yield from self._check_status_value(module, elt.elts[1], status)
+            elif status not in LEGAL_FINISH:
+                yield self.finding(
+                    module,
+                    elt,
+                    "illegal-finish-status",
+                    "error",
+                    f"finish_task_many writes {status}, but RUNNING -> "
+                    f"{status} is not a legal terminal transition in "
+                    f"racecheck._LEGAL "
+                    f"(legal: {', '.join(sorted(LEGAL_FINISH))})",
+                )
 
     def _dict_literals(self, call: ast.Call) -> Iterator[ast.Dict]:
         for arg in list(call.args) + [kw.value for kw in call.keywords]:

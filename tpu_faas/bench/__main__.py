@@ -19,7 +19,8 @@ def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description="tpu-faas benchmarks")
     ap.add_argument(
         "--config",
-        help="benchmark config: 1-5 (BASELINE) or 6 (batch register), "
+        help="benchmark config: 1-5 (BASELINE), 6 (batch register), "
+        "7 (bid kernel), 8 (estimation), 9 (host dispatch throughput), "
         "or 'all'",
     )
     ap.add_argument(

@@ -9,10 +9,13 @@ configs[]) plus one framework-extra:
    task redistribution
 6. (extra, no BASELINE analog) time-to-register: batch /execute_batch +
    pipelined store writes vs one POST per task
+9. (extra) host dispatch throughput: intake -> device -> act against the
+   in-process RESP store server — the host data plane end to end, with the
+   store-round-trips-per-tick counter proving the batched (pipelined) forms
 
-Configs 1-2 and 6 run the real socket stack; 3-5 run the device kernels at
-scales the socket stack can't reach on one box (the reference had no analog
-— its harness topped out at localhost subprocesses, SURVEY §4).
+Configs 1-2, 6 and 9 run the real socket stack; 3-5 run the device kernels
+at scales the socket stack can't reach on one box (the reference had no
+analog — its harness topped out at localhost subprocesses, SURVEY §4).
 Each config returns a dict and is printed as one JSON line by the CLI.
 """
 
@@ -606,6 +609,97 @@ def config_8_estimation() -> dict:
     }
 
 
+def config_9_host_dispatch() -> dict:
+    """Host data-plane throughput: intake -> device tick -> act, end to end
+    against the in-process RESP store server (real TCP round trips, real
+    RESP parsing) — the path the device-tick configs never see because they
+    synthesize tasks in memory. Workers are registered directly on the
+    ROUTER mirror (no subprocesses): dispatch sends to peers that never
+    connected are dropped by ZMQ, so the measurement isolates the HOST cost
+    of acting on a device decision — announce drain, one pipelined record
+    fetch, the device step, the send loop, and the coalesced RUNNING flush.
+
+    Publishes ``host_dispatch_tasks_per_s`` plus the store-round-trips-per-
+    tick counter, pinning the batched data plane's O(1)-rounds-per-tick
+    claim in the BENCH trajectory. Shape via TPU_FAAS_BENCH_HOST_SHAPE=
+    "tasks,workers,procs" (fleet capacity must cover the task count: no
+    results flow back to free slots); the CI smoke lane runs "200,64,4".
+    """
+    import os
+
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.worker import messages as m
+
+    shape = os.environ.get("TPU_FAAS_BENCH_HOST_SHAPE", "20000,4096,8")
+    n_tasks, n_workers, n_procs = (int(x) for x in shape.split(","))
+    handle = start_store_thread()
+    store = make_store(handle.url)
+    feeder = make_store(handle.url)
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=store,
+        max_workers=n_workers,
+        max_pending=min(8192, max(n_tasks, 64)),
+        max_inflight=max(2 * n_tasks, 1024),
+        max_slots=n_procs,
+        recover_queued=False,
+    )
+    try:
+        for i in range(n_workers):
+            disp._handle(
+                f"bench-w{i}".encode(), m.REGISTER, {"num_processes": n_procs}
+            )
+        # compile the device step OUTSIDE the timed window, before any task
+        # exists (shapes are padded/static, so the empty tick compiles the
+        # same trace the loaded ticks replay)
+        disp.tick()
+        # one pipelined batch create per chunk: feeding must not become the
+        # bottleneck being measured
+        chunk = 2_000
+        for lo in range(0, n_tasks, chunk):
+            feeder.create_tasks(
+                [
+                    (f"bench-t{i}", "F", "P")
+                    for i in range(lo, min(lo + chunk, n_tasks))
+                ]
+            )
+        warm = disp.n_dispatched  # 0 unless the empty tick found strays
+        rounds: list[int] = []
+        t0 = time.perf_counter()
+        deadline = t0 + 600.0
+        while disp.n_dispatched < n_tasks and time.perf_counter() < deadline:
+            rt0 = store.n_round_trips
+            disp.tick()
+            rounds.append(store.n_round_trips - rt0)
+        elapsed = time.perf_counter() - t0
+        spans = disp.tracer.summary()
+        return {
+            "config": "host-dispatch-throughput",
+            "shape": {"tasks": n_tasks, "workers": n_workers, "procs": n_procs},
+            "dispatched": disp.n_dispatched,
+            "host_dispatch_tasks_per_s": round(
+                (disp.n_dispatched - warm) / max(elapsed, 1e-9), 1
+            ),
+            "ticks": len(rounds) + 1,
+            "store_round_trips_per_tick_max": max(rounds, default=0),
+            "store_round_trips_per_tick": rounds[:32],
+            "intake_p50_ms": round(
+                spans.get("intake", {}).get("p50", 0.0) * 1e3, 3
+            ),
+            "act_p50_ms": round(spans.get("act", {}).get("p50", 0.0) * 1e3, 3),
+            "device_tick_p50_ms": round(
+                spans.get("device_tick", {}).get("p50", 0.0) * 1e3, 3
+            ),
+        }
+    finally:
+        disp.socket.close(linger=0)
+        disp.close()
+        feeder.close()
+        handle.stop()
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -615,4 +709,5 @@ CONFIGS = {
     "6": config_6_batch_register,
     "7": config_7_bid_headline,
     "8": config_8_estimation,
+    "9": config_9_host_dispatch,
 }

@@ -9,21 +9,23 @@ Differences from the reference, by design:
 
 - the store is injected by URL, not hard-coded (reference hard-codes Redis
   localhost:6379 db=1 at task_dispatcher.py:32 despite config keys);
-- `poll_next_task` can batch-drain up to ``max_n`` announcements per tick —
-  the reference reads at most one message per loop iteration
-  (task_dispatcher.py:75,170,299), which caps dispatch throughput at one task
-  per tick; batching is what lets the TPU backend schedule thousands of
-  pending tasks in one device step;
+- `poll_tasks` batch-drains up to ``max_n`` announcements per tick and
+  fetches all their records in ONE pipelined store round — the reference
+  reads at most one message per loop iteration and pays one store round
+  trip per task (task_dispatcher.py:75,170,299), which caps dispatch
+  throughput at one task per tick; batching is what lets the TPU backend
+  schedule thousands of pending tasks in one device step;
 - a clean ``stop()`` for tests (the reference loops forever).
 """
 
 from __future__ import annotations
 
+import itertools
 import math
 import threading
 import time
 import uuid
-from collections import deque
+from collections import Counter, deque
 from dataclasses import dataclass
 
 from tpu_faas.core.serialize import serialize
@@ -156,6 +158,66 @@ class PendingTask:
             cost=cost,
             timeout=timeout,
         )
+
+
+class PendingQueue:
+    """Deque of PendingTask with an O(1) task-id membership index.
+
+    Intake dedup (and the stranded-task rescan's known-set) used to rebuild
+    a ``seen`` set from the whole pending deque every tick — an O(pending)
+    walk per tick at the headline shape. The index is maintained on every
+    enqueue/dequeue instead, so ``task_id in queue`` is a dict probe. A
+    Counter (multiset), not a set: a double-append of the same id — which
+    the dedup layers should prevent — must not corrupt membership when one
+    copy is popped."""
+
+    __slots__ = ("_q", "_ids")
+
+    def __init__(self, items=()) -> None:
+        self._q: deque[PendingTask] = deque()
+        self._ids: Counter[str] = Counter()
+        self.extend(items)
+
+    def append(self, task: PendingTask) -> None:
+        self._q.append(task)
+        self._ids[task.task_id] += 1
+
+    def appendleft(self, task: PendingTask) -> None:
+        self._q.appendleft(task)
+        self._ids[task.task_id] += 1
+
+    def extend(self, items) -> None:
+        for task in items:
+            self.append(task)
+
+    def popleft(self) -> PendingTask:
+        task = self._q.popleft()
+        self._discard(task.task_id)
+        return task
+
+    def _discard(self, task_id: str) -> None:
+        n = self._ids[task_id] - 1
+        if n > 0:
+            self._ids[task_id] = n
+        else:
+            del self._ids[task_id]
+
+    def __contains__(self, task_id: str) -> bool:
+        return task_id in self._ids
+
+    def task_ids(self) -> set[str]:
+        """Snapshot of the distinct task ids currently queued (the
+        rescan's known-set, without walking the deque)."""
+        return set(self._ids)
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def __iter__(self):
+        return iter(self._q)
+
+    def __getitem__(self, index: int) -> PendingTask:
+        return self._q[index]
 
 
 class TaskDispatcher:
@@ -463,32 +525,80 @@ class TaskDispatcher:
                 )
             return PendingTask.from_fields(msg, fields)
 
+    def drain_announces(self, max_n: int) -> list[str]:
+        """Phase 1 of batched intake: pop up to ``max_n`` TASK announces off
+        the backlog-then-bus without touching the store. Control messages
+        (cancel/kill) are noted in passing — they carry no store read, so
+        they can never park — and do not count toward ``max_n``. Returns
+        announce payloads in drain order, duplicates included."""
+        msgs: list[str] = []
+        while len(msgs) < max_n:
+            if self._announce_backlog:
+                msg = self._announce_backlog.popleft()
+            else:
+                msg = self.subscriber.get_message()
+                if msg is None:
+                    break
+            if msg.startswith(CANCEL_ANNOUNCE_PREFIX):
+                self.note_cancelled(msg[len(CANCEL_ANNOUNCE_PREFIX):])
+            elif msg.startswith(KILL_ANNOUNCE_PREFIX):
+                self.note_kill(msg[len(KILL_ANNOUNCE_PREFIX):])
+            else:
+                msgs.append(msg)
+        return msgs
+
     def poll_tasks(self, max_n: int) -> list[PendingTask]:
-        """Batch intake: drain up to max_n announcements. If a store outage
-        strikes mid-batch, the tasks already fetched are DELIVERED (their
-        announces are consumed; dropping them would lose tasks) and the
-        failing announce is parked in the backlog by poll_next_task; only an
-        outage with nothing fetched yet propagates."""
+        """Batch intake, pipelined: drain up to ``max_n`` announces from the
+        bus FIRST (cheap, store-free), then fetch every announced record in
+        ONE ``hgetall_many`` round trip — the reference pattern (and
+        poll_next_task) pays one round trip per announce. Per-announce
+        semantics are unchanged: unknown records are skipped with a
+        warning, non-QUEUED announces are skipped without consuming cancel
+        notes, stale kill notes are dropped when a fresh QUEUED incarnation
+        arrives, and duplicates within the drain are deduped.
+
+        Outage contract: the batch is all-or-nothing — if the single fetch
+        round fails, EVERY drained announce is parked back at the head of
+        the backlog in order (their bus copies are spent; dropping them
+        would lose tasks) and the outage propagates. Callers keep whatever
+        they already hold and retry next tick."""
+        msgs = self.drain_announces(max_n)
+        if not msgs:
+            return []
+        # duplicate announce inside one drain: both copies still read
+        # status QUEUED (the non-QUEUED skip only protects across rounds,
+        # after mark_running lands), e.g. a dedup-loser's claim adoption
+        # racing the winner's create. Dispatching both would run the task
+        # twice — fetch and deliver each id once.
+        unique = list(dict.fromkeys(msgs))
+        try:
+            records = self.store.hgetall_many(unique)
+        except BaseException:
+            # ANY failure parks the batch, not just the outage family: the
+            # announces are spent either way, and a store error reply (one
+            # WRONGTYPE key poisoning the pipelined fetch) must not lose
+            # the healthy announces drained alongside it
+            self._announce_backlog.extendleft(reversed(msgs))
+            raise
         out: list[PendingTask] = []
-        seen: set[str] = set()
-        for _ in range(max_n):
-            try:
-                t = self.poll_next_task()
-            except STORE_OUTAGE_ERRORS:
-                if out:
-                    return out
-                raise
-            if t is None:
-                break
-            if t.task_id in seen:
-                # duplicate announce inside one drain: both copies still read
-                # status QUEUED (the non-QUEUED skip in poll_next_task only
-                # protects across rounds, after mark_running lands), e.g. a
-                # dedup-loser's claim adoption racing the winner's create.
-                # Dispatching both would run the task twice.
+        for msg, fields in zip(unique, records):
+            if FIELD_FN not in fields or FIELD_PARAMS not in fields:
+                self.log.warning("announce for unknown task %s; skipping", msg)
                 continue
-            seen.add(t.task_id)
-            out.append(t)
+            if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
+                # duplicate or stale announce (see poll_next_task): never
+                # dispatch, and never consume a cancel note here
+                self.log.debug("announce for non-QUEUED task %s; skipping", msg)
+                continue
+            if msg in self.kill_requested:
+                # fresh QUEUED incarnation entering OUR pending set: any
+                # held kill note targets a previous incarnation (full
+                # rationale in poll_next_task)
+                self.kill_requested.pop(msg, None)
+                self.log.info(
+                    "dropped stale kill note for resubmitted task %s", msg
+                )
+            out.append(PendingTask.from_fields(msg, fields))
         return out
 
     # -- shared-fleet dispatch claims --------------------------------------
@@ -670,6 +780,50 @@ class TaskDispatcher:
             self.note_store_outage(exc, pause=0)
             return False
 
+    def mark_running_many(self, task_ids) -> bool:
+        """Coalesced mark_running for the act phase's common path (no
+        retries, no redispatch declaration): every RUNNING transition of a
+        tick flushed as ONE pipelined round, each record still carrying its
+        ownership lease stamp. Same degrade-on-outage contract as
+        mark_running_safe — the tasks are already on the wire, and the
+        deferred-capable terminal write supersedes a missing RUNNING mark.
+        Returns False when the flush was skipped on an outage."""
+        if not task_ids:
+            return True
+        stamp = repr(time.time())
+        try:
+            self.store.set_status_many(
+                TaskStatus.RUNNING,
+                [(tid, {FIELD_LEASE_AT: stamp}) for tid in task_ids],
+            )
+            return True
+        except STORE_OUTAGE_ERRORS as exc:
+            self.note_store_outage(exc, pause=0)
+            return False
+
+    def record_results_safe(self, items) -> int:
+        """Batched record_result_safe: pipeline every terminal write of a
+        worker-message drain into one ``finish_task_many`` round (plus one
+        status pre-read for the first_wins slice, on RESP backends). Items
+        are (task_id, status, result, first_wins) — the deferred_results
+        tuple shape. A store outage defers EVERY item, order preserved,
+        for flush_deferred_results to replay. Returns items written now."""
+        if not items:
+            return 0
+        try:
+            self.store.finish_task_many(list(items))
+            self.note_store_up()
+            return len(items)
+        except STORE_OUTAGE_ERRORS as exc:
+            # a mid-pipeline loss is ambiguous (a prefix may have applied);
+            # deferring the WHOLE batch is safe because the replay is
+            # idempotent — finish writes land the same end state, repeated
+            # RESULTS_CHANNEL publishes are tolerated spurious wakes, and
+            # first_wins items re-check the frozen guard at replay time
+            self.deferred_results.extend(items)
+            self.note_store_outage(exc, pause=0)
+            return 0
+
     def record_result_safe(
         self, task_id: str, status: str, result: str, first_wins: bool = False
     ) -> bool:
@@ -689,11 +843,20 @@ class TaskDispatcher:
             self.note_store_outage(exc, pause=0)
             return False
 
+    #: deferred-result replay batch bound: keeps one replay pipeline's
+    #: buffered commands (result payloads included) from ballooning after
+    #: a long outage, while still collapsing the common case to one round
+    _DEFERRED_REPLAY_CHUNK = 512
+
     def flush_deferred_results(self) -> int:
-        """Replay writes deferred during an outage; stops (keeping order) the
-        moment the store fails again. Call once per loop iteration — while
-        the store is known down, actual attempts are rate-limited so a
-        slow-to-fail connect (packet black hole) can't stall every tick."""
+        """Replay writes deferred during an outage in pipelined chunks
+        (order preserved); stops the moment the store fails again — the
+        un-replayed tail keeps its order for the next attempt, and a chunk
+        whose pipeline died ambiguously is retried WHOLE (safe: the replay
+        is idempotent, see record_results_safe). Call once per loop
+        iteration — while the store is known down, actual attempts are
+        rate-limited so a slow-to-fail connect (packet black hole) can't
+        stall every tick."""
         if (
             self._store_down
             and time.monotonic() - self._last_flush_attempt < 0.5
@@ -702,14 +865,22 @@ class TaskDispatcher:
         self._last_flush_attempt = time.monotonic()
         n = 0
         while self.deferred_results:
-            task_id, status, result, first_wins = self.deferred_results[0]
+            # islice, not integer indexing: deque indexing is O(i) from the
+            # nearest end, which would make chunk building O(chunk^2) on
+            # the post-outage recovery path
+            chunk = list(
+                itertools.islice(
+                    self.deferred_results, self._DEFERRED_REPLAY_CHUNK
+                )
+            )
             try:
-                self.record_result(task_id, status, result, first_wins=first_wins)
+                self.store.finish_task_many(chunk)
             except STORE_OUTAGE_ERRORS as exc:
                 self.note_store_outage(exc)
                 break
-            self.deferred_results.popleft()
-            n += 1
+            for _ in chunk:
+                self.deferred_results.popleft()
+            n += len(chunk)
         if n:
             self.note_store_up()
             self.log.info("replayed %d result writes deferred during outage", n)

@@ -40,6 +40,7 @@ from tpu_faas.core.task import (
 )
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
+    PendingQueue,
     PendingTask,
     TaskDispatcher,
 )
@@ -193,7 +194,33 @@ class TpuPushDispatcher(TaskDispatcher):
                 max_slots=max_slots,
                 placement=placement,
             )
-        self.pending: deque[PendingTask] = deque()
+        #: host-side staging queue; id-indexed so intake dedup and the
+        #: rescan's known-set are O(1) probes, not per-tick O(pending) walks
+        self.pending: PendingQueue = PendingQueue()
+        #: RESULT store writes accumulated during a worker-message drain,
+        #: flushed as ONE pipelined finish_task_many round per drain
+        #: (drain_results_batched); None = unbatched mode, where _handle
+        #: writes each result immediately (direct callers, tests)
+        self._result_batch: list[tuple[str, str, str, bool]] | None = None
+        #: observability for the batched data plane: store round trips paid
+        #: by the last tick (delta of TaskStore.n_round_trips) and the last
+        #: flush sizes of each batched write family
+        self._tick_round_trips = 0
+        self._batch_sizes: dict[str, int] = {
+            "intake": 0, "mark_running": 0, "results": 0,
+        }
+        #: rounds paid by the LATEST _intake call OUTSIDE a tick (the
+        #: serve loop drains the bus itself, then calls
+        #: tick(intake=False)): folded into the next tick's counter so
+        #: serve-mode stats match the documented steady-state reading
+        #: (intake fetch + RUNNING flush). Overwritten, not accumulated:
+        #: on a saturated fleet the device-step gate can skip ticks for
+        #: seconds while intake keeps draining — summing those windows
+        #: would dump hundreds of rounds into one liveness tick's counter
+        #: and read as a per-key-loop regression to an operator following
+        #: the OPERATIONS.md diagnosis
+        self._in_tick = False
+        self._intake_rounds_carry = 0
         #: max seconds between device ticks when there is nothing to place.
         #: The device step also performs liveness detection (purge +
         #: in-flight redistribution), which must keep running on an idle or
@@ -292,7 +319,8 @@ class TpuPushDispatcher(TaskDispatcher):
         # siblings time to re-tighten before adoptions resume.
         self.publish_lease_timeout(self.lease_timeout)
         horizon = self._adoption_horizon()
-        known = {t.task_id for t in self.pending}
+        # the pending queue's persistent id index — no O(pending) walk
+        known = self.pending.task_ids()
         known.update(t.task_id for t in self._unclaimed)
         known.update(self._resident_tasks)
         # tasks whose (terminal) writes sit in the deferred buffer still read
@@ -556,9 +584,19 @@ class TpuPushDispatcher(TaskDispatcher):
             # task's current owner (zombie after a reclaim), or the task was
             # reclaimed at least once on its way to this worker
             suspicious = not from_owner or task_id in self.task_retries
-            self.record_result_safe(
-                task_id, data["status"], data["result"], first_wins=suspicious
-            )
+            if self._result_batch is not None:
+                # batched drain (drain_results_batched): the terminal
+                # write joins one pipelined finish_task_many flush after
+                # the drain — first_wins rides each item, and intra-batch
+                # ordering matches the per-message writes it replaces
+                self._result_batch.append(
+                    (task_id, data["status"], data["result"], suspicious)
+                )
+            else:
+                self.record_result_safe(
+                    task_id, data["status"], data["result"],
+                    first_wins=suspicious,
+                )
             self.n_results += 1
             a.heartbeat(wid)
             # Only the current owner's result releases the in-flight slot:
@@ -588,6 +626,23 @@ class TpuPushDispatcher(TaskDispatcher):
                 a.worker_free[row] = 0
                 a.worker_procs[row] = 0
                 self.log.info("worker row %d draining", int(row))
+
+    def drain_results_batched(self) -> int:
+        """Bounded worker-message drain with the RESULT store writes
+        coalesced: up to _DRAIN_CAP messages are decoded and bookkept
+        per-message (slots released, estimator fed), then every terminal
+        write flushes as ONE pipelined finish_task_many round instead of
+        one round trip per result. Direct _handle callers (tests, other
+        entry points) stay on the immediate per-result write — batching
+        only engages here, around a drain. Returns messages handled."""
+        self._result_batch = []
+        try:
+            n = self.drain_worker_messages(self.socket, self._handle)
+        finally:
+            batch, self._result_batch = self._result_batch, None
+            self._batch_sizes["results"] = len(batch)
+            self.record_results_safe(batch)
+        return n
 
     def _backlog_estimate_s(self) -> float | None:
         """Estimated seconds to drain the pending backlog at the current
@@ -647,6 +702,7 @@ class TpuPushDispatcher(TaskDispatcher):
 
     def stats(self) -> dict:
         a = self.arrays
+        spans = self.tracer.summary()
         now = self.clock()
         cached = getattr(self, "_backlog_cache", None)
         if cached is not None and now - cached[1] < self._BACKLOG_EST_TTL_S:
@@ -671,7 +727,16 @@ class TpuPushDispatcher(TaskDispatcher):
             "placement": a.placement,
             "liveness_period_s": self.liveness_period,
             "tasks_on_retry": len(self.task_retries),
-            "device_tick": self.tracer.summary().get("device_tick", {}),
+            "device_tick": spans.get("device_tick", {}),
+            # host data-plane phases (batched intake / act): spanned like
+            # the device step so operators can see where a tick's time goes
+            "intake_phase": spans.get("intake", {}),
+            "act_phase": spans.get("act", {}),
+            # the batching proof, live: pipelined store rounds paid by the
+            # last tick (bounded, NOT O(tasks)) and the last flush size of
+            # each batched write family
+            "store_round_trips_last_tick": self._tick_round_trips,
+            "batched_write_sizes": dict(self._batch_sizes),
             "estimator": (
                 self.estimator.stats() if self.estimator is not None else None
             ),
@@ -679,47 +744,95 @@ class TpuPushDispatcher(TaskDispatcher):
 
     # -- one scheduler tick ------------------------------------------------
     def _intake(self) -> None:
-        """Drain the announce bus into the pending buffer, bounded by the
-        padded batch size; ids already pending (e.g. adopted by a stranded
-        rescan while the same announce sat buffered in the subscription) are
-        dropped so a task is never dispatched twice."""
+        """Drain the announce bus into the pending buffer (one pipelined
+        record fetch per tick — poll_tasks), bounded by the padded batch
+        size; ids already pending (e.g. adopted by a stranded rescan while
+        the same announce sat buffered in the subscription) are dropped so
+        a task is never dispatched twice. Dedup probes the persistent
+        pending-id index (PendingQueue) instead of rebuilding a seen-set
+        from the whole deque every tick."""
+        with self.tracer.span("intake"):
+            rt0 = getattr(self.store, "n_round_trips", 0)
+            try:
+                self._intake_inner()
+            finally:
+                if not self._in_tick:
+                    # serve-loop intake (tick(intake=False) follows): carry
+                    # the latest window's rounds into the next tick's
+                    # counter — inside a tick they are already in its own
+                    # delta window
+                    self._intake_rounds_carry = (
+                        getattr(self.store, "n_round_trips", 0) - rt0
+                    )
+
+    def _intake_inner(self) -> None:
         room = self.arrays.max_pending - len(self.pending) - len(
             self._resident_tasks
         )
-        if room > 0:
-            seen = {t.task_id for t in self.pending}
-            seen.update(self._resident_tasks)
-            # tasks whose claim round hit an outage last time go first —
-            # their announces are long consumed, dropping them loses tasks
-            batch = []
-            while self._unclaimed and len(batch) < room:
-                t = self._unclaimed.popleft()
-                if t.task_id not in seen:
-                    seen.add(t.task_id)
-                    batch.append(t)
-            for t in self.poll_tasks(max(room - len(batch), 0)):
-                if t.task_id in seen:
-                    continue
-                seen.add(t.task_id)
+        if room <= 0:
+            return
+        batch: list[PendingTask] = []
+        batch_ids: set[str] = set()
+
+        def fresh(task_id: str) -> bool:
+            return (
+                task_id not in batch_ids
+                and task_id not in self.pending
+                and task_id not in self._resident_tasks
+            )
+
+        # tasks whose claim round hit an outage last time go first —
+        # their announces are long consumed, dropping them loses tasks
+        while self._unclaimed and len(batch) < room:
+            t = self._unclaimed.popleft()
+            if fresh(t.task_id):
+                batch_ids.add(t.task_id)
                 batch.append(t)
-            # shared fleets: one pipelined claim round decides which of
-            # these announces are OURS to dispatch (identity when not
-            # shared)
-            try:
-                self.pending.extend(self.claim_for_dispatch(batch))
-            except STORE_OUTAGE_ERRORS:
-                # park UNCLAIMED: dispatching without a claim could double
-                # against a sibling; the claim retries when the store is
-                # back (siblings are equally stuck, so nothing races ahead)
-                self._unclaimed.extend(batch)
-                raise
+        try:
+            polled = self.poll_tasks(max(room - len(batch), 0))
+        except STORE_OUTAGE_ERRORS:
+            # the batch so far came OFF _unclaimed: re-park it (still
+            # unclaimed, announces still spent) before propagating, or the
+            # pop above would have silently dropped those tasks
+            self._unclaimed.extend(batch)
+            raise
+        for t in polled:
+            if not fresh(t.task_id):
+                continue
+            batch_ids.add(t.task_id)
+            batch.append(t)
+        self._batch_sizes["intake"] = len(batch)
+        # shared fleets: one pipelined claim round decides which of
+        # these announces are OURS to dispatch (identity when not
+        # shared)
+        try:
+            self.pending.extend(self.claim_for_dispatch(batch))
+        except STORE_OUTAGE_ERRORS:
+            # park UNCLAIMED: dispatching without a claim could double
+            # against a sibling; the claim retries when the store is
+            # back (siblings are equally stuck, so nothing races ahead)
+            self._unclaimed.extend(batch)
+            raise
 
     def tick(self, intake: bool = True) -> int:
         """Intake + device step + act on outputs. Returns tasks dispatched.
 
         ``intake=False`` when the caller just drained the bus itself (the
         serve loop does, to evaluate the device-step gate) — a second drain
-        microseconds later would only rebuild the seen-set for nothing."""
+        microseconds later would only re-probe the pending index for
+        nothing."""
+        rt0 = getattr(self.store, "n_round_trips", 0)
+        carry, self._intake_rounds_carry = self._intake_rounds_carry, 0
+        self._in_tick = True
+        try:
+            return self._tick_inner(intake)
+        finally:
+            self._in_tick = False
+            self._tick_round_trips = carry + (
+                getattr(self.store, "n_round_trips", 0) - rt0
+            )
+
+    def _tick_inner(self, intake: bool) -> int:
         if self.resident:
             return self._tick_resident(intake)
         a = self.arrays
@@ -738,9 +851,14 @@ class TpuPushDispatcher(TaskDispatcher):
                 continue
             batch.append(t)
         overflow = self.pending
-        self.pending = deque()
+        self.pending = PendingQueue()
         requeued: deque[PendingTask] = deque()
         still_pending: deque[PendingTask] = deque()
+        #: RUNNING transitions of this tick's common path (no retries),
+        #: flushed as ONE pipelined round after the send loop — same
+        #: after-send ordering per task, same degrade-on-outage contract
+        #: as the per-task mark_running_safe it replaces
+        running_batch: list[str] = []
         sent = 0
         # Exception safety: a store outage may raise anywhere below. The
         # finally-block reassembles the queue so no popped task is ever
@@ -780,51 +898,99 @@ class TpuPushDispatcher(TaskDispatcher):
                 requeued.append,
             )
 
+            # zombie-finished pre-pass: ONE pipelined status read over the
+            # retry-carrying slice of the batch replaces the per-retry
+            # task_is_finished round trip in the send loop below. An
+            # outage here aborts the tick with restore_from still 0, so
+            # the whole batch is restored — the same retry-next-tick
+            # contract the per-task probe had.
+            finished = self._finished_probe(
+                [t.task_id for t in batch if t.retries]
+            )
+
             # act: send assignments
-            assignment = np.asarray(out.assignment)[: len(batch)]
-            for idx, (task, row) in enumerate(zip(batch, assignment)):
-                restore_from = idx
-                row = int(row)
-                if row < 0 or row not in a.row_ids:
-                    still_pending.append(task)
+            with self.tracer.span("act"):
+                assignment = np.asarray(out.assignment)[: len(batch)]
+                for idx, (task, row) in enumerate(zip(batch, assignment)):
+                    restore_from = idx
+                    row = int(row)
+                    if row < 0 or row not in a.row_ids:
+                        still_pending.append(task)
+                        restore_from = idx + 1
+                        continue
+                    if task.retries and task.task_id in finished:
+                        # reclaimed task finished meanwhile by its zombie
+                        # worker: re-dispatching would regress the record
+                        # to RUNNING
+                        self._forget_task_state(task.task_id)
+                        restore_from = idx + 1
+                        continue
+                    try:
+                        # reserve tracking BEFORE sending: a task on the
+                        # wire but absent from the inflight table could
+                        # never be re-dispatched
+                        a.inflight_add(task.task_id, row)
+                    except RuntimeError:
+                        still_pending.append(task)  # inflight full: wait
+                        restore_from = idx + 1
+                        continue
+                    wid = a.row_ids[row]
+                    self.socket.send_multipart(
+                        [wid, m.encode(m.TASK, **task.task_message_kwargs())]
+                    )
+                    # on the wire + tracked: must NOT be restored on an
+                    # outage
                     restore_from = idx + 1
-                    continue
-                if task.retries and self.task_is_finished(task.task_id):
-                    # reclaimed task finished meanwhile by its zombie worker:
-                    # re-dispatching would regress the record to RUNNING
-                    self._forget_task_state(task.task_id)
-                    restore_from = idx + 1
-                    continue
-                try:
-                    # reserve tracking BEFORE sending: a task on the wire but
-                    # absent from the inflight table could never be
-                    # re-dispatched
-                    a.inflight_add(task.task_id, row)
-                except RuntimeError:
-                    still_pending.append(task)  # inflight table full: wait
-                    restore_from = idx + 1
-                    continue
-                wid = a.row_ids[row]
-                self.socket.send_multipart(
-                    [wid, m.encode(m.TASK, **task.task_message_kwargs())]
-                )
-                # on the wire + tracked: must NOT be restored on an outage
-                restore_from = idx + 1
-                self.mark_running_safe(
-                    task.task_id,
-                    redispatch=bool(task.retries),
-                    retries=task.retries,
-                )
-                a.worker_free[row] -= 1
-                sent += 1
-                self.n_dispatched += 1
+                    if task.retries:
+                        # re-dispatch path: per-task, so the redispatch
+                        # declaration and the persisted reclaim count keep
+                        # riding the RUNNING write (rare — reclaim events)
+                        self.mark_running_safe(
+                            task.task_id,
+                            redispatch=True,
+                            retries=task.retries,
+                        )
+                    else:
+                        running_batch.append(task.task_id)
+                    a.worker_free[row] -= 1
+                    sent += 1
+                    self.n_dispatched += 1
         except STORE_OUTAGE_ERRORS:
             for t in batch[restore_from:]:
                 still_pending.append(t)
             raise  # start() logs + backs off
         finally:
-            self.pending = requeued + still_pending + overflow
+            # queue reassembly FIRST: the RUNNING flush below can itself
+            # raise (a non-outage store error reply — mark_running_many
+            # only swallows the outage family), and self.pending is still
+            # the empty placeholder until this line — flushing first would
+            # lose every requeued/still-pending/overflow task on that path
+            merged = PendingQueue(requeued)
+            merged.extend(still_pending)
+            merged.extend(overflow)
+            self.pending = merged
+            # coalesced RUNNING flush — in the finally so tasks already on
+            # the wire get their marks even if a later exception (zmq, not
+            # store: store reads can no longer raise inside the send loop)
+            # aborts the tick; degrades internally on an outage
+            self._batch_sizes["mark_running"] = len(running_batch)
+            self.mark_running_many(running_batch)
         return sent
+
+    def _finished_probe(self, task_ids: list[str]) -> set[str]:
+        """One pipelined status read over ``task_ids``; returns the ids a
+        re-dispatch must drop (terminal, vanished, or unparseable — the
+        same safe side as task_is_finished). Raises on a store outage."""
+        if not task_ids:
+            return set()
+        statuses = self.store.hget_many(task_ids, FIELD_STATUS)
+        return {
+            tid
+            for tid, status in zip(task_ids, statuses)
+            if TaskStatus.terminal_str(
+                status if isinstance(status, str) else None, unknown=True
+            )
+        }
 
     def _tick_resident(self, intake: bool = True) -> int:
         """The --resident tick: the pending set stays on device between
@@ -1029,61 +1195,89 @@ class TpuPushDispatcher(TaskDispatcher):
                     undo(task, row)
             raise
 
+        # -- zombie-finished pre-pass: one pipelined status read over the
+        # retry-carrying slice of the placements (was one round trip per
+        # retried task inside the loop). Outage degradation matches the old
+        # per-task probe: affected placements flow back and are recomputed
+        # next tick; everything else still dispatches this tick.
+        finished: set[str] | None
+        try:
+            finished = self._finished_probe(
+                [
+                    tid
+                    for tid, _ in res.placed
+                    if tid in self._resident_tasks
+                    and self._resident_tasks[tid].retries
+                ]
+            )
+        except STORE_OUTAGE_ERRORS as exc:
+            self.note_store_outage(exc, pause=0)
+            finished = None  # probe unanswered: retried placements undo
+
         # -- act on placements (per-task outage degradation: a task whose
-        # zombie-finished probe can't be answered flows back instead of
-        # aborting the loop; mark_running_safe never raises) ---------------
-        for task_id, row in res.placed:
-            task = self._resident_tasks.pop(task_id, None)
-            if task is None:
-                continue
-            try:
-                dropped = self.drop_if_cancelled(task_id)
-            except STORE_OUTAGE_ERRORS as exc:
-                # same degradation as the zombie-finished probe below: the
-                # placement flows back and is recomputed next tick
-                self.note_store_outage(exc, pause=0)
-                undo(task, row)
-                continue
-            if dropped:
-                # cancelled while device-pending: the kernel already
-                # consumed the slot, so return the capacity (the free diff
-                # carries the correction up) — but never dispatch, and
-                # never re-queue
-                self._forget_task_state(task_id)
-                a.release_slot(row)
-                continue
-            if row not in a.row_ids:
-                undo(task, row)
-                continue
-            if task.retries:
-                try:
-                    finished = self.task_is_finished(task.task_id)
-                except STORE_OUTAGE_ERRORS as exc:
-                    self.note_store_outage(exc, pause=0)
-                    undo(task, row)
-                    continue
-                if finished:
-                    # reclaimed task finished meanwhile by its zombie
-                    # worker: re-dispatching would regress the record
-                    self._forget_task_state(task.task_id)
-                    a.release_slot(row)
-                    continue
-            try:
-                a.inflight_add(task.task_id, row)
-            except RuntimeError:
-                undo(task, row)  # inflight table full: wait a tick
-                continue
-            wid = a.row_ids[row]
-            self.socket.send_multipart(
-                [wid, m.encode(m.TASK, **task.task_message_kwargs())]
-            )
-            self.mark_running_safe(
-                task.task_id,
-                redispatch=bool(task.retries),
-                retries=task.retries,
-            )
-            sent += 1
-            self.n_dispatched += 1
+        # cancel probe can't be answered flows back instead of aborting
+        # the loop; the batched RUNNING flush degrades internally) ----------
+        running_batch: list[str] = []
+        try:
+            with self.tracer.span("act"):
+                for task_id, row in res.placed:
+                    task = self._resident_tasks.pop(task_id, None)
+                    if task is None:
+                        continue
+                    try:
+                        dropped = self.drop_if_cancelled(task_id)
+                    except STORE_OUTAGE_ERRORS as exc:
+                        # the placement flows back and is recomputed next
+                        # tick
+                        self.note_store_outage(exc, pause=0)
+                        undo(task, row)
+                        continue
+                    if dropped:
+                        # cancelled while device-pending: the kernel already
+                        # consumed the slot, so return the capacity (the
+                        # free diff carries the correction up) — but never
+                        # dispatch, and never re-queue
+                        self._forget_task_state(task_id)
+                        a.release_slot(row)
+                        continue
+                    if row not in a.row_ids:
+                        undo(task, row)
+                        continue
+                    if task.retries:
+                        if finished is None:
+                            undo(task, row)  # probe hit the outage above
+                            continue
+                        if task.task_id in finished:
+                            # reclaimed task finished meanwhile by its
+                            # zombie worker: re-dispatching would regress
+                            # the record
+                            self._forget_task_state(task.task_id)
+                            a.release_slot(row)
+                            continue
+                    try:
+                        a.inflight_add(task.task_id, row)
+                    except RuntimeError:
+                        undo(task, row)  # inflight table full: wait a tick
+                        continue
+                    wid = a.row_ids[row]
+                    self.socket.send_multipart(
+                        [wid, m.encode(m.TASK, **task.task_message_kwargs())]
+                    )
+                    if task.retries:
+                        # per-task on the re-dispatch path: the redispatch
+                        # declaration + persisted reclaim count ride along
+                        self.mark_running_safe(
+                            task.task_id, redispatch=True, retries=task.retries
+                        )
+                    else:
+                        running_batch.append(task.task_id)
+                    sent += 1
+                    self.n_dispatched += 1
+        finally:
+            # coalesced RUNNING flush, after every send (same contract as
+            # the batch tick's finally)
+            self._batch_sizes["mark_running"] = len(running_batch)
+            self.mark_running_many(running_batch)
         return sent
 
     def start(self, max_results: int | None = None) -> int:
@@ -1127,9 +1321,11 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.note_store_outage(exc)
                 events = dict(self.poller.poll(max(1, int(self.tick_period * 1000))))
                 if self.socket in events:
-                    # bounded drain (base.drain_worker_messages): a
-                    # flooding worker must not starve the device tick
-                    self.drain_worker_messages(self.socket, self._handle)
+                    # bounded drain with coalesced result writes: a
+                    # flooding worker must not starve the device tick, and
+                    # a result burst must not pay one store round trip per
+                    # result
+                    self.drain_results_batched()
                 now = self.clock()
                 if now - last_tick >= self.tick_period:
                     try:

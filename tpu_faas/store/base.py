@@ -113,6 +113,14 @@ class Subscription(abc.ABC):
 class TaskStore(abc.ABC):
     """Hash-per-task store + announce bus."""
 
+    #: Monotonic count of wire round trips this handle has paid (a
+    #: pipelined batch counts as ONE). Only networked backends increment
+    #: it (RespStore); in-process backends leave it 0. Observability only:
+    #: the tpu-push dispatcher publishes per-tick deltas of this so an
+    #: operator can SEE that the data plane stays at a bounded number of
+    #: pipelined rounds per tick instead of O(tasks) round trips.
+    n_round_trips: int = 0
+
     # -- raw hash ops ------------------------------------------------------
     @abc.abstractmethod
     def hset(self, key: str, fields: Mapping[str, str]) -> None: ...
@@ -302,6 +310,14 @@ class TaskStore(abc.ABC):
         task history grows."""
         return [self.hget(k, field) for k in keys]
 
+    def hgetall_many(self, keys: list[str]) -> list[dict[str, str]]:
+        """Full records of many hashes, one dict per key ({} for a missing
+        key — same shape as hgetall). Default: a loop; the RESP client
+        pipelines one round trip. This is the dispatcher's batched-intake
+        primitive: one round fetches every announced task's record instead
+        of one hgetall per announce."""
+        return [self.hgetall(k) for k in keys]
+
     def create_tasks(
         self,
         tasks: list[tuple],  # (task_id, fn_payload, params[, extra_fields])
@@ -337,6 +353,37 @@ class TaskStore(abc.ABC):
         if extra_fields:
             fields.update(extra_fields)
         self.hset(task_id, fields)
+
+    def set_status_many(
+        self,
+        status: TaskStatus | str,
+        items: list[tuple[str, Mapping[str, str] | None]],
+    ) -> None:
+        """ONE status across many tasks, each item (task_id, extra_fields).
+        The single shared ``status`` argument (rather than a status per
+        item) is deliberate: it keeps the written status a static literal
+        at call sites, so the protocol checker
+        (tpu_faas/analysis/protocol.py) can prove a batch call never
+        writes a terminal status — exactly as it proves plain set_status.
+        Per-item ``extra_fields`` carry the ownership lease stamps of the
+        dispatcher's coalesced RUNNING flush. Default: a loop; the RESP
+        client pipelines one round trip."""
+        for task_id, extra in items:
+            self.set_status(task_id, status, extra_fields=extra)
+
+    def finish_task_many(
+        self, items: list[tuple[str, TaskStatus | str, str, bool]]
+    ) -> None:
+        """Batch finish_task, each item (task_id, status, result,
+        first_wins). Sequential per-item semantics are the contract —
+        including INTRA-batch first_wins: an earlier item's terminal write
+        freezes a later first_wins item for the same id, exactly as if the
+        items were applied one by one. Default: a loop; the RESP client
+        collapses the batch into one status pre-read for the first_wins
+        slice plus one pipelined write+announce round — the dispatcher's
+        result drain and its deferred-result replay ride this."""
+        for task_id, status, result, first_wins in items:
+            self.finish_task(task_id, status, result, first_wins=first_wins)
 
     def hset_many(self, items: list[tuple[str, Mapping[str, str]]]) -> None:
         """Field writes across many hashes. Default: a loop; the RESP client

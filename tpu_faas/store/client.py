@@ -174,6 +174,11 @@ class RespStore(TaskStore):
         self._lock = threading.Lock()
         self._closed = False
         self._conn: _Conn | None = _Conn(host, port)
+        #: wire round trips paid by this handle (TaskStore.n_round_trips
+        #: contract: one pipelined batch = one). Written under the command
+        #: lock; read lock-free by stats pollers (a torn read of an int is
+        #: impossible in CPython, and the counter is observability only).
+        self.n_round_trips = 0
 
     def _command(self, *parts: str | bytes | int):
         """Run one command; transparently reconnect once if the server
@@ -209,6 +214,7 @@ class RespStore(TaskStore):
             try:
                 # deliberate I/O under lock: this lock EXISTS to serialize
                 # use of the one connection (RESP replies are positional)
+                self.n_round_trips += 1
                 return self._conn.command(*parts)  # faas: allow(locks.blocking-call-under-lock)
             except (ConnectionError, TimeoutError):
                 # TimeoutError too: the reply may still arrive later, so the
@@ -222,6 +228,7 @@ class RespStore(TaskStore):
                 if str(parts[0]).upper() in _NON_IDEMPOTENT:
                     raise
                 # same serialized-connection justification as above
+                self.n_round_trips += 1  # the retry is a second round trip
                 return conn.command(*parts)  # faas: allow(locks.blocking-call-under-lock)
 
     def pipeline(self, commands: list[tuple]) -> list:
@@ -244,6 +251,7 @@ class RespStore(TaskStore):
             try:
                 # deliberate I/O under lock (see _command): one connection,
                 # positional replies — interleaved pipelines would desync
+                self.n_round_trips += 1  # N commands, one round trip
                 conn.send_many(commands)  # faas: allow(locks.blocking-call-under-lock)
                 out: list = []
                 for _ in commands:
@@ -274,17 +282,11 @@ class RespStore(TaskStore):
     def hmget(self, key: str, fields: list[str]) -> list[str | None]:
         return self._command("HMGET", key, *fields)
 
-    def finish_task(
-        self,
-        task_id: str,
-        status,
-        result: str,
-        first_wins: bool = False,
-    ) -> None:
-        """Base semantics (terminal write + RESULTS_CHANNEL announce), but
-        the write and the announce ride ONE pipelined round trip — the
-        result path is the dispatcher's per-task hot path and must not grow
-        a second RTT for the wake-up feature."""
+    @staticmethod
+    def _finish_cmds(task_id: str, status, result: str, now: str) -> list[tuple]:
+        """The terminal-write command triple shared by finish_task and
+        finish_task_many — ONE builder, so the single and batched forms can
+        never desynchronize on the record contract."""
         from tpu_faas.core.task import (
             FIELD_FINAL_AT,
             FIELD_FINAL_STATUS,
@@ -293,10 +295,7 @@ class RespStore(TaskStore):
             FIELD_STATUS,
         )
 
-        if first_wins and self._result_frozen(task_id):
-            return
-        now = repr(time.time())
-        cmds = [
+        return [
             (
                 "HSET", task_id,
                 FIELD_STATUS, str(status),
@@ -310,6 +309,21 @@ class RespStore(TaskStore):
             ("HDEL", LIVE_INDEX_KEY, task_id),  # drop from the live index
             ("PUBLISH", RESULTS_CHANNEL, task_id),
         ]
+
+    def finish_task(
+        self,
+        task_id: str,
+        status,
+        result: str,
+        first_wins: bool = False,
+    ) -> None:
+        """Base semantics (terminal write + RESULTS_CHANNEL announce), but
+        the write and the announce ride ONE pipelined round trip — the
+        result path is the dispatcher's per-task hot path and must not grow
+        a second RTT for the wake-up feature."""
+        if first_wins and self._result_frozen(task_id):
+            return
+        cmds = self._finish_cmds(task_id, status, result, repr(time.time()))
         try:
             replies = self.pipeline(cmds)
         except (ConnectionError, TimeoutError):
@@ -383,6 +397,91 @@ class RespStore(TaskStore):
     # -- pipelined batch ops ----------------------------------------------
     def hget_many(self, keys, field: str):
         return self.pipeline([("HGET", k, field) for k in keys])
+
+    def hgetall_many(self, keys):
+        """Pipelined HGETALL over many keys — the batched-intake read: one
+        round trip fetches every announced task's record. A per-key error
+        reply (a WRONGTYPE key some foreign producer wrote) degrades to {}
+        for THAT key — the same shape as a missing record, which intake
+        skips with a warning — instead of raising and poisoning the whole
+        batch: one bad key must never wedge the other N-1 announces (or,
+        parked and re-drained, wedge intake forever)."""
+        if not keys:
+            return []
+        out: list[dict[str, str]] = []
+        for flat in self.pipeline([("HGETALL", k) for k in keys]):
+            if isinstance(flat, resp.RespError):
+                out.append({})
+                continue
+            out.append(dict(zip(flat[0::2], flat[1::2])))
+        return out
+
+    def set_status_many(self, status, items) -> None:
+        """Pipelined multi-task status write (base semantics: one shared
+        status, per-item extra fields) — the dispatcher's coalesced
+        RUNNING flush pays one round trip per tick, not one per task."""
+        from tpu_faas.core.task import FIELD_STATUS
+
+        if not items:
+            return
+        cmds = []
+        for task_id, extra in items:
+            fields = {FIELD_STATUS: str(status), **(extra or {})}
+            cmds.append(
+                ("HSET", task_id, *(p for kv in fields.items() for p in kv))
+            )
+        errors = [
+            r for r in self.pipeline(cmds) if isinstance(r, resp.RespError)
+        ]
+        if errors:
+            raise errors[0]
+
+    def finish_task_many(self, items) -> None:
+        """Batch finish_task in a bounded number of round trips: one
+        pipelined status pre-read for the first_wins slice (the frozen
+        probe ``_result_frozen`` pays per task on the loop default), then
+        every surviving write+index-drop+announce in ONE pipelined round —
+        each task's announce still follows its own record write (RESP
+        pipelines execute in order). Intra-batch first_wins is preserved
+        by tracking ids already written earlier in the batch.
+
+        Like the single finish_task, a connection loss retries the whole
+        round once on a fresh connection: HSET replays to the same end
+        state and duplicate RESULTS_CHANNEL publishes are tolerated
+        spurious wakes."""
+        from tpu_faas.core.task import FIELD_STATUS, TaskStatus
+
+        if not items:
+            return
+        fw_ids = list(
+            dict.fromkeys(t_id for t_id, _, _, fw in items if fw)
+        )
+        frozen: set[str] = set()
+        if fw_ids:
+            for t_id, status in zip(fw_ids, self.hget_many(fw_ids, FIELD_STATUS)):
+                if isinstance(status, resp.RespError):
+                    status = None  # unparseable: freeze (never overwrite)
+                if status == str(TaskStatus.CANCELLED):
+                    continue  # a late real result lawfully overwrites
+                if TaskStatus.terminal_str(status, unknown=True):
+                    frozen.add(t_id)
+        now = repr(time.time())
+        cmds: list[tuple] = []
+        written: set[str] = set()
+        for task_id, status, result, first_wins in items:
+            if first_wins and (task_id in written or task_id in frozen):
+                continue
+            cmds.extend(self._finish_cmds(task_id, status, result, now))
+            written.add(task_id)
+        if not cmds:
+            return
+        try:
+            replies = self.pipeline(cmds)
+        except (ConnectionError, TimeoutError):
+            replies = self.pipeline(cmds)  # same rationale as finish_task
+        errors = [r for r in replies if isinstance(r, resp.RespError)]
+        if errors:
+            raise errors[0]
 
     def create_tasks(self, tasks, channel: str = TASKS_CHANNEL) -> None:
         from tpu_faas.core.task import (

@@ -440,6 +440,24 @@ class RaceCheckStore(TaskStore):
         # relies on hmget being ONE round trip on RESP backends
         return self.inner.hmget(key, fields)
 
+    def hget_many(self, keys: list[str], field: str) -> list[str | None]:
+        return self.inner.hget_many(keys, field)
+
+    def hgetall_many(self, keys: list[str]) -> list[dict[str, str]]:
+        # reads pass through pipelined; only writes need the monitor.
+        # The batch WRITE forms (set_status_many / finish_task_many /
+        # hset_many) deliberately keep the base per-item loop defaults:
+        # each item then flows through the intercepted hset above, so a
+        # race-checked run trades the pipelining away for full observation
+        return self.inner.hgetall_many(keys)
+
+    @property
+    def n_round_trips(self) -> int:
+        # surface the wrapped backend's counter: a dispatcher wrapped for
+        # race checking must still publish round-trip deltas (inflated by
+        # the per-item write loops above — that is the observation tax)
+        return self.inner.n_round_trips
+
     def setnx_field(self, key: str, field: str, value: str) -> tuple[bool, str]:
         # pass through for atomicity; not a lifecycle write the monitor
         # models (the claim precedes the task's create)
