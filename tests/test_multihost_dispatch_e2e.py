@@ -29,6 +29,18 @@ from tests.test_workers_e2e import _spawn_worker
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+import pytest
+
+from tests.test_multihost import cpu_pod_supported
+
+if not cpu_pod_supported():
+    pytest.skip(
+        "this JAX cannot simulate a multi-process CPU pod "
+        "(jax_num_cpu_devices / jax.shard_map missing)",
+        allow_module_level=True,
+    )
+
+
 
 def _free_port() -> int:
     s = socket.socket()

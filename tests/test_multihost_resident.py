@@ -23,6 +23,18 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+import pytest
+
+from tests.test_multihost import cpu_pod_supported
+
+if not cpu_pod_supported():
+    pytest.skip(
+        "this JAX cannot simulate a multi-process CPU pod "
+        "(jax_num_cpu_devices / jax.shard_map missing)",
+        allow_module_level=True,
+    )
+
+
 
 def _single_process_reference(placement: str) -> tuple[int, int]:
     """The child's exact scenario on a plain single-device

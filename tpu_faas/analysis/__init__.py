@@ -15,6 +15,10 @@ small shared framework (:mod:`tpu_faas.analysis.core`):
   ``pallas_call`` trace.
 - :mod:`tpu_faas.analysis.locks` — blocking calls made while holding a
   lock, and inconsistent multi-lock acquisition order across modules.
+- :mod:`tpu_faas.analysis.obs` — wall-clock latency math
+  (``time.time()`` subtractions) in dispatch/worker hot paths that
+  belongs to the telemetry layer's monotonic-anchored API
+  (tpu_faas/obs) instead.
 
 Run ``python -m tpu_faas.analysis [paths]`` (exit 1 on non-baselined
 error-severity findings); suppress a deliberate site with a trailing
@@ -33,11 +37,14 @@ from tpu_faas.analysis.core import (
     write_baseline,
 )
 from tpu_faas.analysis.locks import LockDisciplineChecker
+from tpu_faas.analysis.obs import ObsChecker
 from tpu_faas.analysis.protocol import ProtocolChecker
 from tpu_faas.analysis.tracesafety import TraceSafetyChecker
 
 #: The default checker suite, in report order.
-ALL_CHECKERS = (ProtocolChecker, TraceSafetyChecker, LockDisciplineChecker)
+ALL_CHECKERS = (
+    ProtocolChecker, TraceSafetyChecker, LockDisciplineChecker, ObsChecker
+)
 
 __all__ = [
     "ALL_CHECKERS",
@@ -45,6 +52,7 @@ __all__ = [
     "Finding",
     "LockDisciplineChecker",
     "Module",
+    "ObsChecker",
     "ProtocolChecker",
     "TraceSafetyChecker",
     "load_baseline",

@@ -621,15 +621,37 @@ def config_9_host_dispatch() -> dict:
 
     Publishes ``host_dispatch_tasks_per_s`` plus the store-round-trips-per-
     tick counter, pinning the batched data plane's O(1)-rounds-per-tick
-    claim in the BENCH trajectory. Shape via TPU_FAAS_BENCH_HOST_SHAPE=
+    claim in the BENCH trajectory. Mid-run the dispatcher's ``/metrics`` is
+    scraped over HTTP and validated against the strict exposition grammar
+    (tpu_faas/obs/expofmt) with the required series present —
+    ``metrics_scrape_ok``/``metrics_missing`` in the row let the CI smoke
+    lane fail on malformed or incomplete telemetry, not just on
+    throughput. Shape via TPU_FAAS_BENCH_HOST_SHAPE=
     "tasks,workers,procs" (fleet capacity must cover the task count: no
     results flow back to free slots); the CI smoke lane runs "200,64,4".
     """
     import os
+    import urllib.request
 
     from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.obs.expofmt import parse_exposition, require_series
     from tpu_faas.store.launch import make_store, start_store_thread
     from tpu_faas.worker import messages as m
+
+    #: series the dispatcher scrape must always carry (eagerly registered,
+    #: so absence means a regression in the obs wiring, not "no traffic")
+    required_series = [
+        "tpu_faas_dispatcher_pending_tasks",
+        "tpu_faas_dispatcher_inflight_tasks",
+        "tpu_faas_dispatcher_workers_registered",
+        "tpu_faas_dispatcher_tasks_dispatched_total",
+        "tpu_faas_dispatcher_results_total",
+        "tpu_faas_task_stage_seconds",
+        "tpu_faas_span_seconds",
+        "tpu_faas_jit_recompiles_total",
+        "tpu_faas_tick_shape",
+        "tpu_faas_store_round_trips_total",
+    ]
 
     shape = os.environ.get("TPU_FAAS_BENCH_HOST_SHAPE", "20000,4096,8")
     n_tasks, n_workers, n_procs = (int(x) for x in shape.split(","))
@@ -665,14 +687,36 @@ def config_9_host_dispatch() -> dict:
                     for i in range(lo, min(lo + chunk, n_tasks))
                 ]
             )
+        stats_server = disp.serve_stats(0)
+        stats_port = stats_server.server_address[1]
         warm = disp.n_dispatched  # 0 unless the empty tick found strays
         rounds: list[int] = []
+        scrape_ok: bool | None = None
+        scrape_missing: list[str] = []
+        scrape_error = ""
         t0 = time.perf_counter()
         deadline = t0 + 600.0
         while disp.n_dispatched < n_tasks and time.perf_counter() < deadline:
             rt0 = store.n_round_trips
             disp.tick()
             rounds.append(store.n_round_trips - rt0)
+            if scrape_ok is None and disp.n_dispatched >= n_tasks // 2:
+                # mid-run scrape: the exposition must be valid and complete
+                # WHILE the hot loop runs, not just at rest
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{stats_port}/metrics", timeout=10
+                    ) as resp:
+                        families = parse_exposition(
+                            resp.read().decode("utf-8")
+                        )
+                    scrape_missing = require_series(
+                        families, required_series
+                    )
+                    scrape_ok = not scrape_missing
+                except Exception as exc:  # malformed exposition included
+                    scrape_ok = False
+                    scrape_error = f"{type(exc).__name__}: {exc}"
         elapsed = time.perf_counter() - t0
         spans = disp.tracer.summary()
         return {
@@ -692,6 +736,13 @@ def config_9_host_dispatch() -> dict:
             "device_tick_p50_ms": round(
                 spans.get("device_tick", {}).get("p50", 0.0) * 1e3, 3
             ),
+            "jit_recompiles": disp.profiler.n_signatures,
+            # the mid-run /metrics scrape verdict (False on malformed
+            # exposition or a scrape that never happened; the missing list
+            # names absent required series)
+            "metrics_scrape_ok": bool(scrape_ok),
+            "metrics_missing": scrape_missing,
+            "metrics_scrape_error": scrape_error,
         }
     finally:
         disp.socket.close(linger=0)

@@ -38,6 +38,12 @@ class ExecutionResult(NamedTuple):
     #: and feeds the dispatcher's runtime estimator (sched/estimator.py).
     #: None on paths that never executed (cancelled futures, broken pools).
     elapsed: float | None = None
+    #: epoch seconds when the child began executing; rides the RESULT
+    #: message as `started_at` so the dispatcher's task timeline
+    #: (tpu_faas/obs/trace.py) gets exec_start/exec_end events measured at
+    #: the source. `started_at + elapsed` is the exec-end stamp. None on
+    #: paths that never executed.
+    started_at: float | None = None
 
 
 class TaskTimeout(BaseException):
@@ -89,6 +95,7 @@ def execute_fn(
     """
     import time
 
+    t0_wall = time.time()
     t0 = time.perf_counter()
     try:
         res = _execute_guarded(task_id, ser_fn, ser_params, timeout)
@@ -110,7 +117,9 @@ def execute_fn(
         res = ExecutionResult(
             task_id, str(TaskStatus.CANCELLED), serialize(exc)
         )
-    return res._replace(elapsed=time.perf_counter() - t0)
+    return res._replace(
+        elapsed=time.perf_counter() - t0, started_at=t0_wall
+    )
 
 
 def _execute_guarded(

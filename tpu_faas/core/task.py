@@ -78,6 +78,15 @@ FIELD_FINISHED_AT = "finished_at"
 FIELD_FINAL_STATUS = "final_status"
 FIELD_FINAL_AT = "final_finished_at"
 
+#: Optional submit stamp (epoch seconds as str), written by the gateway in
+#: the create-task hash write. Feeds the first event of the per-task
+#: lifecycle timeline (tpu_faas/obs/trace.py): the dispatcher reads it at
+#: intake so queue-wait and end-to-end latency are measurable from the
+#: client's submit, not just from announce receipt. Absent on tasks from
+#: hand-rolled reference-style producers — the timeline simply starts at
+#: its first dispatcher-side event.
+FIELD_SUBMITTED_AT = "submitted_at"
+
 #: Written (epoch seconds as str) with every RUNNING mark and refreshed
 #: periodically by the dispatcher that owns the task's worker. A RUNNING
 #: record whose lease has gone stale has no live owner left — its worker

@@ -69,7 +69,7 @@ def main(argv: list[str] | None = None) -> None:
     )
     ap.add_argument(
         "--stats-port", type=int, default=0,
-        help="serve GET /stats (JSON) on this port; 0 = off",
+        help="serve the observability surface on this port — GET /stats (JSON), /metrics (Prometheus), /trace/<task_id> (lifecycle timeline); 0 = off",
     )
     ap.add_argument(
         "--rescan", type=float, default=10.0,

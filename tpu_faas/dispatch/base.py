@@ -37,10 +37,13 @@ from tpu_faas.core.task import (
     FIELD_PRIORITY,
     FIELD_RECLAIMS,
     FIELD_STATUS,
+    FIELD_SUBMITTED_AT,
     FIELD_TIMEOUT,
     TaskStatus,
     claim_field_for,
 )
+from tpu_faas.obs import REGISTRY, MetricsRegistry, TaskTraceBook
+from tpu_faas.obs import metrics as obs_metrics
 from tpu_faas.store.base import (
     CANCEL_ANNOUNCE_PREFIX,
     DISPATCHERS_KEY,
@@ -50,7 +53,7 @@ from tpu_faas.store.base import (
     TaskStore,
 )
 from tpu_faas.store.launch import make_store
-from tpu_faas.utils.logging import get_logger
+from tpu_faas.utils.logging import get_logger, log_ctx
 
 #: Exceptions treated as a transient store outage (restart, network blip).
 #: Deliberately NOT plain OSError: zmq.ZMQError subclasses OSError, and a
@@ -101,6 +104,10 @@ class PendingTask:
     #: observed runtimes), stamped at batch-build time; an explicit client
     #: cost hint still wins — the operator knows things the EWMA can't
     learned: float | None = None
+    #: gateway submit stamp (FIELD_SUBMITTED_AT, epoch seconds), parsed at
+    #: intake and fed to the task timeline; None for reference-style
+    #: producers that never stamp it
+    submitted_at: float | None = None
 
     def task_message_kwargs(self) -> dict:
         """The TASK wire message's payload fields (timeout rides along so
@@ -149,6 +156,7 @@ class PendingTask:
         # forever; a non-finite timeout would wedge setitimer
         cost = _parse_positive_finite(fields.get(FIELD_COST))
         timeout = _parse_positive_finite(fields.get(FIELD_TIMEOUT))
+        submitted_at = _parse_positive_finite(fields.get(FIELD_SUBMITTED_AT))
         return cls(
             task_id,
             fields.get(FIELD_FN, ""),
@@ -157,6 +165,7 @@ class PendingTask:
             priority=priority,
             cost=cost,
             timeout=timeout,
+            submitted_at=submitted_at,
         )
 
 
@@ -234,6 +243,81 @@ class TaskDispatcher:
         self.channel = channel
         self.subscriber = self.store.subscribe(channel)
         self.log = get_logger(type(self).__name__)
+        #: PRIVATE metrics registry (tpu_faas/obs): tests build dispatchers
+        #: by the dozen in one process, so instance-scoped series live here
+        #: and /metrics renders this registry concatenated with the
+        #: process-global one (store round trips, worker-pool counters)
+        self.metrics = MetricsRegistry()
+        self.m_dispatched = self.metrics.counter(
+            "tpu_faas_dispatcher_tasks_dispatched_total",
+            "Tasks sent to workers (re-dispatches included)",
+        )
+        self.m_results = self.metrics.counter(
+            "tpu_faas_dispatcher_results_total",
+            "Terminal result writes issued, by status (a zombie's late "
+            "duplicate counts again even though first_wins freezes it "
+            "store-side)",
+            ("status",),
+        )
+        self.m_purged = self.metrics.counter(
+            "tpu_faas_dispatcher_workers_purged_total",
+            "Workers purged after heartbeat/liveness silence",
+        )
+        self.m_cancelled_dropped = self.metrics.counter(
+            "tpu_faas_dispatcher_cancelled_dropped_total",
+            "Cancelled tasks dropped before dispatch (store-verified)",
+        )
+        self.m_reclaimed = self.metrics.counter(
+            "tpu_faas_dispatcher_tasks_reclaimed_total",
+            "In-flight tasks reclaimed from dead workers and re-queued",
+        )
+        self.m_queue_depth = self.metrics.gauge(
+            "tpu_faas_dispatcher_pending_tasks",
+            "Tasks held in the dispatcher's pending structures",
+        )
+        self.m_inflight = self.metrics.gauge(
+            "tpu_faas_dispatcher_inflight_tasks",
+            "Tasks dispatched and awaiting a result",
+        )
+        self.m_workers = self.metrics.gauge(
+            "tpu_faas_dispatcher_workers_registered",
+            "Workers currently registered with this dispatcher",
+        )
+        self.m_store_down = self.metrics.gauge(
+            "tpu_faas_dispatcher_store_down",
+            "1 while the store is unreachable (degraded mode), else 0",
+        )
+        self.m_deferred = self.metrics.gauge(
+            "tpu_faas_dispatcher_deferred_results",
+            "Result writes buffered during a store outage, awaiting replay",
+        )
+        self.m_announce_backlog = self.metrics.gauge(
+            "tpu_faas_dispatcher_announce_backlog",
+            "Consumed announces parked by a store outage",
+        )
+        # a gauge, and deliberately NOT *_total: the value is a SUM of
+        # worker-reported cumulative counters, which goes down when a
+        # worker restarts — rate() over it would lie
+        self.m_misfires = self.metrics.gauge(
+            "tpu_faas_dispatcher_worker_misfires",
+            "Sum of the fleet's cumulative misfire-repair counters as "
+            "reported on RESULT messages (at-least-once executions); "
+            "resets partially when a worker restarts",
+        )
+        #: span histogram the TickTracer mirrors into (device_tick, intake,
+        #: act, gateway routes...) — /stats ring percentiles and /metrics
+        #: buckets are two views of the same record() calls
+        self.m_spans = self.metrics.histogram(
+            "tpu_faas_span_seconds",
+            "Hot-loop span durations mirrored from the TickTracer rings",
+            ("span",),
+        )
+        for span in ("device_tick", "intake", "act"):
+            self.m_spans.labels(span=span)
+        #: per-task lifecycle timelines + stage histograms (obs/trace.py);
+        #: serves /trace/<task_id> and feeds tpu_faas_task_stage_seconds
+        self.traces = TaskTraceBook(self.metrics)
+        self.metrics.register_collector(self.collect_metrics)
         #: shared-fleet mode: several dispatchers on one store+channel.
         #: Every dispatcher receives every announce, so intake must CLAIM
         #: each task (one pipelined setnx round per batch) before
@@ -410,7 +494,10 @@ class TaskDispatcher:
             addr = find_owner(task_id)
             if addr is not None:
                 send(addr, task_id)
-                self.log.info("relayed force-cancel for task %s", task_id)
+                self.log.info(
+                    "relayed force-cancel for task %s", task_id,
+                    extra=log_ctx(task_id=task_id),
+                )
                 self.kill_requested.pop(task_id, None)
             elif (
                 now - self.kill_requested.get(task_id, now)
@@ -451,7 +538,12 @@ class TaskDispatcher:
         # incarnation is never lost by this drop: it re-enters pending via
         # its own announce.
         self.n_cancelled_dropped += 1
-        self.log.info("dropped cancelled task %s before dispatch", task_id)
+        self.m_cancelled_dropped.inc()
+        self.traces.finish(task_id, outcome="dropped_cancelled")
+        self.log.info(
+            "dropped cancelled task %s before dispatch", task_id,
+            extra=log_ctx(task_id=task_id),
+        )
         return True
 
     # -- intake ------------------------------------------------------------
@@ -479,6 +571,7 @@ class TaskDispatcher:
                 if from_backlog:
                     self._announce_backlog.popleft()
                 continue
+            self.traces.note(msg, "announced")
             try:
                 fields = self.store.hgetall(msg)
             except STORE_OUTAGE_ERRORS:
@@ -523,7 +616,9 @@ class TaskDispatcher:
                 self.log.info(
                     "dropped stale kill note for resubmitted task %s", msg
                 )
-            return PendingTask.from_fields(msg, fields)
+            task = PendingTask.from_fields(msg, fields)
+            self._note_intake(task)
+            return task
 
     def drain_announces(self, max_n: int) -> list[str]:
         """Phase 1 of batched intake: pop up to ``max_n`` TASK announces off
@@ -544,8 +639,18 @@ class TaskDispatcher:
             elif msg.startswith(KILL_ANNOUNCE_PREFIX):
                 self.note_kill(msg[len(KILL_ANNOUNCE_PREFIX):])
             else:
+                self.traces.note(msg, "announced")
                 msgs.append(msg)
         return msgs
+
+    def _note_intake(self, task: PendingTask) -> None:
+        """Timeline stamps as a task enters the pending structures: the
+        gateway's submit stamp (when the record carries one) plus the
+        intake event. Announce receipt was stamped at drain time; a
+        rescan-adopted task simply starts its timeline here."""
+        if task.submitted_at is not None:
+            self.traces.note(task.task_id, "submitted", ts=task.submitted_at)
+        self.traces.note(task.task_id, "intake")
 
     def poll_tasks(self, max_n: int) -> list[PendingTask]:
         """Batch intake, pipelined: drain up to ``max_n`` announces from the
@@ -598,7 +703,9 @@ class TaskDispatcher:
                 self.log.info(
                     "dropped stale kill note for resubmitted task %s", msg
                 )
-            out.append(PendingTask.from_fields(msg, fields))
+            task = PendingTask.from_fields(msg, fields)
+            self._note_intake(task)
+            out.append(task)
         return out
 
     # -- shared-fleet dispatch claims --------------------------------------
@@ -642,6 +749,9 @@ class TaskDispatcher:
         for t, (created, current) in zip(tasks, results):
             if created or current.startswith(self.dispatcher_id + ":"):
                 kept.append(t)
+            else:
+                # a sibling owns it: its lifecycle is theirs to trace
+                self.traces.discard(t.task_id)
         if len(kept) != len(tasks):
             self.log.debug(
                 "dispatch claims: kept %d/%d (rest owned by siblings)",
@@ -765,6 +875,14 @@ class TaskDispatcher:
         """``first_wins=True`` on paths where a second result for the same
         task is possible (zombie worker of a re-dispatched task)."""
         self.store.finish_task(task_id, status, result, first_wins=first_wins)
+        self._note_finished(task_id, status)
+
+    def _note_finished(self, task_id: str, status: str) -> None:
+        """Terminal write landed: close the task's timeline and count the
+        result. ONE place, so every write path (single, batched, deferred
+        replay) agrees on what 'finished' means."""
+        self.m_results.labels(status=str(status)).inc()
+        self.traces.finish(task_id, outcome=str(status))
 
     def mark_running_safe(
         self, task_id: str, *, redispatch: bool = False, retries: int = 0
@@ -813,6 +931,8 @@ class TaskDispatcher:
         try:
             self.store.finish_task_many(list(items))
             self.note_store_up()
+            for task_id, status, _result, _fw in items:
+                self._note_finished(task_id, status)
             return len(items)
         except STORE_OUTAGE_ERRORS as exc:
             # a mid-pipeline loss is ambiguous (a prefix may have applied);
@@ -832,6 +952,7 @@ class TaskDispatcher:
         would leave the task RUNNING forever on a live worker (never purged,
         never re-dispatched). Returns False when deferred."""
         try:
+            # record_result closes the timeline + counts the result
             self.record_result(task_id, status, result, first_wins=first_wins)
             self.note_store_up()
             return True
@@ -878,8 +999,9 @@ class TaskDispatcher:
             except STORE_OUTAGE_ERRORS as exc:
                 self.note_store_outage(exc)
                 break
-            for _ in chunk:
+            for task_id, status, _result, _fw in chunk:
                 self.deferred_results.popleft()
+                self._note_finished(task_id, status)
             n += len(chunk)
         if n:
             self.note_store_up()
@@ -923,6 +1045,45 @@ class TaskDispatcher:
             "worker_misfires": sum(self.worker_misfires.values()),
         }
 
+    def collect_metrics(self) -> None:
+        """Refresh scrape-time gauges from live state; runs at the top of
+        every /metrics render (registry collector). Subclasses extend with
+        their queue/fleet gauges; everything here must be cheap and safe to
+        call from the stats thread while the serve loop mutates — dict
+        ITERATION over serve-loop-owned state must be resize-guarded (the
+        same stats-thread convention as tpu_push._backlog_estimate_s): a
+        concurrent insert raises RuntimeError, and the gauge just keeps
+        its previous value for this scrape."""
+        self.m_store_down.set(1.0 if self._store_down else 0.0)
+        self.m_deferred.set(len(self.deferred_results))
+        self.m_announce_backlog.set(len(self._announce_backlog))
+        try:
+            self.m_misfires.set(sum(self.worker_misfires.values()))
+        except RuntimeError:  # dict resized mid-iteration: next scrape
+            pass
+
+    def note_result_message(self, task_id: str, data: dict) -> None:
+        """Timeline events carried by one RESULT message: the worker's
+        source-measured execution window (``started_at`` + ``elapsed``,
+        absent from reference-era workers) plus the receipt stamp. Shared
+        by every mode's result drain. ``open_new=False`` throughout: a
+        zombie's late second RESULT for an already-finished task must not
+        resurrect the closed timeline as a duplicate."""
+        started = data.get("started_at")
+        if isinstance(started, (int, float)):
+            self.traces.note(
+                task_id, "exec_start", ts=float(started), open_new=False
+            )
+            elapsed = data.get("elapsed")
+            if isinstance(elapsed, (int, float)):
+                self.traces.note(
+                    task_id,
+                    "exec_end",
+                    ts=float(started) + float(elapsed),
+                    open_new=False,
+                )
+        self.traces.note(task_id, "result_received", open_new=False)
+
     def note_worker_misfires(self, sender: object, data: dict) -> None:
         """Track the cumulative ``misfires`` counter a RESULT message
         carries (absent from reference-era workers). Keyed per sender
@@ -948,6 +1109,7 @@ class TaskDispatcher:
                 "task %s lost with its worker %d times; FAILED",
                 task_id,
                 retries,
+                extra=log_ctx(task_id=task_id),
             )
             self.fail_task(
                 task_id,
@@ -955,7 +1117,11 @@ class TaskDispatcher:
                 f"(max_task_retries={max_retries})",
             )
             return None
-        return self.fetch_reclaim(task_id, retries)
+        pt = self.fetch_reclaim(task_id, retries)
+        if pt is not None:
+            self.m_reclaimed.inc()
+            self.traces.note_retry(task_id)
+        return pt
 
     #: How often a dispatcher re-stamps the lease of its in-flight tasks.
     #: Must stay well under any rescanner's lease_timeout (tpu-push default
@@ -1064,11 +1230,24 @@ class TaskDispatcher:
             self.store.get_status(task_id), unknown=True
         )
 
+    def render_metrics(self) -> str:
+        """This dispatcher's Prometheus exposition: its private registry
+        (gauges refreshed by the collector) concatenated with the
+        process-global one (store round trips, worker-pool counters)."""
+        return obs_metrics.render([self.metrics, REGISTRY])
+
     def serve_stats(self, port: int, host: str = "127.0.0.1"):
-        """Serve ``stats()`` as JSON over HTTP (``GET /stats``, plus
-        ``/healthz``) from a daemon thread — the dispatcher-side analog of
-        the gateway's /metrics, so operators can watch queue depth, outage
-        state, and device-tick percentiles without attaching a debugger.
+        """Serve the observability surface over HTTP from a daemon thread:
+
+        - ``GET /stats`` — the legacy JSON snapshot (``stats()``);
+        - ``GET /metrics`` — Prometheus text exposition (private registry
+          + process-global registry), the scrape path;
+        - ``GET /trace/<task_id>`` — that task's lifecycle timeline (open
+          or recently completed), 404 when unknown;
+        - ``GET /trace`` — the bounded rings: recent completions and the
+          slowest tasks seen;
+        - ``GET /healthz``.
+
         Returns the server (port 0 picks a free one —
         ``server.server_address[1]``); ``stop()`` shuts it down and closes
         the listening socket."""
@@ -1079,15 +1258,35 @@ class TaskDispatcher:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+                ctype = "application/json"
                 if self.path == "/healthz":
                     body = b'{"ok": true}'
                 elif self.path == "/stats":
                     body = json.dumps(dispatcher.stats()).encode()
+                elif self.path == "/metrics":
+                    body = dispatcher.render_metrics().encode()
+                    ctype = obs_metrics.CONTENT_TYPE
+                elif self.path == "/trace":
+                    body = json.dumps(
+                        {
+                            **dispatcher.traces.stats(),
+                            "recent": dispatcher.traces.recent(),
+                            "slowest": dispatcher.traces.slowest(),
+                        }
+                    ).encode()
+                elif self.path.startswith("/trace/"):
+                    timeline = dispatcher.traces.timeline(
+                        self.path[len("/trace/"):]
+                    )
+                    if timeline is None:
+                        self.send_error(404)
+                        return
+                    body = json.dumps(timeline).encode()
                 else:
                     self.send_error(404)
                     return
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

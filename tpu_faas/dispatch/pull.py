@@ -91,6 +91,12 @@ class PullDispatcher(TaskDispatcher):
             "n_reclaimed": self.n_reclaimed,
         }
 
+    def collect_metrics(self) -> None:
+        super().collect_metrics()
+        self.m_queue_depth.set(len(self.requeued))
+        self.m_workers.set(len(self.workers))
+        self.m_inflight.set(len(self.inflight))
+
     # -- dead-worker reclaim ----------------------------------------------
     def _purge_dead_workers(self) -> None:
         """Re-queue the in-flight tasks of workers silent past
@@ -192,7 +198,10 @@ class PullDispatcher(TaskDispatcher):
                 # interrupt the fresh one
                 continue
             hits.append(t)
-            self.log.info("relayed force-cancel for task %s", t)
+            self.log.info(
+                "relayed force-cancel for task %s", t,
+                extra={"task_id": t, "worker_id": wid},
+            )
         return hits
 
     def start(self, max_results: int | None = None) -> int:
@@ -231,6 +240,7 @@ class PullDispatcher(TaskDispatcher):
                 elif msg_type == m.RESULT:
                     task_id = data["task_id"]
                     self.note_worker_misfires(wid, data)
+                    self.note_result_message(task_id, data)
                     owner_entry = self.inflight.get(task_id)
                     owner = owner_entry[0] if owner_entry else None
                     # a second result is possible when the task was ever
@@ -273,6 +283,7 @@ class PullDispatcher(TaskDispatcher):
                 kill_ids = self._kills_for(wid)
                 extra = {"cancel_ids": kill_ids} if kill_ids else {}
                 if task is not None:
+                    self.traces.note(task.task_id, "scheduled")
                     self.mark_running_safe(
                         task.task_id,
                         redispatch=bool(task.retries),
@@ -288,6 +299,8 @@ class PullDispatcher(TaskDispatcher):
                             m.TASK, **task.task_message_kwargs(), **extra
                         )
                     )
+                    self.traces.note(task.task_id, "sent")
+                    self.m_dispatched.inc()
                 else:
                     self.socket.send(m.encode(m.WAIT, **extra))
                 if max_results is not None and n_results >= max_results:

@@ -116,6 +116,20 @@ class PushDispatcher(TaskDispatcher):
         self.n_results = 0
         self.n_purged = 0
 
+    def collect_metrics(self) -> None:
+        super().collect_metrics()
+        self.m_queue_depth.set(len(self.requeue))
+        self.m_workers.set(len(self.workers))
+        try:
+            self.m_inflight.set(
+                sum(len(rec.inflight) for rec in self.workers.values())
+            )
+        except RuntimeError:
+            # scrape raced a register/purge resizing the dict (this runs
+            # on the stats thread): keep the previous value, next scrape
+            # will see the settled state
+            pass
+
     def _refresh_fleet_procs(self) -> None:
         """Recompute cached total capacity; called on the rare membership /
         capacity events (register, reconnect, purge, drain-drop) so the
@@ -216,6 +230,7 @@ class PushDispatcher(TaskDispatcher):
         if msg_type == m.RESULT:
             task_id = data["task_id"]
             self.note_worker_misfires(wid, data)
+            self.note_result_message(task_id, data)
             # suspicious = a second result is possible: the sender doesn't
             # hold the task (zombie whose task was reclaimed), or the task
             # was reclaimed at least once before reaching this worker
@@ -292,6 +307,7 @@ class PushDispatcher(TaskDispatcher):
             self._remove_free(wid)
             self.requeue.extend(reclaims)
             self.n_purged += 1
+            self.m_purged.inc()
             if rec.inflight:
                 self.log.warning(
                     "purged %r; re-queued %d in-flight tasks",
@@ -365,9 +381,11 @@ class PushDispatcher(TaskDispatcher):
                     self._add_free(wid, front=True)
                 break
             rec = self.workers[wid]
+            self.traces.note(task.task_id, "scheduled")
             self._send(
                 wid, m.encode(m.TASK, **task.task_message_kwargs())
             )
+            self.traces.note(task.task_id, "sent")
             self.mark_running_safe(
                 task.task_id,
                 redispatch=bool(task.retries),
@@ -379,6 +397,7 @@ class PushDispatcher(TaskDispatcher):
             rec.free_processes -= 1
             sent += 1
             self.n_dispatched += 1
+            self.m_dispatched.inc()
             # LRU mode re-appends the worker at the back while it still has
             # capacity; in process-LB mode its remaining slots are already
             # individually present in free_procs (one entry was popped per

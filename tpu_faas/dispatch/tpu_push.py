@@ -44,10 +44,11 @@ from tpu_faas.dispatch.base import (
     PendingTask,
     TaskDispatcher,
 )
+from tpu_faas.obs.profile import TickProfiler
 from tpu_faas.sched.estimator import RuntimeEstimator, fn_digest
 from tpu_faas.sched.state import SchedulerArrays
 from tpu_faas.store.base import LIVE_INDEX_KEY
-from tpu_faas.utils.logging import TickTracer
+from tpu_faas.utils.logging import TickTracer, log_ctx
 from tpu_faas.worker import messages as m
 
 
@@ -234,7 +235,12 @@ class TpuPushDispatcher(TaskDispatcher):
             if liveness_period is not None
             else min(1.0, time_to_expire / 4.0)
         )
-        self.tracer = TickTracer()
+        #: span ring mirrored into the metrics registry: /stats percentiles
+        #: and /metrics histogram buckets are views of one record() call
+        self.tracer = TickTracer(mirror=self.m_spans)
+        #: device-tick profiling (obs/profile.py): recompile detection per
+        #: tick signature, padded-shape gauges, env-gated jax.profiler hook
+        self.profiler = TickProfiler(self.metrics, log=self.log)
         self.max_task_retries = max_task_retries
         # reclaim count per task (poison guard); entries exist only for tasks
         # that have survived >= 1 worker death, cleared on their result
@@ -288,7 +294,10 @@ class TpuPushDispatcher(TaskDispatcher):
         conf = self._fleet_lease_conf
         if conf is not None:
             _, published = conf
-            if time.time() - published < 1.25 * self.LEASE_RENEW_PERIOD:
+            # wall-clock age of a CROSS-PROCESS stamp (the fleet's lease
+            # publication time lives in the store as epoch seconds) — not
+            # intra-process latency math, which belongs to the obs API
+            if time.time() - published < 1.25 * self.LEASE_RENEW_PERIOD:  # faas: allow(obs.wall-clock-latency)
                 return max(
                     self.lease_timeout, 2.5 * self.LEASE_RENEW_PERIOD
                 )
@@ -574,6 +583,7 @@ class TpuPushDispatcher(TaskDispatcher):
         if msg_type == m.RESULT:
             task_id = data["task_id"]
             self.note_worker_misfires(wid, data)
+            self.note_result_message(task_id, data)
             owner = a.inflight_owner(task_id)
             from_owner = (
                 owner is not None
@@ -699,6 +709,13 @@ class TpuPushDispatcher(TaskDispatcher):
     #: on the stats thread; scrapes inside this window reuse the last value
     #: (the autoscaler polls every ~2 s — sub-second freshness buys nothing)
     _BACKLOG_EST_TTL_S = 1.0
+
+    def collect_metrics(self) -> None:
+        super().collect_metrics()
+        a = self.arrays
+        self.m_queue_depth.set(len(self.pending) + len(self._resident_tasks))
+        self.m_inflight.set(a.n_inflight)
+        self.m_workers.set(len(a.worker_ids))
 
     def stats(self) -> dict:
         a = self.arrays
@@ -886,7 +903,19 @@ class TpuPushDispatcher(TaskDispatcher):
                         a.placement,
                     )
                     self._warned_priority = True
-            with self.tracer.span("device_tick"):
+            # recompile detection BEFORE the call: the signature carries
+            # everything that changes the jitted trace (padded dims,
+            # placement, optional priority lane)
+            self.profiler.observe_shape(
+                tasks=a.max_pending,
+                workers=a.max_workers,
+                slots=a.max_slots,
+                signature=(
+                    "batch", a.max_pending, a.max_workers, a.max_slots,
+                    a.placement, prios is not None,
+                ),
+            )
+            with self.tracer.span("device_tick"), self.profiler.tick_capture():
                 out = a.tick(sizes, task_priorities=prios)
 
             # reclaim in-flight tasks of dead workers (ahead of the queue)
@@ -934,10 +963,12 @@ class TpuPushDispatcher(TaskDispatcher):
                         still_pending.append(task)  # inflight full: wait
                         restore_from = idx + 1
                         continue
+                    self.traces.note(task.task_id, "scheduled")
                     wid = a.row_ids[row]
                     self.socket.send_multipart(
                         [wid, m.encode(m.TASK, **task.task_message_kwargs())]
                     )
+                    self.traces.note(task.task_id, "sent")
                     # on the wire + tracked: must NOT be restored on an
                     # outage
                     restore_from = idx + 1
@@ -955,6 +986,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     a.worker_free[row] -= 1
                     sent += 1
                     self.n_dispatched += 1
+                    self.m_dispatched.inc()
         except STORE_OUTAGE_ERRORS:
             for t in batch[restore_from:]:
                 still_pending.append(t)
@@ -1051,7 +1083,16 @@ class TpuPushDispatcher(TaskDispatcher):
             a.pending_add(t.task_id, t.size_estimate, t.priority or 0)
 
         sent = 0
-        with self.tracer.span("device_tick"):
+        self.profiler.observe_shape(
+            tasks=a.max_pending,
+            workers=a.max_workers,
+            slots=a.max_slots,
+            signature=(
+                "resident", a.max_pending, a.max_workers, a.max_slots,
+                getattr(a, "placement", ""),
+            ),
+        )
+        with self.tracer.span("device_tick"), self.profiler.tick_capture():
             out = a.tick_resident()
         # Drain EVERY unresolved entry, not just one: an arrival burst
         # beyond KA makes tick_resident emit several flush packets plus the
@@ -1105,6 +1146,10 @@ class TpuPushDispatcher(TaskDispatcher):
         the sites (as _task_digest once was)."""
         self.task_retries.pop(task_id, None)
         self._task_digest.pop(task_id, None)
+        # close any still-open timeline (no-op for the drop/fail sites that
+        # already finished it with a more specific outcome): a task leaving
+        # without a result must not sit in the active trace table forever
+        self.traces.finish(task_id, outcome="forgotten")
 
     def _reap_dead_workers(self, redispatch_slots, purged_rows, requeue):
         """Reclaim the in-flight tasks of dead workers and deactivate the
@@ -1164,6 +1209,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     # durability gap (VERDICT r4 missing #4).
                     self.estimator.forget_worker(wid_p)
             self.n_purged += 1
+            self.m_purged.inc()
 
     def _act_on_resolved(self, res) -> int:
         """Apply one resolved resident tick: reclaims, purges, dispatches."""
@@ -1259,10 +1305,12 @@ class TpuPushDispatcher(TaskDispatcher):
                     except RuntimeError:
                         undo(task, row)  # inflight table full: wait a tick
                         continue
+                    self.traces.note(task.task_id, "scheduled")
                     wid = a.row_ids[row]
                     self.socket.send_multipart(
                         [wid, m.encode(m.TASK, **task.task_message_kwargs())]
                     )
+                    self.traces.note(task.task_id, "sent")
                     if task.retries:
                         # per-task on the re-dispatch path: the redispatch
                         # declaration + persisted reclaim count ride along
@@ -1273,6 +1321,7 @@ class TpuPushDispatcher(TaskDispatcher):
                         running_batch.append(task.task_id)
                     sent += 1
                     self.n_dispatched += 1
+                    self.m_dispatched.inc()
         finally:
             # coalesced RUNNING flush, after every send (same contract as
             # the batch tick's finally)
@@ -1358,6 +1407,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 if max_results is not None and self.n_results >= max_results:
                     break
         finally:
+            self.profiler.close()  # flush any env-gated jax.profiler trace
             if self.estimator is not None:
                 try:
                     self.estimator.maybe_persist(force=True)

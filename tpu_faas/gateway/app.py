@@ -21,7 +21,10 @@ test_client.py:98-126, test_suit.py:39-91):
 Beyond the reference surface: ``POST /cancel/{task_id}`` (queued-only
 best-effort cancel: QUEUED -> CANCELLED terminal, RUNNING refused with 409 —
 see cancel_task below), ``DELETE /task/{task_id}`` (drop a terminal task's
-record), ``GET /healthz``, ``GET /metrics``.
+record), ``GET /healthz``, ``GET /metrics`` (Prometheus text exposition —
+request counts + latency histograms per route, submission counters, store
+reachability; tpu_faas/obs), ``GET /stats`` (the same numbers as a JSON
+snapshot, with exact recent-window percentiles from the tracer ring).
 
 Store-side contract on execute (reference old/client_debug.py:40-45): write the
 full task hash (status QUEUED, fn_payload, param_payload, result "None") then
@@ -54,11 +57,14 @@ from tpu_faas.core.task import (
     FIELD_PARAMS,
     FIELD_PRIORITY,
     FIELD_STATUS,
+    FIELD_SUBMITTED_AT,
     FIELD_TIMEOUT,
     TaskStatus,
     new_function_id,
     new_task_id,
 )
+from tpu_faas.obs import REGISTRY, MetricsRegistry
+from tpu_faas.obs import metrics as obs_metrics
 from tpu_faas.store.base import RESULTS_CHANNEL, TASKS_CHANNEL, TaskStore
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import TickTracer, get_logger
@@ -213,10 +219,10 @@ class GatewayContext:
     #: set on app shutdown so parked long-polls reply immediately instead of
     #: holding the server (and its stop()) for up to _MAX_WAIT_S
     stopping: asyncio.Event = field(default_factory=asyncio.Event)
-    #: request/latency counters by endpoint (reference has no observability —
-    #: SURVEY §5.5); TickTracer is thread-safe enough for GIL-serialized
-    #: appends and cheap enough to leave on
-    tracer: TickTracer = field(default_factory=TickTracer)
+    #: request/latency ring by endpoint (exact recent percentiles for the
+    #: JSON /stats snapshot); built in __post_init__ so it mirrors into the
+    #: registry's latency histogram — one record() feeds both surfaces
+    tracer: "TickTracer | None" = None
     started_at: float = field(default_factory=time.time)
     n_functions: int = 0
     n_tasks: int = 0
@@ -224,6 +230,48 @@ class GatewayContext:
     #: monotonic per-route request totals — the tracer's ring is bounded
     #: (correct for latency percentiles, WRONG as a counter once saturated)
     route_counts: dict = field(default_factory=dict)
+    #: PRIVATE metrics registry (tpu_faas/obs): app instances in one test
+    #: process must not share series; /metrics renders this + the
+    #: process-global registry
+    metrics: MetricsRegistry = field(default_factory=MetricsRegistry)
+
+    def __post_init__(self) -> None:
+        self.m_requests = self.metrics.counter(
+            "tpu_faas_gateway_requests_total",
+            "HTTP requests served, by method+route (long-polls separated)",
+            ("route",),
+        )
+        self.m_latency = self.metrics.histogram(
+            "tpu_faas_gateway_request_latency_seconds",
+            "HTTP serving latency by method+route (long-poll wait time "
+            "kept in its own route bucket)",
+            ("route",),
+        )
+        self.m_functions = self.metrics.counter(
+            "tpu_faas_gateway_functions_registered_total",
+            "Functions registered through this gateway",
+        )
+        self.m_tasks = self.metrics.counter(
+            "tpu_faas_gateway_tasks_submitted_total",
+            "Task records created through this gateway (dedups excluded)",
+        )
+        self.m_cancel_calls = self.metrics.counter(
+            "tpu_faas_gateway_cancel_calls_total",
+            "Cancel calls that reported cancelled=true (idempotent "
+            "repeats counted — see /stats cancel_calls)",
+        )
+        self.m_store_up = self.metrics.gauge(
+            "tpu_faas_gateway_store_up",
+            "1 when the store answered the last scrape-time PING, else 0",
+        )
+        self.m_uptime = self.metrics.gauge(
+            "tpu_faas_gateway_uptime_seconds", "Seconds since app start"
+        )
+        self.metrics.register_collector(
+            lambda: self.m_uptime.set(time.time() - self.started_at)
+        )
+        if self.tracer is None:
+            self.tracer = TickTracer(mirror=self.m_latency)
 
 
 CTX_KEY: web.AppKey["GatewayContext"] = web.AppKey("ctx", GatewayContext)
@@ -249,6 +297,9 @@ async def _metrics_middleware(request: web.Request, handler):
         if request.query.get("wait") not in (None, "", "0"):
             name += " (long-poll)"
         ctx.route_counts[name] = ctx.route_counts.get(name, 0) + 1
+        ctx.m_requests.labels(name).inc()
+        # mirrored tracer: this one record() feeds both the /stats ring
+        # percentiles and the /metrics latency histogram
         ctx.tracer.record(name, time.perf_counter() - t0)
 
 
@@ -335,6 +386,7 @@ def make_app(
     app.router.add_delete("/task/{task_id}", delete_task)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/stats", stats)
 
     async def _start_wakeups(_app: web.Application) -> None:
         ctx.waiters = _ResultWaiters(store)
@@ -407,6 +459,7 @@ async def register_function(request: web.Request) -> web.Response:
         {"name": name, "payload": payload},
     )
     ctx.n_functions += 1
+    ctx.m_functions.inc()
     return web.json_response({"function_id": function_id})
 
 
@@ -469,6 +522,9 @@ async def execute_function(request: web.Request) -> web.Response:
         )
     except ValueError as exc:
         return _json_error(400, str(exc))
+    # first event of the task's lifecycle timeline (obs/trace.py): rides
+    # the record so the dispatcher can measure queue wait from the submit
+    extra[FIELD_SUBMITTED_AT] = repr(time.time())
     idem_key = body.get("idempotency_key")
     if idem_key is not None and (
         not isinstance(idem_key, str) or not idem_key
@@ -542,6 +598,7 @@ async def execute_function(request: web.Request) -> web.Response:
                 )
                 if await _run_blocking(write_task_nx, task_id):
                     ctx.n_tasks += 1
+                    ctx.m_tasks.inc()
             elif (
                 await _run_blocking(ctx.store.hget, task_id, FIELD_STATUS)
                 is None
@@ -560,11 +617,13 @@ async def execute_function(request: web.Request) -> web.Response:
             )
         await _run_blocking(write_task_nx, task_id)
         ctx.n_tasks += 1
+        ctx.m_tasks.inc()
         return web.json_response({"task_id": task_id})
 
     task_id = new_task_id()
     await _run_blocking(write_task, task_id)
     ctx.n_tasks += 1
+    ctx.m_tasks.inc()
     return web.json_response({"task_id": task_id})
 
 
@@ -612,6 +671,9 @@ async def execute_batch(request: web.Request) -> web.Response:
         ]
     except ValueError as exc:
         return _json_error(400, str(exc))
+    submit_stamp = repr(time.time())  # one submit time for the whole batch
+    for e in extras:
+        e[FIELD_SUBMITTED_AT] = submit_stamp
     idem_keys = body.get("idempotency_keys")
     if idem_keys is not None:
         if not isinstance(idem_keys, list) or len(idem_keys) != len(payloads):
@@ -767,6 +829,7 @@ async def execute_batch(request: web.Request) -> web.Response:
 
     await _run_blocking(write_tasks)
     ctx.n_tasks += len(to_create)
+    ctx.m_tasks.inc(len(to_create))
     resp = {"task_ids": task_ids}
     if idem_keys is not None:
         resp["deduplicated"] = dedup
@@ -925,6 +988,7 @@ async def cancel_task(request: web.Request) -> web.Response:
     cancelled = status == str(TaskStatus.CANCELLED)
     if cancelled:
         ctx.n_cancelled += 1
+        ctx.m_cancel_calls.inc()
     body = {"task_id": task_id, "status": status, "cancelled": cancelled}
     if force:
         body["kill_requested"] = kill_requested
@@ -952,18 +1016,34 @@ async def healthz(request: web.Request) -> web.Response:
     return web.json_response({"ok": True})
 
 
+def _safe_ping(store: TaskStore) -> bool:
+    try:
+        return bool(store.ping())
+    except Exception:
+        return False
+
+
 async def metrics(request: web.Request) -> web.Response:
-    """Observability endpoint: per-route request counts + latency
-    percentiles, submission counters, and store reachability."""
+    """Prometheus text exposition: the gateway's private registry (request
+    counts + latency histograms per route, submission counters, store
+    reachability, uptime) concatenated with the process-global registry
+    (store round trips). The scrape path; the JSON twin lives at /stats."""
     ctx: GatewayContext = request.app[CTX_KEY]
+    ctx.m_store_up.set(1.0 if await _run_blocking(_safe_ping, ctx.store) else 0.0)
+    body = await _run_blocking(obs_metrics.render, [ctx.metrics, REGISTRY])
+    # the shared CONTENT_TYPE constant (version=0.0.4 included), same as
+    # the dispatcher's scrape surface — one format, advertised once
+    return web.Response(
+        body=body.encode("utf-8"),
+        headers={"Content-Type": obs_metrics.CONTENT_TYPE},
+    )
 
-    def safe_ping() -> bool:
-        try:
-            return bool(ctx.store.ping())
-        except Exception:
-            return False
 
-    store_ok = await _run_blocking(safe_ping)
+async def stats(request: web.Request) -> web.Response:
+    """JSON observability snapshot: the same counters as /metrics plus the
+    tracer ring's exact recent-window latency percentiles."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+    store_ok = await _run_blocking(_safe_ping, ctx.store)
     return web.json_response(
         {
             "uptime_s": round(time.time() - ctx.started_at, 1),

@@ -1,0 +1,140 @@
+"""Device-tick profiling hooks: recompile detection + jax.profiler capture.
+
+The scheduler tick is jit-compiled against STATIC padded shapes
+(``max_pending``/``max_workers``/``max_slots``/placement), so in steady
+state every tick replays one cached executable — a recompile mid-serve
+means a shape or trace-structure change leaked into the hot loop (the
+exact regression class sched/state.py's packed calling convention exists
+to prevent). :class:`TickProfiler` detects that from the host side: each
+tick reports its shape signature, a signature never seen before counts as
+a compile (``tpu_faas_jit_recompiles_total``), and the current padded dims
+are exported as ``tpu_faas_tick_shape{dim=...}`` gauges. Where the running
+JAX exposes per-function cache sizes (``jit(...)._cache_size()``), the
+observed signature count is cross-checkable against the real cache.
+
+Opt-in deep capture: set ``TPU_FAAS_JAX_PROFILE_DIR=/some/dir`` and the
+first ``TPU_FAAS_JAX_PROFILE_TICKS`` device ticks (default 20) run inside
+one ``jax.profiler`` trace, viewable in TensorBoard/Perfetto — the part of
+this layer that transfers directly to a training or inference stack.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+PROFILE_DIR_ENV = "TPU_FAAS_JAX_PROFILE_DIR"
+PROFILE_TICKS_ENV = "TPU_FAAS_JAX_PROFILE_TICKS"
+
+
+class TickProfiler:
+    """Host-side tick instrumentation; one per dispatcher, registered into
+    that dispatcher's private metrics registry."""
+
+    def __init__(self, registry, log=None) -> None:
+        self._log = log
+        self._recompiles = registry.counter(
+            "tpu_faas_jit_recompiles_total",
+            "Device-tick shape signatures first seen after warmup — each "
+            "is one jit cache miss (steady state: stays flat)",
+        )
+        self._shape = registry.gauge(
+            "tpu_faas_tick_shape",
+            "Padded device-tick dimensions (tasks x workers x slots)",
+            ("dim",),
+        )
+        self._ticks = registry.counter(
+            "tpu_faas_device_ticks_total", "Device scheduler ticks run"
+        )
+        self._seen: set[tuple] = set()
+        self._trace_dir = os.environ.get(PROFILE_DIR_ENV) or None
+        try:
+            self._trace_left = (
+                int(os.environ.get(PROFILE_TICKS_ENV, "20"))
+                if self._trace_dir
+                else 0
+            )
+        except ValueError:
+            self._trace_left = 0
+        self._tracing = False
+
+    @property
+    def n_signatures(self) -> int:
+        return len(self._seen)
+
+    def observe_shape(
+        self, *, tasks: int, workers: int, slots: int, signature: tuple
+    ) -> bool:
+        """Report one tick's padded dims + trace signature BEFORE the
+        device call. Returns True when this signature is new (a compile).
+        The signature must include everything that changes the jitted
+        trace: padded dims, placement, and optional-lane presence (the
+        priority vector being None vs an array retraces)."""
+        self._shape.labels(dim="tasks").set(tasks)
+        self._shape.labels(dim="workers").set(workers)
+        self._shape.labels(dim="slots").set(slots)
+        self._ticks.inc()
+        if signature in self._seen:
+            return False
+        self._seen.add(signature)
+        self._recompiles.inc()
+        if self._log is not None and len(self._seen) > 1:
+            # the first compile is warmup; later ones are the news
+            self._log.info(
+                "device tick recompiled (signature %r, %d total)",
+                signature,
+                len(self._seen),
+            )
+        return True
+
+    @contextmanager
+    def tick_capture(self):
+        """Wrap one device tick; while the env-gated capture budget lasts,
+        the tick runs inside a ``jax.profiler`` trace. No-op (and
+        zero-cost) when ``TPU_FAAS_JAX_PROFILE_DIR`` is unset."""
+        if self._trace_left <= 0:
+            if self._tracing:
+                self._stop_trace()
+            yield
+            return
+        if not self._tracing:
+            self._start_trace()
+        self._trace_left -= 1
+        try:
+            yield
+        finally:
+            if self._trace_left <= 0 and self._tracing:
+                self._stop_trace()
+
+    def _start_trace(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.start_trace(self._trace_dir)
+            self._tracing = True
+            if self._log is not None:
+                self._log.info(
+                    "jax.profiler capture started -> %s", self._trace_dir
+                )
+        except Exception as exc:  # capture is best-effort observability
+            self._trace_left = 0
+            if self._log is not None:
+                self._log.warning("jax.profiler capture unavailable: %s", exc)
+
+    def _stop_trace(self) -> None:
+        self._tracing = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            if self._log is not None:
+                self._log.info(
+                    "jax.profiler capture written to %s", self._trace_dir
+                )
+        except Exception as exc:
+            if self._log is not None:
+                self._log.warning("jax.profiler stop_trace failed: %s", exc)
+
+    def close(self) -> None:
+        if self._tracing:
+            self._stop_trace()

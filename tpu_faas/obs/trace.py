@@ -1,0 +1,237 @@
+"""Per-task lifecycle tracing: where did this task's latency go?
+
+Every task record accumulates up to nine event stamps on its way through
+the system::
+
+    submitted -> announced -> intake -> scheduled -> sent
+        -> exec_start -> exec_end -> result_received -> finished
+
+``submitted`` is stamped by the gateway onto the task hash
+(``FIELD_SUBMITTED_AT``); ``exec_start``/``exec_end`` are measured in the
+worker's pool child and ride the RESULT message (``started_at`` +
+``elapsed``); everything else is stamped by the dispatcher as the task
+passes each boundary. Dispatcher-side stamps are *monotonic-anchored*:
+:func:`anchored_now` returns ``time.monotonic()`` shifted by a
+process-start anchor onto the epoch, so intra-process deltas are immune to
+wall-clock steps while cross-process stamps (gateway, worker — raw
+``time.time()``) remain comparable up to host clock sync.
+
+On ``finished`` the timeline is closed: per-stage deltas are observed into
+the ``tpu_faas_task_stage_seconds{stage=...}`` histogram of the owning
+registry (the scrapeable aggregate), and the full timeline moves into a
+bounded ring of recent completions plus a bounded slowest-task list — the
+raw material behind the dispatcher's ``/trace/<task_id>`` and ``/trace``
+debug endpoints. No per-task storage survives beyond those rings.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from collections import deque
+
+#: Canonical event order (also the order ``timeline()`` reports).
+EVENTS = (
+    "submitted",
+    "announced",
+    "intake",
+    "scheduled",
+    "sent",
+    "exec_start",
+    "exec_end",
+    "result_received",
+    "finished",
+)
+
+#: stage -> (from_event, to_event). Stages whose endpoints are both present
+#: on a closing timeline are observed into the stage histogram.
+STAGES = {
+    # gateway write + announce-bus latency
+    "submit_to_announce": ("submitted", "announced"),
+    # waiting in the pending structures for a placement decision
+    "queue_wait": ("announced", "scheduled"),
+    # device-schedule latency: placement decision -> task on the wire
+    "device_schedule": ("scheduled", "sent"),
+    # wire + worker pool queueing before the child picks it up
+    "dispatch_to_start": ("sent", "exec_start"),
+    # the user function itself (measured in the pool child)
+    "execution": ("exec_start", "exec_end"),
+    # result's trip back over the wire into the dispatcher drain
+    "result_return": ("exec_end", "result_received"),
+    # terminal store write landing after the result arrived
+    "finalize": ("result_received", "finished"),
+    # end to end
+    "total": ("submitted", "finished"),
+}
+
+_ANCHOR = time.time() - time.monotonic()
+
+
+def anchored_now() -> float:
+    """Epoch seconds sampled via the monotonic clock: comparable across
+    processes on one host, immune to wall-clock steps within a process."""
+    return _ANCHOR + time.monotonic()
+
+
+class TaskTraceBook:
+    """Bounded event-timeline store + stage-histogram aggregation.
+
+    Thread-safety: one lock around the dicts/rings — ``note`` is a dict
+    probe plus an insert, cheap enough for the dispatcher's drain loops,
+    and the stats thread snapshots under the same lock.
+    """
+
+    def __init__(
+        self,
+        registry,
+        active_cap: int = 65536,
+        recent_cap: int = 256,
+        slowest_cap: int = 32,
+    ) -> None:
+        self._lock = threading.Lock()
+        self._active: dict[str, dict[str, float]] = {}
+        self._recent: deque[dict] = deque(maxlen=recent_cap)
+        self._completed: dict[str, dict] = {}
+        self._active_cap = active_cap
+        self._slowest_cap = slowest_cap
+        #: (total_seconds, seq, timeline) min-heap of the slowest closures
+        self._slowest: list[tuple[float, int, dict]] = []
+        self._seq = itertools.count()
+        self.n_completed = 0
+        self._hist = registry.histogram(
+            "tpu_faas_task_stage_seconds",
+            "Per-stage task lifecycle latency (seconds), aggregated from "
+            "the nine-event task timelines",
+            ("stage",),
+        )
+        # pre-create every stage child: the scrape shows the full stage
+        # catalog (at zero) before the first task completes
+        for stage in STAGES:
+            self._hist.labels(stage=stage)
+
+    # -- recording ---------------------------------------------------------
+    def note(
+        self,
+        task_id: str,
+        event: str,
+        ts: float | None = None,
+        open_new: bool = True,
+    ) -> None:
+        """Stamp ``event`` on the task's timeline (first stamp wins: a
+        re-dispatched task keeps its original ``sent``, and the retry is
+        visible as ``retries`` on the closed record instead).
+
+        ``open_new=False`` stamps ONLY an already-open timeline: events
+        that can arrive after a task finished — a zombie worker's late
+        second RESULT — must not resurrect the closed trace as a fresh
+        (then duplicate-completed) one."""
+        if ts is None:
+            ts = anchored_now()
+        with self._lock:
+            events = self._active.get(task_id)
+            if events is None:
+                if not open_new:
+                    return
+                if len(self._active) >= self._active_cap:
+                    # drop the oldest open timeline (dict preserves insert
+                    # order): an abandoned trace must never grow memory
+                    self._active.pop(next(iter(self._active)))
+                events = self._active[task_id] = {}
+            events.setdefault(event, ts)
+
+    def note_retry(self, task_id: str) -> None:
+        with self._lock:
+            events = self._active.get(task_id)
+            if events is not None:
+                events["retries"] = events.get("retries", 0.0) + 1.0
+
+    def finish(
+        self, task_id: str, outcome: str, ts: float | None = None
+    ) -> None:
+        """Close the timeline: stamp ``finished``, observe stage deltas,
+        move the record to the recent/slowest rings. Unknown task ids are
+        ignored (a foreign producer's task finishing through this
+        dispatcher has no open timeline)."""
+        if ts is None:
+            ts = anchored_now()
+        with self._lock:
+            events = self._active.pop(task_id, None)
+            if events is None:
+                return
+            events.setdefault("finished", ts)
+            retries = int(events.pop("retries", 0))
+            stages: dict[str, float] = {}
+            for stage, (a, b) in STAGES.items():
+                if a in events and b in events:
+                    delta = events[b] - events[a]
+                    if delta >= 0:
+                        stages[stage] = delta
+        # histogram observes OUTSIDE the book lock (the child has its own)
+        for stage, delta in stages.items():
+            self._hist.labels(stage=stage).observe(delta)
+        record = {
+            "task_id": task_id,
+            "outcome": outcome,
+            "retries": retries,
+            "events": dict(sorted(events.items(), key=lambda kv: kv[1])),
+            "stages": {k: round(v, 6) for k, v in stages.items()},
+            "complete": all(e in events for e in EVENTS),
+        }
+        with self._lock:
+            self.n_completed += 1
+            if len(self._recent) == self._recent.maxlen:
+                evicted = self._recent[0]
+                self._completed.pop(evicted["task_id"], None)
+            self._recent.append(record)
+            self._completed[record["task_id"]] = record
+            total = stages.get("total", stages.get("execution", 0.0))
+            entry = (total, next(self._seq), record)
+            if len(self._slowest) < self._slowest_cap:
+                heapq.heappush(self._slowest, entry)
+            elif total > self._slowest[0][0]:
+                heapq.heapreplace(self._slowest, entry)
+
+    def discard(self, task_id: str) -> None:
+        """Forget an open timeline without closing it (task claimed by a
+        sibling dispatcher — its lifecycle belongs to them)."""
+        with self._lock:
+            self._active.pop(task_id, None)
+
+    # -- inspection --------------------------------------------------------
+    def timeline(self, task_id: str) -> dict | None:
+        """The task's timeline: the closed record if it finished recently,
+        else a snapshot of the open (partial) one."""
+        with self._lock:
+            done = self._completed.get(task_id)
+            if done is not None:
+                return done
+            events = self._active.get(task_id)
+            if events is None:
+                return None
+            snap = {k: v for k, v in events.items() if k != "retries"}
+            return {
+                "task_id": task_id,
+                "outcome": None,
+                "retries": int(events.get("retries", 0)),
+                "events": dict(sorted(snap.items(), key=lambda kv: kv[1])),
+                "stages": {},
+                "complete": False,
+            }
+
+    def recent(self, n: int = 32) -> list[dict]:
+        with self._lock:
+            return list(self._recent)[-n:]
+
+    def slowest(self) -> list[dict]:
+        with self._lock:
+            entries = sorted(self._slowest, reverse=True)
+        return [rec for _, _, rec in entries]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "active": len(self._active),
+                "completed": self.n_completed,
+            }

@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Mapping
 
+from tpu_faas.obs import REGISTRY
 from tpu_faas.store import resp
 from tpu_faas.store.base import (
     LIVE_INDEX_KEY,
@@ -25,6 +26,16 @@ from tpu_faas.store.base import (
     TASKS_CHANNEL,
     Subscription,
     TaskStore,
+)
+
+#: Process-wide round-trip counter, one series per store role: the scrape
+#: analog of each handle's ``n_round_trips`` (one pipelined batch = one).
+#: A per-handle instance counter can't be scraped after the handle dies;
+#: the registry series is the durable process total.
+_ROUND_TRIPS_TOTAL = REGISTRY.counter(
+    "tpu_faas_store_round_trips_total",
+    "Store wire round trips paid by this process (pipelined batch = 1)",
+    ("backend",),
 )
 
 #: Commands that must not be replayed after an ambiguous connection loss —
@@ -179,6 +190,7 @@ class RespStore(TaskStore):
         #: lock; read lock-free by stats pollers (a torn read of an int is
         #: impossible in CPython, and the counter is observability only).
         self.n_round_trips = 0
+        self._rt_series = _ROUND_TRIPS_TOTAL.labels(backend="resp")
 
     def _command(self, *parts: str | bytes | int):
         """Run one command; transparently reconnect once if the server
@@ -215,6 +227,7 @@ class RespStore(TaskStore):
                 # deliberate I/O under lock: this lock EXISTS to serialize
                 # use of the one connection (RESP replies are positional)
                 self.n_round_trips += 1
+                self._rt_series.inc()
                 return self._conn.command(*parts)  # faas: allow(locks.blocking-call-under-lock)
             except (ConnectionError, TimeoutError):
                 # TimeoutError too: the reply may still arrive later, so the
@@ -228,7 +241,8 @@ class RespStore(TaskStore):
                 if str(parts[0]).upper() in _NON_IDEMPOTENT:
                     raise
                 # same serialized-connection justification as above
-                self.n_round_trips += 1  # the retry is a second round trip
+                self.n_round_trips += 1
+                self._rt_series.inc()  # the retry is a second round trip
                 return conn.command(*parts)  # faas: allow(locks.blocking-call-under-lock)
 
     def pipeline(self, commands: list[tuple]) -> list:
@@ -251,7 +265,8 @@ class RespStore(TaskStore):
             try:
                 # deliberate I/O under lock (see _command): one connection,
                 # positional replies — interleaved pipelines would desync
-                self.n_round_trips += 1  # N commands, one round trip
+                self.n_round_trips += 1
+                self._rt_series.inc()  # N commands, one round trip
                 conn.send_many(commands)  # faas: allow(locks.blocking-call-under-lock)
                 out: list = []
                 for _ in commands:

@@ -12,7 +12,12 @@ worker -> dispatcher:
     RESULT     data: task_id, status, result [, elapsed: float — execution
                wall seconds measured in the pool child, feeding the
                dispatcher's runtime estimator; absent from reference-era
-               workers and handled as such] [, no_task=True while draining
+               workers and handled as such] [, started_at: float — epoch
+               seconds the child began executing, measured at the source;
+               with `elapsed` it gives the dispatcher's task timeline its
+               exec_start/exec_end events (tpu_faas/obs/trace.py)]
+               [, misfires: int — the pool's cumulative misfire-repair
+               counter] [, no_task=True while draining
                (pull): the mandatory reply must be WAIT, never a new task]
     READY      (pull only) data: worker_id
     HEARTBEAT  (push hb) data: {}

@@ -39,9 +39,25 @@ from tpu_faas.core.executor import (
 )
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import TaskStatus
-from tpu_faas.utils.logging import get_logger
+from tpu_faas.obs import REGISTRY
+from tpu_faas.utils.logging import get_logger, log_ctx
 
 log = get_logger("worker.pool")
+
+#: Process-wide pool counters (the worker process's share of the unified
+#: metric catalog): every drained result by terminal status, plus the
+#: misfire repairs — the one at-least-once execution in the system — as a
+#: first-class series instead of a buried log line.
+_TASKS_TOTAL = REGISTRY.counter(
+    "tpu_faas_worker_pool_tasks_total",
+    "Results drained from this process's task pools, by terminal status",
+    ("status",),
+)
+_MISFIRES_TOTAL = REGISTRY.counter(
+    "tpu_faas_worker_pool_misfires_total",
+    "Cancel interrupts that landed on a bystander task and were repaired "
+    "by resubmission (at-least-once executions)",
+)
 
 #: child-side: the task id currently executing in THIS child (None between
 #: tasks) — consulted by the SIGUSR1 handler, plain memory only (a signal
@@ -289,6 +305,7 @@ class TaskPool:
             if fut.cancelled():
                 if wanted:
                     # deliberate pre-start cancel: terminal CANCELLED
+                    _TASKS_TOTAL.labels(status=str(TaskStatus.CANCELLED)).inc()
                     out.append(
                         ExecutionResult(
                             task_id,
@@ -323,12 +340,16 @@ class TaskPool:
                     log.warning(
                         "misfired cancel interrupt hit task %s; "
                         "resubmitting it", task_id,
+                        extra=log_ctx(task_id=task_id),
                     )
                     self.n_misfires += 1
+                    _MISFIRES_TOTAL.inc()
                     self.submit(task_id, *args)
                     continue
+                _TASKS_TOTAL.labels(status=res.status).inc()
                 out.append(res)
             else:
+                _TASKS_TOTAL.labels(status=str(TaskStatus.FAILED)).inc()
                 out.append(
                     ExecutionResult(
                         task_id,

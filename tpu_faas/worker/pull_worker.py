@@ -76,7 +76,10 @@ class PullWorker:
         reply_type, reply = m.decode(self.socket.recv())
         for tid in reply.get("cancel_ids", ()):
             if self.pool.cancel(tid):
-                log.info("force-cancelling task %s", tid)
+                log.info(
+                    "force-cancelling task %s", tid,
+                    extra={"task_id": tid, "worker_id": self.worker_id},
+                )
         if reply_type == m.TASK:
             self.pool.submit(
                 reply["task_id"],
@@ -105,6 +108,7 @@ class PullWorker:
                         status=res.status,
                         result=res.result,
                         elapsed=res.elapsed,
+                        started_at=res.started_at,
                         misfires=self.pool.n_misfires,
                         no_task=self._draining,
                     )

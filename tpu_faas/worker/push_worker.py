@@ -139,7 +139,10 @@ class PushWorker:
                             # shipped or is about to; nothing to do)
                             tid = data.get("task_id", "")
                             if self.pool.cancel(tid):
-                                log.info("force-cancelling task %s", tid)
+                                log.info(
+                                    "force-cancelling task %s", tid,
+                                    extra={"task_id": tid},
+                                )
                         elif msg_type == m.RECONNECT:
                             # a draining worker reports zero capacity: it
                             # must not be handed new work
@@ -160,8 +163,13 @@ class PushWorker:
                             status=res.status,
                             result=res.result,
                             elapsed=res.elapsed,
+                            started_at=res.started_at,
                             misfires=self.pool.n_misfires,
                         )
+                    )
+                    log.debug(
+                        "shipped result %s", res.status,
+                        extra={"task_id": res.task_id},
                     )
                     shipped += 1
                 if max_tasks is not None and shipped >= max_tasks:
