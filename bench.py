@@ -318,16 +318,16 @@ def main() -> None:
         sink_reps.append(sink_rep())
 
     def robust_tick_ms(reps_list):
-        """Estimate the per-tick time under the tunnel's contamination
-        model: jitter is dominantly ADDITIVE (a busy transport window
-        inflates a whole pipelined run; the physical tick time is a
-        constant), so the upper tail is fat while the lower edge clusters
-        at the true cost — the r4 clean-window reps (5.78-6.71 plus one
-        11.3 outlier) show exactly this shape. Non-positive slopes
+        """(q25, median) over the physically-valid reps. The HEADLINE is
+        the MEDIAN (ADVICE r5: q25 is a systematically optimistic
+        estimator and must not carry the <10 ms budget claim); the 25th
+        percentile is kept as the transport-contamination-adjusted
+        context number — tunnel jitter is dominantly additive/one-sided,
+        so the lower quartile approximates the uncontaminated cost, but
+        that model is unvalidated against a measured noise floor and the
+        budget is judged conservatively. Non-positive slopes
         (anti-correlated jitter across depths) are physically impossible
-        and excluded; the estimate is the 25th percentile of the valid
-        reps, with the plain median and every rep recorded alongside so
-        the artifact carries the conservative read too."""
+        and excluded; every rep is recorded alongside."""
         valid_r = [x for x in reps_list if x > 0.0]
         if not valid_r:
             return None, None
@@ -336,15 +336,15 @@ def main() -> None:
             float(np.median(valid_r)),
         )
 
-    integrated_ms, integrated_median_ms = robust_tick_ms(int_reps)
-    sink_ms, sink_median_ms = robust_tick_ms(sink_reps)
+    integrated_q25_ms, integrated_ms = robust_tick_ms(int_reps)
+    sink_q25_ms, sink_ms = robust_tick_ms(sink_reps)
 
     def _fmt(x) -> str:
         return "n/a" if x is None else f"{x:.3f}"
 
     print(
         "integrated resident tick, rank placement: "
-        f"{_fmt(integrated_ms)} ms (median {_fmt(integrated_median_ms)}) — "
+        f"{_fmt(integrated_ms)} ms median (q25 {_fmt(integrated_q25_ms)}) — "
         "reps " + ", ".join(f"{x:.3f}" for x in int_reps)
         + f" | single sync incl. compacted readback: "
         f"{integrated_single_ms:.1f} ms (transport floor {floor_ms:.1f} ms)",
@@ -352,7 +352,7 @@ def main() -> None:
     )
     print(
         "integrated resident tick, sinkhorn placement: "
-        f"{_fmt(sink_ms)} ms (median {_fmt(sink_median_ms)}) — reps "
+        f"{_fmt(sink_ms)} ms median (q25 {_fmt(sink_q25_ms)}) — reps "
         + ", ".join(f"{x:.3f}" for x in sink_reps),
         file=sys.stderr,
     )
@@ -484,23 +484,22 @@ def main() -> None:
                 # full resident tick WITH the entropic heterogeneous
                 # solver at 50k x 4k (the rank leg is reported alongside;
                 # if sinkhorn fits the budget, rank trivially does).
-                # Estimator: q25 of 9 interleaved Theil-Sen reps —
-                # transport contamination is additive/one-sided (see
-                # robust_tick_ms), and the median + full rep lists are
-                # recorded for the conservative read.
+                # Estimator: MEDIAN of 9 interleaved Theil-Sen reps
+                # (ADVICE r5 — the budget claim must not headline the
+                # optimistic q25); the q25 rides as the transport-
+                # contamination-adjusted context field, with every rep
+                # recorded.
                 "integrated_tick_50k_ms": (
                     None if sink_ms is None else round(sink_ms, 3)
                 ),
-                "integrated_tick_50k_median_ms": (
-                    None
-                    if sink_median_ms is None
-                    else round(sink_median_ms, 3)
+                "integrated_tick_50k_q25_ms": (
+                    None if sink_q25_ms is None else round(sink_q25_ms, 3)
                 ),
                 "integrated_path": "resident+sinkhorn",
                 "integrated_estimator": (
-                    "q25 of 9 interleaved Theil-Sen slope reps "
-                    "(additive one-sided transport contamination; "
-                    "median + reps recorded)"
+                    "median of 9 interleaved Theil-Sen slope reps "
+                    "(q25 kept as additive-contamination-adjusted "
+                    "context; reps recorded)"
                 ),
                 "integrated_sinkhorn_reps_ms": [
                     round(r, 3) for r in sink_reps
@@ -508,10 +507,10 @@ def main() -> None:
                 "integrated_rank_tick_50k_ms": (
                     None if integrated_ms is None else round(integrated_ms, 3)
                 ),
-                "integrated_rank_median_ms": (
+                "integrated_rank_q25_ms": (
                     None
-                    if integrated_median_ms is None
-                    else round(integrated_median_ms, 3)
+                    if integrated_q25_ms is None
+                    else round(integrated_q25_ms, 3)
                 ),
                 # the integrated tick pays ONE ~22 KB host->device put per
                 # tick; over the tunneled dev transport that put's cost

@@ -792,3 +792,53 @@ def test_obs_wall_clock_latency_suppressible(tmp_path):
         "dispatch/lease.py",
     )
     assert findings == []
+
+
+# -- protocol: EXPIRED (queue-deadline shedding) -----------------------------
+
+
+def test_protocol_expired_terminal_set_status_fires(tmp_path):
+    """EXPIRED is terminal, so the derived TERMINAL set must catch a bare
+    set_status writing it — terminal writes go through expire_task (stamp,
+    index drop, results announce), never raw status writes."""
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, tid):
+            store.set_status(tid, "EXPIRED")
+        """,
+    )
+    assert hits(findings) == [("protocol.terminal-set-status", 2)]
+    assert findings[0].severity == "error"
+
+
+def test_protocol_expired_finish_task_fires(tmp_path):
+    """RUNNING -> EXPIRED is deliberately NOT in racecheck._LEGAL (shed is
+    QUEUED-only): a finish_task carrying EXPIRED must be an error, proven
+    from the derived legal-finish set, not a copied list."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        def f(store, tid):
+            store.finish_task(tid, TaskStatus.EXPIRED, "r")
+        """,
+    )
+    assert hits(findings) == [("protocol.illegal-finish-status", 4)]
+    assert findings[0].severity == "error"
+
+
+def test_protocol_expire_task_call_is_clean(tmp_path):
+    """The sanctioned shed path: store.expire_task carries its own stamp/
+    index/announce contract inside the store package — call sites are
+    clean."""
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, tid, channel):
+            status = store.expire_task(tid, channel)
+            return status
+        """,
+    )
+    assert hits(findings) == []

@@ -495,3 +495,84 @@ def test_dispatcher_learns_param_variants_end_to_end():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def test_ephemeral_uuid_tokens_never_persist_and_forget_on_purge():
+    """ADVICE r5 (medium): a worker launched without --token mints a uuid
+    per process start and flags it ephemeral on REGISTER. Its grade works
+    in memory (reconnects keep it) but is NEVER written to
+    WORKER_STATS_KEY — and the purge path forgets it — so ad-hoc restarts
+    stop leaking one store entry per process forever. Operator/deploy
+    tokens stay durable."""
+    import numpy as np
+
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.sched.estimator import WORKER_STATS_KEY
+    from tpu_faas.store.memory import MemoryStore
+
+    store = MemoryStore()
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=store, max_workers=8,
+        max_pending=32, max_inflight=64,
+    )
+    try:
+        disp._handle(
+            b"s-eph", "register",
+            {"num_processes": 2, "token": "uuid-minted", "ephemeral": True},
+        )
+        disp._handle(
+            b"s-dur", "register",
+            {"num_processes": 2, "token": "deploy-slot0"},
+        )
+        row_e = disp.arrays.worker_ids[b"s-eph"]
+        row_d = disp.arrays.worker_ids[b"s-dur"]
+        fd = fn_digest("fn")
+        for i in range(30):
+            for sock, r, elapsed in (
+                (b"s-dur", row_d, 1.0), (b"s-eph", row_e, 0.25),
+            ):
+                tid = f"t{i}-{elapsed}"
+                disp._task_digest[tid] = (fd, fn_digest("p"), 8)
+                disp._observe_result(
+                    sock, r, tid, {"elapsed": elapsed, "status": "COMPLETED"}
+                )
+        # both graded in memory; the ephemeral grade is live and useful
+        assert disp.estimator.speed_for("uuid-minted") > 1.5
+        assert disp.estimator.is_ephemeral("uuid-minted")
+        assert not disp.estimator.is_ephemeral("deploy-slot0")
+        disp.estimator.maybe_persist(force=True)
+        persisted = store.hgetall(WORKER_STATS_KEY)
+        assert "deploy-slot0" in persisted  # durable token persisted
+        assert "uuid-minted" not in persisted  # ephemeral NEVER persisted
+
+        # purge the ephemeral worker through the real reap path: grade gone
+        disp.arrays.heartbeat(b"s-eph")
+        disp._reap_dead_workers([], [int(row_e)], lambda pt: None)
+        assert disp.estimator.speed_for("uuid-minted") == 1.0
+        # the durable worker's purge keeps its grade (unchanged behavior)
+        disp._reap_dead_workers([], [int(row_d)], lambda pt: None)
+        assert disp.estimator.speed_for("deploy-slot0") > 0.0
+        assert "deploy-slot0" in store.hgetall(WORKER_STATS_KEY)
+        assert isinstance(np.asarray(disp.arrays.worker_active), np.ndarray)
+    finally:
+        disp.socket.close(linger=0)
+
+
+def test_push_worker_flags_minted_token_ephemeral():
+    """The wire contract behind the leak fix: no --token -> ephemeral=True
+    rides REGISTER; an operator token -> ephemeral=False."""
+    from tpu_faas.worker.push_worker import PushWorker
+
+    # DEALER connect doesn't bind, so construction is cheap and offline
+    w = PushWorker(1, "tcp://127.0.0.1:1")
+    try:
+        assert w.token_is_ephemeral is True and len(w.token) == 32
+    finally:
+        w.pool.close()
+        w.socket.close(linger=0)
+    w = PushWorker(1, "tcp://127.0.0.1:1", token="deploy-slot1")
+    try:
+        assert w.token_is_ephemeral is False and w.token == "deploy-slot1"
+    finally:
+        w.pool.close()
+        w.socket.close(linger=0)

@@ -1,0 +1,158 @@
+"""Overload chaos end-to-end: a burst >= 3x fleet capacity PLUS a worker
+SIGKILL mid-burst, race monitor on, against the full real stack (store
+server over TCP, gateway with admission engaged, tpu-push dispatcher,
+subprocess workers). The invariants under fire:
+
+- no admitted task is lost: every id the gateway acknowledged reaches a
+  terminal state (COMPLETED for plain tasks; COMPLETED or EXPIRED for the
+  deadline slice), even though a worker died holding tasks;
+- every reject is a clean 429/503 carrying a Retry-After header — no
+  hangs, no 500s, no silent drops;
+- EXPIRED happens only from QUEUED: the runtime race monitor would flag
+  any RUNNING -> EXPIRED write as an illegal-transition ERROR, and the
+  run must end with zero protocol errors.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+import requests
+
+from tpu_faas.admission import AdmissionController
+from tpu_faas.admission.controller import AdmissionConfig
+from tpu_faas.client import FaaSClient
+from tpu_faas.core.executor import pack_params
+from tpu_faas.core.serialize import serialize
+from tpu_faas.core.task import TaskStatus
+from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+from tpu_faas.workloads import sleep_task
+from tests.test_workers_e2e import _spawn_worker
+
+BOUND = 40
+TASK_S = 0.25
+
+
+def test_overload_burst_worker_kill_invariants():
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    admission = AdmissionController(
+        AdmissionConfig(max_system_inflight=BOUND)
+    )
+    gw = start_gateway_thread(
+        RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="gateway"
+        ),
+        admission=admission,
+    )
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="dispatcher"
+        ),
+        max_workers=64,
+        max_pending=256,
+        max_inflight=512,
+        tick_period=0.01,
+        time_to_expire=1.5,
+        rescan_period=0.5,
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(3)
+    ]
+    client = FaaSClient(gw.url)
+    raw = requests.Session()  # NO retries: rejects must surface raw
+    try:
+        fid = client.register(sleep_task)
+        payload = pack_params(TASK_S)
+
+        # warmup (worker pools spawn, first dill decode) — small, admitted
+        for h in client.submit_many(fid, [((TASK_S,), {})] * 6):
+            assert h.result(timeout=60.0) == TASK_S
+
+        # -- the burst: ~3x what the fleet can hold, raw posts ------------
+        # 6 slots x 0.25 s tasks drain ~24/s; the bound admits at most
+        # BOUND in-system. Offer 3 * BOUND quickly; the tail must reject.
+        admitted: list[str] = []
+        deadline_ids: list[str] = []
+        rejects = 0
+        bad_rejects = []
+        for i in range(3 * BOUND):
+            body = {"function_id": fid, "payload": payload}
+            if i % 5 == 4:
+                # the deadline slice: lapses while queued behind ~BOUND
+                # tasks unless it lands near the front
+                body["deadline"] = 0.8
+            r = raw.post(f"{gw.url}/execute_function", json=body, timeout=30)
+            if r.status_code == 200:
+                tid = r.json()["task_id"]
+                admitted.append(tid)
+                if "deadline" in body:
+                    deadline_ids.append(tid)
+            elif r.status_code in (429, 503):
+                rejects += 1
+                if not r.headers.get("Retry-After"):
+                    bad_rejects.append((r.status_code, dict(r.headers)))
+            else:
+                bad_rejects.append((r.status_code, r.text[:200]))
+            if i == BOUND:  # mid-burst: a worker dies holding tasks
+                workers[0].send_signal(signal.SIGKILL)
+                workers[0].wait()
+
+        assert rejects > 0, "burst never tripped admission"
+        assert not bad_rejects, bad_rejects
+        assert len(admitted) >= 1
+
+        # -- drain: every admitted task reaches a terminal state ----------
+        probe = make_store(store_handle.url)
+        deadline_wall = time.monotonic() + 120
+        statuses: dict[str, str] = {}
+        pending = list(admitted)
+        while pending and time.monotonic() < deadline_wall:
+            got = probe.hget_many(pending, "status")
+            still = []
+            for tid, status in zip(pending, got):
+                if status is not None and TaskStatus.terminal_str(status):
+                    statuses[tid] = status
+                else:
+                    still.append(tid)
+            pending = still
+            if pending:
+                time.sleep(0.25)
+        probe.close()
+        assert pending == [], f"{len(pending)} admitted tasks lost"
+
+        # plain tasks all COMPLETED (worker kill recovered by re-dispatch);
+        # the deadline slice may legitimately EXPIRE instead
+        deadline_set = set(deadline_ids)
+        for tid, status in statuses.items():
+            if tid in deadline_set:
+                assert status in ("COMPLETED", "EXPIRED"), (tid, status)
+            else:
+                assert status == "COMPLETED", (tid, status)
+
+        # protocol clean: zero errors means, among everything else, that
+        # every EXPIRED write came from QUEUED (RUNNING -> EXPIRED is an
+        # illegal-transition ERROR) and the worker kill double-dispatched
+        # nothing undeclared
+        assert monitor.errors == [], "\n".join(str(v) for v in monitor.errors)
+        assert monitor.unfinished() == []
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()

@@ -255,3 +255,71 @@ def test_non_enum_status_is_flagged_not_crashed():
     assert "illegal-transition" in kinds
     # and the task tracker still works
     assert m.unfinished() == []
+
+
+# -- queue-deadline expiry (EXPIRED) -----------------------------------------
+
+
+def test_queued_to_expired_is_clean_and_terminal():
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("d", "status", "t", {S: "EXPIRED"})
+    m.assert_clean()
+    assert m.unfinished() == []
+
+
+def test_running_to_expired_is_error():
+    """The shed is QUEUED-only by protocol: an EXPIRED write over a
+    dispatched task is exactly the bug class this monitor exists for."""
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    m.observe("d", "status", "t", {S: "EXPIRED"})
+    kinds = [v.kind for v in m.errors]
+    assert kinds == ["illegal-transition"]
+
+
+def test_result_over_expired_is_late_race_warning():
+    """A zombie's genuine result landing over a (lost-race) EXPIRED record
+    is truth overwriting a stale never-ran claim — warning, like the
+    cancel analog."""
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("d", "status", "t", {S: "EXPIRED"})
+    m.observe("d", "finish", "t", {S: "COMPLETED", R: "42"})
+    assert m.errors == []
+    assert [v.kind for v in m.warnings] == ["late-cancel-race"]
+
+
+def test_cancel_expire_cross_writes_warn_not_error():
+    """A cancel racing a deadline shed: both assert never-ran; whichever
+    stands tells the client the truth."""
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("gw", "status", "t", {S: "CANCELLED"})
+    m.observe("d", "status", "t", {S: "EXPIRED"})
+    assert m.errors == []
+    assert [v.kind for v in m.warnings] == ["cancel-expire-race"]
+
+
+def test_expire_clobbering_landed_result_is_repairable_warning():
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    m.observe("d", "finish", "t", {S: "COMPLETED", R: "42"})
+    m.observe("d", "status", "t", {S: "EXPIRED"})
+    assert m.errors == []
+    assert [v.kind for v in m.warnings] == ["cancel-after-finish"]
+
+
+def test_keyed_create_via_setnx_is_observed_as_create():
+    """create_task_if_absent claims QUEUED through setnx_field; the
+    wrapped store must surface that claim as the task's create, or every
+    keyed submit's later RUNNING reads as None -> RUNNING."""
+    m = _mon()
+    store = RaceCheckStore(MemoryStore(), m, actor="gw")
+    assert store.create_task_if_absent("t", "F", "P")
+    store.set_status("t", "RUNNING", extra_fields={"lease_at": "1"})
+    store.finish_task("t", "COMPLETED", "42")
+    m.assert_clean(allow_warnings=True)
+    assert m.errors == []

@@ -160,3 +160,56 @@ def test_live_index_tracks_task_lifecycle():
     s.finish_task("t1", "COMPLETED", "R")
     s.finish_task("t3", "COMPLETED", "R")
     assert s.hgetall(LIVE_INDEX_KEY) == {}
+
+
+def test_create_tasks_if_absent_batch_semantics():
+    """The batched keyed-create: fresh ids are created+announced with
+    created=True; ids whose record already exists (any status) write
+    NOTHING and return False — a re-sent batch can never regress a
+    dispatched task back to QUEUED."""
+    s = MemoryStore()
+    sub = s.subscribe(TASKS_CHANNEL)
+    flags = s.create_tasks_if_absent(
+        [("a", "F", "PA"), ("b", "F", "PB", {"priority": "2"})]
+    )
+    assert flags == [True, True]
+    assert {sub.get_message(), sub.get_message()} == {"a", "b"}
+    assert s.hget("b", "priority") == "2"
+    # "a" progressed; a duplicate batch (retry after lost response) must
+    # not touch it, while the genuinely-new "c" is created
+    s.set_status("a", TaskStatus.RUNNING)
+    flags = s.create_tasks_if_absent(
+        [("a", "F", "PA"), ("c", "F", "PC")]
+    )
+    assert flags == [False, True]
+    assert s.get_status("a") == "RUNNING"
+    assert s.hget("a", FIELD_STATUS) == "RUNNING"
+    assert s.get_status("c") == "QUEUED"
+    assert sub.get_message() == "c"
+    assert sub.get_message() is None
+    # live index tracks the batch form too
+    assert "c" in s.hgetall(LIVE_INDEX_KEY)
+
+
+def test_batched_keyed_create_never_regresses_a_racing_dispatch():
+    """The stalled-winner race: gateway A wins the status claim, stalls;
+    a duplicate submit adopts the record and a dispatcher marks it
+    RUNNING; A's late field write must NOT rewrite status back to QUEUED
+    (that would re-announce and run the task twice). The winners' write
+    therefore carries no status field at all."""
+
+    class StalledWinner(MemoryStore):
+        fired = False
+
+        def hset_many(self, items):
+            if not self.fired:
+                self.fired = True
+                # the adversary acts inside the winner's stall window
+                self.set_status("t", TaskStatus.RUNNING)
+            super().hset_many(items)
+
+    s = StalledWinner()
+    created = s.create_tasks_if_absent([("t", "F", "P")])
+    assert created == [True]
+    assert s.get_status("t") == "RUNNING"  # dispatch stands, no regression
+    assert s.hget("t", "param_payload") == "P"  # fields still landed

@@ -12,8 +12,11 @@ configs[]) plus one framework-extra:
 9. (extra) host dispatch throughput: intake -> device -> act against the
    in-process RESP store server — the host data plane end to end, with the
    store-round-trips-per-tick counter proving the batched (pipelined) forms
+10. (extra) overload robustness: offered load >= 3x fleet capacity against
+   the full stack with the admission controller engaged — goodput holds,
+   rejects are clean 429s with Retry-After, no admitted task is lost
 
-Configs 1-2, 6 and 9 run the real socket stack; 3-5 run the device kernels
+Configs 1-2, 6, 9 and 10 run the real socket stack; 3-5 run the device kernels
 at scales the socket stack can't reach on one box (the reference had no
 analog — its harness topped out at localhost subprocesses, SURVEY §4).
 Each config returns a dict and is printed as one JSON line by the CLI.
@@ -751,6 +754,207 @@ def config_9_host_dispatch() -> dict:
         handle.stop()
 
 
+def config_10_overload() -> dict:
+    """Overload robustness (config 10): offered load >= 3x fleet capacity
+    against the full real stack — store server, gateway WITH the admission
+    controller engaged, tpu-push dispatcher publishing the saturation
+    signal, real push-worker subprocesses running sleep tasks.
+
+    Phase 1 measures the unloaded throughput (submissions paced under the
+    brownout threshold). Phase 2 offers ~3x the fleet's drain rate for a
+    fixed window with NO client-side retries, records every admitted task
+    id and every reject (asserting the Retry-After header is present),
+    then drains: the row proves (a) nonzero rejects — admission actually
+    engaged, (b) zero admitted tasks lost — every admitted id reached a
+    terminal state, (c) goodput under overload vs the unloaded
+    throughput (the graceful-degradation ratio; the acceptance bar is
+    >= 0.85), (d) all rejects carried Retry-After. A slice of the burst
+    carries a short queue ``deadline``, exercising EXPIRED shedding end
+    to end (count reported; timing-dependent, not asserted).
+
+    Shape via TPU_FAAS_BENCH_OVERLOAD_SHAPE="workers,procs,task_ms,
+    window_s" (default "4,2,100,10"); the CI smoke lane runs "2,2,60,6".
+    """
+    import os
+
+    import requests as _requests
+
+    from tpu_faas.admission import AdmissionController
+    from tpu_faas.admission.controller import AdmissionConfig
+    from tpu_faas.bench.harness import _spawn_worker
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.executor import pack_params
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.core.task import TaskStatus
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.workloads import sleep_task
+
+    import threading as _threading
+
+    shape = os.environ.get("TPU_FAAS_BENCH_OVERLOAD_SHAPE", "4,2,100,10")
+    n_workers, n_procs, task_ms, window_s = (
+        float(x) for x in shape.split(",")
+    )
+    n_workers, n_procs = int(n_workers), int(n_procs)
+    slots = n_workers * n_procs
+    task_s = task_ms / 1e3
+    capacity_rate = slots / task_s  # tasks/s the fleet can drain
+    bound = 4 * slots  # admission bound: ~4 queued waves of work
+
+    handle = start_store_thread()
+    admission = AdmissionController(
+        AdmissionConfig(max_system_inflight=bound)
+    )
+    gw = start_gateway_thread(make_store(handle.url), admission=admission)
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(handle.url),
+        max_workers=max(64, n_workers),
+        max_pending=max(256, 2 * bound),
+        max_inflight=4096,
+        max_slots=n_procs,
+        tick_period=0.005,
+        time_to_expire=5.0,
+        rescan_period=2.0,
+    )
+    disp_thread = _threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker(
+            "push_worker", n_procs, url, "--hb", "--hb-period", "0.5"
+        )
+        for _ in range(n_workers)
+    ]
+    client = FaaSClient(gw.url)  # phase-1 client (retries on)
+    raw = _requests.Session()  # phase-2: raw posts, NO retries
+    try:
+        time.sleep(1.5)  # workers register
+        fid = client.register_payload("sleep", serialize(sleep_task))
+        payload = pack_params(task_s)
+
+        # -- phase 1: unloaded throughput (stay under brownout) -----------
+        n0_wave = max(1, bound // 2)
+        # untimed warmup wave: worker pool spawn + first dill decode would
+        # otherwise be billed to the unloaded number and fake a flattering
+        # goodput ratio
+        for h in client.submit_many(fid, [((task_s,), {})] * n0_wave):
+            h.result(timeout=120.0)
+        n0 = 0
+        t0 = time.perf_counter()
+        for _ in range(3):
+            handles = client.submit_many(
+                fid, [((task_s,), {})] * n0_wave
+            )
+            for h in handles:
+                h.result(timeout=120.0)
+            n0 += n0_wave
+        unloaded_tps = n0 / (time.perf_counter() - t0)
+
+        # -- phase 2: 3x offered load, no retries -------------------------
+        offered_rate = 3.0 * capacity_rate
+        burst = max(1, int(round(offered_rate / 8)))  # 8 bursts/s
+        deadline_s = max(0.2, bound / (3.0 * capacity_rate))
+        admitted: list[str] = []
+        deadline_ids: list[str] = []
+        offered = rejected = with_retry_after = 0
+        t_burst0 = time.perf_counter()
+        i_burst = 0
+        while time.perf_counter() - t_burst0 < window_s:
+            body = {
+                "function_id": fid,
+                "payloads": [payload] * burst,
+            }
+            if i_burst % 4 == 3:
+                # the deadline slice: short submit-TTL under a saturated
+                # queue — EXPIRED shedding end to end
+                body["deadlines"] = [deadline_s] * burst
+            r = raw.post(f"{gw.url}/execute_batch", json=body, timeout=30)
+            offered += burst
+            if r.status_code == 200:
+                ids = r.json()["task_ids"]
+                admitted.extend(ids)
+                if "deadlines" in body:
+                    deadline_ids.extend(ids)
+            elif r.status_code in (429, 503):
+                rejected += burst
+                if r.headers.get("Retry-After"):
+                    with_retry_after += burst
+            else:
+                r.raise_for_status()
+            i_burst += 1
+            # pace the OFFERED load (not the admitted load)
+            sleep_until = t_burst0 + i_burst * burst / offered_rate
+            pause = sleep_until - time.perf_counter()
+            if pause > 0:
+                time.sleep(pause)
+
+        # -- drain: every admitted task must reach a terminal state -------
+        store = make_store(handle.url)
+        deadline_wall = time.monotonic() + max(60.0, 20 * window_s)
+        statuses: dict[str, str] = {}
+        pending_ids = list(admitted)
+        while pending_ids and time.monotonic() < deadline_wall:
+            got = store.hget_many(pending_ids, "status")
+            still = []
+            for tid, status in zip(pending_ids, got):
+                if status is not None and TaskStatus.terminal_str(status):
+                    statuses[tid] = status
+                else:
+                    still.append(tid)
+            pending_ids = still
+            if pending_ids:
+                time.sleep(0.25)
+        t_done = time.perf_counter()
+        store.close()
+
+        completed = sum(
+            1 for s in statuses.values() if s == str(TaskStatus.COMPLETED)
+        )
+        expired = sum(
+            1 for s in statuses.values() if s == str(TaskStatus.EXPIRED)
+        )
+        goodput = completed / max(t_done - t_burst0, 1e-9)
+        return {
+            "config": "overload-3x-admission",
+            "shape": {
+                "workers": n_workers,
+                "procs": n_procs,
+                "task_ms": task_ms,
+                "window_s": window_s,
+                "bound": bound,
+            },
+            "capacity_tasks_per_s": round(capacity_rate, 1),
+            "offered_tasks_per_s": round(offered_rate, 1),
+            "unloaded_tasks_per_s": round(unloaded_tps, 1),
+            "offered": offered,
+            "admitted": len(admitted),
+            "rejected": rejected,
+            "rejects_with_retry_after": with_retry_after,
+            "admitted_lost": len(pending_ids),
+            "completed": completed,
+            "expired": expired,
+            "deadline_slice": len(deadline_ids),
+            "overload_goodput_tasks_per_s": round(goodput, 1),
+            "goodput_ratio": round(goodput / max(unloaded_tps, 1e-9), 3),
+            "gateway_stats_admission": _requests.get(
+                f"{gw.url}/stats", timeout=10
+            ).json()["admission"],
+        }
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        disp_thread.join(timeout=10)
+        gw.stop()
+        handle.stop()
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -761,4 +965,5 @@ CONFIGS = {
     "7": config_7_bid_headline,
     "8": config_8_estimation,
     "9": config_9_host_dispatch,
+    "10": config_10_overload,
 }

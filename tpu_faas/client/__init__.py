@@ -4,13 +4,15 @@ tpu_faas.client.aio, imported lazily so sync users don't pay for aiohttp)."""
 from tpu_faas.client.sdk import (
     FaaSClient,
     TaskCancelledError,
+    TaskExpiredError,
     TaskFailedError,
     TaskHandle,
 )
 
 # async names stay OUT of __all__: `import *` must not eagerly pull aiohttp
 __all__ = [
-    "FaaSClient", "TaskHandle", "TaskCancelledError", "TaskFailedError",
+    "FaaSClient", "TaskHandle", "TaskCancelledError", "TaskExpiredError",
+    "TaskFailedError",
 ]
 
 _LAZY_ASYNC = ("AsyncFaaSClient", "AsyncTaskHandle")

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import random
+import uuid
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -20,7 +22,9 @@ import aiohttp
 
 from tpu_faas.client.sdk import (
     TaskCancelledError,
+    TaskExpiredError,
     TaskFailedError,
+    _retry_after_s,  # shared Retry-After parsing: sync and async must agree
     _unwrap_terminal,
 )
 from tpu_faas.core.executor import pack_params
@@ -95,20 +99,39 @@ class AsyncFaaSClient:
         self,
         base_url: str = "http://127.0.0.1:8000",
         connect_retries: int = 5,
+        overload_retries: int = 4,
+        auto_idempotency: bool = True,
     ) -> None:
+        """``overload_retries``/``auto_idempotency``: same overload
+        contract as the sync FaaSClient — 429/503 submit rejects retry
+        honoring ``Retry-After`` with jittered exponential backoff, and
+        every submit carries an idempotency key (auto-minted unless the
+        caller supplied one or disabled it) so retries are
+        duplicate-safe."""
         self.base_url = base_url.rstrip("/")
         self.connect_retries = connect_retries
+        self.overload_retries = int(overload_retries)
+        self.auto_idempotency = bool(auto_idempotency)
         self._http: aiohttp.ClientSession | None = None
 
     @contextlib.asynccontextmanager
     async def request(
-        self, method: str, url: str, retry_budget: float | None = None, **kw
+        self,
+        method: str,
+        url: str,
+        retry_budget: float | None = None,
+        retry_overload: bool = False,
+        **kw,
     ):
         """All SDK HTTP rides through here: CONNECTION-establishment
         failures retry with backoff (gateway restarting behind a stable
         address — mirrors the sync client's adapter). Nothing has reached
         the wire on a connector error, so the retry is safe even for
-        POSTs; errors after the request is sent are never retried.
+        POSTs; errors after the request is sent are never retried —
+        EXCEPT 429/503 overload rejects when ``retry_overload`` is set
+        (submit paths only, whose bodies carry idempotency keys): those
+        sleep the server's Retry-After (jittered) and re-send, up to
+        ``overload_retries`` times.
 
         ``retry_budget`` caps the total seconds spent in retry sleeps —
         deadline-bound callers (AsyncTaskHandle.result) pass their
@@ -119,9 +142,27 @@ class AsyncFaaSClient:
         )
         delay = 0.3
         attempt = 0
+        overload_attempt = 0
+        floor = 0.25
         while True:
             try:
                 async with self.http.request(method, url, **kw) as r:
+                    if (
+                        retry_overload
+                        and r.status in (429, 503)
+                        and overload_attempt < self.overload_retries
+                    ):
+                        pause = max(_retry_after_s(r, floor), floor)
+                        if give_up_at is not None:
+                            pause = min(
+                                pause, max(0.0, give_up_at - loop.time())
+                            )
+                        overload_attempt += 1
+                        floor = min(floor * 2, 30.0)
+                        await asyncio.sleep(
+                            pause * random.uniform(0.8, 1.3)
+                        )
+                        continue
                     yield r
                 return
             except aiohttp.ClientConnectorError:
@@ -173,10 +214,14 @@ class AsyncFaaSClient:
         payload = await loop.run_in_executor(
             None, lambda: pack_params(*args, **kwargs)
         )
+        body = {"function_id": function_id, "payload": payload}
+        if self.auto_idempotency:
+            body["idempotency_key"] = uuid.uuid4().hex
         async with self.request(
             "POST",
             f"{self.base_url}/execute_function",
-            json={"function_id": function_id, "payload": payload},
+            retry_overload=True,
+            json=body,
         ) as r:
             r.raise_for_status()
             return AsyncTaskHandle(self, (await r.json())["task_id"])
@@ -191,13 +236,17 @@ class AsyncFaaSClient:
         cost: float | None = None,
         timeout: float | None = None,
         idempotency_key: str | None = None,
+        deadline: float | None = None,
     ) -> AsyncTaskHandle:
         """submit() plus scheduling hints (mirrors the sync SDK): higher
         ``priority`` is admitted first under overload; ``cost`` is the
         estimated run-cost used for task<->worker pairing; ``timeout`` is
         the execution budget enforced inside the worker's pool child;
+        ``deadline`` is a submit-TTL in seconds (still QUEUED past it →
+        terminal EXPIRED, result() raises TaskExpiredError);
         ``idempotency_key`` makes the submit safely retryable (a re-send
-        addresses the same task instead of running it twice)."""
+        addresses the same task instead of running it twice; auto-minted
+        unless auto_idempotency=False)."""
         loop = asyncio.get_running_loop()
         payload = await loop.run_in_executor(
             None, lambda: pack_params(*args, **(kwargs or {}))
@@ -209,10 +258,17 @@ class AsyncFaaSClient:
             body["cost"] = cost
         if timeout is not None:
             body["timeout"] = timeout
+        if deadline is not None:
+            body["deadline"] = deadline
+        if idempotency_key is None and self.auto_idempotency:
+            idempotency_key = uuid.uuid4().hex
         if idempotency_key is not None:
             body["idempotency_key"] = idempotency_key
         async with self.request(
-            "POST", f"{self.base_url}/execute_function", json=body
+            "POST",
+            f"{self.base_url}/execute_function",
+            retry_overload=True,
+            json=body,
         ) as r:
             r.raise_for_status()
             return AsyncTaskHandle(self, (await r.json())["task_id"])
@@ -225,6 +281,7 @@ class AsyncFaaSClient:
         costs: list[float] | None = None,
         timeouts: list[float] | None = None,
         idempotency_keys: list[str | None] | None = None,
+        deadlines: list[float] | None = None,
     ) -> list[AsyncTaskHandle]:
         # dill-packing thousands of payloads inline would stall the event
         # loop (and every concurrently polling handle) — do it in a worker
@@ -243,10 +300,17 @@ class AsyncFaaSClient:
             body["costs"] = costs
         if timeouts is not None:
             body["timeouts"] = timeouts
+        if deadlines is not None:
+            body["deadlines"] = deadlines
+        if idempotency_keys is None and self.auto_idempotency:
+            idempotency_keys = [uuid.uuid4().hex for _ in params_list]
         if idempotency_keys is not None:
             body["idempotency_keys"] = idempotency_keys
         async with self.request(
-            "POST", f"{self.base_url}/execute_batch", json=body
+            "POST",
+            f"{self.base_url}/execute_batch",
+            retry_overload=True,
+            json=body,
         ) as r:
             r.raise_for_status()
             return [
@@ -287,5 +351,6 @@ __all__ = [
     "AsyncFaaSClient",
     "AsyncTaskHandle",
     "TaskCancelledError",
+    "TaskExpiredError",
     "TaskFailedError",
 ]

@@ -8,7 +8,9 @@ the raw wire format identical so hand-rolled clients interoperate.
 
 from __future__ import annotations
 
+import random
 import time
+import uuid
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -25,6 +27,38 @@ class TaskFailedError(Exception):
         super().__init__(f"task {task_id} FAILED: {cause!r}")
         self.task_id = task_id
         self.cause = cause
+
+
+class TaskExpiredError(Exception):
+    """Raised by result() when the task's terminal status is EXPIRED: its
+    queue deadline (the ``deadline`` submit hint) lapsed while it was
+    still QUEUED, so the dispatcher shed it — the function NEVER ran, no
+    side effects exist. Distinct from CANCELLED (an explicit client act)
+    and from the execution ``timeout`` hint (which interrupts a RUNNING
+    task and surfaces as FAILED/TaskTimeout)."""
+
+    def __init__(self, task_id: str) -> None:
+        super().__init__(
+            f"task {task_id} expired in queue before dispatch"
+        )
+        self.task_id = task_id
+
+
+#: Longest single backoff sleep either SDK will take, whatever the server
+#: (or a misconfigured proxy) puts in Retry-After — an hour-scale header
+#: must not hang a submit() thread for an hour.
+_RETRY_AFTER_CAP_S = 30.0
+
+
+def _retry_after_s(response, default: float) -> float:
+    """The server's Retry-After (delay-seconds form), else ``default``;
+    clamped to ``_RETRY_AFTER_CAP_S`` — the value is caller-controlled
+    input from the network, not something to sleep on unbounded."""
+    raw = response.headers.get("Retry-After")
+    try:
+        return min(max(0.0, float(raw)), _RETRY_AFTER_CAP_S)
+    except (TypeError, ValueError):
+        return default
 
 
 class TaskCancelledError(Exception):
@@ -97,11 +131,13 @@ class TaskHandle:
 def _unwrap_terminal(task_id: str, status: str, payload: str):
     """(done, value) for one /result poll — the single place that knows the
     terminal-status protocol (FAILED carries a serialized exception;
-    CANCELLED carries no result at all)."""
+    CANCELLED and EXPIRED carry no result at all)."""
     if not TaskStatus(status).is_terminal():
         return False, None
     if status == str(TaskStatus.CANCELLED):
         raise TaskCancelledError(task_id)
+    if status == str(TaskStatus.EXPIRED):
+        raise TaskExpiredError(task_id)
     value = deserialize(payload)
     if status == str(TaskStatus.FAILED):
         raise TaskFailedError(task_id, value)
@@ -113,8 +149,20 @@ class FaaSClient:
         self,
         base_url: str = "http://127.0.0.1:8000",
         connect_retries: int = 5,
+        overload_retries: int = 4,
+        auto_idempotency: bool = True,
     ) -> None:
+        """``overload_retries``: how many times a submit rejected with
+        429/503 (admission brownout, saturated system, store breaker) is
+        retried, honoring the server's ``Retry-After`` with jittered
+        exponential backoff; 0 surfaces the HTTPError on the first
+        reject. ``auto_idempotency``: mint a fresh idempotency key per
+        submit when the caller supplied none, so those retries (and any
+        manual re-send after a lost response) are duplicate-safe end to
+        end — the retry addresses the SAME task record."""
         self.base_url = base_url.rstrip("/")
+        self.overload_retries = int(overload_retries)
+        self.auto_idempotency = bool(auto_idempotency)
         self.http = requests.Session()
         # retry CONNECTION-establishment failures only (gateway restarting
         # behind a load balancer): nothing has reached the wire yet, so the
@@ -142,6 +190,26 @@ class FaaSClient:
         self.http.mount("http://", adapter)
         self.http.mount("https://", adapter)
 
+    def _post_submit(self, url: str, body: dict) -> requests.Response:
+        """POST a submit with overload backoff: 429/503 replies are
+        retried up to ``overload_retries`` times, sleeping the server's
+        ``Retry-After`` (or a growing local floor when absent) with
+        multiplicative jitter so a rejected burst doesn't re-arrive as
+        the same synchronized burst. Safe for submits because every
+        retried body carries an idempotency key (auto-minted when the
+        caller gave none) — the re-send addresses the same task record.
+        The final reject is returned (not raised): callers keep their
+        raise_for_status semantics."""
+        floor = 0.25
+        for attempt in range(self.overload_retries + 1):
+            r = self.http.post(url, json=body)
+            if r.status_code not in (429, 503) or attempt == self.overload_retries:
+                return r
+            pause = max(_retry_after_s(r, floor), floor)
+            time.sleep(pause * random.uniform(0.8, 1.3))
+            floor = min(floor * 2, 30.0)
+        return r
+
     # -- raw endpoints (wire format identical to SURVEY §0.1) --------------
     def register_payload(self, name: str, payload: str) -> str:
         r = self.http.post(
@@ -159,6 +227,7 @@ class FaaSClient:
         cost: float | None = None,
         timeout: float | None = None,
         idempotency_key: str | None = None,
+        deadline: float | None = None,
     ) -> str:
         body: dict = {"function_id": function_id, "payload": payload}
         if priority is not None:
@@ -167,9 +236,13 @@ class FaaSClient:
             body["cost"] = cost
         if timeout is not None:
             body["timeout"] = timeout
+        if deadline is not None:
+            body["deadline"] = deadline
+        if idempotency_key is None and self.auto_idempotency:
+            idempotency_key = uuid.uuid4().hex
         if idempotency_key is not None:
             body["idempotency_key"] = idempotency_key
-        r = self.http.post(f"{self.base_url}/execute_function", json=body)
+        r = self._post_submit(f"{self.base_url}/execute_function", body)
         r.raise_for_status()
         return r.json()["task_id"]
 
@@ -231,6 +304,7 @@ class FaaSClient:
         cost: float | None = None,
         timeout: float | None = None,
         idempotency_key: str | None = None,
+        deadline: float | None = None,
     ) -> TaskHandle:
         """submit() plus scheduling hints. The hints can't ride submit()
         itself — its **kwargs belong to the remote function — so args/kwargs
@@ -239,9 +313,14 @@ class FaaSClient:
         pair expensive tasks with fast workers; ``timeout``: execution time
         budget in seconds, enforced inside the worker's pool child — the
         task FAILs with TaskTimeout instead of eating a process slot
-        forever; ``idempotency_key``: a client-chosen string making this
-        submit safely retryable — a re-send (lost response, impatient
-        caller) addresses the SAME task instead of running it twice."""
+        forever; ``deadline``: submit-TTL in seconds — a task still QUEUED
+        this long after submit is shed to the terminal EXPIRED status
+        (result() raises TaskExpiredError) instead of burning a worker
+        slot on an answer nobody is waiting for; ``idempotency_key``: a
+        client-chosen string making this submit safely retryable — a
+        re-send (lost response, impatient caller) addresses the SAME task
+        instead of running it twice (auto-minted per submit unless
+        auto_idempotency=False)."""
         payload = pack_params(*args, **(kwargs or {}))
         return TaskHandle(
             self,
@@ -252,6 +331,7 @@ class FaaSClient:
                 cost=cost,
                 timeout=timeout,
                 idempotency_key=idempotency_key,
+                deadline=deadline,
             ),
         )
 
@@ -263,12 +343,16 @@ class FaaSClient:
         costs: list[float] | None = None,
         timeouts: list[float] | None = None,
         idempotency_keys: list[str | None] | None = None,
+        deadlines: list[float] | None = None,
     ) -> list[TaskHandle]:
         """Batch submit over ONE HTTP call (+ one pipelined store round
         trip): ``params_list`` holds (args, kwargs) pairs. N single submits
         cost N round trips on both hops — this is the bulk path.
-        ``priorities``/``costs``/``timeouts`` are optional scheduling-hint
-        lists parallel to ``params_list``."""
+        ``priorities``/``costs``/``timeouts``/``deadlines`` are optional
+        scheduling-hint lists parallel to ``params_list``. Keys are
+        auto-minted per item (unless auto_idempotency=False or the caller
+        passed its own list), so an overload-rejected batch retries
+        duplicate-safe."""
         body: dict = {
             "function_id": function_id,
             "payloads": [
@@ -281,9 +365,13 @@ class FaaSClient:
             body["costs"] = costs
         if timeouts is not None:
             body["timeouts"] = timeouts
+        if deadlines is not None:
+            body["deadlines"] = deadlines
+        if idempotency_keys is None and self.auto_idempotency:
+            idempotency_keys = [uuid.uuid4().hex for _ in params_list]
         if idempotency_keys is not None:
             body["idempotency_keys"] = idempotency_keys
-        r = self.http.post(f"{self.base_url}/execute_batch", json=body)
+        r = self._post_submit(f"{self.base_url}/execute_batch", body)
         r.raise_for_status()
         return [TaskHandle(self, tid) for tid in r.json()["task_ids"]]
 

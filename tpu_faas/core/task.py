@@ -26,10 +26,22 @@ class TaskStatus(str, enum.Enum):
     FAILED = "FAILED"
     #: terminal "never ran, never will": queued-only cancellation
     CANCELLED = "CANCELLED"
+    #: terminal "never ran, never will": the task's queue deadline
+    #: (FIELD_DEADLINE, an optional client hint) lapsed while it was still
+    #: QUEUED, and the dispatcher shed it instead of burning a worker slot
+    #: on an answer nobody is waiting for. Written only by the dispatcher
+    #: that owns the task's pending copy, via store.expire_task — the
+    #: transition is legal from QUEUED alone (a RUNNING task always runs
+    #: to completion; mid-run deadlines are the per-task `timeout` hint's
+    #: job, enforced in the worker pool child).
+    EXPIRED = "EXPIRED"
 
     def is_terminal(self) -> bool:
         return self in (
-            TaskStatus.COMPLETED, TaskStatus.FAILED, TaskStatus.CANCELLED
+            TaskStatus.COMPLETED,
+            TaskStatus.FAILED,
+            TaskStatus.CANCELLED,
+            TaskStatus.EXPIRED,
         )
 
     @classmethod
@@ -63,6 +75,13 @@ FIELD_RESULT = "result"
 FIELD_PRIORITY = "priority"  # int as str; higher = admitted first
 FIELD_COST = "cost"  # float as str; estimated run-cost (scheduler pairing)
 FIELD_TIMEOUT = "timeout"  # float as str; execution budget enforced in-child
+#: Optional queue deadline (ABSOLUTE epoch seconds as str), computed by the
+#: gateway from the client's relative ``deadline`` submit-TTL hint. A task
+#: still QUEUED past this instant is shed to the terminal EXPIRED status by
+#: the dispatcher that holds it, instead of being dispatched. Absolute on
+#: the wire (not the relative TTL) so the decision survives dispatcher
+#: restarts and re-announces without re-deriving the submit time.
+FIELD_DEADLINE = "deadline"
 #: Written by finish_task alongside every terminal write (epoch seconds as
 #: str) — lets the gateway's optional result-TTL sweeper age out consumed
 #: records without a per-task client DELETE.

@@ -28,9 +28,11 @@ import uuid
 from collections import Counter, deque
 from dataclasses import dataclass
 
+from tpu_faas.admission.signal import CapacitySnapshot, publish_snapshot
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import (
     FIELD_COST,
+    FIELD_DEADLINE,
     FIELD_FN,
     FIELD_LEASE_AT,
     FIELD_PARAMS,
@@ -108,6 +110,13 @@ class PendingTask:
     #: intake and fed to the task timeline; None for reference-style
     #: producers that never stamp it
     submitted_at: float | None = None
+    #: queue deadline (FIELD_DEADLINE, ABSOLUTE epoch seconds): a task
+    #: still undispatched past this instant is shed to EXPIRED instead of
+    #: sent (TaskDispatcher.shed_if_expired). None = no deadline. Not
+    #: fetched on the reclaim path (RECLAIM_FIELDS): a reclaimed task
+    #: already ran once — its record is RUNNING and shedding is
+    #: QUEUED-only by protocol.
+    deadline_at: float | None = None
 
     def task_message_kwargs(self) -> dict:
         """The TASK wire message's payload fields (timeout rides along so
@@ -157,6 +166,7 @@ class PendingTask:
         cost = _parse_positive_finite(fields.get(FIELD_COST))
         timeout = _parse_positive_finite(fields.get(FIELD_TIMEOUT))
         submitted_at = _parse_positive_finite(fields.get(FIELD_SUBMITTED_AT))
+        deadline_at = _parse_positive_finite(fields.get(FIELD_DEADLINE))
         return cls(
             task_id,
             fields.get(FIELD_FN, ""),
@@ -166,6 +176,7 @@ class PendingTask:
             cost=cost,
             timeout=timeout,
             submitted_at=submitted_at,
+            deadline_at=deadline_at,
         )
 
 
@@ -266,6 +277,11 @@ class TaskDispatcher:
         self.m_cancelled_dropped = self.metrics.counter(
             "tpu_faas_dispatcher_cancelled_dropped_total",
             "Cancelled tasks dropped before dispatch (store-verified)",
+        )
+        self.m_expired = self.metrics.counter(
+            "tpu_faas_dispatcher_tasks_expired_total",
+            "Tasks shed to EXPIRED because their queue deadline lapsed "
+            "while QUEUED (never dispatched)",
         )
         self.m_reclaimed = self.metrics.counter(
             "tpu_faas_dispatcher_tasks_reclaimed_total",
@@ -372,6 +388,13 @@ class TaskDispatcher:
         self.kill_requested: dict[str, float] = {}
         self._last_kill_relay = 0.0
         self.n_cancelled_dropped = 0
+        self.n_expired = 0
+        #: saturation-signal publishing state (maybe_publish_capacity):
+        #: last publish time, result count at that publish, and the
+        #: drain-rate EWMA the snapshot carries
+        self._cap_published_at: float | None = None
+        self._cap_results_at_publish = 0
+        self._drain_rate = 0.0
         #: per-sender cumulative misfire-repair counters, as reported on
         #: RESULT messages (worker/pool.py n_misfires): a misfired cancel
         #: interrupt re-executes a bystander task whose side effects may
@@ -545,6 +568,106 @@ class TaskDispatcher:
             extra=log_ctx(task_id=task_id),
         )
         return True
+
+    # -- deadline shedding -------------------------------------------------
+    def shed_if_expired(self, task: PendingTask) -> bool:
+        """True when ``task`` must be dropped instead of dispatched because
+        its queue deadline lapsed: the record is shed QUEUED -> EXPIRED
+        (store expire_task — conditional, repair-capable), the trace
+        closes, and the shed is counted. Also True when the expire probe
+        finds the record already terminal or gone (not ours to dispatch
+        either way). Reclaimed tasks (retries > 0) are never shed — their
+        record is RUNNING, and EXPIRED is QUEUED-only by protocol.
+
+        Raises on a store outage with no state consumed, so callers apply
+        their existing parking policy and retry next round. The deadline
+        compare is wall-clock BY DESIGN: FIELD_DEADLINE is a cross-process
+        epoch stamp written by the gateway, same family as lease/claim
+        ages."""
+        if task.deadline_at is None or task.retries:
+            return False
+        if time.time() < task.deadline_at:
+            return False
+        status = self.store.expire_task(task.task_id, self.channel)
+        if status == str(TaskStatus.EXPIRED):
+            self.n_expired += 1
+            self.m_expired.inc()
+            self.traces.finish(task.task_id, outcome="expired")
+            self.log.info(
+                "shed task %s: queue deadline lapsed %.3fs ago",
+                task.task_id,
+                time.time() - task.deadline_at,  # faas: allow(obs.wall-clock-latency)
+                extra=log_ctx(task_id=task.task_id),
+            )
+            return True
+        # terminal some other way (cancelled / a zombie's result), or the
+        # record vanished, or — pathologically — RUNNING (a duplicate copy
+        # was dispatched elsewhere): in every case, dispatching THIS copy
+        # would be wrong
+        self.traces.finish(task.task_id, outcome="expired_drop")
+        return True
+
+    def poll_next_admitted(self) -> PendingTask | None:
+        """poll_next_claimed + deadline shedding, outage-safe: a task whose
+        expire write hits an outage parks in ``_unclaimed`` (its announce
+        is spent; the re-poll re-claims our own claim as a no-op and
+        re-tries the shed) — never dropped, never dispatched expired."""
+        while True:
+            t = self.poll_next_claimed()
+            if t is None:
+                return None
+            try:
+                shed = self.shed_if_expired(t)
+            except STORE_OUTAGE_ERRORS:
+                self._unclaimed.append(t)
+                raise
+            if not shed:
+                return t
+
+    # -- saturation signal -------------------------------------------------
+    #: how often the dispatcher publishes its capacity snapshot to the
+    #: fleet-health hash (admission/signal.py) — one tiny hash write
+    CAPACITY_PUBLISH_PERIOD = 1.0
+    #: drain-rate EWMA smoothing (per publish period)
+    _DRAIN_ALPHA = 0.5
+
+    def maybe_publish_capacity(
+        self, pending: int, inflight: int, capacity: int, results: int
+    ) -> None:
+        """Publish this dispatcher's capacity snapshot (pending depth,
+        inflight, fleet process slots, drain-rate EWMA) to the store's
+        fleet-health hash, at most once per CAPACITY_PUBLISH_PERIOD.
+        Serve loops call it every iteration; it is a cheap clock compare
+        between periods. Raises on a store outage (callers' existing
+        outage handling backs off and retries)."""
+        now = time.monotonic()
+        if (
+            self._cap_published_at is not None
+            and now - self._cap_published_at < self.CAPACITY_PUBLISH_PERIOD
+        ):
+            return
+        if self._cap_published_at is not None:
+            dt = now - self._cap_published_at
+            inst = max(0, results - self._cap_results_at_publish) / dt
+            self._drain_rate = (
+                self._DRAIN_ALPHA * inst
+                + (1.0 - self._DRAIN_ALPHA) * self._drain_rate
+            )
+        publish_snapshot(
+            self.store,
+            self.dispatcher_id,
+            CapacitySnapshot(
+                pending=int(pending),
+                inflight=int(inflight),
+                capacity=int(capacity),
+                drain_rate=self._drain_rate,
+                published_at=time.time(),
+            ),
+        )
+        # state advances only on a successful publish: after an outage the
+        # next attempt re-measures over the whole gap (rate stays honest)
+        self._cap_published_at = now
+        self._cap_results_at_publish = results
 
     # -- intake ------------------------------------------------------------
     def poll_next_task(self) -> PendingTask | None:
@@ -1042,6 +1165,8 @@ class TaskDispatcher:
             "deferred_results": len(self.deferred_results),
             "announce_backlog": len(self._announce_backlog),
             "cancelled_dropped": self.n_cancelled_dropped,
+            "expired": self.n_expired,
+            "drain_rate": round(self._drain_rate, 3),
             "worker_misfires": sum(self.worker_misfires.values()),
         }
 

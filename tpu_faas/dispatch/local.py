@@ -71,9 +71,10 @@ class LocalDispatcher(TaskDispatcher):
                 # admission-controlled intake (reference task_dispatcher.py:73-75)
                 while pool.free > 0:
                     try:
-                        # shared mode: only run tasks we claimed (outage-
-                        # safe: an unclaimed poll parks and retries)
-                        task = self.poll_next_claimed()
+                        # shared mode: only run tasks we claimed, and shed
+                        # tasks whose queue deadline lapsed (outage-safe:
+                        # an unclaimed/unshed poll parks and retries)
+                        task = self.poll_next_admitted()
                     except STORE_OUTAGE_ERRORS as exc:
                         self.note_store_outage(exc)
                         break
@@ -159,6 +160,16 @@ class LocalDispatcher(TaskDispatcher):
                     except STORE_OUTAGE_ERRORS as exc:
                         self.note_store_outage(exc, pause=0)
                     last_renew = time.monotonic()
+                try:
+                    # saturation signal for gateway admission control
+                    self.maybe_publish_capacity(
+                        pending=len(self._announce_backlog),
+                        inflight=len(self._running),
+                        capacity=self.num_workers,
+                        results=completed,
+                    )
+                except STORE_OUTAGE_ERRORS as exc:
+                    self.note_store_outage(exc, pause=0)
                 if max_tasks is not None and completed >= max_tasks:
                     break
                 if not progressed:

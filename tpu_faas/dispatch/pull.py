@@ -167,8 +167,9 @@ class PullDispatcher(TaskDispatcher):
             self.requeued.popleft()
             return pt
         # bus tasks must be CLAIMED in shared mode (requeued ones above
-        # are already ours); outage-safe via the base parking helper
-        return self.poll_next_claimed()
+        # are already ours) and deadline-shed if they lapsed while queued;
+        # outage-safe via the base parking helpers
+        return self.poll_next_admitted()
 
     def _kills_for(self, wid) -> list[str]:
         """Force-cancel ids among THIS worker's in-flight tasks, consumed
@@ -225,6 +226,14 @@ class PullDispatcher(TaskDispatcher):
                         # gets its claims adopted out from under it
                         self.renew_leases(self.inflight)
                         last_renew = self.clock()
+                    # saturation signal for gateway admission control
+                    self.maybe_publish_capacity(
+                        pending=len(self.requeued)
+                        + len(self._announce_backlog),
+                        inflight=len(self.inflight),
+                        capacity=max(len(self.workers), 1),
+                        results=n_results,
+                    )
                 except STORE_OUTAGE_ERRORS as exc:
                     self.note_store_outage(exc, pause=0)
                 events = dict(self.poller.poll(self.poll_timeout_ms))

@@ -335,8 +335,10 @@ class PushDispatcher(TaskDispatcher):
             self.requeue.popleft()
             return task
         # bus tasks must be CLAIMED in shared mode (requeued ones above
-        # are already ours); outage-safe via the base parking helper
-        return self.poll_next_claimed()
+        # are already ours) and deadline-shed if they lapsed while queued;
+        # outage-safe via the base parking helpers. (Requeued tasks carry
+        # retries > 0 and are exempt from shedding by protocol.)
+        return self.poll_next_admitted()
 
     def _relay_kills(self) -> None:
         def owner(tid: str):
@@ -438,6 +440,20 @@ class PushDispatcher(TaskDispatcher):
                     # control messages must still flow
                     self.drain_control_messages()
                     self._relay_kills()
+                    # saturation signal for gateway admission control
+                    self.maybe_publish_capacity(
+                        pending=len(self.requeue)
+                        + len(self._announce_backlog),
+                        inflight=sum(
+                            len(rec.inflight)
+                            for rec in self.workers.values()
+                        ),
+                        capacity=sum(
+                            rec.num_processes
+                            for rec in self.workers.values()
+                        ),
+                        results=self.n_results,
+                    )
                 except STORE_OUTAGE_ERRORS as exc:
                     self.note_store_outage(exc)
                 if max_results is not None and self.n_results >= max_results:

@@ -525,10 +525,17 @@ class TpuPushDispatcher(TaskDispatcher):
     def _note_token(self, wid: bytes, data: dict) -> None:
         """Record the stable worker token a REGISTER/RECONNECT carries
         (absent from reference-era workers: their grades stay keyed to the
-        socket identity, ephemeral by nature)."""
+        socket identity, ephemeral by nature). A token flagged
+        ``ephemeral`` (self-minted uuid — the worker was launched without
+        ``--token``) keeps its in-memory grade across reconnects but is
+        never persisted and is forgotten on purge: each ad-hoc process
+        restart would otherwise leak one never-pruned WORKER_STATS_KEY
+        entry forever (ADVICE r5)."""
         token = data.get("token")
         if isinstance(token, str) and token:
             self._wid_token[wid] = token
+            if data.get("ephemeral") and self.estimator is not None:
+                self.estimator.note_ephemeral(token)
 
     def _apply_learned_speed(self, wid: bytes, row: int) -> None:
         """Registration/reconnect re-applies the learned speed the plain
@@ -1122,13 +1129,18 @@ class TpuPushDispatcher(TaskDispatcher):
         )
 
     def _drop_cancelled_or_park(self, t) -> bool | None:
-        """drop_if_cancelled with the pending-loop outage policy in ONE
-        place: True = dropped (state forgotten), False = keep the task,
-        None = the verification read hit a store outage — the task is
-        parked back at the head of pending (with the cancel note intact)
-        and the caller must stop filtering this tick."""
+        """drop_if_cancelled + deadline shedding with the pending-loop
+        outage policy in ONE place: True = dropped (state forgotten),
+        False = keep the task, None = a store probe hit an outage — the
+        task is parked back at the head of pending (with the cancel note
+        and deadline intact) and the caller must stop filtering this
+        tick."""
         try:
             dropped = self.drop_if_cancelled(t.task_id)
+            if not dropped:
+                # shed_if_expired closes the trace + counts the shed; the
+                # _forget_task_state below cleans the per-task maps
+                dropped = self.shed_if_expired(t)
         except STORE_OUTAGE_ERRORS as exc:
             self.note_store_outage(exc, pause=0)
             self.pending.appendleft(t)
@@ -1208,6 +1220,14 @@ class TpuPushDispatcher(TaskDispatcher):
                     # whole fleet from the 1.0 prior was round-4's
                     # durability gap (VERDICT r4 missing #4).
                     self.estimator.forget_worker(wid_p)
+                elif self.estimator.is_ephemeral(token):
+                    # self-minted uuid token (worker started without
+                    # --token): the process is gone and the token will
+                    # never be presented again — forgetting on purge is
+                    # what keeps ad-hoc restarts from leaking one
+                    # never-pruned grade per process (estimator never
+                    # persisted it either)
+                    self.estimator.forget_worker(token)
             self.n_purged += 1
             self.m_purged.inc()
 
@@ -1366,6 +1386,20 @@ class TpuPushDispatcher(TaskDispatcher):
                         # write-behind of learned runtimes (no-op between
                         # persist periods; internally outage-tolerant)
                         self.estimator.maybe_persist()
+                    # saturation signal for gateway admission control
+                    # (admission/signal.py): one tiny hash write per second
+                    a0 = self.arrays
+                    self.maybe_publish_capacity(
+                        pending=len(self.pending)
+                        + len(self._resident_tasks),
+                        inflight=a0.n_inflight,
+                        capacity=int(
+                            np.where(
+                                a0.worker_active, a0.worker_procs, 0
+                            ).sum()
+                        ),
+                        results=self.n_results,
+                    )
                 except STORE_OUTAGE_ERRORS as exc:
                     self.note_store_outage(exc)
                 events = dict(self.poller.poll(max(1, int(self.tick_period * 1000))))

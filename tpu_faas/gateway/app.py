@@ -8,13 +8,28 @@ test_client.py:98-126, test_suit.py:39-91):
 - ``POST /execute_function``   {"function_id": str, "payload": ser_params}
     -> {"task_id": str}      (404 if function_id unknown)
     optional scheduling hints: "priority" (int, higher admitted first under
-    overload), "cost" (float > 0, estimated run-cost), "timeout" (float > 0,
-    execution budget); /execute_batch takes parallel "priorities"/"costs"/
-    "timeouts" lists (None entries = no hint). Optional "idempotency_key"
-    (non-empty str): the same (function, key) always maps to the same task —
-    a duplicate submit returns {"task_id", "deduplicated": true} and writes
-    nothing, so submits become safely retryable. The dedup window is the
-    record's lifetime (a swept/DELETEd record re-runs).
+    overload — ENFORCED by the admission controller below), "cost"
+    (float > 0, estimated run-cost), "timeout" (float > 0, execution
+    budget), "deadline" (float > 0, submit-TTL in seconds: a task still
+    QUEUED this long after submit is shed to the terminal EXPIRED status
+    instead of dispatched); /execute_batch takes parallel "priorities"/
+    "costs"/"timeouts"/"deadlines" lists (None entries = no hint).
+    Optional "idempotency_key" (non-empty str): the same (function, key)
+    always maps to the same task — a duplicate submit returns {"task_id",
+    "deduplicated": true} and writes nothing, so submits become safely
+    retryable. The dedup window is the record's lifetime (a swept/DELETEd
+    record re-runs).
+
+Overload behavior (tpu_faas/admission): submits pass an admission
+controller BEFORE any store work — per-client token-bucket quotas (keyed
+on the ``X-Client-Id`` header, off unless configured), a bound on tasks in
+the system (from the dispatcher-published saturation signal plus this
+gateway's own accounting), and a priority-aware brownout band that sheds
+the lowest-priority submits first. Rejects are 429 with a ``Retry-After``
+header computed from the fleet's measured drain rate. A store circuit
+breaker fast-fails EVERY store-touching endpoint with 503 +
+``Retry-After`` while the store is down, instead of hanging each request
+on a connect timeout.
 - ``GET /status/{task_id}``    -> {"task_id", "status"}
 - ``GET /result/{task_id}``    -> {"task_id", "status", "result"}
 
@@ -51,8 +66,17 @@ from dataclasses import dataclass, field
 
 from aiohttp import web
 
+from tpu_faas.admission import (
+    AdmissionController,
+    CircuitBreaker,
+    StoreUnavailable,
+    read_fleet_health,
+)
+from tpu_faas.admission.breaker import OUTAGE_ERRORS
+from tpu_faas.admission.controller import AdmissionConfig
 from tpu_faas.core.task import (
     FIELD_COST,
+    FIELD_DEADLINE,
     FIELD_FINISHED_AT,
     FIELD_PARAMS,
     FIELD_PRIORITY,
@@ -65,7 +89,12 @@ from tpu_faas.core.task import (
 )
 from tpu_faas.obs import REGISTRY, MetricsRegistry
 from tpu_faas.obs import metrics as obs_metrics
-from tpu_faas.store.base import RESULTS_CHANNEL, TASKS_CHANNEL, TaskStore
+from tpu_faas.store.base import (
+    LIVE_INDEX_KEY,
+    RESULTS_CHANNEL,
+    TASKS_CHANNEL,
+    TaskStore,
+)
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import TickTracer, get_logger
 
@@ -234,6 +263,14 @@ class GatewayContext:
     #: process must not share series; /metrics renders this + the
     #: process-global registry
     metrics: MetricsRegistry = field(default_factory=MetricsRegistry)
+    #: admission controller (tpu_faas/admission): every submit passes it
+    #: before any store work. None disables admission entirely (tests of
+    #: the raw surface); the default fails open until a dispatcher
+    #: publishes the saturation signal or a bound is configured
+    admission: "AdmissionController | None" = None
+    #: store circuit breaker: store_call routes every handler-side store
+    #: op through it; None disables fast-fail (calls hit the store raw)
+    breaker: "CircuitBreaker | None" = None
 
     def __post_init__(self) -> None:
         self.m_requests = self.metrics.counter(
@@ -267,11 +304,117 @@ class GatewayContext:
         self.m_uptime = self.metrics.gauge(
             "tpu_faas_gateway_uptime_seconds", "Seconds since app start"
         )
-        self.metrics.register_collector(
-            lambda: self.m_uptime.set(time.time() - self.started_at)
+        self.m_admitted = self.metrics.counter(
+            "tpu_faas_gateway_admitted_total",
+            "Submits admitted by the admission controller (tasks, not "
+            "HTTP calls: a batch of N counts N)",
         )
+        self.m_rejected = self.metrics.counter(
+            "tpu_faas_gateway_rejected_total",
+            "Rejects by reason, in TASKS for the admission reasons "
+            "(quota | quota_exceeds_burst | brownout | saturated: a "
+            "batch of N counts N, same unit as admitted_total) and in "
+            "CALLS for store_unavailable (503 on any store-touching "
+            "endpoint, where no task count exists)",
+            ("reason",),
+        )
+        for reason in (
+            "quota",
+            "quota_exceeds_burst",
+            "brownout",
+            "saturated",
+            "store_unavailable",
+        ):
+            self.m_rejected.labels(reason=reason)
+        self.m_saturation = self.metrics.gauge(
+            "tpu_faas_gateway_saturation",
+            "In-system task estimate over the admission bound at the last "
+            "admission decision (>= 1.0 means full stop)",
+        )
+        self.m_breaker_open = self.metrics.gauge(
+            "tpu_faas_gateway_store_breaker_open",
+            "1 while the store circuit breaker is open or half-open "
+            "(store calls fast-fail 503), else 0",
+        )
+        self.metrics.register_collector(self._collect)
         if self.tracer is None:
             self.tracer = TickTracer(mirror=self.m_latency)
+
+    def _collect(self) -> None:
+        self.m_uptime.set(time.time() - self.started_at)
+        if self.admission is not None:
+            self.m_saturation.set(self.admission.last_load)
+        if self.breaker is not None:
+            self.m_breaker_open.set(1.0 if self.breaker.is_open else 0.0)
+
+    def _live_in_system(self) -> int:
+        """The store's live-task index count: every create writes
+        LIVE_INDEX_KEY and every terminal write drops the entry, so its
+        size IS the fleet-wide in-system count — including tasks still
+        buffered in announce subscriptions (invisible to dispatcher
+        snapshots) and foreign producers' tasks. Read whole once per
+        admission TTL; the transfer is O(live tasks), which the admission
+        bound itself keeps proportionate. Blocking: call via store_call."""
+        return len(self.store.hgetall(LIVE_INDEX_KEY))
+
+    async def store_call(self, fn, *args):
+        """Run a blocking store op on the executor, behind the circuit
+        breaker: an open breaker raises StoreUnavailable WITHOUT touching
+        a socket (the <100 ms fast-fail), outage-family failures trip it,
+        successes close it. The middleware maps StoreUnavailable to
+        503 + Retry-After."""
+        breaker = self.breaker
+        if breaker is None:
+            return await _run_blocking(fn, *args)
+        if not breaker.allow():
+            raise StoreUnavailable(breaker.retry_after())
+        try:
+            result = await _run_blocking(fn, *args)
+        except OUTAGE_ERRORS as exc:
+            breaker.record_failure()
+            raise StoreUnavailable(breaker.retry_after()) from exc
+        except BaseException:
+            # no store verdict (cancelled request, non-outage error):
+            # release a held half-open probe slot or the breaker wedges
+            # open forever — one aborted probe must not outlive the call
+            breaker.record_aborted()
+            raise
+        breaker.record_success()
+        return result
+
+    async def admit(self, request: web.Request, n: int, priority: int):
+        """Admission decision for ``n`` tasks at ``priority`` (batches
+        pass their minimum). Refreshes the fleet-health snapshot through
+        the breaker when stale — at most one store read per TTL, and a
+        dead store degrades to the cached snapshot instead of blocking
+        the decision. Returns None when admission is disabled."""
+        adm = self.admission
+        if adm is None:
+            return None
+        if adm.needs_refresh():
+            adm.begin_refresh()
+            try:
+                health = await self.store_call(read_fleet_health, self.store)
+                live = await self.store_call(self._live_in_system)
+            except StoreUnavailable:
+                # decide on the stale snapshot; the submit's own store
+                # write will surface the 503 if the store is truly dark
+                adm.refresh_failed()
+            except BaseException:
+                # BaseException, not Exception: a client disconnect
+                # cancels this handler (asyncio.CancelledError), and a
+                # leaked _refreshing=True would block every future
+                # refresh — the snapshot freezes while admitted-since
+                # ratchets, ending in a gateway that 429s forever
+                adm.refresh_failed()
+                raise
+            else:
+                adm.update_health(health, live_in_system=live)
+        return adm.admit(
+            n=n,
+            priority=priority,
+            client_id=request.headers.get("X-Client-Id"),
+        )
 
 
 CTX_KEY: web.AppKey["GatewayContext"] = web.AppKey("ctx", GatewayContext)
@@ -280,12 +423,61 @@ SWEEPER_KEY: web.AppKey["asyncio.Task"] = web.AppKey(
 )
 
 
+def _admission_reject(
+    ctx: "GatewayContext", decision, what: str, n: int = 1
+) -> web.Response:
+    """Map an admission reject to the wire: retryable reasons are 429 +
+    Retry-After; a batch larger than the quota bucket can EVER hold is a
+    permanent 400 — a finite Retry-After there would send well-behaved
+    clients into a retry loop against an impossible condition. ``n``
+    keeps the reject counter in TASKS, same unit as the admit counter —
+    a rejected 1000-task batch is 1000 rejected tasks, not one."""
+    ctx.m_rejected.labels(reason=decision.reason).inc(n)
+    if decision.reason == "quota_exceeds_burst":
+        return _json_error(
+            400,
+            f"{what} exceeds the per-client quota burst and can never be "
+            "admitted whole; split it or raise --client-quota",
+        )
+    return _retry_after_response(
+        429,
+        f"{what} rejected ({decision.reason}); retry later",
+        decision.reason,
+        decision.retry_after,
+    )
+
+
+def _retry_after_response(
+    status: int, message: str, reason: str, retry_after: float
+) -> web.Response:
+    """A reject carrying machine-readable backpressure: the Retry-After
+    header (whole seconds, per RFC 9110) plus the same numbers in the
+    body for clients that never look at headers."""
+    seconds = max(1, int(math.ceil(retry_after)))
+    return web.json_response(
+        {"error": message, "reason": reason, "retry_after": seconds},
+        status=status,
+        headers={"Retry-After": str(seconds)},
+    )
+
+
 @web.middleware
 async def _metrics_middleware(request: web.Request, handler):
     ctx: GatewayContext = request.app[CTX_KEY]
     t0 = time.perf_counter()
     try:
         return await handler(request)
+    except StoreUnavailable as exc:
+        # the store circuit breaker tripped (or the call just failed):
+        # fast, honest 503 instead of a hung request — the one reject
+        # that applies to EVERY store-touching endpoint
+        ctx.m_rejected.labels(reason="store_unavailable").inc()
+        return _retry_after_response(
+            503,
+            "task store unavailable; retry later",
+            "store_unavailable",
+            exc.retry_after,
+        )
     finally:
         resource = request.match_info.route.resource
         # unmatched paths collapse into one bucket: keying by raw path would
@@ -371,8 +563,25 @@ def make_app(
     store: TaskStore,
     channel: str = TASKS_CHANNEL,
     result_ttl: float | None = None,
+    *,
+    admission: "AdmissionController | None | bool" = True,
+    breaker: "CircuitBreaker | None | bool" = True,
 ) -> web.Application:
-    ctx = GatewayContext(store=store, channel=channel)
+    """``admission``/``breaker``: True builds the defaults (admission
+    fails open until a dispatcher publishes the saturation signal or a
+    bound is configured; the breaker trips after 3 consecutive outage
+    failures), False/None disables, or pass a configured instance."""
+    if admission is True:
+        admission = AdmissionController()
+    elif admission is False:
+        admission = None
+    if breaker is True:
+        breaker = CircuitBreaker()
+    elif breaker is False:
+        breaker = None
+    ctx = GatewayContext(
+        store=store, channel=channel, admission=admission, breaker=breaker
+    )
     app = web.Application(
         client_max_size=256 * 1024 * 1024, middlewares=[_metrics_middleware]
     )
@@ -453,7 +662,7 @@ async def register_function(request: web.Request) -> web.Response:
     except Exception:
         return _json_error(400, "expected JSON body with 'name' and 'payload'")
     function_id = new_function_id()
-    await _run_blocking(
+    await ctx.store_call(
         ctx.store.hset,
         _FUNCTION_PREFIX + function_id,
         {"name": name, "payload": payload},
@@ -469,12 +678,18 @@ async def register_function(request: web.Request) -> web.Response:
 _PRIORITY_BOUND = 2**30
 
 
-def _parse_hints(priority, cost, timeout=None) -> dict[str, str]:
+def _parse_hints(
+    priority, cost, timeout=None, deadline=None, now: float | None = None
+) -> dict[str, str]:
     """Validate the optional scheduling hints into store hash fields.
 
     Raises ValueError with a client-facing message. Bounds: priority is an
     int (bool rejected — it JSON-decodes from true/false and is almost
-    certainly a client bug); cost and timeout finite positive floats.
+    certainly a client bug); cost, timeout and deadline finite positive
+    floats. ``deadline`` is RELATIVE on the wire (a submit-TTL in
+    seconds); the stored field is the ABSOLUTE epoch instant past which a
+    still-QUEUED task is shed to EXPIRED, so the decision survives
+    dispatcher restarts without re-deriving the submit time.
     """
     extra: dict[str, str] = {}
     if priority is not None:
@@ -488,6 +703,7 @@ def _parse_hints(priority, cost, timeout=None) -> dict[str, str]:
     for name, field_name, value in (
         ("cost", FIELD_COST, cost),
         ("timeout", FIELD_TIMEOUT, timeout),
+        ("deadline", FIELD_DEADLINE, deadline),
     ):
         if value is None:
             continue
@@ -498,8 +714,17 @@ def _parse_hints(priority, cost, timeout=None) -> dict[str, str]:
             or value <= 0
         ):
             raise ValueError(f"'{name}' must be a finite positive number")
-        extra[field_name] = repr(float(value))
+        if field_name == FIELD_DEADLINE:
+            base = now if now is not None else time.time()
+            extra[field_name] = repr(base + float(value))
+        else:
+            extra[field_name] = repr(float(value))
     return extra
+
+
+def _priority_of(value) -> int:
+    """The admission-facing priority of a validated hint (0 = default)."""
+    return value if isinstance(value, int) and not isinstance(value, bool) else 0
 
 
 def _idempotent_task_id(function_id: str, key: str) -> str:
@@ -516,21 +741,36 @@ async def execute_function(request: web.Request) -> web.Response:
         function_id, param_payload = body["function_id"], body["payload"]
     except Exception:
         return _json_error(400, "expected JSON body with 'function_id' and 'payload'")
+    now = time.time()
     try:
         extra = _parse_hints(
-            body.get("priority"), body.get("cost"), body.get("timeout")
+            body.get("priority"),
+            body.get("cost"),
+            body.get("timeout"),
+            body.get("deadline"),
+            now=now,
         )
     except ValueError as exc:
         return _json_error(400, str(exc))
     # first event of the task's lifecycle timeline (obs/trace.py): rides
     # the record so the dispatcher can measure queue wait from the submit
-    extra[FIELD_SUBMITTED_AT] = repr(time.time())
+    extra[FIELD_SUBMITTED_AT] = repr(now)
     idem_key = body.get("idempotency_key")
     if idem_key is not None and (
         not isinstance(idem_key, str) or not idem_key
     ):
         return _json_error(400, "'idempotency_key' must be a non-empty string")
-    fn_payload = await _run_blocking(
+    # admission BEFORE any store work: the reject path must cost
+    # microseconds exactly when the system is drowning. (A duplicate
+    # keyed re-send pays admission again — under overload even a dedup
+    # probe is store load the 429 tells the client to defer.)
+    decision = await ctx.admit(
+        request, n=1, priority=_priority_of(body.get("priority"))
+    )
+    if decision is not None and not decision.admitted:
+        return _admission_reject(ctx, decision, "submit")
+    ctx.m_admitted.inc()
+    fn_payload = await ctx.store_call(
         ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
     )
     if fn_payload is None:
@@ -557,7 +797,7 @@ async def execute_function(request: web.Request) -> web.Response:
         # carries the payload hash, so key-reuse-with-different-payload is
         # caught right here without waiting for the winner's record write.
         claim = _idem_claim_value(param_payload)
-        created, current = await _run_blocking(
+        created, current = await ctx.store_call(
             ctx.store.setnx_field, task_id, _IDEM_CLAIM_FIELD, claim
         )
         if not created:
@@ -584,7 +824,7 @@ async def execute_function(request: web.Request) -> web.Response:
                 # and this loop may poll a dozen times while the winner's
                 # create is in flight — never drag the payload to ask "is
                 # it there yet"
-                present = await _run_blocking(
+                present = await ctx.store_call(
                     ctx.store.hexists, task_id, FIELD_PARAMS
                 )
                 if present or time.monotonic() >= deadline:
@@ -596,11 +836,11 @@ async def execute_function(request: web.Request) -> web.Response:
                     "adopting abandoned idempotency claim for task %s",
                     task_id,
                 )
-                if await _run_blocking(write_task_nx, task_id):
+                if await ctx.store_call(write_task_nx, task_id):
                     ctx.n_tasks += 1
                     ctx.m_tasks.inc()
             elif (
-                await _run_blocking(ctx.store.hget, task_id, FIELD_STATUS)
+                await ctx.store_call(ctx.store.hget, task_id, FIELD_STATUS)
                 is None
             ):
                 # payload present but status stripped: a cancel aimed at a
@@ -611,17 +851,17 @@ async def execute_function(request: web.Request) -> web.Response:
                 log.warning(
                     "repairing status-stripped record for task %s", task_id
                 )
-                await _run_blocking(write_task_nx, task_id)
+                await ctx.store_call(write_task_nx, task_id)
             return web.json_response(
                 {"task_id": task_id, "deduplicated": True}
             )
-        await _run_blocking(write_task_nx, task_id)
+        await ctx.store_call(write_task_nx, task_id)
         ctx.n_tasks += 1
         ctx.m_tasks.inc()
         return web.json_response({"task_id": task_id})
 
     task_id = new_task_id()
-    await _run_blocking(write_task, task_id)
+    await ctx.store_call(write_task, task_id)
     ctx.n_tasks += 1
     ctx.m_tasks.inc()
     return web.json_response({"task_id": task_id})
@@ -649,10 +889,12 @@ async def execute_batch(request: web.Request) -> web.Response:
     priorities = body.get("priorities")
     costs = body.get("costs")
     timeouts = body.get("timeouts")
+    deadlines = body.get("deadlines")
     for name, lst in (
         ("priorities", priorities),
         ("costs", costs),
         ("timeouts", timeouts),
+        ("deadlines", deadlines),
     ):
         if lst is not None and (
             not isinstance(lst, list) or len(lst) != len(payloads)
@@ -660,18 +902,21 @@ async def execute_batch(request: web.Request) -> web.Response:
             return _json_error(
                 400, f"'{name}' must be a list parallel to 'payloads'"
             )
+    now = time.time()
     try:
         extras = [
             _parse_hints(
                 priorities[i] if priorities else None,
                 costs[i] if costs else None,
                 timeouts[i] if timeouts else None,
+                deadlines[i] if deadlines else None,
+                now=now,
             )
             for i in range(len(payloads))
         ]
     except ValueError as exc:
         return _json_error(400, str(exc))
-    submit_stamp = repr(time.time())  # one submit time for the whole batch
+    submit_stamp = repr(now)  # one submit time for the whole batch
     for e in extras:
         e[FIELD_SUBMITTED_AT] = submit_stamp
     idem_keys = body.get("idempotency_keys")
@@ -699,7 +944,26 @@ async def execute_batch(request: web.Request) -> web.Response:
                     f"duplicate idempotency_key {k!r} within one batch",
                 )
             seen_keys.add(k)
-    fn_payload = await _run_blocking(
+    # admission AFTER every cheap validation (a malformed batch must not
+    # drain its client's quota or inflate the in-system estimate) but
+    # BEFORE any store work (the reject path stays store-free — which is
+    # also why the unknown-function 404 can still cost a charge: probing
+    # function existence first would put a store read on every reject).
+    # The batch decides ATOMICALLY on its LOWEST priority
+    # (shed-lowest-first stays monotonic: a batch is only admitted where
+    # its weakest member would be) and consumes n quota tokens —
+    # splitting would break the all-ids-or-nothing reply.
+    decision = await ctx.admit(
+        request,
+        n=len(payloads),
+        priority=min(
+            (_priority_of(p) for p in (priorities or [0])), default=0
+        ),
+    )
+    if decision is not None and not decision.admitted:
+        return _admission_reject(ctx, decision, "batch", n=len(payloads))
+    ctx.m_admitted.inc(len(payloads))
+    fn_payload = await ctx.store_call(
         ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
     )
     if fn_payload is None:
@@ -724,7 +988,7 @@ async def execute_batch(request: web.Request) -> web.Response:
             i: _idempotent_task_id(function_id, idem_keys[i]) for i in keyed
         }
         claims = {i: _idem_claim_value(payloads[i]) for i in keyed}
-        existing = await _run_blocking(
+        existing = await ctx.store_call(
             ctx.store.hget_many,
             [claim_ids[i] for i in keyed],
             _IDEM_CLAIM_FIELD,
@@ -739,7 +1003,7 @@ async def execute_batch(request: web.Request) -> web.Response:
                     "different payload",
                 )
         # one pipelined round trip claims every keyed id atomically
-        results = await _run_blocking(
+        results = await ctx.store_call(
             ctx.store.setnx_fields,
             [(claim_ids[i], claims[i]) for i in keyed],
             _IDEM_CLAIM_FIELD,
@@ -764,7 +1028,7 @@ async def execute_batch(request: web.Request) -> web.Response:
             deadline = time.monotonic() + _IDEM_ADOPT_WAIT_S
             pause = 0.02
             while True:
-                stored = await _run_blocking(
+                stored = await ctx.store_call(
                     ctx.store.hget_many,
                     [claim_ids[i] for i in losers],
                     FIELD_PARAMS,
@@ -806,8 +1070,9 @@ async def execute_batch(request: web.Request) -> web.Response:
             )
             return
         # keyed items use the regression-proof create (see write_task_nx in
-        # execute_function); unkeyed items in the same batch keep the one-
-        # round-trip pipelined create
+        # execute_function), batched — a bounded number of pipelined
+        # rounds, not several round trips per item; unkeyed items in the
+        # same batch keep the one-round-trip pipelined create
         unkeyed = [i for i in to_create if idem_keys[i] is None]
         if unkeyed:
             ctx.store.create_tasks(
@@ -817,17 +1082,15 @@ async def execute_batch(request: web.Request) -> web.Response:
                 ],
                 ctx.channel,
             )
-        for i in to_create:
-            if idem_keys[i] is not None:
-                ctx.store.create_task_if_absent(
-                    task_ids[i],
-                    fn_payload,
-                    payloads[i],
-                    ctx.channel,
-                    extras[i] or None,
-                )
+        keyed_items = [
+            (task_ids[i], fn_payload, payloads[i], extras[i] or None)
+            for i in to_create
+            if idem_keys[i] is not None
+        ]
+        if keyed_items:
+            ctx.store.create_tasks_if_absent(keyed_items, ctx.channel)
 
-    await _run_blocking(write_tasks)
+    await ctx.store_call(write_tasks)
     ctx.n_tasks += len(to_create)
     ctx.m_tasks.inc(len(to_create))
     resp = {"task_ids": task_ids}
@@ -839,7 +1102,7 @@ async def execute_batch(request: web.Request) -> web.Response:
 async def get_status(request: web.Request) -> web.Response:
     ctx: GatewayContext = request.app[CTX_KEY]
     task_id = request.match_info["task_id"]
-    status = await _run_blocking(ctx.store.get_status, task_id)
+    status = await ctx.store_call(ctx.store.get_status, task_id)
     if status is None:
         return _json_error(404, f"unknown task_id {task_id!r}")
     return web.json_response({"task_id": task_id, "status": status})
@@ -885,7 +1148,7 @@ async def get_result(request: web.Request) -> web.Response:
             # wake-up can be consumed spuriously but never lost
             if event is not None:
                 event.clear()
-            status, result = await _run_blocking(ctx.store.get_result, task_id)
+            status, result = await ctx.store_call(ctx.store.get_result, task_id)
             if status is None:
                 return _json_error(404, f"unknown task_id {task_id!r}")
             try:
@@ -940,7 +1203,7 @@ async def cancel_task(request: web.Request) -> web.Response:
         if not isinstance(raw_force, bool):
             return _json_error(400, "'force' must be a JSON boolean")
         force = raw_force
-    status = await _run_blocking(ctx.store.cancel_task, task_id, ctx.channel)
+    status = await ctx.store_call(ctx.store.cancel_task, task_id, ctx.channel)
     if status is None:
         # no status field: either a genuinely unknown id, or a record
         # MID-CREATE (idempotency path: claim field written, payloads and
@@ -948,7 +1211,7 @@ async def cancel_task(request: web.Request) -> web.Response:
         # submitter, so a 404 would be a lie — answer 409 "not yet
         # cancellable" (the SDK maps 409 to False, not an HTTPError) and
         # let the client retry once the create lands.
-        claim = await _run_blocking(
+        claim = await ctx.store_call(
             ctx.store.hget, task_id, _IDEM_CLAIM_FIELD
         )
         if claim is not None:
@@ -969,7 +1232,7 @@ async def cancel_task(request: web.Request) -> web.Response:
         # would run its full natural length despite an explicit force
         # request. For a genuinely-queued cancel the note simply finds no
         # in-flight owner and ages out.
-        await _run_blocking(ctx.store.request_kill, task_id, ctx.channel)
+        await ctx.store_call(ctx.store.request_kill, task_id, ctx.channel)
         kill_requested = True
     if status == str(TaskStatus.RUNNING):
         if not force:
@@ -1003,12 +1266,12 @@ async def delete_task(request: web.Request) -> web.Response:
     RUNNING task is refused — the dispatcher still owns it."""
     ctx: GatewayContext = request.app[CTX_KEY]
     task_id = request.match_info["task_id"]
-    status = await _run_blocking(ctx.store.get_status, task_id)
+    status = await ctx.store_call(ctx.store.get_status, task_id)
     if status is None:
         return _json_error(404, f"unknown task_id {task_id!r}")
     if not TaskStatus(status).is_terminal():
         return _json_error(409, f"task {task_id!r} is {status}, not terminal")
-    await _run_blocking(ctx.store.delete, task_id)
+    await ctx.store_call(ctx.store.delete, task_id)
     return web.json_response({"task_id": task_id, "deleted": True})
 
 
@@ -1049,6 +1312,13 @@ async def stats(request: web.Request) -> web.Response:
             "uptime_s": round(time.time() - ctx.started_at, 1),
             "functions_registered": ctx.n_functions,
             "tasks_submitted": ctx.n_tasks,
+            # overload surfaces: admission controller + store breaker
+            "admission": (
+                None if ctx.admission is None else ctx.admission.snapshot()
+            ),
+            "store_breaker": (
+                None if ctx.breaker is None else ctx.breaker.snapshot()
+            ),
             # cancel CALLS that reported cancelled=true — an idempotent
             # repeat on an already-CANCELLED task counts again (the store
             # protocol cannot distinguish transitioned-now from
@@ -1098,6 +1368,8 @@ def start_gateway_thread(
     port: int = 0,
     channel: str = TASKS_CHANNEL,
     result_ttl: float | None = None,
+    admission: "AdmissionController | None | bool" = True,
+    breaker: "CircuitBreaker | None | bool" = True,
 ) -> GatewayHandle:
     """Serve the gateway in a daemon thread; returns once the port is bound."""
     started = threading.Event()
@@ -1110,7 +1382,15 @@ def start_gateway_thread(
         holder["loop"], holder["stop"] = loop, stop
 
         async def main() -> None:
-            runner = web.AppRunner(make_app(store, channel, result_ttl))
+            runner = web.AppRunner(
+                make_app(
+                    store,
+                    channel,
+                    result_ttl,
+                    admission=admission,
+                    breaker=breaker,
+                )
+            )
             await runner.setup()
             site = web.TCPSite(runner, host, port)
             await site.start()
@@ -1148,11 +1428,50 @@ def main(argv: list[str] | None = None) -> None:
         help="seconds to keep terminal task records before the sweeper "
         "deletes them (default: keep forever, the reference behavior)",
     )
+    ap.add_argument(
+        "--max-system-inflight", type=int, default=None,
+        help="hard bound on tasks in the system before submits 429 "
+        "(default: derived from the fleet's published capacity; with no "
+        "publishing dispatcher either, the bound is off)",
+    )
+    ap.add_argument(
+        "--client-quota", default=None, metavar="RATE[:BURST]",
+        help="per-client token-bucket quota keyed on the X-Client-Id "
+        "header, in tasks/second (burst defaults to 2x rate); off unless "
+        "set",
+    )
+    ap.add_argument(
+        "--no-admission", action="store_true",
+        help="disable the admission controller AND the store circuit "
+        "breaker (the pre-overload-hardening behavior)",
+    )
     ns = ap.parse_args(argv)
     store = make_store(ns.store)
+    if ns.no_admission:
+        admission: AdmissionController | bool = False
+        breaker = False
+    else:
+        quota_rate = quota_burst = None
+        if ns.client_quota:
+            rate_s, _, burst_s = ns.client_quota.partition(":")
+            quota_rate = float(rate_s)
+            quota_burst = float(burst_s) if burst_s else None
+        admission = AdmissionController(
+            AdmissionConfig(
+                max_system_inflight=ns.max_system_inflight,
+                quota_rate=quota_rate,
+                quota_burst=quota_burst,
+            )
+        )
+        breaker = True
     log.info("gateway on %s:%d (store %s)", ns.host, ns.port, ns.store)
     web.run_app(
-        make_app(store, result_ttl=ns.result_ttl),
+        make_app(
+            store,
+            result_ttl=ns.result_ttl,
+            admission=admission,
+            breaker=breaker,
+        ),
         host=ns.host,
         port=ns.port,
         print=None,

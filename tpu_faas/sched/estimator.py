@@ -135,6 +135,14 @@ class RuntimeEstimator:
         self._param_est: dict[str, float] = {}
         self._param_count: dict[str, int] = {}
         self._speed_est: dict[str, float] = {}
+        #: tokens flagged EPHEMERAL (worker self-minted a uuid because it
+        #: was launched without --token): graded in memory like any stable
+        #: token — the grade survives reconnects within the process's life
+        #: — but never persisted to WORKER_STATS_KEY, and forgotten when
+        #: the worker is purged. Without this, every ad-hoc worker restart
+        #: leaked one never-pruned store entry that sibling adoption then
+        #: loaded into every dispatcher forever (ADVICE r5, medium).
+        self._ephemeral: set[str] = set()
         self._dirty: set[str] = set()
         self._dirty_speeds: set[str] = set()
         self._last_persist = clock()
@@ -391,19 +399,35 @@ class RuntimeEstimator:
         # identity (bytes) is never seen again after its worker dies, and
         # persisting it would both grow WORKER_STATS_KEY with garbage and
         # let the sibling-adoption read resurrect entries forget_worker
-        # just dropped
-        if isinstance(worker_id, str):
+        # just dropped. Ephemeral tokens (self-minted uuid defaults) are
+        # held to the same rule: durable grades are for operator/deploy
+        # tokens that will be presented again after a process death.
+        if isinstance(worker_id, str) and ident not in self._ephemeral:
             self._dirty_speeds.add(ident)
+
+    def note_ephemeral(self, worker_id) -> None:
+        """Flag an identity as ephemeral (a self-minted uuid token): its
+        grade stays usable in memory but is never persisted, and the purge
+        path forgets it. The set is bounded by the same cap as every other
+        client-controlled keyspace here."""
+        if len(self._ephemeral) < _PARAM_CAP:
+            self._ephemeral.add(_ident(worker_id))
+
+    def is_ephemeral(self, worker_id) -> bool:
+        return _ident(worker_id) in self._ephemeral
 
     def forget_worker(self, worker_id) -> None:
         """Drop an EPHEMERAL identity's grade (tokenless reference-era
-        worker purged: its socket identity is never seen again). Callers
-        must NOT invoke this for token-stable workers — a purged worker
-        that reconnects (or re-registers after a crash-restart on the same
-        machine) keeps its grade, in memory and in the store."""
+        worker purged — its socket identity is never seen again — or a
+        purged worker whose self-minted uuid token was flagged ephemeral).
+        Callers must NOT invoke this for DURABLE token-stable workers — a
+        purged worker that reconnects (or re-registers after a
+        crash-restart on the same machine) keeps its grade, in memory and
+        in the store."""
         ident = _ident(worker_id)
         self._speed_est.pop(ident, None)
         self._dirty_speeds.discard(ident)
+        self._ephemeral.discard(ident)
 
     def stats(self) -> dict:
         return {

@@ -146,6 +146,13 @@ class TaskStore(abc.ABC):
     @abc.abstractmethod
     def publish(self, channel: str, payload: str) -> None: ...
 
+    def publish_many(self, channel: str, payloads: list[str]) -> None:
+        """Batch publish on one channel. Default: a loop; the RESP client
+        pipelines one round trip — the batched keyed-create's announces
+        ride this so a large batch doesn't pay one round trip per task."""
+        for payload in payloads:
+            self.publish(channel, payload)
+
     @abc.abstractmethod
     def subscribe(self, channel: str) -> Subscription: ...
 
@@ -254,6 +261,79 @@ class TaskStore(abc.ABC):
             self.hset(task_id, {FIELD_STATUS: str(TaskStatus.QUEUED)})
             self.publish(channel, task_id)
         return True
+
+    def create_tasks_if_absent(
+        self,
+        tasks: list[tuple],  # (task_id, fn_payload, params[, extra_fields])
+        channel: str = TASKS_CHANNEL,
+    ) -> list[bool]:
+        """Batch ``create_task_if_absent``: the common case (every id
+        fresh — the gateway's auto-keyed bulk submit) pays a BOUNDED
+        number of pipelined rounds on RESP backends — one status-claim
+        round (setnx_fields), one create round (create_tasks; its
+        QUEUED-over-just-claimed-QUEUED rewrite is the protocol's
+        idempotent-retry transition), one claim-loss recheck round —
+        instead of several round trips per item. Items whose status claim
+        LOST (dedup-adoption races, repairs) fall back to the per-item
+        form, which carries the full repair ladder; losers are rare by
+        construction. Returns created flags parallel to ``tasks``."""
+        if not tasks:
+            return []
+        ids = [t[0] for t in tasks]
+        claims = self.setnx_fields(
+            [(tid, str(TaskStatus.QUEUED)) for tid in ids], FIELD_STATUS
+        )
+        created = [False] * len(tasks)
+        winners = [i for i, (won, _cur) in enumerate(claims) if won]
+        if winners:
+            # winners' field writes carry NO status — exactly like the
+            # per-item form: the setnx above already claimed QUEUED, and
+            # rewriting it here would reopen the regression this method
+            # exists to prevent (a winner stalled past the adoption wait
+            # has its record adopted by a duplicate submit and possibly
+            # dispatched; a late status=QUEUED write would then reset
+            # RUNNING and run the task twice)
+            items: list[tuple[str, dict[str, str]]] = []
+            for i in winners:
+                tid, fn_payload, param_payload = tasks[i][:3]
+                extra = tasks[i][3] if len(tasks[i]) > 3 else None
+                # index first (same ordering rationale as create_task)
+                items.append((LIVE_INDEX_KEY, {tid: "1"}))
+                items.append(
+                    (
+                        tid,
+                        {
+                            **(extra or {}),
+                            FIELD_FN: fn_payload,
+                            FIELD_PARAMS: param_payload,
+                            FIELD_RESULT: "None",
+                        },
+                    )
+                )
+            self.hset_many(items)
+            winner_ids = [ids[i] for i in winners]
+            self.publish_many(channel, winner_ids)
+            # claim-loss repair, batched (see create_task_if_absent): a
+            # concurrent cancel's ghost cleanup can strip the status out
+            # from under the create — re-claim and re-announce stragglers
+            recheck = self.hget_many(winner_ids, FIELD_STATUS)
+            for tid, status in zip(winner_ids, recheck):
+                if status is None:
+                    self.hset(tid, {FIELD_STATUS: str(TaskStatus.QUEUED)})
+                    self.publish(channel, tid)
+            for i in winners:
+                created[i] = True
+        for i, (won, _cur) in enumerate(claims):
+            if not won:
+                task = tasks[i]
+                created[i] = self.create_task_if_absent(
+                    task[0],
+                    task[1],
+                    task[2],
+                    channel,
+                    task[3] if len(task) > 3 else None,
+                )
+        return created
 
     def hexists(self, key: str, field: str) -> bool:
         """Field presence WITHOUT transferring the value (standard Redis
@@ -546,6 +626,61 @@ class TaskStore(abc.ABC):
         self.publish(RESULTS_CHANNEL, task_id)
         return str(TaskStatus.CANCELLED)
 
+    def expire_task(
+        self, task_id: str, channel: str = TASKS_CHANNEL
+    ) -> str | None:
+        """Queue-deadline shed: QUEUED -> EXPIRED (terminal).
+
+        Returns the record's status AFTER the attempt — "EXPIRED" when this
+        call (or an earlier one) shed it, the unchanged status when the
+        task already left QUEUED, None when unknown. Called only by the
+        dispatcher that owns the task's pending copy (claim-gated in
+        shared fleets), so unlike cancel_task there is no cross-process
+        writer racing the happy path — the residual interleavings are a
+        concurrent gateway cancel (both write a never-ran terminal; either
+        standing is truthful, and the race monitor reports it as a
+        warning, not an error) and a result landing inside the
+        read->write window from a zombie of a previous reclaim
+        generation, repaired below exactly like cancel_task repairs it:
+        the redundant FIELD_FINAL_STATUS stamp every finish_task writes
+        restores the record, and the true terminal status is returned.
+
+        The terminal write stamps FIELD_FINISHED_AT (the result-TTL
+        sweeper ages EXPIRED records like any other terminal record),
+        drops the live-index entry, and announces on RESULTS_CHANNEL so
+        parked /result long-polls wake immediately. No cancel-style
+        control message rides the tasks channel: the shedder IS the
+        dispatcher holding the pending copy — there is nothing to evict
+        anywhere else."""
+        current = self.get_status(task_id)
+        if current is None:
+            return None
+        if current != str(TaskStatus.QUEUED):
+            return current
+        self.hset(
+            task_id,
+            {
+                FIELD_STATUS: str(TaskStatus.EXPIRED),
+                FIELD_FINISHED_AT: repr(time.time()),
+            },
+        )
+        final, final_at = self.hmget(
+            task_id, [FIELD_FINAL_STATUS, FIELD_FINAL_AT]
+        )
+        if final is not None:
+            # a result landed inside the read->write window and this write
+            # clobbered it: restore the true terminal status + finish stamp
+            # (the result payload was never touched — no FIELD_RESULT here)
+            restore = {FIELD_STATUS: final}
+            if final_at is not None:
+                restore[FIELD_FINISHED_AT] = final_at
+            self.hset(task_id, restore)
+            self.publish(RESULTS_CHANNEL, task_id)
+            return final
+        self.hdel(LIVE_INDEX_KEY, task_id)
+        self.publish(RESULTS_CHANNEL, task_id)
+        return str(TaskStatus.EXPIRED)
+
     def request_kill(
         self, task_id: str, channel: str = TASKS_CHANNEL
     ) -> None:
@@ -559,15 +694,16 @@ class TaskStore(abc.ABC):
         DELETEd must not be resurrected as a partial status+result hash by a
         zombie's late write).
 
-        CANCELLED does NOT freeze: a result can only reach a CANCELLED
-        record when the cancel LOST its race and the task actually executed
-        (a genuinely-cancelled task never dispatches, so nothing can
-        produce a result for it) — e.g. the lost-race task's worker was
-        purged, the reclaimed copy correctly dropped, and the zombie then
-        delivered the genuine result via a first_wins path. Truth wins:
-        freezing would pin 'never ran' over real side effects."""
+        CANCELLED/EXPIRED do NOT freeze: a result can only reach a
+        never-ran terminal record when that write LOST its race and the
+        task actually executed (a genuinely-cancelled or shed task never
+        dispatches, so nothing can produce a result for it) — e.g. the
+        lost-race task's worker was purged, the reclaimed copy correctly
+        dropped, and the zombie then delivered the genuine result via a
+        first_wins path. Truth wins: freezing would pin 'never ran' over
+        real side effects."""
         current = self.get_status(task_id)
-        if current == str(TaskStatus.CANCELLED):
+        if current in (str(TaskStatus.CANCELLED), str(TaskStatus.EXPIRED)):
             return False
         # unknown=True: absent records and foreign status strings are
         # frozen — never overwrite what can't be parsed

@@ -552,6 +552,18 @@ class RespStore(TaskStore):
     def publish(self, channel: str, payload: str) -> None:
         self._command("PUBLISH", channel, payload)
 
+    def publish_many(self, channel: str, payloads: list[str]) -> None:
+        """One pipelined round of PUBLISHes (the batched keyed-create's
+        announce fan-out)."""
+        if not payloads:
+            return
+        replies = self.pipeline(
+            [("PUBLISH", channel, p) for p in payloads]
+        )
+        errors = [r for r in replies if isinstance(r, resp.RespError)]
+        if errors:
+            raise errors[0]
+
     def subscribe(self, channel: str) -> Subscription:
         return _RespSubscription(self.host, self.port, channel)
 

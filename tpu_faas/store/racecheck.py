@@ -33,6 +33,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterable, Mapping
 
+from tpu_faas.admission.signal import FLEET_HEALTH_KEY
 from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS, TaskStatus
 from tpu_faas.store.base import (
     LIVE_INDEX_KEY,
@@ -64,8 +65,18 @@ _LEGAL: frozenset[tuple[str | None, str]] = frozenset(
         # stale CANCELLED later) but worth surfacing — warning, see
         # _check_transition
         ("RUNNING", "CANCELLED"),
+        # queue-deadline shedding (store expire_task, dispatcher-side):
+        # deliberately from QUEUED ONLY — a RUNNING -> EXPIRED write is an
+        # illegal-transition error, which is how the monitor proves "shed
+        # never touches a dispatched task" at runtime
+        ("QUEUED", "EXPIRED"),
     }
 )
+
+#: Terminal statuses that assert the task NEVER RAN. A write of one over
+#: the other (cancel racing a deadline shed) is a warning, not an error:
+#: both agree on the only fact a client can act on.
+_NEVER_RAN = frozenset({"CANCELLED", "EXPIRED"})
 
 
 @dataclass(frozen=True)
@@ -273,35 +284,49 @@ class RaceMonitor:
             same = frm == to and (
                 event.result is None or event.result == state.result
             )
-            if frm == "CANCELLED" and to in (
+            if frm in _NEVER_RAN and to in (
                 "RUNNING", "COMPLETED", "FAILED"
             ):
-                # the one lawful terminal overwrite: a cancel that LOST its
-                # race against dispatch (store/base.py cancel_task) — the
-                # task ran anyway and reality overwrites the stale record
-                # (includes cancel_task's own post-write repair restoring a
-                # clobbered terminal status)
+                # the one lawful terminal overwrite: a cancel/shed that
+                # LOST its race against dispatch (store/base.py
+                # cancel_task, expire_task) — the task ran anyway and
+                # reality overwrites the stale record (includes the
+                # writers' own post-write repairs restoring a clobbered
+                # terminal status)
                 self._flag(
                     "late-cancel-race",
                     "warning",
                     event.task_id,
-                    f"{event.actor} wrote {to} over CANCELLED: the cancel "
-                    f"raced dispatch and lost; the task ran",
+                    f"{event.actor} wrote {to} over {frm}: the "
+                    f"cancel/shed raced dispatch and lost; the task ran",
                     prior + (event,),
                 )
                 return
-            if to == "CANCELLED" and frm in ("COMPLETED", "FAILED"):
+            if to in _NEVER_RAN and frm in ("COMPLETED", "FAILED"):
                 # the sub-millisecond-task interleaving: the result landed
-                # inside the cancel's read->write window and the cancel
-                # write transiently clobbered it — lawful because
-                # cancel_task's post-write repair (keyed on the redundant
+                # inside the cancel/shed's read->write window and its
+                # write transiently clobbered it — lawful because the
+                # writers' post-write repair (keyed on the redundant
                 # final_status stamp) restores the record immediately
                 self._flag(
                     "cancel-after-finish",
                     "warning",
                     event.task_id,
-                    f"{event.actor} wrote CANCELLED over terminal {frm}; "
-                    f"cancel_task's repair restores it from final_status",
+                    f"{event.actor} wrote {to} over terminal {frm}; "
+                    f"the post-write repair restores it from final_status",
+                    prior + (event,),
+                )
+                return
+            if to in _NEVER_RAN and frm in _NEVER_RAN and frm != to:
+                # cancel racing a deadline shed (or vice versa): both
+                # writes assert the task never ran — whichever stands,
+                # the record tells the client the truth
+                self._flag(
+                    "cancel-expire-race",
+                    "warning",
+                    event.task_id,
+                    f"{event.actor} wrote {to} over {frm}: a cancel and "
+                    f"a deadline shed raced; both mean the task never ran",
                     prior + (event,),
                 )
                 return
@@ -394,9 +419,10 @@ class RaceCheckStore(TaskStore):
 
     # -- intercepted writes ------------------------------------------------
     def hset(self, key: str, fields: Mapping[str, str]) -> None:
-        if key == LIVE_INDEX_KEY:
-            # bookkeeping hash, not a task record: its fields are task IDS,
-            # which the lifecycle monitor must not mistake for task fields
+        if key in (LIVE_INDEX_KEY, FLEET_HEALTH_KEY):
+            # bookkeeping hashes, not task records: their fields are task
+            # ids / dispatcher ids, which the lifecycle monitor must not
+            # mistake for task fields
             self.inner.hset(key, fields)
             return
         op = "finish" if FIELD_RESULT in fields else "status"
@@ -459,12 +485,27 @@ class RaceCheckStore(TaskStore):
         return self.inner.n_round_trips
 
     def setnx_field(self, key: str, field: str, value: str) -> tuple[bool, str]:
-        # pass through for atomicity; not a lifecycle write the monitor
-        # models (the claim precedes the task's create)
-        return self.inner.setnx_field(key, field, value)
+        # pass through for atomicity. Idempotency/dispatch claims are not
+        # lifecycle writes — but a WINNING setnx on the STATUS field IS
+        # one: create_task_if_absent claims its QUEUED status this way
+        # (keyed submits), and without observing it here the monitor
+        # would see the eventual RUNNING as None -> RUNNING
+        created, current = self.inner.setnx_field(key, field, value)
+        if created and field == FIELD_STATUS:
+            self.monitor.observe(
+                self.actor, "create", key, {FIELD_STATUS: value}
+            )
+        return created, current
 
     def setnx_fields(self, items, field: str):
-        return self.inner.setnx_fields(items, field)
+        results = self.inner.setnx_fields(items, field)
+        if field == FIELD_STATUS:
+            for (key, value), (created, _current) in zip(items, results):
+                if created:
+                    self.monitor.observe(
+                        self.actor, "create", key, {FIELD_STATUS: value}
+                    )
+        return results
 
     def keys(self) -> list[str]:
         return self.inner.keys()

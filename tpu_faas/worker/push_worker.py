@@ -47,7 +47,12 @@ class PushWorker:
         #: REGISTER and RECONNECT so the grade survives socket churn and
         #: dispatcher restarts; a supervisor (worker/deploy.py) passes a
         #: slot-stable token so even a crash-respawned worker keeps the
-        #: machine's grade
+        #: machine's grade. A self-minted uuid default is flagged EPHEMERAL
+        #: on the wire: it will never be presented again after this
+        #: process dies, so the dispatcher grades it in memory only (no
+        #: store persistence, forgotten on purge) — otherwise every ad-hoc
+        #: restart leaks one WORKER_STATS_KEY entry forever
+        self.token_is_ephemeral = token is None
         self.token = token or uuid.uuid4().hex
         self.heartbeat = heartbeat
         self.heartbeat_period = heartbeat_period
@@ -78,6 +83,7 @@ class PushWorker:
                 m.REGISTER,
                 num_processes=self.num_processes,
                 token=self.token,
+                ephemeral=self.token_is_ephemeral,
             )
         )
 
@@ -153,6 +159,7 @@ class PushWorker:
                                         0 if self._draining else self.pool.free
                                     ),
                                     token=self.token,
+                                    ephemeral=self.token_is_ephemeral,
                                 )
                             )
                 for res in self.pool.drain():
