@@ -115,6 +115,50 @@ def test_protocol_raw_status_write_and_publish_fire(tmp_path):
     ]
 
 
+def test_protocol_raw_blob_write_fires(tmp_path):
+    """Raw writes/deletes into the blob namespace outside the store
+    package, across the static key spellings: a "blob:..." literal, a
+    BLOB_PREFIX concatenation/f-string, and a blob_key() call."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.store.base import BLOB_PREFIX, blob_key
+
+        def f(store, digest, data):
+            store.hset("blob:abc123", {"data": data})
+            store.hset(BLOB_PREFIX + digest, {"data": data})
+            store.setnx_field(f"{BLOB_PREFIX}{digest}", "data", data)
+            store.delete(blob_key(digest))
+        """,
+    )
+    assert hits(findings) == [
+        ("protocol.raw-blob-write", 4),
+        ("protocol.raw-blob-write", 5),
+        ("protocol.raw-blob-write", 6),
+        ("protocol.raw-blob-write", 7),
+    ]
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_protocol_raw_blob_write_clean(tmp_path):
+    """The sanctioned API (put_blob / get_blob / dynamic sweeper key
+    lists) stays clean — reads never fire, nor do hsets on ordinary task
+    keys."""
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, digest, data, stale_keys):
+            store.put_blob(digest, data)
+            body = store.get_blob(digest)
+            store.get_blobs([digest])
+            store.delete_many(stale_keys)  # dynamic GC list: out of scope
+            store.hset(digest, {"lease_at": "1.0"})
+            return body
+        """,
+    )
+    assert hits(findings) == []
+
+
 def test_protocol_set_status_many_rules(tmp_path):
     """The batched status write: its single shared status argument is held
     to the same terminal/unknown rules as plain set_status — a RUNNING

@@ -253,3 +253,28 @@ def test_autoscaler_end_to_end_grows_and_shrinks():
         t.join(timeout=10)
         gw.stop()
         store_handle.stop()
+
+
+def test_worker_tokens_are_fleet_namespaced():
+    """ADVICE r5: two supervisors on ONE host serving DIFFERENT dispatchers
+    must not mint colliding durable tokens (colliding tokens merge the two
+    fleets' speed grades in the estimator). The fleet id — a hash of the
+    dispatcher URL — namespaces them; the token stays stable across
+    supervisor restarts for the SAME dispatcher."""
+    from tpu_faas.worker.deploy import fleet_id
+
+    fleet_a = WorkerFleet(1, 2, "tcp://hostA:5555", protocol="push")
+    fleet_b = WorkerFleet(1, 2, "tcp://hostB:5555", protocol="push")
+    fleet_a2 = WorkerFleet(1, 2, "tcp://hostA:5555", protocol="push")
+
+    def token_of(fleet):
+        cmd = fleet._command(0)
+        return cmd[cmd.index("--token") + 1]
+
+    assert token_of(fleet_a) != token_of(fleet_b)  # different dispatchers
+    assert token_of(fleet_a) == token_of(fleet_a2)  # restart-stable
+    assert fleet_id("tcp://hostA:5555") in token_of(fleet_a)
+    # same slot shape, same host, same protocol — ONLY the fleet id differs
+    assert token_of(fleet_a).replace(
+        fleet_id("tcp://hostA:5555"), fleet_id("tcp://hostB:5555")
+    ) == token_of(fleet_b)

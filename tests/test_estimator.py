@@ -576,3 +576,65 @@ def test_push_worker_flags_minted_token_ephemeral():
     finally:
         w.pool.close()
         w.socket.close(linger=0)
+
+
+def test_constant_byte_workload_falls_back_to_fn_level_grading():
+    """ADVICE r5: when the byte regression DECLINES (constant param bytes,
+    var_x under _REG_MIN_VAR) and the function's runtime spread is small,
+    worker speed learning must degrade to the fn-level prev instead of
+    stopping — a never-repeating-params workload with uniform runtimes
+    used to grade NO workers at all."""
+    est = RuntimeEstimator()
+    d = fn_digest("const-bytes-fn")
+    # params never repeat (fresh digest per task), bytes constant, runtime
+    # uniform: exact-param never settles and the regression never engages
+    for i in range(20):
+        est.observe(d, 1.0, "baseline", param_digest=f"p{i}", param_bytes=64)
+    for i in range(20, 32):
+        est.observe(d, 0.5, "fast", param_digest=f"p{i}", param_bytes=64)
+    assert est.speed_for("fast") > 1.05  # learning engaged via fallback
+    assert est.speed_for("baseline") == pytest.approx(1.0, rel=0.2)
+
+
+def test_mixed_runtime_function_still_refuses_fn_level_grading():
+    """The fallback's guard: a function whose runtime genuinely varies by
+    parameter (large log-space spread) must NOT grade workers against its
+    fn-level mean — that mean mis-grades every worker that happens to draw
+    the small (or large) params."""
+    est = RuntimeEstimator()
+    d = fn_digest("mixed-runtime-fn")
+    runtimes = [0.1, 10.0]
+    for i in range(40):
+        est.observe(
+            d, runtimes[i % 2], "victim", param_digest=f"q{i}", param_bytes=64
+        )
+    assert est.speed_for("victim") == 1.0  # never graded
+
+
+def test_spread_accumulator_survives_persist_roundtrip():
+    """The 6-term regression accumulator (syy included) persists and
+    reloads; a restarted dispatcher keeps the fallback gate's evidence."""
+    from tpu_faas.store.memory import MemoryStore
+
+    store = MemoryStore()
+    est = RuntimeEstimator(store=store, persist_period=0.0)
+    d = fn_digest("persist-fn")
+    for i in range(12):
+        est.observe(d, 1.0, "w", param_digest=f"r{i}", param_bytes=64)
+    est.maybe_persist(force=True)
+    est2 = RuntimeEstimator(store=store)
+    assert est2._fn_reg[d] == pytest.approx(est._fn_reg[d])
+    assert est2._runtime_spread_small(d)
+
+
+def test_legacy_five_term_regression_record_loads_conservatively():
+    """A pre-r6 persisted record (5 accumulator terms, no syy) loads with
+    the unknown-spread sentinel: the fallback stays OFF for it until the
+    accumulator re-learns with fresh samples."""
+    from tpu_faas.store.memory import MemoryStore
+
+    store = MemoryStore()
+    store.hset(FN_STATS_KEY, {"legacyfn": "1.5:20:20:80:20:336:84"})
+    est = RuntimeEstimator(store=store)
+    assert est._fn_reg["legacyfn"][5] == -1.0
+    assert not est._runtime_spread_small("legacyfn")

@@ -1,0 +1,624 @@
+"""Content-addressed payload plane: codec core, blob store protocol,
+gateway dedup, dispatcher resolution, worker codec cache + MISS/FILL,
+binary framing negotiation, SDK memoization — unit through full-stack e2e.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tests.test_workers_e2e import _spawn_worker
+from tpu_faas.client import FaaSClient
+from tpu_faas.core.payload import PayloadLRU, payload_digest
+from tpu_faas.core.serialize import serialize
+from tpu_faas.core.task import (
+    FIELD_FN,
+    FIELD_FN_DIGEST,
+    FIELD_PARAMS,
+    FIELD_STATUS,
+    TaskStatus,
+)
+from tpu_faas.dispatch.base import PendingTask
+from tpu_faas.dispatch.local import LocalDispatcher
+from tpu_faas.dispatch.pull import PullDispatcher
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.base import BLOB_AT_FIELD, BLOB_DATA_FIELD, blob_key
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.store.memory import MemoryStore
+from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+from tpu_faas.worker import messages as m
+from tpu_faas.worker.pull_worker import PullWorker
+from tpu_faas.worker.push_worker import PushWorker
+from tpu_faas.workloads import arithmetic
+
+
+# -- codec core --------------------------------------------------------------
+
+
+def test_payload_digest_is_sha256_hex():
+    d = payload_digest("hello")
+    assert len(d) == 64 and int(d, 16) >= 0
+    assert d == payload_digest("hello")
+    assert d != payload_digest("hello2")
+
+
+def test_payload_lru_byte_bound_and_order():
+    lru = PayloadLRU(max_bytes=10)
+    lru.put("a", "12345")
+    lru.put("b", "12345")
+    assert lru.n_bytes == 10 and len(lru) == 2
+    assert lru.get("a") == "12345"  # refresh a: b is now LRU
+    lru.put("c", "123")
+    assert "b" not in lru and "a" in lru and "c" in lru
+    # an oversized payload is still admitted, alone
+    lru.put("big", "x" * 100)
+    assert lru.get("big") == "x" * 100 and len(lru) == 1
+
+
+def test_payload_lru_counts_hits_and_misses():
+    lru = PayloadLRU()
+    assert lru.get("nope") is None
+    lru.put("d", "data")
+    assert lru.get("d") == "data"
+    assert lru.hits == 1 and lru.misses == 1
+
+
+# -- store blob namespace ----------------------------------------------------
+
+
+def test_put_blob_is_create_once_and_stamps_ttl():
+    store = MemoryStore()
+    d = payload_digest("BODY")
+    assert store.put_blob(d, "BODY") is True
+    stamp1 = store.hget(blob_key(d), BLOB_AT_FIELD)
+    assert store.get_blob(d) == "BODY"
+    # second put: loses the data claim, refreshes the stamp
+    time.sleep(0.01)
+    assert store.put_blob(d, "BODY") is False
+    assert store.get_blob(d) == "BODY"
+    assert store.hget(blob_key(d), BLOB_AT_FIELD) != stamp1
+
+
+def test_get_blobs_multi_and_missing():
+    store = MemoryStore()
+    d1, d2 = payload_digest("one"), payload_digest("two")
+    store.put_blob(d1, "one")
+    assert store.get_blobs([d1, d2, d1]) == ["one", None, "one"]
+
+
+def test_resp_store_blob_roundtrip():
+    handle = start_store_thread()
+    store = make_store(handle.url)
+    try:
+        d = payload_digest("RESP-BODY")
+        assert store.put_blob(d, "RESP-BODY") is True
+        assert store.put_blob(d, "RESP-BODY") is False
+        assert store.get_blob(d) == "RESP-BODY"
+        assert store.get_blobs([d, payload_digest("x")]) == ["RESP-BODY", None]
+        assert store.n_bytes_sent > 0  # the bench lane's bytes counter
+    finally:
+        store.close()
+        handle.stop()
+
+
+# -- race monitor: blob create-once ------------------------------------------
+
+
+def test_race_monitor_put_blob_clean():
+    monitor = RaceMonitor()
+    store = RaceCheckStore(MemoryStore(), monitor, actor="gw")
+    d = payload_digest("CONTENT")
+    store.put_blob(d, "CONTENT")
+    store.put_blob(d, "CONTENT")  # dedup repeat: no second data write
+    monitor.assert_clean()
+
+
+def test_race_monitor_flags_blob_digest_mismatch():
+    monitor = RaceMonitor()
+    store = RaceCheckStore(MemoryStore(), monitor, actor="rogue")
+    store.hset(blob_key(payload_digest("real")), {BLOB_DATA_FIELD: "fake"})
+    kinds = [v.kind for v in monitor.errors]
+    assert "blob-digest-mismatch" in kinds
+
+
+def test_race_monitor_flags_blob_overwrite():
+    monitor = RaceMonitor()
+    store = RaceCheckStore(MemoryStore(), monitor, actor="rogue")
+    d = payload_digest("v1")
+    store.hset(blob_key(d), {BLOB_DATA_FIELD: "v1"})
+    monitor.assert_clean()  # honest first write
+    store.hset(blob_key(d), {BLOB_DATA_FIELD: "v2"})  # bypassed setnx
+    kinds = [v.kind for v in monitor.errors]
+    assert "blob-overwrite" in kinds
+
+
+def test_race_monitor_blob_stamp_refresh_is_not_a_task_write():
+    monitor = RaceMonitor()
+    store = RaceCheckStore(MemoryStore(), monitor, actor="gw")
+    store.hset(blob_key(payload_digest("b")), {BLOB_AT_FIELD: "123.0"})
+    monitor.assert_clean()
+    assert monitor.unfinished() == []  # never mistaken for a task record
+
+
+# -- wire framing ------------------------------------------------------------
+
+
+def test_binary_frame_roundtrip_and_sniffing():
+    ascii_raw = m.encode(m.TASK, task_id="t", fn_payload="F", param_payload="P")
+    bin_raw = m.encode_bin(m.TASK, task_id="t", fn_digest="d" * 64,
+                           param_payload="P")
+    assert not m.is_binary(ascii_raw) and m.is_binary(bin_raw)
+    assert m.decode(ascii_raw)[1]["fn_payload"] == "F"
+    assert m.decode(bin_raw)[1]["fn_digest"] == "d" * 64
+    # encode_for routes by negotiation state
+    assert m.is_binary(m.encode_for(True, m.WAIT))
+    assert not m.is_binary(m.encode_for(False, m.WAIT))
+
+
+def test_binary_frame_smaller_than_ascii_for_payloads():
+    kw = dict(task_id="t", fn_payload="A" * 4096, param_payload="P" * 512)
+    assert len(m.encode_bin(m.TASK, **kw)) < 0.8 * len(m.encode(m.TASK, **kw))
+
+
+def test_caps_of_tolerates_garbage():
+    assert m.caps_of({}) == frozenset()
+    assert m.caps_of({"caps": "blob"}) == frozenset()
+    assert m.caps_of({"caps": ["blob", 7, "bin"]}) == {"blob", "bin"}
+
+
+# -- executor child cache ----------------------------------------------------
+
+
+def test_executor_fn_cache_skips_repeat_decode():
+    from tpu_faas.core import executor
+
+    payload = serialize(lambda x: x * 3)
+    digest = payload_digest(payload)
+    executor._FN_CACHE.clear()
+    fn1 = executor._cached_fn(payload, digest)
+    fn2 = executor._cached_fn("GARBAGE-NEVER-DECODED", digest)
+    assert fn1 is fn2 and fn2(7) == 21  # second call never touched dill
+    # digest-less callers bypass the cache entirely
+    assert executor._cached_fn(payload, None)(2) == 6
+    executor._FN_CACHE.clear()
+
+
+def test_executor_fn_cache_bounded():
+    from tpu_faas.core import executor
+
+    executor._FN_CACHE.clear()
+    payload = serialize(lambda: None)
+    for i in range(executor._FN_CACHE_CAP + 10):
+        executor._cached_fn(payload, f"digest-{i}")
+    assert len(executor._FN_CACHE) == executor._FN_CACHE_CAP
+    executor._FN_CACHE.clear()
+
+
+# -- gateway: payload-plane mode ---------------------------------------------
+
+
+def _submit_and_read(store, gw_url, payload="PARAMS"):
+    client = FaaSClient(gw_url, auto_idempotency=False)
+    fid = client.register_payload("fn", "FNBODY-" + "x" * 64)
+    tid = client.execute_payload(fid, payload)
+    return fid, tid, store.hgetall(tid)
+
+
+def test_gateway_plane_off_keeps_inline_contract():
+    store = MemoryStore()
+    gw = start_gateway_thread(store)  # default: plane off
+    try:
+        _fid, _tid, fields = _submit_and_read(store, gw.url)
+        assert fields[FIELD_FN].startswith("FNBODY-")
+        assert FIELD_FN_DIGEST not in fields
+    finally:
+        gw.stop()
+
+
+def test_gateway_plane_writes_digest_records_and_blob_once():
+    store = MemoryStore()
+    gw = start_gateway_thread(store, payload_plane=True)
+    try:
+        fid, tid, fields = _submit_and_read(store, gw.url)
+        body = "FNBODY-" + "x" * 64
+        digest = payload_digest(body)
+        assert fields[FIELD_FN] == ""
+        assert fields[FIELD_FN_DIGEST] == digest
+        assert fields[FIELD_PARAMS] == "PARAMS"
+        assert store.get_blob(digest) == body
+        # batch submits carry the digest too
+        client = FaaSClient(gw.url, auto_idempotency=False)
+        handles = client.submit_many(fid, [((i,), {}) for i in range(5)])
+        for h in handles:
+            rec = store.hgetall(h.task_id)
+            assert rec[FIELD_FN_DIGEST] == digest and rec[FIELD_FN] == ""
+    finally:
+        gw.stop()
+
+
+def test_gateway_register_once_dedups_by_content():
+    store = MemoryStore()
+    gw = start_gateway_thread(store, payload_plane=True)
+    try:
+        client = FaaSClient(gw.url)
+        fid1 = client.register_payload("a", "SAME-BODY")
+        fid2 = client.register_payload("b", "SAME-BODY")
+        assert fid1 == fid2  # content dedup, names notwithstanding
+        fid3 = client.register_payload("a", "OTHER-BODY")
+        assert fid3 != fid1
+    finally:
+        gw.stop()
+
+
+def test_gateway_dedup_repairs_missing_registry_record():
+    """A claim winner that died between its digest-index setnx and its
+    registry hset must not poison the digest forever: the next
+    registration of the same bytes adopts the claimed id AND repairs the
+    missing function-registry record, so submits of it resolve."""
+    from tpu_faas.gateway.app import _FN_INDEX_PREFIX, _FUNCTION_PREFIX
+
+    store = MemoryStore()
+    gw = start_gateway_thread(store, payload_plane=True)
+    try:
+        # simulate the dead winner: index claimed, registry never written
+        digest = payload_digest("ORPHAN-BODY")
+        store.setnx_field(
+            _FN_INDEX_PREFIX + digest, "function_id", "orphan-fid"
+        )
+        client = FaaSClient(gw.url)
+        fid = client.register_payload("repaired", "ORPHAN-BODY")
+        assert fid == "orphan-fid"  # adopted the winner's claim...
+        rec = store.hgetall(_FUNCTION_PREFIX + "orphan-fid")
+        # ...and wrote the record the winner never did
+        assert rec["payload"] == "ORPHAN-BODY"
+        assert rec["payload_digest"] == digest
+        assert store.get_blob(digest) == "ORPHAN-BODY"
+        # a submit of the repaired function now resolves
+        h = client.submit(fid)
+        assert store.hgetall(h.task_id)[FIELD_FN_DIGEST] == digest
+    finally:
+        gw.stop()
+
+
+def test_blob_gc_spares_referenced_blobs():
+    from tpu_faas.gateway.app import _sweep_expired_results
+
+    store = MemoryStore()
+    now = time.time()
+    old = repr(now - 10_000.0)
+    # referenced by the function registry: kept however stale
+    d_fn = payload_digest("REGISTERED")
+    store.put_blob(d_fn, "REGISTERED")
+    store.hset(blob_key(d_fn), {BLOB_AT_FIELD: old})
+    store.hset("function:f1", {"payload": "REGISTERED", "payload_digest": d_fn})
+    # referenced by a LIVE task: kept
+    d_live = payload_digest("LIVEREF")
+    store.put_blob(d_live, "LIVEREF")
+    store.hset(blob_key(d_live), {BLOB_AT_FIELD: old})
+    store.create_task("t-live", "", "P", extra_fields={FIELD_FN_DIGEST: d_live})
+    # unreferenced + stale: collected
+    d_orphan = payload_digest("ORPHAN")
+    store.put_blob(d_orphan, "ORPHAN")
+    store.hset(blob_key(d_orphan), {BLOB_AT_FIELD: old})
+    # unreferenced but FRESH: kept (TTL half of the policy)
+    d_fresh = payload_digest("FRESH")
+    store.put_blob(d_fresh, "FRESH")
+    _sweep_expired_results(store, ttl=60.0, now=now)
+    assert store.get_blob(d_fn) == "REGISTERED"
+    assert store.get_blob(d_live) == "LIVEREF"
+    assert store.get_blob(d_orphan) is None
+    assert store.get_blob(d_fresh) == "FRESH"
+
+
+# -- dispatcher resolution ---------------------------------------------------
+
+
+def _digest_task(store, task_id, body="DIGEST-BODY", params="P"):
+    digest = payload_digest(body)
+    store.put_blob(digest, body)
+    store.create_task(
+        task_id, "", params, extra_fields={FIELD_FN_DIGEST: digest}
+    )
+    return digest
+
+
+def test_intake_accepts_digest_records():
+    store = MemoryStore()
+    disp = LocalDispatcher(store=store)
+    try:
+        _digest_task(store, "t1")
+        task = disp.poll_next_task()
+        assert task is not None and task.task_id == "t1"
+        assert task.fn_digest == payload_digest("DIGEST-BODY")
+        assert task.fn_payload == ""
+    finally:
+        disp.close()
+
+
+def test_ensure_inline_payload_caches_blob():
+    store = MemoryStore()
+    disp = LocalDispatcher(store=store)
+    try:
+        d = _digest_task(store, "t1")
+        _digest_task(store, "t2")
+        t1 = PendingTask("t1", "", "P", fn_digest=d)
+        t2 = PendingTask("t2", "", "P", fn_digest=d)
+        assert disp.ensure_inline_payload(t1) and t1.fn_payload == "DIGEST-BODY"
+        assert disp.ensure_inline_payload(t2) and t2.fn_payload == "DIGEST-BODY"
+        assert disp.blob_cache.misses == 1 and disp.blob_cache.hits == 1
+    finally:
+        disp.close()
+
+
+def test_missing_blob_fails_task_instead_of_wedging():
+    store = MemoryStore()
+    disp = LocalDispatcher(store=store)
+    try:
+        ghost = payload_digest("never-written")
+        store.create_task(
+            "t1", "", "P", extra_fields={FIELD_FN_DIGEST: ghost}
+        )
+        t = PendingTask("t1", "", "P", fn_digest=ghost)
+        assert disp.ensure_inline_payload(t) is False
+        assert store.get_status("t1") == str(TaskStatus.FAILED)
+    finally:
+        disp.close()
+
+
+def test_local_dispatcher_executes_digest_tasks():
+    store = MemoryStore()
+    disp = LocalDispatcher(num_workers=2, store=store)
+    try:
+        body = serialize(arithmetic)
+        digest = payload_digest(body)
+        store.put_blob(digest, body)
+        from tpu_faas.core.executor import pack_params
+
+        for i in range(4):
+            store.create_task(
+                f"t{i}", "", pack_params(50), extra_fields={
+                    FIELD_FN_DIGEST: digest
+                },
+            )
+        done = disp.start(max_tasks=4)
+        assert done == 4
+        for i in range(4):
+            status, _result = store.get_result(f"t{i}")
+            assert status == str(TaskStatus.COMPLETED)
+    finally:
+        disp.close()
+
+
+# -- SDK memoization ---------------------------------------------------------
+
+
+def test_sdk_register_memoizes_serialize_and_registration():
+    store = MemoryStore()
+    gw = start_gateway_thread(store, payload_plane=True)
+    try:
+        client = FaaSClient(gw.url)
+
+        def fn(x):
+            return x + 1
+
+        fid1 = client.register(fn)
+        fid2 = client.register(fn)  # no HTTP round trip at all
+        assert fid1 == fid2
+        # exactly one function registered gateway-side
+        fn_keys = [k for k in store.keys() if k.startswith("function:")]
+        assert len(fn_keys) == 1
+    finally:
+        gw.stop()
+
+
+def test_fn_memo_id_recycling_is_safe():
+    from tpu_faas.client.sdk import _FnMemo
+
+    memo = _FnMemo()
+
+    def a(x):
+        return x
+
+    p1 = memo.serialize_fn(a)
+    assert memo.serialize_fn(a) == p1  # hit
+    # a DIFFERENT callable must never be served a's bytes, whatever id()
+    def b(x):
+        return x * 2
+
+    assert memo.serialize_fn(b) != p1 or serialize(b) == p1
+
+
+# -- push path e2e: digest shipping, MISS/FILL, binary framing ---------------
+
+
+def test_push_worker_in_process_blob_flow():
+    """In-process PushWorker against a PushDispatcher: REGISTER advertises
+    caps, the dispatcher ships digests, the worker's payload cache misses
+    once (BLOB_MISS/BLOB_FILL round), then hits; frames go binary after
+    negotiation; results land correctly."""
+    from tpu_faas.dispatch.push import PushDispatcher
+    from tpu_faas.core.executor import pack_params
+
+    store = MemoryStore()
+    disp = PushDispatcher(ip="127.0.0.1", port=0, store=store)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    body = serialize(arithmetic)
+    digest = payload_digest(body)
+    store.put_blob(digest, body)
+    for i in range(6):
+        store.create_task(
+            f"t{i}", "", pack_params(40), extra_fields={
+                FIELD_FN_DIGEST: digest
+            },
+        )
+    worker = PushWorker(2, f"tcp://127.0.0.1:{disp.port}", heartbeat=True,
+                        heartbeat_period=0.2)
+    try:
+        shipped = worker.run(max_tasks=6)
+        assert shipped == 6
+        deadline = time.monotonic() + 15.0
+        while disp.n_results < 6 and time.monotonic() < deadline:
+            time.sleep(0.02)  # dispatcher drains the last RESULTs async
+        for i in range(6):
+            status, _ = store.get_result(f"t{i}")
+            assert status == str(TaskStatus.COMPLETED)
+        # the payload plane engaged end to end (several tasks can arrive
+        # before the first FILL lands — each counts a miss; only ONE
+        # MISS/FILL round happens per digest, which m_blob_fills pins)
+        assert worker.fn_cache.misses >= 1
+        assert worker.fn_cache.hits >= 1
+        assert worker._peer_bin  # binary framing negotiated
+        assert disp.m_blob_fills.value >= 1
+        # digests shipped: wire payload bytes exclude the body after fill
+        assert disp.m_payload_bytes.value < 6 * len(body)
+    finally:
+        worker.stop()
+        disp.stop()
+        t.join(timeout=10)
+        disp.close()
+
+
+def test_pull_worker_in_process_blob_flow():
+    """Pull mode: digest-only TASK replies, synchronous BLOB_MISS
+    transaction on the first miss, cached afterwards."""
+    from tpu_faas.core.executor import pack_params
+
+    store = MemoryStore()
+    disp = PullDispatcher(ip="127.0.0.1", port=0, store=store)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    body = serialize(arithmetic)
+    digest = payload_digest(body)
+    store.put_blob(digest, body)
+    for i in range(4):
+        store.create_task(
+            f"t{i}", "", pack_params(30), extra_fields={
+                FIELD_FN_DIGEST: digest
+            },
+        )
+    worker = PullWorker(2, f"tcp://127.0.0.1:{disp.port}", delay=0.005)
+    try:
+        shipped = worker.run(max_tasks=4)
+        assert shipped == 4
+        for i in range(4):
+            status, _ = store.get_result(f"t{i}")
+            assert status == str(TaskStatus.COMPLETED)
+        assert worker.fn_cache.misses == 1 and worker.fn_cache.hits >= 1
+    finally:
+        worker.stop()
+        disp.stop()
+        t.join(timeout=10)
+        disp.close()
+
+
+def test_legacy_worker_gets_inline_payloads():
+    """A worker WITHOUT caps (reference contract) served digest tasks:
+    the dispatcher materializes the body inline — same results, no
+    payload-plane message ever reaches the worker."""
+    from tpu_faas.dispatch.push import PushDispatcher
+    from tpu_faas.core.executor import pack_params
+
+    store = MemoryStore()
+    disp = PushDispatcher(ip="127.0.0.1", port=0, store=store)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    body = serialize(arithmetic)
+    digest = payload_digest(body)
+    store.put_blob(digest, body)
+    for i in range(4):
+        store.create_task(
+            f"t{i}", "", pack_params(25), extra_fields={
+                FIELD_FN_DIGEST: digest
+            },
+        )
+    worker = PushWorker(2, f"tcp://127.0.0.1:{disp.port}", heartbeat=True,
+                        heartbeat_period=0.2, caps=())
+    try:
+        shipped = worker.run(max_tasks=4)
+        assert shipped == 4
+        deadline = time.monotonic() + 15.0
+        while disp.n_results < 4 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        for i in range(4):
+            status, _ = store.get_result(f"t{i}")
+            assert status == str(TaskStatus.COMPLETED)
+        # nothing payload-plane-shaped touched the worker
+        assert worker.fn_cache.hits == 0 and worker.fn_cache.misses == 0
+        assert not worker._peer_bin
+        # dispatcher resolved the body once, served it inline per task
+        assert disp.blob_cache.misses == 1
+    finally:
+        worker.stop()
+        disp.stop()
+        t.join(timeout=10)
+        disp.close()
+
+
+def test_full_stack_payload_plane_e2e():
+    """Gateway (payload_plane=True) -> store server -> tpu-push dispatcher
+    -> real push-worker subprocesses: one function, a burst of tasks, all
+    results correct, fn body written to the store ONCE, dispatch shipping
+    digests (race-monitored clean)."""
+    from tests.test_tpu_push_e2e import _make_dispatcher
+
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    gw_store = RaceCheckStore(
+        make_store(store_handle.url), monitor, actor="gateway"
+    )
+    gw = start_gateway_thread(gw_store, payload_plane=True)
+    disp = _make_dispatcher(
+        store_handle.url,
+        store=RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="dispatcher"
+        ),
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(arithmetic)
+        handles = client.submit_many(fid, [((100 + i,), {}) for i in range(24)])
+        values = [h.result(timeout=90.0) for h in handles]
+        assert values == [arithmetic(100 + i) for i in range(24)]
+        # every record carried the digest, not the body
+        probe = make_store(store_handle.url)
+        try:
+            rec = probe.hgetall(handles[0].task_id)
+            assert rec[FIELD_FN] == "" and rec[FIELD_FN_DIGEST]
+            assert probe.get_blob(rec[FIELD_FN_DIGEST]) is not None
+        finally:
+            probe.close()
+        monitor.assert_clean(allow_warnings=True)
+        assert not monitor.errors
+    finally:
+        for w in workers:
+            w.kill()
+            w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw.stop()
+        store_handle.stop()
+
+
+def test_reclaim_preserves_digest():
+    """A reclaimed digest task rebuilds with its digest (RECLAIM_FIELDS),
+    so re-dispatch keeps riding the payload plane."""
+    store = MemoryStore()
+    disp = LocalDispatcher(store=store)
+    try:
+        d = _digest_task(store, "t1")
+        store.set_status("t1", TaskStatus.RUNNING)
+        pt = disp.fetch_reclaim("t1", retries=1)
+        assert pt is not None and pt.fn_digest == d and pt.retries == 1
+    finally:
+        disp.close()

@@ -316,3 +316,65 @@ def test_real_redis_interop_leg_visibility():
             "redis-client stack runs against OUR server in "
             "tests/test_reference_worker_interop.py)"
         )
+
+
+def test_shim_pubsub_nonblocking_on_partial_reply():
+    """ADVICE r5: the shim's PubSub.get_message must honor its non-blocking
+    contract even when a published payload arrives SPLIT across TCP
+    segments — the old fast-path check ('any CRLF buffered?') walked into
+    read_reply's unguarded socket fills on exactly that shape and blocked
+    until the rest of the frame arrived. With the reply-span lookahead, a
+    partial frame returns None immediately and the complete message is
+    delivered once the tail lands."""
+    import socket as _socket
+    import threading
+    import time
+
+    from tpu_faas.compat.redis_shim.redis import PubSub
+
+    # a hand-rolled one-shot RESP server: accepts the SUBSCRIBE, then
+    # dribbles a large published message in two delayed halves
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    payload = b"X" * 4096
+    msg = (
+        b"*3\r\n$7\r\nmessage\r\n$5\r\ntasks\r\n$%d\r\n%s\r\n"
+        % (len(payload), payload)
+    )
+
+    def serve():
+        conn, _ = srv.accept()
+        conn.recv(4096)  # the SUBSCRIBE command
+        conn.sendall(b"*3\r\n$9\r\nsubscribe\r\n$5\r\ntasks\r\n:1\r\n")
+        time.sleep(0.15)
+        conn.sendall(msg[: len(msg) // 2])  # partial frame...
+        time.sleep(0.6)
+        conn.sendall(msg[len(msg) // 2:])  # ...tail later
+        time.sleep(0.5)
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    ps = PubSub("127.0.0.1", port)
+    try:
+        ps.subscribe("tasks")
+        time.sleep(0.3)  # the partial half is now buffered server-side
+        t0 = time.monotonic()
+        first = ps.get_message(timeout=0.05)
+        waited = time.monotonic() - t0
+        assert first is None  # partial reply: no message, and...
+        assert waited < 0.45  # ...no block past the timeout window
+        # once the tail lands, the message is delivered whole
+        deadline = time.monotonic() + 5.0
+        got = None
+        while got is None and time.monotonic() < deadline:
+            got = ps.get_message(timeout=0.1)
+        assert got == {
+            "type": "message", "channel": b"tasks", "data": payload
+        }
+    finally:
+        ps.close()
+        srv.close()
+        t.join(timeout=5)

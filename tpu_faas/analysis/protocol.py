@@ -27,7 +27,13 @@ reach through the API used:
   ``.publish()`` to the tasks/results channels, are flagged outside
   ``tpu_faas/store/`` (``raw-status-write`` / ``raw-task-publish``): those
   writes bypass the TaskStore conveniences, so the runtime monitor —
-  which models exactly that API — provably would not cover them.
+  which models exactly that API — provably would not cover them;
+- raw ``.hset()``/``.setnx_field()``/``.delete()`` whose KEY statically
+  names the ``blob:`` namespace, outside ``tpu_faas/store/``
+  (``raw-blob-write``): blobs are create-once content — writes must go
+  through ``put_blob`` (setnx'd data field + TTL stamp), which the
+  runtime monitor validates against the digest; deletes belong to the
+  gateway sweeper's reference-checked GC, whose key lists are dynamic.
 
 The legal-status sets are DERIVED from ``racecheck._LEGAL`` and
 ``TaskStatus`` at import time, not copied: if the protocol grows a status or
@@ -46,7 +52,7 @@ from tpu_faas.core.task import (
     FIELD_STATUS,
     TaskStatus,
 )
-from tpu_faas.store.base import RESULTS_CHANNEL, TASKS_CHANNEL
+from tpu_faas.store.base import BLOB_PREFIX, RESULTS_CHANNEL, TASKS_CHANNEL
 from tpu_faas.store.racecheck import _LEGAL
 
 #: All spellable statuses.
@@ -132,6 +138,9 @@ class ProtocolChecker(Checker):
                 yield from self._check_finish_many(module, node)
             elif method in ("hset", "hset_many") and not store_internal:
                 yield from self._check_raw_hset(module, node)
+                yield from self._check_raw_blob(module, node)
+            elif method in ("setnx_field", "delete") and not store_internal:
+                yield from self._check_raw_blob(module, node)
             elif method == "publish" and not store_internal:
                 yield from self._check_raw_publish(module, node)
 
@@ -316,6 +325,62 @@ class ProtocolChecker(Checker):
                             module, value, status
                         )
                 break  # one finding per dict literal
+
+    @staticmethod
+    def _names_blob_key(node: ast.AST) -> bool:
+        """True when a key expression statically addresses the blob
+        namespace: a "blob:..." literal, a blob_key(...) call, or any
+        concatenation/f-string mentioning BLOB_PREFIX. Dynamic key lists
+        (the sweeper's GC) are out of static reach by design."""
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value.startswith(BLOB_PREFIX)
+        ):
+            return True
+        if isinstance(node, ast.Call):
+            named = dotted_name(node.func)
+            if named is not None and named.split(".")[-1] == "blob_key":
+                return True
+        if isinstance(node, ast.BinOp):
+            return ProtocolChecker._names_blob_key(
+                node.left
+            ) or ProtocolChecker._names_blob_key(node.right)
+        named = dotted_name(node)
+        if named is not None and named.split(".")[-1] == "BLOB_PREFIX":
+            return True
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if (
+                    isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                    and v.value.startswith(BLOB_PREFIX)
+                ):
+                    return True
+                if isinstance(
+                    v, ast.FormattedValue
+                ) and ProtocolChecker._names_blob_key(v.value):
+                    return True
+        return False
+
+    def _check_raw_blob(
+        self, module: Module, call: ast.Call
+    ) -> Iterator[Finding]:
+        key = self._arg(call, 0, "key")
+        if key is None or not self._names_blob_key(key):
+            return
+        method = call.func.attr if isinstance(call.func, ast.Attribute) else "?"
+        yield self.finding(
+            module,
+            call,
+            "raw-blob-write",
+            "error",
+            f"raw {method} into the blob namespace outside the store "
+            f"package: blobs are create-once content — writes must go "
+            f"through put_blob (setnx'd data + TTL stamp, validated "
+            f"against the digest by the race monitor), and deletes "
+            f"through the sweeper's reference-checked GC",
+        )
 
     def _check_raw_publish(
         self, module: Module, call: ast.Call

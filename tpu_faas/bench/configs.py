@@ -15,8 +15,11 @@ configs[]) plus one framework-extra:
 10. (extra) overload robustness: offered load >= 3x fleet capacity against
    the full stack with the admission controller engaged — goodput holds,
    rejects are clean 429s with Retry-After, no admitted task is lost
+11. (extra) payload plane: repeated-fn store bytes/task + host dispatch
+   throughput, inline vs content-addressed shipping (blob namespace,
+   dispatcher blob cache, digest-shipped TASKs)
 
-Configs 1-2, 6, 9 and 10 run the real socket stack; 3-5 run the device kernels
+Configs 1-2, 6, 9-11 run the real socket stack; 3-5 run the device kernels
 at scales the socket stack can't reach on one box (the reference had no
 analog — its harness topped out at localhost subprocesses, SURVEY §4).
 Each config returns a dict and is printed as one JSON line by the CLI.
@@ -955,6 +958,157 @@ def config_10_overload() -> dict:
         handle.stop()
 
 
+def config_11_payload_plane() -> dict:
+    """Payload plane (config 11): repeated-fn host throughput and store
+    bytes/task, inline vs content-addressed — the full real submit path
+    (store server over TCP, gateway, HTTP batch submits) into a tpu-push
+    dispatcher with mirror workers on the ROUTER (config-9 style: sends to
+    never-connected peers are dropped, isolating the host cost).
+
+    One function of ``payload_bytes`` serialized size repeats across every
+    task — the shape the payload plane exists for (a 50k burst of one
+    function). Two legs, identical except the gateway's ``payload_plane``
+    flag: the row reports store wire bytes per dispatched task for each
+    (the blob leg writes the body once, records carry a 64-char digest),
+    end-to-end host dispatch throughput, the dispatcher blob-cache hit
+    rate (mirror workers alternate legacy/blob-capable, so the legacy
+    half exercises inline materialization from the cache), and the
+    worker-wire payload bytes per task (the capable half ships digests).
+
+    Shape via TPU_FAAS_BENCH_PAYLOAD_SHAPE="tasks,workers,procs,
+    payload_bytes" — fleet capacity (workers x procs) must cover the task
+    count, exactly as in config 9: mirror workers never return results,
+    so no slot is ever refilled. Default "20000,4096,8,8192"; the CI
+    smoke lane runs "1000,256,4,4096" (the PR-3 comparison shape) and
+    asserts a nonzero blob-cache hit rate plus store bytes/task below
+    the inline leg.
+    """
+    import os
+
+    import requests as _requests
+
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.worker import messages as m
+
+    shape = os.environ.get(
+        "TPU_FAAS_BENCH_PAYLOAD_SHAPE", "20000,4096,8,8192"
+    )
+    n_tasks, n_workers, n_procs, payload_bytes = (
+        int(x) for x in shape.split(",")
+    )
+    fn_payload = "A" * payload_bytes  # opaque to every hop measured here
+    param = "P" * 64
+
+    def run_leg(plane: bool) -> dict:
+        handle = start_store_thread()
+        gw_store = make_store(handle.url)
+        disp_store = make_store(handle.url)
+        gw = start_gateway_thread(gw_store, payload_plane=plane)
+        disp = TpuPushDispatcher(
+            ip="127.0.0.1",
+            port=0,
+            store=disp_store,
+            max_workers=n_workers,
+            max_pending=min(8192, max(n_tasks, 64)),
+            max_inflight=max(2 * n_tasks, 1024),
+            max_slots=n_procs,
+            recover_queued=False,
+        )
+        http = _requests.Session()
+        try:
+            # mirror fleet: majority blob-capable (the steady state the
+            # plane is built for) with a 1-in-8 LEGACY minority, which
+            # forces inline materialization through the dispatcher blob
+            # cache — both resolution paths stay measured, and the cache
+            # hit rate the CI lane asserts on comes from real traffic
+            for i in range(n_workers):
+                reg = {"num_processes": n_procs}
+                if i % 8:
+                    reg["caps"] = list(m.WORKER_CAPS)
+                disp._handle(f"bench-w{i}".encode(), m.REGISTER, reg)
+            disp.tick()  # compile the device step outside the timed window
+            r = http.post(
+                f"{gw.url}/register_function",
+                json={"name": "blobfn", "payload": fn_payload},
+            )
+            r.raise_for_status()
+            fid = r.json()["function_id"]
+            bytes0 = gw_store.n_bytes_sent + disp_store.n_bytes_sent
+            wire0 = disp.m_payload_bytes.value
+            t0 = time.perf_counter()
+            submitted = 0
+            chunk = 2_000
+            while submitted < n_tasks:
+                n = min(chunk, n_tasks - submitted)
+                # raw posts, no idempotency keys: both legs ride the
+                # single-pipeline create_tasks path symmetrically
+                r = http.post(
+                    f"{gw.url}/execute_batch",
+                    json={"function_id": fid, "payloads": [param] * n},
+                    timeout=120,
+                )
+                r.raise_for_status()
+                submitted += n
+            submit_s = time.perf_counter() - t0
+            # dispatch window timed SEPARATELY so tasks_per_s is the same
+            # quantity config 9 reports (intake -> device -> act), directly
+            # comparable with its headline
+            t1 = time.perf_counter()
+            deadline = t1 + 600.0
+            while (
+                disp.n_dispatched < n_tasks
+                and time.perf_counter() < deadline
+            ):
+                disp.tick()
+            elapsed = time.perf_counter() - t1
+            store_bytes = (
+                gw_store.n_bytes_sent + disp_store.n_bytes_sent - bytes0
+            )
+            cache = disp.blob_cache
+            return {
+                "dispatched": disp.n_dispatched,
+                "submit_s": round(submit_s, 3),
+                "tasks_per_s": round(disp.n_dispatched / max(elapsed, 1e-9), 1),
+                "store_bytes_per_task": round(store_bytes / max(n_tasks, 1), 1),
+                "worker_wire_payload_bytes_per_task": round(
+                    (disp.m_payload_bytes.value - wire0) / max(n_tasks, 1), 1
+                ),
+                "blob_cache_hits": cache.hits,
+                "blob_cache_hit_rate": round(
+                    cache.hits / max(cache.hits + cache.misses, 1), 4
+                ),
+            }
+        finally:
+            disp.socket.close(linger=0)
+            disp.close()
+            gw.stop()
+            handle.stop()
+
+    inline = run_leg(False)
+    blob = run_leg(True)
+    return {
+        "config": "payload-plane-repeated-fn",
+        "shape": {
+            "tasks": n_tasks,
+            "workers": n_workers,
+            "procs": n_procs,
+            "payload_bytes": payload_bytes,
+        },
+        "inline": inline,
+        "blob": blob,
+        # the acceptance headline: store wire bytes per dispatched task,
+        # content-addressed vs inline (>= 5x expected on this shape)
+        "store_bytes_per_task_reduction_x": round(
+            inline["store_bytes_per_task"]
+            / max(blob["store_bytes_per_task"], 1e-9),
+            2,
+        ),
+        "host_dispatch_tasks_per_s": blob["tasks_per_s"],
+    }
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -966,4 +1120,5 @@ CONFIGS = {
     "8": config_8_estimation,
     "9": config_9_host_dispatch,
     "10": config_10_overload,
+    "11": config_11_payload_plane,
 }

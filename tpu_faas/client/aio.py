@@ -24,11 +24,11 @@ from tpu_faas.client.sdk import (
     TaskCancelledError,
     TaskExpiredError,
     TaskFailedError,
+    _FnMemo,  # shared serialize()/register dedup: sync and async agree
     _retry_after_s,  # shared Retry-After parsing: sync and async must agree
     _unwrap_terminal,
 )
 from tpu_faas.core.executor import pack_params
-from tpu_faas.core.serialize import serialize
 
 
 @dataclass
@@ -112,6 +112,8 @@ class AsyncFaaSClient:
         self.connect_retries = connect_retries
         self.overload_retries = int(overload_retries)
         self.auto_idempotency = bool(auto_idempotency)
+        #: serialize()/register dedup, shared shape with the sync SDK
+        self._memo = _FnMemo()
         self._http: aiohttp.ClientSession | None = None
 
     @contextlib.asynccontextmanager
@@ -197,15 +199,21 @@ class AsyncFaaSClient:
 
     async def register(self, fn: Callable, name: str | None = None) -> str:
         # serialization is CPU work: off the event loop, like all packing
+        # (the memo makes the repeat case a dict probe — see _FnMemo)
         loop = asyncio.get_running_loop()
-        payload = await loop.run_in_executor(None, serialize, fn)
+        payload = await loop.run_in_executor(None, self._memo.serialize_fn, fn)
+        function_id = self._memo.function_id_for(payload)
+        if function_id is not None:
+            return function_id
         async with self.request(
             "POST",
             f"{self.base_url}/register_function",
             json={"name": name or fn.__name__, "payload": payload},
         ) as r:
             r.raise_for_status()
-            return (await r.json())["function_id"]
+            function_id = (await r.json())["function_id"]
+        self._memo.note_registered(payload, function_id)
+        return function_id
 
     async def submit(
         self, function_id: str, *args: Any, **kwargs: Any

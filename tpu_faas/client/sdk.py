@@ -11,6 +11,7 @@ from __future__ import annotations
 import random
 import time
 import uuid
+import weakref
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -18,8 +19,70 @@ import requests
 from requests.adapters import HTTPAdapter, Retry
 
 from tpu_faas.core.executor import pack_params
+from tpu_faas.core.payload import payload_digest
 from tpu_faas.core.serialize import deserialize, serialize
 from tpu_faas.core.task import TaskStatus
+
+
+class _FnMemo:
+    """Client-side function dedup — the SDK half of the payload plane.
+
+    Two memo levels, both bounded:
+
+    - ``serialize_fn`` caches the dill+base64 payload per CALLABLE
+      IDENTITY (id + weakref liveness check, so a recycled id can never
+      serve another function's bytes): a submit loop that registers or
+      re-serializes the same function per call stops paying dill per
+      iteration;
+    - ``function_id_for``/``note_registered`` dedup registration by
+      payload CONTENT (sha256): register(fn) called N times — or called
+      with two closures that serialize identically — yields one
+      function_id and one HTTP round trip.
+
+    Correctness does not depend on either cache: a miss just pays the
+    old cost, and the gateway's own register-once dedup (payload-plane
+    mode) catches what the client-side memo can't see across processes.
+
+    The one semantic the identity memo trades away: mutating state a
+    callable CLOSES OVER (cell contents, ``__defaults__``) and
+    re-registering the same object returns the originally-serialized
+    bytes — the memo keys on object identity, not captured state (a
+    per-call deep content probe would cost what the memo saves). Code
+    that mutates-and-re-registers should pass a fresh callable (def/
+    lambda re-evaluation gives one) — the same discipline dill's own
+    snapshot-at-serialize behavior already demands between submits.
+    """
+
+    _CAP = 1024
+
+    def __init__(self) -> None:
+        self._payloads: dict[int, tuple[weakref.ref, str]] = {}
+        self._registered: dict[str, str] = {}
+
+    def serialize_fn(self, fn: Callable) -> str:
+        entry = self._payloads.get(id(fn))
+        if entry is not None:
+            ref, payload = entry
+            if ref() is fn:
+                return payload
+            del self._payloads[id(fn)]  # id recycled: stale entry
+        payload = serialize(fn)
+        try:
+            ref = weakref.ref(fn)
+        except TypeError:
+            return payload  # not weakref-able: correct but unmemoized
+        while len(self._payloads) >= self._CAP:
+            self._payloads.pop(next(iter(self._payloads)))
+        self._payloads[id(fn)] = (ref, payload)
+        return payload
+
+    def function_id_for(self, payload: str) -> str | None:
+        return self._registered.get(payload_digest(payload))
+
+    def note_registered(self, payload: str, function_id: str) -> None:
+        while len(self._registered) >= self._CAP:
+            self._registered.pop(next(iter(self._registered)))
+        self._registered[payload_digest(payload)] = function_id
 
 
 class TaskFailedError(Exception):
@@ -163,6 +226,8 @@ class FaaSClient:
         self.base_url = base_url.rstrip("/")
         self.overload_retries = int(overload_retries)
         self.auto_idempotency = bool(auto_idempotency)
+        #: serialize()/register dedup (see _FnMemo)
+        self._memo = _FnMemo()
         self.http = requests.Session()
         # retry CONNECTION-establishment failures only (gateway restarting
         # behind a load balancer): nothing has reached the wire yet, so the
@@ -288,7 +353,18 @@ class FaaSClient:
 
     # -- ergonomic layer ---------------------------------------------------
     def register(self, fn: Callable, name: str | None = None) -> str:
-        return self.register_payload(name or fn.__name__, serialize(fn))
+        """Register ``fn``, deduplicated twice over: the serialize() of an
+        unchanged callable is memoized, and re-registering content this
+        client already registered returns the existing function_id with
+        no HTTP round trip at all (run()/map() in a loop stop paying a
+        registration per call)."""
+        payload = self._memo.serialize_fn(fn)
+        function_id = self._memo.function_id_for(payload)
+        if function_id is not None:
+            return function_id
+        function_id = self.register_payload(name or fn.__name__, payload)
+        self._memo.note_registered(payload, function_id)
+        return function_id
 
     def submit(self, function_id: str, *args: Any, **kwargs: Any) -> TaskHandle:
         payload = pack_params(*args, **kwargs)

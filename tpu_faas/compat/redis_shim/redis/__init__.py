@@ -30,6 +30,7 @@ from __future__ import annotations
 import os
 import select
 import socket
+import time
 
 
 class RedisError(Exception):
@@ -110,6 +111,38 @@ class _Resp2Connection:
             pass
 
 
+def _reply_span(buf: bytes, start: int = 0) -> int | None:
+    """Byte length of ONE complete RESP2 reply at ``start``, or None when
+    the buffer holds only a partial reply. Pure lookahead — consumes
+    nothing — so PubSub.get_message can prove a reply is whole BEFORE
+    read_reply's fills touch the socket (the non-blocking contract: a
+    partial reply must wait in the buffer, never block a recv)."""
+    end = buf.find(b"\r\n", start)
+    if end < 0:
+        return None
+    kind, line = buf[start:start + 1], buf[start + 1:end]
+    if kind in (b"+", b"-", b":"):
+        return end + 2 - start
+    if kind == b"$":
+        n = int(line)
+        if n == -1:
+            return end + 2 - start
+        total = end + 2 + n + 2
+        return total - start if len(buf) >= total else None
+    if kind == b"*":
+        n = int(line)
+        if n == -1:
+            return end + 2 - start
+        pos = end + 2
+        for _ in range(n):
+            span = _reply_span(buf, pos)
+            if span is None:
+                return None
+            pos += span
+        return pos - start
+    raise RedisError(f"malformed reply line: {buf[start:end]!r}")
+
+
 def _resolve(host: str, port: int) -> tuple[str, int]:
     return (
         os.environ.get("REDIS_SHIM_HOST", host),
@@ -141,14 +174,24 @@ class PubSub:
         Subscribe confirmations are consumed in ``subscribe`` itself, so
         every dict returned here has ``type == 'message'`` — a superset of
         what the reference's ``msg['type'] == 'message'`` guard accepts.
+
+        ``read_reply`` is entered only once ``_reply_span`` proves a
+        COMPLETE reply is buffered, every socket fill before that point is
+        select-guarded, and a reply still partial when ``timeout`` lapses
+        stays buffered for the next call — so the non-blocking contract
+        holds even when a large published payload arrives split across
+        TCP segments (the old fast-path check blocked inside read_reply's
+        fills on exactly that shape).
         """
         if self._conn is None:
             return None
-        # anything already buffered parses without touching the socket
-        if b"\r\n" not in self._conn._buf:
-            ready, _, _ = select.select([self._conn.sock], [], [], timeout)
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while _reply_span(self._conn._buf) is None:
+            remaining = max(0.0, deadline - time.monotonic())
+            ready, _, _ = select.select([self._conn.sock], [], [], remaining)
             if not ready:
-                return None
+                return None  # partial (or nothing) buffered: try later
+            self._conn._fill()
         item = self._conn.read_reply()
         if (
             isinstance(item, list)

@@ -20,10 +20,40 @@ from __future__ import annotations
 
 import signal
 import threading
+from collections import OrderedDict
 from typing import NamedTuple
 
 from tpu_faas.core.serialize import deserialize, serialize
 from tpu_faas.core.task import TaskStatus
+
+#: Child-side cache of DESERIALIZED functions keyed by content digest
+#: (core/payload.py sha256, carried on TASK messages as ``fn_digest``).
+#: Lives in the pool child's module globals — each forkserver child keeps
+#: its own — so steady-state execution of a repeated function pays ZERO
+#: dill decode: the decode cost moves from per-task to per-(child,
+#: function). Entry-bounded, not byte-bounded: the cached values are live
+#: Python callables whose footprint dill can't meaningfully size.
+_FN_CACHE_CAP = 64
+_FN_CACHE: OrderedDict[str, object] = OrderedDict()
+
+
+def _cached_fn(ser_fn: str, fn_digest: str | None):
+    """Deserialize ``ser_fn``, through the digest-keyed cache when the
+    caller supplied a digest. Trusting the digest (not re-hashing) is
+    deliberate: it came from the same content-addressed plane that
+    produced the payload, and hashing per task would give back a third of
+    the decode saving."""
+    if fn_digest is None:
+        return deserialize(ser_fn)
+    fn = _FN_CACHE.get(fn_digest)
+    if fn is None:
+        fn = deserialize(ser_fn)
+        _FN_CACHE[fn_digest] = fn
+        while len(_FN_CACHE) > _FN_CACHE_CAP:
+            _FN_CACHE.popitem(last=False)
+    else:
+        _FN_CACHE.move_to_end(fn_digest)
+    return fn
 
 
 class ExecutionResult(NamedTuple):
@@ -77,6 +107,7 @@ def execute_fn(
     ser_fn: str,
     ser_params: str,
     timeout: float | None = None,
+    fn_digest: str | None = None,
 ) -> ExecutionResult:
     """Execute one task; never raises.
 
@@ -98,7 +129,7 @@ def execute_fn(
     t0_wall = time.time()
     t0 = time.perf_counter()
     try:
-        res = _execute_guarded(task_id, ser_fn, ser_params, timeout)
+        res = _execute_guarded(task_id, ser_fn, ser_params, timeout, fn_digest)
     except TaskTimeout as exc:
         # the alarm landed in the narrow window between an exception being
         # caught and the timer disarm: still a clean FAILED, never a raise
@@ -123,7 +154,11 @@ def execute_fn(
 
 
 def _execute_guarded(
-    task_id: str, ser_fn: str, ser_params: str, timeout: float | None
+    task_id: str,
+    ser_fn: str,
+    ser_params: str,
+    timeout: float | None,
+    fn_digest: str | None = None,
 ) -> ExecutionResult:
     timer_armed = False
     try:
@@ -145,7 +180,7 @@ def _execute_guarded(
                     signal.ITIMER_REAL, min(timeout, _MAX_TIMEOUT_S)
                 )
                 timer_armed = True
-        fn = deserialize(ser_fn)
+        fn = _cached_fn(ser_fn, fn_digest)
         params = deserialize(ser_params)
         args, kwargs = params  # contract: (args_tuple, kwargs_dict)
         result = fn(*args, **kwargs)

@@ -82,6 +82,17 @@ FIELD_TIMEOUT = "timeout"  # float as str; execution budget enforced in-child
 #: the wire (not the relative TTL) so the decision survives dispatcher
 #: restarts and re-announces without re-deriving the submit time.
 FIELD_DEADLINE = "deadline"
+#: Content address (sha256 hex, core/payload.py) of the task's serialized
+#: function, written by a payload-plane gateway in place of an inline
+#: FIELD_FN body: the bytes live ONCE under the store's ``blob:<digest>``
+#: key and every consumer (dispatcher blob cache, worker payload cache)
+#: resolves them by digest. A record carrying this field may carry an
+#: EMPTY FIELD_FN; legacy records (and every record from a
+#: reference-style producer) carry the inline body and no digest —
+#: dispatch falls back per record, so the two populations mix freely on
+#: one store.
+FIELD_FN_DIGEST = "fn_digest"
+
 #: Written by finish_task alongside every terminal write (epoch seconds as
 #: str) — lets the gateway's optional result-TTL sweeper age out consumed
 #: records without a per-task client DELETE.

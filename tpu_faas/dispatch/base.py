@@ -29,11 +29,13 @@ from collections import Counter, deque
 from dataclasses import dataclass
 
 from tpu_faas.admission.signal import CapacitySnapshot, publish_snapshot
+from tpu_faas.core.payload import PayloadLRU
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import (
     FIELD_COST,
     FIELD_DEADLINE,
     FIELD_FN,
+    FIELD_FN_DIGEST,
     FIELD_LEASE_AT,
     FIELD_PARAMS,
     FIELD_PRIORITY,
@@ -66,11 +68,21 @@ STORE_OUTAGE_ERRORS = (ConnectionError, TimeoutError)
 #: BUT the result (see TaskDispatcher.fetch_reclaim).
 RECLAIM_FIELDS = [
     FIELD_FN,
+    FIELD_FN_DIGEST,
     FIELD_PARAMS,
     FIELD_PRIORITY,
     FIELD_COST,
     FIELD_TIMEOUT,
 ]
+
+
+def _has_payloads(fields: dict[str, str]) -> bool:
+    """A record is dispatchable when it carries params AND a function in
+    EITHER form — the inline body (legacy/reference producers) or the
+    payload plane's content digest (body lives once under blob:<digest>)."""
+    if FIELD_PARAMS not in fields:
+        return False
+    return FIELD_FN in fields or FIELD_FN_DIGEST in fields
 
 
 def _parse_positive_finite(raw: str | None) -> float | None:
@@ -91,6 +103,13 @@ class PendingTask:
     task_id: str
     fn_payload: str
     param_payload: str
+    #: content address of the serialized function (payload plane): a
+    #: digest-carrying task may arrive with an EMPTY fn_payload — the body
+    #: lives once in the store's blob namespace, and the dispatcher
+    #: materializes it (TaskDispatcher.ensure_inline_payload) only for
+    #: hops that can't resolve digests themselves (legacy workers, local
+    #: execution). Blob-capable workers get the digest alone.
+    fn_digest: str | None = None
     #: how many times this task has been reclaimed from a dead worker and
     #: re-queued (poison-task guard: a task that keeps killing its workers is
     #: FAILED after ``max_task_retries`` reclaims instead of cycling forever)
@@ -118,14 +137,27 @@ class PendingTask:
     #: QUEUED-only by protocol.
     deadline_at: float | None = None
 
-    def task_message_kwargs(self) -> dict:
+    def task_message_kwargs(self, blob: bool = False) -> dict:
         """The TASK wire message's payload fields (timeout rides along so
-        the WORKER can enforce it; priority/cost are dispatcher-side only)."""
+        the WORKER can enforce it; priority/cost are dispatcher-side only).
+
+        ``blob=True`` (the worker negotiated CAP_BLOB and the task carries
+        a digest): ship the digest INSTEAD of the body — the worker
+        resolves it from its payload cache or asks with BLOB_MISS. On the
+        inline path the digest still rides along when known, keying the
+        worker's child-side decode cache; legacy workers ignore the
+        unknown field. Inline callers must have materialized
+        ``fn_payload`` first (ensure_inline_payload)."""
         out = {
             "task_id": self.task_id,
-            "fn_payload": self.fn_payload,
             "param_payload": self.param_payload,
         }
+        if blob and self.fn_digest:
+            out["fn_digest"] = self.fn_digest
+        else:
+            out["fn_payload"] = self.fn_payload
+            if self.fn_digest:
+                out["fn_digest"] = self.fn_digest
         if self.timeout is not None:
             out["timeout"] = self.timeout
         return out
@@ -171,6 +203,7 @@ class PendingTask:
             task_id,
             fields.get(FIELD_FN, ""),
             fields.get(FIELD_PARAMS, ""),
+            fn_digest=fields.get(FIELD_FN_DIGEST) or None,
             retries=retries,
             priority=priority,
             cost=cost,
@@ -287,6 +320,27 @@ class TaskDispatcher:
             "tpu_faas_dispatcher_tasks_reclaimed_total",
             "In-flight tasks reclaimed from dead workers and re-queued",
         )
+        # -- payload plane (content-addressed function bodies) ------------
+        self.m_blob_hits = self.metrics.counter(
+            "tpu_faas_dispatcher_blob_cache_hits_total",
+            "Digest resolutions served from the dispatcher's blob cache",
+        )
+        self.m_blob_misses = self.metrics.counter(
+            "tpu_faas_dispatcher_blob_cache_misses_total",
+            "Digest resolutions that had to fetch the blob from the store",
+        )
+        self.m_blob_fills = self.metrics.counter(
+            "tpu_faas_dispatcher_blob_fills_total",
+            "BLOB_FILL messages served to workers (payload-cache misses "
+            "on their side)",
+        )
+        self.m_payload_bytes = self.metrics.counter(
+            "tpu_faas_dispatcher_payload_bytes_sent_total",
+            "Payload bytes (function body + params) put on the worker "
+            "wire by TASK messages; digest-shipped tasks count only their "
+            "params — the spread vs tasks_dispatched_total IS the "
+            "payload plane's wire saving",
+        )
         self.m_queue_depth = self.metrics.gauge(
             "tpu_faas_dispatcher_pending_tasks",
             "Tasks held in the dispatcher's pending structures",
@@ -395,6 +449,11 @@ class TaskDispatcher:
         self._cap_published_at: float | None = None
         self._cap_results_at_publish = 0
         self._drain_rate = 0.0
+        #: digest -> payload body, byte-bounded LRU: the dispatcher's
+        #: resolution cache for the payload plane. One function repeated
+        #: across a burst fetches its blob from the store ONCE, however
+        #: many legacy workers (or BLOB_MISS rounds) need the body inline.
+        self.blob_cache = PayloadLRU(self.BLOB_CACHE_BYTES)
         #: per-sender cumulative misfire-repair counters, as reported on
         #: RESULT messages (worker/pool.py n_misfires): a misfired cancel
         #: interrupt re-executes a bystander task whose side effects may
@@ -402,6 +461,61 @@ class TaskDispatcher:
         #: system — so the count must be operator-visible in /stats, not
         #: buried in a worker-side log line
         self.worker_misfires: dict[object, int] = {}
+
+    #: blob-cache budget (bytes of cached payload bodies); class attr so
+    #: tests and specialized deployments can tighten it
+    BLOB_CACHE_BYTES = 256 * 1024 * 1024
+
+    # -- payload plane -----------------------------------------------------
+    def blob_lookup(self, digest: str) -> str | None:
+        """Resolve a content digest to its payload body: dispatcher cache
+        first, then ONE store fetch (cached for every later resolution of
+        the same digest). Returns None when the blob is gone from the
+        store too (GC'd, or a foreign producer wrote a dangling digest);
+        raises on a store outage — callers apply their usual parking."""
+        cached = self.blob_cache.get(digest)
+        if cached is not None:
+            self.m_blob_hits.inc()
+            return cached
+        self.m_blob_misses.inc()
+        data = self.store.get_blob(digest)  # raises on outage
+        if data is not None:
+            self.blob_cache.put(digest, data)
+        return data
+
+    def ensure_inline_payload(self, task: PendingTask) -> bool:
+        """Materialize ``task.fn_payload`` for a hop that needs the body
+        inline (legacy worker, local pool, reference-era consumer). False
+        means the blob has vanished and the task was FAILed here — there
+        is nothing executable to send, and leaving it pending would park
+        it forever. Raises on a store outage with the task untouched."""
+        if task.fn_payload or not task.fn_digest:
+            return True
+        data = self.blob_lookup(task.fn_digest)
+        if data is None:
+            self.log.error(
+                "task %s references blob %s, which is gone from the "
+                "store; FAILING it",
+                task.task_id,
+                task.fn_digest[:16],
+                extra=log_ctx(task_id=task.task_id),
+            )
+            self.fail_task(
+                task.task_id,
+                f"function blob {task.fn_digest[:16]}... missing from the "
+                "store (GC'd or never written)",
+            )
+            return False
+        task.fn_payload = data
+        return True
+
+    def note_payload_sent(self, task: PendingTask, blob: bool) -> None:
+        """Count the payload bytes one TASK message put on the wire (the
+        digest form ships ~64 bytes of digest instead of the body)."""
+        n = len(task.param_payload)
+        if not (blob and task.fn_digest):
+            n += len(task.fn_payload)
+        self.m_payload_bytes.inc(n)
 
     #: max worker messages decoded per serve-loop round (push-family
     #: ROUTER drains): a worker flooding messages faster than they
@@ -705,7 +819,7 @@ class TaskDispatcher:
                 raise
             if from_backlog:
                 self._announce_backlog.popleft()
-            if FIELD_FN not in fields or FIELD_PARAMS not in fields:
+            if not _has_payloads(fields):
                 self.log.warning("announce for unknown task %s; skipping", msg)
                 continue
             if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
@@ -810,7 +924,7 @@ class TaskDispatcher:
             raise
         out: list[PendingTask] = []
         for msg, fields in zip(unique, records):
-            if FIELD_FN not in fields or FIELD_PARAMS not in fields:
+            if not _has_payloads(fields):
                 self.log.warning("announce for unknown task %s; skipping", msg)
                 continue
             if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
@@ -1168,6 +1282,12 @@ class TaskDispatcher:
             "expired": self.n_expired,
             "drain_rate": round(self._drain_rate, 3),
             "worker_misfires": sum(self.worker_misfires.values()),
+            "blob_cache": {
+                "entries": len(self.blob_cache),
+                "bytes": self.blob_cache.n_bytes,
+                "hits": self.blob_cache.hits,
+                "misses": self.blob_cache.misses,
+            },
         }
 
     def collect_metrics(self) -> None:
@@ -1336,7 +1456,7 @@ class TaskDispatcher:
         nothing to re-dispatch."""
         vals = self.store.hmget(task_id, RECLAIM_FIELDS)
         fields = {f: v for f, v in zip(RECLAIM_FIELDS, vals) if v is not None}
-        if FIELD_FN not in fields or FIELD_PARAMS not in fields:
+        if not _has_payloads(fields):
             return None
         return PendingTask.from_fields(task_id, fields, retries=retries)
 

@@ -95,6 +95,21 @@ class LocalDispatcher(TaskDispatcher):
                         self.note_store_outage(exc, pause=0)
                         self._suspect.add(task.task_id)
                         suspect = True
+                    # payload plane: a digest-carrying task materializes
+                    # its body through the dispatcher blob cache (one
+                    # store fetch per unique function) before hitting the
+                    # pool; the digest rides into the pool so children
+                    # skip the per-task dill decode too
+                    try:
+                        if not self.ensure_inline_payload(task):
+                            continue  # blob vanished: task FAILed in place
+                    except STORE_OUTAGE_ERRORS as exc:
+                        # the announce is spent — park in the base's
+                        # unclaimed buffer, which poll_next_claimed serves
+                        # first once the store is back
+                        self.note_store_outage(exc, pause=0)
+                        self._unclaimed.append(task)
+                        break
                     if not suspect:
                         # a suspect task gets NO RUNNING mark: the store may
                         # recover between the failed verification read and
@@ -109,6 +124,7 @@ class LocalDispatcher(TaskDispatcher):
                         task.fn_payload,
                         task.param_payload,
                         task.timeout,
+                        fn_digest=task.fn_digest,
                     )
                     self._running.add(task.task_id)
                     progressed = True

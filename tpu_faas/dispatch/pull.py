@@ -70,6 +70,9 @@ class PullDispatcher(TaskDispatcher):
         self.time_to_expire = time_to_expire
         self.max_task_retries = max_task_retries
         self.workers: set[str] = set()
+        #: worker_id -> negotiated capabilities (REGISTER ``caps``); empty
+        #: (reference-era pull workers) keeps the inline ASCII contract
+        self.worker_caps: dict[str, frozenset[str]] = {}
         #: liveness: every request stamps its sender (demand IS the
         #: heartbeat in pull mode — a healthy worker polls constantly)
         self.last_seen: dict[str, float] = {}
@@ -144,6 +147,7 @@ class PullDispatcher(TaskDispatcher):
                     self.task_retries.pop(task_id, None)
             self.worker_tasks.pop(wid, None)
             self.last_seen.pop(wid, None)
+            self.worker_caps.pop(wid, None)
             self.workers.discard(wid)
 
     def _next_task(self) -> PendingTask | None:
@@ -245,7 +249,37 @@ class PullDispatcher(TaskDispatcher):
                     self.last_seen[wid] = self.clock()
                 if msg_type == m.REGISTER:
                     self.workers.add(wid or "?")
+                    caps = m.caps_of(data)
+                    if wid is not None and caps:
+                        self.worker_caps[wid] = caps
                     self.log.info("pull worker registered: %s", data)
+                elif msg_type == m.BLOB_MISS:
+                    # the mandatory reply IS the fill: resolve from the
+                    # blob cache/store; an outage replies an EMPTY fill
+                    # (no data, no missing) — "retry later" — because the
+                    # REP socket must answer every request regardless
+                    digest = data.get("digest")
+                    fill: dict = {"digest": digest}
+                    if isinstance(digest, str) and digest:
+                        try:
+                            payload = self.blob_lookup(digest)
+                        except STORE_OUTAGE_ERRORS as exc:
+                            self.note_store_outage(exc, pause=0)
+                        else:
+                            if payload is None:
+                                fill["missing"] = True
+                            else:
+                                self.m_blob_fills.inc()
+                                fill["data"] = payload
+                    self.socket.send(
+                        m.encode_for(
+                            m.CAP_BIN
+                            in self.worker_caps.get(wid or "", frozenset()),
+                            m.BLOB_FILL,
+                            **fill,
+                        )
+                    )
+                    continue
                 elif msg_type == m.RESULT:
                     task_id = data["task_id"]
                     self.note_worker_misfires(wid, data)
@@ -289,6 +323,27 @@ class PullDispatcher(TaskDispatcher):
                     except STORE_OUTAGE_ERRORS as exc:
                         self.note_store_outage(exc, pause=0)
                         task = None
+                caps = (
+                    self.worker_caps.get(wid, frozenset())
+                    if wid is not None
+                    else frozenset()
+                )
+                blob = (
+                    task is not None
+                    and m.CAP_BLOB in caps
+                    and task.fn_digest is not None
+                )
+                if task is not None and not blob:
+                    # legacy hop: materialize the body; an outage parks
+                    # the task back at the requeue head (its announce is
+                    # spent) and the mandatory reply degrades to WAIT
+                    try:
+                        if not self.ensure_inline_payload(task):
+                            task = None  # blob vanished: FAILed in place
+                    except STORE_OUTAGE_ERRORS as exc:
+                        self.note_store_outage(exc, pause=0)
+                        self.requeued.appendleft(task)
+                        task = None
                 kill_ids = self._kills_for(wid)
                 extra = {"cancel_ids": kill_ids} if kill_ids else {}
                 if task is not None:
@@ -304,14 +359,18 @@ class PullDispatcher(TaskDispatcher):
                             task.task_id
                         )
                     self.socket.send(
-                        m.encode(
-                            m.TASK, **task.task_message_kwargs(), **extra
+                        m.encode_for(
+                            m.CAP_BIN in caps,
+                            m.TASK,
+                            **task.task_message_kwargs(blob=blob),
+                            **extra,
                         )
                     )
+                    self.note_payload_sent(task, blob)
                     self.traces.note(task.task_id, "sent")
                     self.m_dispatched.inc()
                 else:
-                    self.socket.send(m.encode(m.WAIT, **extra))
+                    self.socket.send(m.encode_for(m.CAP_BIN in caps, m.WAIT, **extra))
                 if max_results is not None and n_results >= max_results:
                     break
         finally:

@@ -58,6 +58,9 @@ class WorkerRecord:
     #: prior reclaim count per in-flight task (nonzero only for tasks that
     #: already survived a worker death) — consulted by the poison guard
     inflight_retries: dict[str, int] = field(default_factory=dict)
+    #: negotiated protocol capabilities (REGISTER/RECONNECT ``caps``):
+    #: empty for reference-era workers — full inline ASCII contract
+    caps: frozenset[str] = frozenset()
 
     def is_alive(self, now: float, time_to_expire: float) -> bool:
         return (now - self.last_heartbeat) <= time_to_expire
@@ -192,6 +195,7 @@ class PushDispatcher(TaskDispatcher):
                 num_processes=int(data["num_processes"]),
                 free_processes=int(data["num_processes"]),
                 last_heartbeat=now,
+                caps=m.caps_of(data),
             )
             self._refresh_fleet_procs()
             self._remove_free(wid)
@@ -261,9 +265,15 @@ class PushDispatcher(TaskDispatcher):
                     self.free_procs.append(wid)
                 else:
                     self._add_free(wid)
+        elif msg_type == m.BLOB_MISS:
+            # payload-plane resolution request (blob-capable workers only)
+            self._serve_blob_miss(wid, rec, data)
         elif msg_type == m.RECONNECT:
             # zombie rejoining: trust its reported current capacity and put
             # it at the LRU front (reference :360-367)
+            caps = m.caps_of(data)
+            if caps:
+                rec.caps = caps
             rec.free_processes = int(data.get("free_processes", 0))
             rec.num_processes = max(rec.num_processes, rec.free_processes)
             self._refresh_fleet_procs()
@@ -275,6 +285,29 @@ class PushDispatcher(TaskDispatcher):
 
     def _send(self, wid: bytes, payload: bytes) -> None:
         self.socket.send_multipart([wid, payload])
+
+    def _serve_blob_miss(self, wid: bytes, rec: WorkerRecord, data: dict) -> None:
+        """Answer a worker's payload-cache miss (same contract as
+        tpu_push's: outage drops the request — the worker re-asks on its
+        parked-task timer; a definitively-gone blob is ``missing=True``)."""
+        digest = data.get("digest")
+        if not isinstance(digest, str) or not digest:
+            return
+        try:
+            payload = self.blob_lookup(digest)
+        except STORE_OUTAGE_ERRORS as exc:
+            self.note_store_outage(exc, pause=0)
+            return
+        bin_cap = m.CAP_BIN in rec.caps
+        if payload is None:
+            self._send(
+                wid, m.encode_for(bin_cap, m.BLOB_FILL, digest=digest, missing=True)
+            )
+            return
+        self.m_blob_fills.inc()
+        self._send(
+            wid, m.encode_for(bin_cap, m.BLOB_FILL, digest=digest, data=payload)
+        )
 
     # -- purge + re-dispatch (the recovery the reference lacks) ------------
     def purge_workers(self) -> list[bytes]:
@@ -383,10 +416,38 @@ class PushDispatcher(TaskDispatcher):
                     self._add_free(wid, front=True)
                 break
             rec = self.workers[wid]
+            blob = m.CAP_BLOB in rec.caps and task.fn_digest is not None
+            if not blob:
+                # legacy hop: materialize the body before any bookkeeping
+                try:
+                    inline_ok = self.ensure_inline_payload(task)
+                except STORE_OUTAGE_ERRORS:
+                    # park the task (its announce is spent) and restore
+                    # the picked worker before surfacing the outage
+                    self.requeue.appendleft(task)
+                    if self.process_lb:
+                        self.free_procs.append(wid)
+                    else:
+                        self._add_free(wid, front=True)
+                    raise
+                if not inline_ok:
+                    # blob vanished: task FAILed in place; worker returns
+                    # to rotation and the round moves on
+                    if self.process_lb:
+                        self.free_procs.append(wid)
+                    else:
+                        self._add_free(wid, front=True)
+                    continue
             self.traces.note(task.task_id, "scheduled")
             self._send(
-                wid, m.encode(m.TASK, **task.task_message_kwargs())
+                wid,
+                m.encode_for(
+                    m.CAP_BIN in rec.caps,
+                    m.TASK,
+                    **task.task_message_kwargs(blob=blob),
+                ),
             )
+            self.note_payload_sent(task, blob)
             self.traces.note(task.task_id, "sent")
             self.mark_running_safe(
                 task.task_id,

@@ -100,6 +100,11 @@ class TpuPushDispatcher(TaskDispatcher):
         #: reference-era workers fall back to the socket identity, whose
         #: grade stays ephemeral (dropped on purge — never seen again).
         self._wid_token: dict[bytes, str] = {}
+        #: socket identity -> negotiated protocol capabilities (REGISTER/
+        #: RECONNECT `caps`): CAP_BLOB gets digest-shipped TASKs +
+        #: BLOB_MISS service, CAP_BIN gets binary frames. Reference-era
+        #: workers advertise nothing and keep the inline ASCII contract.
+        self._wid_caps: dict[bytes, frozenset[str]] = {}
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.ROUTER)
         if port == 0:
@@ -513,7 +518,10 @@ class TpuPushDispatcher(TaskDispatcher):
         est = self.estimator
         if est is None:
             return
-        d = fn_digest(task.fn_payload)
+        # digest-carrying tasks key estimation off their content address
+        # (the body may not be materialized host-side at all); inline
+        # tasks keep the historical blake2b identity
+        d = task.fn_digest or fn_digest(task.fn_payload)
         pd = fn_digest(task.param_payload)
         pbytes = len(task.param_payload)
         self._task_digest[task.task_id] = (d, pd, pbytes)
@@ -536,6 +544,11 @@ class TpuPushDispatcher(TaskDispatcher):
             self._wid_token[wid] = token
             if data.get("ephemeral") and self.estimator is not None:
                 self.estimator.note_ephemeral(token)
+        # capability negotiation rides the same messages: absent (reference
+        # workers) leaves the inline ASCII contract in force for this peer
+        caps = m.caps_of(data)
+        if caps:
+            self._wid_caps[wid] = caps
 
     def _apply_learned_speed(self, wid: bytes, row: int) -> None:
         """Registration/reconnect re-applies the learned speed the plain
@@ -571,6 +584,40 @@ class TpuPushDispatcher(TaskDispatcher):
             self.arrays.worker_speed[row] = new_speed
 
     # -- worker messages ---------------------------------------------------
+    def _send_worker(self, wid: bytes, msg_type: str, **kw) -> None:
+        """Send one message framed per the peer's negotiated capabilities
+        (binary for CAP_BIN workers, the reference ASCII contract else)."""
+        self.socket.send_multipart(
+            [
+                wid,
+                m.encode_for(
+                    m.CAP_BIN in self._wid_caps.get(wid, frozenset()),
+                    msg_type,
+                    **kw,
+                ),
+            ]
+        )
+
+    def _serve_blob_miss(self, wid: bytes, data: dict) -> None:
+        """Answer a worker's payload-cache miss with the blob body (cache
+        -> store). A store outage silently drops the request — the worker
+        re-sends its MISS on a timer while tasks stay parked; a blob gone
+        from the store too is answered ``missing=True`` so the worker
+        FAILs the parked tasks instead of waiting forever."""
+        digest = data.get("digest")
+        if not isinstance(digest, str) or not digest:
+            return
+        try:
+            payload = self.blob_lookup(digest)
+        except STORE_OUTAGE_ERRORS as exc:
+            self.note_store_outage(exc, pause=0)
+            return
+        if payload is None:
+            self._send_worker(wid, m.BLOB_FILL, digest=digest, missing=True)
+            return
+        self.m_blob_fills.inc()
+        self._send_worker(wid, m.BLOB_FILL, digest=digest, data=payload)
+
     def _handle(self, wid: bytes, msg_type: str, data: dict) -> None:
         a = self.arrays
         if msg_type == m.REGISTER:
@@ -628,6 +675,10 @@ class TpuPushDispatcher(TaskDispatcher):
                     self._observe_result(wid, row, task_id, data)
             else:
                 self._task_digest.pop(task_id, None)
+        elif msg_type == m.BLOB_MISS:
+            # payload-plane resolution request: any message is liveness
+            a.heartbeat(wid)
+            self._serve_blob_miss(wid, data)
         elif msg_type == m.HEARTBEAT:
             a.heartbeat(wid)
         elif msg_type == m.RECONNECT:
@@ -961,6 +1012,16 @@ class TpuPushDispatcher(TaskDispatcher):
                         self._forget_task_state(task.task_id)
                         restore_from = idx + 1
                         continue
+                    wid = a.row_ids[row]
+                    caps = self._wid_caps.get(wid, frozenset())
+                    blob = m.CAP_BLOB in caps and task.fn_digest is not None
+                    # legacy hop: materialize the body BEFORE any
+                    # bookkeeping (an outage raise here restores the whole
+                    # tail; a vanished blob FAILs the task in place)
+                    if not blob and not self.ensure_inline_payload(task):
+                        self._forget_task_state(task.task_id)
+                        restore_from = idx + 1
+                        continue
                     try:
                         # reserve tracking BEFORE sending: a task on the
                         # wire but absent from the inflight table could
@@ -971,10 +1032,17 @@ class TpuPushDispatcher(TaskDispatcher):
                         restore_from = idx + 1
                         continue
                     self.traces.note(task.task_id, "scheduled")
-                    wid = a.row_ids[row]
                     self.socket.send_multipart(
-                        [wid, m.encode(m.TASK, **task.task_message_kwargs())]
+                        [
+                            wid,
+                            m.encode_for(
+                                m.CAP_BIN in caps,
+                                m.TASK,
+                                **task.task_message_kwargs(blob=blob),
+                            ),
+                        ]
                     )
+                    self.note_payload_sent(task, blob)
                     self.traces.note(task.task_id, "sent")
                     # on the wire + tracked: must NOT be restored on an
                     # outage
@@ -1210,6 +1278,10 @@ class TpuPushDispatcher(TaskDispatcher):
             self.log.warning("purged worker row %d", int(row))
             wid_p = a.row_ids.get(int(row))
             a.deactivate(int(row))
+            if wid_p is not None:
+                # a purged socket identity is never seen again; a zombie
+                # that reconnects re-negotiates its caps on RECONNECT
+                self._wid_caps.pop(wid_p, None)
             if wid_p is not None and self.estimator is not None:
                 token = self._wid_token.pop(wid_p, None)
                 if token is None:
@@ -1320,16 +1392,41 @@ class TpuPushDispatcher(TaskDispatcher):
                             self._forget_task_state(task.task_id)
                             a.release_slot(row)
                             continue
+                    wid = a.row_ids[row]
+                    caps = self._wid_caps.get(wid, frozenset())
+                    blob = m.CAP_BLOB in caps and task.fn_digest is not None
+                    if not blob:
+                        try:
+                            inline_ok = self.ensure_inline_payload(task)
+                        except STORE_OUTAGE_ERRORS as exc:
+                            # same per-task degradation as the cancel
+                            # probe: the placement flows back
+                            self.note_store_outage(exc, pause=0)
+                            undo(task, row)
+                            continue
+                        if not inline_ok:
+                            # blob vanished: task FAILed in place; the
+                            # kernel-consumed slot returns to the pool
+                            self._forget_task_state(task.task_id)
+                            a.release_slot(row)
+                            continue
                     try:
                         a.inflight_add(task.task_id, row)
                     except RuntimeError:
                         undo(task, row)  # inflight table full: wait a tick
                         continue
                     self.traces.note(task.task_id, "scheduled")
-                    wid = a.row_ids[row]
                     self.socket.send_multipart(
-                        [wid, m.encode(m.TASK, **task.task_message_kwargs())]
+                        [
+                            wid,
+                            m.encode_for(
+                                m.CAP_BIN in caps,
+                                m.TASK,
+                                **task.task_message_kwargs(blob=blob),
+                            ),
+                        ]
                     )
+                    self.note_payload_sent(task, blob)
                     self.traces.note(task.task_id, "sent")
                     if task.retries:
                         # per-task on the re-dispatch path: the redispatch

@@ -74,10 +74,12 @@ from tpu_faas.admission import (
 )
 from tpu_faas.admission.breaker import OUTAGE_ERRORS
 from tpu_faas.admission.controller import AdmissionConfig
+from tpu_faas.core.payload import payload_digest
 from tpu_faas.core.task import (
     FIELD_COST,
     FIELD_DEADLINE,
     FIELD_FINISHED_AT,
+    FIELD_FN_DIGEST,
     FIELD_PARAMS,
     FIELD_PRIORITY,
     FIELD_STATUS,
@@ -90,6 +92,8 @@ from tpu_faas.core.task import (
 from tpu_faas.obs import REGISTRY, MetricsRegistry
 from tpu_faas.obs import metrics as obs_metrics
 from tpu_faas.store.base import (
+    BLOB_AT_FIELD,
+    BLOB_PREFIX,
     LIVE_INDEX_KEY,
     RESULTS_CHANNEL,
     TASKS_CHANNEL,
@@ -101,6 +105,13 @@ from tpu_faas.utils.logging import TickTracer, get_logger
 log = get_logger("gateway")
 
 _FUNCTION_PREFIX = "function:"
+#: Field on a function-registry hash holding the payload's content digest
+#: (payload plane); absent on records written by a pre-plane gateway.
+_FN_DIGEST_FIELD = "payload_digest"
+#: Content-digest -> function_id index hashes (one per digest, setnx'd):
+#: lets a repeated register_function of the SAME bytes dedup to the first
+#: function_id instead of writing the body again.
+_FN_INDEX_PREFIX = "function_digest:"
 #: Namespace for idempotency-key -> task-id derivation (uuid5). Any fixed
 #: UUID works; it just keys the hash.
 _IDEMPOTENCY_NS = uuid.UUID("2f1aa4f6-0d8e-4cf1-9e65-6d54e6f1c0aa")
@@ -271,6 +282,16 @@ class GatewayContext:
     #: store circuit breaker: store_call routes every handler-side store
     #: op through it; None disables fast-fail (calls hit the store raw)
     breaker: "CircuitBreaker | None" = None
+    #: content-addressed payload plane: when True, task records carry
+    #: FIELD_FN_DIGEST (body written ONCE under blob:<digest> at register
+    #: time) instead of an inline function body per task. OFF by default —
+    #: a reference-style dispatcher reading raw ``fn_payload`` hashes
+    #: (tests/test_reference_worker_interop.py's stretch leg) needs the
+    #: inline contract, and the store cannot negotiate with consumers that
+    #: advertise nothing; the operator opts in per deployment
+    #: (``--payload-plane``) once every dispatcher on the store is
+    #: payload-plane-aware.
+    payload_plane: bool = False
 
     def __post_init__(self) -> None:
         self.m_requests = self.metrics.counter(
@@ -335,6 +356,17 @@ class GatewayContext:
             "tpu_faas_gateway_store_breaker_open",
             "1 while the store circuit breaker is open or half-open "
             "(store calls fast-fail 503), else 0",
+        )
+        self.m_blob_written = self.metrics.counter(
+            "tpu_faas_gateway_blob_bytes_written_total",
+            "Payload bytes written into the blob namespace (first "
+            "registration of each distinct function body)",
+        )
+        self.m_blob_saved = self.metrics.counter(
+            "tpu_faas_gateway_blob_bytes_saved_total",
+            "Payload bytes NOT written thanks to content addressing: "
+            "inline bodies replaced by digests on task creates, plus "
+            "re-registrations of an already-stored body",
         )
         self.metrics.register_collector(self._collect)
         if self.tracer is None:
@@ -495,6 +527,47 @@ async def _metrics_middleware(request: web.Request, handler):
         ctx.tracer.record(name, time.perf_counter() - t0)
 
 
+def _sweep_stale_blobs(
+    store: TaskStore, all_keys: list[str], ttl: float, now_f: float
+) -> list[str]:
+    """The refcount-or-TTL GC of the blob namespace: a blob is collected
+    only when BOTH (a) its last-put stamp (BLOB_AT_FIELD, refreshed by
+    every registration of the same bytes) aged past 4x the result TTL —
+    slower than task records on purpose, a cache-refill costs more than a
+    stale record — AND (b) nothing references it anymore: no
+    function-registry record carries its digest and no LIVE task does.
+    The reference set is recomputed from the records at sweep time, so
+    there is no persistent counter to corrupt. Returns keys to delete."""
+    blob_keys = [k for k in all_keys if k.startswith(BLOB_PREFIX)]
+    if not blob_keys:
+        return []
+    blob_ttl = 4 * ttl
+    stamps = store.hget_many(blob_keys, BLOB_AT_FIELD)
+    stale = []
+    for key, stamp in zip(blob_keys, stamps):
+        try:
+            if stamp is not None and now_f - float(stamp) > blob_ttl:
+                stale.append(key)
+        except ValueError:
+            continue  # unparseable stamp: never collect
+    if not stale:
+        return []
+    referenced: set[str] = set()
+    fn_keys = [k for k in all_keys if k.startswith(_FUNCTION_PREFIX)]
+    if fn_keys:
+        for d in store.hget_many(fn_keys, _FN_DIGEST_FIELD):
+            if d:
+                referenced.add(d)
+    live_ids = list(store.hgetall(LIVE_INDEX_KEY))
+    if live_ids:
+        for d in store.hget_many(live_ids, FIELD_FN_DIGEST):
+            if d:
+                referenced.add(d)
+    return [
+        k for k in stale if k[len(BLOB_PREFIX):] not in referenced
+    ]
+
+
 def _sweep_expired_results(
     store: TaskStore, ttl: float, now: float | None = None
 ) -> int:
@@ -502,11 +575,22 @@ def _sweep_expired_results(
     FIELD_FINISHED_AT stamp). Returns records deleted. Pipelined status +
     stamp probes so the sweep stays one round trip per phase, not per key;
     live (QUEUED/RUNNING) tasks, unstamped records, and the function
-    registry are never touched."""
+    registry are never touched. Blob-namespace keys get their own
+    refcount-or-TTL policy (_sweep_stale_blobs) instead of the terminal
+    probe."""
     now_f = now if now is not None else time.time()
-    keys = [k for k in store.keys() if not k.startswith(_FUNCTION_PREFIX)]
+    all_keys = store.keys()
+    keys = [
+        k
+        for k in all_keys
+        if not k.startswith(_FUNCTION_PREFIX)
+        and not k.startswith(BLOB_PREFIX)
+        and not k.startswith(_FN_INDEX_PREFIX)
+    ]
+    blob_expired = _sweep_stale_blobs(store, all_keys, ttl, now_f)
     if not keys:
-        return 0
+        store.delete_many(blob_expired)
+        return len(blob_expired)
     statuses = store.hget_many(keys, FIELD_STATUS)
     terminal = []
     statusless = []
@@ -555,6 +639,7 @@ def _sweep_expired_results(
             expired.extend(
                 k for k, s in zip(stale_claims, recheck) if s is None
             )
+    expired.extend(blob_expired)
     store.delete_many(expired)  # one variadic DEL on RESP backends
     return len(expired)
 
@@ -566,11 +651,14 @@ def make_app(
     *,
     admission: "AdmissionController | None | bool" = True,
     breaker: "CircuitBreaker | None | bool" = True,
+    payload_plane: bool = False,
 ) -> web.Application:
     """``admission``/``breaker``: True builds the defaults (admission
     fails open until a dispatcher publishes the saturation signal or a
     bound is configured; the breaker trips after 3 consecutive outage
-    failures), False/None disables, or pass a configured instance."""
+    failures), False/None disables, or pass a configured instance.
+    ``payload_plane=True`` turns on content-addressed function shipping
+    (see GatewayContext.payload_plane for why it is opt-in)."""
     if admission is True:
         admission = AdmissionController()
     elif admission is False:
@@ -580,7 +668,11 @@ def make_app(
     elif breaker is False:
         breaker = None
     ctx = GatewayContext(
-        store=store, channel=channel, admission=admission, breaker=breaker
+        store=store,
+        channel=channel,
+        admission=admission,
+        breaker=breaker,
+        payload_plane=payload_plane,
     )
     app = web.Application(
         client_max_size=256 * 1024 * 1024, middlewares=[_metrics_middleware]
@@ -661,11 +753,67 @@ async def register_function(request: web.Request) -> web.Response:
         name, payload = body["name"], body["payload"]
     except Exception:
         return _json_error(400, "expected JSON body with 'name' and 'payload'")
+    if not ctx.payload_plane:
+        function_id = new_function_id()
+        await ctx.store_call(
+            ctx.store.hset,
+            _FUNCTION_PREFIX + function_id,
+            {"name": name, "payload": payload},
+        )
+        ctx.n_functions += 1
+        ctx.m_functions.inc()
+        return web.json_response({"function_id": function_id})
+    # payload plane: the body is content-addressed. Register-once dedup —
+    # the SAME bytes registered again (client retry, N replicas of one
+    # service each registering at boot) resolve to the FIRST function_id,
+    # writing nothing new. The digest index is claimed with setnx, so
+    # exactly one of N concurrent registrations creates; losers adopt the
+    # winner's id (the registry record may be a few ms behind the claim —
+    # same write-once adoption shape as the idempotent submit path).
+    digest = payload_digest(payload)
     function_id = new_function_id()
+    claimed, current = await ctx.store_call(
+        ctx.store.setnx_field,
+        _FN_INDEX_PREFIX + digest,
+        "function_id",
+        function_id,
+    )
+    if not claimed:
+        ctx.m_blob_saved.inc(len(payload))
+        # refresh the blob TTL stamp (put-if-absent: write-once data, new
+        # stamp) so an active function's body can't age out under it
+        await ctx.store_call(ctx.store.put_blob, digest, payload)
+        # adopt-and-repair: the claim winner may have died between its
+        # index setnx and its registry hset (store outage mid-register) —
+        # without this, the claimed id would 404 on every submit and the
+        # poisoned digest index would pin every future registration of
+        # these bytes to it forever. Safe to (re)write: same digest means
+        # byte-identical payload, so racing repairers and a slow winner
+        # all write the same record (name is last-writer, cosmetic).
+        existing = await ctx.store_call(
+            ctx.store.hget, _FUNCTION_PREFIX + current, "payload"
+        )
+        if existing is None:
+            await ctx.store_call(
+                ctx.store.hset,
+                _FUNCTION_PREFIX + current,
+                {"name": name, "payload": payload, _FN_DIGEST_FIELD: digest},
+            )
+        return web.json_response(
+            {"function_id": current, "deduplicated": True}
+        )
+    created = await ctx.store_call(ctx.store.put_blob, digest, payload)
+    if created:
+        ctx.m_blob_written.inc(len(payload))
+    else:
+        ctx.m_blob_saved.inc(len(payload))
     await ctx.store_call(
         ctx.store.hset,
         _FUNCTION_PREFIX + function_id,
-        {"name": name, "payload": payload},
+        # the inline payload stays on the (single) registry record: it is
+        # the restore source for legacy-mode submits and debugging; the
+        # per-task win is the digest below
+        {"name": name, "payload": payload, _FN_DIGEST_FIELD: digest},
     )
     ctx.n_functions += 1
     ctx.m_functions.inc()
@@ -770,14 +918,29 @@ async def execute_function(request: web.Request) -> web.Response:
     if decision is not None and not decision.admitted:
         return _admission_reject(ctx, decision, "submit")
     ctx.m_admitted.inc()
-    fn_payload = await ctx.store_call(
-        ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
+    fn_payload, fn_dig = await ctx.store_call(
+        ctx.store.hmget,
+        _FUNCTION_PREFIX + function_id,
+        ["payload", _FN_DIGEST_FIELD],
     )
     if fn_payload is None:
         return _json_error(404, f"unknown function_id {function_id!r}")
+    # payload plane: the record carries the digest, not the body — this
+    # single line is where a burst of N submits stops writing the function
+    # N times (the body already sits under blob:<digest>)
+    fn_body = fn_payload
+    blob_saved = 0
+    if ctx.payload_plane and fn_dig:
+        extra[FIELD_FN_DIGEST] = fn_dig
+        fn_body = ""
+        # counted only where a record is actually created (below) —
+        # idempotent duplicates and failed creates save nothing, and the
+        # batch path gates the same metric on its to_create set
+        blob_saved = len(fn_payload)
+
     def write_task(task_id: str) -> None:
         ctx.store.create_task(
-            task_id, fn_payload, param_payload, ctx.channel, extra or None
+            task_id, fn_body, param_payload, ctx.channel, extra or None
         )
 
     def write_task_nx(task_id: str) -> bool:
@@ -786,7 +949,7 @@ async def execute_function(request: web.Request) -> web.Response:
         # an already-dispatched copy would reset RUNNING back to QUEUED
         # and run the task twice
         return ctx.store.create_task_if_absent(
-            task_id, fn_payload, param_payload, ctx.channel, extra or None
+            task_id, fn_body, param_payload, ctx.channel, extra or None
         )
 
     if idem_key is not None:
@@ -839,6 +1002,8 @@ async def execute_function(request: web.Request) -> web.Response:
                 if await ctx.store_call(write_task_nx, task_id):
                     ctx.n_tasks += 1
                     ctx.m_tasks.inc()
+                    if blob_saved:
+                        ctx.m_blob_saved.inc(blob_saved)
             elif (
                 await ctx.store_call(ctx.store.hget, task_id, FIELD_STATUS)
                 is None
@@ -858,12 +1023,16 @@ async def execute_function(request: web.Request) -> web.Response:
         await ctx.store_call(write_task_nx, task_id)
         ctx.n_tasks += 1
         ctx.m_tasks.inc()
+        if blob_saved:
+            ctx.m_blob_saved.inc(blob_saved)
         return web.json_response({"task_id": task_id})
 
     task_id = new_task_id()
     await ctx.store_call(write_task, task_id)
     ctx.n_tasks += 1
     ctx.m_tasks.inc()
+    if blob_saved:
+        ctx.m_blob_saved.inc(blob_saved)
     return web.json_response({"task_id": task_id})
 
 
@@ -963,11 +1132,20 @@ async def execute_batch(request: web.Request) -> web.Response:
     if decision is not None and not decision.admitted:
         return _admission_reject(ctx, decision, "batch", n=len(payloads))
     ctx.m_admitted.inc(len(payloads))
-    fn_payload = await ctx.store_call(
-        ctx.store.hget, _FUNCTION_PREFIX + function_id, "payload"
+    fn_payload, fn_dig = await ctx.store_call(
+        ctx.store.hmget,
+        _FUNCTION_PREFIX + function_id,
+        ["payload", _FN_DIGEST_FIELD],
     )
     if fn_payload is None:
         return _json_error(404, f"unknown function_id {function_id!r}")
+    # payload plane: every record of the batch carries the digest instead
+    # of the inline body (see execute_function)
+    fn_body = fn_payload
+    if ctx.payload_plane and fn_dig:
+        for e in extras:
+            e[FIELD_FN_DIGEST] = fn_dig
+        fn_body = ""
 
     task_ids: list[str] = []
     dedup: list[bool] = [False] * len(payloads)
@@ -1063,7 +1241,7 @@ async def execute_batch(request: web.Request) -> web.Response:
         if idem_keys is None:
             ctx.store.create_tasks(
                 [
-                    (task_ids[i], fn_payload, payloads[i], extras[i] or None)
+                    (task_ids[i], fn_body, payloads[i], extras[i] or None)
                     for i in to_create
                 ],
                 ctx.channel,
@@ -1077,13 +1255,13 @@ async def execute_batch(request: web.Request) -> web.Response:
         if unkeyed:
             ctx.store.create_tasks(
                 [
-                    (task_ids[i], fn_payload, payloads[i], extras[i] or None)
+                    (task_ids[i], fn_body, payloads[i], extras[i] or None)
                     for i in unkeyed
                 ],
                 ctx.channel,
             )
         keyed_items = [
-            (task_ids[i], fn_payload, payloads[i], extras[i] or None)
+            (task_ids[i], fn_body, payloads[i], extras[i] or None)
             for i in to_create
             if idem_keys[i] is not None
         ]
@@ -1091,6 +1269,8 @@ async def execute_batch(request: web.Request) -> web.Response:
             ctx.store.create_tasks_if_absent(keyed_items, ctx.channel)
 
     await ctx.store_call(write_tasks)
+    if fn_body == "" and fn_payload and to_create:
+        ctx.m_blob_saved.inc(len(fn_payload) * len(to_create))
     ctx.n_tasks += len(to_create)
     ctx.m_tasks.inc(len(to_create))
     resp = {"task_ids": task_ids}
@@ -1325,6 +1505,7 @@ async def stats(request: web.Request) -> web.Response:
             # already-cancelled without an extra read; call-count is the
             # honest cheap metric)
             "cancel_calls": ctx.n_cancelled,
+            "payload_plane": ctx.payload_plane,
             "store_ok": store_ok,
             "requests": {
                 name: {
@@ -1370,6 +1551,7 @@ def start_gateway_thread(
     result_ttl: float | None = None,
     admission: "AdmissionController | None | bool" = True,
     breaker: "CircuitBreaker | None | bool" = True,
+    payload_plane: bool = False,
 ) -> GatewayHandle:
     """Serve the gateway in a daemon thread; returns once the port is bound."""
     started = threading.Event()
@@ -1389,6 +1571,7 @@ def start_gateway_thread(
                     result_ttl,
                     admission=admission,
                     breaker=breaker,
+                    payload_plane=payload_plane,
                 )
             )
             await runner.setup()
@@ -1445,6 +1628,14 @@ def main(argv: list[str] | None = None) -> None:
         help="disable the admission controller AND the store circuit "
         "breaker (the pre-overload-hardening behavior)",
     )
+    ap.add_argument(
+        "--payload-plane", action="store_true",
+        help="content-addressed function shipping: task records carry a "
+        "digest (body written once under blob:<sha256>) instead of an "
+        "inline copy per task. Requires every dispatcher on this store "
+        "to be payload-plane-aware; leave off while reference-style "
+        "dispatchers read the store",
+    )
     ns = ap.parse_args(argv)
     store = make_store(ns.store)
     if ns.no_admission:
@@ -1471,6 +1662,7 @@ def main(argv: list[str] | None = None) -> None:
             result_ttl=ns.result_ttl,
             admission=admission,
             breaker=breaker,
+            payload_plane=ns.payload_plane,
         ),
         host=ns.host,
         port=ns.port,

@@ -78,6 +78,13 @@ _PARAM_CAP = 50_000
 #: AND when the byte feature actually varies (log1p-space variance)
 _REG_MIN_SAMPLES = 8
 _REG_MIN_VAR = 1e-3
+#: fallback gate for the grading reference (ADVICE r5): when the byte
+#: regression DECLINES (constant-byte workload — var_x under _REG_MIN_VAR)
+#: but the function's observed RUNTIME spread is small (log-space variance
+#: of size observations under this bound, ~ +/-35% at one sigma), the
+#: fn-level EWMA is representative of every parameterization and worker
+#: speed learning degrades to it instead of stopping dead
+_REG_MAX_Y_VAR = 0.1
 #: predictions are clamped to this factor around the fn-level EWMA: a
 #: regression extrapolating far outside everything observed is noise
 _REG_CLAMP = 64.0
@@ -129,7 +136,10 @@ class RuntimeEstimator:
         self._fn_est: dict[str, float] = {}
         self._fn_count: dict[str, int] = {}
         #: per-fn online regression sums over (x=log1p(param_bytes),
-        #: y=log(size)): [n, sx, sy, sxx, sxy]
+        #: y=log(size)): [n, sx, sy, sxx, sxy, syy]. The 6th term (syy)
+        #: powers the runtime-spread fallback gate; records persisted by
+        #: pre-r6 builds lack it and load with the -1.0 "unknown" sentinel,
+        #: which keeps the fallback conservatively off until re-learned.
         self._fn_reg: dict[str, list[float]] = {}
         #: exact-param estimates, keyed "fn_digest:param_digest"
         self._param_est: dict[str, float] = {}
@@ -169,9 +179,11 @@ class RuntimeEstimator:
                 self._fn_count[key] = count
             if len(parts) >= 7:
                 try:
-                    reg = [float(p) for p in parts[2:7]]
+                    reg = [float(p) for p in parts[2:8]]
                 except ValueError:
                     continue
+                if len(reg) < 6:
+                    reg.append(-1.0)  # legacy record: spread unknown
                 if reg[0] > 0:
                     self._fn_reg[key] = reg
         for token, raw in speed_fields.items():
@@ -266,13 +278,29 @@ class RuntimeEstimator:
                 )
         return fn_level
 
+    def _runtime_spread_small(self, digest: str) -> bool:
+        """True when this function's observed size observations cluster
+        tightly (log-space variance under _REG_MAX_Y_VAR over at least the
+        regression-sample floor): its fn-level EWMA then represents every
+        parameterization well enough to grade workers against. False on
+        too few samples, or on legacy persisted records whose accumulator
+        predates the syy term (sentinel -1.0)."""
+        reg = self._fn_reg.get(digest)
+        if reg is None or len(reg) < 6:
+            return False
+        n, _sx, sy, _sxx, _sxy, syy = reg
+        if n < _REG_MIN_SAMPLES or syy < 0:
+            return False
+        var_y = syy / n - (sy / n) ** 2
+        return var_y < _REG_MAX_Y_VAR
+
     def _predict_from_bytes(
         self, digest: str, param_bytes: int
     ) -> float | None:
         reg = self._fn_reg.get(digest)
         if reg is None:
             return None
-        n, sx, sy, sxx, sxy = reg
+        n, sx, sy, sxx, sxy = reg[:5]
         if n < _REG_MIN_SAMPLES:
             return None
         var_x = sxx / n - (sx / n) ** 2
@@ -343,12 +371,19 @@ class RuntimeEstimator:
             y = math.log(size_obs)
             reg = self._fn_reg.get(digest)
             if reg is None:
-                reg = self._fn_reg[digest] = [0.0] * 5
+                reg = self._fn_reg[digest] = [0.0] * 6
+            elif len(reg) < 6 or reg[5] < 0:
+                # legacy accumulator (pre-syy record): restart it whole —
+                # mixing old counts with a fresh syy would fabricate a
+                # too-small variance, and re-learning the fit costs only
+                # _REG_MIN_SAMPLES observations
+                reg = self._fn_reg[digest] = [0.0] * 6
             reg[0] += 1.0
             reg[1] += x
             reg[2] += y
             reg[3] += x * x
             reg[4] += x * y
+            reg[5] += y * y
 
         # level 1: exact-param EWMA
         prev_param = None
@@ -385,7 +420,24 @@ class RuntimeEstimator:
         elif param_digest is not None:
             ref = reg_ref  # pre-update fit, see above
             if ref is None or ref <= 0:
-                return
+                # the byte regression declined (constant-byte workload, or
+                # not enough samples yet). When this function's runtime
+                # spread is demonstrably SMALL, the fn-level prev is a
+                # faithful reference for any parameterization — fall back
+                # to it so speed learning degrades instead of stopping
+                # (ADVICE r5: the old unconditional return left whole
+                # constant-byte workloads grading no workers at all). A
+                # genuinely mixed-runtime function keeps the return: its
+                # fn-level mean would mis-grade every worker that happens
+                # to draw small (or large) params.
+                if (
+                    prev is not None
+                    and count >= self.speed_min_samples
+                    and self._runtime_spread_small(digest)
+                ):
+                    ref = prev
+                else:
+                    return
         elif prev is not None and count >= self.speed_min_samples:
             ref = prev
         else:

@@ -480,16 +480,22 @@ class ResidentScheduler(SchedulerArrays):
             return
         T, W = self.max_pending, self.max_workers
         hb = self._hb_rel()
+        # live fleet mirrors are uploaded as COPIES: device_put can
+        # materialize lazily (async dispatch), and every one of these
+        # arrays is mutated in place by membership/result events between
+        # ticks — an un-copied upload lets a later host mutation leak into
+        # the first tick's view (the load-dependent over-booking the
+        # overbook test pins). hb is already a fresh temporary.
         self._r_state = _ResidentState(
             self._put_task(np.zeros(T, dtype=np.float32)),
             self._put_task(np.zeros(T, dtype=bool)),
             self._put_task(np.zeros(T, dtype=np.int32)),
             self._put_repl(hb),
-            self._put_repl(self.worker_free),
-            self._put_repl(self.inflight_worker),
-            self._put_repl(self.prev_live),
-            self._put_repl(self.worker_speed),
-            self._put_repl(self.worker_active),
+            self._put_repl(self.worker_free.copy()),
+            self._put_repl(self.inflight_worker.copy()),
+            self._put_repl(np.asarray(self.prev_live).copy()),
+            self._put_repl(self.worker_speed.copy()),
+            self._put_repl(self.worker_active.copy()),
             # auction carry: prices start at zero with refresh=True, so
             # the first tick opens from the analytic dual seed (the cold
             # start IS a warm start from analytic prices)

@@ -421,7 +421,14 @@ class SchedulerArrays:
             or len(self._inflight_delta) > self.max_inflight // 2
         ):
             self._inflight_delta.clear()
-            self._d_inflight = jnp.asarray(self.inflight_worker)
+            # SNAPSHOT the live table: device_put can materialize lazily
+            # (async dispatch), and an in-place host mutation landing
+            # before the enqueued consumer runs would otherwise leak into
+            # a tick that already decided against it — the load-dependent
+            # over-booking tests/test_sched_resident.py::
+            # test_result_arrival_between_tick_and_resolve_cannot_overbook
+            # reproduces
+            self._d_inflight = jnp.asarray(self.inflight_worker.copy())
         elif self._inflight_delta:
             slots = np.fromiter(
                 self._inflight_delta.keys(), np.int32,
@@ -454,11 +461,17 @@ class SchedulerArrays:
         entry = self._dev_cache.get(name)
         if entry is not None and np.array_equal(entry[0], host):
             return entry[1]
+        # upload the SNAPSHOT, not the live array: the transfer can
+        # materialize lazily under async dispatch, and `host` is a mirror
+        # call sites mutate in place right after the tick returns — an
+        # un-copied upload would let that mutation time-travel into the
+        # enqueued kernel (the overbook flake's mechanism)
+        snap = host.copy()
         if sharding is None:
-            dev = jnp.asarray(host)
+            dev = jnp.asarray(snap)
         else:
-            dev = jax.device_put(host, sharding)
-        self._dev_cache[name] = (host.copy(), dev)
+            dev = jax.device_put(snap, sharding)
+        self._dev_cache[name] = (snap, dev)
         return dev
 
     # -- the tick ----------------------------------------------------------
@@ -588,7 +601,10 @@ class SchedulerArrays:
         ts_d = jax.device_put(ts, task_sh)
         prio_d = None if prio is None else jax.device_put(prio, task_sh)
         hb = jax.device_put(hb_age, repl)
-        wf = jax.device_put(self.worker_free, repl)
+        # .copy(): worker_free is mutated in place by the act loop the
+        # moment tick() returns; a lazily-materialized upload of the live
+        # array would read the post-mutation values (see _cached_dev)
+        wf = jax.device_put(self.worker_free.copy(), repl)
         ws = self._cached_dev("speed@mesh", self.worker_speed, repl)
         wa = self._cached_dev("active@mesh", self.worker_active, repl)
         # the delta-maintained single-device mirror is the source of truth;

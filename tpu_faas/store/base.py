@@ -72,6 +72,30 @@ LEASE_CONF_KEY = "fleet:lease_conf"
 #: fallback re-read, never rely on delivery.
 RESULTS_CHANNEL = "results"
 
+#: Content-addressed payload namespace: one hash per payload body, keyed
+#: ``blob:<sha256>`` (core/payload.py payload_digest). Write-once by
+#: protocol — the digest IS the content, so a second writer of the same
+#: key by definition carries identical bytes, and put_blob claims the data
+#: field with setnx so even a buggy second writer cannot mutate it (the
+#: race monitor flags any bypass, store/racecheck.py). Values keep the
+#: ASCII payload contract: the RESP wire and every reference-style
+#: consumer of this store are string-typed surfaces.
+BLOB_PREFIX = "blob:"
+#: the payload body field of a blob hash
+BLOB_DATA_FIELD = "data"
+#: epoch-seconds stamp of the blob's last put ATTEMPT (a dedup hit
+#: refreshes it): the TTL half of refcount-or-TTL GC — the gateway's
+#: sweeper only collects blobs whose stamp has aged out AND that no
+#: function-registry record or live task still references (the refcount
+#: half, recomputed from the referencing records at sweep time so there
+#: is no counter to corrupt).
+BLOB_AT_FIELD = "blob_at"
+
+
+def blob_key(digest: str) -> str:
+    return BLOB_PREFIX + digest
+
+
 #: Control message on the TASKS announce channel: "<prefix><task_id>" tells
 #: dispatchers to drop the task from any pending structure they hold (the
 #: gateway publishes it only AFTER it actually wrote CANCELLED). Plain
@@ -397,6 +421,33 @@ class TaskStore(abc.ABC):
         primitive: one round fetches every announced task's record instead
         of one hgetall per announce."""
         return [self.hgetall(k) for k in keys]
+
+    # -- content-addressed blobs ------------------------------------------
+    def put_blob(self, digest: str, data: str) -> bool:
+        """Put-if-absent write of a payload body under its content address.
+
+        The data field is CLAIMED with setnx — write-once, the create-once
+        protocol the race monitor enforces — and the TTL stamp is
+        refreshed on every attempt (a dedup hit means the content is hot;
+        the GC must not age it out under active producers). Returns True
+        when this call created the blob. Two round trips on the loop
+        default; the RESP client pipelines one."""
+        key = blob_key(digest)
+        created, _ = self.setnx_field(key, BLOB_DATA_FIELD, data)
+        self.hset(key, {BLOB_AT_FIELD: repr(time.time())})
+        return created
+
+    def get_blob(self, digest: str) -> str | None:
+        """The payload body for ``digest``, or None when the blob was never
+        written (or was GC'd). Read-only: resolution must not perturb the
+        TTL stamp — pinning is the referencing records' job."""
+        return self.hget(blob_key(digest), BLOB_DATA_FIELD)
+
+    def get_blobs(self, digests: list[str]) -> list[str | None]:
+        """Pipelined multi-get of payload bodies (one round trip on RESP
+        backends) — the dispatcher's warm-up path for a mixed batch of
+        digests resolves them all at once."""
+        return self.hget_many([blob_key(d) for d in digests], BLOB_DATA_FIELD)
 
     def create_tasks(
         self,

@@ -21,20 +21,33 @@ from typing import Mapping
 from tpu_faas.obs import REGISTRY
 from tpu_faas.store import resp
 from tpu_faas.store.base import (
+    BLOB_AT_FIELD,
+    BLOB_DATA_FIELD,
     LIVE_INDEX_KEY,
     RESULTS_CHANNEL,
     TASKS_CHANNEL,
     Subscription,
     TaskStore,
+    blob_key,
 )
 
 #: Process-wide round-trip counter, one series per store role: the scrape
-#: analog of each handle's ``n_round_trips`` (one pipelined batch = one).
+#: analog of each handle's ``n_round_trips`` (one pipelined batch = 1).
 #: A per-handle instance counter can't be scraped after the handle dies;
 #: the registry series is the durable process total.
 _ROUND_TRIPS_TOTAL = REGISTRY.counter(
     "tpu_faas_store_round_trips_total",
     "Store wire round trips paid by this process (pipelined batch = 1)",
+    ("backend",),
+)
+#: Command bytes put on the store wire by this process — the payload
+#: plane's primary win is measured here (a digest task record is ~100
+#: bytes where the inline form carried the whole function body), so the
+#: bench lane and operators need it as a first-class series, not a
+#: tcpdump session.
+_BYTES_SENT_TOTAL = REGISTRY.counter(
+    "tpu_faas_store_bytes_sent_total",
+    "Encoded command bytes sent to the store by this process",
     ("backend",),
 )
 
@@ -53,8 +66,10 @@ class _Conn:
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.parser = resp.RespParser()
 
-    def send(self, *parts: str | bytes | int) -> None:
-        self.sock.sendall(resp.encode_command(*parts))
+    def send(self, *parts: str | bytes | int) -> int:
+        data = resp.encode_command(*parts)
+        self.sock.sendall(data)
+        return len(data)
 
     def recv_reply(self):
         while True:
@@ -68,12 +83,12 @@ class _Conn:
                 raise ConnectionError("store connection closed")
             self.parser.feed(data)
 
-    def send_many(self, commands) -> None:
+    def send_many(self, commands) -> int:
         """RESP pipelining: every command in one write; replies follow in
-        order."""
-        self.sock.sendall(
-            b"".join(resp.encode_command(*c) for c in commands)
-        )
+        order. Returns bytes written."""
+        data = b"".join(resp.encode_command(*c) for c in commands)
+        self.sock.sendall(data)
+        return len(data)
 
     def command(self, *parts: str | bytes | int):
         self.send(*parts)
@@ -190,7 +205,12 @@ class RespStore(TaskStore):
         #: lock; read lock-free by stats pollers (a torn read of an int is
         #: impossible in CPython, and the counter is observability only).
         self.n_round_trips = 0
+        #: command bytes this handle put on the wire (same lock-free read
+        #: contract as n_round_trips) — the bench lane's bytes-per-task
+        #: measurement is a delta over this
+        self.n_bytes_sent = 0
         self._rt_series = _ROUND_TRIPS_TOTAL.labels(backend="resp")
+        self._bytes_series = _BYTES_SENT_TOTAL.labels(backend="resp")
 
     def _command(self, *parts: str | bytes | int):
         """Run one command; transparently reconnect once if the server
@@ -228,7 +248,10 @@ class RespStore(TaskStore):
                 # use of the one connection (RESP replies are positional)
                 self.n_round_trips += 1
                 self._rt_series.inc()
-                return self._conn.command(*parts)  # faas: allow(locks.blocking-call-under-lock)
+                sent = self._conn.send(*parts)  # faas: allow(locks.blocking-call-under-lock)
+                self.n_bytes_sent += sent
+                self._bytes_series.inc(sent)
+                return self._conn.recv_reply()  # faas: allow(locks.blocking-call-under-lock)
             except (ConnectionError, TimeoutError):
                 # TimeoutError too: the reply may still arrive later, so the
                 # old connection is DESYNCHRONIZED (a future command would
@@ -243,7 +266,10 @@ class RespStore(TaskStore):
                 # same serialized-connection justification as above
                 self.n_round_trips += 1
                 self._rt_series.inc()  # the retry is a second round trip
-                return conn.command(*parts)  # faas: allow(locks.blocking-call-under-lock)
+                sent = conn.send(*parts)  # faas: allow(locks.blocking-call-under-lock)
+                self.n_bytes_sent += sent
+                self._bytes_series.inc(sent)
+                return conn.recv_reply()  # faas: allow(locks.blocking-call-under-lock)
 
     def pipeline(self, commands: list[tuple]) -> list:
         """Run many commands over one round trip (RESP pipelining) and
@@ -267,7 +293,9 @@ class RespStore(TaskStore):
                 # positional replies — interleaved pipelines would desync
                 self.n_round_trips += 1
                 self._rt_series.inc()  # N commands, one round trip
-                conn.send_many(commands)  # faas: allow(locks.blocking-call-under-lock)
+                sent = conn.send_many(commands)  # faas: allow(locks.blocking-call-under-lock)
+                self.n_bytes_sent += sent
+                self._bytes_series.inc(sent)
                 out: list = []
                 for _ in commands:
                     try:
@@ -497,6 +525,22 @@ class RespStore(TaskStore):
         errors = [r for r in replies if isinstance(r, resp.RespError)]
         if errors:
             raise errors[0]
+
+    def put_blob(self, digest: str, data: str) -> bool:
+        """Base semantics (setnx'd data + TTL-stamp refresh) in ONE
+        pipelined round trip — the gateway pays this on every function
+        registration, not per task."""
+        key = blob_key(digest)
+        replies = self.pipeline(
+            [
+                ("HSETNX", key, BLOB_DATA_FIELD, data),
+                ("HSET", key, BLOB_AT_FIELD, repr(time.time())),
+            ]
+        )
+        errors = [r for r in replies if isinstance(r, resp.RespError)]
+        if errors:
+            raise errors[0]
+        return replies[0] == 1
 
     def create_tasks(self, tasks, channel: str = TASKS_CHANNEL) -> None:
         from tpu_faas.core.task import (

@@ -34,8 +34,11 @@ from dataclasses import dataclass, field
 from typing import Iterable, Mapping
 
 from tpu_faas.admission.signal import FLEET_HEALTH_KEY
+from tpu_faas.core.payload import payload_digest
 from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS, TaskStatus
 from tpu_faas.store.base import (
+    BLOB_DATA_FIELD,
+    BLOB_PREFIX,
     LIVE_INDEX_KEY,
     TASKS_CHANNEL,
     Subscription,
@@ -161,9 +164,49 @@ class RaceMonitor:
         self._lock = threading.Lock()
         self._seq = itertools.count()
         self._tasks: dict[str, _TaskState] = {}
+        #: blob namespace state: digest -> sha256 fingerprint of the FIRST
+        #: observed data write (fingerprint, not the bytes: payloads can
+        #: be multi-MB and the monitor must stay cheap)
+        self._blobs: dict[str, str] = {}
         self._strict = strict
         self.events: deque[Event] = deque(maxlen=max_events)
         self.violations: list[Violation] = []
+
+    # -- blob namespace (payload plane) ------------------------------------
+    def observe_blob_write(self, actor: str, key: str, data: str) -> None:
+        """Validate a write touching a blob's data field. Two invariants,
+        both errors when broken:
+
+        - ``blob-digest-mismatch`` — the bytes do not hash to the key's
+          digest: a consumer resolving this digest would execute the
+          wrong function (content addressing's one load-bearing promise);
+        - ``blob-overwrite`` — a second data write carries DIFFERENT
+          bytes than the first: blobs are create-once, and put_blob's
+          setnx makes this impossible through the API — seeing it means
+          some writer bypassed it.
+        """
+        digest = key[len(BLOB_PREFIX):]
+        fp = payload_digest(data)
+        with self._lock:
+            if fp != digest:
+                self._flag(
+                    "blob-digest-mismatch",
+                    "error",
+                    key,
+                    f"{actor} wrote bytes hashing to {fp[:16]}... under "
+                    f"digest {digest[:16]}...: resolvers of this digest "
+                    f"would run the wrong function",
+                )
+            prev = self._blobs.setdefault(digest, fp)
+            if prev != fp:
+                self._flag(
+                    "blob-overwrite",
+                    "error",
+                    key,
+                    f"{actor} rewrote blob {digest[:16]}... with "
+                    f"different bytes (blobs are create-once; put_blob's "
+                    f"setnx was bypassed)",
+                )
 
     # -- declarations ------------------------------------------------------
     def expect_force_cancel(self, task_id: str) -> None:
@@ -232,6 +275,7 @@ class RaceMonitor:
                 Event(next(self._seq), time.time(), actor, "flush", "*", None, None)
             )
             self._tasks.clear()
+            self._blobs.clear()
 
     # -- queries -----------------------------------------------------------
     @property
@@ -425,6 +469,16 @@ class RaceCheckStore(TaskStore):
             # mistake for task fields
             self.inner.hset(key, fields)
             return
+        if key.startswith(BLOB_PREFIX):
+            # blob namespace, not a task record: data-field writes get the
+            # create-once/content check; stamp-only writes (BLOB_AT_FIELD
+            # refresh) are bookkeeping
+            if BLOB_DATA_FIELD in fields:
+                self.monitor.observe_blob_write(
+                    self.actor, key, fields[BLOB_DATA_FIELD]
+                )
+            self.inner.hset(key, fields)
+            return
         op = "finish" if FIELD_RESULT in fields else "status"
         if FIELD_STATUS in fields and fields[FIELD_STATUS] == str(
             TaskStatus.QUEUED
@@ -495,6 +549,14 @@ class RaceCheckStore(TaskStore):
             self.monitor.observe(
                 self.actor, "create", key, {FIELD_STATUS: value}
             )
+        elif (
+            created
+            and field == BLOB_DATA_FIELD
+            and key.startswith(BLOB_PREFIX)
+        ):
+            # put_blob's winning claim IS the blob's create: validate the
+            # content against the digest (losers write nothing)
+            self.monitor.observe_blob_write(self.actor, key, value)
         return created, current
 
     def setnx_fields(self, items, field: str):

@@ -28,6 +28,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import hashlib
 import math
 import os
 import signal
@@ -39,6 +40,18 @@ import time
 from tpu_faas.utils.logging import get_logger
 
 log = get_logger("worker.deploy")
+
+
+def fleet_id(dispatcher_url: str) -> str:
+    """Short stable id of the fleet a supervisor serves, derived from its
+    dispatcher URL. Namespaces the durable worker tokens: two supervisors
+    on ONE host serving DIFFERENT dispatchers used to mint identical
+    hostname/slot tokens, merging their workers' speed grades in the
+    estimator (ADVICE r5) — a machine can be fast for one fleet's
+    workload and slow for another's."""
+    return hashlib.blake2b(
+        dispatcher_url.encode("utf-8", "replace"), digest_size=4
+    ).hexdigest()
 
 
 class WorkerFleet:
@@ -88,11 +101,13 @@ class WorkerFleet:
             # was the worker's OR the whole supervisor's — re-registers
             # under the SAME token, so the estimator's learned speed for
             # this machine slot survives (sched/estimator.py worker
-            # grades) instead of relearning from the 1.0 prior
+            # grades) instead of relearning from the 1.0 prior. The fleet
+            # id (hash of the dispatcher URL) keeps two supervisors on one
+            # host from minting colliding tokens and merging grades.
             cmd += [
                 "--token",
-                f"{_socket.gethostname()}-{self.protocol}"
-                f"{self.num_processes}-slot{slot}",
+                f"{_socket.gethostname()}-{fleet_id(self.dispatcher_url)}"
+                f"-{self.protocol}{self.num_processes}-slot{slot}",
             ]
         else:
             cmd += ["--delay", str(self.delay)]

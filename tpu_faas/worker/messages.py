@@ -9,6 +9,11 @@ Message vocabulary:
 
 worker -> dispatcher:
     REGISTER   data: worker_id (pull) | num_processes (push)
+               [, caps: list[str] — protocol capabilities this worker
+               understands: "blob" (digest-addressed TASK payloads +
+               BLOB_MISS/BLOB_FILL resolution), "bin" (binary frames).
+               Absent from reference-era workers, which therefore get the
+               full inline-payload ASCII contract unchanged]
     RESULT     data: task_id, status, result [, elapsed: float — execution
                wall seconds measured in the pool child, feeding the
                dispatcher's runtime estimator; absent from reference-era
@@ -24,6 +29,17 @@ worker -> dispatcher:
     RECONNECT  (push hb) data: free_processes
     DEREGISTER (push) data: {} — graceful drain: stop assigning to me; my
                in-flight results still follow, then I exit
+
+worker -> dispatcher (payload plane, "blob"-capable workers only):
+    BLOB_MISS  data: digest [, worker_id — pull workers include it: their
+               liveness is request-stamped, and a blob-fetch retry loop
+               may be the only traffic they emit during an outage] — the
+               worker holds tasks whose TASK message carried ``fn_digest``
+               with no body and its payload cache missed; the dispatcher
+               answers with BLOB_FILL. Push workers re-send on a timer
+               while tasks stay parked (a FILL, like everything on this
+               transport, can be lost); pull workers retry in place on
+               their mandatory-reply socket.
 
 dispatcher -> worker:
     TASK       data: task_id, fn_payload, param_payload [, timeout: float —
@@ -43,9 +59,29 @@ dispatcher -> worker:
                effort by design — reference-era workers ignore unknown
                message types and fields, and the record then converges
                via the ordinary result path.
+    TASK (payload plane) may carry ``fn_digest`` INSTEAD of
+               ``fn_payload`` when the worker registered the "blob"
+               capability: the worker resolves the body from its payload
+               cache, or parks the task and asks with BLOB_MISS.
+    BLOB_FILL  data: digest, data (the ASCII payload body) — answers a
+               BLOB_MISS; ``missing=True`` (no data) when the blob is
+               gone from the store too, telling the worker to FAIL the
+               parked tasks instead of waiting forever.
+
+Framing: the reference contract is ASCII — base64(dill(message)) — and
+stays the default. Peers that BOTH understand the "bin" capability switch
+to raw binary frames (``_BIN_MAGIC`` + dill bytes, no base64: ~25% less
+wire volume on every payload-carrying hop). Negotiation is asymmetric on
+purpose: a worker advertises ``caps=["bin"]`` on its (always-ASCII)
+REGISTER/RECONNECT, the dispatcher then frames everything to that worker
+in binary, and the worker switches its own sends only after RECEIVING a
+binary frame — proof the peer decodes them. ``decode`` sniffs the magic,
+so mixed fleets (reference workers beside new ones) share one socket.
 """
 
 from __future__ import annotations
+
+import dill
 
 from tpu_faas.core.serialize import deserialize, serialize
 
@@ -58,14 +94,58 @@ RECONNECT = "reconnect"
 TASK = "task"
 WAIT = "wait"
 CANCEL = "cancel"
+BLOB_MISS = "blob_miss"
+BLOB_FILL = "blob_fill"
+
+#: capability tokens carried in REGISTER/RECONNECT ``caps``
+CAP_BLOB = "blob"
+CAP_BIN = "bin"
+#: what a current-generation worker advertises
+WORKER_CAPS = (CAP_BLOB, CAP_BIN)
+
+#: binary-frame magic: never a valid first byte of the ASCII contract
+#: (base64's alphabet is [A-Za-z0-9+/=]), so one-byte sniffing is exact
+_BIN_MAGIC = b"\x00TF1"
 
 
 def encode(msg_type: str, **data: object) -> bytes:
+    """The reference ASCII contract: base64(dill({type, data}))."""
     return serialize({"type": msg_type, "data": data}).encode("ascii")
 
 
+def encode_bin(msg_type: str, **data: object) -> bytes:
+    """Binary frame: magic + raw dill bytes — skips the ~33% base64
+    inflation on internal hops. Send only to peers that negotiated
+    CAP_BIN (see the module docstring)."""
+    return _BIN_MAGIC + dill.dumps({"type": msg_type, "data": data})
+
+
+def encode_for(bin_capable: bool, msg_type: str, **data: object) -> bytes:
+    """Frame for a specific peer: binary when negotiated, ASCII else."""
+    if bin_capable:
+        return encode_bin(msg_type, **data)
+    return encode(msg_type, **data)
+
+
+def is_binary(raw: bytes) -> bool:
+    return raw.startswith(_BIN_MAGIC)
+
+
 def decode(raw: bytes) -> tuple[str, dict]:
-    msg = deserialize(raw.decode("ascii"))
+    """Decode either framing (magic-sniffed)."""
+    if raw.startswith(_BIN_MAGIC):
+        msg = dill.loads(raw[len(_BIN_MAGIC):])
+    else:
+        msg = deserialize(raw.decode("ascii"))
     if not isinstance(msg, dict) or "type" not in msg:
         raise ValueError(f"malformed worker message: {msg!r}")
     return msg["type"], msg.get("data", {})
+
+
+def caps_of(data: dict) -> frozenset[str]:
+    """The capability set a REGISTER/RECONNECT payload advertises;
+    empty for reference-era workers (and anything malformed)."""
+    raw = data.get("caps")
+    if not isinstance(raw, (list, tuple)):
+        return frozenset()
+    return frozenset(c for c in raw if isinstance(c, str))

@@ -59,6 +59,18 @@ _MISFIRES_TOTAL = REGISTRY.counter(
     "by resubmission (at-least-once executions)",
 )
 
+#: Parent-side payload-cache counters, shared by both worker kinds (they
+#: both import the pool): the operator-visible proof that steady state
+#: ships digests, not bodies.
+FN_CACHE_HITS = REGISTRY.counter(
+    "tpu_faas_worker_fn_cache_hits_total",
+    "Digest-shipped TASKs resolved from the worker's payload cache",
+)
+FN_CACHE_MISSES = REGISTRY.counter(
+    "tpu_faas_worker_fn_cache_misses_total",
+    "Digest-shipped TASKs that needed a BLOB_MISS/BLOB_FILL round",
+)
+
 #: child-side: the task id currently executing in THIS child (None between
 #: tasks) — consulted by the SIGUSR1 handler, plain memory only (a signal
 #: handler must never do IPC)
@@ -91,7 +103,11 @@ def _child_init(events) -> None:
 
 
 def _run_reported(
-    task_id: str, ser_fn: str, ser_params: str, timeout: float | None
+    task_id: str,
+    ser_fn: str,
+    ser_params: str,
+    timeout: float | None,
+    fn_digest: str | None = None,
 ) -> ExecutionResult:
     """execute_fn wrapped with start/end reporting + the cancel window.
 
@@ -115,7 +131,7 @@ def _run_reported(
                 _EVENTS.put(("start", task_id, os.getpid()))
             # interrupts DURING the call are handled inside execute_fn
             # itself (its except clauses return a CANCELLED result)
-            res = execute_fn(task_id, ser_fn, ser_params, timeout)
+            res = execute_fn(task_id, ser_fn, ser_params, timeout, fn_digest)
         except TaskCancelledInterrupt as exc:
             if res is None:
                 # landed before execute_fn produced anything: a pre-start
@@ -161,7 +177,7 @@ class TaskPool:
         #: the submitted payloads (so a misfired interrupt can resubmit),
         #: and which tasks a cancel was actually requested for
         self._futures: dict[str, Future] = {}
-        self._args: dict[str, tuple[str, str, float | None]] = {}
+        self._args: dict[str, tuple[str, str, float | None, str | None]] = {}
         self._want_cancel: set[str] = set()
         #: cancels for tasks sitting in the executor's CALL QUEUE (future
         #: no longer .cancel()-able, child not started): the interrupt is
@@ -265,20 +281,26 @@ class TaskPool:
         fn_payload: str,
         param_payload: str,
         timeout: float | None = None,
+        fn_digest: str | None = None,
     ) -> None:
+        """``fn_digest`` (payload plane): content digest of ``fn_payload``,
+        keying the child-side deserialized-function cache so a repeated
+        function pays dill decode once per child, not once per task."""
         try:
             fut = self._executor.submit(
-                _run_reported, task_id, fn_payload, param_payload, timeout
+                _run_reported, task_id, fn_payload, param_payload, timeout,
+                fn_digest,
             )
         except BrokenProcessPool:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = self._make()
             fut = self._executor.submit(
-                _run_reported, task_id, fn_payload, param_payload, timeout
+                _run_reported, task_id, fn_payload, param_payload, timeout,
+                fn_digest,
             )
         fut.add_done_callback(lambda f, tid=task_id: self._done.put((tid, f)))
         self._futures[task_id] = fut
-        self._args[task_id] = (fn_payload, param_payload, timeout)
+        self._args[task_id] = (fn_payload, param_payload, timeout, fn_digest)
         self._busy += 1
 
     def drain(self) -> list[ExecutionResult]:

@@ -21,9 +21,12 @@ import uuid
 
 import zmq
 
+from tpu_faas.core.payload import PayloadLRU
+from tpu_faas.core.serialize import serialize
+from tpu_faas.core.task import TaskStatus
 from tpu_faas.utils.logging import get_logger
 from tpu_faas.worker import messages as m
-from tpu_faas.worker.pool import TaskPool
+from tpu_faas.worker.pool import FN_CACHE_HITS, FN_CACHE_MISSES, TaskPool
 
 log = get_logger("pull_worker")
 
@@ -36,6 +39,8 @@ class PullWorker:
         delay: float = 0.01,
         recv_timeout_ms: int = 10_000,
         keepalive_period: float = 1.0,
+        caps: tuple[str, ...] = m.WORKER_CAPS,
+        fn_cache_bytes: int = 256 * 1024 * 1024,
     ) -> None:
         self.worker_id = str(uuid.uuid4())
         #: max silence while saturated before sending a WAIT-bound keepalive
@@ -43,6 +48,14 @@ class PullWorker:
         self.keepalive_period = keepalive_period
         self.num_processes = num_processes
         self.delay = delay
+        #: payload-plane capabilities advertised on REGISTER; () = pure
+        #: reference contract
+        self.caps: tuple[str, ...] = tuple(caps)
+        #: digest -> serialized body (parent-side codec cache; REQ/REP
+        #: resolves misses synchronously with a BLOB_MISS transaction)
+        self.fn_cache = PayloadLRU(fn_cache_bytes)
+        #: True after the dispatcher's first binary reply — sends switch
+        self._peer_bin = False
         self.pool = TaskPool(num_processes)
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.REQ)
@@ -66,14 +79,17 @@ class PullWorker:
         self._draining = True
 
     # -- one REQ/REP transaction ------------------------------------------
-    def _transact(self, msg_type: str, **data: object) -> None:
+    def _transact(self, msg_type: str, **data: object) -> tuple[str, dict]:
         """Send one message, receive the mandatory reply, and if the reply
         carries a task, put it on the pool. Force-cancels ride the reply
         too (``cancel_ids``): a pull worker cannot be pushed to, so the
         dispatcher piggy-backs kill requests for tasks THIS worker runs on
-        whatever reply goes out next — TASK or WAIT."""
-        self.socket.send(m.encode(msg_type, **data))
-        reply_type, reply = m.decode(self.socket.recv())
+        whatever reply goes out next — TASK or WAIT. Returns the reply."""
+        self.socket.send(m.encode_for(self._peer_bin, msg_type, **data))
+        raw = self.socket.recv()
+        if not self._peer_bin and m.is_binary(raw):
+            self._peer_bin = True  # binary negotiation complete
+        reply_type, reply = m.decode(raw)
         for tid in reply.get("cancel_ids", ()):
             if self.pool.cancel(tid):
                 log.info(
@@ -81,19 +97,97 @@ class PullWorker:
                     extra={"task_id": tid, "worker_id": self.worker_id},
                 )
         if reply_type == m.TASK:
-            self.pool.submit(
-                reply["task_id"],
-                reply["fn_payload"],
-                reply["param_payload"],
-                timeout=reply.get("timeout"),
-            )
+            self._submit_task(reply)
         # WAIT: nothing to do
+        return reply_type, reply
+
+    def _submit_task(self, reply: dict) -> None:
+        """Resolve the function body (payload plane: digest-only TASKs hit
+        the cache, a miss is resolved SYNCHRONOUSLY with a BLOB_MISS
+        transaction — REQ/REP gives us a mandatory reply to ride) and
+        submit to the pool."""
+        digest = reply.get("fn_digest")
+        payload = reply.get("fn_payload")
+        if payload is None and digest:
+            payload = self.fn_cache.get(digest)
+            if payload is None:
+                FN_CACHE_MISSES.inc()
+                payload = self._fetch_blob(digest)
+            else:
+                FN_CACHE_HITS.inc()
+            if payload is None:
+                # unfillable (blob gone) or store outage at the
+                # dispatcher: FAIL the task via the ordinary result path
+                # rather than dropping it silently — REQ/REP has no
+                # parked-task structure to wait in
+                self._transact(
+                    m.RESULT,
+                    worker_id=self.worker_id,
+                    task_id=reply["task_id"],
+                    status=str(TaskStatus.FAILED),
+                    result=serialize(
+                        RuntimeError(
+                            f"function blob {str(digest)[:16]}... "
+                            "unresolvable at dispatch"
+                        )
+                    ),
+                    no_task=True,
+                )
+                return
+        elif payload is not None and digest:
+            self.fn_cache.put(digest, payload)
+        self.pool.submit(
+            reply["task_id"],
+            payload,
+            reply["param_payload"],
+            timeout=reply.get("timeout"),
+            fn_digest=digest,
+        )
+
+    def _fetch_blob(self, digest: str, retries: int = 40) -> str | None:
+        """One or more BLOB_MISS transactions; an EMPTY fill (dispatcher
+        store outage) backs off and retries — the budget (~35 s at the
+        default, sleeps capped at 1 s) rides out the store blips the rest
+        of the system parks through, since REQ/REP has no parked-task
+        structure to wait in asynchronously. ``missing`` (the blob is
+        gone from the store too) gives up immediately."""
+        for attempt in range(retries):
+            # worker_id rides along: pull-mode liveness is request-stamped
+            # (demand IS the heartbeat), and during an outage this retry
+            # loop is the only traffic this worker emits — an anonymous
+            # MISS would let last_seen age past tte and get the live
+            # worker purged mid-resolution (its in-flight tasks would
+            # re-dispatch and double-execute)
+            self.socket.send(
+                m.encode_for(
+                    self._peer_bin,
+                    m.BLOB_MISS,
+                    digest=digest,
+                    worker_id=self.worker_id,
+                )
+            )
+            raw = self.socket.recv()
+            if not self._peer_bin and m.is_binary(raw):
+                self._peer_bin = True
+            reply_type, reply = m.decode(raw)
+            if reply_type != m.BLOB_FILL:
+                return None  # protocol surprise: treat as unresolvable
+            body = reply.get("data")
+            if isinstance(body, str):
+                self.fn_cache.put(digest, body)
+                return body
+            if reply.get("missing"):
+                return None
+            time.sleep(min(0.2 * (attempt + 1), 1.0))  # dispatcher outage
+        return None
 
     def run(self, max_tasks: int | None = None) -> int:
         """Main loop; returns number of results shipped (for tests)."""
         shipped = 0
         self.pool.warmup()  # pay the child-spawn cost before taking work
-        self._transact(m.REGISTER, worker_id=self.worker_id)
+        self._transact(
+            m.REGISTER, worker_id=self.worker_id, caps=list(self.caps)
+        )
         last_transact = time.monotonic()
         try:
             while not self._stopping:

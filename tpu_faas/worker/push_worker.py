@@ -25,11 +25,18 @@ import uuid
 
 import zmq
 
+from tpu_faas.core.payload import PayloadLRU
+from tpu_faas.core.serialize import serialize
+from tpu_faas.core.task import TaskStatus
 from tpu_faas.utils.logging import get_logger
 from tpu_faas.worker import messages as m
-from tpu_faas.worker.pool import TaskPool
+from tpu_faas.worker.pool import FN_CACHE_HITS, FN_CACHE_MISSES, TaskPool
 
 log = get_logger("push_worker")
+
+#: How long a parked task waits on an unanswered BLOB_MISS before the
+#: worker re-asks (fills ride the same lossy transport as everything else).
+_MISS_RESEND_S = 2.0
 
 
 class PushWorker:
@@ -41,6 +48,8 @@ class PushWorker:
         heartbeat_period: float = 1.0,
         poll_timeout_ms: int = 10,
         token: str | None = None,
+        caps: tuple[str, ...] = m.WORKER_CAPS,
+        fn_cache_bytes: int = 256 * 1024 * 1024,
     ) -> None:
         self.num_processes = num_processes
         #: stable identity for the estimator's speed grades: carried on
@@ -57,6 +66,22 @@ class PushWorker:
         self.heartbeat = heartbeat
         self.heartbeat_period = heartbeat_period
         self.poll_timeout_ms = poll_timeout_ms
+        #: protocol capabilities advertised on REGISTER/RECONNECT (payload
+        #: plane); () runs the pure reference contract — used by tests and
+        #: as an operator escape hatch
+        self.caps: tuple[str, ...] = tuple(caps)
+        #: digest -> serialized body: the parent-side half of the codec
+        #: cache (the child-side half caches DESERIALIZED functions,
+        #: core/executor.py). Filled by BLOB_FILLs and by inline payloads
+        #: seen with a digest attached.
+        self.fn_cache = PayloadLRU(fn_cache_bytes)
+        #: digest -> TASK payload dicts parked on an outstanding miss
+        self._awaiting: dict[str, list[dict]] = {}
+        #: digest -> monotonic time the last BLOB_MISS went out
+        self._miss_sent: dict[str, float] = {}
+        #: True once a binary frame arrived from the dispatcher — proof it
+        #: decodes them; our own sends switch to binary from then on
+        self._peer_bin = False
         self.pool = TaskPool(num_processes)
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.DEALER)
@@ -77,15 +102,98 @@ class PushWorker:
         by heartbeat-timeout purge + re-dispatch."""
         self._draining = True
 
+    def _send(self, msg_type: str, **data: object) -> None:
+        """Frame per the negotiated state: binary once the dispatcher has
+        proven (by sending one) that it decodes binary frames, ASCII until
+        then — so a reference-style dispatcher never sees a frame it can't
+        decode."""
+        self.socket.send(m.encode_for(self._peer_bin, msg_type, **data))
+
     def register(self) -> None:
+        # REGISTER always rides the ASCII contract (first contact: the
+        # peer's decoder is unknown); the caps list inside it is what
+        # unlocks digest shipping + binary framing from the other side
         self.socket.send(
             m.encode(
                 m.REGISTER,
                 num_processes=self.num_processes,
                 token=self.token,
                 ephemeral=self.token_is_ephemeral,
+                caps=list(self.caps),
             )
         )
+
+    # -- payload plane -----------------------------------------------------
+    def _submit_task(self, data: dict, from_fill: bool = False) -> bool:
+        """Resolve one TASK message's function body and put it on the
+        pool. Digest-only tasks (payload plane) hit the parent cache; a
+        miss parks the task and asks the dispatcher with BLOB_MISS —
+        False means parked, not submitted. ``from_fill`` (the fill
+        handler resubmitting a parked task) skips the hit/miss counters:
+        that resolution was already counted as its original miss."""
+        digest = data.get("fn_digest")
+        payload = data.get("fn_payload")
+        if payload is None:
+            payload = self.fn_cache.get(digest) if digest else None
+            if payload is None:
+                if not from_fill:
+                    FN_CACHE_MISSES.inc()
+                self._awaiting.setdefault(digest, []).append(data)
+                if digest not in self._miss_sent:
+                    self._send(m.BLOB_MISS, digest=digest)
+                    self._miss_sent[digest] = time.monotonic()
+                return False
+            if not from_fill:
+                FN_CACHE_HITS.inc()
+        elif digest:
+            # inline body with a digest attached: warm the cache so a
+            # later digest-only TASK (dispatcher upgraded mid-stream)
+            # needs no fill round
+            self.fn_cache.put(digest, payload)
+        self.pool.submit(
+            data["task_id"],
+            payload,
+            data["param_payload"],
+            timeout=data.get("timeout"),
+            fn_digest=digest,
+        )
+        return True
+
+    def _on_blob_fill(self, data: dict) -> None:
+        digest = data.get("digest")
+        if not isinstance(digest, str) or not digest:
+            return
+        body = data.get("data")
+        if isinstance(body, str):
+            self.fn_cache.put(digest, body)
+            self._miss_sent.pop(digest, None)
+            for parked in self._awaiting.pop(digest, ()):
+                self._submit_task(parked, from_fill=True)
+        elif data.get("missing"):
+            # the blob is gone from the store too: nothing will ever fill
+            # this digest — FAIL the parked tasks so their records
+            # converge instead of waiting forever
+            self._miss_sent.pop(digest, None)
+            for parked in self._awaiting.pop(digest, ()):
+                self._send(
+                    m.RESULT,
+                    task_id=parked["task_id"],
+                    status=str(TaskStatus.FAILED),
+                    result=serialize(
+                        RuntimeError(
+                            f"function blob {digest[:16]}... missing from "
+                            "the store"
+                        )
+                    ),
+                )
+        # an empty fill (no data, no missing) means "store outage, retry":
+        # the parked tasks stay and the resend timer re-asks
+
+    def _resend_stale_misses(self, now: float) -> None:
+        for digest in list(self._awaiting):
+            if now - self._miss_sent.get(digest, 0.0) >= _MISS_RESEND_S:
+                self._send(m.BLOB_MISS, digest=digest)
+                self._miss_sent[digest] = now
 
     def run(self, max_tasks: int | None = None) -> int:
         shipped = 0
@@ -100,7 +208,7 @@ class PushWorker:
         try:
             while not self._stopping:
                 if self._draining and not deregistered:
-                    self.socket.send(m.encode(m.DEREGISTER))
+                    self._send(m.DEREGISTER)
                     deregistered = True
                     log.info(
                         "draining: %d task(s) in flight", self.pool.busy
@@ -119,8 +227,10 @@ class PushWorker:
                     and (not deregistered or self.pool.busy > 0)
                     and now - last_heartbeat >= self.heartbeat_period
                 ):
-                    self.socket.send(m.encode(m.HEARTBEAT))
+                    self._send(m.HEARTBEAT)
                     last_heartbeat = now  # the fix for reference :61-62
+                if self._awaiting:
+                    self._resend_stale_misses(now)
                 events = dict(self.poller.poll(self.poll_timeout_ms))
                 if self.socket in events:
                     while True:
@@ -128,15 +238,16 @@ class PushWorker:
                             raw = self.socket.recv(flags=zmq.NOBLOCK)
                         except zmq.Again:
                             break
+                        if not self._peer_bin and m.is_binary(raw):
+                            # the dispatcher frames in binary: negotiation
+                            # complete — our sends switch too
+                            self._peer_bin = True
                         msg_type, data = m.decode(raw)
                         if msg_type == m.TASK:
                             # no admission gate: dispatcher controls load
-                            self.pool.submit(
-                                data["task_id"],
-                                data["fn_payload"],
-                                data["param_payload"],
-                                timeout=data.get("timeout"),
-                            )
+                            self._submit_task(data)
+                        elif msg_type == m.BLOB_FILL:
+                            self._on_blob_fill(data)
                         elif msg_type == m.CANCEL:
                             # force-cancel: interrupt mid-run or drop
                             # pre-start; the CANCELLED result ships via the
@@ -152,27 +263,24 @@ class PushWorker:
                         elif msg_type == m.RECONNECT:
                             # a draining worker reports zero capacity: it
                             # must not be handed new work
-                            self.socket.send(
-                                m.encode(
-                                    m.RECONNECT,
-                                    free_processes=(
-                                        0 if self._draining else self.pool.free
-                                    ),
-                                    token=self.token,
-                                    ephemeral=self.token_is_ephemeral,
-                                )
+                            self._send(
+                                m.RECONNECT,
+                                free_processes=(
+                                    0 if self._draining else self.pool.free
+                                ),
+                                token=self.token,
+                                ephemeral=self.token_is_ephemeral,
+                                caps=list(self.caps),
                             )
                 for res in self.pool.drain():
-                    self.socket.send(
-                        m.encode(
-                            m.RESULT,
-                            task_id=res.task_id,
-                            status=res.status,
-                            result=res.result,
-                            elapsed=res.elapsed,
-                            started_at=res.started_at,
-                            misfires=self.pool.n_misfires,
-                        )
+                    self._send(
+                        m.RESULT,
+                        task_id=res.task_id,
+                        status=res.status,
+                        result=res.result,
+                        elapsed=res.elapsed,
+                        started_at=res.started_at,
+                        misfires=self.pool.n_misfires,
                     )
                     log.debug(
                         "shipped result %s", res.status,
