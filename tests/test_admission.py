@@ -267,6 +267,73 @@ def test_breaker_aborted_probe_releases_the_slot():
     assert br.state == "closed"
 
 
+def test_breaker_failed_probe_rotates_endpoint_and_stays_half_open():
+    """Store HA (store/replication.py): with the rotation hook installed,
+    a failed half-open probe rotates the store client to the next
+    endpoint and STAYS half-open — the very next caller probes the
+    replica immediately instead of waiting out another full open window
+    against the dead primary."""
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=5.0, clock=clock)
+    rotations = []
+    br.set_rotate_hook(lambda: rotations.append(clock()), budget=1)
+    br.record_failure()  # open
+    clock.advance(5.1)
+    assert br.allow()  # the probe, against the dead primary
+    br.record_failure()  # probe failed -> rotate, not re-open
+    assert rotations == [clock()]
+    assert br.state == "half_open"  # window NOT restarted
+    assert br.n_rotations == 1
+    assert br.allow()  # next caller probes the replica immediately
+    br.record_success()
+    assert br.state == "closed"
+    assert br.snapshot()["endpoint_rotations"] == 1
+
+
+def test_breaker_rotation_budget_exhaustion_reopens_fresh_window():
+    """Once every other endpoint has had its immediate probe (budget =
+    endpoints - 1), a still-failing probe re-opens a fresh window as
+    before — rotation cannot turn the breaker into a hot retry loop.
+    A successful close refills the budget for the next incident."""
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=5.0, clock=clock)
+    rotations = []
+    br.set_rotate_hook(lambda: rotations.append(1), budget=2)
+    br.record_failure()  # open
+    clock.advance(5.1)
+    for expected in (1, 2):  # two rotations: both OTHER endpoints probed
+        assert br.allow()
+        br.record_failure()
+        assert len(rotations) == expected
+        assert br.state == "half_open"
+    assert br.allow()  # third probe this window...
+    br.record_failure()  # ...fails with no endpoint left
+    assert br.state == "open"  # fresh open window
+    assert len(rotations) == 2  # no extra rotation spent
+    clock.advance(5.1)
+    assert br.allow()
+    br.record_success()  # close refills the budget
+    br.record_failure()
+    clock.advance(5.1)
+    assert br.allow()
+    br.record_failure()
+    assert len(rotations) == 3  # budget was reset on close
+    assert br.state == "half_open"
+
+
+def test_breaker_without_hook_keeps_legacy_reopen():
+    """Single-endpoint deployments: no hook installed, a failed probe
+    re-opens with a fresh window exactly as before this PR."""
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=5.0, clock=clock)
+    br.record_failure()
+    clock.advance(5.1)
+    assert br.allow()
+    br.record_failure()
+    assert br.state == "open"
+    assert br.n_rotations == 0
+
+
 # -- queue-deadline expiry (store level) -------------------------------------
 
 

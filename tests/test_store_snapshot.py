@@ -34,13 +34,91 @@ def test_dump_load_empty():
 def test_load_rejects_garbage():
     with pytest.raises(resp.ProtocolError):
         snapshot.load_hashes(b"not a snapshot")
-    # a non-HSET RESP command must be rejected, not silently skipped
+    # a command outside the log grammar (HSET/DEL/HDEL) must be rejected,
+    # not silently skipped
     with pytest.raises(resp.ProtocolError):
-        snapshot.load_hashes(resp.encode_command("DEL", "k", "f", "v"))
+        snapshot.load_hashes(resp.encode_command("SET", "k", "v"))
+    # malformed arity of a known command is rejected too
+    with pytest.raises(resp.ProtocolError):
+        snapshot.load_hashes(resp.encode_command("HSET", "k", "f"))
 
 
 def test_load_missing_file_is_empty(tmp_path):
     assert snapshot.load_file(str(tmp_path / "nope.snap")) == {}
+
+
+# -- deletion records (HA / log-merge completeness) --------------------------
+
+
+def test_dump_with_deleted_keys_roundtrip():
+    """DEL records make deletions EXPRESSIBLE in the log format: a dump
+    carrying tombstones loads to a state where those keys are absent, and
+    keys both dumped and tombstoned (a caller bug) stay dumped — the DEL
+    record is filtered, not applied over live state."""
+    data = snapshot.dump_hashes(WEIRD, deleted=["gone-blob", "gone-index"])
+    assert b"DEL" in data
+    assert snapshot.load_hashes(data) == WEIRD
+    # a tombstone colliding with a live key is dropped at dump time
+    data2 = snapshot.dump_hashes(WEIRD, deleted=["k", "really-gone"])
+    loaded = snapshot.load_hashes(data2)
+    assert loaded["k"] == {"f": "v"}
+    assert "really-gone" not in loaded
+
+
+def test_load_applies_del_and_hdel_in_order():
+    """The log replays strictly in order, so a dump + appended mutations
+    (the replication stream's shape) cannot resurrect deleted keys."""
+    log = (
+        resp.encode_command("HSET", "t1", "status", "COMPLETED")
+        + resp.encode_command("HSET", "blob:abc", "data", "x" * 64)
+        + resp.encode_command("DEL", "blob:abc")  # GC'd after the dump
+        + resp.encode_command("HSET", "tasks:index", "t1", "1", "t2", "1")
+        + resp.encode_command("HDEL", "tasks:index", "t1")
+        + resp.encode_command("HSET", "t2", "status", "QUEUED")
+        + resp.encode_command("HDEL", "t2", "status")  # emptied -> absent
+    )
+    loaded = snapshot.load_hashes(log)
+    assert "blob:abc" not in loaded  # the GC'd blob stays gone
+    assert loaded["tasks:index"] == {"t2": "1"}  # live-index entry dropped
+    assert "t2" not in loaded  # empty hash = absent key (Redis semantics)
+    assert loaded["t1"] == {"status": "COMPLETED"}
+    # inverse order DOES resurrect — proving order-sensitivity is real
+    relog = resp.encode_command("DEL", "k") + resp.encode_command(
+        "HSET", "k", "f", "v"
+    )
+    assert snapshot.load_hashes(relog) == {"k": {"f": "v"}}
+
+
+def test_server_snapshot_records_deletions(tmp_path):
+    """A checkpoint taken AFTER a deletion carries the tombstone: merging
+    it over an older log (cat old new | replay) cannot revive the key —
+    the resurrection the pure-HSET format allowed."""
+    path = str(tmp_path / "tomb.snap")
+    h = start_store_thread(snapshot_path=path)
+    try:
+        c = RespStore(port=h.port)
+        c.hset("keep", {"a": "1"})
+        c.hset("gc-me", {"data": "blob-bytes"})
+        c.hset("empty-me", {"f": "v"})
+        c.save()
+        c.delete("gc-me")
+        c.hdel("empty-me", "f")  # HDEL to empty = key deleted
+        c.save()
+        raw = open(path, "rb").read()
+        assert b"DEL" in raw
+        loaded = snapshot.load_hashes(raw)
+        assert "gc-me" not in loaded and "empty-me" not in loaded
+        # the merge scenario: an older full dump followed by the new
+        # snapshot replays WITHOUT resurrecting the deleted keys
+        old = snapshot.dump_hashes(
+            {"gc-me": {"data": "blob-bytes"}, "keep": {"a": "0"}}
+        )
+        merged = snapshot.load_hashes(old + raw)
+        assert "gc-me" not in merged
+        assert merged["keep"] == {"a": "1"}
+        c.close()
+    finally:
+        h.stop()
 
 
 def test_memory_store_save_load(tmp_path):

@@ -20,6 +20,16 @@ machine:
 Only the outage family (connection/timeout errors — the same set the
 dispatchers treat as a transient outage) trips it; a store ERROR reply is
 an application bug, not an availability signal.
+
+Failover awareness (store HA, tpu_faas/store/replication.py): with an
+endpoint-rotation hook installed (``set_rotate_hook`` — the gateway wires
+it to the multi-endpoint store's ``rotate_endpoint``), a FAILED half-open
+probe rotates the store client to the next endpoint and stays half-open,
+so the very next caller probes the replica immediately instead of
+waiting out another full open window against the dead primary. The
+rotation budget (endpoints - 1 per window) bounds it: once every other
+endpoint has had its immediate probe, the breaker re-opens a fresh
+window as before.
 """
 
 from __future__ import annotations
@@ -65,9 +75,25 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at: float | None = None
         self._probe_in_flight = False
+        #: endpoint-rotation hook (set_rotate_hook): called — outside the
+        #: lock — when a half-open probe fails with rotation budget left
+        self._rotate_hook = None
+        self._rotation_budget = 0
+        self._rotations_left = 0
         #: monotonic counters for /stats and tests
         self.n_opened = 0
         self.n_fast_failed = 0
+        self.n_rotations = 0
+
+    def set_rotate_hook(self, hook, budget: int) -> None:
+        """Install the store client's endpoint rotation as the failed-probe
+        reaction. ``budget`` is how many immediate endpoint probes one
+        open window may spend (endpoints - 1: each OTHER endpoint gets
+        one) before the breaker falls back to a fresh open window."""
+        with self._lock:
+            self._rotate_hook = hook
+            self._rotation_budget = max(0, int(budget))
+            self._rotations_left = self._rotation_budget
 
     @property
     def state(self) -> str:
@@ -104,6 +130,7 @@ class CircuitBreaker:
             self._failures = 0
             self._opened_at = None
             self._probe_in_flight = False
+            self._rotations_left = self._rotation_budget
 
     def record_aborted(self) -> None:
         """The call ended without a store verdict (cancelled request, a
@@ -117,6 +144,7 @@ class CircuitBreaker:
             self._probe_in_flight = False
 
     def record_failure(self) -> None:
+        rotate = None
         with self._lock:
             was_probe = self._probe_in_flight
             self._probe_in_flight = False
@@ -126,14 +154,33 @@ class CircuitBreaker:
                     self._opened_at = self.clock()
                     self.n_opened += 1
             elif was_probe:
-                # the half-open probe failed: re-open with a fresh window
-                self._opened_at = self.clock()
-                self.n_opened += 1
+                if self._rotate_hook is not None and self._rotations_left > 0:
+                    # failover awareness: the probe may have died against
+                    # the dead PRIMARY — rotate the client to the next
+                    # endpoint and STAY half-open (``_opened_at`` is
+                    # untouched, already past the window), so the next
+                    # caller probes the replica immediately instead of
+                    # waiting out another full open window
+                    self._rotations_left -= 1
+                    self.n_rotations += 1
+                    rotate = self._rotate_hook
+                else:
+                    # the half-open probe failed with no endpoint left to
+                    # try this window: re-open with a fresh window (and a
+                    # fresh rotation budget for the next one)
+                    self._opened_at = self.clock()
+                    self.n_opened += 1
+                    self._rotations_left = self._rotation_budget
             # else: a STRAGGLER — a call already in flight when the
             # breaker opened, landing late. It proves nothing the open
             # state doesn't already assume, and restarting the window on
             # each one (slow connect timeouts can land seconds apart)
             # would push the recovery probe out indefinitely
+        if rotate is not None:
+            # outside the lock: the hook takes the store client's own lock
+            # (socket teardown), and nesting the two here would impose a
+            # cross-module lock order nothing else needs
+            rotate()
 
     def retry_after(self) -> float:
         """Client-facing wait: the remaining open window (at least 1 s,
@@ -151,4 +198,5 @@ class CircuitBreaker:
                 "consecutive_failures": self._failures,
                 "times_opened": self.n_opened,
                 "fast_failed": self.n_fast_failed,
+                "endpoint_rotations": self.n_rotations,
             }

@@ -320,6 +320,11 @@ class TaskDispatcher:
             "tpu_faas_dispatcher_tasks_reclaimed_total",
             "In-flight tasks reclaimed from dead workers and re-queued",
         )
+        self.m_failover_rearms = self.metrics.counter(
+            "tpu_faas_dispatcher_failover_rearms_total",
+            "Store failovers this dispatcher detected and re-armed for "
+            "(announce-replay round + immediate stranded-task rescan)",
+        )
         # -- payload plane (content-addressed function bodies) ------------
         self.m_blob_hits = self.metrics.counter(
             "tpu_faas_dispatcher_blob_cache_hits_total",
@@ -428,6 +433,26 @@ class TaskDispatcher:
         self._store_down = False
         self._last_flush_attempt = 0.0
         self._stats_server = None
+        #: store-failover re-arm state (maybe_rearm_after_failover): the
+        #: client generation last re-armed for, the announce-ring offset
+        #: already covered, and whether the backend speaks REPLAY at all
+        self._store_generation = getattr(self.store, "failover_generation", 0)
+        self._announce_offset = -1
+        self._replay_supported = True
+        try:
+            # prime the replay offset so a later failover replays only the
+            # window since NOW, not the whole ring's history
+            self._announce_offset, _ = self.store.replay_announces(-1)
+        except STORE_OUTAGE_ERRORS:
+            # whole-ring replay on the first re-arm instead: ring offsets
+            # start at 1, so 0 covers everything (NOT the -1 priming
+            # sentinel, which asks for the tail alone and would make the
+            # first replay return nothing); duplicates are deduped at
+            # intake, bounded by the ring
+            self._announce_offset = 0
+        except Exception:
+            # backend without REPLAY (plain Redis): rescan-only re-arm
+            self._replay_supported = False
         #: task_id -> note-time for cancel control messages consumed from
         #: the bus (store/base.py cancel_task). Entries are consumed when
         #: the matching task is dropped at a dispatch site; entries whose
@@ -443,6 +468,7 @@ class TaskDispatcher:
         self._last_kill_relay = 0.0
         self.n_cancelled_dropped = 0
         self.n_expired = 0
+        self.n_failover_rearms = 0
         #: saturation-signal publishing state (maybe_publish_capacity):
         #: last publish time, result count at that publish, and the
         #: drain-rate EWMA the snapshot carries
@@ -1245,6 +1271,50 @@ class TaskDispatcher:
             self.log.info("replayed %d result writes deferred during outage", n)
         return n
 
+    # -- store failover re-arm (store HA, store/replication.py) -------------
+    def maybe_rearm_after_failover(self) -> bool:
+        """Detect that the store client failed over to a different
+        endpoint (a promoted replica) and re-arm dispatch: replay the
+        announce ring since the last covered offset into the announce
+        backlog — tasks announced on the dead primary but never drained
+        re-enter intake, where the usual dedup (non-QUEUED skip,
+        pending-id check) makes duplicates harmless — and report True so
+        the serve loop runs an immediate adopt-by-rescan round on top.
+        Cheap when nothing happened: one int compare per call.
+
+        Outage-safe: a replay that fails mid-outage leaves the generation
+        un-consumed, so the next loop iteration retries the whole re-arm;
+        backends without REPLAY degrade to rescan-only re-arm."""
+        gen = getattr(self.store, "failover_generation", 0)
+        if gen == self._store_generation:
+            return False
+        replayed = 0
+        if self._replay_supported:
+            try:
+                tail, entries = self.store.replay_announces(
+                    self._announce_offset
+                )
+            except STORE_OUTAGE_ERRORS:
+                raise  # generation stays un-consumed: retried next loop
+            except Exception:
+                self._replay_supported = False
+            else:
+                for channel, payload in entries:
+                    if channel == self.channel:
+                        self._announce_backlog.append(payload)
+                        replayed += 1
+                self._announce_offset = tail
+        self._store_generation = gen
+        self.n_failover_rearms += 1
+        self.m_failover_rearms.inc()
+        self.log.warning(
+            "store failover detected (generation %d): replayed %d "
+            "announces from the ring; re-arming rescan",
+            gen,
+            replayed,
+        )
+        return True
+
     # -- store outage tracking ----------------------------------------------
     def note_store_outage(self, exc: BaseException, pause: float = 0.2) -> None:
         """Log (once per outage, not per tick) and back off briefly so a
@@ -1280,6 +1350,7 @@ class TaskDispatcher:
             "announce_backlog": len(self._announce_backlog),
             "cancelled_dropped": self.n_cancelled_dropped,
             "expired": self.n_expired,
+            "failover_rearms": self.n_failover_rearms,
             "drain_rate": round(self._drain_rate, 3),
             "worker_misfires": sum(self.worker_misfires.values()),
             "blob_cache": {

@@ -68,6 +68,12 @@ class LocalDispatcher(TaskDispatcher):
                 progressed = False
                 if self.deferred_results:
                     self.flush_deferred_results()
+                try:
+                    # store failover: replay the announce ring so tasks
+                    # announced on the dead primary re-enter intake
+                    self.maybe_rearm_after_failover()
+                except STORE_OUTAGE_ERRORS as exc:
+                    self.note_store_outage(exc)
                 # admission-controlled intake (reference task_dispatcher.py:73-75)
                 while pool.free > 0:
                     try:

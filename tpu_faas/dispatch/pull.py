@@ -221,6 +221,9 @@ class PullDispatcher(TaskDispatcher):
                 # asking for tasks (saturated fleet mid-long-tasks)
                 self.drain_control_messages()
                 try:
+                    # store failover: replay the announce ring so tasks
+                    # announced on the dead primary re-enter intake
+                    self.maybe_rearm_after_failover()
                     self._purge_dead_workers()
                     if self.clock() - last_renew >= self.lease_renew_period and (
                         self.inflight or self.shared

@@ -487,6 +487,10 @@ class PushDispatcher(TaskDispatcher):
                         self.purge_workers()
                     if self.deferred_results:
                         self.flush_deferred_results()
+                    # store failover: replay the announce ring so tasks
+                    # announced on the dead primary re-enter intake (the
+                    # push mode has no rescan; the replay is its re-arm)
+                    self.maybe_rearm_after_failover()
                     now = time.monotonic()
                     if now - last_renew >= self.lease_renew_period:
                         inflight = [

@@ -1458,6 +1458,16 @@ class TpuPushDispatcher(TaskDispatcher):
                 try:
                     if self.deferred_results:
                         self.flush_deferred_results()
+                    # store failover (client settled on a promoted
+                    # replica): replay the announce ring into the backlog
+                    # and force an immediate rescan — together these
+                    # re-discover every task the dead primary had
+                    # announced-but-undrained or stranded QUEUED/RUNNING
+                    if (
+                        self.maybe_rearm_after_failover()
+                        and self.rescan_period > 0
+                    ):
+                        last_rescan = self.clock() - self.rescan_period
                     # no rescan while results are deferred: a task whose
                     # COMPLETED write is waiting in deferred_results still
                     # reads QUEUED from the store, so a rescan would adopt

@@ -368,6 +368,18 @@ class GatewayContext:
             "inline bodies replaced by digests on task creates, plus "
             "re-registrations of an already-stored body",
         )
+        self.m_store_role = self.metrics.gauge(
+            "tpu_faas_gateway_store_role",
+            "Replication role of the store endpoint this gateway talks "
+            "to, at the last scrape: 1 primary, 0 replica, -1 fenced "
+            "stale primary, -2 unknown (no HA introspection)",
+        )
+        self.m_repl_lag = self.metrics.gauge(
+            "tpu_faas_store_replication_lag_commands",
+            "Replication offset delta between the active store primary "
+            "and its slowest attached replica (mutating commands not "
+            "yet acknowledged), at the last scrape; 0 with no replica",
+        )
         self.metrics.register_collector(self._collect)
         if self.tracer is None:
             self.tracer = TickTracer(mirror=self.m_latency)
@@ -667,6 +679,15 @@ def make_app(
         breaker = CircuitBreaker()
     elif breaker is False:
         breaker = None
+    if breaker is not None:
+        # store HA: against a multi-endpoint (replicated) store, a failed
+        # half-open probe rotates the client to the next endpoint and
+        # re-probes immediately — failover happens inside ONE breaker
+        # window instead of one full open window per dead endpoint
+        rotate = getattr(store, "rotate_endpoint", None)
+        endpoints = getattr(store, "endpoints", None)
+        if rotate is not None and endpoints and len(endpoints) > 1:
+            breaker.set_rotate_hook(rotate, budget=len(endpoints) - 1)
     ctx = GatewayContext(
         store=store,
         channel=channel,
@@ -1466,6 +1487,30 @@ def _safe_ping(store: TaskStore) -> bool:
         return False
 
 
+#: INFO "role" string -> the role gauge's encoding (see m_store_role)
+_ROLE_GAUGE = {"primary": 1.0, "replica": 0.0, "fenced": -1.0}
+
+
+def _safe_store_ha(store: TaskStore) -> tuple[str | None, float | None]:
+    """(role, replication_lag) from the store's INFO introspection, both
+    None when the backend has no HA surface (MemoryStore, plain Redis)
+    or the store is unreachable. Blocking — call off-loop."""
+    info_fn = getattr(store, "info", None)
+    if info_fn is None:
+        return None, None
+    try:
+        info = info_fn()
+    except Exception:
+        return None, None
+    role = info.get("role")
+    lag: float | None = None
+    try:
+        lag = float(info["repl_lag"])
+    except (KeyError, ValueError):
+        pass
+    return role, lag
+
+
 async def metrics(request: web.Request) -> web.Response:
     """Prometheus text exposition: the gateway's private registry (request
     counts + latency histograms per route, submission counters, store
@@ -1473,6 +1518,10 @@ async def metrics(request: web.Request) -> web.Response:
     (store round trips). The scrape path; the JSON twin lives at /stats."""
     ctx: GatewayContext = request.app[CTX_KEY]
     ctx.m_store_up.set(1.0 if await _run_blocking(_safe_ping, ctx.store) else 0.0)
+    role, lag = await _run_blocking(_safe_store_ha, ctx.store)
+    ctx.m_store_role.set(_ROLE_GAUGE.get(role, -2.0))
+    if lag is not None:
+        ctx.m_repl_lag.set(lag)
     body = await _run_blocking(obs_metrics.render, [ctx.metrics, REGISTRY])
     # the shared CONTENT_TYPE constant (version=0.0.4 included), same as
     # the dispatcher's scrape surface — one format, advertised once
@@ -1487,9 +1536,15 @@ async def stats(request: web.Request) -> web.Response:
     tracer ring's exact recent-window latency percentiles."""
     ctx: GatewayContext = request.app[CTX_KEY]
     store_ok = await _run_blocking(_safe_ping, ctx.store)
+    store_role, _lag = await _run_blocking(_safe_store_ha, ctx.store)
     return web.json_response(
         {
             "uptime_s": round(time.time() - ctx.started_at, 1),
+            # replication role of the endpoint this gateway's store client
+            # settled on (None = backend without HA introspection); the
+            # promotion runbook's "is the fleet pointed at the primary?"
+            # probe
+            "store_role": store_role,
             "functions_registered": ctx.n_functions,
             "tasks_submitted": ctx.n_tasks,
             # overload surfaces: admission controller + store breaker

@@ -145,6 +145,13 @@ class TaskStore(abc.ABC):
     #: pipelined rounds per tick instead of O(tasks) round trips.
     n_round_trips: int = 0
 
+    #: Bumped by failover-capable backends (multi-endpoint RespStore)
+    #: every time commands settle on a DIFFERENT store endpoint.
+    #: Dispatchers watch it to trigger their post-failover re-arm
+    #: (announce replay + immediate rescan); 0 forever on backends that
+    #: cannot fail over.
+    failover_generation: int = 0
+
     # -- raw hash ops ------------------------------------------------------
     @abc.abstractmethod
     def hset(self, key: str, fields: Mapping[str, str]) -> None: ...
@@ -179,6 +186,18 @@ class TaskStore(abc.ABC):
 
     @abc.abstractmethod
     def subscribe(self, channel: str) -> Subscription: ...
+
+    def replay_announces(
+        self, after: int
+    ) -> tuple[int, list[tuple[str, str]]]:
+        """Re-read recent announces from the backend's bounded replay ring
+        (store/replication.py): entries with replay offset > ``after``
+        plus the current tail offset; ``after=-1`` asks for the tail
+        alone. The post-failover re-arm reads this on a promoted replica
+        to re-discover announces the dead primary published that no
+        dispatcher drained. Default: unsupported — tail -1, no entries
+        (backends without a ring simply rely on the rescan)."""
+        return -1, []
 
     # -- admin -------------------------------------------------------------
     @abc.abstractmethod

@@ -8,6 +8,16 @@ connection per pub/sub subscription with a non-blocking ``get_message()``
 
 Thread-safety: command calls are serialized by a lock, so one RespStore can be
 shared across gateway/dispatcher threads; each Subscription owns its socket.
+
+High availability: construct with an ordered ``endpoints`` list (or a
+``resp://h1:p1,h2:p2`` URL through ``make_store``) and the client fails
+over — every (re)connect walks the list from the active endpoint, runs a
+FENCE/ROLE handshake against each candidate, and settles on the first
+endpoint that reports the writable ``primary`` role. Unpromoted replicas
+and fenced stale primaries are skipped; the highest epoch ever seen is
+re-declared on every handshake, which is what fences a resurrected old
+primary (store/replication.py). Single-endpoint clients send NO handshake
+— the wire surface toward a plain Redis is byte-identical to before.
 """
 
 from __future__ import annotations
@@ -48,6 +58,17 @@ _ROUND_TRIPS_TOTAL = REGISTRY.counter(
 _BYTES_SENT_TOTAL = REGISTRY.counter(
     "tpu_faas_store_bytes_sent_total",
     "Encoded command bytes sent to the store by this process",
+    ("backend",),
+)
+
+#: Store failovers this process's clients performed: an endpoint rotation
+#: that SETTLED on a different endpoint than the previous commands used.
+#: Process-global like the round-trip counter — the operator-facing
+#: "how often did we fail over" series.
+_FAILOVERS_TOTAL = REGISTRY.counter(
+    "tpu_faas_store_failovers_total",
+    "Store endpoint failovers performed by this process's clients "
+    "(reconnects that settled on a different endpoint)",
     ("backend",),
 )
 
@@ -107,18 +128,41 @@ class _RespSubscription(Subscription):
     Survives a store restart: on connection loss the next ``get_message``
     reconnects and resubscribes. Messages published while disconnected are
     lost — exactly the fire-and-forget pub/sub contract the dispatchers
-    already handle (reference SURVEY §5.4: stranded announcements)."""
+    already handle (reference SURVEY §5.4: stranded announcements).
 
-    def __init__(self, host: str, port: int, channel: str) -> None:
+    Failover: when built by a multi-endpoint RespStore, the subscription
+    follows the store's ACTIVE endpoint — the one the command path's
+    FENCE/ROLE handshake settled on — so after a promotion the bus
+    reattaches to the endpoint actually receiving the writes (announces
+    published to a fenced old primary's bus would never arrive). A
+    generation check on every drain forces the reattach even while the
+    old socket still looks healthy."""
+
+    def __init__(
+        self, host: str, port: int, channel: str, store: "RespStore | None" = None
+    ) -> None:
         self._host = host
         self._port = port
+        self._store = store
         self._channel = channel
         self._conn: _Conn | None = None
+        self._gen = -1
         self._closed = False
         self._connect()  # initial failure propagates: caller wants a live bus
 
+    def _endpoint(self) -> tuple[str, int, int]:
+        if self._store is not None:
+            # one-attribute read: endpoint and generation arrive together
+            # (separate host/port/generation reads could tear against a
+            # concurrent failover and pin this sub to the old endpoint
+            # while recording the new generation)
+            return self._store._sub_target
+        return self._host, self._port, 0
+
     def _connect(self) -> None:
-        self._conn = _Conn(self._host, self._port)
+        host, port, gen = self._endpoint()
+        self._conn = _Conn(host, port)
+        self._gen = gen
         reply = self._conn.command("SUBSCRIBE", self._channel)
         if not (isinstance(reply, list) and reply[0] == "subscribe"):
             raise resp.RespError(f"unexpected SUBSCRIBE reply: {reply!r}")
@@ -136,7 +180,25 @@ class _RespSubscription(Subscription):
             return False
 
     def get_message(self, timeout: float = 0.0) -> str | None:
-        if self._closed or (self._conn is None and not self._reconnect()):
+        if self._closed:
+            return None
+        if (
+            self._conn is not None
+            and self._store is not None
+            and self._store.failover_generation != self._gen
+        ):
+            # the command path failed over: this socket may point at a
+            # dead (or fenced — silently announce-less) endpoint. Any
+            # frames still buffered on the old connection are drained
+            # first; announces published to the old endpoint after the
+            # failover are the bus's documented fire-and-forget loss,
+            # covered by the dispatcher's replay + rescan re-arm.
+            drained = self._drain_buffered()
+            if drained is not None:
+                return drained
+            self._conn.close()
+            self._conn = None
+        if self._conn is None and not self._reconnect():
             return None
         try:
             return self._get_message(timeout)
@@ -146,14 +208,22 @@ class _RespSubscription(Subscription):
                 self._conn = None  # reconnect on the next call
             return None
 
-    def _get_message(self, timeout: float) -> str | None:
-        # First drain anything already parsed/buffered.
+    def _drain_buffered(self) -> str | None:
+        """Pop the next already-parsed push message without touching the
+        socket (the failover handoff's no-loss drain of the old conn)."""
         item = self._conn.parser.pop()
         while item is not resp.NEED_MORE:
             payload = self._decode_push(item)
             if payload is not None:
                 return payload
             item = self._conn.parser.pop()
+        return None
+
+    def _get_message(self, timeout: float) -> str | None:
+        # First drain anything already parsed/buffered.
+        payload = self._drain_buffered()
+        if payload is not None:
+            return payload
         # Then poll the socket.
         deadline = None if timeout <= 0 else timeout
         while True:
@@ -194,12 +264,36 @@ class _RespSubscription(Subscription):
 
 
 class RespStore(TaskStore):
-    def __init__(self, host: str = "127.0.0.1", port: int = 6380) -> None:
-        self.host = host
-        self.port = port
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6380,
+        endpoints: list[tuple[str, int]] | None = None,
+    ) -> None:
+        #: ordered failover ring; [(host, port)] in the classic
+        #: single-endpoint form
+        self.endpoints: list[tuple[str, int]] = (
+            list(endpoints) if endpoints else [(host, port)]
+        )
+        #: where the next connect STARTS walking the ring (rotate_endpoint
+        #: advances it); distinct from _settled_idx, the endpoint the last
+        #: successful connection actually landed on
+        self._active_idx = 0
+        self._settled_idx = 0
+        #: bumped every time commands SETTLE on a different endpoint than
+        #: before; dispatchers watch it to trigger their failover re-arm
+        #: (announce replay + rescan) and subscriptions to reattach
+        self.failover_generation = 0
+        #: highest fencing epoch any handshake reported; re-declared via
+        #: FENCE on every multi-endpoint connect (never sent with a
+        #: single endpoint — plain-Redis wire compatibility)
+        self.known_epoch = 0
+        #: (host, port, failover_generation) the subscriptions follow —
+        #: one tuple attribute, written whole on settle, so subscription
+        #: threads read endpoint and generation consistently lock-free
+        self._sub_target: tuple[str, int, int] = (*self.endpoints[0], 0)
         self._lock = threading.Lock()
         self._closed = False
-        self._conn: _Conn | None = _Conn(host, port)
         #: wire round trips paid by this handle (TaskStore.n_round_trips
         #: contract: one pipelined batch = one). Written under the command
         #: lock; read lock-free by stats pollers (a torn read of an int is
@@ -211,6 +305,123 @@ class RespStore(TaskStore):
         self.n_bytes_sent = 0
         self._rt_series = _ROUND_TRIPS_TOTAL.labels(backend="resp")
         self._bytes_series = _BYTES_SENT_TOTAL.labels(backend="resp")
+        self._failover_series = _FAILOVERS_TOTAL.labels(backend="resp")
+        self._conn: _Conn | None = self._connect()
+
+    @property
+    def host(self) -> str:
+        return self.endpoints[self._settled_idx][0]
+
+    @property
+    def port(self) -> int:
+        return self.endpoints[self._settled_idx][1]
+
+    def _connect(self) -> _Conn:
+        """Connect to the first WRITABLE endpoint, walking the ring from
+        the active index. Single-endpoint: a plain connect, no handshake
+        bytes — the classic (plain-Redis-compatible) wire surface.
+        Multi-endpoint: each TCP-reachable candidate gets a pipelined
+        FENCE(known_epoch) + ROLE handshake; unpromoted replicas and
+        fenced stale primaries are skipped (the FENCE declaration is what
+        fences a resurrected old primary — see store/replication.py).
+        Settling on a different endpoint than the previous connection
+        bumps ``failover_generation`` and the failovers counter. Raises
+        ConnectionError when no endpoint is writable — the same outage
+        family the breaker and the dispatchers already handle."""
+        n = len(self.endpoints)
+        if n == 1:
+            return _Conn(*self.endpoints[0])
+        # discovery sweep: handshake EVERY reachable endpoint before
+        # settling, so the highest epoch in the fleet is known first — a
+        # fresh process (known_epoch 0) must not settle on a resurrected
+        # stale primary while the true (higher-epoch) primary is also
+        # reachable, and the stale one gets actively fenced below
+        last_err: Exception | None = None
+        candidates: list[tuple[int, _Conn, int, str | None]] = []
+        for step in range(n):
+            idx = (self._active_idx + step) % n
+            host, port = self.endpoints[idx]
+            try:
+                conn = _Conn(host, port)
+            except OSError as exc:
+                last_err = exc
+                continue
+            try:
+                conn.send_many(
+                    [("FENCE", self.known_epoch), ("ROLE",)]
+                )
+                srv_epoch = conn.recv_reply()
+                role_reply = conn.recv_reply()
+            except (OSError, ConnectionError, resp.RespError) as exc:
+                # RespError too: an endpoint that can't speak the HA
+                # handshake (a plain Redis slipped into a multi-endpoint
+                # ring) is not failover-safe to write through
+                conn.close()
+                last_err = exc
+                continue
+            epoch = srv_epoch if isinstance(srv_epoch, int) else -1
+            self.known_epoch = max(self.known_epoch, epoch)
+            role = role_reply[0] if isinstance(role_reply, list) and role_reply else None
+            candidates.append((idx, conn, epoch, role))
+        # the true primary is the one carrying the fleet's highest epoch;
+        # a "primary" below it is a resurrected stale one — never settle
+        # there (its writes are doomed to -ERR FENCED anyway)
+        best: tuple[int, _Conn, int, str | None] | None = None
+        for cand in candidates:
+            if cand[3] == "primary" and cand[2] >= self.known_epoch:
+                if best is None or cand[2] > best[2]:
+                    best = cand
+        for idx, conn, epoch, role in candidates:
+            if best is not None and conn is best[1]:
+                continue
+            if role == "primary" and epoch < self.known_epoch:
+                # actively fence the stale primary: our first handshake may
+                # have declared a lower epoch than the sweep ended up with
+                try:
+                    conn.send_many([("FENCE", self.known_epoch)])
+                    conn.recv_reply()
+                except (OSError, ConnectionError, resp.RespError):
+                    pass
+            if role != "primary":
+                last_err = ConnectionError(
+                    f"store {self.endpoints[idx][0]}:{self.endpoints[idx][1]} "
+                    f"is {role or 'unknown'}, not primary"
+                )
+            conn.close()
+        if best is None:
+            raise ConnectionError(
+                f"no writable store endpoint among {self.endpoints}"
+                + (f" (last: {last_err})" if last_err else "")
+            )
+        idx, conn, _epoch, _role = best
+        self._active_idx = idx
+        if idx != self._settled_idx:
+            self._settled_idx = idx
+            self.failover_generation += 1
+            self._failover_series.inc()
+        # one atomic tuple for the subscription threads: endpoint and
+        # generation must be read together (a torn host/port-vs-generation
+        # read would pin a subscription to the old endpoint while marking
+        # it current, silencing the bus until an unrelated socket error)
+        host, port = self.endpoints[idx]
+        self._sub_target = (host, port, self.failover_generation)
+        return conn
+
+    def rotate_endpoint(self) -> bool:
+        """Advance the ring so the NEXT connect starts at the following
+        endpoint — the circuit breaker's half-open hook: a probe that
+        failed against a dead-but-black-holing primary (slow connect
+        timeout) immediately probes the replica instead of retrying the
+        same endpoint or waiting out another open window. Returns False
+        on single-endpoint handles (nothing to rotate to)."""
+        if len(self.endpoints) < 2:
+            return False
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+            self._active_idx = (self._active_idx + 1) % len(self.endpoints)
+        return True
 
     def _command(self, *parts: str | bytes | int):
         """Run one command; transparently reconnect once if the server
@@ -242,7 +453,7 @@ class RespStore(TaskStore):
             if self._conn is None:
                 # previous reconnect failed; retry it now (raises if the
                 # server is still down, leaving _conn None for next time)
-                self._conn = _Conn(self.host, self.port)
+                self._conn = self._connect()
             try:
                 # deliberate I/O under lock: this lock EXISTS to serialize
                 # use of the one connection (RESP replies are positional)
@@ -259,7 +470,7 @@ class RespStore(TaskStore):
                 # any retry must go through a fresh connection
                 self._conn.close()
                 self._conn = None
-                conn = _Conn(self.host, self.port)  # may raise: _conn stays None
+                conn = self._connect()  # may raise: _conn stays None
                 self._conn = conn
                 if str(parts[0]).upper() in _NON_IDEMPOTENT:
                     raise
@@ -286,7 +497,7 @@ class RespStore(TaskStore):
             if self._closed:
                 raise ConnectionError("store client is closed")
             if self._conn is None:
-                self._conn = _Conn(self.host, self.port)
+                self._conn = self._connect()
             conn = self._conn
             try:
                 # deliberate I/O under lock (see _command): one connection,
@@ -609,7 +820,47 @@ class RespStore(TaskStore):
             raise errors[0]
 
     def subscribe(self, channel: str) -> Subscription:
-        return _RespSubscription(self.host, self.port, channel)
+        # store=self: a multi-endpoint subscription follows the command
+        # path's settled endpoint across failovers (single-endpoint
+        # handles behave exactly as before — the provider returns the one
+        # endpoint forever)
+        return _RespSubscription(self.host, self.port, channel, store=self)
+
+    # -- high availability (store/replication.py) --------------------------
+    def replay_announces(
+        self, after: int
+    ) -> tuple[int, list[tuple[str, str]]]:
+        """Drain the server's bounded announce ring: entries published
+        with replication offset > ``after``, plus the current tail
+        offset. ``after=-1`` fetches the tail alone (offset priming).
+        The dispatcher's post-failover re-arm calls this on the promoted
+        replica to re-discover announces the dead primary published that
+        nobody drained. Raises RespError on servers without REPLAY (a
+        plain Redis) — callers degrade to rescan-only re-arm."""
+        reply = self._command("REPLAY", int(after))
+        if not isinstance(reply, list) or not reply or not isinstance(reply[0], int):
+            raise resp.RespError(f"unexpected REPLAY reply: {reply!r}")
+        tail = reply[0]
+        entries = list(zip(reply[1::2], reply[2::2]))
+        return tail, entries
+
+    def promote(self) -> int:
+        """Promote the ACTIVE endpoint (operator action / failover
+        controller): a replica takes the primary role and bumps the
+        fencing epoch, which this client adopts immediately. Idempotent
+        against an already-primary endpoint."""
+        epoch = self._command("PROMOTE")
+        if isinstance(epoch, int):
+            self.known_epoch = max(self.known_epoch, epoch)
+        return epoch
+
+    def role(self) -> dict:
+        """The active endpoint's replication role: ``{"role", "epoch",
+        "offset"}`` (role is ``primary`` | ``replica`` | ``fenced``)."""
+        reply = self._command("ROLE")
+        if not (isinstance(reply, list) and len(reply) == 3):
+            raise resp.RespError(f"unexpected ROLE reply: {reply!r}")
+        return {"role": reply[0], "epoch": reply[1], "offset": reply[2]}
 
     # -- admin -------------------------------------------------------------
     def save(self, path: str | None = None) -> None:

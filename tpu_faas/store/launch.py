@@ -4,6 +4,9 @@ URL scheme used across CLIs and configs:
 
 - ``memory://``            in-process MemoryStore (single-process modes/tests)
 - ``resp://host:port``     TCP client to any RESP store server (ours or Redis)
+- ``resp://h1:p1,h2:p2``   ordered FAILOVER endpoint list (primary first,
+  replicas after): the client settles on whichever endpoint holds the
+  writable primary role and follows promotions (store/replication.py)
 
 `start_store_thread` runs the Python asyncio server inside a daemon thread and
 returns a handle — used by tests and by single-machine deployments that don't
@@ -44,6 +47,17 @@ def make_store(url: str) -> TaskStore:
                 _SHARED_MEMORY_STORE = MemoryStore()
             return _SHARED_MEMORY_STORE
     if parsed.scheme in ("resp", "redis", "tcp"):
+        if "," in parsed.netloc:
+            # ordered failover list: "h1:p1,h2:p2[,...]" — urlparse can't
+            # digest the comma form, so split it by hand
+            from tpu_faas.store.replication import parse_endpoint
+
+            endpoints = [
+                parse_endpoint(spec)
+                for spec in parsed.netloc.split(",")
+                if spec
+            ]
+            return RespStore(endpoints=endpoints)
         host = parsed.hostname or "127.0.0.1"
         port = parsed.port or 6380
         return RespStore(host, port)
@@ -65,6 +79,9 @@ class StoreServerHandle:
         return f"resp://{self.server.host}:{self.server.port}"
 
     def stop(self) -> None:
+        if self.loop.is_closed():  # idempotent: already stopped
+            return
+
         async def _stop() -> None:
             await self.server.stop()
 
@@ -81,10 +98,20 @@ def start_store_thread(
     port: int = 0,
     snapshot_path: str | None = None,
     autosave_interval: float = 0.0,
+    replica_of: tuple[str, int] | str | None = None,
+    epoch: int = 0,
 ) -> StoreServerHandle:
-    """Start the Python store server in a daemon thread; returns once bound."""
+    """Start the Python store server in a daemon thread; returns once bound.
+    ``replica_of`` starts it as a read-only replica tailing that primary
+    (promote with ``RespStore.promote()``); ``epoch`` seeds the fencing
+    epoch for restarts of previously-promoted stores."""
     server = StoreServer(
-        host, port, snapshot_path=snapshot_path, autosave_interval=autosave_interval
+        host,
+        port,
+        snapshot_path=snapshot_path,
+        autosave_interval=autosave_interval,
+        replica_of=replica_of,
+        epoch=epoch,
     )
     started = threading.Event()
     loop_holder: dict[str, asyncio.AbstractEventLoop] = {}

@@ -42,6 +42,13 @@ class MemoryStore(TaskStore):
         self._lock = threading.RLock()
         self._hashes: dict[str, dict[str, str]] = {}
         self._subs: dict[str, list[_MemorySubscription]] = {}
+        # bounded announce-replay ring, same semantics as the RESP
+        # servers' (store/replication.py AnnounceRing): lets dispatcher
+        # failover re-arm logic be unit-tested without sockets
+        from tpu_faas.store.replication import AnnounceRing
+
+        self._ring = AnnounceRing()
+        self._ring_offset = 0
         self.snapshot_path = snapshot_path
         if snapshot_path is not None:
             self.load(snapshot_path)
@@ -93,8 +100,21 @@ class MemoryStore(TaskStore):
     def publish(self, channel: str, payload: str) -> None:
         with self._lock:
             subs = list(self._subs.get(channel, ()))
+            self._ring_offset += 1
+            self._ring.append(self._ring_offset, channel, payload)
         for sub in subs:
             sub._queue.put(payload)
+
+    def replay_announces(
+        self, after: int
+    ) -> tuple[int, list[tuple[str, str]]]:
+        with self._lock:
+            tail = self._ring.tail
+            if after < 0:
+                return tail, []
+            return tail, [
+                (ch, payload) for _off, ch, payload in self._ring.since(after)
+            ]
 
     def subscribe(self, channel: str) -> Subscription:
         sub = _MemorySubscription(self, channel)

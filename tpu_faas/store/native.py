@@ -81,9 +81,23 @@ def start_native_store(
     timeout: float = 10.0,
     snapshot_path: str | None = None,
     autosave_interval: float = 0.0,
+    replica_of: str | None = None,
 ) -> NativeStoreHandle:
     """Build (if needed) and launch the native store; blocks until it accepts
-    connections."""
+    connections.
+
+    ``replica_of`` is the HA launch hook matching the Python server's
+    ``--replica-of`` (store/replication.py). The C++ server does not
+    implement the replication stream yet, so requesting it here fails
+    fast with a pointer at the Python server instead of launching a
+    store that silently is not a replica."""
+    if replica_of is not None:
+        raise NativeStoreUnavailable(
+            "the native store does not implement the replication stream "
+            "(REPLSYNC) yet; run BOTH ends of an HA pair as "
+            "`python -m tpu_faas.store.server` (the replica as "
+            f"`--replica-of {replica_of}`)"
+        )
     binary = build_native_store()
     if port == 0:
         port = _free_port()

@@ -581,6 +581,36 @@ class RaceCheckStore(TaskStore):
     def ping(self) -> bool:
         return self.inner.ping()
 
+    # -- HA pass-throughs (store/replication.py) ---------------------------
+    # replay delivers announces, not writes — nothing lifecycle-shaped to
+    # observe; dedup/verification happens at dispatcher intake as usual
+    def replay_announces(self, after: int):
+        return self.inner.replay_announces(after)
+
+    @property
+    def failover_generation(self) -> int:
+        return getattr(self.inner, "failover_generation", 0)
+
+    @property
+    def endpoints(self):
+        return getattr(self.inner, "endpoints", None)
+
+    def rotate_endpoint(self) -> bool:
+        fn = getattr(self.inner, "rotate_endpoint", None)
+        return bool(fn()) if fn is not None else False
+
+    def promote(self) -> int:
+        fn = getattr(self.inner, "promote", None)
+        if fn is None:
+            raise NotImplementedError(
+                f"{type(self.inner).__name__} cannot be promoted"
+            )
+        return fn()
+
+    def info(self) -> dict:
+        fn = getattr(self.inner, "info", None)
+        return fn() if fn is not None else {}
+
     def save(self, path: str | None = None) -> None:
         self.inner.save(path)
 

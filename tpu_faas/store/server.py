@@ -12,8 +12,17 @@ UNSUBSCRIBE, FLUSHDB, SAVE, QUIT, SHUTDOWN.
 
 Checkpoint/resume: ``--snapshot PATH`` loads PATH at startup and saves to it
 on SAVE (no path argument), on SHUTDOWN/stop, and every ``--autosave`` seconds
-while dirty. Format: tpu_faas/store/snapshot.py (replayable RESP HSET log,
-shared with the native server).
+while dirty. Format: tpu_faas/store/snapshot.py (replayable RESP command log
+with DEL records, shared with the native server and the replication sync).
+
+High availability: ``--replica-of host:port`` starts this server as a
+read-only replica tailing that primary's write stream
+(tpu_faas/store/replication.py): full snapshot sync, then every mutating
+command in order, replicated PUBLISHes fanning out to local subscribers
+and landing in the bounded announce ring that backs ``REPLAY``. A replica
+accepts writes only after an explicit ``PROMOTE`` (which bumps the fencing
+epoch); ``--epoch N`` restarts a previously-promoted store with its epoch
+intact.
 
 Run: ``python -m tpu_faas.store.server --port 6380``.
 """
@@ -27,6 +36,21 @@ import signal
 from typing import Iterable
 
 from tpu_faas.store import resp, snapshot
+from tpu_faas.store.replication import (
+    FENCED_ERR,
+    MUTATING_COMMANDS,
+    READONLY_ERR,
+    AnnounceRing,
+    ReplicaLink,
+    ReplicationState,
+    parse_endpoint,
+)
+
+#: Bound on the deleted-keys set carried into the next snapshot: the
+#: tombstones exist so a checkpoint can EXPRESS deletions (snapshot.py);
+#: past the cap the oldest are dropped — they are then simply absent from
+#: the dump, which is the pre-tombstone behavior, never wrong state.
+_TOMBSTONE_CAP = 100_000
 
 
 class StoreState:
@@ -37,6 +61,10 @@ class StoreState:
         # all open connections, so stop() can close them (Python 3.12's
         # Server.wait_closed() blocks until every handler returns)
         self.conns: set[asyncio.StreamWriter] = set()
+        # keys deleted since the last checkpoint, insertion-ordered (a
+        # dict so the cap can drop oldest-first); written as DEL records
+        # into the next snapshot so a replayed log can't resurrect them
+        self.tombstones: dict[str, None] = {}
 
 
 class StoreServer:
@@ -46,6 +74,9 @@ class StoreServer:
         port: int = 6380,
         snapshot_path: str | None = None,
         autosave_interval: float = 0.0,
+        replica_of: tuple[str, int] | str | None = None,
+        epoch: int = 0,
+        announce_ring: int = 0,
     ) -> None:
         self.host = host
         self.port = port
@@ -56,6 +87,17 @@ class StoreServer:
         self._shutdown = asyncio.Event()
         self._dirty = False
         self._autosave_task: asyncio.Task | None = None
+        if isinstance(replica_of, str):
+            replica_of = parse_endpoint(replica_of)
+        self.replica_of = replica_of
+        self.repl = ReplicationState(
+            role="replica" if replica_of is not None else "primary",
+            epoch=int(epoch),
+        )
+        if announce_ring > 0:
+            self.repl.ring = AnnounceRing(announce_ring)
+        self._link: ReplicaLink | None = None
+        self._link_down_logged = False
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -66,6 +108,9 @@ class StoreServer:
         )
         # If port was 0, record the actual bound port.
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.replica_of is not None:
+            self._link = ReplicaLink(self, *self.replica_of)
+            self._link.start()
         if self.snapshot_path is not None and self.autosave_interval > 0:
             self._autosave_task = asyncio.create_task(self._autosave_loop())
 
@@ -80,6 +125,8 @@ class StoreServer:
             # subscriber still attached would hang the process forever.
             if self._autosave_task is not None:
                 self._autosave_task.cancel()
+            if self._link is not None:
+                self._link.stop()
             for w in list(self.state.conns):
                 w.close()
 
@@ -90,6 +137,8 @@ class StoreServer:
             print(f"shutdown snapshot save failed: {exc}", flush=True)
         if self._autosave_task is not None:
             self._autosave_task.cancel()
+        if self._link is not None:
+            self._link.stop()
         self._shutdown.set()
         if self._server is not None:
             self._server.close()
@@ -101,8 +150,132 @@ class StoreServer:
     # -- checkpointing -----------------------------------------------------
     def _save_if_configured(self) -> None:
         if self.snapshot_path is not None:
-            snapshot.save_file(self.snapshot_path, self.state.hashes)
+            snapshot.save_file(
+                self.snapshot_path,
+                self.state.hashes,
+                deleted=list(self.state.tombstones),
+            )
+            # the file is now a complete point-in-time dump WITH these
+            # deletions recorded; start the next delta window empty
+            self.state.tombstones.clear()
             self._dirty = False
+
+    # -- replication plumbing ----------------------------------------------
+    def _note_deleted(self, key: str) -> None:
+        """A key vanished (DEL, or HDEL emptied it): tombstone it for the
+        next snapshot so a replayed log cannot resurrect it."""
+        ts = self.state.tombstones
+        ts.pop(key, None)  # re-insert at the tail (ordered dict semantics)
+        ts[key] = None
+        while len(ts) > _TOMBSTONE_CAP:
+            ts.pop(next(iter(ts)))
+
+    def _replicate(self, parts: list[str]) -> None:
+        """A mutating command was applied: advance the replication offset,
+        record PUBLISHes in the announce ring, and forward the command
+        verbatim to every attached replica stream — BEFORE the caller's
+        reply is written, so an acknowledged write has at least reached
+        the kernel send buffer toward each live replica when this
+        process dies (the zero-loss-failover half-promise; the rescan and
+        announce replay cover the rest)."""
+        self.repl.offset += 1
+        name = parts[0].upper()
+        if name == "PUBLISH":
+            self.repl.ring.append(self.repl.offset, parts[1], parts[2])
+        elif name == "FLUSHDB":
+            self.repl.ring.clear()
+            self.state.tombstones.clear()
+        if self.repl.replicas:
+            data = resp.encode_command(*parts)
+            for w in list(self.repl.replicas):
+                if w.is_closing():
+                    self.repl.replicas.pop(w, None)
+                    continue
+                try:
+                    w.write(data)
+                except (ConnectionResetError, BrokenPipeError):
+                    self.repl.replicas.pop(w, None)
+
+    def apply_replicated(self, cmd: list) -> None:
+        """Replica side: apply one command from the primary's stream.
+        Commands arrive in primary execution order; anything outside the
+        mutating set is ignored (future-proofing — an upgraded primary
+        must not crash an older replica). Chained replication falls out:
+        applying re-forwards through _replicate to OUR replicas."""
+        if not cmd or not isinstance(cmd[0], str):
+            return
+        name, args = cmd[0].upper(), [str(a) for a in cmd[1:]]
+        if name not in MUTATING_COMMANDS:
+            return
+        st = self.state
+        if name == "HSET":
+            h = st.hashes.setdefault(args[0], {})
+            for f, v in zip(args[1::2], args[2::2]):
+                h[f] = v
+        elif name == "HSETNX":
+            h = st.hashes.setdefault(args[0], {})
+            h.setdefault(args[1], args[2])
+        elif name == "HDEL":
+            h = st.hashes.get(args[0])
+            if h is not None:
+                for f in args[1:]:
+                    h.pop(f, None)
+                if not h:
+                    del st.hashes[args[0]]
+                    self._note_deleted(args[0])
+        elif name == "DEL":
+            for k in args:
+                if st.hashes.pop(k, None) is not None:
+                    self._note_deleted(k)
+        elif name == "PUBLISH":
+            # local fan-out (fire-and-forget, like the primary's own) so
+            # subscribers attached to the replica see the announce stream
+            asyncio.ensure_future(self._publish(args[0], args[1]))
+        elif name == "FLUSHDB":
+            st.hashes.clear()
+        self._dirty = True
+        self._replicate([name, *args])
+
+    def load_replicated_snapshot(
+        self, hashes: dict[str, dict[str, str]], epoch: int, offset: int
+    ) -> None:
+        """Replica side: adopt the primary's full-sync state (REPLSYNC
+        header + snapshot). Replaces local hashes wholesale — a fresh
+        point-in-time dump needs no tombstones."""
+        self.state.hashes = hashes
+        self.state.tombstones.clear()
+        self.repl.epoch = epoch
+        self.repl.offset = offset
+        self._dirty = True
+        self._link_down_logged = False
+        print(
+            f"replica synced from {self.replica_of}: epoch={epoch} "
+            f"offset={offset} keys={len(hashes)}",
+            flush=True,
+        )
+
+    def note_link_down(self, exc: BaseException) -> None:
+        if not self._link_down_logged:
+            self._link_down_logged = True
+            print(
+                f"replication link to {self.replica_of} lost ({exc}); "
+                "retrying until promoted or the primary returns",
+                flush=True,
+            )
+
+    def promote(self) -> int:
+        """Replica -> primary: stop tailing, take writes, bump the fencing
+        epoch. Idempotent on an already-primary server (epoch unchanged —
+        a retried PROMOTE must not burn fencing generations)."""
+        if self.repl.role != "replica":
+            return self.repl.epoch
+        if self._link is not None:
+            self._link.stop()
+            self._link = None
+        self.repl.role = "primary"
+        self.repl.epoch += 1
+        print(f"promoted to primary (epoch {self.repl.epoch})", flush=True)
+        return self.repl.epoch
 
     async def _autosave_loop(self) -> None:
         while True:
@@ -149,6 +322,7 @@ class StoreServer:
             pass
         finally:
             self.state.conns.discard(writer)
+            self.repl.replicas.pop(writer, None)
             for ch in subscribed:
                 self.state.subs.get(ch, set()).discard(writer)
             writer.close()
@@ -161,6 +335,17 @@ class StoreServer:
     ) -> bool:
         name, args = cmd[0].upper(), cmd[1:]
         st = self.state
+        if name in MUTATING_COMMANDS:
+            # HA write gating, BEFORE any state is touched: an unpromoted
+            # replica is read-only (its state is the primary's to write),
+            # and a fenced stale primary refuses everyone — including
+            # epoch-oblivious legacy clients (see replication.py)
+            if self.repl.role == "replica":
+                writer.write(resp.encode_error(READONLY_ERR))
+                return True
+            if self.repl.fenced:
+                writer.write(resp.encode_error(FENCED_ERR))
+                return True
         if name == "PING":
             writer.write(resp.encode_simple("PONG"))
         elif name == "SELECT":
@@ -175,8 +360,93 @@ class StoreServer:
                 f"channels:{len(st.subs)}",
                 f"dirty:{int(self._dirty)}",
                 f"snapshot_path:{self.snapshot_path or ''}",
+                # -- replication introspection (replication.py) ----------
+                f"role:{'fenced' if self.repl.fenced else self.repl.role}",
+                f"epoch:{self.repl.epoch}",
+                f"repl_offset:{self.repl.offset}",
+                f"repl_replicas:{len(self.repl.replicas)}",
+                f"repl_min_acked:{self.repl.min_acked()}",
+                f"repl_lag:{self.repl.lag()}",
+                f"repl_link_up:{int(self._link.synced) if self._link else 0}",
+                f"announce_ring:{len(self.repl.ring)}",
             ]
             writer.write(resp.encode_bulk("\n".join(lines)))
+        elif name == "ROLE":
+            # [role, epoch, offset]: the client failover handshake's "can
+            # this endpoint take writes?" probe (store/client.py _connect)
+            role = "fenced" if self.repl.fenced else self.repl.role
+            writer.write(
+                resp.encode_array(
+                    [
+                        resp.encode_bulk(role),
+                        resp.encode_integer(self.repl.epoch),
+                        resp.encode_integer(self.repl.offset),
+                    ]
+                )
+            )
+        elif name == "FENCE":
+            # epoch declaration: a client that has seen a promotion
+            # declares the highest epoch it knows. A PRIMARY seeing a
+            # declaration above its own epoch has been superseded — fence
+            # it permanently. Replies with this server's epoch so the
+            # client's knowledge is monotone too.
+            try:
+                declared = int(args[0]) if args else 0
+            except ValueError:
+                writer.write(resp.encode_error("FENCE needs an integer epoch"))
+                return True
+            if declared > self.repl.epoch and self.repl.role == "primary":
+                if not self.repl.fenced:
+                    self.repl.fenced = True
+                    print(
+                        f"fenced: a client declared epoch {declared} > "
+                        f"our {self.repl.epoch}; refusing writes",
+                        flush=True,
+                    )
+            writer.write(resp.encode_integer(self.repl.epoch))
+        elif name == "PROMOTE":
+            writer.write(resp.encode_integer(self.promote()))
+        elif name == "REPLSYNC":
+            # full sync + stream registration, atomically (no await between
+            # the snapshot and the registration, so no command is missed
+            # or doubled): [epoch, offset, snapshot] then raw forwarded
+            # commands ride this connection forever
+            snap = snapshot.dump_hashes(st.hashes)
+            writer.write(
+                resp.encode_array(
+                    [
+                        resp.encode_integer(self.repl.epoch),
+                        resp.encode_integer(self.repl.offset),
+                        resp.encode_bulk(snap),
+                    ]
+                )
+            )
+            self.repl.replicas[writer] = self.repl.offset
+        elif name == "REPLACK":
+            # reply-less by design: the primary->replica direction of this
+            # connection is the replication stream, and an interleaved
+            # "+OK" would corrupt it
+            try:
+                acked = int(args[0])
+            except (IndexError, ValueError):
+                return True
+            if writer in self.repl.replicas:
+                self.repl.replicas[writer] = acked
+        elif name == "REPLAY":
+            # announce-ring replay: [tail, ch, payload, ch, payload ...]
+            # for entries with offset > after; after=-1 asks for the tail
+            # alone (the dispatcher's offset-priming read)
+            try:
+                after = int(args[0]) if args else -1
+            except ValueError:
+                writer.write(resp.encode_error("REPLAY needs an integer offset"))
+                return True
+            items = [resp.encode_integer(self.repl.ring.tail)]
+            if after >= 0:
+                for _off, ch, payload in self.repl.ring.since(after):
+                    items.append(resp.encode_bulk(ch))
+                    items.append(resp.encode_bulk(payload))
+            writer.write(resp.encode_array(items))
         elif name == "HSET":
             if len(args) < 3 or len(args) % 2 == 0:
                 writer.write(resp.encode_error("wrong number of arguments for HSET"))
@@ -188,6 +458,7 @@ class StoreServer:
                     added += 1
                 h[f] = v
             self._dirty = True
+            self._replicate(["HSET", *args])
             writer.write(resp.encode_integer(added))
         elif name == "HGET":
             if len(args) != 2:
@@ -217,6 +488,7 @@ class StoreServer:
             else:
                 h[args[1]] = args[2]
                 self._dirty = True
+                self._replicate(["HSETNX", *args])
                 writer.write(resp.encode_integer(1))
         elif name == "HDEL":
             if len(args) < 2:
@@ -231,8 +503,10 @@ class StoreServer:
                         removed += 1
                 if not h:  # Redis semantics: empty hash = absent key
                     del st.hashes[args[0]]
+                    self._note_deleted(args[0])
             if removed:
                 self._dirty = True
+                self._replicate(["HDEL", *args])
             writer.write(resp.encode_integer(removed))
         elif name == "HMGET":
             if len(args) < 2:
@@ -253,8 +527,11 @@ class StoreServer:
             n = 0
             for k in args:
                 if st.hashes.pop(k, None) is not None:
+                    self._note_deleted(k)
                     n += 1
             self._dirty = self._dirty or n > 0
+            if n:
+                self._replicate(["DEL", *args])
             writer.write(resp.encode_integer(n))
         elif name == "KEYS":
             pattern = args[0] if args else "*"
@@ -264,6 +541,11 @@ class StoreServer:
             if len(args) != 2:
                 writer.write(resp.encode_error("wrong number of arguments for PUBLISH"))
                 return True
+            # replicate BEFORE replying: the announce reaches the
+            # replica's ring (and its subscribers) no later than the
+            # publisher's acknowledgment — what makes post-failover
+            # REPLAY a trustworthy re-discovery source
+            self._replicate(["PUBLISH", args[0], args[1]])
             n = await self._publish(args[0], args[1])
             writer.write(resp.encode_integer(n))
         elif name == "SUBSCRIBE":
@@ -296,6 +578,7 @@ class StoreServer:
         elif name == "FLUSHDB":
             st.hashes.clear()
             self._dirty = True
+            self._replicate(["FLUSHDB"])
             writer.write(resp.encode_simple("OK"))
         elif name == "SAVE":
             target = args[0] if args else self.snapshot_path
@@ -305,11 +588,17 @@ class StoreServer:
                 )
                 return True
             try:
-                snapshot.save_file(target, st.hashes)
+                snapshot.save_file(
+                    target, st.hashes, deleted=list(st.tombstones)
+                )
             except OSError as exc:
                 writer.write(resp.encode_error(f"SAVE failed: {exc}"))
                 return True
             if target == self.snapshot_path:
+                # delta window restarts only for the CONFIGURED target —
+                # an ad-hoc SAVE elsewhere must not eat the tombstones the
+                # next checkpoint still needs to record
+                st.tombstones.clear()
                 self._dirty = False
             writer.write(resp.encode_simple("OK"))
         elif name == "QUIT":
@@ -370,11 +659,38 @@ def main(argv: list[str] | None = None) -> None:
         default=0.0,
         help="seconds between automatic snapshots while dirty (0 = off)",
     )
+    ap.add_argument(
+        "--replica-of",
+        default=None,
+        metavar="HOST:PORT",
+        help="start as a read-only replica tailing this primary's write "
+        "stream; accepts writes only after an explicit PROMOTE command",
+    )
+    ap.add_argument(
+        "--epoch",
+        type=int,
+        default=0,
+        help="fencing epoch to start with (restart a previously-promoted "
+        "store with its post-promotion epoch so old primaries stay fenced)",
+    )
+    ap.add_argument(
+        "--announce-ring",
+        type=int,
+        default=0,
+        help="override the bounded announce-replay ring size "
+        "(default 10000 entries)",
+    )
     ns = ap.parse_args(argv)
 
     async def run() -> None:
         server = StoreServer(
-            ns.host, ns.port, snapshot_path=ns.snapshot, autosave_interval=ns.autosave
+            ns.host,
+            ns.port,
+            snapshot_path=ns.snapshot,
+            autosave_interval=ns.autosave,
+            replica_of=ns.replica_of,
+            epoch=ns.epoch,
+            announce_ring=ns.announce_ring,
         )
         await server.start()
         # graceful kill/Ctrl-C must checkpoint, like the native server's
