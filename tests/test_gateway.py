@@ -732,6 +732,99 @@ def test_idempotency_abandoned_claim_adopted():
         handle.stop()
 
 
+def test_adopted_claim_response_carries_callers_trace_id():
+    """Adoption writes THIS caller's trace context onto the record (the
+    winner died before writing one) — so unlike a plain dedup hit, the
+    response must return the caller's trace_id: it IS the id on the
+    record, and the client needs it to correlate logs and key /trace."""
+    from tpu_faas.gateway.app import (
+        _IDEM_CLAIM_FIELD,
+        _idem_claim_value,
+        _idempotent_task_id,
+    )
+    from tpu_faas.core.task import FIELD_TRACE_ID
+
+    store = MemoryStore()
+    handle = start_gateway_thread(store, trace=True)
+    try:
+        fid = requests.post(
+            f"{handle.url}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        payload = serialize(((7,), {}))
+        tid = _idempotent_task_id(fid, "dead-winner")
+        store.hset(tid, {_IDEM_CLAIM_FIELD: _idem_claim_value(payload)})
+        r = requests.post(
+            f"{handle.url}/execute_function",
+            json={
+                "function_id": fid,
+                "payload": payload,
+                "idempotency_key": "dead-winner",
+                "trace_id": "aabbccdd11223344",
+            },
+        )
+        assert r.status_code == 200
+        got = r.json()
+        assert got.get("deduplicated") is True
+        assert got.get("trace_id") == "aabbccdd11223344"
+        assert store.hget(tid, FIELD_TRACE_ID) == "aabbccdd11223344"
+
+        # a PLAIN dedup hit (record exists) still suppresses trace_id —
+        # the record carries the winner's id, not this caller's
+        dup = requests.post(
+            f"{handle.url}/execute_function",
+            json={
+                "function_id": fid,
+                "payload": payload,
+                "idempotency_key": "dead-winner",
+                "trace_id": "ffff0000ffff0000",
+            },
+        ).json()
+        assert dup.get("deduplicated") is True
+        assert "trace_id" not in dup
+        assert store.hget(tid, FIELD_TRACE_ID) == "aabbccdd11223344"
+    finally:
+        handle.stop()
+
+
+def test_batch_duplicate_trace_ids_rejected():
+    """Two batch items sharing one client-minted trace id would fight
+    over the same span hash (identical process:stage fields lose the
+    first-write-wins race) — a 400, mirroring duplicate idempotency_keys."""
+    store = MemoryStore()
+    handle = start_gateway_thread(store, trace=True)
+    try:
+        fid = requests.post(
+            f"{handle.url}/register_function",
+            json={"name": "arith", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        payloads = [serialize(((i,), {})) for i in range(2)]
+        r = requests.post(
+            f"{handle.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": payloads,
+                "trace_ids": ["aabbccdd11223344", "aabbccdd11223344"],
+            },
+        )
+        assert r.status_code == 400
+        assert "duplicates" in r.json()["error"]
+        # distinct ids (and holes, minted server-side) still pass
+        ok = requests.post(
+            f"{handle.url}/execute_batch",
+            json={
+                "function_id": fid,
+                "payloads": payloads,
+                "trace_ids": ["aabbccdd11223344", None],
+            },
+        )
+        assert ok.status_code == 200
+        tids = ok.json()["trace_ids"]
+        assert tids[0] == "aabbccdd11223344" and tids[1]
+    finally:
+        handle.stop()
+
+
 def test_batch_duplicate_idempotency_keys_rejected():
     """Two items with one idempotency_key in a single batch is a client
     error (400) — the claim round would silently dedup the second against

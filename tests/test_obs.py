@@ -667,6 +667,211 @@ def test_trace_endpoint_full_stack_e2e():
         store_handle.stop()
 
 
+def test_cross_process_trace_assembly_e2e():
+    """The distributed-tracing acceptance path: gateway with ``--trace``,
+    tpu-push dispatcher, a REAL push-worker subprocess, and a trace-minting
+    SDK client. ``GET /trace/<task_id>`` on the GATEWAY must assemble the
+    cross-process timeline — >= 3 processes (gateway, dispatcher, worker)
+    and >= 8 stages, including the gateway observe span (the poll gap no
+    dispatcher-local view can see) — and the handle's trace id must be the
+    assembled trace's key."""
+    import threading
+
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.workloads import sleep_task
+    from tests.test_workers_e2e import _spawn_worker
+
+    store_handle = start_store_thread()
+    gw = start_gateway_thread(make_store(store_handle.url), trace=True)
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(store_handle.url),
+        max_workers=16,
+        max_pending=64,
+        max_inflight=128,
+        tick_period=0.01,
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    worker = _spawn_worker(
+        "push_worker", 2, f"tcp://127.0.0.1:{disp.port}", "--hb"
+    )
+    client = FaaSClient(gw.url, trace=True)
+    try:
+        fid = client.register(sleep_task)
+        handle = client.submit(fid, 0.1)
+        assert handle.trace_id is not None
+        assert handle.result(timeout=120) == 0.1
+
+        # spans flush on ~0.25 s cadences (dispatcher serve loop, gateway
+        # background task): poll until the full catalog assembles
+        deadline = time.monotonic() + 20
+        tl = None
+        while time.monotonic() < deadline:
+            r = requests.get(f"{gw.url}/trace/{handle.task_id}")
+            if r.status_code == 200:
+                tl = r.json()
+                if len(tl["processes"]) >= 3 and tl["n_stages"] >= 9:
+                    break
+            time.sleep(0.2)
+        assert tl is not None, "trace never assembled"
+        assert tl["trace_id"] == handle.trace_id
+        assert set(tl["processes"]) >= {"gateway", "dispatcher", "worker"}
+        assert tl["n_stages"] >= 8, tl
+        stages = {(s["process"], s["stage"]) for s in tl["spans"]}
+        for expected in (
+            ("gateway", "admit"),
+            ("gateway", "create"),
+            ("dispatcher", "intake"),
+            ("dispatcher", "queue"),
+            ("dispatcher", "dispatch"),
+            ("dispatcher", "inflight"),
+            ("dispatcher", "finalize"),
+            ("worker", "exec"),
+        ):
+            assert expected in stages, (expected, stages)
+        # the worker-measured exec window survived the trip
+        [exec_span] = [s for s in tl["spans"] if s["stage"] == "exec"]
+        assert 0.05 <= exec_span["duration_s"] <= 5.0
+        assert all(s["duration_s"] >= 0 for s in tl["spans"])
+        # an unknown task still 404s
+        assert requests.get(f"{gw.url}/trace/ghost").status_code == 404
+        # the e2e histograms observed the delivery; /slo serves
+        fams = parse_exposition(requests.get(f"{gw.url}/metrics").text)
+        counts = {
+            s.labels["phase"]: s.value
+            for s in fams["tpu_faas_task_e2e_seconds"].samples
+            if s.name.endswith("_count")
+        }
+        assert counts["submit_to_observe"] >= 1
+        slo = requests.get(f"{gw.url}/slo").json()
+        assert {o["name"] for o in slo["objectives"]} == {
+            "submit_to_finish", "submit_to_observe",
+        }
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        disp.close()
+        gw.stop()
+        store_handle.stop()
+
+
+def test_gateway_trace_off_runs_unchanged():
+    """With tracing off (the default) the submit surface is byte-identical
+    to the pre-trace contract: no trace_id in responses, no trace field on
+    records, no span hashes in the store — and /trace/<id> still resolves
+    (zero spans) instead of 404ing a real task."""
+    from tpu_faas.core.task import FIELD_TRACE_ID
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.obs.tracectx import TRACE_PREFIX
+
+    store = MemoryStore()
+    gw = start_gateway_thread(store)
+    try:
+        r = requests.post(
+            f"{gw.url}/register_function",
+            json={"name": "f", "payload": "P"},
+        )
+        fid = r.json()["function_id"]
+        r = requests.post(
+            f"{gw.url}/execute_function",
+            # a client-minted trace id is IGNORED while tracing is off
+            json={"function_id": fid, "payload": "x", "trace_id": "ab" * 8},
+        )
+        body = r.json()
+        assert "trace_id" not in body
+        assert FIELD_TRACE_ID not in store.hgetall(body["task_id"])
+        assert not [k for k in store.keys() if k.startswith(TRACE_PREFIX)]
+        r = requests.get(f"{gw.url}/trace/{body['task_id']}")
+        assert r.status_code == 200
+        assert r.json()["spans"] == [] and r.json()["trace_id"] is None
+    finally:
+        gw.stop()
+
+
+class _PingFailStore(MemoryStore):
+    def __init__(self) -> None:
+        super().__init__()
+        self.fail_ping = False
+
+    def ping(self) -> bool:
+        if self.fail_ping:
+            raise ConnectionError("store down")
+        return True
+
+
+def test_gateway_readyz_liveness_vs_readiness():
+    from tpu_faas.gateway import start_gateway_thread
+
+    store = _PingFailStore()
+    gw = start_gateway_thread(store)
+    try:
+        assert requests.get(f"{gw.url}/healthz").status_code == 200
+        r = requests.get(f"{gw.url}/readyz")
+        assert r.status_code == 200 and r.json()["ready"] is True
+        store.fail_ping = True
+        r = requests.get(f"{gw.url}/readyz")
+        assert r.status_code == 503
+        assert r.json() == {"ready": False, "reason": "store_unreachable"}
+        # liveness stays green: a degraded gateway is drained, not killed
+        assert requests.get(f"{gw.url}/healthz").status_code == 200
+    finally:
+        store.fail_ping = False
+        gw.stop()
+
+
+def test_dispatcher_readyz_and_slo_endpoints():
+    store, disp = _drive_dispatcher()
+    server = disp.serve_stats(0)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        assert requests.get(f"{base}/healthz").status_code == 200
+        r = requests.get(f"{base}/readyz")
+        assert r.status_code == 200 and r.json()["ready"] is True
+        slo = requests.get(f"{base}/slo").json()
+        assert {o["name"] for o in slo["objectives"]} == {
+            "submit_to_result", "queue_wait",
+        }
+        disp._store_down = True
+        r = requests.get(f"{base}/readyz")
+        assert r.status_code == 503
+        assert r.json()["reason"] == "store_unreachable"
+        assert requests.get(f"{base}/healthz").status_code == 200
+    finally:
+        disp.socket.close(linger=0)
+        disp.stop()
+        disp.close()
+
+
+def test_announce_for_terminal_task_closes_timeline():
+    """An announce drained for an already-terminal record (cancelled
+    before any dispatcher saw it) opens a timeline at drain time that
+    nothing downstream would ever close — the intake skip must stamp it
+    finished with the record's terminal status instead of letting it age
+    out of the active ring."""
+    store, disp = _drive_dispatcher()
+    try:
+        _submit(store, "skip-1")
+        assert store.cancel_task("skip-1") == "CANCELLED"
+        # the announce is still on the bus: intake drains it, sees the
+        # non-QUEUED record, and must close the timeline it just opened
+        disp.tick()
+        rec = disp.traces.timeline("skip-1")
+        assert rec is not None, "timeline lost instead of closed"
+        assert rec["outcome"] == "CANCELLED"
+        assert disp.traces.stats()["active"] == 0
+    finally:
+        disp.socket.close(linger=0)
+        disp.close()
+
+
 def test_zombie_second_result_does_not_resurrect_timeline():
     """A late duplicate RESULT for an already-finished task (zombie worker
     of a re-dispatched task) must not reopen the closed timeline — no

@@ -1,0 +1,714 @@
+"""Distributed trace context + latency-SLO plane (tpu_faas/obs/tracectx,
+tpu_faas/obs/slo): trace-id validation, span codec, the buffered
+first-write-wins SpanSink (duplicates counted, outages absorbed, buffer
+bounded), cross-process timeline assembly, span-hash TTL sweeping, SLO
+objective parsing + multi-window burn rates, the trace book's
+first-write-wins duplicate counter + terminal-labeled stage histogram,
+and strict exposition conformance for every metric family this plane (and
+the PR-6 HA work) added."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from tpu_faas.core.task import (
+    FIELD_STATUS,
+    FIELD_SUBMITTED_AT,
+    FIELD_TRACE_ID,
+)
+from tpu_faas.obs import MetricsRegistry, TaskTraceBook, render
+from tpu_faas.obs.expofmt import parse_exposition, require_series
+from tpu_faas.obs.slo import (
+    Objective,
+    SLOTracker,
+    objectives_from_env,
+    parse_objectives,
+)
+from tpu_faas.obs.tracectx import (
+    TRACE_AT_FIELD,
+    SpanSink,
+    assemble_timeline,
+    decode_span,
+    encode_span,
+    new_trace_id,
+    sweep_stale_traces,
+    trace_key,
+    valid_trace_id,
+)
+from tpu_faas.store.memory import MemoryStore
+
+
+# -- trace ids + span codec --------------------------------------------------
+
+
+def test_trace_id_validation():
+    assert valid_trace_id(new_trace_id())
+    assert valid_trace_id("deadbeef")  # 8 hex chars: minimum
+    assert not valid_trace_id("DEADBEEF")  # uppercase rejected
+    assert not valid_trace_id("dead")  # too short
+    assert not valid_trace_id("g" * 16)  # non-hex
+    assert not valid_trace_id("a" * 65)  # too long
+    assert not valid_trace_id(12345)  # non-string becomes no store key
+    assert not valid_trace_id(None)
+
+
+def test_span_codec_round_trip_and_garbage():
+    raw = encode_span(1.25, 2.5, {"outcome": "COMPLETED"})
+    assert decode_span("worker:exec", raw) == (
+        "worker", "exec", 1.25, 2.5, {"outcome": "COMPLETED"},
+    )
+    # stage names may contain ':' themselves — split once on the left
+    assert decode_span("a:b:c", raw)[1] == "b:c"
+    assert decode_span("nofield", raw) is None  # no process separator
+    assert decode_span("p:s", "not json") is None
+    assert decode_span("p:s", '{"a": 1}') is None  # wrong shape
+    # non-dict attrs degrade to {} instead of breaking assembly
+    assert decode_span("p:s", "[1.0, 2.0, 7]")[4] == {}
+
+
+# -- SpanSink ----------------------------------------------------------------
+
+
+def test_span_sink_flush_is_first_write_wins():
+    store = MemoryStore()
+    r = MetricsRegistry()
+    sink = SpanSink(store=store, process="gateway", registry=r)
+    tid = new_trace_id()
+    sink.emit(tid, "admit", 10.0, 10.5)
+    assert len(sink) == 1
+    assert sink.flush() == 1
+    # a replay re-emits the same span with DIFFERENT stamps: the original
+    # must stand, the duplicate must be counted
+    sink.emit(tid, "admit", 99.0, 99.9)
+    assert sink.flush() == 0
+    assert sink.n_duplicates == 1
+    raw = store.hgetall(trace_key(tid))
+    assert json.loads(raw["gateway:admit"])[0] == 10.0
+    fams = parse_exposition(render([r]))
+    [dup] = [
+        s
+        for s in fams["tpu_faas_trace_duplicate_events_total"].samples
+        if s.labels.get("event") == "gateway:admit"
+    ]
+    assert dup.value == 1
+    # the TTL stamp landed beside the spans
+    assert TRACE_AT_FIELD in raw
+
+
+def test_span_sink_emit_as_writes_foreign_process():
+    store = MemoryStore()
+    sink = SpanSink(store=store, process="dispatcher")
+    tid = new_trace_id()
+    sink.emit_as("worker", tid, "exec", 1.0, 2.0)
+    sink.flush()
+    assert "worker:exec" in store.hgetall(trace_key(tid))
+
+
+class _OutageStore(MemoryStore):
+    def __init__(self) -> None:
+        super().__init__()
+        self.down = False
+        self.stamp_down = False
+
+    def hsetnx_many(self, items):
+        if self.down:
+            raise ConnectionError("store down")
+        return super().hsetnx_many(items)
+
+    def hset_many(self, items):
+        if self.stamp_down:
+            raise ConnectionError("store down")
+        return super().hset_many(items)
+
+
+def test_span_sink_outage_keeps_buffer_and_retries():
+    store = _OutageStore()
+    sink = SpanSink(store=store, process="gateway")
+    tid = new_trace_id()
+    store.down = True
+    sink.emit(tid, "admit", 1.0, 2.0)
+    assert sink.flush() == 0  # swallowed, not raised
+    assert len(sink) == 1  # batch restored
+    store.down = False
+    assert sink.flush() == 1
+    assert "gateway:admit" in store.hgetall(trace_key(tid))
+
+
+def test_span_sink_stamp_failure_does_not_fabricate_duplicates():
+    """A TTL-stamp write failing AFTER its spans landed must retry ONLY
+    the stamp: restoring the whole batch would re-HSETNX landed spans on
+    the next flush and spike the duplicate counter — the replay-storm
+    alarm — from a single store hiccup."""
+    store = _OutageStore()
+    sink = SpanSink(store=store, process="gateway")
+    tid = new_trace_id()
+    store.stamp_down = True
+    sink.emit(tid, "admit", 1.0, 2.0)
+    assert sink.flush() == 1  # spans landed despite the stamp failure
+    assert len(sink) == 0  # NOT restored
+    assert TRACE_AT_FIELD not in store.hgetall(trace_key(tid))
+    # the parked stamp keeps the sink dirty: flush-gates that check the
+    # buffer alone would strand it (an unstamped hash never sweeps)
+    assert sink.dirty
+    store.stamp_down = False
+    assert sink.flush() == 0  # nothing new to write...
+    assert TRACE_AT_FIELD in store.hgetall(trace_key(tid))  # ...stamp retried
+    assert sink.n_duplicates == 0  # and no duplicates were fabricated
+    assert not sink.dirty
+
+
+def test_span_sink_buffer_bounded_drops_oldest():
+    r = MetricsRegistry()
+    sink = SpanSink(
+        store=MemoryStore(), process="gateway", registry=r, max_buffer=4
+    )
+    for i in range(7):
+        sink.emit(new_trace_id(), f"s{i}", 1.0, 2.0)
+    assert len(sink) == 4
+    assert sink.n_dropped == 3
+    # the SURVIVORS are the newest emits
+    assert {s.field for s in sink._buf} == {
+        "gateway:s3", "gateway:s4", "gateway:s5", "gateway:s6",
+    }
+
+
+# -- assembly ----------------------------------------------------------------
+
+
+def _make_task(store, task_id: str, trace_id: str | None) -> None:
+    fields = {FIELD_STATUS: "COMPLETED", FIELD_SUBMITTED_AT: "100.0"}
+    if trace_id:
+        fields[FIELD_TRACE_ID] = trace_id
+    store.hset(task_id, fields)
+
+
+def test_assemble_timeline_orders_spans_and_reports_gaps():
+    store = MemoryStore()
+    tid = new_trace_id()
+    _make_task(store, "t1", tid)
+    sink = SpanSink(store=store, process="gateway")
+    sink.emit(tid, "admit", 100.0, 100.2)
+    sink.emit(tid, "observe", 101.0, 101.5)
+    sink.emit_as("dispatcher", tid, "queue", 100.2, 100.6)
+    sink.emit_as("worker", tid, "exec", 100.6, 100.8)
+    sink.flush()
+    tl = assemble_timeline(store, "t1")
+    assert tl["trace_id"] == tid
+    assert [s["stage"] for s in tl["spans"]] == [
+        "admit", "queue", "exec", "observe",
+    ]
+    assert tl["processes"] == ["gateway", "dispatcher", "worker"]
+    assert tl["n_stages"] == 4
+    assert tl["total_s"] == pytest.approx(1.5)
+    # covered: [100.0,100.8] + [101.0,101.5] -> 0.2 s gap before observe
+    assert tl["uncovered_s"] == pytest.approx(0.2)
+
+
+def test_assemble_timeline_untraced_and_unknown():
+    store = MemoryStore()
+    _make_task(store, "plain", None)
+    tl = assemble_timeline(store, "plain")
+    assert tl is not None and tl["trace_id"] is None and tl["spans"] == []
+    assert assemble_timeline(store, "ghost") is None
+
+
+def test_assemble_timeline_skips_foreign_garbage_fields():
+    store = MemoryStore()
+    tid = new_trace_id()
+    _make_task(store, "t1", tid)
+    store.hset(
+        trace_key(tid),
+        {
+            "gateway:admit": encode_span(1.0, 2.0, None),
+            "nonsense": "not a span",
+            "p:broken": "{{{",
+            TRACE_AT_FIELD: "1.0",
+        },
+    )
+    tl = assemble_timeline(store, "t1")
+    assert tl["n_stages"] == 1  # garbage skipped, assembly survives
+
+
+def test_sweep_stale_traces_uses_t0_stamp():
+    store = MemoryStore()
+    now = time.time()
+    for name, stamp in (
+        ("old", repr(now - 100.0)),
+        ("fresh", repr(now - 1.0)),
+        ("garbage", "not-a-float"),
+    ):
+        store.hset(trace_key(name), {TRACE_AT_FIELD: stamp, "p:s": "x"})
+    store.hset(trace_key("unstamped"), {"p:s": "x"})
+    stale = sweep_stale_traces(store, store.keys(), ttl=50.0, now=now)
+    assert stale == [trace_key("old")]
+    # non-trace keys are never touched
+    store.hset("task-1", {FIELD_STATUS: "COMPLETED"})
+    assert "task-1" not in sweep_stale_traces(
+        store, store.keys(), ttl=0.0, now=now + 1e6
+    )
+
+
+def test_sweep_stale_traces_spares_live_tasks():
+    """An aged trace hash whose task is still QUEUED/RUNNING must NOT be
+    swept (its stamp only refreshes when new spans flush — a task queued
+    past the TTL would lose its early spans mid-flight); terminal and
+    already-swept tasks collect normally."""
+    from tpu_faas.obs.tracectx import TRACE_TASK_FIELD
+
+    store = MemoryStore()
+    now = time.time()
+    old = repr(now - 100.0)
+    cases = (
+        ("live-q", "t-q", "QUEUED"),
+        ("live-r", "t-r", "RUNNING"),
+        ("done", "t-d", "COMPLETED"),
+        ("gone", "t-gone", None),  # record already swept
+    )
+    for name, tid, status in cases:
+        store.hset(
+            trace_key(name),
+            {TRACE_AT_FIELD: old, TRACE_TASK_FIELD: tid, "p:s": "x"},
+        )
+        if status is not None:
+            store.hset(tid, {FIELD_STATUS: status})
+    stale = sweep_stale_traces(store, store.keys(), ttl=50.0, now=now)
+    assert sorted(stale) == [trace_key("done"), trace_key("gone")]
+
+
+# -- SLO objectives + tracker ------------------------------------------------
+
+
+def test_parse_objectives_good_and_bad():
+    objs = parse_objectives(
+        "fast=total:0.25:0.99, queue=queue_wait:0.1:0.95,"
+    )
+    assert objs == [
+        Objective("fast", "total", 0.25, 0.99),
+        Objective("queue", "queue_wait", 0.1, 0.95),
+    ]
+    for bad in (
+        "noequals",
+        "x=only_two:0.5",
+        "x=s:nan:0.99",
+        "x=s:0.5:1.5",  # target out of (0,1)
+        "x=s:-1:0.5",  # non-positive threshold
+    ):
+        with pytest.raises(ValueError):
+            parse_objectives(bad)
+
+
+def test_objectives_from_env(monkeypatch):
+    default = [Objective("d", "total", 1.0, 0.5)]
+    monkeypatch.delenv("TPU_FAAS_SLO", raising=False)
+    assert objectives_from_env(default) == default
+    monkeypatch.setenv("TPU_FAAS_SLO", "mine=execution:0.5:0.9")
+    assert objectives_from_env(default) == [
+        Objective("mine", "execution", 0.5, 0.9)
+    ]
+
+
+class _FakeHist:
+    """Synthetic SLO source: fixed uppers, mutable per-bucket counts
+    (non-cumulative, overflow slot last — _HistogramChild.snapshot's
+    shape)."""
+
+    def __init__(self) -> None:
+        self.uppers = (0.1, 0.25, 1.0)
+        self.counts = [0, 0, 0, 0]
+
+    def source(self, stage: str):
+        if stage != "total":
+            return None
+        return self.uppers, list(self.counts)
+
+
+def test_slo_tracker_burn_rate_math():
+    clock = [0.0]
+    hist = _FakeHist()
+    r = MetricsRegistry()
+    tracker = SLOTracker(
+        r,
+        [Objective("fast", "total", 0.25, 0.9)],
+        hist.source,
+        clock=lambda: clock[0],
+    )
+    # 8 good (<= 0.25 s), 2 bad -> ratio 0.8, burn (1-0.8)/(1-0.9) = 2.0
+    hist.counts = [5, 3, 1, 1]
+    clock[0] = 10.0
+    snap = tracker.snapshot()
+    w = snap["objectives"][0]["windows"]["5m"]
+    assert w["events"] == 10
+    assert w["good_ratio"] == pytest.approx(0.8)
+    assert w["burn_rate"] == pytest.approx(2.0)
+    # gauges agree at collect time
+    tracker.collect()
+    fams = parse_exposition(render([r]))
+    burn = {
+        s.labels["window"]: s.value
+        for s in fams["tpu_faas_slo_burn_rate"].samples
+        if s.labels["objective"] == "fast"
+    }
+    assert burn["5m"] == pytest.approx(2.0)
+    assert fams["tpu_faas_slo_target_ratio"].samples[0].value == 0.9
+
+
+def test_slo_tracker_threshold_between_buckets_is_conservative():
+    clock = [0.0]
+    hist = _FakeHist()
+    tracker = SLOTracker(
+        MetricsRegistry(),
+        # threshold 0.5 sits BETWEEN the 0.25 and 1.0 boundaries: the
+        # straddling bucket counts BAD, so good = the first two buckets
+        [Objective("mid", "total", 0.5, 0.9)],
+        hist.source,
+        clock=lambda: clock[0],
+    )
+    hist.counts = [4, 4, 2, 0]
+    clock[0] = 10.0
+    w = tracker.snapshot()["objectives"][0]["windows"]["5m"]
+    assert w["good_ratio"] == pytest.approx(0.8)  # 8/10, not 10/10
+
+
+def test_slo_tracker_windows_age_out_old_events():
+    clock = [0.0]
+    hist = _FakeHist()
+    tracker = SLOTracker(
+        MetricsRegistry(),
+        [Objective("fast", "total", 0.25, 0.9)],
+        hist.source,
+        clock=lambda: clock[0],
+    )
+    hist.counts = [0, 0, 0, 10]  # 10 bad events, early
+    clock[0] = 100.0
+    tracker.update()
+    # ~50 min later, no new traffic: the events aged out of the 5 m
+    # window but still sit inside the 1 h one
+    clock[0] = 3000.0
+    tracker.update()
+    snap = tracker.snapshot()
+    w5 = snap["objectives"][0]["windows"]["5m"]
+    assert w5["events"] == 0 and w5["good_ratio"] == 1.0
+    w1h = snap["objectives"][0]["windows"]["1h"]
+    assert w1h["events"] == 10 and w1h["good_ratio"] == 0.0
+
+
+def test_slo_tracker_no_source_stays_quiet():
+    tracker = SLOTracker(
+        MetricsRegistry(),
+        [Objective("ghost", "nope", 0.25, 0.9)],
+        lambda stage: None,
+    )
+    w = tracker.snapshot()["objectives"][0]["windows"]["5m"]
+    assert w["events"] == 0 and w["burn_rate"] == 0.0
+
+
+def test_slo_tracker_source_present_flags_inert_objectives():
+    """A stage name that never matches a histogram (typo, or a stage
+    foreign to this process under a fleet-wide TPU_FAAS_SLO) must be
+    VISIBLY inert: quiet burn gauges alone read as 'perfectly green'."""
+    clock = [0.0]
+    hist = _FakeHist()
+    r = MetricsRegistry()
+    tracker = SLOTracker(
+        r,
+        [
+            Objective("live", "total", 0.25, 0.9),
+            Objective("typo", "totall", 0.25, 0.9),
+        ],
+        hist.source,
+        clock=lambda: clock[0],
+    )
+    clock[0] = 10.0
+    snap = tracker.snapshot()
+    by_name = {o["name"]: o for o in snap["objectives"]}
+    assert by_name["live"]["source_present"] is True
+    assert by_name["typo"]["source_present"] is False
+    fams = parse_exposition(render([r]))
+    present = {
+        s.labels["objective"]: s.value
+        for s in fams["tpu_faas_slo_source_present"].samples
+    }
+    assert present == {"live": 1.0, "typo": 0.0}
+
+
+# -- trace book: first-write-wins + terminal labels + trace ids --------------
+
+
+def test_trace_book_duplicate_events_counted():
+    r = MetricsRegistry()
+    book = TaskTraceBook(r)
+    book.note("t1", "intake", ts=1.0)
+    book.note("t1", "intake", ts=2.0)  # replayed announce
+    book.note("t1", "intake", ts=3.0)
+    fams = parse_exposition(render([r]))
+    [dup] = [
+        s
+        for s in fams["tpu_faas_trace_duplicate_events_total"].samples
+        if s.labels.get("event") == "intake"
+    ]
+    assert dup.value == 2
+    # and the original stamp stood
+    assert book.timeline("t1")["events"]["intake"] == 1.0
+
+
+def test_trace_book_terminal_label_separates_populations():
+    r = MetricsRegistry()
+    book = TaskTraceBook(r)
+    for tid, outcome in (("a", "COMPLETED"), ("b", "expired")):
+        book.note(tid, "announced", ts=1.0)
+        book.note(tid, "scheduled", ts=2.0)
+        book.finish(tid, outcome=outcome, ts=3.0)
+    fams = parse_exposition(render([r]))
+    counts = {
+        (s.labels["stage"], s.labels["terminal"]): s.value
+        for s in fams["tpu_faas_task_stage_seconds"].samples
+        if s.name.endswith("_count")
+    }
+    assert counts[("queue_wait", "COMPLETED")] == 1
+    assert counts[("queue_wait", "expired")] == 1
+    # the SLO source sees ONLY the COMPLETED population by default —
+    # shed tasks must not burn the latency error budget
+    uppers, total = book.stage_snapshot("queue_wait")
+    assert sum(total) == 1
+    _, everything = book.stage_snapshot("queue_wait", terminal=None)
+    assert sum(everything) == 2
+    assert book.stage_snapshot("no_such_stage") is None
+
+
+def test_trace_book_routine_retry_restamps_not_counted_as_duplicates():
+    """The scheduled/sent re-stamps of a reclaimed task's redispatch are
+    normal at-least-once operation (visible as `retries`), NOT a replay
+    storm — counting them would page operators on steady worker churn."""
+    r = MetricsRegistry()
+    book = TaskTraceBook(r)
+    book.note("t1", "scheduled", ts=1.0)
+    book.note("t1", "sent", ts=1.1)
+    book.note_retry("t1")
+    # redispatch after reclaim: caller knows it's routine
+    book.note("t1", "scheduled", ts=2.0, count_dup=False)
+    book.note("t1", "sent", ts=2.1, count_dup=False)
+    # a genuine replay duplicate still counts
+    book.note("t1", "intake", ts=1.0)
+    book.note("t1", "intake", ts=3.0)
+    fams = parse_exposition(render([r]))
+    dups = {
+        s.labels["event"]: s.value
+        for s in fams["tpu_faas_trace_duplicate_events_total"].samples
+        if s.value > 0
+    }
+    assert dups == {"intake": 1}
+    # first stamps stood either way, and the retry is on the record
+    tl = book.timeline("t1")
+    assert tl["events"]["scheduled"] == 1.0 and tl["events"]["sent"] == 1.1
+    assert tl["retries"] == 1
+
+
+def test_trace_book_first_completion_wins_on_replayed_announce():
+    """A replayed announce (store-failover re-arm) for a task whose rich
+    closed record still sits in the ring opens a stub timeline; closing
+    that stub must be DISCARDED — not clobber the record, not double-count
+    the completion — and counted as a suppressed 'finished' duplicate."""
+    r = MetricsRegistry()
+    book = TaskTraceBook(r)
+    book.note("t1", "announced", ts=1.0)
+    book.note("t1", "intake", ts=1.1)
+    book.note("t1", "scheduled", ts=1.2)
+    book.finish("t1", outcome="COMPLETED", ts=2.0)
+    assert book.n_completed == 1
+    rich = book.timeline("t1")
+    assert "intake" in rich["events"]
+    # the replayed announce re-opens a stub, then the terminal-record skip
+    # path closes it again
+    book.note("t1", "announced", ts=50.0)
+    book.finish("t1", outcome="COMPLETED", ts=50.1)
+    assert book.n_completed == 1  # not double-counted
+    assert book.timeline("t1") is rich  # record not clobbered
+    assert all(rec is rich for rec in book.recent() if rec["task_id"] == "t1")
+    fams = parse_exposition(render([r]))
+    [dup] = [
+        s
+        for s in fams["tpu_faas_trace_duplicate_events_total"].samples
+        if s.labels.get("event") == "finished"
+    ]
+    assert dup.value == 1
+
+
+def test_note_dispatch_attaches_trace_for_rescan_adopted_task():
+    """A rescan-adopted task never passes _note_intake: its timeline is
+    opened by note_dispatch's 'scheduled' stamp, and the trace id must
+    attach THERE (note first, then note_trace — note_trace only attaches
+    to an open timeline), or the close hook emits no spans for it."""
+    from tpu_faas.dispatch.base import PendingTask
+    from tpu_faas.dispatch.local import LocalDispatcher
+
+    disp = LocalDispatcher(store=MemoryStore(), num_workers=1)
+    try:
+        task = PendingTask(
+            task_id="adopted-1",
+            fn_payload="f",
+            param_payload="p",
+            trace_id="aabbccdd",
+        )
+        assert disp.traces.timeline("adopted-1") is None  # no intake ran
+        disp.note_dispatch(task)
+        tl = disp.traces.timeline("adopted-1")
+        assert tl is not None and "scheduled" in tl["events"]
+        assert tl["trace_id"] == "aabbccdd"
+    finally:
+        disp.close()
+
+
+def test_trace_book_carries_trace_id_to_close_hook():
+    book = TaskTraceBook(MetricsRegistry())
+    closed: list[dict] = []
+    book.on_close = closed.append
+    book.note("t1", "intake", ts=1.0)
+    book.note_trace("t1", "aabbccdd")
+    book.note_trace("t1", "ffffffff")  # first write wins here too
+    assert book.timeline("t1")["trace_id"] == "aabbccdd"
+    book.finish("t1", outcome="COMPLETED", ts=2.0)
+    assert closed and closed[0]["trace_id"] == "aabbccdd"
+    # untraced tasks close with trace_id None
+    book.note("t2", "intake", ts=1.0)
+    book.finish("t2", outcome="COMPLETED", ts=2.0)
+    assert closed[1]["trace_id"] is None
+    # discard forgets the trace id with the timeline
+    book.note("t3", "intake", ts=1.0)
+    book.note_trace("t3", "aaaaaaaa")
+    book.discard("t3")
+    assert book.timeline("t3") is None
+
+
+def test_gateway_e2e_slo_source_filters_to_completed():
+    """The gateway's SLO data source must mirror the dispatcher policy:
+    shed (EXPIRED) and cancelled deliveries land in their own terminal
+    series and never reach the burn-rate math — deadline shedding under
+    overload is intended behavior, not an SLO violation."""
+    from tpu_faas.gateway.app import GatewayContext
+
+    ctx = GatewayContext(store=MemoryStore(), trace=False)
+    base = {FIELD_SUBMITTED_AT: "100.0", "finished_at": "100.1"}
+    ctx.note_result_observed("ok", {FIELD_STATUS: "COMPLETED", **base})
+    ctx.note_result_observed("shed", {FIELD_STATUS: "EXPIRED", **base})
+    ctx.note_result_observed("cxl", {FIELD_STATUS: "CANCELLED", **base})
+    uppers, counts = ctx._e2e_snapshot("submit_to_finish")
+    assert sum(counts) == 1  # COMPLETED only
+    fams = parse_exposition(render([ctx.metrics]))
+    by_terminal = {
+        s.labels["terminal"]: s.value
+        for s in fams["tpu_faas_task_e2e_seconds"].samples
+        if s.name.endswith("_count")
+        and s.labels["phase"] == "submit_to_finish"
+    }
+    # every population is still measured — just separately
+    assert by_terminal["COMPLETED"] == 1
+    assert by_terminal["EXPIRED"] == 1
+    assert by_terminal["CANCELLED"] == 1
+
+
+def test_skipped_timeline_close_normalizes_expired_label():
+    """A drained announce for an already-EXPIRED record closes with
+    terminal="expired" — the same label the shed_if_expired drop sites
+    use — not the raw record status, which would split one shed
+    population across two label vocabularies."""
+    from tpu_faas.dispatch.local import LocalDispatcher
+
+    disp = LocalDispatcher(store=MemoryStore(), num_workers=1)
+    try:
+        disp.traces.note("t1", "announced", ts=1.0)
+        disp._close_skipped_timeline("t1", "EXPIRED")
+        assert disp.traces.timeline("t1")["outcome"] == "expired"
+        # non-expired terminals keep the record vocabulary
+        disp.traces.note("t2", "announced", ts=1.0)
+        disp._close_skipped_timeline("t2", "CANCELLED")
+        assert disp.traces.timeline("t2")["outcome"] == "CANCELLED"
+    finally:
+        disp.close()
+
+
+# -- exposition conformance for every family added since PR 3 ----------------
+
+
+def test_new_families_render_strict_exposition():
+    """Every series this PR (slo/trace/e2e) and PR 6 (HA gauges) added,
+    rendered and strict-parsed from REAL constructors — the conformance
+    gate that keeps /metrics scrapeable as families accumulate."""
+    from tpu_faas.gateway.app import GatewayContext
+
+    ctx = GatewayContext(store=MemoryStore(), trace=True)
+    # traffic through the new surfaces so samples carry real values
+    ctx.note_result_observed(
+        "t1",
+        {
+            FIELD_STATUS: "COMPLETED",
+            FIELD_SUBMITTED_AT: "100.0",
+            "finished_at": "100.2",
+            FIELD_TRACE_ID: new_trace_id(),
+        },
+    )
+    ctx.m_store_role.set(1.0)
+    ctx.m_repl_lag.set(3.0)
+    fams = parse_exposition(render([ctx.metrics]))
+    missing = require_series(
+        fams,
+        [
+            # this PR's families
+            "tpu_faas_task_e2e_seconds",
+            "tpu_faas_slo_burn_rate",
+            "tpu_faas_slo_good_ratio",
+            "tpu_faas_slo_target_ratio",
+            "tpu_faas_slo_threshold_seconds",
+            "tpu_faas_slo_source_present",
+            "tpu_faas_trace_duplicate_events_total",
+            "tpu_faas_trace_spans_dropped_total",
+            # PR 6's HA gauges
+            "tpu_faas_gateway_store_role",
+            "tpu_faas_store_replication_lag_commands",
+            "tpu_faas_gateway_store_up",
+        ],
+    )
+    assert not missing, missing
+    e2e_counts = {
+        s.labels["phase"]: s.value
+        for s in fams["tpu_faas_task_e2e_seconds"].samples
+        if s.name.endswith("_count")
+    }
+    assert e2e_counts["submit_to_finish"] == 1
+    assert e2e_counts["submit_to_observe"] == 1
+    # repeat delivery is deduped
+    ctx.note_result_observed(
+        "t1", {FIELD_STATUS: "COMPLETED", FIELD_SUBMITTED_AT: "100.0"}
+    )
+    fams = parse_exposition(render([ctx.metrics]))
+    e2e_counts = {
+        s.labels["phase"]: s.value
+        for s in fams["tpu_faas_task_e2e_seconds"].samples
+        if s.name.endswith("_count")
+    }
+    assert e2e_counts["submit_to_observe"] == 1
+
+
+def test_dispatcher_scrape_carries_slo_and_trace_families():
+    from tpu_faas.dispatch.local import LocalDispatcher
+
+    disp = LocalDispatcher(store=MemoryStore(), num_workers=1)
+    try:
+        fams = parse_exposition(disp.render_metrics())
+        missing = require_series(
+            fams,
+            [
+                "tpu_faas_slo_burn_rate",
+                "tpu_faas_slo_threshold_seconds",
+                "tpu_faas_slo_source_present",
+                "tpu_faas_trace_duplicate_events_total",
+                "tpu_faas_trace_spans_dropped_total",
+                "tpu_faas_task_stage_seconds",
+                "tpu_faas_dispatcher_failover_rearms_total",
+            ],
+        )
+        assert not missing, missing
+    finally:
+        disp.close()

@@ -18,8 +18,12 @@ configs[]) plus one framework-extra:
 11. (extra) payload plane: repeated-fn store bytes/task + host dispatch
    throughput, inline vs content-addressed shipping (blob namespace,
    dispatcher blob cache, digest-shipped TASKs)
+12. (extra) latency distribution: closed-loop submit→observe against the
+   full stack with distributed tracing on — p50/p95/p99 submit→result
+   plus the per-stage p99 breakdown from assembled cross-process traces
+   (which stage owns the latency floor)
 
-Configs 1-2, 6, 9-11 run the real socket stack; 3-5 run the device kernels
+Configs 1-2, 6, 9-12 run the real socket stack; 3-5 run the device kernels
 at scales the socket stack can't reach on one box (the reference had no
 analog — its harness topped out at localhost subprocesses, SURVEY §4).
 Each config returns a dict and is printed as one JSON line by the CLI.
@@ -1109,6 +1113,242 @@ def config_11_payload_plane() -> dict:
     }
 
 
+def config_12_latency() -> dict:
+    """Latency-distribution lane (config 12): closed-loop submit→observe
+    against the full real stack — store server over TCP, gateway with
+    distributed tracing ON, tpu-push dispatcher, real push-worker
+    subprocesses running a no-op function. The throughput lanes
+    (configs 9-11) measure tasks/s with results that never flow back;
+    this lane measures what a CLIENT waits: N closed-loop submitters
+    each submit one task, long-poll its result, stamp the wall time,
+    repeat — so queue depth stays at the concurrency and the row is the
+    latency FLOOR of the stack, the number ROADMAP item 2 ("kill the
+    polling floor", p99 < 10 ms) is judged against.
+
+    Reported: p50/p95/p99/mean submit→result (client-measured), the
+    PER-STAGE p99 breakdown from the assembled cross-process traces
+    (which stage owns the floor — includes the gateway observe span and
+    the uncovered poll/bus gap no dispatcher-local view can see), the
+    stage owning the floor, trace-assembly completeness (processes +
+    stage counts over the sampled traces), the gateway /slo burn-rate
+    snapshot, and a strict-grammar /metrics scrape verdict covering the
+    slo/trace/e2e families this plane added.
+
+    Shape via TPU_FAAS_BENCH_LATENCY_SHAPE="workers,procs,tasks,
+    concurrency" (default "4,2,400,8"); the CI latency-smoke lane runs
+    "2,2,80,4"."""
+    import os
+    import threading as _threading
+
+    import requests as _requests
+
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.obs.expofmt import parse_exposition, require_series
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.bench.harness import _spawn_worker
+    from tpu_faas.workloads import no_op
+
+    shape = os.environ.get("TPU_FAAS_BENCH_LATENCY_SHAPE", "4,2,400,8")
+    n_workers, n_procs, n_tasks, concurrency = (
+        int(x) for x in shape.split(",")
+    )
+
+    #: families the scrape must carry now that the latency-SLO plane is
+    #: wired (absence = obs-wiring regression, not "no traffic")
+    required_series = [
+        "tpu_faas_task_e2e_seconds",
+        "tpu_faas_slo_burn_rate",
+        "tpu_faas_slo_good_ratio",
+        "tpu_faas_slo_target_ratio",
+        "tpu_faas_slo_threshold_seconds",
+        "tpu_faas_slo_source_present",
+        "tpu_faas_trace_duplicate_events_total",
+        "tpu_faas_trace_spans_dropped_total",
+        "tpu_faas_gateway_requests_total",
+    ]
+
+    handle = start_store_thread()
+    gw = start_gateway_thread(make_store(handle.url), trace=True)
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(handle.url),
+        max_workers=max(64, n_workers),
+        max_pending=max(256, 2 * n_tasks),
+        max_inflight=4096,
+        max_slots=n_procs,
+        tick_period=0.005,
+    )
+    disp_thread = _threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker(
+            "push_worker", n_procs, url, "--hb", "--hb-period", "0.5"
+        )
+        for _ in range(n_workers)
+    ]
+    setup = FaaSClient(gw.url)
+    try:
+        time.sleep(1.5)  # workers register
+        from tpu_faas.core.serialize import serialize
+
+        fid = setup.register_payload("no_op", serialize(no_op))
+        # warmup OUTSIDE the measured window: pool spawn + first dill
+        # decode + announce-path warm; result() long-polls at the gateway
+        for h in setup.submit_many(fid, [((), {})] * (2 * concurrency)):
+            h.result(timeout=120.0)
+
+        latencies: list[float] = []
+        task_ids: list[str] = []
+        lat_lock = _threading.Lock()
+
+        def closed_loop(count: int) -> None:
+            # one client (= one connection pool) per submitter thread
+            client = FaaSClient(gw.url, trace=True)
+            for _ in range(count):
+                t0 = time.perf_counter()
+                h = client.submit(fid)
+                h.result(timeout=120.0)
+                dt = time.perf_counter() - t0
+                with lat_lock:
+                    latencies.append(dt)
+                    task_ids.append(h.task_id)
+
+        # the remainder is spread over the first threads so the lane runs
+        # EXACTLY shape.tasks tasks for any shape (CI asserts equality)
+        threads = [
+            _threading.Thread(
+                target=closed_loop,
+                args=(
+                    n_tasks // concurrency
+                    + (1 if i < n_tasks % concurrency else 0),
+                ),
+            )
+            for i in range(concurrency)
+        ]
+        t_run0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        run_s = time.perf_counter() - t_run0
+
+        # -- strict-grammar scrape + SLO snapshot (post-run, traffic in) --
+        scrape_missing: list[str] = []
+        scrape_error = ""
+        try:
+            r = _requests.get(f"{gw.url}/metrics", timeout=10)
+            families = parse_exposition(r.text)
+            scrape_missing = require_series(families, required_series)
+            scrape_ok = not scrape_missing
+        except Exception as exc:
+            scrape_ok = False
+            scrape_error = f"{type(exc).__name__}: {exc}"
+        # degrade like the scrape above: a stalled/reset /slo fetch must
+        # not crash the leg after every task already completed
+        try:
+            slo_snapshot = _requests.get(f"{gw.url}/slo", timeout=10).json()
+        except Exception as exc:
+            slo_snapshot = {"error": f"{type(exc).__name__}: {exc}"}
+
+        # -- per-stage breakdown from the assembled cross-process traces --
+        # sample a bounded slice; spans flush on ~0.25 s cadences, so give
+        # the tail a moment and re-fetch until assembly stops growing
+        sample = task_ids[-min(len(task_ids), 200):]
+        stage_durs: dict[str, list[float]] = {}
+        stages_seen: list[int] = []
+        processes_max: list[str] = []
+        uncovered: list[float] = []
+        deadline = time.monotonic() + 10.0
+        timelines: dict[str, dict] = {}
+        while time.monotonic() < deadline:
+            for tid in sample:
+                # a fully-assembled timeline never shrinks — stop
+                # re-fetching it (at 200 sampled ids the poll would
+                # otherwise hammer the very gateway it just measured with
+                # hundreds of redundant GETs per 0.5 s round)
+                old = timelines.get(tid)
+                if old is not None and old["n_stages"] >= 9:
+                    continue
+                r = _requests.get(f"{gw.url}/trace/{tid}", timeout=10)
+                if r.status_code != 200:
+                    continue
+                tl = r.json()
+                if old is None or tl["n_stages"] > old["n_stages"]:
+                    timelines[tid] = tl
+            full = [t for t in timelines.values() if t["n_stages"] >= 9]
+            if len(full) >= max(1, len(sample) // 2):
+                break
+            time.sleep(0.5)
+        for tl in timelines.values():
+            stages_seen.append(tl["n_stages"])
+            if len(tl["processes"]) > len(processes_max):
+                processes_max = tl["processes"]
+            if "uncovered_s" in tl:
+                uncovered.append(tl["uncovered_s"])
+            for s in tl["spans"]:
+                stage_durs.setdefault(
+                    f"{s['process']}:{s['stage']}", []
+                ).append(s["duration_s"])
+
+        def p(vals: list[float], q: float) -> float:
+            return float(np.percentile(vals, q)) if vals else 0.0
+
+        stage_p99_ms = {
+            stage: round(p(durs, 99) * 1e3, 3)
+            for stage, durs in sorted(stage_durs.items())
+        }
+        floor_stage = (
+            max(stage_p99_ms, key=stage_p99_ms.get) if stage_p99_ms else None
+        )
+        return {
+            "config": "latency-closed-loop",
+            "shape": {
+                "workers": n_workers,
+                "procs": n_procs,
+                "tasks": n_tasks,
+                "concurrency": concurrency,
+            },
+            "completed": len(latencies),
+            "run_s": round(run_s, 2),
+            "closed_loop_tasks_per_s": round(
+                len(latencies) / max(run_s, 1e-9), 1
+            ),
+            "submit_to_result_p50_ms": round(p(latencies, 50) * 1e3, 2),
+            "submit_to_result_p95_ms": round(p(latencies, 95) * 1e3, 2),
+            "submit_to_result_p99_ms": round(p(latencies, 99) * 1e3, 2),
+            "submit_to_result_mean_ms": round(
+                float(np.mean(latencies)) * 1e3, 2
+            ) if latencies else 0.0,
+            # which stage owns the floor: per-(process:stage) p99 over the
+            # assembled cross-process traces, plus the uncovered wall time
+            # between spans (announce-bus + poll gaps)
+            "stage_p99_ms": stage_p99_ms,
+            "floor_stage": floor_stage,
+            "uncovered_p99_ms": round(p(uncovered, 99) * 1e3, 3),
+            "traces_assembled": len(timelines),
+            "trace_stages_max": max(stages_seen, default=0),
+            "trace_stages_min": min(stages_seen, default=0),
+            "trace_processes": processes_max,
+            "slo": slo_snapshot,
+            "metrics_scrape_ok": bool(scrape_ok),
+            "metrics_missing": scrape_missing,
+            "metrics_scrape_error": scrape_error,
+        }
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        disp_thread.join(timeout=10)
+        gw.stop()
+        handle.stop()
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -1121,4 +1361,5 @@ CONFIGS = {
     "9": config_9_host_dispatch,
     "10": config_10_overload,
     "11": config_11_payload_plane,
+    "12": config_12_latency,
 }

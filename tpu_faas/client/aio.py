@@ -29,12 +29,17 @@ from tpu_faas.client.sdk import (
     _unwrap_terminal,
 )
 from tpu_faas.core.executor import pack_params
+from tpu_faas.obs.tracectx import new_trace_id
 
 
 @dataclass
 class AsyncTaskHandle:
     client: "AsyncFaaSClient"
     task_id: str
+    #: distributed trace id of this submit (trace-enabled clients against
+    #: a --trace gateway); None otherwise — same contract as the sync
+    #: TaskHandle.trace_id
+    trace_id: str | None = None
 
     async def status(self) -> str:
         async with self.client.request(
@@ -101,17 +106,20 @@ class AsyncFaaSClient:
         connect_retries: int = 5,
         overload_retries: int = 4,
         auto_idempotency: bool = True,
+        trace: bool = False,
     ) -> None:
         """``overload_retries``/``auto_idempotency``: same overload
         contract as the sync FaaSClient — 429/503 submit rejects retry
         honoring ``Retry-After`` with jittered exponential backoff, and
         every submit carries an idempotency key (auto-minted unless the
         caller supplied one or disabled it) so retries are
-        duplicate-safe."""
+        duplicate-safe. ``trace``: mint a distributed trace id per submit
+        and send it along — same contract as the sync FaaSClient."""
         self.base_url = base_url.rstrip("/")
         self.connect_retries = connect_retries
         self.overload_retries = int(overload_retries)
         self.auto_idempotency = bool(auto_idempotency)
+        self.trace = bool(trace)
         #: serialize()/register dedup, shared shape with the sync SDK
         self._memo = _FnMemo()
         self._http: aiohttp.ClientSession | None = None
@@ -223,6 +231,8 @@ class AsyncFaaSClient:
             None, lambda: pack_params(*args, **kwargs)
         )
         body = {"function_id": function_id, "payload": payload}
+        if self.trace:
+            body["trace_id"] = new_trace_id()
         if self.auto_idempotency:
             body["idempotency_key"] = uuid.uuid4().hex
         async with self.request(
@@ -232,7 +242,8 @@ class AsyncFaaSClient:
             json=body,
         ) as r:
             r.raise_for_status()
-            return AsyncTaskHandle(self, (await r.json())["task_id"])
+            out = await r.json()
+            return AsyncTaskHandle(self, out["task_id"], out.get("trace_id"))
 
     async def submit_with(
         self,
@@ -268,6 +279,8 @@ class AsyncFaaSClient:
             body["timeout"] = timeout
         if deadline is not None:
             body["deadline"] = deadline
+        if self.trace:
+            body["trace_id"] = new_trace_id()
         if idempotency_key is None and self.auto_idempotency:
             idempotency_key = uuid.uuid4().hex
         if idempotency_key is not None:
@@ -279,7 +292,8 @@ class AsyncFaaSClient:
             json=body,
         ) as r:
             r.raise_for_status()
-            return AsyncTaskHandle(self, (await r.json())["task_id"])
+            out = await r.json()
+            return AsyncTaskHandle(self, out["task_id"], out.get("trace_id"))
 
     async def submit_many(
         self,
@@ -314,6 +328,8 @@ class AsyncFaaSClient:
             idempotency_keys = [uuid.uuid4().hex for _ in params_list]
         if idempotency_keys is not None:
             body["idempotency_keys"] = idempotency_keys
+        if self.trace:
+            body["trace_ids"] = [new_trace_id() for _ in params_list]
         async with self.request(
             "POST",
             f"{self.base_url}/execute_batch",
@@ -321,9 +337,11 @@ class AsyncFaaSClient:
             json=body,
         ) as r:
             r.raise_for_status()
+            out = await r.json()
+            trace_ids = out.get("trace_ids") or [None] * len(out["task_ids"])
             return [
-                AsyncTaskHandle(self, tid)
-                for tid in (await r.json())["task_ids"]
+                AsyncTaskHandle(self, tid, trace)
+                for tid, trace in zip(out["task_ids"], trace_ids)
             ]
 
     async def delete_task(self, task_id: str) -> None:

@@ -22,6 +22,7 @@ from tpu_faas.core.executor import pack_params
 from tpu_faas.core.payload import payload_digest
 from tpu_faas.core.serialize import deserialize, serialize
 from tpu_faas.core.task import TaskStatus
+from tpu_faas.obs.tracectx import new_trace_id
 
 
 class _FnMemo:
@@ -140,6 +141,10 @@ class TaskCancelledError(Exception):
 class TaskHandle:
     client: "FaaSClient"
     task_id: str
+    #: distributed trace id of this submit (trace-enabled clients against
+    #: a --trace gateway); None otherwise. Key for GET /trace/<task_id>'s
+    #: cross-process timeline and for joining JSON logs fleet-wide.
+    trace_id: str | None = None
 
     def status(self) -> str:
         return self.client.status(self.task_id)
@@ -214,6 +219,7 @@ class FaaSClient:
         connect_retries: int = 5,
         overload_retries: int = 4,
         auto_idempotency: bool = True,
+        trace: bool = False,
     ) -> None:
         """``overload_retries``: how many times a submit rejected with
         429/503 (admission brownout, saturated system, store breaker) is
@@ -222,10 +228,16 @@ class FaaSClient:
         reject. ``auto_idempotency``: mint a fresh idempotency key per
         submit when the caller supplied none, so those retries (and any
         manual re-send after a lost response) are duplicate-safe end to
-        end — the retry addresses the SAME task record."""
+        end — the retry addresses the SAME task record. ``trace``: mint a
+        distributed trace id per submit (obs/tracectx) and send it with
+        the request; against a ``--trace`` gateway the returned handles
+        carry ``trace_id`` and ``/trace/<task_id>`` assembles the
+        cross-process timeline. Harmless against a trace-disabled
+        gateway (the field is ignored there)."""
         self.base_url = base_url.rstrip("/")
         self.overload_retries = int(overload_retries)
         self.auto_idempotency = bool(auto_idempotency)
+        self.trace = bool(trace)
         #: serialize()/register dedup (see _FnMemo)
         self._memo = _FnMemo()
         self.http = requests.Session()
@@ -293,7 +305,37 @@ class FaaSClient:
         timeout: float | None = None,
         idempotency_key: str | None = None,
         deadline: float | None = None,
+        trace_id: str | None = None,
+        parent_span: str | None = None,
     ) -> str:
+        return self._execute(
+            function_id,
+            payload,
+            priority=priority,
+            cost=cost,
+            timeout=timeout,
+            idempotency_key=idempotency_key,
+            deadline=deadline,
+            trace_id=trace_id,
+            parent_span=parent_span,
+        )["task_id"]
+
+    def _execute(
+        self,
+        function_id: str,
+        payload: str,
+        priority: int | None = None,
+        cost: float | None = None,
+        timeout: float | None = None,
+        idempotency_key: str | None = None,
+        deadline: float | None = None,
+        trace_id: str | None = None,
+        parent_span: str | None = None,
+    ) -> dict:
+        """One submit; returns the gateway's parsed response body (the
+        handle constructors read ``trace_id`` off it — present only when
+        the gateway runs ``--trace`` and the record was actually
+        created)."""
         body: dict = {"function_id": function_id, "payload": payload}
         if priority is not None:
             body["priority"] = priority
@@ -303,13 +345,19 @@ class FaaSClient:
             body["timeout"] = timeout
         if deadline is not None:
             body["deadline"] = deadline
+        if trace_id is None and self.trace:
+            trace_id = new_trace_id()
+        if trace_id is not None:
+            body["trace_id"] = trace_id
+        if parent_span is not None:
+            body["parent_span"] = parent_span
         if idempotency_key is None and self.auto_idempotency:
             idempotency_key = uuid.uuid4().hex
         if idempotency_key is not None:
             body["idempotency_key"] = idempotency_key
         r = self._post_submit(f"{self.base_url}/execute_function", body)
         r.raise_for_status()
-        return r.json()["task_id"]
+        return r.json()
 
     def status(self, task_id: str) -> str:
         r = self.http.get(f"{self.base_url}/status/{task_id}")
@@ -368,7 +416,8 @@ class FaaSClient:
 
     def submit(self, function_id: str, *args: Any, **kwargs: Any) -> TaskHandle:
         payload = pack_params(*args, **kwargs)
-        return TaskHandle(self, self.execute_payload(function_id, payload))
+        body = self._execute(function_id, payload)
+        return TaskHandle(self, body["task_id"], body.get("trace_id"))
 
     def submit_with(
         self,
@@ -398,18 +447,16 @@ class FaaSClient:
         instead of running it twice (auto-minted per submit unless
         auto_idempotency=False)."""
         payload = pack_params(*args, **(kwargs or {}))
-        return TaskHandle(
-            self,
-            self.execute_payload(
-                function_id,
-                payload,
-                priority=priority,
-                cost=cost,
-                timeout=timeout,
-                idempotency_key=idempotency_key,
-                deadline=deadline,
-            ),
+        body = self._execute(
+            function_id,
+            payload,
+            priority=priority,
+            cost=cost,
+            timeout=timeout,
+            idempotency_key=idempotency_key,
+            deadline=deadline,
         )
+        return TaskHandle(self, body["task_id"], body.get("trace_id"))
 
     def submit_many(
         self,
@@ -447,9 +494,18 @@ class FaaSClient:
             idempotency_keys = [uuid.uuid4().hex for _ in params_list]
         if idempotency_keys is not None:
             body["idempotency_keys"] = idempotency_keys
+        if self.trace:
+            body["trace_ids"] = [new_trace_id() for _ in params_list]
         r = self._post_submit(f"{self.base_url}/execute_batch", body)
         r.raise_for_status()
-        return [TaskHandle(self, tid) for tid in r.json()["task_ids"]]
+        out = r.json()
+        # the gateway's echo is authoritative: null for dedup hits (their
+        # records carry the claim winner's trace), absent with tracing off
+        trace_ids = out.get("trace_ids") or [None] * len(out["task_ids"])
+        return [
+            TaskHandle(self, tid, trace)
+            for tid, trace in zip(out["task_ids"], trace_ids)
+        ]
 
     def run(
         self, fn: Callable, *args: Any, timeout: float = 60.0, **kwargs: Any

@@ -117,6 +117,16 @@ FIELD_FINAL_AT = "final_finished_at"
 #: its first dispatcher-side event.
 FIELD_SUBMITTED_AT = "submitted_at"
 
+#: Distributed trace context (tpu_faas/obs/tracectx.py): the trace id this
+#: task's cross-process spans are keyed by (lowercase hex, minted by the
+#: SDK — or by a trace-enabled gateway for legacy clients), plus the
+#: optional parent span id of the submitting client. Absent on tasks from
+#: reference-style producers and on trace-disabled gateways — every
+#: consumer treats absence as "tracing off for this task" and changes
+#: nothing.
+FIELD_TRACE_ID = "trace_id"
+FIELD_TRACE_PARENT = "trace_parent"
+
 #: Written (epoch seconds as str) with every RUNNING mark and refreshed
 #: periodically by the dispatcher that owns the task's worker. A RUNNING
 #: record whose lease has gone stale has no live owner left — its worker

@@ -43,11 +43,22 @@ from tpu_faas.core.task import (
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
     FIELD_TIMEOUT,
+    FIELD_TRACE_ID,
     TaskStatus,
     claim_field_for,
 )
-from tpu_faas.obs import REGISTRY, MetricsRegistry, TaskTraceBook
+from tpu_faas.obs import (
+    REGISTRY,
+    MetricsRegistry,
+    SLOTracker,
+    SpanSink,
+    TaskTraceBook,
+)
 from tpu_faas.obs import metrics as obs_metrics
+from tpu_faas.obs.slo import (
+    DEFAULT_DISPATCHER_OBJECTIVES,
+    objectives_from_env,
+)
 from tpu_faas.store.base import (
     CANCEL_ANNOUNCE_PREFIX,
     DISPATCHERS_KEY,
@@ -73,6 +84,7 @@ RECLAIM_FIELDS = [
     FIELD_PRIORITY,
     FIELD_COST,
     FIELD_TIMEOUT,
+    FIELD_TRACE_ID,
 ]
 
 
@@ -136,8 +148,13 @@ class PendingTask:
     #: already ran once — its record is RUNNING and shedding is
     #: QUEUED-only by protocol.
     deadline_at: float | None = None
+    #: distributed trace id (FIELD_TRACE_ID): keys the task's cross-process
+    #: span records and rides TASK frames to trace-capable workers. None
+    #: for reference-style producers and trace-disabled gateways — the
+    #: whole trace plane is a no-op for such tasks.
+    trace_id: str | None = None
 
-    def task_message_kwargs(self, blob: bool = False) -> dict:
+    def task_message_kwargs(self, blob: bool = False, trace: bool = False) -> dict:
         """The TASK wire message's payload fields (timeout rides along so
         the WORKER can enforce it; priority/cost are dispatcher-side only).
 
@@ -147,7 +164,11 @@ class PendingTask:
         inline path the digest still rides along when known, keying the
         worker's child-side decode cache; legacy workers ignore the
         unknown field. Inline callers must have materialized
-        ``fn_payload`` first (ensure_inline_payload)."""
+        ``fn_payload`` first (ensure_inline_payload).
+
+        ``trace=True`` (the worker negotiated CAP_TRACE): the trace id
+        rides along so the worker's logs correlate and its RESULT echoes
+        it — reference-era workers never see the field."""
         out = {
             "task_id": self.task_id,
             "param_payload": self.param_payload,
@@ -160,6 +181,8 @@ class PendingTask:
                 out["fn_digest"] = self.fn_digest
         if self.timeout is not None:
             out["timeout"] = self.timeout
+        if trace and self.trace_id:
+            out["trace_id"] = self.trace_id
         return out
 
     @property
@@ -210,6 +233,7 @@ class PendingTask:
             timeout=timeout,
             submitted_at=submitted_at,
             deadline_at=deadline_at,
+            trace_id=fields.get(FIELD_TRACE_ID) or None,
         )
 
 
@@ -392,6 +416,24 @@ class TaskDispatcher:
         #: per-task lifecycle timelines + stage histograms (obs/trace.py);
         #: serves /trace/<task_id> and feeds tpu_faas_task_stage_seconds
         self.traces = TaskTraceBook(self.metrics)
+        #: cross-process span plane (obs/tracectx.py): every closed
+        #: timeline of a TRACED task (record carried FIELD_TRACE_ID) is
+        #: decomposed into (process, stage) span records and flushed into
+        #: the store's trace: namespace first-write-wins. Untraced tasks
+        #: never touch it — the sink's buffer stays empty and flush is a
+        #: len() check, so reference-era setups run unchanged.
+        self.spans = SpanSink(
+            store=self.store, process="dispatcher", registry=self.metrics
+        )
+        self.traces.on_close = self._emit_trace_spans
+        self._last_span_flush = 0.0
+        #: latency-SLO layer (obs/slo.py): multi-window burn rates over the
+        #: stage histograms, served as tpu_faas_slo_* gauges and /slo
+        self.slo = SLOTracker(
+            self.metrics,
+            objectives_from_env(DEFAULT_DISPATCHER_OBJECTIVES),
+            self.traces.stage_snapshot,
+        )
         self.metrics.register_collector(self.collect_metrics)
         #: shared-fleet mode: several dispatchers on one store+channel.
         #: Every dispatcher receives every announce, so intake must CLAIM
@@ -524,7 +566,7 @@ class TaskDispatcher:
                 "store; FAILING it",
                 task.task_id,
                 task.fn_digest[:16],
-                extra=log_ctx(task_id=task.task_id),
+                extra=log_ctx(task_id=task.task_id, trace_id=task.trace_id),
             )
             self.fail_task(
                 task.task_id,
@@ -737,7 +779,7 @@ class TaskDispatcher:
                 "shed task %s: queue deadline lapsed %.3fs ago",
                 task.task_id,
                 time.time() - task.deadline_at,  # faas: allow(obs.wall-clock-latency)
-                extra=log_ctx(task_id=task.task_id),
+                extra=log_ctx(task_id=task.task_id, trace_id=task.trace_id),
             )
             return True
         # terminal some other way (cancelled / a zombie's result), or the
@@ -779,7 +821,10 @@ class TaskDispatcher:
         fleet-health hash, at most once per CAPACITY_PUBLISH_PERIOD.
         Serve loops call it every iteration; it is a cheap clock compare
         between periods. Raises on a store outage (callers' existing
-        outage handling backs off and retries)."""
+        outage handling backs off and retries). The span plane's periodic
+        flush piggybacks here — every serve loop already calls this each
+        iteration, and the flush itself swallows outages."""
+        self.maybe_flush_spans()
         now = time.monotonic()
         if (
             self._cap_published_at is not None
@@ -859,6 +904,7 @@ class TaskDispatcher:
                 # dispatch — the note is consumed only at drop sites
                 # (store-verified there), and a never-matched note is
                 # pruned by note_cancelled's cap sweep
+                self._close_skipped_timeline(msg, fields.get(FIELD_STATUS))
                 self.log.debug("announce for non-QUEUED task %s; skipping", msg)
                 continue
             if msg in self.kill_requested:
@@ -882,6 +928,28 @@ class TaskDispatcher:
             task = PendingTask.from_fields(msg, fields)
             self._note_intake(task)
             return task
+
+    def _close_skipped_timeline(
+        self, task_id: str, status: str | None
+    ) -> None:
+        """An announce for an already-TERMINAL record (cancelled before any
+        dispatcher drained it, expired, finished elsewhere) opened a
+        timeline at drain time that nothing downstream will ever close —
+        stamp it finished NOW with the record's terminal status instead of
+        letting it age out of the active ring. Non-terminal skips (a
+        duplicate announce for a RUNNING task this dispatcher owns) leave
+        the live timeline alone, and an already-closed timeline makes this
+        a no-op."""
+        if TaskStatus.terminal_str(status, unknown=False):
+            # label-vocabulary normalization: shed tasks close as
+            # "expired" at every dispatcher drop site (shed_if_expired),
+            # and a drained announce for an already-EXPIRED record is the
+            # same shed population — the raw record status would split it
+            # across terminal="expired" and terminal="EXPIRED"
+            outcome = str(status)
+            if outcome == str(TaskStatus.EXPIRED):
+                outcome = "expired"
+            self.traces.finish(task_id, outcome=outcome)
 
     def drain_announces(self, max_n: int) -> list[str]:
         """Phase 1 of batched intake: pop up to ``max_n`` TASK announces off
@@ -914,6 +982,80 @@ class TaskDispatcher:
         if task.submitted_at is not None:
             self.traces.note(task.task_id, "submitted", ts=task.submitted_at)
         self.traces.note(task.task_id, "intake")
+        self.traces.note_trace(task.task_id, task.trace_id)
+
+    def note_dispatch(self, task: PendingTask) -> None:
+        """Timeline stamp at the moment a placement decision binds ``task``
+        to a worker. Attaches the trace id AFTER the event stamp: a
+        rescan-adopted task never passed _note_intake, so the ``scheduled``
+        note is what opens its timeline — note_trace only attaches to an
+        open one, and its spans must still assemble. A reclaimed task's
+        re-dispatch re-stamps ``scheduled`` as a matter of course — that
+        duplicate is routine retry traffic, not a replay storm, so it must
+        not tick the duplicate counter."""
+        self.traces.note(
+            task.task_id, "scheduled", count_dup=task.retries == 0
+        )
+        self.traces.note_trace(task.task_id, task.trace_id)
+
+    #: span catalog this process contributes to the cross-process timeline:
+    #: (process, stage, from_event, to_event) over the 9-event timeline.
+    #: The worker's execution window is emitted here ON ITS BEHALF — the
+    #: stamps are worker-measured (RESULT started_at/elapsed) but workers
+    #: have no store access, so the dispatcher persists them.
+    _SPAN_STAGES = (
+        ("dispatcher", "intake", "announced", "intake"),
+        ("dispatcher", "queue", "intake", "scheduled"),
+        ("dispatcher", "dispatch", "scheduled", "sent"),
+        ("dispatcher", "inflight", "sent", "result_received"),
+        ("dispatcher", "finalize", "result_received", "finished"),
+        ("worker", "exec", "exec_start", "exec_end"),
+    )
+
+    def _emit_trace_spans(self, record: dict) -> None:
+        """TaskTraceBook close hook: decompose one closed timeline into
+        span records for the store-backed span plane. No-op for untraced
+        tasks; buffer-only (the periodic maybe_flush_spans pays the store
+        round trip). The finalize span carries the outcome + retry count
+        so the assembled timeline says how the task ended."""
+        trace_id = record.get("trace_id")
+        if not trace_id:
+            return
+        events = record["events"]
+        for process, stage, a, b in self._SPAN_STAGES:
+            if a not in events or b not in events:
+                continue
+            t0, t1 = events[a], events[b]
+            if t1 < t0:
+                continue
+            attrs: dict = {}
+            if stage == "finalize":
+                attrs = {
+                    "outcome": record["outcome"],
+                    "retries": record["retries"],
+                }
+            self.spans.emit_as(
+                process,
+                trace_id,
+                stage,
+                t0,
+                t1,
+                task_id=record["task_id"],
+                **attrs,
+            )
+
+    #: how often buffered spans flush to the store (one pipelined
+    #: first-write-wins round per flush; internally outage-tolerant)
+    SPAN_FLUSH_PERIOD = 0.25
+
+    def maybe_flush_spans(self) -> None:
+        if not self.spans.dirty:
+            return
+        now = time.monotonic()
+        if now - self._last_span_flush < self.SPAN_FLUSH_PERIOD:
+            return
+        self._last_span_flush = now
+        self.spans.flush()
 
     def poll_tasks(self, max_n: int) -> list[PendingTask]:
         """Batch intake, pipelined: drain up to ``max_n`` announces from the
@@ -956,6 +1098,7 @@ class TaskDispatcher:
             if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
                 # duplicate or stale announce (see poll_next_task): never
                 # dispatch, and never consume a cancel note here
+                self._close_skipped_timeline(msg, fields.get(FIELD_STATUS))
                 self.log.debug("announce for non-QUEUED task %s; skipping", msg)
                 continue
             if msg in self.kill_requested:
@@ -1552,6 +1695,29 @@ class TaskDispatcher:
         process-global one (store round trips, worker-pool counters)."""
         return obs_metrics.render([self.metrics, REGISTRY])
 
+    def readiness(self) -> tuple[bool, str]:
+        """(ready, reason) for the /readyz probe: a dispatcher is ready
+        when its store is reachable AND writable — a replica or fenced
+        store endpoint serves reads but every dispatch write would fail,
+        so orchestration must not route to (or keep) this process as if
+        it were serving. Liveness (/healthz) stays unconditional: a
+        degraded dispatcher must not be killed, it is parking work.
+
+        Blocking (one INFO round trip on HA backends) — called from the
+        stats thread, never the serve loop; backends without the
+        introspection (MemoryStore, plain Redis) skip the role check."""
+        if self._store_down:
+            return False, "store_unreachable"
+        info_fn = getattr(self.store, "info", None)
+        if info_fn is not None:
+            try:
+                role = info_fn().get("role")
+            except Exception:
+                return False, "store_unreachable"
+            if role in ("replica", "fenced"):
+                return False, f"store_role_{role}"
+        return True, "ok"
+
     def serve_stats(self, port: int, host: str = "127.0.0.1"):
         """Serve the observability surface over HTTP from a daemon thread:
 
@@ -1562,7 +1728,12 @@ class TaskDispatcher:
           or recently completed), 404 when unknown;
         - ``GET /trace`` — the bounded rings: recent completions and the
           slowest tasks seen;
-        - ``GET /healthz``.
+        - ``GET /slo`` — per-objective multi-window burn rates
+          (obs/slo.py) over the stage histograms;
+        - ``GET /healthz`` — liveness (always 200 while serving);
+        - ``GET /readyz`` — readiness (503 while the store is down or
+          this dispatcher is pointed at a non-writable replica/fenced
+          endpoint), for orchestration probes.
 
         Returns the server (port 0 picks a free one —
         ``server.server_address[1]``); ``stop()`` shuts it down and closes
@@ -1577,6 +1748,20 @@ class TaskDispatcher:
                 ctype = "application/json"
                 if self.path == "/healthz":
                     body = b'{"ok": true}'
+                elif self.path == "/readyz":
+                    ready, reason = dispatcher.readiness()
+                    body = json.dumps(
+                        {"ready": ready, "reason": reason}
+                    ).encode()
+                    if not ready:
+                        self.send_response(503)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                elif self.path == "/slo":
+                    body = json.dumps(dispatcher.slo.snapshot()).encode()
                 elif self.path == "/stats":
                     body = json.dumps(dispatcher.stats()).encode()
                 elif self.path == "/metrics":
@@ -1632,5 +1817,6 @@ class TaskDispatcher:
 
     def close(self) -> None:
         self.stop()
+        self.spans.flush()  # best-effort final span flush (swallows outages)
         self.subscriber.close()
         self.store.close()

@@ -125,6 +125,7 @@ class LocalDispatcher(TaskDispatcher):
                         # deferred-capable first_wins result write is the
                         # only store touch a suspect earns.
                         self.mark_running_safe(task.task_id)
+                    self.note_dispatch(task)
                     pool.submit(
                         task.task_id,
                         task.fn_payload,
@@ -154,6 +155,13 @@ class LocalDispatcher(TaskDispatcher):
                     )
                 for res in pool.drain():
                     self._running.discard(res.task_id)
+                    # exec window for the timeline (worker-measured in the
+                    # pool child, same fields the wire modes carry on
+                    # RESULT messages)
+                    self.note_result_message(
+                        res.task_id,
+                        {"started_at": res.started_at, "elapsed": res.elapsed},
+                    )
                     suspect = res.task_id in self._suspect
                     self._suspect.discard(res.task_id)
                     self.record_result_safe(

@@ -350,7 +350,7 @@ class PullDispatcher(TaskDispatcher):
                 kill_ids = self._kills_for(wid)
                 extra = {"cancel_ids": kill_ids} if kill_ids else {}
                 if task is not None:
-                    self.traces.note(task.task_id, "scheduled")
+                    self.note_dispatch(task)
                     self.mark_running_safe(
                         task.task_id,
                         redispatch=bool(task.retries),
@@ -365,12 +365,16 @@ class PullDispatcher(TaskDispatcher):
                         m.encode_for(
                             m.CAP_BIN in caps,
                             m.TASK,
-                            **task.task_message_kwargs(blob=blob),
+                            **task.task_message_kwargs(
+                                blob=blob, trace=m.CAP_TRACE in caps
+                            ),
                             **extra,
                         )
                     )
                     self.note_payload_sent(task, blob)
-                    self.traces.note(task.task_id, "sent")
+                    self.traces.note(
+                        task.task_id, "sent", count_dup=task.retries == 0
+                    )
                     self.m_dispatched.inc()
                 else:
                     self.socket.send(m.encode_for(m.CAP_BIN in caps, m.WAIT, **extra))

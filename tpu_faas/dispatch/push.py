@@ -438,17 +438,21 @@ class PushDispatcher(TaskDispatcher):
                     else:
                         self._add_free(wid, front=True)
                     continue
-            self.traces.note(task.task_id, "scheduled")
+            self.note_dispatch(task)
             self._send(
                 wid,
                 m.encode_for(
                     m.CAP_BIN in rec.caps,
                     m.TASK,
-                    **task.task_message_kwargs(blob=blob),
+                    **task.task_message_kwargs(
+                        blob=blob, trace=m.CAP_TRACE in rec.caps
+                    ),
                 ),
             )
             self.note_payload_sent(task, blob)
-            self.traces.note(task.task_id, "sent")
+            self.traces.note(
+                task.task_id, "sent", count_dup=task.retries == 0
+            )
             self.mark_running_safe(
                 task.task_id,
                 redispatch=bool(task.retries),

@@ -1031,19 +1031,23 @@ class TpuPushDispatcher(TaskDispatcher):
                         still_pending.append(task)  # inflight full: wait
                         restore_from = idx + 1
                         continue
-                    self.traces.note(task.task_id, "scheduled")
+                    self.note_dispatch(task)
                     self.socket.send_multipart(
                         [
                             wid,
                             m.encode_for(
                                 m.CAP_BIN in caps,
                                 m.TASK,
-                                **task.task_message_kwargs(blob=blob),
+                                **task.task_message_kwargs(
+                                    blob=blob, trace=m.CAP_TRACE in caps
+                                ),
                             ),
                         ]
                     )
                     self.note_payload_sent(task, blob)
-                    self.traces.note(task.task_id, "sent")
+                    self.traces.note(
+                        task.task_id, "sent", count_dup=task.retries == 0
+                    )
                     # on the wire + tracked: must NOT be restored on an
                     # outage
                     restore_from = idx + 1
@@ -1415,19 +1419,23 @@ class TpuPushDispatcher(TaskDispatcher):
                     except RuntimeError:
                         undo(task, row)  # inflight table full: wait a tick
                         continue
-                    self.traces.note(task.task_id, "scheduled")
+                    self.note_dispatch(task)
                     self.socket.send_multipart(
                         [
                             wid,
                             m.encode_for(
                                 m.CAP_BIN in caps,
                                 m.TASK,
-                                **task.task_message_kwargs(blob=blob),
+                                **task.task_message_kwargs(
+                                    blob=blob, trace=m.CAP_TRACE in caps
+                                ),
                             ),
                         ]
                     )
                     self.note_payload_sent(task, blob)
-                    self.traces.note(task.task_id, "sent")
+                    self.traces.note(
+                        task.task_id, "sent", count_dup=task.retries == 0
+                    )
                     if task.retries:
                         # per-task on the re-dispatch path: the redispatch
                         # declaration + persisted reclaim count ride along

@@ -36,10 +36,16 @@ on a connect timeout.
 Beyond the reference surface: ``POST /cancel/{task_id}`` (queued-only
 best-effort cancel: QUEUED -> CANCELLED terminal, RUNNING refused with 409 —
 see cancel_task below), ``DELETE /task/{task_id}`` (drop a terminal task's
-record), ``GET /healthz``, ``GET /metrics`` (Prometheus text exposition —
-request counts + latency histograms per route, submission counters, store
-reachability; tpu_faas/obs), ``GET /stats`` (the same numbers as a JSON
-snapshot, with exact recent-window percentiles from the tracer ring).
+record), ``GET /healthz`` (liveness), ``GET /readyz`` (readiness: 503 while
+the breaker is open or the store endpoint is a replica/fenced — route
+traffic on this one, restart on /healthz), ``GET /metrics`` (Prometheus
+text exposition — request counts + latency histograms per route, submission
+counters, store reachability, e2e latency + SLO burn rates; tpu_faas/obs),
+``GET /stats`` (the same numbers as a JSON snapshot, with exact
+recent-window percentiles from the tracer ring), ``GET /slo``
+(per-objective burn rates as JSON), and — with ``--trace`` — submits carry
+distributed trace context and ``GET /trace/{task_id}`` assembles the full
+cross-process timeline from the store's span plane (obs/tracectx).
 
 Store-side contract on execute (reference old/client_debug.py:40-45): write the
 full task hash (status QUEUED, fn_payload, param_payload, result "None") then
@@ -85,12 +91,22 @@ from tpu_faas.core.task import (
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
     FIELD_TIMEOUT,
+    FIELD_TRACE_ID,
+    FIELD_TRACE_PARENT,
     TaskStatus,
     new_function_id,
     new_task_id,
 )
-from tpu_faas.obs import REGISTRY, MetricsRegistry
+from tpu_faas.obs import REGISTRY, MetricsRegistry, SLOTracker, SpanSink
 from tpu_faas.obs import metrics as obs_metrics
+from tpu_faas.obs.slo import DEFAULT_GATEWAY_OBJECTIVES, objectives_from_env
+from tpu_faas.obs.tracectx import (
+    TRACE_PREFIX,
+    assemble_timeline,
+    new_trace_id,
+    sweep_stale_traces,
+    valid_trace_id,
+)
 from tpu_faas.store.base import (
     BLOB_AT_FIELD,
     BLOB_PREFIX,
@@ -292,6 +308,16 @@ class GatewayContext:
     #: (``--payload-plane``) once every dispatcher on the store is
     #: payload-plane-aware.
     payload_plane: bool = False
+    #: distributed tracing (tpu_faas/obs/tracectx.py): when True, every
+    #: submit carries a trace id (client-supplied, validated — or minted
+    #: here for legacy clients), the gateway emits its own span records
+    #: (admit, create, observe) into the store's trace: namespace, and
+    #: ``/trace/<task_id>`` assembles the full cross-process timeline.
+    #: OFF by default: single-process setups and reference-era fleets run
+    #: byte-identical with it off (``--trace`` opts in). The SLO layer
+    #: below does NOT depend on it — e2e latency is measured from the
+    #: record's own submit/finish stamps either way.
+    trace: bool = False
 
     def __post_init__(self) -> None:
         self.m_requests = self.metrics.counter(
@@ -380,9 +406,108 @@ class GatewayContext:
             "and its slowest attached replica (mutating commands not "
             "yet acknowledged), at the last scrape; 0 with no replica",
         )
+        self.m_e2e = self.metrics.histogram(
+            "tpu_faas_task_e2e_seconds",
+            "End-to-end task latency as THIS gateway can measure it from "
+            "the record's own stamps, observed once per task at its first "
+            "terminal /result delivery: submit_to_finish (gateway submit "
+            "stamp -> terminal write stamp) and submit_to_observe (submit "
+            "stamp -> the client actually receiving the result — the "
+            "poll/transport gap included); 'terminal' is the record's "
+            "closing status, so shed (EXPIRED) and cancelled populations "
+            "stay out of the completed-latency distribution the SLO "
+            "layer judges",
+            ("phase", "terminal"),
+        )
+        for phase in ("submit_to_finish", "submit_to_observe"):
+            self.m_e2e.labels(phase=phase, terminal="COMPLETED")
+        #: bounded first-delivery dedup for the e2e histogram (repeat
+        #: polls of a terminal record must not re-observe)
+        self._observed: dict[str, bool] = {}
+        #: in-flight fire-and-forget observation tasks (strong refs so
+        #: the event loop can't GC them mid-fetch)
+        self._observe_tasks: set = set()
+        #: latency-SLO layer over the e2e histogram (obs/slo.py):
+        #: tpu_faas_slo_* gauges + the /slo endpoint
+        self.slo = SLOTracker(
+            self.metrics,
+            objectives_from_env(DEFAULT_GATEWAY_OBJECTIVES),
+            self._e2e_snapshot,
+        )
+        #: span plane writer (None with tracing off); flushed by a
+        #: background task so submit latency never pays the store trip
+        self.span_sink = (
+            SpanSink(store=self.store, process="gateway", registry=self.metrics)
+            if self.trace
+            else None
+        )
         self.metrics.register_collector(self._collect)
         if self.tracer is None:
             self.tracer = TickTracer(mirror=self.m_latency)
+
+    def _e2e_snapshot(self, phase: str):
+        """SLO data source: (bucket uppers, counts) of one e2e phase —
+        COMPLETED outcomes only, matching the dispatcher's stage_snapshot
+        policy: a burst of deadline-shed EXPIRED tasks is intended
+        overload behavior and must not burn the latency error budget,
+        and quick cancels must not dilute real violations."""
+        return self.m_e2e.sum_counts((phase, "COMPLETED"))
+
+    _OBSERVED_CAP = 65536
+
+    def note_result_observed(
+        self, task_id: str, fields: dict, observed_at: float | None = None
+    ) -> None:
+        """First terminal /result delivery for a task: observe the e2e
+        latency phases and emit the ``observe`` span — the poll-gap
+        segment no dispatcher-local timeline can see. Repeat polls are
+        deduped here (histogram) and by the span store's first-write-wins
+        (spans). Non-blocking: spans go to the sink buffer.
+        ``observed_at`` is the reply-time stamp the caller took BEFORE
+        any telemetry store fetch — the observe phase must measure the
+        client's wait, not the measurement's own cost."""
+        first = task_id not in self._observed
+        if first:
+            self._observed[task_id] = True
+            while len(self._observed) > self._OBSERVED_CAP:
+                self._observed.pop(next(iter(self._observed)))
+        now = observed_at if observed_at is not None else time.time()
+        submitted = finished = None
+        try:
+            submitted = float(fields[FIELD_SUBMITTED_AT])
+        except (KeyError, ValueError):
+            pass
+        try:
+            finished = float(fields[FIELD_FINISHED_AT])
+        except (KeyError, ValueError):
+            pass
+        if first and submitted is not None:
+            terminal = str(fields.get(FIELD_STATUS) or "unknown")
+            if finished is not None:
+                self.m_e2e.labels(
+                    phase="submit_to_finish", terminal=terminal
+                ).observe(max(0.0, finished - submitted))
+            self.m_e2e.labels(
+                phase="submit_to_observe", terminal=terminal
+            ).observe(max(0.0, now - submitted))
+        trace_id = fields.get(FIELD_TRACE_ID)
+        if (
+            first
+            and self.span_sink is not None
+            and trace_id
+            and finished is not None
+        ):
+            # first-delivery-gated here AND first-write-wins in the store:
+            # a racing duplicate emit would only tick the duplicate
+            # counter for a non-event
+            self.span_sink.emit(
+                trace_id,
+                "observe",
+                finished,
+                now,
+                task_id=task_id,
+                outcome=fields.get(FIELD_STATUS),
+            )
 
     def _collect(self) -> None:
         self.m_uptime.set(time.time() - self.started_at)
@@ -464,6 +589,9 @@ class GatewayContext:
 CTX_KEY: web.AppKey["GatewayContext"] = web.AppKey("ctx", GatewayContext)
 SWEEPER_KEY: web.AppKey["asyncio.Task"] = web.AppKey(
     "result_ttl_sweeper", asyncio.Task
+)
+SPAN_FLUSHER_KEY: web.AppKey["asyncio.Task"] = web.AppKey(
+    "span_flusher", asyncio.Task
 )
 
 
@@ -598,8 +726,13 @@ def _sweep_expired_results(
         if not k.startswith(_FUNCTION_PREFIX)
         and not k.startswith(BLOB_PREFIX)
         and not k.startswith(_FN_INDEX_PREFIX)
+        and not k.startswith(TRACE_PREFIX)
     ]
     blob_expired = _sweep_stale_blobs(store, all_keys, ttl, now_f)
+    # span-plane hashes age by their own t0 stamp (they carry no status,
+    # so the terminal probe below would never collect them)
+    trace_expired = sweep_stale_traces(store, all_keys, ttl, now_f)
+    blob_expired = blob_expired + trace_expired
     if not keys:
         store.delete_many(blob_expired)
         return len(blob_expired)
@@ -664,13 +797,17 @@ def make_app(
     admission: "AdmissionController | None | bool" = True,
     breaker: "CircuitBreaker | None | bool" = True,
     payload_plane: bool = False,
+    trace: bool = False,
 ) -> web.Application:
     """``admission``/``breaker``: True builds the defaults (admission
     fails open until a dispatcher publishes the saturation signal or a
     bound is configured; the breaker trips after 3 consecutive outage
     failures), False/None disables, or pass a configured instance.
     ``payload_plane=True`` turns on content-addressed function shipping
-    (see GatewayContext.payload_plane for why it is opt-in)."""
+    (see GatewayContext.payload_plane for why it is opt-in).
+    ``trace=True`` turns on distributed tracing (see GatewayContext.trace;
+    off by default — single-process and reference-era setups run
+    unchanged)."""
     if admission is True:
         admission = AdmissionController()
     elif admission is False:
@@ -694,6 +831,7 @@ def make_app(
         admission=admission,
         breaker=breaker,
         payload_plane=payload_plane,
+        trace=trace,
     )
     app = web.Application(
         client_max_size=256 * 1024 * 1024, middlewares=[_metrics_middleware]
@@ -707,8 +845,11 @@ def make_app(
     app.router.add_post("/cancel/{task_id}", cancel_task)
     app.router.add_delete("/task/{task_id}", delete_task)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/readyz", readyz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/stats", stats)
+    app.router.add_get("/slo", slo)
+    app.router.add_get("/trace/{task_id}", trace_task)
 
     async def _start_wakeups(_app: web.Application) -> None:
         ctx.waiters = _ResultWaiters(store)
@@ -743,8 +884,38 @@ def make_app(
 
             _app[SWEEPER_KEY] = asyncio.create_task(sweeper())
 
+        if ctx.span_sink is not None:
+            async def span_flusher() -> None:
+                """Drain the span sink's buffer to the store on a short
+                cadence — submits only append to the in-memory buffer, so
+                tracing never puts a store round trip on the serving path.
+                Outages are absorbed by the sink itself (bounded buffer,
+                retry next cycle)."""
+                while not ctx.stopping.is_set():
+                    try:
+                        await _run_blocking(ctx.span_sink.flush)
+                    except Exception:  # flush never raises; belt+braces
+                        pass
+                    try:
+                        await asyncio.wait_for(
+                            ctx.stopping.wait(), timeout=0.25
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+
+            _app[SPAN_FLUSHER_KEY] = asyncio.create_task(span_flusher())
+
     async def _release_waiters(_app: web.Application) -> None:
         ctx.stopping.set()
+        flusher_task = _app.get(SPAN_FLUSHER_KEY)
+        if flusher_task is not None:
+            flusher_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await flusher_task
+            # best-effort final flush so short-lived gateways (tests,
+            # bench legs) don't strand their last buffered spans
+            with contextlib.suppress(Exception):
+                await _run_blocking(ctx.span_sink.flush)
         sweeper_task = _app.get(SWEEPER_KEY)
         if sweeper_task is not None:
             # the sweep period can be hours; don't wait it out on shutdown —
@@ -924,6 +1095,28 @@ async def execute_function(request: web.Request) -> web.Response:
     # first event of the task's lifecycle timeline (obs/trace.py): rides
     # the record so the dispatcher can measure queue wait from the submit
     extra[FIELD_SUBMITTED_AT] = repr(now)
+    # distributed trace context (obs/tracectx.py): client-supplied id
+    # validated (it becomes a store key), or minted here for legacy
+    # clients; ignored entirely while tracing is off
+    trace_id = None
+    if ctx.trace:
+        trace_id = body.get("trace_id")
+        if trace_id is not None and not valid_trace_id(trace_id):
+            return _json_error(
+                400, "'trace_id' must be 8-64 lowercase hex characters"
+            )
+        parent_span = body.get("parent_span")
+        if parent_span is not None and (
+            not isinstance(parent_span, str) or len(parent_span) > 64
+        ):
+            return _json_error(
+                400, "'parent_span' must be a string of at most 64 chars"
+            )
+        if trace_id is None:
+            trace_id = new_trace_id()
+        extra[FIELD_TRACE_ID] = trace_id
+        if parent_span:
+            extra[FIELD_TRACE_PARENT] = parent_span
     idem_key = body.get("idempotency_key")
     if idem_key is not None and (
         not isinstance(idem_key, str) or not idem_key
@@ -939,6 +1132,38 @@ async def execute_function(request: web.Request) -> web.Response:
     if decision is not None and not decision.admitted:
         return _admission_reject(ctx, decision, "submit")
     ctx.m_admitted.inc()
+    t_admit = time.time()
+
+    def note_submit_spans(created_at: float | None) -> None:
+        """Gateway span records of this submit (buffered; the background
+        flusher pays the store trip). Called only at sites that actually
+        created the record — a dedup hit's trace belongs to the winner.
+        Reads ``task_id`` from the enclosing scope at call time (every
+        call site binds it first): the trace hash learns its task so the
+        sweeper can check liveness."""
+        if ctx.span_sink is None or not trace_id:
+            return
+        ctx.span_sink.emit(trace_id, "admit", now, t_admit, task_id=task_id)
+        if created_at is not None:
+            ctx.span_sink.emit(
+                trace_id, "create", created_at, time.time(), task_id=task_id
+            )
+
+    def submit_response(
+        task_id: str, own_trace: bool = True, **extra_body
+    ) -> web.Response:
+        """``own_trace=False``: this request's trace id is NOT the one on
+        the record (a racing creator's won) — suppress it like a dedup
+        hit's, even though the submit itself wasn't deduplicated."""
+        body_out: dict = {"task_id": task_id, **extra_body}
+        if (
+            trace_id is not None
+            and own_trace
+            and not extra_body.get("deduplicated")
+        ):
+            body_out["trace_id"] = trace_id
+        return web.json_response(body_out)
+
     fn_payload, fn_dig = await ctx.store_call(
         ctx.store.hmget,
         _FUNCTION_PREFIX + function_id,
@@ -1020,11 +1245,21 @@ async def execute_function(request: web.Request) -> web.Response:
                     "adopting abandoned idempotency claim for task %s",
                     task_id,
                 )
+                t_create = time.time()
                 if await ctx.store_call(write_task_nx, task_id):
                     ctx.n_tasks += 1
                     ctx.m_tasks.inc()
                     if blob_saved:
                         ctx.m_blob_saved.inc(blob_saved)
+                    # the adopted record carries OUR trace context (the
+                    # winner died before writing one) — so unlike a plain
+                    # dedup hit, THIS caller's trace id is the one on the
+                    # record and the response must say so
+                    note_submit_spans(t_create)
+                    if trace_id is not None:
+                        return submit_response(
+                            task_id, deduplicated=True, trace_id=trace_id
+                        )
             elif (
                 await ctx.store_call(ctx.store.hget, task_id, FIELD_STATUS)
                 is None
@@ -1038,23 +1273,31 @@ async def execute_function(request: web.Request) -> web.Response:
                     "repairing status-stripped record for task %s", task_id
                 )
                 await ctx.store_call(write_task_nx, task_id)
-            return web.json_response(
-                {"task_id": task_id, "deduplicated": True}
-            )
-        await ctx.store_call(write_task_nx, task_id)
-        ctx.n_tasks += 1
-        ctx.m_tasks.inc()
-        if blob_saved:
-            ctx.m_blob_saved.inc(blob_saved)
-        return web.json_response({"task_id": task_id})
+            return submit_response(task_id, deduplicated=True)
+        t_create = time.time()
+        if await ctx.store_call(write_task_nx, task_id):
+            ctx.n_tasks += 1
+            ctx.m_tasks.inc()
+            if blob_saved:
+                ctx.m_blob_saved.inc(blob_saved)
+            note_submit_spans(t_create)
+            return submit_response(task_id)
+        # won the claim but LOST the record write: our create stalled past
+        # the adopt deadline and a dedup loser created the record with ITS
+        # trace context — echoing ours would hand the client a trace id
+        # that disagrees with the record (and the adopter already counted
+        # the task)
+        return submit_response(task_id, own_trace=False)
 
     task_id = new_task_id()
+    t_create = time.time()
     await ctx.store_call(write_task, task_id)
     ctx.n_tasks += 1
     ctx.m_tasks.inc()
     if blob_saved:
         ctx.m_blob_saved.inc(blob_saved)
-    return web.json_response({"task_id": task_id})
+    note_submit_spans(t_create)
+    return submit_response(task_id)
 
 
 async def execute_batch(request: web.Request) -> web.Response:
@@ -1109,6 +1352,42 @@ async def execute_batch(request: web.Request) -> web.Response:
     submit_stamp = repr(now)  # one submit time for the whole batch
     for e in extras:
         e[FIELD_SUBMITTED_AT] = submit_stamp
+    # distributed trace context, batched: a parallel optional list of
+    # client-minted ids; holes (and the whole list, for legacy clients)
+    # are minted here. Ignored entirely while tracing is off.
+    trace_ids: list[str | None] = [None] * len(payloads)
+    if ctx.trace:
+        client_tids = body.get("trace_ids")
+        if client_tids is not None and (
+            not isinstance(client_tids, list)
+            or len(client_tids) != len(payloads)
+        ):
+            return _json_error(
+                400, "'trace_ids' must be a list parallel to 'payloads'"
+            )
+        seen_tids: set[str] = set()
+        for i in range(len(payloads)):
+            tid = client_tids[i] if client_tids else None
+            if tid is not None and not valid_trace_id(tid):
+                return _json_error(
+                    400,
+                    f"trace_ids[{i}] must be 8-64 lowercase hex characters",
+                )
+            if tid is not None:
+                if tid in seen_tids:
+                    # two tasks sharing one trace id would fight over the
+                    # same span hash: identical process:stage fields lose
+                    # the first-write-wins race, the loser's timeline
+                    # silently assembles as the winner's, and the
+                    # duplicate counter (the replay-storm signal) ticks
+                    # on client misuse — same contract as duplicate
+                    # idempotency_keys below
+                    return _json_error(
+                        400, f"trace_ids[{i}] duplicates an earlier entry"
+                    )
+                seen_tids.add(tid)
+            trace_ids[i] = tid or new_trace_id()
+            extras[i][FIELD_TRACE_ID] = trace_ids[i]
     idem_keys = body.get("idempotency_keys")
     if idem_keys is not None:
         if not isinstance(idem_keys, list) or len(idem_keys) != len(payloads):
@@ -1153,6 +1432,7 @@ async def execute_batch(request: web.Request) -> web.Response:
     if decision is not None and not decision.admitted:
         return _admission_reject(ctx, decision, "batch", n=len(payloads))
     ctx.m_admitted.inc(len(payloads))
+    t_admit = time.time()
     fn_payload, fn_dig = await ctx.store_call(
         ctx.store.hmget,
         _FUNCTION_PREFIX + function_id,
@@ -1258,7 +1538,10 @@ async def execute_batch(request: web.Request) -> web.Response:
                 task_ids.append(claim_ids[i])
                 dedup[i] = True
 
-    def write_tasks() -> None:
+    def write_tasks() -> dict[int, bool]:
+        """Write every to-create record; returns which indices THIS call
+        actually created — an NX item can lose to a racing adopter, and
+        its slot's trace id / task count then belongs to the winner."""
         if idem_keys is None:
             ctx.store.create_tasks(
                 [
@@ -1267,11 +1550,12 @@ async def execute_batch(request: web.Request) -> web.Response:
                 ],
                 ctx.channel,
             )
-            return
+            return {i: True for i in to_create}
         # keyed items use the regression-proof create (see write_task_nx in
         # execute_function), batched — a bounded number of pipelined
         # rounds, not several round trips per item; unkeyed items in the
         # same batch keep the one-round-trip pipelined create
+        created_flags: dict[int, bool] = {}
         unkeyed = [i for i in to_create if idem_keys[i] is None]
         if unkeyed:
             ctx.store.create_tasks(
@@ -1281,22 +1565,67 @@ async def execute_batch(request: web.Request) -> web.Response:
                 ],
                 ctx.channel,
             )
-        keyed_items = [
-            (task_ids[i], fn_body, payloads[i], extras[i] or None)
-            for i in to_create
-            if idem_keys[i] is not None
-        ]
-        if keyed_items:
-            ctx.store.create_tasks_if_absent(keyed_items, ctx.channel)
+            created_flags.update({i: True for i in unkeyed})
+        keyed_idx = [i for i in to_create if idem_keys[i] is not None]
+        if keyed_idx:
+            flags = ctx.store.create_tasks_if_absent(
+                [
+                    (task_ids[i], fn_body, payloads[i], extras[i] or None)
+                    for i in keyed_idx
+                ],
+                ctx.channel,
+            )
+            created_flags.update(dict(zip(keyed_idx, flags)))
+        return created_flags
 
-    await ctx.store_call(write_tasks)
-    if fn_body == "" and fn_payload and to_create:
-        ctx.m_blob_saved.inc(len(fn_payload) * len(to_create))
-    ctx.n_tasks += len(to_create)
-    ctx.m_tasks.inc(len(to_create))
+    created_flags = await ctx.store_call(write_tasks)
+    n_created = sum(1 for won_i in created_flags.values() if won_i)
+    if fn_body == "" and fn_payload and n_created:
+        ctx.m_blob_saved.inc(len(fn_payload) * n_created)
+    ctx.n_tasks += n_created
+    ctx.m_tasks.inc(n_created)
+    if ctx.span_sink is not None:
+        # gateway spans for the records this call actually created (a
+        # dedup hit's trace belongs to the claim winner); buffered — the
+        # background flusher pays the store trip
+        t_done = time.time()
+        # one pipelined write round serves the whole batch, so per-record
+        # windows don't exist: every member's span covers the BATCH window,
+        # annotated with the batch size so triage can divide (or discount)
+        # instead of reading N copies of the whole batch's store work as N
+        # independently slow creates
+        batch_attr = {"batch": len(to_create)} if len(to_create) > 1 else {}
+        for i in to_create:
+            tid = trace_ids[i]
+            if tid and created_flags.get(i):
+                ctx.span_sink.emit(
+                    tid,
+                    "admit",
+                    now,
+                    t_admit,
+                    task_id=task_ids[i],
+                    **batch_attr,
+                )
+                ctx.span_sink.emit(
+                    tid,
+                    "create",
+                    t_admit,
+                    t_done,
+                    task_id=task_ids[i],
+                    **batch_attr,
+                )
     resp = {"task_ids": task_ids}
     if idem_keys is not None:
         resp["deduplicated"] = dedup
+    if ctx.trace:
+        # a trace id is only truthful for records THIS call wrote — a
+        # dedup hit's (or an NX race loser's) record carries the claim
+        # winner's id, so its slot reports null (query /trace/<task_id>
+        # for the real one)
+        resp["trace_ids"] = [
+            trace_ids[i] if created_flags.get(i) else None
+            for i in range(len(payloads))
+        ]
     return web.json_response(resp)
 
 
@@ -1357,6 +1686,15 @@ async def get_result(request: web.Request) -> web.Response:
             except ValueError:
                 terminal = True  # unknown status string: reply, don't 500/hang
             if terminal or loop.time() >= deadline or ctx.stopping.is_set():
+                if terminal and task_id not in ctx._observed:
+                    # fire-and-forget: the reply must not wait on the
+                    # telemetry fetch (held via ctx so it can't be GC'd
+                    # mid-flight)
+                    t = loop.create_task(
+                        _note_observed(ctx, task_id, status, time.time())
+                    )
+                    ctx._observe_tasks.add(t)
+                    t.add_done_callback(ctx._observe_tasks.discard)
                 return web.json_response(
                     {"task_id": task_id, "status": status, "result": result}
                 )
@@ -1372,6 +1710,37 @@ async def get_result(request: web.Request) -> web.Response:
     finally:
         if event is not None and waiters is not None:
             waiters.release(task_id, event)
+
+
+async def _note_observed(
+    ctx: "GatewayContext", task_id: str, status: str, observed_at: float
+) -> None:
+    """First terminal /result delivery: feed the e2e latency histograms
+    and the ``observe`` span (the poll-gap segment no dispatcher-local
+    view can see). Runs as a FIRE-AND-FORGET task scheduled after the
+    reply, with ``observed_at`` stamped reply-side — the extra field
+    fetch must neither delay the delivery it measures nor inflate the
+    submit_to_observe phase by its own round trip. Never allowed to fail
+    anything (telemetry degrades, replies don't); the dedup set makes a
+    burst of concurrent first polls observe once."""
+    if task_id in ctx._observed:
+        return
+    try:
+        submitted, finished, trace_id = await ctx.store_call(
+            ctx.store.hmget,
+            task_id,
+            [FIELD_SUBMITTED_AT, FIELD_FINISHED_AT, FIELD_TRACE_ID],
+        )
+    except Exception:
+        return
+    fields: dict = {FIELD_STATUS: status}
+    if submitted is not None:
+        fields[FIELD_SUBMITTED_AT] = submitted
+    if finished is not None:
+        fields[FIELD_FINISHED_AT] = finished
+    if trace_id is not None:
+        fields[FIELD_TRACE_ID] = trace_id
+    ctx.note_result_observed(task_id, fields, observed_at)
 
 
 async def cancel_task(request: web.Request) -> web.Response:
@@ -1511,6 +1880,51 @@ def _safe_store_ha(store: TaskStore) -> tuple[str | None, float | None]:
     return role, lag
 
 
+async def readyz(request: web.Request) -> web.Response:
+    """Readiness (vs /healthz's liveness): 503 while this gateway cannot
+    usefully serve — store breaker open/half-open, store unreachable, or
+    the store client settled on a non-writable replica/fenced endpoint.
+    Orchestration probes route traffic on THIS endpoint and keep /healthz
+    for restarts: a degraded gateway must be drained, not killed."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+    ready, reason = True, "ok"
+    if ctx.breaker is not None and ctx.breaker.state != "closed":
+        ready, reason = False, f"store_breaker_{ctx.breaker.state}"
+    elif not await _run_blocking(_safe_ping, ctx.store):
+        ready, reason = False, "store_unreachable"
+    else:
+        role, _lag = await _run_blocking(_safe_store_ha, ctx.store)
+        if role in ("replica", "fenced"):
+            ready, reason = False, f"store_role_{role}"
+    return web.json_response(
+        {"ready": ready, "reason": reason}, status=200 if ready else 503
+    )
+
+
+async def slo(request: web.Request) -> web.Response:
+    """Per-objective multi-window burn rates over the gateway's e2e
+    latency histograms (obs/slo.py) — the JSON twin of the
+    ``tpu_faas_slo_*`` gauges on /metrics."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+    return web.json_response(await _run_blocking(ctx.slo.snapshot))
+
+
+async def trace_task(request: web.Request) -> web.Response:
+    """The assembled CROSS-PROCESS timeline of one task: gateway admit/
+    create/observe spans, dispatcher intake-to-finalize spans, and the
+    worker's exec window, merged from the store's span plane
+    (obs/tracectx.py assemble_timeline). Works store-wide — any gateway
+    can assemble any task's trace, unlike the dispatcher's /trace which
+    only knows tasks it dispatched. Tasks without a trace id (tracing
+    off, legacy producers) resolve with zero spans rather than 404ing."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+    task_id = request.match_info["task_id"]
+    timeline = await ctx.store_call(assemble_timeline, ctx.store, task_id)
+    if timeline is None:
+        return _json_error(404, f"unknown task_id {task_id!r}")
+    return web.json_response(timeline)
+
+
 async def metrics(request: web.Request) -> web.Response:
     """Prometheus text exposition: the gateway's private registry (request
     counts + latency histograms per route, submission counters, store
@@ -1607,6 +2021,7 @@ def start_gateway_thread(
     admission: "AdmissionController | None | bool" = True,
     breaker: "CircuitBreaker | None | bool" = True,
     payload_plane: bool = False,
+    trace: bool = False,
 ) -> GatewayHandle:
     """Serve the gateway in a daemon thread; returns once the port is bound."""
     started = threading.Event()
@@ -1627,6 +2042,7 @@ def start_gateway_thread(
                     admission=admission,
                     breaker=breaker,
                     payload_plane=payload_plane,
+                    trace=trace,
                 )
             )
             await runner.setup()
@@ -1691,6 +2107,14 @@ def main(argv: list[str] | None = None) -> None:
         "to be payload-plane-aware; leave off while reference-style "
         "dispatchers read the store",
     )
+    ap.add_argument(
+        "--trace", action="store_true",
+        help="distributed tracing: every submit carries a trace id "
+        "(client-minted or minted here), every hop emits span records "
+        "into the store's trace: namespace, and /trace/<task_id> "
+        "assembles the cross-process timeline. Off by default — "
+        "single-process and reference-era setups run unchanged",
+    )
     ns = ap.parse_args(argv)
     store = make_store(ns.store)
     if ns.no_admission:
@@ -1718,6 +2142,7 @@ def main(argv: list[str] | None = None) -> None:
             admission=admission,
             breaker=breaker,
             payload_plane=ns.payload_plane,
+            trace=ns.trace,
         ),
         host=ns.host,
         port=ns.port,

@@ -35,7 +35,14 @@ from tpu_faas.obs.metrics import (
     MetricsRegistry,
     render,
 )
+from tpu_faas.obs.slo import Objective, SLOTracker
 from tpu_faas.obs.trace import EVENTS, STAGES, TaskTraceBook, anchored_now
+from tpu_faas.obs.tracectx import (
+    SpanSink,
+    assemble_timeline,
+    new_trace_id,
+    valid_trace_id,
+)
 
 __all__ = [
     "CONTENT_TYPE",
@@ -45,9 +52,15 @@ __all__ = [
     "Histogram",
     "LATENCY_BUCKETS",
     "MetricsRegistry",
+    "Objective",
     "REGISTRY",
+    "SLOTracker",
     "STAGES",
+    "SpanSink",
     "TaskTraceBook",
     "anchored_now",
+    "assemble_timeline",
+    "new_trace_id",
     "render",
+    "valid_trace_id",
 ]

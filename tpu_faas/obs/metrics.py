@@ -273,6 +273,31 @@ class Histogram(_Metric):
     def observe(self, value: float) -> None:
         self._default().observe(value)
 
+    def sum_counts(
+        self, match: Sequence[str | None]
+    ) -> tuple[tuple[float, ...], list[int]] | None:
+        """(bucket uppers, summed per-bucket counts — overflow slot last)
+        across every child whose label values equal ``match`` positionally
+        (None = wildcard). None when nothing matches. The SLO trackers'
+        shared data source: both the gateway e2e phases and the dispatcher
+        stage histogram filter one label exactly and one to a terminal
+        outcome."""
+        total: list[int] | None = None
+        for values, child in self.child_items():
+            if any(
+                want is not None and have != want
+                for have, want in zip(values, match)
+            ):
+                continue
+            counts, _ = child.snapshot()
+            if total is None:
+                total = counts
+            else:
+                total = [a + b for a, b in zip(total, counts)]
+        if total is None:
+            return None
+        return self._uppers, total
+
     def render_into(self, out: list[str]) -> None:
         for values, child in self.child_items():
             counts, total = child.snapshot()

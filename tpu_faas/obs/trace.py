@@ -17,11 +17,21 @@ wall-clock steps while cross-process stamps (gateway, worker — raw
 ``time.time()``) remain comparable up to host clock sync.
 
 On ``finished`` the timeline is closed: per-stage deltas are observed into
-the ``tpu_faas_task_stage_seconds{stage=...}`` histogram of the owning
-registry (the scrapeable aggregate), and the full timeline moves into a
-bounded ring of recent completions plus a bounded slowest-task list — the
-raw material behind the dispatcher's ``/trace/<task_id>`` and ``/trace``
-debug endpoints. No per-task storage survives beyond those rings.
+the ``tpu_faas_task_stage_seconds{stage=...,terminal=...}`` histogram of
+the owning registry (the scrapeable aggregate; ``terminal`` carries the
+closing outcome so shed/cancelled populations don't pollute the COMPLETED
+latency distribution), and the full timeline moves into a bounded ring of
+recent completions plus a bounded slowest-task list — the raw material
+behind the dispatcher's ``/trace/<task_id>`` and ``/trace`` debug
+endpoints. No per-task storage survives beyond those rings; an optional
+``on_close`` callback hands each closed record to the cross-process span
+plane (obs/tracectx.py).
+
+Recording is FIRST-WRITE-WINS: a duplicate stamp of an already-present
+event (replayed announce after a store failover, a re-dispatch, a
+zombie's late RESULT) keeps the original and is counted into
+``tpu_faas_trace_duplicate_events_total{event}`` — replay storms become
+visible instead of silently corrupting stage deltas.
 """
 
 from __future__ import annotations
@@ -92,6 +102,11 @@ class TaskTraceBook:
     ) -> None:
         self._lock = threading.Lock()
         self._active: dict[str, dict[str, float]] = {}
+        #: task_id -> trace id (distributed trace context), carried beside
+        #: the float-valued event dicts and popped with them — the closed
+        #: record hands it to ``on_close`` so the span plane can key its
+        #: cross-process writes
+        self._trace_ids: dict[str, str] = {}
         self._recent: deque[dict] = deque(maxlen=recent_cap)
         self._completed: dict[str, dict] = {}
         self._active_cap = active_cap
@@ -100,16 +115,45 @@ class TaskTraceBook:
         self._slowest: list[tuple[float, int, dict]] = []
         self._seq = itertools.count()
         self.n_completed = 0
+        #: optional callback(record) invoked OUTSIDE the book lock for
+        #: every closed timeline — the dispatcher wires the cross-process
+        #: span emission here; exceptions are the caller's problem to
+        #: avoid (span sinks never raise)
+        self.on_close = None
         self._hist = registry.histogram(
             "tpu_faas_task_stage_seconds",
             "Per-stage task lifecycle latency (seconds), aggregated from "
-            "the nine-event task timelines",
-            ("stage",),
+            "the nine-event task timelines; 'terminal' is the closing "
+            "outcome (COMPLETED/FAILED/CANCELLED/EXPIRED and the "
+            "dispatcher-side drop reasons), so shed populations don't "
+            "pollute the completed-latency distribution",
+            ("stage", "terminal"),
         )
-        # pre-create every stage child: the scrape shows the full stage
-        # catalog (at zero) before the first task completes
+        self._m_dup = registry.counter(
+            "tpu_faas_trace_duplicate_events_total",
+            "Trace event/span stamps suppressed by first-write-wins "
+            "recording, by event — replay storms (announce replay "
+            "after failover, zombie duplicate RESULTs) surface here "
+            "instead of silently corrupting stage deltas",
+            ("event",),
+        )
+        # pre-create every stage child (for the common outcome): the scrape
+        # shows the full stage catalog (at zero) before the first task
+        # completes
         for stage in STAGES:
-            self._hist.labels(stage=stage)
+            self._hist.labels(stage=stage, terminal="COMPLETED")
+
+    def stage_snapshot(
+        self, stage: str, terminal: str | None = "COMPLETED"
+    ) -> tuple[tuple[float, ...], list[int]] | None:
+        """(bucket uppers, per-bucket counts) for one stage — the SLO
+        tracker's data source. COMPLETED outcomes only by default: shed
+        (EXPIRED) and cancelled populations must not burn the latency
+        error budget — shedding under overload is intended behavior, and
+        counting quick cancels as "good" would dilute real violations.
+        ``terminal=None`` sums across every outcome. None for an unknown
+        stage with no series yet."""
+        return self._hist.sum_counts((stage, terminal))
 
     # -- recording ---------------------------------------------------------
     def note(
@@ -118,6 +162,7 @@ class TaskTraceBook:
         event: str,
         ts: float | None = None,
         open_new: bool = True,
+        count_dup: bool = True,
     ) -> None:
         """Stamp ``event`` on the task's timeline (first stamp wins: a
         re-dispatched task keeps its original ``sent``, and the retry is
@@ -126,7 +171,14 @@ class TaskTraceBook:
         ``open_new=False`` stamps ONLY an already-open timeline: events
         that can arrive after a task finished — a zombie worker's late
         second RESULT — must not resurrect the closed trace as a fresh
-        (then duplicate-completed) one."""
+        (then duplicate-completed) one.
+
+        ``count_dup=False`` suppresses the duplicate-counter tick for a
+        re-stamp the CALLER knows is routine — the scheduled/sent stamps
+        of a reclaimed task's redispatch are normal at-least-once
+        operation (already visible as ``retries``), and counting them
+        would page operators reading the counter as the replay-storm
+        signal it is documented to be."""
         if ts is None:
             ts = anchored_now()
         with self._lock:
@@ -137,9 +189,26 @@ class TaskTraceBook:
                 if len(self._active) >= self._active_cap:
                     # drop the oldest open timeline (dict preserves insert
                     # order): an abandoned trace must never grow memory
-                    self._active.pop(next(iter(self._active)))
+                    evicted = next(iter(self._active))
+                    self._active.pop(evicted)
+                    self._trace_ids.pop(evicted, None)
                 events = self._active[task_id] = {}
+            duplicate = event in events
             events.setdefault(event, ts)
+        if duplicate and count_dup:
+            # first write wins; the suppressed stamp is counted so replay
+            # storms (failover announce replay re-entering intake) are
+            # operator-visible instead of silent
+            self._m_dup.labels(event=event).inc()
+
+    def note_trace(self, task_id: str, trace_id: str | None) -> None:
+        """Attach the distributed trace id to an open (or about-to-open)
+        timeline; first write wins, same as event stamps."""
+        if not trace_id:
+            return
+        with self._lock:
+            if task_id in self._active:
+                self._trace_ids.setdefault(task_id, trace_id)
 
     def note_retry(self, task_id: str) -> None:
         with self._lock:
@@ -158,8 +227,20 @@ class TaskTraceBook:
             ts = anchored_now()
         with self._lock:
             events = self._active.pop(task_id, None)
+            trace_id = self._trace_ids.pop(task_id, None)
             if events is None:
                 return
+            already_closed = task_id in self._completed
+        if already_closed:
+            # FIRST COMPLETION WINS: a replayed announce (store-failover
+            # re-arm) or a zombie's duplicate RESULT opened a stub
+            # timeline for a task whose rich closed record still sits in
+            # the ring — discard the stub instead of clobbering the
+            # record, double-counting the completion, and polluting the
+            # recent ring. Counted like any other suppressed replay.
+            self._m_dup.labels(event="finished").inc()
+            return
+        with self._lock:
             events.setdefault("finished", ts)
             retries = int(events.pop("retries", 0))
             stages: dict[str, float] = {}
@@ -170,9 +251,12 @@ class TaskTraceBook:
                         stages[stage] = delta
         # histogram observes OUTSIDE the book lock (the child has its own)
         for stage, delta in stages.items():
-            self._hist.labels(stage=stage).observe(delta)
+            self._hist.labels(stage=stage, terminal=str(outcome)).observe(
+                delta
+            )
         record = {
             "task_id": task_id,
+            "trace_id": trace_id,
             "outcome": outcome,
             "retries": retries,
             "events": dict(sorted(events.items(), key=lambda kv: kv[1])),
@@ -192,12 +276,16 @@ class TaskTraceBook:
                 heapq.heappush(self._slowest, entry)
             elif total > self._slowest[0][0]:
                 heapq.heapreplace(self._slowest, entry)
+        on_close = self.on_close
+        if on_close is not None:
+            on_close(record)
 
     def discard(self, task_id: str) -> None:
         """Forget an open timeline without closing it (task claimed by a
         sibling dispatcher — its lifecycle belongs to them)."""
         with self._lock:
             self._active.pop(task_id, None)
+            self._trace_ids.pop(task_id, None)
 
     # -- inspection --------------------------------------------------------
     def timeline(self, task_id: str) -> dict | None:
@@ -213,6 +301,7 @@ class TaskTraceBook:
             snap = {k: v for k, v in events.items() if k != "retries"}
             return {
                 "task_id": task_id,
+                "trace_id": self._trace_ids.get(task_id),
                 "outcome": None,
                 "retries": int(events.get("retries", 0)),
                 "events": dict(sorted(snap.items(), key=lambda kv: kv[1])),

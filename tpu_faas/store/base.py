@@ -420,6 +420,20 @@ class TaskStore(abc.ABC):
         RESP client pipelines everything into one round trip."""
         return [self.setnx_field(key, field, value) for key, value in items]
 
+    def hsetnx_many(
+        self, items: list[tuple[str, str, str]]
+    ) -> list[bool]:
+        """Set-if-absent over arbitrary (key, field, value) triples —
+        unlike ``setnx_fields`` the FIELD varies per item. Returns created
+        flags parallel to ``items`` (no value read-back: callers of this
+        form only need to know whether their write stood). The span
+        plane's first-write-wins record flush rides this. Default: a
+        loop; the RESP client pipelines one HSETNX round."""
+        return [
+            self.setnx_field(key, field, value)[0]
+            for key, field, value in items
+        ]
+
     def delete_many(self, keys: list[str]) -> None:
         """Batch delete. Default: a loop; the RESP client sends one DEL
         with all keys (the TTL sweeper's backlog purge)."""

@@ -648,6 +648,19 @@ class RespStore(TaskStore):
             for i in range(len(items))
         ]
 
+    def hsetnx_many(self, items) -> list[bool]:
+        """Pipelined HSETNX over (key, field, value) triples: the span
+        plane's first-write-wins flush pays one round trip per flush, not
+        one per span. An error reply on one item (foreign WRONGTYPE key)
+        degrades to created=False for that item instead of poisoning the
+        batch — spans are telemetry, the healthy writes must land."""
+        if not items:
+            return []
+        replies = self.pipeline(
+            [("HSETNX", key, field, value) for key, field, value in items]
+        )
+        return [r == 1 for r in replies]
+
     # -- pipelined batch ops ----------------------------------------------
     def hget_many(self, keys, field: str):
         return self.pipeline([("HGET", k, field) for k in keys])

@@ -36,6 +36,7 @@ from typing import Iterable, Mapping
 from tpu_faas.admission.signal import FLEET_HEALTH_KEY
 from tpu_faas.core.payload import payload_digest
 from tpu_faas.core.task import FIELD_RESULT, FIELD_STATUS, TaskStatus
+from tpu_faas.obs.tracectx import TRACE_PREFIX
 from tpu_faas.store.base import (
     BLOB_DATA_FIELD,
     BLOB_PREFIX,
@@ -479,6 +480,12 @@ class RaceCheckStore(TaskStore):
                 )
             self.inner.hset(key, fields)
             return
+        if key.startswith(TRACE_PREFIX):
+            # span-plane hashes (obs/tracectx.py): telemetry, not task
+            # records — span fields are first-write-wins by construction
+            # (hsetnx), the stamp refresh is bookkeeping
+            self.inner.hset(key, fields)
+            return
         op = "finish" if FIELD_RESULT in fields else "status"
         if FIELD_STATUS in fields and fields[FIELD_STATUS] == str(
             TaskStatus.QUEUED
@@ -568,6 +575,12 @@ class RaceCheckStore(TaskStore):
                         self.actor, "create", key, {FIELD_STATUS: value}
                     )
         return results
+
+    def hsetnx_many(self, items) -> list[bool]:
+        # span-plane first-write-wins writes: trace hashes carry no
+        # lifecycle fields, so there is nothing to observe — but route
+        # through setnx_field-aware inner for atomicity
+        return self.inner.hsetnx_many(items)
 
     def keys(self) -> list[str]:
         return self.inner.keys()

@@ -33,7 +33,7 @@ LOG_FORMAT_ENV = "TPU_FAAS_LOG_FORMAT"
 
 #: record attributes copied into JSON log lines when a log site set them
 #: via ``extra=`` (see :func:`log_ctx`)
-_CONTEXT_FIELDS = ("task_id", "worker_id", "dispatcher_id")
+_CONTEXT_FIELDS = ("task_id", "worker_id", "dispatcher_id", "trace_id")
 
 
 def log_ctx(**fields: object) -> dict:

@@ -63,6 +63,11 @@ dispatcher -> worker:
                ``fn_payload`` when the worker registered the "blob"
                capability: the worker resolves the body from its payload
                cache, or parks the task and asks with BLOB_MISS.
+    TASK (tracing) carries ``trace_id`` when the worker registered the
+               "trace" capability (distributed trace context,
+               tpu_faas/obs/tracectx.py); the worker stamps it into its
+               logs and echoes it on the matching RESULT. Reference-era
+               workers never receive the field.
     BLOB_FILL  data: digest, data (the ASCII payload body) — answers a
                BLOB_MISS; ``missing=True`` (no data) when the blob is
                gone from the store too, telling the worker to FAIL the
@@ -100,8 +105,13 @@ BLOB_FILL = "blob_fill"
 #: capability tokens carried in REGISTER/RECONNECT ``caps``
 CAP_BLOB = "blob"
 CAP_BIN = "bin"
+#: distributed tracing: a trace-capable worker receives the task's
+#: ``trace_id`` on TASK messages (stamped into its logs via log_ctx) and
+#: echoes it on the matching RESULT. Capability-gated like blob/bin so
+#: reference-era workers never see the field.
+CAP_TRACE = "trace"
 #: what a current-generation worker advertises
-WORKER_CAPS = (CAP_BLOB, CAP_BIN)
+WORKER_CAPS = (CAP_BLOB, CAP_BIN, CAP_TRACE)
 
 #: binary-frame magic: never a valid first byte of the ASCII contract
 #: (base64's alphabet is [A-Za-z0-9+/=]), so one-byte sniffing is exact

@@ -24,7 +24,7 @@ import zmq
 from tpu_faas.core.payload import PayloadLRU
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import TaskStatus
-from tpu_faas.utils.logging import get_logger
+from tpu_faas.utils.logging import get_logger, log_ctx
 from tpu_faas.worker import messages as m
 from tpu_faas.worker.pool import FN_CACHE_HITS, FN_CACHE_MISSES, TaskPool
 
@@ -56,6 +56,9 @@ class PullWorker:
         self.fn_cache = PayloadLRU(fn_cache_bytes)
         #: True after the dispatcher's first binary reply — sends switch
         self._peer_bin = False
+        #: task_id -> distributed trace id (TASK ``trace_id``): stamped
+        #: into logs and echoed on the matching RESULT
+        self._task_trace: dict[str, str] = {}
         self.pool = TaskPool(num_processes)
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.REQ)
@@ -107,6 +110,16 @@ class PullWorker:
         transaction — REQ/REP gives us a mandatory reply to ride) and
         submit to the pool."""
         digest = reply.get("fn_digest")
+        trace_id = reply.get("trace_id")
+        if isinstance(trace_id, str) and trace_id:
+            self._task_trace[reply["task_id"]] = trace_id
+            log.debug(
+                "task received", extra=log_ctx(
+                    task_id=reply["task_id"],
+                    worker_id=self.worker_id,
+                    trace_id=trace_id,
+                ),
+            )
         payload = reply.get("fn_payload")
         if payload is None and digest:
             payload = self.fn_cache.get(digest)
@@ -120,6 +133,10 @@ class PullWorker:
                 # dispatcher: FAIL the task via the ordinary result path
                 # rather than dropping it silently — REQ/REP has no
                 # parked-task structure to wait in
+                fail_extra: dict = {}
+                fail_trace = self._task_trace.pop(reply["task_id"], None)
+                if fail_trace:
+                    fail_extra["trace_id"] = fail_trace
                 self._transact(
                     m.RESULT,
                     worker_id=self.worker_id,
@@ -132,6 +149,7 @@ class PullWorker:
                         )
                     ),
                     no_task=True,
+                    **fail_extra,
                 )
                 return
         elif payload is not None and digest:
@@ -195,6 +213,10 @@ class PullWorker:
                 # ship every finished result; each reply may carry new work
                 # (unless draining, where no_task forces a WAIT reply)
                 for res in self.pool.drain():
+                    extra_kw: dict = {}
+                    trace_id = self._task_trace.pop(res.task_id, None)
+                    if trace_id:
+                        extra_kw["trace_id"] = trace_id
                     self._transact(
                         m.RESULT,
                         worker_id=self.worker_id,
@@ -205,6 +227,7 @@ class PullWorker:
                         started_at=res.started_at,
                         misfires=self.pool.n_misfires,
                         no_task=self._draining,
+                        **extra_kw,
                     )
                     shipped += 1
                     last_transact = time.monotonic()

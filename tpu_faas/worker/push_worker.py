@@ -28,7 +28,7 @@ import zmq
 from tpu_faas.core.payload import PayloadLRU
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import TaskStatus
-from tpu_faas.utils.logging import get_logger
+from tpu_faas.utils.logging import get_logger, log_ctx
 from tpu_faas.worker import messages as m
 from tpu_faas.worker.pool import FN_CACHE_HITS, FN_CACHE_MISSES, TaskPool
 
@@ -75,6 +75,11 @@ class PushWorker:
         #: core/executor.py). Filled by BLOB_FILLs and by inline payloads
         #: seen with a digest attached.
         self.fn_cache = PayloadLRU(fn_cache_bytes)
+        #: task_id -> distributed trace id (TASK ``trace_id``, present only
+        #: when this worker advertised CAP_TRACE to a tracing dispatcher):
+        #: stamped into logs and echoed on the matching RESULT; entries
+        #: live exactly as long as the task is held here
+        self._task_trace: dict[str, str] = {}
         #: digest -> TASK payload dicts parked on an outstanding miss
         self._awaiting: dict[str, list[dict]] = {}
         #: digest -> monotonic time the last BLOB_MISS went out
@@ -132,6 +137,14 @@ class PushWorker:
         handler resubmitting a parked task) skips the hit/miss counters:
         that resolution was already counted as its original miss."""
         digest = data.get("fn_digest")
+        trace_id = data.get("trace_id")
+        if isinstance(trace_id, str) and trace_id:
+            self._task_trace[data["task_id"]] = trace_id
+            log.debug(
+                "task received", extra=log_ctx(
+                    task_id=data["task_id"], trace_id=trace_id
+                ),
+            )
         payload = data.get("fn_payload")
         if payload is None:
             payload = self.fn_cache.get(digest) if digest else None
@@ -175,6 +188,10 @@ class PushWorker:
             # converge instead of waiting forever
             self._miss_sent.pop(digest, None)
             for parked in self._awaiting.pop(digest, ()):
+                extra: dict = {}
+                trace_id = self._task_trace.pop(parked["task_id"], None)
+                if trace_id:
+                    extra["trace_id"] = trace_id
                 self._send(
                     m.RESULT,
                     task_id=parked["task_id"],
@@ -185,6 +202,7 @@ class PushWorker:
                             "the store"
                         )
                     ),
+                    **extra,
                 )
         # an empty fill (no data, no missing) means "store outage, retry":
         # the parked tasks stay and the resend timer re-asks
@@ -273,6 +291,10 @@ class PushWorker:
                                 caps=list(self.caps),
                             )
                 for res in self.pool.drain():
+                    extra_kw: dict = {}
+                    trace_id = self._task_trace.pop(res.task_id, None)
+                    if trace_id:
+                        extra_kw["trace_id"] = trace_id
                     self._send(
                         m.RESULT,
                         task_id=res.task_id,
@@ -281,10 +303,13 @@ class PushWorker:
                         elapsed=res.elapsed,
                         started_at=res.started_at,
                         misfires=self.pool.n_misfires,
+                        **extra_kw,
                     )
                     log.debug(
                         "shipped result %s", res.status,
-                        extra={"task_id": res.task_id},
+                        extra=log_ctx(
+                            task_id=res.task_id, trace_id=trace_id
+                        ),
                     )
                     shipped += 1
                 if max_tasks is not None and shipped >= max_tasks:
