@@ -9,9 +9,9 @@
 // no threads, no locks, no allocation in the steady-state paths beyond the
 // hash tables themselves.
 //
-// Supported commands: PING, SELECT (ignored), HSET, HSETNX, HGET, HEXISTS, HMGET, HDEL,
-// HGETALL, DEL, KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE, FLUSHDB, SAVE, QUIT,
-// SHUTDOWN.
+// Supported commands: PING, SELECT (ignored), HSET, HSETNX, HINCRBY, HGET,
+// HEXISTS, HMGET, HDEL, HGETALL, DEL, KEYS, PUBLISH, SUBSCRIBE, UNSUBSCRIBE,
+// FLUSHDB, SAVE, QUIT, SHUTDOWN.
 //
 // Checkpoint/resume: --snapshot PATH loads PATH at startup and writes it on
 // SAVE / SHUTDOWN and every --autosave seconds while dirty. The snapshot is
@@ -483,6 +483,36 @@ class Server {
         dirty_ = true;
         reply_integer(c.outbuf, 1);
       }
+    } else if (name == "HINCRBY") {
+      // atomic integer add (single-threaded server => trivially atomic):
+      // the task-graph promotion plane's pending-count decrement
+      if (argc != 3) {
+        reply_error(c.outbuf, "wrong number of arguments for HINCRBY");
+        return;
+      }
+      errno = 0;
+      char* end = nullptr;
+      const long long delta = strtoll(cmd[3].c_str(), &end, 10);
+      if (errno != 0 || end == cmd[3].c_str() || *end != '\0') {
+        reply_error(c.outbuf, "HINCRBY delta is not an integer");
+        return;
+      }
+      auto& h = store_.hashes[cmd[1]];
+      long long value = 0;
+      auto f = h.find(cmd[2]);
+      if (f != h.end()) {
+        errno = 0;
+        end = nullptr;
+        value = strtoll(f->second.c_str(), &end, 10);
+        if (errno != 0 || end == f->second.c_str() || *end != '\0') {
+          reply_error(c.outbuf, "hash value is not an integer");
+          return;
+        }
+      }
+      value += delta;
+      h[cmd[2]] = std::to_string(value);
+      dirty_ = true;
+      reply_integer(c.outbuf, value);
     } else if (name == "HDEL") {
       if (argc < 2) {
         reply_error(c.outbuf, "wrong number of arguments for HDEL");

@@ -204,6 +204,60 @@ def test_protocol_finish_task_many_rules(tmp_path):
     ]
 
 
+def test_protocol_waiting_set_status_fires(tmp_path):
+    """The graph vocabulary: WAITING may only be written by the store
+    package (create with deps + the promotion plane). A bare set_status /
+    set_status_many of WAITING anywhere else strands an undispatchable
+    node."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        def f(store, tid):
+            store.set_status(tid, TaskStatus.WAITING)
+            store.set_status_many("WAITING", [(tid, None)])
+        """,
+    )
+    assert hits(findings) == [
+        ("protocol.waiting-set-status", 4),
+        ("protocol.waiting-set-status", 5),
+    ]
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_protocol_waiting_vocabulary_clean(tmp_path):
+    """WAITING via the legal surfaces stays clean: creation with
+    status=WAITING (any path), promotion via the store package, and the
+    poison's finish_task(FAILED) — the derived sets must know the new
+    status (not flag it unknown)."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        def f(store, tasks, tid):
+            store.create_tasks(tasks, status=TaskStatus.WAITING)
+            store.finish_task(tid, TaskStatus.FAILED, "dep_failed")
+        """,
+    )
+    assert findings == []
+    # inside the store package the promotion plane's own writes are legal
+    pkg = tmp_path / "tpu_faas" / "store"
+    pkg.mkdir(parents=True)
+    (pkg / "promo.py").write_text(
+        textwrap.dedent(
+            """\
+            from tpu_faas.core.task import TaskStatus
+
+            def promote(store, items):
+                store.set_status_many(TaskStatus.QUEUED, items)
+            """
+        )
+    )
+    assert run_paths([pkg]) == []
+
+
 def test_protocol_clean_fixture(tmp_path):
     """The legal surface: conveniences with legal statuses, hset without
     lifecycle fields, publish on a non-lifecycle channel, dynamic statuses

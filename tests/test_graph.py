@@ -1,0 +1,845 @@
+"""Task graphs (tpu_faas/graph): validation, the store promotion plane,
+the device frontier kernels, gateway /execute_graph, SDK builders, and the
+end-to-end diamond on the tpu-push path — including the acceptance proof
+that no WAITING node ever reaches a worker (the race monitor's missing
+WAITING -> RUNNING transition)."""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from tpu_faas.client import FaaSClient, TaskDependencyError
+from tpu_faas.core.serialize import deserialize, serialize
+from tpu_faas.core.task import (
+    FIELD_CHILDREN,
+    FIELD_DEPS,
+    FIELD_FINISHED_AT,
+    FIELD_PENDING_DEPS,
+    TaskStatus,
+)
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.graph import GraphValidationError, validate_graph
+from tpu_faas.store import MemoryStore
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.workloads import arithmetic, failing_task, sleep_task
+
+WAITING = str(TaskStatus.WAITING)
+QUEUED = str(TaskStatus.QUEUED)
+
+
+def _make_waiting(store, task_id, parents, children=None, extra=None):
+    fields = {
+        FIELD_DEPS: ",".join(parents),
+        FIELD_PENDING_DEPS: str(len(parents)),
+        **(extra or {}),
+    }
+    if children:
+        fields[FIELD_CHILDREN] = ",".join(children)
+    store.create_tasks(
+        [(task_id, "f", "p", fields)], status=TaskStatus.WAITING
+    )
+
+
+def _make_parent(store, task_id, children):
+    store.create_tasks(
+        [(task_id, "f", "p", {FIELD_CHILDREN: ",".join(children)})]
+    )
+
+
+# -- validation --------------------------------------------------------------
+
+
+def test_validate_graph_accepts_diamond_and_orders_topologically():
+    nodes = [
+        {"function_id": "f", "payload": "p"},
+        {"function_id": "f", "payload": "p", "depends_on": [0]},
+        {"function_id": "f", "payload": "p", "depends_on": [0]},
+        {"function_id": "f", "payload": "p", "depends_on": [1, 2]},
+    ]
+    deps, topo = validate_graph(nodes)
+    assert deps == [[], [0], [0], [1, 2]]
+    pos = {i: k for k, i in enumerate(topo)}
+    for i, parents in enumerate(deps):
+        for p in parents:
+            assert pos[p] < pos[i]
+
+
+def test_validate_graph_rejects_cycles_refs_and_caps():
+    with pytest.raises(GraphValidationError, match="cycle"):
+        validate_graph(
+            [
+                {"function_id": "f", "payload": "p", "depends_on": [1]},
+                {"function_id": "f", "payload": "p", "depends_on": [0]},
+            ]
+        )
+    with pytest.raises(GraphValidationError, match="itself"):
+        validate_graph(
+            [{"function_id": "f", "payload": "p", "depends_on": [0]}]
+        )
+    with pytest.raises(GraphValidationError, match="out of range"):
+        validate_graph(
+            [{"function_id": "f", "payload": "p", "depends_on": [5]}]
+        )
+    with pytest.raises(GraphValidationError, match="unknown node id"):
+        validate_graph(
+            [{"function_id": "f", "payload": "p", "depends_on": ["ghost"]}]
+        )
+    with pytest.raises(GraphValidationError, match="duplicates"):
+        validate_graph(
+            [
+                {"function_id": "f", "payload": "p", "id": "a"},
+                {"function_id": "f", "payload": "p", "id": "a"},
+            ]
+        )
+    with pytest.raises(GraphValidationError, match="above the cap"):
+        validate_graph(
+            [{"function_id": "f", "payload": "p"} for _ in range(5)],
+            max_nodes=4,
+        )
+    # string refs resolve by node id
+    deps, _ = validate_graph(
+        [
+            {"function_id": "f", "payload": "p", "id": "root"},
+            {"function_id": "f", "payload": "p", "depends_on": ["root"]},
+        ]
+    )
+    assert deps == [[], [0]]
+
+
+# -- store promotion plane ---------------------------------------------------
+
+
+def test_promotion_diamond_announces_only_when_last_parent_completes():
+    s = MemoryStore()
+    _make_waiting(s, "D", ["B", "C"])
+    _make_waiting(s, "B", ["A"], children=["D"])
+    _make_waiting(s, "C", ["A"], children=["D"])
+    _make_parent(s, "A", ["B", "C"])
+    sub = s.subscribe("tasks")
+    while sub.get_message() is not None:
+        pass  # drain the create announces
+
+    s.finish_task("A", TaskStatus.COMPLETED, "r")
+    promoted, poisoned = s.complete_dep_many([("A", "COMPLETED")])
+    assert sorted(promoted) == ["B", "C"] and poisoned == []
+    assert s.get_status("B") == QUEUED and s.get_status("C") == QUEUED
+    assert s.get_status("D") == WAITING
+    msgs = []
+    while True:
+        m = sub.get_message()
+        if m is None:
+            break
+        msgs.append(m)
+    assert sorted(msgs) == ["B", "C"]  # promoted children re-announced
+
+    s.finish_task("B", TaskStatus.COMPLETED, "r")
+    assert s.complete_dep_many([("B", "COMPLETED")]) == ([], [])
+    assert s.get_status("D") == WAITING  # one parent still outstanding
+    s.finish_task("C", TaskStatus.COMPLETED, "r")
+    promoted, _ = s.complete_dep_many([("C", "COMPLETED")])
+    assert promoted == ["D"]
+    assert s.get_status("D") == QUEUED
+
+
+def test_poison_walks_transitive_frontier_without_dispatching():
+    # chain A -> B -> C -> D; A fails => B, C, D all FAILED, never QUEUED
+    s = MemoryStore()
+    _make_waiting(s, "D", ["C"])
+    _make_waiting(s, "C", ["B"], children=["D"])
+    _make_waiting(s, "B", ["A"], children=["C"])
+    _make_parent(s, "A", ["B"])
+    s.finish_task("A", TaskStatus.FAILED, serialize(ValueError("boom")))
+    promoted, poisoned = s.complete_dep_many([("A", "FAILED")])
+    assert promoted == [] and poisoned == ["B", "C", "D"]
+    for tid, parent in (("B", "A"), ("C", "B"), ("D", "C")):
+        assert s.get_status(tid) == "FAILED"
+        err = deserialize(s.hget(tid, "result"))
+        assert str(err).startswith(f"dep_failed:{parent}"), (tid, err)
+        assert s.hget(tid, FIELD_FINISHED_AT) is not None
+    # never-dispatched: no record ever read RUNNING, and the live index
+    # dropped every poisoned node
+    assert s.hgetall("tasks:index") == {}
+
+
+def test_complete_dep_is_idempotent_across_duplicate_finishes():
+    s = MemoryStore()
+    _make_waiting(s, "B", ["A"])
+    _make_parent(s, "A", ["B"])
+    s.finish_task("A", TaskStatus.COMPLETED, "r")
+    assert s.complete_dep_many([("A", "COMPLETED")]) == (["B"], [])
+    # a zombie's duplicate terminal write replays the walk: the per-edge
+    # claim stops the double decrement, the resolution claim the repromote
+    assert s.complete_dep_many([("A", "COMPLETED")]) == ([], [])
+    assert int(s.hget("B", FIELD_PENDING_DEPS)) == 0
+    assert s.get_status("B") == QUEUED
+
+
+def test_expire_and_cancel_poison_children_in_store():
+    s = MemoryStore()
+    _make_waiting(s, "B", ["A"])
+    _make_parent(s, "A", ["B"])
+    assert s.expire_task("A") == "EXPIRED"
+    assert s.get_status("B") == "FAILED"
+    assert str(deserialize(s.hget("B", "result"))).startswith("dep_failed:A")
+
+    s2 = MemoryStore()
+    _make_waiting(s2, "B", ["A"])
+    _make_parent(s2, "A", ["B"])
+    assert s2.cancel_task("A") == "CANCELLED"
+    assert s2.get_status("B") == "FAILED"
+
+
+def test_resolve_waiting_repairs_lost_promotion_and_poison():
+    s = MemoryStore()
+    _make_waiting(s, "Y", ["X"])
+    _make_parent(s, "X", ["Y"])
+    s.finish_task("X", TaskStatus.COMPLETED, "r")  # promotion lost (crash)
+    assert s.get_status("Y") == WAITING
+    assert s.resolve_waiting("Y", {"X": s.get_status("X")}) == "promoted"
+    assert s.get_status("Y") == QUEUED
+    # a node with a LIVE parent is left strictly alone
+    s2 = MemoryStore()
+    _make_waiting(s2, "Y", ["X"])
+    _make_parent(s2, "X", ["Y"])
+    assert s2.resolve_waiting("Y", {"X": s2.get_status("X")}) is None
+    assert s2.get_status("Y") == WAITING
+    # vanished parent => poison, transitively
+    s3 = MemoryStore()
+    _make_waiting(s3, "Z", ["Y"])
+    _make_waiting(s3, "Y", ["X"], children=["Z"])
+    assert s3.resolve_waiting("Y", {"X": None}) == "poisoned"
+    assert s3.get_status("Y") == "FAILED"
+    assert s3.get_status("Z") == "FAILED"
+
+
+def test_sweeper_repairs_orphaned_waiting_nodes():
+    from tpu_faas.gateway.app import _sweep_expired_results
+
+    s = MemoryStore()
+    _make_waiting(s, "Y", ["X"])
+    _make_parent(s, "X", ["Y"])
+    s.finish_task("X", TaskStatus.COMPLETED, "r")  # promotion lost
+    repaired: list[int] = []
+    _sweep_expired_results(
+        s, ttl=3600.0, on_waiting_repaired=repaired.append
+    )
+    assert repaired == [1]
+    assert s.get_status("Y") == QUEUED
+    # second sweep: nothing left to repair
+    _sweep_expired_results(
+        s, ttl=3600.0, on_waiting_repaired=repaired.append
+    )
+    assert repaired == [1]
+
+
+# -- device frontier kernels -------------------------------------------------
+
+
+def test_dep_ready_mask_segment_reduce():
+    import jax.numpy as jnp
+
+    from tpu_faas.graph.frontier import dep_ready_mask, pad_edges
+
+    T = 8
+    child, undone = pad_edges([2, 2, 3], [0, 1, 0], T)
+    mask = np.asarray(
+        dep_ready_mask(jnp.asarray(child), jnp.asarray(undone), T=T)
+    )
+    assert not mask[2]  # one unconfirmed parent blocks
+    assert mask[3]  # all parents confirmed
+    assert mask[0] and mask[7]  # edge-free rows (flat tasks) stay ready
+
+
+def test_locality_exchange_swaps_only_equal_speed_workers():
+    import jax.numpy as jnp
+
+    from tpu_faas.graph.frontier import locality_exchange
+
+    assignment = jnp.asarray(np.array([1, 0, -1, 2], dtype=np.int32))
+    speed = jnp.asarray(np.array([1.0, 1.0, 2.0], dtype=np.float32))
+    # task 0 prefers w0 (equal speed with its w1): swap with holder task 1
+    pref = jnp.asarray(np.array([0, -1, -1, -1], dtype=np.int32))
+    out = list(np.asarray(locality_exchange(assignment, pref, speed)))
+    assert out == [0, 1, -1, 2]
+    # preferring a FASTER worker: no swap (would not be makespan-neutral)
+    pref2 = jnp.asarray(np.array([2, -1, -1, -1], dtype=np.int32))
+    out2 = list(np.asarray(locality_exchange(assignment, pref2, speed)))
+    assert out2 == [1, 0, -1, 2]
+    # unassigned preferring task: no swap
+    pref3 = jnp.asarray(np.array([-1, -1, 0, -1], dtype=np.int32))
+    out3 = list(np.asarray(locality_exchange(assignment, pref3, speed)))
+    assert out3 == [1, 0, -1, 2]
+
+
+# -- gateway /execute_graph --------------------------------------------------
+
+
+@pytest.fixture()
+def gw():
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    yield handle, store
+    handle.stop()
+
+
+def _register(url: str, fn) -> str:
+    r = requests.post(
+        f"{url}/register_function",
+        json={"name": fn.__name__, "payload": serialize(fn)},
+    )
+    assert r.status_code == 200
+    return r.json()["function_id"]
+
+
+def test_execute_graph_creates_children_before_roots(gw):
+    handle, store = gw
+    fid = _register(handle.url, arithmetic)
+    sub = store.subscribe("tasks")
+    payload = serialize(((10,), {}))
+    nodes = [
+        {"function_id": fid, "payload": payload},
+        {"function_id": fid, "payload": payload, "depends_on": [0]},
+        {"function_id": fid, "payload": payload, "depends_on": [0]},
+        {"function_id": fid, "payload": payload, "depends_on": [1, 2]},
+    ]
+    r = requests.post(f"{handle.url}/execute_graph", json={"nodes": nodes})
+    assert r.status_code == 200, r.text
+    body = r.json()
+    tids = body["task_ids"]
+    assert len(tids) == 4
+    assert body["graph"] == {"nodes": 4, "roots": 1, "edges": 4}
+    root, b, c, sink = tids
+    assert store.hgetall(root)["status"] == QUEUED
+    assert store.hgetall(root)[FIELD_CHILDREN] == f"{b},{c}"
+    for child in (b, c):
+        fields = store.hgetall(child)
+        assert fields["status"] == WAITING
+        assert fields[FIELD_DEPS] == root
+        assert fields[FIELD_PENDING_DEPS] == "1"
+        assert fields[FIELD_CHILDREN] == sink
+    fields = store.hgetall(sink)
+    assert fields["status"] == WAITING
+    assert fields[FIELD_DEPS] == f"{b},{c}"
+    assert fields[FIELD_PENDING_DEPS] == "2"
+    # every announce must follow its record write; children announce
+    # before roots (creation order proves a parent can never walk edges
+    # to missing records)
+    announced = []
+    while True:
+        m = sub.get_message(timeout=1.0)
+        if m is None:
+            break
+        announced.append(m)
+    assert set(announced) == set(tids)
+    assert announced.index(root) > max(
+        announced.index(t) for t in (b, c, sink)
+    )
+
+
+def test_execute_graph_validation_errors(gw):
+    handle, _store = gw
+    fid = "nonexistent"
+    payload = serialize(((1,), {}))
+    # cycle -> 400
+    r = requests.post(
+        f"{handle.url}/execute_graph",
+        json={
+            "nodes": [
+                {"function_id": fid, "payload": payload, "depends_on": [1]},
+                {"function_id": fid, "payload": payload, "depends_on": [0]},
+            ]
+        },
+    )
+    assert r.status_code == 400 and "cycle" in r.json()["error"]
+    # malformed body -> 400
+    assert (
+        requests.post(f"{handle.url}/execute_graph", json={}).status_code
+        == 400
+    )
+    # unknown function -> 404 (graph validated first)
+    r = requests.post(
+        f"{handle.url}/execute_graph",
+        json={"nodes": [{"function_id": fid, "payload": payload}]},
+    )
+    assert r.status_code == 404
+    # bad hint names the node
+    r = requests.post(
+        f"{handle.url}/execute_graph",
+        json={
+            "nodes": [
+                {"function_id": fid, "payload": payload, "priority": "x"}
+            ]
+        },
+    )
+    assert r.status_code == 400 and "nodes[0]" in r.json()["error"]
+
+
+# -- SDK builders ------------------------------------------------------------
+
+
+def test_graph_builder_validation():
+    client = FaaSClient("http://127.0.0.1:1")  # never contacted
+    g = client.graph()
+    other = client.graph()
+    n = other.call("fid", 1)
+    with pytest.raises(ValueError, match="from this builder"):
+        g.call("fid", 2, after=[n])
+    with pytest.raises(RuntimeError, match="not submitted"):
+        g.call("fid", 3).handle  # noqa: B018 - the property raises
+
+
+def test_graph_builder_end_to_end_local_dispatcher():
+    """client.graph() -> /execute_graph -> local dispatcher: a diamond
+    completes in dependency order, entirely through the store promotion
+    plane (the local dispatcher has no device frontier)."""
+    from tpu_faas.dispatch.local import LocalDispatcher
+
+    store_handle = start_store_thread()
+    gw_handle = start_gateway_thread(make_store(store_handle.url))
+    disp = LocalDispatcher(num_workers=2, store=make_store(store_handle.url))
+    thread = threading.Thread(target=disp.start, daemon=True)
+    thread.start()
+    client = FaaSClient(gw_handle.url)
+    try:
+        g = client.graph()
+        root = g.call(arithmetic, 100)
+        mids = [g.call(arithmetic, 200, after=[root]) for _ in range(3)]
+        sink = g.call(arithmetic, 300, after=mids)
+        handles = g.submit()
+        assert len(handles) == 5 and all(h.task_id for h in handles)
+        assert sink.result(timeout=90.0) == arithmetic(300)
+        for mid in mids:
+            assert mid.result(timeout=30.0) == arithmetic(200)
+        # dependency order: every parent's finish stamp precedes its
+        # children's
+        store = make_store(store_handle.url)
+        try:
+            t_root = float(store.hget(root.task_id, FIELD_FINISHED_AT))
+            t_mids = [
+                float(store.hget(m.task_id, FIELD_FINISHED_AT)) for m in mids
+            ]
+            t_sink = float(store.hget(sink.task_id, FIELD_FINISHED_AT))
+        finally:
+            store.close()
+        assert t_root <= min(t_mids) and max(t_mids) <= t_sink
+    finally:
+        disp.stop()
+        thread.join(timeout=10)
+        gw_handle.stop()
+        store_handle.stop()
+
+
+def test_graph_poison_raises_task_dependency_error_sync_and_async():
+    """A failing parent poisons its dependents: result() raises
+    TaskDependencyError carrying the parent id, in both SDKs, and the
+    poisoned nodes never ran."""
+    import asyncio
+
+    from tpu_faas.client.aio import AsyncFaaSClient
+    from tpu_faas.dispatch.local import LocalDispatcher
+
+    store_handle = start_store_thread()
+    gw_handle = start_gateway_thread(make_store(store_handle.url))
+    disp = LocalDispatcher(num_workers=2, store=make_store(store_handle.url))
+    thread = threading.Thread(target=disp.start, daemon=True)
+    thread.start()
+    client = FaaSClient(gw_handle.url)
+    try:
+        g = client.graph()
+        bad = g.call(failing_task, "kaput")
+        child = g.call(arithmetic, 100, after=[bad])
+        grandchild = g.call(arithmetic, 100, after=[child])
+        g.submit()
+        with pytest.raises(TaskDependencyError) as ei:
+            child.result(timeout=60.0)
+        assert ei.value.parent_id == bad.task_id
+        with pytest.raises(TaskDependencyError) as ei2:
+            grandchild.result(timeout=30.0)
+        assert ei2.value.parent_id == child.task_id
+
+        async def async_leg():
+            async with AsyncFaaSClient(gw_handle.url) as aclient:
+                ag = aclient.graph()
+                abad = ag.call(failing_task, "kaput")
+                achild = ag.call(arithmetic, 50, after=[abad])
+                await ag.submit()
+                with pytest.raises(TaskDependencyError) as aei:
+                    await achild.result(timeout=60.0)
+                assert aei.value.parent_id == abad.task_id
+
+        asyncio.run(async_leg())
+    finally:
+        disp.stop()
+        thread.join(timeout=10)
+        gw_handle.stop()
+        store_handle.stop()
+
+
+# -- tpu-push: device frontier + e2e ----------------------------------------
+
+
+def _make_tpu_dispatcher(store_url, **kw):
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+
+    defaults = dict(
+        ip="127.0.0.1",
+        port=0,
+        max_workers=64,
+        max_pending=256,
+        max_inflight=512,
+        tick_period=0.01,
+    )
+    defaults.update(kw)
+    if "store" not in defaults:
+        defaults["store"] = make_store(store_url)
+    return TpuPushDispatcher(**defaults)
+
+
+def test_frontier_dispatches_in_tick_when_promotion_announce_is_lost():
+    """The device-frontier acceptance slice, deterministic: a chain's
+    child is held WAITING in the frontier; the parent's result lands and
+    its dep round is confirmed; the promotion ANNOUNCE is stolen off the
+    bus (simulating the fire-and-forget loss) — the next tick must still
+    dispatch the child, readiness computed by the in-tick mask, and only
+    from a QUEUED record."""
+    from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+    from tpu_faas.worker import messages as m
+
+    monitor = RaceMonitor()
+    raw = MemoryStore()
+    disp = _make_tpu_dispatcher(
+        "memory://",
+        store=RaceCheckStore(raw, monitor, actor="dispatcher"),
+        recover_queued=False,
+    )
+    try:
+        assert disp.graph is not None
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 2})
+        feeder = RaceCheckStore(raw, monitor, actor="gateway")
+        feeder.create_tasks(
+            [
+                (
+                    "child",
+                    "f",
+                    "p",
+                    {FIELD_DEPS: "parent", FIELD_PENDING_DEPS: "1"},
+                )
+            ],
+            status=TaskStatus.WAITING,
+        )
+        feeder.create_tasks(
+            [("parent", "f", "p", {FIELD_CHILDREN: "child"})]
+        )
+        disp.tick()  # intake: parent -> pending+dispatch, child -> frontier
+        assert "child" in disp.graph.waiting
+        assert disp.arrays.inflight_owner("parent") is not None
+        # parent's result arrives from its worker
+        disp._handle(
+            b"w0",
+            m.RESULT,
+            {"task_id": "parent", "status": "COMPLETED", "result": "r"},
+        )
+        assert raw.get_status("child") == QUEUED  # promotion plane ran
+        # steal every buffered announce (incl. the promotion announce):
+        # the frontier must not depend on the fire-and-forget bus
+        while disp.subscriber.get_message() is not None:
+            pass
+        disp.tick()
+        assert disp.n_frontier_dispatches == 1
+        assert "child" not in disp.graph.waiting
+        assert disp.arrays.inflight_owner("child") is not None
+        disp._handle(
+            b"w0",
+            m.RESULT,
+            {"task_id": "child", "status": "COMPLETED", "result": "r"},
+        )
+        # the monitor proves the child was never touched while WAITING
+        # (WAITING -> RUNNING is an illegal transition it would flag)
+        monitor.assert_clean()
+        assert monitor.unfinished() == []
+    finally:
+        disp.close()
+
+
+def test_frontier_dispatched_mid_node_still_promotes_its_children():
+    """Regression: a mid-graph node (both child AND parent) dispatched
+    straight from the device frontier never re-enters intake — its
+    forward edges must have been registered at the WAITING drain, or its
+    children would strand until the sweeper. Chain A -> B -> C with B and
+    C frontier-held; every promotion announce is stolen, so the frontier
+    fast path is the ONLY route — C must still complete."""
+    from tpu_faas.worker import messages as m
+
+    disp = _make_tpu_dispatcher("memory://", recover_queued=False)
+    try:
+        store = disp.store
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 2})
+        store.create_tasks(
+            [("C", "f", "p", {FIELD_DEPS: "B", FIELD_PENDING_DEPS: "1"})],
+            status=TaskStatus.WAITING,
+        )
+        store.create_tasks(
+            [
+                (
+                    "B",
+                    "f",
+                    "p",
+                    {
+                        FIELD_DEPS: "A",
+                        FIELD_PENDING_DEPS: "1",
+                        FIELD_CHILDREN: "C",
+                    },
+                )
+            ],
+            status=TaskStatus.WAITING,
+        )
+        store.create_tasks([("A", "f", "p", {FIELD_CHILDREN: "B"})])
+        disp.tick()  # A dispatches; B, C held in the frontier
+        assert {"B", "C"} <= set(disp.graph.waiting)
+        assert "B" in disp.graph_parents  # registered at the WAITING drain
+        disp._handle(
+            b"w0",
+            m.RESULT,
+            {"task_id": "A", "status": "COMPLETED", "result": "r"},
+        )
+        while disp.subscriber.get_message() is not None:
+            pass  # steal B's promotion announce: frontier-only route
+        disp.tick()
+        assert disp.arrays.inflight_owner("B") is not None
+        disp._handle(
+            b"w0",
+            m.RESULT,
+            {"task_id": "B", "status": "COMPLETED", "result": "r"},
+        )
+        # B's result must walk the promotion plane even though B never
+        # passed QUEUED intake — C promotes and dispatches
+        assert store.get_status("C") == QUEUED
+        while disp.subscriber.get_message() is not None:
+            pass  # steal C's announce too
+        disp.tick()
+        assert disp.arrays.inflight_owner("C") is not None
+        assert disp.n_frontier_dispatches == 2
+    finally:
+        disp.close()
+
+
+def test_frontier_blocks_unready_children():
+    """A child whose parent is still in flight occupies a frontier row
+    but the in-tick mask keeps it out of placement entirely."""
+    from tpu_faas.worker import messages as m
+
+    disp = _make_tpu_dispatcher("memory://", recover_queued=False)
+    try:
+        store = disp.store
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 4})
+        store.create_tasks(
+            [
+                (
+                    "child",
+                    "f",
+                    "p",
+                    {FIELD_DEPS: "parent", FIELD_PENDING_DEPS: "1"},
+                )
+            ],
+            status=TaskStatus.WAITING,
+        )
+        store.create_tasks([("parent", "slow", "p", {})])
+        for _ in range(3):
+            disp.tick()
+        assert "child" in disp.graph.waiting
+        assert disp.arrays.inflight_owner("child") is None
+        assert store.get_status("child") == WAITING
+        assert disp.n_frontier_dispatches == 0
+    finally:
+        disp.close()
+
+
+def test_tpu_push_graph_diamond_e2e():
+    """Acceptance: a 1 -> N -> 1 diamond submitted via /execute_graph
+    completes end to end on the tpu-push path with children dispatched
+    only after parents finish — race-monitored, so any WAITING node
+    reaching a worker (WAITING -> RUNNING) or double write would fail."""
+    from tests.test_workers_e2e import _spawn_worker
+    from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    gw_handle = start_gateway_thread(
+        RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="gateway"
+        )
+    )
+    disp = _make_tpu_dispatcher(
+        store_handle.url,
+        store=RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="dispatcher"
+        ),
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    client = FaaSClient(gw_handle.url)
+    try:
+        g = client.graph()
+        root = g.call(arithmetic, 500)
+        mids = [g.call(arithmetic, 700, after=[root]) for _ in range(4)]
+        sink = g.call(arithmetic, 900, after=mids)
+        g.submit()
+        assert sink.result(timeout=120.0) == arithmetic(900)
+        assert root.result(timeout=10.0) == arithmetic(500)
+        for mid in mids:
+            assert mid.result(timeout=30.0) == arithmetic(700)
+        store = make_store(store_handle.url)
+        try:
+            t_root = float(store.hget(root.task_id, FIELD_FINISHED_AT))
+            t_mids = [
+                float(store.hget(m_.task_id, FIELD_FINISHED_AT))
+                for m_ in mids
+            ]
+            t_sink = float(store.hget(sink.task_id, FIELD_FINISHED_AT))
+        finally:
+            store.close()
+        assert t_root <= min(t_mids) and max(t_mids) <= t_sink
+        monitor.assert_clean()
+        assert monitor.unfinished() == []
+    finally:
+        for w in workers:
+            w.kill()
+            w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw_handle.stop()
+        store_handle.stop()
+
+
+def test_graph_chaos_worker_kill_mid_diamond():
+    """Chaos leg: SIGKILL a worker while the diamond's middle layer runs.
+    With retries available the reclaimed middle tasks re-dispatch and the
+    sink still completes; the run stays race-clean (re-dispatch declared,
+    no WAITING node ever dispatched), and after the result-TTL sweeper
+    runs no WAITING record remains in the store."""
+    from tests.test_workers_e2e import _spawn_worker
+    from tpu_faas.gateway.app import _sweep_expired_results
+    from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    gw_handle = start_gateway_thread(
+        RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="gateway"
+        )
+    )
+    disp = _make_tpu_dispatcher(
+        store_handle.url,
+        time_to_expire=1.5,
+        store=RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="dispatcher"
+        ),
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker("push_worker", 2, url, "--hb", "--hb-period", "0.3")
+        for _ in range(2)
+    ]
+    client = FaaSClient(gw_handle.url)
+    try:
+        g = client.graph()
+        root = g.call(sleep_task, 0.2)
+        mids = [g.call(sleep_task, 1.2, after=[root]) for _ in range(4)]
+        sink = g.call(sleep_task, 0.1, after=mids)
+        g.submit()
+        # wait for the middle layer to be in flight, then kill a worker
+        assert root.result(timeout=60.0) == 0.2
+        time.sleep(0.6)
+        workers[0].send_signal(signal.SIGKILL)
+        workers[0].wait()
+        assert sink.result(timeout=120.0) == 0.1
+        monitor.assert_clean()
+        assert monitor.unfinished() == []
+        # the sweeper must leave no orphaned WAITING node behind
+        store = make_store(store_handle.url)
+        try:
+            _sweep_expired_results(store, ttl=3600.0)
+            statuses = store.hget_many(store.keys(), "status")
+            assert WAITING not in statuses
+        finally:
+            store.close()
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw_handle.stop()
+        store_handle.stop()
+
+
+def test_graph_poison_chaos_failed_parent_never_dispatches_frontier():
+    """Chaos leg 2: the middle layer FAILS (poison path, no retries
+    involved) — the sink is transitively poisoned without dispatching,
+    the monitor stays clean, and no WAITING record survives the sweep."""
+    from tests.test_workers_e2e import _spawn_worker
+    from tpu_faas.gateway.app import _sweep_expired_results
+    from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+
+    monitor = RaceMonitor()
+    store_handle = start_store_thread()
+    gw_handle = start_gateway_thread(
+        RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="gateway"
+        )
+    )
+    disp = _make_tpu_dispatcher(
+        store_handle.url,
+        store=RaceCheckStore(
+            make_store(store_handle.url), monitor, actor="dispatcher"
+        ),
+    )
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    worker = _spawn_worker(
+        "push_worker", 2, url, "--hb", "--hb-period", "0.3"
+    )
+    client = FaaSClient(gw_handle.url)
+    try:
+        g = client.graph()
+        root = g.call(arithmetic, 100)
+        bad = g.call(failing_task, "mid-diamond", after=[root])
+        ok = g.call(arithmetic, 100, after=[root])
+        sink = g.call(arithmetic, 100, after=[bad, ok])
+        g.submit()
+        with pytest.raises(TaskDependencyError) as ei:
+            sink.result(timeout=90.0)
+        assert ei.value.parent_id == bad.task_id
+        assert ok.result(timeout=30.0) == arithmetic(100)
+        monitor.assert_clean()
+        assert monitor.unfinished() == []
+        store = make_store(store_handle.url)
+        try:
+            _sweep_expired_results(store, ttl=3600.0)
+            statuses = store.hget_many(store.keys(), "status")
+            assert WAITING not in statuses
+        finally:
+            store.close()
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw_handle.stop()
+        store_handle.stop()

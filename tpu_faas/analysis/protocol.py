@@ -21,6 +21,12 @@ reach through the API used:
 - a RUNNING ``set_status`` without ``extra_fields`` carries no ownership
   lease (``running-without-lease``, warning) — such a record is
   unadoptable-forever if worker and dispatcher die (see FIELD_LEASE_AT);
+- ``set_status``/``set_status_many`` may never write WAITING outside the
+  store package (``waiting-set-status``) — WAITING nodes are created with
+  their dependency fields by ``create_task(s)(status=WAITING)`` and moved
+  out only by the store's promotion plane (complete_dep_many /
+  resolve_waiting); a bare WAITING write strands a task no dispatcher may
+  ever send (WAITING -> RUNNING is illegal in ``racecheck._LEGAL``);
 - any literal status outside the :class:`TaskStatus` enum is flagged
   wherever it appears (``unknown-status``);
 - raw ``.hset()`` whose field-dict literal touches status/result, and raw
@@ -131,9 +137,11 @@ class ProtocolChecker(Checker):
             if method == "finish_task":
                 yield from self._check_finish(module, node)
             elif method == "set_status":
-                yield from self._check_set_status(module, node)
+                yield from self._check_set_status(module, node, store_internal)
             elif method == "set_status_many":
-                yield from self._check_set_status_many(module, node)
+                yield from self._check_set_status_many(
+                    module, node, store_internal
+                )
             elif method == "finish_task_many":
                 yield from self._check_finish_many(module, node)
             elif method in ("hset", "hset_many") and not store_internal:
@@ -186,7 +194,7 @@ class ProtocolChecker(Checker):
             )
 
     def _check_set_status(
-        self, module: Module, call: ast.Call
+        self, module: Module, call: ast.Call, store_internal: bool = False
     ) -> Iterator[Finding]:
         arg = self._arg(call, 1, "status")
         status = _status_literal(arg) if arg is not None else None
@@ -205,6 +213,19 @@ class ProtocolChecker(Checker):
                 f"go through finish_task/cancel_task (FINISHED_AT stamp, "
                 f"live-index removal, RESULTS_CHANNEL announce)",
             )
+        elif status == "WAITING" and not store_internal:
+            yield self.finding(
+                module,
+                call,
+                "waiting-set-status",
+                "error",
+                "set_status writes WAITING outside the store package: "
+                "WAITING nodes are created by create_task(s)(status=WAITING) "
+                "with their dependency fields, and only the store's "
+                "promotion plane (complete_dep_many/resolve_waiting) moves "
+                "them out — a bare WAITING write strands a task no "
+                "dispatcher may ever send",
+            )
         elif status == "RUNNING" and self._arg(call, 2, "extra_fields") is None:
             yield self.finding(
                 module,
@@ -217,7 +238,7 @@ class ProtocolChecker(Checker):
             )
 
     def _check_set_status_many(
-        self, module: Module, call: ast.Call
+        self, module: Module, call: ast.Call, store_internal: bool = False
     ) -> Iterator[Finding]:
         """The batched status write carries ONE shared status as its first
         argument precisely so this check works like plain set_status's:
@@ -231,6 +252,17 @@ class ProtocolChecker(Checker):
             return
         if status not in STATUS_NAMES:
             yield from self._check_status_value(module, call, status)
+            return
+        if status == "WAITING" and not store_internal:
+            yield self.finding(
+                module,
+                call,
+                "waiting-set-status",
+                "error",
+                "set_status_many writes WAITING outside the store package: "
+                "only create_task(s)(status=WAITING) and the store's "
+                "promotion plane may touch the WAITING state",
+            )
             return
         if status in TERMINAL:
             yield self.finding(

@@ -1349,6 +1349,171 @@ def config_12_latency() -> dict:
         handle.stop()
 
 
+def config_13_graph_pipeline() -> dict:
+    """Task-graph lane (config 13): a fan-out/fan-in diamond workload
+    (1 root -> width middles -> 1 sink, repeated ``rounds`` times as
+    independent graphs) through the full real stack — store server over
+    TCP, gateway with POST /execute_graph, tpu-push dispatcher with the
+    device frontier, real push-worker subprocesses. Two legs:
+
+    - **graph leg**: each diamond submitted as a DAG; the middles exist as
+      WAITING records until the root completes (promotion plane + in-tick
+      frontier mask), the sink until the middles do. Reported: graph
+      makespan (submit -> sink terminal, the dependency-aware number) and
+      the frontier-size trajectory sampled from the dispatcher while the
+      leg runs.
+    - **flat leg**: the SAME node multiset submitted dependency-free via
+      /execute_batch — the baseline that shows what the dependency
+      bookkeeping costs on wall time when no ordering is required (it
+      also runs the sink/middles concurrently, so flat completing faster
+      is expected; the row is a sanity floor, not a race).
+
+    Invariants the smoke lane asserts: every graph node reaches COMPLETED,
+    zero WAITING records survive the run, and the frontier trajectory was
+    actually sampled (peak >= width+1). Shape via TPU_FAAS_BENCH_GRAPH_SHAPE=
+    "width,rounds,workers,procs" (default "8,6,4,2"); the CI graph-smoke
+    lane runs "4,3,2,2"."""
+    import os
+    import threading as _threading
+
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.core.task import TaskStatus
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.bench.harness import _spawn_worker
+    from tpu_faas.workloads import no_op
+
+    shape = os.environ.get("TPU_FAAS_BENCH_GRAPH_SHAPE", "8,6,4,2")
+    width, rounds, n_workers, n_procs = (int(x) for x in shape.split(","))
+    nodes_per_graph = width + 2
+
+    handle = start_store_thread()
+    gw = start_gateway_thread(make_store(handle.url))
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(handle.url),
+        max_workers=max(64, n_workers),
+        max_pending=max(256, 4 * nodes_per_graph * rounds),
+        max_inflight=4096,
+        max_slots=n_procs,
+        tick_period=0.005,
+    )
+    disp_thread = _threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker(
+            "push_worker", n_procs, url, "--hb", "--hb-period", "0.5"
+        )
+        for _ in range(n_workers)
+    ]
+    client = FaaSClient(gw.url)
+    try:
+        time.sleep(1.5)  # workers register
+        fid = client.register_payload("no_op", serialize(no_op))
+        # warmup outside the measured window (pool spawn + dill decode)
+        for h in client.submit_many(fid, [((), {})] * (2 * n_procs)):
+            h.result(timeout=120.0)
+
+        # -- graph leg: sample the frontier gauge while diamonds run ------
+        frontier_traj: list[int] = []
+        sampling = _threading.Event()
+
+        def sample_frontier() -> None:
+            while not sampling.is_set():
+                g = disp.graph
+                frontier_traj.append(0 if g is None else len(g))
+                sampling.wait(0.05)
+
+        sampler = _threading.Thread(target=sample_frontier, daemon=True)
+        sampler.start()
+        graph_makespans: list[float] = []
+        all_ids: list[str] = []
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            g = client.graph()
+            root = g.call(fid)
+            mids = [g.call(fid, after=[root]) for _ in range(width)]
+            sink = g.call(fid, after=mids)
+            g.submit()
+            all_ids.extend(h.task_id for h in [root, *mids, sink])
+            t_g = time.perf_counter()
+            sink.result(timeout=300.0)
+            graph_makespans.append(time.perf_counter() - t_g)
+        graph_s = time.perf_counter() - t0
+        sampling.set()
+        sampler.join(timeout=5)
+
+        # -- flat leg: same node multiset, no dependencies ----------------
+        t1 = time.perf_counter()
+        flat_makespans: list[float] = []
+        for _ in range(rounds):
+            t_f = time.perf_counter()
+            handles = client.submit_many(fid, [((), {})] * nodes_per_graph)
+            for h in handles:
+                h.result(timeout=300.0)
+            flat_makespans.append(time.perf_counter() - t_f)
+        flat_s = time.perf_counter() - t1
+
+        # -- invariants ---------------------------------------------------
+        store = make_store(handle.url)
+        try:
+            statuses = store.hget_many(all_ids, "status")
+            completed = sum(
+                1 for s in statuses if s == str(TaskStatus.COMPLETED)
+            )
+            waiting_left = sum(
+                1 for s in statuses if s == str(TaskStatus.WAITING)
+            )
+        finally:
+            store.close()
+        stats = disp.stats()
+        return {
+            "config": "graph-pipeline",
+            "shape": {
+                "width": width,
+                "rounds": rounds,
+                "workers": n_workers,
+                "procs": n_procs,
+                "nodes": nodes_per_graph * rounds,
+            },
+            "graph_completed": completed,
+            "waiting_left": waiting_left,
+            "graph_leg_s": round(graph_s, 3),
+            "flat_leg_s": round(flat_s, 3),
+            "graph_makespan_p50_s": round(
+                float(np.percentile(graph_makespans, 50)), 4
+            ),
+            "graph_makespan_max_s": round(max(graph_makespans), 4),
+            "flat_makespan_p50_s": round(
+                float(np.percentile(flat_makespans, 50)), 4
+            ),
+            # the dependency-bookkeeping trajectory: frontier occupancy
+            # sampled at 20 Hz across the graph leg (peak ~= width+1 per
+            # in-flight diamond; must return to 0)
+            "frontier_size_trajectory": frontier_traj[:256],
+            "frontier_size_peak": max(frontier_traj, default=0),
+            "frontier_dispatches": stats["graph"]["frontier_dispatches"],
+            # EXPECTED dependent-node count (computed from the shape, not
+            # measured) — the measured promotion counter lives on the
+            # dispatcher scrape (tpu_faas_graph_nodes_total{outcome})
+            "dependent_nodes_expected": rounds * (width + 1),
+            "dispatched": disp.n_dispatched,
+        }
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        disp_thread.join(timeout=10)
+        gw.stop()
+        handle.stop()
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -1362,4 +1527,5 @@ CONFIGS = {
     "10": config_10_overload,
     "11": config_11_payload_plane,
     "12": config_12_latency,
+    "13": config_13_graph_pipeline,
 }

@@ -3,7 +3,10 @@ tpu_faas.client.aio, imported lazily so sync users don't pay for aiohttp)."""
 
 from tpu_faas.client.sdk import (
     FaaSClient,
+    GraphBuilder,
+    GraphNode,
     TaskCancelledError,
+    TaskDependencyError,
     TaskExpiredError,
     TaskFailedError,
     TaskHandle,
@@ -11,11 +14,13 @@ from tpu_faas.client.sdk import (
 
 # async names stay OUT of __all__: `import *` must not eagerly pull aiohttp
 __all__ = [
-    "FaaSClient", "TaskHandle", "TaskCancelledError", "TaskExpiredError",
+    "FaaSClient", "TaskHandle", "GraphBuilder", "GraphNode",
+    "TaskCancelledError", "TaskDependencyError", "TaskExpiredError",
     "TaskFailedError",
 ]
 
-_LAZY_ASYNC = ("AsyncFaaSClient", "AsyncTaskHandle")
+_LAZY_ASYNC = ("AsyncFaaSClient", "AsyncTaskHandle", "AsyncGraphBuilder",
+               "AsyncGraphNode")
 
 
 def __getattr__(name: str):

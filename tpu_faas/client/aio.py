@@ -22,11 +22,12 @@ import aiohttp
 
 from tpu_faas.client.sdk import (
     TaskCancelledError,
+    TaskDependencyError,
     TaskExpiredError,
     TaskFailedError,
     _FnMemo,  # shared serialize()/register dedup: sync and async agree
     _retry_after_s,  # shared Retry-After parsing: sync and async must agree
-    _unwrap_terminal,
+    _unwrap_terminal,  # shared terminal protocol (incl. dep_failed parsing)
 )
 from tpu_faas.core.executor import pack_params
 from tpu_faas.obs.tracectx import new_trace_id
@@ -372,11 +373,141 @@ class AsyncFaaSClient:
         handle = await self.submit(await self.register(fn), *args, **kwargs)
         return await handle.result(timeout)
 
+    def graph(self) -> "AsyncGraphBuilder":
+        """Start a task-graph submission (async twin of
+        FaaSClient.graph()): ``g.call(...)`` stays synchronous and cheap
+        (callables register lazily at submit); ``await g.submit()`` posts
+        the whole DAG in one call."""
+        return AsyncGraphBuilder(self)
+
+    async def execute_graph(self, nodes: list[dict]) -> dict:
+        """Raw graph submit (wire format of POST /execute_graph)."""
+        async with self.request(
+            "POST",
+            f"{self.base_url}/execute_graph",
+            retry_overload=True,
+            json={"nodes": nodes},
+        ) as r:
+            r.raise_for_status()
+            return await r.json()
+
+
+@dataclass
+class AsyncGraphNode:
+    """One node of an async graph submission — a dependency reference
+    before submit(), an :class:`AsyncTaskHandle` delegate after. A
+    dep-poisoned node's ``await result()`` raises
+    :class:`TaskDependencyError` naming the failed parent."""
+
+    builder: "AsyncGraphBuilder"
+    index: int
+    task_id: str | None = None
+    trace_id: str | None = None
+
+    @property
+    def handle(self) -> AsyncTaskHandle:
+        if self.task_id is None:
+            raise RuntimeError(
+                "graph not submitted yet: await GraphBuilder.submit() first"
+            )
+        return AsyncTaskHandle(self.builder.client, self.task_id, self.trace_id)
+
+    async def status(self) -> str:
+        return await self.handle.status()
+
+    async def result(
+        self, timeout: float = 60.0, poll_interval: float = 0.01
+    ) -> Any:
+        return await self.handle.result(timeout, poll_interval)
+
+    async def cancel(self, force: bool = False) -> bool:
+        return await self.handle.cancel(force=force)
+
+
+class AsyncGraphBuilder:
+    """The sync GraphBuilder's async twin. ``call`` is synchronous (graph
+    assembly is pure bookkeeping — awaiting per node would serialize a
+    wide fan-out for nothing); callables are registered at submit() time
+    through the shared dedup memo, one HTTP round per distinct function."""
+
+    def __init__(self, client: AsyncFaaSClient) -> None:
+        self.client = client
+        #: (fn-or-id, args, kwargs, deps, hints) per node until submit
+        self._calls: list[tuple] = []
+        self._handles: list[AsyncGraphNode] = []
+        self._submitted = False
+
+    def call(
+        self,
+        fn: "Callable | str",
+        *args: Any,
+        after: "list[AsyncGraphNode] | tuple[AsyncGraphNode, ...]" = (),
+        priority: int | None = None,
+        cost: float | None = None,
+        timeout: float | None = None,
+        deadline: float | None = None,
+        **kwargs: Any,
+    ) -> AsyncGraphNode:
+        if self._submitted:
+            raise RuntimeError("graph already submitted")
+        deps: list[int] = []
+        for dep in after:
+            if not isinstance(dep, AsyncGraphNode) or dep.builder is not self:
+                raise ValueError(
+                    "'after' entries must be AsyncGraphNodes from this builder"
+                )
+            if dep.index not in deps:
+                deps.append(dep.index)
+        hints = {
+            "priority": priority,
+            "cost": cost,
+            "timeout": timeout,
+            "deadline": deadline,
+        }
+        handle = AsyncGraphNode(self, len(self._calls))
+        self._calls.append((fn, args, kwargs, deps, hints))
+        self._handles.append(handle)
+        return handle
+
+    async def submit(self) -> list[AsyncGraphNode]:
+        if self._submitted:
+            raise RuntimeError("graph already submitted")
+        loop = asyncio.get_running_loop()
+        nodes: list[dict] = []
+        for fn, args, kwargs, deps, hints in self._calls:
+            function_id = (
+                fn if isinstance(fn, str) else await self.client.register(fn)
+            )
+            payload = await loop.run_in_executor(
+                None, lambda a=args, k=kwargs: pack_params(*a, **k)
+            )
+            node: dict = {
+                "function_id": function_id,
+                "payload": payload,
+                "depends_on": deps,
+            }
+            for key, value in hints.items():
+                if value is not None:
+                    node[key] = value
+            nodes.append(node)
+        out = await self.client.execute_graph(nodes)
+        self._submitted = True
+        trace_ids = out.get("trace_ids") or [None] * len(out["task_ids"])
+        for handle, task_id, trace in zip(
+            self._handles, out["task_ids"], trace_ids
+        ):
+            handle.task_id = task_id
+            handle.trace_id = trace
+        return list(self._handles)
+
 
 __all__ = [
     "AsyncFaaSClient",
+    "AsyncGraphBuilder",
+    "AsyncGraphNode",
     "AsyncTaskHandle",
     "TaskCancelledError",
+    "TaskDependencyError",
     "TaskExpiredError",
     "TaskFailedError",
 ]

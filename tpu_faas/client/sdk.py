@@ -21,7 +21,7 @@ from requests.adapters import HTTPAdapter, Retry
 from tpu_faas.core.executor import pack_params
 from tpu_faas.core.payload import payload_digest
 from tpu_faas.core.serialize import deserialize, serialize
-from tpu_faas.core.task import TaskStatus
+from tpu_faas.core.task import DEP_FAILED_PREFIX, TaskStatus
 from tpu_faas.obs.tracectx import new_trace_id
 
 
@@ -91,6 +91,39 @@ class TaskFailedError(Exception):
         super().__init__(f"task {task_id} FAILED: {cause!r}")
         self.task_id = task_id
         self.cause = cause
+
+
+class TaskDependencyError(TaskFailedError):
+    """Raised by result() on a dep-poisoned graph node: a parent reached a
+    FAILED/EXPIRED/CANCELLED terminal, so this node was failed by the
+    store's promotion plane WITHOUT ever being dispatched — no side
+    effects exist for it (unlike its failed ancestor, which may have run
+    partially). ``parent_id`` names the direct parent whose failure
+    poisoned it (for transitive poisoning, the parent is itself poisoned
+    and its own result carries the next hop up).
+
+    Retry semantics: resubmitting the poisoned subgraph is safe — none of
+    its nodes executed. Address the ROOT CAUSE first: fetch the parent's
+    result (``client.raw_result(parent_id)``) for the original failure,
+    fix/resubmit that node, then resubmit the dependents (graph
+    submissions are not idempotency-keyed; a resubmit creates fresh
+    nodes). Subclasses TaskFailedError, so code that catches the generic
+    failure keeps working."""
+
+    def __init__(self, task_id: str, parent_id: str, cause: object) -> None:
+        super().__init__(task_id, cause)
+        self.parent_id = parent_id
+
+
+def _maybe_dependency_error(task_id: str, value: object):
+    """The poison protocol is message-shaped (``dep_failed:<parent>: ...``
+    on a RuntimeError), not dill-class-shaped, so any client can detect it
+    without import coupling. Returns the specific error or None."""
+    message = str(value)
+    if isinstance(value, Exception) and message.startswith(DEP_FAILED_PREFIX):
+        parent = message[len(DEP_FAILED_PREFIX):].split(":", 1)[0].strip()
+        return TaskDependencyError(task_id, parent, value)
+    return None
 
 
 class TaskExpiredError(Exception):
@@ -208,6 +241,9 @@ def _unwrap_terminal(task_id: str, status: str, payload: str):
         raise TaskExpiredError(task_id)
     value = deserialize(payload)
     if status == str(TaskStatus.FAILED):
+        dep_error = _maybe_dependency_error(task_id, value)
+        if dep_error is not None:
+            raise dep_error
         raise TaskFailedError(task_id, value)
     return True, value
 
@@ -507,6 +543,22 @@ class FaaSClient:
             for tid, trace in zip(out["task_ids"], trace_ids)
         ]
 
+    def graph(self) -> "GraphBuilder":
+        """Start a task-graph submission: ``g = client.graph()``, then
+        ``h = g.call(fn, x)``, ``g.call(fn2, y, after=[h])``, ...,
+        ``g.submit()``. Nodes run only after everything they depend on
+        COMPLETED; a failed/cancelled/expired dependency fails its
+        dependents without running them (result() raises
+        :class:`TaskDependencyError`)."""
+        return GraphBuilder(self)
+
+    def execute_graph(self, nodes: list[dict]) -> dict:
+        """Raw graph submit (wire format of POST /execute_graph); the
+        ergonomic layer is :meth:`graph`."""
+        r = self._post_submit(f"{self.base_url}/execute_graph", {"nodes": nodes})
+        r.raise_for_status()
+        return r.json()
+
     def run(
         self, fn: Callable, *args: Any, timeout: float = 60.0, **kwargs: Any
     ) -> Any:
@@ -557,3 +609,117 @@ class FaaSClient:
                     )
                 time.sleep(poll_interval)
         return [results[i] for i in range(len(handles))]
+
+
+# -- task-graph builder ------------------------------------------------------
+
+
+@dataclass
+class GraphNode:
+    """One node of a graph submission: a dependency reference before
+    submit() (pass it in another call's ``after=[...]``), a task handle
+    after (``task_id`` assigned; result()/status()/cancel() delegate to a
+    :class:`TaskHandle`). A poisoned node's result() raises
+    :class:`TaskDependencyError` naming the failed parent."""
+
+    builder: "GraphBuilder"
+    index: int
+    task_id: str | None = None
+    trace_id: str | None = None
+
+    @property
+    def handle(self) -> TaskHandle:
+        if self.task_id is None:
+            raise RuntimeError(
+                "graph not submitted yet: call GraphBuilder.submit() first"
+            )
+        return TaskHandle(self.builder.client, self.task_id, self.trace_id)
+
+    def status(self) -> str:
+        return self.handle.status()
+
+    def result(self, timeout: float = 60.0, poll_interval: float = 0.01):
+        return self.handle.result(timeout, poll_interval)
+
+    def cancel(self, force: bool = False) -> bool:
+        return self.handle.cancel(force=force)
+
+    def forget(self) -> None:
+        self.handle.forget()
+
+
+class GraphBuilder:
+    """Accumulate a DAG locally, submit it in ONE call::
+
+        g = client.graph()
+        parts = [g.call(extract, shard) for shard in shards]   # fan-out
+        merged = g.call(merge, after=parts)                    # fan-in
+        g.submit()
+        total = merged.result(timeout=120.0)
+
+    ``call`` accepts a callable (registered through the client's dedup
+    memo — N calls of one function cost one registration) or a
+    function_id string, plus the usual scheduling hints. ``after`` lists
+    the GraphNodes this node depends on; the gateway validates
+    acyclicity, charges admission for the whole graph up front, and the
+    store's promotion plane runs the frontier from there. submit() may be
+    called once; it returns the nodes in call order."""
+
+    def __init__(self, client: FaaSClient) -> None:
+        self.client = client
+        self._nodes: list[dict] = []
+        self._handles: list[GraphNode] = []
+        self._submitted = False
+
+    def call(
+        self,
+        fn: "Callable | str",
+        *args: Any,
+        after: "list[GraphNode] | tuple[GraphNode, ...]" = (),
+        priority: int | None = None,
+        cost: float | None = None,
+        timeout: float | None = None,
+        deadline: float | None = None,
+        **kwargs: Any,
+    ) -> GraphNode:
+        if self._submitted:
+            raise RuntimeError("graph already submitted")
+        function_id = fn if isinstance(fn, str) else self.client.register(fn)
+        deps: list[int] = []
+        for dep in after:
+            if not isinstance(dep, GraphNode) or dep.builder is not self:
+                raise ValueError(
+                    "'after' entries must be GraphNodes from this builder"
+                )
+            if dep.index not in deps:
+                deps.append(dep.index)
+        node: dict = {
+            "function_id": function_id,
+            "payload": pack_params(*args, **kwargs),
+            "depends_on": deps,
+        }
+        if priority is not None:
+            node["priority"] = priority
+        if cost is not None:
+            node["cost"] = cost
+        if timeout is not None:
+            node["timeout"] = timeout
+        if deadline is not None:
+            node["deadline"] = deadline
+        handle = GraphNode(self, len(self._nodes))
+        self._nodes.append(node)
+        self._handles.append(handle)
+        return handle
+
+    def submit(self) -> list[GraphNode]:
+        if self._submitted:
+            raise RuntimeError("graph already submitted")
+        out = self.client.execute_graph(self._nodes)
+        self._submitted = True
+        trace_ids = out.get("trace_ids") or [None] * len(out["task_ids"])
+        for handle, task_id, trace in zip(
+            self._handles, out["task_ids"], trace_ids
+        ):
+            handle.task_id = task_id
+            handle.trace_id = trace
+        return list(self._handles)

@@ -21,6 +21,17 @@ from dataclasses import dataclass, field
 
 class TaskStatus(str, enum.Enum):
     QUEUED = "QUEUED"
+    #: non-terminal "not yet dispatchable": a graph node whose parents have
+    #: not all COMPLETED. Created by the gateway's POST /execute_graph for
+    #: every node with a non-empty depends_on; the store's promotion plane
+    #: (store/base.py complete_dep_many) flips it to QUEUED when the last
+    #: parent completes (then it flows through intake/admission/shedding
+    #: like any submit) or to FAILED when any parent reaches a
+    #: FAILED/EXPIRED/CANCELLED terminal (the transitive frontier is
+    #: poisoned, never dispatched). WAITING -> RUNNING is an ILLEGAL
+    #: transition by protocol: no dispatcher may ever send a WAITING task
+    #: to a worker.
+    WAITING = "WAITING"
     RUNNING = "RUNNING"
     COMPLETED = "COMPLETED"
     FAILED = "FAILED"
@@ -149,6 +160,43 @@ FIELD_RECLAIMS = "reclaim_count"
 #: dispatchers wins the setnx and dispatches. Adoptions of an owner that
 #: died re-arbitrate on generation-scoped fields (``claim_field_for``).
 FIELD_DISPATCH_CLAIM = "dispatch_claim"
+
+
+#: Task-graph dependency edges (tpu_faas/graph): comma-joined parent task
+#: ids on a WAITING node, written once at graph create and never mutated.
+#: The sweeper's orphan repair re-derives a stranded node's fate from
+#: these; the tpu-push frontier builds its device edge list from them.
+FIELD_DEPS = "deps"
+#: Countdown of not-yet-COMPLETED parents (int as str) on a WAITING node.
+#: Decremented ATOMICALLY (store hincrby) by the promotion plane, exactly
+#: once per parent (each decrement is gated by a write-once per-edge claim
+#: field "dep_done:<parent>", so a zombie's duplicate terminal write can't
+#: double-count). Hitting zero triggers WAITING -> QUEUED.
+FIELD_PENDING_DEPS = "pending_deps"
+#: Comma-joined child task ids on any graph node that other nodes depend
+#: on — the forward edges the promotion plane walks on the parent's
+#: terminal write. Absent on non-graph tasks, so the flat hot path never
+#: pays a dependency probe.
+FIELD_CHILDREN = "dep_children"
+#: Write-once resolution claim on a WAITING node ("promote" or
+#: "poison:<parent_id>"): exactly one resolver — the promotion plane, the
+#: poison walk, or the gateway sweeper's orphan repair — ever moves the
+#: node out of WAITING, so promote/poison cannot race each other into an
+#: illegal status interleaving. A claim whose writer died before the
+#: status write is re-applied idempotently by the sweeper.
+FIELD_DEP_RESOLVED = "dep_resolved"
+
+#: Per-edge decrement claim field for parent ``parent_id`` on a child's
+#: hash — see FIELD_PENDING_DEPS.
+def dep_done_field(parent_id: str) -> str:
+    return f"dep_done:{parent_id}"
+
+
+#: Result-message prefix of a dep-poisoned node's FAILED payload: the
+#: serialized exception reads "dep_failed:<parent_id>: <detail>", so SDKs
+#: can raise TaskDependencyError with the failed parent attached without
+#: any dill class-identity coupling.
+DEP_FAILED_PREFIX = "dep_failed:"
 
 
 def claim_field_for(generation: int) -> str:

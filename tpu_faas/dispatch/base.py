@@ -32,8 +32,10 @@ from tpu_faas.admission.signal import CapacitySnapshot, publish_snapshot
 from tpu_faas.core.payload import PayloadLRU
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import (
+    FIELD_CHILDREN,
     FIELD_COST,
     FIELD_DEADLINE,
+    FIELD_DEPS,
     FIELD_FN,
     FIELD_FN_DIGEST,
     FIELD_LEASE_AT,
@@ -85,6 +87,9 @@ RECLAIM_FIELDS = [
     FIELD_COST,
     FIELD_TIMEOUT,
     FIELD_TRACE_ID,
+    # graph parents must keep promoting their children after a reclaim:
+    # the dep-completion gate (graph_parents) is rebuilt from this field
+    FIELD_CHILDREN,
 ]
 
 
@@ -529,6 +534,25 @@ class TaskDispatcher:
         #: system — so the count must be operator-visible in /stats, not
         #: buried in a worker-side log line
         self.worker_misfires: dict[object, int] = {}
+        # -- task graphs (tpu_faas/graph) ----------------------------------
+        #: task ids whose record carried FIELD_CHILDREN at intake/reclaim —
+        #: the dep-completion gate: flat tasks never pay a dependency probe
+        #: on the result path (config 9's throughput bar depends on this)
+        self.graph_parents: set[str] = set()
+        #: (parent_id, status) dep completions whose store round hit an
+        #: outage; replayed by flush_deferred_results (the promotion walk
+        #: is idempotent: per-edge claims + the resolution claim)
+        self.deferred_dep_completions: deque[tuple[str, str]] = deque()
+        self.m_graph_nodes = self.metrics.counter(
+            "tpu_faas_graph_nodes_total",
+            "Graph-node dependency resolutions this dispatcher's terminal "
+            "writes triggered, by outcome: promoted (WAITING->QUEUED, "
+            "announced) or poisoned (WAITING->FAILED, dep_failed, never "
+            "dispatched)",
+            ("outcome",),
+        )
+        for outcome in ("promoted", "poisoned"):
+            self.m_graph_nodes.labels(outcome=outcome)
 
     #: blob-cache budget (bytes of cached payload bodies); class attr so
     #: tests and specialized deployments can tighten it
@@ -751,6 +775,74 @@ class TaskDispatcher:
         )
         return True
 
+    # -- task graphs (tpu_faas/graph) --------------------------------------
+    def note_waiting(self, task: PendingTask, fields: dict) -> None:
+        """A WAITING graph node's announce drained. Default: skip it — the
+        store's promotion plane re-announces the node QUEUED when its last
+        parent completes, and intake picks that announce up like any
+        submit. The tpu-push dispatcher overrides this to hold the node in
+        its device frontier, so the child can be placed in the same tick
+        its promotion is confirmed instead of waiting out a bus hop."""
+        # the drain opened a timeline for this announce; the node's real
+        # lifecycle starts at promotion — discard instead of closing, so
+        # the promoted intake doesn't read as a duplicate replay
+        self.traces.discard(task.task_id)
+        self.log.debug(
+            "waiting graph node %s; riding the promotion announce",
+            task.task_id,
+        )
+
+    def note_graph_parent(self, task_id: str, fields) -> None:
+        """Record that this task's store record carries dependency children
+        (FIELD_CHILDREN) — the result path then (and only then) walks the
+        promotion plane for it. Flat tasks never enter the set, so flat
+        workloads pay ZERO dependency bookkeeping on the result path."""
+        if FIELD_CHILDREN in fields:
+            self.graph_parents.add(task_id)
+
+    def complete_deps_safe(self, items) -> None:
+        """Run the store promotion plane for the graph parents among these
+        landed terminal writes; ``items`` is (task_id, status) pairs. A
+        store outage defers the completions for flush_deferred_results —
+        the walk is idempotent (per-edge claims + the resolution claim),
+        so replaying a partially-applied round converges. Never raises."""
+        cand: list[tuple[str, str]] = []
+        for task_id, status in items:
+            if task_id in self.graph_parents:
+                self.graph_parents.discard(task_id)
+                cand.append((task_id, str(status)))
+        if not cand:
+            return
+        try:
+            promoted, poisoned = self.store.complete_dep_many(
+                cand, self.channel
+            )
+        except STORE_OUTAGE_ERRORS as exc:
+            self.deferred_dep_completions.extend(cand)
+            self.note_store_outage(exc, pause=0)
+            return
+        if promoted:
+            self.m_graph_nodes.labels(outcome="promoted").inc(len(promoted))
+        if poisoned:
+            self.m_graph_nodes.labels(outcome="poisoned").inc(len(poisoned))
+            # a poisoned child may itself be a registered parent (the
+            # store walk already failed ITS frontier): drop the stale
+            # entry so the gate set stays bounded by live graph work
+            for child in poisoned:
+                self.graph_parents.discard(child)
+        self.note_deps_resolved(cand, promoted, poisoned)
+
+    def note_deps_resolved(
+        self,
+        parents: list[tuple[str, str]],
+        promoted: list[str],
+        poisoned: list[str],
+    ) -> None:
+        """Hook: a complete_dep_many round SUCCEEDED for ``parents``. The
+        tpu-push dispatcher feeds its device frontier here — confirmation
+        is what makes the frontier's ready mask imply "record already
+        QUEUED" (a frontier dispatch must never touch a WAITING record)."""
+
     # -- deadline shedding -------------------------------------------------
     def shed_if_expired(self, task: PendingTask) -> bool:
         """True when ``task`` must be dropped instead of dispatched because
@@ -893,6 +985,21 @@ class TaskDispatcher:
             if not _has_payloads(fields):
                 self.log.warning("announce for unknown task %s; skipping", msg)
                 continue
+            if (
+                fields.get(FIELD_STATUS) == str(TaskStatus.WAITING)
+                and FIELD_DEPS in fields
+            ):
+                # a graph node announced behind its dependencies: never
+                # dispatchable as-is — frontier-capable dispatchers hold
+                # it (tpu-push), everyone else waits for the promotion
+                # plane's QUEUED re-announce. Register its own forward
+                # edges NOW: a frontier-dispatched mid-graph node may
+                # never re-enter intake (its promotion announce skips as
+                # stale once it is RUNNING), and its children's promotion
+                # hangs off this registration
+                self.note_graph_parent(msg, fields)
+                self.note_waiting(PendingTask.from_fields(msg, fields), fields)
+                continue
             if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
                 # duplicate or stale announce: the task was already picked up
                 # (RUNNING — e.g. adopted by a stranded-task rescan), even
@@ -926,7 +1033,12 @@ class TaskDispatcher:
                     "dropped stale kill note for resubmitted task %s", msg
                 )
             task = PendingTask.from_fields(msg, fields)
+            self.note_graph_parent(msg, fields)
             self._note_intake(task)
+            if FIELD_DEPS in fields:
+                # a promoted graph child: close its dep_wait span (the
+                # WAITING stretch between create and promotion)
+                self.traces.note(msg, "promoted")
             return task
 
     def _close_skipped_timeline(
@@ -1004,6 +1116,10 @@ class TaskDispatcher:
     #: stamps are worker-measured (RESULT started_at/elapsed) but workers
     #: have no store access, so the dispatcher persists them.
     _SPAN_STAGES = (
+        # graph children only: the WAITING stretch between the gateway's
+        # create and the promotion plane flipping the node QUEUED (both
+        # endpoints absent on flat tasks, so the span simply never emits)
+        ("dispatcher", "dep_wait", "submitted", "promoted"),
         ("dispatcher", "intake", "announced", "intake"),
         ("dispatcher", "queue", "intake", "scheduled"),
         ("dispatcher", "dispatch", "scheduled", "sent"),
@@ -1095,6 +1211,15 @@ class TaskDispatcher:
             if not _has_payloads(fields):
                 self.log.warning("announce for unknown task %s; skipping", msg)
                 continue
+            if (
+                fields.get(FIELD_STATUS) == str(TaskStatus.WAITING)
+                and FIELD_DEPS in fields
+            ):
+                # graph node behind its dependencies (see poll_next_task);
+                # forward edges registered here for the same reason
+                self.note_graph_parent(msg, fields)
+                self.note_waiting(PendingTask.from_fields(msg, fields), fields)
+                continue
             if fields.get(FIELD_STATUS) != str(TaskStatus.QUEUED):
                 # duplicate or stale announce (see poll_next_task): never
                 # dispatch, and never consume a cancel note here
@@ -1110,7 +1235,11 @@ class TaskDispatcher:
                     "dropped stale kill note for resubmitted task %s", msg
                 )
             task = PendingTask.from_fields(msg, fields)
+            self.note_graph_parent(msg, fields)
             self._note_intake(task)
+            if FIELD_DEPS in fields:
+                # promoted graph child (see poll_next_task)
+                self.traces.note(msg, "promoted")
             out.append(task)
         return out
 
@@ -1282,6 +1411,7 @@ class TaskDispatcher:
         task is possible (zombie worker of a re-dispatched task)."""
         self.store.finish_task(task_id, status, result, first_wins=first_wins)
         self._note_finished(task_id, status)
+        self.complete_deps_safe([(task_id, status)])
 
     def _note_finished(self, task_id: str, status: str) -> None:
         """Terminal write landed: close the task's timeline and count the
@@ -1339,6 +1469,9 @@ class TaskDispatcher:
             self.note_store_up()
             for task_id, status, _result, _fw in items:
                 self._note_finished(task_id, status)
+            self.complete_deps_safe(
+                [(tid, status) for tid, status, _r, _fw in items]
+            )
             return len(items)
         except STORE_OUTAGE_ERRORS as exc:
             # a mid-pipeline loss is ambiguous (a prefix may have applied);
@@ -1408,10 +1541,21 @@ class TaskDispatcher:
             for task_id, status, _result, _fw in chunk:
                 self.deferred_results.popleft()
                 self._note_finished(task_id, status)
+            self.complete_deps_safe(
+                [(tid, status) for tid, status, _r, _fw in chunk]
+            )
             n += len(chunk)
         if n:
             self.note_store_up()
             self.log.info("replayed %d result writes deferred during outage", n)
+        # dep completions whose own store round died mid-outage: replay
+        # them too (idempotent walk — see complete_deps_safe); re-parked
+        # by complete_deps_safe itself if the store is still dark
+        if self.deferred_dep_completions and not self.deferred_results:
+            replay = list(self.deferred_dep_completions)
+            self.deferred_dep_completions.clear()
+            self.graph_parents.update(tid for tid, _ in replay)
+            self.complete_deps_safe(replay)
         return n
 
     # -- store failover re-arm (store HA, store/replication.py) -------------
@@ -1501,6 +1645,12 @@ class TaskDispatcher:
                 "bytes": self.blob_cache.n_bytes,
                 "hits": self.blob_cache.hits,
                 "misses": self.blob_cache.misses,
+            },
+            "graph": {
+                "parents_tracked": len(self.graph_parents),
+                "deferred_dep_completions": len(
+                    self.deferred_dep_completions
+                ),
             },
         }
 
@@ -1672,6 +1822,9 @@ class TaskDispatcher:
         fields = {f: v for f, v in zip(RECLAIM_FIELDS, vals) if v is not None}
         if not _has_payloads(fields):
             return None
+        # a reclaimed graph parent must keep promoting its children when
+        # its (re-run) result lands
+        self.note_graph_parent(task_id, fields)
         return PendingTask.from_fields(task_id, fields, retries=retries)
 
     def task_is_finished(self, task_id: str) -> bool:

@@ -66,7 +66,7 @@ class LocalDispatcher(TaskDispatcher):
         try:
             while not self.stopping:
                 progressed = False
-                if self.deferred_results:
+                if self.deferred_results or self.deferred_dep_completions:
                     self.flush_deferred_results()
                 try:
                     # store failover: replay the announce ring so tasks

@@ -215,7 +215,7 @@ class PullDispatcher(TaskDispatcher):
         last_renew = self.clock()
         try:
             while not self.stopping:
-                if self.deferred_results:
+                if self.deferred_results or self.deferred_dep_completions:
                     self.flush_deferred_results()
                 # control messages must flow even while no worker is
                 # asking for tasks (saturated fleet mid-long-tasks)

@@ -489,7 +489,7 @@ class PushDispatcher(TaskDispatcher):
                 try:
                     if self.heartbeat:
                         self.purge_workers()
-                    if self.deferred_results:
+                    if self.deferred_results or self.deferred_dep_completions:
                         self.flush_deferred_results()
                     # store failover: replay the announce ring so tasks
                     # announced on the dead primary re-enter intake (the

@@ -31,6 +31,7 @@ import numpy as np
 import zmq
 
 from tpu_faas.core.task import (
+    FIELD_DEPS,
     FIELD_LEASE_AT,
     FIELD_PARAMS,
     FIELD_RECLAIMS,
@@ -38,6 +39,7 @@ from tpu_faas.core.task import (
     TaskStatus,
     claim_field_for,
 )
+from tpu_faas.graph.frontier import GraphFrontier
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
     PendingQueue,
@@ -203,6 +205,27 @@ class TpuPushDispatcher(TaskDispatcher):
         #: host-side staging queue; id-indexed so intake dedup and the
         #: rescan's known-set are O(1) probes, not per-tick O(pending) walks
         self.pending: PendingQueue = PendingQueue()
+        #: task-graph device frontier (tpu_faas/graph/frontier.py): WAITING
+        #: nodes held beside the pending batch, readiness computed by a
+        #: segment-reduce INSIDE the device tick. Batch path only — the
+        #: resident/multihost/mesh ticks and shared fleets ride the
+        #: store-side promotion announces instead (a shared sibling could
+        #: otherwise double-dispatch a child it never claimed).
+        self.graph = (
+            None
+            if (resident or multihost or shared or mesh_devices)
+            else GraphFrontier(cap=max_pending)
+        )
+        #: worker row that returned each graph parent's result (locality
+        #: preference for its waiting children); populated only while the
+        #: frontier holds children of that parent, popped on confirmation
+        self._result_rows: dict[str, int] = {}
+        self.n_frontier_dispatches = 0
+        self.m_frontier = self.metrics.gauge(
+            "tpu_faas_graph_frontier_waiting",
+            "WAITING graph nodes held in the device frontier (tpu-push "
+            "batch path); 0 on flat workloads and frontier-less modes",
+        )
         #: RESULT store writes accumulated during a worker-message drain,
         #: flushed as ONE pipelined finish_task_many round per drain
         #: (drain_results_batched); None = unbatched mode, where _handle
@@ -337,6 +360,8 @@ class TpuPushDispatcher(TaskDispatcher):
         known = self.pending.task_ids()
         known.update(t.task_id for t in self._unclaimed)
         known.update(self._resident_tasks)
+        if self.graph is not None:
+            known.update(self.graph.waiting)
         # tasks whose (terminal) writes sit in the deferred buffer still read
         # as QUEUED/RUNNING from the store — adopting them would re-execute
         known.update(item[0] for item in self.deferred_results)
@@ -464,8 +489,24 @@ class TpuPushDispatcher(TaskDispatcher):
                     # adopting now would dispatch an empty payload — the
                     # creator (or the next rescan) will finish it
                     continue
+                self.note_graph_parent(key, fields)
                 self.pending.append(PendingTask.from_fields(key, fields))
                 n += 1
+            elif (
+                status == str(TaskStatus.WAITING) and self.graph is not None
+            ):
+                # stranded WAITING node (its announce was lost while no
+                # dispatcher listened): hold it in the frontier — its
+                # promotion/poison still flows through the store plane,
+                # and the reconciliation below keeps the held copy honest
+                fields = self.store.hgetall(key)
+                if (
+                    fields.get(FIELD_STATUS) != str(TaskStatus.WAITING)
+                    or FIELD_PARAMS not in fields
+                ):
+                    continue
+                self.note_graph_parent(key, fields)
+                self.note_waiting(PendingTask.from_fields(key, fields), fields)
             elif key in expired:
                 # among sibling dispatchers, exactly one wins this reclaim
                 # generation (single-dispatcher mode always wins)
@@ -487,6 +528,27 @@ class TpuPushDispatcher(TaskDispatcher):
                 self.task_retries[key] = pt.retries
                 self.pending.append(pt)
                 n_adopted += 1
+        # frontier reconciliation: held WAITING copies must track the
+        # store's truth — a node promoted by another writer (gateway
+        # sweeper repair, a parent cancel's poison walk) whose announce
+        # was lost would otherwise sit held forever. One pipelined status
+        # round over the held set.
+        if self.graph is not None and self.graph.waiting:
+            held = list(self.graph.waiting)
+            for tid, status in zip(
+                held, self.store.hget_many(held, FIELD_STATUS)
+            ):
+                if status == str(TaskStatus.WAITING):
+                    continue
+                t = self.graph.pop(tid)
+                if (
+                    status == str(TaskStatus.QUEUED)
+                    and t is not None
+                    and tid not in self.pending
+                ):
+                    # promoted elsewhere, announce lost: adopt as pending
+                    self.pending.append(t)
+                # terminal or vanished: the held copy just goes
         # reads succeeded: the store is reachable (an idle dispatcher has no
         # result writes to clear the outage flag otherwise)
         self.note_store_up()
@@ -583,6 +645,53 @@ class TpuPushDispatcher(TaskDispatcher):
         if abs(new_speed - cur) > 0.05 * max(cur, 1e-6):
             self.arrays.worker_speed[row] = new_speed
 
+    # -- task-graph frontier (tpu_faas/graph/frontier.py) ------------------
+    def note_waiting(self, task, fields) -> None:
+        """Hold a WAITING graph node in the device frontier (batch path):
+        its readiness is then computed by the in-tick segment-reduce, and
+        it can dispatch the very tick its last parent's completion is
+        confirmed. Frontier-less modes keep the base skip — the promotion
+        announce re-delivers the node QUEUED."""
+        if self.graph is None:
+            super().note_waiting(task, fields)
+            return
+        tid = task.task_id
+        self.traces.discard(tid)  # real lifecycle starts at promotion
+        if (
+            tid in self.pending
+            or tid in self._resident_tasks
+            or self.arrays.inflight_owner(tid) is not None
+        ):
+            return
+        deps = [p for p in (fields.get(FIELD_DEPS) or "").split(",") if p]
+        if not deps or not self.graph.add(task, deps):
+            return
+        self.log.debug(
+            "frontier holds waiting graph node %s (%d parents)",
+            tid,
+            len(deps),
+        )
+
+    def note_deps_resolved(self, parents, promoted, poisoned) -> None:
+        """A complete_dep_many round SUCCEEDED: confirm the parents into
+        the frontier (what flips the device mask's edges — and implies the
+        promoted children's records are already QUEUED), and forget
+        poisoned nodes (their records already read FAILED; they must
+        never dispatch)."""
+        if self.graph is None:
+            return
+        for pid, status in parents:
+            row = self._result_rows.pop(pid, -1)
+            self.graph.note_parent(
+                pid, status == str(TaskStatus.COMPLETED), row
+            )
+        for child in poisoned:
+            if self.graph.pop(child) is not None:
+                self.log.info(
+                    "dropped dep-poisoned node %s from the frontier", child,
+                    extra=log_ctx(task_id=child),
+                )
+
     # -- worker messages ---------------------------------------------------
     def _send_worker(self, wid: bytes, msg_type: str, **kw) -> None:
         """Send one message framed per the peer's negotiated capabilities
@@ -673,6 +782,14 @@ class TpuPushDispatcher(TaskDispatcher):
                 if row is not None:
                     a.release_slot(row)
                     self._observe_result(wid, row, task_id, data)
+                    if (
+                        self.graph is not None
+                        and self.graph.has_waiting_children(task_id)
+                    ):
+                        # locality: this worker's payload cache now holds
+                        # the parent's function — its row is the waiting
+                        # children's preferred placement
+                        self._result_rows[task_id] = row
             else:
                 self._task_digest.pop(task_id, None)
         elif msg_type == m.BLOB_MISS:
@@ -774,6 +891,7 @@ class TpuPushDispatcher(TaskDispatcher):
         self.m_queue_depth.set(len(self.pending) + len(self._resident_tasks))
         self.m_inflight.set(a.n_inflight)
         self.m_workers.set(len(a.worker_ids))
+        self.m_frontier.set(0 if self.graph is None else len(self.graph))
 
     def stats(self) -> dict:
         a = self.arrays
@@ -785,8 +903,14 @@ class TpuPushDispatcher(TaskDispatcher):
         else:
             backlog_s = self._backlog_estimate_s()
             self._backlog_cache = (backlog_s, now)
+        base = super().stats()
+        base["graph"] = {
+            **base["graph"],
+            "frontier_waiting": 0 if self.graph is None else len(self.graph),
+            "frontier_dispatches": self.n_frontier_dispatches,
+        }
         return {
-            **super().stats(),
+            **base,
             "backlog_est_s": (
                 None if backlog_s is None else round(backlog_s, 3)
             ),
@@ -850,10 +974,17 @@ class TpuPushDispatcher(TaskDispatcher):
         batch_ids: set[str] = set()
 
         def fresh(task_id: str) -> bool:
+            # the inflight probe closes a narrow double-dispatch window: a
+            # task sent whose RUNNING mark was dropped on an outage
+            # (mark_running_many degrades) still reads QUEUED store-side
+            # while a buffered duplicate announce (rescan adoption, or a
+            # frontier dispatch racing its promotion announce) re-delivers
+            # it — the O(1) owner probe keeps the second copy out
             return (
                 task_id not in batch_ids
                 and task_id not in self.pending
                 and task_id not in self._resident_tasks
+                and self.arrays.inflight_owner(task_id) is None
             )
 
         # tasks whose claim round hit an outage last time go first —
@@ -861,6 +992,8 @@ class TpuPushDispatcher(TaskDispatcher):
         while self._unclaimed and len(batch) < room:
             t = self._unclaimed.popleft()
             if fresh(t.task_id):
+                if self.graph is not None:
+                    self.graph.pop(t.task_id)
                 batch_ids.add(t.task_id)
                 batch.append(t)
         try:
@@ -874,6 +1007,12 @@ class TpuPushDispatcher(TaskDispatcher):
         for t in polled:
             if not fresh(t.task_id):
                 continue
+            if self.graph is not None:
+                # a promoted child whose WAITING copy the frontier still
+                # holds (its parent finished through another writer, or
+                # the promotion announce beat our confirmation): the
+                # QUEUED announce's fresh record wins, the held copy goes
+                self.graph.pop(t.task_id)
             batch_ids.add(t.task_id)
             batch.append(t)
         self._batch_sizes["intake"] = len(batch)
@@ -925,10 +1064,34 @@ class TpuPushDispatcher(TaskDispatcher):
             if dropped:
                 continue
             batch.append(t)
+        # graph frontier: WAITING nodes ride the SAME device batch; the
+        # in-tick segment-reduce masks the not-yet-ready ones, so they
+        # occupy rows but never admit. They are NOT popped from the
+        # frontier here — only a successful dispatch removes them.
+        frontier_rows: dict[int, str] = {}
+        if self.graph is not None and len(self.graph):
+            batch_ids = {t.task_id for t in batch}
+            for tid in list(self.graph.waiting):
+                bad = self.graph.failed_parent_of(tid)
+                if bad is not None:
+                    # poisoned store-side by the promotion plane (its
+                    # record already reads FAILED); forget the held copy
+                    self._forget_task_state(tid)
+            for tid, t in self.graph.waiting.items():
+                if len(batch) >= a.max_pending:
+                    break
+                if tid in batch_ids:
+                    continue
+                frontier_rows[len(batch)] = tid
+                batch.append(t)
         overflow = self.pending
         self.pending = PendingQueue()
         requeued: deque[PendingTask] = deque()
         still_pending: deque[PendingTask] = deque()
+        #: frontier batch rows already POPPED from the frontier this tick
+        #: (their records are QUEUED): on an abort they restore to pending
+        #: like any task — un-popped frontier rows stay held instead
+        popped_frontier: set[int] = set()
         #: RUNNING transitions of this tick's common path (no retries),
         #: flushed as ONE pipelined round after the send loop — same
         #: after-send ordering per task, same degrade-on-outage contract
@@ -961,9 +1124,19 @@ class TpuPushDispatcher(TaskDispatcher):
                         a.placement,
                     )
                     self._warned_priority = True
+            # graph frontier: padded edge list + locality preference for
+            # this tick's batch (None on flat workloads — the jitted tick
+            # keeps its dependency-free signature)
+            dep_edges = task_pref = None
+            if frontier_rows:
+                child, undone, task_pref = self.graph.edge_arrays(
+                    frontier_rows, a.max_pending
+                )
+                dep_edges = (child, undone)
             # recompile detection BEFORE the call: the signature carries
             # everything that changes the jitted trace (padded dims,
-            # placement, optional priority lane)
+            # placement, optional priority lane, the frontier's padded
+            # edge width + locality lane)
             self.profiler.observe_shape(
                 tasks=a.max_pending,
                 workers=a.max_workers,
@@ -971,10 +1144,17 @@ class TpuPushDispatcher(TaskDispatcher):
                 signature=(
                     "batch", a.max_pending, a.max_workers, a.max_slots,
                     a.placement, prios is not None,
+                    0 if dep_edges is None else len(dep_edges[0]),
+                    task_pref is not None,
                 ),
             )
             with self.tracer.span("device_tick"), self.profiler.tick_capture():
-                out = a.tick(sizes, task_priorities=prios)
+                out = a.tick(
+                    sizes,
+                    task_priorities=prios,
+                    dep_edges=dep_edges,
+                    task_pref=task_pref,
+                )
 
             # reclaim in-flight tasks of dead workers (ahead of the queue)
             # and deactivate the purged rows; an outage raise propagates
@@ -1002,9 +1182,29 @@ class TpuPushDispatcher(TaskDispatcher):
                     restore_from = idx
                     row = int(row)
                     if row < 0 or row not in a.row_ids:
-                        still_pending.append(task)
+                        if idx not in frontier_rows:
+                            still_pending.append(task)
+                        # frontier rows stay HELD in the frontier: either
+                        # not ready (the device mask excluded them) or no
+                        # capacity — next tick recomputes
                         restore_from = idx + 1
                         continue
+                    if idx in frontier_rows:
+                        # the device mask admitted this node: every parent
+                        # is confirmed complete, so its record is already
+                        # QUEUED (promotion preceded confirmation) — it
+                        # leaves the frontier and dispatches like any task
+                        self.graph.pop(task.task_id)
+                        popped_frontier.add(idx)
+                        if task.submitted_at is not None:
+                            self.traces.note(
+                                task.task_id, "submitted",
+                                ts=task.submitted_at,
+                            )
+                        self.traces.note(task.task_id, "promoted")
+                        self.traces.note_trace(task.task_id, task.trace_id)
+                        self.n_frontier_dispatches += 1
+                        self.graph.n_frontier_dispatches += 1
                     if task.retries and task.task_id in finished:
                         # reclaimed task finished meanwhile by its zombie
                         # worker: re-dispatching would regress the record
@@ -1067,8 +1267,12 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.n_dispatched += 1
                     self.m_dispatched.inc()
         except STORE_OUTAGE_ERRORS:
-            for t in batch[restore_from:]:
-                still_pending.append(t)
+            for i in range(restore_from, len(batch)):
+                if i not in frontier_rows or i in popped_frontier:
+                    # ordinary tasks, plus frontier tasks already popped
+                    # (their records are QUEUED — pending is their home
+                    # now); un-popped frontier rows stay held instead
+                    still_pending.append(batch[i])
             raise  # start() logs + backs off
         finally:
             # queue reassembly FIRST: the RUNNING flush below can itself
@@ -1230,6 +1434,9 @@ class TpuPushDispatcher(TaskDispatcher):
         the sites (as _task_digest once was)."""
         self.task_retries.pop(task_id, None)
         self._task_digest.pop(task_id, None)
+        self._result_rows.pop(task_id, None)
+        if self.graph is not None:
+            self.graph.pop(task_id)
         # close any still-open timeline (no-op for the drop/fail sites that
         # already finished it with a more specific outcome): a task leaving
         # without a result must not sit in the active trace table forever
@@ -1464,7 +1671,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 # heartbeating, results buffer), never crash it — everything
                 # below retries next iteration once the store is back
                 try:
-                    if self.deferred_results:
+                    if self.deferred_results or self.deferred_dep_completions:
                         self.flush_deferred_results()
                     # store failover (client settled on a promoted
                     # replica): replay the announce ring into the backlog

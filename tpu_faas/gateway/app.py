@@ -32,6 +32,12 @@ breaker fast-fails EVERY store-touching endpoint with 503 +
 on a connect timeout.
 - ``GET /status/{task_id}``    -> {"task_id", "status"}
 - ``GET /result/{task_id}``    -> {"task_id", "status", "result"}
+- ``POST /execute_graph``      {"nodes": [{"function_id", "payload",
+    "depends_on": [refs], ...hints}]} -> {"task_ids", "graph"} — DAG
+    submission (tpu_faas/graph): acyclicity + size cap proven before any
+    write, admission charged for the whole graph, dependent nodes created
+    WAITING and promoted/poisoned by the store's dependency plane (see
+    execute_graph below).
 
 Beyond the reference surface: ``POST /cancel/{task_id}`` (queued-only
 best-effort cancel: QUEUED -> CANCELLED terminal, RUNNING refused with 409 —
@@ -82,11 +88,14 @@ from tpu_faas.admission.breaker import OUTAGE_ERRORS
 from tpu_faas.admission.controller import AdmissionConfig
 from tpu_faas.core.payload import payload_digest
 from tpu_faas.core.task import (
+    FIELD_CHILDREN,
     FIELD_COST,
     FIELD_DEADLINE,
+    FIELD_DEPS,
     FIELD_FINISHED_AT,
     FIELD_FN_DIGEST,
     FIELD_PARAMS,
+    FIELD_PENDING_DEPS,
     FIELD_PRIORITY,
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
@@ -97,6 +106,7 @@ from tpu_faas.core.task import (
     new_function_id,
     new_task_id,
 )
+from tpu_faas.graph import GraphValidationError, validate_graph
 from tpu_faas.obs import REGISTRY, MetricsRegistry, SLOTracker, SpanSink
 from tpu_faas.obs import metrics as obs_metrics
 from tpu_faas.obs.slo import DEFAULT_GATEWAY_OBJECTIVES, objectives_from_env
@@ -343,6 +353,25 @@ class GatewayContext:
             "tpu_faas_gateway_cancel_calls_total",
             "Cancel calls that reported cancelled=true (idempotent "
             "repeats counted — see /stats cancel_calls)",
+        )
+        self.m_graphs = self.metrics.counter(
+            "tpu_faas_gateway_graphs_total",
+            "Graph submissions accepted (POST /execute_graph)",
+        )
+        self.m_graph_nodes = self.metrics.counter(
+            "tpu_faas_gateway_graph_nodes_total",
+            "Graph nodes created, by kind: root (QUEUED, announced "
+            "dispatchable) or waiting (WAITING behind depends_on, promoted "
+            "by the store's dependency plane)",
+            ("kind",),
+        )
+        for kind in ("root", "waiting"):
+            self.m_graph_nodes.labels(kind=kind)
+        self.m_waiting_repaired = self.metrics.counter(
+            "tpu_faas_gateway_waiting_repaired_total",
+            "Orphaned WAITING nodes the result-TTL sweeper resolved "
+            "(promotion/poison re-derived from the parents' terminal "
+            "statuses after a resolver crash)",
         )
         self.m_store_up = self.metrics.gauge(
             "tpu_faas_gateway_store_up",
@@ -708,8 +737,47 @@ def _sweep_stale_blobs(
     ]
 
 
+def _repair_orphaned_waiting(
+    store: TaskStore,
+    keys: list[str],
+    statuses: list[str | None],
+    channel: str,
+) -> int:
+    """Resolve WAITING graph nodes whose promotion was lost: a resolver
+    crash between the dependency decrement and the status flip (or a
+    dispatcher dying with deferred dep completions) leaves a node WAITING
+    forever while its parents are all terminal. Re-derive each such
+    node's fate from the parents' statuses via the store's write-once
+    resolution claim (TaskStore.resolve_waiting) — nodes with any LIVE
+    parent are left strictly alone. Returns nodes resolved."""
+    waiting = [
+        k
+        for k, s in zip(keys, statuses)
+        if s == str(TaskStatus.WAITING)
+    ]
+    if not waiting:
+        return 0
+    repaired = 0
+    for key, raw_deps in zip(waiting, store.hget_many(waiting, FIELD_DEPS)):
+        parents = [p for p in (raw_deps or "").split(",") if p]
+        if not parents:
+            continue  # WAITING without deps: not ours to judge
+        parent_statuses = dict(
+            zip(parents, store.hget_many(parents, FIELD_STATUS))
+        )
+        fate = store.resolve_waiting(key, parent_statuses, channel)
+        if fate is not None:
+            log.warning("repaired orphaned WAITING node %s: %s", key, fate)
+            repaired += 1
+    return repaired
+
+
 def _sweep_expired_results(
-    store: TaskStore, ttl: float, now: float | None = None
+    store: TaskStore,
+    ttl: float,
+    now: float | None = None,
+    channel: str = TASKS_CHANNEL,
+    on_waiting_repaired=None,
 ) -> int:
     """Delete terminal task records older than ``ttl`` seconds (their
     FIELD_FINISHED_AT stamp). Returns records deleted. Pipelined status +
@@ -717,7 +785,10 @@ def _sweep_expired_results(
     live (QUEUED/RUNNING) tasks, unstamped records, and the function
     registry are never touched. Blob-namespace keys get their own
     refcount-or-TTL policy (_sweep_stale_blobs) instead of the terminal
-    probe."""
+    probe. WAITING graph nodes are never deleted, but orphaned ones —
+    all parents terminal, promotion lost to a crash — are resolved in
+    passing (_repair_orphaned_waiting; count reported via
+    ``on_waiting_repaired``)."""
     now_f = now if now is not None else time.time()
     all_keys = store.keys()
     keys = [
@@ -737,6 +808,9 @@ def _sweep_expired_results(
         store.delete_many(blob_expired)
         return len(blob_expired)
     statuses = store.hget_many(keys, FIELD_STATUS)
+    repaired = _repair_orphaned_waiting(store, keys, statuses, channel)
+    if repaired and on_waiting_repaired is not None:
+        on_waiting_repaired(repaired)
     terminal = []
     statusless = []
     for key, status in zip(keys, statuses):
@@ -840,6 +914,7 @@ def make_app(
     app.router.add_post("/register_function", register_function)
     app.router.add_post("/execute_function", execute_function)
     app.router.add_post("/execute_batch", execute_batch)
+    app.router.add_post("/execute_graph", execute_graph)
     app.router.add_get("/status/{task_id}", get_status)
     app.router.add_get("/result/{task_id}", get_result)
     app.router.add_post("/cancel/{task_id}", cancel_task)
@@ -869,7 +944,15 @@ def make_app(
                 while not ctx.stopping.is_set():
                     try:
                         n = await _run_blocking(
-                            _sweep_expired_results, ctx.store, result_ttl
+                            functools.partial(
+                                _sweep_expired_results,
+                                ctx.store,
+                                result_ttl,
+                                channel=ctx.channel,
+                                on_waiting_repaired=(
+                                    ctx.m_waiting_repaired.inc
+                                ),
+                            )
                         )
                         if n:
                             log.info("result-ttl sweep: %d records expired", n)
@@ -1626,6 +1709,145 @@ async def execute_batch(request: web.Request) -> web.Response:
             trace_ids[i] if created_flags.get(i) else None
             for i in range(len(payloads))
         ]
+    return web.json_response(resp)
+
+
+async def execute_graph(request: web.Request) -> web.Response:
+    """Submit a task DAG in one call: ``{"nodes": [{"function_id",
+    "payload", "depends_on": [refs], "id"?, hints...}, ...]}`` where each
+    ``depends_on`` entry is an integer node index or another node's
+    client-local ``id``. The gateway proves acyclicity + the size cap and
+    charges admission for the WHOLE graph up front; creation is two
+    pipelined store rounds — every dependent node first (status WAITING,
+    carrying FIELD_DEPS + FIELD_PENDING_DEPS + its children edges), then
+    the roots (QUEUED, announced dispatchable), so a parent can never
+    finish against missing child records. From there the store's
+    promotion plane owns the lifecycle: the last COMPLETED parent flips a
+    child WAITING -> QUEUED onto the ordinary bus; a FAILED/EXPIRED/
+    CANCELLED parent poisons its transitive frontier (dep_failed, never
+    dispatched). Reply: ``{"task_ids": [per node], "graph": {...}}``."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+    try:
+        body = await request.json()
+        nodes = body["nodes"]
+    except Exception:
+        return _json_error(400, "expected JSON body with a 'nodes' list")
+    try:
+        deps, topo = validate_graph(nodes)
+    except GraphValidationError as exc:
+        return _json_error(400, str(exc))
+    now = time.time()
+    submit_stamp = repr(now)
+    extras: list[dict[str, str]] = []
+    fids: list[str] = []
+    for i, node in enumerate(nodes):
+        fid, payload = node.get("function_id"), node.get("payload")
+        if not isinstance(fid, str) or not isinstance(payload, str):
+            return _json_error(
+                400,
+                f"nodes[{i}] needs 'function_id' and 'payload' strings",
+            )
+        try:
+            extra = _parse_hints(
+                node.get("priority"),
+                node.get("cost"),
+                node.get("timeout"),
+                node.get("deadline"),
+                now=now,
+            )
+        except ValueError as exc:
+            return _json_error(400, f"nodes[{i}]: {exc}")
+        extra[FIELD_SUBMITTED_AT] = submit_stamp
+        extras.append(extra)
+        fids.append(fid)
+    # admission AFTER validation, BEFORE store work; the graph decides
+    # ATOMICALLY (children are useless without their parents admitted) on
+    # its lowest priority and consumes one token per node — same contract
+    # as the batch endpoint
+    decision = await ctx.admit(
+        request,
+        n=len(nodes),
+        priority=min(_priority_of(n.get("priority")) for n in nodes),
+    )
+    if decision is not None and not decision.admitted:
+        return _admission_reject(ctx, decision, "graph", n=len(nodes))
+    ctx.m_admitted.inc(len(nodes))
+    distinct = list(dict.fromkeys(fids))
+    fn_keys = [_FUNCTION_PREFIX + f for f in distinct]
+    payloads = await ctx.store_call(ctx.store.hget_many, fn_keys, "payload")
+    digests = await ctx.store_call(
+        ctx.store.hget_many, fn_keys, _FN_DIGEST_FIELD
+    )
+    fn_map: dict[str, tuple[str, str | None]] = {}
+    for fid, fn_payload, dig in zip(distinct, payloads, digests):
+        if fn_payload is None:
+            return _json_error(404, f"unknown function_id {fid!r}")
+        fn_map[fid] = (fn_payload, dig)
+    task_ids = [new_task_id() for _ in nodes]
+    children: list[list[int]] = [[] for _ in nodes]
+    for i, parents in enumerate(deps):
+        for p in parents:
+            children[p].append(i)
+    trace_ids: list[str | None] = [None] * len(nodes)
+    bodies: list[str] = []
+    for i in range(len(nodes)):
+        if children[i]:
+            extras[i][FIELD_CHILDREN] = ",".join(
+                task_ids[c] for c in children[i]
+            )
+        if deps[i]:
+            extras[i][FIELD_DEPS] = ",".join(task_ids[p] for p in deps[i])
+            extras[i][FIELD_PENDING_DEPS] = str(len(deps[i]))
+        if ctx.trace:
+            trace_ids[i] = new_trace_id()
+            extras[i][FIELD_TRACE_ID] = trace_ids[i]
+        fn_payload, dig = fn_map[fids[i]]
+        if ctx.payload_plane and dig:
+            extras[i][FIELD_FN_DIGEST] = dig
+            ctx.m_blob_saved.inc(len(fn_payload))
+            bodies.append("")
+        else:
+            bodies.append(fn_payload)
+    # creation order: children BEFORE parents (reverse topological), so a
+    # parent's terminal write can never walk edges to records that don't
+    # exist yet; WAITING nodes in one pipelined round, then the QUEUED
+    # roots (whose announces make the graph runnable) in a second
+    order = list(reversed(topo))
+    waiting_nodes = [
+        (task_ids[i], bodies[i], nodes[i]["payload"], extras[i])
+        for i in order
+        if deps[i]
+    ]
+    root_nodes = [
+        (task_ids[i], bodies[i], nodes[i]["payload"], extras[i])
+        for i in order
+        if not deps[i]
+    ]
+
+    def write_graph() -> None:
+        if waiting_nodes:
+            ctx.store.create_tasks(
+                waiting_nodes, ctx.channel, status=TaskStatus.WAITING
+            )
+        if root_nodes:
+            ctx.store.create_tasks(root_nodes, ctx.channel)
+
+    await ctx.store_call(write_graph)
+    ctx.n_tasks += len(nodes)
+    ctx.m_tasks.inc(len(nodes))
+    ctx.m_graphs.inc()
+    ctx.m_graph_nodes.labels(kind="root").inc(len(root_nodes))
+    ctx.m_graph_nodes.labels(kind="waiting").inc(len(waiting_nodes))
+    resp: dict = {
+        "task_ids": task_ids,
+        "graph": {
+            "nodes": len(nodes),
+            "roots": len(root_nodes),
+            "edges": sum(len(d) for d in deps),
+        },
+    }
+    if ctx.trace:
+        resp["trace_ids"] = trace_ids
     return web.json_response(resp)
 
 

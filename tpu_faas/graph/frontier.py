@@ -1,0 +1,220 @@
+"""Device-computed ready frontier for task graphs (tpu-push batch tick).
+
+The tpu-push dispatcher keeps WAITING graph nodes resident beside the
+pending batch and feeds the device step a padded edge list; the readiness
+mask is ONE segment-reduce composed into the jitted tick
+(sched/state._packed_tick), so dependency-aware placement happens where
+placement already happens — not in a host pre-pass. The host side of this
+module is pure bookkeeping: which nodes are waiting, which parents have
+been CONFIRMED complete (confirmed = the store's promotion plane ran for
+that parent, so the child's record is already QUEUED by the time the mask
+can say "ready" — a dispatched frontier child is never WAITING store-side,
+which is the invariant the race monitor's missing WAITING -> RUNNING
+transition enforces).
+
+Also here: the data-locality exchange. The worker that ran a COMPLETED
+parent holds the parent's function in its payload-plane cache (PR 5), so a
+ready child prefers that worker. The exchange is a jitted post-placement
+pass that swaps a preferring task with the task currently holding its
+preferred worker — only between EQUAL-SPEED workers, where the swap is
+makespan-neutral by the rank-pairing argument (the multiset of
+size/speed completion times is unchanged) and therefore a pure cache win.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("T",))
+def dep_ready_mask(
+    edge_child: jnp.ndarray,  # i32[E] batch row per edge (T = dropped pad)
+    edge_undone: jnp.ndarray,  # i32[E] 1 while the edge's parent is unconfirmed
+    *,
+    T: int,
+) -> jnp.ndarray:
+    """bool[T]: True where a batch row has no unconfirmed parents — the
+    segment-reduce over the edge list. Rows without edges are ready (flat
+    tasks and frontier-free batches compose for free)."""
+    blocked = jnp.zeros(T, jnp.int32).at[edge_child].add(
+        edge_undone, mode="drop"
+    )
+    return blocked == 0
+
+
+def locality_exchange(
+    assignment: jnp.ndarray,  # i32[T] worker row per task, -1 queued
+    task_pref: jnp.ndarray,  # i32[T] preferred worker row, -1 none
+    worker_speed: jnp.ndarray,  # f32[W]
+) -> jnp.ndarray:
+    """Swap preferring tasks toward their preferred workers, makespan-
+    neutrally. For each preferred worker the (index-lowest) preferring
+    task swaps assignments with that worker's (index-lowest) currently
+    assigned task, iff both workers' speeds are equal (rank pairing makes
+    an equal-speed swap change nothing but cache hit rate) and the holder
+    is itself preference-free (so no task participates in two swaps). All
+    scatters use distinct indices by construction; invalid lanes scatter
+    out of range and drop."""
+    T = assignment.shape[0]
+    W = worker_speed.shape[0]
+    tidx = jnp.arange(T, dtype=jnp.int32)
+    BIG = jnp.int32(T)
+    assigned = assignment >= 0
+    a_clip = jnp.clip(assignment, 0, W - 1)
+    p_clip = jnp.clip(task_pref, 0, W - 1)
+    want = (
+        (task_pref >= 0)
+        & assigned
+        & (task_pref != assignment)
+        & (
+            jnp.abs(worker_speed[a_clip] - worker_speed[p_clip])
+            <= 1e-6 * jnp.maximum(worker_speed[a_clip], 1e-9)
+        )
+    )
+    # representative holder per worker (lowest assigned task index)
+    holder = (
+        jnp.full(W, BIG, jnp.int32)
+        .at[a_clip]
+        .min(jnp.where(assigned, tidx, BIG))
+    )
+    # chosen wanter per preferred worker (a task wants exactly one worker,
+    # so each task appears under at most one w)
+    wanter = (
+        jnp.full(W, BIG, jnp.int32)
+        .at[p_clip]
+        .min(jnp.where(want, tidx, BIG))
+    )
+    h = jnp.clip(holder, 0, T - 1)
+    t = jnp.clip(wanter, 0, T - 1)
+    valid = (holder < BIG) & (wanter < BIG) & (holder != wanter)
+    # the holder must not itself be a preferring task: that makes every
+    # task's swap membership unique (a wanter can't double as a holder,
+    # because any worker holding it would fail this guard)
+    valid = valid & ~want[h]
+    w_ids = jnp.arange(W, dtype=jnp.int32)
+    # scatter with mode="drop": invalid lanes target index T (out of range)
+    t_idx = jnp.where(valid, t, T)
+    h_idx = jnp.where(valid, h, T)
+    old_of_t = assignment[t]
+    out = assignment.at[t_idx].set(w_ids, mode="drop")
+    out = out.at[h_idx].set(old_of_t, mode="drop")
+    return out
+
+
+def pad_edges(
+    edge_child: list[int], edge_undone: list[int], T: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pad the host edge list to the next power of two (bounded jit
+    signatures) with dropped lanes (child = T, undone = 0)."""
+    E = max(len(edge_child), 1)
+    k = 1 << (E - 1).bit_length()
+    child = np.full(k, T, dtype=np.int32)
+    undone = np.zeros(k, dtype=np.int32)
+    if edge_child:
+        child[: len(edge_child)] = edge_child
+        undone[: len(edge_undone)] = edge_undone
+    return child, undone
+
+
+class GraphFrontier:
+    """Host bookkeeping of the device frontier: WAITING nodes held beside
+    the pending batch, parent confirmations, and per-node preferred rows.
+
+    A parent becomes ``done`` here ONLY when the dispatcher's
+    complete_dep_many round for it succeeded (note_parent), which is what
+    makes the device mask's "ready" imply "record already QUEUED". Nodes
+    leave through pop() — dispatch, promotion-announce adoption into
+    pending, poison, or reconciliation."""
+
+    def __init__(self, cap: int = 8192) -> None:
+        self.cap = cap
+        #: task_id -> PendingTask (the payload source at dispatch time)
+        self.waiting: dict[str, object] = {}
+        #: task_id -> parent ids (immutable edge list from FIELD_DEPS)
+        self.parents: dict[str, list[str]] = {}
+        #: parent id -> waiting child ids (reverse index)
+        self._children: dict[str, set[str]] = {}
+        #: parent id -> (ok, worker_row) once CONFIRMED terminal; kept only
+        #: while some waiting child still references the parent
+        self._parent_state: dict[str, tuple[bool, int]] = {}
+        self.n_frontier_dispatches = 0
+
+    def __len__(self) -> int:
+        return len(self.waiting)
+
+    def add(self, task, parent_ids: list[str]) -> bool:
+        """Hold a WAITING node; False when full or already held (the
+        promotion-announce path covers skipped nodes)."""
+        tid = task.task_id
+        if tid in self.waiting or len(self.waiting) >= self.cap:
+            return False
+        self.waiting[tid] = task
+        self.parents[tid] = list(parent_ids)
+        for pid in parent_ids:
+            self._children.setdefault(pid, set()).add(tid)
+        return True
+
+    def has_waiting_children(self, parent_id: str) -> bool:
+        return bool(self._children.get(parent_id))
+
+    def note_parent(self, parent_id: str, ok: bool, row: int = -1) -> None:
+        """A parent's terminal write landed AND its complete_dep_many round
+        succeeded: flip its edges. ``row`` is the worker row that returned
+        the result (the locality preference for ok parents)."""
+        if self._children.get(parent_id):
+            self._parent_state[parent_id] = (bool(ok), int(row))
+
+    def pop(self, task_id: str):
+        """Remove and return a held node (None if not held). Parent states
+        nothing references anymore are dropped with it."""
+        task = self.waiting.pop(task_id, None)
+        if task is None:
+            return None
+        for pid in self.parents.pop(task_id, ()):
+            kids = self._children.get(pid)
+            if kids is not None:
+                kids.discard(task_id)
+                if not kids:
+                    del self._children[pid]
+                    self._parent_state.pop(pid, None)
+        return task
+
+    def failed_parent_of(self, task_id: str) -> str | None:
+        """A confirmed-failed parent of this node, if any — the host-side
+        fast drop for poisoned nodes (the store record is already FAILED
+        by the promotion plane; the frontier just forgets)."""
+        for pid in self.parents.get(task_id, ()):
+            state = self._parent_state.get(pid)
+            if state is not None and not state[0]:
+                return pid
+        return None
+
+    def edge_arrays(
+        self, rows: dict[int, str], T: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray | None]:
+        """(edge_child, edge_undone, task_pref) for this tick's batch:
+        ``rows`` maps batch row -> held task_id. task_pref is None when no
+        node has a confirmed-ok parent row (skips the exchange pass and
+        its jit signature entirely)."""
+        edge_child: list[int] = []
+        edge_undone: list[int] = []
+        pref = np.full(T, -1, dtype=np.int32)
+        any_pref = False
+        for row, tid in rows.items():
+            best = -1
+            for pid in self.parents.get(tid, ()):
+                state = self._parent_state.get(pid)
+                done = state is not None and state[0]
+                edge_child.append(row)
+                edge_undone.append(0 if done else 1)
+                if done and state[1] >= 0:
+                    best = state[1]
+            if best >= 0:
+                pref[row] = best
+                any_pref = True
+        child, undone = pad_edges(edge_child, edge_undone, T)
+        return child, undone, (pref if any_pref else None)

@@ -60,6 +60,11 @@ EVENTS = (
 STAGES = {
     # gateway write + announce-bus latency
     "submit_to_announce": ("submitted", "announced"),
+    # graph children only: WAITING stretch from create to the promotion
+    # plane's WAITING -> QUEUED flip ("promoted" is stamped at intake of
+    # the promoted record, or at the frontier's in-tick readiness) — both
+    # endpoints absent on flat tasks, so the stage never observes there
+    "dep_wait": ("submitted", "promoted"),
     # waiting in the pending structures for a placement decision
     "queue_wait": ("announced", "scheduled"),
     # device-schedule latency: placement decision -> task on the wire

@@ -23,14 +23,19 @@ import time
 from typing import Mapping
 
 from tpu_faas.core.task import (
+    DEP_FAILED_PREFIX,
+    FIELD_CHILDREN,
+    FIELD_DEP_RESOLVED,
     FIELD_FINAL_AT,
     FIELD_FINAL_STATUS,
     FIELD_FINISHED_AT,
     FIELD_FN,
     FIELD_PARAMS,
+    FIELD_PENDING_DEPS,
     FIELD_RESULT,
     FIELD_STATUS,
     TaskStatus,
+    dep_done_field,
 )
 
 #: Default announce channel name (reference config.ini:7 `TASKS_CHANNEL=tasks`).
@@ -217,6 +222,27 @@ class TaskStore(abc.ABC):
         (a server's --snapshot file). Backends without durability raise."""
         raise NotImplementedError(f"{type(self).__name__} cannot checkpoint")
 
+    def hincrby(self, key: str, field: str, delta: int) -> int:
+        """Atomically add ``delta`` to an integer hash field (absent = 0)
+        and return the new value — the dependency plane's pending-count
+        decrement. This base default is read-modify-write and only
+        single-thread safe; production backends override it (the RESP
+        client sends HINCRBY, the memory store holds its lock)."""
+        current = self.hget(key, field)
+        try:
+            value = int(current) if current is not None else 0
+        except ValueError:
+            value = 0
+        value += int(delta)
+        self.hset(key, {field: str(value)})
+        return value
+
+    def hincrby_many(self, items: list[tuple[str, str, int]]) -> list[int]:
+        """hincrby over (key, field, delta) triples. Default: a loop; the
+        RESP client pipelines one HINCRBY round — the promotion plane
+        decrements every child of a finished parent batch at once."""
+        return [self.hincrby(key, field, delta) for key, field, delta in items]
+
     # -- task-level conveniences ------------------------------------------
     def create_task(
         self,
@@ -225,13 +251,18 @@ class TaskStore(abc.ABC):
         param_payload: str,
         channel: str = TASKS_CHANNEL,
         extra_fields: dict[str, str] | None = None,
+        status: TaskStatus = TaskStatus.QUEUED,
     ) -> None:
         """Write the gateway-side contract: full hash then announce.
 
         Field set and QUEUED initial status per SURVEY §0.1 (demonstrated in
         the reference by old/client_debug.py:40-45). ``extra_fields`` carries
         optional scheduling hints (FIELD_PRIORITY/FIELD_COST); the core four
-        fields win on any name collision.
+        fields win on any name collision. ``status`` admits exactly one
+        other initial state: WAITING, for graph nodes created behind their
+        dependencies (gateway /execute_graph) — the announce still fires
+        (graph-aware dispatchers park the node in their frontier; everyone
+        else skips non-QUEUED announces as always).
         """
         # index first: a crash after the index write leaves a stale entry
         # (filtered by the rescan's status probe); the opposite order would
@@ -241,7 +272,7 @@ class TaskStore(abc.ABC):
             task_id,
             {
                 **(extra_fields or {}),
-                FIELD_STATUS: str(TaskStatus.QUEUED),
+                FIELD_STATUS: str(status),
                 FIELD_FN: fn_payload,
                 FIELD_PARAMS: param_payload,
                 FIELD_RESULT: "None",
@@ -486,15 +517,21 @@ class TaskStore(abc.ABC):
         self,
         tasks: list[tuple],  # (task_id, fn_payload, params[, extra_fields])
         channel: str = TASKS_CHANNEL,
+        status: TaskStatus = TaskStatus.QUEUED,
     ) -> None:
         """Batch create_task. Each tuple is (task_id, fn_payload,
         param_payload) with an optional 4th element of extra hash fields.
         Default: a loop; the RESP client pipelines all writes + announces
-        into one round trip (the gateway's batch-submit path)."""
+        into one round trip (the gateway's batch-submit path). ``status``
+        as in create_task — the graph submit creates its WAITING children
+        in one pipelined batch before announcing the QUEUED roots."""
         for task in tasks:
             task_id, fn_payload, param_payload = task[:3]
             extra = task[3] if len(task) > 3 else None
-            self.create_task(task_id, fn_payload, param_payload, channel, extra)
+            self.create_task(
+                task_id, fn_payload, param_payload, channel, extra,
+                status=status,
+            )
 
     def get_payloads(self, task_id: str) -> tuple[str, str]:
         """Fetch (fn_payload, param_payload) in one round-trip —
@@ -708,6 +745,9 @@ class TaskStore(abc.ABC):
         self.hdel(LIVE_INDEX_KEY, task_id)
         self.publish(channel, CANCEL_ANNOUNCE_PREFIX + task_id)
         self.publish(RESULTS_CHANNEL, task_id)
+        # a cancelled graph parent never completes: poison its frontier
+        # (one small-field probe for non-graph tasks, nothing more)
+        self.complete_dep_many([(task_id, str(TaskStatus.CANCELLED))], channel)
         return str(TaskStatus.CANCELLED)
 
     def expire_task(
@@ -763,7 +803,182 @@ class TaskStore(abc.ABC):
             return final
         self.hdel(LIVE_INDEX_KEY, task_id)
         self.publish(RESULTS_CHANNEL, task_id)
+        # a shed graph parent never completes: poison its frontier
+        self.complete_dep_many([(task_id, str(TaskStatus.EXPIRED))], channel)
         return str(TaskStatus.EXPIRED)
+
+    # -- task-graph promotion plane (tpu_faas/graph) -----------------------
+    def complete_dep_many(
+        self,
+        parents: list[tuple[str, str]],
+        channel: str = TASKS_CHANNEL,
+    ) -> tuple[list[str], list[str]]:
+        """Walk the forward dependency edges of terminal parent writes that
+        LANDED: ``parents`` is (task_id, terminal_status) pairs. Returns
+        (promoted_child_ids, poisoned_child_ids).
+
+        COMPLETED parents decrement each child's pending count — exactly
+        once per edge (a write-once ``dep_done:<parent>`` claim gates the
+        atomic hincrby, so a zombie's duplicate terminal write cannot
+        double-count) — and a count hitting zero flips the child
+        WAITING -> QUEUED and announces it on the ordinary task bus, so
+        promoted children flow through intake/admission/shedding
+        unchanged. A parent that reached FAILED/EXPIRED/CANCELLED instead
+        POISONS its children: WAITING -> FAILED with a
+        ``dep_failed:<parent>`` error payload, never dispatched — and the
+        poison walks the TRANSITIVE frontier iteratively (no recursion:
+        graph depth must not meet Python's stack limit).
+
+        Either way the child's exit from WAITING is arbitrated by the
+        write-once FIELD_DEP_RESOLVED claim, so a promote racing a poison
+        (two parents finishing oppositely from two processes) resolves to
+        exactly one writer. Non-graph parents (no FIELD_CHILDREN) cost one
+        pipelined small-field read and nothing else — and dispatchers skip
+        even that for tasks whose records never carried children. Built
+        from pipelined primitives only, so RESP backends pay a bounded
+        number of rounds per generation of the walk."""
+        from tpu_faas.core.serialize import serialize  # lazy: dill is heavy
+
+        promoted: list[str] = []
+        poisoned: list[str] = []
+        work = [(pid, str(status)) for pid, status in parents]
+        while work:
+            batch, work = work, []
+            kid_lists = self.hget_many([p for p, _ in batch], FIELD_CHILDREN)
+            ok_edges: list[tuple[str, str]] = []  # (parent, child)
+            bad_edges: list[tuple[str, str, str]] = []  # (+ parent status)
+            for (pid, status), kids in zip(batch, kid_lists):
+                if not kids:
+                    continue
+                for child in kids.split(","):
+                    if not child:
+                        continue
+                    if status == str(TaskStatus.COMPLETED):
+                        ok_edges.append((pid, child))
+                    else:
+                        bad_edges.append((pid, child, status))
+            if ok_edges:
+                claims = self.hsetnx_many(
+                    [(c, dep_done_field(p), "1") for p, c in ok_edges]
+                )
+                dec = [c for (_p, c), won in zip(ok_edges, claims) if won]
+                counts = self.hincrby_many(
+                    [(c, FIELD_PENDING_DEPS, -1) for c in dec]
+                )
+                ready = [c for c, n in zip(dec, counts) if n <= 0]
+                if ready:
+                    res = self.hsetnx_many(
+                        [(c, FIELD_DEP_RESOLVED, "promote") for c in ready]
+                    )
+                    to_promote = [c for c, won in zip(ready, res) if won]
+                    if to_promote:
+                        # one pipelined status round + one announce round;
+                        # the claim above makes this the ONLY writer moving
+                        # these children out of WAITING
+                        self.set_status_many(
+                            TaskStatus.QUEUED,
+                            [(c, None) for c in to_promote],
+                        )
+                        self.publish_many(channel, to_promote)
+                        promoted.extend(to_promote)
+            if bad_edges:
+                claims = self.hsetnx_many(
+                    [
+                        (child, FIELD_DEP_RESOLVED, f"poison:{pid}")
+                        for pid, child, _status in bad_edges
+                    ]
+                )
+                items: list[tuple[str, TaskStatus, str, bool]] = []
+                for (pid, child, status), won in zip(bad_edges, claims):
+                    if not won:
+                        # promoted, or already poisoned via another parent
+                        continue
+                    items.append(
+                        (
+                            child,
+                            TaskStatus.FAILED,
+                            serialize(
+                                RuntimeError(
+                                    f"{DEP_FAILED_PREFIX}{pid}: parent "
+                                    f"reached {status}; this node was "
+                                    "never dispatched"
+                                )
+                            ),
+                            True,  # first_wins: never clobber a real result
+                        )
+                    )
+                    poisoned.append(child)
+                    work.append((child, str(TaskStatus.FAILED)))
+                if items:
+                    # one pipelined terminal round per poison generation
+                    self.finish_task_many(items)
+        return promoted, poisoned
+
+    def resolve_waiting(
+        self,
+        task_id: str,
+        parent_statuses: dict[str, str | None],
+        channel: str = TASKS_CHANNEL,
+    ) -> str | None:
+        """Orphan repair for a WAITING node whose promotion was lost (its
+        resolver crashed between claim and status write, or the decrement
+        stream died with a dispatcher): given the node's parents' current
+        statuses (None = record gone), apply the fate the graph protocol
+        implies — poison if any parent is a never-ran/failed terminal OR
+        vanished, promote if every parent COMPLETED, nothing if any parent
+        is still live. Honors an existing FIELD_DEP_RESOLVED claim by
+        re-applying ITS action (idempotent: the claimed action's writes
+        converge), claims otherwise. Returns "promoted", "poisoned", or
+        None (left alone). Used by the gateway's result-TTL sweeper; safe
+        against a concurrent live promotion because both go through the
+        same write-once claim."""
+        from tpu_faas.core.serialize import serialize
+
+        if self.hget(task_id, FIELD_STATUS) != str(TaskStatus.WAITING):
+            return None
+        bad_parent: str | None = None
+        bad_status = "MISSING"
+        all_done = True
+        for pid, status in parent_statuses.items():
+            if status == str(TaskStatus.COMPLETED):
+                continue
+            all_done = False
+            if status is None or TaskStatus.terminal_str(status):
+                bad_parent, bad_status = pid, status or "MISSING"
+            else:
+                return None  # a parent is still live: not orphaned
+        claim = self.hget(task_id, FIELD_DEP_RESOLVED)
+        if claim is None:
+            action = "promote" if all_done else (
+                f"poison:{bad_parent}" if bad_parent is not None else None
+            )
+            if action is None:
+                return None
+            created, claim = self.setnx_field(
+                task_id, FIELD_DEP_RESOLVED, action
+            )
+        if claim == "promote":
+            if self.hget(task_id, FIELD_STATUS) == str(TaskStatus.WAITING):
+                self.set_status(task_id, TaskStatus.QUEUED)
+                self.publish(channel, task_id)
+            return "promoted"
+        parent = claim.split(":", 1)[1] if ":" in claim else "?"
+        self.finish_task(
+            task_id,
+            TaskStatus.FAILED,
+            serialize(
+                RuntimeError(
+                    f"{DEP_FAILED_PREFIX}{parent}: parent reached "
+                    f"{bad_status}; this node was never dispatched"
+                )
+            ),
+            first_wins=True,
+        )
+        # the repaired node may itself have children: poison them too
+        self.complete_dep_many(
+            [(task_id, str(TaskStatus.FAILED))], channel
+        )
+        return "poisoned"
 
     def request_kill(
         self, task_id: str, channel: str = TASKS_CHANNEL

@@ -621,6 +621,25 @@ class RespStore(TaskStore):
     def hexists(self, key: str, field: str) -> bool:
         return bool(self._command("HEXISTS", key, field))
 
+    def hincrby(self, key: str, field: str, delta: int) -> int:
+        # atomic at the single-threaded server (real Redis's HINCRBY has
+        # the same contract) — the dependency plane's pending-count
+        # decrement must not lose updates between concurrent dispatchers
+        return int(self._command("HINCRBY", key, field, int(delta)))
+
+    def hincrby_many(self, items: list[tuple[str, str, int]]) -> list[int]:
+        """Pipelined HINCRBY: the promotion plane decrements every child of
+        a finished parent batch in ONE round trip."""
+        if not items:
+            return []
+        replies = self.pipeline(
+            [("HINCRBY", key, field, int(delta)) for key, field, delta in items]
+        )
+        errors = [r for r in replies if isinstance(r, resp.RespError)]
+        if errors:
+            raise errors[0]
+        return [int(r) for r in replies]
+
     def setnx_field(
         self, key: str, field: str, value: str
     ) -> tuple[bool, str]:
@@ -766,7 +785,9 @@ class RespStore(TaskStore):
             raise errors[0]
         return replies[0] == 1
 
-    def create_tasks(self, tasks, channel: str = TASKS_CHANNEL) -> None:
+    def create_tasks(
+        self, tasks, channel: str = TASKS_CHANNEL, status=None
+    ) -> None:
         from tpu_faas.core.task import (
             FIELD_FN,
             FIELD_PARAMS,
@@ -775,6 +796,8 @@ class RespStore(TaskStore):
             TaskStatus,
         )
 
+        if status is None:
+            status = TaskStatus.QUEUED
         commands: list[tuple] = []
         if tasks:
             # live-index entries first (same ordering rationale as
@@ -795,7 +818,7 @@ class RespStore(TaskStore):
                 (
                     "HSET", task_id,
                     *extra_args,
-                    FIELD_STATUS, str(TaskStatus.QUEUED),
+                    FIELD_STATUS, str(status),
                     FIELD_FN, fn_payload,
                     FIELD_PARAMS, param_payload,
                     FIELD_RESULT, "None",

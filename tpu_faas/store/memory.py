@@ -70,6 +70,19 @@ class MemoryStore(TaskStore):
             h[field] = value
             return True, value
 
+    def hincrby(self, key: str, field: str, delta: int) -> int:
+        # atomic under the store lock (the base default's read-modify-write
+        # would lose decrements between gateway/dispatcher threads)
+        with self._lock:
+            h = self._hashes.setdefault(key, {})
+            try:
+                value = int(h.get(field, "0"))
+            except ValueError:
+                value = 0
+            value += int(delta)
+            h[field] = str(value)
+            return value
+
     def hget(self, key: str, field: str) -> str | None:
         with self._lock:
             return self._hashes.get(key, {}).get(field)

@@ -74,6 +74,18 @@ _LEGAL: frozenset[tuple[str | None, str]] = frozenset(
         # illegal-transition error, which is how the monitor proves "shed
         # never touches a dispatched task" at runtime
         ("QUEUED", "EXPIRED"),
+        # -- task graphs (tpu_faas/graph, store complete_dep_many) ---------
+        # a graph node created behind its dependencies (gateway
+        # /execute_graph); deliberately NO ("WAITING", "RUNNING") entry —
+        # that transition being illegal is how the monitor proves at
+        # runtime that no WAITING node ever reaches a worker
+        (None, "WAITING"),
+        # promotion: the last parent COMPLETED and the pending count hit
+        # zero (single writer by the FIELD_DEP_RESOLVED claim)
+        ("WAITING", "QUEUED"),
+        # poison: a parent reached FAILED/EXPIRED/CANCELLED, so the node
+        # (and transitively its own frontier) fails without dispatching
+        ("WAITING", "FAILED"),
     }
 )
 
@@ -487,8 +499,9 @@ class RaceCheckStore(TaskStore):
             self.inner.hset(key, fields)
             return
         op = "finish" if FIELD_RESULT in fields else "status"
-        if FIELD_STATUS in fields and fields[FIELD_STATUS] == str(
-            TaskStatus.QUEUED
+        if FIELD_STATUS in fields and fields[FIELD_STATUS] in (
+            str(TaskStatus.QUEUED),
+            str(TaskStatus.WAITING),  # graph nodes created behind deps
         ):
             op = "create"
         self.monitor.observe(self.actor, op, key, fields)
@@ -529,6 +542,14 @@ class RaceCheckStore(TaskStore):
 
     def hget_many(self, keys: list[str], field: str) -> list[str | None]:
         return self.inner.hget_many(keys, field)
+
+    def hincrby(self, key: str, field: str, delta: int) -> int:
+        # dependency-count bookkeeping, not a lifecycle write: pass through
+        # for atomicity (the base default's read-modify-write would race)
+        return self.inner.hincrby(key, field, delta)
+
+    def hincrby_many(self, items) -> list[int]:
+        return self.inner.hincrby_many(items)
 
     def hgetall_many(self, keys: list[str]) -> list[dict[str, str]]:
         # reads pass through pipelined; only writes need the monitor.

@@ -57,7 +57,7 @@ from tpu_faas.store import resp, snapshot
 #: ordinary clients, a fenced primary refuses from everyone, and a live
 #: primary forwards down its replication streams.
 MUTATING_COMMANDS = frozenset(
-    {"HSET", "HSETNX", "HDEL", "DEL", "PUBLISH", "FLUSHDB"}
+    {"HSET", "HSETNX", "HINCRBY", "HDEL", "DEL", "PUBLISH", "FLUSHDB"}
 )
 
 #: Error prefixes clients can match on (encode_error prepends "-ERR ").

@@ -215,6 +215,13 @@ class StoreServer:
         elif name == "HSETNX":
             h = st.hashes.setdefault(args[0], {})
             h.setdefault(args[1], args[2])
+        elif name == "HINCRBY":
+            h = st.hashes.setdefault(args[0], {})
+            try:
+                value = int(h.get(args[1], "0")) + int(args[2])
+            except ValueError:
+                value = 0
+            h[args[1]] = str(value)
         elif name == "HDEL":
             h = st.hashes.get(args[0])
             if h is not None:
@@ -490,6 +497,32 @@ class StoreServer:
                 self._dirty = True
                 self._replicate(["HSETNX", *args])
                 writer.write(resp.encode_integer(1))
+        elif name == "HINCRBY":
+            if len(args) != 3:
+                writer.write(
+                    resp.encode_error("wrong number of arguments for HINCRBY")
+                )
+                return True
+            h = st.hashes.setdefault(args[0], {})
+            try:
+                delta = int(args[2])
+            except ValueError:
+                writer.write(
+                    resp.encode_error("HINCRBY delta is not an integer")
+                )
+                return True
+            try:
+                current = int(h.get(args[1], "0"))
+            except ValueError:
+                writer.write(
+                    resp.encode_error("hash value is not an integer")
+                )
+                return True
+            value = current + delta
+            h[args[1]] = str(value)
+            self._dirty = True
+            self._replicate(["HINCRBY", *args])
+            writer.write(resp.encode_integer(value))
         elif name == "HDEL":
             if len(args) < 2:
                 writer.write(resp.encode_error("wrong number of arguments for HDEL"))
