@@ -253,7 +253,10 @@ def test_pull_worker_kill_loses_no_tasks():
     try:
         fid = client.register(sleep_task)
         handles = [client.submit(fid, 0.8) for _ in range(8)]
-        deadline = time.monotonic() + 30
+        # condition wait, not a tight wall-clock bound: under full-suite
+        # load, worker subprocess startup + first REQ can take tens of
+        # seconds — the assert is "tasks start", not "tasks start fast"
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             if sum(h.status() == "RUNNING" for h in handles) >= 2:
                 break
@@ -262,7 +265,9 @@ def test_pull_worker_kill_loses_no_tasks():
             raise AssertionError("tasks never started on the pull fleet")
         workers[0].send_signal(signal.SIGKILL)
         workers[0].wait()
-        assert [h.result(timeout=90) for h in handles] == [0.8] * 8
+        # generous: the surviving 2-proc worker serially re-runs the dead
+        # worker's reclaimed tasks, and a loaded box stretches every leg
+        assert [h.result(timeout=180) for h in handles] == [0.8] * 8
         assert disp.n_reclaimed > 0  # the recovery path actually ran
     finally:
         for w in workers:

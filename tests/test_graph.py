@@ -238,6 +238,36 @@ def test_sweeper_repairs_orphaned_waiting_nodes():
     assert repaired == [1]
 
 
+def test_sweeper_keeps_terminal_parent_while_child_still_waits():
+    """A COMPLETED parent whose dep walk is still pending (deferred
+    through an outage, resolver crashed) must outlive the result TTL
+    while any of its children sits WAITING: resolve_waiting reads a
+    missing parent as poison-worthy, so an age-only delete would later
+    fail a child whose parents all succeeded. Once the child leaves
+    WAITING, the parent expires normally — no leak."""
+    import time as _time
+
+    from tpu_faas.gateway.app import _sweep_expired_results
+
+    s = MemoryStore()
+    _make_waiting(s, "C", ["P1", "P2"])
+    _make_parent(s, "P1", ["C"])
+    _make_parent(s, "P2", ["C"])
+    s.set_status("P2", TaskStatus.RUNNING)  # sibling still live
+    s.finish_task("P1", TaskStatus.COMPLETED, "r")  # dep walk LOST
+    aged = _time.time() + 3600  # P1's finish stamp is ancient by then
+    deleted = _sweep_expired_results(s, ttl=30.0, now=aged)
+    assert deleted == 0
+    assert s.get_status("P1") == "COMPLETED"  # survived the TTL
+    assert s.get_status("C") == WAITING  # untouched (P2 still live)
+    # the deferred walk finally lands: child promoted, parent now free
+    s.finish_task("P2", TaskStatus.COMPLETED, "r")
+    s.complete_dep_many([("P1", "COMPLETED"), ("P2", "COMPLETED")])
+    assert s.get_status("C") == QUEUED
+    assert _sweep_expired_results(s, ttl=30.0, now=aged + 3600) >= 2
+    assert s.get_status("P1") is None  # expired once nothing waited on it
+
+
 # -- device frontier kernels -------------------------------------------------
 
 

@@ -208,3 +208,54 @@ def test_first_k_indices_matches_numpy_reference():
         idx = np.flatnonzero(mask)[:K]
         want[: len(idx)] = idx
         np.testing.assert_array_equal(got, want, err_msg=f"K={K} n={len(mask)}")
+
+
+# -- consistent-hash ring (store/sharding.py) --------------------------------
+
+RING_KEYS = st.lists(
+    st.text(
+        alphabet=st.characters(min_codepoint=33, max_codepoint=126),
+        min_size=1,
+        max_size=40,
+    ),
+    min_size=1,
+    max_size=200,
+    unique=True,
+)
+
+
+@SET
+@given(RING_KEYS, st.integers(1, 8))
+def test_ring_routing_is_deterministic_and_in_range(keys, n_shards):
+    from tpu_faas.store.sharding import HashRing
+
+    a, b = HashRing(n_shards), HashRing(n_shards)
+    for key in keys:
+        shard = a.shard_of(key)
+        assert 0 <= shard < n_shards
+        # a fresh ring with the same membership places every key
+        # identically — the property every fleet process depends on
+        assert b.shard_of(key) == shard
+
+
+@SET
+@given(st.integers(2, 8))
+def test_ring_add_remove_moves_bounded_fraction(n_shards):
+    """Consistent hashing's defining property: growing (or shrinking)
+    the ring by one shard re-homes ~1/(N+1) of keys, never the ~N/(N+1)
+    a modulo partition would. Bounded at 2.5x the ideal fraction to
+    absorb virtual-node variance at small N."""
+    from tpu_faas.store.sharding import HashRing
+
+    keys = [f"task-{i}" for i in range(3000)]
+    small, big = HashRing(n_shards), HashRing(n_shards + 1)
+    moved = sum(
+        1 for k in keys if small.shard_of(k) != big.shard_of(k)
+    )
+    ideal = 1.0 / (n_shards + 1)
+    assert moved / len(keys) <= 2.5 * ideal
+    # and the keys that DID move all landed on the new shard — a grow
+    # must never shuffle keys between surviving shards
+    for k in keys:
+        if small.shard_of(k) != big.shard_of(k):
+            assert big.shard_of(k) == n_shards

@@ -1,0 +1,449 @@
+"""Federated control plane (tpu_faas/store/sharding.py): consistent-hash
+ring determinism, ShardedStore routing/fan-out/merge semantics, shard-slice
+ownership scoping, cross-shard graph promotion, per-shard failover re-arm,
+and a gateway + per-shard-dispatcher end-to-end leg."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from tpu_faas.admission.signal import (
+    FLEET_HEALTH_KEY,
+    CapacitySnapshot,
+    publish_snapshot,
+    read_fleet_health,
+)
+from tpu_faas.core.task import (
+    FIELD_CHILDREN,
+    FIELD_DEPS,
+    FIELD_PENDING_DEPS,
+    FIELD_STATUS,
+    TaskStatus,
+)
+from tpu_faas.store.base import (
+    CANCEL_ANNOUNCE_PREFIX,
+    DISPATCHERS_KEY,
+    LEASE_CONF_KEY,
+    LIVE_INDEX_KEY,
+    RESULTS_CHANNEL,
+    TASKS_CHANNEL,
+)
+from tpu_faas.store.launch import make_store, start_store_thread
+from tpu_faas.store.memory import MemoryStore
+from tpu_faas.store.sharding import HashRing, ShardedStore
+
+
+def sharded(n: int = 3, owned=None) -> ShardedStore:
+    return ShardedStore(
+        [MemoryStore() for _ in range(n)], owned_shards=owned
+    )
+
+
+def other_shard_key(store: ShardedStore, key: str, prefix: str = "k") -> str:
+    """A key the ring places on a DIFFERENT shard than ``key``."""
+    target = store.shard_of(key)
+    for i in range(10_000):
+        cand = f"{prefix}-{i}"
+        if store.shard_of(cand) != target:
+            return cand
+    raise AssertionError("ring degenerated to one shard")
+
+
+# -- ring --------------------------------------------------------------------
+
+
+def test_ring_is_deterministic_across_instances():
+    a, b = HashRing(4), HashRing(4)
+    keys = [f"task-{i}" for i in range(500)]
+    assert [a.shard_of(k) for k in keys] == [b.shard_of(k) for k in keys]
+
+
+def test_ring_uses_every_shard_and_stays_roughly_balanced():
+    ring = HashRing(4)
+    counts = Counter(ring.shard_of(f"t{i}") for i in range(4000))
+    assert set(counts) == {0, 1, 2, 3}
+    # virtual nodes keep the imbalance bounded (loose bar: no shard may
+    # carry more than 2x its fair share or less than a third of it)
+    for shard in range(4):
+        assert 4000 / 12 < counts[shard] < 4000 / 2
+
+
+def test_ring_membership_change_moves_bounded_fraction():
+    keys = [f"task-{i}" for i in range(4000)]
+    before = HashRing(4)
+    after = HashRing(5)
+    moved = sum(
+        1 for k in keys if before.shard_of(k) != after.shard_of(k)
+    )
+    # consistent hashing: ~1/5 of keys re-home when a 5th shard joins
+    # (vs ~4/5 under modulo hashing); generous bound for vnode variance
+    assert moved / len(keys) < 0.40
+
+
+def test_ring_rejects_empty():
+    with pytest.raises(ValueError):
+        HashRing(0)
+
+
+# -- routing -----------------------------------------------------------------
+
+
+def test_single_key_ops_route_to_the_ring_shard():
+    s = sharded(3)
+    s.hset("t-route", {"a": "1"})
+    owner = s.shard_of("t-route")
+    for i in range(3):
+        raw = s.shard_store(i).hgetall("t-route")
+        assert raw == ({"a": "1"} if i == owner else {})
+    assert s.hget("t-route", "a") == "1"
+    s.hdel("t-route", "a")
+    assert s.hget("t-route", "a") is None
+
+
+def test_live_index_partitions_by_task_id_field():
+    s = sharded(3)
+    a = "idx-a"
+    b = other_shard_key(s, a, "idx")
+    s.hset(LIVE_INDEX_KEY, {a: "1", b: "1"})
+    assert s.shard_store(s.shard_of(a)).hgetall(LIVE_INDEX_KEY) == {a: "1"}
+    assert s.shard_store(s.shard_of(b)).hgetall(LIVE_INDEX_KEY) == {b: "1"}
+    assert s.hgetall(LIVE_INDEX_KEY) == {a: "1", b: "1"}
+    s.hdel(LIVE_INDEX_KEY, a)
+    assert s.hgetall(LIVE_INDEX_KEY) == {b: "1"}
+
+
+def test_fleet_keys_broadcast_writes_and_merge_reads():
+    s = sharded(3)
+    s.hset(FLEET_HEALTH_KEY, {"d1": "v1:1:2:3:0.5:100.0"})
+    # broadcast: every shard carries the copy (any surviving shard can
+    # answer the aggregation)
+    for i in range(3):
+        assert "d1" in s.shard_store(i).hgetall(FLEET_HEALTH_KEY)
+    # merge keeps the FRESHEST copy per field (max trailing stamp)
+    s.shard_store(0).hset(FLEET_HEALTH_KEY, {"d1": "v1:9:9:9:0.5:50.0"})
+    assert s.hgetall(FLEET_HEALTH_KEY)["d1"].endswith("100.0")
+    # lease conf merges the EARLIEST (first publication pins the grace
+    # window)
+    s.shard_store(0).hset(LEASE_CONF_KEY, {"t:30.0": "200.0"})
+    s.shard_store(1).hset(LEASE_CONF_KEY, {"t:30.0": "100.0"})
+    assert s.hgetall(LEASE_CONF_KEY)["t:30.0"] == "100.0"
+    # broadcast hdel reaches shards the writer never owned
+    s.hdel(FLEET_HEALTH_KEY, "d1")
+    for i in range(3):
+        assert "d1" not in s.shard_store(i).hgetall(FLEET_HEALTH_KEY)
+
+
+def test_batch_ops_preserve_input_order_across_shards():
+    s = sharded(4)
+    ids = [f"b-{i}" for i in range(40)]
+    assert len({s.shard_of(i) for i in ids}) > 1  # genuinely spread
+    s.create_tasks([(i, "F", f"P{i}") for i in ids])
+    records = s.hgetall_many(ids)
+    assert [r["param_payload"] for r in records] == [f"P{i}" for i in ids]
+    statuses = s.hget_many(ids, FIELD_STATUS)
+    assert statuses == ["QUEUED"] * len(ids)
+    created = s.create_tasks_if_absent([(i, "F", "P") for i in ids])
+    assert created == [False] * len(ids)  # all already exist
+    counts = s.hincrby_many([(i, "n", 2) for i in ids])
+    assert counts == [2] * len(ids)
+
+
+def test_create_finish_cancel_route_announces_by_task_shard():
+    s = sharded(3)
+    a = "ann-a"
+    b = other_shard_key(s, a, "ann")
+    sub_a = s.shard_store(s.shard_of(a)).subscribe(TASKS_CHANNEL)
+    sub_all = s.subscribe(TASKS_CHANNEL)
+    s.create_task(a, "F", "P")
+    s.create_task(b, "F", "P")
+    assert sub_a.get_message() == a
+    assert sub_a.get_message() is None  # b went to the other shard
+    got = {sub_all.get_message(), sub_all.get_message()}
+    assert got == {a, b}
+    res_sub = s.subscribe(RESULTS_CHANNEL)
+    s.finish_task(a, TaskStatus.COMPLETED, "R")
+    assert s.get_result(a) == ("COMPLETED", "R")
+    assert res_sub.get_message() == a
+    # live-index entry dropped on a's own shard
+    assert a not in s.shard_store(s.shard_of(a)).hgetall(LIVE_INDEX_KEY)
+    # cancel publishes the control message on b's shard bus
+    assert s.cancel_task(b) == str(TaskStatus.CANCELLED)
+    msgs = []
+    while True:
+        m = sub_all.get_message()
+        if m is None:
+            break
+        msgs.append(m)
+    assert CANCEL_ANNOUNCE_PREFIX + b in msgs
+    sub_a.close(), sub_all.close(), res_sub.close()
+    s.close()
+
+
+def test_owned_shards_scope_subscription_index_and_keys():
+    mems = [MemoryStore() for _ in range(3)]
+    full = ShardedStore(mems)
+    a = "own-a"
+    b = other_shard_key(full, a, "own")
+    owned = ShardedStore(mems, owned_shards=[full.shard_of(a)])
+    sub = owned.subscribe(TASKS_CHANNEL)
+    full.create_task(a, "F", "P")
+    full.create_task(b, "F", "P")
+    assert sub.get_message() == a
+    assert sub.get_message() is None  # b's shard is not owned
+    # rescan surface scopes too: keys + live index
+    assert b not in owned.keys()
+    assert a in owned.keys()
+    assert set(owned.hgetall(LIVE_INDEX_KEY)) == {a}
+    # but the unowned shard stays reachable for writes/reads by key
+    assert owned.get_status(b) == "QUEUED"
+    owned.finish_task(b, TaskStatus.COMPLETED, "R")
+    assert full.get_result(b) == ("COMPLETED", "R")
+    with pytest.raises(ValueError):
+        ShardedStore(mems, owned_shards=[7])
+    sub.close()
+
+
+def test_cross_shard_graph_promotion_and_poison():
+    s = sharded(3)
+    parent = "gp-parent"
+    child = other_shard_key(s, parent, "gp-child")
+    grandchild = other_shard_key(s, child, "gp-grand")
+    s.create_task(parent, "F", "P")
+    for node, deps in ((child, parent), (grandchild, child)):
+        s.create_task(
+            node,
+            "F",
+            "P",
+            extra_fields={FIELD_DEPS: deps, FIELD_PENDING_DEPS: "1"},
+            status=TaskStatus.WAITING,
+        )
+    s.hset(parent, {FIELD_CHILDREN: child})
+    s.hset(child, {FIELD_CHILDREN: grandchild})
+    promoted, poisoned = s.complete_dep_many(
+        [(parent, str(TaskStatus.COMPLETED))]
+    )
+    assert (promoted, poisoned) == ([child], [])
+    assert s.get_status(child) == "QUEUED"
+    # a failed mid-graph parent poisons its transitive frontier across
+    # shard boundaries
+    promoted, poisoned = s.complete_dep_many(
+        [(child, str(TaskStatus.FAILED))]
+    )
+    assert (promoted, poisoned) == ([], [grandchild])
+    assert s.get_status(grandchild) == "FAILED"
+
+
+def test_fleet_health_aggregation_reads_all_shards():
+    mems = [MemoryStore() for _ in range(2)]
+    full = ShardedStore(mems)
+    # two dispatchers publishing through shard-scoped handles: the
+    # broadcast lands their snapshots on their reachable shards; a
+    # gateway over the full ring aggregates both exactly once
+    now = time.time()
+    publish_snapshot(
+        ShardedStore(mems, owned_shards=[0]),
+        "disp-0",
+        CapacitySnapshot(2, 3, 8, 1.5, now),
+    )
+    publish_snapshot(
+        ShardedStore(mems, owned_shards=[1]),
+        "disp-1",
+        CapacitySnapshot(4, 5, 8, 2.5, now),
+    )
+    health = read_fleet_health(full, now=now)
+    assert health is not None
+    assert health.dispatchers == 2
+    assert (health.pending, health.inflight) == (6, 8)
+    assert health.capacity == 16
+    assert abs(health.drain_rate - 4.0) < 1e-9
+
+
+def test_replay_cursor_handles_cover_the_window_since_priming():
+    s = sharded(2)
+    handle, entries = s.replay_announces(-1)
+    assert entries == []
+    ids = [f"rp-{i}" for i in range(8)]
+    for tid in ids:
+        s.create_task(tid, "F", "P")
+    handle2, entries2 = s.replay_announces(handle)
+    replayed = [p for c, p in entries2 if c == TASKS_CHANNEL]
+    assert sorted(replayed) == sorted(ids)
+    # nothing new: the fresh handle covers everything
+    _h3, entries3 = s.replay_announces(handle2)
+    assert entries3 == []
+    # an unknown handle (the dispatcher's post-outage 0 fallback)
+    # replays each shard's whole bounded ring
+    _h4, entries4 = s.replay_announces(0)
+    assert sorted(p for c, p in entries4 if c == TASKS_CHANNEL) == sorted(ids)
+
+
+def test_owned_replay_scopes_to_owned_shards():
+    mems = [MemoryStore() for _ in range(2)]
+    full = ShardedStore(mems)
+    a = "rpo-a"
+    b = other_shard_key(full, a, "rpo")
+    owned = ShardedStore(mems, owned_shards=[full.shard_of(a)])
+    handle, _ = owned.replay_announces(-1)
+    full.create_task(a, "F", "P")
+    full.create_task(b, "F", "P")
+    _h, entries = owned.replay_announces(handle)
+    assert [p for _c, p in entries] == [a]
+
+
+def test_make_store_sharded_urls():
+    s = make_store("memory://fresh;fresh")
+    assert isinstance(s, ShardedStore) and s.shard_count == 2
+    o = make_store("memory://fresh;fresh;fresh", owned_shards=[1, 2])
+    assert o.owned_shards == [1, 2]
+    with pytest.raises(ValueError):
+        make_store("memory://", owned_shards=[0])
+    with pytest.raises(ValueError):
+        make_store("memory://fresh;fresh", owned_shards=[5])
+    with pytest.raises(ValueError):
+        make_store("resp://;")
+
+
+def test_round_trip_and_failover_accounting_sums_shards():
+    s = sharded(2)
+    assert s.n_round_trips == 0  # memory shards never pay wire trips
+    assert s.failover_generation == 0
+    assert s.shard_failover_generations() == [0, 0]
+    info = s.info()
+    assert info["role"] == "primary" and info["shards"] == "2"
+
+
+# -- per-shard failover over real RESP servers -------------------------------
+
+
+def _wait_until(cond, timeout=10.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_one_shard_failover_bumps_generation_and_rearms():
+    """Shard 0 is a primary+replica pair; killing its primary and
+    promoting the replica must (1) settle shard 0's client on the
+    promoted endpoint, (2) bump the SHARDED handle's generation, and
+    (3) let a dispatcher-style replay re-discover shard 0's announces —
+    while shard 1 never notices."""
+    from tpu_faas.store.client import RespStore
+
+    p0 = start_store_thread()
+    r0 = start_store_thread(replica_of=("127.0.0.1", p0.port))
+    s1 = start_store_thread()
+    url = (
+        f"resp://127.0.0.1:{p0.port},127.0.0.1:{r0.port}"
+        f";127.0.0.1:{s1.port}"
+    )
+    store = make_store(url)
+    rc = RespStore(port=r0.port)
+    try:
+        assert store.shard_count == 2
+        assert _wait_until(
+            lambda: rc.info().get("repl_link_up") == "1"
+        ), "replica never synced"
+        handle, _ = store.replay_announces(-1)
+        # a task whose id lands on shard 0 (the HA pair)
+        tid = "fo-0"
+        for i in range(10_000):
+            if store.shard_of(f"fo-{i}") == 0:
+                tid = f"fo-{i}"
+                break
+        store.create_task(tid, "F", "P")
+        assert _wait_until(
+            lambda: rc.hget(tid, FIELD_STATUS) == "QUEUED"
+        ), "create never replicated"
+        gen0 = store.failover_generation
+        p0.stop()
+        rc.promote()
+        # next command through shard 0 walks its ring and settles on the
+        # promoted replica
+        assert _wait_until(
+            lambda: _safe_status(store, tid) == "QUEUED", timeout=20
+        ), "shard 0 never failed over to the promoted replica"
+        assert store.failover_generation == gen0 + 1
+        assert store.shard_failover_generations()[1] == 0
+        # dispatcher-style re-arm replay: the promoted replica's ring
+        # still carries the announce
+        _h, entries = store.replay_announces(handle)
+        assert (TASKS_CHANNEL, tid) in entries
+    finally:
+        rc.close()
+        store.close()
+        for h in (r0, s1, p0):
+            h.stop()
+
+
+def _safe_status(store, tid):
+    try:
+        return store.get_status(tid)
+    except (ConnectionError, OSError):
+        return None
+
+
+# -- gateway + per-shard dispatchers end to end ------------------------------
+
+
+def test_gateway_over_sharded_store_end_to_end():
+    """2 memory shards, one LocalDispatcher owning each, one stateless
+    gateway over the full ring: every submit completes, /result //status
+    route by shard, and the gateway's shard topology is visible."""
+    import requests
+
+    from tpu_faas.client.sdk import FaaSClient
+    from tpu_faas.dispatch.local import LocalDispatcher
+    from tpu_faas.gateway.app import start_gateway_thread
+
+    mems = [MemoryStore() for _ in range(2)]
+    gw_store = ShardedStore(mems)
+    gw = start_gateway_thread(gw_store)
+    disps = [
+        LocalDispatcher(
+            num_workers=2, store=ShardedStore(mems, owned_shards=[i])
+        )
+        for i in range(2)
+    ]
+    threads = [
+        threading.Thread(target=d.start, daemon=True) for d in disps
+    ]
+    for t in threads:
+        t.start()
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(len)
+        handles = [client.submit(fid, [0] * n) for n in range(12)]
+        assert [h.result(timeout=60) for h in handles] == list(range(12))
+        # the keyspace genuinely spread over both shards
+        by_shard = Counter(gw_store.shard_of(h.task_id) for h in handles)
+        assert set(by_shard) == {0, 1}, by_shard
+        # every task's terminal record landed on ITS ring shard — and
+        # since dispatcher i is the only consumer of shard i's bus, each
+        # shard's completions were served by its owning dispatcher
+        for i, mem in enumerate(mems):
+            done = [
+                h.task_id
+                for h in handles
+                if mem.hget(h.task_id, FIELD_STATUS) == "COMPLETED"
+            ]
+            assert len(done) == by_shard[i], (i, done, by_shard)
+            assert all(gw_store.shard_of(t) == i for t in done)
+        stats = requests.get(f"{gw.url}/stats", timeout=10).json()
+        assert stats["store_shards"] == 2
+        # the shard-routing counter saw the /result traffic
+        metrics = requests.get(f"{gw.url}/metrics", timeout=10).text
+        assert "tpu_faas_gateway_shard_routed_total" in metrics
+        assert 'shard="0"' in metrics and 'shard="1"' in metrics
+    finally:
+        for d in disps:
+            d.stop()
+        for t in threads:
+            t.join(timeout=10)
+        gw.stop()

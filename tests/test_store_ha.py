@@ -620,3 +620,93 @@ def test_primary_kill_mid_burst_zero_loss():
         if primary.poll() is None:
             primary.kill()
             primary.wait()
+
+
+# -- store server /healthz //readyz probe pair --------------------------------
+
+
+def test_store_health_probes_track_role():
+    """Probe parity with the gateway/dispatcher stats servers: /healthz
+    is unconditional liveness; /readyz 503s while the server cannot take
+    writes (unpromoted replica) and flips 200 the moment PROMOTE lands —
+    fleet orchestration routes shards on /readyz and restarts on
+    /healthz, like every other process."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    primary = start_store_thread(health_port=0)
+    replica = start_store_thread(
+        replica_of=("127.0.0.1", primary.port), health_port=0
+    )
+    rc = RespStore(port=replica.port)
+    try:
+        php = primary.server.health_port
+        rhp = replica.server.health_port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{php}/healthz", timeout=5
+        ) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{php}/readyz", timeout=5
+        ) as r:
+            body = json.load(r)
+            assert r.status == 200 and body == {"ready": True, "reason": "ok"}
+        # replica: alive, NOT ready
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{rhp}/healthz", timeout=5
+        ) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{rhp}/readyz", timeout=5
+            )
+        assert exc.value.code == 503
+        assert json.load(exc.value)["reason"] == "replica"
+        # unknown path: 404, not a crash
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{rhp}/nope", timeout=5
+            )
+        assert exc.value.code == 404
+        rc.promote()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{rhp}/readyz", timeout=5
+        ) as r:
+            assert r.status == 200
+    finally:
+        rc.close()
+        replica.stop()
+        primary.stop()
+
+
+def test_store_health_probe_fenced_not_ready():
+    """A fenced stale primary keeps answering /healthz but 503s /readyz
+    with the fenced reason — exactly the state where orchestration must
+    stop routing writes to it without killing the evidence."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    handle = start_store_thread(health_port=0)
+    client = RespStore(port=handle.port)
+    try:
+        # an HA-aware peer declares a higher epoch: the server fences
+        client._command("FENCE", 7)
+    except resp.RespError:
+        pass
+    try:
+        hp = handle.server.health_port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{hp}/healthz", timeout=5
+        ) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{hp}/readyz", timeout=5
+            )
+        assert exc.value.code == 503
+        assert json.load(exc.value)["reason"] == "fenced"
+    finally:
+        client.close()
+        handle.stop()

@@ -22,7 +22,8 @@ def main(argv: list[str] | None = None) -> None:
         help="benchmark config: 1-5 (BASELINE), 6 (batch register), "
         "7 (bid kernel), 8 (estimation), 9 (host dispatch throughput), "
         "10 (overload admission), 11 (payload plane), "
-        "12 (latency closed-loop), or 'all'",
+        "12 (latency closed-loop), 13 (task graphs), "
+        "14 (fleet throughput: sharded control plane), or 'all'",
     )
     ap.add_argument(
         "-m", "--mode", default="push",

@@ -22,6 +22,11 @@ configs[]) plus one framework-extra:
    full stack with distributed tracing on — p50/p95/p99 submit→result
    plus the per-stage p99 breakdown from assembled cross-process traces
    (which stage owns the latency floor)
+14. (extra) fleet throughput: the federated control plane — N store-shard
+   subprocesses x N dispatcher subprocesses behind a stateless gateway
+   tier vs the 1x1x1 single stack on the same box, publishing tasks/s per
+   topology + the scaling ratio, plus a one-shard-primary-SIGKILL chaos
+   leg under the race monitor (zero admitted-task loss)
 
 Configs 1-2, 6, 9-12 run the real socket stack; 3-5 run the device kernels
 at scales the socket stack can't reach on one box (the reference had no
@@ -1514,6 +1519,437 @@ def config_13_graph_pipeline() -> dict:
         handle.stop()
 
 
+def _free_port() -> int:
+    import socket as _socket
+
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_store_server(port: int):
+    """A store shard as a real subprocess (SIGKILL-able, own core)."""
+    import socket as _socket
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.Popen(
+        [
+            _sys.executable, "-m", "tpu_faas.store.server",
+            "--host", "127.0.0.1", "--port", str(port),
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            with _socket.create_connection(("127.0.0.1", port), timeout=0.5):
+                return proc
+        except OSError:
+            if proc.poll() is not None:
+                raise RuntimeError("store shard subprocess died at launch")
+            time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("store shard subprocess never bound")
+
+
+def _http_json(url: str, timeout: float = 10.0):
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return _json.loads(r.read().decode("utf-8"))
+
+
+def config_14_fleet() -> dict:
+    """Federated control plane (config 14): N store shards x N tpu-push
+    dispatchers behind a stateless gateway tier vs the single 1x1x1 stack
+    on the same box — ROADMAP item 1's scaling claim, measured.
+
+    Every store shard and every dispatcher is a REAL subprocess (threads
+    would let the GIL serialize exactly the serve loops being compared);
+    the gateway tier runs over the full ShardedStore ring and is scraped
+    mid-run. Dispatch throughput is isolated config-9 style: mirror
+    workers registered on each child's ROUTER (no result path — the task
+    feed IS the bottleneck probe), fed by pipelined batch creates through
+    the sharded client, i.e. the gateway's own write path minus HTTP
+    framing. Each leg reports tasks/s, the per-shard dispatch split, and
+    a strict-grammar /metrics verdict for every process (gateway +
+    dispatchers); the headline is ``scaling_ratio`` = fleet tasks/s over
+    control tasks/s. ``host_cores`` rides along: process-level scaling
+    cannot exceed the cores actually present, so a 2-core CI box bounds
+    the ratio long before the architecture does.
+
+    A chaos leg always runs at a small fixed shape: 2 shards where shard
+    0 is a primary+replica pair, real subprocess workers, race monitor on
+    every store client — shard 0's primary is SIGKILLed mid-burst, its
+    replica promoted, and the leg asserts zero admitted-task loss and
+    zero monitor errors (per-shard failover composing with the PR-6 HA
+    plane). TPU_FAAS_BENCH_FLEET_CHAOS=0 skips it.
+
+    Shape via TPU_FAAS_BENCH_FLEET_SHAPE="tasks,workers,procs,shards".
+    ``workers`` is the mirror fleet EACH dispatcher child registers
+    (workers*procs must cover tasks: mirror workers never free a slot,
+    and a shard can draw several % over tasks/shards from the ring);
+    the CI smoke lane runs "2000,256,8,2".
+    """
+    import os
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+    import urllib.request
+
+    from tpu_faas.obs.expofmt import parse_exposition, require_series
+    from tpu_faas.store.launch import make_store
+
+    shape = os.environ.get("TPU_FAAS_BENCH_FLEET_SHAPE", "20000,4096,8,4")
+    n_tasks, n_workers, n_procs, n_shards = (
+        int(x) for x in shape.split(",")
+    )
+
+    def run_leg(leg_shards: int) -> dict:
+        from tpu_faas.gateway.app import start_gateway_thread
+
+        stores = []
+        ports = []
+        children: list[subprocess.Popen] = []
+        gw = None
+        feeder = None
+        try:
+            for _ in range(leg_shards):
+                port = _free_port()
+                stores.append(_spawn_store_server(port))
+                ports.append(port)
+            hostports = [f"127.0.0.1:{p}" for p in ports]
+            url = "resp://" + (
+                ";".join(hostports) if leg_shards > 1 else hostports[0]
+            )
+            gw = start_gateway_thread(make_store(url))
+            # EVERY child registers the full mirror fleet: per-shard
+            # splits would have to cover the ring's worst-case imbalance
+            # (a shard can draw several % over tasks/N, and mirror
+            # workers never free a slot — an undersized shard stalls the
+            # leg at its slot cap), and identical pads mean one XLA
+            # compile shared by both legs' children via the persistent
+            # cache. Capacity is not speed: the serve loops being
+            # compared are unchanged.
+            per_workers = n_workers
+            stats_ports = []
+            for i in range(leg_shards):
+                sp = _free_port()
+                stats_ports.append(sp)
+                children.append(
+                    subprocess.Popen(
+                        [
+                            _sys.executable, "-m",
+                            "tpu_faas.bench.fleet_child",
+                            "--store", url,
+                            "--shard", str(i if leg_shards > 1 else -1),
+                            "--workers", str(per_workers),
+                            "--procs", str(n_procs),
+                            "--stats-port", str(sp),
+                            "--max-pending",
+                            str(min(8192, max(64, n_tasks))),
+                            "--max-inflight",
+                            str(max(2 * n_tasks, 1024)),
+                        ],
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL,
+                    )
+                )
+
+            def child_stats(i: int) -> dict | None:
+                try:
+                    return _http_json(
+                        f"http://127.0.0.1:{stats_ports[i]}/stats",
+                        timeout=5,
+                    )
+                except Exception:
+                    return None
+
+            # readiness: every child registered its mirror fleet and
+            # compiled its device step (excluded from the timed window;
+            # the XLA cold compile can take minutes on a loaded box)
+            deadline = time.monotonic() + 900
+            ready = [False] * leg_shards
+            while not all(ready) and time.monotonic() < deadline:
+                for i in range(leg_shards):
+                    if ready[i]:
+                        continue
+                    if children[i].poll() is not None:
+                        raise RuntimeError(
+                            f"fleet child {i} died before readiness"
+                        )
+                    got = child_stats(i)
+                    if (
+                        got is not None
+                        and got.get("workers_registered", 0) >= per_workers
+                    ):
+                        ready[i] = True
+                if not all(ready):
+                    time.sleep(0.25)
+            if not all(ready):
+                raise RuntimeError(f"fleet children never ready: {ready}")
+
+            feeder = make_store(url)
+            scrape_ok = True
+            scrape_missing: list[str] = []
+            scrape_error = ""
+            scraped = False
+            t0 = time.perf_counter()
+            chunk = 2_000
+            for lo in range(0, n_tasks, chunk):
+                feeder.create_tasks(
+                    [
+                        (f"fleet-t{i}", "F", "P")
+                        for i in range(lo, min(lo + chunk, n_tasks))
+                    ]
+                )
+            dispatched_per_child = [0] * leg_shards
+            deadline = time.perf_counter() + 600
+            last_progress = (0, time.perf_counter())
+            while (
+                sum(dispatched_per_child) < n_tasks
+                and time.perf_counter() < deadline
+            ):
+                for i in range(leg_shards):
+                    got = child_stats(i)
+                    if got is not None:
+                        dispatched_per_child[i] = got.get(
+                            "n_dispatched", dispatched_per_child[i]
+                        )
+                total = sum(dispatched_per_child)
+                if total > last_progress[0]:
+                    last_progress = (total, time.perf_counter())
+                elif time.perf_counter() - last_progress[1] > 60:
+                    # stalled (dead child, exhausted capacity): stop the
+                    # clock instead of billing the wait to tasks/s
+                    break
+                if not scraped and sum(dispatched_per_child) >= n_tasks // 2:
+                    # mid-run scrape of EVERY process against the strict
+                    # exposition grammar: gateway + each dispatcher child
+                    scraped = True
+                    targets = [
+                        (f"{gw.url}/metrics", ["tpu_faas_gateway_requests_total"]),
+                    ] + [
+                        (
+                            f"http://127.0.0.1:{sp}/metrics",
+                            [
+                                "tpu_faas_dispatcher_tasks_dispatched_total",
+                                "tpu_faas_store_round_trips_total",
+                            ],
+                        )
+                        for sp in stats_ports
+                    ]
+                    for target, required in targets:
+                        try:
+                            with urllib.request.urlopen(
+                                target, timeout=10
+                            ) as r:
+                                families = parse_exposition(
+                                    r.read().decode("utf-8")
+                                )
+                            missing = require_series(families, required)
+                            scrape_missing.extend(missing)
+                            scrape_ok = scrape_ok and not missing
+                        except Exception as exc:
+                            scrape_ok = False
+                            scrape_error = f"{type(exc).__name__}: {exc}"
+                time.sleep(0.05)
+            dispatched = sum(dispatched_per_child)
+            # the clock stops at the LAST OBSERVED PROGRESS: a stall
+            # break (or the final poll sleep) must not dilute tasks/s
+            elapsed = (
+                last_progress[1] - t0 if dispatched else
+                time.perf_counter() - t0
+            )
+            return {
+                "shards": leg_shards,
+                "dispatched": dispatched,
+                "tasks_per_s": round(dispatched / max(elapsed, 1e-9), 1),
+                "elapsed_s": round(elapsed, 2),
+                "dispatched_per_shard": dispatched_per_child,
+                "store_round_trips_feeder": feeder.n_round_trips,
+                "metrics_scrape_ok": bool(scrape_ok and scraped),
+                "metrics_missing": scrape_missing,
+                "metrics_scrape_error": scrape_error,
+            }
+        finally:
+            for child in children:
+                if child.poll() is None:
+                    child.send_signal(_signal.SIGTERM)
+            for child in children:
+                try:
+                    child.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+                    child.wait()
+            if feeder is not None:
+                feeder.close()
+            if gw is not None:
+                gw.stop()
+            for proc in stores:
+                if proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+
+    control = run_leg(1)
+    fleet = run_leg(n_shards)
+    ratio = (
+        fleet["tasks_per_s"] / control["tasks_per_s"]
+        if control["tasks_per_s"] > 0
+        else 0.0
+    )
+    out = {
+        "config": "fleet-throughput",
+        "shape": {
+            "tasks": n_tasks,
+            "workers": n_workers,
+            "procs": n_procs,
+            "shards": n_shards,
+        },
+        # the physical bound on process-level scaling for THIS record: a
+        # ratio near min(shards, cores) is the box saturating, not the
+        # architecture
+        "host_cores": os.cpu_count(),
+        "control": control,
+        "fleet": fleet,
+        "scaling_ratio": round(ratio, 2),
+    }
+    if os.environ.get("TPU_FAAS_BENCH_FLEET_CHAOS", "1") != "0":
+        out["chaos"] = _fleet_chaos_leg()
+    return out
+
+
+def _fleet_chaos_leg() -> dict:
+    """One-shard-primary-SIGKILL under the race monitor: 2 shards (shard
+    0 = subprocess primary + in-thread replica), a gateway over the full
+    ring, one tpu-push dispatcher owning each shard, real subprocess
+    workers. Shard 0's primary dies mid-burst, its replica is promoted,
+    and every admitted task must still COMPLETE with zero monitor errors
+    — per-shard failover composing with the PR-6 HA plane."""
+    import signal as _signal
+    import threading as _threading
+
+    from tpu_faas.bench.harness import _spawn_worker
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.task import TaskStatus
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.client import RespStore
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+    from tpu_faas.workloads import sleep_task
+
+    task_s = 0.05
+    n_submits = 60
+    kill_at = n_submits // 2
+
+    p0_port = _free_port()
+    p0 = _spawn_store_server(p0_port)
+    r0 = start_store_thread(replica_of=("127.0.0.1", p0_port))
+    s1 = start_store_thread()
+    url = (
+        f"resp://127.0.0.1:{p0_port},127.0.0.1:{r0.port}"
+        f";127.0.0.1:{s1.port}"
+    )
+    monitor = RaceMonitor()
+    gw = start_gateway_thread(
+        RaceCheckStore(make_store(url), monitor, actor="gateway")
+    )
+    disps = [
+        TpuPushDispatcher(
+            ip="127.0.0.1",
+            port=0,
+            store=RaceCheckStore(
+                make_store(url, owned_shards=[i]),
+                monitor,
+                actor=f"dispatcher-{i}",
+            ),
+            max_workers=64,
+            max_pending=256,
+            max_inflight=512,
+            tick_period=0.01,
+            time_to_expire=2.0,
+            rescan_period=0.5,
+        )
+        for i in range(2)
+    ]
+    threads = [
+        _threading.Thread(target=d.start, daemon=True) for d in disps
+    ]
+    for t in threads:
+        t.start()
+    workers = [
+        _spawn_worker(
+            "push_worker", 2, f"tcp://127.0.0.1:{d.port}",
+            "--hb", "--hb-period", "0.3",
+        )
+        for d in disps
+    ]
+    client = FaaSClient(gw.url)
+    rc = RespStore(port=r0.port)
+    admitted: list = []
+    submit_errors: list[str] = []
+    try:
+        deadline = time.monotonic() + 30
+        while rc.info().get("repl_link_up") != "1":
+            if time.monotonic() > deadline:
+                raise RuntimeError("shard-0 replica never synced")
+            time.sleep(0.1)
+        fid = client.register(sleep_task)
+        for i in range(n_submits):
+            if i == kill_at:
+                # -- the event: shard 0's primary dies hard --------------
+                p0.send_signal(_signal.SIGKILL)
+                p0.wait()
+                rc.promote()  # the operator runbook's failover action
+            try:
+                admitted.append(client.submit(fid, task_s))
+            except Exception as exc:  # rejected after SDK retries: not
+                submit_errors.append(f"{type(exc).__name__}: {exc}")
+                # admitted, so not part of the zero-loss population
+        results = [h.result(timeout=180.0) for h in admitted]
+        completed = sum(1 for r in results if r == task_s)
+        # settle: let in-flight timelines close before judging the trace
+        deadline = time.monotonic() + 30
+        while monitor.unfinished() and time.monotonic() < deadline:
+            time.sleep(0.25)
+        return {
+            "submits": n_submits,
+            "admitted": len(admitted),
+            "completed": completed,
+            "lost": len(admitted) - completed,
+            "submit_errors": len(submit_errors),
+            "shard0_failover_rearms": disps[0].n_failover_rearms,
+            "monitor_errors": [str(v) for v in monitor.errors],
+            "monitor_warnings": len(monitor.warnings),
+            "zero_loss": completed == len(admitted)
+            and not monitor.errors,
+        }
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        for d in disps:
+            d.stop()
+        for t in threads:
+            t.join(timeout=10)
+        gw.stop()
+        rc.close()
+        for h in (r0, s1):
+            h.stop()
+        if p0.poll() is None:
+            p0.kill()
+            p0.wait()
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -1528,4 +1964,5 @@ CONFIGS = {
     "11": config_11_payload_plane,
     "12": config_12_latency,
     "13": config_13_graph_pipeline,
+    "14": config_14_fleet,
 }

@@ -1,0 +1,111 @@
+"""One dispatcher of the fleet-throughput bench (config 14), as a real OS
+process.
+
+The federated control plane's scaling claim is about PROCESSES — N
+dispatcher serve loops on N cores against N store shards — so the bench
+cannot run its dispatchers as threads of the parent (the GIL would
+serialize exactly the work being measured). This child builds a tpu-push
+dispatcher over the (possibly sharded) store URL, registers config-9-style
+mirror workers directly on its ROUTER (dispatch sends to never-connected
+peers are dropped by ZMQ, isolating HOST dispatch cost: announce drain,
+pipelined record fetch, device step, send loop, coalesced RUNNING flush),
+compiles the device step outside the measured window, serves /stats +
+/metrics, and runs the ordinary serve loop until SIGTERM.
+
+The parent polls each child's ``/stats`` for ``workers_registered``
+(readiness) and ``n_dispatched`` (progress), and scrapes ``/metrics``
+against the strict exposition grammar mid-run.
+
+Run: ``python -m tpu_faas.bench.fleet_child --store "resp://h0:p0;h1:p1"
+--shard 0 --workers 1024 --procs 8 --stats-port 9100`` (the shard COUNT
+comes from the sharded store URL; ``--shard`` picks the owned slice).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="fleet-throughput bench dispatcher child"
+    )
+    ap.add_argument("--store", required=True)
+    ap.add_argument(
+        "--shard", type=int, default=-1,
+        help="shard index this dispatcher OWNS (-1 = own everything: the "
+        "single-stack control leg, or an unsharded store url)",
+    )
+    ap.add_argument("--workers", type=int, required=True,
+                    help="mirror workers to register")
+    ap.add_argument("--procs", type=int, default=8,
+                    help="process slots per mirror worker")
+    ap.add_argument("--stats-port", type=int, required=True)
+    ap.add_argument("--max-pending", type=int, default=8192)
+    ap.add_argument("--max-inflight", type=int, default=65536)
+    ap.add_argument(
+        "--tte", type=float, default=3600.0,
+        help="mirror workers never heartbeat: keep them alive for the "
+        "whole run",
+    )
+    ns = ap.parse_args(argv)
+
+    # persistent XLA compile cache + platform pin, same as the dispatcher
+    # CLI: a cold-compiling child would bill tens of seconds of XLA time
+    # to the readiness wait of every leg
+    cache_dir = os.environ.get(
+        "TPU_FAAS_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "tpu_faas_xla"),
+    )
+    if cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.store.launch import make_store
+    from tpu_faas.worker import messages as m
+
+    store = make_store(
+        ns.store, owned_shards=[ns.shard] if ns.shard >= 0 else None
+    )
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=store,
+        max_workers=ns.workers,
+        max_pending=ns.max_pending,
+        max_inflight=ns.max_inflight,
+        max_slots=ns.procs,
+        time_to_expire=ns.tte,
+        recover_queued=False,  # the parent feeds AFTER readiness: no
+        # announce can be lost, and rescans must not perturb the window
+    )
+    prefix = f"mirror-{max(ns.shard, 0)}"
+    for i in range(ns.workers):
+        disp._handle(
+            f"{prefix}-w{i}".encode(), m.REGISTER,
+            {"num_processes": ns.procs},
+        )
+    disp.tick()  # compile the device step before the parent starts timing
+    disp.serve_stats(ns.stats_port)
+
+    def _stop(signum, frame):  # noqa: ARG001 (signal handler shape)
+        disp.stop()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    print("READY", flush=True)
+    try:
+        disp.start()
+    finally:
+        disp.socket.close(linger=0)
+        disp.close()
+
+
+if __name__ == "__main__":
+    main()

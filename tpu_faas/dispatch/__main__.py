@@ -171,6 +171,14 @@ def main(argv: list[str] | None = None) -> None:
         "by the rescan",
     )
     ap.add_argument(
+        "--shards", default=None, metavar="I,J,...",
+        help="sharded store (';'-separated --store url): OWN only these "
+        "shard indices — the announce subscription, stranded-task rescans, "
+        "and announce replay scope to them, while every shard stays "
+        "reachable for writes (cross-shard graph edges, fleet hashes). "
+        "Default with a sharded url: own every shard",
+    )
+    ap.add_argument(
         "--shared", action="store_true",
         help="several dispatchers share this store+channel: each claims "
         "tasks atomically before dispatching (exactly one runs each "
@@ -182,11 +190,26 @@ def main(argv: list[str] | None = None) -> None:
     if ns.delay:
         time.sleep(ns.delay)
 
+    # shard-slice ownership, resolved ONCE for every mode: build the
+    # store handle here so the ShardedStore scopes its consumption
+    # surface before any dispatcher constructor subscribes/rescans
+    owned_store = None
+    if ns.shards is not None:
+        from tpu_faas.store.launch import make_store
+
+        owned_store = make_store(
+            ns.store,
+            owned_shards=[int(x) for x in ns.shards.split(",") if x != ""],
+        )
+
     if ns.mode == "local":
         from tpu_faas.dispatch.local import LocalDispatcher
 
         d = LocalDispatcher(
-            num_workers=ns.num_workers, store_url=ns.store, shared=ns.shared
+            num_workers=ns.num_workers,
+            store_url=ns.store,
+            store=owned_store,
+            shared=ns.shared,
         )
         log.info("local dispatcher: pool=%d store=%s", ns.num_workers, ns.store)
         if ns.stats_port:
@@ -308,6 +331,8 @@ def main(argv: list[str] | None = None) -> None:
         max_task_retries=ns.max_task_retries,
         shared=ns.shared,
     )
+    if owned_store is not None:
+        kwargs["store"] = owned_store
     if ns.mode == "push":
         kwargs.update(heartbeat=ns.hb, process_lb=ns.plb)
     elif ns.mode == "tpu-push":

@@ -1652,6 +1652,26 @@ class TaskDispatcher:
                     self.deferred_dep_completions
                 ),
             },
+            **self._sharding_stats(),
+        }
+
+    def _sharding_stats(self) -> dict:
+        """Sharded-control-plane stats block ({} on single-store stacks):
+        shard count, this dispatcher's owned slice (None = all), and the
+        per-shard failover generations — which shard promoted is the
+        first question of the shard-kill runbook."""
+        shards = getattr(self.store, "shard_count", 0)
+        if not shards or shards < 2:
+            return {}
+        gens_fn = getattr(self.store, "shard_failover_generations", None)
+        return {
+            "sharding": {
+                "shards": shards,
+                "owned": getattr(self.store, "owned_shards", None),
+                "failover_generations": (
+                    gens_fn() if gens_fn is not None else None
+                ),
+            }
         }
 
     def collect_metrics(self) -> None:

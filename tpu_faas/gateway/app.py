@@ -450,6 +450,14 @@ class GatewayContext:
         )
         for phase in ("submit_to_finish", "submit_to_observe"):
             self.m_e2e.labels(phase=phase, terminal="COMPLETED")
+        self.m_shard_routed = self.metrics.counter(
+            "tpu_faas_gateway_shard_routed_total",
+            "Task-keyed reads (/status, /result, /trace) routed to a "
+            "store shard by the consistent-hash ring, by shard — the "
+            "stateless-gateway routing plane's traffic attribution. No "
+            "children on single-store stacks",
+            ("shard",),
+        )
         #: bounded first-delivery dedup for the e2e histogram (repeat
         #: polls of a terminal record must not re-observe)
         self._observed: dict[str, bool] = {}
@@ -544,6 +552,16 @@ class GatewayContext:
             self.m_saturation.set(self.admission.last_load)
         if self.breaker is not None:
             self.m_breaker_open.set(1.0 if self.breaker.is_open else 0.0)
+
+    def note_shard_route(self, task_id: str) -> None:
+        """Count a task-keyed read against the shard the ring routes it
+        to. No-op (and no series) on single-store stacks; the ring lookup
+        is pure local hashing — no store round trip rides a request."""
+        if getattr(self.store, "shard_count", 0) < 2:
+            return
+        shard_of = getattr(self.store, "shard_of", None)
+        if shard_of is not None:
+            self.m_shard_routed.labels(shard=str(shard_of(task_id))).inc()
 
     def _live_in_system(self) -> int:
         """The store's live-task index count: every create writes
@@ -834,6 +852,30 @@ def _sweep_expired_results(
                 continue
             if now_f - finished_at > ttl:
                 expired.append(key)
+    if expired:
+        # a terminal GRAPH PARENT must outlive the TTL while any of its
+        # children still sits WAITING: resolve_waiting treats a missing
+        # parent record as poison-worthy ("reached MISSING"), so deleting
+        # a COMPLETED parent whose dep walk is still pending (deferred
+        # through an outage, resolver crashed) would later fail a child
+        # whose parents all succeeded. Children statuses are already in
+        # hand from this sweep's own probe — one extra pipelined
+        # FIELD_CHILDREN round over the aged slice, no per-key traffic.
+        # A child absent from the probe is long-deleted (children are
+        # created with their parents), not waiting — those parents expire.
+        kids_lists = store.hget_many(expired, FIELD_CHILDREN)
+        status_by_key = dict(zip(keys, statuses))
+        waiting = str(TaskStatus.WAITING)
+        expired = [
+            key
+            for key, kids in zip(expired, kids_lists)
+            if not kids
+            or not any(
+                status_by_key.get(child) == waiting
+                for child in kids.split(",")
+                if child
+            )
+        ]
     if statusless:
         # claim-only hashes: an idempotency claim whose winner died between
         # claim and create, never adopted by a retry. The claim value's
@@ -1774,15 +1816,15 @@ async def execute_graph(request: web.Request) -> web.Response:
     ctx.m_admitted.inc(len(nodes))
     distinct = list(dict.fromkeys(fids))
     fn_keys = [_FUNCTION_PREFIX + f for f in distinct]
-    payloads = await ctx.store_call(ctx.store.hget_many, fn_keys, "payload")
-    digests = await ctx.store_call(
-        ctx.store.hget_many, fn_keys, _FN_DIGEST_FIELD
-    )
+    # payload + digest in ONE pipelined round, like the single/batch
+    # submit endpoints' hmget — not a sequential round trip per field
+    records = await ctx.store_call(ctx.store.hgetall_many, fn_keys)
     fn_map: dict[str, tuple[str, str | None]] = {}
-    for fid, fn_payload, dig in zip(distinct, payloads, digests):
+    for fid, rec in zip(distinct, records):
+        fn_payload = rec.get("payload")
         if fn_payload is None:
             return _json_error(404, f"unknown function_id {fid!r}")
-        fn_map[fid] = (fn_payload, dig)
+        fn_map[fid] = (fn_payload, rec.get(_FN_DIGEST_FIELD))
     task_ids = [new_task_id() for _ in nodes]
     children: list[list[int]] = [[] for _ in nodes]
     for i, parents in enumerate(deps):
@@ -1854,6 +1896,7 @@ async def execute_graph(request: web.Request) -> web.Response:
 async def get_status(request: web.Request) -> web.Response:
     ctx: GatewayContext = request.app[CTX_KEY]
     task_id = request.match_info["task_id"]
+    ctx.note_shard_route(task_id)
     status = await ctx.store_call(ctx.store.get_status, task_id)
     if status is None:
         return _json_error(404, f"unknown task_id {task_id!r}")
@@ -1887,6 +1930,7 @@ async def get_result(request: web.Request) -> web.Response:
     if not (0.0 <= wait_s):  # rejects NaN too (any NaN compare is False)
         return _json_error(400, "'wait' must be a non-negative number")
     wait_s = min(wait_s, _MAX_WAIT_S)
+    ctx.note_shard_route(task_id)
     loop = asyncio.get_running_loop()
     deadline = loop.time() + wait_s
     poll_s = _WAIT_POLL_S
@@ -2141,6 +2185,7 @@ async def trace_task(request: web.Request) -> web.Response:
     off, legacy producers) resolve with zero spans rather than 404ing."""
     ctx: GatewayContext = request.app[CTX_KEY]
     task_id = request.match_info["task_id"]
+    ctx.note_shard_route(task_id)
     timeline = await ctx.store_call(assemble_timeline, ctx.store, task_id)
     if timeline is None:
         return _json_error(404, f"unknown task_id {task_id!r}")
@@ -2181,6 +2226,10 @@ async def stats(request: web.Request) -> web.Response:
             # promotion runbook's "is the fleet pointed at the primary?"
             # probe
             "store_role": store_role,
+            # sharded control plane: shard count (0 = single store) —
+            # every gateway is stateless over the ring, so any of them
+            # reports the same topology
+            "store_shards": getattr(ctx.store, "shard_count", 0) or 0,
             "functions_registered": ctx.n_functions,
             "tasks_submitted": ctx.n_tasks,
             # overload surfaces: admission controller + store breaker

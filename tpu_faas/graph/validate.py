@@ -94,13 +94,17 @@ def validate_graph(
                 f"nodes[{i}].depends_on must be a list"
             )
         seen: list[int] = []
+        seen_set: set[int] = set()  # list keeps ref order; set keeps the
+        # membership probe O(1) — a dense in-cap graph (4096 nodes x
+        # thousands of refs) runs this inside the gateway event loop
         for ref in raw:
             parent = _resolve_ref(ref, i, names, n)
             if parent == i:
                 raise GraphValidationError(
                     f"nodes[{i}] depends on itself"
                 )
-            if parent not in seen:
+            if parent not in seen_set:
+                seen_set.add(parent)
                 seen.append(parent)
         deps.append(seen)
     # Kahn's algorithm: exhaustion == acyclic, and the pop order IS the

@@ -7,6 +7,12 @@ URL scheme used across CLIs and configs:
 - ``resp://h1:p1,h2:p2``   ordered FAILOVER endpoint list (primary first,
   replicas after): the client settles on whichever endpoint holds the
   writable primary role and follows promotions (store/replication.py)
+- ``resp://h1:p1;h2:p2``   SHARDED control plane (store/sharding.py): a
+  ``;``-separated shard list builds a ShardedStore routing the task
+  keyspace over the shards by consistent hashing. Each shard may itself
+  be a ``,``-separated failover ring (``resp://p1:1,r1:2;p2:3,r2:4`` =
+  two shards, each a primary+replica pair), so per-shard HA composes.
+  ``memory://fresh;fresh`` shards over private in-process stores (tests).
 
 `start_store_thread` runs the Python asyncio server inside a daemon thread and
 returns a handle — used by tests and by single-machine deployments that don't
@@ -30,13 +36,45 @@ _SHARED_MEMORY_STORE: MemoryStore | None = None
 _SHARED_LOCK = threading.Lock()
 
 
-def make_store(url: str) -> TaskStore:
+def make_store(
+    url: str, owned_shards: list[int] | None = None
+) -> TaskStore:
     """Create a TaskStore from a URL.
 
     ``memory://`` returns a process-wide shared MemoryStore (so a gateway and
     dispatcher running in one process see the same tasks); ``memory://fresh``
     returns a private instance.
+
+    A ``;`` in the URL selects the sharded form (see module docstring):
+    ``owned_shards`` then scopes the handle's consumption surface —
+    announce subscriptions, rescans, announce replay — to those shard
+    indices (a dispatcher owning a slice of the fleet); ``None`` consumes
+    every shard (gateways, clients).
     """
+    if ";" in url:
+        from tpu_faas.store.sharding import ShardedStore
+
+        scheme, sep, rest = url.partition("://")
+        if not sep:
+            raise ValueError(f"unknown store url scheme: {url!r}")
+        groups = [g for g in rest.split(";") if g]
+        if len(groups) < 2:
+            raise ValueError(
+                f"sharded store url needs >= 2 ';'-separated shards: {url!r}"
+            )
+        if scheme == "memory":
+            # sharding over ONE shared dict would be no sharding at all:
+            # every memory shard is a private instance
+            stores: list[TaskStore] = [
+                MemoryStore() for _ in groups
+            ]
+        else:
+            stores = [make_store(f"{scheme}://{group}") for group in groups]
+        return ShardedStore(stores, owned_shards=owned_shards)
+    if owned_shards is not None:
+        raise ValueError(
+            "owned_shards needs a sharded (';'-separated) store url"
+        )
     parsed = urlparse(url)
     if parsed.scheme == "memory":
         if parsed.netloc == "fresh" or parsed.path == "/fresh":
@@ -100,11 +138,14 @@ def start_store_thread(
     autosave_interval: float = 0.0,
     replica_of: tuple[str, int] | str | None = None,
     epoch: int = 0,
+    health_port: int | None = None,
 ) -> StoreServerHandle:
     """Start the Python store server in a daemon thread; returns once bound.
     ``replica_of`` starts it as a read-only replica tailing that primary
     (promote with ``RespStore.promote()``); ``epoch`` seeds the fencing
-    epoch for restarts of previously-promoted stores."""
+    epoch for restarts of previously-promoted stores; ``health_port``
+    serves the HTTP /healthz //readyz probe pair (0 picks a free port,
+    resolved on ``handle.server.health_port``)."""
     server = StoreServer(
         host,
         port,
@@ -112,6 +153,7 @@ def start_store_thread(
         autosave_interval=autosave_interval,
         replica_of=replica_of,
         epoch=epoch,
+        health_port=health_port,
     )
     started = threading.Event()
     loop_holder: dict[str, asyncio.AbstractEventLoop] = {}

@@ -629,6 +629,26 @@ class RaceCheckStore(TaskStore):
     def endpoints(self):
         return getattr(self.inner, "endpoints", None)
 
+    # -- sharding pass-throughs (store/sharding.py) ------------------------
+    # the ring is routing, not lifecycle: a race-checked sharded stack
+    # keeps its shard topology visible to dispatchers/gateways while every
+    # write above still flows through the observed per-item paths
+    @property
+    def shard_count(self):
+        return getattr(self.inner, "shard_count", 0)
+
+    @property
+    def owned_shards(self):
+        return getattr(self.inner, "owned_shards", None)
+
+    def shard_of(self, task_id: str) -> int:
+        fn = getattr(self.inner, "shard_of", None)
+        return fn(task_id) if fn is not None else 0
+
+    def shard_failover_generations(self):
+        fn = getattr(self.inner, "shard_failover_generations", None)
+        return fn() if fn is not None else []
+
     def rotate_endpoint(self) -> bool:
         fn = getattr(self.inner, "rotate_endpoint", None)
         return bool(fn()) if fn is not None else False

@@ -77,11 +77,24 @@ class StoreServer:
         replica_of: tuple[str, int] | str | None = None,
         epoch: int = 0,
         announce_ring: int = 0,
+        health_port: int | None = None,
     ) -> None:
         self.host = host
         self.port = port
         self.snapshot_path = snapshot_path
         self.autosave_interval = autosave_interval
+        #: HTTP liveness/readiness surface (``--health-port``): /healthz
+        #: answers 200 while the process serves; /readyz answers 503
+        #: while this store cannot take writes (loading its snapshot,
+        #: unpromoted replica, fenced stale primary) — parity with the
+        #: gateway/dispatcher stats servers, so fleet orchestration can
+        #: route and restart shards like every other process. None = off.
+        self.health_port = health_port
+        self._health_server: asyncio.AbstractServer | None = None
+        #: True until the startup snapshot load (if any) completed — the
+        #: health listener binds FIRST so orchestration sees
+        #: "alive but not ready" during a long load instead of a dead port
+        self._loading = True
         self.state = StoreState()
         self._server: asyncio.AbstractServer | None = None
         self._shutdown = asyncio.Event()
@@ -101,8 +114,23 @@ class StoreServer:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
+        if self.health_port is not None:
+            # before the snapshot load: a long load must read as
+            # alive-but-not-ready, not as a dead process
+            self._health_server = await asyncio.start_server(
+                self._handle_health, self.host, self.health_port
+            )
+            self.health_port = self._health_server.sockets[0].getsockname()[1]
         if self.snapshot_path is not None:
-            self.state.hashes = snapshot.load_file(self.snapshot_path)
+            # off-loop: a synchronous multi-GB load would block this very
+            # event loop, so the just-bound health listener could accept
+            # but never ANSWER — orchestration liveness probes would time
+            # out and kill the process mid-load, the exact crash loop the
+            # bind-before-load ordering exists to prevent
+            self.state.hashes = await asyncio.get_running_loop().run_in_executor(
+                None, snapshot.load_file, self.snapshot_path
+            )
+        self._loading = False
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port
         )
@@ -127,6 +155,8 @@ class StoreServer:
                 self._autosave_task.cancel()
             if self._link is not None:
                 self._link.stop()
+            if self._health_server is not None:
+                self._health_server.close()
             for w in list(self.state.conns):
                 w.close()
 
@@ -140,12 +170,81 @@ class StoreServer:
         if self._link is not None:
             self._link.stop()
         self._shutdown.set()
+        if self._health_server is not None:
+            self._health_server.close()
         if self._server is not None:
             self._server.close()
         for w in list(self.state.conns):
             w.close()
         if self._server is not None:
             await self._server.wait_closed()
+
+    # -- HTTP health surface (--health-port) -------------------------------
+    def readiness(self) -> tuple[bool, str]:
+        """(ready, reason) for /readyz: ready iff this store can take
+        writes RIGHT NOW. A loading snapshot, an unpromoted replica, and
+        a fenced stale primary all serve 503 — route elsewhere, don't
+        restart (liveness stays unconditional on /healthz)."""
+        if self._loading:
+            return False, "loading_snapshot"
+        if self.repl.fenced:
+            return False, "fenced"
+        if self.repl.role == "replica":
+            return False, "replica"
+        return True, "ok"
+
+    async def _handle_health(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """Minimal HTTP/1.1 for the two probe paths — deliberately not an
+        HTTP framework: the store process must not grow a dependency (or
+        a thread) for two constant-shaped replies."""
+        import json
+
+        try:
+            # bounded read: a connection that never sends a full request
+            # (port scanner, half-open LB probe) must not pin a coroutine
+            # + fd for its TCP lifetime
+            async def _read_request() -> bytes:
+                line = await reader.readline()
+                while True:  # drain headers; probes send no body
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        return line
+
+            request_line = await asyncio.wait_for(_read_request(), timeout=5.0)
+            parts = request_line.split()
+            path = parts[1].decode("ascii", "replace") if len(parts) > 1 else "/"
+            if path == "/healthz":
+                status, body = 200, b'{"ok": true}'
+            elif path == "/readyz":
+                ready, reason = self.readiness()
+                status = 200 if ready else 503
+                body = json.dumps(
+                    {"ready": ready, "reason": reason}
+                ).encode()
+            else:
+                status, body = 404, b'{"error": "not found"}'
+            reason_phrase = {200: "OK", 404: "Not Found", 503: "Service Unavailable"}[status]
+            writer.write(
+                (
+                    f"HTTP/1.1 {status} {reason_phrase}\r\n"
+                    "Content-Type: application/json\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode()
+                + body
+            )
+            await writer.drain()
+        except (
+            ConnectionError,
+            ValueError,  # readline LimitOverrun on a >64 KiB garbage line
+            asyncio.IncompleteReadError,
+            asyncio.TimeoutError,
+        ):
+            pass
+        finally:
+            writer.close()
 
     # -- checkpointing -----------------------------------------------------
     def _save_if_configured(self) -> None:
@@ -713,6 +812,14 @@ def main(argv: list[str] | None = None) -> None:
         help="override the bounded announce-replay ring size "
         "(default 10000 entries)",
     )
+    ap.add_argument(
+        "--health-port",
+        type=int,
+        default=None,
+        help="serve HTTP GET /healthz (liveness) and /readyz (503 while "
+        "loading a snapshot / unpromoted replica / fenced) on this port — "
+        "probe parity with the gateway and dispatcher stats servers",
+    )
     ns = ap.parse_args(argv)
 
     async def run() -> None:
@@ -724,6 +831,7 @@ def main(argv: list[str] | None = None) -> None:
             replica_of=ns.replica_of,
             epoch=ns.epoch,
             announce_ring=ns.announce_ring,
+            health_port=ns.health_port,
         )
         await server.start()
         # graceful kill/Ctrl-C must checkpoint, like the native server's
