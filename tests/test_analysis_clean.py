@@ -1,11 +1,17 @@
 """Tier-1 gate: the static analysis pass is clean on the shipped tree.
 
 This is the static complement of the runtime racecheck suite: every store
-write site, every jitted kernel, and every lock region in ``tpu_faas/`` is
-verified at rest. A new error-severity finding here means a change either
-broke the store-write protocol, made a jitted function trace-unsafe, or put
-a blocking call under a lock — fix it or suppress it at the site with a
-justified ``# faas: allow(<rule>)``.
+write site, every jitted kernel, every lock region, every ``async def``,
+every store-command registry, every statically-spelled store key, and
+every metric registration in ``tpu_faas/`` is verified at rest. A new
+error-severity finding here means a change broke the store-write
+protocol, made a jitted function trace-unsafe, put a blocking call under
+a lock or on an event loop, let the store-command registries drift apart,
+minted an undeclared shard-routing namespace, or broke metrics
+discipline — fix it or suppress it at the site with a justified
+``# faas: allow(<rule>)`` (a suppression that stops matching becomes a
+``core.stale-suppression`` warning, which this gate also keeps at
+zero).
 """
 
 from __future__ import annotations

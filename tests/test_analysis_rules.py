@@ -653,6 +653,8 @@ def test_inline_allow_checker_and_star_forms(tmp_path):
 
 
 def test_allow_for_wrong_rule_does_not_suppress(tmp_path):
+    """A mismatched token suppresses nothing — and is itself reported
+    stale, since it absorbed no finding."""
     findings = check(
         tmp_path,
         """\
@@ -660,7 +662,10 @@ def test_allow_for_wrong_rule_does_not_suppress(tmp_path):
             store.set_status(tid, "COMPLETED")  # faas: allow(trace.print)
         """,
     )
-    assert hits(findings) == [("protocol.terminal-set-status", 2)]
+    assert hits(findings) == [
+        ("core.stale-suppression", 2),
+        ("protocol.terminal-set-status", 2),
+    ]
 
 
 # -- baseline ----------------------------------------------------------------
@@ -940,3 +945,692 @@ def test_protocol_expire_task_call_is_clean(tmp_path):
         """,
     )
     assert hits(findings) == []
+
+
+# -- eventloop ---------------------------------------------------------------
+
+
+def test_eventloop_blocking_calls_fire_with_exact_lines(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import time
+
+        async def handler(ctx, tid):
+            record = ctx.store.hgetall(tid)
+            time.sleep(0.1)
+            data = open("/tmp/x").read()
+            return record, data
+        """,
+    )
+    assert hits(findings) == [
+        ("eventloop.blocking-store-call", 4),
+        ("eventloop.blocking-sleep", 5),
+        ("eventloop.blocking-file-io", 6),
+    ]
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_eventloop_lock_forms_fire(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        async def handler(self, tid):
+            self._lock.acquire()
+            with self._state_lock:
+                self.seen.add(tid)
+        """,
+    )
+    assert hits(findings) == [
+        ("eventloop.blocking-lock", 2),
+        ("eventloop.blocking-lock", 3),
+    ]
+
+
+def test_eventloop_sanctioned_escapes_are_clean(tmp_path):
+    """The executor forms pass the callable UNCALLED; asyncio.sleep is the
+    coroutine form; nested sync defs are values, not loop code."""
+    findings = check(
+        tmp_path,
+        """\
+        import asyncio
+        import functools
+
+        async def handler(ctx, tid, loop):
+            await loop.run_in_executor(None, ctx.store.hgetall, tid)
+            await loop.run_in_executor(
+                None, functools.partial(ctx.store.hget, tid, "status")
+            )
+            await asyncio.to_thread(ctx.store.delete, tid)
+            await asyncio.sleep(0.1)
+
+            def thunk():
+                return ctx.store.hgetall(tid)
+
+            return await loop.run_in_executor(None, thunk)
+        """,
+    )
+    assert hits(findings) == []
+
+
+def test_eventloop_reaches_same_module_sync_helpers(tmp_path):
+    """A sync helper doing the blocking on the coroutine's behalf is
+    caught through the same-module call closure — free functions and
+    same-class methods both."""
+    findings = check(
+        tmp_path,
+        """\
+        import time
+
+        def helper(store, tid):
+            return store.hgetall(tid)
+
+        class Server:
+            def _checkpoint(self):
+                time.sleep(1.0)
+
+            async def serve(self, store, tid):
+                self._checkpoint()
+                return helper(store, tid)
+        """,
+    )
+    assert hits(findings) == [
+        ("eventloop.blocking-store-call", 4),
+        ("eventloop.blocking-sleep", 8),
+    ]
+    assert "reachable from async def serve" in findings[0].message
+
+
+def test_eventloop_quadratic_scan_fires_and_set_is_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        async def validate(nodes):
+            refs = []
+            seen = set()
+            for node in nodes:
+                if node in refs:
+                    continue
+                refs.append(node)
+                if node in seen:
+                    continue
+                seen.add(node)
+            return refs
+        """,
+    )
+    assert hits(findings) == [("eventloop.quadratic-scan", 5)]
+
+
+def test_eventloop_sync_code_is_out_of_scope(tmp_path):
+    """The dispatcher serve loops are threads, not coroutines — the same
+    calls outside async reach are the locks/obs checkers' business."""
+    findings = check(
+        tmp_path,
+        """\
+        import time
+
+        def serve_loop(store, tid):
+            time.sleep(0.1)
+            return store.hgetall(tid)
+        """,
+    )
+    assert hits(findings) == []
+
+
+def test_eventloop_suppressible_with_justification(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import snapshot
+
+        class Server:
+            async def stop(self):
+                # blocking on the loop IS the consistency cut (Redis SAVE)
+                snapshot.save_file("/tmp/s", {})  # faas: allow(eventloop.blocking-file-io)
+        """,
+    )
+    assert hits(findings) == []
+
+
+# -- replication (registry drift) --------------------------------------------
+
+
+_TOY_SERVER = """\
+class StoreServer:
+    async def _dispatch(self, cmd, writer):
+        name = cmd[0].upper()
+        if name == "PING":
+            writer.write(b"+PONG")
+        elif name == "HSET":
+            self.apply(cmd)
+            self._replicate(cmd)
+        elif name == "HFOO":
+            self.apply(cmd)
+            self._replicate(cmd)
+
+    def apply_replicated(self, cmd):
+        name = cmd[0].upper()
+        if name == "HSET":
+            self.apply(cmd)
+        elif name == "HFOO":
+            self.apply(cmd)
+"""
+
+
+def test_registry_drift_fires_when_forward_set_lags(tmp_path):
+    """THE regression shape: a toy server grows a mutating command (its
+    dispatch branch replicates) that the toy replication forward list
+    never learned — the drift must fire at the forward set."""
+    (tmp_path / "toy_server.py").write_text(_TOY_SERVER)
+    (tmp_path / "toy_replication.py").write_text(
+        'MUTATING_COMMANDS = frozenset({"HSET"})\n'
+    )
+    findings = run_paths([tmp_path])
+    drift = [f for f in findings if f.rule == "replication.registry-drift"]
+    assert len(drift) == 1, findings
+    assert Path(drift[0].path).name == "toy_replication.py"
+    assert "HFOO" in drift[0].message
+    assert "forward set" in drift[0].message
+    assert drift[0].severity == "error"
+
+
+def test_registry_drift_fires_on_partitioner_and_monitor_gaps(tmp_path):
+    """A mutating primitive absent from the class-shaped registries
+    (ShardedStore / RaceCheckStore method surface) fires once per
+    incomplete registry."""
+    (tmp_path / "toy_server.py").write_text(_TOY_SERVER)
+    (tmp_path / "toy_replication.py").write_text(
+        'MUTATING_COMMANDS = frozenset({"HSET", "HFOO"})\n'
+    )
+    (tmp_path / "toy_sharding.py").write_text(
+        textwrap.dedent(
+            """\
+            class ShardedStore:
+                def hset(self, key, fields):
+                    pass
+            """
+        )
+    )
+    (tmp_path / "toy_racecheck.py").write_text(
+        textwrap.dedent(
+            """\
+            class RaceCheckStore:
+                def hset(self, key, fields):
+                    pass
+
+                def hfoo(self, key):
+                    pass
+            """
+        )
+    )
+    findings = run_paths([tmp_path])
+    drift = [f for f in findings if f.rule == "replication.registry-drift"]
+    assert [(Path(f.path).name, f.line) for f in drift] == [
+        ("toy_sharding.py", 1)
+    ]
+    assert "HFOO" in drift[0].message
+    assert "hfoo" in drift[0].message  # names the expected method spellings
+
+
+def test_registry_drift_clean_when_registries_agree(tmp_path):
+    (tmp_path / "toy_server.py").write_text(_TOY_SERVER)
+    (tmp_path / "toy_replication.py").write_text(
+        'MUTATING_COMMANDS = frozenset({"HSET", "HFOO"})\n'
+    )
+    findings = run_paths([tmp_path])
+    assert [f for f in findings if f.rule.startswith("replication.")] == []
+
+
+def test_registry_drift_ignores_non_switch_dispatch_methods(tmp_path):
+    """A dispatcher-side method that merely shares the _dispatch name
+    (no command branches) is not a registry — PR-10 regression: the
+    multihost dispatcher's _dispatch must not be held to the RESP set."""
+    (tmp_path / "toy_replication.py").write_text(
+        'MUTATING_COMMANDS = frozenset({"HSET"})\n'
+    )
+    (tmp_path / "toy_dispatch.py").write_text(
+        textwrap.dedent(
+            """\
+            class Dispatcher:
+                def _dispatch(self, task, worker):
+                    worker.send(task)
+            """
+        )
+    )
+    findings = run_paths([tmp_path])
+    assert [f for f in findings if f.rule.startswith("replication.")] == []
+
+
+def test_registry_drift_real_tree_is_synchronized():
+    """The shipped five registries (plus the native table) agree on the
+    full mutating set — and the checker is demonstrably LOOKING at them:
+    it must have collected all six registry instances from the real
+    store package."""
+    from tpu_faas.analysis.registries import RegistryChecker
+    from tpu_faas.analysis.core import Module
+
+    checker = RegistryChecker()
+    package = Path(__file__).parent.parent / "tpu_faas"
+    for name in (
+        "store/server.py", "store/replication.py",
+        "store/sharding.py", "store/racecheck.py",
+    ):
+        p = package / name
+        list(checker.check(Module.parse(p, name, p.read_text())))
+    kinds = sorted(r.kind for r in checker._registries)
+    assert kinds == [
+        "apply", "dispatch", "forward", "native", "racecheck", "sharded",
+    ]
+    assert list(checker.finalize()) == []
+    # the derived mutating set is the documented seven
+    assert {
+        "HSET", "HSETNX", "HINCRBY", "HDEL", "DEL", "PUBLISH", "FLUSHDB"
+    } <= {c for r in checker._registries for c in r.commands | r.replicating}
+
+
+# -- shard safety ------------------------------------------------------------
+
+
+def test_shard_undeclared_namespace_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, tid):
+            store.hset("speed_grades:" + tid, {"v": "1"})
+            store.hget(f"leaderboard:{tid}", "rank")
+        """,
+    )
+    assert hits(findings) == [
+        ("shard.undeclared-namespace", 2),
+        ("shard.undeclared-namespace", 3),
+    ]
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_shard_declared_namespaces_are_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.store.base import LIVE_INDEX_KEY, blob_key
+
+        FLEET_HEALTH_KEY = "fleet:health"
+
+        def f(store, tid, digest, trace_id):
+            store.hget(LIVE_INDEX_KEY, tid)
+            store.hgetall(FLEET_HEALTH_KEY)
+            store.hget(blob_key(digest), "data")
+            store.hset(f"trace:{trace_id}", {"t0": "1"})
+            store.hgetall(tid)  # dynamic key: plain ring routing
+        """,
+    )
+    assert hits(findings) == []
+
+
+def test_shard_mixed_routing_pipeline_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.store.base import LIVE_INDEX_KEY
+
+        def f(store, digest):
+            store.hgetall_many([LIVE_INDEX_KEY, f"blob:{digest}"])
+        """,
+    )
+    assert hits(findings) == [("shard.mixed-routing-pipeline", 4)]
+
+
+def test_shard_single_class_batches_and_dynamic_batches_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, digests, items):
+            store.hgetall_many([f"blob:{d}" for d in digests])
+            store.hset_many(items)
+            store.hgetall_many(["blob:aa", "blob:bb"])
+        """,
+    )
+    assert hits(findings) == []
+
+
+def test_shard_store_package_may_mix_routing(tmp_path):
+    """ShardedStore's own batch forms special-case broadcast keys — the
+    store package is the one place a literal mix is the implementation,
+    not a bypass."""
+    pkg = tmp_path / "tpu_faas" / "store"
+    pkg.mkdir(parents=True)
+    (pkg / "impl.py").write_text(
+        textwrap.dedent(
+            """\
+            from tpu_faas.store.base import LIVE_INDEX_KEY
+
+            def fan(store, digest):
+                store.hgetall_many([LIVE_INDEX_KEY, f"blob:{digest}"])
+            """
+        )
+    )
+    findings = run_paths([tmp_path / "tpu_faas"])
+    assert [f for f in findings if f.rule == "shard.mixed-routing-pipeline"] == []
+
+
+def test_shard_suppressible(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def f(store):
+            # one-off migration key, never read by fleet routing
+            store.hset("migration:v2", {"done": "1"})  # faas: allow(shard.undeclared-namespace)
+        """,
+    )
+    assert hits(findings) == []
+
+
+# -- metrics discipline ------------------------------------------------------
+
+
+def test_metrics_counter_not_total_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def build(registry):
+            return registry.counter("tpu_faas_requests", "requests served")
+        """,
+    )
+    assert hits(findings) == [("metrics.counter-not-total", 2)]
+    assert findings[0].severity == "error"
+
+
+def test_metrics_unbounded_label_fires_at_declaration_and_use(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def build(metrics, task_id):
+            m = metrics.counter(
+                "tpu_faas_lookups_total", "lookups", ("task_id",)
+            )
+            m.labels(task_id=task_id).inc()
+            m.labels(str(task_id)).inc()
+        """,
+    )
+    assert hits(findings) == [
+        ("metrics.unbounded-cardinality-label", 2),
+        ("metrics.unbounded-cardinality-label", 5),
+        ("metrics.unbounded-cardinality-label", 6),
+    ]
+
+
+def test_metrics_label_vocabulary_drift_fires_cross_module(tmp_path):
+    (tmp_path / "gateway_m.py").write_text(
+        textwrap.dedent(
+            """\
+            def build(metrics):
+                return metrics.histogram(
+                    "tpu_faas_stage_seconds", "stage", ("stage",)
+                )
+            """
+        )
+    )
+    (tmp_path / "dispatch_m.py").write_text(
+        textwrap.dedent(
+            """\
+            def build(registry):
+                return registry.histogram(
+                    "tpu_faas_stage_seconds", "stage", ("phase",)
+                )
+            """
+        )
+    )
+    findings = run_paths([tmp_path])
+    drift = [
+        f for f in findings if f.rule == "metrics.label-vocabulary-drift"
+    ]
+    assert [(Path(f.path).name, f.line) for f in drift] == [
+        ("dispatch_m.py", 2),
+        ("gateway_m.py", 2),
+    ]
+    assert "one family, one vocabulary" in drift[0].message
+
+
+def test_metrics_same_vocab_in_two_processes_is_clean(tmp_path):
+    """The gateway and a dispatcher legitimately re-register the same
+    family in their per-process registries — identical vocabulary is not
+    drift."""
+    for name in ("a.py", "b.py"):
+        (tmp_path / name).write_text(
+            textwrap.dedent(
+                """\
+                def build(registry):
+                    registry.counter(
+                        "tpu_faas_dup_events_total", "dups", ("event",)
+                    )
+                    registry.gauge("tpu_faas_depth", "queue depth")
+                """
+            )
+        )
+    findings = run_paths([tmp_path])
+    assert [f for f in findings if f.rule.startswith("metrics.")] == []
+
+
+def test_metrics_non_registry_receivers_are_ignored(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def f(machine, task_id):
+            machine.counter("spins")
+            machine.labels(task_id)
+        """,
+    )
+    assert hits(findings) == []
+
+
+def test_metrics_derived_label_values_are_clean(tmp_path):
+    """A value DERIVED from an unbounded id (shard index, status) is
+    bounded by construction."""
+    findings = check(
+        tmp_path,
+        """\
+        def f(m, ring, task_id):
+            m.labels(shard=str(ring.shard_of(task_id))).inc()
+        """,
+    )
+    assert hits(findings) == []
+
+
+# -- stale suppressions ------------------------------------------------------
+
+
+def test_stale_suppression_warns_and_strict_promotes(tmp_path, capsys):
+    p = tmp_path / "snippet.py"
+    p.write_text(
+        textwrap.dedent(
+            """\
+            def f(x):
+                return x + 1  # faas: allow(obs.wall-clock-latency)
+            """
+        )
+    )
+    findings = run_paths([p])
+    assert hits(findings) == [("core.stale-suppression", 2)]
+    assert findings[0].severity == "warning"
+    # default gate passes (warning), --strict fails
+    assert analysis_main([str(p)]) == 0
+    assert analysis_main(["--strict", str(p)]) == 1
+    capsys.readouterr()
+
+
+def test_stale_suppression_per_token_granularity(tmp_path):
+    """One live token plus one dead token on the same line: only the dead
+    one is reported."""
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, tid):
+            store.set_status(tid, "COMPLETED")  # faas: allow(protocol.terminal-set-status, trace.print)
+        """,
+    )
+    assert hits(findings) == [("core.stale-suppression", 2)]
+    assert "trace.print" in findings[0].message
+
+
+def test_live_suppressions_stay_silent(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def f(store, tid):
+            store.set_status(tid, "COMPLETED")  # faas: allow(protocol.terminal-set-status)
+        """,
+    )
+    assert hits(findings) == []
+
+
+def test_docstring_spelled_allow_is_not_a_suppression(tmp_path):
+    """The directive quoted in a docstring (rule catalogs, examples) must
+    neither suppress nor count as stale — only real comment tokens that
+    START with the directive register."""
+    findings = check(
+        tmp_path,
+        '''\
+        def f(store, tid):
+            """Suppress with ``# faas: allow(protocol.terminal-set-status)``."""
+            store.set_status(tid, "COMPLETED")
+        ''',
+    )
+    assert hits(findings) == [("protocol.terminal-set-status", 3)]
+
+
+# -- SARIF -------------------------------------------------------------------
+
+
+def test_sarif_output_shape_and_gate_exit(tmp_path, capsys):
+    p = tmp_path / "snippet.py"
+    p.write_text(
+        textwrap.dedent(
+            """\
+            def f(store, tid):
+                store.set_status(tid, "COMPLETED")
+            """
+        )
+    )
+    out = tmp_path / "out.sarif"
+    rc = analysis_main(["--sarif", str(out), str(p)])
+    assert rc == 1  # SARIF emission never weakens the gate
+    capsys.readouterr()
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpu-faas-analysis"
+    (result,) = run["results"]
+    assert result["ruleId"] == "protocol.terminal-set-status"
+    assert result["level"] == "error"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "snippet.py"
+    assert loc["region"]["startLine"] == 2
+    # rule metadata present for every distinct rule id
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rules == {"protocol.terminal-set-status"}
+
+
+def test_sarif_respects_baseline_subtraction(tmp_path, capsys):
+    p = tmp_path / "snippet.py"
+    p.write_text(
+        textwrap.dedent(
+            """\
+            def f(store, tid):
+                store.set_status(tid, "COMPLETED")
+            """
+        )
+    )
+    baseline = tmp_path / "baseline.json"
+    assert analysis_main(["--write-baseline", str(baseline), str(p)]) == 0
+    out = tmp_path / "out.sarif"
+    rc = analysis_main(
+        ["--baseline", str(baseline), "--sarif", str(out), str(p)]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    doc = json.loads(out.read_text())
+    assert doc["runs"][0]["results"] == []
+
+
+def test_registry_drift_fires_when_apply_switch_lags(tmp_path):
+    """The forwarded-and-DROPPED shape: dispatch replicates HFOO and the
+    forward set carries it, but the replica apply switch never learned
+    it — fires at apply_replicated."""
+    (tmp_path / "toy_server.py").write_text(
+        textwrap.dedent(
+            """\
+            class StoreServer:
+                async def _dispatch(self, cmd, writer):
+                    name = cmd[0].upper()
+                    if name == "HSET":
+                        self._replicate(cmd)
+                    elif name == "HFOO":
+                        self._replicate(cmd)
+
+                def apply_replicated(self, cmd):
+                    name = cmd[0].upper()
+                    if name == "HSET":
+                        self.apply(cmd)
+            """
+        )
+    )
+    (tmp_path / "toy_replication.py").write_text(
+        'MUTATING_COMMANDS = frozenset({"HSET", "HFOO"})\n'
+    )
+    findings = run_paths([tmp_path])
+    drift = [f for f in findings if f.rule == "replication.registry-drift"]
+    assert len(drift) == 1
+    assert Path(drift[0].path).name == "toy_server.py"
+    assert "HFOO" in drift[0].message
+    assert "apply_replicated" in drift[0].message
+
+
+def test_registry_drift_fires_when_dispatch_mutates_without_replicate(tmp_path):
+    """The silently-un-replicates shape: the dispatch HANDLES a mutating
+    primitive (branch exists, applies state) but never forwards it —
+    replicas would silently diverge. Must fire at the dispatch even
+    though the command is spelled in every registry."""
+    (tmp_path / "toy_server.py").write_text(
+        textwrap.dedent(
+            """\
+            class StoreServer:
+                async def _dispatch(self, cmd, writer):
+                    name = cmd[0].upper()
+                    if name == "HSET":
+                        self.apply(cmd)
+                        self._replicate(cmd)
+                    elif name == "HFOO":
+                        self.apply(cmd)  # forgot _replicate
+
+                def apply_replicated(self, cmd):
+                    name = cmd[0].upper()
+                    if name == "HSET":
+                        self.apply(cmd)
+                    elif name == "HFOO":
+                        self.apply(cmd)
+            """
+        )
+    )
+    (tmp_path / "toy_replication.py").write_text(
+        'MUTATING_COMMANDS = frozenset({"HSET", "HFOO"})\n'
+    )
+    findings = run_paths([tmp_path])
+    drift = [f for f in findings if f.rule == "replication.registry-drift"]
+    assert len(drift) == 1
+    assert Path(drift[0].path).name == "toy_server.py"
+    assert "HFOO" in drift[0].message
+    assert "WITHOUT a _replicate call" in drift[0].message
+
+
+def test_shard_literal_namespaces_pin_their_runtime_constants():
+    """shardsafety spells the admission/obs-owned namespaces literally
+    (importing those packages would crash the gate on the broken
+    checkouts it exists to diagnose) — this pin keeps the literals from
+    drifting against the runtime constants."""
+    from tpu_faas.analysis import shardsafety
+    from tpu_faas.admission.signal import FLEET_HEALTH_KEY
+    from tpu_faas.obs.tracectx import TRACE_PREFIX
+
+    assert shardsafety.FLEET_HEALTH_KEY == FLEET_HEALTH_KEY
+    assert shardsafety.TRACE_PREFIX == TRACE_PREFIX
+    declared = {s for s, _k, _r in shardsafety.NAMESPACES}
+    assert {FLEET_HEALTH_KEY, TRACE_PREFIX} <= declared

@@ -2,7 +2,7 @@
 ``store/racecheck.py`` checks at runtime.
 
 The runtime monitor validates the interleavings a given run happens to hit;
-these AST passes see every code path. Three project-specific checkers ride a
+these AST passes see every code path. The project-specific checkers ride a
 small shared framework (:mod:`tpu_faas.analysis.core`):
 
 - :mod:`tpu_faas.analysis.protocol` — every store write site that sets a
@@ -20,9 +20,29 @@ small shared framework (:mod:`tpu_faas.analysis.core`):
   belongs to the telemetry layer's monotonic-anchored API
   (tpu_faas/obs) instead.
 
+- :mod:`tpu_faas.analysis.eventloop` — blocking work (sync store round
+  trips, ``time.sleep``, file I/O, threading-lock acquires, O(n²)
+  scans) reachable from ``async def`` bodies; ``run_in_executor`` /
+  ``asyncio.to_thread`` thunks are the sanctioned escapes.
+- :mod:`tpu_faas.analysis.registries` — the store-command registries
+  (RESP dispatch, replication forward set, replica apply switch,
+  sharded partitioner, racecheck pass-throughs, native command table)
+  carry the same mutating-primitive set — cross-registry drift proven
+  absent at rest.
+- :mod:`tpu_faas.analysis.shardsafety` — statically-spelled store keys
+  match a declared namespace with a known routing rule
+  (routed / broadcast / field-partitioned); no literal batch mixes
+  routing classes outside the sharded store itself.
+- :mod:`tpu_faas.analysis.metricsdiscipline` — one metric family name,
+  one label vocabulary; counters end ``_total``; no unbounded-cardinality
+  (per-task) label values.
+
 Run ``python -m tpu_faas.analysis [paths]`` (exit 1 on non-baselined
 error-severity findings); suppress a deliberate site with a trailing
-``# faas: allow(<rule>)`` comment. See docs/ANALYSIS.md.
+``# faas: allow(<rule>)`` comment — a suppression that stops matching
+becomes a ``core.stale-suppression`` warning, so it cannot outlive its
+reason. ``--sarif out.json`` emits SARIF 2.1.0 for PR annotation. See
+docs/ANALYSIS.md.
 """
 
 from __future__ import annotations
@@ -36,24 +56,39 @@ from tpu_faas.analysis.core import (
     subtract_baseline,
     write_baseline,
 )
+from tpu_faas.analysis.eventloop import EventLoopChecker
 from tpu_faas.analysis.locks import LockDisciplineChecker
+from tpu_faas.analysis.metricsdiscipline import MetricsDisciplineChecker
 from tpu_faas.analysis.obs import ObsChecker
 from tpu_faas.analysis.protocol import ProtocolChecker
+from tpu_faas.analysis.registries import RegistryChecker
+from tpu_faas.analysis.shardsafety import ShardSafetyChecker
 from tpu_faas.analysis.tracesafety import TraceSafetyChecker
 
 #: The default checker suite, in report order.
 ALL_CHECKERS = (
-    ProtocolChecker, TraceSafetyChecker, LockDisciplineChecker, ObsChecker
+    ProtocolChecker,
+    TraceSafetyChecker,
+    LockDisciplineChecker,
+    ObsChecker,
+    EventLoopChecker,
+    RegistryChecker,
+    ShardSafetyChecker,
+    MetricsDisciplineChecker,
 )
 
 __all__ = [
     "ALL_CHECKERS",
     "Checker",
+    "EventLoopChecker",
     "Finding",
     "LockDisciplineChecker",
+    "MetricsDisciplineChecker",
     "Module",
     "ObsChecker",
     "ProtocolChecker",
+    "RegistryChecker",
+    "ShardSafetyChecker",
     "TraceSafetyChecker",
     "load_baseline",
     "run_paths",

@@ -19,13 +19,76 @@ from tpu_faas.analysis import (
     subtract_baseline,
     write_baseline,
 )
-from tpu_faas.analysis.core import iter_py_files
+from tpu_faas.analysis.core import Finding, iter_py_files
+
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(findings: list[Finding]) -> dict:
+    """SARIF 2.1.0 for the findings that survived baseline subtraction —
+    the shape GitHub code scanning ingests to annotate PR diffs inline.
+    Rule metadata is derived from the findings themselves (the suite has
+    no separate rule registry to drift from)."""
+    rules: dict[str, dict] = {}
+    results: list[dict] = []
+    for f in findings:
+        rules.setdefault(
+            f.rule,
+            {
+                "id": f.rule,
+                "shortDescription": {"text": f.rule},
+                "defaultConfiguration": {
+                    "level": "error" if f.severity == "error" else "warning"
+                },
+            },
+        )
+        results.append(
+            {
+                "ruleId": f.rule,
+                "level": "error" if f.severity == "error" else "warning",
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path,
+                                "uriBaseId": "%SRCROOT%",
+                            },
+                            "region": {"startLine": f.line},
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "version": "2.1.0",
+        "$schema": _SARIF_SCHEMA,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpu-faas-analysis",
+                        "informationUri": (
+                            "https://github.com/tpu-faas/tpu-faas"
+                            "/blob/main/docs/ANALYSIS.md"
+                        ),
+                        "rules": [rules[k] for k in sorted(rules)],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tpu_faas.analysis",
-        description="Static protocol / trace-safety / lock-discipline "
+        description="Static protocol / trace-safety / lock / event-loop / "
+        "registry-completeness / shard-routing / metrics-discipline "
         "checks for the tpu-faas tree (see docs/ANALYSIS.md).",
     )
     parser.add_argument(
@@ -55,6 +118,12 @@ def main(argv: list[str] | None = None) -> int:
         dest="as_json",
         help="emit findings as a JSON array instead of text",
     )
+    parser.add_argument(
+        "--sarif",
+        metavar="FILE",
+        help="additionally write findings (after baseline subtraction) "
+        "as SARIF 2.1.0 to FILE, for inline PR annotation",
+    )
     args = parser.parse_args(argv)
 
     paths = args.paths or [Path(tpu_faas.__file__).parent]
@@ -83,6 +152,11 @@ def main(argv: list[str] | None = None) -> int:
         except (OSError, ValueError, json.JSONDecodeError) as exc:
             print(f"cannot load baseline {args.baseline}: {exc}", file=sys.stderr)
             return 2
+
+    if args.sarif:
+        Path(args.sarif).write_text(
+            json.dumps(to_sarif(findings), indent=2) + "\n", encoding="utf-8"
+        )
 
     if args.as_json:
         print(

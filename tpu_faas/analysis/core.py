@@ -15,14 +15,19 @@ CI without a TPU, and over fixture snippets in tests.
 from __future__ import annotations
 
 import ast
+import io
 import json
 import re
+import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Iterable, Sequence
 
-#: ``# faas: allow(rule-a, rule-b)`` — trailing comment on the reported line.
-_ALLOW_RE = re.compile(r"#\s*faas:\s*allow\(\s*([^)]*?)\s*\)")
+#: ``# faas: allow(rule-a, rule-b)`` — a REAL comment token that STARTS
+#: with the directive (matched against tokenize COMMENT tokens, so the
+#: spelling quoted inside a docstring or a doc comment never registers a
+#: suppression — which matters now that stale suppressions are findings).
+_ALLOW_RE = re.compile(r"^#\s*faas:\s*allow\(\s*([^)]*?)\s*\)")
 
 SEVERITIES = ("error", "warning")
 
@@ -56,13 +61,32 @@ class Module:
     tree: ast.Module
     #: line number -> suppression tokens from a ``# faas: allow(...)`` comment
     allows: dict[int, frozenset[str]] = field(default_factory=dict)
+    #: line number -> tokens that actually absorbed a finding this run —
+    #: the complement is the stale-suppression report
+    used: dict[int, set[str]] = field(default_factory=dict)
 
     @classmethod
     def parse(cls, path: Path, relpath: str, source: str) -> "Module":
         tree = ast.parse(source, filename=str(path))
         allows: dict[int, frozenset[str]] = {}
-        for lineno, line in enumerate(source.splitlines(), start=1):
-            m = _ALLOW_RE.search(line)
+        try:
+            comments = [
+                (tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline
+                )
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            # ast.parse succeeded, so this is near-unreachable; fall back
+            # to the line scan rather than losing suppressions entirely
+            comments = [
+                (lineno, line[line.index("#"):])
+                for lineno, line in enumerate(source.splitlines(), start=1)
+                if "#" in line
+            ]
+        for lineno, comment in comments:
+            m = _ALLOW_RE.match(comment)
             if m:
                 tokens = frozenset(
                     t.strip() for t in m.group(1).split(",") if t.strip()
@@ -71,15 +95,35 @@ class Module:
                     allows[lineno] = tokens
         return cls(path=path, relpath=relpath, source=source, tree=tree, allows=allows)
 
+    def _matching_tokens(self, line: int, rule: str) -> frozenset[str]:
+        tokens = self.allows.get(line)
+        if not tokens:
+            return frozenset()
+        checker = rule.split(".", 1)[0]
+        return tokens & {"*", rule, checker}
+
     def suppressed(self, line: int, rule: str) -> bool:
         """True when ``rule`` is allowed on ``line``. A token matches its
         exact rule, a whole checker (``allow(locks)``), or everything
         (``allow(*)``)."""
-        tokens = self.allows.get(line)
-        if not tokens:
-            return False
-        checker = rule.split(".", 1)[0]
-        return bool(tokens & {"*", rule, checker})
+        return bool(self._matching_tokens(line, rule))
+
+    def consume_suppression(self, line: int, rule: str) -> bool:
+        """:meth:`suppressed`, but recording which tokens did the work —
+        what the stale-suppression pass reports against."""
+        matched = self._matching_tokens(line, rule)
+        if matched:
+            self.used.setdefault(line, set()).update(matched)
+            return True
+        return False
+
+    def stale_allow_tokens(self) -> Iterable[tuple[int, str]]:
+        """(line, token) pairs whose suppression absorbed nothing this
+        run — comments that have outlived their reason (the rule was
+        fixed, the code moved, or the token was a typo all along)."""
+        for line in sorted(self.allows):
+            for token in sorted(self.allows[line] - self.used.get(line, set())):
+                yield line, token
 
 
 class Checker:
@@ -200,7 +244,7 @@ def run_paths(
     for checker in checkers:
         for module in modules:
             for f in checker.check(module):
-                if not module.suppressed(f.line, f.rule):
+                if not module.consume_suppression(f.line, f.rule):
                     findings.append(f)
         # finalize sees suppressions through the checker's own bookkeeping;
         # cross-module findings carry their module context in the checker
@@ -208,8 +252,27 @@ def run_paths(
     for checker in checkers:
         for f in checker.finalize():
             m = by_rel.get(f.path)
-            if m is None or not m.suppressed(f.line, f.rule):
+            if m is None or not m.consume_suppression(f.line, f.rule):
                 findings.append(f)
+    # stale-suppression pass: an allow token that absorbed nothing has
+    # outlived its reason. Deliberately NOT itself suppressible (an
+    # allow(*) that suppresses nothing would otherwise suppress its own
+    # staleness report); warning severity, promoted by --strict.
+    for module in modules:
+        for line, token in module.stale_allow_tokens():
+            findings.append(
+                Finding(
+                    module.relpath,
+                    line,
+                    "core.stale-suppression",
+                    "warning",
+                    f"suppression 'faas: allow({token})' no longer matches "
+                    f"any finding on this line: the rule was fixed, the "
+                    f"code moved, or the token never named a firing rule — "
+                    f"remove the comment so suppressions cannot outlive "
+                    f"their reason",
+                )
+            )
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings
 

@@ -1,0 +1,341 @@
+"""Event-loop discipline checker: no blocking work reachable from
+``async def`` bodies.
+
+The gateway, the Python store server, and the replication link all live on
+asyncio event loops. One blocking call in a coroutine — a synchronous store
+round trip, ``time.sleep``, file I/O, a ``threading.Lock`` acquire, an
+O(n²) scan over a request body — stalls EVERY connection sharing that
+loop: the /result long-poller parks every other client, the store server
+stops answering health probes, the replication link misses its ack window.
+The sanctioned escapes are structural and therefore statically visible:
+``run_in_executor`` / ``asyncio.to_thread`` take the callable UNCALLED, so
+a blocking function passed as a value never trips this pass — only a call
+executed on the loop does.
+
+Rules (all error severity):
+
+- ``blocking-store-call`` — a synchronous :class:`TaskStore` method called
+  on a store-named receiver (``ctx.store``, ``self._store``, ``store``)
+  in async-reachable code. The store surface is a network round trip on
+  production backends; the gateway routes every handler-side store op
+  through ``GatewayContext.store_call`` (executor + circuit breaker) for
+  exactly this reason.
+- ``blocking-sleep`` — ``time.sleep`` on the loop (``asyncio.sleep`` is
+  the coroutine form).
+- ``blocking-file-io`` — ``open()``, ``Path.read_text/write_text/
+  read_bytes/write_bytes``, or the snapshot codec's ``save_file`` /
+  ``load_file`` on the loop. The store server's startup snapshot load
+  runs via ``run_in_executor`` for this reason (a multi-GB load would
+  starve the just-bound health listener into a liveness-kill crash loop).
+- ``blocking-lock`` — a ``threading``-style lock acquired on the loop:
+  ``<lock>.acquire()`` or a synchronous ``with <lock>:`` (lock spelling
+  per the locks checker: final identifier contains lock/mutex). A
+  contended acquire freezes the whole loop, not one coroutine; use
+  ``asyncio.Lock`` (``async with``) or move the locked section off-loop.
+- ``quadratic-scan`` — a membership test (``x in acc``) against a
+  sequence appended to inside the same loop: the O(refs²)
+  ``validate_graph`` class (found live in PR 9 — a dependency-dedup list
+  scan inside the gateway event loop, pre-admission, on bodies up to the
+  256 MB cap). Use a set alongside the ordered list.
+
+Reachability is lexical plus a same-module call closure: an ``async def``
+body is scanned directly (nested ``def``s are skipped — they are values,
+usually executor thunks), and direct calls to same-module functions and
+same-class methods are followed transitively, so a sync helper that does
+the blocking on the coroutine's behalf (``StoreServer._save_if_configured``)
+is still caught. Cross-module sync calls are out of static scope by the
+same tradeoff the trace checker makes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tpu_faas.analysis.core import Checker, Finding, Module, dotted_name
+from tpu_faas.analysis.locks import _lock_id
+from tpu_faas.store.base import TaskStore
+
+#: The synchronous store surface: every public TaskStore method, DERIVED
+#: from the class (grow the protocol and this pass follows), minus the
+#: handful that never leave the process.
+_LOCAL_ONLY = frozenset({"decode_payloads"})
+STORE_METHODS: frozenset[str] = frozenset(
+    name
+    for name in dir(TaskStore)
+    if not name.startswith("_")
+    and callable(getattr(TaskStore, name, None))
+) - _LOCAL_ONLY
+
+#: Dotted / final-attribute spellings of blocking file I/O. The snapshot
+#: codec's file entry points are named here because they are this tree's
+#: canonical "big synchronous disk write".
+_FILE_IO_ATTRS = frozenset(
+    {"read_text", "write_text", "read_bytes", "write_bytes",
+     "save_file", "load_file"}
+)
+
+
+def _receiver_is_store(node: ast.AST) -> bool:
+    """True when a call receiver is store-shaped: the final identifier of
+    its dotted spelling contains "store" (``ctx.store``, ``self._store``,
+    bare ``store``). Wrapper internals (``self.inner``) are deliberately
+    not matched — the wrapper itself is the audited surface."""
+    d = dotted_name(node)
+    if d is None:
+        return False
+    return "store" in d.rsplit(".", 1)[-1].lower()
+
+
+class _Scope:
+    """One module's function topology: defs by name, methods by class,
+    and every async def (the roots of the reachability walk)."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.module_defs: dict[str, ast.FunctionDef] = {}
+        self.methods: dict[tuple[str, str], ast.FunctionDef] = {}
+        #: (async def node, enclosing class name or None)
+        self.roots: list[tuple[ast.AsyncFunctionDef, str | None]] = []
+        self._index(tree, cls=None, top=True)
+
+    def _index(self, node: ast.AST, cls: str | None, top: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self._index(child, cls=child.name, top=False)
+            elif isinstance(child, ast.AsyncFunctionDef):
+                self.roots.append((child, cls))
+                self._index(child, cls=cls, top=False)
+            elif isinstance(child, ast.FunctionDef):
+                if cls is not None:
+                    self.methods.setdefault((cls, child.name), child)
+                if top:
+                    self.module_defs.setdefault(child.name, child)
+                self._index(child, cls=cls, top=False)
+            else:
+                self._index(child, cls=cls, top=top)
+
+
+def _lexical_statements(fn: ast.AST) -> Iterator[ast.AST]:
+    """Every node lexically inside ``fn`` excluding nested function /
+    lambda bodies: a nested def is a value (usually an executor thunk),
+    not code running on the loop — unless it is CALLED directly, which
+    the caller follows separately."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _nested_defs(fn: ast.AST) -> dict[str, ast.FunctionDef]:
+    """Sync defs nested DIRECTLY inside ``fn``'s lexical body (candidates
+    for direct-call following)."""
+    out: dict[str, ast.FunctionDef] = {}
+    for node in _lexical_statements(fn):
+        if isinstance(node, ast.FunctionDef):
+            out.setdefault(node.name, node)
+    return out
+
+
+class EventLoopChecker(Checker):
+    name = "eventloop"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        scope = _Scope(module.tree)
+        if not scope.roots:
+            return
+        reported: set[tuple[int, str]] = set()
+        for root, cls in scope.roots:
+            yield from self._scan_root(module, scope, root, cls, reported)
+
+    # -- reachability walk -------------------------------------------------
+    def _scan_root(
+        self,
+        module: Module,
+        scope: _Scope,
+        root: ast.AsyncFunctionDef,
+        cls: str | None,
+        reported: set[tuple[int, str]],
+    ) -> Iterator[Finding]:
+        visited: set[ast.AST] = {root}
+        queue: list[tuple[ast.AST, str | None]] = [(root, cls)]
+        while queue:
+            fn, fn_cls = queue.pop()
+            nested = _nested_defs(fn)
+            via = "" if fn is root else (
+                f" (in {getattr(fn, 'name', '?')}(), reachable from "
+                f"async def {root.name})"
+            )
+            for node in _lexical_statements(fn):
+                yield from self._check_node(module, node, via, reported)
+                for callee, callee_cls in self._callees(
+                    node, fn_cls, nested, scope
+                ):
+                    if callee not in visited:
+                        visited.add(callee)
+                        queue.append((callee, callee_cls))
+
+    def _callees(
+        self,
+        node: ast.AST,
+        cls: str | None,
+        nested: dict[str, ast.FunctionDef],
+        scope: _Scope,
+    ) -> Iterator[tuple[ast.FunctionDef, str | None]]:
+        """Direct same-module sync calls made by ``node``: a bare name
+        resolving to a nested or module-level def, or ``self.x()`` /
+        ``cls.x()`` resolving to a method of the enclosing class."""
+        if not isinstance(node, ast.Call):
+            return
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            target = nested.get(fn.id) or scope.module_defs.get(fn.id)
+            if target is not None:
+                yield target, cls
+        elif (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in ("self", "cls")
+            and cls is not None
+        ):
+            target = scope.methods.get((cls, fn.attr))
+            if target is not None:
+                yield target, cls
+
+    # -- blocking detection ------------------------------------------------
+    def _emit(
+        self,
+        module: Module,
+        node: ast.AST,
+        rule: str,
+        message: str,
+        reported: set[tuple[int, str]],
+    ) -> Iterator[Finding]:
+        key = (getattr(node, "lineno", 1), rule)
+        if key in reported:  # one finding per site, however many roots reach it
+            return
+        reported.add(key)
+        yield self.finding(module, node, rule, "error", message)
+
+    def _check_node(
+        self,
+        module: Module,
+        node: ast.AST,
+        via: str,
+        reported: set[tuple[int, str]],
+    ) -> Iterator[Finding]:
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lock = _lock_id(item.context_expr)
+                if lock is not None:
+                    yield from self._emit(
+                        module, node, "blocking-lock",
+                        f"synchronous 'with {lock}:' on the event loop"
+                        f"{via}: a contended acquire freezes every "
+                        f"coroutine on this loop — use asyncio.Lock "
+                        f"(async with) or move the section off-loop",
+                        reported,
+                    )
+            return
+        if isinstance(node, (ast.For, ast.While)):
+            yield from self._check_quadratic(module, node, via, reported)
+            return
+        if not isinstance(node, ast.Call):
+            return
+        d = dotted_name(node.func)
+        if d == "time.sleep":
+            yield from self._emit(
+                module, node, "blocking-sleep",
+                f"time.sleep() on the event loop{via}: every connection "
+                f"on this loop stalls for the whole interval — await "
+                f"asyncio.sleep() instead",
+                reported,
+            )
+            return
+        if d == "open" or (
+            d is not None and d.rsplit(".", 1)[-1] in _FILE_IO_ATTRS
+        ):
+            target = d if d == "open" else d.rsplit(".", 1)[-1]
+            yield from self._emit(
+                module, node, "blocking-file-io",
+                f"blocking file I/O ({target}) on the event loop{via}: "
+                f"disk latency is unbounded under load — run it via "
+                f"run_in_executor / asyncio.to_thread",
+                reported,
+            )
+            return
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr == "acquire" and _lock_id(node.func.value) is not None:
+                yield from self._emit(
+                    module, node, "blocking-lock",
+                    f"{dotted_name(node.func.value)}.acquire() on the "
+                    f"event loop{via}: a threading lock blocks the whole "
+                    f"loop, not one coroutine — use asyncio.Lock or move "
+                    f"the section off-loop",
+                    reported,
+                )
+                return
+            if attr in STORE_METHODS and _receiver_is_store(node.func.value):
+                yield from self._emit(
+                    module, node, "blocking-store-call",
+                    f"synchronous store round trip .{attr}() on the event "
+                    f"loop{via}: one slow store RTT parks every connection "
+                    f"on this loop — route it through an executor "
+                    f"(gateway: ctx.store_call)",
+                    reported,
+                )
+
+    def _check_quadratic(
+        self,
+        module: Module,
+        loop: ast.AST,
+        via: str,
+        reported: set[tuple[int, str]],
+    ) -> Iterator[Finding]:
+        """Membership test against a name appended to inside the same
+        loop: each iteration rescans the accumulator — O(n²) on the loop
+        for request-sized n. (Sets use .add, so list accumulation is
+        what the .append probe identifies.)"""
+        appended: set[str] = set()
+        body = getattr(loop, "body", []) + getattr(loop, "orelse", [])
+        nodes = []
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            nodes.append(n)
+            if not isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(ast.iter_child_nodes(n))
+        for n in nodes:
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "append"
+                and isinstance(n.func.value, ast.Name)
+            ):
+                appended.add(n.func.value.id)
+        if not appended:
+            return
+        for n in nodes:
+            if not isinstance(n, ast.Compare):
+                continue
+            for op, comp in zip(n.ops, n.comparators):
+                if (
+                    isinstance(op, (ast.In, ast.NotIn))
+                    and isinstance(comp, ast.Name)
+                    and comp.id in appended
+                ):
+                    yield from self._emit(
+                        module, n, "quadratic-scan",
+                        f"membership test against {comp.id!r}, which this "
+                        f"loop also appends to{via}: O(n²) rescans on the "
+                        f"event loop (the validate_graph class) — keep a "
+                        f"set beside the ordered list",
+                        reported,
+                    )

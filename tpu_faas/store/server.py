@@ -248,8 +248,12 @@ class StoreServer:
 
     # -- checkpointing -----------------------------------------------------
     def _save_if_configured(self) -> None:
+        # deliberate blocking checkpoint: state mutates ONLY on this event
+        # loop, so blocking it for the dump's duration IS the point-in-time
+        # consistency mechanism — same contract as Redis SAVE (an async
+        # BGSAVE would need copy-on-write state this server doesn't keep)
         if self.snapshot_path is not None:
-            snapshot.save_file(
+            snapshot.save_file(  # faas: allow(eventloop.blocking-file-io)
                 self.snapshot_path,
                 self.state.hashes,
                 deleted=list(self.state.tombstones),
@@ -720,7 +724,10 @@ class StoreServer:
                 )
                 return True
             try:
-                snapshot.save_file(
+                # deliberate blocking checkpoint, like Redis SAVE: the loop
+                # pause guarantees the dump is a consistent point-in-time
+                # cut (see _save_if_configured)
+                snapshot.save_file(  # faas: allow(eventloop.blocking-file-io)
                     target, st.hashes, deleted=list(st.tombstones)
                 )
             except OSError as exc:
