@@ -1634,3 +1634,112 @@ def test_shard_literal_namespaces_pin_their_runtime_constants():
     assert shardsafety.TRACE_PREFIX == TRACE_PREFIX
     declared = {s for s, _k, _r in shardsafety.NAMESPACES}
     assert {FLEET_HEALTH_KEY, TRACE_PREFIX} <= declared
+
+
+# -- trace: fused-kernel helper shape (sched/pallas_fused.py) ----------------
+
+
+def test_trace_fused_kernel_helper_chain_fires(tmp_path):
+    """The fused resident kernel's structure — a pallas_call whose kernel
+    closure reads refs and traces through module-level ``_impl`` helpers —
+    keeps the whole helper chain pallas-REACHABLE: a host-time call or a
+    data-dependent Python branch smuggled into any layer of the chain
+    must fire exactly as if it sat in the kernel body."""
+    findings = check(
+        tmp_path,
+        """\
+        import time
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _solver_impl(x):
+            stamp = time.monotonic()
+            return x + stamp
+
+        def _tick_impl(x):
+            return _solver_impl(x) * 2
+
+        def fused_tick(packed):
+            def kernel(packed_ref, out_ref):
+                out_ref[...] = _tick_impl(packed_ref[...])
+
+            return pl.pallas_call(kernel, out_shape=None)(packed)
+        """,
+    )
+    assert ("trace.host-time", 7) in hits(findings)
+
+
+def test_trace_fused_kernel_shape_clean(tmp_path):
+    """The real fused-kernel idioms — a closure kernel writing refs, a
+    make_jaxpr constant lift, fori_loop streaming over dynamic slices —
+    carry no trace hazards and must stay clean."""
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _stream_impl(x, price):
+            def chunk(j, acc):
+                c = jax.lax.dynamic_slice(price, (j * 8,), (8,))
+                return jnp.maximum(acc, c.max())
+
+            return jax.lax.fori_loop(0, 4, chunk, jnp.float32(-1e30)) + x
+
+        def fused_tick(packed, price):
+            closed = jax.make_jaxpr(_stream_impl)(packed, price)
+            consts = [jnp.atleast_1d(jnp.asarray(c)) for c in closed.consts]
+
+            def kernel(*refs):
+                vals = [r[...] for r in refs[:-1]]
+                refs[-1][...] = jax.core.eval_jaxpr(
+                    closed.jaxpr, vals[2:], *vals[:2]
+                )
+
+            return pl.pallas_call(kernel, out_shape=None)(
+                packed, price, *consts
+            )
+        """,
+    )
+    assert hits(findings) == []
+
+
+def test_trace_real_fused_modules_analyzed_clean():
+    """The static gate's live proof: the shipped fused-kernel modules are
+    in scope for the trace checker (pallas_call roots) and carry zero
+    findings — the kernel stays trace-safe as it grows."""
+    import tpu_faas.sched.pallas_fused as pf
+    import tpu_faas.sched.pallas_kernels as pk
+
+    findings = run_paths([Path(pf.__file__), Path(pk.__file__)])
+    assert [f for f in findings if f.rule.startswith("trace.")] == []
+
+
+def test_trace_partial_jit_assignment_wrap_is_a_root(tmp_path):
+    """The _impl/jitted-twin split (`foo = partial(jax.jit, ...)(foo_impl)`)
+    must keep foo_impl a traced ROOT with its statics known: a hazard in
+    the impl fires, and a branch on a declared static stays exempt."""
+    findings = check(
+        tmp_path,
+        """\
+        import time
+        import jax
+        from functools import partial
+
+        def solver_impl(x, mode="fast"):
+            t = time.time()
+            if mode == "fast":
+                x = x * 2
+            if x > 0:
+                x = x + 1
+            return x + t
+
+        solver = partial(jax.jit, static_argnames=("mode",))(solver_impl)
+        """,
+    )
+    assert hits(findings) == [
+        ("trace.host-time", 6),
+        ("trace.data-dependent-branch", 9),
+    ]

@@ -2,6 +2,7 @@
 single-device kernels + invariants."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -15,12 +16,15 @@ from tpu_faas.parallel.mesh import (
 from tpu_faas.sched.problem import PlacementProblem, check_assignment
 from tpu_faas.sched.sinkhorn import sinkhorn_placement
 
-#: the raw sharded kernels are written against the jax.shard_map alias;
-#: the SchedulerArrays mesh tick below compiles through sharding
-#: constraints instead and runs on older JAX too
+from tpu_faas.parallel.mesh import have_shard_map
+
+#: the raw sharded kernels resolve shard_map through mesh._shard_map
+#: (jax.shard_map where it exists, the experimental module otherwise) —
+#: skip only when NEITHER spelling is importable
 requires_shard_map = pytest.mark.skipif(
-    not hasattr(jax, "shard_map"),
-    reason="this JAX lacks jax.shard_map (sharded kernels unavailable)",
+    not have_shard_map(),
+    reason="this JAX lacks any shard_map spelling (sharded kernels "
+    "unavailable)",
 )
 
 
@@ -184,3 +188,141 @@ def test_scheduler_arrays_mesh_auction_matches_single_device(mesh):
     warm_s = np.asarray(single.tick(sizes * 1.01).assignment)
     warm_m = np.asarray(meshed.tick(sizes * 1.01).assignment)
     np.testing.assert_array_equal(warm_s, warm_m)
+
+
+# -- explicit-permute winner resolve ----------------------------------------
+
+
+@requires_shard_map
+def test_sharded_auction_permute_exact_parity(mesh):
+    """The permute winner-resolve must reproduce the single-device seeded
+    auction EXACTLY — same assignment, same round count — because every
+    per-cell bid value, max-reduction, and tie rule is identical (see
+    sharded_auction_placement's docstring). Not a tolerance test."""
+    from tpu_faas.parallel.mesh import sharded_auction_placement
+    from tpu_faas.sched.auction import auction_placement
+
+    rng = np.random.default_rng(5)
+    T, W, K = 1024, 256, 4
+    p = PlacementProblem.build(
+        rng.uniform(0.1, 5.0, 700).astype(np.float32),
+        rng.uniform(0.5, 4.0, W).astype(np.float32),
+        rng.integers(0, K + 1, W).astype(np.int32),
+        rng.random(W) > 0.1,
+        T=T,
+        W=W,
+    )
+    ts, tv = shard_task_arrays(mesh, p.task_size, p.task_valid)
+    ws, wf, wl = replicate(
+        mesh, p.worker_speed, p.worker_free, p.worker_live
+    )
+    res_m = sharded_auction_placement(mesh, ts, tv, ws, wf, wl, max_slots=K)
+    res_s = auction_placement(
+        p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+        p.worker_live, max_slots=K,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_m.assignment), np.asarray(res_s.assignment)
+    )
+    assert int(res_m.n_rounds) == int(res_s.n_rounds)
+    np.testing.assert_allclose(
+        np.asarray(res_m.prices), np.asarray(res_s.prices), atol=1e-5
+    )
+    check_assignment(
+        np.asarray(res_m.assignment), np.asarray(p.task_valid),
+        np.minimum(np.asarray(p.worker_free), K), np.asarray(p.worker_live),
+    )
+
+
+@requires_shard_map
+def test_sharded_tick_permute_winner_resolve(mesh):
+    """sharded_scheduler_tick(winner_resolve='permute') — the wired-in
+    form — matches the default GSPMD lexsort resolution end to end,
+    including the liveness/purge/redispatch outputs around it."""
+    from tpu_faas.parallel.mesh import sharded_scheduler_tick
+
+    rng = np.random.default_rng(11)
+    T, W, K = 512, 64, 4
+    sizes = np.zeros(T, np.float32)
+    sizes[:300] = rng.uniform(0.2, 3.0, 300)
+    valid = np.zeros(T, bool)
+    valid[:300] = True
+    speeds = rng.uniform(0.5, 4.0, W).astype(np.float32)
+    free = rng.integers(0, K + 1, W).astype(np.int32)
+    active = rng.random(W) > 0.1
+    hb_age = rng.uniform(0.0, 15.0, W).astype(np.float32)
+    prev_live = rng.random(W) > 0.5
+    inflight = rng.integers(-1, W, 256).astype(np.int32)
+    ts, tv = shard_task_arrays(
+        mesh, jnp.asarray(sizes), jnp.asarray(valid)
+    )
+    ws, wf, wa, hb, pl_, iw = replicate(
+        mesh, jnp.asarray(speeds), jnp.asarray(free), jnp.asarray(active),
+        jnp.asarray(hb_age), jnp.asarray(prev_live), jnp.asarray(inflight),
+    )
+    kw = dict(max_slots=K, placement="auction")
+    out_g = sharded_scheduler_tick(
+        mesh, ts, tv, ws, wf, wa, hb, pl_, iw, jnp.float32(10.0), **kw
+    )
+    out_p = sharded_scheduler_tick(
+        mesh, ts, tv, ws, wf, wa, hb, pl_, iw, jnp.float32(10.0),
+        winner_resolve="permute", **kw,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_g.assignment), np.asarray(out_p.assignment)
+    )
+    for field in ("live", "purged", "redispatch"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out_g, field)),
+            np.asarray(getattr(out_p, field)),
+            err_msg=field,
+        )
+    np.testing.assert_allclose(
+        np.asarray(out_g.auction_price),
+        np.asarray(out_p.auction_price),
+        atol=1e-5,
+    )
+
+
+@requires_shard_map
+def test_sharded_auction_permute_warm_carry(mesh):
+    """Warm prices thread through the permute path exactly as through the
+    single-device warm branch: the same init_price must produce the same
+    warm trajectory (assignment AND round count) on both paths."""
+    from tpu_faas.parallel.mesh import sharded_auction_placement
+    from tpu_faas.sched.auction import auction_placement
+
+    rng = np.random.default_rng(13)
+    T, W, K = 512, 128, 4
+    p = PlacementProblem.build(
+        rng.uniform(0.1, 5.0, 400).astype(np.float32),
+        rng.uniform(0.5, 4.0, W).astype(np.float32),
+        rng.integers(1, K + 1, W).astype(np.int32),
+        np.ones(W, bool),
+        T=T,
+        W=W,
+    )
+    ts, tv = shard_task_arrays(mesh, p.task_size, p.task_valid)
+    ws, wf, wl = replicate(
+        mesh, p.worker_speed, p.worker_free, p.worker_live
+    )
+    cold = sharded_auction_placement(mesh, ts, tv, ws, wf, wl, max_slots=K)
+    warm = sharded_auction_placement(
+        mesh, ts, tv, ws, wf, wl, max_slots=K, init_price=cold.prices
+    )
+    warm_single = auction_placement(
+        p.task_size, p.task_valid, p.worker_speed, p.worker_free,
+        p.worker_live, max_slots=K,
+        init_price=jnp.asarray(np.asarray(cold.prices)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(warm.assignment), np.asarray(warm_single.assignment)
+    )
+    assert int(warm.n_rounds) == int(warm_single.n_rounds)
+    check_assignment(
+        np.asarray(warm.assignment), np.asarray(p.task_valid),
+        np.minimum(np.asarray(p.worker_free), K), np.asarray(p.worker_live),
+    )
+    assert (np.asarray(warm.assignment) >= 0).sum() == (
+        np.asarray(cold.assignment) >= 0
+    ).sum()

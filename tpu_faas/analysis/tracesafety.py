@@ -190,6 +190,20 @@ class TraceSafetyChecker(Checker):
                 continue
             d = dotted_name(node.func)
             if d is None or _last(d) not in _WRAP_NAMES:
+                # the assignment-wrap idiom `partial(jax.jit, ...)(impl)`
+                # (the _impl/jitted-twin split the fused kernel introduced):
+                # the outer call's func is itself the partial-jit call the
+                # decorator detector already understands
+                spec = (
+                    self._jit_decorator(node.func)
+                    if isinstance(node.func, ast.Call)
+                    else None
+                )
+                if spec is None or not node.args:
+                    continue
+                name = _unwrap_target(node.args[0])
+                for info in by_name.get(name or "", []):
+                    info.mark(_resolve_static(info.node, *spec))
                 continue
             if not node.args:
                 continue

@@ -23,7 +23,8 @@ def main(argv: list[str] | None = None) -> None:
         "7 (bid kernel), 8 (estimation), 9 (host dispatch throughput), "
         "10 (overload admission), 11 (payload plane), "
         "12 (latency closed-loop), 13 (task graphs), "
-        "14 (fleet throughput: sharded control plane), or 'all'",
+        "14 (fleet throughput: sharded control plane), "
+        "15 (tick-latency trajectory: fused vs XLA tick), or 'all'",
     )
     ap.add_argument(
         "-m", "--mode", default="push",

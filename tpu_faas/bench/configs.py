@@ -27,6 +27,11 @@ configs[]) plus one framework-extra:
    tier vs the 1x1x1 single stack on the same box, publishing tasks/s per
    topology + the scaling ratio, plus a one-shard-primary-SIGKILL chaos
    leg under the race monitor (zero admitted-task loss)
+15. (extra) tick-latency trajectory: the fused-Pallas resident tick vs
+   the XLA op-graph tick over a shape ladder (median per-tick wall time,
+   one-dispatch-per-tick pinned live), plus a capacity DRYRUN at the
+   ROADMAP 500k x 32k shape (O(T+S) memory — no [T, S] materialization)
+   and an optional sharded permute-winner-resolve leg
 
 Configs 1-2, 6, 9-12 run the real socket stack; 3-5 run the device kernels
 at scales the socket stack can't reach on one box (the reference had no
@@ -1950,6 +1955,310 @@ def _fleet_chaos_leg() -> dict:
             p0.wait()
 
 
+def _resident_fleet(rs, n_workers: int, procs: int) -> None:
+    """Register a full mirror fleet by direct array fill (a Python
+    register() loop at 32k workers costs more than the ticks being
+    measured; the host mirrors are the registration surface)."""
+    now = rs.clock()
+    rs.worker_speed[:n_workers] = 1.0
+    rs.worker_active[:n_workers] = True
+    rs.worker_procs[:n_workers] = procs
+    rs.worker_free[:n_workers] = procs
+    rs.last_heartbeat[:n_workers] = now
+    for i in range(n_workers):
+        wid = b"bench-w%d" % i
+        rs.worker_ids[wid] = i
+        rs.row_ids[i] = wid
+
+
+def _tick_leg(
+    backend: str, T: int, W: int, n_ticks: int, seed: int,
+    placement: str = "rank",
+) -> dict:
+    """Median integrated-tick time for one backend at one shape: bulk-load
+    a full pending buffer, then measure tick_resident + full resolve
+    (arrivals trickling in each tick) — the steady-state product cycle.
+    ``seed`` fixes the task-size instance so the two backends of one
+    shape solve the IDENTICAL problem (the headlined ratio must compare
+    kernels, not random instances)."""
+    import itertools
+
+    from tpu_faas.sched.resident import ResidentScheduler
+
+    rng = np.random.default_rng(seed)
+    procs = 8
+    rs = ResidentScheduler(
+        max_workers=W,
+        max_pending=T,
+        max_inflight=min(4 * W * procs, 1 << 17),
+        max_slots=procs,
+        placement=placement,
+        tick_backend=backend,
+    )
+    _resident_fleet(rs, W, procs)
+    # load leaves KA-per-tick arrival headroom at real shapes; small
+    # (smoke) shapes clamp to half the buffer — surplus arrivals bounce
+    # and re-queue, which the resident contract handles by design
+    n_load = min(T, max(T - rs.KA * (n_ticks + 1), (T + 1) // 2))
+    rs.pending_bulk_load(
+        [f"t{i}" for i in range(n_load)],
+        rng.uniform(0.1, 5.0, n_load).astype(np.float32),
+    )
+    # warmup: compile + first placement wave outside the timed window
+    rs.tick_resident()
+    while rs.resolve_next() is not None:
+        pass
+    times = []
+    dispatches_max = 0
+    arrival_seq = itertools.count(n_load)
+    for _ in range(n_ticks):
+        for _k in range(rs.KA // 2):
+            rs.pending_add(f"t{next(arrival_seq)}", 1.0)
+        t0 = time.perf_counter()
+        rs.tick_resident()
+        while rs.resolve_next() is not None:  # forces the readback
+            pass
+        times.append((time.perf_counter() - t0) * 1e3)
+        # the one-dispatch pin covers EVERY measured tick: a single
+        # overflow flush on any of them is a contract violation, not
+        # only one on the last
+        dispatches_max = max(dispatches_max, rs.device_dispatches_last_tick)
+    times.sort()
+    return {
+        "median_ms": round(times[len(times) // 2], 3),
+        "q25_ms": round(times[len(times) // 4], 3),
+        "max_ms": round(times[-1], 3),
+        "n_ticks": n_ticks,
+        "dispatches_last_tick": dispatches_max,
+    }
+
+
+def config_15_tick_trajectory() -> dict:
+    """Tick-latency trajectory (config 15): the fused Pallas resident tick
+    vs the XLA op-graph tick, integrated (delta pack -> kernel -> resolved
+    readback), over a shape ladder — the ROADMAP item-3 capacity story.
+
+    MEDIAN per-tick wall time headlines each shape (ADVICE r5 estimator
+    rule: the median is the compliance number, quartiles are context).
+    The fused leg also pins the one-dispatch-per-tick contract live
+    (``dispatches_last_tick`` must be 1) and feeds a TickProfiler whose
+    rendered exposition is strict-parsed — the bench's /metrics verdict.
+
+    The capacity DRYRUN runs ONE tick per leg at the 500k x 32k ROADMAP
+    shape: completion is the assertion (the rank path is sort-based and
+    the fused auction bid streams O(T+S), so no [T, S] buffer exists to
+    OOM — materializing one would be 500k x 256k x 4 B = 512 GB).
+
+    On CPU the fused leg runs under the Pallas interpreter (the parity
+    contract's form — latency numbers there compare interpreter overhead,
+    not kernels; the TPU capture is the headline artifact). Shapes via
+    TPU_FAAS_BENCH_TICK_SHAPES="T,W;T,W", rank dryrun via
+    TPU_FAAS_BENCH_TICK_DRYRUN="T,W", fused-AUCTION dryrun via
+    TPU_FAAS_BENCH_TICK_AUCTION_DRYRUN="T,W" (empty string disables
+    either), reps via TPU_FAAS_BENCH_TICK_REPS, sharded winner-resolve
+    leg via TPU_FAAS_BENCH_TICK_MULTICHIP=1 (needs >= 2 devices)."""
+    import os
+
+    import jax
+
+    from tpu_faas.obs.expofmt import parse_exposition
+    from tpu_faas.obs.metrics import MetricsRegistry, render
+    from tpu_faas.obs.profile import TickProfiler
+
+    fused = "fused" if jax.default_backend() == "tpu" else "fused_interpret"
+    shapes = [
+        tuple(int(x) for x in part.split(","))
+        for part in os.environ.get(
+            "TPU_FAAS_BENCH_TICK_SHAPES", "50000,4096;200000,16384"
+        ).split(";")
+        if part
+    ]
+    dry_env = os.environ.get("TPU_FAAS_BENCH_TICK_DRYRUN", "500000,32768")
+    n_ticks = int(os.environ.get("TPU_FAAS_BENCH_TICK_REPS", "5"))
+
+    registry = MetricsRegistry()
+    profiler = TickProfiler(registry)
+    rows = []
+    for T, W in shapes:
+        # one seed per shape, SHARED by both legs: identical instance
+        xla = _tick_leg("xla", T, W, n_ticks, seed=15 + T)
+        fus = _tick_leg(fused, T, W, n_ticks, seed=15 + T)
+        profiler.observe_shape(
+            tasks=T, workers=W, slots=8,
+            signature=("bench15", T, W, fused),
+        )
+        profiler.note_device_dispatches(fus["dispatches_last_tick"])
+        rows.append(
+            {
+                "tasks": T,
+                "workers": W,
+                "xla": xla,
+                "fused": fus,
+                "fused_vs_xla": round(
+                    xla["median_ms"] / max(fus["median_ms"], 1e-9), 3
+                ),
+                "one_dispatch_per_tick": fus["dispatches_last_tick"] == 1,
+            }
+        )
+
+    dryrun = None
+    if dry_env:
+        dT, dW = (int(x) for x in dry_env.split(","))
+        t0 = time.perf_counter()
+        leg = _tick_leg(fused, dT, dW, 1, seed=15 + dT)
+        dryrun = {
+            "tasks": dT,
+            "workers": dW,
+            "backend": fused,
+            "tick_ms": leg["median_ms"],
+            "total_s": round(time.perf_counter() - t0, 2),
+            "one_dispatch_per_tick": leg["dispatches_last_tick"] == 1,
+            "ok": True,
+        }
+
+    # auction capacity leg: the O(T+S) claim is about the BID matrix,
+    # which the rank dryrun above never builds in the first place — this
+    # leg drives one fused AUCTION tick (the streamed in-kernel bid), at
+    # a shape whose per-round [T, S] block would be multi-GB if anything
+    # regressed into materializing it. Smaller than the rank dryrun
+    # because each streamed round still EVALUATES T x S cells.
+    auction_dry = None
+    adry_env = os.environ.get(
+        "TPU_FAAS_BENCH_TICK_AUCTION_DRYRUN", "50000,4096"
+    )
+    if adry_env:
+        aT, aW = (int(x) for x in adry_env.split(","))
+        t0 = time.perf_counter()
+        leg = _tick_leg(
+            fused, aT, aW, 1, seed=16 + aT, placement="auction"
+        )
+        auction_dry = {
+            "tasks": aT,
+            "workers": aW,
+            "backend": fused,
+            "bid_matrix_gb_never_built": round(
+                aT * aW * 8 * 4 / 2**30, 1
+            ),
+            "tick_ms": leg["median_ms"],
+            "total_s": round(time.perf_counter() - t0, 2),
+            "one_dispatch_per_tick": leg["dispatches_last_tick"] == 1,
+            "ok": True,
+        }
+
+    multichip = None
+    if os.environ.get("TPU_FAAS_BENCH_TICK_MULTICHIP", "0") == "1":
+        multichip = _tick_multichip_leg()
+
+    scrape_missing: list[str] = []
+    scrape_error = ""
+    try:
+        families = parse_exposition(render([registry]))
+        for fam in (
+            "tpu_faas_tick_device_dispatches_last",
+            "tpu_faas_tick_device_dispatches_total",
+            "tpu_faas_jit_recompiles_total",
+            "tpu_faas_device_ticks_total",
+            "tpu_faas_tick_shape",
+        ):
+            if fam not in families:
+                scrape_missing.append(fam)
+        scrape_ok = not scrape_missing
+    except Exception as exc:  # malformed exposition included
+        scrape_ok = False
+        scrape_error = f"{type(exc).__name__}: {exc}"
+
+    return {
+        "config": "tick-latency-trajectory",
+        "backend_fused": fused,
+        "jax_backend": jax.default_backend(),
+        "shapes": rows,
+        "dryrun_500k": dryrun,
+        "auction_dryrun": auction_dry,
+        "multichip": multichip,
+        "metrics_scrape_ok": scrape_ok,
+        "metrics_missing": scrape_missing,
+        "metrics_scrape_error": scrape_error,
+    }
+
+
+def _tick_multichip_leg() -> dict:
+    """Sharded winner-resolve dryrun: the explicit ring-permute auction
+    vs the GSPMD lexsort form on the same sharded problem — exact
+    assignment parity asserted, median solve time for both (MULTICHIP
+    artifact material; on the virtual CPU mesh the timing compares
+    lowering overhead, the parity is the point)."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(jax.devices()) < 2:
+        return {"skipped": True, "reason": "needs >= 2 devices"}
+    from tpu_faas.parallel.mesh import (
+        make_mesh,
+        replicate,
+        shard_task_arrays,
+        sharded_auction_placement,
+    )
+    from tpu_faas.sched.auction import auction_placement
+
+    n_dev = len(jax.devices())
+    rng = np.random.default_rng(16)
+    T, W, K = 4096, 512, 4
+    ts = rng.uniform(0.1, 5.0, T).astype(np.float32)
+    tv = np.ones(T, bool)
+    ws = rng.uniform(0.5, 4.0, W).astype(np.float32)
+    wf = rng.integers(1, K + 1, W).astype(np.int32)
+    wl = np.ones(W, bool)
+    mesh = make_mesh(n_dev)
+    ts_d, tv_d = shard_task_arrays(mesh, jnp.asarray(ts), jnp.asarray(tv))
+    ws_d, wf_d, wl_d = replicate(
+        mesh, jnp.asarray(ws), jnp.asarray(wf), jnp.asarray(wl)
+    )
+
+    def run_permute():
+        return sharded_auction_placement(
+            mesh, ts_d, tv_d, ws_d, wf_d, wl_d, max_slots=K
+        )
+
+    def run_gspmd():
+        return auction_placement(
+            ts_d, tv_d, ws_d, wf_d, wl_d, max_slots=K
+        )
+
+    res_p = run_permute()  # compile + parity reference
+    res_g = run_gspmd()
+    exact = bool(
+        np.array_equal(
+            np.asarray(res_p.assignment), np.asarray(res_g.assignment)
+        )
+    )
+    if not exact:
+        # the MULTICHIP artifact exists to PROVE bit-identical winner
+        # resolution — regenerating it with a silent parity break would
+        # commit a record that no longer proves anything
+        raise RuntimeError(
+            "permute winner-resolve diverged from the GSPMD form at the "
+            "multichip dryrun shape — parity regression"
+        )
+
+    def med_ms(fn) -> float:
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn().assignment)
+            times.append((time.perf_counter() - t0) * 1e3)
+        return round(sorted(times)[1], 2)
+
+    return {
+        "n_devices": n_dev,
+        "tasks": T,
+        "workers": W,
+        "rounds": int(res_p.n_rounds),
+        "assignment_exact_parity": exact,
+        "permute_solve_ms_median": med_ms(run_permute),
+        "gspmd_solve_ms_median": med_ms(run_gspmd),
+    }
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -1965,4 +2274,5 @@ CONFIGS = {
     "12": config_12_latency,
     "13": config_13_graph_pipeline,
     "14": config_14_fleet,
+    "15": config_15_tick_trajectory,
 }

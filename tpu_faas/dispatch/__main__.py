@@ -121,6 +121,16 @@ def main(argv: list[str] | None = None) -> None:
         "the global mesh)",
     )
     ap.add_argument(
+        "--tick-backend", default=None,
+        choices=("xla", "fused", "fused_interpret"),
+        help="tpu-push --resident: which tick kernel serves — xla (the "
+        "jitted op-graph, default) or fused (the single-pallas_call tick: "
+        "state in VMEM, one device dispatch per tick, zero intra-tick "
+        "host syncs; fused_interpret runs the same kernel under the "
+        "Pallas interpreter for CPU debugging/CI). Default from "
+        "TPU_FAAS_TICK_BACKEND. Single-device only",
+    )
+    ap.add_argument(
         "--mesh", type=int, default=0, metavar="N",
         help="tpu-push: shard the pending-task axis over N devices "
         "(jax.sharding.Mesh; all placements — rank, sinkhorn, auction — "
@@ -348,6 +358,7 @@ def main(argv: list[str] | None = None) -> None:
             lease_timeout=ns.lease_timeout,
             multihost=ns.multihost,
             resident=ns.resident,
+            tick_backend=ns.tick_backend,
             estimate_runtimes=not ns.no_runtime_learning,
         )
     if ns.mode == "tpu-push" and ns.multihost:

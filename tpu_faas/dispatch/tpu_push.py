@@ -79,6 +79,7 @@ class TpuPushDispatcher(TaskDispatcher):
         shared: bool = False,
         multihost: bool = False,
         resident: bool = False,
+        tick_backend: str | None = None,
         estimate_runtimes: bool = True,
     ) -> None:
         super().__init__(
@@ -155,6 +156,9 @@ class TpuPushDispatcher(TaskDispatcher):
             # (round-4; round 3 forced a choice). use_priority keeps
             # client priority hints working (all-zero priorities reduce to
             # plain FCFS, so the flag costs one [T] argsort, not semantics)
+            # tick_backend: None resolves via TPU_FAAS_TICK_BACKEND (xla
+            # default); "fused"/"fused_interpret" runs the ONE-pallas_call
+            # tick (sched/pallas_fused.py) — single-device only
             self.arrays = ResidentScheduler(
                 max_workers=max_workers,
                 max_pending=max_pending,
@@ -165,6 +169,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 placement=placement,
                 use_priority=True,
                 mesh_devices=mesh_devices,
+                tick_backend=tick_backend,
             )
             #: tasks currently living in the device pending set (or queued
             #: into it): task_id -> PendingTask, the payload source at
@@ -936,6 +941,13 @@ class TpuPushDispatcher(TaskDispatcher):
             # each batched write family
             "store_round_trips_last_tick": self._tick_round_trips,
             "batched_write_sizes": dict(self._batch_sizes),
+            # resident-only: compiled-callable dispatches issued by the
+            # last tick (fused steady state pins this at exactly 1) and
+            # which tick kernel is serving (xla | fused | fused_interpret)
+            "device_dispatches_last_tick": getattr(
+                self.arrays, "device_dispatches_last_tick", None
+            ),
+            "tick_backend": getattr(self.arrays, "tick_backend", None),
             "estimator": (
                 self.estimator.stats() if self.estimator is not None else None
             ),
@@ -1373,10 +1385,17 @@ class TpuPushDispatcher(TaskDispatcher):
             signature=(
                 "resident", a.max_pending, a.max_workers, a.max_slots,
                 getattr(a, "placement", ""),
+                getattr(a, "tick_backend", "xla"),
             ),
         )
         with self.tracer.span("device_tick"), self.profiler.tick_capture():
             out = a.tick_resident()
+        # the one-dispatch-per-tick contract, observable: the fused tick
+        # issues exactly 1 compiled-callable dispatch in steady state
+        # (overflow bursts add one flush each) — see sched/resident.py
+        self.profiler.note_device_dispatches(
+            getattr(a, "device_dispatches_last_tick", 0)
+        )
         # Drain EVERY unresolved entry, not just one: an arrival burst
         # beyond KA makes tick_resident emit several flush packets plus the
         # main tick, and resolving one-per-call would put the dispatcher

@@ -46,6 +46,15 @@ class TickProfiler:
         self._ticks = registry.counter(
             "tpu_faas_device_ticks_total", "Device scheduler ticks run"
         )
+        self._dispatches_last = registry.gauge(
+            "tpu_faas_tick_device_dispatches_last",
+            "Compiled-callable dispatches issued by the last resident tick "
+            "(fused steady state: exactly 1; each overflow flush adds 1)",
+        )
+        self._dispatches = registry.counter(
+            "tpu_faas_tick_device_dispatches_total",
+            "Compiled-callable dispatches issued by resident ticks",
+        )
         self._seen: set[tuple] = set()
         self._trace_dir = os.environ.get(PROFILE_DIR_ENV) or None
         try:
@@ -61,6 +70,14 @@ class TickProfiler:
     @property
     def n_signatures(self) -> int:
         return len(self._seen)
+
+    def note_device_dispatches(self, n: int) -> None:
+        """Record one resident tick's compiled-callable dispatch count
+        (``ResidentScheduler.device_dispatches_last_tick``) — the
+        observable form of the one-dispatch-per-tick contract."""
+        self._dispatches_last.set(n)
+        if n > 0:
+            self._dispatches.inc(n)
 
     def observe_shape(
         self, *, tasks: int, workers: int, slots: int, signature: tuple

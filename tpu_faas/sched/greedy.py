@@ -29,8 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@partial(jax.jit, static_argnames=("max_slots",))
-def rank_match_placement(
+def rank_match_placement_impl(
     task_size: jnp.ndarray,  # f32[T]
     task_valid: jnp.ndarray,  # bool[T]
     worker_speed: jnp.ndarray,  # f32[W]
@@ -98,6 +97,13 @@ def rank_match_placement(
 
     assignment = jnp.full((T,), -1, dtype=jnp.int32)
     return assignment.at[paired_tasks].set(paired_workers)
+
+
+#: Public jitted form; the un-jitted ``_impl`` is what the fused resident
+#: Pallas kernel traces through (no pjit primitive inside a kernel body).
+rank_match_placement = partial(jax.jit, static_argnames=("max_slots",))(
+    rank_match_placement_impl
+)
 
 
 def host_greedy_reference(

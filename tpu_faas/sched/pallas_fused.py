@@ -1,0 +1,256 @@
+"""The fused Pallas resident tick: one kernel, one dispatch, state in VMEM.
+
+The XLA resident tick (`sched/resident.py::_resident_tick`) is already a
+single jitted executable, but it is an *op graph*: XLA schedules each phase
+(delta scatter, liveness, the solver's bid/scale loop, compaction) as
+separate fusions with HBM round trips between them, and on some runtimes
+splits the auction's `while_loop` rounds into separate device launches.
+This module compiles the SAME step as ONE ``pl.pallas_call``:
+
+- every piece of resident state (pending sizes/valid/priority, per-worker
+  heartbeat/free/speed/active, the in-flight table, auction slot prices,
+  the refresh flag) is a kernel ref — VMEM on TPU — read once at entry and
+  written once at exit, with ``input_output_aliases`` pinning each state
+  output onto its input buffer so the state never moves between ticks;
+- the solver loop runs INSIDE the kernel: the auction's per-round top-2
+  bid uses the O(T+S) streamed form (``bid_top2_stream_impl`` — the same
+  tile/merge discipline as the standalone Pallas bid kernel, expressed as
+  plain loops because ``pallas_call`` cannot nest), so no [T, S] block
+  ever exists, in VMEM or HBM;
+- the only host traffic is the delta packet in (~15 KB) and the compacted
+  outputs out (~15 KB), both part of the single dispatch.
+
+The kernel body deliberately traces through the same ``_impl`` functions
+as the XLA oracle (``_resident_tick_impl`` down to ``_bid_block``), so the
+two paths cannot drift semantically; what the parity tests
+(tests/test_sched_fused.py, interpret mode on CPU) actually pin is the ref
+plumbing — packing, aliasing, dtype round trips — plus the streamed-vs-
+matrix bid difference on the auction path, under the same contract as the
+bid kernel: values within 1e-5, argmax equal where the top-2 gap exceeds
+it.
+
+VMEM sizing (the knob that decides whether a shape fits the fused path on
+a real chip): ``fused_state_bytes`` below computes the resident working
+set — 9 bytes/pending-task row, 16 bytes/worker, 4 bytes/in-flight
+slot, 4 bytes/price slot plus the packet and compaction buffers, plus
+~8 MB of streamed-bid tile scratch on the auction path. The 500k x 32k
+ROADMAP shape is ~6 MB on rank, inside a v5e core's 16 MB VMEM (~14 MB
+with the auction's tile scratch — at the guidance ceiling); anything
+past ~14 MB should stay on the XLA tick (HBM-resident state) or shrink
+``max_inflight``/``KP``. CPU CI runs the kernel under the
+Pallas interpreter (``interpret=True``), where the same jaxpr executes as
+ordinary XLA ops — that is the tested contract, exactly as for the bid
+kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tpu_faas.sched.pallas_kernels import _HAVE_PALLAS
+from tpu_faas.sched.resident import (
+    ResidentTickOutput,
+    _ResidentState,
+    _resident_tick_impl,
+)
+
+if _HAVE_PALLAS:  # pragma: no branch - both CI jaxlibs ship pallas
+    from jax.experimental import pallas as pl
+
+
+def fused_ok() -> bool:
+    """Is the fused kernel importable on this jaxlib? (Interpret mode needs
+    only pallas itself; compiled mode additionally needs a TPU backend,
+    which the caller selects via ``tick_backend='fused'``.)"""
+    return _HAVE_PALLAS
+
+
+def fused_state_bytes(
+    T: int,
+    W: int,
+    I: int,
+    max_slots: int,
+    KA: int = 512,
+    KP: int = 2048,
+    KR: int = 512,
+    packet_len: int = 0,
+    placement: str = "rank",
+) -> int:
+    """Resident working set of the fused kernel, in bytes — the number to
+    hold against a core's VMEM budget (16 MB on v5e) when sizing
+    ``max_pending``/``max_workers``/``max_inflight`` for the fused path.
+
+    ``placement="auction"`` adds the streamed bidding loop's live tile
+    scratch: one [STREAM_T, STREAM_S] f32 value block plus its iota/hash
+    intermediates (~8 MB at the shipped tile sizes — the same figure the
+    standalone bid kernel's tuning notes carry). The sort-based rank path
+    and the bucketed sinkhorn carry no comparable per-tile block."""
+    task = T * (4 + 1 + 4)  # sizes f32 + valid bool + prio i32
+    fleet = W * (4 + 4 + 1 + 4 + 1 + 1 + 1)  # hb/free/speed + 4 bool[W]
+    inflight = I * 4
+    price = W * max_slots * 4
+    out = (KP * 2 + KA + KR + 1) * 4
+    solver = 0
+    if placement == "auction":
+        from tpu_faas.sched.pallas_kernels import STREAM_S, STREAM_T
+
+        # one live [STREAM_T, STREAM_S] f32 tile working set (~8 MB incl.
+        # reused iota/hash intermediates — the bid kernel's tuning figure)
+        solver = STREAM_T * STREAM_S * 4
+    return task + fleet + inflight + price + out + packet_len * 4 + solver
+
+
+def fused_resident_tick(
+    packed,
+    st: _ResidentState,
+    *,
+    interpret=False,
+    **statics,
+):
+    """One device dispatch: apply the delta packet, run the full scheduler
+    step, compact the outputs — returns ``(ResidentTickOutput,
+    _ResidentState)`` exactly like the XLA ``_resident_tick``.
+
+    The compiled path DONATES the state pytree: ``input_output_aliases``
+    inside the pallas_call only updates buffers in place when the
+    surrounding jit donates them — un-donated entry parameters are
+    immutable and XLA would copy the whole state every tick, silently
+    voiding the VMEM-residency design. The interpreter path (CPU
+    debug/CI) skips donation: the CPU backend can't use it and would
+    warn on every compile."""
+    fn = _fused_tick_interpret if interpret else _fused_tick_donated
+    return fn(packed, st, interpret=interpret, **statics)
+
+
+def _fused_resident_tick_impl(
+    packed,  # f32[packet_len] (numpy fine: the jit moves it with the call)
+    st: _ResidentState,
+    *,
+    T, W, I, KA, KH, KF, KI, KS, KB, KP, KR,
+    max_slots, placement, use_priority, interpret=False,
+):
+    if not _HAVE_PALLAS:
+        raise RuntimeError(
+            "pallas unavailable in this jaxlib; use tick_backend='xla'"
+        )
+    statics = dict(
+        T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS, KB=KB,
+        use_priority=use_priority,
+    )
+
+    def _value_step(packed_v, *state_leaves):
+        """The whole tick on VALUES — traced once by make_jaxpr below so
+        trace-time constant arrays (the solvers build a few small ones,
+        e.g. the lexsort segment seed) are LIFTED out: pallas_call cannot
+        capture non-scalar constants, so they ride in as extra operands."""
+        st_in = _ResidentState(*state_leaves[:-1], state_leaves[-1][0])
+        res, new = _resident_tick_impl(
+            packed_v, st_in, **statics, KP=KP, KR=KR,
+            max_slots=max_slots, placement=placement, bid_backend="stream",
+        )
+        return (
+            res.placed_slots, res.placed_rows, res.arrival_slots,
+            res.redispatch_slots, res.purged, res.live,
+            jnp.reshape(res.n_pending, (1,)),
+            new.sizes, new.valid, new.prio, new.last_hb, new.free,
+            new.inflight, new.prev_live, new.speed, new.active, new.price,
+            jnp.reshape(new.refresh, (1,)),
+        )
+
+    S = W * max_slots
+    f32, i32, b = jnp.float32, jnp.int32, jnp.bool_
+    in_specs = (
+        jax.ShapeDtypeStruct(jnp.shape(packed), f32),
+        jax.ShapeDtypeStruct((T,), f32),  # sizes
+        jax.ShapeDtypeStruct((T,), b),  # valid
+        jax.ShapeDtypeStruct((T,), i32),  # prio
+        jax.ShapeDtypeStruct((W,), f32),  # last_hb
+        jax.ShapeDtypeStruct((W,), i32),  # free
+        jax.ShapeDtypeStruct((I,), i32),  # inflight
+        jax.ShapeDtypeStruct((W,), b),  # prev_live
+        jax.ShapeDtypeStruct((W,), f32),  # speed
+        jax.ShapeDtypeStruct((W,), b),  # active
+        jax.ShapeDtypeStruct((S,), f32),  # price
+        jax.ShapeDtypeStruct((1,), b),  # refresh
+    )
+    closed = jax.make_jaxpr(_value_step)(*in_specs)
+    # zero-size consts (e.g. an empty concat seed) carry no data and a
+    # 0-length ref is not a legal pallas operand — they are rebuilt
+    # in-kernel; everything else rides in as (at least 1-D) operands
+    consts = [
+        jnp.atleast_1d(jnp.asarray(c)) for c in closed.consts if c.size
+    ]
+    n_in = len(in_specs)
+
+    def kernel(*refs):
+        in_vals = [r[...] for r in refs[:n_in]]
+        const_refs = iter(refs[n_in : n_in + len(consts)])
+        const_vals = [
+            jnp.zeros(jnp.shape(c), c.dtype)
+            if c.size == 0
+            else jnp.reshape(next(const_refs)[...], jnp.shape(c))
+            for c in closed.consts
+        ]
+        out_vals = jax.core.eval_jaxpr(closed.jaxpr, const_vals, *in_vals)
+        for ref, val in zip(refs[n_in + len(consts) :], out_vals):
+            ref[...] = val
+    out_shape = (
+        jax.ShapeDtypeStruct((KP,), i32),  # placed_slots
+        jax.ShapeDtypeStruct((KP,), i32),  # placed_rows
+        jax.ShapeDtypeStruct((KA,), i32),  # arrival_slots
+        jax.ShapeDtypeStruct((KR,), i32),  # redispatch_slots
+        jax.ShapeDtypeStruct((W,), b),  # purged
+        jax.ShapeDtypeStruct((W,), b),  # live
+        jax.ShapeDtypeStruct((1,), i32),  # n_pending
+        jax.ShapeDtypeStruct((T,), f32),  # sizes
+        jax.ShapeDtypeStruct((T,), b),  # valid
+        jax.ShapeDtypeStruct((T,), i32),  # prio
+        jax.ShapeDtypeStruct((W,), f32),  # last_hb
+        jax.ShapeDtypeStruct((W,), i32),  # free
+        jax.ShapeDtypeStruct((I,), i32),  # inflight
+        jax.ShapeDtypeStruct((W,), b),  # prev_live
+        jax.ShapeDtypeStruct((W,), f32),  # speed
+        jax.ShapeDtypeStruct((W,), b),  # active
+        jax.ShapeDtypeStruct((S,), f32),  # price
+        jax.ShapeDtypeStruct((1,), b),  # refresh
+    )
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        # state input k (operand k, packet is 0) writes output 7 + (k - 1):
+        # each state buffer is updated in place across ticks. Lifted trace
+        # constants ride after the state operands and alias nothing.
+        input_output_aliases={k: 6 + k for k in range(1, 12)},
+        interpret=interpret,
+    )(
+        jnp.asarray(packed, jnp.float32),
+        st.sizes, st.valid, st.prio, st.last_hb, st.free, st.inflight,
+        st.prev_live, st.speed, st.active, st.price,
+        jnp.reshape(st.refresh, (1,)),
+        *consts,
+    )
+    res = ResidentTickOutput(
+        outs[0], outs[1], outs[2], outs[3], outs[4], outs[5], outs[6][0]
+    )
+    new_state = _ResidentState(
+        outs[7], outs[8], outs[9], outs[10], outs[11], outs[12], outs[13],
+        outs[14], outs[15], outs[16], outs[17][0],
+    )
+    return res, new_state
+
+
+_STATICS = (
+    "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP", "KR",
+    "max_slots", "placement", "use_priority", "interpret",
+)
+#: compiled form: state donated so the kernel's aliases update in place
+_fused_tick_donated = partial(
+    jax.jit, static_argnames=_STATICS, donate_argnums=(1,)
+)(_fused_resident_tick_impl)
+#: interpreter form (CPU): donation unusable there — plain call
+_fused_tick_interpret = partial(jax.jit, static_argnames=_STATICS)(
+    _fused_resident_tick_impl
+)

@@ -237,6 +237,121 @@ def bid_top2_pallas(
     return v1[:, 0], best[:, 0], v2[:, 0]
 
 
+#: Tile sizes for the STREAMED XLA top-2 (`bid_top2_stream`): the same
+#: blocking as the Pallas grid, expressed as `lax.fori_loop`s over
+#: `dynamic_slice` tiles so the whole computation is plain traced ops — the
+#: form that can run INSIDE another Pallas kernel (pallas_call cannot
+#: nest) and on any backend. Working set per step is one
+#: [STREAM_T, STREAM_S] value block (~8 MB f32), total memory O(T + S).
+STREAM_T = 1024
+STREAM_S = 2048
+
+
+def bid_top2_stream_impl(
+    task_size: jnp.ndarray,  # f32[T]
+    slot_inv_speed: jnp.ndarray,  # f32[S]
+    slot_valid: jnp.ndarray,  # f32[S] 1.0 = usable
+    price: jnp.ndarray,  # f32[S]
+    jitter_scale: jnp.ndarray,  # f32 scalar
+    row_offset=0,  # global id of row 0 (sharded callers pass their shard base)
+    n_slots_total: int | None = None,  # jitter-hash stride (default S)
+):
+    """O(T+S)-memory top-2 bid in plain XLA ops, any (T, S).
+
+    Semantically identical to ``bid_top2_xla`` (same ``_bid_block``
+    elementwise formula, same global-argmax-first tie rule) but never
+    materializes [T, S]: a double ``fori_loop`` streams [STREAM_T,
+    STREAM_S] tiles and folds each slot chunk into a running per-row
+    top-2 with exactly the Pallas kernel's accumulator merge. This is
+
+    - the bid form the FUSED resident kernel uses (its grid is already
+      spoken for by the tick phases, and ``pallas_call`` cannot nest), and
+    - the capacity fallback for shapes whose matrix must never exist
+      (500k x 256k slots = 500 GB) on backends without the Pallas kernel.
+
+    Shapes need no tiling alignment: both axes are zero-padded to tile
+    multiples, padded slots carry valid=0 (their hash cells compute but
+    mask to -inf) and padded task rows are sliced off the outputs.
+
+    ``row_offset``/``n_slots_total`` keep the tie-break jitter hash GLOBAL
+    when only a task shard is in hand (parallel/mesh.py's permute winner
+    resolve): row ids open at the shard's base and the hash stride is the
+    full problem's S, so every device computes bit-identical cell values
+    to the single-device paths.
+    """
+    T = task_size.shape[0]
+    S = slot_inv_speed.shape[0]
+    hash_S = S if n_slots_total is None else n_slots_total
+    n_t = -(-T // STREAM_T)
+    n_s = -(-S // STREAM_S)
+    Tp, Sp = n_t * STREAM_T, n_s * STREAM_S
+    ts = jnp.zeros(Tp, jnp.float32).at[:T].set(task_size)
+    inv = jnp.zeros(Sp, jnp.float32).at[:S].set(slot_inv_speed)
+    val = jnp.zeros(Sp, jnp.float32).at[:S].set(slot_valid)
+    pr = jnp.zeros(Sp, jnp.float32).at[:S].set(price)
+    jit_f = jitter_scale.astype(jnp.float32)
+
+    def tile(ti, out):
+        v1_all, b_all, v2_all = out
+        t0 = ti * STREAM_T
+        ts_col = jax.lax.dynamic_slice(ts, (t0,), (STREAM_T,))[:, None]
+        rows = row_offset + t0 + jax.lax.broadcasted_iota(
+            jnp.int32, (STREAM_T, STREAM_S), 0
+        )
+
+        def chunk(j, carry):
+            v1o, bo, v2o = carry
+            s0 = j * STREAM_S
+            inv_row = jax.lax.dynamic_slice(inv, (s0,), (STREAM_S,))[None, :]
+            val_row = jax.lax.dynamic_slice(val, (s0,), (STREAM_S,))[None, :]
+            pr_row = jax.lax.dynamic_slice(pr, (s0,), (STREAM_S,))[None, :]
+            cols = s0 + jax.lax.broadcasted_iota(
+                jnp.int32, (STREAM_T, STREAM_S), 1
+            )
+            v = _bid_block(
+                ts_col, inv_row, pr_row, val_row, rows, cols, jit_f, hash_S
+            )
+            v1c, bc, v2c = _top2_block(v, s0)
+            # identical merge to _bid_top2_kernel: strict '>' keeps the
+            # earlier chunk on ties == global argmax-first
+            take = v1c > v1o
+            v1 = jnp.where(take, v1c, v1o)
+            b = jnp.where(take, bc, bo)
+            v2 = jnp.maximum(jnp.maximum(v2o, v2c), jnp.minimum(v1o, v1c))
+            return v1, b, v2
+
+        v1, b, v2 = jax.lax.fori_loop(
+            0,
+            n_s,
+            chunk,
+            (
+                jnp.full((STREAM_T, 1), -jnp.inf, jnp.float32),
+                jnp.zeros((STREAM_T, 1), jnp.int32),
+                jnp.full((STREAM_T, 1), -jnp.inf, jnp.float32),
+            ),
+        )
+        return (
+            jax.lax.dynamic_update_slice(v1_all, v1[:, 0], (t0,)),
+            jax.lax.dynamic_update_slice(b_all, b[:, 0], (t0,)),
+            jax.lax.dynamic_update_slice(v2_all, v2[:, 0], (t0,)),
+        )
+
+    v1, best, v2 = jax.lax.fori_loop(
+        0,
+        n_t,
+        tile,
+        (
+            jnp.full(Tp, -jnp.inf, jnp.float32),
+            jnp.zeros(Tp, jnp.int32),
+            jnp.full(Tp, -jnp.inf, jnp.float32),
+        ),
+    )
+    return v1[:T], best[:T], v2[:T]
+
+
+bid_top2_stream = jax.jit(bid_top2_stream_impl)
+
+
 def pallas_ok(T: int, S: int) -> bool:
     """Can the fused kernel handle this padded problem?"""
     return _HAVE_PALLAS and T % TILE_T == 0 and S % CHUNK_S == 0
@@ -269,15 +384,24 @@ def bid_top2(
     jitter_scale: jnp.ndarray,
     backend: str = "auto",
 ):
-    """Backend-dispatching top-2 bid. ``backend``: auto | xla | pallas |
-    pallas_interpret. 'auto' resolves at trace time by problem size
-    (``resolve_backend``): the XLA matrix path where the [T, S] matrix
-    fits comfortably (faster there), the streaming kernel in the
-    memory-bound regime where XLA's hoisted matrix OOMs the chip."""
+    """Backend-dispatching top-2 bid. ``backend``: auto | xla | stream |
+    pallas | pallas_interpret. 'auto' resolves at trace time by problem
+    size (``resolve_backend``): the XLA matrix path where the [T, S]
+    matrix fits comfortably (faster there), the streaming kernel in the
+    memory-bound regime where XLA's hoisted matrix OOMs the chip.
+    'stream' is the plain-ops O(T+S) form (``bid_top2_stream``) — any
+    backend, any shape, nestable inside a Pallas kernel."""
     if backend == "auto":
         backend = resolve_backend(task_size.shape[0], slot_inv_speed.shape[0])
     if backend == "xla":
         return bid_top2_xla(
+            task_size, slot_inv_speed, slot_valid, price, jitter_scale
+        )
+    if backend == "stream":
+        # impl form, not the jitted wrapper: this branch is what the fused
+        # resident kernel traces through, and a pjit primitive inside a
+        # pallas_call body does not lower
+        return bid_top2_stream_impl(
             task_size, slot_inv_speed, slot_valid, price, jitter_scale
         )
     if backend in ("pallas", "pallas_interpret"):

@@ -53,7 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_faas.sched.state import SchedulerArrays, scheduler_tick
+from tpu_faas.sched.state import SchedulerArrays, scheduler_tick_impl
 
 
 class ResidentTickOutput(NamedTuple):
@@ -205,14 +205,8 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     )
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "use_priority",
-    ),
-)
-def _flush_kernel(packed, st, *, T, W, I, KA, KH, KF, KI, KS, KB,
-                  use_priority):
+def _flush_kernel_impl(packed, st, *, T, W, I, KA, KH, KF, KI, KS, KB,
+                       use_priority):
     """Delta application alone — used when a tick's deltas exceed one
     packet's capacity (mass registration, adoption bursts): the overflow is
     drained in extra small dispatches, the final packet rides the fused
@@ -224,27 +218,32 @@ def _flush_kernel(packed, st, *, T, W, I, KA, KH, KF, KI, KS, KB,
     return st, arrival_slots
 
 
-@partial(
+_flush_kernel = partial(
     jax.jit,
     static_argnames=(
-        "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP", "KR",
-        "max_slots", "placement", "use_priority",
+        "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "use_priority",
     ),
-)
-def _resident_tick(
+)(_flush_kernel_impl)
+
+
+def _resident_tick_impl(
     packed,
     st: _ResidentState,
     *,
     T, W, I, KA, KH, KF, KI, KS, KB, KP, KR,
-    max_slots, placement, use_priority,
+    max_slots, placement, use_priority, bid_backend="auto",
 ):
+    """The full resident step as plain traced ops — jitted below for the
+    XLA path, traced INSIDE one pallas_call by sched/pallas_fused.py (the
+    fused path passes ``bid_backend="stream"`` so the auction's per-round
+    bids stay O(T+S) with no [T, S] block in the kernel)."""
     st, arrival_slots, now = _apply_deltas(
         packed, st, T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS,
         KB=KB, use_priority=use_priority,
     )
     hb_age = now - st.last_hb
     auction = placement == "auction"
-    out = scheduler_tick(
+    out = scheduler_tick_impl(
         st.sizes,
         st.valid,
         st.speed,
@@ -259,6 +258,7 @@ def _resident_tick(
         placement=placement,
         auction_price=st.price if auction else None,
         auction_refresh=st.refresh if auction else None,
+        bid_backend=bid_backend,
     )
 
     # -- compact placements to KP (slot, row) pairs ------------------------
@@ -306,6 +306,15 @@ def _resident_tick(
         valid_next.sum().astype(jnp.int32),
     )
     return res, new_state
+
+
+_resident_tick = partial(
+    jax.jit,
+    static_argnames=(
+        "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP", "KR",
+        "max_slots", "placement", "use_priority", "bid_backend",
+    ),
+)(_resident_tick_impl)
 
 
 @dataclass
@@ -364,9 +373,40 @@ class ResidentScheduler(SchedulerArrays):
         KB: int | None = None,
         KP: int | None = None,
         KR: int | None = None,
+        tick_backend: str | None = None,
         **kw,
     ):
         super().__init__(*args, **kw)
+        # tick backend: "xla" (the jitted op-graph oracle), "fused" (ONE
+        # pallas_call per tick, state in VMEM refs), "fused_interpret"
+        # (the same kernel under the Pallas interpreter — CPU CI's parity
+        # form). Default from TPU_FAAS_TICK_BACKEND, falling back to xla.
+        import os as _os
+
+        from_env = tick_backend is None
+        if from_env:
+            tick_backend = _os.environ.get("TPU_FAAS_TICK_BACKEND", "xla")
+        if tick_backend not in ("xla", "fused", "fused_interpret"):
+            raise ValueError(f"unknown tick backend {tick_backend!r}")
+        if tick_backend != "xla" and (
+            self.mesh is not None or self.multihost is not None
+        ):
+            # the fused kernel is the single-device fast path; the mesh /
+            # multihost layouts keep the XLA tick (their sharded winner
+            # resolve lives in parallel/mesh.py). A fleet-wide env default
+            # downgrades quietly; an explicit constructor ask is an error.
+            if not from_env:
+                raise ValueError(
+                    "tick_backend='fused' is single-device only; mesh/"
+                    "multihost resident fleets use the XLA tick"
+                )
+            tick_backend = "xla"
+        self.tick_backend = tick_backend
+        #: compiled-callable dispatches issued by the LAST tick_resident()
+        #: call (steady state: exactly 1 — the one fused kernel; overflow
+        #: bursts add one flush dispatch per surplus packet) and ever.
+        self.device_dispatches_last_tick: int = 0
+        self.device_dispatches_total: int = 0
         for name, v in (("KA", KA), ("KH", KH), ("KF", KF), ("KI", KI),
                         ("KS", KS), ("KB", KB), ("KP", KP), ("KR", KR)):
             if v is not None:
@@ -591,6 +631,15 @@ class ResidentScheduler(SchedulerArrays):
 
     # -- kernel dispatch (multihost-resident overrides these to broadcast
     # the packet to follower processes first) ------------------------------
+    def _count_dispatch(self) -> None:
+        # called at the tick_resident CALL SITES, not inside _run_tick/
+        # _run_flush: subclasses (multihost resident) override those to
+        # broadcast+apply, and counting here would silently read 0 there
+        # — the exact value the OPERATIONS triage row reads as "not
+        # ticking at all"
+        self.device_dispatches_last_tick += 1
+        self.device_dispatches_total += 1
+
     def _run_flush(self, packet: np.ndarray):
         packet[7] = _OP_FLUSH
         return _flush_kernel(
@@ -598,6 +647,22 @@ class ResidentScheduler(SchedulerArrays):
         )
 
     def _run_tick(self, packet: np.ndarray):
+        if self.tick_backend != "xla":
+            from tpu_faas.sched.pallas_fused import fused_resident_tick
+
+            # ONE pallas_call: the packet rides the dispatch (jit moves it
+            # host->device as part of the call), state buffers are aliased
+            # in place, and nothing is read back here
+            return fused_resident_tick(
+                packet,
+                self._r_state,
+                **self._statics(),
+                KP=self.KP,
+                KR=self.KR,
+                max_slots=self.max_slots,
+                placement=self.placement,
+                interpret=(self.tick_backend == "fused_interpret"),
+            )
         return _resident_tick(
             self._put_repl(packet),
             self._r_state,
@@ -611,6 +676,7 @@ class ResidentScheduler(SchedulerArrays):
     # -- the tick ----------------------------------------------------------
     def tick_resident(self, now: float | None = None) -> ResidentTickOutput:
         self._ensure_state()
+        self.device_dispatches_last_tick = 0
         if self._rejected:
             # bounced arrivals retry ahead of newer traffic, in their
             # original order (_rejected is FCFS; extendleft reverses)
@@ -665,6 +731,7 @@ class ResidentScheduler(SchedulerArrays):
             if_idx, if_val = if_idx[self.KI :], if_val[self.KI :]
             sp_idx, sp_val = sp_idx[self.KS :], sp_val[self.KS :]
             ac_idx, ac_val = ac_idx[self.KB :], ac_val[self.KB :]
+            self._count_dispatch()
             st, arrival_slots = self._run_flush(packet)
             self._r_state = st
             self._d_inflight = st.inflight
@@ -682,6 +749,7 @@ class ResidentScheduler(SchedulerArrays):
             now_rel, take, (hb_idx, hb_val), (fr_idx, fr_val),
             (if_idx, if_val), (sp_idx, sp_val), (ac_idx, ac_val),
         )
+        self._count_dispatch()
         out, st = self._run_tick(packet)
         self._r_state = st
         self._d_inflight = st.inflight

@@ -28,7 +28,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from tpu_faas.sched.greedy import rank_match_placement
+from tpu_faas.sched.greedy import rank_match_placement_impl
 
 
 class SinkhornResult(NamedTuple):
@@ -37,8 +37,7 @@ class SinkhornResult(NamedTuple):
     marginal_err: jnp.ndarray  # f32 scalar: max row-marginal violation
 
 
-@partial(jax.jit, static_argnames=("n_iters", "max_slots"))
-def sinkhorn_placement(
+def sinkhorn_placement_impl(
     task_size: jnp.ndarray,  # f32[T]
     task_valid: jnp.ndarray,  # bool[T]
     worker_speed: jnp.ndarray,  # f32[W]
@@ -102,6 +101,13 @@ def sinkhorn_placement(
         worker_live, max_slots,
     )
     return SinkhornResult(assignment, plan, marginal_err)
+
+
+#: Public jitted form; the un-jitted ``_impl`` is traceable inside a
+#: Pallas kernel body (see sched/pallas_fused.py).
+sinkhorn_placement = partial(jax.jit, static_argnames=("n_iters", "max_slots"))(
+    sinkhorn_placement_impl
+)
 
 
 def _sinkhorn_fg(
@@ -206,7 +212,7 @@ def _repair_candidates(
     )
     remaining = jnp.maximum(cap_i - used, 0)
     spilled = task_valid & (assignment < 0)
-    spill_assignment = rank_match_placement(
+    spill_assignment = rank_match_placement_impl(
         task_size, spilled, worker_speed, remaining, worker_live,
         max_slots=max_slots,
     )
@@ -417,13 +423,7 @@ def sinkhorn_placement_streamed(
     )
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "tau", "n_iters", "max_slots", "n_buckets", "chunk", "rounding",
-    ),
-)
-def sinkhorn_placement_bucketed(
+def sinkhorn_placement_bucketed_impl(
     task_size: jnp.ndarray,  # f32[T]
     task_valid: jnp.ndarray,  # bool[T]
     worker_speed: jnp.ndarray,  # f32[W]
@@ -616,3 +616,13 @@ def sinkhorn_placement_bucketed(
         jnp.zeros((0, W + 1), dtype=jnp.float32),
         col_err,
     )
+
+
+#: Public jitted form of the bucketed kernel (un-jitted ``_impl`` above
+#: for Pallas-kernel-body tracing, same split as the exact kernel).
+sinkhorn_placement_bucketed = partial(
+    jax.jit,
+    static_argnames=(
+        "tau", "n_iters", "max_slots", "n_buckets", "chunk", "rounding",
+    ),
+)(sinkhorn_placement_bucketed_impl)

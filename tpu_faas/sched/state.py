@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_faas.sched.greedy import rank_match_placement
+from tpu_faas.sched.greedy import rank_match_placement_impl
 
 
 @jax.jit
@@ -128,8 +128,7 @@ class TickOutput(NamedTuple):
     # np.bincount costs microseconds (see SchedulerArrays.assigned_counts)
 
 
-@partial(jax.jit, static_argnames=("max_slots", "placement"))
-def scheduler_tick(
+def scheduler_tick_impl(
     task_size: jnp.ndarray,  # f32[T]
     task_valid: jnp.ndarray,  # bool[T]
     worker_speed: jnp.ndarray,  # f32[W]
@@ -144,6 +143,7 @@ def scheduler_tick(
     placement: str = "rank",  # rank | auction | sinkhorn
     auction_price: jnp.ndarray | None = None,  # f32[W*max_slots] warm start
     auction_refresh: jnp.ndarray | None = None,  # bool scalar: resident carry
+    bid_backend: str = "auto",  # auction bid path: auto | xla | stream | ...
 ) -> TickOutput:
     # -- failure detection (reference purge_workers, device-side) ----------
     # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
@@ -166,17 +166,17 @@ def scheduler_tick(
     # (general costs / heterogeneous soft balancing) — they ignore
     # task_priority, whose admission-ordering contract is rank-specific
     if placement == "rank":
-        assignment = rank_match_placement(
+        assignment = rank_match_placement_impl(
             task_size, task_valid, worker_speed, worker_free, live,
             max_slots=max_slots, task_priority=task_priority,
         )
     elif placement == "auction":
-        from tpu_faas.sched.auction import auction_placement
+        from tpu_faas.sched.auction import auction_placement_impl
 
-        res = auction_placement(
+        res = auction_placement_impl(
             task_size, task_valid, worker_speed, worker_free, live,
             max_slots=max_slots, init_price=auction_price,
-            carry_refresh=auction_refresh,
+            carry_refresh=auction_refresh, backend=bid_backend,
         )
         return TickOutput(
             res.assignment, live, purged, redispatch, res.prices,
@@ -195,16 +195,18 @@ def scheduler_tick(
             # ms of the measured ~11.7 ms at 50k x 4k regardless of
             # n_iters), while bucket rounding is one [K, W] argmax + O(T)
             # gathers with test-pinned equal placement quality
-            from tpu_faas.sched.sinkhorn import sinkhorn_placement_bucketed
+            from tpu_faas.sched.sinkhorn import (
+                sinkhorn_placement_bucketed_impl,
+            )
 
-            assignment = sinkhorn_placement_bucketed(
+            assignment = sinkhorn_placement_bucketed_impl(
                 task_size, task_valid, worker_speed, worker_free, live,
                 max_slots=max_slots, n_iters=20, rounding="bucket",
             ).assignment
         else:
-            from tpu_faas.sched.sinkhorn import sinkhorn_placement
+            from tpu_faas.sched.sinkhorn import sinkhorn_placement_impl
 
-            assignment = sinkhorn_placement(
+            assignment = sinkhorn_placement_impl(
                 task_size, task_valid, worker_speed, worker_free, live,
                 max_slots=max_slots,
             ).assignment
@@ -212,6 +214,15 @@ def scheduler_tick(
         raise ValueError(f"unknown placement kernel {placement!r}")
 
     return TickOutput(assignment, live, purged, redispatch)
+
+
+#: Public jitted form. ``scheduler_tick_impl`` is the un-jitted core the
+#: fused resident Pallas kernel traces through (sched/pallas_fused.py) —
+#: a pjit primitive inside a pallas_call body does not lower, so the
+#: whole solver stack exposes ``_impl`` twins down to the bid kernel.
+scheduler_tick = partial(
+    jax.jit, static_argnames=("max_slots", "placement", "bid_backend")
+)(scheduler_tick_impl)
 
 
 @dataclass
