@@ -62,9 +62,9 @@ class FlakyStore:
         self._gate("set_status_many")
         return self.inner.set_status_many(status, items)
 
-    def finish_task_many(self, items):
+    def finish_task_many(self, items, inline_max: int = 0):
         self._gate("finish_task_many")
-        return self.inner.finish_task_many(items)
+        return self.inner.finish_task_many(items, inline_max=inline_max)
 
     def __getattr__(self, name):
         return getattr(self.inner, name)

@@ -1,0 +1,635 @@
+"""Express result lane: inline result announces end to end, event-driven
+intake, multiplexed + streaming waits, and the safety-poll fallback.
+
+Covers the four planes the lane spans:
+
+- **store**: the ``!r1:`` announce codec (oversized/NUL fallback), the
+  finish paths carrying ``inline_max`` (memory, RESP pipelined, sharded
+  routing), and the subscription readability fds behind event-driven
+  serve loops;
+- **gateway**: parked long-polls served from the inline forward with the
+  delivery-source counter proving it, the wait=0 immediate-reply contract
+  untouched, ``POST /results/wait`` and ``GET /events`` contracts
+  (early-terminal tasks, unknown ids, oversized fallback);
+- **SDK**: ``wait_many`` (sync + aio) and the pacing fix (a server-parked
+  round must not be followed by a client-side sleep);
+- **chaos**: the announce bus dropping every inline forward mid-burst
+  under the race monitor — every parked wait still resolves via the
+  safety poll, zero admitted-task loss, zero protocol violations.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import select
+import threading
+import time
+
+import pytest
+import requests
+
+from tpu_faas.client import FaaSClient
+from tpu_faas.client.aio import AsyncFaaSClient
+from tpu_faas.core.serialize import serialize
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store.base import (
+    RESULT_INLINE_MAX_BYTES,
+    RESULT_INLINE_PREFIX,
+    RESULTS_CHANNEL,
+    decode_result_announce,
+    encode_result_announce,
+)
+from tpu_faas.store.memory import MemoryStore
+from tpu_faas.store.racecheck import RaceCheckStore, RaceMonitor
+from tpu_faas.workloads import arithmetic
+
+
+# -- announce codec ----------------------------------------------------------
+
+
+def test_result_announce_codec_roundtrip():
+    payload = encode_result_announce("t1", "COMPLETED", "abc", 4096)
+    assert payload.startswith(RESULT_INLINE_PREFIX)
+    assert decode_result_announce(payload) == ("t1", "COMPLETED", "abc")
+
+
+def test_result_announce_oversized_falls_back_to_id():
+    big = "x" * (RESULT_INLINE_MAX_BYTES + 1)
+    assert encode_result_announce("t1", "COMPLETED", big, RESULT_INLINE_MAX_BYTES) == "t1"
+    # inline disabled (the default everywhere): always id-only
+    assert encode_result_announce("t1", "COMPLETED", "small") == "t1"
+
+
+def test_result_announce_nul_collision_falls_back():
+    # a result containing the frame separator must not produce a frame
+    # that decodes to the wrong payload — fall back to id-only instead
+    assert encode_result_announce("t1", "COMPLETED", "a\x00b", 4096) == "t1"
+
+
+def test_result_announce_malformed_frames_degrade_to_opaque_id():
+    # classic form: passthrough
+    assert decode_result_announce("plain-id") == ("plain-id", None, None)
+    # truncated inline frame: whole payload treated as an opaque id (the
+    # consumer's record probe then finds nothing and skips, like any
+    # garbage announce)
+    bad = RESULT_INLINE_PREFIX + "only-id-no-seps"
+    assert decode_result_announce(bad) == (bad, None, None)
+
+
+# -- store layer -------------------------------------------------------------
+
+
+def test_memory_finish_inline_announce_and_fileno_wake():
+    s = MemoryStore()
+    sub = s.subscribe(RESULTS_CHANNEL)
+    fd = sub.fileno()
+    assert fd is not None and sub.pollable_fds() == [fd]
+    s.create_task("t1", "F", "P")
+    s.finish_task("t1", "COMPLETED", "RES", inline_max=4096)
+    ready, _, _ = select.select([fd], [], [], 2.0)
+    assert ready, "publish did not signal the subscription self-pipe"
+    assert decode_result_announce(sub.get_message()) == (
+        "t1", "COMPLETED", "RES",
+    )
+    # drained: fd no longer readable, queue empty
+    assert sub.get_message() is None
+    ready, _, _ = select.select([fd], [], [], 0)
+    assert not ready
+    # default (inline off): the classic bare-id payload
+    s.create_task("t2", "F", "P")
+    s.finish_task("t2", "COMPLETED", "RES")
+    assert sub.get_message(timeout=1.0) == "t2"
+    sub.close()
+
+
+def test_resp_finish_many_inline_pipelined_and_fileno():
+    from tpu_faas.store.launch import make_store, start_store_thread
+
+    handle = start_store_thread()
+    try:
+        s = make_store(handle.url)
+        sub = s.subscribe(RESULTS_CHANNEL)
+        assert sub.fileno() is not None
+        for tid in ("a", "b", "c"):
+            s.create_task(tid, "F", "P")
+        rt0 = s.n_round_trips
+        s.finish_task_many(
+            [
+                ("a", "COMPLETED", "RA", False),
+                ("b", "FAILED", "RB", False),
+                # oversized: id-only announce, record still authoritative
+                ("c", "COMPLETED", "x" * 5000, False),
+            ],
+            inline_max=4096,
+        )
+        # the batched write + inline announces stay ONE pipelined round
+        assert s.n_round_trips - rt0 == 1
+        got = {}
+        deadline = time.monotonic() + 5
+        while len(got) < 3 and time.monotonic() < deadline:
+            msg = sub.get_message(timeout=0.5)
+            if msg is not None:
+                tid, status, result = decode_result_announce(msg)
+                got[tid] = (status, result)
+        assert got["a"] == ("COMPLETED", "RA")
+        assert got["b"] == ("FAILED", "RB")
+        assert got["c"] == (None, None)  # oversized fell back to id-only
+        # the store write is the authority either way
+        assert s.get_result("c") == ("COMPLETED", "x" * 5000)
+        sub.close()
+        s.close()
+    finally:
+        handle.stop()
+
+
+def test_inline_announce_replicates_verbatim_to_replica_subscribers():
+    """Replication passthrough: a replicated PUBLISH forwards the payload
+    verbatim, so inline result frames reach subscribers attached to the
+    REPLICA's bus intact — a promoted replica's gateways keep getting the
+    express forwards without re-negotiating anything."""
+    from tpu_faas.store.client import RespStore
+    from tpu_faas.store.launch import start_store_thread
+
+    p = start_store_thread()
+    r = None
+    try:
+        pc = RespStore(port=p.port)
+        r = start_store_thread(replica_of=("127.0.0.1", p.port))
+        rc = RespStore(port=r.port)
+        deadline = time.monotonic() + 10
+        while (
+            rc.info().get("role") != "replica"
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        sub = rc.subscribe(RESULTS_CHANNEL)
+        pc.create_task("t-repl", "F", "P")
+        pc.finish_task("t-repl", "COMPLETED", "RREPL", inline_max=4096)
+        msg = None
+        deadline = time.monotonic() + 10
+        while msg is None and time.monotonic() < deadline:
+            msg = sub.get_message(timeout=0.5)
+        assert msg is not None, "replica subscriber never saw the announce"
+        assert decode_result_announce(msg) == (
+            "t-repl", "COMPLETED", "RREPL",
+        )
+        sub.close()
+        pc.close()
+        rc.close()
+    finally:
+        if r is not None:
+            r.stop()
+        p.stop()
+
+
+def test_sharded_inline_announce_routes_by_embedded_task_id():
+    from tpu_faas.store.launch import make_store
+
+    s = make_store("memory://fresh;fresh")
+    sub = s.subscribe(RESULTS_CHANNEL)  # fan over both shards
+    s.create_task("t-route", "F", "P")
+    s.finish_task("t-route", "COMPLETED", "R", inline_max=4096)
+    msg = None
+    deadline = time.monotonic() + 2
+    while msg is None and time.monotonic() < deadline:
+        msg = sub.get_message(timeout=0.2)
+    assert msg is not None
+    assert decode_result_announce(msg) == ("t-route", "COMPLETED", "R")
+    # fan subscription exposes one pollable fd per shard once asked
+    assert len(sub.pollable_fds()) == 2
+    sub.close()
+    s.close()
+
+
+def test_racecheck_passthrough_observes_inline_finish():
+    monitor = RaceMonitor()
+    s = RaceCheckStore(MemoryStore(), monitor, actor="test")
+    sub = s.subscribe(RESULTS_CHANNEL)
+    s.create_task("t1", "F", "P")
+    s.set_status("t1", "RUNNING")
+    s.finish_task("t1", "COMPLETED", "R", inline_max=4096)
+    assert decode_result_announce(sub.get_message(timeout=1.0)) == (
+        "t1", "COMPLETED", "R",
+    )
+    assert monitor.errors == []
+    sub.close()
+
+
+# -- gateway contract --------------------------------------------------------
+
+
+@pytest.fixture()
+def gw():
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    yield handle, store
+    handle.stop()
+
+
+def _submit(handle, store) -> str:
+    fid = requests.post(
+        f"{handle.url}/register_function",
+        json={"name": "arithmetic", "payload": serialize(arithmetic)},
+    ).json()["function_id"]
+    return requests.post(
+        f"{handle.url}/execute_function",
+        json={"function_id": fid, "payload": serialize(((1,), {}))},
+    ).json()["task_id"]
+
+
+def _served_counts(handle) -> dict[str, int]:
+    out = {"inline": 0, "store": 0}
+    for line in requests.get(f"{handle.url}/metrics").text.splitlines():
+        if line.startswith("tpu_faas_gateway_result_served_total{"):
+            for src in out:
+                if f'source="{src}"' in line:
+                    out[src] = int(float(line.rsplit(" ", 1)[1]))
+    return out
+
+
+def test_long_poll_served_from_inline_forward(gw):
+    handle, store = gw
+    tid = _submit(handle, store)
+    out: dict = {}
+
+    def poll():
+        out["body"] = requests.get(
+            f"{handle.url}/result/{tid}", params={"wait": 10}, timeout=30
+        ).json()
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.4)  # parks (waiter armed) before the result lands
+    store.finish_task(tid, "COMPLETED", "RES", inline_max=4096)
+    t.join(timeout=10)
+    assert out["body"]["status"] == "COMPLETED"
+    assert out["body"]["result"] == "RES"
+    counts = _served_counts(handle)
+    assert counts["inline"] == 1 and counts["store"] == 0, counts
+
+
+def test_early_terminal_and_oversized_serve_from_store(gw):
+    handle, store = gw
+    # early-terminal: the record is terminal before the wait request
+    # arrives — the first store read answers (no announce involved)
+    tid = _submit(handle, store)
+    store.finish_task(tid, "COMPLETED", "EARLY", inline_max=4096)
+    body = requests.get(
+        f"{handle.url}/result/{tid}", params={"wait": 5}, timeout=30
+    ).json()
+    assert body["result"] == "EARLY"
+    assert _served_counts(handle)["store"] == 1
+
+    # oversized result: the announce fell back to id-only, so the woken
+    # poll re-reads the store — correct result, source=store
+    tid2 = _submit(handle, store)
+    big = "y" * 5000
+    out: dict = {}
+
+    def poll():
+        out["body"] = requests.get(
+            f"{handle.url}/result/{tid2}", params={"wait": 10}, timeout=30
+        ).json()
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.4)
+    store.finish_task(tid2, "COMPLETED", big, inline_max=4096)
+    t.join(timeout=10)
+    assert out["body"]["result"] == big
+    counts = _served_counts(handle)
+    assert counts["inline"] == 0 and counts["store"] == 2, counts
+
+
+def test_wait0_immediate_reply_contract_unchanged(gw):
+    handle, store = gw
+    tid = _submit(handle, store)
+    t0 = time.monotonic()
+    body = requests.get(f"{handle.url}/result/{tid}", timeout=10).json()
+    assert body["status"] == "QUEUED" and time.monotonic() - t0 < 5.0
+    # unknown id still 404s
+    r = requests.get(f"{handle.url}/result/nope", timeout=10)
+    assert r.status_code == 404
+
+
+def test_results_wait_contract(gw):
+    handle, store = gw
+    done_id = _submit(handle, store)
+    live_id = _submit(handle, store)
+    store.finish_task(done_id, "COMPLETED", "D", inline_max=4096)
+
+    # early-terminal answered immediately; live + unknown ids reported
+    r = requests.post(
+        f"{handle.url}/results/wait",
+        json={"task_ids": [done_id, live_id, "ghost"], "wait": 5},
+        timeout=30,
+    ).json()
+    assert r["results"][done_id] == {"status": "COMPLETED", "result": "D"}
+    assert r["pending"] == [live_id]
+    assert r["unknown"] == ["ghost"]
+
+    # a parked multi-wait wakes on the inline forward of ANY watched id
+    out: dict = {}
+
+    def wait():
+        out["r"] = requests.post(
+            f"{handle.url}/results/wait",
+            json={"task_ids": [live_id], "wait": 10},
+            timeout=30,
+        ).json()
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.4)
+    t0 = time.monotonic()
+    store.finish_task(live_id, "COMPLETED", "L", inline_max=4096)
+    t.join(timeout=10)
+    assert time.monotonic() - t0 < 1.5  # woken, not safety-polled
+    assert out["r"]["results"][live_id]["result"] == "L"
+
+    # duplicate ids collapse; validation errors are 400s
+    assert requests.post(
+        f"{handle.url}/results/wait", json={"task_ids": []}, timeout=10
+    ).status_code == 400
+    assert requests.post(
+        f"{handle.url}/results/wait",
+        json={"task_ids": [done_id], "wait": -1},
+        timeout=10,
+    ).status_code == 400
+    assert requests.post(
+        f"{handle.url}/results/wait", json={"wrong": 1}, timeout=10
+    ).status_code == 400
+
+
+def test_results_wait_unknown_then_delivered_not_double_reported(gw):
+    """Review regression: an id the probe found no record for, whose
+    create + inline-forwarded result land while the wait is parked, must
+    come back in ``results`` and NOT in ``unknown`` — a client treating
+    unknown as 'give up' would discard a completed task."""
+    handle, store = gw
+    fid = requests.post(
+        f"{handle.url}/register_function",
+        json={"name": "arithmetic", "payload": serialize(arithmetic)},
+    ).json()["function_id"]
+    late_id = "late-task-id"
+    out: dict = {}
+
+    def wait():
+        out["r"] = requests.post(
+            f"{handle.url}/results/wait",
+            json={"task_ids": [late_id], "wait": 10},
+            timeout=30,
+        ).json()
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.4)  # parked; first probe already marked the id unknown
+    store.create_task(late_id, serialize(arithmetic), serialize(((1,), {})))
+    store.finish_task(late_id, "COMPLETED", "LATE", inline_max=4096)
+    t.join(timeout=15)
+    r = out["r"]
+    assert r["results"].get(late_id, {}).get("result") == "LATE", r
+    assert late_id not in r["unknown"], r
+    assert late_id not in r["pending"], r
+
+
+def test_events_sse_stream_contract(gw):
+    handle, store = gw
+    early = _submit(handle, store)
+    late = _submit(handle, store)
+    store.finish_task(early, "COMPLETED", "E", inline_max=4096)
+
+    def finish_late():
+        time.sleep(0.5)
+        store.finish_task(late, "COMPLETED", "L", inline_max=4096)
+
+    threading.Thread(target=finish_late).start()
+    with requests.get(
+        f"{handle.url}/events",
+        params={"task_ids": f"{early},{late},ghost", "wait": 10},
+        stream=True,
+        timeout=30,
+    ) as resp:
+        assert resp.status_code == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        body = b"".join(resp.iter_content(None)).decode()
+    # one result frame per terminal task, closed by done with the unknowns
+    assert body.count("event: result") == 2
+    assert '"result": "E"' in body and '"result": "L"' in body
+    assert "event: done" in body
+    assert '"ghost"' in body.split("event: done")[1]
+    # validation: no ids = 400 (before any stream starts)
+    assert requests.get(f"{handle.url}/events", timeout=10).status_code == 400
+
+
+# -- SDK ---------------------------------------------------------------------
+
+
+def test_sdk_wait_many_sync(gw):
+    handle, store = gw
+    client = FaaSClient(handle.url)
+    a = _submit(handle, store)
+    b = _submit(handle, store)
+    store.finish_task(a, "COMPLETED", serialize(1), inline_max=4096)
+    results, pending, unknown = client.wait_many([a, b, "ghost"], wait=2.0)
+    assert a in results and results[a][0] == "COMPLETED"
+    assert pending == [b] and unknown == ["ghost"]
+
+
+def test_sdk_wait_many_async(gw):
+    handle, store = gw
+    a = _submit(handle, store)
+    store.finish_task(a, "COMPLETED", serialize(2), inline_max=4096)
+
+    async def go():
+        async with AsyncFaaSClient(handle.url) as client:
+            return await client.wait_many([a], wait=2.0)
+
+    results, pending, unknown = asyncio.run(go())
+    assert results[a][0] == "COMPLETED" and not pending and not unknown
+
+
+def test_result_skips_pacing_sleep_when_server_parked(gw, monkeypatch):
+    """The satellite fix: Handle.result() used to sleep poll_interval
+    between long-poll rounds even when the server parked the request —
+    with the server parking, any client-side sleep is a pure latency
+    floor. Proven by making the pacing sleep explode."""
+    handle, store = gw
+    import types
+
+    import tpu_faas.client.sdk as sdk_mod
+
+    def boom(_s):
+        raise AssertionError("client-side pacing sleep on a parked round")
+
+    # scope the patch to the SDK module's view of ``time`` (patching the
+    # real time module would detonate every other thread in the process)
+    monkeypatch.setattr(
+        sdk_mod,
+        "time",
+        types.SimpleNamespace(
+            monotonic=time.monotonic, time=time.time, sleep=boom
+        ),
+    )
+    client = FaaSClient(handle.url)
+    tid = _submit(handle, store)
+
+    def finish():
+        time.sleep(0.5)
+        store.finish_task(tid, "COMPLETED", serialize(7), inline_max=4096)
+
+    threading.Thread(target=finish).start()
+    from tpu_faas.client.sdk import TaskHandle
+
+    assert TaskHandle(client, tid).result(timeout=30.0) == 7
+
+
+# -- chaos: announce loss mid-burst ------------------------------------------
+
+
+class _LossyResultsStore:
+    """Wraps a store, DROPPING every RESULTS_CHANNEL publish — the
+    fire-and-forget bus losing the express lane's inline forwards. The
+    terminal record writes go through untouched (durability unchanged)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.dropped = 0
+
+    def publish(self, channel, payload):
+        if channel == RESULTS_CHANNEL:
+            self.dropped += 1
+            return
+        self._inner.publish(channel, payload)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_lossy_inline_forward_resolves_via_safety_poll():
+    """Chaos leg: the announce bus drops/loses EVERY inline forward
+    mid-burst under the race monitor. Every parked wait must still
+    resolve via the gateway's safety poll (armed waiter => poll starts at
+    _WAIT_POLL_MAX_S, the announce-loss insurance), with zero
+    admitted-task loss and zero protocol violations."""
+    monitor = RaceMonitor()
+    mem = MemoryStore()
+    gateway_store = RaceCheckStore(mem, monitor, actor="gateway")
+    # the "dispatcher" writes through the SAME backing store, monitored,
+    # with its results channel severed BELOW the monitor (the monitored
+    # finish path calls self.publish, so the loss must sit underneath)
+    lossy = _LossyResultsStore(mem)
+    finisher_store = RaceCheckStore(lossy, monitor, actor="dispatcher")
+    handle = start_gateway_thread(gateway_store)
+    try:
+        fid = requests.post(
+            f"{handle.url}/register_function",
+            json={"name": "arithmetic", "payload": serialize(arithmetic)},
+        ).json()["function_id"]
+        tids = [
+            requests.post(
+                f"{handle.url}/execute_function",
+                json={"function_id": fid, "payload": serialize(((i,), {}))},
+            ).json()["task_id"]
+            for i in range(6)
+        ]
+        results: dict[str, dict] = {}
+        errors: list = []
+
+        def wait(tid):
+            try:
+                results[tid] = requests.get(
+                    f"{handle.url}/result/{tid}",
+                    params={"wait": 20},
+                    timeout=40,
+                ).json()
+            except Exception as exc:  # pragma: no cover - diagnostic
+                errors.append(exc)
+
+        threads = [threading.Thread(target=wait, args=(t,)) for t in tids]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # all parked, waiters armed
+        # mid-burst: the dispatcher finishes every task, inline announces
+        # requested — and every single one is LOST on the bus
+        for i, tid in enumerate(tids):
+            finisher_store.set_status(tid, "RUNNING")
+            finisher_store.finish_task(
+                tid, "COMPLETED", f"R{i}", inline_max=4096
+            )
+        for t in threads:
+            t.join(timeout=40)
+        assert not errors, errors
+        assert lossy.dropped == len(tids)  # the chaos actually hit
+        # zero admitted-task loss: every parked wait resolved with the
+        # task's real terminal result, via the safety poll
+        assert set(results) == set(tids)
+        for i, tid in enumerate(tids):
+            assert results[tid]["status"] == "COMPLETED"
+            assert results[tid]["result"] == f"R{i}"
+        counts = _served_counts(handle)
+        assert counts["inline"] == 0 and counts["store"] == len(tids)
+        assert monitor.errors == [], "\n".join(
+            str(v) for v in monitor.errors
+        )
+    finally:
+        handle.stop()
+
+
+# -- tpu-push express e2e ----------------------------------------------------
+
+
+def test_tpu_push_express_e2e_inline_delivery():
+    """The whole lane against a real stack: RESP store server, gateway,
+    tpu-push --express, subprocess push worker. Results must be served
+    from the inline forward and the dispatcher must report express mode;
+    the announce_wait span proves intake ran."""
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tests.test_workers_e2e import _spawn_worker
+    from tpu_faas.workloads import no_op
+
+    store_handle = start_store_thread()
+    gw_handle = start_gateway_thread(
+        make_store(store_handle.url), trace=True
+    )
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(store_handle.url),
+        max_workers=16,
+        max_pending=128,
+        max_slots=2,
+        tick_period=0.05,
+        express=True,
+    )
+    assert disp.inline_result_max == RESULT_INLINE_MAX_BYTES
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    worker = _spawn_worker(
+        "push_worker", 2, f"tcp://127.0.0.1:{disp.port}",
+        "--hb", "--hb-period", "0.5",
+    )
+    try:
+        time.sleep(1.5)
+        client = FaaSClient(gw_handle.url, trace=True)
+        fid = client.register(no_op)
+        for _ in range(5):
+            h = client.submit(fid)
+            assert h.result(timeout=60.0) == "DONE"
+        counts = _served_counts(gw_handle)
+        assert counts["inline"] >= 4, counts  # ~all express-served
+        # the tick(50 ms)-independent proof: with event-driven intake and
+        # push delivery, a no-op round trip beats one tick period
+        t0 = time.perf_counter()
+        h = client.submit(fid)
+        h.result(timeout=60.0)
+        assert time.perf_counter() - t0 < 10 * 0.05  # loaded-box headroom
+    finally:
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()
+        disp.stop()
+        t.join(timeout=10)
+        gw_handle.stop()
+        store_handle.stop()

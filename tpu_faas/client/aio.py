@@ -52,10 +52,21 @@ class AsyncTaskHandle:
     async def result(
         self, timeout: float = 60.0, poll_interval: float = 0.01
     ) -> Any:
+        """Push-based await: the request PARKS at the gateway (``?wait=``)
+        and is woken by the result's announce — against an express-lane
+        dispatcher the gateway replies straight from the forwarded
+        payload, so ``await handle.result()`` never polls anything.
+        ``poll_interval`` paces only the degenerate wait<=0 rounds right
+        at the deadline — and any non-terminal reply that came back in
+        well under the requested wait (a draining or wait-oblivious
+        gateway never parked; pacing there prevents a zero-delay request
+        hot-spin). A parked round was its own pacing, and sleeping after
+        it would put a client-side floor under every delivery."""
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         while True:
             remaining = max(0.0, min(deadline - loop.time(), 5.0))
+            t_req = loop.time()
             async with self.client.request(
                 "GET",
                 f"{self.client.base_url}/result/{self.task_id}",
@@ -78,7 +89,8 @@ class AsyncTaskHandle:
                     f"task {self.task_id} still {body['status']} "
                     f"after {timeout}s"
                 )
-            await asyncio.sleep(poll_interval)
+            if remaining <= 0 or loop.time() - t_req < 0.5 * remaining:
+                await asyncio.sleep(poll_interval)
 
     async def forget(self) -> None:
         """Delete this task's store record once terminal."""
@@ -344,6 +356,29 @@ class AsyncFaaSClient:
                 AsyncTaskHandle(self, tid, trace)
                 for tid, trace in zip(out["task_ids"], trace_ids)
             ]
+
+    async def wait_many(
+        self, task_ids: list[str], wait: float = 0.0
+    ) -> tuple[dict[str, tuple[str, str]], list[str], list[str]]:
+        """The multiplexed long-poll (``POST /results/wait``), async twin
+        of the sync SDK's wait_many: many task ids, ONE parked request;
+        returns ``(results, pending, unknown)`` with ``results`` mapping
+        newly-terminal ids to raw ``(status, result)`` pairs. The gateway
+        replies as soon as ANY watched task is terminal — loop over waves
+        until ``pending`` empties."""
+        async with self.request(
+            "POST",
+            f"{self.base_url}/results/wait",
+            json={"task_ids": list(task_ids), "wait": wait},
+            timeout=aiohttp.ClientTimeout(total=wait + 15.0),
+        ) as r:
+            r.raise_for_status()
+            body = await r.json()
+        results = {
+            tid: (entry["status"], entry["result"])
+            for tid, entry in body.get("results", {}).items()
+        }
+        return results, body.get("pending", []), body.get("unknown", [])
 
     async def delete_task(self, task_id: str) -> None:
         """Free a terminal task's store record (409 while it is live)."""

@@ -212,13 +212,21 @@ class TaskHandle:
         :class:`TaskFailedError` with the deserialized exception. Uses the
         gateway's long-poll (``?wait=``) so each round trip parks at the
         gateway instead of hammering it; ``poll_interval`` only paces the
-        rare retry after an empty long-poll."""
+        degenerate wait<=0 rounds right at the deadline — when the SERVER
+        parked the request, the park already was the pacing, and sleeping
+        another poll_interval on top would put a client-side floor under
+        every result delivery (against an express-lane gateway the whole
+        submit->result path can be sub-millisecond). A non-terminal reply
+        that came back in well under the requested wait means the server
+        did NOT park (gateway draining/stopping, or a wait-oblivious
+        foreign gateway) — those rounds pace, or the loop would hot-spin
+        zero-delay requests at the worst moment."""
         deadline = time.monotonic() + timeout
         while True:
             remaining = deadline - time.monotonic()
-            status, payload = self.client.raw_result(
-                self.task_id, wait=max(0.0, min(remaining, 5.0))
-            )
+            wait = max(0.0, min(remaining, 5.0))
+            t_req = time.monotonic()
+            status, payload = self.client.raw_result(self.task_id, wait=wait)
             done, value = _unwrap_terminal(self.task_id, status, payload)
             if done:
                 return value
@@ -226,7 +234,8 @@ class TaskHandle:
                 raise TimeoutError(
                     f"task {self.task_id} still {status} after {timeout}s"
                 )
-            time.sleep(poll_interval)
+            if wait <= 0 or time.monotonic() - t_req < 0.5 * wait:
+                time.sleep(poll_interval)
 
 
 def _unwrap_terminal(task_id: str, status: str, payload: str):
@@ -435,6 +444,31 @@ class FaaSClient:
         body = r.json()
         return body["status"], body["result"]
 
+    def wait_many(
+        self, task_ids: list[str], wait: float = 0.0
+    ) -> tuple[dict[str, tuple[str, str]], list[str], list[str]]:
+        """The multiplexed long-poll (``POST /results/wait``): many task
+        ids, ONE parked request — replaces a serial per-id long-poll
+        rotation when waiting on a batch. Returns ``(results, pending,
+        unknown)`` where ``results`` maps each newly-terminal task_id to
+        its raw ``(status, result)`` pair (feed :func:`_unwrap_terminal` /
+        deserialize as with raw_result), ``pending`` lists watched ids
+        still live, and ``unknown`` ids the gateway found no record for.
+        The gateway replies as soon as ANY watched task is terminal, so
+        callers loop over waves until ``pending`` empties."""
+        r = self.http.post(
+            f"{self.base_url}/results/wait",
+            json={"task_ids": list(task_ids), "wait": wait},
+            timeout=(5.0, wait + 15.0),
+        )
+        r.raise_for_status()
+        body = r.json()
+        results = {
+            tid: (entry["status"], entry["result"])
+            for tid, entry in body.get("results", {}).items()
+        }
+        return results, body.get("pending", []), body.get("unknown", [])
+
     # -- ergonomic layer ---------------------------------------------------
     def register(self, fn: Callable, name: str | None = None) -> str:
         """Register ``fn``, deduplicated twice over: the serialize() of an
@@ -572,42 +606,76 @@ class FaaSClient:
         timeout: float = 120.0,
         poll_interval: float = 0.01,
     ) -> list[Any]:
-        """Pool.map-style batch: register once, submit every item, then poll
-        handles in rotation (the reference's clients hand-roll exactly this
-        loop — test_client.py:109-128); results come back in input order,
-        and any FAILED task raises its TaskFailedError."""
+        """Pool.map-style batch: register once, submit every item, then wait
+        on the whole wave with ONE parked multiplexed request per round
+        (``wait_many`` — the reference's clients hand-roll a serial poll
+        rotation instead, test_client.py:109-128); results come back in
+        input order, and any FAILED task raises its TaskFailedError. A
+        pre-express gateway (no /results/wait route) degrades to the
+        serial long-poll rotation."""
         fid = self.register(fn)
         handles = [self.submit(fid, item) for item in iterable]
         deadline = time.monotonic() + timeout
         results: dict[int, Any] = {}
         pending = set(range(len(handles)))
+        index_of = {h.task_id: i for i, h in enumerate(handles)}
+        multiplex = True
         while pending:
-            # LONG-poll the lowest pending handle (parks at the gateway —
-            # most of a rotation is spent there, not issuing requests), then
-            # sweep the rest with immediate polls to catch the wave of tasks
-            # that completed meanwhile; one /result round-trip each carries
-            # both status and payload
-            first = min(pending)
-            for i in sorted(pending):
-                wait = (
-                    min(2.0, max(0.0, deadline - time.monotonic()))
-                    if i == first
-                    else 0.0
-                )
-                status, payload = self.raw_result(handles[i].task_id, wait=wait)
-                done, value = _unwrap_terminal(
-                    handles[i].task_id, status, payload
-                )
-                if done:
-                    results[i] = value
-                    pending.discard(i)
+            wait = min(2.0, max(0.0, deadline - time.monotonic()))
+            t_req = time.monotonic()
+            if multiplex:
+                try:
+                    got, _live, unknown = self.wait_many(
+                        [handles[i].task_id for i in sorted(pending)],
+                        wait=wait,
+                    )
+                except requests.HTTPError as exc:
+                    if (
+                        exc.response is not None
+                        and exc.response.status_code == 404
+                    ):
+                        multiplex = False  # older gateway: serial rotation
+                        continue
+                    raise
+                for tid, (status, payload) in got.items():
+                    done, value = _unwrap_terminal(tid, status, payload)
+                    if done:
+                        results[index_of[tid]] = value
+                        pending.discard(index_of[tid])
+                if unknown:
+                    # a watched record vanished mid-wait (swept/deleted):
+                    # the serial rotation surfaced this as an immediate
+                    # 404 — burning the remaining timeout on ids that can
+                    # never resolve would hide which task died and why.
+                    # (Delivered results above are consumed first: an id
+                    # can never be both.)
+                    raise requests.HTTPError(
+                        f"task record(s) gone while waiting: {unknown}"
+                    )
+            else:
+                first = min(pending)
+                for i in sorted(pending):
+                    status, payload = self.raw_result(
+                        handles[i].task_id, wait=wait if i == first else 0.0
+                    )
+                    done, value = _unwrap_terminal(
+                        handles[i].task_id, status, payload
+                    )
+                    if done:
+                        results[i] = value
+                        pending.discard(i)
             if pending:
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"{len(pending)} of {len(handles)} tasks still "
                         f"running after {timeout}s"
                     )
-                time.sleep(poll_interval)
+                if wait <= 0 or time.monotonic() - t_req < 0.5 * wait:
+                    # the server never parked (deadline imminent, or a
+                    # draining/wait-oblivious gateway replied instantly):
+                    # pace the residual spin; parked rounds need no
+                    # client pacing
+                    time.sleep(poll_interval)
         return [results[i] for i in range(len(handles))]
 
 

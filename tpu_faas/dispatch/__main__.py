@@ -189,6 +189,22 @@ def main(argv: list[str] | None = None) -> None:
         "Default with a sharded url: own every shard",
     )
     ap.add_argument(
+        "--express", action="store_true",
+        help="tpu-push: the express result lane — terminal announces "
+        "carry bounded inline results (gateways reply from the forward "
+        "instead of a store re-read; size via --inline-result-max) and "
+        "the serve loop parks its poll on the announce bus, so a submit "
+        "wakes intake immediately instead of waiting out --tick-period. "
+        "Opt-in: enable once every RESULTS-channel consumer on this "
+        "store understands the inline announce form",
+    )
+    ap.add_argument(
+        "--inline-result-max", type=int, default=None, metavar="BYTES",
+        help="tpu-push --express: inline up to this many result bytes on "
+        "the announce (default 4096); larger results fall back to the "
+        "classic id-only announce and the gateway's store read",
+    )
+    ap.add_argument(
         "--shared", action="store_true",
         help="several dispatchers share this store+channel: each claims "
         "tasks atomically before dispatching (exactly one runs each "
@@ -360,6 +376,8 @@ def main(argv: list[str] | None = None) -> None:
             resident=ns.resident,
             tick_backend=ns.tick_backend,
             estimate_runtimes=not ns.no_runtime_learning,
+            express=ns.express,
+            inline_result_max=ns.inline_result_max,
         )
     if ns.mode == "tpu-push" and ns.multihost:
         # Lead-side failure containment: once the followers joined the

@@ -440,6 +440,15 @@ class TaskDispatcher:
             self.traces.stage_snapshot,
         )
         self.metrics.register_collector(self.collect_metrics)
+        #: express result lane (opt-in): > 0 makes every terminal write's
+        #: RESULTS_CHANNEL announce carry status + result inline up to this
+        #: many result bytes (store/base.py encode_result_announce), so a
+        #: gateway's woken long-poll replies from the forwarded payload
+        #: instead of a store re-read. 0 (default) keeps the classic
+        #: id-only announce — reference-era consumers never see the form
+        #: unless the operator enables it. The store write itself is
+        #: unchanged (same pipelined round, announce still after the write).
+        self.inline_result_max = 0
         #: shared-fleet mode: several dispatchers on one store+channel.
         #: Every dispatcher receives every announce, so intake must CLAIM
         #: each task (one pipelined setnx round per batch) before
@@ -1120,6 +1129,12 @@ class TaskDispatcher:
         # create and the promotion plane flipping the node QUEUED (both
         # endpoints absent on flat tasks, so the span simply never emits)
         ("dispatcher", "dep_wait", "submitted", "promoted"),
+        # the express-lane intake stage: gateway submit stamp -> this
+        # dispatcher draining the announce off the bus. With tick-cadence
+        # intake its p99 rides the tick period; event-driven intake
+        # (tpu-push --express) pins it well below — the trace-visible
+        # proof that a submit's intake latency stopped being tick-quantized
+        ("dispatcher", "announce_wait", "submitted", "announced"),
         ("dispatcher", "intake", "announced", "intake"),
         ("dispatcher", "queue", "intake", "scheduled"),
         ("dispatcher", "dispatch", "scheduled", "sent"),
@@ -1409,7 +1424,10 @@ class TaskDispatcher:
     ) -> None:
         """``first_wins=True`` on paths where a second result for the same
         task is possible (zombie worker of a re-dispatched task)."""
-        self.store.finish_task(task_id, status, result, first_wins=first_wins)
+        self.store.finish_task(
+            task_id, status, result,
+            first_wins=first_wins, inline_max=self.inline_result_max,
+        )
         self._note_finished(task_id, status)
         self.complete_deps_safe([(task_id, status)])
 
@@ -1465,7 +1483,9 @@ class TaskDispatcher:
         if not items:
             return 0
         try:
-            self.store.finish_task_many(list(items))
+            self.store.finish_task_many(
+                list(items), inline_max=self.inline_result_max
+            )
             self.note_store_up()
             for task_id, status, _result, _fw in items:
                 self._note_finished(task_id, status)
@@ -1534,7 +1554,9 @@ class TaskDispatcher:
                 )
             )
             try:
-                self.store.finish_task_many(chunk)
+                self.store.finish_task_many(
+                    chunk, inline_max=self.inline_result_max
+                )
             except STORE_OUTAGE_ERRORS as exc:
                 self.note_store_outage(exc)
                 break

@@ -81,10 +81,29 @@ class TpuPushDispatcher(TaskDispatcher):
         resident: bool = False,
         tick_backend: str | None = None,
         estimate_runtimes: bool = True,
+        express: bool = False,
+        inline_result_max: int | None = None,
     ) -> None:
         super().__init__(
             store_url=store_url, channel=channel, store=store, shared=shared
         )
+        #: express result lane (ROADMAP item 2, opt-in): terminal announces
+        #: carry bounded inline results (gateways reply from the forward
+        #: instead of re-reading the store) AND the serve loop parks its
+        #: poll on the announce bus — a submit wakes intake immediately and
+        #: an express sub-tick dispatches the ready batch instead of
+        #: waiting out the next tick_period.
+        self.express = bool(express)
+        if self.express:
+            from tpu_faas.store.base import RESULT_INLINE_MAX_BYTES
+
+            self.inline_result_max = (
+                RESULT_INLINE_MAX_BYTES
+                if inline_result_max is None
+                else max(0, int(inline_result_max))
+            )
+        elif inline_result_max is not None:
+            self.inline_result_max = max(0, int(inline_result_max))
         # the estimation loop (sched/estimator.py): learned per-function
         # sizes stamp un-hinted tasks at batch build, learned per-worker
         # speeds feed SchedulerArrays.worker_speed — so the heterogeneous
@@ -930,6 +949,10 @@ class TpuPushDispatcher(TaskDispatcher):
             ),
             "placement": a.placement,
             "liveness_period_s": self.liveness_period,
+            # express result lane: event-driven intake + inline result
+            # announces (0 = classic id-only announces)
+            "express": self.express,
+            "inline_result_max": self.inline_result_max,
             "tasks_on_retry": len(self.task_retries),
             "device_tick": spans.get("device_tick", {}),
             # host data-plane phases (batched intake / act): spanned like
@@ -1680,11 +1703,38 @@ class TpuPushDispatcher(TaskDispatcher):
             self.mark_running_many(running_batch)
         return sent
 
+    def _sync_announce_fds(self, registered: list[int]) -> None:
+        """Express intake: keep the announce subscription's readability
+        fds registered in the serve-loop poller, so a submit's announce
+        WAKES the poll instead of waiting out tick_period. Re-synced every
+        iteration (one attribute probe when nothing changed): the fd
+        changes across store reconnects/failovers, and while the announce
+        backlog sits at its cap the fds are deliberately UNregistered —
+        intake cannot drain the bus then, and a level-triggered readable
+        fd nobody drains would turn the park into a spin."""
+        if len(self._announce_backlog) >= self._CONTROL_DRAIN_BACKLOG_CAP:
+            fds: list[int] = []
+        else:
+            fds = self.subscriber.pollable_fds()
+        if fds == registered:
+            return
+        for fd in registered:
+            try:
+                self.poller.unregister(fd)
+            except KeyError:
+                pass
+        registered[:] = fds
+        for fd in fds:
+            self.poller.register(fd, zmq.POLLIN)
+
     def start(self, max_results: int | None = None) -> int:
         try:
             last_tick = 0.0
             last_device = 0.0  # 0 forces a first tick (seeds prev_live)
             last_rescan = self.clock()
+            #: announce-bus fds currently registered in the poller
+            #: (express mode only; [] keeps the classic tick-cadence park)
+            announce_fds: list[int] = []
             while not self.stopping:
                 # a store outage must degrade the dispatcher (workers keep
                 # heartbeating, results buffer), never crash it — everything
@@ -1743,6 +1793,8 @@ class TpuPushDispatcher(TaskDispatcher):
                     )
                 except STORE_OUTAGE_ERRORS as exc:
                     self.note_store_outage(exc)
+                if self.express:
+                    self._sync_announce_fds(announce_fds)
                 events = dict(self.poller.poll(max(1, int(self.tick_period * 1000))))
                 if self.socket in events:
                     # bounded drain with coalesced result writes: a
@@ -1750,8 +1802,16 @@ class TpuPushDispatcher(TaskDispatcher):
                     # a result burst must not pay one store round trip per
                     # result
                     self.drain_results_batched()
+                # express sub-tick: an announce arrived — run intake + a
+                # dispatch pass NOW instead of waiting out the tick
+                # cadence (the device-step gate below still skips the
+                # device call when there is nothing to place or no
+                # capacity; intake always drains, which clears the fd)
+                express_due = bool(announce_fds) and any(
+                    fd in events for fd in announce_fds
+                )
                 now = self.clock()
-                if now - last_tick >= self.tick_period:
+                if now - last_tick >= self.tick_period or express_due:
                     try:
                         self._intake()
                         # control messages must flow even when intake has

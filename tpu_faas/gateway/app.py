@@ -32,6 +32,15 @@ breaker fast-fails EVERY store-touching endpoint with 503 +
 on a connect timeout.
 - ``GET /status/{task_id}``    -> {"task_id", "status"}
 - ``GET /result/{task_id}``    -> {"task_id", "status", "result"}
+    ``?wait=N`` long-polls (capped); parked requests are woken by the
+    store's terminal announce, and express-lane announces (dispatcher
+    ``--express``) carry the result inline so the woken reply skips the
+    store re-read entirely.
+- ``POST /results/wait``       {"task_ids": [...], "wait": N} — the
+    multiplexed long-poll: one parked request watching many tasks, reply
+    ``{"results", "pending", "unknown"}`` as soon as anything is terminal.
+- ``GET /events?task_ids=...`` — SSE stream over the same waiter plane:
+    one ``result`` event per terminal task as it lands, closed by ``done``.
 - ``POST /execute_graph``      {"nodes": [{"function_id", "payload",
     "depends_on": [refs], ...hints}]} -> {"task_ids", "graph"} — DAG
     submission (tpu_faas/graph): acyclicity + size cap proven before any
@@ -97,6 +106,7 @@ from tpu_faas.core.task import (
     FIELD_PARAMS,
     FIELD_PENDING_DEPS,
     FIELD_PRIORITY,
+    FIELD_RESULT,
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
     FIELD_TIMEOUT,
@@ -124,6 +134,7 @@ from tpu_faas.store.base import (
     RESULTS_CHANNEL,
     TASKS_CHANNEL,
     TaskStore,
+    decode_result_announce,
 )
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import TickTracer, get_logger
@@ -189,23 +200,46 @@ async def _run_blocking(fn, *args):
     return await loop.run_in_executor(None, functools.partial(fn, *args))
 
 
+class _Waiter:
+    """One parked wait — single-id long-poll, multiplexed /results/wait,
+    or an SSE stream: a PRIVATE wake event plus the express lane's inline
+    forward slots, (status, result) payloads the pump decoded off
+    RESULTS_CHANNEL announces while this wait was parked. Serving from the
+    slot is what removes the store re-read from the woken delivery path;
+    the slot is only ever filled from an announce that FOLLOWED the
+    authoritative store write on the same pipelined round, so it can never
+    disagree with a re-read. Written exclusively on the app loop
+    (call_soon_threadsafe) and read by the owning handler on the same
+    loop — no lock. Per-waiter (not a global cache) on purpose: a payload
+    is only delivered to waits parked when it was announced, so a stale
+    forward can never answer a LATER wait for a resubmitted incarnation
+    of the same deterministic task id."""
+
+    __slots__ = ("event", "inline")
+
+    def __init__(self) -> None:
+        self.event = asyncio.Event()
+        self.inline: dict[str, tuple[str, str]] = {}
+
+
 class _ResultWaiters:
     """Wakes parked /result long-polls when the store announces a terminal
-    write on RESULTS_CHANNEL.
+    write on RESULTS_CHANNEL, forwarding the express lane's inline
+    payloads to the parked handlers (see _Waiter).
 
     A pump thread (its own store subscription — a dedicated connection, so
     it never interleaves with handler traffic) drains the channel and sets
     the matching task's waiter events via the app loop. Each parked handler
-    owns a PRIVATE asyncio.Event (one fire sets them all): a shared event
+    owns a PRIVATE _Waiter (one fire sets them all): a shared event
     would let one handler's clear() erase a wake another handler hadn't
-    consumed yet. Handlers drop their event on exit, fired or not, so
+    consumed yet. Handlers drop their waiter on exit, fired or not, so
     abandoned waits can't leak entries. The channel is fire-and-forget:
     handlers keep a coarse fallback re-read, and a pump that loses its
     subscription (store restart) just resubscribes."""
 
     def __init__(self, store: TaskStore):
         self.store = store
-        self._events: dict[str, list[asyncio.Event]] = {}
+        self._waiters: dict[str, list[_Waiter]] = {}
         self._loop: asyncio.AbstractEventLoop | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -224,32 +258,48 @@ class _ResultWaiters:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
-    def acquire(self, task_id: str) -> asyncio.Event:
-        ev = asyncio.Event()
-        self._events.setdefault(task_id, []).append(ev)
-        return ev
+    def acquire(self, task_id: str) -> _Waiter:
+        w = _Waiter()
+        self._waiters.setdefault(task_id, []).append(w)
+        return w
 
-    def release(self, task_id: str, event: asyncio.Event) -> None:
-        waiters = self._events.get(task_id)
+    def acquire_many(self, task_ids) -> _Waiter:
+        """ONE waiter registered under every id — any of their announces
+        wakes the (multiplexed) wait, and each id's inline forward lands
+        in its own slot."""
+        w = _Waiter()
+        for task_id in task_ids:
+            self._waiters.setdefault(task_id, []).append(w)
+        return w
+
+    def release(self, task_id: str, waiter: _Waiter) -> None:
+        waiters = self._waiters.get(task_id)
         if waiters is None:
             return
         try:
-            waiters.remove(event)
+            waiters.remove(waiter)
         except ValueError:
             pass
         if not waiters:
-            self._events.pop(task_id, None)
+            self._waiters.pop(task_id, None)
 
-    def _fire(self, task_id: str) -> None:
-        for ev in self._events.get(task_id, ()):
-            ev.set()
+    def release_many(self, task_ids, waiter: _Waiter) -> None:
+        for task_id in task_ids:
+            self.release(task_id, waiter)
+
+    def _fire(self, payload: str) -> None:
+        task_id, status, result = decode_result_announce(payload)
+        for w in self._waiters.get(task_id, ()):
+            if status is not None:
+                w.inline[task_id] = (status, result or "")
+            w.event.set()
 
     def fire_all(self) -> None:
         """Shutdown: wake every parked poll NOW (each re-checks ctx.stopping
         and replies) instead of letting them ride out the fallback timeout."""
-        for waiters in self._events.values():
-            for ev in waiters:
-                ev.set()
+        for waiters in self._waiters.values():
+            for w in waiters:
+                w.event.set()
 
     def _pump(self) -> None:
         down = False  # log once per outage, not once per retry
@@ -450,6 +500,19 @@ class GatewayContext:
         )
         for phase in ("submit_to_finish", "submit_to_observe"):
             self.m_e2e.labels(phase=phase, terminal="COMPLETED")
+        self.m_result_served = self.metrics.counter(
+            "tpu_faas_gateway_result_served_total",
+            "Terminal result deliveries to clients (/result, "
+            "/results/wait, /events) by source: inline = replied from the "
+            "express lane's forwarded announce payload (no store re-read "
+            "on the delivery path), store = replied from a store read "
+            "(immediate-reply polls, oversized/disabled inline, safety-"
+            "poll fallback). inline/(inline+store) is the express lane's "
+            "hit rate",
+            ("source",),
+        )
+        for source in ("inline", "store"):
+            self.m_result_served.labels(source=source)
         self.m_shard_routed = self.metrics.counter(
             "tpu_faas_gateway_shard_routed_total",
             "Task-keyed reads (/status, /result, /trace) routed to a "
@@ -959,6 +1022,8 @@ def make_app(
     app.router.add_post("/execute_graph", execute_graph)
     app.router.add_get("/status/{task_id}", get_status)
     app.router.add_get("/result/{task_id}", get_result)
+    app.router.add_post("/results/wait", wait_results)
+    app.router.add_get("/events", events_stream)
     app.router.add_post("/cancel/{task_id}", cancel_task)
     app.router.add_delete("/task/{task_id}", delete_task)
     app.router.add_get("/healthz", healthz)
@@ -1915,12 +1980,38 @@ _WAIT_POLL_S = 0.5
 _WAIT_POLL_MAX_S = 2.0
 
 
+def _note_terminal_delivery(
+    ctx: "GatewayContext",
+    task_id: str,
+    status: str,
+    source: str,
+    loop: asyncio.AbstractEventLoop,
+) -> None:
+    """Bookkeeping shared by every terminal delivery path (/result,
+    /results/wait, /events): the delivery-source counter plus the
+    fire-and-forget first-delivery observation (e2e histograms + observe
+    span) — the reply must never wait on the telemetry fetch (the task is
+    held via ctx so it can't be GC'd mid-flight)."""
+    ctx.m_result_served.labels(source=source).inc()
+    if task_id not in ctx._observed:
+        t = loop.create_task(
+            _note_observed(ctx, task_id, status, time.time())
+        )
+        ctx._observe_tasks.add(t)
+        t.add_done_callback(ctx._observe_tasks.discard)
+
+
 async def get_result(request: web.Request) -> web.Response:
     """``?wait=N`` long-polls: hold the request up to N seconds (capped)
     until the task is terminal, then reply immediately — one request
     replaces hundreds of 10 ms polls per task. Parked requests are woken by
-    the store's terminal-write announce the moment the result lands;
-    ``wait`` absent or 0 keeps the reference's immediate-reply contract."""
+    the store's terminal-write announce the moment the result lands — and
+    when that announce carries the express lane's inline payload
+    (dispatcher ``--express``), the reply is served straight from the
+    forwarded status+result with NO store re-read on the delivery path
+    (counted in result_served_total{source="inline"}). ``wait`` absent or
+    0 keeps the reference's immediate-reply contract, store read and
+    all."""
     ctx: GatewayContext = request.app[CTX_KEY]
     task_id = request.match_info["task_id"]
     try:
@@ -1933,17 +2024,43 @@ async def get_result(request: web.Request) -> web.Response:
     ctx.note_shard_route(task_id)
     loop = asyncio.get_running_loop()
     deadline = loop.time() + wait_s
-    poll_s = _WAIT_POLL_S
     waiters = ctx.waiters
-    event = waiters.acquire(task_id) if waiters is not None and wait_s > 0 else None
+    waiter = (
+        waiters.acquire(task_id)
+        if waiters is not None and wait_s > 0
+        else None
+    )
+    # safety-poll tuning: with a waiter armed, the announce IS the wake
+    # path and the store re-read is only announce-loss insurance — start
+    # it coarse instead of re-reading at 0.5 s. Without a waiter plane the
+    # poll is the only wake path and keeps its fine-grained start.
+    poll_s = _WAIT_POLL_MAX_S if waiter is not None else _WAIT_POLL_S
     try:
         while True:
             # clear BEFORE the read: an announce landing between the read
             # and the wait then leaves the event set, so the wait returns at
-            # once and the next read observes the terminal record — the
-            # wake-up can be consumed spuriously but never lost
-            if event is not None:
-                event.clear()
+            # once and the next read observes the terminal record (or the
+            # forwarded payload) — the wake-up can be consumed spuriously
+            # but never lost
+            if waiter is not None:
+                waiter.event.clear()
+                inline = waiter.inline.get(task_id)
+                if inline is not None:
+                    # express delivery: the announce that woke us carried
+                    # the terminal payload — the authoritative store write
+                    # landed BEFORE it on the same pipelined round, so this
+                    # reply equals the re-read it replaces
+                    status, result = inline
+                    _note_terminal_delivery(
+                        ctx, task_id, status, "inline", loop
+                    )
+                    return web.json_response(
+                        {
+                            "task_id": task_id,
+                            "status": status,
+                            "result": result,
+                        }
+                    )
             status, result = await ctx.store_call(ctx.store.get_result, task_id)
             if status is None:
                 return _json_error(404, f"unknown task_id {task_id!r}")
@@ -1952,30 +2069,25 @@ async def get_result(request: web.Request) -> web.Response:
             except ValueError:
                 terminal = True  # unknown status string: reply, don't 500/hang
             if terminal or loop.time() >= deadline or ctx.stopping.is_set():
-                if terminal and task_id not in ctx._observed:
-                    # fire-and-forget: the reply must not wait on the
-                    # telemetry fetch (held via ctx so it can't be GC'd
-                    # mid-flight)
-                    t = loop.create_task(
-                        _note_observed(ctx, task_id, status, time.time())
+                if terminal:
+                    _note_terminal_delivery(
+                        ctx, task_id, status, "store", loop
                     )
-                    ctx._observe_tasks.add(t)
-                    t.add_done_callback(ctx._observe_tasks.discard)
                 return web.json_response(
                     {"task_id": task_id, "status": status, "result": result}
                 )
             pause = min(poll_s, max(0.0, deadline - loop.time()))
-            if event is not None:
+            if waiter is not None:
                 try:
-                    await asyncio.wait_for(event.wait(), timeout=pause)
+                    await asyncio.wait_for(waiter.event.wait(), timeout=pause)
                 except asyncio.TimeoutError:
                     pass
             else:
                 await asyncio.sleep(pause)
             poll_s = min(poll_s * 1.5, _WAIT_POLL_MAX_S)
     finally:
-        if event is not None and waiters is not None:
-            waiters.release(task_id, event)
+        if waiter is not None and waiters is not None:
+            waiters.release(task_id, waiter)
 
 
 async def _note_observed(
@@ -2007,6 +2119,274 @@ async def _note_observed(
     if trace_id is not None:
         fields[FIELD_TRACE_ID] = trace_id
     ctx.note_result_observed(task_id, fields, observed_at)
+
+
+#: /results/wait and /events accept at most this many task ids per call:
+#: each probe round is a pipelined read over the still-pending slice, and
+#: an unbounded list would let one request park unbounded store work.
+_WAIT_MANY_CAP = 1024
+
+
+class _ResultWatch:
+    """The multiplexed waiter behind POST /results/wait and GET /events:
+    ONE parked request watching many task ids, woken by any of their
+    terminal announces (express inline payloads served without a store
+    re-read), with the same coarse safety re-read as the single-id
+    long-poll. Probe rounds are two pipelined reads over the still-pending
+    slice (statuses, then results for the newly-terminal) — never a round
+    trip per id."""
+
+    def __init__(self, ctx: "GatewayContext", ids: list[str], wait_s: float):
+        self.ctx = ctx
+        self.ids = ids
+        self.loop = asyncio.get_running_loop()
+        self.deadline = self.loop.time() + wait_s
+        self.pending: set[str] = set(ids)
+        #: ids the LAST store probe found no record for; exposed through
+        #: the ``unknown`` property, which re-filters against ``pending``
+        #: so an id delivered from an inline forward AFTER the probe can
+        #: never be reported unknown and delivered in the same reply
+        self._unknown: set[str] = set()
+        self.waiter = (
+            ctx.waiters.acquire_many(ids)
+            if ctx.waiters is not None and wait_s > 0
+            else None
+        )
+        self.poll_s = (
+            _WAIT_POLL_MAX_S if self.waiter is not None else _WAIT_POLL_S
+        )
+
+    async def collect(self) -> list[tuple[str, str, str, str]]:
+        """Newly-terminal (task_id, status, result, source) since the last
+        call: the waiter's inline forwards first (no store traffic), then
+        one pipelined status probe + one result fetch over whatever is
+        still pending. Ids with no record are reported in ``unknown`` (a
+        mid-create id may appear on a later probe; they never block the
+        reply)."""
+        out: list[tuple[str, str, str, str]] = []
+        if self.waiter is not None:
+            self.waiter.event.clear()
+            for tid in list(self.pending):
+                inline = self.waiter.inline.get(tid)
+                if inline is not None:
+                    self.pending.discard(tid)
+                    out.append((tid, inline[0], inline[1], "inline"))
+        if self.pending:
+            remaining = [t for t in self.ids if t in self.pending]
+            statuses = await self.ctx.store_call(
+                self.ctx.store.hget_many, remaining, FIELD_STATUS
+            )
+            self._unknown = {
+                t for t, s in zip(remaining, statuses) if s is None
+            }
+            term: list[tuple[str, str]] = []
+            for tid, status in zip(remaining, statuses):
+                if status is None or not isinstance(status, str):
+                    continue
+                try:
+                    is_term = TaskStatus(status).is_terminal()
+                except ValueError:
+                    is_term = True  # foreign status: deliver, don't hang
+                if is_term:
+                    term.append((tid, status))
+            if term:
+                results = await self.ctx.store_call(
+                    self.ctx.store.hget_many,
+                    [t for t, _ in term],
+                    FIELD_RESULT,
+                )
+                for (tid, status), result in zip(term, results):
+                    self.pending.discard(tid)
+                    out.append(
+                        (
+                            tid,
+                            status,
+                            result if isinstance(result, str) else "",
+                            "store",
+                        )
+                    )
+        for tid, status, _result, source in out:
+            _note_terminal_delivery(self.ctx, tid, status, source, self.loop)
+        return out
+
+    @property
+    def exhausted(self) -> bool:
+        return (
+            not self.pending
+            or self.loop.time() >= self.deadline
+            or self.ctx.stopping.is_set()
+        )
+
+    async def park(self) -> None:
+        """Sleep until an announce wake or the next safety re-read."""
+        pause = min(self.poll_s, max(0.0, self.deadline - self.loop.time()))
+        if self.waiter is not None:
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(self.waiter.event.wait(), timeout=pause)
+        else:
+            await asyncio.sleep(pause)
+        self.poll_s = min(self.poll_s * 1.5, _WAIT_POLL_MAX_S)
+
+    @property
+    def unknown(self) -> list[str]:
+        """Ids with no record as of the last probe that are ALSO still
+        undelivered, input order — an inline forward landing after the
+        probe removes its id from pending, and with it from here."""
+        return [
+            t for t in self.ids if t in self._unknown and t in self.pending
+        ]
+
+    def pending_ids(self) -> list[str]:
+        """Still-live ids in input order (unknown ids excluded — they are
+        reported separately)."""
+        unknown = self._unknown
+        return [
+            t for t in self.ids if t in self.pending and t not in unknown
+        ]
+
+    def close(self) -> None:
+        if self.waiter is not None and self.ctx.waiters is not None:
+            self.ctx.waiters.release_many(self.ids, self.waiter)
+
+
+def _parse_wait_ids(task_ids, wait_raw):
+    """Shared validation for the multiplexed wait surfaces: returns
+    (ids, wait_s) or raises ValueError with the client-facing message."""
+    if (
+        not isinstance(task_ids, list)
+        or not task_ids
+        or not all(isinstance(t, str) and t for t in task_ids)
+    ):
+        raise ValueError("'task_ids' must be a non-empty list of strings")
+    if len(task_ids) > _WAIT_MANY_CAP:
+        raise ValueError(
+            f"at most {_WAIT_MANY_CAP} task_ids per wait; split the call"
+        )
+    try:
+        wait_s = float(wait_raw or 0)
+    except (TypeError, ValueError):
+        wait_s = math.nan
+    if not (0.0 <= wait_s):  # rejects NaN
+        raise ValueError("'wait' must be a non-negative number")
+    # dedup preserving order: one id parked once, results keyed by id
+    return list(dict.fromkeys(task_ids)), min(wait_s, _MAX_WAIT_S)
+
+
+async def wait_results(request: web.Request) -> web.Response:
+    """``POST /results/wait`` — the multiplexed long-poll: many task ids,
+    ONE parked request. Body ``{"task_ids": [...], "wait": N}``. Replies
+    as soon as at least one watched task is terminal (immediately, if any
+    already are — the wait=0 immediate-reply contract holds per id), else
+    when the wait lapses. Reply: ``{"results": {task_id: {"status",
+    "result"}}, "pending": [...], "unknown": [...]}`` — unknown ids (no
+    record; possibly mid-create) are reported, never 404 the whole call,
+    and stay watched until the deadline in case their create lands.
+    Batch-submitting clients replace N serial per-id long-polls (the
+    run_many wait loop) with one parked request per wave."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+    try:
+        body = await request.json()
+        raw_ids = body["task_ids"]
+    except Exception:
+        return _json_error(400, "expected JSON body with a 'task_ids' list")
+    try:
+        ids, wait_s = _parse_wait_ids(raw_ids, body.get("wait", 0))
+    except ValueError as exc:
+        return _json_error(400, str(exc))
+    for tid in ids:
+        ctx.note_shard_route(tid)
+    watch = _ResultWatch(ctx, ids, wait_s)
+    results: dict[str, dict] = {}
+    try:
+        while True:
+            for tid, status, result, _source in await watch.collect():
+                results[tid] = {"status": status, "result": result}
+            if results or watch.exhausted:
+                break
+            await watch.park()
+    finally:
+        watch.close()
+    return web.json_response(
+        {
+            "results": results,
+            "pending": watch.pending_ids(),
+            "unknown": watch.unknown,
+        }
+    )
+
+
+async def events_stream(request: web.Request) -> web.StreamResponse:
+    """``GET /events?task_ids=a,b,c&wait=N`` — Server-Sent Events over the
+    same waiter plane: one ``event: result`` frame per terminal task as it
+    lands (express inline payloads stream with no store re-read), closed
+    by an ``event: done`` frame carrying whatever is still pending/unknown
+    when every watched task is terminal or the wait cap lapses (clients
+    reconnect with the remainder; the cap bounds handler lifetime exactly
+    like the long-poll's). A store outage mid-stream degrades to the done
+    frame with an ``error`` field — headers are already on the wire, so a
+    503 is no longer possible."""
+    import json as _json
+
+    ctx: GatewayContext = request.app[CTX_KEY]
+    raw_ids = [t for t in request.query.get("task_ids", "").split(",") if t]
+    try:
+        ids, wait_s = _parse_wait_ids(
+            raw_ids, request.query.get("wait", _MAX_WAIT_S)
+        )
+    except ValueError as exc:
+        return _json_error(400, str(exc))
+    for tid in ids:
+        ctx.note_shard_route(tid)
+    resp = web.StreamResponse(
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-store",
+            "Connection": "keep-alive",
+        }
+    )
+    await resp.prepare(request)
+
+    async def send(event: str, data: dict) -> None:
+        await resp.write(
+            f"event: {event}\ndata: {_json.dumps(data)}\n\n".encode()
+        )
+
+    watch = _ResultWatch(ctx, ids, wait_s)
+    error = ""
+    try:
+        while True:
+            try:
+                ready = await watch.collect()
+            except StoreUnavailable:
+                error = "store_unavailable"
+                break
+            for tid, status, result, source in ready:
+                await send(
+                    "result",
+                    {
+                        "task_id": tid,
+                        "status": status,
+                        "result": result,
+                        "source": source,
+                    },
+                )
+            if watch.exhausted:
+                break
+            await watch.park()
+    except (ConnectionResetError, asyncio.CancelledError):
+        raise  # client went away: nothing to finalize on the wire
+    finally:
+        watch.close()
+    done: dict = {
+        "pending": watch.pending_ids(),
+        "unknown": watch.unknown,
+    }
+    if error:
+        done["error"] = error
+    with contextlib.suppress(ConnectionResetError):
+        await send("done", done)
+        await resp.write_eof()
+    return resp
 
 
 async def cancel_task(request: web.Request) -> web.Response:

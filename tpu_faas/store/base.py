@@ -77,6 +77,64 @@ LEASE_CONF_KEY = "fleet:lease_conf"
 #: fallback re-read, never rely on delivery.
 RESULTS_CHANNEL = "results"
 
+#: Express result lane: terminal announces on RESULTS_CHANNEL may carry the
+#: status + result INLINE ("<prefix><task_id>\\x00<status>\\x00<result>") so
+#: a woken gateway long-poll replies from the forwarded payload instead of
+#: paying a store re-read per delivery. Strictly opt-in at the producer
+#: (finish_task's ``inline_max``; 0 = the classic id-only payload, the
+#: default everywhere) — the store write stays authoritative and PRECEDES
+#: the announce on the same pipelined round, so a consumer that ignores the
+#: inline form and re-reads the record sees the identical terminal state.
+#: Reference-era consumers never see the form unless the operator enables
+#: it fleet-wide.
+RESULT_INLINE_PREFIX = "!r1:"
+#: Default inline-payload bound for express producers (the dispatcher's
+#: ``--express`` knob): results larger than this fall back to the id-only
+#: announce and the gateway's ordinary store read.
+RESULT_INLINE_MAX_BYTES = 4096
+_RESULT_INLINE_SEP = "\x00"
+
+
+def encode_result_announce(
+    task_id: str, status: str, result: str, inline_max: int = 0
+) -> str:
+    """The RESULTS_CHANNEL payload for one terminal write: the inline
+    express form when ``inline_max`` allows it, else the classic bare task
+    id. Oversized results — and any field that would collide with the
+    framing — fall back to id-only rather than truncate: a wrong inline
+    payload is worse than a store re-read."""
+    status = str(status)
+    if (
+        inline_max > 0
+        and len(result) <= inline_max
+        and _RESULT_INLINE_SEP not in task_id
+        and _RESULT_INLINE_SEP not in status
+        and _RESULT_INLINE_SEP not in result
+    ):
+        return (
+            f"{RESULT_INLINE_PREFIX}{task_id}{_RESULT_INLINE_SEP}"
+            f"{status}{_RESULT_INLINE_SEP}{result}"
+        )
+    return task_id
+
+
+def decode_result_announce(
+    payload: str,
+) -> tuple[str, str | None, str | None]:
+    """(task_id, status, result) of one RESULTS_CHANNEL payload; status and
+    result are None for the classic id-only form (and for any malformed
+    inline frame — the consumer then falls back to its store read, which is
+    always correct)."""
+    if not payload.startswith(RESULT_INLINE_PREFIX):
+        return payload, None, None
+    parts = payload[len(RESULT_INLINE_PREFIX):].split(_RESULT_INLINE_SEP, 2)
+    if len(parts) != 3 or not parts[0] or not parts[1]:
+        # malformed frame (foreign producer): treat the whole payload as an
+        # opaque id — the consumer's record probe will find nothing and
+        # skip, exactly like any garbage announce
+        return payload, None, None
+    return parts[0], parts[1], parts[2]
+
 #: Content-addressed payload namespace: one hash per payload body, keyed
 #: ``blob:<sha256>`` (core/payload.py payload_digest). Write-once by
 #: protocol — the digest IS the content, so a second writer of the same
@@ -131,6 +189,25 @@ class Subscription(abc.ABC):
 
     @abc.abstractmethod
     def close(self) -> None: ...
+
+    def fileno(self) -> int | None:
+        """A file descriptor whose READABILITY signals that a message may
+        be pending — what lets an event-driven serve loop park in one
+        poll() over its worker sockets AND the announce bus instead of
+        waking on a tick cadence. None (the default) means the backend has
+        no pollable signal; consumers keep their periodic drain. The fd
+        may change across reconnects — pollers should re-check each
+        iteration. Readability is a HINT (spurious wakes are fine, the
+        drain finding nothing is fine); the periodic fallback drain still
+        covers a backend whose signal is lossy."""
+        return None
+
+    def pollable_fds(self) -> list[int]:
+        """Every pollable readability fd of this subscription (fan-out
+        subscriptions over several shards return one per shard); [] when
+        the backend has no pollable signal."""
+        fd = self.fileno()
+        return [fd] if fd is not None else []
 
     def __enter__(self) -> "Subscription":
         return self
@@ -573,7 +650,9 @@ class TaskStore(abc.ABC):
             self.set_status(task_id, status, extra_fields=extra)
 
     def finish_task_many(
-        self, items: list[tuple[str, TaskStatus | str, str, bool]]
+        self,
+        items: list[tuple[str, TaskStatus | str, str, bool]],
+        inline_max: int = 0,
     ) -> None:
         """Batch finish_task, each item (task_id, status, result,
         first_wins). Sequential per-item semantics are the contract —
@@ -582,9 +661,13 @@ class TaskStore(abc.ABC):
         items were applied one by one. Default: a loop; the RESP client
         collapses the batch into one status pre-read for the first_wins
         slice plus one pipelined write+announce round — the dispatcher's
-        result drain and its deferred-result replay ride this."""
+        result drain and its deferred-result replay ride this.
+        ``inline_max`` as in finish_task (express result lane)."""
         for task_id, status, result, first_wins in items:
-            self.finish_task(task_id, status, result, first_wins=first_wins)
+            self.finish_task(
+                task_id, status, result,
+                first_wins=first_wins, inline_max=inline_max,
+            )
 
     def hset_many(self, items: list[tuple[str, Mapping[str, str]]]) -> None:
         """Field writes across many hashes. Default: a loop; the RESP client
@@ -603,6 +686,7 @@ class TaskStore(abc.ABC):
         status: TaskStatus | str,
         result: str,
         first_wins: bool = False,
+        inline_max: int = 0,
     ) -> None:
         """Record a terminal status + serialized result in one write
         (reference task_dispatcher.py:153-156, 284-295).
@@ -620,7 +704,14 @@ class TaskStore(abc.ABC):
         After the write the task_id is announced on RESULTS_CHANNEL (after,
         so a woken subscriber always reads the terminal record). The write
         also stamps FIELD_FINISHED_AT (epoch seconds) so a result-TTL
-        sweeper can age the record out."""
+        sweeper can age the record out.
+
+        ``inline_max`` > 0 (the express result lane, opt-in at the
+        producing dispatcher) makes the announce carry status + result
+        inline up to that many result bytes (encode_result_announce) —
+        oversized results fall back to the classic id-only payload. The
+        record write above stays authoritative and still precedes the
+        announce."""
         if first_wins and self._result_frozen(task_id):
             return
         now = repr(time.time())
@@ -638,7 +729,10 @@ class TaskStore(abc.ABC):
             },
         )
         self.hdel(LIVE_INDEX_KEY, task_id)
-        self.publish(RESULTS_CHANNEL, task_id)
+        self.publish(
+            RESULTS_CHANNEL,
+            encode_result_announce(task_id, str(status), result, inline_max),
+        )
 
     def cancel_task(
         self, task_id: str, channel: str = TASKS_CHANNEL

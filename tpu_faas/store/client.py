@@ -39,6 +39,7 @@ from tpu_faas.store.base import (
     Subscription,
     TaskStore,
     blob_key,
+    encode_result_announce,
 )
 
 #: Process-wide round-trip counter, one series per store role: the scrape
@@ -243,6 +244,21 @@ class _RespSubscription(Subscription):
             # Partial message: keep waiting within the same timeout window.
             # (Simplification: we don't decrement the deadline; pub/sub frames
             # are tiny so a partial read resolves on the next recv.)
+
+    def fileno(self) -> int | None:
+        """Readability fd of the live subscription socket (None while
+        disconnected) — lets an event-driven serve loop park in one poll()
+        over workers AND the bus. The fd changes on reconnect/failover;
+        pollers re-check each iteration (Subscription.fileno contract).
+        NOTE: messages already parsed into the buffer don't show as
+        readability — consumers drain to empty each wake, and their
+        periodic fallback covers the rest."""
+        if self._closed or self._conn is None:
+            return None
+        try:
+            return self._conn.sock.fileno()
+        except OSError:
+            return None
 
     @staticmethod
     def _decode_push(item) -> str | None:
@@ -537,10 +553,15 @@ class RespStore(TaskStore):
         return self._command("HMGET", key, *fields)
 
     @staticmethod
-    def _finish_cmds(task_id: str, status, result: str, now: str) -> list[tuple]:
+    def _finish_cmds(
+        task_id: str, status, result: str, now: str, inline_max: int = 0
+    ) -> list[tuple]:
         """The terminal-write command triple shared by finish_task and
         finish_task_many — ONE builder, so the single and batched forms can
-        never desynchronize on the record contract."""
+        never desynchronize on the record contract. ``inline_max`` > 0
+        (express lane) puts the status + result inline on the announce —
+        SAME pipelined round, record write still first, so durability and
+        ordering are unchanged."""
         from tpu_faas.core.task import (
             FIELD_FINAL_AT,
             FIELD_FINAL_STATUS,
@@ -561,7 +582,12 @@ class RespStore(TaskStore):
                 FIELD_FINISHED_AT, now,
             ),
             ("HDEL", LIVE_INDEX_KEY, task_id),  # drop from the live index
-            ("PUBLISH", RESULTS_CHANNEL, task_id),
+            (
+                "PUBLISH", RESULTS_CHANNEL,
+                encode_result_announce(
+                    task_id, str(status), result, inline_max
+                ),
+            ),
         ]
 
     def finish_task(
@@ -570,6 +596,7 @@ class RespStore(TaskStore):
         status,
         result: str,
         first_wins: bool = False,
+        inline_max: int = 0,
     ) -> None:
         """Base semantics (terminal write + RESULTS_CHANNEL announce), but
         the write and the announce ride ONE pipelined round trip — the
@@ -577,7 +604,9 @@ class RespStore(TaskStore):
         a second RTT for the wake-up feature."""
         if first_wins and self._result_frozen(task_id):
             return
-        cmds = self._finish_cmds(task_id, status, result, repr(time.time()))
+        cmds = self._finish_cmds(
+            task_id, status, result, repr(time.time()), inline_max
+        )
         try:
             replies = self.pipeline(cmds)
         except (ConnectionError, TimeoutError):
@@ -722,7 +751,7 @@ class RespStore(TaskStore):
         if errors:
             raise errors[0]
 
-    def finish_task_many(self, items) -> None:
+    def finish_task_many(self, items, inline_max: int = 0) -> None:
         """Batch finish_task in a bounded number of round trips: one
         pipelined status pre-read for the first_wins slice (the frozen
         probe ``_result_frozen`` pays per task on the loop default), then
@@ -757,7 +786,9 @@ class RespStore(TaskStore):
         for task_id, status, result, first_wins in items:
             if first_wins and (task_id in written or task_id in frozen):
                 continue
-            cmds.extend(self._finish_cmds(task_id, status, result, now))
+            cmds.extend(
+                self._finish_cmds(task_id, status, result, now, inline_max)
+            )
             written.add(task_id)
         if not cmds:
             return

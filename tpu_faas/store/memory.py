@@ -10,6 +10,7 @@ SURVEY §5.4 on stranded announcements).
 from __future__ import annotations
 
 import queue
+import socket
 import threading
 from typing import Mapping
 
@@ -22,6 +23,39 @@ class _MemorySubscription(Subscription):
         self._channel = channel
         self._queue: queue.Queue[str] = queue.Queue()
         self._closed = False
+        #: lazy self-pipe (socketpair) backing fileno(): created only when
+        #: an event-driven consumer asks for it, so the hundreds of
+        #: subscriptions a test run creates don't each burn two fds
+        self._pipe: tuple[socket.socket, socket.socket] | None = None
+
+    def fileno(self) -> int | None:
+        """Readability signal for event-driven serve loops (see
+        Subscription.fileno): a self-pipe the publish path pokes. Created
+        on first ask; publishes before that never signal (the consumer
+        registered the fd before any message it cares about)."""
+        if self._closed:
+            return None
+        if self._pipe is None:
+            r, w = socket.socketpair()
+            r.setblocking(False)
+            w.setblocking(False)
+            self._pipe = (r, w)
+        return self._pipe[0].fileno()
+
+    def _signal(self) -> None:
+        if self._pipe is not None:
+            try:
+                self._pipe[1].send(b"\x01")
+            except (BlockingIOError, OSError):
+                pass  # pipe full (consumer behind) or closed: both fine
+
+    def _drain_signal(self) -> None:
+        if self._pipe is not None:
+            try:
+                while self._pipe[0].recv(4096):
+                    pass
+            except (BlockingIOError, OSError):
+                pass
 
     def get_message(self, timeout: float = 0.0) -> str | None:
         try:
@@ -29,12 +63,27 @@ class _MemorySubscription(Subscription):
                 return self._queue.get(timeout=timeout)
             return self._queue.get_nowait()
         except queue.Empty:
-            return None
+            # empty queue: drain the wake pipe, then re-check once — a
+            # publish landing between the get and the drain leaves its
+            # byte for the next poll, so a wake can be spurious but never
+            # lost
+            self._drain_signal()
+            try:
+                return self._queue.get_nowait()
+            except queue.Empty:
+                return None
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
             self._store._unsubscribe(self._channel, self)
+            if self._pipe is not None:
+                for s in self._pipe:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                self._pipe = None
 
 
 class MemoryStore(TaskStore):
@@ -117,6 +166,7 @@ class MemoryStore(TaskStore):
             self._ring.append(self._ring_offset, channel, payload)
         for sub in subs:
             sub._queue.put(payload)
+            sub._signal()
 
     def replay_announces(
         self, after: int

@@ -70,9 +70,11 @@ from tpu_faas.store.base import (
     KILL_ANNOUNCE_PREFIX,
     LEASE_CONF_KEY,
     LIVE_INDEX_KEY,
+    RESULT_INLINE_PREFIX,
     TASKS_CHANNEL,
     Subscription,
     TaskStore,
+    decode_result_announce,
 )
 
 #: Fleet coordination hashes: broadcast writes, merged reads (see module
@@ -166,6 +168,14 @@ class _FanSubscription(Subscription):
             if remaining <= 0:
                 return None
             time.sleep(min(self._SWEEP_SLEEP, remaining))
+
+    def pollable_fds(self) -> list[int]:
+        """Every shard subscription's readability fd (event-driven serve
+        loops register them all; any shard's publish wakes the poll)."""
+        fds: list[int] = []
+        for sub in self._subs:
+            fds.extend(sub.pollable_fds())
+        return fds
 
     def close(self) -> None:
         for sub in self._subs:
@@ -323,10 +333,13 @@ class ShardedStore(TaskStore):
     @staticmethod
     def _payload_task_id(payload: str) -> str:
         """The task id embedded in an announce payload (control prefixes
-        stripped) — what publishes route by."""
+        stripped, express inline result frames decoded) — what publishes
+        route by."""
         for prefix in (CANCEL_ANNOUNCE_PREFIX, KILL_ANNOUNCE_PREFIX):
             if payload.startswith(prefix):
                 return payload[len(prefix):]
+        if payload.startswith(RESULT_INLINE_PREFIX):
+            return decode_result_announce(payload)[0]
         return payload
 
     def _merge_fleet_values(self, key: str, a: str, b: str) -> str:
@@ -614,21 +627,25 @@ class ShardedStore(TaskStore):
             or [None] * len(sub),
         )
 
-    def finish_task(self, task_id, status, result, first_wins=False):
+    def finish_task(
+        self, task_id, status, result, first_wins=False, inline_max=0
+    ):
         # wholesale delegation: the shard client's pipelined form (write +
         # index drop + announce in one round) — index and announce both
         # live on the task's own shard by construction
         self._stores[self.ring.shard_of(task_id)].finish_task(
-            task_id, status, result, first_wins=first_wins
+            task_id, status, result,
+            first_wins=first_wins, inline_max=inline_max,
         )
 
-    def finish_task_many(self, items) -> None:
+    def finish_task_many(self, items, inline_max: int = 0) -> None:
         # same-id items stay in one shard's sub-batch in input order, so
         # intra-batch first_wins semantics survive the partition
         self._fan_indexed(
             items,
             lambda item: self.ring.shard_of(item[0]),
-            lambda s, sub: s.finish_task_many(sub) or [None] * len(sub),
+            lambda s, sub: s.finish_task_many(sub, inline_max=inline_max)
+            or [None] * len(sub),
         )
 
     def create_tasks(self, tasks, channel=TASKS_CHANNEL, **kw) -> None:
