@@ -638,3 +638,49 @@ def test_legacy_five_term_regression_record_loads_conservatively():
     est = RuntimeEstimator(store=store)
     assert est._fn_reg["legacyfn"][5] == -1.0
     assert not est._runtime_spread_small("legacyfn")
+
+
+def test_ungraded_regime_speeds_stay_prior():
+    """The documented ungraded-worker regime (module docstring): params
+    never repeat, bytes carry no spread, runtimes genuinely vary — so no
+    estimate level is a trustworthy grading reference. The whole FLEET
+    must stay at the 1.0 prior (no worker graded, nothing dirty to
+    persist) while SIZE learning continues, and placement degrades to
+    size-only: with equal speeds the rank kernel's pairing is
+    speed-blind, so every live worker's slots are interchangeable."""
+    import numpy as np
+
+    from tpu_faas.sched.greedy import rank_match_placement
+
+    est = RuntimeEstimator()
+    d = fn_digest("ungraded-regime-fn")
+    runtimes = [0.05, 5.0]
+    workers = ["w0", "w1", "w2"]
+    for i in range(60):
+        est.observe(
+            d,
+            runtimes[i % 2],
+            workers[i % 3],
+            param_digest=f"u{i}",  # never repeats
+            param_bytes=128,  # no byte spread
+        )
+    # fleet speeds pinned at prior; no speed ever queued for persistence
+    for w in workers:
+        assert est.speed_for(w) == 1.0
+    assert not est._dirty_speeds
+    # size learning is unaffected (the fn-level EWMA tracks the mix)
+    assert est._fn_est[d] == pytest.approx(2.5, rel=0.5)
+    assert not est._runtime_spread_small(d)  # the gate's reason
+    # placement degradation: with all speeds at the prior, assignment is
+    # exactly the size-only rank matching — permuting the (equal) speed
+    # vector cannot change which workers are loaded how much
+    sizes = np.asarray([5.0, 4.0, 3.0, 2.0, 1.0, 0.5], np.float32)
+    valid = np.ones(6, bool)
+    free = np.asarray([2, 2, 2], np.int32)
+    live = np.ones(3, bool)
+    speeds = np.asarray([est.speed_for(w) for w in workers], np.float32)
+    a = np.asarray(
+        rank_match_placement(sizes, valid, speeds, free, live, max_slots=2)
+    )
+    counts = np.bincount(a[a >= 0], minlength=3)
+    assert (counts == 2).all()  # pure process-balancing, no speed skew

@@ -1,0 +1,807 @@
+"""Tenancy plane (tpu_faas/tenancy): config, in-tick fairness kernels,
+resident XLA-vs-fused parity with tenant state, dispatcher wiring,
+gateway/SDK tenant propagation, per-tenant observability, hot reload —
+plus the worker-bookkeeping churn soak (VERDICT item 4 satellite).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import requests
+
+from tpu_faas.core.serialize import serialize
+from tpu_faas.core.task import FIELD_TENANT
+from tpu_faas.sched.state import scheduler_tick_impl
+from tpu_faas.store.base import TENANT_CONF_KEY
+from tpu_faas.store.memory import MemoryStore
+from tpu_faas.tenancy import (
+    DEFAULT_TENANT,
+    TenantTable,
+    parse_caps,
+    parse_shares,
+    valid_tenant,
+)
+from tpu_faas.tenancy.config import decode_conf, encode_conf
+from tpu_faas.tenancy.fairshare import (
+    tenant_deficit_update,
+    tenant_fair_admission,
+)
+
+
+# -- config / table ---------------------------------------------------------
+
+
+def test_parse_shares_and_caps():
+    assert parse_shares("a=3,b=1.5") == {"a": 3.0, "b": 1.5}
+    assert parse_shares("") == {}
+    assert parse_caps("a=100, b=2") == {"a": 100, "b": 2}
+    for bad in ("a", "a=x", "a=-1", "a=0", "a=inf", "a=1,a=2", "bad name=1"):
+        with pytest.raises(ValueError):
+            parse_shares(bad)
+
+
+def test_valid_tenant():
+    assert valid_tenant("team-a") and valid_tenant("A.b_c-9")
+    for bad in ("", "-lead", "has space", "x" * 65, "colon:bad", None, 7):
+        assert not valid_tenant(bad)
+
+
+def test_conf_roundtrip():
+    v = encode_conf("a=3,b=1", now=123.5)
+    assert decode_conf(v) == ("a=3,b=1", 123.5)
+    assert decode_conf(None) is None
+    assert decode_conf("garbled") is None
+
+
+def test_tenant_table_rows_overflow_and_labels():
+    t = TenantTable(shares={"a": 2.0}, caps={"b": 5}, max_tenants=3)
+    assert t.row_for(None) == 0 and t.row_for(DEFAULT_TENANT) == 0
+    ra, rb = t.row_for("a"), t.row_for("b")
+    assert ra != 0 and rb != 0 and ra != rb
+    assert t.row_for("a") == ra  # stable
+    # table full: the next distinct name accounts to default, counted
+    assert t.row_for("c") == 0
+    assert t.overflowed == 1
+    # label vocabulary is bounded by the CONFIGURED names
+    assert t.label_for("a") == "a" and t.label_for("b") == "b"
+    assert t.label_for("c") == "other"
+    assert t.label_for(None) == DEFAULT_TENANT
+    assert float(t.share[ra]) == 2.0 and int(t.cap[rb]) == 5
+    st = t.stats()
+    assert st["tenants"]["a"]["share"] == 2.0
+    assert st["overflowed"] == 1
+
+
+def test_parse_caps_rejects_fractional_values():
+    """int() truncation would turn 'batch=0.5' into cap 0 = UNCAPPED —
+    the inverse of the operator's tightest-possible ask."""
+    for bad in ("a=0.5", "a=2.7"):
+        with pytest.raises(ValueError):
+            parse_caps(bad)
+    assert parse_caps("a=2") == {"a": 2}
+
+
+def test_table_overflow_never_retunes_default_row():
+    """A configured tenant that doesn't fit the table must be SKIPPED,
+    not written onto row 0 — cap[0]=N would hard-cap every header-less
+    client. Configuring 'default' explicitly still works."""
+    t = TenantTable(max_tenants=2)
+    t.row_for("filler")  # table now full (default + filler)
+    t.apply_specs("overflow-tenant=5", "overflow-tenant=3")
+    assert float(t.share[0]) == 1.0  # default row untouched
+    assert int(t.cap[0]) == 0
+    assert t.label_for("overflow-tenant") == "other"  # not labelled either
+    t2 = TenantTable(max_tenants=2)
+    t2.apply_specs("default=4", "default=7")
+    assert float(t2.share[0]) == 4.0 and int(t2.cap[0]) == 7
+
+
+def test_apply_specs_is_all_or_nothing():
+    """Valid shares + malformed caps in one retune must fail WHOLE: a
+    half-applied reload would leave new shares silently live beside old
+    caps while reporting 'no change'."""
+    t = TenantTable(max_tenants=8)
+    t.apply_specs("a=2", "a=5")
+    with pytest.raises(ValueError):
+        t.apply_specs("a=9", "a=bad")
+    assert float(t.share[t.row_for("a")]) == 2.0  # shares NOT applied
+    # and the store-driven reload path reports no change + keeps config
+    store = MemoryStore()
+    store.hset(
+        TENANT_CONF_KEY,
+        {"shares": encode_conf("a=9"), "caps": encode_conf("a=broken")},
+    )
+    assert t.maybe_reload(store) is False
+    assert float(t.share[t.row_for("a")]) == 2.0
+
+
+def test_tenant_table_apply_specs_change_detection():
+    t = TenantTable(max_tenants=8)
+    assert t.apply_specs("a=2", None) is True
+    assert t.apply_specs("a=2", None) is False  # unchanged
+    assert t.apply_specs("a=4", "a=9") is True
+    assert float(t.share[t.row_for("a")]) == 4.0
+    assert int(t.cap[t.row_for("a")]) == 9
+    with pytest.raises(ValueError):
+        t.apply_specs("broken==", None)
+
+
+def test_tenant_table_hot_reload_via_store():
+    store = MemoryStore()
+    t = TenantTable(max_tenants=8)
+    t.apply_specs("a=2", "")
+    t.publish(store)
+    # a second table (another dispatcher) picks the config up
+    t2 = TenantTable(max_tenants=8)
+    assert t2.maybe_reload(store) is True
+    assert float(t2.share[t2.row_for("a")]) == 2.0
+    assert t2.maybe_reload(store) is False  # unchanged
+    # operator hot-updates the hash; both tables converge
+    store.hset(TENANT_CONF_KEY, {"shares": encode_conf("a=7")})
+    assert t.maybe_reload(store) is True and t2.maybe_reload(store) is True
+    assert float(t.share[t.row_for("a")]) == 7.0
+    # malformed published spec: ignored, last good config kept
+    store.hset(TENANT_CONF_KEY, {"shares": encode_conf("a==broken")})
+    assert t.maybe_reload(store) is False
+    assert float(t.share[t.row_for("a")]) == 7.0
+
+
+# -- the in-tick kernels ----------------------------------------------------
+
+
+def _admit(valid, tenant, share, deficit=None, ahead=None, cap=None,
+           prio=None, **kw):
+    N = share.shape[0]
+    z = lambda dt: jnp.zeros(N, dt)  # noqa: E731
+    return tenant_fair_admission(
+        jnp.asarray(valid), jnp.asarray(tenant, jnp.int32),
+        None if prio is None else jnp.asarray(prio, jnp.int32),
+        jnp.asarray(share, jnp.float32),
+        z(jnp.float32) if deficit is None else jnp.asarray(deficit, jnp.float32),
+        z(jnp.int32) if ahead is None else jnp.asarray(ahead, jnp.int32),
+        z(jnp.int32) if cap is None else jnp.asarray(cap, jnp.int32),
+        **kw,
+    )
+
+
+def test_weighted_interleave_tracks_shares():
+    # alternating tenants 0/1, shares 3:1 -> any admitted prefix of the
+    # fair order holds ~3 tenant-0 per tenant-1
+    tenant = np.array([0, 1] * 16, np.int32)
+    share = np.array([3.0, 1.0], np.float32)
+    _elig, rank, _demand = _admit(np.ones(32, bool), tenant, share)
+    order = np.asarray(tenant)[np.argsort(np.asarray(rank))]
+    for k in (8, 16, 24):
+        frac0 = (order[:k] == 0).mean()
+        assert 0.6 <= frac0 <= 0.85, (k, order[:k])
+
+
+def test_work_conservation_idle_tenant_spills():
+    # tenant 1 has NO tasks: tenant 0 takes every admitted slot
+    tenant = np.zeros(8, np.int32)
+    share = np.array([1.0, 100.0], np.float32)  # huge idle share
+    elig, rank, demand = _admit(np.ones(8, bool), tenant, share)
+    assert np.asarray(elig).all()
+    assert sorted(np.asarray(rank)[:8]) == list(range(8))
+    assert list(np.asarray(demand)) == [True, False]
+
+
+def test_fcfs_within_tenant_preserved():
+    tenant = np.array([0, 0, 0, 0], np.int32)
+    share = np.array([1.0], np.float32)
+    _e, rank, _d = _admit(np.ones(4, bool), tenant, share)
+    assert list(np.asarray(rank)) == [0, 1, 2, 3]
+
+
+def test_inflight_cap_masks_surplus():
+    tenant = np.array([0, 0, 0, 1, 1, 1], np.int32)
+    share = np.array([1.0, 1.0], np.float32)
+    elig, _r, demand = _admit(
+        np.ones(6, bool), tenant, share,
+        ahead=np.array([0, 2], np.int32), cap=np.array([0, 3], np.int32),
+    )
+    # tenant 1: cap 3, 2 already inflight -> only its FIRST pending row
+    # stays eligible; tenant 0 uncapped
+    assert list(np.asarray(elig)) == [True, True, True, True, False, False]
+    assert list(np.asarray(demand)) == [True, True]
+
+
+def test_priority_classes_dominate_fairness():
+    tenant = np.array([0, 0, 1, 1], np.int32)
+    share = np.array([100.0, 1.0], np.float32)
+    prio = np.array([0, 0, 1, 1], np.int32)
+    _e, rank, _d = _admit(np.ones(4, bool), tenant, share, prio=prio)
+    order = list(np.argsort(np.asarray(rank)))
+    assert order == [2, 3, 0, 1]  # the priority class first, shares within
+
+
+def test_starvation_boost_rides_priority_lane():
+    tenant = np.array([0, 0, 1, 1], np.int32)
+    share = np.array([1.0, 1.0], np.float32)
+    prio = np.array([1, 1, 0, 0], np.int32)
+    # below threshold: tenant 0's priority class wins outright
+    _e, rank, _d = _admit(
+        np.ones(4, bool), tenant, share, prio=prio,
+        deficit=np.array([0.0, 4.0], np.float32),
+        starve_deficit=8.0, starve_boost=1,
+    )
+    assert list(np.argsort(np.asarray(rank)))[:2] == [0, 1]
+    # past threshold: the starving tenant is boosted one class and its
+    # huge deficit pulls its whole queue to the front of that class
+    _e, rank, _d = _admit(
+        np.ones(4, bool), tenant, share, prio=prio,
+        deficit=np.array([0.0, 9.0], np.float32),
+        starve_deficit=8.0, starve_boost=1,
+    )
+    assert list(np.argsort(np.asarray(rank)))[:2] == [2, 3]
+
+
+def test_deficit_update_drr_semantics():
+    tenant = np.array([0, 0, 1, 1], np.int32)
+    share = jnp.asarray(np.array([1.0, 1.0], np.float32))
+    demand = jnp.asarray(np.array([True, True]))
+    # tenant 1 backlogged but got nothing: its deficit grows by its
+    # entitlement (half of 2 placements); tenant 0 over-served, clamps at 0
+    assignment = jnp.asarray(np.array([0, 1, -1, -1], np.int32))
+    new = np.asarray(
+        tenant_deficit_update(
+            assignment, jnp.asarray(tenant, jnp.int32), demand, share,
+            jnp.zeros(2, jnp.float32),
+        )
+    )
+    assert new[0] == 0.0 and new[1] == pytest.approx(1.0)
+    # a tenant with no demand RESETS (DRR: credit is for waiting work)
+    new2 = np.asarray(
+        tenant_deficit_update(
+            assignment, jnp.asarray(tenant, jnp.int32),
+            jnp.asarray(np.array([True, False])), share,
+            jnp.asarray(np.array([0.0, 3.0], np.float32)),
+        )
+    )
+    assert new2[1] == 0.0
+
+
+def test_starved_tenant_recovers_through_tick_iterations():
+    """End-to-end through scheduler_tick_impl: a priority-0 tenant starved
+    by a priority-1 flood accumulates deficit tick over tick until the
+    starvation guard boosts it into the admitted class."""
+    T = 8
+    tenant = jnp.asarray(np.array([0, 1] * 4, np.int32))
+    prio = jnp.asarray(np.array([1, 0] * 4, np.int32))
+    share = jnp.asarray(np.ones(2, np.float32))
+    deficit = jnp.zeros(2, jnp.float32)
+    ws = jnp.ones(2, jnp.float32)
+    wa = jnp.ones(2, bool)
+    hb = jnp.zeros(2, jnp.float32)
+    pl = jnp.ones(2, bool)
+    iw = jnp.full(4, -1, jnp.int32)
+    placed_t1 = []
+    for _ in range(6):
+        out = scheduler_tick_impl(
+            jnp.ones(T, jnp.float32), jnp.ones(T, bool), ws,
+            jnp.asarray(np.array([1, 1], np.int32)), wa, hb, pl, iw,
+            jnp.float32(10.0), max_slots=1, task_priority=prio,
+            task_tenant=tenant, tenant_share=share, tenant_deficit=deficit,
+            tenant_ahead=jnp.zeros(2, jnp.int32),
+            tenant_cap=jnp.zeros(2, jnp.int32),
+            starve_deficit=2.5, starve_boost=1,
+        )
+        a = np.asarray(out.assignment)
+        placed_t1.append(int(((a >= 0) & (np.asarray(tenant) == 1)).sum()))
+        deficit = out.tenant_deficit
+    # starved at first (priority flood takes both slots), then the guard
+    # engages and tenant 1 gets placements
+    assert placed_t1[0] == 0
+    assert any(n > 0 for n in placed_t1[2:]), placed_t1
+    assert float(np.asarray(deficit)[0]) >= 0.0
+
+
+def test_tick_without_tenancy_unchanged():
+    """task_tenant=None must trace the pre-tenancy graph: identical
+    assignment, no deficit output."""
+    T = 6
+    args = (
+        jnp.asarray(np.arange(T, 0, -1), jnp.float32),
+        jnp.ones(T, bool),
+        jnp.ones(3, jnp.float32),
+        jnp.asarray(np.array([2, 2, 2], np.int32)),
+        jnp.ones(3, bool),
+        jnp.zeros(3, jnp.float32),
+        jnp.ones(3, bool),
+        jnp.full(8, -1, jnp.int32),
+        jnp.float32(10.0),
+    )
+    out = scheduler_tick_impl(*args, max_slots=2)
+    assert out.tenant_deficit is None
+    out2 = scheduler_tick_impl(
+        *args, max_slots=2,
+        task_tenant=jnp.zeros(T, jnp.int32),
+        tenant_share=jnp.ones(1, jnp.float32),
+        tenant_deficit=jnp.zeros(1, jnp.float32),
+        tenant_ahead=jnp.zeros(1, jnp.int32),
+        tenant_cap=jnp.zeros(1, jnp.int32),
+    )
+    # one tenant, no caps: fairness degenerates to FCFS — same placement
+    assert np.array_equal(
+        np.asarray(out.assignment), np.asarray(out2.assignment)
+    )
+    assert out2.tenant_deficit is not None
+
+
+def test_cap_mask_applies_to_auction_placement():
+    """Auction/sinkhorn get the hard eligibility mask even though the
+    fair ORDERING is rank-only: a capped tenant's surplus never places."""
+    T = 6
+    tenant = jnp.asarray(np.array([0, 0, 0, 0, 1, 1], np.int32))
+    out = scheduler_tick_impl(
+        jnp.ones(T, jnp.float32), jnp.ones(T, bool),
+        jnp.ones(2, jnp.float32), jnp.asarray(np.array([4, 4], np.int32)),
+        jnp.ones(2, bool), jnp.zeros(2, jnp.float32), jnp.ones(2, bool),
+        jnp.full(4, -1, jnp.int32), jnp.float32(10.0),
+        max_slots=4, placement="auction",
+        task_tenant=tenant,
+        tenant_share=jnp.ones(2, jnp.float32),
+        tenant_deficit=jnp.zeros(2, jnp.float32),
+        tenant_ahead=jnp.zeros(2, jnp.int32),
+        tenant_cap=jnp.asarray(np.array([2, 0], np.int32)),
+    )
+    a = np.asarray(out.assignment)
+    t = np.asarray(tenant)
+    assert ((a >= 0) & (t == 0)).sum() == 2  # capped at 2
+    assert ((a >= 0) & (t == 1)).sum() == 2  # its whole backlog
+
+
+# -- resident parity (XLA oracle vs fused Pallas kernel) --------------------
+
+
+def _resident_script(backend):
+    from tpu_faas.sched.resident import ResidentScheduler
+
+    ten = TenantTable(shares={"a": 2.0, "b": 1.0}, caps={"b": 3},
+                      max_tenants=4)
+    clock = [100.0]
+    r = ResidentScheduler(
+        max_workers=8, max_pending=32, max_inflight=64, max_slots=2,
+        time_to_expire=10.0, clock=lambda: clock[0], use_priority=True,
+        tick_backend=backend, tenancy=ten,
+    )
+    for w in range(2):
+        r.register(f"w{w}".encode(), 2)
+    ra, rb = ten.row_for("a"), ten.row_for("b")
+    log = []
+    for i in range(4):
+        r.pending_add(f"a{i}", 1.0, 0, ra)
+        r.pending_add(f"b{i}", 1.0, 0, rb)
+    for step in range(4):
+        clock[0] += 0.1
+        r.tick_resident()
+        while True:
+            res = r.resolve_next()
+            if res is None:
+                break
+            for tid, row in sorted(res.placed):
+                ten.note_dispatched(ra if tid.startswith("a") else rb)
+                log.append((step, tid, row))
+        if step == 1:
+            # results arrive: capacity frees, inflight counts drop
+            for w in range(2):
+                r.release_slot(w)
+                r.release_slot(w)
+            ten.inflight[:] = 0
+        # a mid-run hot reload flips the shares — values, not statics
+        if step == 2:
+            ten.apply_specs("a=1,b=5", None)
+    return log, r.tenant_deficits()
+
+
+@pytest.mark.parametrize("fused", ["fused_interpret"])
+def test_resident_fused_parity_with_tenant_state(fused):
+    """The PR-11 parity pin extended to tenancy: identical placement
+    streams and deficit carries from the XLA oracle and the one-dispatch
+    fused kernel, through caps, share hot-reload, and capacity churn."""
+    from tpu_faas.sched.pallas_fused import fused_ok
+
+    if not fused_ok():
+        pytest.skip("pallas unavailable")
+    log_x, def_x = _resident_script("xla")
+    log_f, def_f = _resident_script(fused)
+    assert log_x == log_f
+    assert np.allclose(def_x, def_f)
+    assert len(log_x) > 0
+
+
+def test_resident_tenant_packet_roundtrip():
+    """Arrival tenant rows survive the packet -> device -> readback loop:
+    with a hard cap of 0 admitted... (cap=1 and ahead=1) the capped
+    tenant's tasks stay device-pending while the other drains."""
+    from tpu_faas.sched.resident import ResidentScheduler
+
+    ten = TenantTable(shares={"a": 1.0, "b": 1.0}, caps={"b": 1},
+                      max_tenants=4)
+    r = ResidentScheduler(
+        max_workers=4, max_pending=16, max_inflight=16, max_slots=4,
+        time_to_expire=10.0, clock=lambda: 50.0, use_priority=True,
+        tick_backend="xla", tenancy=ten,
+    )
+    r.register(b"w0", 4)
+    ra, rb = ten.row_for("a"), ten.row_for("b")
+    ten.inflight[rb] = 1  # b already at its cap
+    for i in range(3):
+        r.pending_add(f"a{i}", 1.0, 0, ra)
+        r.pending_add(f"b{i}", 1.0, 0, rb)
+    r.tick_resident()
+    res = r.resolve_next()
+    placed = sorted(tid for tid, _ in res.placed)
+    assert placed == ["a0", "a1", "a2"]  # b fully masked by its cap
+    assert res.n_pending == 3  # b's tasks still valid device-side
+
+
+# -- dispatcher wiring ------------------------------------------------------
+
+
+def _mk_disp(**kw):
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+
+    defaults = dict(
+        ip="127.0.0.1", port=0, store=MemoryStore(), max_workers=16,
+        max_pending=64, max_inflight=128, tick_period=0.01,
+        recover_queued=False, estimate_runtimes=False,
+    )
+    defaults.update(kw)
+    return TpuPushDispatcher(**defaults)
+
+
+def test_dispatcher_tenancy_requires_single_device():
+    with pytest.raises(ValueError):
+        _mk_disp(tenant_shares="a=1", multihost=True)
+
+
+def test_dispatcher_fair_dispatch_and_observability():
+    """In-process fairness e2e (batch path): a heavy tenant's flood ahead
+    of a light tenant's task in arrival order does not starve the light
+    tenant; per-tenant counters, gauges, /stats block, and the strict
+    exposition all carry the bounded tenant vocabulary."""
+    from tpu_faas.obs.expofmt import parse_exposition
+    from tpu_faas.worker import messages as m
+
+    disp = _mk_disp(tenant_shares="heavy=1,light=1")
+    try:
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 4})
+        store = disp.store
+        # heavy floods 12 tasks, then ONE light task arrives LAST
+        for i in range(12):
+            store.create_task(
+                f"h{i}", "F", "P", extra_fields={FIELD_TENANT: "heavy"}
+            )
+        store.create_task(
+            "light0", "F", "P", extra_fields={FIELD_TENANT: "light"}
+        )
+        disp.tick()
+        # 4 slots: weighted-fair admission gives light its slot in the
+        # first tick even though 12 heavy tasks queued ahead of it
+        sent = set(disp.arrays._inflight_slot)
+        assert "light0" in sent
+        assert len(sent) == 4
+        # inflight accounting per tenant
+        ten = disp.tenancy
+        assert int(ten.inflight[ten.row_for("light")]) == 1
+        assert int(ten.inflight[ten.row_for("heavy")]) == 3
+        # result for the light task releases its charge
+        disp._handle(
+            b"w0", m.RESULT,
+            {"task_id": "light0", "status": "COMPLETED", "result": "42"},
+        )
+        assert int(ten.inflight[ten.row_for("light")]) == 0
+        # /stats tenancy block + deficits
+        block = disp.stats()["tenancy"]
+        assert block["tenants"]["heavy"]["dispatched"] == 3
+        assert block["tenants"]["light"]["dispatched"] == 1
+        # strict exposition carries the families with bounded labels
+        fams = parse_exposition(disp.render_metrics())
+        f = fams["tpu_faas_tasks_dispatched_total"]
+        labels = {s.labels["tenant"] for s in f.samples}
+        assert {"heavy", "light", "default", "other"} <= labels
+        assert fams["tpu_faas_tenant_queue_depth"] is not None
+        assert fams["tpu_faas_tenant_inflight_tasks"] is not None
+    finally:
+        disp.close()
+
+
+def test_dispatcher_unregistered_tenant_buckets_to_other():
+    from tpu_faas.worker import messages as m
+
+    disp = _mk_disp(tenant_shares="known=1", max_tenants=4)
+    try:
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 2})
+        disp.store.create_task(
+            "t0", "F", "P", extra_fields={FIELD_TENANT: "surprise"}
+        )
+        disp.tick()
+        ten = disp.tenancy
+        assert ten.label_for("surprise") == "other"
+        # it still got its own fair-queue row (capacity permitting)
+        assert ten.row_for("surprise", register=False) != 0
+    finally:
+        disp.close()
+
+
+def test_dispatcher_hot_reload_from_store():
+    disp = _mk_disp(tenant_shares="a=1")
+    try:
+        disp.store.hset(
+            TENANT_CONF_KEY, {"shares": encode_conf("a=9,b=2")}
+        )
+        disp._last_tenant_reload = -1e9
+        disp._maybe_reload_tenant_conf()
+        ten = disp.tenancy
+        assert float(ten.share[ten.row_for("a")]) == 9.0
+        assert float(ten.share[ten.row_for("b")]) == 2.0
+    finally:
+        disp.close()
+
+
+def test_dispatcher_resident_tenancy_e2e():
+    """Resident path: tenant rows ride the delta packet; the capped
+    tenant's surplus stays device-side."""
+    from tpu_faas.worker import messages as m
+
+    disp = _mk_disp(
+        tenant_shares="a=1,b=1", tenant_caps="b=1", resident=True
+    )
+    try:
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 4})
+        for i in range(3):
+            disp.store.create_task(
+                f"a{i}", "F", "P", extra_fields={FIELD_TENANT: "a"}
+            )
+            disp.store.create_task(
+                f"b{i}", "F", "P", extra_fields={FIELD_TENANT: "b"}
+            )
+        disp.tick()
+        sent = set(disp.arrays.slot_task.values()) | set(
+            disp.arrays._inflight_slot
+        )
+        inflight = set(disp.arrays._inflight_slot)
+        assert {"a0", "a1", "a2"} <= inflight
+        assert len([t for t in inflight if t.startswith("b")]) == 1
+    finally:
+        disp.close()
+
+
+def test_inflight_gauge_sums_rows_sharing_other_label():
+    """Two dynamically-registered tenants share the 'other' label; the
+    gauge must SUM their inflight, not report whichever row looped last."""
+    from tpu_faas.obs.expofmt import parse_exposition
+    from tpu_faas.worker import messages as m
+
+    disp = _mk_disp(tenant_shares="known=1", max_tenants=8)
+    try:
+        disp._handle(b"w0", m.REGISTER, {"num_processes": 4})
+        for i, name in enumerate(["dyn-a", "dyn-a", "dyn-b"]):
+            disp.store.create_task(
+                f"t{i}", "F", "P", extra_fields={FIELD_TENANT: name}
+            )
+        disp.tick()
+        fams = parse_exposition(disp.render_metrics())
+        vals = {
+            s.labels["tenant"]: s.value
+            for s in fams["tpu_faas_tenant_inflight_tasks"].samples
+        }
+        assert vals["other"] == 3.0  # dyn-a's 2 + dyn-b's 1, not 1
+    finally:
+        disp.close()
+
+
+def test_tenant_deficits_survives_donated_state_read():
+    """Fused backend donates the state pytree each tick: a stats-thread
+    snapshot of a deleted buffer degrades to None, never raises."""
+    from tpu_faas.sched.pallas_fused import fused_ok
+    from tpu_faas.sched.resident import ResidentScheduler
+
+    if not fused_ok():
+        pytest.skip("pallas unavailable")
+    ten = TenantTable(shares={"a": 1.0}, max_tenants=2)
+    r = ResidentScheduler(
+        max_workers=2, max_pending=8, max_inflight=8, max_slots=1,
+        time_to_expire=10.0, clock=lambda: 1.0, use_priority=True,
+        tick_backend="fused_interpret", tenancy=ten,
+    )
+    r.register(b"w0", 1)
+    r.tick_resident()
+    st = r._r_state
+    # simulate the donation race: the snapshot's buffer gets deleted
+    st.t_deficit.delete()
+    assert r.tenant_deficits() is None
+
+
+# -- gateway / SDK propagation ----------------------------------------------
+
+
+@pytest.fixture()
+def gw():
+    from tpu_faas.gateway import start_gateway_thread
+
+    store = MemoryStore()
+    handle = start_gateway_thread(store)
+    yield handle, store
+    handle.stop()
+
+
+def _register(handle) -> str:
+    r = requests.post(
+        f"{handle.url}/register_function",
+        json={"name": "f", "payload": serialize(lambda: 1)},
+    )
+    return r.json()["function_id"]
+
+
+def test_gateway_stamps_tenant_header(gw):
+    handle, store = gw
+    fid = _register(handle)
+    r = requests.post(
+        f"{handle.url}/execute_function",
+        json={"function_id": fid, "payload": serialize(((), {}))},
+        headers={"X-Tenant-Id": "team-a"},
+    )
+    assert r.status_code == 200
+    assert store.hgetall(r.json()["task_id"])[FIELD_TENANT] == "team-a"
+    # absent header: no field (legacy default tenant)
+    r = requests.post(
+        f"{handle.url}/execute_function",
+        json={"function_id": fid, "payload": serialize(((), {}))},
+    )
+    assert FIELD_TENANT not in store.hgetall(r.json()["task_id"])
+
+
+def test_gateway_rejects_malformed_tenant(gw):
+    handle, _store = gw
+    fid = _register(handle)
+    r = requests.post(
+        f"{handle.url}/execute_function",
+        json={"function_id": fid, "payload": serialize(((), {}))},
+        headers={"X-Tenant-Id": "bad tenant!"},
+    )
+    assert r.status_code == 400
+    assert "X-Tenant-Id" in r.json()["error"]
+
+
+def test_gateway_batch_and_graph_carry_tenant(gw):
+    handle, store = gw
+    fid = _register(handle)
+    r = requests.post(
+        f"{handle.url}/execute_batch",
+        json={"function_id": fid, "payloads": [serialize(((), {}))] * 3},
+        headers={"X-Tenant-Id": "b-tenant"},
+    )
+    assert r.status_code == 200
+    for tid in r.json()["task_ids"]:
+        assert store.hgetall(tid)[FIELD_TENANT] == "b-tenant"
+    r = requests.post(
+        f"{handle.url}/execute_graph",
+        json={
+            "nodes": [
+                {"function_id": fid, "payload": serialize(((), {}))},
+                {
+                    "function_id": fid,
+                    "payload": serialize(((), {})),
+                    "depends_on": [0],
+                },
+            ]
+        },
+        headers={"X-Tenant-Id": "g-tenant"},
+    )
+    assert r.status_code == 200
+    for tid in r.json()["task_ids"]:
+        assert store.hgetall(tid)[FIELD_TENANT] == "g-tenant"
+
+
+def test_sdk_clients_send_tenant_header():
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.client.aio import AsyncFaaSClient
+
+    c = FaaSClient("http://127.0.0.1:1", tenant="team-z")
+    assert c.http.headers["X-Tenant-Id"] == "team-z"
+    assert FaaSClient("http://127.0.0.1:1").http.headers.get(
+        "X-Tenant-Id"
+    ) is None
+
+    import asyncio
+
+    async def probe():
+        async with AsyncFaaSClient(
+            "http://127.0.0.1:1", tenant="a-z"
+        ) as ac:
+            return ac.http.headers.get("X-Tenant-Id")
+
+    assert asyncio.run(probe()) == "a-z"
+
+
+def test_sdk_tenant_reaches_store_end_to_end(gw):
+    handle, store = gw
+    from tpu_faas.client import FaaSClient
+
+    client = FaaSClient(handle.url, tenant="sdk-tenant")
+    fid = client.register_payload("f", serialize(lambda: 1))
+    tid = client.execute_payload(fid, serialize(((), {})))
+    assert store.hgetall(tid)[FIELD_TENANT] == "sdk-tenant"
+
+
+# -- churn soak (satellite: bounded per-worker bookkeeping) -----------------
+
+
+def test_churn_soak_bookkeeping_stays_bounded():
+    """~10k register/misfire/purge/reconnect cycles: every per-worker and
+    per-task map on the tpu-push dispatcher must stay bounded by the LIVE
+    fleet, and the fleet misfire total stays monotone across purges (the
+    worker_misfires dict used to leak one entry per purged socket
+    identity forever; _wid_token leaked whenever the estimator was off)."""
+    from tpu_faas.worker import messages as m
+
+    disp = _mk_disp()  # estimator OFF: the historical _wid_token leak path
+    try:
+        a = disp.arrays
+        total_reported = 0
+        last_total = 0
+        for i in range(10_000):
+            wid = f"churn-{i}".encode()
+            disp._handle(
+                wid, m.REGISTER,
+                {
+                    "num_processes": 1,
+                    "token": f"tok-{i}",
+                    "ephemeral": True,
+                    "caps": ["blob", "bin"],
+                },
+            )
+            # the worker reports a cumulative misfire total on a RESULT
+            # for a task we never dispatched (suspicious path: store
+            # write is first_wins, harmless) — every cycle leaks one
+            # dict entry without the purge fold
+            disp.note_worker_misfires(wid, {"misfires": 2})
+            total_reported += 2
+            row = a.worker_ids[wid]
+            disp._reap_dead_workers([], [row], lambda t: None)
+            cur = disp.total_worker_misfires()
+            assert cur >= last_total
+            last_total = cur
+        assert disp.total_worker_misfires() == total_reported
+        # every per-worker map bounded (empty: the whole fleet was purged)
+        assert len(disp.worker_misfires) == 0
+        assert len(disp._wid_token) == 0
+        assert len(disp._wid_caps) == 0
+        assert len(a.worker_ids) == 0 and len(a.row_ids) == 0
+        # per-task maps untouched by pure worker churn
+        assert len(disp._task_digest) == 0
+        assert len(disp.task_retries) == 0
+        assert len(disp._task_tenant_row) == 0
+    finally:
+        disp.close()
+
+
+def test_push_dispatcher_purge_folds_misfires():
+    """The classic push dispatcher's purge path folds too (same leak)."""
+    from tpu_faas.dispatch.push import PushDispatcher
+    from tpu_faas.worker import messages as m
+
+    clock = [0.0]
+    disp = PushDispatcher(
+        ip="127.0.0.1", port=0, store=MemoryStore(), heartbeat=True,
+        time_to_expire=5.0, clock=lambda: clock[0],
+    )
+    try:
+        for i in range(50):
+            wid = f"pw-{i}".encode()
+            disp._handle(wid, m.REGISTER, {"num_processes": 1})
+            disp.note_worker_misfires(wid, {"misfires": 1})
+            clock[0] += 10.0  # past time_to_expire: next purge reaps it
+            disp.purge_workers()
+        assert len(disp.worker_misfires) == 0
+        assert disp.total_worker_misfires() == 50
+        assert len(disp.workers) == 0
+    finally:
+        disp.close()

@@ -24,7 +24,8 @@ def main(argv: list[str] | None = None) -> None:
         "10 (overload admission), 11 (payload plane), "
         "12 (latency closed-loop), 13 (task graphs), "
         "14 (fleet throughput: sharded control plane), "
-        "15 (tick-latency trajectory: fused vs XLA tick), or 'all'",
+        "15 (tick-latency trajectory: fused vs XLA tick), "
+        "16 (tenant fairness: isolation + weighted shares), or 'all'",
     )
     ap.add_argument(
         "-m", "--mode", default="push",

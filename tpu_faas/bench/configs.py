@@ -2390,6 +2390,341 @@ def _tick_multichip_leg() -> dict:
     }
 
 
+def _tenant_stack(
+    n_workers: int,
+    n_procs: int,
+    tick_period: float,
+    tenant_shares: str | None,
+    tenant_caps: str | None = None,
+):
+    """A fresh full real stack for one tenant-fairness leg: store server
+    over TCP, gateway, tpu-push dispatcher (tenancy plane per
+    ``tenant_shares``; None = plane OFF, the FCFS control), real
+    push-worker subprocesses. Returns (gw, disp, disp_thread, workers,
+    store_handle) — callers tear all five down."""
+    import threading as _threading
+
+    from tpu_faas.bench.harness import _spawn_worker
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+
+    handle = start_store_thread()
+    # admission OFF: this lane measures in-TICK fairness among ADMITTED
+    # tasks. With the default edge admission on, the heavy backlog trips
+    # the derived in-system bound and the light tenant's submits measure
+    # 429/Retry-After backoff instead of placement (config 10 owns that
+    # surface) — the 20-second "p99" that shows up is the SDK sleeping,
+    # not the tick queueing.
+    gw = start_gateway_thread(make_store(handle.url), admission=False)
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(handle.url),
+        max_workers=max(64, n_workers),
+        max_pending=8192,
+        max_inflight=4096,
+        max_slots=n_procs,
+        tick_period=tick_period,
+        tenant_shares=tenant_shares,
+        tenant_caps=tenant_caps,
+    )
+    disp_thread = _threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _spawn_worker(
+            "push_worker", n_procs, url, "--hb", "--hb-period", "0.5"
+        )
+        for _ in range(n_workers)
+    ]
+    return gw, disp, disp_thread, workers, handle
+
+
+def _teardown_tenant_stack(gw, disp, disp_thread, workers, handle) -> None:
+    for w in workers:
+        if w.poll() is None:
+            w.kill()
+            w.wait()
+    disp.stop()
+    disp_thread.join(timeout=10)
+    gw.stop()
+    handle.stop()
+
+
+def _light_latency_leg(
+    n_workers: int,
+    n_procs: int,
+    n_light: int,
+    heavy_backlog: int,
+    task_s: float,
+    tenant_shares: str | None,
+    tenant_caps: str | None = None,
+    tick_period: float = 0.005,
+) -> dict:
+    """One light-tenant latency measurement: optionally flood the fleet
+    with ``heavy_backlog`` sleep tasks from the HEAVY tenant first (one
+    batched submit), then run the LIGHT tenant's closed loop of
+    ``n_light`` sleep tasks and report its latency distribution plus the
+    heavy tenant's saturation evidence. ``heavy_backlog=0`` is the light
+    tenant's SOLO baseline."""
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.workloads import sleep_task
+
+    gw, disp, disp_thread, workers, handle = _tenant_stack(
+        n_workers, n_procs, tick_period, tenant_shares, tenant_caps
+    )
+    try:
+        time.sleep(1.5)  # workers register
+        light = FaaSClient(gw.url, tenant="light")
+        heavy = FaaSClient(gw.url, tenant="heavy")
+        fid = light.register_payload("sleep_task", serialize(sleep_task))
+        # warmup outside the window: pool spawn + first dill decode
+        for h in light.submit_many(fid, [(((0.001,), {}))] * 4):
+            h.result(timeout=60.0)
+        dispatched0 = disp.n_dispatched
+        if heavy_backlog:
+            heavy.submit_many(
+                fid, [(((task_s,), {}))] * heavy_backlog
+            )
+        lat: list[float] = []
+        t0 = time.perf_counter()
+        for _ in range(n_light):
+            s = time.perf_counter()
+            light.submit(fid, task_s).result(timeout=300.0)
+            lat.append(time.perf_counter() - s)
+        run_s = time.perf_counter() - t0
+        arr = np.asarray(lat)
+        tenancy = disp.stats().get("tenancy")
+        # strict-grammar /metrics scrape carrying the tenant families
+        # (tenancy legs only — the FCFS control has no tenant series)
+        scrape_ok = True
+        scrape_missing: list[str] = []
+        scrape_error = ""
+        if tenant_shares is not None:
+            import requests as _requests
+
+            from tpu_faas.obs.expofmt import parse_exposition, require_series
+
+            try:
+                srv = disp.serve_stats(0)
+                port = srv.server_address[1]
+                families = parse_exposition(
+                    _requests.get(
+                        f"http://127.0.0.1:{port}/metrics", timeout=10
+                    ).text
+                )
+                scrape_missing = require_series(
+                    families,
+                    [
+                        "tpu_faas_tasks_dispatched_total",
+                        "tpu_faas_tenant_queue_depth",
+                        "tpu_faas_tenant_inflight_tasks",
+                    ],
+                )
+                scrape_ok = not scrape_missing
+            except Exception as exc:
+                scrape_ok = False
+                scrape_error = f"{type(exc).__name__}: {exc}"
+        return {
+            "leg": (
+                "solo" if not heavy_backlog
+                else ("overload" if tenant_shares is not None
+                      else "overload-control")
+            ),
+            "light_tasks": n_light,
+            "heavy_backlog": heavy_backlog,
+            "run_s": round(run_s, 2),
+            "light_p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
+            "light_p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+            "light_mean_ms": round(float(arr.mean()) * 1e3, 2),
+            # saturation evidence: the heavy tenant kept the fleet busy
+            # through the light run (dispatches well past the light count)
+            "dispatched_during": disp.n_dispatched - dispatched0,
+            "tenancy": tenancy,
+            "metrics_scrape_ok": scrape_ok,
+            "metrics_missing": scrape_missing,
+            "metrics_scrape_error": scrape_error,
+        }
+    finally:
+        _teardown_tenant_stack(gw, disp, disp_thread, workers, handle)
+
+
+def _weighted_share_leg(
+    n_workers: int,
+    n_procs: int,
+    backlog_per_tenant: int,
+    task_s: float,
+    shares: dict[str, float],
+    tick_period: float = 0.005,
+) -> dict:
+    """Three saturating tenants under a configured share vector: submit
+    equal backlogs, let the fleet run until roughly half the work is
+    dispatched (every tenant still backlogged), and report each tenant's
+    dispatched fraction against its configured share fraction."""
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.workloads import sleep_task
+
+    spec = ",".join(f"{k}={v:g}" for k, v in shares.items())
+    gw, disp, disp_thread, workers, handle = _tenant_stack(
+        n_workers, n_procs, tick_period, spec
+    )
+    try:
+        time.sleep(1.5)
+        clients = {k: FaaSClient(gw.url, tenant=k) for k in shares}
+        first = next(iter(clients.values()))
+        fid = first.register_payload("sleep_task", serialize(sleep_task))
+        for h in first.submit_many(fid, [(((0.001,), {}))] * 4):
+            h.result(timeout=60.0)
+        base = {
+            k: int(disp.tenancy.dispatched[disp.tenancy.row_for(k)])
+            for k in shares
+        }
+        for k, c in clients.items():
+            c.submit_many(fid, [(((task_s,), {}))] * backlog_per_tenant)
+        total = backlog_per_tenant * len(shares)
+        # sample while EVERY tenant is still backlogged: at half the
+        # total dispatched, the largest share (<= 4/7 of the work) has
+        # not yet exhausted its equal backlog
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            done = sum(
+                int(disp.tenancy.dispatched[disp.tenancy.row_for(k)])
+                - base[k]
+                for k in shares
+            )
+            if done >= total // 2:
+                break
+            time.sleep(0.05)
+        counts = {
+            k: int(disp.tenancy.dispatched[disp.tenancy.row_for(k)])
+            - base[k]
+            for k in shares
+        }
+        got_total = max(sum(counts.values()), 1)
+        share_total = sum(shares.values())
+        return {
+            "shares": dict(shares),
+            "backlog_per_tenant": backlog_per_tenant,
+            "dispatched": counts,
+            "dispatched_fraction": {
+                k: round(v / got_total, 4) for k, v in counts.items()
+            },
+            "configured_fraction": {
+                k: round(v / share_total, 4) for k, v in shares.items()
+            },
+            "max_abs_fraction_error": round(
+                max(
+                    abs(counts[k] / got_total - shares[k] / share_total)
+                    for k in shares
+                ),
+                4,
+            ),
+        }
+    finally:
+        _teardown_tenant_stack(gw, disp, disp_thread, workers, handle)
+
+
+def config_16_tenant_fairness() -> dict:
+    """Tenant-fairness lane (config 16): the tenancy plane's two promises
+    measured on the full real stack (store server, gateway, tpu-push with
+    ``--tenant-shares``, real push-worker subprocesses) —
+
+    - **isolation**: a LIGHT tenant's closed-loop p99 with a HEAVY
+      tenant's backlog saturating the fleet, against its own SOLO
+      baseline (the bar: <= 1.2x while the heavy tenant saturates);
+      plus an optional FCFS CONTROL leg (tenancy off) where the same
+      light task sits behind the whole heavy backlog — the number the
+      plane exists to fix;
+    - **weighted shares**: three saturating tenants under a 4:2:1 share
+      vector; dispatched fractions must track configured fractions
+      (CI bar: within 10%).
+
+    Shape via TPU_FAAS_BENCH_TENANT_SHAPE="workers,procs,light_tasks,
+    heavy_backlog,task_ms" (default "2,4,20,160,300" — task_ms well
+    above the box's fixed scheduling jitter, so the ratio reflects
+    isolation, not host noise); TPU_FAAS_BENCH_TENANT_CONTROL=0 skips
+    the slow FCFS control leg;
+    TPU_FAAS_BENCH_TENANT_SHARE_SHAPE="backlog,task_ms" sizes the share
+    leg (default "150,20")."""
+    import os
+
+    shape = os.environ.get(
+        "TPU_FAAS_BENCH_TENANT_SHAPE", "2,4,20,160,300"
+    )
+    n_workers, n_procs, n_light, heavy_backlog, task_ms = (
+        int(x) for x in shape.split(",")
+    )
+    task_s = task_ms / 1e3
+    # the isolation config under test, both mechanisms the plane ships:
+    # the SHARE vector makes the light tenant's head-of-queue virtual
+    # position (1/share) beat the backlogged tenant's head on the first
+    # free slot (weight 8 ~ "latency-sensitive"), and the heavy tenant's
+    # inflight CAP of slots-1 keeps one slot of standing headroom — a
+    # saturating tenant may never occupy the LAST slot, so the light
+    # tenant's task starts immediately instead of waiting out a
+    # slot-free interval. This is the documented latency-isolation
+    # recipe (OPERATIONS.md "Multi-tenancy"); the weighted-share leg
+    # below measures the share vector without caps.
+    shares = "light=8,heavy=1"
+    caps = f"heavy={n_workers * n_procs - 1}"
+    row: dict = {
+        "config": "tenant-fairness",
+        "shape": {
+            "workers": n_workers,
+            "procs": n_procs,
+            "light_tasks": n_light,
+            "heavy_backlog": heavy_backlog,
+            "task_ms": task_ms,
+        },
+        "tenant_shares": shares,
+        "tenant_caps": caps,
+        "solo": _light_latency_leg(
+            n_workers, n_procs, n_light, 0, task_s, shares, caps
+        ),
+        "overload": _light_latency_leg(
+            n_workers, n_procs, n_light, heavy_backlog, task_s, shares,
+            caps,
+        ),
+    }
+    solo_p99 = row["solo"]["light_p99_ms"]
+    row["light_p99_ratio_overload_over_solo"] = (
+        round(row["overload"]["light_p99_ms"] / solo_p99, 3)
+        if solo_p99
+        else None
+    )
+    # the heavy tenant saturated: it consumed (nearly) every dispatch the
+    # light tenant didn't
+    row["heavy_saturated"] = (
+        row["overload"]["dispatched_during"] >= n_light + heavy_backlog // 2
+    )
+    if os.environ.get("TPU_FAAS_BENCH_TENANT_CONTROL", "1") != "0":
+        # FCFS control: tenancy OFF, fewer light tasks (each can wait out
+        # the whole heavy backlog — that is the point)
+        row["control"] = _light_latency_leg(
+            n_workers, n_procs, max(3, n_light // 5), heavy_backlog,
+            task_s, None,
+        )
+        if solo_p99:
+            row["light_p99_ratio_control_over_solo"] = round(
+                row["control"]["light_p99_ms"] / solo_p99, 3
+            )
+    share_shape = os.environ.get(
+        "TPU_FAAS_BENCH_TENANT_SHARE_SHAPE", "150,20"
+    )
+    share_backlog, share_task_ms = (int(x) for x in share_shape.split(","))
+    row["weighted_share"] = _weighted_share_leg(
+        n_workers, n_procs, share_backlog, share_task_ms / 1e3,
+        {"gold": 4.0, "silver": 2.0, "bronze": 1.0},
+    )
+    row["share_ratios_within_10pct"] = (
+        row["weighted_share"]["max_abs_fraction_error"] <= 0.10
+    )
+    return row
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -2406,4 +2741,5 @@ CONFIGS = {
     "13": config_13_graph_pipeline,
     "14": config_14_fleet,
     "15": config_15_tick_trajectory,
+    "16": config_16_tenant_fairness,
 }

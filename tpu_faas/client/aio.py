@@ -120,6 +120,7 @@ class AsyncFaaSClient:
         overload_retries: int = 4,
         auto_idempotency: bool = True,
         trace: bool = False,
+        tenant: str | None = None,
     ) -> None:
         """``overload_retries``/``auto_idempotency``: same overload
         contract as the sync FaaSClient — 429/503 submit rejects retry
@@ -127,12 +128,15 @@ class AsyncFaaSClient:
         every submit carries an idempotency key (auto-minted unless the
         caller supplied one or disabled it) so retries are
         duplicate-safe. ``trace``: mint a distributed trace id per submit
-        and send it along — same contract as the sync FaaSClient."""
+        and send it along — same contract as the sync FaaSClient.
+        ``tenant``: sent as ``X-Tenant-Id`` on every request (same
+        contract as the sync FaaSClient's tenant)."""
         self.base_url = base_url.rstrip("/")
         self.connect_retries = connect_retries
         self.overload_retries = int(overload_retries)
         self.auto_idempotency = bool(auto_idempotency)
         self.trace = bool(trace)
+        self.tenant = tenant
         #: serialize()/register dedup, shared shape with the sync SDK
         self._memo = _FnMemo()
         self._http: aiohttp.ClientSession | None = None
@@ -210,7 +214,12 @@ class AsyncFaaSClient:
         return self._http
 
     async def __aenter__(self) -> "AsyncFaaSClient":
-        self._http = aiohttp.ClientSession()
+        headers = (
+            {"X-Tenant-Id": str(self.tenant)}
+            if self.tenant is not None
+            else None
+        )
+        self._http = aiohttp.ClientSession(headers=headers)
         return self
 
     async def __aexit__(self, *exc: object) -> None:

@@ -265,6 +265,7 @@ class FaaSClient:
         overload_retries: int = 4,
         auto_idempotency: bool = True,
         trace: bool = False,
+        tenant: str | None = None,
     ) -> None:
         """``overload_retries``: how many times a submit rejected with
         429/503 (admission brownout, saturated system, store breaker) is
@@ -278,14 +279,23 @@ class FaaSClient:
         the request; against a ``--trace`` gateway the returned handles
         carry ``trace_id`` and ``/trace/<task_id>`` assembles the
         cross-process timeline. Harmless against a trace-disabled
-        gateway (the field is ignored there)."""
+        gateway (the field is ignored there). ``tenant``: this client's
+        tenant identity (tpu_faas/tenancy) — sent as ``X-Tenant-Id`` on
+        every request, so the dispatcher's weighted-fair tick accounts
+        the submits to it; None (the default) is the shared default
+        tenant, and the header is ignored by tenancy-oblivious
+        gateways."""
         self.base_url = base_url.rstrip("/")
         self.overload_retries = int(overload_retries)
         self.auto_idempotency = bool(auto_idempotency)
         self.trace = bool(trace)
+        self.tenant = tenant
         #: serialize()/register dedup (see _FnMemo)
         self._memo = _FnMemo()
         self.http = requests.Session()
+        if tenant is not None:
+            # session-wide: single, batch, and graph submits all carry it
+            self.http.headers["X-Tenant-Id"] = str(tenant)
         # retry CONNECTION-establishment failures only (gateway restarting
         # behind a load balancer): nothing has reached the wire yet, so the
         # retry is safe even for POSTs — re-sending an /execute_function

@@ -138,6 +138,16 @@ FIELD_SUBMITTED_AT = "submitted_at"
 FIELD_TRACE_ID = "trace_id"
 FIELD_TRACE_PARENT = "trace_parent"
 
+#: Tenant identity (tpu_faas/tenancy): which principal this task is
+#: accounted to by the weighted-fair placement plane. Written by the
+#: gateway from the ``X-Tenant-Id`` request header (validated — it becomes
+#: a metrics-label candidate and a share-table key); ABSENT on tasks from
+#: legacy/reference-style producers, which every consumer reads as the
+#: default tenant — so tenancy-oblivious clients share one fair-queued
+#: bucket and the whole plane is invisible until two tenants actually
+#: coexist. Rides RECLAIM_FIELDS: a reclaimed task keeps its accounting.
+FIELD_TENANT = "tenant"
+
 #: Written (epoch seconds as str) with every RUNNING mark and refreshed
 #: periodically by the dispatcher that owns the task's worker. A RUNNING
 #: record whose lease has gone stale has no live owner left — its worker

@@ -212,6 +212,30 @@ def main(argv: list[str] | None = None) -> None:
         "rescans — include at least one tpu-push dispatcher in a shared "
         "fleet for automatic failover",
     )
+    ap.add_argument(
+        "--tenant-shares", default=None, metavar="NAME=W,...",
+        help="tpu-push: turn on the tenancy plane with this share vector "
+        "(e.g. 'team-a=3,team-b=1'; unlisted tenants weigh 1). Placement "
+        "becomes weighted-fair INSIDE the device tick: backlogged "
+        "tenants are admitted in proportion to their shares, an idle "
+        "tenant's capacity spills to the others, and a starved tenant's "
+        "deficit boosts it up the priority lane. Hot-reloadable at "
+        "runtime via the fleet:tenant_conf store hash (HSET shares "
+        "'<spec>:<epoch>'). Pass '' to enable the plane with equal "
+        "shares. Single-device feature (refused with --mesh/--multihost)",
+    )
+    ap.add_argument(
+        "--tenant-caps", default=None, metavar="NAME=N,...",
+        help="tpu-push: per-tenant inflight ceilings enforced where "
+        "placement happens (a tenant at its cap keeps its surplus QUEUED "
+        "on device; unlisted = uncapped). Enables the tenancy plane like "
+        "--tenant-shares; hot-reloadable via the same store hash",
+    )
+    ap.add_argument(
+        "--max-tenants", type=int, default=32, metavar="N",
+        help="tpu-push: tenant-table capacity (a compiled-tick static); "
+        "distinct tenant names past it account to the default bucket",
+    )
     ns = ap.parse_args(argv)
     if ns.delay:
         time.sleep(ns.delay)
@@ -378,6 +402,9 @@ def main(argv: list[str] | None = None) -> None:
             estimate_runtimes=not ns.no_runtime_learning,
             express=ns.express,
             inline_result_max=ns.inline_result_max,
+            tenant_shares=ns.tenant_shares,
+            tenant_caps=ns.tenant_caps,
+            max_tenants=ns.max_tenants,
         )
     if ns.mode == "tpu-push" and ns.multihost:
         # Lead-side failure containment: once the followers joined the

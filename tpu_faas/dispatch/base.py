@@ -44,6 +44,7 @@ from tpu_faas.core.task import (
     FIELD_RECLAIMS,
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
+    FIELD_TENANT,
     FIELD_TIMEOUT,
     FIELD_TRACE_ID,
     TaskStatus,
@@ -90,6 +91,9 @@ RECLAIM_FIELDS = [
     # graph parents must keep promoting their children after a reclaim:
     # the dep-completion gate (graph_parents) is rebuilt from this field
     FIELD_CHILDREN,
+    # a reclaimed task keeps its tenant accounting (tpu_faas/tenancy): the
+    # re-dispatch must charge the same share bucket as the original
+    FIELD_TENANT,
 ]
 
 
@@ -158,6 +162,10 @@ class PendingTask:
     #: for reference-style producers and trace-disabled gateways — the
     #: whole trace plane is a no-op for such tasks.
     trace_id: str | None = None
+    #: tenant name (FIELD_TENANT, tpu_faas/tenancy): which principal this
+    #: task's placement is accounted to. None (legacy producers, tenancy-
+    #: oblivious gateways) reads as the default tenant everywhere.
+    tenant: str | None = None
 
     def task_message_kwargs(self, blob: bool = False, trace: bool = False) -> dict:
         """The TASK wire message's payload fields (timeout rides along so
@@ -239,6 +247,7 @@ class PendingTask:
             submitted_at=submitted_at,
             deadline_at=deadline_at,
             trace_id=fields.get(FIELD_TRACE_ID) or None,
+            tenant=fields.get(FIELD_TENANT) or None,
         )
 
 
@@ -541,8 +550,16 @@ class TaskDispatcher:
         #: interrupt re-executes a bystander task whose side effects may
         #: have partially run — the one at-least-once execution in the
         #: system — so the count must be operator-visible in /stats, not
-        #: buried in a worker-side log line
+        #: buried in a worker-side log line. BOUNDED by the live fleet:
+        #: a purged sender's total is folded into the scalar below and its
+        #: entry dropped (forget_worker_sender) — keyed-per-sender forever,
+        #: the dict grew one entry per worker socket identity EVER seen,
+        #: a real leak under register/purge churn (VERDICT item 4).
         self.worker_misfires: dict[object, int] = {}
+        #: misfires folded from purged senders: a purged identity is never
+        #: seen again, so its last cumulative total is final — the fleet
+        #: sum stays monotone across purges while the dict stays bounded
+        self.worker_misfires_purged = 0
         # -- task graphs (tpu_faas/graph) ----------------------------------
         #: task ids whose record carried FIELD_CHILDREN at intake/reclaim —
         #: the dep-completion gate: flat tasks never pay a dependency probe
@@ -1661,7 +1678,7 @@ class TaskDispatcher:
             "expired": self.n_expired,
             "failover_rearms": self.n_failover_rearms,
             "drain_rate": round(self._drain_rate, 3),
-            "worker_misfires": sum(self.worker_misfires.values()),
+            "worker_misfires": self.total_worker_misfires(),
             "blob_cache": {
                 "entries": len(self.blob_cache),
                 "bytes": self.blob_cache.n_bytes,
@@ -1709,7 +1726,7 @@ class TaskDispatcher:
         self.m_deferred.set(len(self.deferred_results))
         self.m_announce_backlog.set(len(self._announce_backlog))
         try:
-            self.m_misfires.set(sum(self.worker_misfires.values()))
+            self.m_misfires.set(self.total_worker_misfires())
         except RuntimeError:  # dict resized mid-iteration: next scrape
             pass
 
@@ -1738,10 +1755,27 @@ class TaskDispatcher:
     def note_worker_misfires(self, sender: object, data: dict) -> None:
         """Track the cumulative ``misfires`` counter a RESULT message
         carries (absent from reference-era workers). Keyed per sender
-        because each worker reports its own monotonic total."""
+        because each worker reports its own monotonic total; purge paths
+        MUST call forget_worker_sender so the dict stays bounded by the
+        live fleet."""
         count = data.get("misfires")
         if isinstance(count, int) and count > 0:
             self.worker_misfires[sender] = count
+
+    def forget_worker_sender(self, sender: object) -> None:
+        """A worker identity was purged: fold its final cumulative misfire
+        total into the scalar and drop the entry. Its socket identity is
+        never seen again (zombies re-register fresh), so without this every
+        register/purge/reconnect cycle leaked one dict entry forever."""
+        self.worker_misfires_purged += self.worker_misfires.pop(sender, 0)
+
+    def total_worker_misfires(self) -> int:
+        """Fleet misfire total: live senders' cumulative counters plus the
+        folded totals of purged ones. May raise RuntimeError if the dict
+        resizes mid-iteration (stats-thread callers guard)."""
+        return self.worker_misfires_purged + sum(
+            self.worker_misfires.values()
+        )
 
     def reclaim_or_fail(
         self, task_id: str, prior_retries: int, max_retries: int

@@ -149,6 +149,9 @@ class PullDispatcher(TaskDispatcher):
             self.last_seen.pop(wid, None)
             self.worker_caps.pop(wid, None)
             self.workers.discard(wid)
+            # fold the purged sender's cumulative misfire total into the
+            # scalar (same per-worker bookkeeping bound as push/tpu-push)
+            self.forget_worker_sender(wid)
 
     def _next_task(self) -> PendingTask | None:
         """Reclaimed tasks first (they have already waited once), then the

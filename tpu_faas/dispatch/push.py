@@ -230,6 +230,7 @@ class PushDispatcher(TaskDispatcher):
             )
             if not rec.inflight:
                 self.workers.pop(wid, None)
+                self.forget_worker_sender(wid)
             return
         if msg_type == m.RESULT:
             task_id = data["task_id"]
@@ -257,6 +258,7 @@ class PushDispatcher(TaskDispatcher):
                     if not rec.inflight:
                         self.workers.pop(wid, None)
                         self._refresh_fleet_procs()
+                        self.forget_worker_sender(wid)
                     return
                 rec.free_processes = min(
                     rec.free_processes + 1, rec.num_processes
@@ -338,6 +340,10 @@ class PushDispatcher(TaskDispatcher):
             self.workers.pop(wid)
             self._refresh_fleet_procs()
             self._remove_free(wid)
+            # fold the purged sender's cumulative misfire total into the
+            # scalar; the identity is never seen again, and keeping the
+            # entry leaked one dict slot per purge forever
+            self.forget_worker_sender(wid)
             self.requeue.extend(reclaims)
             self.n_purged += 1
             self.m_purged.inc()

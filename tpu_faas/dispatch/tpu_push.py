@@ -83,10 +83,36 @@ class TpuPushDispatcher(TaskDispatcher):
         estimate_runtimes: bool = True,
         express: bool = False,
         inline_result_max: int | None = None,
+        tenant_shares: str | None = None,
+        tenant_caps: str | None = None,
+        max_tenants: int = 32,
     ) -> None:
         super().__init__(
             store_url=store_url, channel=channel, store=store, shared=shared
         )
+        # -- tenancy plane (tpu_faas/tenancy): ON iff the operator named a
+        # share or cap config. Off = zero new work anywhere (the tick
+        # traces its pre-tenancy graph, no per-task bookkeeping). The
+        # in-tick fairness is a single-device feature like the graph
+        # frontier — mesh/multihost fleets refuse loudly rather than
+        # silently running unfair.
+        self.tenancy = None
+        if tenant_shares is not None or tenant_caps is not None:
+            if multihost or mesh_devices:
+                raise ValueError(
+                    "--tenant-shares/--tenant-caps are single-device "
+                    "features (the fairness mask lives in the local tick); "
+                    "mesh/multihost fleets must run without them"
+                )
+            from tpu_faas.tenancy import TenantTable, parse_caps, parse_shares
+
+            # parse EAGERLY so a typo'd spec fails startup, not the first
+            # device tick; the table then holds the raw spec strings for
+            # the hot-reload compare
+            parse_shares(tenant_shares or "")
+            parse_caps(tenant_caps or "")
+            self.tenancy = TenantTable(max_tenants=max_tenants)
+            self.tenancy.apply_specs(tenant_shares or "", tenant_caps or "")
         #: express result lane (ROADMAP item 2, opt-in): terminal announces
         #: carry bounded inline results (gateways reply from the forward
         #: instead of re-reading the store) AND the serve loop parks its
@@ -189,6 +215,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 use_priority=True,
                 mesh_devices=mesh_devices,
                 tick_backend=tick_backend,
+                tenancy=self.tenancy,
             )
             #: tasks currently living in the device pending set (or queued
             #: into it): task_id -> PendingTask, the payload source at
@@ -205,6 +232,7 @@ class TpuPushDispatcher(TaskDispatcher):
                 placement=placement,
                 mesh_devices=mesh_devices,
             )
+            self.arrays.tenancy = self.tenancy
             self._resident_tasks = {}
         if multihost and not resident:
             # this process is the LEAD of a multi-process dispatcher fleet:
@@ -250,6 +278,44 @@ class TpuPushDispatcher(TaskDispatcher):
             "WAITING graph nodes held in the device frontier (tpu-push "
             "batch path); 0 on flat workloads and frontier-less modes",
         )
+        # -- per-tenant observability (tenancy plane only: the families
+        # exist iff the plane is on, and label cardinality is BOUNDED by
+        # the registered-tenant vocabulary — configured names get their
+        # own series, everything dynamically discovered aggregates under
+        # "other", so a client minting random tenant names cannot explode
+        # the scrape)
+        self._task_tenant_row: dict[str, int] = {}
+        self._last_tenant_reload = 0.0
+        if self.tenancy is not None:
+            self.m_tenant_dispatched = self.metrics.counter(
+                "tpu_faas_tasks_dispatched_total",
+                "Tasks handed to workers, by tenant (bounded vocabulary: "
+                "configured tenants + 'default' + 'other')",
+                ("tenant",),
+            )
+            self.m_tenant_queue = self.metrics.gauge(
+                "tpu_faas_tenant_queue_depth",
+                "Tasks waiting in this dispatcher's pending structures, "
+                "by tenant (same bounded vocabulary)",
+                ("tenant",),
+            )
+            self.m_tenant_inflight = self.metrics.gauge(
+                "tpu_faas_tenant_inflight_tasks",
+                "Tasks dispatched and awaiting a result, by tenant (what "
+                "the in-tick inflight caps are enforced against)",
+                ("tenant",),
+            )
+            for lbl in self.tenancy.labels:
+                self.m_tenant_dispatched.labels(tenant=lbl)
+                self.m_tenant_queue.labels(tenant=lbl)
+                self.m_tenant_inflight.labels(tenant=lbl)
+            # seed the fleet conf hash so stateless siblings/gateways can
+            # read the active config; best-effort (outage = serve loop
+            # retries via the hot-reload path)
+            try:
+                self.tenancy.publish(self.store)
+            except STORE_OUTAGE_ERRORS as exc:
+                self.note_store_outage(exc, pause=0)
         #: RESULT store writes accumulated during a worker-message drain,
         #: flushed as ONE pipelined finish_task_many round per drain
         #: (drain_results_batched); None = unbatched mode, where _handle
@@ -616,6 +682,56 @@ class TpuPushDispatcher(TaskDispatcher):
             if task.learned is None:
                 task.learned = est.default_size()
 
+    # -- tenancy plane (tpu_faas/tenancy) ----------------------------------
+    def _tenant_row(self, task: PendingTask) -> int:
+        """Dense tenant row for a task (0 when the plane is off)."""
+        return 0 if self.tenancy is None else self.tenancy.row_for(task.tenant)
+
+    def _note_tenant_dispatch(self, task: PendingTask) -> None:
+        """A task went on the wire: charge its tenant's inflight count
+        (what the in-tick caps enforce against) and the dispatch series."""
+        if self.tenancy is None:
+            return
+        row = self.tenancy.row_for(task.tenant)
+        self._task_tenant_row[task.task_id] = row
+        self.tenancy.note_dispatched(row)
+        self.m_tenant_dispatched.labels(
+            tenant=self.tenancy.label_for(task.tenant)
+        ).inc()
+
+    def _tenant_task_done(self, task_id: str) -> None:
+        """A task left the inflight table (result, reclaim, drop): release
+        its tenant's inflight charge. Pop-gated, so the paths that overlap
+        (_forget_task_state after an explicit release) cannot double-count."""
+        if self.tenancy is None:
+            return
+        row = self._task_tenant_row.pop(task_id, None)
+        if row is not None:
+            self.tenancy.note_done(row)
+
+    #: how often the serve loop re-reads the fleet tenant-conf hash
+    _TENANT_RELOAD_PERIOD = 1.0
+
+    def _maybe_reload_tenant_conf(self) -> None:
+        """Hot reload: pull fleet:tenant_conf at ~1 Hz and apply newer
+        share/cap specs to the live table — the next tick's packet carries
+        the new vectors, no restart, no recompile. Raises on a store
+        outage (serve-loop handling applies)."""
+        if self.tenancy is None:
+            return
+        now = self.clock()
+        if now - self._last_tenant_reload < self._TENANT_RELOAD_PERIOD:
+            return
+        self._last_tenant_reload = now
+        if self.tenancy.maybe_reload(self.store):
+            self.log.info(
+                "tenant config hot-reloaded from the store: %s",
+                {
+                    name: row["share"]
+                    for name, row in self.tenancy.stats()["tenants"].items()
+                },
+            )
+
     def _note_token(self, wid: bytes, data: dict) -> None:
         """Record the stable worker token a REGISTER/RECONNECT carries
         (absent from reference-era workers: their grades stay keyed to the
@@ -802,6 +918,7 @@ class TpuPushDispatcher(TaskDispatcher):
             # since its own result would then find nothing to release).
             if from_owner:
                 self.task_retries.pop(task_id, None)
+                self._tenant_task_done(task_id)
                 row = a.inflight_done(task_id)
                 if row is not None:
                     a.release_slot(row)
@@ -916,6 +1033,39 @@ class TpuPushDispatcher(TaskDispatcher):
         self.m_inflight.set(a.n_inflight)
         self.m_workers.set(len(a.worker_ids))
         self.m_frontier.set(0 if self.graph is None else len(self.graph))
+        if self.tenancy is not None:
+            ten = self.tenancy
+            # inflight: off the table's vector (serve-loop-owned ints — a
+            # torn read is one scrape stale, never wrong-shaped).
+            # ACCUMULATE per label before setting: several dynamically-
+            # registered rows share the "other" label, and per-row .set()
+            # would leave only the last row's count standing
+            infl: dict[str, int] = {}
+            for row in range(ten.n_tenants):
+                lbl = ten.label_for(ten.name_of(row))
+                infl[lbl] = infl.get(lbl, 0) + int(ten.inflight[row])
+            for lbl in ten.labels:
+                self.m_tenant_inflight.labels(tenant=lbl).set(
+                    infl.get(lbl, 0)
+                )
+            # queue depth: walk the pending structures with the standard
+            # stats-thread resize guard (same convention as the misfires
+            # gauge) — a raced mutation keeps the previous scrape's value
+            try:
+                depth: dict[str, int] = {}
+                for t in list(self.pending):
+                    lbl = ten.label_for(t.tenant)
+                    depth[lbl] = depth.get(lbl, 0) + 1
+                for t in dict(self._resident_tasks).values():
+                    lbl = ten.label_for(t.tenant)
+                    depth[lbl] = depth.get(lbl, 0) + 1
+            except RuntimeError:
+                pass
+            else:
+                for lbl in ten.labels:
+                    self.m_tenant_queue.labels(tenant=lbl).set(
+                        depth.get(lbl, 0)
+                    )
 
     def stats(self) -> dict:
         a = self.arrays
@@ -973,6 +1123,15 @@ class TpuPushDispatcher(TaskDispatcher):
             "tick_backend": getattr(self.arrays, "tick_backend", None),
             "estimator": (
                 self.estimator.stats() if self.estimator is not None else None
+            ),
+            # tenancy block (None = plane off): per-tenant share / cap /
+            # inflight / dispatched + the device deficit carry
+            "tenancy": (
+                None
+                if self.tenancy is None
+                else self.tenancy.stats(
+                    deficits=self.arrays.tenant_deficits()
+                )
             ),
         }
 
@@ -1159,6 +1318,14 @@ class TpuPushDispatcher(TaskDispatcher):
                         a.placement,
                     )
                     self._warned_priority = True
+            # tenancy lane: dense tenant row per batch task (the in-tick
+            # fairness mask + admission order key off it); None keeps the
+            # flat jitted signature
+            tenants = None
+            if self.tenancy is not None:
+                tenants = np.asarray(
+                    [self._tenant_row(t) for t in batch], dtype=np.int32
+                )
             # graph frontier: padded edge list + locality preference for
             # this tick's batch (None on flat workloads — the jitted tick
             # keeps its dependency-free signature)
@@ -1171,7 +1338,7 @@ class TpuPushDispatcher(TaskDispatcher):
             # recompile detection BEFORE the call: the signature carries
             # everything that changes the jitted trace (padded dims,
             # placement, optional priority lane, the frontier's padded
-            # edge width + locality lane)
+            # edge width + locality lane, the tenancy plane)
             self.profiler.observe_shape(
                 tasks=a.max_pending,
                 workers=a.max_workers,
@@ -1181,6 +1348,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     a.placement, prios is not None,
                     0 if dep_edges is None else len(dep_edges[0]),
                     task_pref is not None,
+                    tenants is not None,
                 ),
             )
             with self.tracer.span("device_tick"), self.profiler.tick_capture():
@@ -1189,6 +1357,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     task_priorities=prios,
                     dep_edges=dep_edges,
                     task_pref=task_pref,
+                    task_tenants=tenants,
                 )
 
             # reclaim in-flight tasks of dead workers (ahead of the queue)
@@ -1301,6 +1470,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     sent += 1
                     self.n_dispatched += 1
                     self.m_dispatched.inc()
+                    self._note_tenant_dispatch(task)
         except STORE_OUTAGE_ERRORS:
             for i in range(restore_from, len(batch)):
                 if i not in frontier_rows or i in popped_frontier:
@@ -1386,6 +1556,14 @@ class TpuPushDispatcher(TaskDispatcher):
                     priorities=np.asarray(
                         [t.priority or 0 for t in batch], dtype=np.int32
                     ),
+                    tenants=(
+                        None
+                        if self.tenancy is None
+                        else np.asarray(
+                            [self._tenant_row(t) for t in batch],
+                            dtype=np.int32,
+                        )
+                    ),
                 )
         while self.pending:
             t = self.pending.popleft()
@@ -1398,7 +1576,10 @@ class TpuPushDispatcher(TaskDispatcher):
                 continue
             self._stamp_estimate(t)
             self._resident_tasks[t.task_id] = t
-            a.pending_add(t.task_id, t.size_estimate, t.priority or 0)
+            a.pending_add(
+                t.task_id, t.size_estimate, t.priority or 0,
+                self._tenant_row(t),
+            )
 
         sent = 0
         self.profiler.observe_shape(
@@ -1477,6 +1658,7 @@ class TpuPushDispatcher(TaskDispatcher):
         self.task_retries.pop(task_id, None)
         self._task_digest.pop(task_id, None)
         self._result_rows.pop(task_id, None)
+        self._tenant_task_done(task_id)
         if self.graph is not None:
             self.graph.pop(task_id)
         # close any still-open timeline (no-op for the drop/fail sites that
@@ -1520,6 +1702,9 @@ class TpuPushDispatcher(TaskDispatcher):
             self._forget_task_state(task_id)
         for slot, pt in reclaims:
             a.inflight_clear_slot(slot)
+            # off the wire: release the tenant's inflight charge (the
+            # re-dispatch charges it again)
+            self._tenant_task_done(pt.task_id)
             self.task_retries[pt.task_id] = pt.retries
             requeue(pt)
         if reclaims:
@@ -1533,10 +1718,21 @@ class TpuPushDispatcher(TaskDispatcher):
             a.deactivate(int(row))
             if wid_p is not None:
                 # a purged socket identity is never seen again; a zombie
-                # that reconnects re-negotiates its caps on RECONNECT
+                # that reconnects re-negotiates its caps on RECONNECT.
+                # Every per-identity map is cleaned HERE — _wid_token was
+                # previously popped only when an estimator existed, and
+                # the misfire counters were never cleaned at all, so an
+                # estimator-less dispatcher under register/purge churn
+                # leaked two dict entries per cycle (VERDICT item 4; the
+                # churn soak test pins the bound).
                 self._wid_caps.pop(wid_p, None)
+                self.forget_worker_sender(wid_p)
+            token = (
+                self._wid_token.pop(wid_p, None)
+                if wid_p is not None
+                else None
+            )
             if wid_p is not None and self.estimator is not None:
-                token = self._wid_token.pop(wid_p, None)
                 if token is None:
                     # tokenless (reference-era) worker: its socket identity
                     # is never seen again, so the grade is garbage. A
@@ -1696,6 +1892,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     sent += 1
                     self.n_dispatched += 1
                     self.m_dispatched.inc()
+                    self._note_tenant_dispatch(task)
         finally:
             # coalesced RUNNING flush, after every send (same contract as
             # the batch tick's finally)
@@ -1777,6 +1974,9 @@ class TpuPushDispatcher(TaskDispatcher):
                         # write-behind of learned runtimes (no-op between
                         # persist periods; internally outage-tolerant)
                         self.estimator.maybe_persist()
+                    # tenant-config hot reload (tpu_faas/tenancy): one
+                    # tiny hash read per second, applied in place
+                    self._maybe_reload_tenant_conf()
                     # saturation signal for gateway admission control
                     # (admission/signal.py): one tiny hash write per second
                     a0 = self.arrays

@@ -109,6 +109,7 @@ from tpu_faas.core.task import (
     FIELD_RESULT,
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
+    FIELD_TENANT,
     FIELD_TIMEOUT,
     FIELD_TRACE_ID,
     FIELD_TRACE_PARENT,
@@ -116,6 +117,7 @@ from tpu_faas.core.task import (
     new_function_id,
     new_task_id,
 )
+from tpu_faas.tenancy import valid_tenant
 from tpu_faas.graph import GraphValidationError, validate_graph
 from tpu_faas.obs import REGISTRY, MetricsRegistry, SLOTracker, SpanSink
 from tpu_faas.obs import metrics as obs_metrics
@@ -1257,6 +1259,31 @@ def _priority_of(value) -> int:
     return value if isinstance(value, int) and not isinstance(value, bool) else 0
 
 
+#: sentinel distinguishing "no header" (fine: default tenant) from "bad
+#: header" (400) in _tenant_of's return
+_BAD_TENANT = object()
+
+
+def _tenant_of(request: web.Request):
+    """The validated ``X-Tenant-Id`` header, None when absent (legacy
+    clients — their tasks read as the default tenant everywhere), or
+    ``_BAD_TENANT`` for a malformed value. Validated because the name
+    becomes store-hash content, a share-table key, and a metrics-label
+    candidate at the dispatcher."""
+    tenant = request.headers.get("X-Tenant-Id")
+    if tenant is None:
+        return None
+    if not valid_tenant(tenant):
+        return _BAD_TENANT
+    return tenant
+
+
+_TENANT_400 = (
+    "X-Tenant-Id must be 1-64 characters of [A-Za-z0-9._-], starting "
+    "alphanumeric"
+)
+
+
 def _idempotent_task_id(function_id: str, key: str) -> str:
     """Deterministic task id for (function, idempotency key): a client that
     re-sends the same submit — e.g. after a response was lost — addresses
@@ -1285,6 +1312,14 @@ async def execute_function(request: web.Request) -> web.Response:
     # first event of the task's lifecycle timeline (obs/trace.py): rides
     # the record so the dispatcher can measure queue wait from the submit
     extra[FIELD_SUBMITTED_AT] = repr(now)
+    # tenancy plane: the record carries the validated tenant header so the
+    # dispatcher's weighted-fair tick accounts this task to its principal;
+    # absent = default tenant (legacy clients pay nothing)
+    tenant = _tenant_of(request)
+    if tenant is _BAD_TENANT:
+        return _json_error(400, _TENANT_400)
+    if tenant is not None:
+        extra[FIELD_TENANT] = tenant
     # distributed trace context (obs/tracectx.py): client-supplied id
     # validated (it becomes a store key), or minted here for legacy
     # clients; ignored entirely while tracing is off
@@ -1540,8 +1575,14 @@ async def execute_batch(request: web.Request) -> web.Response:
     except ValueError as exc:
         return _json_error(400, str(exc))
     submit_stamp = repr(now)  # one submit time for the whole batch
+    # one tenant per request (the header), stamped on every member
+    tenant = _tenant_of(request)
+    if tenant is _BAD_TENANT:
+        return _json_error(400, _TENANT_400)
     for e in extras:
         e[FIELD_SUBMITTED_AT] = submit_stamp
+        if tenant is not None:
+            e[FIELD_TENANT] = tenant
     # distributed trace context, batched: a parallel optional list of
     # client-minted ids; holes (and the whole list, for legacy clients)
     # are minted here. Ignored entirely while tracing is off.
@@ -1845,6 +1886,9 @@ async def execute_graph(request: web.Request) -> web.Response:
         return _json_error(400, str(exc))
     now = time.time()
     submit_stamp = repr(now)
+    tenant = _tenant_of(request)  # one tenant per graph (the header)
+    if tenant is _BAD_TENANT:
+        return _json_error(400, _TENANT_400)
     extras: list[dict[str, str]] = []
     fids: list[str] = []
     for i, node in enumerate(nodes):
@@ -1865,6 +1909,8 @@ async def execute_graph(request: web.Request) -> web.Response:
         except ValueError as exc:
             return _json_error(400, f"nodes[{i}]: {exc}")
         extra[FIELD_SUBMITTED_AT] = submit_stamp
+        if tenant is not None:
+            extra[FIELD_TENANT] = tenant
         extras.append(extra)
         fids.append(fid)
     # admission AFTER validation, BEFORE store work; the graph decides

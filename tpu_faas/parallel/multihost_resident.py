@@ -149,9 +149,10 @@ class MultihostResidentScheduler(ResidentScheduler):
         task_sh = NamedSharding(self.mesh, P(TASK_AXIS))
         repl = NamedSharding(self.mesh, P())
         state_sh = _ResidentState(
-            sizes=task_sh, valid=task_sh, prio=task_sh,
+            sizes=task_sh, valid=task_sh, prio=task_sh, tenant=task_sh,
             last_hb=repl, free=repl, inflight=repl, prev_live=repl,
-            speed=repl, active=repl, price=repl, refresh=repl,
+            speed=repl, active=repl, price=repl, t_deficit=repl,
+            refresh=repl,
         )
         out_sh = ResidentTickOutput(
             placed_slots=repl, placed_rows=repl, arrival_slots=repl,
@@ -162,6 +163,7 @@ class MultihostResidentScheduler(ResidentScheduler):
             static_argnames=(
                 "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP",
                 "KR", "max_slots", "placement", "use_priority",
+                "use_tenancy", "NT",
             ),
             out_shardings=(out_sh, state_sh),
         )
@@ -169,7 +171,7 @@ class MultihostResidentScheduler(ResidentScheduler):
             _flush_kernel.__wrapped__,
             static_argnames=(
                 "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB",
-                "use_priority",
+                "use_priority", "use_tenancy", "NT",
             ),
             out_shardings=(state_sh, repl),
         )

@@ -43,6 +43,20 @@ RESULT message): the dispatcher-side dispatch->result interval would fold
 in pool queueing and transport. FAILED results are not observed — failures
 often short-circuit and would drag estimates toward zero.
 
+**The ungraded-worker regime (deliberate, pinned by tests):** a workload
+whose params NEVER repeat, whose byte sizes carry no spread (the byte
+regression declines), AND whose runtimes genuinely vary (fn-level
+log-variance over ``_REG_MAX_Y_VAR``) leaves NO trustworthy per-task
+reference to divide a speed observation by — the exact-param level never
+settles, the regression never fits, and the fn-level mean would mis-grade
+every worker that happens to draw small (or large) params. In that regime
+``observe`` keeps learning SIZES but refuses to grade workers: fleet
+speeds stay at the 1.0 prior and placement degrades to size-only rank
+matching — still the batched Monge pairing, just speed-blind. This is the
+safe floor, not a bug: a wrong speed grade mis-places every future task
+on that worker, while no grade merely forgoes the heterogeneity win.
+tests/test_estimator.py::test_ungraded_regime_speeds_stay_prior pins it.
+
 Estimates survive restarts through the store (two hashes, pipelined
 write-behind, best-effort under outages): a dispatcher that restarts
 mid-day re-learns nothing — functions NOR fleet grades.

@@ -37,6 +37,7 @@ def rank_match_placement_impl(
     worker_live: jnp.ndarray,  # bool[W]
     max_slots: int = 8,
     task_priority: jnp.ndarray | None = None,  # i32[T], higher first
+    task_adm_rank: jnp.ndarray | None = None,  # i32[T] precomputed order
 ) -> jnp.ndarray:
     """Return assignment i32[T]: worker index per task, -1 = stay queued."""
     T = task_size.shape[0]
@@ -61,9 +62,16 @@ def rank_match_placement_impl(
     # be starved forever by a stream of larger ones. With task_priority the
     # order becomes (priority desc, arrival asc): the stable sort keeps FCFS
     # as the tie-break, so equal-priority traffic behaves exactly as before.
-    # Pairing within the admitted set is still largest-task <-> fastest-slot.
+    # With task_adm_rank (the tenancy plane's precomputed admission order —
+    # priority desc, weighted-fair virtual time asc, arrival asc; see
+    # tenancy/fairshare.py) the cut is a direct rank compare: valid tasks
+    # occupy ranks 0..n_valid-1 by construction, so the first n_slots of
+    # that order are admitted. Pairing within the admitted set is still
+    # largest-task <-> fastest-slot in every mode.
     n_slots = slot_valid.sum()
-    if task_priority is None:
+    if task_adm_rank is not None:
+        admitted = task_valid & (task_adm_rank < n_slots)
+    elif task_priority is None:
         arrival_rank = jnp.cumsum(task_valid.astype(jnp.int32)) - 1
         admitted = task_valid & (arrival_rank < n_slots)
     else:

@@ -78,6 +78,7 @@ def fused_state_bytes(
     KR: int = 512,
     packet_len: int = 0,
     placement: str = "rank",
+    NT: int = 1,
 ) -> int:
     """Resident working set of the fused kernel, in bytes — the number to
     hold against a core's VMEM budget (16 MB on v5e) when sizing
@@ -87,11 +88,15 @@ def fused_state_bytes(
     scratch: one [STREAM_T, STREAM_S] f32 value block plus its iota/hash
     intermediates (~8 MB at the shipped tile sizes — the same figure the
     standalone bid kernel's tuning notes carry). The sort-based rank path
-    and the bucketed sinkhorn carry no comparable per-tile block."""
-    task = T * (4 + 1 + 4)  # sizes f32 + valid bool + prio i32
+    and the bucketed sinkhorn carry no comparable per-tile block.
+
+    ``NT`` is the tenancy plane's tenant-row padding: the per-task tenant
+    leaf (i32[T], carried even when the plane is off — 13 B/task total vs
+    the pre-tenancy 9 B/task) plus the NT-length deficit vector."""
+    task = T * (4 + 1 + 4 + 4)  # sizes f32 + valid bool + prio/tenant i32
     fleet = W * (4 + 4 + 1 + 4 + 1 + 1 + 1)  # hb/free/speed + 4 bool[W]
     inflight = I * 4
-    price = W * max_slots * 4
+    price = W * max_slots * 4 + NT * 4
     out = (KP * 2 + KA + KR + 1) * 4
     solver = 0
     if placement == "auction":
@@ -130,7 +135,8 @@ def _fused_resident_tick_impl(
     st: _ResidentState,
     *,
     T, W, I, KA, KH, KF, KI, KS, KB, KP, KR,
-    max_slots, placement, use_priority, interpret=False,
+    max_slots, placement, use_priority, use_tenancy=False, NT=1,
+    interpret=False,
 ):
     if not _HAVE_PALLAS:
         raise RuntimeError(
@@ -138,7 +144,7 @@ def _fused_resident_tick_impl(
         )
     statics = dict(
         T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS, KB=KB,
-        use_priority=use_priority,
+        use_priority=use_priority, use_tenancy=use_tenancy, NT=NT,
     )
 
     def _value_step(packed_v, *state_leaves):
@@ -155,8 +161,9 @@ def _fused_resident_tick_impl(
             res.placed_slots, res.placed_rows, res.arrival_slots,
             res.redispatch_slots, res.purged, res.live,
             jnp.reshape(res.n_pending, (1,)),
-            new.sizes, new.valid, new.prio, new.last_hb, new.free,
-            new.inflight, new.prev_live, new.speed, new.active, new.price,
+            new.sizes, new.valid, new.prio, new.tenant, new.last_hb,
+            new.free, new.inflight, new.prev_live, new.speed, new.active,
+            new.price, new.t_deficit,
             jnp.reshape(new.refresh, (1,)),
         )
 
@@ -167,6 +174,7 @@ def _fused_resident_tick_impl(
         jax.ShapeDtypeStruct((T,), f32),  # sizes
         jax.ShapeDtypeStruct((T,), b),  # valid
         jax.ShapeDtypeStruct((T,), i32),  # prio
+        jax.ShapeDtypeStruct((T,), i32),  # tenant rows
         jax.ShapeDtypeStruct((W,), f32),  # last_hb
         jax.ShapeDtypeStruct((W,), i32),  # free
         jax.ShapeDtypeStruct((I,), i32),  # inflight
@@ -174,6 +182,7 @@ def _fused_resident_tick_impl(
         jax.ShapeDtypeStruct((W,), f32),  # speed
         jax.ShapeDtypeStruct((W,), b),  # active
         jax.ShapeDtypeStruct((S,), f32),  # price
+        jax.ShapeDtypeStruct((NT,), f32),  # tenant deficits
         jax.ShapeDtypeStruct((1,), b),  # refresh
     )
     closed = jax.make_jaxpr(_value_step)(*in_specs)
@@ -208,6 +217,7 @@ def _fused_resident_tick_impl(
         jax.ShapeDtypeStruct((T,), f32),  # sizes
         jax.ShapeDtypeStruct((T,), b),  # valid
         jax.ShapeDtypeStruct((T,), i32),  # prio
+        jax.ShapeDtypeStruct((T,), i32),  # tenant rows
         jax.ShapeDtypeStruct((W,), f32),  # last_hb
         jax.ShapeDtypeStruct((W,), i32),  # free
         jax.ShapeDtypeStruct((I,), i32),  # inflight
@@ -215,6 +225,7 @@ def _fused_resident_tick_impl(
         jax.ShapeDtypeStruct((W,), f32),  # speed
         jax.ShapeDtypeStruct((W,), b),  # active
         jax.ShapeDtypeStruct((S,), f32),  # price
+        jax.ShapeDtypeStruct((NT,), f32),  # tenant deficits
         jax.ShapeDtypeStruct((1,), b),  # refresh
     )
     outs = pl.pallas_call(
@@ -223,13 +234,13 @@ def _fused_resident_tick_impl(
         # state input k (operand k, packet is 0) writes output 7 + (k - 1):
         # each state buffer is updated in place across ticks. Lifted trace
         # constants ride after the state operands and alias nothing.
-        input_output_aliases={k: 6 + k for k in range(1, 12)},
+        input_output_aliases={k: 6 + k for k in range(1, 14)},
         interpret=interpret,
     )(
         jnp.asarray(packed, jnp.float32),
-        st.sizes, st.valid, st.prio, st.last_hb, st.free, st.inflight,
-        st.prev_live, st.speed, st.active, st.price,
-        jnp.reshape(st.refresh, (1,)),
+        st.sizes, st.valid, st.prio, st.tenant, st.last_hb, st.free,
+        st.inflight, st.prev_live, st.speed, st.active, st.price,
+        st.t_deficit, jnp.reshape(st.refresh, (1,)),
         *consts,
     )
     res = ResidentTickOutput(
@@ -237,14 +248,15 @@ def _fused_resident_tick_impl(
     )
     new_state = _ResidentState(
         outs[7], outs[8], outs[9], outs[10], outs[11], outs[12], outs[13],
-        outs[14], outs[15], outs[16], outs[17][0],
+        outs[14], outs[15], outs[16], outs[17], outs[18], outs[19][0],
     )
     return res, new_state
 
 
 _STATICS = (
     "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP", "KR",
-    "max_slots", "placement", "use_priority", "interpret",
+    "max_slots", "placement", "use_priority", "use_tenancy", "NT",
+    "interpret",
 )
 #: compiled form: state donated so the kernel's aliases update in place
 _fused_tick_donated = partial(

@@ -72,6 +72,10 @@ class _ResidentState(NamedTuple):
     sizes: jnp.ndarray  # f32[T]
     valid: jnp.ndarray  # bool[T]
     prio: jnp.ndarray  # i32[T] (all-zero when priorities unused)
+    #: i32[T] dense tenant row per pending slot (all-zero when the tenancy
+    #: plane is off — the leaf always exists so the packet protocol and
+    #: the fused kernel's alias table keep ONE shape per capacity set)
+    tenant: jnp.ndarray
     last_hb: jnp.ndarray  # f32[W] epoch-relative heartbeat stamps
     free: jnp.ndarray  # i32[W]
     inflight: jnp.ndarray  # i32[I]
@@ -81,6 +85,9 @@ class _ResidentState(NamedTuple):
     #: f32[W*max_slots] auction slot prices carried tick-over-tick (zeros
     #: when placement != auction) — see auction_placement's carry_refresh
     price: jnp.ndarray
+    #: f32[NT] per-tenant deficit counters carried tick-over-tick (length
+    #: 1, inert, while the tenancy plane is off) — tenancy/fairshare.py
+    t_deficit: jnp.ndarray
     #: bool scalar: last tick flagged the prices stale (next tick opens
     #: from the analytic dual seed instead); starts True (cold start)
     refresh: jnp.ndarray
@@ -122,7 +129,7 @@ def _first_k_indices(mask, K: int):
 
 
 def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
-                  KS, KB, use_priority):
+                  KS, KB, use_priority, use_tenancy=False):
     """Scatter one delta packet into the carried state. Traced helper shared
     by the flush kernel and the fused tick kernel. Returns (state,
     arrival_slots i32[KA])."""
@@ -133,6 +140,8 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     arr_sizes = packed[off : off + KA]; off += KA
     if use_priority:
         arr_prio = packed[off : off + KA].astype(jnp.int32); off += KA
+    if use_tenancy:
+        arr_tenant = packed[off : off + KA].astype(jnp.int32); off += KA
     hb_idx = packed[off : off + KH].astype(jnp.int32); off += KH
     hb_val = packed[off : off + KH]; off += KH
     free_idx = packed[off : off + KF].astype(jnp.int32); off += KF
@@ -196,24 +205,31 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     prio = st.prio
     if use_priority:
         prio = prio.at[slots].set(jnp.where(ok, arr_prio, 0), mode="drop")
+    tenant = st.tenant
+    if use_tenancy:
+        tenant = tenant.at[slots].set(
+            jnp.where(ok, arr_tenant, 0), mode="drop"
+        )
     arrival_slots = jnp.where(ok, free_slots, -1).astype(jnp.int32)
     return (
-        _ResidentState(sizes, valid, prio, last_hb, free, inflight,
-                       st.prev_live, speed, active, st.price, st.refresh),
+        _ResidentState(sizes, valid, prio, tenant, last_hb, free, inflight,
+                       st.prev_live, speed, active, st.price, st.t_deficit,
+                       st.refresh),
         arrival_slots,
         now,
     )
 
 
 def _flush_kernel_impl(packed, st, *, T, W, I, KA, KH, KF, KI, KS, KB,
-                       use_priority):
+                       use_priority, use_tenancy=False, NT=1):
     """Delta application alone — used when a tick's deltas exceed one
     packet's capacity (mass registration, adoption bursts): the overflow is
     drained in extra small dispatches, the final packet rides the fused
-    tick."""
+    tick. ``NT`` shapes nothing here (the tenant-vec tail is tick-only)
+    but rides the statics so both kernels share one ``_statics()`` dict."""
     st, arrival_slots, _ = _apply_deltas(
         packed, st, T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS,
-        KB=KB, use_priority=use_priority,
+        KB=KB, use_priority=use_priority, use_tenancy=use_tenancy,
     )
     return st, arrival_slots
 
@@ -222,6 +238,7 @@ _flush_kernel = partial(
     jax.jit,
     static_argnames=(
         "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "use_priority",
+        "use_tenancy", "NT",
     ),
 )(_flush_kernel_impl)
 
@@ -232,6 +249,7 @@ def _resident_tick_impl(
     *,
     T, W, I, KA, KH, KF, KI, KS, KB, KP, KR,
     max_slots, placement, use_priority, bid_backend="auto",
+    use_tenancy=False, NT=1,
 ):
     """The full resident step as plain traced ops — jitted below for the
     XLA path, traced INSIDE one pallas_call by sched/pallas_fused.py (the
@@ -239,10 +257,24 @@ def _resident_tick_impl(
     bids stay O(T+S) with no [T, S] block in the kernel)."""
     st, arrival_slots, now = _apply_deltas(
         packed, st, T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS,
-        KB=KB, use_priority=use_priority,
+        KB=KB, use_priority=use_priority, use_tenancy=use_tenancy,
     )
     hb_age = now - st.last_hb
     auction = placement == "auction"
+    tenant_kw: dict = {}
+    if use_tenancy:
+        # the tenant-vec tail (share ++ ahead ++ cap, 3*NT floats) rides
+        # the END of every tick packet: hot-reloaded shares and the
+        # per-tick inflight counts reach the kernel as VALUES — no
+        # recompile, and the deficit carry stays a device-resident leaf
+        tail = packed.shape[0] - 3 * NT
+        tenant_kw = dict(
+            task_tenant=st.tenant,
+            tenant_share=packed[tail : tail + NT],
+            tenant_deficit=st.t_deficit,
+            tenant_ahead=packed[tail + NT : tail + 2 * NT].astype(jnp.int32),
+            tenant_cap=packed[tail + 2 * NT :].astype(jnp.int32),
+        )
     out = scheduler_tick_impl(
         st.sizes,
         st.valid,
@@ -259,6 +291,7 @@ def _resident_tick_impl(
         auction_price=st.price if auction else None,
         auction_refresh=st.refresh if auction else None,
         bid_backend=bid_backend,
+        **tenant_kw,
     )
 
     # -- compact placements to KP (slot, row) pairs ------------------------
@@ -291,9 +324,10 @@ def _resident_tick_impl(
     redispatch_slots = _first_k_indices(out.redispatch, KR)
 
     new_state = _ResidentState(
-        st.sizes, valid_next, st.prio, st.last_hb, free_next, st.inflight,
-        out.live, st.speed, st.active,
+        st.sizes, valid_next, st.prio, st.tenant, st.last_hb, free_next,
+        st.inflight, out.live, st.speed, st.active,
         out.auction_price if auction else st.price,
+        out.tenant_deficit if use_tenancy else st.t_deficit,
         out.auction_refresh if auction else st.refresh,
     )
     res = ResidentTickOutput(
@@ -313,6 +347,7 @@ _resident_tick = partial(
     static_argnames=(
         "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP", "KR",
         "max_slots", "placement", "use_priority", "bid_backend",
+        "use_tenancy", "NT",
     ),
 )(_resident_tick_impl)
 
@@ -322,6 +357,7 @@ class _Arrival:
     task_id: str
     size: float
     priority: int = 0
+    tenant: int = 0  # dense tenant row (tenancy plane; 0 = default)
 
 
 @dataclass
@@ -374,9 +410,18 @@ class ResidentScheduler(SchedulerArrays):
         KP: int | None = None,
         KR: int | None = None,
         tick_backend: str | None = None,
+        tenancy=None,
         **kw,
     ):
         super().__init__(*args, **kw)
+        # tenancy plane (tpu_faas/tenancy): a TenantTable turns the plane
+        # on — the packet grows a tenant arrival lane plus the share/
+        # ahead/cap tail, and the state carries tenant rows + deficits.
+        # NT is a STATIC (vector padding), so the table must exist at
+        # construction; its CONTENTS stay values (hot-reloadable).
+        self.tenancy = tenancy
+        self.use_tenancy = tenancy is not None
+        self.NT = tenancy.max_tenants if tenancy is not None else 1
         # tick backend: "xla" (the jitted op-graph oracle), "fused" (ONE
         # pallas_call per tick, state in VMEM refs), "fused_interpret"
         # (the same kernel under the Pallas interpreter — CPU CI's parity
@@ -445,14 +490,19 @@ class ResidentScheduler(SchedulerArrays):
     supports_bulk_load: bool = True
 
     # -- pending interface -------------------------------------------------
-    def pending_add(self, task_id: str, size: float, priority: int = 0) -> None:
-        self._arrivals.append(_Arrival(task_id, float(size), int(priority)))
+    def pending_add(
+        self, task_id: str, size: float, priority: int = 0, tenant: int = 0
+    ) -> None:
+        self._arrivals.append(
+            _Arrival(task_id, float(size), int(priority), int(tenant))
+        )
 
     def pending_bulk_load(
         self,
         ids: list[str],
         sizes: np.ndarray,
         priorities: np.ndarray | None = None,
+        tenants: np.ndarray | None = None,
     ) -> None:
         """Seed the device pending set with one full upload — the cold-start
         path (dispatcher restart re-adopting thousands of QUEUED tasks at
@@ -473,14 +523,34 @@ class ResidentScheduler(SchedulerArrays):
         p = np.zeros(T, dtype=np.int32)
         if priorities is not None:
             p[:n] = np.asarray(priorities, dtype=np.int32)
+        tn = np.zeros(T, dtype=np.int32)
+        if tenants is not None:
+            tn[:n] = np.asarray(tenants, dtype=np.int32)
         self._r_state = self._r_state._replace(
             sizes=self._put_task(s),
             valid=self._put_task(v),
             prio=self._put_task(p),
+            tenant=self._put_task(tn),
         )
         for i, tid in enumerate(ids):
             self.slot_task[i] = tid
-            self._slot_meta[i] = _Arrival(tid, float(s[i]), int(p[i]))
+            self._slot_meta[i] = _Arrival(
+                tid, float(s[i]), int(p[i]), int(tn[i])
+            )
+
+    def tenant_deficits(self) -> np.ndarray | None:
+        """Host view of the resident deficit leaf (stats surface). On the
+        FUSED backend the state pytree is DONATED every tick, so a stats
+        thread's snapshot can reference a just-deleted buffer — that read
+        degrades to None (next scrape reads the settled state) instead of
+        crashing the stats surface."""
+        st = self._r_state
+        if not self.use_tenancy or st is None:
+            return None
+        try:
+            return np.asarray(st.t_deficit)
+        except RuntimeError:  # donated-and-deleted under a running tick
+            return None
 
     @property
     def n_pending_host(self) -> int:
@@ -530,6 +600,7 @@ class ResidentScheduler(SchedulerArrays):
             self._put_task(np.zeros(T, dtype=np.float32)),
             self._put_task(np.zeros(T, dtype=bool)),
             self._put_task(np.zeros(T, dtype=np.int32)),
+            self._put_task(np.zeros(T, dtype=np.int32)),  # tenant rows
             self._put_repl(hb),
             self._put_repl(self.worker_free.copy()),
             self._put_repl(self.inflight_worker.copy()),
@@ -542,6 +613,7 @@ class ResidentScheduler(SchedulerArrays):
             self._put_repl(
                 np.zeros(W * self.max_slots, dtype=np.float32)
             ),
+            self._put_repl(np.zeros(self.NT, dtype=np.float32)),
             self._put_repl(np.asarray(True)),
         )
         self._hb_sent = hb.copy()
@@ -591,10 +663,17 @@ class ResidentScheduler(SchedulerArrays):
                 sp_idx, sp_val, ac_idx, ac_val)
 
     def packet_len(self) -> int:
+        lanes = 1 + (1 if self.use_priority else 0) + (
+            1 if self.use_tenancy else 0
+        )
         return (
             _HEADER
-            + self.KA * (2 if self.use_priority else 1)
+            + self.KA * lanes
             + 2 * (self.KH + self.KF + self.KI + self.KS + self.KB)
+            # tenancy tail: share ++ ahead ++ cap vectors ride EVERY tick
+            # packet (3*NT floats — tiny), so hot-reloaded shares and the
+            # live inflight counts reach the kernel as values
+            + (3 * self.NT if self.use_tenancy else 0)
         )
 
     def _pack(self, now_rel, arrivals, hb, fr, infl, sp, ac) -> np.ndarray:
@@ -615,11 +694,20 @@ class ResidentScheduler(SchedulerArrays):
         if self.use_priority:
             p[off : off + len(arrivals)] = [a.priority for a in arrivals]
             off += KA
+        if self.use_tenancy:
+            p[off : off + len(arrivals)] = [a.tenant for a in arrivals]
+            off += KA
         for idx, val, K in ((hb[0], hb[1], KH), (fr[0], fr[1], KF),
                             (infl[0], infl[1], KI), (sp[0], sp[1], KS),
                             (ac[0], ac[1], KB)):
             p[off : off + len(idx)] = idx; off += K
             p[off : off + len(val)] = val; off += K
+        if self.use_tenancy:
+            NT = self.NT
+            ten = self.tenancy
+            p[off : off + NT] = ten.share[:NT]; off += NT
+            p[off : off + NT] = ten.inflight[:NT]; off += NT
+            p[off : off + NT] = ten.cap[:NT]; off += NT
         return p
 
     def _statics(self) -> dict:
@@ -627,6 +715,7 @@ class ResidentScheduler(SchedulerArrays):
             T=self.max_pending, W=self.max_workers, I=self.max_inflight,
             KA=self.KA, KH=self.KH, KF=self.KF, KI=self.KI, KS=self.KS,
             KB=self.KB, use_priority=self.use_priority,
+            use_tenancy=self.use_tenancy, NT=self.NT,
         )
 
     # -- kernel dispatch (multihost-resident overrides these to broadcast

@@ -48,6 +48,11 @@ def _packed_tick(
     dep_edge_child=None,  # i32[E] batch row per graph edge (pad = T, dropped)
     dep_edge_undone=None,  # i32[E] 1 while the edge's parent is unconfirmed
     task_pref=None,  # i32[T] preferred worker row (graph locality), -1 none
+    task_tenant=None,  # i32[T] dense tenant rows (tenancy plane)
+    tenant_share=None,  # f32[N]
+    tenant_deficit=None,  # f32[N] device-carried between ticks
+    tenant_ahead=None,  # i32[N]
+    tenant_cap=None,  # i32[N]
     *,
     T: int,
     W: int,
@@ -93,6 +98,11 @@ def _packed_tick(
         task_priority=task_priority,
         placement=placement,
         auction_price=auction_price,
+        task_tenant=task_tenant,
+        tenant_share=tenant_share,
+        tenant_deficit=tenant_deficit,
+        tenant_ahead=tenant_ahead,
+        tenant_cap=tenant_cap,
     )
     if task_pref is not None:
         # data-locality exchange for graph children: prefer the worker
@@ -122,6 +132,11 @@ class TickOutput(NamedTuple):
     #: must re-solve cold (host checks this one tick late, when the value
     #: is long since computed — no extra sync)
     auction_refresh: jnp.ndarray | None = None
+    #: f32[N_TENANTS] updated per-tenant deficit counters (tenancy plane
+    #: only, else None): fed back as the next tick's carry, device-resident
+    #: between ticks like the auction prices — read to host only by the
+    #: /stats tenancy block
+    tenant_deficit: jnp.ndarray | None = None
     # NOTE deliberately NO per-worker assigned-count output: a T-wide
     # scatter-add with colliding indices measured ~0.5 ms of the ~1 ms tick
     # on v5e — and the host gets the full assignment vector anyway, where
@@ -144,6 +159,13 @@ def scheduler_tick_impl(
     auction_price: jnp.ndarray | None = None,  # f32[W*max_slots] warm start
     auction_refresh: jnp.ndarray | None = None,  # bool scalar: resident carry
     bid_backend: str = "auto",  # auction bid path: auto | xla | stream | ...
+    task_tenant: jnp.ndarray | None = None,  # i32[T] dense tenant rows
+    tenant_share: jnp.ndarray | None = None,  # f32[N] weights
+    tenant_deficit: jnp.ndarray | None = None,  # f32[N] carried counters
+    tenant_ahead: jnp.ndarray | None = None,  # i32[N] inflight per tenant
+    tenant_cap: jnp.ndarray | None = None,  # i32[N] ceilings (0 = uncapped)
+    starve_deficit: float | None = None,  # tenancy starvation-guard knobs
+    starve_boost: int | None = None,
 ) -> TickOutput:
     # -- failure detection (reference purge_workers, device-side) ----------
     # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
@@ -159,16 +181,55 @@ def scheduler_tick_impl(
     worker_of = jnp.clip(iw, 0)
     redispatch = occupied & ~live[worker_of]
 
+    # -- tenancy plane (tpu_faas/tenancy): inflight-cap eligibility masks
+    # task_valid for EVERY placement kernel, and the weighted-fair +
+    # priority admission order feeds rank placement's cut. Flat stacks
+    # (task_tenant None) trace byte-identical graphs to the pre-tenancy
+    # tick — the plane costs nothing until a tenant dimension exists.
+    adm_rank = demand = None
+    if task_tenant is not None:
+        from tpu_faas.tenancy.fairshare import (
+            DEFAULT_STARVE_BOOST,
+            DEFAULT_STARVE_DEFICIT,
+            tenant_fair_admission_impl,
+        )
+
+        eligible, adm_rank, demand = tenant_fair_admission_impl(
+            task_valid, task_tenant, task_priority,
+            tenant_share, tenant_deficit, tenant_ahead, tenant_cap,
+            starve_deficit=(
+                DEFAULT_STARVE_DEFICIT
+                if starve_deficit is None
+                else starve_deficit
+            ),
+            starve_boost=(
+                DEFAULT_STARVE_BOOST if starve_boost is None else starve_boost
+            ),
+        )
+        task_valid = task_valid & eligible
+
+    def _deficit_out(assignment):
+        if task_tenant is None:
+            return None
+        from tpu_faas.tenancy.fairshare import tenant_deficit_update_impl
+
+        return tenant_deficit_update_impl(
+            assignment, task_tenant, demand, tenant_share, tenant_deficit
+        )
+
     # -- batched placement -------------------------------------------------
     # rank is the production default (Monge-optimal for the size/speed cost,
     # cheapest, and the only one with hard priority classes); auction and
     # Sinkhorn serve live for operators whose cost structure needs them
     # (general costs / heterogeneous soft balancing) — they ignore
-    # task_priority, whose admission-ordering contract is rank-specific
+    # task_priority, whose admission-ordering contract is rank-specific.
+    # The tenancy plane follows the same split: its fair ORDERING rides
+    # rank's admission lane; auction/sinkhorn get the hard cap mask alone.
     if placement == "rank":
         assignment = rank_match_placement_impl(
             task_size, task_valid, worker_speed, worker_free, live,
             max_slots=max_slots, task_priority=task_priority,
+            task_adm_rank=adm_rank,
         )
     elif placement == "auction":
         from tpu_faas.sched.auction import auction_placement_impl
@@ -180,7 +241,7 @@ def scheduler_tick_impl(
         )
         return TickOutput(
             res.assignment, live, purged, redispatch, res.prices,
-            res.refresh,
+            res.refresh, tenant_deficit=_deficit_out(res.assignment),
         )
     elif placement == "sinkhorn":
         T, W = task_size.shape[0], worker_speed.shape[0]
@@ -213,7 +274,10 @@ def scheduler_tick_impl(
     else:
         raise ValueError(f"unknown placement kernel {placement!r}")
 
-    return TickOutput(assignment, live, purged, redispatch)
+    return TickOutput(
+        assignment, live, purged, redispatch,
+        tenant_deficit=_deficit_out(assignment),
+    )
 
 
 #: Public jitted form. ``scheduler_tick_impl`` is the un-jitted core the
@@ -221,7 +285,11 @@ def scheduler_tick_impl(
 #: a pjit primitive inside a pallas_call body does not lower, so the
 #: whole solver stack exposes ``_impl`` twins down to the bid kernel.
 scheduler_tick = partial(
-    jax.jit, static_argnames=("max_slots", "placement", "bid_backend")
+    jax.jit,
+    static_argnames=(
+        "max_slots", "placement", "bid_backend", "starve_deficit",
+        "starve_boost",
+    ),
 )(scheduler_tick_impl)
 
 
@@ -324,6 +392,11 @@ class SchedulerArrays:
         # previous tick's price-staleness flag, checked one tick late
         self._d_auction_price = None
         self._d_auction_refresh = None
+        # tenancy plane (tpu_faas/tenancy): the host TenantTable (None =
+        # plane off) and the device-carried deficit vector, fed back
+        # tick-over-tick exactly like the auction prices
+        self.tenancy = None
+        self._d_tenant_deficit = None
 
     # -- membership (reference register/reconnect/purge semantics) ---------
     def register(
@@ -518,6 +591,7 @@ class SchedulerArrays:
         task_priorities: np.ndarray | None = None,
         dep_edges: tuple[np.ndarray, np.ndarray] | None = None,
         task_pref: np.ndarray | None = None,
+        task_tenants: np.ndarray | None = None,
     ) -> TickOutput:
         """Run the fused device step for the current pending batch.
 
@@ -541,6 +615,12 @@ class SchedulerArrays:
             raise ValueError(
                 "graph frontier args are single-device only; mesh/"
                 "multihost dispatchers must rely on promotion announces"
+            )
+        tenancy_on = self.tenancy is not None and task_tenants is not None
+        if tenancy_on and (self.multihost is not None or self.mesh is not None):
+            raise ValueError(
+                "the tenancy plane is single-device only in the one-shot "
+                "tick; mesh/multihost fleets run without in-tick fairness"
             )
         if n > self.max_pending:
             raise ValueError(f"{n} pending > max_pending={self.max_pending}")
@@ -603,6 +683,26 @@ class SchedulerArrays:
             if self._tte_host != self.time_to_expire:
                 self._d_tte = jnp.float32(self.time_to_expire)
                 self._tte_host = self.time_to_expire
+            tenant_kw: dict = {}
+            if tenancy_on:
+                ten = self.tenancy
+                tt = np.zeros(T, dtype=np.int32)
+                tt[:n] = task_tenants
+                if self._d_tenant_deficit is None:
+                    self._d_tenant_deficit = jnp.zeros(
+                        ten.max_tenants, dtype=jnp.float32
+                    )
+                # share/cap ride the cached-upload discipline (they change
+                # only on hot reload); the inflight vector is genuinely
+                # per-tick and tiny (N x 4 bytes). Snapshots throughout —
+                # the table mutates between ticks (see _cached_dev).
+                tenant_kw = dict(
+                    task_tenant=jnp.asarray(tt),
+                    tenant_share=self._cached_dev("tenant_share", ten.share),
+                    tenant_deficit=self._d_tenant_deficit,
+                    tenant_ahead=jnp.asarray(ten.inflight.copy()),
+                    tenant_cap=self._cached_dev("tenant_cap", ten.cap),
+                )
             out = _packed_tick(
                 jnp.asarray(packed),
                 jnp.int32(n),
@@ -624,6 +724,7 @@ class SchedulerArrays:
                 task_pref=(
                     None if task_pref is None else jnp.asarray(task_pref)
                 ),
+                **tenant_kw,
                 T=T,
                 W=W,
                 max_slots=self.max_slots,
@@ -632,6 +733,10 @@ class SchedulerArrays:
             if self.placement == "auction":
                 self._d_auction_price = out.auction_price
                 self._d_auction_refresh = out.auction_refresh
+            if tenancy_on:
+                # deficit carry stays device-resident (read to host only
+                # by the /stats tenancy block — see tenant_deficits)
+                self._d_tenant_deficit = out.tenant_deficit
         # keep prev_live DEVICE-resident: it is only ever fed back into the
         # next tick, and forcing it to host here would put a synchronous
         # device->host round trip inside every tick (over a tunneled dev
@@ -639,6 +744,12 @@ class SchedulerArrays:
         # it forbids pipelining consecutive ticks)
         self.prev_live = out.live
         return out
+
+    def tenant_deficits(self) -> np.ndarray | None:
+        """Host view of the device-carried per-tenant deficit vector (one
+        sync, stats-surface only); None before the first tenancy tick."""
+        d = self._d_tenant_deficit
+        return None if d is None else np.asarray(d)
 
     def _tick_sharded(
         self,

@@ -70,6 +70,14 @@ DISPATCHERS_KEY = "dispatchers:alive"
 #: period would adopt tasks whose owner is alive but between renewals —
 #: double execution.
 LEASE_CONF_KEY = "fleet:lease_conf"
+#: Fleet-wide tenant-fairness configuration (tpu_faas/tenancy): fields
+#: ``shares`` / ``caps`` hold "<spec>:<wall stamp>" — the spec is the same
+#: "name=value,..." string the ``--tenant-shares``/``--tenant-caps`` CLI
+#: flags take, and the trailing stamp makes the sharded store's
+#: freshest-wins fleet-hash merge (store/sharding.py) pick the newest
+#: publication. Dispatchers re-read it at capacity-publish cadence (~1 Hz)
+#: and apply changes to their live TenantTable without a restart.
+TENANT_CONF_KEY = "fleet:tenant_conf"
 #: Results channel: finish_task announces every terminal write here so the
 #: gateway can wake parked /result long-polls instantly instead of polling
 #: the store. No reference analog (its clients poll, SURVEY §3.1); the

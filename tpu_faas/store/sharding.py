@@ -72,6 +72,7 @@ from tpu_faas.store.base import (
     LIVE_INDEX_KEY,
     RESULT_INLINE_PREFIX,
     TASKS_CHANNEL,
+    TENANT_CONF_KEY,
     Subscription,
     TaskStore,
     decode_result_announce,
@@ -80,8 +81,11 @@ from tpu_faas.store.base import (
 #: Fleet coordination hashes: broadcast writes, merged reads (see module
 #: docstring). "fleet:health" is admission/signal.FLEET_HEALTH_KEY —
 #: spelled literally here so the store layer does not import the
-#: admission package.
-FLEET_KEYS = frozenset({"fleet:health", DISPATCHERS_KEY, LEASE_CONF_KEY})
+#: admission package. The tenant-conf hash rides the stamp-tail
+#: freshest-wins merge (its values are "<spec>:<epoch>").
+FLEET_KEYS = frozenset(
+    {"fleet:health", DISPATCHERS_KEY, LEASE_CONF_KEY, TENANT_CONF_KEY}
+)
 
 #: Per-shard round trips, summed over this process's sharded clients.
 #: A separate family from tpu_faas_store_round_trips_total{backend=}

@@ -1,0 +1,32 @@
+"""Multi-tenant fairness plane (ROADMAP item 5).
+
+Admission (tpu_faas/admission) protects the SYSTEM from overload; nothing
+before this package protected tenants from EACH OTHER once admitted — one
+user's 50k-task burst sat ahead of every other user's traffic in plain
+FCFS order, so the light tenant's p99 tracked the heavy tenant's backlog.
+
+The fix lives where placement decisions are made — inside the device tick
+(Sparrow's lesson: fair sharing belongs at the scheduling decision, not
+the admission edge):
+
+- :mod:`tpu_faas.tenancy.config` — tenant vocabulary, share-vector /
+  inflight-cap parsing (``--tenant-shares``/``--tenant-caps``), the
+  hot-reload protocol over the ``fleet:tenant_conf`` store hash, and the
+  host-side :class:`TenantTable` bookkeeping (row registry, per-tenant
+  inflight counts, bounded metric-label vocabulary);
+- :mod:`tpu_faas.tenancy.fairshare` — the in-tick kernels: start-time
+  weighted-fair admission ranking (work-conserving — an idle tenant's
+  share spills to backlogged ones), per-tenant inflight-cap eligibility
+  masking, deficit-counter carry with a starvation age-boost riding the
+  existing priority lane. Un-jitted ``_impl`` twins are traced by BOTH
+  the XLA oracle and the fused Pallas resident kernel, so the two tick
+  backends cannot drift (tenant state is one more aliased VMEM ref).
+"""
+
+from tpu_faas.tenancy.config import (  # noqa: F401
+    DEFAULT_TENANT,
+    TenantTable,
+    parse_caps,
+    parse_shares,
+    valid_tenant,
+)

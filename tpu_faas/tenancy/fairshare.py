@@ -1,0 +1,171 @@
+"""The in-tick weighted-fair admission kernels.
+
+Where they run: :func:`tpu_faas.sched.state.scheduler_tick_impl` calls the
+``_impl`` forms directly, so the SAME traced ops serve the jitted XLA
+tick, the mesh tick, AND the fused Pallas resident kernel (which traces
+``scheduler_tick_impl`` inside one ``pallas_call`` — a pjit primitive
+would not lower there, hence the un-jitted twins, exactly like the
+solver stack's ``_impl`` split in PR 11). Parity between the two resident
+backends with tenant state in play is pinned by tests/test_tenancy.py.
+
+Policy (start-time fair queuing over the admission lane):
+
+- every pending task gets a **virtual position**
+  ``v = (j + 1 - deficit[t]) / share[t]`` where ``j`` is its FCFS rank
+  WITHIN its tenant's backlog; admission under contention follows
+  ascending ``v`` — so two backlogged tenants with shares 2:1 are
+  admitted ~2:1 in any prefix, while an idle tenant consumes nothing and
+  its capacity spills to whoever is backlogged (**work-conserving**, the
+  property a hard per-tick quota mask lacks: the bench's heavy tenant
+  must still saturate the fleet when the light tenant naps);
+- **per-tenant inflight caps** are the one HARD mask: a tenant whose
+  dispatched-but-unreturned count reached its cap has its surplus rows
+  masked out of ``task_valid`` right where placement happens — they stay
+  QUEUED on device and retry next tick. Caps are isolation, deliberately
+  not work-conservation;
+- **deficit counters** carry under-service across ticks: after placement
+  each backlogged tenant's deficit moves by (its share-weighted
+  entitlement of the work actually placed) minus (what it got), clamped
+  to [0, cap]; a tenant with nothing eligible resets to 0 (classic DRR —
+  credit is for waiting work, not for absence). The deficit shifts the
+  tenant's whole queue earlier in virtual time, and past
+  ``starve_deficit`` it boosts the tenant's tasks by ``starve_boost``
+  priority classes — the starvation guard riding the EXISTING priority
+  lane (rank placement's admission key), not a second mechanism;
+- client ``priority`` hints still dominate: the admission order is
+  (effective priority desc, virtual position asc, arrival asc). Equal-
+  priority traffic is exactly weighted-fair; a priority class is still a
+  hard class.
+
+Shape note: the within-tenant rank sorts an i32 key ``tenant * T + row``,
+so ``(max_tenants + 1) * max_pending`` must stay inside int32 — at the
+default 32 tenants that allows ~65M pending rows, two orders past the
+500k headline shape.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+#: deficit clamp (tasks): bounds the catch-up burst a long-starved tenant
+#: can claim at once, and with it the virtual-time shift
+DEFAULT_DEFICIT_CAP = 4096.0
+#: deficit at which the starvation guard engages
+DEFAULT_STARVE_DEFICIT = 1024.0
+#: priority classes a starving tenant's tasks are boosted by
+DEFAULT_STARVE_BOOST = 1
+
+
+def tenant_fair_admission_impl(
+    task_valid: jnp.ndarray,  # bool[T]
+    task_tenant: jnp.ndarray,  # i32[T] dense tenant row per task
+    task_priority: jnp.ndarray | None,  # i32[T] client hints (None = all 0)
+    tenant_share: jnp.ndarray,  # f32[N] positive weights
+    tenant_deficit: jnp.ndarray,  # f32[N] carried under-service
+    tenant_ahead: jnp.ndarray,  # i32[N] dispatched-but-unreturned per row
+    tenant_cap: jnp.ndarray,  # i32[N] inflight ceilings (0 = uncapped)
+    starve_deficit: float = DEFAULT_STARVE_DEFICIT,
+    starve_boost: int = DEFAULT_STARVE_BOOST,
+):
+    """Returns ``(eligible bool[T], adm_rank i32[T], demand bool[N])``.
+
+    ``eligible`` is ``task_valid`` minus the rows past their tenant's
+    inflight-cap allowance; ``adm_rank`` is each task's position in the
+    full admission order (eligible tasks occupy ranks ``0..n_eligible-1``)
+    for the rank placement's admission cut; ``demand`` marks tenants with
+    at least one eligible task this tick (the deficit update's DRR gate).
+    """
+    T = task_valid.shape[0]
+    N = tenant_share.shape[0]
+    t = jnp.clip(task_tenant, 0, N - 1)
+    idx = jnp.arange(T, dtype=jnp.int32)
+
+    # -- FCFS rank within each tenant's valid backlog ----------------------
+    # one stable sort groups rows by tenant (invalid sink to segment N);
+    # within a segment, rank = position minus the segment start
+    seg = jnp.where(task_valid, t, N)
+    order = jnp.argsort(seg * T + idx)
+    seg_sorted = seg[order]
+    is_start = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), seg_sorted[1:] != seg_sorted[:-1]]
+    )
+    start = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    j = jnp.zeros(T, dtype=jnp.int32).at[order].set(idx - start)
+
+    # -- hard eligibility: per-tenant inflight caps ------------------------
+    allowance = jnp.where(
+        tenant_cap > 0,
+        jnp.maximum(tenant_cap - tenant_ahead, 0),
+        jnp.int32(T),
+    )
+    eligible = task_valid & (j < allowance[t])
+    demand = (
+        jnp.zeros(N, dtype=bool)
+        .at[jnp.where(eligible, t, N)]
+        .set(True, mode="drop")
+    )
+
+    # -- the admission order -----------------------------------------------
+    share = jnp.maximum(tenant_share, 1e-6)
+    v = (j.astype(jnp.float32) + 1.0 - tenant_deficit[t]) / share[t]
+    prio = (
+        jnp.zeros(T, dtype=jnp.int32)
+        if task_priority is None
+        else task_priority.astype(jnp.int32)
+    )
+    boost = jnp.where(
+        tenant_deficit[t] >= jnp.float32(starve_deficit),
+        jnp.int32(starve_boost),
+        0,
+    )
+    eff_prio = prio + boost
+    # lexsort: LAST key is primary — eligible first, then priority desc,
+    # then virtual position asc, then arrival asc (the stable tie-break)
+    adm_order = jnp.lexsort(
+        (idx, v, -eff_prio, (~eligible).astype(jnp.int32))
+    )
+    adm_rank = jnp.zeros(T, dtype=jnp.int32).at[adm_order].set(idx)
+    return eligible, adm_rank, demand
+
+
+def tenant_deficit_update_impl(
+    assignment: jnp.ndarray,  # i32[T] worker per task, -1 = stayed queued
+    task_tenant: jnp.ndarray,  # i32[T]
+    demand: jnp.ndarray,  # bool[N] from the admission pass
+    tenant_share: jnp.ndarray,  # f32[N]
+    tenant_deficit: jnp.ndarray,  # f32[N] carried in
+    deficit_cap: float = DEFAULT_DEFICIT_CAP,
+) -> jnp.ndarray:
+    """The post-placement deficit carry: each backlogged tenant is
+    entitled to its share-weighted fraction (normalized over backlogged
+    tenants only — idle shares don't dilute) of the placements the tick
+    actually made; under-service accumulates, service repays it, and a
+    tenant with no eligible work resets (DRR). Clamped to
+    ``[0, deficit_cap]``."""
+    N = tenant_share.shape[0]
+    t = jnp.clip(task_tenant, 0, N - 1)
+    placed = (
+        jnp.zeros(N, dtype=jnp.float32)
+        .at[jnp.where(assignment >= 0, t, N)]
+        .add(1.0, mode="drop")
+    )
+    total = placed.sum()
+    w = jnp.where(demand, jnp.maximum(tenant_share, 1e-6), 0.0)
+    entitled = w / jnp.maximum(w.sum(), 1e-9) * total
+    new = jnp.clip(
+        tenant_deficit + entitled - placed, 0.0, jnp.float32(deficit_cap)
+    )
+    return jnp.where(demand, new, 0.0)
+
+
+#: jitted forms for host-side callers (tests, standalone use); the tick
+#: paths trace the _impl twins directly.
+tenant_fair_admission = partial(
+    jax.jit, static_argnames=("starve_deficit", "starve_boost")
+)(tenant_fair_admission_impl)
+tenant_deficit_update = partial(jax.jit, static_argnames=("deficit_cap",))(
+    tenant_deficit_update_impl
+)
