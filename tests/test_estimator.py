@@ -558,6 +558,69 @@ def test_ephemeral_uuid_tokens_never_persist_and_forget_on_purge():
         disp.socket.close(linger=0)
 
 
+def test_restart_churn_keeps_worker_stats_key_bounded():
+    """ADVICE r5 regression, restart-LOOP form: many generations of ad-hoc
+    (uuid-token, ephemeral-flagged) workers registering, getting graded,
+    and being purged must leave WORKER_STATS_KEY holding ONLY the durable
+    deploy tokens — the store key is bounded by the operator-managed
+    fleet, not by restarts-ever."""
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.sched.estimator import WORKER_STATS_KEY
+    from tpu_faas.store.memory import MemoryStore
+
+    store = MemoryStore()
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=store, max_workers=8,
+        max_pending=32, max_inflight=64,
+    )
+    try:
+        fd = fn_digest("churn-fn")
+        for gen in range(25):
+            sock = f"churn-{gen}".encode()
+            disp._handle(
+                sock, "register",
+                {
+                    "num_processes": 2,
+                    "token": f"uuid-{gen:04d}" + "f" * 24,
+                    "ephemeral": True,
+                },
+            )
+            row = disp.arrays.worker_ids[sock]
+            # grade it (speed observations make the entry dirty if the
+            # ephemeral flag were ever dropped)
+            for i in range(6):
+                tid = f"g{gen}-t{i}"
+                disp._task_digest[tid] = (fd, fn_digest("p"), 8)
+                disp._observe_result(
+                    sock, row, tid,
+                    {"elapsed": 0.25, "status": "COMPLETED"},
+                )
+            disp.estimator.maybe_persist(force=True)
+            # the process dies; the purge path forgets the token
+            disp.arrays.heartbeat(sock)
+            disp._reap_dead_workers([], [int(row)], lambda pt: None)
+        # one durable deploy token beside the churn persists normally
+        disp._handle(
+            b"stable", "register",
+            {"num_processes": 2, "token": "deploy-slot0"},
+        )
+        row = disp.arrays.worker_ids[b"stable"]
+        for i in range(6):
+            tid = f"stable-t{i}"
+            disp._task_digest[tid] = (fd, fn_digest("p"), 8)
+            disp._observe_result(
+                b"stable", row, tid, {"elapsed": 0.5, "status": "COMPLETED"}
+            )
+        disp.estimator.maybe_persist(force=True)
+        persisted = store.hgetall(WORKER_STATS_KEY)
+        assert set(persisted) == {"deploy-slot0"}  # bounded: zero churn leak
+        # in-memory grade table bounded by the live fleet too
+        assert len(disp.estimator._speed_est) <= 2
+    finally:
+        disp.socket.close(linger=0)
+        disp.close()
+
+
 def test_push_worker_flags_minted_token_ephemeral():
     """The wire contract behind the leak fix: no --token -> ephemeral=True
     rides REGISTER; an operator token -> ephemeral=False."""

@@ -25,7 +25,9 @@ def main(argv: list[str] | None = None) -> None:
         "12 (latency closed-loop), 13 (task graphs), "
         "14 (fleet throughput: sharded control plane), "
         "15 (tick-latency trajectory: fused vs XLA tick), "
-        "16 (tenant fairness: isolation + weighted shares), or 'all'",
+        "16 (tenant fairness: isolation + weighted shares), "
+        "17 (batched data plane: TASK_BATCH/bundles vs per-task wire), "
+        "or 'all'",
     )
     ap.add_argument(
         "-m", "--mode", default="push",

@@ -1,0 +1,217 @@
+"""One full-stack leg of the batched-data-plane bench (config 17), as a
+real OS process.
+
+The config compares two serve-loop configurations of the SAME stack
+(batched vs per-task worker wire). Run as threads of one parent process,
+the second leg measurably inherits the first's teardown tail (dying
+forkserver children, allocator/GC state, asyncio loop remains) — identical
+reps were observed 6x apart purely by leg order on a small box. Each leg
+therefore runs in a fresh child process (config-14 precedent: processes,
+not threads, for anything whose serve loop is being compared).
+
+The child builds the whole stack — RESP store server, gateway, an express
+tpu-push dispatcher with the requested ``--batch-max``/``--batch-window-ms``,
+and real PushWorkers as threads of this child (their pool children are
+separate processes; keeping the worker parents in-child makes the pool
+counters readable) — drives a no-op burst through the real submit path,
+probes solo express latency on the then-idle stack, scrapes /metrics
+against the strict exposition grammar mid-run, and prints ONE JSON row on
+stdout.
+
+Run: ``python -m tpu_faas.bench.batch_leg_child --batch-max 16
+--batch-window-ms 2 --tasks 2000 --workers 2 --procs 4 --solo 30``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="batched-data-plane bench leg child"
+    )
+    ap.add_argument("--batch-max", type=int, default=0)
+    ap.add_argument("--batch-window-ms", type=float, default=0.0)
+    ap.add_argument("--tasks", type=int, required=True)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--procs", type=int, default=4)
+    ap.add_argument("--solo", type=int, default=30)
+    ns = ap.parse_args(argv)
+
+    # persistent XLA compile cache, same as fleet_child/the dispatcher
+    # CLI: a cold child re-compiling the device tick mid-burst stalls the
+    # serve loop long enough to trip heartbeat purges of its own workers
+    import os
+
+    cache_dir = os.environ.get(
+        "TPU_FAAS_COMPILE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "tpu_faas_xla"),
+    )
+    if cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.obs.expofmt import parse_exposition, require_series
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.utils.logging import percentile
+    from tpu_faas.worker.pool import POOL_IPC
+    from tpu_faas.worker.push_worker import PushWorker
+    from tpu_faas.workloads import no_op
+
+    required_series = [
+        "tpu_faas_dispatcher_tasks_dispatched_total",
+        "tpu_faas_dispatcher_task_frames_total",
+        "tpu_faas_dispatch_batch_size",
+        "tpu_faas_worker_bundle_size",
+        "tpu_faas_worker_pool_ipc_total",
+        "tpu_faas_dispatcher_results_total",
+    ]
+
+    n_tasks = ns.tasks
+    handle = start_store_thread()
+    gw = start_gateway_thread(make_store(handle.url))
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(handle.url),
+        max_workers=max(64, ns.workers * 2),
+        max_pending=4096,
+        max_inflight=max(4 * n_tasks, 1024),
+        max_slots=ns.procs,
+        tick_period=0.005,
+        recover_queued=False,
+        express=True,
+        batch_max=ns.batch_max,
+        batch_window_ms=ns.batch_window_ms,
+    )
+    disp_thread = threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        PushWorker(ns.procs, url, heartbeat=True, heartbeat_period=0.5)
+        for _ in range(ns.workers)
+    ]
+    worker_threads = [
+        threading.Thread(target=w.run, daemon=True) for w in workers
+    ]
+    for t in worker_threads:
+        t.start()
+    stats_server = disp.serve_stats(0)
+    stats_port = stats_server.server_address[1]
+    client = FaaSClient(gw.url)
+    try:
+        fid = client.register(no_op)
+        # warm the stack end to end (pool children spawned inside
+        # PushWorker.run; first results prove the wire) before timing
+        for h in client.submit_many(fid, [((), {})] * 4):
+            h.result(timeout=120.0)
+        ipc0 = POOL_IPC.value
+        frames0 = disp.m_task_frames.value
+        dispatched0 = disp.n_dispatched
+        results0 = disp.n_results
+        scrape_ok: bool | None = None
+        scrape_missing: list[str] = []
+        scrape_error = ""
+        t0 = time.perf_counter()
+        chunk = 500
+        submitted = 0
+        while submitted < n_tasks:
+            n = min(chunk, n_tasks - submitted)
+            client.submit_many(fid, [((), {})] * n)
+            submitted += n
+        deadline = t0 + 600.0
+        while (
+            disp.n_results - results0 < n_tasks
+            and time.perf_counter() < deadline
+        ):
+            if (
+                scrape_ok is None
+                and disp.n_results - results0 >= n_tasks // 2
+            ):
+                # mid-run scrape: the exposition must be valid and
+                # complete WHILE the hot loop runs, not just at rest
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{stats_port}/metrics",
+                        timeout=10,
+                    ) as resp:
+                        families = parse_exposition(
+                            resp.read().decode("utf-8")
+                        )
+                    scrape_missing = require_series(
+                        families, required_series
+                    )
+                    scrape_ok = not scrape_missing
+                except Exception as exc:
+                    scrape_ok = False
+                    scrape_error = f"{type(exc).__name__}: {exc}"
+            time.sleep(0.01)
+        elapsed = time.perf_counter() - t0
+        completed = disp.n_results - results0
+        n_dispatched = disp.n_dispatched - dispatched0
+        frames = disp.m_task_frames.value - frames0
+        ipc = POOL_IPC.value - ipc0
+        # solo latency probe on the now-idle stack: sequential
+        # single-task round trips through the express lane. A short
+        # settle first — the burst's tail (trace-book close-out, span
+        # flushes, gateway observe backlog) otherwise bleeds one
+        # multi-second outlier into a small-sample p99
+        time.sleep(0.5)
+        solo_ms: list[float] = []
+        for _ in range(ns.solo):
+            s0 = time.perf_counter()
+            h = client.submit(fid)
+            h.result(timeout=60.0)
+            solo_ms.append((time.perf_counter() - s0) * 1e3)
+        solo_ms.sort()  # percentile() is nearest-rank over SORTED data
+        row = {
+            "batch_max": ns.batch_max,
+            "batch_window_ms": ns.batch_window_ms,
+            "completed": completed,
+            "tasks_per_s": round(completed / max(elapsed, 1e-9), 1),
+            "frames_per_task": round(frames / max(n_dispatched, 1), 4),
+            "pool_ipc_per_task": round(ipc / max(completed, 1), 4),
+            "solo_p50_ms": round(percentile(solo_ms, 0.5), 3),
+            "solo_p99_ms": round(percentile(solo_ms, 0.99), 3),
+            "metrics_scrape_ok": bool(scrape_ok),
+            "metrics_missing": scrape_missing,
+            "metrics_scrape_error": scrape_error,
+            # stall diagnostics: recompiles and purges mid-burst mean the
+            # leg measured a compile/reclaim cascade, not the data plane
+            "jit_signatures": disp.profiler.n_signatures,
+            "workers_purged": disp.n_purged,
+            "tasks_reclaimed": int(disp.m_reclaimed.value),
+            "tick_p99_ms": round(
+                disp.tracer.summary()
+                .get("device_tick", {})
+                .get("p99", 0.0) * 1e3,
+                2,
+            ),
+        }
+        print(json.dumps(row), flush=True)
+    finally:
+        for w in workers:
+            w.stop()
+        for t in worker_threads:
+            t.join(timeout=30)
+        disp.stop()
+        disp_thread.join(timeout=10)
+        disp.socket.close(linger=0)
+        disp.close()
+        gw.stop()
+        handle.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2725,6 +2725,211 @@ def config_16_tenant_fairness() -> dict:
     return row
 
 
+def config_17_batched_plane() -> dict:
+    """Batched worker data plane (config 17): e2e dispatch throughput for
+    no-op functions against the FULL real stack — store server over TCP,
+    gateway, an express tpu-push dispatcher, and real PushWorkers (run
+    in-process so their pool counters are readable; execution still
+    happens in forkserver child processes) — in a ``batched`` leg
+    (--batch-max K, --batch-window-ms W: TASK_BATCH frames out,
+    RESULT_BATCH frames back, K-task pool bundles) vs an ``unbatched``
+    control (batch off: the per-task wire, byte-identical to the
+    pre-batch build) on the same box and topology.
+
+    Each leg also runs a SOLO latency probe — sequential single-task
+    submit→result round trips on the idle stack — pinning that the
+    batching window never re-introduces a latency floor for a lone
+    express task (acceptance: batched solo p99 <= 1.1x unbatched). The
+    frames-per-task and pool-IPC-per-task counters prove the
+    O(1)-per-bundle claim (both ~1.0 on the control, << 1 batched), and
+    each leg's dispatcher /metrics is scraped mid-run against the strict
+    exposition grammar with the new batch families required.
+
+    Shape via TPU_FAAS_BENCH_BATCH_SHAPE="tasks,workers,procs,batch_max"
+    (default "2000,2,4,16"); the CI smoke lane runs "300,2,2,8" and
+    asserts completion on both legs, a finite nonzero ratio, bundling
+    engaged (frames/task < 1 on the batched leg), and clean scrapes.
+    """
+    import json
+    import os
+    import threading
+
+    from tpu_faas.worker.push_worker import PushWorker
+    from tpu_faas.workloads import no_op
+
+    shape = os.environ.get("TPU_FAAS_BENCH_BATCH_SHAPE", "2000,2,4,16")
+    n_tasks, n_workers, n_procs, batch_max = (
+        int(x) for x in shape.split(",")
+    )
+    n_solo = int(os.environ.get("TPU_FAAS_BENCH_BATCH_SOLO", "30"))
+
+    def run_leg(leg_batch_max: int, window_ms: float) -> dict:
+        """One full-stack leg in a FRESH child process
+        (tpu_faas/bench/batch_leg_child.py): run as threads of this
+        process, the second leg inherits the first's teardown tail
+        (dying forkserver children, allocator/GC state) and identical
+        reps were observed 6x apart purely by order — the config-14
+        lesson, applied to legs instead of fleet members."""
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [
+                _sys.executable, "-m", "tpu_faas.bench.batch_leg_child",
+                "--batch-max", str(leg_batch_max),
+                "--batch-window-ms", str(window_ms),
+                "--tasks", str(n_tasks),
+                "--workers", str(n_workers),
+                "--procs", str(n_procs),
+                "--solo", str(n_solo),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+        raise RuntimeError(
+            f"batch leg child produced no row (rc={proc.returncode}): "
+            f"{proc.stderr[-2000:]}"
+        )
+
+    def run_wire_leg(frame_size: int) -> dict:
+        """The worker data plane in isolation: a synthetic ROUTER feeds a
+        real PushWorker (real decode, real pool, real no-op execution in
+        forkserver children, real result frames back) open-loop, in
+        per-task TASK framing (frame_size 1 — the pre-batch wire) or
+        TASK_BATCH frames of ``frame_size``. This is the per-process
+        segment the batching optimizes, free of the store/gateway/
+        device-tick costs the full-stack legs share on a small box."""
+        import zmq
+
+        from tpu_faas.core.executor import pack_params
+        from tpu_faas.core.serialize import serialize
+        from tpu_faas.worker import messages as wm
+        from tpu_faas.worker.pool import POOL_IPC
+
+        n = max(4 * n_tasks, 2000)
+        ctx = zmq.Context.instance()
+        router = ctx.socket(zmq.ROUTER)
+        port = router.bind_to_random_port("tcp://127.0.0.1")
+        worker = PushWorker(
+            n_procs, f"tcp://127.0.0.1:{port}", poll_timeout_ms=10
+        )
+        t = threading.Thread(target=worker.run, daemon=True)
+        t.start()
+        try:
+            wid, _ = router.recv_multipart()
+            fn = serialize(no_op)
+            params = pack_params()
+            tasks = [
+                {"task_id": f"t{i}", "fn_payload": fn,
+                 "param_payload": params}
+                for i in range(n)
+            ]
+            ipc0 = POOL_IPC.value
+            frames = 0
+            t0 = time.perf_counter()
+            if frame_size > 1:
+                for lo in range(0, n, frame_size):
+                    router.send_multipart(
+                        [wid, wm.encode(
+                            wm.TASK_BATCH, tasks=tasks[lo:lo + frame_size]
+                        )]
+                    )
+                    frames += 1
+            else:
+                for task in tasks:
+                    router.send_multipart(
+                        [wid, wm.encode(wm.TASK, **task)]
+                    )
+                    frames += 1
+            got = 0
+            deadline = t0 + 300.0
+            while got < n and time.perf_counter() < deadline:
+                if not router.poll(1000):
+                    continue
+                _, raw = router.recv_multipart()
+                typ, data = wm.decode(raw)
+                if typ == wm.RESULT:
+                    got += 1
+                elif typ == wm.RESULT_BATCH:
+                    got += len(data["results"])
+            elapsed = time.perf_counter() - t0
+            return {
+                "frame_size": frame_size,
+                "completed": got,
+                "tasks_per_s": round(got / max(elapsed, 1e-9), 1),
+                "frames_per_task": round(frames / max(n, 1), 4),
+                "pool_ipc_per_task": round(
+                    (POOL_IPC.value - ipc0) / max(got, 1), 4
+                ),
+            }
+        finally:
+            worker.stop()
+            t.join(timeout=30)
+            router.close(linger=0)
+
+    def best_of(fn, reps: int = 2) -> dict:
+        """Best-of-N on a shared/noisy box (config-15 precedent: medians
+        over reps): a leg that starts into the previous leg's teardown
+        tail (dying pool children, forkserver churn) can lose 5x+ for
+        environmental reasons, so each leg settles first and the healthy
+        rep carries the row; every rep's throughput is recorded."""
+        import gc
+
+        rows = []
+        for _ in range(reps):
+            gc.collect()
+            time.sleep(1.5)  # let the previous leg's teardown tail drain
+            rows.append(fn())
+        best = max(rows, key=lambda r: r["tasks_per_s"])
+        best["reps_tasks_per_s"] = [r["tasks_per_s"] for r in rows]
+        return best
+
+    # control leg FIRST: the process accumulates state (forkserver
+    # residue, registries) across legs, so any ordering bias loads the
+    # BATCHED leg and the reported ratio is conservative
+    unbatched = best_of(lambda: run_leg(0, 0.0))
+    batched = best_of(lambda: run_leg(batch_max, 2.0))
+    wire_per_task = best_of(lambda: run_wire_leg(1))
+    wire_batched = best_of(lambda: run_wire_leg(batch_max))
+    return {
+        "config": "batched-data-plane",
+        "shape": {
+            "tasks": n_tasks,
+            "workers": n_workers,
+            "procs": n_procs,
+            "batch_max": batch_max,
+        },
+        "host_cores": os.cpu_count(),
+        "batched": batched,
+        "unbatched": unbatched,
+        # acceptance headlines: the full-stack ratio shares one box with
+        # the (untouched) store server, gateway, and device tick — on a
+        # core-starved host those bound it well below the data plane's
+        # own win, so the isolated worker-wire ratio is recorded beside
+        # it (config-14 precedent: host_cores is the binding constraint
+        # before architecture is); the solo guard (<= 1.1x) pins that
+        # batching never trades idle latency away
+        "throughput_ratio": round(
+            batched["tasks_per_s"] / max(unbatched["tasks_per_s"], 1e-9), 3
+        ),
+        "solo_p99_ratio": round(
+            batched["solo_p99_ms"] / max(unbatched["solo_p99_ms"], 1e-9), 3
+        ),
+        "wire_batched": wire_batched,
+        "wire_per_task": wire_per_task,
+        "wire_ratio": round(
+            wire_batched["tasks_per_s"]
+            / max(wire_per_task["tasks_per_s"], 1e-9),
+            3,
+        ),
+    }
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -2742,4 +2947,5 @@ CONFIGS = {
     "14": config_14_fleet,
     "15": config_15_tick_trajectory,
     "16": config_16_tenant_fairness,
+    "17": config_17_batched_plane,
 }

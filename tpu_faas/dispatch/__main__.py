@@ -205,6 +205,25 @@ def main(argv: list[str] | None = None) -> None:
         "classic id-only announce and the gateway's store read",
     )
     ap.add_argument(
+        "--batch-max", type=int, default=0, metavar="K",
+        help="push/tpu-push: batched worker data plane — group each "
+        "round's assignments into ONE TASK_BATCH frame (up to K tasks) "
+        "per batch-capable worker, and accept coalesced RESULT_BATCH "
+        "frames back; a K-task bundle then costs O(1) frames and O(1) "
+        "worker pool wakeups instead of O(K). Reference-era workers (no "
+        "'batch' capability) keep the per-task wire verbatim. 0 (default) "
+        "= batching off: the wire is byte-identical everywhere",
+    )
+    ap.add_argument(
+        "--batch-window-ms", type=float, default=0.0, metavar="MS",
+        help="tpu-push --express: adaptive micro-batching window for the "
+        "announce-woken sub-tick — a small ready set still dispatches "
+        "immediately (solo latency unchanged), but under load arrivals "
+        "coalesce up to this many ms (or until --batch-max is reached) "
+        "so express sub-ticks ship fuller bundles. 0 = every express "
+        "wake ticks immediately",
+    )
+    ap.add_argument(
         "--shared", action="store_true",
         help="several dispatchers share this store+channel: each claims "
         "tasks atomically before dispatching (exactly one runs each "
@@ -384,7 +403,9 @@ def main(argv: list[str] | None = None) -> None:
     if owned_store is not None:
         kwargs["store"] = owned_store
     if ns.mode == "push":
-        kwargs.update(heartbeat=ns.hb, process_lb=ns.plb)
+        kwargs.update(
+            heartbeat=ns.hb, process_lb=ns.plb, batch_max=ns.batch_max
+        )
     elif ns.mode == "tpu-push":
         kwargs.update(
             rescan_period=ns.rescan,
@@ -402,6 +423,8 @@ def main(argv: list[str] | None = None) -> None:
             estimate_runtimes=not ns.no_runtime_learning,
             express=ns.express,
             inline_result_max=ns.inline_result_max,
+            batch_max=ns.batch_max,
+            batch_window_ms=ns.batch_window_ms,
             tenant_shares=ns.tenant_shares,
             tenant_caps=ns.tenant_caps,
             max_tenants=ns.max_tenants,

@@ -72,6 +72,7 @@ from tpu_faas.store.base import (
 )
 from tpu_faas.store.launch import make_store
 from tpu_faas.utils.logging import get_logger, log_ctx
+from tpu_faas.worker import messages as _wm
 
 #: Exceptions treated as a transient store outage (restart, network blip).
 #: Deliberately NOT plain OSError: zmq.ZMQError subclasses OSError, and a
@@ -384,6 +385,25 @@ class TaskDispatcher:
             "params — the spread vs tasks_dispatched_total IS the "
             "payload plane's wire saving",
         )
+        # -- batched data plane (TASK_BATCH/RESULT_BATCH frames) -----------
+        #: dispatcher-side batching knob: >= 2 groups a round's assignments
+        #: into one TASK_BATCH frame per CAP_BATCH worker (push-family
+        #: subclasses expose it as --batch-max); 0/1 keeps the per-task
+        #: wire byte-identical everywhere
+        self.batch_max = 0
+        self.m_task_frames = self.metrics.counter(
+            "tpu_faas_dispatcher_task_frames_total",
+            "TASK/TASK_BATCH frames put on the worker wire (a K-task "
+            "bundle counts 1, so frames / tasks_dispatched_total is the "
+            "O(1)-frames-per-bundle proof; 1:1 with batching off)",
+        )
+        self.m_batch_size = self.metrics.histogram(
+            "tpu_faas_dispatch_batch_size",
+            "Tasks per TASK-carrying frame on the worker wire (1 = the "
+            "classic per-task form; larger values are TASK_BATCH frames "
+            "to batch-capable workers)",
+            buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
+        )
         self.m_queue_depth = self.metrics.gauge(
             "tpu_faas_dispatcher_pending_tasks",
             "Tasks held in the dispatcher's pending structures",
@@ -634,6 +654,80 @@ class TaskDispatcher:
         if not (blob and task.fn_digest):
             n += len(task.fn_payload)
         self.m_payload_bytes.inc(n)
+
+    # -- batched data plane (push-family send path) ------------------------
+    def send_wire(self, wid, payload: bytes) -> None:
+        """Put one framed message on the worker wire (push-family ROUTER
+        sockets; subclasses own ``self.socket``)."""
+        self.socket.send_multipart([wid, payload])
+
+    def send_task_frame(self, buf: dict, wid, caps, task, blob: bool) -> None:
+        """Send — or buffer for a per-worker TASK_BATCH — one assignment.
+
+        The batching gate is capability-negotiated AND operator-opted:
+        only a worker that advertised CAP_BATCH, under a dispatcher with
+        ``batch_max >= 2``, ever has its frames grouped; everyone else
+        gets the per-task TASK frame byte-identically to the unbatched
+        build. ``buf`` maps wid -> (bin_capable, [task kwargs...]); a
+        worker's buffer reaching batch_max flushes early so one frame
+        never exceeds the knob. Callers MUST drain the buffer with
+        flush_task_frames before the send round's bookkeeping completes
+        (put it in the finally: a buffered task is already tracked
+        in-flight, so its frame must reach the wire even on an abort)."""
+        kw = task.task_message_kwargs(
+            blob=blob, trace=_wm.CAP_TRACE in caps
+        )
+        if self.batch_max >= 2 and _wm.CAP_BATCH in caps:
+            ent = buf.get(wid)
+            if ent is None:
+                ent = buf[wid] = (_wm.CAP_BIN in caps, [])
+            ent[1].append(kw)
+            if len(ent[1]) >= self.batch_max:
+                buf.pop(wid)
+                self._flush_batch_frame(wid, ent[0], ent[1])
+        else:
+            self.send_wire(
+                wid, _wm.encode_for(_wm.CAP_BIN in caps, _wm.TASK, **kw)
+            )
+            self.m_task_frames.inc()
+            self.m_batch_size.observe(1.0)
+
+    def _flush_batch_frame(self, wid, bin_cap: bool, items: list) -> None:
+        """One buffered worker's frame: a singleton stays a plain TASK
+        (identical wire to the unbatched path), K > 1 ship as TASK_BATCH."""
+        if len(items) == 1:
+            self.send_wire(
+                wid, _wm.encode_for(bin_cap, _wm.TASK, **items[0])
+            )
+        else:
+            self.send_wire(
+                wid, _wm.encode_for(bin_cap, _wm.TASK_BATCH, tasks=items)
+            )
+        self.m_task_frames.inc()
+        self.m_batch_size.observe(float(len(items)))
+
+    def flush_task_frames(self, buf: dict) -> None:
+        """Drain every buffered per-worker batch onto the wire; safe to
+        call twice (the buffer empties as it flushes). Per-worker
+        isolation: one worker's send raising (socket torn down mid-stop)
+        must not strand the OTHER workers' buffered frames — their tasks
+        are already tracked in-flight and would hang until a purge. The
+        failing worker's own tasks recover exactly like any lost frame:
+        heartbeat purge + reclaim."""
+        first_err: BaseException | None = None
+        while buf:
+            wid, (bin_cap, items) = buf.popitem()
+            try:
+                self._flush_batch_frame(wid, bin_cap, items)
+            except Exception as exc:
+                if first_err is None:
+                    first_err = exc
+                self.log.error(
+                    "TASK frame flush to %r failed (%d tasks ride the "
+                    "purge/reclaim recovery): %s", wid, len(items), exc,
+                )
+        if first_err is not None:
+            raise first_err
 
     #: max worker messages decoded per serve-loop round (push-family
     #: ROUTER drains): a worker flooding messages faster than they

@@ -81,10 +81,15 @@ class PushDispatcher(TaskDispatcher):
         max_task_retries: int = 3,
         clock=time.monotonic,
         shared: bool = False,
+        batch_max: int = 0,
     ) -> None:
         super().__init__(
             store_url=store_url, channel=channel, store=store, shared=shared
         )
+        #: batched worker data plane (opt-in, like tpu-push's --batch-max):
+        #: >= 2 groups one dispatch round's sends into one TASK_BATCH
+        #: frame per CAP_BATCH worker; 0 keeps the per-task wire verbatim
+        self.batch_max = max(0, int(batch_max))
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.ROUTER)
         if port == 0:
@@ -212,7 +217,7 @@ class PushDispatcher(TaskDispatcher):
                     num_processes=0, free_processes=0, last_heartbeat=now
                 )
                 self._send(wid, m.encode(m.RECONNECT))
-                if msg_type not in (m.RECONNECT, m.RESULT):
+                if msg_type not in (m.RECONNECT, m.RESULT, m.RESULT_BATCH):
                     return
             else:
                 return
@@ -233,40 +238,19 @@ class PushDispatcher(TaskDispatcher):
                 self.forget_worker_sender(wid)
             return
         if msg_type == m.RESULT:
-            task_id = data["task_id"]
             self.note_worker_misfires(wid, data)
-            self.note_result_message(task_id, data)
-            # suspicious = a second result is possible: the sender doesn't
-            # hold the task (zombie whose task was reclaimed), or the task
-            # was reclaimed at least once before reaching this worker
-            suspicious = (
-                task_id not in rec.inflight
-                or task_id in rec.inflight_retries
-            )
-            self.record_result_safe(
-                task_id, data["status"], data["result"], first_wins=suspicious
-            )
-            self.n_results += 1
-            # Only a result for a task this worker actually holds releases a
-            # process slot: a zombie's stale result (its task was reclaimed
-            # and it re-registered) must not over-commit its pool.
-            if task_id in rec.inflight:
-                rec.inflight.discard(task_id)
-                rec.inflight_retries.pop(task_id, None)
-                if rec.num_processes == 0:
-                    # draining worker: last in-flight result drops the record
-                    if not rec.inflight:
-                        self.workers.pop(wid, None)
-                        self._refresh_fleet_procs()
-                        self.forget_worker_sender(wid)
-                    return
-                rec.free_processes = min(
-                    rec.free_processes + 1, rec.num_processes
-                )
-                if self.process_lb:
-                    self.free_procs.append(wid)
-                else:
-                    self._add_free(wid)
+            self._handle_result(wid, rec, data)
+        elif msg_type == m.RESULT_BATCH:
+            # batched result lane: K results in one frame, each running
+            # the full per-task path (slot release, drain-drop, zombie
+            # guards) exactly like K RESULT frames. A draining worker's
+            # record can drop mid-batch (its last in-flight result
+            # landed); later elements still get their store writes, as
+            # unknown-sender results would.
+            self.note_worker_misfires(wid, data)
+            for item in data.get("results", ()):
+                if isinstance(item, dict) and "task_id" in item:
+                    self._handle_result(wid, self.workers.get(wid), item)
         elif msg_type == m.BLOB_MISS:
             # payload-plane resolution request (blob-capable workers only)
             self._serve_blob_miss(wid, rec, data)
@@ -285,8 +269,51 @@ class PushDispatcher(TaskDispatcher):
         elif msg_type == m.HEARTBEAT:
             pass  # timestamp already refreshed above
 
+    def _handle_result(
+        self, wid: bytes, rec: WorkerRecord | None, data: dict
+    ) -> None:
+        """One result's full per-task path (shared by RESULT frames and
+        RESULT_BATCH elements). ``rec`` may be None for a late batch
+        element after a draining worker's record dropped mid-frame — the
+        store write still lands (first-wins suspicious), there is just no
+        slot to release."""
+        task_id = data["task_id"]
+        self.note_result_message(task_id, data)
+        # suspicious = a second result is possible: the sender doesn't
+        # hold the task (zombie whose task was reclaimed), or the task
+        # was reclaimed at least once before reaching this worker
+        suspicious = (
+            rec is None
+            or task_id not in rec.inflight
+            or task_id in rec.inflight_retries
+        )
+        self.record_result_safe(
+            task_id, data["status"], data["result"], first_wins=suspicious
+        )
+        self.n_results += 1
+        # Only a result for a task this worker actually holds releases a
+        # process slot: a zombie's stale result (its task was reclaimed
+        # and it re-registered) must not over-commit its pool.
+        if rec is not None and task_id in rec.inflight:
+            rec.inflight.discard(task_id)
+            rec.inflight_retries.pop(task_id, None)
+            if rec.num_processes == 0:
+                # draining worker: last in-flight result drops the record
+                if not rec.inflight:
+                    self.workers.pop(wid, None)
+                    self._refresh_fleet_procs()
+                    self.forget_worker_sender(wid)
+                return
+            rec.free_processes = min(
+                rec.free_processes + 1, rec.num_processes
+            )
+            if self.process_lb:
+                self.free_procs.append(wid)
+            else:
+                self._add_free(wid)
+
     def _send(self, wid: bytes, payload: bytes) -> None:
-        self.socket.send_multipart([wid, payload])
+        self.send_wire(wid, payload)  # one send point: base.send_wire
 
     def _serve_blob_miss(self, wid: bytes, rec: WorkerRecord, data: dict) -> None:
         """Answer a worker's payload-cache miss (same contract as
@@ -398,7 +425,22 @@ class PushDispatcher(TaskDispatcher):
         )
 
     def _dispatch_round(self) -> int:
-        """Hand out tasks while there is free capacity and pending work."""
+        """Hand out tasks while there is free capacity and pending work.
+        With batching on, a round's sends to each CAP_BATCH worker group
+        into one TASK_BATCH frame (flushed in the finally — a task is
+        tracked in its record's inflight set the moment it is buffered,
+        so the frame must reach the wire even on an outage abort)."""
+        sent = 0
+        task_frames: dict = {}
+        try:
+            sent = self._dispatch_round_inner(task_frames)
+        finally:
+            self.flush_task_frames(task_frames)
+        if self.process_lb:
+            random.shuffle(self.free_procs)  # reference :469-472
+        return sent
+
+    def _dispatch_round_inner(self, task_frames: dict) -> int:
         sent = 0
         while True:
             wid = self._pick_worker()
@@ -445,16 +487,7 @@ class PushDispatcher(TaskDispatcher):
                         self._add_free(wid, front=True)
                     continue
             self.note_dispatch(task)
-            self._send(
-                wid,
-                m.encode_for(
-                    m.CAP_BIN in rec.caps,
-                    m.TASK,
-                    **task.task_message_kwargs(
-                        blob=blob, trace=m.CAP_TRACE in rec.caps
-                    ),
-                ),
-            )
+            self.send_task_frame(task_frames, wid, rec.caps, task, blob)
             self.note_payload_sent(task, blob)
             self.traces.note(
                 task.task_id, "sent", count_dup=task.retries == 0
@@ -477,8 +510,6 @@ class PushDispatcher(TaskDispatcher):
             # dispatch), so re-adding would duplicate entries without bound.
             if not self.process_lb and rec.free_processes > 0:
                 self._add_free(wid)  # back of the LRU
-        if self.process_lb:
-            random.shuffle(self.free_procs)  # reference :469-472
         return sent
 
     def start(self, max_results: int | None = None) -> int:

@@ -83,6 +83,8 @@ class TpuPushDispatcher(TaskDispatcher):
         estimate_runtimes: bool = True,
         express: bool = False,
         inline_result_max: int | None = None,
+        batch_max: int = 0,
+        batch_window_ms: float = 0.0,
         tenant_shares: str | None = None,
         tenant_caps: str | None = None,
         max_tenants: int = 32,
@@ -130,6 +132,23 @@ class TpuPushDispatcher(TaskDispatcher):
             )
         elif inline_result_max is not None:
             self.inline_result_max = max(0, int(inline_result_max))
+        #: batched worker data plane (opt-in): >= 2 groups each tick's
+        #: assignments into ONE TASK_BATCH frame per CAP_BATCH worker
+        #: (reference-era workers keep the per-task wire verbatim), and
+        #: batch-negotiated workers coalesce their result drains into
+        #: RESULT_BATCH frames back. 0 (default) = the per-task wire
+        #: everywhere, byte-identical to the pre-batch build.
+        self.batch_max = max(0, int(batch_max))
+        #: adaptive micro-batching window for the EXPRESS sub-tick: an
+        #: announce-woken dispatch pass with a small ready set flushes
+        #: immediately (a solo task never waits), but under load —
+        #: ready set past _EXPRESS_FLUSH_DEPTH and still below batch_max —
+        #: it coalesces arrivals up to this many seconds so express
+        #: sub-ticks dispatch fuller bundles. 0 disables the hold (every
+        #: express wake ticks immediately, the PR-12 behavior).
+        self.batch_window_s = max(0.0, float(batch_window_ms) / 1000.0)
+        #: monotonic deadline of an armed coalescing hold (None = no hold)
+        self._express_hold_until: float | None = None
         # the estimation loop (sched/estimator.py): learned per-function
         # sizes stamp un-hinted tasks at batch build, learned per-worker
         # speeds feed SchedulerArrays.worker_speed — so the heterogeneous
@@ -881,58 +900,23 @@ class TpuPushDispatcher(TaskDispatcher):
             row = a.register(wid, 0)
             self._apply_learned_speed(wid, row)
             self.socket.send_multipart([wid, m.encode(m.RECONNECT)])
-            if msg_type not in (m.RECONNECT, m.RESULT):
+            if msg_type not in (m.RECONNECT, m.RESULT, m.RESULT_BATCH):
                 return
         if msg_type == m.RESULT:
-            task_id = data["task_id"]
             self.note_worker_misfires(wid, data)
-            self.note_result_message(task_id, data)
-            owner = a.inflight_owner(task_id)
-            from_owner = (
-                owner is not None
-                and owner in a.row_ids
-                and a.row_ids[owner] == wid
-            )
-            # suspicious = a second result is possible: sender is not the
-            # task's current owner (zombie after a reclaim), or the task was
-            # reclaimed at least once on its way to this worker
-            suspicious = not from_owner or task_id in self.task_retries
-            if self._result_batch is not None:
-                # batched drain (drain_results_batched): the terminal
-                # write joins one pipelined finish_task_many flush after
-                # the drain — first_wins rides each item, and intra-batch
-                # ordering matches the per-message writes it replaces
-                self._result_batch.append(
-                    (task_id, data["status"], data["result"], suspicious)
-                )
-            else:
-                self.record_result_safe(
-                    task_id, data["status"], data["result"],
-                    first_wins=suspicious,
-                )
-            self.n_results += 1
             a.heartbeat(wid)
-            # Only the current owner's result releases the in-flight slot:
-            # a zombie's late result must not pop the NEW owner's entry (that
-            # would leak one process of the new owner's capacity forever,
-            # since its own result would then find nothing to release).
-            if from_owner:
-                self.task_retries.pop(task_id, None)
-                self._tenant_task_done(task_id)
-                row = a.inflight_done(task_id)
-                if row is not None:
-                    a.release_slot(row)
-                    self._observe_result(wid, row, task_id, data)
-                    if (
-                        self.graph is not None
-                        and self.graph.has_waiting_children(task_id)
-                    ):
-                        # locality: this worker's payload cache now holds
-                        # the parent's function — its row is the waiting
-                        # children's preferred placement
-                        self._result_rows[task_id] = row
-            else:
-                self._task_digest.pop(task_id, None)
+            self._handle_result(wid, data)
+        elif msg_type == m.RESULT_BATCH:
+            # batched result lane: one frame, K results — each element
+            # runs the full per-task result path (ownership check,
+            # estimator, tenancy release, graph locality), and the
+            # terminal writes coalesce in the surrounding
+            # drain_results_batched flush exactly like K RESULT frames
+            self.note_worker_misfires(wid, data)
+            a.heartbeat(wid)
+            for item in data.get("results", ()):
+                if isinstance(item, dict) and "task_id" in item:
+                    self._handle_result(wid, item)
         elif msg_type == m.BLOB_MISS:
             # payload-plane resolution request: any message is liveness
             a.heartbeat(wid)
@@ -952,6 +936,61 @@ class TpuPushDispatcher(TaskDispatcher):
                 a.worker_free[row] = 0
                 a.worker_procs[row] = 0
                 self.log.info("worker row %d draining", int(row))
+
+    def _handle_result(self, wid: bytes, data: dict) -> None:
+        """One result's full per-task path (shared by RESULT frames and
+        RESULT_BATCH elements): timeline stamps, the terminal store write
+        (immediate, or joined to the drain's batched flush), in-flight
+        slot release gated on current ownership, estimator observation,
+        tenancy release, and graph locality bookkeeping."""
+        a = self.arrays
+        task_id = data["task_id"]
+        self.note_result_message(task_id, data)
+        owner = a.inflight_owner(task_id)
+        from_owner = (
+            owner is not None
+            and owner in a.row_ids
+            and a.row_ids[owner] == wid
+        )
+        # suspicious = a second result is possible: sender is not the
+        # task's current owner (zombie after a reclaim), or the task was
+        # reclaimed at least once on its way to this worker
+        suspicious = not from_owner or task_id in self.task_retries
+        if self._result_batch is not None:
+            # batched drain (drain_results_batched): the terminal
+            # write joins one pipelined finish_task_many flush after
+            # the drain — first_wins rides each item, and intra-batch
+            # ordering matches the per-message writes it replaces
+            self._result_batch.append(
+                (task_id, data["status"], data["result"], suspicious)
+            )
+        else:
+            self.record_result_safe(
+                task_id, data["status"], data["result"],
+                first_wins=suspicious,
+            )
+        self.n_results += 1
+        # Only the current owner's result releases the in-flight slot:
+        # a zombie's late result must not pop the NEW owner's entry (that
+        # would leak one process of the new owner's capacity forever,
+        # since its own result would then find nothing to release).
+        if from_owner:
+            self.task_retries.pop(task_id, None)
+            self._tenant_task_done(task_id)
+            row = a.inflight_done(task_id)
+            if row is not None:
+                a.release_slot(row)
+                self._observe_result(wid, row, task_id, data)
+                if (
+                    self.graph is not None
+                    and self.graph.has_waiting_children(task_id)
+                ):
+                    # locality: this worker's payload cache now holds
+                    # the parent's function — its row is the waiting
+                    # children's preferred placement
+                    self._result_rows[task_id] = row
+        else:
+            self._task_digest.pop(task_id, None)
 
     def drain_results_batched(self) -> int:
         """Bounded worker-message drain with the RESULT store writes
@@ -1103,6 +1142,12 @@ class TpuPushDispatcher(TaskDispatcher):
             # announces (0 = classic id-only announces)
             "express": self.express,
             "inline_result_max": self.inline_result_max,
+            # batched data plane: the knob, and frames actually put on the
+            # worker wire (frames/dispatched < 1 is bundling engaged;
+            # == 1 with batching off or an all-legacy fleet)
+            "batch_max": self.batch_max,
+            "batch_window_ms": round(self.batch_window_s * 1000.0, 3),
+            "task_frames": int(self.m_task_frames.value),
             "tasks_on_retry": len(self.task_retries),
             "device_tick": spans.get("device_tick", {}),
             # host data-plane phases (batched intake / act): spanned like
@@ -1291,6 +1336,10 @@ class TpuPushDispatcher(TaskDispatcher):
         #: after-send ordering per task, same degrade-on-outage contract
         #: as the per-task mark_running_safe it replaces
         running_batch: list[str] = []
+        #: per-worker TASK_BATCH buffers (batched data plane): drained by
+        #: the finally's flush_task_frames, so a task tracked in-flight is
+        #: guaranteed its frame even when a later exception aborts the tick
+        task_frames: dict = {}
         sent = 0
         # Exception safety: a store outage may raise anywhere below. The
         # finally-block reassembles the queue so no popped task is ever
@@ -1436,24 +1485,14 @@ class TpuPushDispatcher(TaskDispatcher):
                         restore_from = idx + 1
                         continue
                     self.note_dispatch(task)
-                    self.socket.send_multipart(
-                        [
-                            wid,
-                            m.encode_for(
-                                m.CAP_BIN in caps,
-                                m.TASK,
-                                **task.task_message_kwargs(
-                                    blob=blob, trace=m.CAP_TRACE in caps
-                                ),
-                            ),
-                        ]
-                    )
+                    self.send_task_frame(task_frames, wid, caps, task, blob)
                     self.note_payload_sent(task, blob)
                     self.traces.note(
                         task.task_id, "sent", count_dup=task.retries == 0
                     )
-                    # on the wire + tracked: must NOT be restored on an
-                    # outage
+                    # on the wire (or in a buffered frame the finally is
+                    # guaranteed to flush) + tracked: must NOT be restored
+                    # on an outage
                     restore_from = idx + 1
                     if task.retries:
                         # re-dispatch path: per-task, so the redispatch
@@ -1480,21 +1519,32 @@ class TpuPushDispatcher(TaskDispatcher):
                     still_pending.append(batch[i])
             raise  # start() logs + backs off
         finally:
-            # queue reassembly FIRST: the RUNNING flush below can itself
-            # raise (a non-outage store error reply — mark_running_many
-            # only swallows the outage family), and self.pending is still
-            # the empty placeholder until this line — flushing first would
-            # lose every requeued/still-pending/overflow task on that path
-            merged = PendingQueue(requeued)
-            merged.extend(still_pending)
-            merged.extend(overflow)
-            self.pending = merged
-            # coalesced RUNNING flush — in the finally so tasks already on
-            # the wire get their marks even if a later exception (zmq, not
-            # store: store reads can no longer raise inside the send loop)
-            # aborts the tick; degrades internally on an outage
-            self._batch_sizes["mark_running"] = len(running_batch)
-            self.mark_running_many(running_batch)
+            # buffered TASK_BATCH frames go on the wire FIRST: every
+            # buffered task is already tracked in-flight, so its frame
+            # must ship even when an exception aborted the send loop —
+            # but inside its own try/finally: queue reassembly is the
+            # no-task-ever-dropped invariant and must run even if a
+            # socket teardown makes the flush itself raise
+            try:
+                self.flush_task_frames(task_frames)
+            finally:
+                # queue reassembly next: the RUNNING flush below can
+                # itself raise (a non-outage store error reply —
+                # mark_running_many only swallows the outage family), and
+                # self.pending is still the empty placeholder until this
+                # line — flushing first would lose every requeued/
+                # still-pending/overflow task on that path
+                merged = PendingQueue(requeued)
+                merged.extend(still_pending)
+                merged.extend(overflow)
+                self.pending = merged
+                # coalesced RUNNING flush — in the finally so tasks
+                # already on the wire get their marks even if a later
+                # exception (zmq, not store: store reads can no longer
+                # raise inside the send loop) aborts the tick; degrades
+                # internally on an outage
+                self._batch_sizes["mark_running"] = len(running_batch)
+                self.mark_running_many(running_batch)
         return sent
 
     def _finished_probe(self, task_ids: list[str]) -> set[str]:
@@ -1805,6 +1855,7 @@ class TpuPushDispatcher(TaskDispatcher):
         # cancel probe can't be answered flows back instead of aborting
         # the loop; the batched RUNNING flush degrades internally) ----------
         running_batch: list[str] = []
+        task_frames: dict = {}
         try:
             with self.tracer.span("act"):
                 for task_id, row in res.placed:
@@ -1865,18 +1916,7 @@ class TpuPushDispatcher(TaskDispatcher):
                         undo(task, row)  # inflight table full: wait a tick
                         continue
                     self.note_dispatch(task)
-                    self.socket.send_multipart(
-                        [
-                            wid,
-                            m.encode_for(
-                                m.CAP_BIN in caps,
-                                m.TASK,
-                                **task.task_message_kwargs(
-                                    blob=blob, trace=m.CAP_TRACE in caps
-                                ),
-                            ),
-                        ]
-                    )
+                    self.send_task_frame(task_frames, wid, caps, task, blob)
                     self.note_payload_sent(task, blob)
                     self.traces.note(
                         task.task_id, "sent", count_dup=task.retries == 0
@@ -1894,11 +1934,65 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.m_dispatched.inc()
                     self._note_tenant_dispatch(task)
         finally:
-            # coalesced RUNNING flush, after every send (same contract as
-            # the batch tick's finally)
-            self._batch_sizes["mark_running"] = len(running_batch)
-            self.mark_running_many(running_batch)
+            # buffered TASK_BATCH frames first (tracked in-flight tasks
+            # must reach the wire), then the coalesced RUNNING flush,
+            # after every send (same contract as the batch tick's
+            # finally); nested so a raising flush can't skip the marks
+            try:
+                self.flush_task_frames(task_frames)
+            finally:
+                self._batch_sizes["mark_running"] = len(running_batch)
+                self.mark_running_many(running_batch)
         return sent
+
+    #: express ready-set size at or below which an announce-woken sub-tick
+    #: always dispatches immediately (a solo/near-solo task never waits out
+    #: a coalescing hold — the express lane's latency contract)
+    _EXPRESS_FLUSH_DEPTH = 3
+
+    def _express_gate(self, now: float, express_due: bool) -> tuple[bool, bool]:
+        """Adaptive micro-batching for the express sub-tick. Returns
+        (run_tick, intake_done).
+
+        Depth-triggered: with no batching window (or batching off) every
+        announce wake ticks immediately (the PR-12 behavior). With a
+        window, the wake drains intake first (cheap, and it clears the
+        announce fd), then: a small ready set flushes NOW — latency is
+        never traded away when idle; a ready set at/above batch_max
+        flushes NOW — the bundle is already full; anything in between
+        arms a hold of batch_window_s so streaming arrivals coalesce into
+        fuller TASK_BATCH frames, and the hold's expiry ticks even
+        without further announces."""
+        hold = self._express_hold_until
+        if not express_due:
+            if hold is not None and now >= hold:
+                self._express_hold_until = None
+                return True, False
+            return False, False
+        if self.batch_window_s <= 0 or self.batch_max < 2:
+            return True, False
+        try:
+            self._intake()
+        except STORE_OUTAGE_ERRORS as exc:
+            self.note_store_outage(exc, pause=0)
+            self._express_hold_until = None
+            return True, True  # degrade: tick now, intake already attempted
+        # the ready set is the HOST-pending work this sub-tick would
+        # dispatch — deliberately not the device-resident backlog: tasks
+        # parked on device across ticks (tenant-capped, capacity-starved)
+        # ride the periodic tick regardless, and counting them would make
+        # a genuinely solo arrival pay the coalescing window
+        depth = len(self.pending)
+        if depth <= self._EXPRESS_FLUSH_DEPTH or depth >= self.batch_max:
+            self._express_hold_until = None
+            return True, True
+        if hold is None:
+            self._express_hold_until = now + self.batch_window_s
+            return False, True
+        if now >= hold:
+            self._express_hold_until = None
+            return True, True
+        return False, True
 
     def _sync_announce_fds(self, registered: list[int]) -> None:
         """Express intake: keep the announce subscription's readability
@@ -1995,7 +2089,22 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.note_store_outage(exc)
                 if self.express:
                     self._sync_announce_fds(announce_fds)
-                events = dict(self.poller.poll(max(1, int(self.tick_period * 1000))))
+                # an armed coalescing hold shortens the park so its expiry
+                # fires on time instead of waiting out a full tick period
+                timeout_ms = max(1, int(self.tick_period * 1000))
+                if self._express_hold_until is not None:
+                    timeout_ms = max(
+                        1,
+                        min(
+                            timeout_ms,
+                            int(
+                                (self._express_hold_until - self.clock())
+                                * 1000
+                            )
+                            + 1,
+                        ),
+                    )
+                events = dict(self.poller.poll(timeout_ms))
                 if self.socket in events:
                     # bounded drain with coalesced result writes: a
                     # flooding worker must not starve the device tick, and
@@ -2006,14 +2115,26 @@ class TpuPushDispatcher(TaskDispatcher):
                 # dispatch pass NOW instead of waiting out the tick
                 # cadence (the device-step gate below still skips the
                 # device call when there is nothing to place or no
-                # capacity; intake always drains, which clears the fd)
+                # capacity; intake always drains, which clears the fd).
+                # With a batching window the sub-tick may HOLD briefly
+                # under load to coalesce arrivals (_express_gate).
                 express_due = bool(announce_fds) and any(
                     fd in events for fd in announce_fds
                 )
                 now = self.clock()
-                if now - last_tick >= self.tick_period or express_due:
+                period_due = now - last_tick >= self.tick_period
+                intaken = False
+                if not period_due:
+                    express_run, intaken = self._express_gate(
+                        now, express_due
+                    )
+                else:
+                    express_run = False
+                    self._express_hold_until = None
+                if period_due or express_run:
                     try:
-                        self._intake()
+                        if not intaken:
+                            self._intake()
                         # control messages must flow even when intake has
                         # no room (pending full); then relay force-cancels
                         # to the owning workers before placing

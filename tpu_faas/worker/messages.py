@@ -68,6 +68,23 @@ dispatcher -> worker:
                tpu_faas/obs/tracectx.py); the worker stamps it into its
                logs and echoes it on the matching RESULT. Reference-era
                workers never receive the field.
+    TASK_BATCH data: tasks: list — each element a full TASK ``data`` dict
+               (task_id/fn_payload-or-fn_digest/param_payload/timeout/
+               trace_id, exactly the per-task vocabulary above). ONE frame
+               carries a whole tick's assignments for this worker, sent
+               only to workers that advertised the "batch" capability and
+               only by dispatchers with batching enabled (``--batch-max``
+               >= 2) — everyone else keeps the per-task TASK contract
+               byte for byte. Per-task semantics (blob resolution,
+               parking, cancel, tracing) are element-wise identical to K
+               separate TASK frames.
+    RESULT_BATCH (worker -> dispatcher) data: results: list — each element
+               a full RESULT ``data`` dict (task_id/status/result/elapsed/
+               started_at/trace_id) — plus one top-level ``misfires``
+               total. A worker switches to this form only after RECEIVING
+               a TASK_BATCH (proof the dispatcher decodes it), the same
+               asymmetric negotiation as binary framing; a K-result drain
+               then costs one frame instead of K.
     BLOB_FILL  data: digest, data (the ASCII payload body) — answers a
                BLOB_MISS; ``missing=True`` (no data) when the blob is
                gone from the store too, telling the worker to FAIL the
@@ -97,6 +114,8 @@ READY = "ready"
 HEARTBEAT = "heartbeat"
 RECONNECT = "reconnect"
 TASK = "task"
+TASK_BATCH = "task_batch"
+RESULT_BATCH = "result_batch"
 WAIT = "wait"
 CANCEL = "cancel"
 BLOB_MISS = "blob_miss"
@@ -110,8 +129,15 @@ CAP_BIN = "bin"
 #: echoes it on the matching RESULT. Capability-gated like blob/bin so
 #: reference-era workers never see the field.
 CAP_TRACE = "trace"
+#: batched data plane: a batch-capable worker may receive TASK_BATCH
+#: frames (one frame per worker per tick) and, once it has seen one,
+#: coalesces its own result drain into RESULT_BATCH frames. Negotiated
+#: like blob/bin/trace, and additionally gated dispatcher-side on
+#: ``--batch-max`` — batching off means the per-task wire is untouched
+#: even between capable peers.
+CAP_BATCH = "batch"
 #: what a current-generation worker advertises
-WORKER_CAPS = (CAP_BLOB, CAP_BIN, CAP_TRACE)
+WORKER_CAPS = (CAP_BLOB, CAP_BIN, CAP_TRACE, CAP_BATCH)
 
 #: binary-frame magic: never a valid first byte of the ASCII contract
 #: (base64's alphabet is [A-Za-z0-9+/=]), so one-byte sniffing is exact

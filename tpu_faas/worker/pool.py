@@ -29,6 +29,7 @@ import multiprocessing as mp
 import os
 import queue
 import signal as _signal
+import threading
 from concurrent.futures import Future, ProcessPoolExecutor, wait
 from concurrent.futures.process import BrokenProcessPool
 
@@ -70,6 +71,26 @@ FN_CACHE_MISSES = REGISTRY.counter(
     "tpu_faas_worker_fn_cache_misses_total",
     "Digest-shipped TASKs that needed a BLOB_MISS/BLOB_FILL round",
 )
+
+#: Batched data plane (worker side): bundle sizes and pool IPC volume.
+#: ipc_total / tasks_total is the O(1)-pool-wakeups-per-bundle proof the
+#: bench asserts on — a K-task bundle pays ONE executor submit.
+BUNDLE_SIZE = REGISTRY.histogram(
+    "tpu_faas_worker_bundle_size",
+    "Tasks per pool submission (1 = the classic per-task path; larger "
+    "values are TASK_BATCH bundles executing K tasks on one pool IPC "
+    "message)",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
+)
+POOL_IPC = REGISTRY.counter(
+    "tpu_faas_worker_pool_ipc_total",
+    "Pool IPC submissions (executor round trips): a K-task bundle "
+    "counts 1, so ipc/tasks << 1 is the bundling win",
+)
+
+#: done-queue key marking a bundle future's completion (the payload is a
+#: list of ExecutionResults, one per member)
+_BUNDLE = object()
 
 #: child-side: the task id currently executing in THIS child (None between
 #: tasks) — consulted by the SIGUSR1 handler, plain memory only (a signal
@@ -159,6 +180,18 @@ def _run_reported(
     return res
 
 
+def _run_bundle(items) -> list[ExecutionResult]:
+    """Bundle form of _run_reported: K tasks ride ONE pool IPC message and
+    execute sequentially in this child — one wakeup, one result shipment,
+    and a repeated function pays its digest-cache lookup against a warm
+    entry for every element after the first. Each element keeps the full
+    per-task contract (own timeout arm, own cancel window, own start/end
+    events), so a mid-bundle force-cancel interrupts exactly the element
+    the parent's event mirror says is running. ``items`` is a list of
+    (task_id, ser_fn, ser_params, timeout, fn_digest) tuples."""
+    return [_run_reported(*item) for item in items]
+
+
 def _warm() -> None:
     """No-op run in each child to force its spawn (must be module-level to
     pickle)."""
@@ -178,6 +211,11 @@ class TaskPool:
         #: and which tasks a cancel was actually requested for
         self._futures: dict[str, Future] = {}
         self._args: dict[str, tuple[str, str, float | None, str | None]] = {}
+        #: bundle future -> member task ids (batched data plane): members
+        #: share ONE future, so cancel() must never fut.cancel() a bundle
+        #: (it would cancel the innocent siblings) — bundled pre-start
+        #: cancels ride the deferred-kill path instead
+        self._bundle_members: dict[Future, list[str]] = {}
         self._want_cancel: set[str] = set()
         #: cancels for tasks sitting in the executor's CALL QUEUE (future
         #: no longer .cancel()-able, child not started): the interrupt is
@@ -189,6 +227,19 @@ class TaskPool:
         #: RESULT messages and aggregated into dispatcher /stats, so
         #: doubled side effects are operator-visible without log scraping.
         self.n_misfires = 0
+        #: completion wakeup pipe: the done callback (executor thread)
+        #: pokes it so a serving loop parked in a poller wakes the moment
+        #: a result is ready instead of waiting out its poll timeout —
+        #: the worker-side analog of the dispatcher's event-driven intake.
+        #: Register ``wakeup_fd`` for POLLIN; drain() clears it.
+        self._wake_r, self._wake_w = os.pipe()
+        os.set_blocking(self._wake_r, False)
+        os.set_blocking(self._wake_w, False)
+        #: serializes done-callback pokes against close(): without it, a
+        #: callback that snapshotted the fd pre-close could write into a
+        #: since-reused descriptor number (one uncontended acquire per
+        #: POOL round trip, not per task — bundles amortize it too)
+        self._wake_lock = threading.Lock()
         self._executor = self._make()
 
     def _make(self) -> ProcessPoolExecutor:
@@ -201,6 +252,29 @@ class TaskPool:
             initializer=_child_init,
             initargs=(self._events,),
         )
+
+    @property
+    def wakeup_fd(self) -> int:
+        """Readable fd that becomes ready when a result lands in the done
+        queue (level-cleared by drain())."""
+        return self._wake_r
+
+    def _on_done(self, key, fut) -> None:
+        """Done callback (runs on an executor thread): enqueue + poke the
+        wakeup pipe. A full pipe is fine — the byte already in it wakes
+        the reader, which drains everything level-triggered. The poke
+        holds _wake_lock so it cannot race close(): a straggler callback
+        either sees the live fd (close hasn't started) or -1 (close won
+        the lock) — never a closed-and-reused descriptor number."""
+        self._done.put((key, fut))
+        with self._wake_lock:
+            w = self._wake_w
+            if w < 0:
+                return
+            try:
+                os.write(w, b"\0")
+            except (BlockingIOError, OSError):
+                pass
 
     def _drain_events(self) -> None:
         while not self._events.empty():
@@ -230,7 +304,8 @@ class TaskPool:
         repairs such misfires internally by resubmitting the wrongly
         interrupted task — see the module docstring."""
         fut = self._futures.get(task_id)
-        if fut is not None and fut.cancel():
+        bundled = fut is not None and fut in self._bundle_members
+        if fut is not None and not bundled and fut.cancel():
             # never handed to a child: the done-callback queues the
             # cancelled future and drain() reports terminal CANCELLED
             self._want_cancel.add(task_id)
@@ -298,10 +373,88 @@ class TaskPool:
                 _run_reported, task_id, fn_payload, param_payload, timeout,
                 fn_digest,
             )
-        fut.add_done_callback(lambda f, tid=task_id: self._done.put((tid, f)))
+        fut.add_done_callback(lambda f, tid=task_id: self._on_done(tid, f))
         self._futures[task_id] = fut
         self._args[task_id] = (fn_payload, param_payload, timeout, fn_digest)
         self._busy += 1
+        POOL_IPC.inc()
+        BUNDLE_SIZE.observe(1.0)
+
+    def submit_bundle(self, items) -> None:
+        """Submit K tasks as ONE pool IPC message (batched data plane):
+        ``items`` is a list of (task_id, fn_payload, param_payload,
+        timeout, fn_digest) tuples that execute sequentially in one child.
+        Every per-task semantic is preserved element-wise — own timeout,
+        own cancel window (deferred-kill interrupts exactly the running
+        element), own misfire repair — but the bundle costs one executor
+        round trip and one drain entry instead of K of each. A singleton
+        falls through to the classic submit."""
+        if not items:
+            return
+        if len(items) == 1:
+            self.submit(*items[0])
+            return
+        items = list(items)
+        try:
+            fut = self._executor.submit(_run_bundle, items)
+        except BrokenProcessPool:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = self._make()
+            fut = self._executor.submit(_run_bundle, items)
+        fut.add_done_callback(lambda f: self._on_done(_BUNDLE, f))
+        self._bundle_members[fut] = [it[0] for it in items]
+        for task_id, fn_payload, param_payload, timeout, fn_digest in items:
+            self._futures[task_id] = fut
+            self._args[task_id] = (
+                fn_payload, param_payload, timeout, fn_digest
+            )
+        self._busy += len(items)
+        POOL_IPC.inc()
+        BUNDLE_SIZE.observe(float(len(items)))
+
+    def _pop_member(self, task_id: str):
+        """Shared per-task bookkeeping pop as a result is drained: busy
+        slot, future/args maps, deferred-kill note. Returns (wanted,
+        args)."""
+        self._busy -= 1
+        self._futures.pop(task_id, None)
+        self._deferred_kill.discard(task_id)
+        args = self._args.pop(task_id, None)
+        wanted = task_id in self._want_cancel
+        self._want_cancel.discard(task_id)
+        return wanted, args
+
+    @staticmethod
+    def _terminal(task_id: str, status: TaskStatus, exc: BaseException) -> ExecutionResult:
+        """Synthesized terminal result for a task whose future never
+        produced one (pre-start cancel, rebuild-cancelled, dead child) —
+        ONE construction point so the per-task and bundle drain paths
+        cannot diverge."""
+        _TASKS_TOTAL.labels(status=str(status)).inc()
+        return ExecutionResult(task_id, str(status), serialize(exc))
+
+    def _deliver(
+        self, task_id: str, res: ExecutionResult, wanted: bool, args, out
+    ) -> None:
+        """Terminal-result delivery with misfire repair (shared by the
+        per-task and bundle drain paths): a CANCELLED result nobody asked
+        for is a misfired interrupt — resubmit instead of delivering."""
+        if (
+            res.status == str(TaskStatus.CANCELLED)
+            and not wanted
+            and args is not None
+        ):
+            log.warning(
+                "misfired cancel interrupt hit task %s; resubmitting it",
+                task_id,
+                extra=log_ctx(task_id=task_id),
+            )
+            self.n_misfires += 1
+            _MISFIRES_TOTAL.inc()
+            self.submit(task_id, *args)
+            return
+        _TASKS_TOTAL.labels(status=res.status).inc()
+        out.append(res)
 
     def drain(self) -> list[ExecutionResult]:
         """Non-blocking: collect all finished results. Force-cancel
@@ -312,30 +465,32 @@ class TaskPool:
         interrupted run reported nothing externally, so the re-execution
         is invisible to every consumer."""
         self._drain_events()  # keep the task->pid mirror bounded + fresh
+        r = self._wake_r
+        if r >= 0:
+            try:
+                while os.read(r, 4096):  # clear the wakeup pipe
+                    pass
+            except (BlockingIOError, OSError):
+                pass
         out: list[ExecutionResult] = []
         while True:
             try:
                 task_id, fut = self._done.get_nowait()
             except queue.Empty:
                 return out
-            self._busy -= 1
-            self._futures.pop(task_id, None)
-            self._deferred_kill.discard(task_id)
-            args = self._args.pop(task_id, None)
-            wanted = task_id in self._want_cancel
-            self._want_cancel.discard(task_id)
+            if task_id is _BUNDLE:
+                self._drain_bundle(fut, out)
+                continue
+            wanted, args = self._pop_member(task_id)
             if fut.cancelled():
                 if wanted:
                     # deliberate pre-start cancel: terminal CANCELLED
-                    _TASKS_TOTAL.labels(status=str(TaskStatus.CANCELLED)).inc()
                     out.append(
-                        ExecutionResult(
+                        self._terminal(
                             task_id,
-                            str(TaskStatus.CANCELLED),
-                            serialize(
-                                TaskCancelledInterrupt(
-                                    f"task {task_id} cancelled before start"
-                                )
+                            TaskStatus.CANCELLED,
+                            TaskCancelledInterrupt(
+                                f"task {task_id} cancelled before start"
                             ),
                         )
                     )
@@ -348,37 +503,81 @@ class TaskPool:
             else:
                 exc = fut.exception()
             if exc is None:
-                res: ExecutionResult = fut.result()
-                if (
-                    res.status == str(TaskStatus.CANCELLED)
-                    and not wanted
-                    and args is not None
-                ):
-                    # misfire: the interrupt landed on this task after its
-                    # child switched away from the intended one — re-run
-                    # it. Logged: this is the one at-least-once execution
-                    # in the system, and an operator chasing doubled side
-                    # effects needs the trace.
-                    log.warning(
-                        "misfired cancel interrupt hit task %s; "
-                        "resubmitting it", task_id,
-                        extra=log_ctx(task_id=task_id),
-                    )
-                    self.n_misfires += 1
-                    _MISFIRES_TOTAL.inc()
-                    self.submit(task_id, *args)
-                    continue
-                _TASKS_TOTAL.labels(status=res.status).inc()
-                out.append(res)
+                # misfire repair lives in _deliver: the one at-least-once
+                # execution in the system, logged + counted there
+                self._deliver(task_id, fut.result(), wanted, args, out)
             else:
-                _TASKS_TOTAL.labels(status=str(TaskStatus.FAILED)).inc()
                 out.append(
-                    ExecutionResult(
+                    self._terminal(
+                        task_id, TaskStatus.FAILED, RuntimeError(str(exc))
+                    )
+                )
+
+    def _drain_bundle(self, fut: Future, out: list[ExecutionResult]) -> None:
+        """Drain one completed bundle future into ``out``. The happy path
+        delivers each member through the shared misfire-repair gate; a
+        future-level failure (pool rebuild cancelled it, or a member
+        killed the child — the executor fails the WHOLE submission) fails
+        every member, exactly what K per-task futures sharing the dead
+        child's queue would have reported."""
+        members = self._bundle_members.pop(fut, [])
+        was_cancelled = fut.cancelled()
+        if was_cancelled:
+            exc: BaseException | None = RuntimeError(
+                "task cancelled: worker pool died and was rebuilt"
+            )
+        else:
+            exc = fut.exception()
+        if exc is None:
+            by_id = {res.task_id: res for res in fut.result()}
+            for task_id in members:
+                wanted, args = self._pop_member(task_id)
+                res = by_id.get(task_id)
+                if res is None:  # defensive: a child must answer every item
+                    res = ExecutionResult(
                         task_id,
                         str(TaskStatus.FAILED),
-                        serialize(RuntimeError(str(exc))),
+                        serialize(
+                            RuntimeError("bundle returned no result")
+                        ),
+                    )
+                self._deliver(task_id, res, wanted, args, out)
+        else:
+            for task_id in members:
+                wanted, _ = self._pop_member(task_id)
+                if was_cancelled and wanted:
+                    # per-task parity: a rebuild-cancelled future whose
+                    # member had a deliberate cancel pending reports
+                    # terminal CANCELLED, exactly like the single-task
+                    # drain's cancelled+wanted branch
+                    out.append(
+                        self._terminal(
+                            task_id,
+                            TaskStatus.CANCELLED,
+                            TaskCancelledInterrupt(
+                                f"task {task_id} cancelled before start"
+                            ),
+                        )
+                    )
+                    continue
+                out.append(
+                    self._terminal(
+                        task_id, TaskStatus.FAILED, RuntimeError(str(exc))
                     )
                 )
 
     def close(self) -> None:
         self._executor.shutdown(wait=False, cancel_futures=True)
+        # park-then-close UNDER the wake lock: a straggler done callback
+        # (the shutdown above does not wait) either ran its poke before
+        # we took the lock or sees -1 after — the descriptor is never
+        # closed (and possibly reused) under a callback's feet
+        with self._wake_lock:
+            r, w = self._wake_r, self._wake_w
+            self._wake_r = self._wake_w = -1
+            for fd in (r, w):
+                if fd >= 0:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass

@@ -87,6 +87,12 @@ class PushWorker:
         #: True once a binary frame arrived from the dispatcher — proof it
         #: decodes them; our own sends switch to binary from then on
         self._peer_bin = False
+        #: True once a TASK_BATCH frame arrived — proof the dispatcher
+        #: speaks the batched data plane; the result drain then coalesces
+        #: multi-result shipments into RESULT_BATCH frames (same
+        #: asymmetric negotiation as binary framing: advertising CAP_BATCH
+        #: alone never changes this worker's sends)
+        self._peer_batch = False
         self.pool = TaskPool(num_processes)
         self.ctx = zmq.Context.instance()
         self.socket = self.ctx.socket(zmq.DEALER)
@@ -94,6 +100,11 @@ class PushWorker:
         self.socket.connect(dispatcher_url)
         self.poller = zmq.Poller()
         self.poller.register(self.socket, zmq.POLLIN)
+        # pool-completion wakeup: a finished task pokes this fd, so the
+        # serving loop drains + ships results the moment they land instead
+        # of waiting out poll_timeout — the worker-side analog of the
+        # dispatcher's event-driven (express) intake
+        self.poller.register(self.pool.wakeup_fd, zmq.POLLIN)
         self._stopping = False
         self._draining = False
 
@@ -129,13 +140,20 @@ class PushWorker:
         )
 
     # -- payload plane -----------------------------------------------------
-    def _submit_task(self, data: dict, from_fill: bool = False) -> bool:
+    def _submit_task(
+        self, data: dict, from_fill: bool = False, collect: list | None = None
+    ) -> bool:
         """Resolve one TASK message's function body and put it on the
         pool. Digest-only tasks (payload plane) hit the parent cache; a
         miss parks the task and asks the dispatcher with BLOB_MISS —
         False means parked, not submitted. ``from_fill`` (the fill
         handler resubmitting a parked task) skips the hit/miss counters:
-        that resolution was already counted as its original miss."""
+        that resolution was already counted as its original miss.
+        ``collect`` (the TASK_BATCH path): a resolved task is appended as
+        a pool-submit tuple instead of submitted, so the caller can bundle
+        the whole batch into O(1) pool IPC messages — parking semantics
+        are unchanged (a parked task misses its bundle and rides a
+        classic submit when its fill lands)."""
         digest = data.get("fn_digest")
         trace_id = data.get("trace_id")
         if isinstance(trace_id, str) and trace_id:
@@ -163,6 +181,17 @@ class PushWorker:
             # later digest-only TASK (dispatcher upgraded mid-stream)
             # needs no fill round
             self.fn_cache.put(digest, payload)
+        if collect is not None:
+            collect.append(
+                (
+                    data["task_id"],
+                    payload,
+                    data["param_payload"],
+                    data.get("timeout"),
+                    digest,
+                )
+            )
+            return True
         self.pool.submit(
             data["task_id"],
             payload,
@@ -171,6 +200,50 @@ class PushWorker:
             fn_digest=digest,
         )
         return True
+
+    # -- batched data plane ------------------------------------------------
+    def _on_task_batch(self, data: dict) -> None:
+        """One TASK_BATCH frame: resolve every element (identical per-task
+        semantics — digest cache, BLOB_MISS parking, trace stamping), then
+        spread the ready set over the pool's free children as bundles, so
+        K tasks cost ~min(K, free) pool IPC messages instead of K.
+        Receiving this frame is also the negotiation proof that flips this
+        worker's own result drain to RESULT_BATCH framing."""
+        self._peer_batch = True
+        ready: list[tuple] = []
+        for item in data.get("tasks", ()):
+            if isinstance(item, dict) and "task_id" in item:
+                self._submit_task(item, collect=ready)
+        self._submit_bundles(ready)
+
+    #: floor on bundle size when chunking a TASK_BATCH across free pool
+    #: children: below this, per-task pool IPC dominates sub-ms execution
+    #: and splitting buys nothing — the dispatcher already bounds a frame
+    #: at the worker's free slots, so free-proportional chunking alone
+    #: would degenerate every frame into singletons
+    _MIN_BUNDLE = 4
+
+    def _submit_bundles(self, ready: list[tuple]) -> None:
+        """Chunk resolved tasks into bundles balancing the two costs:
+        bundling amortizes pool IPC (the batched plane's point), while
+        one huge bundle would serialize everything through a single child.
+        The batch splits into min(free_children, K // _MIN_BUNDLE)
+        contiguous bundles (at least one) — large frames still fan out
+        across children in >= _MIN_BUNDLE chunks, small frames ride one
+        bundle whose sequential execution is cheaper than per-task IPC.
+        Sequential-in-child is the deliberate tradeoff batching buys its
+        throughput with: for the sub-ms functions the plane targets, a
+        bundle's serial execution is noise next to the saved per-task
+        overhead, while long-running functions should keep --batch-max
+        off/small dispatcher-side (documented in OPERATIONS.md)."""
+        if not ready:
+            return
+        n_bundles = max(
+            1, min(self.pool.free, len(ready) // self._MIN_BUNDLE)
+        )
+        size = -(-len(ready) // n_bundles)  # ceil
+        for lo in range(0, len(ready), size):
+            self.pool.submit_bundle(ready[lo:lo + size])
 
     def _on_blob_fill(self, data: dict) -> None:
         digest = data.get("digest")
@@ -206,6 +279,52 @@ class PushWorker:
                 )
         # an empty fill (no data, no missing) means "store outage, retry":
         # the parked tasks stay and the resend timer re-asks
+
+    def _result_item(self, res) -> dict:
+        """One result's wire fields (shared by the per-task RESULT form
+        and the RESULT_BATCH elements)."""
+        item = {
+            "task_id": res.task_id,
+            "status": res.status,
+            "result": res.result,
+            "elapsed": res.elapsed,
+            "started_at": res.started_at,
+        }
+        trace_id = self._task_trace.pop(res.task_id, None)
+        if trace_id:
+            item["trace_id"] = trace_id
+        log.debug(
+            "shipped result %s", res.status,
+            extra=log_ctx(task_id=res.task_id, trace_id=trace_id),
+        )
+        return item
+
+    def _ship_results(self, results) -> int:
+        """Ship one drain's results: a multi-result drain toward a
+        batch-negotiated dispatcher coalesces into ONE RESULT_BATCH frame
+        (misfires total rides once at the top level); everything else —
+        single results, and every peer that never sent a TASK_BATCH —
+        keeps the per-task RESULT wire byte for byte."""
+        if not results:
+            return 0
+        if self._peer_batch and len(results) > 1:
+            self._send(
+                m.RESULT_BATCH,
+                results=[self._result_item(res) for res in results],
+                misfires=self.pool.n_misfires,
+            )
+        else:
+            for res in results:
+                # field order matches the historical per-task send exactly
+                # (trace_id last, after misfires): the serialized frame
+                # must stay byte-identical for non-batch peers
+                item = self._result_item(res)
+                trace_id = item.pop("trace_id", None)
+                item["misfires"] = self.pool.n_misfires
+                if trace_id:
+                    item["trace_id"] = trace_id
+                self._send(m.RESULT, **item)
+        return len(results)
 
     def _resend_stale_misses(self, now: float) -> None:
         for digest in list(self._awaiting):
@@ -264,6 +383,8 @@ class PushWorker:
                         if msg_type == m.TASK:
                             # no admission gate: dispatcher controls load
                             self._submit_task(data)
+                        elif msg_type == m.TASK_BATCH:
+                            self._on_task_batch(data)
                         elif msg_type == m.BLOB_FILL:
                             self._on_blob_fill(data)
                         elif msg_type == m.CANCEL:
@@ -290,28 +411,7 @@ class PushWorker:
                                 ephemeral=self.token_is_ephemeral,
                                 caps=list(self.caps),
                             )
-                for res in self.pool.drain():
-                    extra_kw: dict = {}
-                    trace_id = self._task_trace.pop(res.task_id, None)
-                    if trace_id:
-                        extra_kw["trace_id"] = trace_id
-                    self._send(
-                        m.RESULT,
-                        task_id=res.task_id,
-                        status=res.status,
-                        result=res.result,
-                        elapsed=res.elapsed,
-                        started_at=res.started_at,
-                        misfires=self.pool.n_misfires,
-                        **extra_kw,
-                    )
-                    log.debug(
-                        "shipped result %s", res.status,
-                        extra=log_ctx(
-                            task_id=res.task_id, trace_id=trace_id
-                        ),
-                    )
-                    shipped += 1
+                shipped += self._ship_results(self.pool.drain())
                 if max_tasks is not None and shipped >= max_tasks:
                     break
                 if deregistered and self.pool.busy == 0:
