@@ -93,6 +93,50 @@ def test_protocol_running_without_lease_warns(tmp_path):
     assert findings[0].severity == "warning"
 
 
+def test_protocol_hedge_dispatch_surface_clean(tmp_path):
+    """Speculation plane (tpu_faas/spec): the hedge replica's store
+    surface — declare_replica + a leased RUNNING mark + both results
+    through first-wins finish_task — is exactly the declared-redispatch
+    vocabulary the checker already proves. Zero new write paths means
+    zero new findings."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import FIELD_LEASE_AT, TaskStatus
+
+        def hedge(store, tid, stamp):
+            store.declare_replica(tid)
+            store.set_status(
+                tid, TaskStatus.RUNNING,
+                extra_fields={FIELD_LEASE_AT: stamp},
+            )
+            store.finish_task(tid, TaskStatus.COMPLETED, "r",
+                              first_wins=True)
+            store.finish_task(tid, TaskStatus.CANCELLED, "k",
+                              first_wins=True)
+        """,
+    )
+    assert hits(findings) == []
+
+
+def test_protocol_hedge_loser_kill_via_set_status_fires(tmp_path):
+    """The loser's CANCELLED must ride finish_task's first-wins contract
+    (frozen against the winner's record) — a raw terminal set_status
+    spelling of the kill would overwrite the winner and fires the
+    existing terminal-set-status rule."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        def bad_kill(store, tid):
+            store.declare_replica(tid)
+            store.set_status(tid, TaskStatus.CANCELLED)
+        """,
+    )
+    assert hits(findings) == [("protocol.terminal-set-status", 5)]
+
+
 def test_protocol_raw_status_write_and_publish_fire(tmp_path):
     findings = check(
         tmp_path,

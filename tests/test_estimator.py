@@ -747,3 +747,59 @@ def test_ungraded_regime_speeds_stay_prior():
     )
     counts = np.bincount(a[a >= 0], minlength=3)
     assert (counts == 2).all()  # pure process-balancing, no speed skew
+
+
+def test_loser_exec_window_never_grades_workers():
+    """Speculation-plane guard (tpu_faas/spec): a hedge LOSER's execution
+    window — a CANCELLED result, or any result arriving from a worker
+    that is not the task's current owner — must not move worker speed
+    grades. The mechanism lands with the dispatcher's result path
+    (_observe_result gates on COMPLETED + current ownership; hedge
+    resolution feeds only the WINNER's window); this test pins it
+    independently of the hedge machinery."""
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.store import MemoryStore
+    from tpu_faas.worker import messages as m
+
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=MemoryStore(),
+        max_workers=8, max_pending=32, max_inflight=64,
+        estimate_runtimes=True,
+    )
+    try:
+        a = disp.arrays
+        a.register(b"w0", 2)
+        a.register(b"w1", 2)
+        est = disp.estimator
+        # settle the size estimate so speed grading is armed
+        d = fn_digest("fn")
+        for _ in range(5):
+            est.observe(d, 1.0, "warm", param_digest="p", param_bytes=3)
+        speeds_before = dict(est._speed_est)
+
+        # a CANCELLED window from the task's own worker: never observed
+        disp.store.create_task("t-cancel", "fn", "p")
+        disp._task_digest["t-cancel"] = (d, fn_digest("p"), 3)
+        a.inflight_add("t-cancel", 0)
+        n0 = est.n_observations
+        disp._handle(
+            b"w0", m.RESULT,
+            {"task_id": "t-cancel", "status": "CANCELLED", "result": "x",
+             "elapsed": 123.0},
+        )
+        assert est.n_observations == n0
+
+        # a COMPLETED window from a NON-owner (zombie/loser): never
+        # observed either — only the current owner's window grades
+        disp.store.create_task("t-zombie", "fn", "p")
+        disp._task_digest["t-zombie"] = (d, fn_digest("p"), 3)
+        a.inflight_add("t-zombie", 0)  # owned by w0
+        disp._handle(
+            b"w1", m.RESULT,
+            {"task_id": "t-zombie", "status": "COMPLETED", "result": "y",
+             "elapsed": 456.0},
+        )
+        assert est.n_observations == n0
+        assert dict(est._speed_est) == speeds_before
+    finally:
+        disp.close()

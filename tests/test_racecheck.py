@@ -323,3 +323,80 @@ def test_keyed_create_via_setnx_is_observed_as_create():
     store.finish_task("t", "COMPLETED", "42")
     m.assert_clean(allow_warnings=True)
     assert m.errors == []
+
+
+# -- speculation plane: declared hedge replicas (tpu_faas/spec) --------------
+
+
+def test_declared_replica_second_running_is_clean():
+    """A hedge replica's second RUNNING mark rides expect_replica exactly
+    like a reclaim rides expect_redispatch: declared = clean, undeclared =
+    the double-dispatch warning this monitor exists to raise."""
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    m.expect_replica("t")
+    m.observe("d", "status", "t", {S: "RUNNING"})  # the replica's mark
+    m.assert_clean()
+
+
+def test_hedge_loser_cancelled_after_winner_is_warning_not_error():
+    """The loser's CANCEL-kill confirmation landing after the winner's
+    terminal write: with the replica declared, the monitor attributes it
+    (hedge-loser-cancelled, warning) instead of the generic repairable
+    cancel-after-finish — and it is never an error."""
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    m.expect_replica("t")
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    m.observe("d", "finish", "t", {S: "COMPLETED", R: "42"})  # winner
+    m.observe("d", "finish", "t", {S: "CANCELLED", R: "kill"})  # loser
+    assert not m.errors
+    assert [v.kind for v in m.warnings] == ["hedge-loser-cancelled"]
+
+
+def test_hedge_double_completion_with_different_result_stays_error():
+    """What 'the monitor proves no double-completion' means at runtime: a
+    declared replica does NOT license a second COMPLETED carrying a
+    different result — that is exactly the corruption first_wins exists
+    to prevent, and seeing it means some writer bypassed it."""
+    m = _mon()
+    m.observe("gw", "create", "t", {S: "QUEUED"})
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    m.expect_replica("t")
+    m.observe("d", "status", "t", {S: "RUNNING"})
+    m.observe("d", "finish", "t", {S: "COMPLETED", R: "42"})
+    m.observe("d", "finish", "t", {S: "COMPLETED", R: "43"})
+    assert [v.kind for v in m.errors] == ["terminal-overwrite"]
+
+
+def test_undeclared_hedge_loser_cancel_is_generic_warning():
+    """Without the declaration the same interleaving keeps its generic
+    classification — the hedge attribution never masks a real bug class."""
+    m = _mon()
+    _lifecycle(m)
+    m.observe("w", "finish", "t", {S: "CANCELLED", R: "x"})
+    assert [v.kind for v in m.warnings] == ["cancel-after-finish"]
+
+
+def test_racecheck_store_declares_replica_through():
+    """RaceCheckStore.declare_replica feeds the monitor AND the wrapped
+    store's (no-op) hook — the dispatcher's hedge path works identically
+    against monitored and bare stores."""
+    from tpu_faas.core.task import FIELD_LEASE_AT, TaskStatus
+
+    monitor = _mon()
+    store = RaceCheckStore(MemoryStore(), monitor, actor="d")
+    store.create_task("t", "f", "p")
+    store.set_status("t", TaskStatus.RUNNING,
+                     extra_fields={FIELD_LEASE_AT: "1.0"})
+    store.declare_replica("t")
+    store.set_status("t", TaskStatus.RUNNING,
+                     extra_fields={FIELD_LEASE_AT: "1.0"})
+    store.finish_task("t", TaskStatus.COMPLETED, "42", first_wins=True)
+    # the loser's first-wins write is frozen BEFORE any store write, so
+    # the monitor never even sees it — the record stands
+    store.finish_task("t", TaskStatus.CANCELLED, "x", first_wins=True)
+    assert store.get_status("t") == "COMPLETED"
+    monitor.assert_clean()

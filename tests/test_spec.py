@@ -1,0 +1,840 @@
+"""Speculation plane (tpu_faas/spec): device straggler scoring, anti-
+affinity fixup, hedge policy/book, dispatcher lifecycle (launch, first-wins
+resolution, loser kill + slot reclaim, promotion on original-worker death),
+resident XLA-vs-fused parity with spec state, byte-identity when off, and
+the full-stack e2e + chaos legs under the race monitor."""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_faas.core.task import FIELD_SPECULATIVE, TaskStatus
+from tpu_faas.dispatch.base import RECLAIM_FIELDS, PendingTask
+from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+from tpu_faas.spec import SpeculationPolicy
+from tpu_faas.spec.straggler import (
+    HEDGE_FIXUP_K,
+    anti_affinity_veto,
+    hedge_fixup,
+    straggler_flags,
+)
+from tpu_faas.store import MemoryStore
+from tpu_faas.store.launch import make_store
+from tpu_faas.worker import messages as m
+
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+# ---------------------------------------------------------------------------
+def test_straggler_flags_basic():
+    elapsed = jnp.asarray([5.0, 5.0, 0.1, 5.0], dtype=jnp.float32)
+    pred = jnp.asarray([1.0, 0.0, 1.0, 1.0], dtype=jnp.float32)
+    occupied = jnp.asarray([True, True, True, False])
+    flags = np.asarray(
+        straggler_flags(
+            elapsed, pred, occupied, jnp.float32(3.0), jnp.float32(0.05)
+        )
+    )
+    # slot 0: elapsed 5 > 3x1 -> flagged; slot 1: pred 0 opts out;
+    # slot 2: not past threshold; slot 3: unoccupied
+    assert flags.tolist() == [True, False, False, False]
+
+
+def test_straggler_min_runtime_floor():
+    """A tight prediction on a tiny task must not hedge on noise: the
+    absolute floor dominates mult x pred when pred is small."""
+    elapsed = jnp.asarray([0.04, 0.2], dtype=jnp.float32)
+    pred = jnp.asarray([0.01, 0.01], dtype=jnp.float32)
+    occupied = jnp.asarray([True, True])
+    flags = np.asarray(
+        straggler_flags(
+            elapsed, pred, occupied, jnp.float32(2.0), jnp.float32(0.05)
+        )
+    )
+    # 0.04 < floor 0.05 -> not flagged even though > 2 x 0.01
+    assert flags.tolist() == [False, True]
+
+
+def test_anti_affinity_veto_masks_only_forbidden_pairing():
+    assignment = jnp.asarray([0, 1, 2, -1], dtype=jnp.int32)
+    avoid = jnp.asarray([0, -1, 1, 2], dtype=jnp.int32)
+    out = np.asarray(anti_affinity_veto(assignment, avoid))
+    # task 0 hit its forbidden row -> vetoed; task 2 avoids row 1 but got
+    # row 2 -> untouched; unplaced stays unplaced
+    assert out.tolist() == [-1, 1, 2, -1]
+
+
+def test_hedge_fixup_replaces_on_fastest_other_worker():
+    # 3 workers; task 0 was placed on its forbidden row 0; rows 1 (slow)
+    # and 2 (fast) have capacity -> re-placed on row 2
+    assignment = jnp.asarray([0, -1], dtype=jnp.int32)
+    avoid = jnp.asarray([0, -1], dtype=jnp.int32)
+    speed = jnp.asarray([1.0, 0.5, 2.0], dtype=jnp.float32)
+    free = jnp.asarray([1, 1, 1], dtype=jnp.int32)
+    live = jnp.asarray([True, True, True])
+    out = np.asarray(hedge_fixup(assignment, avoid, speed, free, live))
+    assert out[0] == 2
+
+
+def test_hedge_fixup_no_capacity_elsewhere_stays_queued():
+    assignment = jnp.asarray([0], dtype=jnp.int32)
+    avoid = jnp.asarray([0], dtype=jnp.int32)
+    speed = jnp.asarray([1.0, 1.0], dtype=jnp.float32)
+    free = jnp.asarray([2, 0], dtype=jnp.int32)  # only the forbidden row
+    live = jnp.asarray([True, True])
+    out = np.asarray(hedge_fixup(assignment, avoid, speed, free, live))
+    assert out[0] == -1  # never onto the forbidden worker, never dropped
+
+
+def test_hedge_fixup_respects_remaining_capacity():
+    """Two vetoed ghosts, one free slot elsewhere: only one re-places (the
+    fixup's greedy loop consumes capacity as it assigns)."""
+    assignment = jnp.asarray([0, 0], dtype=jnp.int32)
+    avoid = jnp.asarray([0, 0], dtype=jnp.int32)
+    speed = jnp.asarray([1.0, 1.0], dtype=jnp.float32)
+    free = jnp.asarray([2, 1], dtype=jnp.int32)
+    live = jnp.asarray([True, True])
+    out = np.asarray(hedge_fixup(assignment, avoid, speed, free, live))
+    assert sorted(out.tolist()) == [-1, 1]
+    assert HEDGE_FIXUP_K >= 2  # the bound documented as "rarely binding"
+
+
+# ---------------------------------------------------------------------------
+# policy / hedge book
+# ---------------------------------------------------------------------------
+def test_policy_knob_validation():
+    with pytest.raises(ValueError):
+        SpeculationPolicy(1.0)  # mult must exceed 1
+    with pytest.raises(ValueError):
+        SpeculationPolicy(3.0, max_frac=0.0)
+
+
+def test_policy_budget_and_dup_gates():
+    p = SpeculationPolicy(3.0, max_frac=0.5)
+    assert p.consider("a", 0, n_dispatched=10) is not None
+    # one hedge outstanding for "a": a re-flag is ignored
+    assert p.consider("a", 0, n_dispatched=10) is None
+    assert p.n_launched == 1
+    # budget: 0.5 x 4 = 2 -> second hedge fits, third does not
+    assert p.consider("b", 1, n_dispatched=4) is not None
+    assert not p.within_budget(4)
+    assert p.consider("c", 1, n_dispatched=4) is None
+    assert p.n_suppressed_budget == 1
+
+
+def test_policy_resolution_and_loser_accounting():
+    p = SpeculationPolicy(3.0)
+    e = p.consider("a", 0, n_dispatched=100)
+    e.hedge_row = 1
+    p.resolve("a", winner="replica", loser_row=0)
+    assert p.n_replica_wins == 1 and "a" not in p.entries
+    # sender-checked: a duplicate from the WINNER's row (or an unknown
+    # sender) must not consume the entry or book waste
+    assert p.note_loser_result("a", 1, 9.9) is None
+    assert p.note_loser_result("a", None, 9.9) is None
+    # the loser's late result attributes its window once
+    assert p.note_loser_result("a", 0, 1.5) == 1.5
+    assert p.note_loser_result("a", 0, 1.5) is None  # consumed
+    assert p.wasted_exec_s == 1.5
+    # unknown ids are not losers
+    assert p.note_loser_result("zzz", 0, 1.0) is None
+
+
+def test_policy_abandon_and_promote_counters():
+    p = SpeculationPolicy(3.0)
+    p.consider("a", 0, n_dispatched=100)
+    p.consider("b", 0, n_dispatched=100)
+    assert p.abandon("a") is not None
+    assert p.promote("b") is not None
+    assert p.abandon("a") is None  # already gone
+    assert p.n_abandoned == 1 and p.n_promoted == 1
+    assert p.stats()["outstanding"] == 0
+
+
+# ---------------------------------------------------------------------------
+# resident parity: XLA vs fused, spec state carried
+# ---------------------------------------------------------------------------
+def _spec_resident(backend, clock):
+    from tpu_faas.sched.resident import ResidentScheduler
+
+    return ResidentScheduler(
+        max_workers=4, max_pending=16, max_inflight=32, max_slots=2,
+        time_to_expire=100.0, clock=clock, use_priority=True,
+        tick_backend=backend, spec_mult=2.0, spec_min_s=0.01,
+    )
+
+
+def _drive_spec_script(backend):
+    """One deterministic script: dispatch, stamp pred, advance time past
+    the threshold, hedge with anti-affinity — returns the observables."""
+    t = [0.0]
+    a = _spec_resident(backend, lambda: t[0])
+    a.register(b"w0", 2)
+    a.register(b"w1", 2)
+    a.pending_add("t0", 1.0)
+    a.tick_resident()
+    r = a.resolve_next()
+    placed1 = list(r.placed)
+    for tid, row in r.placed:
+        a.inflight_add(tid, row, pred=0.1)
+    t[0] += 1.0
+    a.tick_resident()
+    r = a.resolve_next()
+    assert not r.straggler_slots  # stamp applies this tick; elapsed 0
+    t[0] += 5.0
+    a.tick_resident()
+    r = a.resolve_next()
+    flagged = list(r.straggler_slots)
+    orig_row = int(a.inflight_worker[flagged[0]]) if flagged else -1
+    a.pending_add("t0", 1.0, avoid=orig_row)
+    a.tick_resident()
+    r2 = a.resolve_next()
+    hedge_placed = list(r2.placed)
+    return placed1, flagged, orig_row, hedge_placed
+
+
+def test_resident_spec_parity_xla_vs_fused_interpret():
+    from tpu_faas.sched.pallas_fused import fused_ok
+
+    xla = _drive_spec_script("xla")
+    assert xla[1], "XLA tick flagged no straggler"
+    # the hedge placed, and not on the original's row
+    assert xla[3] and all(row != xla[2] for _, row in xla[3])
+    if not fused_ok():
+        pytest.skip("pallas unavailable")
+    fused = _drive_spec_script("fused_interpret")
+    assert fused == xla
+
+
+def test_resident_spec_off_packet_unchanged():
+    """Speculation off = the resident packet (the wire between host and
+    device, and the multihost broadcast buffer) is byte-identical to the
+    pre-speculation layout: no avoid lane, no pred lane, no spec tail."""
+    from tpu_faas.sched.resident import ResidentScheduler
+
+    off = ResidentScheduler(
+        max_workers=4, max_pending=16, max_inflight=32, max_slots=2,
+        use_priority=True,
+    )
+    expected = (
+        9  # header
+        + off.KA * 2  # sizes + priority lanes
+        + 2 * (off.KH + off.KF + off.KI + off.KS + off.KB)
+    )
+    assert off.packet_len() == expected
+    assert off.KG == 1  # straggler output collapsed to its pad
+    on = ResidentScheduler(
+        max_workers=4, max_pending=16, max_inflight=32, max_slots=2,
+        use_priority=True, spec_mult=2.0,
+    )
+    assert on.packet_len() == expected + on.KA + on.KI + 2
+
+
+def test_batch_tick_spec_off_has_no_straggler_output():
+    from tpu_faas.sched.state import SchedulerArrays
+
+    a = SchedulerArrays(max_workers=4, max_pending=8, max_inflight=16)
+    a.register(b"w0", 2)
+    out = a.tick(np.asarray([1.0], dtype=np.float32))
+    assert out.straggler is None
+
+
+def test_batch_tick_dead_worker_redispatches_never_flags():
+    """The straggler and redispatch sets are disjoint: a dead worker's
+    slot rides the reclaim plane, not the hedge plane."""
+    from tpu_faas.sched.state import SchedulerArrays
+
+    t = [100.0]
+    a = SchedulerArrays(
+        max_workers=4, max_pending=8, max_inflight=16,
+        time_to_expire=5.0, clock=lambda: t[0],
+    )
+    a.spec_mult = 2.0
+    a.spec_min_s = 0.01
+    a.register(b"w0", 2)
+    a.register(b"w1", 2)
+    a.tick(np.zeros(0, dtype=np.float32))  # seed prev_live
+    a.inflight_add("x", 0, pred=0.1)
+    t[0] += 100.0  # far past both the straggler threshold AND the hb TTL
+    out = a.tick(np.zeros(0, dtype=np.float32))
+    redis = np.asarray(out.redispatch)
+    flags = np.asarray(out.straggler)
+    assert redis[0] and not flags[0]
+
+
+# ---------------------------------------------------------------------------
+# dispatcher lifecycle units (fake worker rows, no sockets)
+# ---------------------------------------------------------------------------
+def _spec_dispatcher(clock, store=None, **kw):
+    defaults = dict(
+        ip="127.0.0.1", port=0, store=store or MemoryStore(),
+        max_workers=8, max_pending=64, max_inflight=128, max_slots=2,
+        tick_period=0.01, time_to_expire=1000.0, clock=clock,
+        estimate_runtimes=False, speculate_mult=3.0,
+        # single-task unit scenarios: the wasted-work budget must admit a
+        # hedge with one dispatch on the books (the budget gate itself is
+        # covered by test_budget_suppression_is_counted)
+        speculate_max_frac=1.0, speculate_min_s=0.05,
+    )
+    defaults.update(kw)
+    return TpuPushDispatcher(**defaults)
+
+
+def _seed_speculative_task(disp, tid="task-1", cost=0.1):
+    disp.store.create_task(
+        tid, "fnp", "pp",
+        extra_fields={FIELD_SPECULATIVE: "1", "cost": repr(cost)},
+    )
+    disp.pending.append(
+        PendingTask(tid, "fnp", "pp", cost=cost, speculative=True)
+    )
+
+
+def _run_hedge_to_dispatched(disp, t, tid="task-1"):
+    """Drive the batch dispatcher until the hedge replica is on the
+    (fake) wire; returns the entry."""
+    disp.tick(intake=False)  # dispatches the original
+    assert disp.arrays.inflight_owner(tid) is not None
+    t[0] += 0.5
+    disp.tick(intake=False)  # no flag yet? pred=0.1 mult=3 -> 0.3 < 0.5 ok
+    # the flag may land on this or the next tick depending on stamps;
+    # iterate a couple of periods
+    for _ in range(3):
+        if disp.spec.entries.get(tid):
+            break
+        t[0] += 0.5
+        disp.tick(intake=False)
+    assert tid in disp.spec.entries, "straggler never flagged"
+    # next tick places the ghost with anti-affinity and dispatches it
+    disp.tick(intake=False)
+    entry = disp.spec.entries[tid]
+    assert entry.dispatched
+    assert entry.hedge_row != entry.orig_row
+    return entry
+
+
+def test_dispatcher_hedges_straggler_and_replica_wins():
+    t = [0.0]
+    disp = _spec_dispatcher(lambda: t[0])
+    try:
+        a = disp.arrays
+        r0 = a.register(b"w0", 2)
+        r1 = a.register(b"w1", 2)
+        _seed_speculative_task(disp)
+        entry = _run_hedge_to_dispatched(disp, t)
+        orig_row = entry.orig_row
+        hedge_row = entry.hedge_row
+        free_before = int(a.worker_free[orig_row])
+        # replica's result arrives first -> replica wins, loser killed
+        hedge_wid = a.row_ids[hedge_row]
+        disp._handle(
+            hedge_wid, m.RESULT,
+            {"task_id": "task-1", "status": "COMPLETED", "result": "42",
+             "elapsed": 0.05},
+        )
+        assert disp.spec.n_replica_wins == 1
+        assert "task-1" not in disp.spec.entries
+        assert a.inflight_owner("task-1") is None  # original's slot freed
+        assert int(a.worker_free[orig_row]) == free_before + 1
+        assert int(a.worker_free[hedge_row]) == 2  # replica slot back
+        assert disp.store.get_status("task-1") == "COMPLETED"
+        # the loser's late CANCELLED result: frozen write, waste counted
+        orig_wid = a.row_ids[orig_row]
+        disp._handle(
+            orig_wid, m.RESULT,
+            {"task_id": "task-1", "status": "CANCELLED", "result": "x",
+             "elapsed": 1.2},
+        )
+        assert disp.store.get_status("task-1") == "COMPLETED"  # first wins
+        assert disp.spec.wasted_exec_s == pytest.approx(1.2)
+        assert int(a.worker_free[orig_row]) == free_before + 1  # no double
+        assert r0 != r1  # sanity: two distinct rows existed
+    finally:
+        disp.close()
+
+
+def test_dispatcher_original_wins_and_replica_is_killed():
+    t = [0.0]
+    disp = _spec_dispatcher(lambda: t[0])
+    try:
+        a = disp.arrays
+        a.register(b"w0", 2)
+        a.register(b"w1", 2)
+        _seed_speculative_task(disp)
+        entry = _run_hedge_to_dispatched(disp, t)
+        orig_wid = a.row_ids[entry.orig_row]
+        hedge_row = entry.hedge_row
+        disp._handle(
+            orig_wid, m.RESULT,
+            {"task_id": "task-1", "status": "COMPLETED", "result": "7",
+             "elapsed": 2.0},
+        )
+        assert disp.spec.n_original_wins == 1
+        assert a.inflight_owner("task-1") is None
+        assert int(a.worker_free[hedge_row]) == 2  # replica slot reclaimed
+        assert disp.store.get_status("task-1") == "COMPLETED"
+        # replica's late result is a frozen no-op and counted as waste
+        disp._handle(
+            a.row_ids[hedge_row], m.RESULT,
+            {"task_id": "task-1", "status": "CANCELLED", "result": "x",
+             "elapsed": 0.3},
+        )
+        assert disp.spec.wasted_exec_s == pytest.approx(0.3)
+    finally:
+        disp.close()
+
+
+def test_dispatcher_promotes_replica_when_original_worker_dies():
+    t = [0.0]
+    disp = _spec_dispatcher(lambda: t[0], time_to_expire=5.0)
+    try:
+        a = disp.arrays
+        a.register(b"w0", 2)
+        a.register(b"w1", 2)
+        _seed_speculative_task(disp)
+        entry = _run_hedge_to_dispatched(disp, t)
+        orig_row, hedge_row = entry.orig_row, entry.hedge_row
+        hedge_wid = a.row_ids[hedge_row]
+        # only the hedge's worker keeps heartbeating; the original's dies
+        for _ in range(4):
+            t[0] += 2.0
+            a.heartbeat(hedge_wid)
+            disp.tick(intake=False)
+        assert disp.spec.n_promoted == 1
+        assert "task-1" not in disp.spec.entries
+        # the replica IS the owner now: its result completes the task
+        assert a.inflight_owner("task-1") == hedge_row
+        disp._handle(
+            hedge_wid, m.RESULT,
+            {"task_id": "task-1", "status": "COMPLETED", "result": "9",
+             "elapsed": 0.1},
+        )
+        assert disp.store.get_status("task-1") == "COMPLETED"
+        assert a.inflight_owner("task-1") is None
+        assert int(a.worker_free[hedge_row]) == 2
+        assert orig_row not in a.row_ids  # purged
+    finally:
+        disp.close()
+
+
+def test_dispatcher_abandons_hedge_when_its_worker_dies():
+    t = [0.0]
+    disp = _spec_dispatcher(lambda: t[0], time_to_expire=5.0)
+    try:
+        a = disp.arrays
+        a.register(b"w0", 2)
+        a.register(b"w1", 2)
+        _seed_speculative_task(disp)
+        entry = _run_hedge_to_dispatched(disp, t)
+        orig_wid = a.row_ids[entry.orig_row]
+        # only the ORIGINAL's worker keeps heartbeating
+        for _ in range(4):
+            t[0] += 2.0
+            a.heartbeat(orig_wid)
+            disp.tick(intake=False)
+        assert disp.spec.n_abandoned == 1
+        # the still-straggling original may legitimately be RE-hedged —
+        # but with no capacity off its own worker the new ghost can
+        # never dispatch (anti-affinity holds it queued)
+        e = disp.spec.entries.get("task-1")
+        assert e is None or not e.dispatched
+        # the original still owns the task and completes it normally
+        assert a.inflight_owner("task-1") == entry.orig_row
+        disp._handle(
+            orig_wid, m.RESULT,
+            {"task_id": "task-1", "status": "COMPLETED", "result": "1",
+             "elapsed": 3.0},
+        )
+        assert disp.store.get_status("task-1") == "COMPLETED"
+    finally:
+        disp.close()
+
+
+def test_non_speculative_task_never_hedges():
+    t = [0.0]
+    disp = _spec_dispatcher(lambda: t[0])
+    try:
+        a = disp.arrays
+        a.register(b"w0", 2)
+        a.register(b"w1", 2)
+        disp.store.create_task("plain", "fnp", "pp",
+                               extra_fields={"cost": repr(0.1)})
+        disp.pending.append(PendingTask("plain", "fnp", "pp", cost=0.1))
+        disp.tick(intake=False)
+        for _ in range(4):
+            t[0] += 2.0
+            disp.tick(intake=False)
+        assert disp.spec.n_launched == 0
+        assert not disp.spec.entries
+    finally:
+        disp.close()
+
+
+def test_budget_suppression_is_counted():
+    t = [0.0]
+    disp = _spec_dispatcher(lambda: t[0], speculate_max_frac=0.01)
+    try:
+        a = disp.arrays
+        a.register(b"w0", 2)
+        a.register(b"w1", 2)
+        _seed_speculative_task(disp)
+        disp.tick(intake=False)
+        for _ in range(4):
+            t[0] += 2.0
+            disp.tick(intake=False)
+        # 1 task dispatched, budget 0.01 -> a single hedge never fits
+        assert disp.spec.n_launched == 0
+        assert disp.spec.n_suppressed_budget > 0
+        assert disp.stats()["speculation"]["suppressed_budget"] > 0
+    finally:
+        disp.close()
+
+
+def test_estimator_graded_by_winner_only():
+    """The replica's (winner's) exec window grades its worker; the
+    loser's CANCELLED window must not move any grade (satellite pinned
+    independently in test_estimator.py; this is the dispatcher-level
+    integration)."""
+    t = [0.0]
+    disp = _spec_dispatcher(lambda: t[0], estimate_runtimes=True)
+    try:
+        a = disp.arrays
+        a.register(b"w0", 2)
+        a.register(b"w1", 2)
+        _seed_speculative_task(disp)
+        entry = _run_hedge_to_dispatched(disp, t)
+        hedge_wid = a.row_ids[entry.hedge_row]
+        orig_wid = a.row_ids[entry.orig_row]
+        disp._handle(
+            hedge_wid, m.RESULT,
+            {"task_id": "task-1", "status": "COMPLETED", "result": "42",
+             "elapsed": 0.05},
+        )
+        n_after_win = disp.estimator.n_observations
+        assert n_after_win >= 1  # winner observed
+        disp._handle(
+            orig_wid, m.RESULT,
+            {"task_id": "task-1", "status": "CANCELLED", "result": "x",
+             "elapsed": 9.9},
+        )
+        assert disp.estimator.n_observations == n_after_win  # loser not
+    finally:
+        disp.close()
+
+
+def test_spec_off_is_inert_everywhere():
+    """No --speculate-mult = None policy, no spec metrics families, no
+    straggler lanes in the tick, stats block None — the plane costs
+    nothing and changes nothing."""
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1", port=0, store=MemoryStore(),
+        max_workers=8, max_pending=64, max_inflight=128,
+        estimate_runtimes=False,
+    )
+    try:
+        assert disp.spec is None
+        assert disp.stats()["speculation"] is None
+        assert disp.arrays.spec_mult is None
+        assert not hasattr(disp, "m_hedges")
+        assert "tpu_faas_dispatcher_hedges_total" not in disp.render_metrics()
+    finally:
+        disp.close()
+
+
+def test_mesh_and_multihost_refuse_speculation():
+    with pytest.raises(ValueError, match="single-device"):
+        TpuPushDispatcher(
+            ip="127.0.0.1", port=0, store=MemoryStore(),
+            mesh_devices=2, speculate_mult=3.0,
+        )
+
+
+def test_reclaim_fields_carry_the_speculative_flag():
+    assert FIELD_SPECULATIVE in RECLAIM_FIELDS
+    pt = PendingTask.from_fields(
+        "t", {"fn_payload": "f", "param_payload": "p",
+              FIELD_SPECULATIVE: "1"},
+    )
+    assert pt.speculative
+    pt2 = PendingTask.from_fields(
+        "t", {"fn_payload": "f", "param_payload": "p"},
+    )
+    assert not pt2.speculative
+
+
+# ---------------------------------------------------------------------------
+# gateway / SDK surface
+# ---------------------------------------------------------------------------
+def test_gateway_hint_parse_speculative():
+    from tpu_faas.gateway.app import _parse_hints
+
+    assert FIELD_SPECULATIVE not in _parse_hints(None, None)
+    assert FIELD_SPECULATIVE not in _parse_hints(
+        None, None, speculative=False
+    )
+    assert _parse_hints(None, None, speculative=True)[
+        FIELD_SPECULATIVE
+    ] == "1"
+    with pytest.raises(ValueError, match="speculative"):
+        _parse_hints(None, None, speculative="yes")
+
+
+def test_gateway_safety_poll_knob_and_counter():
+    from tpu_faas.gateway.app import make_app, CTX_KEY
+
+    app = make_app(MemoryStore(), wait_safety_poll_s=5.0)
+    ctx = app[CTX_KEY]
+    assert ctx.wait_safety_poll_s == 5.0
+    ctx.m_safety_poll.inc()
+    from tpu_faas.obs.metrics import render
+
+    text = render([ctx.metrics])
+    assert "tpu_faas_gateway_safety_poll_served_total 1" in text
+
+
+# ---------------------------------------------------------------------------
+# full-stack e2e + chaos (real store server, gateway, workers)
+# ---------------------------------------------------------------------------
+def _spawn_push_worker(url, delay=None):
+    from tests.test_workers_e2e import _GroupPopen
+    from tpu_faas.bench.harness import REPO, cpu_worker_env
+
+    env = cpu_worker_env()
+    if delay:
+        env["TPU_FAAS_EXEC_DELAY_S"] = str(delay)
+    # _GroupPopen: a SIGKILL must reap the worker's forkserver/resource-
+    # tracker children too (group kill), or chaos tests leak them
+    return _GroupPopen(
+        [sys.executable, "-m", "tpu_faas.worker.push_worker", "2", url,
+         "--hb", "--hb-period", "0.3"],
+        env=env, cwd=REPO, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _spec_stack(monitor, speculate=True, time_to_expire=2.0):
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import start_store_thread
+    from tpu_faas.store.racecheck import RaceCheckStore
+
+    handle = start_store_thread()
+    gw = start_gateway_thread(
+        RaceCheckStore(make_store(handle.url), monitor, actor="gateway")
+    )
+    kw = dict(
+        ip="127.0.0.1", port=0,
+        store=RaceCheckStore(
+            make_store(handle.url), monitor, actor="dispatcher"
+        ),
+        max_workers=64, max_pending=256, max_inflight=512, max_slots=2,
+        tick_period=0.01, time_to_expire=time_to_expire,
+        estimate_runtimes=False,
+    )
+    if speculate:
+        kw.update(
+            speculate_mult=3.0, speculate_max_frac=0.5,
+            speculate_min_s=0.05,
+        )
+    disp = TpuPushDispatcher(**kw)
+    thread = threading.Thread(target=disp.start, daemon=True)
+    thread.start()
+    return handle, gw, disp, thread
+
+
+def test_e2e_hedge_replica_wins_under_race_monitor():
+    """Full stack, one sick worker (3 s exec delay): speculative tasks
+    that land on it are hedged and complete fast via the replica; slot
+    accounting converges; zero race-monitor errors."""
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.store.racecheck import RaceMonitor
+    from tpu_faas.workloads import straggler_sleep
+
+    monitor = RaceMonitor()
+    handle, gw, disp, thread = _spec_stack(monitor, time_to_expire=5.0)
+    url = f"tcp://127.0.0.1:{disp.port}"
+    slow = _spawn_push_worker(url, delay=3.0)
+    fast = _spawn_push_worker(url)
+    try:
+        time.sleep(1.5)
+        c = FaaSClient(gw.url)
+        fid = c.register_payload(
+            "straggler_sleep", serialize(straggler_sleep)
+        )
+        for h in c.submit_many(fid, [(((0.01,), {}))] * 4):  # warm pools
+            h.result(timeout=60)
+        handles = [
+            c.submit_with(fid, (0.05,), cost=0.05, speculative=True)
+            for _ in range(8)
+        ]
+        t0 = time.monotonic()
+        results = [h.result(timeout=120) for h in handles]
+        elapsed = time.monotonic() - t0
+        assert results == [0.05] * 8
+        # the hedges carried the slow worker's victims: far under the
+        # 3 s the sick worker would have cost
+        assert elapsed < 2.5, f"hedging did not beat the straggler ({elapsed:.2f}s)"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and disp.spec.entries:
+            time.sleep(0.05)
+        assert disp.spec.n_launched >= 1
+        assert disp.spec.n_replica_wins >= 1
+        assert not disp.spec.entries
+        assert disp.arrays.n_inflight == 0
+        assert not monitor.errors, [str(v) for v in monitor.errors]
+        # hedge metrics on the rendered scrape
+        text = disp.render_metrics()
+        assert 'tpu_faas_dispatcher_hedges_total{outcome="launched"}' in text
+    finally:
+        for w in (slow, fast):
+            w.kill()
+            w.wait()
+        disp.stop()
+        thread.join(timeout=10)
+        gw.stop()
+        handle.stop()
+
+
+def test_e2e_chaos_sigkill_original_mid_hedge_zero_loss():
+    """The chaos story: SIGKILL the worker running the ORIGINALS while
+    hedges are outstanding — every admitted task still completes (via the
+    replicas or promotion), zero race-monitor errors."""
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.store.racecheck import RaceMonitor
+    from tpu_faas.workloads import straggler_sleep
+
+    monitor = RaceMonitor()
+    handle, gw, disp, thread = _spec_stack(monitor, time_to_expire=2.0)
+    url = f"tcp://127.0.0.1:{disp.port}"
+    slow = _spawn_push_worker(url, delay=8.0)
+    fast = _spawn_push_worker(url)
+    try:
+        time.sleep(1.5)
+        c = FaaSClient(gw.url)
+        fid = c.register_payload(
+            "straggler_sleep", serialize(straggler_sleep)
+        )
+        for h in c.submit_many(fid, [(((0.01,), {}))] * 4):
+            h.result(timeout=60)
+        handles = [
+            c.submit_with(fid, (0.05,), cost=0.05, speculative=True)
+            for _ in range(8)
+        ]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and disp.spec.n_launched == 0:
+            time.sleep(0.02)
+        assert disp.spec.n_launched > 0, "no hedge launched before kill"
+        slow.kill()
+        slow.wait()
+        results = [h.result(timeout=120) for h in handles]
+        assert results == [0.05] * 8  # zero admitted-task loss
+        assert not monitor.errors, [str(v) for v in monitor.errors]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and disp.arrays.n_inflight:
+            time.sleep(0.05)
+        assert disp.arrays.n_inflight == 0
+    finally:
+        for w in (slow, fast):
+            if w.poll() is None:
+                w.kill()
+                w.wait()
+        disp.stop()
+        thread.join(timeout=10)
+        gw.stop()
+        handle.stop()
+
+
+def test_resident_ghost_slot_swaps_back_to_reclaimed_original():
+    """Review regression: in resident mode a hedge GHOST occupying
+    _resident_tasks must not make the move loop drop the REAL task when
+    its reclaimed original comes back around — the ghost's device slot
+    becomes the re-dispatch vehicle (payload swapped), not a silent
+    drop that strands the task until lease adoption."""
+    t = [0.0]
+    disp = _spec_dispatcher(
+        lambda: t[0], resident=True, time_to_expire=5.0,
+    )
+    try:
+        a = disp.arrays
+        a.register(b"w0", 2)
+        _seed_speculative_task(disp)
+        disp.tick(intake=False)  # original dispatched to w0
+        assert a.inflight_owner("task-1") is not None
+        # flag the straggler; the ghost queues but can NEVER place (the
+        # only live worker is the forbidden one)
+        for _ in range(4):
+            t[0] += 0.5
+            a.heartbeat(b"w0")
+            disp.tick(intake=False)
+        assert "task-1" in disp.spec.entries
+        assert not disp.spec.entries["task-1"].dispatched
+        # the ghost now holds the task id in the device pending set
+        assert disp._resident_tasks.get("task-1") is not None
+        assert disp._resident_tasks["task-1"].is_hedge
+        # original's worker dies: reclaim abandons the hedge and
+        # re-queues the REAL task, which must displace the ghost
+        for _ in range(4):
+            t[0] += 2.0
+            disp.tick(intake=False)
+        assert "task-1" not in disp.spec.entries
+        occ = disp._resident_tasks.get("task-1")
+        assert occ is not None and not occ.is_hedge, (
+            "reclaimed original was dropped in favor of a dead ghost"
+        )
+        # a replacement worker appears: the task dispatches to it
+        a.register(b"w1", 2)
+        for _ in range(3):
+            t[0] += 0.2
+            disp.tick(intake=False)
+        owner = a.inflight_owner("task-1")
+        assert owner is not None and a.row_ids[owner] == b"w1"
+    finally:
+        disp.close()
+
+
+def test_promoted_replica_result_rides_first_wins():
+    """Review regression: a purged-but-alive zombie original can still
+    ship a result after its replica was promoted — the promoted
+    replica's own write must ride first-wins so it can never overwrite
+    the terminal record a client may already have consumed."""
+    t = [0.0]
+    disp = _spec_dispatcher(lambda: t[0], time_to_expire=5.0)
+    try:
+        a = disp.arrays
+        a.register(b"w0", 2)
+        a.register(b"w1", 2)
+        _seed_speculative_task(disp)
+        entry = _run_hedge_to_dispatched(disp, t)
+        hedge_wid = a.row_ids[entry.hedge_row]
+        orig_wid = a.row_ids[entry.orig_row]
+        for _ in range(4):  # purge the (stalled, not dead) original
+            t[0] += 2.0
+            a.heartbeat(hedge_wid)
+            disp.tick(intake=False)
+        assert disp.spec.n_promoted == 1
+        # the zombie wakes up and ships its result FIRST
+        disp._handle(
+            orig_wid, m.RESULT,
+            {"task_id": "task-1", "status": "COMPLETED",
+             "result": "zombie", "elapsed": 9.0},
+        )
+        assert disp.store.hget("task-1", "result") == "zombie"
+        # the promoted replica's later result must NOT overwrite it
+        disp._handle(
+            hedge_wid, m.RESULT,
+            {"task_id": "task-1", "status": "COMPLETED",
+             "result": "replica", "elapsed": 0.1},
+        )
+        assert disp.store.hget("task-1", "result") == "zombie"
+        assert disp.store.get_status("task-1") == "COMPLETED"
+    finally:
+        disp.close()

@@ -27,7 +27,8 @@ def main(argv: list[str] | None = None) -> None:
         "15 (tick-latency trajectory: fused vs XLA tick), "
         "16 (tenant fairness: isolation + weighted shares), "
         "17 (batched data plane: TASK_BATCH/bundles vs per-task wire), "
-        "or 'all'",
+        "18 (tail hedging: straggler speculation vs an injected sick "
+        "worker), or 'all'",
     )
     ap.add_argument(
         "-m", "--mode", default="push",

@@ -2930,6 +2930,359 @@ def config_17_batched_plane() -> dict:
     }
 
 
+def _tail_spawn_worker(n_procs: int, url: str, delay_s: float | None):
+    """One push-worker subprocess; ``delay_s`` injects the deterministic
+    sick-worker behavior (workloads.straggler_sleep reads the env in the
+    worker's pool children)."""
+    import subprocess
+    import sys as _sys
+
+    from tpu_faas.bench.harness import REPO, cpu_worker_env
+
+    env = cpu_worker_env()
+    if delay_s:
+        env["TPU_FAAS_EXEC_DELAY_S"] = str(delay_s)
+    return subprocess.Popen(
+        [_sys.executable, "-m", "tpu_faas.worker.push_worker",
+         str(n_procs), url, "--hb", "--hb-period", "0.3"],
+        env=env, cwd=REPO, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _tail_stack(
+    n_workers: int,
+    n_procs: int,
+    slow_s: float,
+    speculate: bool,
+    monitor=None,
+    time_to_expire: float = 3.0,
+):
+    """Full real stack for one tail leg: store server, gateway, tpu-push
+    (speculation per flag), N real push-worker subprocesses with worker 0
+    carrying ``slow_s`` of injected per-execution delay. ``monitor``
+    wraps every store handle under the race monitor (chaos leg)."""
+    import threading as _threading
+
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+
+    def wrap(actor):
+        s = make_store(handle.url)
+        if monitor is None:
+            return s
+        from tpu_faas.store.racecheck import RaceCheckStore
+
+        return RaceCheckStore(s, monitor, actor=actor)
+
+    handle = start_store_thread()
+    gw = start_gateway_thread(wrap("gateway"), admission=False)
+    kw: dict = {}
+    if speculate:
+        kw = dict(
+            speculate_mult=3.0,
+            speculate_max_frac=0.3,
+            speculate_min_s=0.02,
+        )
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=wrap("dispatcher"),
+        # modest padded shapes: the spec scan re-runs the device tick at
+        # hedge granularity while work is in flight, and this lane's
+        # boxes are small — an oversized padded tick would bill the
+        # measurement for compute the shape never uses
+        max_workers=max(16, n_workers),
+        max_pending=512,
+        max_inflight=1024,
+        max_slots=n_procs,
+        tick_period=0.005,
+        time_to_expire=time_to_expire,
+        # the estimator would LEARN the sick worker's speed and re-derive
+        # the prediction; the lane pins the prediction to the client cost
+        # hint so the injected delay is the one variable measured
+        estimate_runtimes=False,
+        **kw,
+    )
+    disp_thread = _threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _tail_spawn_worker(n_procs, url, slow_s if i == 0 else None)
+        for i in range(n_workers)
+    ]
+    return gw, disp, disp_thread, workers, handle
+
+
+def _tail_teardown(gw, disp, disp_thread, workers, handle) -> None:
+    import os as _os
+    import signal as _signal
+
+    for w in workers:
+        if w.poll() is None:
+            try:
+                _os.killpg(w.pid, _signal.SIGKILL)  # pool children too
+            except (ProcessLookupError, PermissionError):
+                w.kill()
+            w.wait()
+    disp.stop()
+    disp_thread.join(timeout=10)
+    gw.stop()
+    handle.stop()
+
+
+def _tail_scrapes(gw, disp) -> dict:
+    """Strict-grammar /metrics scrapes from every serving process (the
+    speculation families required on hedged dispatchers)."""
+    import requests as _requests
+
+    from tpu_faas.obs.expofmt import parse_exposition, require_series
+
+    out: dict = {"scrape_ok": True, "missing": [], "error": ""}
+    try:
+        srv = disp.serve_stats(0)
+        port = srv.server_address[1]
+        fams = parse_exposition(
+            _requests.get(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).text
+        )
+        need = ["tpu_faas_dispatcher_tasks_dispatched_total"]
+        if disp.spec is not None:
+            need += [
+                "tpu_faas_dispatcher_hedges_total",
+                "tpu_faas_dispatcher_hedge_loser_exec_seconds_total",
+            ]
+        out["missing"] = require_series(fams, need)
+        gfams = parse_exposition(
+            _requests.get(f"{gw.url}/metrics", timeout=10).text
+        )
+        out["missing"] += require_series(
+            gfams, ["tpu_faas_gateway_safety_poll_served_total"]
+        )
+        out["scrape_ok"] = not out["missing"]
+    except Exception as exc:
+        out["scrape_ok"] = False
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
+def _tail_leg(
+    n_tasks: int,
+    n_workers: int,
+    n_procs: int,
+    task_s: float,
+    slow_s: float,
+    speculate: bool,
+) -> dict:
+    """One tail-latency measurement: open-loop batch of speculative tasks
+    with cost hints against the injected-straggler fleet; per-task
+    latency = batch submit -> that task's terminal delivery (one waiter
+    thread per handle, so serial polling can't skew the tail)."""
+    import threading as _threading
+
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.workloads import straggler_sleep
+
+    gw, disp, disp_thread, workers, handle = _tail_stack(
+        n_workers, n_procs, slow_s, speculate
+    )
+    try:
+        time.sleep(1.5)  # workers register
+        c = FaaSClient(gw.url)
+        fid = c.register_payload(
+            "straggler_sleep", serialize(straggler_sleep)
+        )
+        # warmup outside the window: pool spawn + first dill decode on
+        # every worker (incl. the slow one — its delay is paid here once)
+        warm = c.submit_many(fid, [(((0.001,), {}))] * (n_workers * n_procs))
+        for h in warm:
+            h.result(timeout=120.0)
+        handles = c.submit_many(
+            fid,
+            [(((task_s,), {}))] * n_tasks,
+            costs=[task_s] * n_tasks,
+            speculative=True,
+        )
+        t0 = time.perf_counter()
+        # inf sentinel: a lost/errored task must push the tail to
+        # infinity, never contribute a flattering 0.0 to the percentiles
+        lat = [float("inf")] * n_tasks
+        errs: list[str] = []
+
+        def waiter(i, h):
+            try:
+                h.result(timeout=300.0)
+                lat[i] = time.perf_counter() - t0
+            except Exception as exc:  # loss shows as an error, not a hang
+                errs.append(f"{h.task_id}: {type(exc).__name__}")
+
+        threads = [
+            _threading.Thread(target=waiter, args=(i, h), daemon=True)
+            for i, h in enumerate(handles)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=310.0)
+        arr = np.asarray(lat)
+        spec = disp.stats()["speculation"]
+        row = {
+            "leg": "hedged" if speculate else "unhedged",
+            "tasks": n_tasks,
+            "completed": n_tasks - len(errs),
+            "errors": errs,
+            "run_s": round(float(arr.max()), 3) if len(arr) else None,
+            "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 1),
+            "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 1),
+            "p999_ms": round(float(np.percentile(arr, 99.9)) * 1e3, 1),
+            "mean_ms": round(float(arr.mean()) * 1e3, 1),
+            "speculation": spec,
+        }
+        if spec is not None:
+            row["wasted_work_frac"] = round(
+                spec["launched"] / max(n_tasks, 1), 4
+            )
+            row["loser_exec_s"] = spec["wasted_exec_s"]
+        row.update(_tail_scrapes(gw, disp))
+        return row
+    finally:
+        _tail_teardown(gw, disp, disp_thread, workers, handle)
+
+
+def _tail_chaos_leg(
+    n_tasks: int, n_workers: int, n_procs: int, task_s: float
+) -> dict:
+    """SIGKILL the worker running the ORIGINALS mid-hedge, under the race
+    monitor: every admitted task must complete (replica first-wins, or
+    promotion on the purge) with zero monitor errors."""
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.store.racecheck import RaceMonitor
+    from tpu_faas.workloads import straggler_sleep
+
+    monitor = RaceMonitor()
+    gw, disp, disp_thread, workers, handle = _tail_stack(
+        n_workers, n_procs, 30.0, True, monitor=monitor,
+        time_to_expire=2.0,
+    )
+    try:
+        time.sleep(1.5)
+        c = FaaSClient(gw.url)
+        fid = c.register_payload(
+            "straggler_sleep", serialize(straggler_sleep)
+        )
+        # warm only the HEALTHY workers (tiny batch; the sick one's 30 s
+        # delay must not gate the leg — its victims are the point)
+        for h in c.submit_many(fid, [(((0.001,), {}))] * 2):
+            h.result(timeout=120.0)
+        handles = c.submit_many(
+            fid,
+            [(((task_s,), {}))] * n_tasks,
+            costs=[task_s] * n_tasks,
+            speculative=True,
+        )
+        deadline = time.monotonic() + 60.0
+        while (
+            time.monotonic() < deadline
+            and disp.spec is not None
+            and disp.spec.n_launched == 0
+        ):
+            time.sleep(0.02)
+        hedges_at_kill = disp.spec.n_launched
+        import os as _os
+        import signal as _signal
+
+        try:
+            _os.killpg(workers[0].pid, _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            workers[0].kill()
+        workers[0].wait()
+        completed = 0
+        errs: list[str] = []
+        for h in handles:
+            try:
+                h.result(timeout=300.0)
+                completed += 1
+            except Exception as exc:
+                errs.append(f"{h.task_id}: {type(exc).__name__}")
+        row = {
+            "leg": "chaos-kill-original",
+            "tasks": n_tasks,
+            "completed": completed,
+            "errors": errs,
+            "hedges_at_kill": hedges_at_kill,
+            "speculation": disp.stats()["speculation"],
+            "monitor_errors": [str(v) for v in monitor.errors],
+            "monitor_warnings": len(monitor.warnings),
+            "zero_loss": completed == n_tasks,
+            "race_clean": not monitor.errors,
+        }
+        row.update(_tail_scrapes(gw, disp))
+        return row
+    finally:
+        _tail_teardown(gw, disp, disp_thread, workers, handle)
+
+
+def config_18_tail_hedging() -> dict:
+    """Tail-hedging lane (config 18, tpu_faas/spec): the speculation
+    plane's promise measured on the full real stack — store server,
+    gateway, tpu-push with --speculate-mult, real push-worker
+    subprocesses with ONE deterministically sick worker (every execution
+    there pays an injected delay; workloads.straggler_sleep).
+
+    - **hedged vs unhedged**: an open-loop batch of speculative tasks
+      with cost hints; the sick worker's victims own p99/p999 unhedged,
+      and the hedged leg's replicas must beat them >= 1.5x at a
+      wasted-work fraction (hedges launched / tasks) <= 0.3;
+    - **chaos**: SIGKILL the worker running the ORIGINALS mid-hedge under
+      the race monitor — 100% of admitted tasks complete, zero monitor
+      errors.
+
+    Shape via TPU_FAAS_BENCH_TAIL_SHAPE="tasks,workers,procs,task_ms,
+    slow_ms" (default "48,4,2,40,1500");
+    TPU_FAAS_BENCH_TAIL_CHAOS=0 skips the chaos leg."""
+    import os
+
+    shape = os.environ.get("TPU_FAAS_BENCH_TAIL_SHAPE", "48,4,2,40,1500")
+    n_tasks, n_workers, n_procs, task_ms, slow_ms = (
+        int(x) for x in shape.split(",")
+    )
+    task_s, slow_s = task_ms / 1e3, slow_ms / 1e3
+    row: dict = {
+        "config": "tail-hedging",
+        "shape": {
+            "tasks": n_tasks,
+            "workers": n_workers,
+            "procs": n_procs,
+            "task_ms": task_ms,
+            "slow_ms": slow_ms,
+        },
+        "host_cores": os.cpu_count(),
+        "unhedged": _tail_leg(
+            n_tasks, n_workers, n_procs, task_s, slow_s, False
+        ),
+        "hedged": _tail_leg(
+            n_tasks, n_workers, n_procs, task_s, slow_s, True
+        ),
+    }
+    hp99 = row["hedged"]["p99_ms"]
+    row["p99_ratio_unhedged_over_hedged"] = (
+        round(row["unhedged"]["p99_ms"] / hp99, 3) if hp99 else None
+    )
+    hp999 = row["hedged"]["p999_ms"]
+    row["p999_ratio_unhedged_over_hedged"] = (
+        round(row["unhedged"]["p999_ms"] / hp999, 3) if hp999 else None
+    )
+    if os.environ.get("TPU_FAAS_BENCH_TAIL_CHAOS", "1") != "0":
+        row["chaos"] = _tail_chaos_leg(
+            max(8, n_tasks // 4), n_workers, n_procs, task_s
+        )
+    return row
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -2948,4 +3301,5 @@ CONFIGS = {
     "15": config_15_tick_trajectory,
     "16": config_16_tenant_fairness,
     "17": config_17_batched_plane,
+    "18": config_18_tail_hedging,
 }

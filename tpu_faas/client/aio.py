@@ -278,6 +278,7 @@ class AsyncFaaSClient:
         timeout: float | None = None,
         idempotency_key: str | None = None,
         deadline: float | None = None,
+        speculative: bool = False,
     ) -> AsyncTaskHandle:
         """submit() plus scheduling hints (mirrors the sync SDK): higher
         ``priority`` is admitted first under overload; ``cost`` is the
@@ -287,7 +288,9 @@ class AsyncFaaSClient:
         terminal EXPIRED, result() raises TaskExpiredError);
         ``idempotency_key`` makes the submit safely retryable (a re-send
         addresses the same task instead of running it twice; auto-minted
-        unless auto_idempotency=False)."""
+        unless auto_idempotency=False); ``speculative`` declares the task
+        IDEMPOTENT and hedge-eligible (tpu_faas/spec) — only set it for
+        functions safe to execute more than once."""
         loop = asyncio.get_running_loop()
         payload = await loop.run_in_executor(
             None, lambda: pack_params(*args, **(kwargs or {}))
@@ -301,6 +304,8 @@ class AsyncFaaSClient:
             body["timeout"] = timeout
         if deadline is not None:
             body["deadline"] = deadline
+        if speculative:
+            body["speculative"] = True
         if self.trace:
             body["trace_id"] = new_trace_id()
         if idempotency_key is None and self.auto_idempotency:
@@ -326,6 +331,7 @@ class AsyncFaaSClient:
         timeouts: list[float] | None = None,
         idempotency_keys: list[str | None] | None = None,
         deadlines: list[float] | None = None,
+        speculative: bool = False,
     ) -> list[AsyncTaskHandle]:
         # dill-packing thousands of payloads inline would stall the event
         # loop (and every concurrently polling handle) — do it in a worker
@@ -346,6 +352,8 @@ class AsyncFaaSClient:
             body["timeouts"] = timeouts
         if deadlines is not None:
             body["deadlines"] = deadlines
+        if speculative:
+            body["speculative"] = True
         if idempotency_keys is None and self.auto_idempotency:
             idempotency_keys = [uuid.uuid4().hex for _ in params_list]
         if idempotency_keys is not None:

@@ -362,6 +362,7 @@ class FaaSClient:
         deadline: float | None = None,
         trace_id: str | None = None,
         parent_span: str | None = None,
+        speculative: bool = False,
     ) -> str:
         return self._execute(
             function_id,
@@ -373,6 +374,7 @@ class FaaSClient:
             deadline=deadline,
             trace_id=trace_id,
             parent_span=parent_span,
+            speculative=speculative,
         )["task_id"]
 
     def _execute(
@@ -386,6 +388,7 @@ class FaaSClient:
         deadline: float | None = None,
         trace_id: str | None = None,
         parent_span: str | None = None,
+        speculative: bool = False,
     ) -> dict:
         """One submit; returns the gateway's parsed response body (the
         handle constructors read ``trace_id`` off it — present only when
@@ -400,6 +403,8 @@ class FaaSClient:
             body["timeout"] = timeout
         if deadline is not None:
             body["deadline"] = deadline
+        if speculative:
+            body["speculative"] = True
         if trace_id is None and self.trace:
             trace_id = new_trace_id()
         if trace_id is not None:
@@ -510,6 +515,7 @@ class FaaSClient:
         timeout: float | None = None,
         idempotency_key: str | None = None,
         deadline: float | None = None,
+        speculative: bool = False,
     ) -> TaskHandle:
         """submit() plus scheduling hints. The hints can't ride submit()
         itself — its **kwargs belong to the remote function — so args/kwargs
@@ -525,7 +531,11 @@ class FaaSClient:
         client-chosen string making this submit safely retryable — a
         re-send (lost response, impatient caller) addresses the SAME task
         instead of running it twice (auto-minted per submit unless
-        auto_idempotency=False)."""
+        auto_idempotency=False); ``speculative``: declares the task IDEMPOTENT
+        and hedge-eligible — a dispatcher running --speculate-mult may race a
+        replica against a straggling execution (tpu_faas/spec; exactly one
+        result is ever delivered, the store's first-wins write arbitrates).
+        Only set it for functions safe to execute more than once."""
         payload = pack_params(*args, **(kwargs or {}))
         body = self._execute(
             function_id,
@@ -535,6 +545,7 @@ class FaaSClient:
             timeout=timeout,
             idempotency_key=idempotency_key,
             deadline=deadline,
+            speculative=speculative,
         )
         return TaskHandle(self, body["task_id"], body.get("trace_id"))
 
@@ -547,6 +558,7 @@ class FaaSClient:
         timeouts: list[float] | None = None,
         idempotency_keys: list[str | None] | None = None,
         deadlines: list[float] | None = None,
+        speculative: bool = False,
     ) -> list[TaskHandle]:
         """Batch submit over ONE HTTP call (+ one pipelined store round
         trip): ``params_list`` holds (args, kwargs) pairs. N single submits
@@ -570,6 +582,10 @@ class FaaSClient:
             body["timeouts"] = timeouts
         if deadlines is not None:
             body["deadlines"] = deadlines
+        if speculative:
+            # one flag for the whole batch: the idempotency promise is
+            # per-call (tpu_faas/spec hedge eligibility)
+            body["speculative"] = True
         if idempotency_keys is None and self.auto_idempotency:
             idempotency_keys = [uuid.uuid4().hex for _ in params_list]
         if idempotency_keys is not None:

@@ -93,6 +93,13 @@ FIELD_TIMEOUT = "timeout"  # float as str; execution budget enforced in-child
 #: the wire (not the relative TTL) so the decision survives dispatcher
 #: restarts and re-announces without re-deriving the submit time.
 FIELD_DEADLINE = "deadline"
+#: Speculative-execution opt-in ("1" when set; tpu_faas/spec): the client
+#: declares this task safe to execute more than once (idempotent side
+#: effects), so a dispatcher running with ``--speculate-mult`` may hedge a
+#: straggling execution with a replica on a second worker — the store's
+#: first-wins finish_task arbitrates, the loser is killed via the CANCEL
+#: plane. Absent (every legacy producer) = never hedged.
+FIELD_SPECULATIVE = "speculative"
 #: Content address (sha256 hex, core/payload.py) of the task's serialized
 #: function, written by a payload-plane gateway in place of an inline
 #: FIELD_FN body: the bytes live ONCE under the store's ``blob:<digest>``

@@ -255,6 +255,27 @@ def main(argv: list[str] | None = None) -> None:
         help="tpu-push: tenant-table capacity (a compiled-tick static); "
         "distinct tenant names past it account to the default bucket",
     )
+    ap.add_argument(
+        "--speculate-mult", type=float, default=None, metavar="M",
+        help="tpu-push: turn on the speculation plane (tpu_faas/spec) — "
+        "an in-flight execution of a speculative=true task that outlives "
+        "M x its predicted runtime is hedged with a replica on a "
+        "DIFFERENT worker; the store's first-wins result write decides "
+        "the race and the loser is CANCEL-killed. Must be > 1. Single-"
+        "device feature (refused with --mesh/--multihost)",
+    )
+    ap.add_argument(
+        "--speculate-max-frac", type=float, default=0.1, metavar="F",
+        help="tpu-push: hard wasted-work budget — hedges launched never "
+        "exceed F x tasks dispatched (suppressions are counted in "
+        "tpu_faas_dispatcher_hedges_total{outcome='suppressed_budget'})",
+    )
+    ap.add_argument(
+        "--speculate-min-s", type=float, default=0.05, metavar="S",
+        help="tpu-push: absolute floor — an execution under S seconds is "
+        "never flagged however tight its prediction (scheduling jitter "
+        "on tiny tasks must not hedge)",
+    )
     ns = ap.parse_args(argv)
     if ns.delay:
         time.sleep(ns.delay)
@@ -428,6 +449,9 @@ def main(argv: list[str] | None = None) -> None:
             tenant_shares=ns.tenant_shares,
             tenant_caps=ns.tenant_caps,
             max_tenants=ns.max_tenants,
+            speculate_mult=ns.speculate_mult,
+            speculate_max_frac=ns.speculate_max_frac,
+            speculate_min_s=ns.speculate_min_s,
         )
     if ns.mode == "tpu-push" and ns.multihost:
         # Lead-side failure containment: once the followers joined the

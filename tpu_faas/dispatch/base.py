@@ -42,6 +42,7 @@ from tpu_faas.core.task import (
     FIELD_PARAMS,
     FIELD_PRIORITY,
     FIELD_RECLAIMS,
+    FIELD_SPECULATIVE,
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
     FIELD_TENANT,
@@ -95,6 +96,9 @@ RECLAIM_FIELDS = [
     # a reclaimed task keeps its tenant accounting (tpu_faas/tenancy): the
     # re-dispatch must charge the same share bucket as the original
     FIELD_TENANT,
+    # a reclaimed task keeps its hedge eligibility (tpu_faas/spec): the
+    # client's idempotency declaration survives re-dispatch
+    FIELD_SPECULATIVE,
 ]
 
 
@@ -167,6 +171,16 @@ class PendingTask:
     #: task's placement is accounted to. None (legacy producers, tenancy-
     #: oblivious gateways) reads as the default tenant everywhere.
     tenant: str | None = None
+    #: client declared this task idempotent and hedge-eligible
+    #: (FIELD_SPECULATIVE, tpu_faas/spec); False for every legacy producer
+    speculative: bool = False
+    #: this PendingTask IS a hedge replica of an already-running original
+    #: (host-constructed, never parsed from the store): it dispatches
+    #: without an inflight-table entry and dies silently if its hedge
+    #: entry resolved meanwhile
+    is_hedge: bool = False
+    #: anti-affinity row a hedge carries (the original's worker); -1 none
+    avoid_row: int = -1
 
     def task_message_kwargs(self, blob: bool = False, trace: bool = False) -> dict:
         """The TASK wire message's payload fields (timeout rides along so
@@ -249,6 +263,7 @@ class PendingTask:
             deadline_at=deadline_at,
             trace_id=fields.get(FIELD_TRACE_ID) or None,
             tenant=fields.get(FIELD_TENANT) or None,
+            speculative=fields.get(FIELD_SPECULATIVE) == "1",
         )
 
 

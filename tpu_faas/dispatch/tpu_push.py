@@ -88,6 +88,9 @@ class TpuPushDispatcher(TaskDispatcher):
         tenant_shares: str | None = None,
         tenant_caps: str | None = None,
         max_tenants: int = 32,
+        speculate_mult: float | None = None,
+        speculate_max_frac: float = 0.1,
+        speculate_min_s: float = 0.05,
     ) -> None:
         super().__init__(
             store_url=store_url, channel=channel, store=store, shared=shared
@@ -98,6 +101,29 @@ class TpuPushDispatcher(TaskDispatcher):
         # in-tick fairness is a single-device feature like the graph
         # frontier — mesh/multihost fleets refuse loudly rather than
         # silently running unfair.
+        # -- speculation plane (tpu_faas/spec): ON iff the operator named a
+        # straggler multiplier. Off = zero new work anywhere (the tick
+        # traces its pre-speculation graph, no per-task bookkeeping, wire/
+        # store/trace surfaces byte-identical). Hedges additionally gate on
+        # each task's OWN speculative=true submit flag — the dispatcher
+        # policy alone never replicates a task the client didn't declare
+        # idempotent. Single-device like tenancy: mesh/multihost refuse.
+        self.spec = None
+        if speculate_mult is not None:
+            if multihost or mesh_devices:
+                raise ValueError(
+                    "--speculate-mult is a single-device feature (the "
+                    "straggler scoring lives in the local tick); mesh/"
+                    "multihost fleets must run without hedging"
+                )
+            from tpu_faas.spec import SpeculationPolicy
+
+            self.spec = SpeculationPolicy(
+                speculate_mult,
+                max_frac=speculate_max_frac,
+                min_runtime_s=speculate_min_s,
+                clock=clock,
+            )
         self.tenancy = None
         if tenant_shares is not None or tenant_caps is not None:
             if multihost or mesh_devices:
@@ -235,6 +261,15 @@ class TpuPushDispatcher(TaskDispatcher):
                 mesh_devices=mesh_devices,
                 tick_backend=tick_backend,
                 tenancy=self.tenancy,
+                # speculation plane: grows the resident state/packet with
+                # the straggler lanes (constructor-time — leaf shapes are
+                # statics); None keeps the pre-speculation layout
+                spec_mult=(
+                    None if self.spec is None else self.spec.quantile_mult
+                ),
+                spec_min_s=(
+                    0.05 if self.spec is None else self.spec.min_runtime_s
+                ),
             )
             #: tasks currently living in the device pending set (or queued
             #: into it): task_id -> PendingTask, the payload source at
@@ -252,6 +287,12 @@ class TpuPushDispatcher(TaskDispatcher):
                 mesh_devices=mesh_devices,
             )
             self.arrays.tenancy = self.tenancy
+            if self.spec is not None:
+                # batch path: the spec lanes ride the one-shot tick's
+                # optional kwargs (state.py); the threshold knobs live on
+                # the arrays so tick() knows the plane is on
+                self.arrays.spec_mult = self.spec.quantile_mult
+                self.arrays.spec_min_s = self.spec.min_runtime_s
             self._resident_tasks = {}
         if multihost and not resident:
             # this process is the LEAD of a multi-process dispatcher fleet:
@@ -335,6 +376,28 @@ class TpuPushDispatcher(TaskDispatcher):
                 self.tenancy.publish(self.store)
             except STORE_OUTAGE_ERRORS as exc:
                 self.note_store_outage(exc, pause=0)
+        # -- speculation-plane observability (families exist iff the plane
+        # is on; outcome vocabulary is fixed, so cardinality is bounded)
+        if self.spec is not None:
+            self.m_hedges = self.metrics.counter(
+                "tpu_faas_dispatcher_hedges_total",
+                "Hedge lifecycle events, by outcome: launched (replica "
+                "queued for a flagged straggler), replica_won / "
+                "original_won (first-wins resolution), promoted (original's "
+                "worker died, replica adopted as owner), abandoned (hedge "
+                "dropped without racing), suppressed_budget (flag ignored "
+                "— wasted-work budget spent)",
+                ("outcome",),
+            )
+            for outcome in ("launched", "replica_won", "original_won",
+                            "promoted", "abandoned", "suppressed_budget"):
+                self.m_hedges.labels(outcome=outcome)
+            self.m_hedge_waste = self.metrics.counter(
+                "tpu_faas_dispatcher_hedge_loser_exec_seconds_total",
+                "Worker-measured execution seconds reported by hedge "
+                "LOSERS (the speculation plane's measured wasted work; "
+                "losers killed before their child started report none)",
+            )
         #: RESULT store writes accumulated during a worker-message drain,
         #: flushed as ONE pipelined finish_task_many round per drain
         #: (drain_results_batched); None = unbatched mode, where _handle
@@ -751,6 +814,239 @@ class TpuPushDispatcher(TaskDispatcher):
                 },
             )
 
+    # -- speculation plane (tpu_faas/spec) ---------------------------------
+    def _spec_pred(self, task: PendingTask, row: int) -> float:
+        """Predicted runtime (seconds) of ``task`` on worker ``row`` —
+        what arms in-tick straggler scoring for this dispatch. 0 opts the
+        slot out: plane off, task not declared speculative, a hedge
+        replica or reclaimed task (already suspicious — never hedged), or
+        no seconds-unit prediction (the payload-byte fallback size is not
+        a runtime; only a client cost hint or a learned estimate is)."""
+        if (
+            self.spec is None
+            or not task.speculative
+            or task.is_hedge
+            or task.retries
+        ):
+            return 0.0
+        ref = task.cost if task.cost is not None else task.learned
+        if ref is None or ref <= 0:
+            return 0.0
+        return ref / max(float(self.arrays.worker_speed[row]), 1e-6)
+
+    def _consider_hedges(self, slots) -> None:
+        """Straggler flags from the device tick: queue one hedge replica
+        per flagged in-flight slot that passes the host gates (submit-
+        gated speculative flag, one outstanding hedge per id, never a
+        reclaimed task, wasted-work budget). The replica re-enters the
+        ordinary pending queue as a ghost row carrying anti-affinity to
+        the original's worker; the next tick's placement (with the
+        in-step fixup) launches it on a DIFFERENT worker."""
+        spec, a = self.spec, self.arrays
+        if spec is None:
+            return
+        # budget denominator: PRIMARY dispatches only (hedges ride
+        # n_dispatched too, and counting them would loosen the bound to
+        # f/(1-f) — the budget is documented as hard)
+        denom = self.n_dispatched - spec.n_launched
+        for slot in slots:
+            slot = int(slot)
+            task_id = a.inflight_task[slot]
+            if task_id is None or task_id in spec.entries:
+                continue
+            if task_id in self.task_retries:
+                continue  # reclaimed at least once: suspicious, not slow
+            if not spec.within_budget(denom):
+                # budget spent: consider() owns the suppression counter
+                # (one accounting site); the store fetch is skipped
+                spec.consider(task_id, int(a.inflight_worker[slot]), denom)
+                self.m_hedges.labels(outcome="suppressed_budget").inc()
+                continue
+            orig_row = int(a.inflight_worker[slot])
+            try:
+                # the original's payload left this process at dispatch:
+                # rebuild the replica from the store like a reclaim does
+                # (read-only; RECLAIM_FIELDS carries the speculative flag)
+                pt = self.fetch_reclaim(task_id, 0)
+            except STORE_OUTAGE_ERRORS as exc:
+                self.note_store_outage(exc, pause=0)
+                return  # next tick re-flags; nothing mutated
+            if pt is None or not pt.speculative:
+                continue  # vanished, or the record lost its declaration
+            if spec.consider(task_id, orig_row, denom) is None:
+                continue
+            pt.is_hedge = True
+            pt.avoid_row = orig_row
+            self.pending.append(pt)
+            self.m_hedges.labels(outcome="launched").inc()
+            self.traces.note(task_id, "hedge_launched", count_dup=False)
+            self.log.info(
+                "hedging straggler task %s (original on worker row %d)",
+                task_id, orig_row, extra=log_ctx(task_id=task_id),
+            )
+
+    def _hedge_dispatchable(self, task: PendingTask):
+        """Is this hedge replica still worth sending? Returns its live
+        entry, or None when the race resolved meanwhile (original
+        finished/reclaimed/cancelled — the ghost dies silently here)."""
+        if self.spec is None:
+            return None
+        entry = self.spec.entries.get(task.task_id)
+        if (
+            entry is None
+            or entry.dispatched
+            or self.arrays.inflight_owner(task.task_id) is None
+        ):
+            return None
+        return entry
+
+    def _dispatch_hedge(
+        self, entry, task: PendingTask, row: int, wid: bytes, caps,
+        blob: bool, task_frames: dict,
+    ) -> None:
+        """Put a hedge replica on the wire: NO inflight-table entry (the
+        original keeps the task's slot; the book tracks the replica), the
+        second RUNNING mark rides a declared replica, and the tenant is
+        charged for the extra execution (a hedge burns its own share)."""
+        entry.hedge_row = row
+        entry.hedge_wid = wid
+        # declaration BEFORE the wire/store writes (monitor contract);
+        # no-op on real stores, an expect_replica credit under racecheck
+        self.store.declare_replica(task.task_id)
+        self.send_task_frame(task_frames, wid, caps, task, blob)
+        self.note_payload_sent(task, blob)
+        self.mark_running_safe(task.task_id)
+        if self.tenancy is not None:
+            trow = self.tenancy.row_for(task.tenant)
+            entry.tenant_row = trow
+            self.tenancy.note_dispatched(trow)
+            self.m_tenant_dispatched.labels(
+                tenant=self.tenancy.label_for(task.tenant)
+            ).inc()
+        self.n_dispatched += 1
+        self.m_dispatched.inc()
+
+    def _purge_resident_ghost(self, task_id: str) -> bool:
+        """Resident path: evict an abandoned hedge GHOST's device-pending
+        copy so the REAL task can re-enter as a fresh arrival (no stale
+        anti-affinity row — the dead original's row may be RECYCLED by a
+        new worker, and a stale veto against it could pin the task).
+        The ghost is either still in the un-uploaded arrival queue
+        (dropped there) or already slot-mapped (host maps orphaned — the
+        resolve path's defensive no-mapping branch returns the device
+        slot's capacity when it eventually places). Returns True when a
+        ghost copy was evicted."""
+        occ = self._resident_tasks.pop(task_id, None)
+        if occ is None or not occ.is_hedge:
+            if occ is not None:  # defensive: never evict a real task
+                self._resident_tasks[task_id] = occ
+            return False
+        a = self.arrays
+        slot_task = getattr(a, "slot_task", None)
+        if slot_task is None:
+            return True
+        slot = next(
+            (s for s, t0 in slot_task.items() if t0 == task_id), None
+        )
+        if slot is not None:
+            slot_task.pop(slot, None)
+            a._slot_meta.pop(slot, None)
+        else:
+            ghost = next(
+                (x for x in a._arrivals if x.task_id == task_id), None
+            )
+            if ghost is not None:
+                a._arrivals.remove(ghost)
+        return True
+
+    def _abandon_hedge(
+        self, task_id: str, kill: bool = True, release: bool = True
+    ) -> None:
+        """Drop a task's outstanding hedge without a winner (task
+        cancelled/expired/zombie-finished, or the hedge's worker died):
+        CANCEL the replica if it is on a still-known worker, return its
+        slot, release its tenant charge."""
+        if self.spec is None:
+            return
+        entry = self.spec.abandon(task_id)
+        if entry is None:
+            return
+        self.m_hedges.labels(outcome="abandoned").inc()
+        if not entry.dispatched:
+            return
+        a = self.arrays
+        if (
+            kill
+            and entry.hedge_wid is not None
+            and a.row_ids.get(entry.hedge_row) == entry.hedge_wid
+        ):
+            self._send_worker(entry.hedge_wid, m.CANCEL, task_id=task_id)
+        if release:
+            a.release_slot(entry.hedge_row)
+        if entry.tenant_row is not None and self.tenancy is not None:
+            self.tenancy.note_done(entry.tenant_row)
+
+    def _resolve_hedge(self, wid: bytes, task_id: str, data: dict) -> None:
+        """First result for a task with a DISPATCHED hedge: arbitrate,
+        kill + reclaim the loser's slot immediately, keep the accounting
+        exactly-once. A replica win does ALL the winner's bookkeeping
+        here (slot, tenant, estimator): the caller's from_owner path is
+        structurally False for it — anti-affinity put the replica on a
+        different worker than the inflight-table owner — so nothing
+        double-runs."""
+        spec, a = self.spec, self.arrays
+        entry = spec.entries.get(task_id) if spec is not None else None
+        if entry is None or not entry.dispatched:
+            return
+        if wid == entry.hedge_wid:
+            # REPLICA won: the original (still on its worker) is the loser
+            row_o = a.inflight_done(task_id)
+            spec.resolve(
+                task_id, winner="replica",
+                loser_row=row_o if row_o is not None else entry.orig_row,
+            )
+            self.m_hedges.labels(outcome="replica_won").inc()
+            self.traces.note(task_id, "hedge_resolved", count_dup=False)
+            if row_o is not None:
+                # loser slot reclaims immediately; the CANCEL kill frees
+                # the worker-side process (late/cancelled result arrives
+                # as a frozen first-wins no-op)
+                a.release_slot(row_o)
+                wid_o = a.row_ids.get(row_o)
+                if wid_o is not None:
+                    self._send_worker(wid_o, m.CANCEL, task_id=task_id)
+            # winner bookkeeping (the from_owner path never runs for a
+            # replica): slot back, tenant charges released on BOTH legs,
+            # estimator graded by the WINNER's window only
+            self.task_retries.pop(task_id, None)
+            self._tenant_task_done(task_id)
+            if entry.tenant_row is not None and self.tenancy is not None:
+                self.tenancy.note_done(entry.tenant_row)
+            a.release_slot(entry.hedge_row)
+            self._observe_result(wid, entry.hedge_row, task_id, data)
+            return
+        owner = a.inflight_owner(task_id)
+        if owner is not None and a.row_ids.get(owner) == wid:
+            # ORIGINAL won: kill + reclaim the replica; the caller's
+            # normal owner path finishes the winner's bookkeeping
+            spec.resolve(
+                task_id, winner="original", loser_row=entry.hedge_row
+            )
+            self.m_hedges.labels(outcome="original_won").inc()
+            self.traces.note(task_id, "hedge_resolved", count_dup=False)
+            if (
+                entry.hedge_wid is not None
+                and a.row_ids.get(entry.hedge_row) == entry.hedge_wid
+            ):
+                self._send_worker(
+                    entry.hedge_wid, m.CANCEL, task_id=task_id
+                )
+            a.release_slot(entry.hedge_row)
+            if entry.tenant_row is not None and self.tenancy is not None:
+                self.tenancy.note_done(entry.tenant_row)
+        # a result from NEITHER leg (an older zombie): leave the hedge
+        # racing — first_wins already froze the record for everyone
+
     def _note_token(self, wid: bytes, data: dict) -> None:
         """Record the stable worker token a REGISTER/RECONNECT carries
         (absent from reference-era workers: their grades stay keyed to the
@@ -952,10 +1248,41 @@ class TpuPushDispatcher(TaskDispatcher):
             and owner in a.row_ids
             and a.row_ids[owner] == wid
         )
+        # speculation plane: a task racing a dispatched hedge resolves on
+        # its FIRST result — the loser is killed and its slot reclaimed
+        # here, and a replica win does the winner's bookkeeping inside
+        # _resolve_hedge (the replica never owned an inflight-table
+        # entry, so from_owner is structurally False for it and the
+        # owner path below stays skipped). A task whose hedge is still a
+        # pending ghost just drops the ghost.
+        hedged = (
+            self.spec is not None and task_id in self.spec.entries
+        )
+        if hedged:
+            entry = self.spec.entries[task_id]
+            if entry.dispatched:
+                self._resolve_hedge(wid, task_id, data)
+            elif from_owner:
+                # original finished before its ghost ever placed: the
+                # ghost dies at its dispatch-time liveness check
+                self.spec.abandon(task_id)
+                self.m_hedges.labels(outcome="abandoned").inc()
+        elif self.spec is not None:
+            # loser attribution is SENDER-checked: only the recorded
+            # loser row's worker consumes the entry (a winner's duplicate
+            # retransmit must not book the winner's window as waste)
+            waste = self.spec.note_loser_result(
+                task_id, a.worker_ids.get(wid), data.get("elapsed")
+            )
+            if waste is not None:
+                self.m_hedge_waste.inc(waste)
         # suspicious = a second result is possible: sender is not the
-        # task's current owner (zombie after a reclaim), or the task was
-        # reclaimed at least once on its way to this worker
-        suspicious = not from_owner or task_id in self.task_retries
+        # task's current owner (zombie after a reclaim), the task was
+        # reclaimed at least once on its way to this worker, or a hedge
+        # replica is (or was, this very message) racing it
+        suspicious = (
+            not from_owner or task_id in self.task_retries or hedged
+        )
         if self._result_batch is not None:
             # batched drain (drain_results_batched): the terminal
             # write joins one pipelined finish_task_many flush after
@@ -1178,6 +1505,12 @@ class TpuPushDispatcher(TaskDispatcher):
                     deficits=self.arrays.tenant_deficits()
                 )
             ),
+            # speculation block (None = plane off): policy knobs + hedge
+            # book counters (tpu_faas/spec) — launched/tasks is the
+            # wasted-work ratio the budget bounds
+            "speculation": (
+                None if self.spec is None else self.spec.stats()
+            ),
         }
 
     # -- one scheduler tick ------------------------------------------------
@@ -1341,6 +1674,7 @@ class TpuPushDispatcher(TaskDispatcher):
         #: guaranteed its frame even when a later exception aborts the tick
         task_frames: dict = {}
         sent = 0
+        straggler_idx = None  # speculation: flags consumed after reassembly
         # Exception safety: a store outage may raise anywhere below. The
         # finally-block reassembles the queue so no popped task is ever
         # dropped, and the reclaim phase does its store reads BEFORE touching
@@ -1375,6 +1709,18 @@ class TpuPushDispatcher(TaskDispatcher):
                 tenants = np.asarray(
                     [self._tenant_row(t) for t in batch], dtype=np.int32
                 )
+            # speculation lane: anti-affinity rows for hedge ghost rows.
+            # Built on EVERY tick while the plane is on (all -1 without
+            # ghosts): the lane is part of the jitted signature, and
+            # materializing it only when the first hedge appears would
+            # recompile the tick MID-RUN — a serve-loop stall at the
+            # exact moment the tail needs rescuing (measured live: the
+            # hedged leg's p50 tripled on the recompile pause)
+            avoids = None
+            if self.spec is not None:
+                avoids = np.asarray(
+                    [t.avoid_row for t in batch], dtype=np.int32
+                )
             # graph frontier: padded edge list + locality preference for
             # this tick's batch (None on flat workloads — the jitted tick
             # keeps its dependency-free signature)
@@ -1398,6 +1744,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     0 if dep_edges is None else len(dep_edges[0]),
                     task_pref is not None,
                     tenants is not None,
+                    avoids is not None,
                 ),
             )
             with self.tracer.span("device_tick"), self.profiler.tick_capture():
@@ -1407,6 +1754,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     dep_edges=dep_edges,
                     task_pref=task_pref,
                     task_tenants=tenants,
+                    task_avoid=avoids,
                 )
 
             # reclaim in-flight tasks of dead workers (ahead of the queue)
@@ -1417,6 +1765,11 @@ class TpuPushDispatcher(TaskDispatcher):
                 np.flatnonzero(np.asarray(out.purged)),
                 requeued.append,
             )
+            # speculation: straggler flags acted on AFTER the tick's
+            # try/finally (the queue is a placeholder inside it — a hedge
+            # appended here would be lost to the reassembly)
+            if self.spec is not None and out.straggler is not None:
+                straggler_idx = np.flatnonzero(np.asarray(out.straggler))
 
             # zombie-finished pre-pass: ONE pipelined status read over the
             # retry-carrying slice of the batch replaces the per-retry
@@ -1468,6 +1821,48 @@ class TpuPushDispatcher(TaskDispatcher):
                     wid = a.row_ids[row]
                     caps = self._wid_caps.get(wid, frozenset())
                     blob = m.CAP_BLOB in caps and task.fn_digest is not None
+                    if task.is_hedge:
+                        # hedge replica: dispatches WITHOUT an inflight-
+                        # table entry (the original owns the slot) behind
+                        # a declared replica; a ghost whose race resolved
+                        # meanwhile dies silently here. The device fixup
+                        # guarantees row != avoid_row; the compare is a
+                        # defensive invariant, not a policy.
+                        entry = self._hedge_dispatchable(task)
+                        if entry is None:
+                            restore_from = idx + 1
+                            continue
+                        if row == task.avoid_row:
+                            # defensive (the in-step fixup forbids this):
+                            # retry next tick rather than dropping a ghost
+                            # whose book entry would then dangle forever
+                            still_pending.append(task)
+                            restore_from = idx + 1
+                            continue
+                        if not blob and not task.fn_payload:
+                            # NOT ensure_inline_payload: its vanished-blob
+                            # branch FAILs the record — which here is the
+                            # still-RUNNING original's. A hedge that can't
+                            # materialize just abandons quietly.
+                            body = (
+                                self.blob_lookup(task.fn_digest)
+                                if task.fn_digest
+                                else None
+                            )
+                            if body is None:
+                                self._abandon_hedge(
+                                    task.task_id, kill=False
+                                )
+                                restore_from = idx + 1
+                                continue
+                            task.fn_payload = body
+                        self._dispatch_hedge(
+                            entry, task, row, wid, caps, blob, task_frames
+                        )
+                        a.worker_free[row] -= 1
+                        sent += 1
+                        restore_from = idx + 1
+                        continue
                     # legacy hop: materialize the body BEFORE any
                     # bookkeeping (an outage raise here restores the whole
                     # tail; a vanished blob FAILs the task in place)
@@ -1479,7 +1874,10 @@ class TpuPushDispatcher(TaskDispatcher):
                         # reserve tracking BEFORE sending: a task on the
                         # wire but absent from the inflight table could
                         # never be re-dispatched
-                        a.inflight_add(task.task_id, row)
+                        a.inflight_add(
+                            task.task_id, row,
+                            pred=self._spec_pred(task, row),
+                        )
                     except RuntimeError:
                         still_pending.append(task)  # inflight full: wait
                         restore_from = idx + 1
@@ -1545,6 +1943,10 @@ class TpuPushDispatcher(TaskDispatcher):
                 # internally on an outage
                 self._batch_sizes["mark_running"] = len(running_batch)
                 self.mark_running_many(running_batch)
+        # hedge candidates queue AFTER the reassembly put the real pending
+        # queue back (they ride the next tick's placement as ghost rows)
+        if straggler_idx is not None and len(straggler_idx):
+            self._consider_hedges(straggler_idx)
         return sent
 
     def _finished_probe(self, task_ids: list[str]) -> set[str]:
@@ -1585,8 +1987,15 @@ class TpuPushDispatcher(TaskDispatcher):
             # ceil(n/KA) delta flush dispatches through one tick
             take = min(len(self.pending), a.max_pending)
             batch = []
+            hedges: list[PendingTask] = []
             for _ in range(take):
                 t = self.pending.popleft()
+                if t.is_hedge:
+                    # bulk load has no anti-affinity lane (it clears the
+                    # avoid leaf): hedge ghosts keep to the per-arrival
+                    # path below
+                    hedges.append(t)
+                    continue
                 if t.task_id in self._resident_tasks:
                     continue
                 dropped = self._drop_cancelled_or_park(t)
@@ -1597,6 +2006,8 @@ class TpuPushDispatcher(TaskDispatcher):
                 self._stamp_estimate(t)
                 self._resident_tasks[t.task_id] = t
                 batch.append(t)
+            for t in reversed(hedges):
+                self.pending.appendleft(t)
             if batch:
                 a.pending_bulk_load(
                     [t.task_id for t in batch],
@@ -1617,8 +2028,18 @@ class TpuPushDispatcher(TaskDispatcher):
                 )
         while self.pending:
             t = self.pending.popleft()
-            if t.task_id in self._resident_tasks:
-                continue  # already queued device-side (rescan overlap)
+            occupant = self._resident_tasks.get(t.task_id)
+            if occupant is not None:
+                if not (occupant.is_hedge and not t.is_hedge):
+                    continue  # already queued device-side (rescan overlap)
+                # a hedge GHOST holds the id while the REAL task comes
+                # back around (its original was reclaimed after the ghost
+                # queued, so the hedge entry is dead): evict the ghost's
+                # device copy and admit the real task as a fresh arrival
+                # — silently dropping it here stranded the task until
+                # lease adoption, and re-using the ghost's slot would
+                # carry a stale anti-affinity row
+                self._purge_resident_ghost(t.task_id)
             dropped = self._drop_cancelled_or_park(t)
             if dropped is None:
                 break  # outage: t parked for next tick
@@ -1629,6 +2050,7 @@ class TpuPushDispatcher(TaskDispatcher):
             a.pending_add(
                 t.task_id, t.size_estimate, t.priority or 0,
                 self._tenant_row(t),
+                avoid=t.avoid_row if t.is_hedge else -1,
             )
 
         sent = 0
@@ -1709,6 +2131,10 @@ class TpuPushDispatcher(TaskDispatcher):
         self._task_digest.pop(task_id, None)
         self._result_rows.pop(task_id, None)
         self._tenant_task_done(task_id)
+        # an outstanding hedge dies with the task (cancel/expire/zombie-
+        # finish): CANCEL the replica if it is on the wire, reclaim its
+        # slot, release its tenant charge
+        self._abandon_hedge(task_id)
         if self.graph is not None:
             self.graph.pop(task_id)
         # close any still-open timeline (no-op for the drop/fail sites that
@@ -1727,13 +2153,31 @@ class TpuPushDispatcher(TaskDispatcher):
         PendingTask (the batch tick interleaves into its in-progress
         requeue list, the resident path appends to the pending deque)."""
         a = self.arrays
+        purged_set = {int(r) for r in purged_rows}
         reclaims: list[tuple[int, PendingTask]] = []
         drops: list[tuple[int, str]] = []  # failed or vanished
+        #: hedged tasks whose ORIGINAL's worker died while the replica is
+        #: still running elsewhere: the replica is promoted to owner in
+        #: phase 2 instead of re-queuing the task (speculation plane —
+        #: the chaos story: kill the original's worker mid-hedge, the
+        #: replica completes, zero loss, zero extra executions)
+        promotes: list[tuple[int, str, object]] = []
         for slot in redispatch_slots:
             slot = int(slot)
             task_id = a.inflight_task[slot]
             if task_id is None:
                 continue
+            if self.spec is not None:
+                entry = self.spec.entries.get(task_id)
+                if entry is not None and entry.dispatched and (
+                    entry.hedge_row not in purged_set
+                    and a.row_ids.get(entry.hedge_row) == entry.hedge_wid
+                ):
+                    promotes.append((slot, task_id, entry))
+                    continue
+                # hedge still a ghost, or its worker died too: the task
+                # rides the normal reclaim; the entry is dropped in
+                # phase 2 (the ghost dies at its dispatch check)
             pt = self.reclaim_or_fail(
                 task_id,
                 self.task_retries.get(task_id, 0),
@@ -1747,16 +2191,48 @@ class TpuPushDispatcher(TaskDispatcher):
                 continue
             reclaims.append((slot, pt))
         # phase 2: bookkeeping only, cannot raise
+        for slot, task_id, entry in promotes:
+            a.inflight_clear_slot(slot)
+            self.spec.promote(task_id)
+            self.m_hedges.labels(outcome="promoted").inc()
+            self.traces.note(task_id, "hedge_resolved", count_dup=False)
+            a.inflight_add(task_id, entry.hedge_row)
+            # the purged original may be a STALLED-not-dead zombie that
+            # still ships a result: the promoted replica's write must ride
+            # first-wins like every second-result path — presence in
+            # task_retries is what marks the result suspicious
+            self.task_retries.setdefault(task_id, 0)
+            # the original's tenant charge releases with its worker; the
+            # replica's charge becomes the task's (released on its result)
+            self._tenant_task_done(task_id)
+            if entry.tenant_row is not None and self.tenancy is not None:
+                self._task_tenant_row[task_id] = entry.tenant_row
+            self.log.warning(
+                "original's worker died mid-hedge: promoted replica to "
+                "owner for %s", task_id, extra=log_ctx(task_id=task_id),
+            )
         for slot, task_id in drops:
             a.inflight_clear_slot(slot)
             self._forget_task_state(task_id)
         for slot, pt in reclaims:
             a.inflight_clear_slot(slot)
             # off the wire: release the tenant's inflight charge (the
-            # re-dispatch charges it again)
+            # re-dispatch charges it again); any hedge state dies with the
+            # original (its worker — possibly both workers — is gone)
             self._tenant_task_done(pt.task_id)
+            self._abandon_hedge(pt.task_id, kill=False, release=False)
+            # resident path: an abandoned hedge's GHOST copy may already
+            # sit in the device pending set under this id — evict it now
+            # so the requeued original isn't deduped against it
+            self._purge_resident_ghost(pt.task_id)
             self.task_retries[pt.task_id] = pt.retries
             requeue(pt)
+        # hedges whose REPLICA's worker was purged while the original is
+        # alive: the hedge is abandoned, the original races nobody
+        if self.spec is not None and purged_set and self.spec.entries:
+            for tid, e in list(self.spec.entries.items()):
+                if e.dispatched and e.hedge_row in purged_set:
+                    self._abandon_hedge(tid, kill=False, release=False)
         if reclaims:
             self.log.warning(
                 "reclaimed %d in-flight tasks from dead workers",
@@ -1881,6 +2357,49 @@ class TpuPushDispatcher(TaskDispatcher):
                     if row not in a.row_ids:
                         undo(task, row)
                         continue
+                    if task.is_hedge:
+                        # hedge replica (see the batch loop): no inflight
+                        # entry, declared replica, dead ghosts return the
+                        # kernel-consumed slot
+                        entry = self._hedge_dispatchable(task)
+                        if entry is None:
+                            a.release_slot(row)
+                            continue
+                        if row == task.avoid_row:
+                            # defensive (the in-step fixup forbids this):
+                            # undo re-queues the ghost for the next tick
+                            undo(task, row)
+                            continue
+                        h_wid = a.row_ids[row]
+                        h_caps = self._wid_caps.get(h_wid, frozenset())
+                        h_blob = (
+                            m.CAP_BLOB in h_caps
+                            and task.fn_digest is not None
+                        )
+                        if not h_blob and not task.fn_payload:
+                            try:
+                                body = (
+                                    self.blob_lookup(task.fn_digest)
+                                    if task.fn_digest
+                                    else None
+                                )
+                            except STORE_OUTAGE_ERRORS as exc:
+                                self.note_store_outage(exc, pause=0)
+                                undo(task, row)
+                                continue
+                            if body is None:
+                                self._abandon_hedge(
+                                    task.task_id, kill=False
+                                )
+                                a.release_slot(row)
+                                continue
+                            task.fn_payload = body
+                        self._dispatch_hedge(
+                            entry, task, row, h_wid, h_caps, h_blob,
+                            task_frames,
+                        )
+                        sent += 1
+                        continue
                     if task.retries:
                         if finished is None:
                             undo(task, row)  # probe hit the outage above
@@ -1911,7 +2430,10 @@ class TpuPushDispatcher(TaskDispatcher):
                             a.release_slot(row)
                             continue
                     try:
-                        a.inflight_add(task.task_id, row)
+                        a.inflight_add(
+                            task.task_id, row,
+                            pred=self._spec_pred(task, row),
+                        )
                     except RuntimeError:
                         undo(task, row)  # inflight table full: wait a tick
                         continue
@@ -1943,6 +2465,11 @@ class TpuPushDispatcher(TaskDispatcher):
             finally:
                 self._batch_sizes["mark_running"] = len(running_batch)
                 self.mark_running_many(running_batch)
+        # straggler flags from this resolved tick: queue hedge ghosts for
+        # the next tick's placement (after the act loop, so a flagged
+        # task whose result just resolved above is skipped by the book)
+        if self.spec is not None and res.straggler_slots:
+            self._consider_hedges(res.straggler_slots)
         return sent
 
     #: express ready-set size at or below which an announce-woken sub-tick
@@ -2152,7 +2679,22 @@ class TpuPushDispatcher(TaskDispatcher):
                         placeable = bool(self.pending) or bool(
                             self._resident_tasks
                         )
-                        if (placeable and free_any) or (
+                        # speculation: straggler scoring happens INSIDE
+                        # the device step, so a saturated fleet (nothing
+                        # placeable, no free slots) must still scan at
+                        # hedge granularity — the min-runtime floor, not
+                        # the coarse liveness period — while anything is
+                        # in flight. Off, the gate is byte-identical.
+                        spec_due = (
+                            self.spec is not None
+                            and a.n_inflight > 0
+                            and now - last_device
+                            >= max(
+                                self.tick_period,
+                                self.spec.min_runtime_s,
+                            )
+                        )
+                        if (placeable and free_any) or spec_due or (
                             now - last_device >= self.liveness_period
                         ):
                             self.tick(intake=False)

@@ -107,6 +107,7 @@ from tpu_faas.core.task import (
     FIELD_PENDING_DEPS,
     FIELD_PRIORITY,
     FIELD_RESULT,
+    FIELD_SPECULATIVE,
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
     FIELD_TENANT,
@@ -328,6 +329,12 @@ class _ResultWaiters:
                 self._stop.wait(1.0)
 
 
+#: default ceiling for the parked-wait safety re-read cadence (seconds);
+#: GatewayContext.wait_safety_poll_s (--wait-safety-poll-s) overrides it
+#: per process — latency benches raise it to attribute the poll floor
+_WAIT_POLL_MAX_S_DEFAULT = 2.0
+
+
 @dataclass
 class GatewayContext:
     store: TaskStore
@@ -352,6 +359,12 @@ class GatewayContext:
     #: process must not share series; /metrics renders this + the
     #: process-global registry
     metrics: MetricsRegistry = field(default_factory=MetricsRegistry)
+    #: safety-poll ceiling (seconds) for parked waits whose waiter plane
+    #: is armed: the announce is the wake path and the periodic store
+    #: re-read only insures against announce loss, so latency benches can
+    #: RAISE this to attribute (and tune away) the poll floor — see
+    #: tpu_faas_gateway_safety_poll_served_total
+    wait_safety_poll_s: float = _WAIT_POLL_MAX_S_DEFAULT
     #: admission controller (tpu_faas/admission): every submit passes it
     #: before any store work. None disables admission entirely (tests of
     #: the raw surface); the default fails open until a dispatcher
@@ -515,6 +528,15 @@ class GatewayContext:
         )
         for source in ("inline", "store"):
             self.m_result_served.labels(source=source)
+        self.m_safety_poll = self.metrics.counter(
+            "tpu_faas_gateway_safety_poll_served_total",
+            "Parked waits (waiter plane armed) whose terminal reply was "
+            "found by the periodic SAFETY store re-read rather than an "
+            "announce wake — each one ate up to wait_safety_poll_s "
+            "(--wait-safety-poll-s) of avoidable latency. Nonzero under "
+            "steady traffic means announce loss (bus gap, subscription "
+            "reconnect) is on the latency path; see OPERATIONS.md triage",
+        )
         self.m_shard_routed = self.metrics.counter(
             "tpu_faas_gateway_shard_routed_total",
             "Task-keyed reads (/status, /result, /trace) routed to a "
@@ -979,6 +1001,7 @@ def make_app(
     breaker: "CircuitBreaker | None | bool" = True,
     payload_plane: bool = False,
     trace: bool = False,
+    wait_safety_poll_s: float = _WAIT_POLL_MAX_S_DEFAULT,
 ) -> web.Application:
     """``admission``/``breaker``: True builds the defaults (admission
     fails open until a dispatcher publishes the saturation signal or a
@@ -1013,6 +1036,7 @@ def make_app(
         breaker=breaker,
         payload_plane=payload_plane,
         trace=trace,
+        wait_safety_poll_s=max(0.1, float(wait_safety_poll_s)),
     )
     app = web.Application(
         client_max_size=256 * 1024 * 1024, middlewares=[_metrics_middleware]
@@ -1211,7 +1235,8 @@ _PRIORITY_BOUND = 2**30
 
 
 def _parse_hints(
-    priority, cost, timeout=None, deadline=None, now: float | None = None
+    priority, cost, timeout=None, deadline=None, now: float | None = None,
+    speculative=None,
 ) -> dict[str, str]:
     """Validate the optional scheduling hints into store hash fields.
 
@@ -1251,6 +1276,14 @@ def _parse_hints(
             extra[field_name] = repr(base + float(value))
         else:
             extra[field_name] = repr(float(value))
+    if speculative is not None:
+        # strict bool: the flag is a CLIENT PROMISE (this task is safe to
+        # execute more than once), not a tuning hint — a truthy string
+        # must not silently opt a non-idempotent task into hedging
+        if not isinstance(speculative, bool):
+            raise ValueError("'speculative' must be a boolean")
+        if speculative:
+            extra[FIELD_SPECULATIVE] = "1"
     return extra
 
 
@@ -1306,6 +1339,7 @@ async def execute_function(request: web.Request) -> web.Response:
             body.get("timeout"),
             body.get("deadline"),
             now=now,
+            speculative=body.get("speculative"),
         )
     except ValueError as exc:
         return _json_error(400, str(exc))
@@ -1562,6 +1596,8 @@ async def execute_batch(request: web.Request) -> web.Response:
             )
     now = time.time()
     try:
+        # one speculative flag for the whole batch (like the tenant
+        # header): the client's idempotency promise is per-submit-call
         extras = [
             _parse_hints(
                 priorities[i] if priorities else None,
@@ -1569,6 +1605,7 @@ async def execute_batch(request: web.Request) -> web.Response:
                 timeouts[i] if timeouts else None,
                 deadlines[i] if deadlines else None,
                 now=now,
+                speculative=body.get("speculative"),
             )
             for i in range(len(payloads))
         ]
@@ -2023,7 +2060,7 @@ _MAX_WAIT_S = 30.0
 #: can be coarse: parked waiters must not saturate the shared executor
 #: (each re-read is a blocking store call on the default thread pool).
 _WAIT_POLL_S = 0.5
-_WAIT_POLL_MAX_S = 2.0
+_WAIT_POLL_MAX_S = _WAIT_POLL_MAX_S_DEFAULT
 
 
 def _note_terminal_delivery(
@@ -2080,7 +2117,12 @@ async def get_result(request: web.Request) -> web.Response:
     # path and the store re-read is only announce-loss insurance — start
     # it coarse instead of re-reading at 0.5 s. Without a waiter plane the
     # poll is the only wake path and keeps its fine-grained start.
-    poll_s = _WAIT_POLL_MAX_S if waiter is not None else _WAIT_POLL_S
+    poll_cap = ctx.wait_safety_poll_s if waiter is not None else _WAIT_POLL_MAX_S
+    poll_s = poll_cap if waiter is not None else _WAIT_POLL_S
+    # attribution: did the last park time out (safety re-read) rather
+    # than being woken by an announce? A terminal found that way is
+    # counted in safety_poll_served_total
+    woke_by_poll = False
     try:
         while True:
             # clear BEFORE the read: an announce landing between the read
@@ -2116,6 +2158,11 @@ async def get_result(request: web.Request) -> web.Response:
                 terminal = True  # unknown status string: reply, don't 500/hang
             if terminal or loop.time() >= deadline or ctx.stopping.is_set():
                 if terminal:
+                    if waiter is not None and woke_by_poll:
+                        # the announce never woke us — the safety re-read
+                        # found the terminal record (announce loss on the
+                        # latency path; see --wait-safety-poll-s)
+                        ctx.m_safety_poll.inc()
                     _note_terminal_delivery(
                         ctx, task_id, status, "store", loop
                     )
@@ -2126,11 +2173,12 @@ async def get_result(request: web.Request) -> web.Response:
             if waiter is not None:
                 try:
                     await asyncio.wait_for(waiter.event.wait(), timeout=pause)
+                    woke_by_poll = False
                 except asyncio.TimeoutError:
-                    pass
+                    woke_by_poll = True
             else:
                 await asyncio.sleep(pause)
-            poll_s = min(poll_s * 1.5, _WAIT_POLL_MAX_S)
+            poll_s = min(poll_s * 1.5, poll_cap)
     finally:
         if waiter is not None and waiters is not None:
             waiters.release(task_id, waiter)
@@ -2198,9 +2246,18 @@ class _ResultWatch:
             if ctx.waiters is not None and wait_s > 0
             else None
         )
-        self.poll_s = (
-            _WAIT_POLL_MAX_S if self.waiter is not None else _WAIT_POLL_S
+        self.poll_cap = (
+            ctx.wait_safety_poll_s
+            if self.waiter is not None
+            else _WAIT_POLL_MAX_S
         )
+        self.poll_s = (
+            self.poll_cap if self.waiter is not None else _WAIT_POLL_S
+        )
+        #: the last park timed out (safety re-read) instead of an
+        #: announce wake — store-sourced deliveries then count into
+        #: safety_poll_served_total
+        self._woke_by_poll = False
 
     async def collect(self) -> list[tuple[str, str, str, str]]:
         """Newly-terminal (task_id, status, result, source) since the last
@@ -2252,6 +2309,12 @@ class _ResultWatch:
                         )
                     )
         for tid, status, _result, source in out:
+            if (
+                source == "store"
+                and self.waiter is not None
+                and self._woke_by_poll
+            ):
+                self.ctx.m_safety_poll.inc()
             _note_terminal_delivery(self.ctx, tid, status, source, self.loop)
         return out
 
@@ -2267,11 +2330,13 @@ class _ResultWatch:
         """Sleep until an announce wake or the next safety re-read."""
         pause = min(self.poll_s, max(0.0, self.deadline - self.loop.time()))
         if self.waiter is not None:
+            self._woke_by_poll = True
             with contextlib.suppress(asyncio.TimeoutError):
                 await asyncio.wait_for(self.waiter.event.wait(), timeout=pause)
+                self._woke_by_poll = False
         else:
             await asyncio.sleep(pause)
-        self.poll_s = min(self.poll_s * 1.5, _WAIT_POLL_MAX_S)
+        self.poll_s = min(self.poll_s * 1.5, self.poll_cap)
 
     @property
     def unknown(self) -> list[str]:
@@ -2719,6 +2784,7 @@ def start_gateway_thread(
     breaker: "CircuitBreaker | None | bool" = True,
     payload_plane: bool = False,
     trace: bool = False,
+    wait_safety_poll_s: float = _WAIT_POLL_MAX_S_DEFAULT,
 ) -> GatewayHandle:
     """Serve the gateway in a daemon thread; returns once the port is bound."""
     started = threading.Event()
@@ -2740,6 +2806,7 @@ def start_gateway_thread(
                     breaker=breaker,
                     payload_plane=payload_plane,
                     trace=trace,
+                    wait_safety_poll_s=wait_safety_poll_s,
                 )
             )
             await runner.setup()
@@ -2812,6 +2879,16 @@ def main(argv: list[str] | None = None) -> None:
         "assembles the cross-process timeline. Off by default — "
         "single-process and reference-era setups run unchanged",
     )
+    ap.add_argument(
+        "--wait-safety-poll-s", type=float,
+        default=_WAIT_POLL_MAX_S_DEFAULT, metavar="S",
+        help="ceiling of the parked long-poll SAFETY store re-read "
+        "cadence while the announce-wake plane is armed (default 2.0). "
+        "The re-read only insures against announce loss; replies it "
+        "serves are counted in "
+        "tpu_faas_gateway_safety_poll_served_total so latency runs can "
+        "attribute — and by raising this — tune away the poll floor",
+    )
     ns = ap.parse_args(argv)
     store = make_store(ns.store)
     if ns.no_admission:
@@ -2840,6 +2917,7 @@ def main(argv: list[str] | None = None) -> None:
             breaker=breaker,
             payload_plane=ns.payload_plane,
             trace=ns.trace,
+            wait_safety_poll_s=ns.wait_safety_poll_s,
         ),
         host=ns.host,
         port=ns.port,

@@ -77,6 +77,11 @@ STAGES = {
     "result_return": ("exec_end", "result_received"),
     # terminal store write landing after the result arrived
     "finalize": ("result_received", "finished"),
+    # speculation plane (tpu_faas/spec): hedge replica launched for a
+    # straggling execution -> first result resolved the race. Both
+    # endpoints absent on unhedged tasks, so the stage never observes
+    # there — the hedged population's detection-to-resolution window.
+    "hedge_window": ("hedge_launched", "hedge_resolved"),
     # end to end
     "total": ("submitted", "finished"),
 }

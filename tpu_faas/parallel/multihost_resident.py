@@ -152,18 +152,22 @@ class MultihostResidentScheduler(ResidentScheduler):
             sizes=task_sh, valid=task_sh, prio=task_sh, tenant=task_sh,
             last_hb=repl, free=repl, inflight=repl, prev_live=repl,
             speed=repl, active=repl, price=repl, t_deficit=repl,
+            # speculation plane is single-device (like tenancy): the
+            # leaves here are their length-1 inert dummies, replicated
+            infl_start=repl, infl_pred=repl, avoid=repl,
             refresh=repl,
         )
         out_sh = ResidentTickOutput(
             placed_slots=repl, placed_rows=repl, arrival_slots=repl,
             redispatch_slots=repl, purged=repl, live=repl, n_pending=repl,
+            straggler_slots=repl,
         )
         tick = jax.jit(
             _resident_tick.__wrapped__,
             static_argnames=(
                 "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP",
                 "KR", "max_slots", "placement", "use_priority",
-                "use_tenancy", "NT",
+                "use_tenancy", "NT", "use_spec", "KG",
             ),
             out_shardings=(out_sh, state_sh),
         )
@@ -171,7 +175,7 @@ class MultihostResidentScheduler(ResidentScheduler):
             _flush_kernel.__wrapped__,
             static_argnames=(
                 "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB",
-                "use_priority", "use_tenancy", "NT",
+                "use_priority", "use_tenancy", "NT", "use_spec", "KG",
             ),
             out_shardings=(state_sh, repl),
         )

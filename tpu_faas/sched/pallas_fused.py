@@ -79,6 +79,8 @@ def fused_state_bytes(
     packet_len: int = 0,
     placement: str = "rank",
     NT: int = 1,
+    use_spec: bool = False,
+    KG: int = 64,
 ) -> int:
     """Resident working set of the fused kernel, in bytes — the number to
     hold against a core's VMEM budget (16 MB on v5e) when sizing
@@ -92,12 +94,24 @@ def fused_state_bytes(
 
     ``NT`` is the tenancy plane's tenant-row padding: the per-task tenant
     leaf (i32[T], carried even when the plane is off — 13 B/task total vs
-    the pre-tenancy 9 B/task) plus the NT-length deficit vector."""
+    the pre-tenancy 9 B/task) plus the NT-length deficit vector.
+
+    ``use_spec`` (speculation plane) adds the real-shaped straggler
+    leaves: two f32[I] (dispatch stamp + predicted runtime), one i32[T]
+    anti-affinity vector, and the KG-compacted straggler output — 8 more
+    B/in-flight slot and 4 more B/task. Off, the leaves are length-1
+    dummies and the budget matches the pre-speculation build."""
     task = T * (4 + 1 + 4 + 4)  # sizes f32 + valid bool + prio/tenant i32
     fleet = W * (4 + 4 + 1 + 4 + 1 + 1 + 1)  # hb/free/speed + 4 bool[W]
     inflight = I * 4
     price = W * max_slots * 4 + NT * 4
     out = (KP * 2 + KA + KR + 1) * 4
+    if use_spec:
+        inflight += I * 8  # infl_start + infl_pred f32[I]
+        task += T * 4  # avoid i32[T]
+        out += KG * 4  # compacted straggler slots
+    else:
+        out += 4  # the length-1 straggler pad
     solver = 0
     if placement == "auction":
         from tpu_faas.sched.pallas_kernels import STREAM_S, STREAM_T
@@ -136,6 +150,7 @@ def _fused_resident_tick_impl(
     *,
     T, W, I, KA, KH, KF, KI, KS, KB, KP, KR,
     max_slots, placement, use_priority, use_tenancy=False, NT=1,
+    use_spec=False, KG=1,
     interpret=False,
 ):
     if not _HAVE_PALLAS:
@@ -145,7 +160,13 @@ def _fused_resident_tick_impl(
     statics = dict(
         T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS, KB=KB,
         use_priority=use_priority, use_tenancy=use_tenancy, NT=NT,
+        use_spec=use_spec, KG=KG,
     )
+    # speculation leaves are real-shaped only when the plane is on; off,
+    # they are the length-1 inert dummies the resident state carries so
+    # the alias table keeps one leaf count either way
+    SI = I if use_spec else 1
+    ST = T if use_spec else 1
 
     def _value_step(packed_v, *state_leaves):
         """The whole tick on VALUES — traced once by make_jaxpr below so
@@ -161,9 +182,11 @@ def _fused_resident_tick_impl(
             res.placed_slots, res.placed_rows, res.arrival_slots,
             res.redispatch_slots, res.purged, res.live,
             jnp.reshape(res.n_pending, (1,)),
+            res.straggler_slots,
             new.sizes, new.valid, new.prio, new.tenant, new.last_hb,
             new.free, new.inflight, new.prev_live, new.speed, new.active,
             new.price, new.t_deficit,
+            new.infl_start, new.infl_pred, new.avoid,
             jnp.reshape(new.refresh, (1,)),
         )
 
@@ -183,6 +206,9 @@ def _fused_resident_tick_impl(
         jax.ShapeDtypeStruct((W,), b),  # active
         jax.ShapeDtypeStruct((S,), f32),  # price
         jax.ShapeDtypeStruct((NT,), f32),  # tenant deficits
+        jax.ShapeDtypeStruct((SI,), f32),  # infl_start (spec plane)
+        jax.ShapeDtypeStruct((SI,), f32),  # infl_pred (spec plane)
+        jax.ShapeDtypeStruct((ST,), i32),  # avoid rows (spec plane)
         jax.ShapeDtypeStruct((1,), b),  # refresh
     )
     closed = jax.make_jaxpr(_value_step)(*in_specs)
@@ -214,6 +240,7 @@ def _fused_resident_tick_impl(
         jax.ShapeDtypeStruct((W,), b),  # purged
         jax.ShapeDtypeStruct((W,), b),  # live
         jax.ShapeDtypeStruct((1,), i32),  # n_pending
+        jax.ShapeDtypeStruct((KG,), i32),  # straggler_slots (spec plane)
         jax.ShapeDtypeStruct((T,), f32),  # sizes
         jax.ShapeDtypeStruct((T,), b),  # valid
         jax.ShapeDtypeStruct((T,), i32),  # prio
@@ -226,29 +253,36 @@ def _fused_resident_tick_impl(
         jax.ShapeDtypeStruct((W,), b),  # active
         jax.ShapeDtypeStruct((S,), f32),  # price
         jax.ShapeDtypeStruct((NT,), f32),  # tenant deficits
+        jax.ShapeDtypeStruct((SI,), f32),  # infl_start
+        jax.ShapeDtypeStruct((SI,), f32),  # infl_pred
+        jax.ShapeDtypeStruct((ST,), i32),  # avoid rows
         jax.ShapeDtypeStruct((1,), b),  # refresh
     )
     outs = pl.pallas_call(
         kernel,
         out_shape=out_shape,
-        # state input k (operand k, packet is 0) writes output 7 + (k - 1):
-        # each state buffer is updated in place across ticks. Lifted trace
-        # constants ride after the state operands and alias nothing.
-        input_output_aliases={k: 6 + k for k in range(1, 14)},
+        # state input k (operand k, packet is 0) writes output 7 + k (the
+        # first 8 outputs are the compacted tick results): each state
+        # buffer is updated in place across ticks. Lifted trace constants
+        # ride after the state operands and alias nothing.
+        input_output_aliases={k: 7 + k for k in range(1, 17)},
         interpret=interpret,
     )(
         jnp.asarray(packed, jnp.float32),
         st.sizes, st.valid, st.prio, st.tenant, st.last_hb, st.free,
         st.inflight, st.prev_live, st.speed, st.active, st.price,
-        st.t_deficit, jnp.reshape(st.refresh, (1,)),
+        st.t_deficit, st.infl_start, st.infl_pred, st.avoid,
+        jnp.reshape(st.refresh, (1,)),
         *consts,
     )
     res = ResidentTickOutput(
-        outs[0], outs[1], outs[2], outs[3], outs[4], outs[5], outs[6][0]
+        outs[0], outs[1], outs[2], outs[3], outs[4], outs[5], outs[6][0],
+        outs[7],
     )
     new_state = _ResidentState(
-        outs[7], outs[8], outs[9], outs[10], outs[11], outs[12], outs[13],
-        outs[14], outs[15], outs[16], outs[17], outs[18], outs[19][0],
+        outs[8], outs[9], outs[10], outs[11], outs[12], outs[13], outs[14],
+        outs[15], outs[16], outs[17], outs[18], outs[19], outs[20],
+        outs[21], outs[22], outs[23][0],
     )
     return res, new_state
 
@@ -256,6 +290,7 @@ def _fused_resident_tick_impl(
 _STATICS = (
     "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP", "KR",
     "max_slots", "placement", "use_priority", "use_tenancy", "NT",
+    "use_spec", "KG",
     "interpret",
 )
 #: compiled form: state donated so the kernel's aliases update in place

@@ -64,6 +64,10 @@ class ResidentTickOutput(NamedTuple):
     purged: jnp.ndarray  # bool[W]
     live: jnp.ndarray  # bool[W]
     n_pending: jnp.ndarray  # i32 pending tasks still valid after this tick
+    #: i32[KG] in-flight slots flagged as stragglers this tick (-1 = pad;
+    #: length 1, all -1, while the speculation plane is off) — hedge
+    #: candidates the host resolves to task ids (tpu_faas/spec)
+    straggler_slots: jnp.ndarray | None = None
 
 
 class _ResidentState(NamedTuple):
@@ -88,6 +92,19 @@ class _ResidentState(NamedTuple):
     #: f32[NT] per-tenant deficit counters carried tick-over-tick (length
     #: 1, inert, while the tenancy plane is off) — tenancy/fairshare.py
     t_deficit: jnp.ndarray
+    #: speculation plane (tpu_faas/spec; all three are length-1 inert
+    #: dummies while the plane is off, so the spec-off packet and VMEM
+    #: budget stay byte-identical to the pre-speculation build):
+    #: f32[I] epoch-relative dispatch stamp per in-flight slot (stamped at
+    #: the slot's delta-scatter apply time)
+    infl_start: jnp.ndarray
+    #: f32[I] predicted runtime in seconds per in-flight slot (<= 0 opts
+    #: the slot out of straggler scoring)
+    infl_pred: jnp.ndarray
+    #: i32[T] anti-affinity row per pending slot: the worker this task
+    #: must NOT be placed on (-1 = none) — hedge ghost rows carry their
+    #: original's row here
+    avoid: jnp.ndarray
     #: bool scalar: last tick flagged the prices stale (next tick opens
     #: from the analytic dual seed instead); starts True (cold start)
     refresh: jnp.ndarray
@@ -129,7 +146,7 @@ def _first_k_indices(mask, K: int):
 
 
 def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
-                  KS, KB, use_priority, use_tenancy=False):
+                  KS, KB, use_priority, use_tenancy=False, use_spec=False):
     """Scatter one delta packet into the carried state. Traced helper shared
     by the flush kernel and the fused tick kernel. Returns (state,
     arrival_slots i32[KA])."""
@@ -142,12 +159,21 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
         arr_prio = packed[off : off + KA].astype(jnp.int32); off += KA
     if use_tenancy:
         arr_tenant = packed[off : off + KA].astype(jnp.int32); off += KA
+    if use_spec:
+        # hedge anti-affinity lane: the ghost row's forbidden worker
+        # (-1 on ordinary arrivals) — always written, so a recycled slot
+        # can never inherit a previous hedge's veto
+        arr_avoid = packed[off : off + KA].astype(jnp.int32); off += KA
     hb_idx = packed[off : off + KH].astype(jnp.int32); off += KH
     hb_val = packed[off : off + KH]; off += KH
     free_idx = packed[off : off + KF].astype(jnp.int32); off += KF
     free_val = packed[off : off + KF].astype(jnp.int32); off += KF
     infl_idx = packed[off : off + KI].astype(jnp.int32); off += KI
     infl_val = packed[off : off + KI].astype(jnp.int32); off += KI
+    if use_spec:
+        # predicted runtime per scattered in-flight slot (speculation
+        # plane): rides the SAME indices as the infl scatter
+        infl_pred_val = packed[off : off + KI]; off += KI
     sp_idx = packed[off : off + KS].astype(jnp.int32); off += KS
     sp_val = packed[off : off + KS]; off += KS
     ac_idx = packed[off : off + KB].astype(jnp.int32); off += KB
@@ -176,6 +202,20 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
     inflight = st.inflight.at[jnp.where(m, infl_idx, I)].set(
         jnp.where(m, infl_val, -1), mode="drop"
     )
+    infl_start, infl_pred = st.infl_start, st.infl_pred
+    if use_spec:
+        # a slot's dispatch stamp is the packet's ``now`` at apply time
+        # (the host mirror dispatched it at most a tick earlier — elapsed
+        # error is bounded by the tick period plus resolve lag, far under
+        # any sane straggler threshold); cleared slots (val < 0) zero both
+        occupied_w = jnp.where(m, infl_val, -1) >= 0
+        sidx = jnp.where(m, infl_idx, I)
+        infl_start = st.infl_start.at[sidx].set(
+            jnp.where(occupied_w, now, 0.0), mode="drop"
+        )
+        infl_pred = st.infl_pred.at[sidx].set(
+            jnp.where(occupied_w, infl_pred_val, 0.0), mode="drop"
+        )
     # worker speed / active ride the SAME delta discipline (round 4): the
     # estimation loop rewrites speeds continuously, and re-uploading the
     # whole [W] array per change was the one remaining non-delta transfer
@@ -210,26 +250,34 @@ def _apply_deltas(packed, st: _ResidentState, *, T, W, I, KA, KH, KF, KI,
         tenant = tenant.at[slots].set(
             jnp.where(ok, arr_tenant, 0), mode="drop"
         )
+    avoid = st.avoid
+    if use_spec:
+        avoid = avoid.at[slots].set(
+            jnp.where(ok, arr_avoid, -1), mode="drop"
+        )
     arrival_slots = jnp.where(ok, free_slots, -1).astype(jnp.int32)
     return (
         _ResidentState(sizes, valid, prio, tenant, last_hb, free, inflight,
                        st.prev_live, speed, active, st.price, st.t_deficit,
-                       st.refresh),
+                       infl_start, infl_pred, avoid, st.refresh),
         arrival_slots,
         now,
     )
 
 
 def _flush_kernel_impl(packed, st, *, T, W, I, KA, KH, KF, KI, KS, KB,
-                       use_priority, use_tenancy=False, NT=1):
+                       use_priority, use_tenancy=False, NT=1,
+                       use_spec=False, KG=1):
     """Delta application alone — used when a tick's deltas exceed one
     packet's capacity (mass registration, adoption bursts): the overflow is
     drained in extra small dispatches, the final packet rides the fused
-    tick. ``NT`` shapes nothing here (the tenant-vec tail is tick-only)
-    but rides the statics so both kernels share one ``_statics()`` dict."""
+    tick. ``NT``/``KG`` shape nothing here (the tenant-vec tail and the
+    straggler compaction are tick-only) but ride the statics so both
+    kernels share one ``_statics()`` dict."""
     st, arrival_slots, _ = _apply_deltas(
         packed, st, T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS,
         KB=KB, use_priority=use_priority, use_tenancy=use_tenancy,
+        use_spec=use_spec,
     )
     return st, arrival_slots
 
@@ -238,7 +286,7 @@ _flush_kernel = partial(
     jax.jit,
     static_argnames=(
         "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "use_priority",
-        "use_tenancy", "NT",
+        "use_tenancy", "NT", "use_spec", "KG",
     ),
 )(_flush_kernel_impl)
 
@@ -249,7 +297,7 @@ def _resident_tick_impl(
     *,
     T, W, I, KA, KH, KF, KI, KS, KB, KP, KR,
     max_slots, placement, use_priority, bid_backend="auto",
-    use_tenancy=False, NT=1,
+    use_tenancy=False, NT=1, use_spec=False, KG=1,
 ):
     """The full resident step as plain traced ops — jitted below for the
     XLA path, traced INSIDE one pallas_call by sched/pallas_fused.py (the
@@ -258,9 +306,24 @@ def _resident_tick_impl(
     st, arrival_slots, now = _apply_deltas(
         packed, st, T=T, W=W, I=I, KA=KA, KH=KH, KF=KF, KI=KI, KS=KS,
         KB=KB, use_priority=use_priority, use_tenancy=use_tenancy,
+        use_spec=use_spec,
     )
     hb_age = now - st.last_hb
     auction = placement == "auction"
+    spec_kw: dict = {}
+    if use_spec:
+        # straggler lanes (tpu_faas/spec): elapsed per in-flight slot from
+        # the device-resident dispatch stamps, threshold knobs off the
+        # 2-float spec tail (VALUES — hot-tunable, no recompile). The
+        # anti-affinity vector rides the state like the tenant rows.
+        spec_off = packed.shape[0] - (3 * NT if use_tenancy else 0) - 2
+        spec_kw = dict(
+            spec_elapsed=now - st.infl_start,
+            spec_predicted=st.infl_pred,
+            spec_mult=packed[spec_off],
+            spec_min_s=packed[spec_off + 1],
+            task_avoid_worker=st.avoid,
+        )
     tenant_kw: dict = {}
     if use_tenancy:
         # the tenant-vec tail (share ++ ahead ++ cap, 3*NT floats) rides
@@ -292,6 +355,7 @@ def _resident_tick_impl(
         auction_refresh=st.refresh if auction else None,
         bid_backend=bid_backend,
         **tenant_kw,
+        **spec_kw,
     )
 
     # -- compact placements to KP (slot, row) pairs ------------------------
@@ -323,11 +387,19 @@ def _resident_tick_impl(
     # -- compact redispatch to KR in-flight slots --------------------------
     redispatch_slots = _first_k_indices(out.redispatch, KR)
 
+    # -- compact straggler flags to KG in-flight slots (speculation) -------
+    if use_spec:
+        straggler_slots = _first_k_indices(out.straggler, KG)
+    else:
+        # inert length-KG pad so both tick backends keep one output arity
+        straggler_slots = jnp.full(KG, -1, dtype=jnp.int32)
+
     new_state = _ResidentState(
         st.sizes, valid_next, st.prio, st.tenant, st.last_hb, free_next,
         st.inflight, out.live, st.speed, st.active,
         out.auction_price if auction else st.price,
         out.tenant_deficit if use_tenancy else st.t_deficit,
+        st.infl_start, st.infl_pred, st.avoid,
         out.auction_refresh if auction else st.refresh,
     )
     res = ResidentTickOutput(
@@ -338,6 +410,7 @@ def _resident_tick_impl(
         out.purged,
         out.live,
         valid_next.sum().astype(jnp.int32),
+        straggler_slots,
     )
     return res, new_state
 
@@ -347,7 +420,7 @@ _resident_tick = partial(
     static_argnames=(
         "T", "W", "I", "KA", "KH", "KF", "KI", "KS", "KB", "KP", "KR",
         "max_slots", "placement", "use_priority", "bid_backend",
-        "use_tenancy", "NT",
+        "use_tenancy", "NT", "use_spec", "KG",
     ),
 )(_resident_tick_impl)
 
@@ -358,6 +431,9 @@ class _Arrival:
     size: float
     priority: int = 0
     tenant: int = 0  # dense tenant row (tenancy plane; 0 = default)
+    #: anti-affinity worker row (speculation plane; -1 = none): a hedge
+    #: ghost row carries its original's row so placement avoids it
+    avoid: int = -1
 
 
 @dataclass
@@ -369,6 +445,9 @@ class ResolvedTick:
     purged_rows: np.ndarray  # worker rows purged this tick
     rejected: int  # arrivals bounced (pending buffer full), re-queued
     n_pending: int  # device-side pending count after the tick
+    #: in-flight slots the tick flagged as stragglers (speculation plane;
+    #: empty when off) — hedge candidates for the dispatcher
+    straggler_slots: list = field(default_factory=list)
 
 
 class ResidentScheduler(SchedulerArrays):
@@ -391,6 +470,7 @@ class ResidentScheduler(SchedulerArrays):
     KB: int = 256  # worker-active scatters
     KP: int = 2048  # reported placements / tick
     KR: int = 512  # reported redispatches / tick
+    KG: int = 64  # reported straggler flags / tick (speculation plane)
     use_priority: bool = False
     #: dispatcher uptime (seconds) after which the heartbeat epoch is
     #: re-based — f32 epoch-relative stamps must never approach the ~2^23 s
@@ -409,11 +489,25 @@ class ResidentScheduler(SchedulerArrays):
         KB: int | None = None,
         KP: int | None = None,
         KR: int | None = None,
+        KG: int | None = None,
         tick_backend: str | None = None,
         tenancy=None,
+        spec_mult: float | None = None,
+        spec_min_s: float = 0.05,
         **kw,
     ):
         super().__init__(*args, **kw)
+        # speculation plane (tpu_faas/spec): a straggler multiplier turns
+        # it on — the state grows real infl_start/infl_pred/avoid leaves,
+        # the packet an avoid arrival lane + a pred scatter lane + a
+        # 2-float threshold tail, and the tick a KG-compacted straggler
+        # output. Off = length-1 inert leaves, packet byte-identical.
+        # The leaf SHAPES are statics, so the choice is constructor-time;
+        # the threshold VALUES ride the packet (hot-tunable).
+        self.use_spec = spec_mult is not None
+        if self.use_spec:
+            self.spec_mult = float(spec_mult)
+            self.spec_min_s = float(spec_min_s)
         # tenancy plane (tpu_faas/tenancy): a TenantTable turns the plane
         # on — the packet grows a tenant arrival lane plus the share/
         # ahead/cap tail, and the state carries tenant rows + deficits.
@@ -453,7 +547,8 @@ class ResidentScheduler(SchedulerArrays):
         self.device_dispatches_last_tick: int = 0
         self.device_dispatches_total: int = 0
         for name, v in (("KA", KA), ("KH", KH), ("KF", KF), ("KI", KI),
-                        ("KS", KS), ("KB", KB), ("KP", KP), ("KR", KR)):
+                        ("KS", KS), ("KB", KB), ("KP", KP), ("KR", KR),
+                        ("KG", KG)):
             if v is not None:
                 setattr(self, name, int(v))
         # packet capacities can't exceed the arrays they scatter into
@@ -465,6 +560,8 @@ class ResidentScheduler(SchedulerArrays):
         self.KB = min(self.KB, self.max_workers)
         self.KI = min(self.KI, self.max_inflight)
         self.KR = min(self.KR, self.max_inflight)
+        # spec off collapses the straggler output to its length-1 pad
+        self.KG = min(self.KG, self.max_inflight) if self.use_spec else 1
         self.use_priority = bool(use_priority)
         self._epoch = self.clock()
         self._arrivals: deque[_Arrival] = deque()
@@ -491,10 +588,12 @@ class ResidentScheduler(SchedulerArrays):
 
     # -- pending interface -------------------------------------------------
     def pending_add(
-        self, task_id: str, size: float, priority: int = 0, tenant: int = 0
+        self, task_id: str, size: float, priority: int = 0, tenant: int = 0,
+        avoid: int = -1,
     ) -> None:
         self._arrivals.append(
-            _Arrival(task_id, float(size), int(priority), int(tenant))
+            _Arrival(task_id, float(size), int(priority), int(tenant),
+                     int(avoid))
         )
 
     def pending_bulk_load(
@@ -526,12 +625,17 @@ class ResidentScheduler(SchedulerArrays):
         tn = np.zeros(T, dtype=np.int32)
         if tenants is not None:
             tn[:n] = np.asarray(tenants, dtype=np.int32)
-        self._r_state = self._r_state._replace(
+        replace = dict(
             sizes=self._put_task(s),
             valid=self._put_task(v),
             prio=self._put_task(p),
             tenant=self._put_task(tn),
         )
+        if self.use_spec:
+            # bulk loads are adoption backlogs, never hedges: clear the
+            # avoid leaf so no slot inherits a stale veto
+            replace["avoid"] = self._put_task(np.full(T, -1, dtype=np.int32))
+        self._r_state = self._r_state._replace(**replace)
         for i, tid in enumerate(ids):
             self.slot_task[i] = tid
             self._slot_meta[i] = _Arrival(
@@ -614,6 +718,18 @@ class ResidentScheduler(SchedulerArrays):
                 np.zeros(W * self.max_slots, dtype=np.float32)
             ),
             self._put_repl(np.zeros(self.NT, dtype=np.float32)),
+            # speculation leaves: real [I]/[I]/[T] arrays when the plane
+            # is on, length-1 inert dummies otherwise (the fused alias
+            # table keeps one leaf COUNT either way; shapes are statics)
+            self._put_repl(np.zeros(
+                self.max_inflight if self.use_spec else 1, dtype=np.float32
+            )),
+            self._put_repl(np.zeros(
+                self.max_inflight if self.use_spec else 1, dtype=np.float32
+            )),
+            (self._put_task(np.full(T, -1, dtype=np.int32))
+             if self.use_spec
+             else self._put_repl(np.full(1, -1, dtype=np.int32))),
             self._put_repl(np.asarray(True)),
         )
         self._hb_sent = hb.copy()
@@ -665,11 +781,14 @@ class ResidentScheduler(SchedulerArrays):
     def packet_len(self) -> int:
         lanes = 1 + (1 if self.use_priority else 0) + (
             1 if self.use_tenancy else 0
-        )
+        ) + (1 if self.use_spec else 0)
         return (
             _HEADER
             + self.KA * lanes
             + 2 * (self.KH + self.KF + self.KI + self.KS + self.KB)
+            # speculation: one pred lane riding the infl scatter indices
+            # plus the 2-float threshold tail (before the tenancy tail)
+            + (self.KI + 2 if self.use_spec else 0)
             # tenancy tail: share ++ ahead ++ cap vectors ride EVERY tick
             # packet (3*NT floats — tiny), so hot-reloaded shares and the
             # live inflight counts reach the kernel as values
@@ -697,11 +816,28 @@ class ResidentScheduler(SchedulerArrays):
         if self.use_tenancy:
             p[off : off + len(arrivals)] = [a.tenant for a in arrivals]
             off += KA
+        if self.use_spec:
+            p[off : off + len(arrivals)] = [a.avoid for a in arrivals]
+            off += KA
         for idx, val, K in ((hb[0], hb[1], KH), (fr[0], fr[1], KF),
-                            (infl[0], infl[1], KI), (sp[0], sp[1], KS),
-                            (ac[0], ac[1], KB)):
+                            (infl[0], infl[1], KI)):
             p[off : off + len(idx)] = idx; off += K
             p[off : off + len(val)] = val; off += K
+        if self.use_spec:
+            # pred lane: predicted runtimes for the infl scatter's slots,
+            # read off the host mirror at pack time (the mirror holds the
+            # latest pred for whatever row the delta's value carries)
+            p[off : off + len(infl[0])] = self.inflight_pred[
+                np.asarray(infl[0], dtype=np.int64)
+            ]
+            off += KI
+        for idx, val, K in ((sp[0], sp[1], KS), (ac[0], ac[1], KB)):
+            p[off : off + len(idx)] = idx; off += K
+            p[off : off + len(val)] = val; off += K
+        if self.use_spec:
+            p[off] = self.spec_mult
+            p[off + 1] = self.spec_min_s
+            off += 2
         if self.use_tenancy:
             NT = self.NT
             ten = self.tenancy
@@ -716,6 +852,7 @@ class ResidentScheduler(SchedulerArrays):
             KA=self.KA, KH=self.KH, KF=self.KF, KI=self.KI, KS=self.KS,
             KB=self.KB, use_priority=self.use_priority,
             use_tenancy=self.use_tenancy, NT=self.NT,
+            use_spec=self.use_spec, KG=self.KG,
         )
 
     # -- kernel dispatch (multihost-resident overrides these to broadcast
@@ -918,8 +1055,13 @@ class ResidentScheduler(SchedulerArrays):
         rd = np.asarray(out.redispatch_slots)
         redisp = [int(s) for s in rd if s >= 0]
         purged_rows = np.flatnonzero(np.asarray(out.purged))
+        stragglers: list[int] = []
+        if self.use_spec and out.straggler_slots is not None:
+            sg = np.asarray(out.straggler_slots)
+            stragglers = [int(s) for s in sg if s >= 0]
         return ResolvedTick(
-            placed, redisp, purged_rows, rejected, int(out.n_pending)
+            placed, redisp, purged_rows, rejected, int(out.n_pending),
+            stragglers,
         )
 
 

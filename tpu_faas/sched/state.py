@@ -53,6 +53,11 @@ def _packed_tick(
     tenant_deficit=None,  # f32[N] device-carried between ticks
     tenant_ahead=None,  # i32[N]
     tenant_cap=None,  # i32[N]
+    spec_elapsed=None,  # f32[I] speculation plane: seconds since dispatch
+    spec_predicted=None,  # f32[I] predicted runtime (<=0 = never hedge)
+    spec_mult=None,  # f32 scalar straggler multiplier
+    spec_min_s=None,  # f32 scalar absolute floor
+    task_avoid_worker=None,  # i32[T] hedge anti-affinity row (-1 = none)
     *,
     T: int,
     W: int,
@@ -103,6 +108,11 @@ def _packed_tick(
         tenant_deficit=tenant_deficit,
         tenant_ahead=tenant_ahead,
         tenant_cap=tenant_cap,
+        spec_elapsed=spec_elapsed,
+        spec_predicted=spec_predicted,
+        spec_mult=spec_mult,
+        spec_min_s=spec_min_s,
+        task_avoid_worker=task_avoid_worker,
     )
     if task_pref is not None:
         # data-locality exchange for graph children: prefer the worker
@@ -137,6 +147,11 @@ class TickOutput(NamedTuple):
     #: between ticks like the auction prices — read to host only by the
     #: /stats tenancy block
     tenant_deficit: jnp.ndarray | None = None
+    #: bool[I] straggler flags (speculation plane only, else None): in-flight
+    #: slots whose elapsed time exceeded quantile_mult x their predicted
+    #: runtime on a still-LIVE worker — hedge candidates for the dispatcher
+    #: (dead workers' slots ride ``redispatch`` instead, never both)
+    straggler: jnp.ndarray | None = None
     # NOTE deliberately NO per-worker assigned-count output: a T-wide
     # scatter-add with colliding indices measured ~0.5 ms of the ~1 ms tick
     # on v5e — and the host gets the full assignment vector anyway, where
@@ -166,6 +181,11 @@ def scheduler_tick_impl(
     tenant_cap: jnp.ndarray | None = None,  # i32[N] ceilings (0 = uncapped)
     starve_deficit: float | None = None,  # tenancy starvation-guard knobs
     starve_boost: int | None = None,
+    spec_elapsed: jnp.ndarray | None = None,  # f32[I] seconds since dispatch
+    spec_predicted: jnp.ndarray | None = None,  # f32[I] predicted runtime
+    spec_mult: jnp.ndarray | None = None,  # f32 scalar straggler multiplier
+    spec_min_s: jnp.ndarray | None = None,  # f32 scalar absolute floor
+    task_avoid_worker: jnp.ndarray | None = None,  # i32[T] forbidden row
 ) -> TickOutput:
     # -- failure detection (reference purge_workers, device-side) ----------
     # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
@@ -180,6 +200,37 @@ def scheduler_tick_impl(
     occupied = iw >= 0
     worker_of = jnp.clip(iw, 0)
     redispatch = occupied & ~live[worker_of]
+
+    # -- speculation plane (tpu_faas/spec): straggler scoring rides the
+    # SAME liveness pass — a slot flags only while its worker is still
+    # LIVE (a dead worker's slot is a redispatch, never a hedge; the two
+    # sets are disjoint by construction). Flat stacks (spec args None)
+    # trace the byte-identical pre-speculation graph.
+    straggler = None
+    if spec_elapsed is not None:
+        from tpu_faas.spec.straggler import straggler_flags_impl
+
+        straggler = straggler_flags_impl(
+            spec_elapsed,
+            spec_predicted,
+            occupied & live[worker_of],
+            spec_mult,
+            spec_min_s,
+        )
+
+    def _veto(assignment):
+        """Anti-affinity for hedge ghost rows (tpu_faas/spec): veto the
+        one useless pairing — a replica placed on its original's worker —
+        then re-place the vetoed tail onto remaining capacity, composed
+        into the device step after placement like the tenancy cap mask
+        composes before it. None = no-op, identical trace."""
+        if task_avoid_worker is None:
+            return assignment
+        from tpu_faas.spec.straggler import hedge_fixup_impl
+
+        return hedge_fixup_impl(
+            assignment, task_avoid_worker, worker_speed, worker_free, live
+        )
 
     # -- tenancy plane (tpu_faas/tenancy): inflight-cap eligibility masks
     # task_valid for EVERY placement kernel, and the weighted-fair +
@@ -239,9 +290,11 @@ def scheduler_tick_impl(
             max_slots=max_slots, init_price=auction_price,
             carry_refresh=auction_refresh, backend=bid_backend,
         )
+        assignment = _veto(res.assignment)
         return TickOutput(
-            res.assignment, live, purged, redispatch, res.prices,
-            res.refresh, tenant_deficit=_deficit_out(res.assignment),
+            assignment, live, purged, redispatch, res.prices,
+            res.refresh, tenant_deficit=_deficit_out(assignment),
+            straggler=straggler,
         )
     elif placement == "sinkhorn":
         T, W = task_size.shape[0], worker_speed.shape[0]
@@ -274,9 +327,11 @@ def scheduler_tick_impl(
     else:
         raise ValueError(f"unknown placement kernel {placement!r}")
 
+    assignment = _veto(assignment)
     return TickOutput(
         assignment, live, purged, redispatch,
         tenant_deficit=_deficit_out(assignment),
+        straggler=straggler,
     )
 
 
@@ -370,6 +425,21 @@ class SchedulerArrays:
         self.inflight_worker: np.ndarray = np.full(
             self.max_inflight, -1, dtype=np.int32
         )
+        # speculation plane (tpu_faas/spec): per-slot dispatch stamp (f64
+        # monotonic, host-side only — the device sees f32 AGES like the
+        # heartbeats) and predicted runtime in seconds (0 = not hedge-
+        # eligible: non-speculative submit, or no seconds-unit prediction)
+        self.inflight_started: np.ndarray = np.zeros(
+            self.max_inflight, dtype=np.float64
+        )
+        self.inflight_pred: np.ndarray = np.zeros(
+            self.max_inflight, dtype=np.float32
+        )
+        #: straggler threshold (speculation plane): None = plane off, the
+        #: tick traces its pre-speculation graph; the dispatcher sets both
+        #: from its --speculate-* knobs
+        self.spec_mult: float | None = None
+        self.spec_min_s: float = 0.05
         self._inflight_slot: dict[str, int] = {}  # task_id -> slot
         self._free_inflight: list[int] = list(range(self.max_inflight - 1, -1, -1))
         # device mirror of inflight_worker, updated by small scatters: the
@@ -461,12 +531,17 @@ class SchedulerArrays:
         if self._d_inflight is not None:
             self._inflight_delta[slot] = row
 
-    def inflight_add(self, task_id: str, row: int) -> int:
+    def inflight_add(self, task_id: str, row: int, pred: float = 0.0) -> int:
+        """``pred`` (speculation plane) is the predicted runtime in seconds
+        on THIS worker; > 0 makes the slot straggler-scorable in-tick.
+        0 (the default, and every non-speculative caller) opts out."""
         if not self._free_inflight:
             raise RuntimeError("inflight table full; raise max_inflight")
         slot = self._free_inflight.pop()
         self.inflight_task[slot] = task_id
         self.inflight_worker[slot] = row
+        self.inflight_started[slot] = self.clock()
+        self.inflight_pred[slot] = max(0.0, float(pred))
         self._note_inflight(slot, row)
         self._inflight_slot[task_id] = slot
         return slot
@@ -496,6 +571,8 @@ class SchedulerArrays:
         row = int(self.inflight_worker[slot])
         self.inflight_task[slot] = None
         self.inflight_worker[slot] = -1
+        self.inflight_started[slot] = 0.0
+        self.inflight_pred[slot] = 0.0
         self._note_inflight(slot, -1)
         self._free_inflight.append(slot)
         return row
@@ -511,6 +588,8 @@ class SchedulerArrays:
         tid = self.inflight_task[slot]
         self.inflight_task[slot] = None
         self.inflight_worker[slot] = -1
+        self.inflight_started[slot] = 0.0
+        self.inflight_pred[slot] = 0.0
         self._note_inflight(slot, -1)
         if tid is not None:
             self._inflight_slot.pop(tid, None)
@@ -592,6 +671,7 @@ class SchedulerArrays:
         dep_edges: tuple[np.ndarray, np.ndarray] | None = None,
         task_pref: np.ndarray | None = None,
         task_tenants: np.ndarray | None = None,
+        task_avoid: np.ndarray | None = None,
     ) -> TickOutput:
         """Run the fused device step for the current pending batch.
 
@@ -621,6 +701,14 @@ class SchedulerArrays:
             raise ValueError(
                 "the tenancy plane is single-device only in the one-shot "
                 "tick; mesh/multihost fleets run without in-tick fairness"
+            )
+        spec_on = self.spec_mult is not None
+        if (spec_on or task_avoid is not None) and (
+            self.multihost is not None or self.mesh is not None
+        ):
+            raise ValueError(
+                "the speculation plane is single-device only; mesh/"
+                "multihost fleets run without straggler hedging"
             )
         if n > self.max_pending:
             raise ValueError(f"{n} pending > max_pending={self.max_pending}")
@@ -703,6 +791,24 @@ class SchedulerArrays:
                     tenant_ahead=jnp.asarray(ten.inflight.copy()),
                     tenant_cap=self._cached_dev("tenant_cap", ten.cap),
                 )
+            spec_kw: dict = {}
+            if spec_on:
+                # speculation lanes (tpu_faas/spec): elapsed ages are
+                # computed host-side like the heartbeat ages (f64 stamps
+                # never cross the wire); pred ships as a snapshot — the
+                # act loop mutates it the moment tick() returns
+                spec_kw = dict(
+                    spec_elapsed=jnp.asarray(
+                        (now_f - self.inflight_started).astype(np.float32)
+                    ),
+                    spec_predicted=jnp.asarray(self.inflight_pred.copy()),
+                    spec_mult=jnp.float32(self.spec_mult),
+                    spec_min_s=jnp.float32(self.spec_min_s),
+                )
+            if task_avoid is not None:
+                av = np.full(T, -1, dtype=np.int32)
+                av[:n] = task_avoid
+                spec_kw["task_avoid_worker"] = jnp.asarray(av)
             out = _packed_tick(
                 jnp.asarray(packed),
                 jnp.int32(n),
@@ -725,6 +831,7 @@ class SchedulerArrays:
                     None if task_pref is None else jnp.asarray(task_pref)
                 ),
                 **tenant_kw,
+                **spec_kw,
                 T=T,
                 W=W,
                 max_slots=self.max_slots,

@@ -1,0 +1,34 @@
+"""Speculation plane: device-scored straggler hedging with first-wins
+replica results.
+
+``straggler`` holds the device kernels (flagging + anti-affinity, traced
+inside the scheduler step by both tick backends); ``policy`` holds the
+host-side hedge book and the opt-in knobs. Everything is off — and every
+surface byte-identical — until a dispatcher runs with ``--speculate-mult``
+AND a submit carries ``speculative=true``.
+"""
+
+from tpu_faas.spec.policy import HedgeEntry, SpeculationPolicy
+from tpu_faas.spec.straggler import (
+    DEFAULT_MIN_RUNTIME_S,
+    HEDGE_FIXUP_K,
+    anti_affinity_veto,
+    anti_affinity_veto_impl,
+    hedge_fixup,
+    hedge_fixup_impl,
+    straggler_flags,
+    straggler_flags_impl,
+)
+
+__all__ = [
+    "DEFAULT_MIN_RUNTIME_S",
+    "HEDGE_FIXUP_K",
+    "HedgeEntry",
+    "SpeculationPolicy",
+    "anti_affinity_veto",
+    "anti_affinity_veto_impl",
+    "hedge_fixup",
+    "hedge_fixup_impl",
+    "straggler_flags",
+    "straggler_flags_impl",
+]

@@ -1,0 +1,169 @@
+"""Device-side straggler scoring + anti-affinity for the speculation plane.
+
+The tail-latency bet (Dean & Barroso, "The Tail at Scale", CACM 2013;
+PAPERS.md): when one execution of a task runs demonstrably longer than its
+predicted runtime, a second copy on a DIFFERENT worker bounds the task's
+latency by the second-fastest machine instead of the sickest one. The
+ingredients already exist in this system — the estimator's size x speed
+runtime predictions ride the in-flight table, and the store's first-wins
+``finish_task`` arbitrates two results for one id — this module adds the
+two device-side pieces that compose them into the tick:
+
+- :func:`straggler_flags_impl` — flag in-flight slots whose observed
+  elapsed time exceeds ``quantile_mult x`` their predicted runtime (with an
+  absolute floor so sub-millisecond noise never hedges). One vectorized
+  compare over the in-flight table, traced INSIDE the scheduler step by
+  BOTH tick backends (the jitted XLA resident tick and the fused Pallas
+  kernel trace the same ``_impl`` — the PR-11/13 pattern), so flagging
+  costs no extra device dispatch.
+- :func:`anti_affinity_veto_impl` — a hedge candidate re-enters the
+  placement problem as an ordinary pending row carrying the row index of
+  the worker already running its original; the veto masks the one
+  (task, worker) pairing that would be useless (a replica racing on the
+  SAME sick worker), composed into the device step after placement exactly
+  like the tenancy cap mask composes before it. The vetoed task stays
+  valid and is re-placed next tick against whatever capacity exists
+  elsewhere — a hedge never launches onto its original's worker, and never
+  silently drops.
+
+Both kernels follow the solver stack's ``_impl`` convention: the un-jitted
+core is what ``scheduler_tick_impl`` traces (a pjit primitive inside a
+pallas_call body does not lower), the jitted twin serves direct callers
+and unit tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: default absolute floor (seconds) under which an execution is never
+#: flagged, whatever the multiplier says: predictions for sub-hundred-ms
+#: tasks carry scheduling jitter comparable to the runtime itself, and a
+#: hedge there burns a slot to save nothing
+DEFAULT_MIN_RUNTIME_S = 0.05
+
+
+def straggler_flags_impl(
+    inflight_elapsed: jnp.ndarray,  # f32[I] seconds since dispatch
+    inflight_predicted: jnp.ndarray,  # f32[I] predicted runtime, <=0 = opt out
+    inflight_occupied: jnp.ndarray,  # bool[I] slot holds a live dispatch
+    quantile_mult: jnp.ndarray,  # f32 scalar: flag past mult x predicted
+    min_runtime_s: jnp.ndarray,  # f32 scalar: absolute floor
+) -> jnp.ndarray:
+    """bool[I]: in-flight slots whose execution has outlived its prediction.
+
+    A slot opts out of hedging with ``predicted <= 0`` — the dispatcher
+    stamps a positive prediction only for tasks that are hedge-eligible
+    (submit-gated ``speculative`` AND a runtime prediction in seconds:
+    client cost hint or learned estimate; payload-byte fallback sizes are
+    not seconds and never hedge). The threshold is
+    ``max(quantile_mult x predicted, min_runtime_s)`` so a tight
+    prediction on a tiny task cannot hedge on scheduling noise."""
+    threshold = jnp.maximum(
+        quantile_mult * inflight_predicted, min_runtime_s
+    )
+    return (
+        inflight_occupied
+        & (inflight_predicted > 0.0)
+        & (inflight_elapsed > threshold)
+    )
+
+
+straggler_flags = jax.jit(straggler_flags_impl)
+
+
+def anti_affinity_veto_impl(
+    assignment: jnp.ndarray,  # i32[T] placement output, -1 = queued
+    task_avoid_worker: jnp.ndarray,  # i32[T] forbidden row per task, -1 none
+) -> jnp.ndarray:
+    """Mask placements that landed a task on its forbidden worker row.
+
+    The vetoed task's assignment reverts to -1 (stays queued/valid — the
+    resident kernel only clears slots it reports placed, so the ghost row
+    re-enters next tick's problem); every other pairing passes through
+    untouched. Flat workloads (all -1) trace to a no-op compare."""
+    veto = (task_avoid_worker >= 0) & (assignment == task_avoid_worker)
+    return jnp.where(veto, -1, assignment)
+
+
+anti_affinity_veto = jax.jit(anti_affinity_veto_impl)
+
+
+#: per-tick bound on vetoed ghost rows re-placed by the fixup pass below:
+#: hedges are budget-bounded rarities, and a surplus simply waits a tick
+HEDGE_FIXUP_K = 64
+
+
+def hedge_fixup_impl(
+    assignment: jnp.ndarray,  # i32[T] placement output
+    task_avoid_worker: jnp.ndarray,  # i32[T] forbidden row (-1 = none)
+    worker_speed: jnp.ndarray,  # f32[W]
+    worker_free: jnp.ndarray,  # i32[W] capacity the placement pass saw
+    worker_live: jnp.ndarray,  # bool[W]
+) -> jnp.ndarray:
+    """Anti-affinity composed into the device step: veto + re-place.
+
+    The placement kernels are rank/price matchers with no per-(task,
+    worker) exclusion lane, so the forbidden pairing is masked AFTER
+    placement (:func:`anti_affinity_veto_impl`) — but a bare veto starves
+    under rank's deterministic tie-break: the same ghost row keeps winning
+    the same forbidden slot every tick. This fixup closes the loop inside
+    the same traced step: up to :data:`HEDGE_FIXUP_K` vetoed rows are
+    re-placed greedily onto the fastest live worker with capacity REMAINING
+    after the main pass, excluding each row's own forbidden worker —
+    rank's largest-task/fastest-slot pairing applied to the hedge tail.
+    A ghost row with no eligible capacity stays queued (a hedge must never
+    launch onto its original's worker, and never silently drops). Flat
+    ticks never trace this: the caller gates on the avoid lane existing.
+    """
+    T = assignment.shape[0]
+    W = worker_speed.shape[0]
+    veto = (task_avoid_worker >= 0) & (assignment == task_avoid_worker)
+    assignment = jnp.where(veto, -1, assignment)
+    # capacity remaining after the main pass (one bounded scatter-add —
+    # only traced on speculation-enabled ticks)
+    placed = assignment >= 0
+    counts = (
+        jnp.zeros(W, dtype=jnp.int32)
+        .at[jnp.where(placed, assignment, W)]
+        .add(1, mode="drop")
+    )
+    free_rem = jnp.maximum(
+        jnp.where(worker_live, worker_free, 0) - counts, 0
+    )
+    # compact the vetoed rows to the fixup bound (first-K in index order)
+    pos = jnp.cumsum(veto) - 1
+    idx = jnp.where(veto & (pos < HEDGE_FIXUP_K), pos, HEDGE_FIXUP_K)
+    vet_idx = (
+        jnp.full(HEDGE_FIXUP_K, -1, dtype=jnp.int32)
+        .at[idx]
+        .set(jnp.arange(T, dtype=jnp.int32), mode="drop")
+    )
+    rows = jnp.arange(W, dtype=jnp.int32)
+
+    def body(k, carry):
+        assignment, free_rem = carry
+        t = vet_idx[k]
+        safe_t = jnp.clip(t, 0)
+        avoid = task_avoid_worker[safe_t]
+        score = jnp.where(
+            worker_live & (free_rem > 0) & (rows != avoid),
+            worker_speed,
+            -jnp.inf,
+        )
+        row = jnp.argmax(score).astype(jnp.int32)
+        can = (t >= 0) & (score[row] > -jnp.inf)
+        assignment = assignment.at[jnp.where(can, safe_t, T)].set(
+            row, mode="drop"
+        )
+        free_rem = free_rem.at[row].add(jnp.where(can, -1, 0))
+        return assignment, free_rem
+
+    assignment, _ = jax.lax.fori_loop(
+        0, HEDGE_FIXUP_K, body, (assignment, free_rem)
+    )
+    return assignment
+
+
+hedge_fixup = jax.jit(hedge_fixup_impl)

@@ -1121,6 +1121,15 @@ class TaskStore(abc.ABC):
         stores; ``racecheck.RaceCheckStore`` overrides it so its monitor can
         tell deliberate re-dispatch from a double-dispatch bug."""
 
+    def declare_replica(self, task_id: str) -> None:
+        """Protocol-checker hook (speculation plane, tpu_faas/spec): the
+        caller is about to dispatch a HEDGE replica of a still-running
+        ``task_id`` — a deliberate second RUNNING mark whose result race
+        is arbitrated by finish_task's first-wins contract. No-op on real
+        stores; ``racecheck.RaceCheckStore`` overrides it so its monitor
+        can tell a declared hedge from a double-dispatch bug and prove no
+        double-completion at runtime."""
+
     def __enter__(self) -> "TaskStore":
         return self
 

@@ -142,6 +142,12 @@ class _TaskState:
     #: a force-cancel (!kill) was requested for this task — a worker's
     #: result-bearing CANCELLED write is lawful only with this set
     kill_requested: bool = False
+    #: a hedge replica was declared (speculation plane): the loser's
+    #: CANCELLED-after-winner-terminal write is the expected kill
+    #: confirmation (warning with hedge attribution, never an error);
+    #: double-COMPLETION with a different result stays a terminal-
+    #: overwrite ERROR — that is what "first-wins held" means at runtime
+    replica_declared: bool = False
 
 
 class RaceMonitor:
@@ -235,6 +241,20 @@ class RaceMonitor:
         not a double-dispatch bug."""
         with self._lock:
             self._state(task_id).redispatch_credits += 1
+
+    def expect_replica(self, task_id: str) -> None:
+        """Declare a hedge replica (speculation plane, tpu_faas/spec): the
+        next RUNNING -> RUNNING write is the replica's deliberate dispatch
+        beside a still-running original (one redispatch credit), and the
+        eventual loser's CANCELLED write over the winner's terminal record
+        is the kill confirmation (hedge-loser warning, not an error). The
+        monitor still proves no double-COMPLETION: a second terminal write
+        carrying a DIFFERENT result stays a terminal-overwrite error —
+        first_wins at the store is what keeps it from ever appearing."""
+        with self._lock:
+            state = self._state(task_id)
+            state.redispatch_credits += 1
+            state.replica_declared = True
 
     # -- observation -------------------------------------------------------
     def observe(
@@ -360,6 +380,22 @@ class RaceMonitor:
                 )
                 return
             if to in _NEVER_RAN and frm in ("COMPLETED", "FAILED"):
+                if state.replica_declared and to == "CANCELLED":
+                    # hedge loser reporting in after the winner's terminal
+                    # write landed (speculation plane): the CANCEL kill
+                    # confirmation for a declared replica — expected, and
+                    # first_wins froze the record before this write could
+                    # even be attempted through finish_task
+                    self._flag(
+                        "hedge-loser-cancelled",
+                        "warning",
+                        event.task_id,
+                        f"{event.actor} wrote CANCELLED over terminal "
+                        f"{frm} for a declared hedge replica: the loser's "
+                        f"kill confirmation; the winner's record stands",
+                        prior + (event,),
+                    )
+                    return
                 # the sub-millisecond-task interleaving: the result landed
                 # inside the cancel/shed's read->write window and its
                 # write transiently clobbered it — lawful because the
@@ -517,6 +553,10 @@ class RaceCheckStore(TaskStore):
     def declare_redispatch(self, task_id: str) -> None:
         self.monitor.expect_redispatch(task_id)
         self.inner.declare_redispatch(task_id)
+
+    def declare_replica(self, task_id: str) -> None:
+        self.monitor.expect_replica(task_id)
+        self.inner.declare_replica(task_id)
 
     def request_kill(
         self, task_id: str, channel: str = TASKS_CHANNEL

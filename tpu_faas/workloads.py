@@ -47,6 +47,21 @@ def failing_task(msg: str = "boom") -> None:
     raise ValueError(msg)
 
 
+def straggler_sleep(seconds: float) -> float:
+    """sleep_task whose runtime additionally depends on WHICH worker runs
+    it: a worker process started with ``TPU_FAAS_EXEC_DELAY_S`` in its
+    environment (the pool children inherit it) adds that many seconds to
+    every execution. The deterministic sick-worker injector behind bench
+    config 18 (tail-hedging) and the speculation-plane tests — the same
+    function is fast on a healthy worker and a straggler on the slow one,
+    exactly the hedged-request scenario of "The Tail at Scale"."""
+    import os
+
+    delay = float(os.environ.get("TPU_FAAS_EXEC_DELAY_S", "0") or 0.0)
+    time.sleep(seconds + delay)
+    return seconds
+
+
 def _params_no_op(n_tasks: int, size: int, rng: random.Random):
     return [((), {}) for _ in range(n_tasks)]
 
