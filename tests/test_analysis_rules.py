@@ -1787,3 +1787,1104 @@ def test_trace_partial_jit_assignment_wrap_is_a_root(tmp_path):
         ("trace.host-time", 6),
         ("trace.data-dependent-branch", 9),
     ]
+
+
+# -- kernelparity ------------------------------------------------------------
+
+
+def test_kernelparity_group_order_drift_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def kernel(st, run):
+            return run(st.a, st.b, st.d, st.c)
+        """,
+    )
+    assert hits(findings) == [("kernelparity.state-leaf-drift", 10)]
+    assert "out of declaration order" in findings[0].message
+
+
+def test_kernelparity_group_missing_leaf_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class PodState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # f32[T]
+            c: object  # f32[T]
+            d: object  # f32[T]
+            e: object  # f32[T]
+            f: object  # f32[T]
+            g: object  # f32[T]
+            h: object  # f32[T]
+
+        def kernel(st, run):
+            return run(st.a, st.b, st.c, st.d, st.e, st.f, st.g)
+        """,
+    )
+    assert hits(findings) == [("kernelparity.state-leaf-drift", 14)]
+    assert "missing ['h']" in findings[0].message
+
+
+def test_kernelparity_full_consumption_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def kernel(st, run):
+            return run(st.a, st.b, st.c, st.d)
+
+        def tick(st):
+            return (st.a, st.b, st.c, st.d)
+        """,
+    )
+    assert findings == []
+
+
+def test_kernelparity_partial_reads_below_threshold_clean(tmp_path):
+    """Helper sites reading a handful of leaves out of order (the XLA
+    tick's scheduler_tick_impl shape) are not full-consumption sites."""
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class PodState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # f32[T]
+            c: object  # f32[T]
+            d: object  # f32[T]
+            e: object  # f32[T]
+            f: object  # f32[T]
+            g: object  # f32[T]
+            h: object  # f32[T]
+
+        def helper(st, run):
+            return run(st.e, st.a, st.c)
+        """,
+    )
+    assert findings == []
+
+
+def test_kernelparity_ctor_arity_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def rebuild(x, y, z):
+            return FooState(x, y, z)
+        """,
+    )
+    assert hits(findings) == [("kernelparity.state-leaf-drift", 10)]
+    assert "constructs 3 leaves" in findings[0].message
+
+
+def test_kernelparity_ctor_positional_token_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def rebuild(st, b_new, d_new):
+            return FooState(st.a, st.c, b_new, d_new)
+        """,
+    )
+    assert hits(findings) == [("kernelparity.state-leaf-drift", 10)]
+    assert "'c' at position 1" in findings[0].message
+
+
+def test_kernelparity_ctor_mixed_computed_leaves_clean(tmp_path):
+    """The resident tick's constructor shape: passthrough st.* leaves at
+    their declared positions interleaved with freshly-computed values."""
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def rebuild(st, b_next, flag):
+            return FooState(st.a, b_next, st.c, st.d if flag else st.d)
+        """,
+    )
+    assert findings == []
+
+
+def test_kernelparity_alias_span_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def build(pallas_call, kern):
+            return pallas_call(
+                kern,
+                input_output_aliases={k: 2 + k for k in range(1, 4)},
+            )
+        """,
+    )
+    assert [f.rule for f in findings] == ["kernelparity.state-leaf-drift"]
+    assert "spans 3 state operands" in findings[0].message
+
+
+def test_kernelparity_spec_tuple_length_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def build(pallas_call, kern, ps):
+            in_specs = (ps, ps, ps, ps)
+            return pallas_call(
+                kern,
+                input_output_aliases={k: 2 + k for k in range(1, 5)},
+            )
+        """,
+    )
+    assert [f.rule for f in findings] == ["kernelparity.state-leaf-drift"]
+    assert "in_specs holds 4 entries but 5 are required" in findings[0].message
+
+
+def test_kernelparity_out_shape_dtype_drift_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        import jax.numpy as jnp
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def build(pallas_call, kern, ps):
+            f32, i32, b = jnp.float32, jnp.int32, jnp.bool_
+            out_shape = (
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), b),
+            )
+            return pallas_call(
+                kern,
+                input_output_aliases={k: 2 + k for k in range(1, 5)},
+            )
+        """,
+    )
+    assert [f.rule for f in findings] == ["kernelparity.state-dtype-drift"]
+    assert "leaf 'b' as f32" in findings[0].message
+
+
+def test_kernelparity_out_shape_dtypes_match_comments_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        import jax.numpy as jnp
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def build(pallas_call, kern, ps):
+            f32, i32, b = jnp.float32, jnp.int32, jnp.bool_
+            out_shape = (
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), i32),
+                jax.ShapeDtypeStruct((4,), f32),
+                jax.ShapeDtypeStruct((4,), b),
+            )
+            return pallas_call(
+                kern,
+                input_output_aliases={k: 1 + k for k in range(1, 5)},
+            )
+        """,
+    )
+    assert findings == []
+
+
+def test_kernelparity_twin_unknown_kwarg_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def tick_impl(x, y, mode="fast"):
+            return x
+
+        def run(x, y):
+            return tick_impl(x, y, lanes=4)
+        """,
+    )
+    assert hits(findings) == [("kernelparity.twin-signature-drift", 5)]
+    assert "['lanes']" in findings[0].message
+
+
+def test_kernelparity_twin_required_coverage_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        def tick_impl(x, y, z):
+            return x
+
+        def run(x):
+            return tick_impl(x)
+        """,
+    )
+    assert hits(findings) == [("kernelparity.twin-signature-drift", 5)]
+    assert "['y', 'z']" in findings[0].message
+
+
+def test_kernelparity_twin_splat_resolved_clean(tmp_path):
+    """The fused tick's ``**statics`` idiom: a local dict literal (even
+    one bound in an enclosing scope) closes the kwarg set."""
+    findings = check(
+        tmp_path,
+        """\
+        def tick_impl(x, T, S):
+            return x
+
+        def outer(x):
+            statics = dict(T=4, S=8)
+
+            def run():
+                return tick_impl(x, **statics)
+
+            return run
+        """,
+    )
+    assert findings == []
+
+
+def test_kernelparity_jit_static_argnames_drift_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        from functools import partial
+
+        def solve_impl(x, mode):
+            return x
+
+        solve = partial(jax.jit, static_argnames=("mode", "lanes"))(solve_impl)
+        """,
+    )
+    assert [f.rule for f in findings] == ["kernelparity.twin-signature-drift"]
+    assert "['lanes']" in findings[0].message
+
+
+def test_kernelparity_suppressible(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        from typing import NamedTuple
+
+        class FooState(NamedTuple):
+            a: object  # f32[T]
+            b: object  # i32[T]
+            c: object  # f32[T]
+            d: object  # bool[T]
+
+        def kernel(st, run):
+            return run(st.a, st.b, st.d, st.c)  # faas: allow(kernelparity)
+        """,
+    )
+    assert findings == []
+
+
+def test_kernelparity_real_tree_registry_pin():
+    """Real-tree synchronization pin: the 16-leaf _ResidentState registry
+    is derived from the shipped declarations, and the shipped backends
+    carry zero parity findings."""
+    import tpu_faas.sched.pallas_fused as pf
+    import tpu_faas.sched.pallas_kernels as pk
+    import tpu_faas.sched.resident as rs
+    from tpu_faas.analysis import KernelParityChecker
+    from tpu_faas.analysis.core import Module
+
+    checker = KernelParityChecker()
+    for mod in (rs, pf, pk):
+        path = Path(mod.__file__)
+        m = Module.parse(path, path.name, path.read_text(encoding="utf-8"))
+        list(checker.check(m))
+    assert list(checker.finalize()) == []
+    regs = {r.name: r.leaves for r in checker.registries}
+    assert regs["_ResidentState"] == [
+        "sizes", "valid", "prio", "tenant", "last_hb", "free",
+        "inflight", "prev_live", "speed", "active", "price",
+        "t_deficit", "infl_start", "infl_pred", "avoid", "refresh",
+    ]
+
+
+def test_kernelparity_live_mutation_drop_leaf_flips_gate(tmp_path, capsys):
+    """The ISSUE's live-verified mutation: delete one state leaf from only
+    the Pallas consumer and the strict gate flips from 0 to 1."""
+    (tmp_path / "state.py").write_text(
+        textwrap.dedent(
+            """\
+            from typing import NamedTuple
+
+            class PodState(NamedTuple):
+                a: object  # f32[T]
+                b: object  # f32[T]
+                c: object  # f32[T]
+                d: object  # f32[T]
+                e: object  # f32[T]
+                f: object  # f32[T]
+                g: object  # f32[T]
+                h: object  # f32[T]
+
+            def xla_tick(st, run):
+                return run(st.a, st.b, st.c, st.d, st.e, st.f, st.g, st.h)
+            """
+        )
+    )
+    pallas_full = textwrap.dedent(
+        """\
+        def pallas_tick(st, run):
+            return run(st.a, st.b, st.c, st.d, st.e, st.f, st.g, st.h)
+        """
+    )
+    (tmp_path / "pallas.py").write_text(pallas_full)
+    assert analysis_main(["--strict", str(tmp_path)]) == 0
+    capsys.readouterr()
+    (tmp_path / "pallas.py").write_text(
+        pallas_full.replace(", st.e", "")
+    )
+    assert analysis_main(["--strict", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "kernelparity.state-leaf-drift" in out
+    assert "pallas.py" in out
+
+
+# -- devicesnapshot ----------------------------------------------------------
+
+
+def test_devicesnapshot_asarray_then_index_assign_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax.numpy as jnp
+
+        def f(host):
+            dev = jnp.asarray(host)
+            host[0] = 1.0
+            return dev
+        """,
+    )
+    assert hits(findings) == [("devicesnapshot.unsnapshotted-upload", 4)]
+    assert "mutated in place at line 5" in findings[0].message
+
+
+def test_devicesnapshot_device_put_attr_chain_and_augassign_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+
+        class S:
+            def push(self):
+                dev = jax.device_put(self.buf)
+                self.buf += 1
+                return dev
+        """,
+    )
+    assert hits(findings) == [("devicesnapshot.unsnapshotted-upload", 5)]
+
+
+def test_devicesnapshot_mutating_method_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax.numpy as jnp
+
+        def f(host):
+            dev = jnp.asarray(host)
+            host.fill(0)
+            return dev
+        """,
+    )
+    assert hits(findings) == [("devicesnapshot.unsnapshotted-upload", 4)]
+
+
+def test_devicesnapshot_copy_upload_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax.numpy as jnp
+
+        def f(host):
+            dev = jnp.asarray(host.copy())
+            host[0] = 1.0
+            return dev
+        """,
+    )
+    assert findings == []
+
+
+def test_devicesnapshot_mutate_before_upload_clean(tmp_path):
+    """The build-then-upload idiom: locals that finish mutating before
+    the transfer are snapshots by construction."""
+    findings = check(
+        tmp_path,
+        """\
+        import jax.numpy as jnp
+
+        def f(n):
+            host = [0] * n
+            host[0] = 1.0
+            return jnp.asarray(host)
+        """,
+    )
+    assert findings == []
+
+
+def test_devicesnapshot_rebind_breaks_aliasing_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax.numpy as jnp
+
+        def f(host):
+            dev = jnp.asarray(host)
+            host = host * 2
+            host[0] = 1.0
+            return dev, host
+        """,
+    )
+    assert findings == []
+
+
+def test_devicesnapshot_np_asarray_is_host_side_and_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import numpy as np
+
+        def f(host):
+            mirror = np.asarray(host)
+            host[0] = 1.0
+            return mirror
+        """,
+    )
+    assert findings == []
+
+
+def test_devicesnapshot_nested_scopes_are_independent(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax.numpy as jnp
+
+        def f(host):
+            dev = jnp.asarray(host)
+
+            def later():
+                host[0] = 1.0
+
+            return dev, later
+        """,
+    )
+    assert findings == []
+
+
+def test_devicesnapshot_suppressible(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax.numpy as jnp
+
+        def f(host):
+            dev = jnp.asarray(host)  # faas: allow(devicesnapshot)
+            host[0] = 1.0
+            return dev
+        """,
+    )
+    assert findings == []
+
+
+def test_devicesnapshot_real_sched_and_push_dispatch_clean():
+    """The PR 5 bug class stays fixed: every shipped upload in the
+    scheduler and the TPU push dispatcher is a snapshot."""
+    import tpu_faas.dispatch.tpu_push as tp
+    import tpu_faas.sched as sched
+
+    findings = run_paths(
+        [Path(sched.__file__).parent, Path(tp.__file__)]
+    )
+    assert [
+        f for f in findings if f.rule.startswith("devicesnapshot.")
+    ] == []
+
+
+# -- planegate ---------------------------------------------------------------
+
+
+def test_planegate_ungated_field_write_fires(tmp_path):
+    """The ISSUE's live-verified mutation shape: a FIELD_* write gated by
+    its plane flag at one site and naked at another."""
+    findings = check(
+        tmp_path,
+        """\
+        CAP_TRACE = "trace"
+        FIELD_TRACE_ID = "t0"
+
+        def submit(extra, ctx, tid):
+            if ctx.trace:
+                extra[FIELD_TRACE_ID] = tid
+
+        def observe(extra, tid):
+            extra[FIELD_TRACE_ID] = tid
+        """,
+    )
+    assert hits(findings) == [("planegate.ungated-field-write", 9)]
+
+
+def test_planegate_gate_forms_are_recognized_clean(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        CAP_BLOB = "blob"
+        FIELD_FN_DIGEST = "fn_digest"
+
+        def negotiated(extra, caps, dig):
+            if CAP_BLOB in caps:
+                extra[FIELD_FN_DIGEST] = dig
+
+        def flagged(extra, use_payload_plane, dig):
+            if use_payload_plane:
+                extra[FIELD_FN_DIGEST] = dig
+
+        def ctx_attr(extra, ctx, dig):
+            if ctx.payload_plane and dig:
+                extra[FIELD_FN_DIGEST] = dig
+        """,
+    )
+    assert findings == []
+
+
+def test_planegate_presence_gate_satisfies_but_never_registers(tmp_path):
+    """A value-presence check satisfies a gated write (the round-trip
+    idiom) but cannot itself register a field as plane-gated."""
+    findings = check(
+        tmp_path,
+        """\
+        CAP_TRACE = "trace"
+        FIELD_TRACE_ID = "t0"
+        FIELD_SUBMITTED_AT = "s0"
+
+        def submit(extra, ctx, tid, now):
+            if ctx.trace:
+                extra[FIELD_TRACE_ID] = tid
+            extra[FIELD_SUBMITTED_AT] = repr(now)
+
+        def restore(extra, tid, at):
+            if tid is not None:
+                extra[FIELD_TRACE_ID] = tid
+            if at is not None:
+                extra[FIELD_SUBMITTED_AT] = at
+
+        def stamp(extra, now):
+            extra[FIELD_SUBMITTED_AT] = repr(now)
+        """,
+    )
+    assert findings == []
+
+
+def test_planegate_else_branch_does_not_inherit_gate(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        CAP_TRACE = "trace"
+        FIELD_TRACE_ID = "t0"
+
+        def submit(extra, ctx, tid):
+            if ctx.trace:
+                extra[FIELD_TRACE_ID] = tid
+            else:
+                extra[FIELD_TRACE_ID] = "missing"
+        """,
+    )
+    assert hits(findings) == [("planegate.ungated-field-write", 8)]
+
+
+def test_planegate_unknown_capability_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        CAP_TRACE = "trace"
+
+        def negotiate(caps):
+            return CAP_TRACING in caps
+        """,
+    )
+    assert hits(findings) == [("planegate.unknown-capability", 4)]
+
+
+def test_planegate_ungated_wire_write_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        CAP_TRACE = "trace"
+        FIELD_TRACE_ID = "trace_id"
+
+        def frame(out, ctx, tid):
+            if ctx.trace:
+                out["trace_id"] = tid
+
+        def echo(out, tid):
+            out["trace_id"] = tid
+        """,
+    )
+    assert hits(findings) == [("planegate.ungated-wire-write", 9)]
+
+
+def test_planegate_non_vocab_wire_keys_unconstrained(tmp_path):
+    """A literal dict key outside the FIELD_* vocabulary must not be
+    conscripted by an incidental flag — only declared wire fields carry
+    the byte-identical-surface contract."""
+    findings = check(
+        tmp_path,
+        """\
+        CAP_TRACE = "trace"
+
+        def fast_path(out, use_fast, v):
+            if use_fast:
+                out["shard_hint"] = v
+
+        def slow_path(out, v):
+            out["shard_hint"] = v
+        """,
+    )
+    assert findings == []
+
+
+def test_planegate_reference_surface_exempt(tmp_path):
+    """Fields read by to_fields() predate every plane: gating one site
+    does not constrain the reference writes."""
+    findings = check(
+        tmp_path,
+        """\
+        CAP_TRACE = "trace"
+        FIELD_STATUS = "status"
+
+        class Task:
+            def to_fields(self):
+                return {FIELD_STATUS: self.status}
+
+        def gated(out, ctx, s):
+            if ctx.trace:
+                out[FIELD_STATUS] = s
+
+        def reference(out, s):
+            out[FIELD_STATUS] = s
+            out["status"] = s
+        """,
+    )
+    assert findings == []
+
+
+def test_planegate_field_constant_and_wire_key_cross_register(tmp_path):
+    """Gating the FIELD_*-keyed spelling constrains the literal wire-key
+    spelling of the same field, and vice versa."""
+    findings = check(
+        tmp_path,
+        """\
+        CAP_TRACE = "trace"
+        FIELD_TRACE_ID = "trace_id"
+
+        def gated(extra, ctx, tid):
+            if ctx.trace:
+                extra[FIELD_TRACE_ID] = tid
+
+        def echo(frame, tid):
+            frame["trace_id"] = tid
+        """,
+    )
+    assert hits(findings) == [("planegate.ungated-wire-write", 9)]
+
+
+def test_planegate_suppressible(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        CAP_TRACE = "trace"
+        FIELD_TRACE_ID = "t0"
+
+        def submit(extra, ctx, tid):
+            if ctx.trace:
+                extra[FIELD_TRACE_ID] = tid
+
+        def observe(extra, tid):
+            extra[FIELD_TRACE_ID] = tid  # faas: allow(planegate)
+        """,
+    )
+    assert findings == []
+
+
+def test_planegate_real_tree_capability_map_pin():
+    """Real-tree synchronization pin: the derived capability registry is
+    exactly the negotiated WORKER_CAPS vocabulary, and the trace/payload
+    plane fields are derived as gated."""
+    import tpu_faas
+    from tpu_faas.analysis import PlaneGateChecker
+    from tpu_faas.analysis.core import Module
+
+    pkg = Path(tpu_faas.__file__).parent
+    checker = PlaneGateChecker()
+    for path in sorted(pkg.rglob("*.py")):
+        m = Module.parse(
+            path,
+            str(path.relative_to(pkg.parent)),
+            path.read_text(encoding="utf-8"),
+        )
+        list(checker.check(m))
+    assert list(checker.finalize()) == []
+    assert set(checker.capabilities.values()) == {
+        "blob", "bin", "trace", "batch",
+    }
+    assert {
+        "FIELD_TRACE_ID", "FIELD_TRACE_PARENT", "FIELD_FN_DIGEST",
+    } <= checker.gated_fields
+    # unconditional gateway stamps stay unconstrained by derivation
+    assert "FIELD_SUBMITTED_AT" not in checker.gated_fields
+
+
+def test_planegate_live_mutation_ungated_write_flips_gate(tmp_path, capsys):
+    """The ISSUE's second live-verified mutation: move a gated FIELD_*
+    write outside its plane flag and the strict gate flips from 0 to 1."""
+    gated = textwrap.dedent(
+        """\
+        CAP_TRACE = "trace"
+        FIELD_TRACE_ID = "t0"
+
+        def submit(extra, ctx, tid):
+            if ctx.trace:
+                extra[FIELD_TRACE_ID] = tid
+
+        def batch(extra, ctx, tid):
+            if ctx.trace:
+                extra[FIELD_TRACE_ID] = tid
+        """
+    )
+    (tmp_path / "gw.py").write_text(gated)
+    assert analysis_main(["--strict", str(tmp_path)]) == 0
+    capsys.readouterr()
+    (tmp_path / "gw.py").write_text(
+        gated.replace(
+            "def batch(extra, ctx, tid):\n    if ctx.trace:\n"
+            "        extra[FIELD_TRACE_ID] = tid",
+            "def batch(extra, ctx, tid):\n"
+            "    extra[FIELD_TRACE_ID] = tid",
+        )
+    )
+    assert analysis_main(["--strict", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "planegate.ungated-field-write" in out
+
+
+# -- trace: mesh axis-name discipline ----------------------------------------
+
+
+def test_trace_unknown_axis_fires(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array([0]), ("tasks",))
+
+        def combine(x):
+            return jax.lax.psum(x, "task")
+        """,
+    )
+    assert hits(findings) == [("trace.unknown-axis-name", 8)]
+    assert "'task'" in findings[0].message
+
+
+def test_trace_axis_via_constant_and_param_default_clean(tmp_path):
+    """The mesh.py idiom end to end: the axis constant names the mesh
+    axis, collectives resolve it through the constant, a parameter
+    default, and axis_index's zeroth position."""
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        TASK_AXIS = "tasks"
+        mesh = Mesh(np.array([0]), (TASK_AXIS,))
+
+        def ring(x, axis=TASK_AXIS):
+            return jax.lax.ppermute(x, axis, [(0, 0)])
+
+        def gid():
+            return jax.lax.axis_index(TASK_AXIS)
+
+        def total(x):
+            return jax.lax.psum(x, axis_name=TASK_AXIS)
+        """,
+    )
+    assert findings == []
+
+
+def test_trace_no_mesh_in_run_skips_axis_rule(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+
+        def combine(x):
+            return jax.lax.psum(x, "anything")
+        """,
+    )
+    assert findings == []
+
+
+def test_trace_axis_declared_cross_module(tmp_path):
+    """The mesh declaration and the collective may live in different
+    modules of one run — declared axes are a run-wide registry."""
+    (tmp_path / "meshdef.py").write_text(
+        textwrap.dedent(
+            """\
+            import numpy as np
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array([0]), ("tasks",))
+            """
+        )
+    )
+    (tmp_path / "kern.py").write_text(
+        textwrap.dedent(
+            """\
+            import jax
+
+            def good(x):
+                return jax.lax.pmax(x, "tasks")
+
+            def bad(x):
+                return jax.lax.pmax(x, "rows")
+            """
+        )
+    )
+    findings = run_paths([tmp_path])
+    assert hits(findings) == [("trace.unknown-axis-name", 7)]
+    assert findings[0].path.endswith("kern.py")
+
+
+def test_trace_dynamic_axis_is_skipped(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array([0]), ("tasks",))
+
+        def combine(x, axis):
+            return jax.lax.psum(x, axis)
+        """,
+    )
+    assert findings == []
+
+
+def test_trace_unknown_axis_suppressible(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array([0]), ("tasks",))
+
+        def combine(x):
+            return jax.lax.psum(x, "task")  # faas: allow(trace.unknown-axis-name)
+        """,
+    )
+    assert findings == []
+
+
+# -- CLI: --only -------------------------------------------------------------
+
+
+def test_cli_only_runs_exactly_the_selected_checker(tmp_path, capsys):
+    """--only kernelparity runs that checker and nothing else: a snippet
+    carrying both a protocol and a kernelparity violation reports only
+    the kernelparity rule."""
+    p = tmp_path / "snippet.py"
+    p.write_text(
+        textwrap.dedent(
+            """\
+            from typing import NamedTuple
+
+            class FooState(NamedTuple):
+                a: object  # f32[T]
+                b: object  # i32[T]
+                c: object  # f32[T]
+                d: object  # bool[T]
+
+            def kernel(st, run):
+                return run(st.a, st.b, st.d, st.c)
+
+            def finishes(store, tid):
+                store.set_status(tid, "COMPLETED")
+            """
+        )
+    )
+    rc = analysis_main(["--only", "kernelparity", "--json", str(p)])
+    assert rc == 1
+    rules = {f["rule"] for f in json.loads(capsys.readouterr().out)}
+    assert rules == {"kernelparity.state-leaf-drift"}
+    rc = analysis_main(["--only", "protocol", "--json", str(p)])
+    assert rc == 1
+    rules = {f["rule"] for f in json.loads(capsys.readouterr().out)}
+    assert rules == {"protocol.terminal-set-status"}
+    rc = analysis_main(
+        ["--only", "protocol,kernelparity", "--json", str(p)]
+    )
+    assert rc == 1
+    rules = {f["rule"] for f in json.loads(capsys.readouterr().out)}
+    assert rules == {
+        "kernelparity.state-leaf-drift",
+        "protocol.terminal-set-status",
+    }
+
+
+def test_cli_only_rejects_unknown_checker(tmp_path, capsys):
+    p = tmp_path / "snippet.py"
+    p.write_text("x = 1\n")
+    rc = analysis_main(["--only", "nosuch", str(p)])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "nosuch" in err and "kernelparity" in err
+
+
+def test_cli_only_does_not_stale_foreign_suppressions(tmp_path, capsys):
+    """A narrowed run cannot judge staleness for checkers it skipped:
+    suppressions owned by unselected rules stay silent even under
+    --strict."""
+    p = tmp_path / "snippet.py"
+    p.write_text(
+        textwrap.dedent(
+            """\
+            def finishes(store, tid):
+                store.set_status(tid, "COMPLETED")  # faas: allow(protocol.terminal-set-status)
+            """
+        )
+    )
+    assert analysis_main(["--strict", str(p)]) == 0
+    capsys.readouterr()
+    assert analysis_main(["--only", "kernelparity", "--strict", str(p)]) == 0
+
+
+# -- SARIF: new rule ids -----------------------------------------------------
+
+
+def test_sarif_carries_new_device_plane_rule_ids(tmp_path, capsys):
+    """One module firing all three new checkers lands all three rule ids
+    in the SARIF rule metadata and results."""
+    p = tmp_path / "snippet.py"
+    p.write_text(
+        textwrap.dedent(
+            """\
+            import jax.numpy as jnp
+            from typing import NamedTuple
+
+            CAP_TRACE = "trace"
+            FIELD_TRACE_ID = "t0"
+
+            class PodState(NamedTuple):
+                a: object  # f32[T]
+                b: object  # i32[T]
+                c: object  # f32[T]
+                d: object  # bool[T]
+
+            def kernel(st, run):
+                return run(st.a, st.b, st.d, st.c)
+
+            def upload(host):
+                dev = jnp.asarray(host)
+                host[0] = 1.0
+                return dev
+
+            def submit(extra, ctx, tid):
+                if ctx.trace:
+                    extra[FIELD_TRACE_ID] = tid
+
+            def observe(extra, tid):
+                extra[FIELD_TRACE_ID] = tid
+            """
+        )
+    )
+    out = tmp_path / "out.sarif"
+    rc = analysis_main(["--sarif", str(out), str(p)])
+    assert rc == 1
+    capsys.readouterr()
+    doc = json.loads(out.read_text())
+    run = doc["runs"][0]
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {
+        "kernelparity.state-leaf-drift",
+        "devicesnapshot.unsnapshotted-upload",
+        "planegate.ungated-field-write",
+    } <= rules
+    assert {r["ruleId"] for r in run["results"]} == rules

@@ -36,6 +36,19 @@ small shared framework (:mod:`tpu_faas.analysis.core`):
 - :mod:`tpu_faas.analysis.metricsdiscipline` — one metric family name,
   one label vocabulary; counters end ``_total``; no unbounded-cardinality
   (per-task) label values.
+- :mod:`tpu_faas.analysis.kernelparity` — the scheduler state-leaf
+  registry (``sched/state.py`` / ``resident.py`` NamedTuple declarations)
+  is consumed leaf-for-leaf, in order, with matching dtype spelling, by
+  both the XLA resident tick and the fused Pallas kernel; every jitted
+  kernel stays in signature lockstep with its un-jitted ``_impl`` twin.
+- :mod:`tpu_faas.analysis.devicesnapshot` — host arrays handed to
+  ``jnp.asarray``/``jax.device_put`` are snapshots whenever the same
+  scope later mutates them in place (the PR 5 lazy-materialization bug
+  class as a rule).
+- :mod:`tpu_faas.analysis.planegate` — capability-gated wire and store
+  fields (the ``CAP_*`` → ``FIELD_*`` map derived from the worker
+  negotiation sites) are never written outside their plane's flag check:
+  "plane off = byte-identical surface", proven at rest.
 
 Run ``python -m tpu_faas.analysis [paths]`` (exit 1 on non-baselined
 error-severity findings); suppress a deliberate site with a trailing
@@ -56,10 +69,13 @@ from tpu_faas.analysis.core import (
     subtract_baseline,
     write_baseline,
 )
+from tpu_faas.analysis.devicesnapshot import DeviceSnapshotChecker
 from tpu_faas.analysis.eventloop import EventLoopChecker
+from tpu_faas.analysis.kernelparity import KernelParityChecker
 from tpu_faas.analysis.locks import LockDisciplineChecker
 from tpu_faas.analysis.metricsdiscipline import MetricsDisciplineChecker
 from tpu_faas.analysis.obs import ObsChecker
+from tpu_faas.analysis.planegate import PlaneGateChecker
 from tpu_faas.analysis.protocol import ProtocolChecker
 from tpu_faas.analysis.registries import RegistryChecker
 from tpu_faas.analysis.shardsafety import ShardSafetyChecker
@@ -75,17 +91,23 @@ ALL_CHECKERS = (
     RegistryChecker,
     ShardSafetyChecker,
     MetricsDisciplineChecker,
+    KernelParityChecker,
+    DeviceSnapshotChecker,
+    PlaneGateChecker,
 )
 
 __all__ = [
     "ALL_CHECKERS",
     "Checker",
+    "DeviceSnapshotChecker",
     "EventLoopChecker",
     "Finding",
+    "KernelParityChecker",
     "LockDisciplineChecker",
     "MetricsDisciplineChecker",
     "Module",
     "ObsChecker",
+    "PlaneGateChecker",
     "ProtocolChecker",
     "RegistryChecker",
     "ShardSafetyChecker",

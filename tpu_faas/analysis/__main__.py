@@ -14,6 +14,7 @@ from pathlib import Path
 
 import tpu_faas
 from tpu_faas.analysis import (
+    ALL_CHECKERS,
     load_baseline,
     run_paths,
     subtract_baseline,
@@ -85,11 +86,13 @@ def to_sarif(findings: list[Finding]) -> dict:
 
 
 def main(argv: list[str] | None = None) -> int:
+    checker_names = [cls.name for cls in ALL_CHECKERS]
     parser = argparse.ArgumentParser(
         prog="python -m tpu_faas.analysis",
         description="Static protocol / trace-safety / lock / event-loop / "
-        "registry-completeness / shard-routing / metrics-discipline "
-        "checks for the tpu-faas tree (see docs/ANALYSIS.md).",
+        "registry-completeness / shard-routing / metrics-discipline / "
+        "kernel-parity / device-snapshot / plane-gating checks for the "
+        "tpu-faas tree (see docs/ANALYSIS.md).",
     )
     parser.add_argument(
         "paths",
@@ -106,6 +109,14 @@ def main(argv: list[str] | None = None) -> int:
         "--write-baseline",
         metavar="FILE",
         help="write current error findings to FILE and exit 0",
+    )
+    parser.add_argument(
+        "--only",
+        metavar="CHECKER[,CHECKER]",
+        help="run only the named checker(s), comma-separated, for fast "
+        f"targeted iteration (available: {', '.join(checker_names)}); "
+        "note the stale-suppression pass then only sees the selected "
+        "rules' tokens",
     )
     parser.add_argument(
         "--strict",
@@ -126,6 +137,21 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    checker_classes = None
+    if args.only:
+        by_name = {cls.name: cls for cls in ALL_CHECKERS}
+        wanted = [t.strip() for t in args.only.split(",") if t.strip()]
+        unknown = [t for t in wanted if t not in by_name]
+        if unknown or not wanted:
+            print(
+                f"tpu_faas.analysis: unknown checker(s) "
+                f"{', '.join(unknown) or '<empty>'} "
+                f"(available: {', '.join(checker_names)})",
+                file=sys.stderr,
+            )
+            return 2
+        checker_classes = [by_name[t] for t in wanted]
+
     paths = args.paths or [Path(tpu_faas.__file__).parent]
     try:
         if not iter_py_files(paths):
@@ -134,7 +160,7 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 2
-        findings = run_paths(paths)
+        findings = run_paths(paths, checker_classes=checker_classes)
     except (FileNotFoundError, ValueError) as exc:
         # a typo'd target must fail the gate, never pass it vacuously
         print(f"tpu_faas.analysis: {exc}", file=sys.stderr)

@@ -223,6 +223,10 @@ def run_paths(
     a file the pass cannot see is a failure, not a silent skip. Finding
     paths are relative to each scan argument's parent (or to ``root`` when
     given), independent of the process working directory."""
+    # a narrowed run (--only / explicit checker_classes) cannot judge
+    # staleness for the checkers it skipped — their suppressions absorbed
+    # nothing only because the rule never ran
+    narrowed = checker_classes is not None
     if checker_classes is None:
         from tpu_faas.analysis import ALL_CHECKERS
 
@@ -258,8 +262,11 @@ def run_paths(
     # outlived its reason. Deliberately NOT itself suppressible (an
     # allow(*) that suppresses nothing would otherwise suppress its own
     # staleness report); warning severity, promoted by --strict.
+    active = {c.name for c in checkers}
     for module in modules:
         for line, token in module.stale_allow_tokens():
+            if narrowed and token.split(".", 1)[0] not in active:
+                continue
             findings.append(
                 Finding(
                     module.relpath,

@@ -1,0 +1,164 @@
+"""Snapshot-before-upload: host arrays handed to the device must be
+copies when the same scope mutates them afterwards.
+
+The PR 5 live-bug class as a rule. ``jnp.asarray`` / ``jax.device_put``
+materialize LAZILY under async dispatch: the transfer may read host
+memory well after the call returns, so an in-place mutation of the same
+array (``a[i] = v``, ``a += d``, ``a.fill(0)``) later in the scope
+time-travels into a kernel that was already enqueued with the old
+decision — the mechanism behind the over-booking flake that
+``tests/test_sched_resident.py::
+test_result_arrival_between_tick_and_resolve_cannot_overbook``
+reproduces. The fix is always the same one line: upload a snapshot
+(``jnp.asarray(host.copy())``), never the live mirror.
+
+One rule:
+
+- ``devicesnapshot.unsnapshotted-upload`` (error) — an upload whose
+  argument is a bare name or attribute chain (not already a ``.copy()``
+  or other call) that the SAME function later mutates in place, with no
+  rebinding of the name in between.
+
+Scoping is textual and per-function, matching how the live-mirror
+discipline is actually written (``_cached_dev`` / ``_device_inflight``
+in ``sched/state.py``): build-then-upload locals that finish mutating
+BEFORE the upload are clean; a mutation on a textually later line is
+the hazard. Uploads of expressions (``.copy()``, slicing, casts) are
+exempt by construction — they already read a private buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tpu_faas.analysis.core import Checker, Finding, Module, dotted_name
+
+#: call names that move a host buffer to the device
+_UPLOAD_LAST = {"asarray", "device_put"}
+#: roots under which those names mean a DEVICE transfer (``np.asarray``
+#: stays host-side and is deliberately not matched)
+_UPLOAD_ROOTS = {"jnp", "jax"}
+#: method calls that mutate an ndarray in place
+_MUTATING_METHODS = {"fill", "sort", "put", "itemset", "partition", "resize"}
+
+
+def _upload_target(node: ast.Call) -> str | None:
+    """The uploaded host buffer as a dotted name, or None when the call
+    is not a device upload of a bare name/attribute chain."""
+    name = dotted_name(node.func)
+    if name is None or "." not in name:
+        return None
+    root, last = name.split(".", 1)[0], name.rsplit(".", 1)[-1]
+    if last not in _UPLOAD_LAST or root not in _UPLOAD_ROOTS:
+        return None
+    if not node.args:
+        return None
+    return dotted_name(node.args[0])
+
+
+class DeviceSnapshotChecker(Checker):
+    name = "devicesnapshot"
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        scopes: list[tuple[ast.AST, list[ast.stmt]]] = [
+            (module.tree, module.tree.body)
+        ]
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((node, node.body))
+        for scope, body in scopes:
+            yield from self._check_scope(module, scope, body)
+
+    def _check_scope(self, module, scope, body) -> Iterable[Finding]:
+        uploads: list[tuple[str, ast.Call]] = []
+        mutations: dict[str, list[int]] = {}
+        rebinds: dict[str, list[int]] = {}
+        for node in _walk_own_code(body):
+            if isinstance(node, ast.Call):
+                target = _upload_target(node)
+                if target is not None:
+                    uploads.append((target, node))
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in _MUTATING_METHODS
+                ):
+                    base = dotted_name(fn.value)
+                    if base is not None:
+                        mutations.setdefault(base, []).append(node.lineno)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        base = dotted_name(t.value)
+                        if base is not None:
+                            mutations.setdefault(base, []).append(
+                                t.lineno
+                            )
+                    else:
+                        name = dotted_name(t)
+                        if name is not None:
+                            rebinds.setdefault(name, []).append(t.lineno)
+            elif isinstance(node, ast.AugAssign):
+                t = node.target
+                base = (
+                    dotted_name(t.value)
+                    if isinstance(t, ast.Subscript)
+                    else dotted_name(t)
+                )
+                if base is not None:
+                    mutations.setdefault(base, []).append(node.lineno)
+        for target, call in uploads:
+            later = [
+                line
+                for line in mutations.get(target, [])
+                if line > call.lineno
+                # a rebinding between upload and mutation breaks the
+                # aliasing: the mutation then hits a different object
+                and not any(
+                    call.lineno < r <= line
+                    for r in rebinds.get(target, [])
+                )
+            ]
+            if later:
+                yield self.finding(
+                    module,
+                    call,
+                    "unsnapshotted-upload",
+                    "error",
+                    f"'{target}' is uploaded here but mutated in place "
+                    f"at line {min(later)} of the same scope: the "
+                    f"transfer can materialize lazily under async "
+                    f"dispatch, so the mutation time-travels into the "
+                    f"already-enqueued kernel — upload a snapshot "
+                    f"instead ({target}.copy(), see sched/state.py::"
+                    f"_cached_dev)",
+                )
+
+
+def _walk_own_code(body: list[ast.stmt]):
+    """Every node of these statements, NOT descending into nested
+    function/class definitions — each scope is judged on its own
+    textual order."""
+    # defs sitting directly in the body belong to their own scope too
+    stack: list[ast.AST] = [
+        s
+        for s in body
+        if not isinstance(
+            s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        )
+    ]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                stack.append(child)

@@ -1,0 +1,788 @@
+"""Kernel-twin parity: the device-state registry and its XLA/Pallas
+consumers proven synchronized at rest.
+
+The resident scheduler carries one source of truth for everything that
+lives on device between ticks: the ``*State`` NamedTuple in
+``sched/resident.py`` (16 leaves today — sizes through refresh, each with
+a dtype/shape doc comment). That registry has THREE independent consumers
+that must agree leaf for leaf, in declaration order, with the same dtype
+spelling: the XLA tick's state constructors, the fused Pallas kernel's
+operand list / ``in_specs`` / ``out_shape`` / ``input_output_aliases``
+table, and the packet protocol between them. PR 10's registry-drift
+checker proved the derive-then-check pattern pays for the store-command
+registries; this module applies it to the scheduler, where a silently
+diverged replica of the scheduling step is the worst bug class (Ray's
+multi-backend scheduler motivates the same discipline — PAPERS.md).
+
+Three rules:
+
+- ``kernelparity.state-leaf-drift`` — a full-consumption site (an
+  expression reading at least half the registry's leaves off one base,
+  e.g. the fused kernel's ``st.sizes, st.valid, ...`` operand list) is
+  missing a leaf, repeats one, or lists them out of declaration order;
+  or a positional registry construction passes the wrong number of
+  arguments / a recognizable leaf at the wrong position; or the
+  ``input_output_aliases`` span and the ``in_specs``/``out_shape``
+  tuple lengths disagree with the leaf count.
+- ``kernelparity.state-dtype-drift`` — an ``in_specs``/``out_shape``
+  entry spells a leaf's dtype differently from the registry's field
+  comment (``# f32[T]`` and friends), the exact way a one-sided
+  ``i32``->``f32`` migration starts.
+- ``kernelparity.twin-signature-drift`` — the jitted-kernel/``_impl``
+  twin contract: a call site passes a keyword no ``*_impl`` definition
+  of that name accepts, omits a required parameter, passes more
+  positionals than the signature holds, or a ``partial(jax.jit,
+  static_argnames=...)`` twin wrapper names a static that is not a
+  parameter of its target — the exact hazard of adding a
+  tenant-deficit or straggler lane to only one backend.
+
+Like every checker here this is a pure function of source text: the
+registry is recognized structurally (a NamedTuple class whose name ends
+in ``State``), ``**kwargs`` splats are resolved through local
+``dict(...)`` literals, and ``static_argnames`` tuples resolve through
+module-level constants — nothing is imported or executed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterable
+
+from tpu_faas.analysis.core import Checker, Finding, Module, dotted_name
+
+#: dtype tokens accepted in registry field comments
+_DTYPE_COMMENT_RE = re.compile(
+    r"\b(f32|f64|bf16|f16|i32|i64|u32|u64|bool)\b"
+)
+#: canonical short spelling per jnp dtype attribute
+_DTYPE_CANON = {
+    "float32": "f32",
+    "float64": "f64",
+    "bfloat16": "bf16",
+    "float16": "f16",
+    "int32": "i32",
+    "int64": "i64",
+    "uint32": "u32",
+    "uint64": "u64",
+    "bool_": "bool",
+    "bool": "bool",
+}
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _last_segment(node: ast.AST) -> str | None:
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _attr_operand(node: ast.AST) -> tuple[str, str] | None:
+    """``(base, attr)`` for a state-leaf operand, unwrapping the thin
+    upload/reshape wrappers the consumers use (``jnp.reshape(st.refresh,
+    (1,))`` reads leaf ``refresh`` off base ``st``)."""
+    depth = 0
+    while isinstance(node, ast.Call) and node.args and depth < 3:
+        node = node.args[0]
+        depth += 1
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        if base is not None:
+            return base, node.attr
+    return None
+
+
+def _comment_dtype(module: Module, lineno: int) -> str | None:
+    """The dtype a registry field's doc comment declares: the trailing
+    comment on the field's own line, else the nearest preceding line of
+    the contiguous ``#`` block above it."""
+    lines = module.source.splitlines()
+    if not 1 <= lineno <= len(lines):
+        return None
+    _, _, trailing = lines[lineno - 1].partition("#")
+    m = _DTYPE_COMMENT_RE.search(trailing)
+    if m:
+        return m.group(1)
+    i = lineno - 2
+    while i >= 0 and lines[i].strip().startswith("#"):
+        m = _DTYPE_COMMENT_RE.search(lines[i])
+        if m:
+            return m.group(1)
+        i -= 1
+    return None
+
+
+def _resolve_dtype(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Canonical dtype spelling of ``jnp.float32`` / a local alias like
+    ``f32`` (from ``f32, i32, b = jnp.float32, jnp.int32, jnp.bool_``)."""
+    if isinstance(node, ast.Attribute):
+        return _DTYPE_CANON.get(node.attr)
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id)
+    return None
+
+
+@dataclass
+class _Registry:
+    name: str
+    module: Module
+    node: ast.ClassDef
+    leaves: list[str]
+    dtypes: dict[str, str | None]
+
+
+@dataclass
+class _ImplSig:
+    module: Module
+    node: ast.AST
+    pos: list[str]
+    n_pos_required: int
+    kwonly: set[str]
+    kwonly_required: set[str]
+    has_vararg: bool
+    has_kwarg: bool
+
+    @property
+    def params(self) -> set[str]:
+        return set(self.pos) | self.kwonly
+
+
+@dataclass
+class _ImplCall:
+    module: Module
+    node: ast.Call
+    name: str
+    n_pos: int
+    has_star: bool
+    kwargs: set[str]
+    open_kwargs: bool  # an unresolvable ``**splat`` rode along
+
+
+@dataclass
+class _AliasSpan:
+    module: Module
+    node: ast.AST
+    out_base: int  # C in ``{k: C + k for k in range(lo, hi)}``
+    lo: int
+    hi: int
+
+
+@dataclass
+class _SpecTuple:
+    module: Module
+    node: ast.AST
+    which: str  # "in_specs" | "out_shape"
+    dtypes: list[str | None]
+    length: int
+
+
+class KernelParityChecker(Checker):
+    """Cross-module pass: collect the registry, every consumer sequence,
+    and every ``*_impl`` def/call/jit-twin site in :meth:`check`; judge
+    them against each other in :meth:`finalize`."""
+
+    name = "kernelparity"
+
+    def __init__(self) -> None:
+        self.registries: list[_Registry] = []
+        self._groups: list[tuple[Module, ast.AST, str, list[str]]] = []
+        self._ctors: list[
+            tuple[Module, ast.Call, str, list[str | None], set[str], bool]
+        ] = []
+        self._alias_spans: list[_AliasSpan] = []
+        self._spec_tuples: list[_SpecTuple] = []
+        self._impl_defs: dict[str, list[_ImplSig]] = {}
+        self._all_def_params: dict[str, list[set[str]]] = {}
+        self._impl_calls: list[_ImplCall] = []
+        self._jit_sites: list[tuple[Module, ast.AST, str, list[str]]] = []
+
+    # -- collection --------------------------------------------------------
+
+    def check(self, module: Module) -> Iterable[Finding]:
+        str_tuples = self._module_string_tuples(module)
+        dtype_aliases = self._dtype_aliases(module)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                self._collect_registry(module, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_def(module, node, str_tuples)
+            elif isinstance(node, ast.Call):
+                self._collect_call(module, node, str_tuples)
+                # a registry CONSTRUCTOR legitimately mixes passthrough
+                # st.* leaves with freshly-computed ones; the per-position
+                # ctor token check judges it, not the full-consumption
+                # group rule (which is for consumer sites: operand lists
+                # and output tuples)
+                if not (_last_segment(node.func) or "").endswith("State"):
+                    self._collect_group(module, node, node.args)
+            elif isinstance(node, ast.Tuple):
+                self._collect_group(module, node, node.elts)
+            elif isinstance(node, ast.Assign):
+                self._collect_assign(
+                    module, node, str_tuples, dtype_aliases
+                )
+        return ()
+
+    def _collect_registry(self, module: Module, node: ast.ClassDef) -> None:
+        if not node.name.endswith("State"):
+            return
+        if not any(_last_segment(b) == "NamedTuple" for b in node.bases):
+            return
+        leaves: list[str] = []
+        dtypes: dict[str, str | None] = {}
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                leaves.append(stmt.target.id)
+                dtypes[stmt.target.id] = _comment_dtype(module, stmt.lineno)
+        if leaves:
+            self.registries.append(
+                _Registry(node.name, module, node, leaves, dtypes)
+            )
+
+    def _collect_def(self, module, node, str_tuples) -> None:
+        a = node.args
+        pos = [arg.arg for arg in list(a.posonlyargs) + list(a.args)]
+        kwonly = [arg.arg for arg in a.kwonlyargs]
+        sig = _ImplSig(
+            module=module,
+            node=node,
+            pos=pos,
+            n_pos_required=len(pos) - len(a.defaults),
+            kwonly=set(kwonly),
+            kwonly_required={
+                arg
+                for arg, d in zip(kwonly, a.kw_defaults)
+                if d is None
+            },
+            has_vararg=a.vararg is not None,
+            has_kwarg=a.kwarg is not None,
+        )
+        self._all_def_params.setdefault(node.name, []).append(sig.params)
+        if node.name.endswith("_impl"):
+            self._impl_defs.setdefault(node.name, []).append(sig)
+        for dec in node.decorator_list:
+            statics = self._static_argnames(dec, str_tuples)
+            if statics is not None:
+                self._jit_sites.append((module, dec, node.name, statics))
+
+    def _collect_call(self, module, node: ast.Call, str_tuples) -> None:
+        fname = _last_segment(node.func)
+        if fname and fname.endswith("_impl"):
+            kwargs: set[str] = set()
+            open_kwargs = False
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    kwargs.add(kw.arg)
+                    continue
+                keys = self._splat_keys(module, node, kw.value)
+                if keys is None:
+                    open_kwargs = True
+                else:
+                    kwargs |= keys
+            self._impl_calls.append(
+                _ImplCall(
+                    module=module,
+                    node=node,
+                    name=fname,
+                    n_pos=sum(
+                        1
+                        for a in node.args
+                        if not isinstance(a, ast.Starred)
+                    ),
+                    has_star=any(
+                        isinstance(a, ast.Starred) for a in node.args
+                    ),
+                    kwargs=kwargs,
+                    open_kwargs=open_kwargs,
+                )
+            )
+        if fname and fname.endswith("State"):
+            tokens: list[str | None] = []
+            has_star = False
+            for a in node.args:
+                if isinstance(a, ast.Starred):
+                    has_star = True
+                    tokens.append(None)
+                elif isinstance(a, ast.Name):
+                    tokens.append(a.id)
+                elif isinstance(a, ast.Attribute):
+                    tokens.append(a.attr)
+                else:
+                    tokens.append(None)
+            kwarg_names = {
+                kw.arg for kw in node.keywords if kw.arg is not None
+            }
+            if not any(kw.arg is None for kw in node.keywords):
+                self._ctors.append(
+                    (module, node, fname, tokens, kwarg_names, has_star)
+                )
+        if fname == "pallas_call":
+            for kw in node.keywords:
+                if kw.arg == "input_output_aliases":
+                    span = self._alias_span(module, kw.value)
+                    if span is not None:
+                        self._alias_spans.append(span)
+        # jitted-twin assignment form: ``partial(jax.jit, ...)(X_impl)``
+        if isinstance(node.func, ast.Call):
+            statics = self._static_argnames(node.func, str_tuples)
+            if statics is not None and node.args:
+                target = _last_segment(node.args[0])
+                if target:
+                    self._jit_sites.append(
+                        (module, node, target, statics)
+                    )
+
+    def _collect_group(self, module, anchor, elements) -> None:
+        by_base: dict[str, list[str]] = {}
+        for el in elements:
+            hit = _attr_operand(el)
+            if hit is not None:
+                by_base.setdefault(hit[0], []).append(hit[1])
+        for base, attrs in by_base.items():
+            if len(attrs) >= 3:
+                self._groups.append((module, anchor, base, attrs))
+
+    def _collect_assign(self, module, node, str_tuples, aliases) -> None:
+        if len(node.targets) != 1 or not isinstance(
+            node.targets[0], ast.Name
+        ):
+            return
+        name = node.targets[0].id
+        if name in ("in_specs", "out_shape") and isinstance(
+            node.value, ast.Tuple
+        ):
+            dtypes: list[str | None] = []
+            for el in node.value.elts:
+                dt = None
+                if (
+                    isinstance(el, ast.Call)
+                    and _last_segment(el.func) == "ShapeDtypeStruct"
+                    and len(el.args) >= 2
+                ):
+                    dt = _resolve_dtype(el.args[1], aliases)
+                dtypes.append(dt)
+            self._spec_tuples.append(
+                _SpecTuple(module, node, name, dtypes, len(dtypes))
+            )
+        # jitted-twin assignment via the plain spelling: ``X = jax.jit(Y)``
+        if (
+            isinstance(node.value, ast.Call)
+            and _last_segment(node.value.func) in _JIT_NAMES
+            and node.value.args
+        ):
+            target = _last_segment(node.value.args[0])
+            statics = None
+            for kw in node.value.keywords:
+                if kw.arg == "static_argnames":
+                    statics = self._resolve_strings(kw.value, str_tuples)
+            if target and statics:
+                self._jit_sites.append((module, node, target, statics))
+
+    # -- resolution helpers ------------------------------------------------
+
+    @staticmethod
+    def _module_string_tuples(module: Module) -> dict[str, list[str]]:
+        """Module-level ``NAME = ("a", "b", ...)`` constants — how the
+        fused kernel spells its shared ``static_argnames`` tuple."""
+        out: dict[str, list[str]] = {}
+        for stmt in module.tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, (ast.Tuple, ast.List))
+                and stmt.value.elts
+                and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in stmt.value.elts
+                )
+            ):
+                out[stmt.targets[0].id] = [
+                    e.value for e in stmt.value.elts
+                ]
+        return out
+
+    @staticmethod
+    def _dtype_aliases(module: Module) -> dict[str, str]:
+        """Local dtype shorthands: ``f32, i32, b = jnp.float32,
+        jnp.int32, jnp.bool_`` (and single-name forms)."""
+        out: dict[str, str] = {}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target, value = node.targets[0], node.value
+            if isinstance(target, ast.Tuple) and isinstance(
+                value, ast.Tuple
+            ):
+                pairs = zip(target.elts, value.elts)
+            else:
+                pairs = [(target, value)]
+            for t, v in pairs:
+                if isinstance(t, ast.Name) and isinstance(v, ast.Attribute):
+                    canon = _DTYPE_CANON.get(v.attr)
+                    if canon:
+                        out[t.id] = canon
+        return out
+
+    def _static_argnames(self, node, str_tuples) -> list[str] | None:
+        """``static_argnames`` of a ``partial(jax.jit, ...)`` or
+        ``jax.jit`` expression; None when this isn't one (or the names
+        don't statically resolve)."""
+        if not isinstance(node, ast.Call):
+            return None
+        fname = _last_segment(node.func)
+        if fname == "partial":
+            if not node.args or _last_segment(node.args[0]) not in _JIT_NAMES:
+                return None
+        elif fname not in _JIT_NAMES:
+            return None
+        for kw in node.keywords:
+            if kw.arg == "static_argnames":
+                return self._resolve_strings(kw.value, str_tuples)
+        return None
+
+    @staticmethod
+    def _resolve_strings(node, str_tuples) -> list[str] | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.append(e.value)
+                else:
+                    return None
+            return out
+        if isinstance(node, ast.Name):
+            return str_tuples.get(node.id)
+        return None
+
+    @staticmethod
+    def _splat_keys(module, call, node) -> set[str] | None:
+        """Keys of a ``**splat`` argument: an inline ``dict(...)`` /
+        ``{...}`` literal, or a local name assigned only dict literals
+        and constant-key subscript stores in the enclosing function.
+        None = unresolvable (the call then skips coverage checks)."""
+
+        def literal_keys(value) -> set[str] | None:
+            if (
+                isinstance(value, ast.Call)
+                and _last_segment(value.func) == "dict"
+                and not value.args
+                and all(kw.arg is not None for kw in value.keywords)
+            ):
+                return {kw.arg for kw in value.keywords}
+            if isinstance(value, ast.Dict):
+                keys: set[str] = set()
+                for k in value.keys:
+                    if not (
+                        isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    ):
+                        return None
+                    keys.add(k.value)
+                return keys
+            return None
+
+        direct = literal_keys(node)
+        if direct is not None:
+            return direct
+        if not isinstance(node, ast.Name):
+            return None
+        # walk the scope chain outward: a closure like the fused kernel's
+        # ``_value_step`` splats a dict its ENCLOSING function built
+        for fn in _enclosing_functions(module.tree, call):
+            keys: set[str] = set()
+            bound = False
+            for stmt in ast.walk(fn):
+                value = None
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                    value = stmt.value
+                elif (
+                    isinstance(stmt, ast.AnnAssign)
+                    and stmt.value is not None
+                ):
+                    targets = [stmt.target]
+                    value = stmt.value
+                else:
+                    targets = []
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id == node.id:
+                        got = literal_keys(value)
+                        if got is None:
+                            return None
+                        keys |= got
+                        bound = True
+                    elif (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == node.id
+                    ):
+                        if isinstance(
+                            t.slice, ast.Constant
+                        ) and isinstance(t.slice.value, str):
+                            keys.add(t.slice.value)
+                        else:
+                            return None
+            if bound:
+                return keys
+        return None
+
+    # -- judgement ---------------------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for reg in self.registries:
+            findings.extend(self._judge_registry(reg))
+        findings.extend(self._judge_twins())
+        return findings
+
+    def _judge_registry(self, reg: _Registry) -> Iterable[Finding]:
+        leaves = reg.leaves
+        index = {leaf: i for i, leaf in enumerate(leaves)}
+        # a sequence reading at least half the registry off one base is a
+        # full-consumption site and must list every leaf, once, in order
+        need = max(4, (len(leaves) + 1) // 2)
+        for module, anchor, base, attrs in self._groups:
+            hits = [a for a in attrs if a in index]
+            if len(set(hits)) < need:
+                continue
+            if hits != leaves:
+                missing = [l for l in leaves if l not in hits]
+                extra = sorted(set(hits) - set(leaves))
+                detail = (
+                    f"missing {missing}"
+                    if missing
+                    else "out of declaration order"
+                    + (f"; repeated/foreign {extra}" if extra else "")
+                )
+                yield self.finding(
+                    module,
+                    anchor,
+                    "state-leaf-drift",
+                    "error",
+                    f"consumer of {reg.name} reads leaves off '{base}' as "
+                    f"{hits} but the registry declares {leaves} "
+                    f"({reg.module.relpath}:{reg.node.lineno}): {detail} — "
+                    f"every backend must consume every leaf in "
+                    f"declaration order (see the state-leaf triage row in "
+                    f"docs/OPERATIONS.md)",
+                )
+        for module, node, fname, tokens, kwargs, has_star in self._ctors:
+            if fname != reg.name or has_star:
+                continue
+            unknown = kwargs - set(leaves)
+            if unknown:
+                yield self.finding(
+                    module,
+                    node,
+                    "state-leaf-drift",
+                    "error",
+                    f"{reg.name}(...) passes keyword(s) "
+                    f"{sorted(unknown)} that are not registry leaves",
+                )
+                continue
+            if len(tokens) + len(kwargs) != len(leaves):
+                yield self.finding(
+                    module,
+                    node,
+                    "state-leaf-drift",
+                    "error",
+                    f"{reg.name}(...) constructs "
+                    f"{len(tokens) + len(kwargs)} leaves but the registry "
+                    f"declares {len(leaves)} "
+                    f"({reg.module.relpath}:{reg.node.lineno}) — a leaf "
+                    f"was added or dropped on one side only",
+                )
+                continue
+            for i, token in enumerate(tokens):
+                if token in index and token != leaves[i]:
+                    yield self.finding(
+                        module,
+                        node,
+                        "state-leaf-drift",
+                        "error",
+                        f"{reg.name}(...) passes leaf '{token}' at "
+                        f"position {i} where the registry declares "
+                        f"'{leaves[i]}' — positional construction must "
+                        f"follow declaration order",
+                    )
+        for span in self._alias_spans:
+            if span.hi - span.lo != len(leaves):
+                yield self.finding(
+                    span.module,
+                    span.node,
+                    "state-leaf-drift",
+                    "error",
+                    f"input_output_aliases spans {span.hi - span.lo} "
+                    f"state operands but {reg.name} declares "
+                    f"{len(leaves)} leaves — the in-place alias table "
+                    f"no longer covers the state",
+                )
+        for spec in self._spec_tuples:
+            spans = [
+                s for s in self._alias_spans if s.module is spec.module
+            ]
+            if not spans:
+                continue
+            span = spans[0]
+            state0 = (
+                span.lo
+                if spec.which == "in_specs"
+                else span.out_base + span.lo
+            )
+            expected = state0 + len(leaves)
+            if spec.length != expected:
+                yield self.finding(
+                    spec.module,
+                    spec.node,
+                    "state-leaf-drift",
+                    "error",
+                    f"{spec.which} holds {spec.length} entries but "
+                    f"{expected} are required ({state0} kernel slots + "
+                    f"{len(leaves)} {reg.name} leaves)",
+                )
+                continue
+            for i, leaf in enumerate(leaves):
+                declared = reg.dtypes.get(leaf)
+                spelled = spec.dtypes[state0 + i]
+                if declared and spelled and declared != spelled:
+                    yield self.finding(
+                        spec.module,
+                        spec.node,
+                        "state-dtype-drift",
+                        "error",
+                        f"{spec.which} spells leaf '{leaf}' as "
+                        f"{spelled} but the registry comment declares "
+                        f"{declared} "
+                        f"({reg.module.relpath}:{reg.node.lineno}) — "
+                        f"dtype migrations must land in the registry "
+                        f"and every backend together",
+                    )
+
+    def _judge_twins(self) -> Iterable[Finding]:
+        for call in self._impl_calls:
+            sigs = self._impl_defs.get(call.name)
+            if not sigs:
+                continue
+            unknown = {
+                kw
+                for kw in call.kwargs
+                if all(
+                    kw not in s.params and not s.has_kwarg for s in sigs
+                )
+            }
+            if unknown:
+                yield self.finding(
+                    call.module,
+                    call.node,
+                    "twin-signature-drift",
+                    "error",
+                    f"call passes keyword(s) {sorted(unknown)} that no "
+                    f"definition of {call.name} accepts — a parameter "
+                    f"was added on the caller side only",
+                )
+                continue
+            if call.has_star or call.open_kwargs:
+                continue
+            missing_per_sig = []
+            for s in sigs:
+                if call.n_pos > len(s.pos) and not s.has_vararg:
+                    missing_per_sig.append(
+                        [f"<{call.n_pos - len(s.pos)} extra positionals>"]
+                    )
+                    continue
+                required = (
+                    set(s.pos[call.n_pos : s.n_pos_required])
+                    | s.kwonly_required
+                )
+                missing_per_sig.append(sorted(required - call.kwargs))
+            if all(missing_per_sig) and missing_per_sig:
+                yield self.finding(
+                    call.module,
+                    call.node,
+                    "twin-signature-drift",
+                    "error",
+                    f"call does not cover required parameter(s) "
+                    f"{missing_per_sig[0]} of {call.name} "
+                    f"({sigs[0].module.relpath}:{sigs[0].node.lineno}) — "
+                    f"a parameter was added on the impl side only",
+                )
+        for module, node, target, statics in self._jit_sites:
+            param_sets = self._all_def_params.get(target)
+            if not param_sets:
+                continue
+            bad = [
+                s
+                for s in statics
+                if all(s not in params for params in param_sets)
+            ]
+            if bad:
+                yield self.finding(
+                    module,
+                    node,
+                    "twin-signature-drift",
+                    "error",
+                    f"static_argnames {bad} name no parameter of "
+                    f"{target} — the jitted twin and its impl have "
+                    f"drifted apart",
+                )
+
+    def _alias_span(self, module: Module, node) -> _AliasSpan | None:
+        if not isinstance(node, ast.DictComp) or len(node.generators) != 1:
+            return None
+        gen = node.generators[0]
+        if not isinstance(gen.target, ast.Name) or gen.ifs:
+            return None
+        it = gen.iter
+        if not (
+            isinstance(it, ast.Call)
+            and _last_segment(it.func) == "range"
+            and len(it.args) == 2
+            and all(
+                isinstance(a, ast.Constant) and isinstance(a.value, int)
+                for a in it.args
+            )
+        ):
+            return None
+        value = node.value
+        if not (isinstance(value, ast.BinOp) and isinstance(value.op, ast.Add)):
+            return None
+        parts = [value.left, value.right]
+        consts = [
+            p.value
+            for p in parts
+            if isinstance(p, ast.Constant) and isinstance(p.value, int)
+        ]
+        names = [
+            p for p in parts if isinstance(p, ast.Name) and p.id == gen.target.id
+        ]
+        if len(consts) != 1 or len(names) != 1:
+            return None
+        return _AliasSpan(
+            module, node, consts[0], it.args[0].value, it.args[1].value
+        )
+
+
+def _enclosing_functions(tree: ast.Module, target: ast.AST) -> list:
+    """FunctionDefs containing ``target``, innermost first (the AST
+    carries no parent links, so this is a one-shot descent)."""
+    chain: list = []
+
+    def visit(node, stack):
+        nonlocal chain
+        if node is target:
+            chain = list(reversed(stack))
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack = stack + [node]
+        for child in ast.iter_child_nodes(node):
+            if visit(child, stack):
+                return True
+        return False
+
+    visit(tree, [])
+    return chain

@@ -35,6 +35,15 @@ Hazards flagged inside traced code:
   ``len()``/``isinstance()`` probes are understood to be static and exempt.
 - ``print`` (warning): trace-time-only output; ``jax.debug.print`` is the
   traced form and is not flagged.
+- ``unknown-axis-name`` (error): a collective (``ppermute``/``psum``/
+  ``axis_index``/...) names a mesh axis no ``Mesh(...)`` declaration in
+  the run provides — the call raises ``NameError: unbound axis`` at trace
+  time, but only on the first mesh-backed execution path, which unit runs
+  on one device never take. Axis arguments resolve through module string
+  constants (``TASK_AXIS = "tasks"``) and enclosing-function parameter
+  defaults (``def f(x, axis=TASK_AXIS)``); an unresolvable axis (passed
+  dynamically) is skipped, and so is the whole rule when the run declares
+  no mesh at all (single-backend trees).
 """
 
 from __future__ import annotations
@@ -67,6 +76,39 @@ _HOST_SYNC_DOTTED = frozenset({"jax.device_get"})
 _NP_MATERIALIZE = frozenset({"np.asarray", "np.array", "numpy.asarray", "numpy.array"})
 _SHAPE_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
 _STATIC_PROBES = frozenset({"len", "isinstance", "getattr", "hasattr", "type"})
+
+#: collective ops that must name an axis declared by an enclosing mesh
+_COLLECTIVES = frozenset(
+    {
+        "ppermute",
+        "psum",
+        "pmax",
+        "pmin",
+        "pmean",
+        "all_gather",
+        "axis_index",
+        "psum_scatter",
+        "all_to_all",
+    }
+)
+#: positional index of the axis argument (1 for the x-then-axis family)
+_AXIS_ARG_POS = {"axis_index": 0}
+
+
+def _module_string_consts(tree: ast.AST) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings — how axis names are
+    actually spelled (``TASK_AXIS = "tasks"`` in ``parallel/mesh.py``)."""
+    out: dict[str, str] = {}
+    for stmt in getattr(tree, "body", ()):
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        ):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
 
 
 def _last(dotted: str) -> str:
@@ -161,7 +203,15 @@ class TraceSafetyChecker(Checker):
     #: names bound to jax modules in the module under check (set per module)
     _jax_names: frozenset[str] = frozenset()
 
+    def __init__(self) -> None:
+        #: axis names declared by any Mesh(...) in the run (cross-module:
+        #: mesh.py declares, kernel modules consume)
+        self._declared_axes: set[str] = set()
+        #: (module, call node, collective name, resolved axis string)
+        self._axis_uses: list[tuple[Module, ast.Call, str, str]] = []
+
     def check(self, module: Module) -> Iterable[Finding]:
+        self._collect_mesh_axes(module)
         # every def keeps its own info; the name->infos multimap serves
         # reachability, so two same-named functions (methods of sibling
         # classes, same-named nested helpers) are BOTH analyzed — an
@@ -248,6 +298,110 @@ class TraceSafetyChecker(Checker):
                 )
         for lam, static in lambdas:
             yield from self._check_traced(module, lam, "<lambda>", static)
+
+    # -- mesh axis-name discipline -----------------------------------------
+    def _collect_mesh_axes(self, module: Module) -> None:
+        consts = _module_string_consts(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is None or _last(d) != "Mesh":
+                continue
+            spec: ast.AST | None = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    spec = kw.value
+            if spec is None:
+                continue
+            elts = (
+                spec.elts
+                if isinstance(spec, (ast.Tuple, ast.List))
+                else [spec]
+            )
+            for e in elts:
+                axis = self._resolve_axis(e, consts, [])
+                if axis is not None:
+                    self._declared_axes.add(axis)
+        self._collect_collectives(module, module.tree, [], consts)
+
+    def _collect_collectives(self, module, node, fnstack, consts) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fnstack = fnstack + [node]
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            coll = _last(d) if d is not None else ""
+            if coll in _COLLECTIVES:
+                spec = None
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis"):
+                        spec = kw.value
+                if spec is None:
+                    pos = _AXIS_ARG_POS.get(coll, 1)
+                    if len(node.args) > pos:
+                        spec = node.args[pos]
+                elts = (
+                    spec.elts
+                    if isinstance(spec, (ast.Tuple, ast.List))
+                    else [spec]
+                ) if spec is not None else []
+                for e in elts:
+                    axis = self._resolve_axis(e, consts, fnstack)
+                    if axis is not None:
+                        self._axis_uses.append((module, node, coll, axis))
+        for child in ast.iter_child_nodes(node):
+            self._collect_collectives(module, child, fnstack, consts)
+
+    def _resolve_axis(self, node, consts, fnstack) -> str | None:
+        """An axis argument as a string: a literal, a module string
+        constant, or (innermost-first) an enclosing function's parameter
+        default. Dynamic values resolve to None and are skipped."""
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        if not isinstance(node, ast.Name):
+            return None
+        if node.id in consts:
+            return consts[node.id]
+        for fn in reversed(fnstack):
+            args = [*fn.args.posonlyargs, *fn.args.args]
+            defaults = fn.args.defaults
+            # defaults right-align against the positional signature
+            offset = len(args) - len(defaults)
+            for i, p in enumerate(args):
+                if p.arg != node.id:
+                    continue
+                if i >= offset:
+                    return self._resolve_axis(
+                        defaults[i - offset], consts, []
+                    )
+                return None
+            for p, dflt in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+                if p.arg == node.id:
+                    return (
+                        self._resolve_axis(dflt, consts, [])
+                        if dflt is not None
+                        else None
+                    )
+        return None
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self._declared_axes:
+            # no mesh anywhere in the run: single-backend tree, nothing
+            # to check collectives against
+            return
+        for module, node, coll, axis in self._axis_uses:
+            if axis not in self._declared_axes:
+                yield self.finding(
+                    module,
+                    node,
+                    "unknown-axis-name",
+                    "error",
+                    f"{coll} names axis '{axis}', which no Mesh(...) in "
+                    f"the run declares (declared: "
+                    f"{sorted(self._declared_axes)}) — this raises "
+                    f"'unbound axis name' at trace time on the first "
+                    f"mesh-backed execution path",
+                )
 
     # -- jit site detection ------------------------------------------------
     def _jit_decorator(
