@@ -1136,6 +1136,76 @@ def test_eventloop_suppressible_with_justification(tmp_path):
     assert hits(findings) == []
 
 
+def test_eventloop_dict_churn_fires_in_dispatcher_loop(tmp_path):
+    """A task-shaped dict ({"task_id": ...}) built per iteration of a
+    Dispatcher-method loop is serve-loop allocator churn — the rule needs
+    no async roots (the push serve loop is a plain sync loop)."""
+    findings = check(
+        tmp_path,
+        """\
+        class ToyDispatcher:
+            def serve_once(self, batch):
+                frames = []
+                for t in batch:
+                    frames.append({"task_id": t.task_id, "fn_payload": t.fn})
+                return frames
+        """,
+    )
+    assert hits(findings) == [("eventloop.hot-loop-dict-churn", 5)]
+    assert findings[0].severity == "warning"
+
+
+def test_eventloop_dict_churn_fires_in_task_message_kwargs(tmp_path):
+    """The per-dispatch materializer fires wherever it lives — the rule's
+    anchors (class-name suffix, method name) scope it without path gates,
+    so the column-backed twin in core/ is held to the same discipline."""
+    findings = check(
+        tmp_path,
+        """\
+        class RowView:
+            def task_message_kwargs(self):
+                return {"task_id": self.task_id, "param_payload": self.params}
+        """,
+    )
+    assert hits(findings) == [("eventloop.hot-loop-dict-churn", 3)]
+
+
+def test_eventloop_dict_churn_exemptions_are_clean(tmp_path):
+    """Out of scope by design: non-task-shaped dicts in loops, logging
+    extra= dicts (the log call dwarfs the dict), task-shaped dicts built
+    once outside any loop, and non-Dispatcher classes."""
+    findings = check(
+        tmp_path,
+        """\
+        class ToyDispatcher:
+            def serve_once(self, batch, log):
+                for t in batch:
+                    stats = {"elapsed": t.elapsed}
+                    log.info("done", extra={"task_id": t.task_id})
+                return {"task_id": "summary", "n": len(batch)}
+
+        class Collector:
+            def gather(self, batch):
+                return [{"task_id": t.task_id} for t in batch]
+        """,
+    )
+    assert hits(findings) == []
+
+
+def test_eventloop_dict_churn_suppressible_at_wire_boundary(tmp_path):
+    findings = check(
+        tmp_path,
+        """\
+        class RowView:
+            def task_message_kwargs(self):
+                return {  # faas: allow(eventloop.hot-loop-dict-churn) wire contract
+                    "task_id": self.task_id,
+                }
+        """,
+    )
+    assert hits(findings) == []
+
+
 # -- replication (registry drift) --------------------------------------------
 
 

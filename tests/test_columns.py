@@ -1,0 +1,293 @@
+"""Columnar host data plane: the TaskColumns arena, RowTask views, and —
+the load-bearing property — the columnar intake lane making EXACTLY the
+same decisions as the dict plane over randomized announce streams."""
+
+from __future__ import annotations
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from tpu_faas.core.columns import (
+    STATUS_DISPATCHED,
+    STATUS_FREE,
+    STATUS_PENDING,
+    RowTask,
+    TaskColumns,
+)
+from tpu_faas.core.task import (
+    FIELD_COST,
+    FIELD_DEADLINE,
+    FIELD_FN_DIGEST,
+    FIELD_PRIORITY,
+    FIELD_SPECULATIVE,
+    FIELD_SUBMITTED_AT,
+    FIELD_TENANT,
+    FIELD_TIMEOUT,
+    FIELD_TRACE_ID,
+)
+from tpu_faas.dispatch.base import PendingTask, TaskDispatcher
+from tpu_faas.store.memory import MemoryStore
+
+
+# -- arena mechanics ---------------------------------------------------------
+
+
+def _flat(**fields):
+    """kwargs -> the flat [field, value, ...] list hgetall_many_raw hands
+    the intake (str spelling; the bytes leg has its own test)."""
+    out = []
+    for k, v in fields.items():
+        out.extend([k, v])
+    return out
+
+
+def test_arena_acquire_release_recycles_and_scrubs():
+    a = TaskColumns(capacity=2)
+    t = a.intake_flat("t1", _flat(fn_payload="F", param_payload="P",
+                                  priority="7", cost="2.5"))
+    assert isinstance(t, RowTask)
+    row = t.row
+    assert a.occupancy == 1
+    assert a.row_of("t1") == row
+    assert a.status[row] == STATUS_PENDING
+    t.release()
+    # recycled: id unmapped, row scrubbed, slot reusable
+    assert a.occupancy == 0
+    assert a.row_of("t1") is None
+    assert a.status[row] == STATUS_FREE
+    assert a.task_id[row] is None
+    assert np.isnan(a.cost[row])
+    t2 = a.intake_flat("t2", _flat(fn_payload="G", param_payload="Q"))
+    assert t2.row == row  # LIFO free list hands the hot row back
+    assert t2.priority == 0 and t2.cost is None
+
+
+def test_arena_overflow_returns_none_for_dict_fallback():
+    a = TaskColumns(capacity=2)
+    kept = [a.intake_flat(f"t{i}", _flat(fn_payload="F", param_payload="P"))
+            for i in range(2)]
+    assert all(isinstance(t, RowTask) for t in kept)
+    assert a.intake_flat("t2", _flat(fn_payload="F", param_payload="P")) is None
+    kept[0].release()
+    assert a.intake_flat("t3", _flat(fn_payload="F", param_payload="P")) is not None
+
+
+def test_intake_parse_semantics_match_the_dict_plane():
+    a = TaskColumns(capacity=8)
+    t = a.intake_flat("t", _flat(
+        fn_payload="FN", param_payload="PA",
+        priority=str(2 ** 40),       # clamps, never overflows the i32 column
+        cost="-1",                   # non-positive -> absent
+        timeout="inf",               # non-finite -> absent
+        deadline="12.5",
+        speculative="1",
+        fn_digest="", trace_id="", tenant="",  # empty string -> None
+    ))
+    assert t.priority == 2 ** 30
+    assert t.cost is None and t.timeout is None
+    assert t.deadline_at == 12.5
+    assert t.speculative is True
+    assert t.fn_digest is None and t.trace_id is None and t.tenant is None
+    junk = a.intake_flat("j", _flat(fn_payload="F", param_payload="P",
+                                    priority="wat", cost="nan"))
+    assert junk.priority == 0 and junk.cost is None
+
+
+def test_intake_accepts_bytes_rows():
+    """The negotiated binary-batch store hands bytes straight through —
+    the intake decodes without a str() detour on the whole row."""
+    a = TaskColumns(capacity=4)
+    t = a.intake_flat("t", [
+        b"fn_payload", b"FN", b"param_payload", b"PAR",
+        b"priority", b"-9", b"cost", b"1.5", b"speculative", b"1",
+        b"tenant", b"acme",
+    ])
+    assert t.fn_payload == "FN" and t.param_payload == "PAR"
+    assert t.priority == -9 and t.cost == 1.5
+    assert t.speculative is True and t.tenant == "acme"
+    assert a.payload_bytes[t.row] == 5
+
+
+def test_rowtask_detach_preserves_every_field():
+    a = TaskColumns(capacity=2)
+    t = a.intake_flat("t", _flat(
+        fn_payload="FN", param_payload="PAR", priority="3", cost="2.0",
+        timeout="9.0", submitted_at="100.5", deadline="200.0",
+        fn_digest="d1", trace_id="tr", tenant="ten", speculative="1",
+    ))
+    before = {
+        "task_id": t.task_id, "fn_payload": t.fn_payload,
+        "param_payload": t.param_payload, "fn_digest": t.fn_digest,
+        "trace_id": t.trace_id, "tenant": t.tenant,
+        "priority": t.priority, "retries": t.retries,
+        "speculative": t.speculative, "cost": t.cost,
+        "timeout": t.timeout, "submitted_at": t.submitted_at,
+        "deadline_at": t.deadline_at, "size_estimate": t.size_estimate,
+    }
+    kwargs_before = t.task_message_kwargs(blob=True, trace=True)
+    t.release()
+    t.release()  # idempotent
+    assert t.attached is False and t.row is None
+    after = {k: getattr(t, k) for k in before}
+    assert after == before
+    assert t.task_message_kwargs(blob=True, trace=True) == kwargs_before
+    # a detached view still takes writes (parked/requeued copies mutate)
+    t.retries = 2
+    assert t.retries == 2
+    assert a.occupancy == 0
+
+
+def test_gathers_trust_order_and_dtypes():
+    a = TaskColumns(capacity=8)
+    t_cost = a.intake_flat("c", _flat(fn_payload="xx", param_payload="yy",
+                                      cost="5.0", priority="2"))
+    t_learned = a.intake_flat("l", _flat(fn_payload="xx", param_payload="yy"))
+    t_learned.learned = 3.0
+    t_bytes = a.intake_flat("b", _flat(fn_payload="xxx", param_payload="y"))
+    rows = np.array([t_cost.row, t_learned.row, t_bytes.row], dtype=np.intp)
+    sizes = a.gather_sizes(rows)
+    assert sizes.dtype == np.float32
+    assert sizes.tolist() == [5.0, 3.0, 4.0]
+    prios = a.gather_priorities(rows)
+    assert prios.dtype == np.int32
+    assert prios.tolist() == [2, 0, 0]
+    # the gathers agree with the scalar views batch-for-batch
+    assert [t.size_estimate for t in (t_cost, t_learned, t_bytes)] == [
+        5.0, 3.0, 4.0,
+    ]
+
+
+def test_stamp_dispatched_marks_row():
+    a = TaskColumns(capacity=2)
+    t = a.intake_flat("t", _flat(fn_payload="F", param_payload="P"))
+    a.stamp_dispatched(t.row, 42.0)
+    assert a.status[t.row] == STATUS_DISPATCHED
+    assert a.dispatched_at[t.row] == 42.0
+
+
+# -- columnar-vs-dict intake equivalence -------------------------------------
+
+
+_ATTRS = (
+    "task_id", "fn_payload", "param_payload", "fn_digest", "trace_id",
+    "tenant", "priority", "retries", "speculative", "cost", "timeout",
+    "learned", "submitted_at", "deadline_at", "size_estimate",
+)
+
+
+def _random_extra_fields(rng: random.Random) -> dict:
+    """A randomized announce record: every optional field independently
+    present, with junk spellings mixed in at the rates real clients
+    produce them (hand-written curl bodies, reference-era SDKs)."""
+    pick = rng.random
+    out: dict = {}
+    if pick() < 0.7:
+        out[FIELD_PRIORITY] = rng.choice(
+            ["0", "5", "-3", str(2 ** 40), str(-(2 ** 40)), "wat", "1.5", ""]
+        )
+    if pick() < 0.6:
+        out[FIELD_COST] = rng.choice(
+            ["2.5", "0", "-1", "nan", "inf", "junk", "1e3"]
+        )
+    if pick() < 0.5:
+        out[FIELD_TIMEOUT] = rng.choice(["9.0", "-2", "0", "x"])
+    if pick() < 0.5:
+        out[FIELD_DEADLINE] = rng.choice(["150.25", "-5", "oops"])
+    if pick() < 0.5:
+        out[FIELD_SPECULATIVE] = rng.choice(["1", "0", "", "yes"])
+    if pick() < 0.5:
+        out[FIELD_FN_DIGEST] = rng.choice(["sha:abc", ""])
+    if pick() < 0.4:
+        out[FIELD_TRACE_ID] = rng.choice(["tr-1", ""])
+    if pick() < 0.4:
+        out[FIELD_TENANT] = rng.choice(["acme", "umbrella", ""])
+    return out
+
+
+def _populate_twins(stores, n: int, seed: int) -> None:
+    """Write the IDENTICAL announce stream into both stores (stamps pinned
+    so the twins match bit-for-bit despite wall-clock skew). Dispatchers
+    must already be constructed — intake only sees announces published
+    after its subscription exists."""
+    rng = random.Random(seed)
+    for i in range(n):
+        tid = f"t{i}"
+        fn = "F" * rng.randint(1, 12)
+        par = "P" * rng.randint(1, 12)
+        extra = _random_extra_fields(rng)
+        extra[FIELD_SUBMITTED_AT] = f"{100.0 + i}"
+        for s in stores:
+            s.create_task(tid, fn, par, channel="tasks", extra_fields=extra)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_columnar_intake_equivalent_to_dict_plane(seed):
+    """THE acceptance property: the same announce stream through the
+    columnar lane and the dict lane yields the same tasks, in the same
+    order, with the same derived attributes and the same wire kwargs —
+    the arena changes where task state LIVES, never what intake decides."""
+    n = 40
+    dict_store, col_store = MemoryStore(), MemoryStore()
+    d_dict = TaskDispatcher(store=dict_store)
+    d_col = TaskDispatcher(store=col_store)
+    d_col.enable_columnar(capacity=n)
+    _populate_twins((dict_store, col_store), n, seed)
+    got_dict = d_dict.poll_tasks(n)
+    got_col = d_col.poll_tasks(n)
+    assert len(got_dict) == len(got_col) == n
+    assert all(isinstance(t, PendingTask) for t in got_dict)
+    assert all(isinstance(t, RowTask) for t in got_col)
+    for td, tc in zip(got_dict, got_col):
+        for attr in _ATTRS:
+            vd, vc = getattr(td, attr), getattr(tc, attr)
+            assert vd == vc, (td.task_id, attr, vd, vc)
+        for blob in (False, True):
+            for trace in (False, True):
+                assert td.task_message_kwargs(blob=blob, trace=trace) == (
+                    tc.task_message_kwargs(blob=blob, trace=trace)
+                ), (td.task_id, blob, trace)
+    assert d_col.m_columnar_intake.labels(lane="arena").value == n
+    assert d_col.m_columnar_intake.labels(lane="fallback").value == 0
+    assert d_col.m_arena_occupancy.value == n
+
+
+def test_columnar_overflow_falls_back_per_task_not_per_poll():
+    """A full arena degrades one task at a time to the dict plane — same
+    attributes either way, and the lane counters tell the operator the
+    arena is undersized."""
+    n = 12
+    dict_store, col_store = MemoryStore(), MemoryStore()
+    d_dict = TaskDispatcher(store=dict_store)
+    d_col = TaskDispatcher(store=col_store)
+    d_col.enable_columnar(capacity=5)
+    _populate_twins((dict_store, col_store), n, seed=7)
+    got_dict = d_dict.poll_tasks(n)
+    got_col = d_col.poll_tasks(n)
+    assert len(got_col) == n
+    kinds = [isinstance(t, RowTask) for t in got_col]
+    assert kinds.count(True) == 5 and kinds.count(False) == 7
+    for td, tc in zip(got_dict, got_col):
+        for attr in _ATTRS:
+            assert getattr(td, attr) == getattr(tc, attr), (td.task_id, attr)
+    assert d_col.m_columnar_intake.labels(lane="arena").value == 5
+    assert d_col.m_columnar_intake.labels(lane="fallback").value == 7
+
+
+def test_claim_loser_releases_its_row():
+    """Two dispatchers racing the same announce stream: the claim loser's
+    arena rows recycle immediately (a leaked row per lost claim would
+    bleed the arena dry in a sharded fleet)."""
+    store = MemoryStore()
+    winner = TaskDispatcher(store=store, shared=True)
+    loser = TaskDispatcher(store=store, shared=True)
+    loser.enable_columnar(capacity=6)
+    for i in range(6):
+        store.create_task(f"t{i}", "F", "P", channel="tasks")
+    polled_w = winner.poll_tasks(6)
+    polled_l = loser.poll_tasks(6)
+    assert winner.claim_for_dispatch(polled_w) == polled_w
+    assert loser.claim_for_dispatch(polled_l) == []
+    assert loser.arena.occupancy == 0

@@ -179,6 +179,32 @@ def test_store_contract_against_redis(redis_url):
         s.close()
 
 
+def test_binbatch_knob_degrades_silently_against_redis(redis_url):
+    """binbatch=True against a backend that is NOT our store server: the
+    CAPS probe gets Redis's -ERR unknown command, negotiation reads that
+    as no capabilities, and every batched op rides the plain pipelined
+    forms — same results, no errors, no retries. This is the drop-in-Redis
+    half of the binary-batch contract (the other half — byte-identical
+    wire with the knob OFF — is pinned in test_store_resp.py)."""
+    s = make_store(redis_url, binbatch=True)
+    try:
+        s.create_tasks([(f"bb{i}", f"F{i}", f"P{i}") for i in range(3)])
+        recs = s.hgetall_many(["bb0", "ghost", "bb2"])
+        assert recs[0]["fn_payload"] == "F0"
+        assert recs[1] == {}
+        assert recs[2]["param_payload"] == "P2"
+        flats = s.hgetall_many_raw(["bb1", "ghost"])
+        assert dict(zip(flats[0][::2], flats[0][1::2]))["fn_payload"] == "F1"
+        assert list(flats[1]) == []
+        s.finish_task_many(
+            [("bb0", "COMPLETED", "r0", False), ("bb0", "FAILED", "x", True)]
+        )
+        assert s.get_result("bb0") == ("COMPLETED", "r0")
+        s.delete_many(["bb0", "bb1", "bb2"])
+    finally:
+        s.close()
+
+
 def test_local_dispatch_e2e_against_redis(redis_url):
     """A local dispatcher serving real traffic out of a Redis-semantics
     store."""

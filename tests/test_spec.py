@@ -6,6 +6,7 @@ the full-stack e2e + chaos legs under the race monitor."""
 
 from __future__ import annotations
 
+import math
 import subprocess
 import sys
 import threading
@@ -271,6 +272,95 @@ def test_batch_tick_dead_worker_redispatches_never_flags():
 
 
 # ---------------------------------------------------------------------------
+# tail-aware placement feedback (worker health)
+# ---------------------------------------------------------------------------
+def test_worker_health_decay_floor_recovery_and_register_reset():
+    """note_hedge_loss decays multiplicatively to a hard floor; the tick
+    recovers toward 1.0 at HEALTH_RECOVERY_TAU and SNAPS to exactly 1.0
+    (the bit-stable steady state the cached device upload keys on); a
+    recycled row registers with a clean slate."""
+    from tpu_faas.sched.state import SchedulerArrays
+
+    t = [100.0]
+    a = SchedulerArrays(
+        max_workers=4, max_pending=8, max_inflight=16, clock=lambda: t[0]
+    )
+    a.spec_mult = 2.0
+    r0 = a.register(b"w0", 2)
+    r1 = a.register(b"w1", 2)
+    a.note_hedge_loss(r0)
+    assert a.worker_health[r0] == pytest.approx(a.HEALTH_DECAY)
+    for _ in range(30):
+        a.note_hedge_loss(r0)
+    assert a.worker_health[r0] == pytest.approx(a.HEALTH_FLOOR)
+    # inactive and out-of-range rows are ignored (a purged worker's late
+    # hedge resolution must not decay whoever recycled its row)
+    a.deactivate(r1)
+    a.note_hedge_loss(r1)
+    assert a.worker_health[r1] == 1.0
+    a.note_hedge_loss(-1)
+    a.note_hedge_loss(99)
+    # recovery: one tau closes ~63% of the gap, long idle snaps to 1.0
+    a.tick(np.zeros(0, dtype=np.float32))  # primes the recovery stamp
+    h0 = float(a.worker_health[r0])
+    t[0] += a.HEALTH_RECOVERY_TAU
+    a.tick(np.zeros(0, dtype=np.float32))
+    h1 = float(a.worker_health[r0])
+    assert h1 == pytest.approx(h0 + (1 - h0) * (1 - math.exp(-1)), abs=1e-3)
+    t[0] += 40 * a.HEALTH_RECOVERY_TAU
+    a.tick(np.zeros(0, dtype=np.float32))
+    assert (a.worker_health == 1.0).all()
+    # a fresh registrant on a recycled row does not inherit the penalty
+    a.note_hedge_loss(r0)
+    a.deactivate(r0)
+    assert a.register(b"w0b", 2) == r0
+    assert a.worker_health[r0] == 1.0
+
+
+def test_worker_health_steers_placement_away_from_lossy_worker():
+    """The _impl twin folds health into EFFECTIVE speed: a worker whose
+    raw speed grade still says 'fastest' loses placements once its health
+    multiplier says the tail disagrees."""
+    from tpu_faas.sched.state import SchedulerArrays
+
+    t = [100.0]
+    a = SchedulerArrays(
+        max_workers=2, max_pending=4, max_inflight=8, clock=lambda: t[0]
+    )
+    a.spec_mult = 2.0
+    fast = a.register(b"fast", 2, speed=1.0)
+    slow = a.register(b"slow", 2, speed=0.6)
+    a.tick(np.zeros(0, dtype=np.float32))  # seed prev_live
+    out = a.tick(np.asarray([1.0], dtype=np.float32))
+    assert int(np.asarray(out.assignment)[0]) == fast
+    # repeated lost hedge races: effective speed 1.0*0.25 < 0.6
+    for _ in range(10):
+        a.note_hedge_loss(fast)
+    out = a.tick(np.asarray([1.0], dtype=np.float32))
+    assert int(np.asarray(out.assignment)[0]) == slow
+
+
+def test_worker_health_off_plane_is_inert():
+    """Speculation off: no health operand reaches the tick (byte-identical
+    trace) and a decayed value neither recovers nor influences placement."""
+    from tpu_faas.sched.state import SchedulerArrays
+
+    t = [100.0]
+    a = SchedulerArrays(
+        max_workers=2, max_pending=4, max_inflight=8, clock=lambda: t[0]
+    )
+    fast = a.register(b"fast", 2, speed=1.0)
+    a.register(b"slow", 2, speed=0.6)
+    a.worker_health[fast] = 0.1  # would lose every placement if consumed
+    a.tick(np.zeros(0, dtype=np.float32))
+    out = a.tick(np.asarray([1.0], dtype=np.float32))
+    assert int(np.asarray(out.assignment)[0]) == fast
+    t[0] += 1000.0
+    a.tick(np.zeros(0, dtype=np.float32))
+    assert a.worker_health[fast] == pytest.approx(0.1)  # no silent recovery
+
+
+# ---------------------------------------------------------------------------
 # dispatcher lifecycle units (fake worker rows, no sockets)
 # ---------------------------------------------------------------------------
 def _spec_dispatcher(clock, store=None, **kw):
@@ -342,6 +432,9 @@ def test_dispatcher_hedges_straggler_and_replica_wins():
         )
         assert disp.spec.n_replica_wins == 1
         assert "task-1" not in disp.spec.entries
+        # tail feedback: the loser's worker row took one health decay
+        assert a.worker_health[orig_row] == pytest.approx(a.HEALTH_DECAY)
+        assert a.worker_health[hedge_row] == 1.0
         assert a.inflight_owner("task-1") is None  # original's slot freed
         assert int(a.worker_free[orig_row]) == free_before + 1
         assert int(a.worker_free[hedge_row]) == 2  # replica slot back

@@ -332,3 +332,99 @@ def test_create_tasks_pipelined_announces_after_writes():
         c.close()
         reader.close()
         handle.stop()
+
+
+# -- binary-batch fast path (CAPS / MHGETALL / MFINISH) ----------------------
+
+
+def _flat_to_dict(flat):
+    """Decode one hgetall_many_raw entry ([f, v, f, v, ...], bytes on a
+    negotiated connection, str on the fallback) for comparison."""
+    def _s(x):
+        return x.decode() if isinstance(x, (bytes, bytearray)) else x
+
+    return {_s(flat[i]): _s(flat[i + 1]) for i in range(0, len(flat) - 1, 2)}
+
+
+def test_binbatch_negotiation_and_parity(store_server):
+    """binbatch=True negotiates the aggregate forms on servers advertising
+    them (CAPS -> MHGETALL/MFINISH) and silently stays on the plain
+    pipeline elsewhere (the native server answers -ERR to CAPS) — the
+    observable results are identical either way, which is the whole
+    contract: the knob changes round trips, never semantics."""
+    from tpu_faas.store.base import RESULTS_CHANNEL
+
+    s = make_store(store_server.url, binbatch=True)
+    plain = make_store(store_server.url)
+    try:
+        s.create_tasks([(f"m{i}", f"F{i}", f"P{i}") for i in range(3)])
+        recs = s.hgetall_many(["m0", "ghost", "m2"])
+        assert recs == plain.hgetall_many(["m0", "ghost", "m2"])
+        assert recs[1] == {}
+        assert s.hgetall_many([]) == []
+        # raw form: one flat [field, value, ...] per key, order kept,
+        # [] for a missing key; decodes to exactly the dict form
+        flats = s.hgetall_many_raw(["m0", "ghost", "m2"])
+        assert len(flats) == 3 and list(flats[1]) == []
+        assert _flat_to_dict(flats[0]) == recs[0]
+        assert _flat_to_dict(flats[2]) == recs[2]
+        assert s.hgetall_many_raw([]) == []
+        with plain.subscribe(RESULTS_CHANNEL) as rsub:
+            s.finish_task_many(
+                [
+                    ("m0", "COMPLETED", "r0", False),
+                    # intra-batch first_wins: m0 turned terminal one item
+                    # up — this write must be skipped, exactly as if the
+                    # items were applied sequentially
+                    ("m0", "FAILED", "late", True),
+                    ("m1", "FAILED", "r1", False),
+                ]
+            )
+            assert rsub.get_message(timeout=2.0) == "m0"
+            assert rsub.get_message(timeout=2.0) == "m1"
+            assert rsub.get_message(timeout=0.3) is None
+        assert plain.get_result("m0") == ("COMPLETED", "r0")
+        assert plain.get_result("m1") == ("FAILED", "r1")
+        # store-state first_wins: the frozen record stays frozen
+        s.finish_task_many([("m0", "COMPLETED", "again", True)])
+        assert plain.get_result("m0") == ("COMPLETED", "r0")
+        # live index dropped both terminal ids
+        assert set(plain.hgetall(LIVE_INDEX_KEY)) == {"m2"}
+        s.flush()
+    finally:
+        s.close()
+        plain.close()
+
+
+def test_binbatch_off_wire_surface_is_plain_redis(monkeypatch):
+    """The default (binbatch=False) client must put NOTHING non-Redis on
+    the wire: no CAPS probe, no MHGETALL/MFINISH — every command name in
+    the recorded stream is part of the plain-Redis subset. The opt-in
+    client on the same server shows the aggregate forms, proving the spy
+    would have caught them."""
+    sent: list[str] = []
+    real_encode = resp.encode_command
+
+    def spy(*parts):
+        sent.append(str(parts[0]).upper())
+        return real_encode(*parts)
+
+    monkeypatch.setattr(resp, "encode_command", spy)
+    handle = start_store_thread()
+    try:
+        s = make_store(handle.url)
+        s.create_tasks([("w0", "F", "P"), ("w1", "F", "P")])
+        s.hgetall_many(["w0", "w1"])
+        s.hgetall_many_raw(["w0", "w1"])
+        s.finish_task_many([("w0", "COMPLETED", "r", False)])
+        s.close()
+        forbidden = {"CAPS", "MHGETALL", "MFINISH"}
+        assert not forbidden & set(sent), sorted(forbidden & set(sent))
+        sent.clear()
+        fast = make_store(handle.url, binbatch=True)
+        fast.hgetall_many_raw(["w0", "w1"])
+        fast.finish_task_many([("w1", "COMPLETED", "r", False)])
+        fast.close()
+        assert "CAPS" in sent and "MHGETALL" in sent and "MFINISH" in sent
+    finally:
+        handle.stop()

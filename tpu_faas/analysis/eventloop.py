@@ -37,6 +37,19 @@ Rules (all error severity):
   ``validate_graph`` class (found live in PR 9 — a dependency-dedup list
   scan inside the gateway event loop, pre-admission, on bodies up to the
   256 MB cap). Use a set alongside the ordered list.
+- ``hot-loop-dict-churn`` (warning) — a task-shaped dict display (one
+  carrying a literal ``"task_id"`` key) built per iteration of a
+  ``for``/``while`` loop in a Dispatcher method, or built by a
+  ``task_message_kwargs`` materializer. The dispatcher's serve loop is
+  the host wall the columnar plane (core/columns.py) attacks: at tens of
+  thousands of tasks per second, one Python dict per task is allocator +
+  per-key hashing churn at task rate, and profile-visible. Read from the
+  arena columns instead; the ONE legitimate site is the legacy-worker
+  wire boundary, where the dict IS the message contract — suppress there
+  with a justification. Logging ``extra=`` dicts are exempt (the log
+  call they ride dwarfs the dict; the rule targets the data plane, not
+  diagnostics). Unlike the rules above, this one needs no async roots —
+  the push dispatcher's serve loop is a plain sync loop.
 
 Reachability is lexical plus a same-module call closure: an ``async def``
 body is scanned directly (nested ``def``s are skipped — they are values,
@@ -145,12 +158,81 @@ class EventLoopChecker(Checker):
     name = "eventloop"
 
     def check(self, module: Module) -> Iterable[Finding]:
+        yield from self._check_dict_churn(module)
         scope = _Scope(module.tree)
         if not scope.roots:
             return
         reported: set[tuple[int, str]] = set()
         for root, cls in scope.roots:
             yield from self._scan_root(module, scope, root, cls, reported)
+
+    # -- per-task dict churn on the dispatch serve loop ---------------------
+    @staticmethod
+    def _task_shaped_dicts(fn: ast.AST) -> Iterator[ast.Dict]:
+        """Dict displays carrying a literal ``"task_id"`` key — the
+        per-task message shape — lexically inside ``fn`` (nested defs
+        excluded, same value-not-code reasoning as the loop rules), minus
+        logging ``extra=`` keyword dicts."""
+        extras: set[ast.AST] = set()
+        for node in _lexical_statements(fn):
+            if isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg == "extra":
+                        extras.add(kw.value)
+        for node in _lexical_statements(fn):
+            if isinstance(node, ast.Dict) and node not in extras:
+                for key in node.keys:
+                    if (
+                        isinstance(key, ast.Constant)
+                        and key.value == "task_id"
+                    ):
+                        yield node
+                        break
+
+    def _check_dict_churn(self, module: Module) -> Iterator[Finding]:
+        """Task-shaped dicts at task rate: inside the per-dispatch
+        ``task_message_kwargs`` materializer, or per iteration of a loop in
+        a Dispatcher method. The anchors scope the rule by themselves — no
+        module path gating — so a new dispatcher backend inherits the
+        discipline the moment its class name says what it is."""
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for meth in node.body:
+                if not isinstance(meth, ast.FunctionDef):
+                    continue
+                if meth.name == "task_message_kwargs":
+                    for d in self._task_shaped_dicts(meth):
+                        yield self.finding(
+                            module, d, "hot-loop-dict-churn", "warning",
+                            f"per-task dict materialized by "
+                            f"{node.name}.task_message_kwargs(): on the "
+                            f"dispatch serve loop this runs at task rate — "
+                            f"legitimate ONLY at the legacy-worker wire "
+                            f"boundary where the dict is the message "
+                            f"contract (suppress there with the reason); "
+                            f"everywhere else, read the arena columns",
+                        )
+                    continue
+                if not node.name.endswith("Dispatcher"):
+                    continue
+                seen: set[ast.AST] = set()  # nested loops see the same dict
+                for sub in _lexical_statements(meth):
+                    if not isinstance(sub, (ast.For, ast.While)):
+                        continue
+                    for d in self._task_shaped_dicts(sub):
+                        if d in seen:
+                            continue
+                        seen.add(d)
+                        yield self.finding(
+                            module, d, "hot-loop-dict-churn", "warning",
+                            f"task-shaped dict built per iteration of a "
+                            f"loop in {node.name}.{meth.name}(): per-task "
+                            f"dict construction is allocator + hashing "
+                            f"churn at task rate on the serve loop — read "
+                            f"from the arena columns (core/columns.py) or "
+                            f"justify a suppression at a wire boundary",
+                        )
 
     # -- reachability walk -------------------------------------------------
     def _scan_root(

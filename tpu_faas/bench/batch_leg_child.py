@@ -18,6 +18,15 @@ probes solo express latency on the then-idle stack, scrapes /metrics
 against the strict exposition grammar mid-run, and prints ONE JSON row on
 stdout.
 
+The dispatcher's serve loop runs under cProfile (both legs pay the same
+overhead, so cross-leg ratios stay honest) and the row carries a
+``host_profile`` block — top-10 cumulative functions — attributing where
+the host cycles went. ``--columnar`` flips the dispatcher onto the
+columnar arena intake + binbatch store wire (core/columns.py);
+``--safety-poll-s`` pins the gateway's announce-loss safety poll, which
+otherwise floors solo wait latency at its default when an announce is
+dropped.
+
 Run: ``python -m tpu_faas.bench.batch_leg_child --batch-max 16
 --batch-window-ms 2 --tasks 2000 --workers 2 --procs 4 --solo 30``
 """
@@ -25,11 +34,36 @@ Run: ``python -m tpu_faas.bench.batch_leg_child --batch-max 16
 from __future__ import annotations
 
 import argparse
+import cProfile
 import json
+import pstats
 import sys
 import threading
 import time
 import urllib.request
+
+
+def _top_profile(prof: cProfile.Profile, limit: int = 10) -> list[dict]:
+    """Top ``limit`` functions by cumulative time, as JSON-able rows."""
+    import os
+
+    st = pstats.Stats(prof)
+    st.sort_stats("cumulative")
+    out: list[dict] = []
+    for func in st.fcn_list or []:
+        _cc, nc, tt, ct, _callers = st.stats[func]
+        fname, line, name = func
+        out.append(
+            {
+                "func": f"{os.path.basename(fname)}:{line}({name})",
+                "cum_s": round(ct, 4),
+                "tot_s": round(tt, 4),
+                "calls": int(nc),
+            }
+        )
+        if len(out) >= limit:
+            break
+    return out
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -42,6 +76,8 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--procs", type=int, default=4)
     ap.add_argument("--solo", type=int, default=30)
+    ap.add_argument("--columnar", action="store_true")
+    ap.add_argument("--safety-poll-s", type=float, default=2.0)
     ns = ap.parse_args(argv)
 
     # persistent XLA compile cache, same as fleet_child/the dispatcher
@@ -80,11 +116,13 @@ def main(argv: list[str] | None = None) -> None:
 
     n_tasks = ns.tasks
     handle = start_store_thread()
-    gw = start_gateway_thread(make_store(handle.url))
+    gw = start_gateway_thread(
+        make_store(handle.url), wait_safety_poll_s=ns.safety_poll_s
+    )
     disp = TpuPushDispatcher(
         ip="127.0.0.1",
         port=0,
-        store=make_store(handle.url),
+        store=make_store(handle.url, binbatch=ns.columnar),
         max_workers=max(64, ns.workers * 2),
         max_pending=4096,
         max_inflight=max(4 * n_tasks, 1024),
@@ -94,8 +132,20 @@ def main(argv: list[str] | None = None) -> None:
         express=True,
         batch_max=ns.batch_max,
         batch_window_ms=ns.batch_window_ms,
+        columnar=ns.columnar,
     )
-    disp_thread = threading.Thread(target=disp.start, daemon=True)
+    # profile the serve loop from inside its own thread (cProfile is
+    # per-thread); stats are read only after the thread joins
+    serve_profile = cProfile.Profile()
+
+    def _serve() -> None:
+        serve_profile.enable()
+        try:
+            disp.start()
+        finally:
+            serve_profile.disable()
+
+    disp_thread = threading.Thread(target=_serve, daemon=True)
     disp_thread.start()
     url = f"tcp://127.0.0.1:{disp.port}"
     workers = [
@@ -175,9 +225,20 @@ def main(argv: list[str] | None = None) -> None:
             h.result(timeout=60.0)
             solo_ms.append((time.perf_counter() - s0) * 1e3)
         solo_ms.sort()  # percentile() is nearest-rank over SORTED data
+        # quiesce the serve loop BEFORE reading its profile: cProfile
+        # stats are only consistent after the profiled thread exits
+        # (stop()/disp.stop() are idempotent flag-sets; the finally
+        # block's repeats are harmless)
+        for w in workers:
+            w.stop()
+        for t in worker_threads:
+            t.join(timeout=30)
+        disp.stop()
+        disp_thread.join(timeout=10)
         row = {
             "batch_max": ns.batch_max,
             "batch_window_ms": ns.batch_window_ms,
+            "columnar": bool(ns.columnar),
             "completed": completed,
             "tasks_per_s": round(completed / max(elapsed, 1e-9), 1),
             "frames_per_task": round(frames / max(n_dispatched, 1), 4),
@@ -198,6 +259,9 @@ def main(argv: list[str] | None = None) -> None:
                 .get("p99", 0.0) * 1e3,
                 2,
             ),
+            # top-10 cumulative serve-loop functions (cProfile over the
+            # dispatcher thread, warm-up through solo probe)
+            "host_profile": _top_profile(serve_profile),
         }
         print(json.dumps(row), flush=True)
     finally:

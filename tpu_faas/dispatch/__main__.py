@@ -271,6 +271,31 @@ def main(argv: list[str] | None = None) -> None:
         "tpu_faas_dispatcher_hedges_total{outcome='suppressed_budget'})",
     )
     ap.add_argument(
+        "--columnar", action="store_true",
+        help="tpu-push: columnar host data plane — intake decodes store "
+        "records straight into a struct-of-arrays task arena "
+        "(core/columns.py) and the batch build gathers its device lanes "
+        "from columns instead of walking per-task objects; per-task "
+        "dicts materialize only at the worker frame boundary. Dispatch "
+        "decisions and every wire/store surface are unchanged (property-"
+        "pinned); off keeps the classic dict plane byte for byte",
+    )
+    ap.add_argument(
+        "--arena-capacity", type=int, default=None, metavar="N",
+        help="tpu-push --columnar: task-arena rows (default 2x "
+        "--max-pending); a full arena degrades intake to the dict plane "
+        "per task, visible on tpu_faas_columnar_arena_occupancy",
+    )
+    ap.add_argument(
+        "--store-binbatch", action="store_true",
+        help="negotiate the RESP binary-batch command surface (CAPS/"
+        "MHGETALL/MFINISH) per store connection: batch record fetches "
+        "and result finishes ride length-prefixed raw-bytes replies in "
+        "ONE round trip. Plain Redis (or an older store) fails the probe "
+        "and the classic pipelined commands are used — off the wire is "
+        "byte-identical to the default",
+    )
+    ap.add_argument(
         "--speculate-min-s", type=float, default=0.05, metavar="S",
         help="tpu-push: absolute floor — an execution under S seconds is "
         "never flagged however tight its prediction (scheduling jitter "
@@ -290,6 +315,7 @@ def main(argv: list[str] | None = None) -> None:
         owned_store = make_store(
             ns.store,
             owned_shards=[int(x) for x in ns.shards.split(",") if x != ""],
+            binbatch=ns.store_binbatch,
         )
 
     if ns.mode == "local":
@@ -452,6 +478,9 @@ def main(argv: list[str] | None = None) -> None:
             speculate_mult=ns.speculate_mult,
             speculate_max_frac=ns.speculate_max_frac,
             speculate_min_s=ns.speculate_min_s,
+            columnar=ns.columnar,
+            arena_capacity=ns.arena_capacity,
+            store_binbatch=ns.store_binbatch,
         )
     if ns.mode == "tpu-push" and ns.multihost:
         # Lead-side failure containment: once the followers joined the

@@ -29,6 +29,7 @@ from collections import Counter, deque
 from dataclasses import dataclass
 
 from tpu_faas.admission.signal import CapacitySnapshot, publish_snapshot
+from tpu_faas.core.columns import RowTask, TaskColumns
 from tpu_faas.core.payload import PayloadLRU
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import (
@@ -109,6 +110,39 @@ def _has_payloads(fields: dict[str, str]) -> bool:
     if FIELD_PARAMS not in fields:
         return False
     return FIELD_FN in fields or FIELD_FN_DIGEST in fields
+
+
+def _flat_control(flat: list) -> tuple[set, str | None]:
+    """Intake control signals straight off a flat ``[field, value, ...]``
+    record (the shape ``hgetall_many_raw`` returns, elements bytes or
+    str): the set of field names present plus the status value. The
+    columnar lane routes every announce on these two without building the
+    record dict — ``_has_payloads``/``note_graph_parent`` only probe
+    membership, which a set answers."""
+    names: set = set()
+    status: str | None = None
+    for i in range(0, len(flat) - 1, 2):
+        f = flat[i]
+        if isinstance(f, bytes):
+            f = f.decode("utf-8")
+        names.add(f)
+        if f == FIELD_STATUS:
+            v = flat[i + 1]
+            status = v.decode("utf-8") if isinstance(v, bytes) else v
+    return names, status
+
+
+def _flat_dict(flat: list) -> dict[str, str]:
+    """Materialize a flat record into the classic str->str field dict —
+    the columnar lane's escape hatch for the rare branches that genuinely
+    need one (WAITING graph nodes, arena-full fallback)."""
+    out: dict[str, str] = {}
+    for i in range(0, len(flat) - 1, 2):
+        f, v = flat[i], flat[i + 1]
+        if isinstance(f, bytes):
+            f = f.decode("utf-8")
+        out[f] = v.decode("utf-8") if isinstance(v, bytes) else v
+    return out
 
 
 def _parse_positive_finite(raw: str | None) -> float | None:
@@ -197,7 +231,7 @@ class PendingTask:
         ``trace=True`` (the worker negotiated CAP_TRACE): the trace id
         rides along so the worker's logs correlate and its RESULT echoes
         it — reference-era workers never see the field."""
-        out = {
+        out = {  # faas: allow(eventloop.hot-loop-dict-churn) the TASK frame's wire payload: this dict IS the worker message contract, materialized once per dispatch at the legacy boundary
             "task_id": self.task_id,
             "param_payload": self.param_payload,
         }
@@ -336,8 +370,13 @@ class TaskDispatcher:
         channel: str = TASKS_CHANNEL,
         store: TaskStore | None = None,
         shared: bool = False,
+        store_binbatch: bool = False,
     ) -> None:
-        self.store = store if store is not None else make_store(store_url)
+        self.store = (
+            store
+            if store is not None
+            else make_store(store_url, binbatch=store_binbatch)
+        )
         self.channel = channel
         self.subscriber = self.store.subscribe(channel)
         self.log = get_logger(type(self).__name__)
@@ -418,6 +457,26 @@ class TaskDispatcher:
             "classic per-task form; larger values are TASK_BATCH frames "
             "to batch-capable workers)",
             buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0),
+        )
+        # -- columnar host data plane (core/columns.py, opt-in) ------------
+        #: TaskColumns arena when --columnar intake is enabled (see
+        #: enable_columnar); None keeps the dict-plane intake byte-for-byte
+        self.arena: TaskColumns | None = None
+        self.m_columnar_intake = self.metrics.counter(
+            "tpu_faas_columnar_intake_total",
+            "Tasks decoded at intake under --columnar, by lane: "
+            "lane=\"arena\" went straight into a TaskColumns row (no "
+            "per-task record dict anywhere on its path); lane=\"fallback\" "
+            "found the arena full and degraded to the dict plane "
+            "(identical semantics, classic allocation cost)",
+            ("lane",),
+        )
+        self.m_arena_occupancy = self.metrics.gauge(
+            "tpu_faas_columnar_arena_occupancy",
+            "TaskColumns rows currently held (attached RowTasks); pinned "
+            "at capacity = intake is degrading to the dict-plane fallback "
+            "— raise --arena-capacity (rows recycle at dispatch/drop, so "
+            "steady state tracks the pending depth)",
         )
         self.m_queue_depth = self.metrics.gauge(
             "tpu_faas_dispatcher_pending_tasks",
@@ -1082,6 +1141,41 @@ class TaskDispatcher:
         self._cap_results_at_publish = results
 
     # -- intake ------------------------------------------------------------
+    def enable_columnar(self, capacity: int) -> None:
+        """Switch batch intake (poll_tasks) onto the columnar lane: store
+        records decode straight into a TaskColumns arena and RowTask views
+        flow through the pending structures instead of PendingTasks. Wire,
+        store, and dispatch semantics are unchanged — the lane is a memory-
+        layout change only, property-pinned by the intake-equivalence
+        tests. Size ``capacity`` to the worst-case pending depth (tpu-push
+        passes 2x max_pending); overflow degrades to the dict plane per
+        task, never errors."""
+        self.arena = TaskColumns(capacity)
+        # render both lanes at zero from the first scrape; the children are
+        # kept as attributes so the intake loop skips the per-call label
+        # resolution (a dict probe + lock per task at dispatch rates)
+        self._m_intake_arena = self.m_columnar_intake.labels(lane="arena")
+        self._m_intake_fallback = self.m_columnar_intake.labels(lane="fallback")
+
+    def _retire_row(self, task, dispatched: bool = False) -> None:
+        """Recycle ``task``'s arena row at the moment its fate is sealed.
+        ``dispatched`` (the task is on the wire — the hot path, once per
+        dispatch) detaches WITHOUT the field snapshot: a reclaim rebuilds
+        from the store record, never from this view, so the snapshot would
+        be dead work. Permanent drops keep the full snapshot — their views
+        can be re-queued or parked and must keep answering. No-op for
+        plain PendingTasks and already-detached views, so drop sites call
+        it unconditionally. The occupancy gauge refreshes here only on the
+        rare drop path; the hot path leaves it to the per-tick refresh
+        (intake sets it every poll, tpu-push again at tick end)."""
+        if isinstance(task, RowTask) and task.attached:
+            if dispatched:
+                task.discard()
+            else:
+                task.release()
+                if self.arena is not None:
+                    self.m_arena_occupancy.set(float(self.arena.occupancy))
+
     def poll_next_task(self) -> PendingTask | None:
         """Non-blocking: one announcement -> payload fetch (reference
         query_redis, task_dispatcher.py:38-52). Announcements whose hash has
@@ -1338,6 +1432,8 @@ class TaskDispatcher:
         # racing the winner's create. Dispatching both would run the task
         # twice — fetch and deliver each id once.
         unique = list(dict.fromkeys(msgs))
+        if self.arena is not None:
+            return self._poll_tasks_columnar(msgs, unique)
         try:
             records = self.store.hgetall_many(unique)
         except BaseException:
@@ -1384,6 +1480,83 @@ class TaskDispatcher:
             out.append(task)
         return out
 
+    def _poll_tasks_columnar(
+        self, msgs: list[str], unique: list[str]
+    ) -> list[PendingTask]:
+        """poll_tasks' columnar lane (--columnar): the ONE record fetch
+        goes over ``hgetall_many_raw`` — flat [field, value, ...] lists,
+        raw bytes end to end on a binbatch store connection — and each
+        QUEUED announce decodes straight into the TaskColumns arena. No
+        per-task record dict is built anywhere on the hot path: control
+        routing reads a field-name set (+ status), and the RowTask views
+        returned here duck-type PendingTask for every downstream consumer.
+        Per-announce semantics, skip rules, and the all-or-nothing outage
+        contract are poll_tasks' own, mirrored branch for branch (the
+        intake-equivalence property test pins the two lanes to identical
+        dispatch decisions); the rare branches that genuinely need a dict
+        (WAITING graph nodes, arena-full fallback) materialize one."""
+        try:
+            records = self.store.hgetall_many_raw(unique)
+        except BaseException:
+            # same parking contract as the dict lane: the announces are
+            # spent, so ANY fetch failure re-parks the whole drain
+            self._announce_backlog.extendleft(reversed(msgs))
+            raise
+        arena = self.arena
+        out: list[PendingTask] = []
+        n_arena = n_fallback = 0
+        for msg, flat in zip(unique, records):
+            names, status = _flat_control(flat)
+            if not _has_payloads(names):
+                self.log.warning("announce for unknown task %s; skipping", msg)
+                continue
+            if status == str(TaskStatus.WAITING) and FIELD_DEPS in names:
+                # graph node behind its dependencies (see poll_next_task):
+                # held host-side as a classic PendingTask — frontier nodes
+                # outlive intake and the dict is built once, off the hot
+                # path
+                fields = _flat_dict(flat)
+                self.note_graph_parent(msg, fields)
+                self.note_waiting(PendingTask.from_fields(msg, fields), fields)
+                continue
+            if status != str(TaskStatus.QUEUED):
+                # duplicate or stale announce (see poll_next_task): never
+                # dispatch, and never consume a cancel note here
+                self._close_skipped_timeline(msg, status)
+                self.log.debug("announce for non-QUEUED task %s; skipping", msg)
+                continue
+            if msg in self.kill_requested:
+                # fresh QUEUED incarnation entering OUR pending set: any
+                # held kill note targets a previous incarnation (full
+                # rationale in poll_next_task)
+                self.kill_requested.pop(msg, None)
+                self.log.info(
+                    "dropped stale kill note for resubmitted task %s", msg
+                )
+            task = arena.intake_flat(msg, flat)
+            if task is None:
+                # arena full: the dict plane absorbs the overflow with
+                # identical semantics — degraded allocation cost, visible
+                # on the lane counter and the pinned occupancy gauge
+                task = PendingTask.from_fields(msg, _flat_dict(flat))
+                n_fallback += 1
+            else:
+                n_arena += 1
+            self.note_graph_parent(msg, names)
+            self._note_intake(task)
+            if FIELD_DEPS in names:
+                # promoted graph child (see poll_next_task)
+                self.traces.note(msg, "promoted")
+            out.append(task)
+        # lane counters tick once per drain, not once per task — same
+        # series, a fraction of the lock traffic
+        if n_arena:
+            self._m_intake_arena.inc(n_arena)
+        if n_fallback:
+            self._m_intake_fallback.inc(n_fallback)
+        self.m_arena_occupancy.set(float(arena.occupancy))
+        return out
+
     # -- shared-fleet dispatch claims --------------------------------------
     def _claim_value(self) -> str:
         return f"{self.dispatcher_id}:{time.time()}"
@@ -1428,6 +1601,7 @@ class TaskDispatcher:
             else:
                 # a sibling owns it: its lifecycle is theirs to trace
                 self.traces.discard(t.task_id)
+                self._retire_row(t)
         if len(kept) != len(tasks):
             self.log.debug(
                 "dispatch claims: kept %d/%d (rest owned by siblings)",

@@ -39,6 +39,7 @@ from tpu_faas.core.task import (
     TaskStatus,
     claim_field_for,
 )
+from tpu_faas.core.columns import RowTask
 from tpu_faas.graph.frontier import GraphFrontier
 from tpu_faas.dispatch.base import (
     STORE_OUTAGE_ERRORS,
@@ -91,10 +92,28 @@ class TpuPushDispatcher(TaskDispatcher):
         speculate_mult: float | None = None,
         speculate_max_frac: float = 0.1,
         speculate_min_s: float = 0.05,
+        columnar: bool = False,
+        arena_capacity: int | None = None,
+        store_binbatch: bool = False,
     ) -> None:
         super().__init__(
-            store_url=store_url, channel=channel, store=store, shared=shared
+            store_url=store_url, channel=channel, store=store, shared=shared,
+            store_binbatch=store_binbatch,
         )
+        # -- columnar host data plane (core/columns.py, opt-in): intake
+        # decodes store records straight into a struct-of-arrays arena and
+        # RowTask views ride the pending structures; the batch build then
+        # GATHERS sizes/priorities from columns instead of walking
+        # per-task objects. Off = the dict plane verbatim. Capacity
+        # defaults to 2x the pending bound: pending + device-resident
+        # tasks together are capped at max_pending, so 2x absorbs a whole
+        # reclaim burst before intake has to fall back.
+        if columnar:
+            self.enable_columnar(
+                arena_capacity
+                if arena_capacity is not None
+                else 2 * max_pending
+            )
         # -- tenancy plane (tpu_faas/tenancy): ON iff the operator named a
         # share or cap config. Off = zero new work anywhere (the tick
         # traces its pre-tenancy graph, no per-task bookkeeping). The
@@ -754,15 +773,37 @@ class TpuPushDispatcher(TaskDispatcher):
             return
         # digest-carrying tasks key estimation off their content address
         # (the body may not be materialized host-side at all); inline
-        # tasks keep the historical blake2b identity
+        # tasks keep the historical blake2b identity. Fields read into
+        # locals once — on RowTask views every attribute is a column
+        # property, and this hook runs once per intaken task
         d = task.fn_digest or fn_digest(task.fn_payload)
-        pd = fn_digest(task.param_payload)
-        pbytes = len(task.param_payload)
+        pp = task.param_payload
+        pd = fn_digest(pp)
+        pbytes = len(pp)
         self._task_digest[task.task_id] = (d, pd, pbytes)
         if task.cost is None:
-            task.learned = est.size_for(d, pd, pbytes)
-            if task.learned is None:
-                task.learned = est.default_size()
+            learned = est.size_for(d, pd, pbytes)
+            if learned is None:
+                learned = est.default_size()
+            task.learned = learned
+
+    def _batch_rows(self, batch) -> np.ndarray | None:
+        """Arena row indices for a device batch, or None when any member
+        is off the columnar plane (plain PendingTask, detached RowTask, or
+        --columnar off) — mixed batches happen routinely (hedge replicas,
+        arena-full fallbacks, outage requeues), and the whole-batch gather
+        is only sound when every row is live."""
+        if self.arena is None or not batch:
+            return None
+        rows = np.empty(len(batch), dtype=np.intp)
+        for i, t in enumerate(batch):
+            if not isinstance(t, RowTask):
+                return None
+            r = t.row
+            if r is None:
+                return None
+            rows[i] = r
+        return rows
 
     # -- tenancy plane (tpu_faas/tenancy) ----------------------------------
     def _tenant_row(self, task: PendingTask) -> int:
@@ -1004,6 +1045,12 @@ class TpuPushDispatcher(TaskDispatcher):
             spec.resolve(
                 task_id, winner="replica",
                 loser_row=row_o if row_o is not None else entry.orig_row,
+            )
+            # tail-aware placement feedback: the original's worker just
+            # LOST a straggler race — decay its health multiplier so the
+            # next ticks place around it (recovers over time, state.py)
+            a.note_hedge_loss(
+                row_o if row_o is not None else entry.orig_row
             )
             self.m_hedges.labels(outcome="replica_won").inc()
             self.traces.note(task_id, "hedge_resolved", count_dup=False)
@@ -1578,6 +1625,9 @@ class TpuPushDispatcher(TaskDispatcher):
             raise
         for t in polled:
             if not fresh(t.task_id):
+                # duplicate of a task already pending/in flight: its arena
+                # row (if any) recycles with the dropped copy
+                self._retire_row(t)
                 continue
             if self.graph is not None:
                 # a promoted child whose WAITING copy the frontier still
@@ -1683,24 +1733,41 @@ class TpuPushDispatcher(TaskDispatcher):
         try:
             for t in batch:
                 self._stamp_estimate(t)
-            sizes = np.asarray(
-                [t.size_estimate for t in batch], dtype=np.float32
-            )
-            # only build (and pay for) the priority lane when some task in
-            # the batch actually carries a non-default priority
-            prios = None
-            if any(t.priority for t in batch):
-                prios = np.asarray([t.priority for t in batch], dtype=np.int32)
-                if a.placement != "rank" and not self._warned_priority:
-                    # don't silently downgrade: entropic/auction admission
-                    # is soft by construction, so the hint is dropped there
-                    self.log.warning(
-                        "clients are sending 'priority' hints but placement "
-                        "%r ignores them — hard priority classes need "
-                        "--placement rank",
-                        a.placement,
+            arena_rows = self._batch_rows(batch)
+            if arena_rows is not None:
+                # columnar batch build: whole-column gathers replace the
+                # per-task property walks (the f32 sizes and i32 priority
+                # lanes come out numerically identical — gather_sizes IS
+                # size_estimate's trust order, vectorized)
+                sizes = self.arena.gather_sizes(arena_rows)
+                prios = self.arena.gather_priorities(arena_rows)
+                if not prios.any():
+                    # all-default priorities: drop the lane, keeping the
+                    # jitted tick signature identical to the dict plane's
+                    prios = None
+            else:
+                sizes = np.asarray(
+                    [t.size_estimate for t in batch], dtype=np.float32
+                )
+                # only build (and pay for) the priority lane when some task
+                # in the batch actually carries a non-default priority
+                prios = None
+                if any(t.priority for t in batch):
+                    prios = np.asarray(
+                        [t.priority for t in batch], dtype=np.int32
                     )
-                    self._warned_priority = True
+            if prios is not None and (
+                a.placement != "rank" and not self._warned_priority
+            ):
+                # don't silently downgrade: entropic/auction admission
+                # is soft by construction, so the hint is dropped there
+                self.log.warning(
+                    "clients are sending 'priority' hints but placement "
+                    "%r ignores them — hard priority classes need "
+                    "--placement rank",
+                    a.placement,
+                )
+                self._warned_priority = True
             # tenancy lane: dense tenant row per batch task (the in-tick
             # fairness mask + admission order key off it); None keeps the
             # flat jitted signature
@@ -1816,6 +1883,7 @@ class TpuPushDispatcher(TaskDispatcher):
                         # worker: re-dispatching would regress the record
                         # to RUNNING
                         self._forget_task_state(task.task_id)
+                        self._retire_row(task)
                         restore_from = idx + 1
                         continue
                     wid = a.row_ids[row]
@@ -1868,6 +1936,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     # tail; a vanished blob FAILs the task in place)
                     if not blob and not self.ensure_inline_payload(task):
                         self._forget_task_state(task.task_id)
+                        self._retire_row(task)
                         restore_from = idx + 1
                         continue
                     try:
@@ -1908,6 +1977,9 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.n_dispatched += 1
                     self.m_dispatched.inc()
                     self._note_tenant_dispatch(task)
+                    # on the wire: the arena row recycles (a reclaim
+                    # rebuilds from the store record, never from this row)
+                    self._retire_row(task, dispatched=True)
         except STORE_OUTAGE_ERRORS:
             for i in range(restore_from, len(batch)):
                 if i not in frontier_rows or i in popped_frontier:
@@ -1947,6 +2019,10 @@ class TpuPushDispatcher(TaskDispatcher):
         # queue back (they ride the next tick's placement as ghost rows)
         if straggler_idx is not None and len(straggler_idx):
             self._consider_hedges(straggler_idx)
+        if self.arena is not None:
+            # per-tick occupancy refresh: the dispatch hot path retires
+            # rows without touching the gauge (see _retire_row)
+            self.m_arena_occupancy.set(float(self.arena.occupancy))
         return sent
 
     def _finished_probe(self, task_ids: list[str]) -> set[str]:
@@ -2009,12 +2085,19 @@ class TpuPushDispatcher(TaskDispatcher):
             for t in reversed(hedges):
                 self.pending.appendleft(t)
             if batch:
+                # columnar plane: the bulk-load lanes gather from the
+                # arena's columns when the whole backlog rode intake there
+                rows_b = self._batch_rows(batch)
                 a.pending_bulk_load(
                     [t.task_id for t in batch],
-                    np.asarray(
+                    self.arena.gather_sizes(rows_b)
+                    if rows_b is not None
+                    else np.asarray(
                         [t.size_estimate for t in batch], dtype=np.float32
                     ),
-                    priorities=np.asarray(
+                    priorities=self.arena.gather_priorities(rows_b)
+                    if rows_b is not None
+                    else np.asarray(
                         [t.priority or 0 for t in batch], dtype=np.int32
                     ),
                     tenants=(
@@ -2083,6 +2166,9 @@ class TpuPushDispatcher(TaskDispatcher):
             if res is None:
                 break
             sent += self._act_on_resolved(res)
+        if self.arena is not None:
+            # per-tick occupancy refresh (see _tick_inner)
+            self.m_arena_occupancy.set(float(self.arena.occupancy))
         return sent
 
     def _relay_kills(self) -> None:
@@ -2118,6 +2204,7 @@ class TpuPushDispatcher(TaskDispatcher):
             return None
         if dropped:
             self._forget_task_state(t.task_id)
+            self._retire_row(t)
             return True
         return False
 
@@ -2352,6 +2439,7 @@ class TpuPushDispatcher(TaskDispatcher):
                         # free diff carries the correction up) — but never
                         # dispatch, and never re-queue
                         self._forget_task_state(task_id)
+                        self._retire_row(task)
                         a.release_slot(row)
                         continue
                     if row not in a.row_ids:
@@ -2409,6 +2497,7 @@ class TpuPushDispatcher(TaskDispatcher):
                             # zombie worker: re-dispatching would regress
                             # the record
                             self._forget_task_state(task.task_id)
+                            self._retire_row(task)
                             a.release_slot(row)
                             continue
                     wid = a.row_ids[row]
@@ -2427,6 +2516,7 @@ class TpuPushDispatcher(TaskDispatcher):
                             # blob vanished: task FAILed in place; the
                             # kernel-consumed slot returns to the pool
                             self._forget_task_state(task.task_id)
+                            self._retire_row(task)
                             a.release_slot(row)
                             continue
                     try:
@@ -2455,6 +2545,9 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.n_dispatched += 1
                     self.m_dispatched.inc()
                     self._note_tenant_dispatch(task)
+                    # on the wire: the arena row recycles (a reclaim
+                    # rebuilds from the store record, never from this row)
+                    self._retire_row(task, dispatched=True)
         finally:
             # buffered TASK_BATCH frames first (tracked in-flight tasks
             # must reach the wire), then the coalesced RUNNING flush,

@@ -15,6 +15,7 @@ store + worker reconnects.
 
 from __future__ import annotations
 
+import math
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -58,6 +59,7 @@ def _packed_tick(
     spec_mult=None,  # f32 scalar straggler multiplier
     spec_min_s=None,  # f32 scalar absolute floor
     task_avoid_worker=None,  # i32[T] hedge anti-affinity row (-1 = none)
+    worker_health=None,  # f32[W] tail-health multiplier on effective speed
     *,
     T: int,
     W: int,
@@ -113,6 +115,7 @@ def _packed_tick(
         spec_mult=spec_mult,
         spec_min_s=spec_min_s,
         task_avoid_worker=task_avoid_worker,
+        worker_health=worker_health,
     )
     if task_pref is not None:
         # data-locality exchange for graph children: prefer the worker
@@ -186,7 +189,18 @@ def scheduler_tick_impl(
     spec_mult: jnp.ndarray | None = None,  # f32 scalar straggler multiplier
     spec_min_s: jnp.ndarray | None = None,  # f32 scalar absolute floor
     task_avoid_worker: jnp.ndarray | None = None,  # i32[T] forbidden row
+    worker_health: jnp.ndarray | None = None,  # f32[W] tail multiplier
 ) -> TickOutput:
+    # -- tail-aware placement feedback (speculation plane): a worker that
+    # keeps LOSING hedge races is slow in a way its learned speed grade
+    # hasn't caught yet (the grade averages; the tail is what hedging
+    # measures). Its health multiplier — host-decayed per lost race,
+    # recovering toward 1.0 over time (SchedulerArrays.note_hedge_loss) —
+    # scales its EFFECTIVE speed here, so every placement kernel (and the
+    # hedge fixup's re-placement) steers work away until it recovers.
+    # None (plane off, or resident tick) keeps the byte-identical trace.
+    if worker_health is not None:
+        worker_speed = worker_speed * worker_health
     # -- failure detection (reference purge_workers, device-side) ----------
     # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
     # subtract before the device sees anything, so f32 quantization error is
@@ -410,6 +424,14 @@ class SchedulerArrays:
                 )
         W = self.max_workers
         self.worker_speed = np.zeros(W, dtype=np.float32)
+        #: tail-health multiplier on effective placement speed (1.0 =
+        #: healthy): decayed by note_hedge_loss each time the row LOSES a
+        #: hedge race, recovered toward 1.0 by the tick at
+        #: HEALTH_RECOVERY_TAU. Consumed by the batch tick while the
+        #: speculation plane is on (the only producer of losses); the
+        #: resident tick keeps its pre-health state layout.
+        self.worker_health = np.ones(W, dtype=np.float32)
+        self._last_health_recover: float | None = None
         self.worker_free = np.zeros(W, dtype=np.int32)
         self.worker_active = np.zeros(W, dtype=bool)
         # float64: absolute monotonic timestamps live host-side only; the
@@ -485,6 +507,9 @@ class SchedulerArrays:
             self.row_ids[row] = worker_id
         self.worker_active[row] = True
         self.worker_speed[row] = speed
+        # clean tail-health slate: the row may be recycled from a purged
+        # worker, and a fresh registrant must not inherit its penalty
+        self.worker_health[row] = 1.0
         self.worker_procs[row] = num_processes
         self.worker_free[row] = num_processes
         self.last_heartbeat[row] = self.clock()
@@ -520,6 +545,43 @@ class SchedulerArrays:
         wid = self.row_ids.pop(row, None)
         if wid is not None:
             self.worker_ids.pop(wid, None)
+
+    # -- tail-aware worker health ------------------------------------------
+    #: multiplicative penalty per lost hedge race, the hard floor under
+    #: repeated losses, and the recovery time constant (seconds to close
+    #: ~63% of the remaining gap back toward 1.0)
+    HEALTH_DECAY = 0.8
+    HEALTH_FLOOR = 0.25
+    HEALTH_RECOVERY_TAU = 30.0
+
+    def note_hedge_loss(self, row: int) -> None:
+        """The original placement on ``row`` LOST its hedge race: the worker
+        is slow in a way the learned speed grade hasn't caught yet (the
+        grade averages; the race measures the tail). Decay the row's health
+        multiplier so the next ticks steer work away; recovery is
+        time-based and happens in tick() (_recover_health)."""
+        if 0 <= row < len(self.worker_health) and self.worker_active[row]:
+            self.worker_health[row] = max(
+                self.HEALTH_FLOOR,
+                float(self.worker_health[row]) * self.HEALTH_DECAY,
+            )
+
+    def _recover_health(self, now: float) -> None:
+        """Exponential recovery toward 1.0. Rows within noise of 1.0 snap to
+        EXACTLY 1.0 so the all-healthy steady state is bit-stable — that is
+        what lets the _cached_dev("health", ...) compare-and-upload go back
+        to sleep once the fleet has recovered."""
+        last = self._last_health_recover
+        self._last_health_recover = now
+        if last is None or not (self.worker_health < 0.9999).any():
+            return
+        dt = now - last
+        if dt <= 0.0:
+            return
+        alpha = 1.0 - math.exp(-dt / self.HEALTH_RECOVERY_TAU)
+        h = self.worker_health
+        h += (np.float32(1.0) - h) * np.float32(alpha)
+        np.copyto(h, np.float32(1.0), where=h > 0.999)
 
     # -- in-flight table ---------------------------------------------------
     @property
@@ -796,7 +858,12 @@ class SchedulerArrays:
                 # speculation lanes (tpu_faas/spec): elapsed ages are
                 # computed host-side like the heartbeat ages (f64 stamps
                 # never cross the wire); pred ships as a snapshot — the
-                # act loop mutates it the moment tick() returns
+                # act loop mutates it the moment tick() returns. Tail
+                # health rides the same gate: only the speculation plane
+                # produces hedge losses, so only it pays the extra operand
+                # (the off-plane trace stays byte-identical), and once the
+                # fleet recovers to all-ones the cached upload goes idle.
+                self._recover_health(now_f)
                 spec_kw = dict(
                     spec_elapsed=jnp.asarray(
                         (now_f - self.inflight_started).astype(np.float32)
@@ -804,6 +871,9 @@ class SchedulerArrays:
                     spec_predicted=jnp.asarray(self.inflight_pred.copy()),
                     spec_mult=jnp.float32(self.spec_mult),
                     spec_min_s=jnp.float32(self.spec_min_s),
+                    worker_health=self._cached_dev(
+                        "health", self.worker_health
+                    ),
                 )
             if task_avoid is not None:
                 av = np.full(T, -1, dtype=np.int32)

@@ -571,6 +571,18 @@ class TaskStore(abc.ABC):
         of one hgetall per announce."""
         return [self.hgetall(k) for k in keys]
 
+    def hgetall_many_raw(self, keys: list[str]) -> list[list]:
+        """Full records of many hashes as FLAT ``[field, value, ...]``
+        lists, one per key ([] for a missing key) — the columnar intake's
+        read form (dispatch/base.py): no per-record dict is materialized.
+        Elements are ``bytes`` on the RESP client's negotiated binary-batch
+        path and ``str`` everywhere else; columnar consumers must accept
+        both. Default: re-flatten hgetall_many."""
+        return [
+            [p for kv in rec.items() for p in kv]
+            for rec in self.hgetall_many(keys)
+        ]
+
     # -- content-addressed blobs ------------------------------------------
     def put_blob(self, digest: str, data: str) -> bool:
         """Put-if-absent write of a payload body under its content address.

@@ -87,15 +87,20 @@ class _Conn:
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.parser = resp.RespParser()
+        #: True once THIS connection negotiated the binary-batch command
+        #: surface (CAPS advertised "binbatch") — per-connection exactly
+        #: like CAP_BIN on the worker wire: a reconnect (possibly to a
+        #: plain Redis after failover) re-negotiates from scratch
+        self.binbatch = False
 
     def send(self, *parts: str | bytes | int) -> int:
         data = resp.encode_command(*parts)
         self.sock.sendall(data)
         return len(data)
 
-    def recv_reply(self):
+    def recv_reply(self, raw: bool = False):
         while True:
-            item = self.parser.pop()
+            item = self.parser.pop(raw=raw)
             if item is not resp.NEED_MORE:
                 if isinstance(item, resp.RespError):
                     raise item
@@ -285,6 +290,7 @@ class RespStore(TaskStore):
         host: str = "127.0.0.1",
         port: int = 6380,
         endpoints: list[tuple[str, int]] | None = None,
+        binbatch: bool = False,
     ) -> None:
         #: ordered failover ring; [(host, port)] in the classic
         #: single-endpoint form
@@ -322,6 +328,14 @@ class RespStore(TaskStore):
         self._rt_series = _ROUND_TRIPS_TOTAL.labels(backend="resp")
         self._bytes_series = _BYTES_SENT_TOTAL.labels(backend="resp")
         self._failover_series = _FAILOVERS_TOTAL.labels(backend="resp")
+        #: binary-batch knob (``--store-binbatch``): when True, every fresh
+        #: connection probes CAPS once and — iff the server advertises
+        #: "binbatch" — hgetall_many/finish_task_many collapse into the
+        #: MHGETALL/MFINISH aggregate commands with raw-bytes reply
+        #: parsing. Off (the default) sends ZERO extra bytes: the wire
+        #: toward a plain Redis is byte-identical to before (the same
+        #: contract as the single-endpoint no-handshake rule above).
+        self._binbatch = bool(binbatch)
         self._conn: _Conn | None = self._connect()
 
     @property
@@ -346,7 +360,7 @@ class RespStore(TaskStore):
         family the breaker and the dispatchers already handle."""
         n = len(self.endpoints)
         if n == 1:
-            return _Conn(*self.endpoints[0])
+            return self._negotiate(_Conn(*self.endpoints[0]))
         # discovery sweep: handshake EVERY reachable endpoint before
         # settling, so the highest epoch in the fleet is known first — a
         # fresh process (known_epoch 0) must not settle on a resurrected
@@ -421,6 +435,21 @@ class RespStore(TaskStore):
         # it current, silencing the bus until an unrelated socket error)
         host, port = self.endpoints[idx]
         self._sub_target = (host, port, self.failover_generation)
+        return self._negotiate(conn)
+
+    def _negotiate(self, conn: _Conn) -> _Conn:
+        """Binary-batch capability probe on a fresh connection: one CAPS
+        round trip, sent ONLY when the knob is on (off = zero extra bytes,
+        the byte-identical plain-Redis surface). A backend without CAPS
+        (real Redis, native server) answers -ERR unknown command — read as
+        no capabilities, never an error: the slow paths keep working and
+        the negotiation result is pinned per-connection like CAP_BIN."""
+        if self._binbatch:
+            try:
+                reply = conn.command("CAPS")
+                conn.binbatch = isinstance(reply, list) and "binbatch" in reply
+            except resp.RespError:
+                conn.binbatch = False
         return conn
 
     def rotate_endpoint(self) -> bool:
@@ -439,7 +468,7 @@ class RespStore(TaskStore):
             self._active_idx = (self._active_idx + 1) % len(self.endpoints)
         return True
 
-    def _command(self, *parts: str | bytes | int):
+    def _command(self, *parts: str | bytes | int, _raw: bool = False):
         """Run one command; transparently reconnect once if the server
         restarted (matches redis-py's retry-on-ConnectionError the reference
         relies on — without it a store restart would permanently wedge every
@@ -478,7 +507,7 @@ class RespStore(TaskStore):
                 sent = self._conn.send(*parts)  # faas: allow(locks.blocking-call-under-lock)
                 self.n_bytes_sent += sent
                 self._bytes_series.inc(sent)
-                return self._conn.recv_reply()  # faas: allow(locks.blocking-call-under-lock)
+                return self._conn.recv_reply(raw=_raw)  # faas: allow(locks.blocking-call-under-lock)
             except (ConnectionError, TimeoutError):
                 # TimeoutError too: the reply may still arrive later, so the
                 # old connection is DESYNCHRONIZED (a future command would
@@ -496,13 +525,16 @@ class RespStore(TaskStore):
                 sent = conn.send(*parts)  # faas: allow(locks.blocking-call-under-lock)
                 self.n_bytes_sent += sent
                 self._bytes_series.inc(sent)
-                return conn.recv_reply()  # faas: allow(locks.blocking-call-under-lock)
+                return conn.recv_reply(raw=_raw)  # faas: allow(locks.blocking-call-under-lock)
 
-    def pipeline(self, commands: list[tuple]) -> list:
+    def pipeline(self, commands: list[tuple], _raw: bool = False) -> list:
         """Run many commands over one round trip (RESP pipelining) and
         return their replies in order; error replies come back as
         :class:`resp.RespError` values in place rather than raising, so one
         bad command cannot mask the other N-1 results.
+
+        ``_raw`` reads every reply in raw mode (bulk strings stay bytes) —
+        the binary-batch fast paths' pipelined MHGETALL reads.
 
         No automatic retry: after a mid-pipeline connection loss there is no
         telling which commands were applied, so the connection is dropped
@@ -526,7 +558,7 @@ class RespStore(TaskStore):
                 out: list = []
                 for _ in commands:
                     try:
-                        out.append(conn.recv_reply())  # faas: allow(locks.blocking-call-under-lock)
+                        out.append(conn.recv_reply(raw=_raw))  # faas: allow(locks.blocking-call-under-lock)
                     except resp.RespError as exc:
                         out.append(exc)
                 return out
@@ -713,6 +745,26 @@ class RespStore(TaskStore):
     def hget_many(self, keys, field: str):
         return self.pipeline([("HGET", k, field) for k in keys])
 
+    #: keys per MHGETALL command on the binary-batch path. The stream
+    #: parser (store/resp.py) re-parses a partial nested array from its
+    #: start each time more bytes arrive, so one monolithic MHGETALL over
+    #: a whole intake batch (potentially MBs, dozens of recv chunks) costs
+    #: quadratic parse work — measured as the dominant intake cost at the
+    #: 20k-task bench shape. Bounded chunks pipelined over ONE round trip
+    #: keep replies inside a couple of recv buffers (parse stays ~linear)
+    #: and bound the server-side reply buffer too.
+    _MHGETALL_CHUNK = 256
+
+    def _binbatch_on(self) -> bool:
+        """Whether the CURRENT connection negotiated the binary-batch
+        command surface. Lock-free read by design (attribute reads are
+        atomic in CPython); a reconnect racing the check is handled by the
+        fast paths themselves — an MHGETALL/MFINISH landing on a freshly
+        non-capable connection comes back as RespError and the caller
+        falls through to the slow path."""
+        conn = self._conn
+        return self._binbatch and conn is not None and conn.binbatch
+
     def hgetall_many(self, keys):
         """Pipelined HGETALL over many keys — the batched-intake read: one
         round trip fetches every announced task's record. A per-key error
@@ -720,15 +772,71 @@ class RespStore(TaskStore):
         for THAT key — the same shape as a missing record, which intake
         skips with a warning — instead of raising and poisoning the whole
         batch: one bad key must never wedge the other N-1 announces (or,
-        parked and re-drained, wedge intake forever)."""
+        parked and re-drained, wedge intake forever).
+
+        On a negotiated binary-batch connection the N pipelined HGETALLs
+        collapse into pipelined MHGETALL commands of bounded chunks (one
+        round trip, same reply shape per key; see ``_MHGETALL_CHUNK``)."""
         if not keys:
             return []
+        if self._binbatch_on():
+            reply = self._mhgetall_chunked(keys, raw_mode=False)
+            if reply is not None:
+                return [
+                    dict(zip(flat[0::2], flat[1::2]))
+                    if isinstance(flat, list)
+                    else {}
+                    for flat in reply
+                ]
         out: list[dict[str, str]] = []
         for flat in self.pipeline([("HGETALL", k) for k in keys]):
             if isinstance(flat, resp.RespError):
                 out.append({})
                 continue
             out.append(dict(zip(flat[0::2], flat[1::2])))
+        return out
+
+    def hgetall_many_raw(self, keys) -> list[list]:
+        """Base semantics (flat [field, value, ...] per key), but on a
+        negotiated binary-batch connection the whole fetch is ONE MHGETALL
+        with the reply parsed in RAW mode — bulk strings stay ``bytes``,
+        no per-field utf-8 decode, no per-record dict. The columnar intake
+        (dispatch/base.py) parses these flat lists straight into arena
+        columns. Fallback (knob off / plain Redis / mid-failover): the
+        pipelined HGETALL path with ``str`` elements — callers handle
+        both element types by contract."""
+        if not keys:
+            return []
+        if self._binbatch_on():
+            reply = self._mhgetall_chunked(keys, raw_mode=True)
+            if reply is not None:
+                return [
+                    flat if isinstance(flat, list) else [] for flat in reply
+                ]
+        out: list[list] = []
+        for flat in self.pipeline([("HGETALL", k) for k in keys]):
+            out.append([] if isinstance(flat, resp.RespError) else flat)
+        return out
+
+    def _mhgetall_chunked(self, keys, raw_mode: bool):
+        """Fetch ``keys`` as pipelined bounded-chunk MHGETALLs (one round
+        trip). Returns the per-key reply list, or None when any chunk came
+        back non-conforming (peer changed under us mid-failover) — the
+        caller falls through to the plain pipelined-HGETALL path."""
+        chunk = self._MHGETALL_CHUNK
+        cmds = [
+            ("MHGETALL", *keys[lo : lo + chunk])
+            for lo in range(0, len(keys), chunk)
+        ]
+        try:
+            replies = self.pipeline(cmds, _raw=raw_mode)
+        except resp.RespError:
+            return None
+        out: list = []
+        for cmd, reply in zip(cmds, replies):
+            if not isinstance(reply, list) or len(reply) != len(cmd) - 1:
+                return None
+            out.extend(reply)
         return out
 
     def set_status_many(self, status, items) -> None:
@@ -763,11 +871,31 @@ class RespStore(TaskStore):
         Like the single finish_task, a connection loss retries the whole
         round once on a fresh connection: HSET replays to the same end
         state and duplicate RESULTS_CHANNEL publishes are tolerated
-        spurious wakes."""
+        spurious wakes.
+
+        On a negotiated binary-batch connection the whole batch — pre-read
+        included — is ONE MFINISH command: the server evaluates the
+        first_wins freeze set against its own state (identical semantics,
+        pinned by tests/test_store_resp.py), saving both the pre-read
+        round trip and the 3N-command pipeline build. MFINISH replays to
+        the same end state (re-applied fw items are frozen by their own
+        first write), so _command's idempotent reconnect-retry applies."""
         from tpu_faas.core.task import FIELD_STATUS, TaskStatus
 
         if not items:
             return
+        if self._binbatch_on():
+            flat: list[str] = []
+            for task_id, status, result, fw in items:
+                flat += [task_id, str(status), result, "1" if fw else "0"]
+            try:
+                self._command(
+                    "MFINISH", repr(time.time()), int(inline_max),
+                    len(items), *flat,
+                )
+                return
+            except resp.RespError:
+                pass  # peer changed under us: slow path below
         fw_ids = list(
             dict.fromkeys(t_id for t_id, _, _, fw in items if fw)
         )

@@ -37,7 +37,7 @@ _SHARED_LOCK = threading.Lock()
 
 
 def make_store(
-    url: str, owned_shards: list[int] | None = None
+    url: str, owned_shards: list[int] | None = None, binbatch: bool = False
 ) -> TaskStore:
     """Create a TaskStore from a URL.
 
@@ -50,6 +50,11 @@ def make_store(
     announce subscriptions, rescans, announce replay — to those shard
     indices (a dispatcher owning a slice of the fleet); ``None`` consumes
     every shard (gateways, clients).
+
+    ``binbatch`` (the dispatcher's ``--store-binbatch`` knob) asks RESP
+    clients to negotiate the binary-batch command surface per connection
+    (store/client.py); off sends zero extra bytes, and non-RESP backends
+    ignore it entirely.
     """
     if ";" in url:
         from tpu_faas.store.sharding import ShardedStore
@@ -69,7 +74,10 @@ def make_store(
                 MemoryStore() for _ in groups
             ]
         else:
-            stores = [make_store(f"{scheme}://{group}") for group in groups]
+            stores = [
+                make_store(f"{scheme}://{group}", binbatch=binbatch)
+                for group in groups
+            ]
         return ShardedStore(stores, owned_shards=owned_shards)
     if owned_shards is not None:
         raise ValueError(
@@ -95,10 +103,10 @@ def make_store(
                 for spec in parsed.netloc.split(",")
                 if spec
             ]
-            return RespStore(endpoints=endpoints)
+            return RespStore(endpoints=endpoints, binbatch=binbatch)
         host = parsed.hostname or "127.0.0.1"
         port = parsed.port or 6380
-        return RespStore(host, port)
+        return RespStore(host, port, binbatch=binbatch)
     raise ValueError(f"unknown store url scheme: {url!r}")
 
 

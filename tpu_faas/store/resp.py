@@ -70,6 +70,12 @@ class RespParser:
     Decoded values: simple string -> str, integer -> int, bulk -> str | None,
     array -> list (recursively decoded), error -> RespError instance (returned,
     not raised, so callers decide).
+
+    ``pop(raw=True)`` returns bulk strings as ``bytes`` instead of decoding
+    them to ``str`` — the binary-batch fast path (MHGETALL/MFINISH, see
+    store/client.py) reads whole record payloads without a per-field utf-8
+    round trip; simple strings, errors, and integers decode identically in
+    both modes, so control replies are mode-agnostic.
     """
 
     def __init__(self) -> None:
@@ -78,14 +84,14 @@ class RespParser:
     def feed(self, data: bytes) -> None:
         self._buf.extend(data)
 
-    def pop(self):
+    def pop(self, raw: bool = False):
         """Return the next complete decoded reply, or the NEED_MORE sentinel
         when the buffer holds only a partial reply.
 
         Raises :class:`ProtocolError` on malformed bytes; the buffer is
         cleared first so a poisoned connection fails once, not forever."""
         try:
-            result, consumed = _parse(self._buf, 0)
+            result, consumed = _parse(self._buf, 0, raw=raw)
         except (ValueError, ProtocolError) as exc:
             self._buf.clear()
             raise ProtocolError(f"malformed RESP input: {exc}") from exc
@@ -98,10 +104,10 @@ class RespParser:
         """Bytes buffered but not yet parsed into a complete reply."""
         return len(self._buf)
 
-    def pop_all(self) -> list:
+    def pop_all(self, raw: bool = False) -> list:
         out = []
         while True:
-            item = self.pop()
+            item = self.pop(raw=raw)
             if item is NEED_MORE:
                 return out
             out.append(item)
@@ -121,8 +127,11 @@ def _find_crlf(buf: bytearray, start: int) -> int:
     return buf.find(CRLF, start)
 
 
-def _parse(buf: bytearray, pos: int):
-    """Parse one value at pos. Return (value | NEED_MORE, end_pos)."""
+def _parse(buf: bytearray, pos: int, raw: bool = False):
+    """Parse one value at pos. Return (value | NEED_MORE, end_pos).
+
+    ``raw=True`` leaves bulk strings as bytes (no utf-8 decode) — the
+    binary-batch reply path; every other reply type is unaffected."""
     if pos >= len(buf):
         return NEED_MORE, pos
     kind = buf[pos : pos + 1]
@@ -144,7 +153,8 @@ def _parse(buf: bytearray, pos: int):
         end = body_start + n + 2
         if len(buf) < end:
             return NEED_MORE, pos
-        return bytes(buf[body_start : body_start + n]).decode("utf-8"), end
+        body = bytes(buf[body_start : body_start + n])
+        return (body if raw else body.decode("utf-8")), end
     if kind == b"*":
         n = int(line)
         if n == -1:
@@ -152,7 +162,7 @@ def _parse(buf: bytearray, pos: int):
         items = []
         cur = body_start
         for _ in range(n):
-            item, cur = _parse(buf, cur)
+            item, cur = _parse(buf, cur, raw=raw)
             if item is NEED_MORE:
                 return NEED_MORE, pos
             items.append(item)

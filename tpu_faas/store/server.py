@@ -52,6 +52,13 @@ from tpu_faas.store.replication import (
 #: the dump, which is the pre-tombstone behavior, never wrong state.
 _TOMBSTONE_CAP = 100_000
 
+#: Capability tokens the CAPS command advertises (store/client.py's
+#: binary-batch negotiation): command-surface extensions beyond the
+#: plain-Redis subset. "binbatch" = the MHGETALL/MFINISH aggregate forms.
+#: A real Redis answers CAPS with -ERR unknown command, which the client
+#: reads as "no capabilities" — negotiation is safe against any backend.
+STORE_CAPS = ("binbatch",)
+
 
 class StoreState:
     def __init__(self) -> None:
@@ -659,6 +666,27 @@ class StoreServer:
                 flat.append(resp.encode_bulk(f))
                 flat.append(resp.encode_bulk(v))
             writer.write(resp.encode_array(flat))
+        elif name == "CAPS":
+            writer.write(
+                resp.encode_array([resp.encode_bulk(c) for c in STORE_CAPS])
+            )
+        elif name == "MHGETALL":
+            # batched HGETALL: ONE command whose reply is an array of
+            # per-key flat field/value arrays (missing key -> empty array,
+            # matching HGETALL). Replaces N pipelined HGETALLs on the
+            # intake hot path — the client builds one command and parses
+            # one reply instead of N of each.
+            records: list[bytes] = []
+            for k in args:
+                h = st.hashes.get(k, {})
+                flat = []
+                for f, v in h.items():
+                    flat.append(resp.encode_bulk(f))
+                    flat.append(resp.encode_bulk(v))
+                records.append(resp.encode_array(flat))
+            writer.write(resp.encode_array(records))
+        elif name == "MFINISH":
+            return await self._mfinish(args, writer)
         elif name == "DEL":
             n = 0
             for k in args:
@@ -758,6 +786,104 @@ class StoreServer:
             return False
         else:
             writer.write(resp.encode_error(f"unknown command '{name}'"))
+        return True
+
+    async def _mfinish(self, args: list[str], writer) -> bool:
+        """MFINISH <now> <inline_max> <n> (task_id status result fw)*n —
+        the server-side batched terminal flush behind the client's
+        binary-batch fast path (store/client.py finish_task_many).
+
+        Semantics mirror the client's pipelined slow path exactly: the
+        first-wins freeze set is evaluated against PRE-batch state
+        (CANCELLED is lawfully overwritable by a late real result; any
+        other terminal or unknown/missing status freezes), and ids written
+        earlier in the SAME batch freeze later first-wins duplicates. Each
+        surviving task applies record-write -> live-index drop -> announce
+        in order, and replicates as the same PRIMITIVE commands the slow
+        path would have sent (HSET/HDEL/PUBLISH) — replication streams,
+        snapshots, and replica-attached subscribers are indistinguishable
+        from the pipelined form. Replies with the written-task count."""
+        from tpu_faas.core.task import (
+            FIELD_FINAL_AT,
+            FIELD_FINAL_STATUS,
+            FIELD_FINISHED_AT,
+            FIELD_RESULT,
+            FIELD_STATUS,
+            TaskStatus,
+        )
+        from tpu_faas.store.base import (
+            LIVE_INDEX_KEY,
+            RESULTS_CHANNEL,
+            encode_result_announce,
+        )
+
+        # branch-local HA write gate (MFINISH expands to mutating
+        # primitives but is not itself in MUTATING_COMMANDS — the
+        # replication stream only ever carries the primitives)
+        if self.repl.role == "replica":
+            writer.write(resp.encode_error(READONLY_ERR))
+            return True
+        if self.repl.fenced:
+            writer.write(resp.encode_error(FENCED_ERR))
+            return True
+        try:
+            now, inline_max, n = args[0], int(args[1]), int(args[2])
+            rest = args[3:]
+            if n < 0 or len(rest) != 4 * n:
+                raise ValueError
+        except (IndexError, ValueError):
+            writer.write(
+                resp.encode_error("wrong number of arguments for MFINISH")
+            )
+            return True
+        st = self.state
+        items = [
+            (rest[4 * i], rest[4 * i + 1], rest[4 * i + 2], rest[4 * i + 3] == "1")
+            for i in range(n)
+        ]
+        frozen: set[str] = set()
+        for task_id, _status, _result, fw in items:
+            if not fw or task_id in frozen:
+                continue
+            status = st.hashes.get(task_id, {}).get(FIELD_STATUS)
+            if status == str(TaskStatus.CANCELLED):
+                continue  # a late real result lawfully overwrites
+            if TaskStatus.terminal_str(status, unknown=True):
+                frozen.add(task_id)
+        written: set[str] = set()
+        for task_id, status, result, fw in items:
+            if fw and (task_id in written or task_id in frozen):
+                continue
+            h = st.hashes.setdefault(task_id, {})
+            h[FIELD_STATUS] = status
+            h[FIELD_FINAL_STATUS] = status
+            h[FIELD_FINAL_AT] = now
+            h[FIELD_RESULT] = result
+            h[FIELD_FINISHED_AT] = now
+            self._replicate(
+                [
+                    "HSET", task_id,
+                    FIELD_STATUS, status,
+                    FIELD_FINAL_STATUS, status,
+                    FIELD_FINAL_AT, now,
+                    FIELD_RESULT, result,
+                    FIELD_FINISHED_AT, now,
+                ]
+            )
+            live = st.hashes.get(LIVE_INDEX_KEY)
+            if live is not None and task_id in live:
+                del live[task_id]
+                if not live:
+                    del st.hashes[LIVE_INDEX_KEY]
+                    self._note_deleted(LIVE_INDEX_KEY)
+                self._replicate(["HDEL", LIVE_INDEX_KEY, task_id])
+            payload = encode_result_announce(task_id, status, result, inline_max)
+            self._replicate(["PUBLISH", RESULTS_CHANNEL, payload])
+            await self._publish(RESULTS_CHANNEL, payload)
+            written.add(task_id)
+        if written:
+            self._dirty = True
+        writer.write(resp.encode_integer(len(written)))
         return True
 
     async def _publish(self, channel: str, payload: str) -> int:

@@ -105,7 +105,11 @@ from __future__ import annotations
 
 import dill
 
-from tpu_faas.core.serialize import deserialize, serialize
+from tpu_faas.core.serialize import (
+    deserialize,
+    dumps_wire,
+    serialize_wire,
+)
 
 REGISTER = "register"
 DEREGISTER = "deregister"
@@ -145,15 +149,23 @@ _BIN_MAGIC = b"\x00TF1"
 
 
 def encode(msg_type: str, **data: object) -> bytes:
-    """The reference ASCII contract: base64(dill({type, data}))."""
-    return serialize({"type": msg_type, "data": data}).encode("ascii")
+    """The reference ASCII contract: base64(pickle({type, data})).
+
+    The envelope pickles through the C fast path when every leaf is a
+    primitive (the whole documented vocabulary — payload bodies are
+    already-serialized strings by the time they reach the envelope) and
+    through dill otherwise; both are standard pickle streams, so every
+    decoder — reference-era dill.loads included — reads them identically
+    (core/serialize.dumps_wire)."""
+    return serialize_wire({"type": msg_type, "data": data}).encode("ascii")
 
 
 def encode_bin(msg_type: str, **data: object) -> bytes:
-    """Binary frame: magic + raw dill bytes — skips the ~33% base64
+    """Binary frame: magic + raw pickle bytes — skips the ~33% base64
     inflation on internal hops. Send only to peers that negotiated
-    CAP_BIN (see the module docstring)."""
-    return _BIN_MAGIC + dill.dumps({"type": msg_type, "data": data})
+    CAP_BIN (see the module docstring). Same C-pickler envelope fast
+    path as :func:`encode`."""
+    return _BIN_MAGIC + dumps_wire({"type": msg_type, "data": data})
 
 
 def encode_for(bin_capable: bool, msg_type: str, **data: object) -> bytes:
