@@ -1,0 +1,313 @@
+"""Composed tail-SLO attribution plane (tpu_faas/obs/attribution.py +
+obs/flightrec.py): class derivation totality, the closed attribution
+vocabulary and its pre-created child set under the strict exposition
+grammar, the hi-res bucket ladder, per-class SLO objective parsing, the
+flight recorder's ring bounds / cursor semantics / emit-while-scrape
+thread safety — and the proof that with every new knob OFF the default
+metrics surface stays byte-identical."""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from tpu_faas.obs import MetricsRegistry, TaskTraceBook, render
+from tpu_faas.obs.attribution import (
+    ATTRIB_VOCAB,
+    CLASS_ENV,
+    DEFAULT_CLASS,
+    HIRES_ENV,
+    SLO_CLASSES,
+    AttributionBook,
+    class_of,
+    class_of_fields,
+    hires_buckets,
+    latency_buckets,
+    normalize_class,
+)
+from tpu_faas.obs.expofmt import parse_exposition
+from tpu_faas.obs.flightrec import FlightRecorder
+from tpu_faas.obs.metrics import LATENCY_BUCKETS
+from tpu_faas.obs.slo import Objective, parse_objectives
+from tpu_faas.core.task import FIELD_PRIORITY, FIELD_SLO_CLASS
+
+
+# -- class derivation --------------------------------------------------------
+
+
+def test_class_of_is_total_and_never_off_vocabulary():
+    # explicit valid declaration wins over the priority sign
+    assert class_of("batch", 5) == "batch"
+    assert class_of(" Interactive ", -3) == "interactive"
+    # no declaration: the priority sign decides
+    assert class_of(None, 7) == "interactive"
+    assert class_of(None, -1) == "batch"
+    assert class_of(None, 0) == DEFAULT_CLASS
+    assert class_of(None, None) == DEFAULT_CLASS
+    # garbage degrades, never raises, never escapes the vocabulary
+    for junk_cls in ("gold", 17, b"\xff\xfe", object()):
+        for junk_prio in ("not-a-number", object()):
+            assert class_of(junk_cls, junk_prio) in SLO_CLASSES
+
+
+def test_normalize_class_accepts_only_the_closed_vocabulary():
+    assert normalize_class("interactive") == "interactive"
+    assert normalize_class(b"batch") == "batch"
+    assert normalize_class("BATCH ") == "batch"
+    assert normalize_class("premium") is None
+    assert normalize_class(None) is None
+    assert normalize_class(3.14) is None
+    assert normalize_class(b"\xff\xfe") is None
+
+
+def test_class_of_fields_reads_store_record_vocabulary():
+    assert (
+        class_of_fields({FIELD_SLO_CLASS: "batch", FIELD_PRIORITY: "9"})
+        == "batch"
+    )
+    assert class_of_fields({FIELD_PRIORITY: "9"}) == "interactive"
+    assert class_of_fields({}) == DEFAULT_CLASS
+
+
+# -- attribution counter family ----------------------------------------------
+
+
+def test_attribution_family_prerenders_full_closed_vocabulary():
+    r = MetricsRegistry()
+    book = AttributionBook(r, enabled=True)
+    fams = parse_exposition(render([r]))
+    fam = fams["tpu_faas_task_attrib_total"]
+    got = {
+        (s.labels["plane"], s.labels["outcome"], s.labels["class"])
+        for s in fam.samples
+    }
+    want = {
+        (plane, outcome, cls)
+        for plane, outcomes in ATTRIB_VOCAB.items()
+        for outcome in outcomes
+        for cls in SLO_CLASSES
+    }
+    # explicit zeros for the whole plane x outcome x class product — the
+    # bench's "plane live" check is a plain nonzero read against these
+    assert got == want
+    assert all(s.value == 0 for s in fam.samples)
+    book.note("express", "inline", "interactive")
+    book.note("speculation", "hedged_won", "batch", n=3)
+    fams = parse_exposition(render([r]))
+    by_key = {
+        (s.labels["plane"], s.labels["outcome"], s.labels["class"]): s.value
+        for s in fams["tpu_faas_task_attrib_total"].samples
+    }
+    assert by_key[("express", "inline", "interactive")] == 1
+    assert by_key[("speculation", "hedged_won", "batch")] == 3
+
+
+def test_attribution_rejects_off_vocabulary_outcomes():
+    r = MetricsRegistry()
+    book = AttributionBook(r, enabled=True)
+    with pytest.raises(ValueError):
+        book.note("express", "teleported", "default")
+    with pytest.raises(ValueError):
+        book.note("warp", "inline", "default")
+    # off-vocabulary CLASSES degrade instead (they come from user input)
+    book.note("express", "inline", "platinum")
+    fams = parse_exposition(render([r]))
+    by_key = {
+        (s.labels["plane"], s.labels["outcome"], s.labels["class"]): s.value
+        for s in fams["tpu_faas_task_attrib_total"].samples
+    }
+    assert by_key[("express", "inline", DEFAULT_CLASS)] == 1
+
+
+def test_disabled_attribution_is_byte_identical_and_noop():
+    r_plain = MetricsRegistry()
+    r_plain.counter("unrelated_total", "help").inc()
+    r_with = MetricsRegistry()
+    r_with.counter("unrelated_total", "help").inc()
+    book = AttributionBook(r_with, enabled=False)
+    book.note("express", "inline", "interactive")  # must be a no-op
+    book.note("warp", "teleported", "x")  # disabled: not even validated
+    assert render([r_with]) == render([r_plain])
+    assert "tpu_faas_task_attrib_total" not in render([r_with])
+
+
+# -- bucket ladders ----------------------------------------------------------
+
+
+def test_hires_ladder_is_log_spaced_and_strictly_increasing():
+    b = hires_buckets()
+    assert len(b) == 30
+    assert b[0] == pytest.approx(0.001)
+    assert b[-1] == pytest.approx(60.0)
+    assert all(hi > lo for lo, hi in zip(b, b[1:]))
+    # roughly constant ratio (log spacing), ~1.46x per step
+    ratios = [hi / lo for lo, hi in zip(b, b[1:])]
+    assert all(1.3 < q < 1.6 for q in ratios)
+
+
+def test_latency_buckets_env_gate(monkeypatch):
+    monkeypatch.delenv(HIRES_ENV, raising=False)
+    assert latency_buckets(LATENCY_BUCKETS) == LATENCY_BUCKETS
+    monkeypatch.setenv(HIRES_ENV, "1")
+    assert latency_buckets(LATENCY_BUCKETS) == hires_buckets()
+    monkeypatch.setenv(HIRES_ENV, "off")
+    assert latency_buckets(LATENCY_BUCKETS) == LATENCY_BUCKETS
+
+
+# -- default-surface byte identity -------------------------------------------
+
+
+def test_trace_book_default_surface_byte_identical(monkeypatch):
+    """With both env gates unset, a trace book + attribution book render
+    the exact bytes of the pre-attribution two-label surface: no class
+    label, no attrib family, the default bucket ladder."""
+    monkeypatch.delenv(CLASS_ENV, raising=False)
+    monkeypatch.delenv(HIRES_ENV, raising=False)
+    r_new = MetricsRegistry()
+    book = TaskTraceBook(r_new)
+    AttributionBook(r_new)
+    assert book.class_enabled is False
+    book.note("t1", "submitted", ts=1.0)
+    book.note_class("t1", "interactive")  # gate off: must be a no-op
+    book.finish("t1", "COMPLETED", ts=2.0)
+    body = render([r_new])
+    assert 'class="' not in body
+    assert "tpu_faas_task_attrib_total" not in body
+    # same driving sequence against an explicitly class-blind book
+    # produces the identical bytes — the label plumbing is invisible off
+    r_old = MetricsRegistry()
+    old = TaskTraceBook(r_old, class_enabled=False)
+    old.note("t1", "submitted", ts=1.0)
+    old.finish("t1", "COMPLETED", ts=2.0)
+    assert body == render([r_old])
+
+
+def test_trace_book_class_label_on_records_and_restricts():
+    r = MetricsRegistry()
+    book = TaskTraceBook(r, class_enabled=True)
+    book.note("t1", "submitted", ts=1.0)
+    book.note_class("t1", "interactive")
+    book.note_class("t1", "batch")  # first write wins
+    book.note_class("t1", "gold")  # off-vocabulary: ignored
+    book.finish("t1", "COMPLETED", ts=2.0)
+    rec = book.timeline("t1")
+    assert rec["slo_class"] == "interactive"
+    snap = book.stage_snapshot("total", cls="interactive")
+    assert snap is not None and sum(snap[1]) == 1
+    snap_other = book.stage_snapshot("total", cls="batch")
+    assert snap_other is not None and sum(snap_other[1]) == 0
+    # class-blind book: a class-restricted read must refuse (None), not
+    # silently alias the aggregate
+    blind = TaskTraceBook(MetricsRegistry(), class_enabled=False)
+    assert blind.stage_snapshot("total", cls="interactive") is None
+
+
+# -- per-class SLO objectives ------------------------------------------------
+
+
+def test_parse_objectives_with_class_suffix():
+    objs = parse_objectives(
+        "int_p999=total@interactive:0.3:0.999, all_p99=total:0.25:0.99"
+    )
+    assert objs[0] == Objective(
+        "int_p999", "total", 0.3, 0.999, "interactive"
+    )
+    assert objs[1].cls is None
+    with pytest.raises(ValueError):
+        parse_objectives("bad=total@platinum:0.3:0.99")
+    with pytest.raises(ValueError):
+        parse_objectives("bad=total@interactive")
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flightrec_ring_is_bounded_and_counts_drops():
+    rec = FlightRecorder(capacity=8, clock=lambda: 42.0)
+    for i in range(20):
+        rec.emit("tick", i=i)
+    snap = rec.snapshot()
+    assert snap["cursor"] == 20
+    assert snap["capacity"] == 8
+    assert snap["dropped"] == 12
+    assert [e["seq"] for e in snap["events"]] == list(range(13, 21))
+    assert snap["events"][0]["i"] == 12  # payload fields ride verbatim
+    assert snap["events"][0]["t"] == 42.0
+
+
+def test_flightrec_since_cursor_polls_incrementally():
+    rec = FlightRecorder(capacity=64)
+    rec.emit("a")
+    rec.emit("b")
+    first = rec.snapshot()
+    assert [e["kind"] for e in first["events"]] == ["a", "b"]
+    rec.emit("c")
+    second = rec.snapshot(since=first["cursor"])
+    assert [e["kind"] for e in second["events"]] == ["c"]
+    assert rec.snapshot(since=second["cursor"])["events"] == []
+
+
+def test_flightrec_limit_keeps_newest():
+    rec = FlightRecorder(capacity=64)
+    for i in range(10):
+        rec.emit("e", i=i)
+    snap = rec.snapshot(limit=3)
+    assert snap["truncated"] == 7
+    assert [e["i"] for e in snap["events"]] == [7, 8, 9]
+
+
+def test_flightrec_dump_json_round_trips():
+    rec = FlightRecorder(capacity=4)
+    rec.emit("hedge", task_id="t-1", verdict="launched")
+    body = json.loads(rec.dump_json())
+    assert body["events"][0]["kind"] == "hedge"
+    assert body["events"][0]["task_id"] == "t-1"
+
+
+def test_flightrec_rejects_degenerate_capacity():
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_flightrec_concurrent_emit_and_scrape():
+    """Writers hammer emit() while a reader snapshots: no exceptions, no
+    torn reads (seqs strictly increase within every snapshot), and the
+    final cursor accounts for every emit exactly once."""
+    rec = FlightRecorder(capacity=256)
+    n_writers, per_writer = 4, 2000
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def write(w: int) -> None:
+        try:
+            for i in range(per_writer):
+                rec.emit("tick", w=w, i=i)
+        except BaseException as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    def read() -> None:
+        try:
+            while not stop.is_set():
+                snap = rec.snapshot()
+                seqs = [e["seq"] for e in snap["events"]]
+                assert seqs == sorted(seqs)
+                assert len(seqs) <= rec.capacity
+                json.loads(rec.dump_json())
+        except BaseException as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    writers = [
+        threading.Thread(target=write, args=(w,)) for w in range(n_writers)
+    ]
+    reader = threading.Thread(target=read)
+    reader.start()
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    reader.join()
+    assert not errors
+    assert rec.snapshot()["cursor"] == n_writers * per_writer

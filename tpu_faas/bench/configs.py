@@ -3470,6 +3470,361 @@ def config_18_tail_hedging() -> dict:
     return row
 
 
+# -- config 19: composed tail-SLO product bench ------------------------------
+
+#: default per-class objectives for the composed lane (overridable via
+#: TPU_FAAS_BENCH_COMPOSED_SLO). The int_p999 threshold is the lane's
+#: STATED interactive p999 bar — the row's verdict checks the measured
+#: client-side p999 against it.
+_COMPOSED_SLO_SPEC = (
+    "int_p99=total@interactive:0.5:0.99,"
+    "int_p999=total@interactive:2.0:0.999,"
+    "batch_p99=total@batch:30:0.99,"
+    "gw_int_p99=submit_to_finish@interactive:0.5:0.99"
+)
+
+
+def _composed_stack(n_workers: int, n_procs: int, slow_s: float):
+    """Full real stack with EVERY opt-in plane on at once: store server,
+    tracing gateway, tpu-push with express + micro-batching + weighted
+    tenancy (bulk capped to half the fleet) + speculation + columnar
+    intake, N real push-worker subprocesses with worker 0 deterministically
+    sick (``slow_s`` injected per execution). Callers must already hold
+    the composed env gates (class label, hi-res buckets, SLO spec) — both
+    serving processes read them at construction."""
+    import threading as _threading
+
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+
+    handle = start_store_thread()
+    # admission OFF for the same reason as configs 16/18: the lane
+    # measures in-tick composition among admitted tasks; edge 429s are
+    # config 10's surface
+    gw = start_gateway_thread(
+        make_store(handle.url), admission=False, trace=True
+    )
+    cap_bulk = max(1, (n_workers * n_procs) // 2)
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(handle.url),
+        max_workers=max(16, n_workers),
+        max_pending=2048,
+        max_inflight=2048,
+        max_slots=n_procs,
+        tick_period=0.005,
+        time_to_expire=60.0,
+        # pin predictions to the client cost hints (config 18's rule):
+        # the sick worker's injected delay is the variable under test
+        estimate_runtimes=False,
+        express=True,
+        batch_max=4,
+        batch_window_ms=1.0,
+        tenant_shares="fast=3,bulk=1",
+        tenant_caps=f"bulk={cap_bulk}",
+        speculate_mult=3.0,
+        speculate_max_frac=0.3,
+        speculate_min_s=0.02,
+        columnar=True,
+    )
+    disp_thread = _threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        _tail_spawn_worker(n_procs, url, slow_s if i == 0 else None)
+        for i in range(n_workers)
+    ]
+    return gw, disp, disp_thread, workers, handle
+
+
+def _attrib_totals(fams) -> dict:
+    """{plane: {outcome: {class: value}}} from one parsed exposition, or
+    {} when the family is absent (gate off — a lane bug here)."""
+    fam = fams.get("tpu_faas_task_attrib_total")
+    if fam is None:
+        return {}
+    out: dict = {}
+    for s in fam.samples:
+        plane, outcome, cls = (
+            s.labels["plane"], s.labels["outcome"], s.labels["class"]
+        )
+        out.setdefault(plane, {}).setdefault(outcome, {})[cls] = int(s.value)
+    return out
+
+
+def _plane_sum(attrib: dict, plane: str, *outcomes: str) -> int:
+    total = 0
+    for outcome in outcomes or tuple(attrib.get(plane, ())):
+        total += sum(attrib.get(plane, {}).get(outcome, {}).values())
+    return total
+
+
+def _composed_scrapes(gw, disp) -> dict:
+    """Strict-grammar /metrics from both serving processes plus their
+    /slo and /flightrec bodies — the composed lane's required families
+    include the class-labeled histograms, the attribution counters, the
+    per-objective burn gauges and the worker-health family."""
+    import requests as _requests
+
+    from tpu_faas.obs.expofmt import parse_exposition, require_series
+
+    out: dict = {"scrape_ok": True, "missing": [], "error": ""}
+    try:
+        srv = disp.serve_stats(0)
+        port = srv.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        dfams = parse_exposition(
+            _requests.get(f"{base}/metrics", timeout=10).text
+        )
+        out["missing"] = require_series(
+            dfams,
+            [
+                "tpu_faas_task_attrib_total",
+                "tpu_faas_task_stage_seconds",
+                "tpu_faas_slo_burn_rate",
+                "tpu_faas_worker_health",
+                "tpu_faas_tenant_queue_depth",
+                "tpu_faas_dispatcher_hedges_total",
+            ],
+        )
+        gfams = parse_exposition(
+            _requests.get(f"{gw.url}/metrics", timeout=10).text
+        )
+        out["missing"] += require_series(
+            gfams,
+            [
+                "tpu_faas_task_attrib_total",
+                "tpu_faas_task_e2e_seconds",
+                "tpu_faas_slo_burn_rate",
+            ],
+        )
+        # the class label actually rides the latency histograms
+        stage_fam = dfams["tpu_faas_task_stage_seconds"]
+        out["class_label_live"] = any(
+            s.labels.get("class") == "interactive" for s in stage_fam.samples
+        )
+        # hi-res ladder: the e2e histogram carries ~30 le= bounds + +Inf
+        e2e = gfams["tpu_faas_task_e2e_seconds"]
+        les = {
+            s.labels["le"]
+            for s in e2e.samples
+            if s.name.endswith("_bucket")
+        }
+        out["hires_bucket_count"] = len(les)
+        # per-plane attribution, summed across both processes
+        d_at, g_at = _attrib_totals(dfams), _attrib_totals(gfams)
+        out["attribution"] = {"dispatcher": d_at, "gateway": g_at}
+        out["planes_live"] = {
+            "express": _plane_sum(g_at, "express", "inline") > 0,
+            "batch": _plane_sum(d_at, "batch", "bundle_rode") > 0,
+            "speculation": _plane_sum(d_at, "speculation") > 0,
+            "tenancy": _plane_sum(d_at, "tenancy") > 0,
+            "columnar": _plane_sum(d_at, "columnar", "arena") > 0,
+        }
+        out["slo"] = {
+            "dispatcher": _http_json(f"{base}/slo"),
+            "gateway": _http_json(f"{gw.url}/slo"),
+        }
+        frec_d = _http_json(f"{base}/flightrec")
+        frec_g = _http_json(f"{gw.url}/flightrec")
+        kinds: dict[str, int] = {}
+        for body in (frec_d, frec_g):
+            for ev in body.get("events", []):
+                kinds[ev["kind"]] = kinds.get(ev["kind"], 0) + 1
+        out["flightrec"] = {
+            "dispatcher_events": len(frec_d.get("events", [])),
+            "gateway_events": len(frec_g.get("events", [])),
+            "kinds": kinds,
+        }
+        out["scrape_ok"] = not out["missing"]
+    except Exception as exc:
+        out["scrape_ok"] = False
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
+def config_19_composed_slo() -> dict:
+    """Composed tail-SLO lane (config 19): ALL four opt-in planes live at
+    once — express result delivery, micro-batching, weighted tenancy with
+    an inflight cap, device-scored speculation — plus columnar intake, on
+    the full real stack under mixed insult traffic: closed-loop SHORT
+    interactive tasks racing a saturating BULK tenant's long batch
+    backlog across a fleet with one deterministically sick worker.
+
+    The composed observability plane is on (TPU_FAAS_OBS_CLASS +
+    TPU_FAAS_OBS_HIRES_BUCKETS + per-class TPU_FAAS_SLO): the row reports
+    client-side p50/p99/p999 PER CLASS, both processes' /slo burn rates
+    (per-class objectives included), the per-plane attribution counter
+    totals proving every plane actually touched tasks, the flight
+    recorders' event mix, and strict-grammar /metrics verdicts from every
+    serving process. The headline verdict: the stated interactive p999
+    objective HELD while every plane was live.
+
+    Shape via TPU_FAAS_BENCH_COMPOSED_SHAPE =
+    "interactive,loops,batch_backlog,workers,procs,task_ms,batch_ms,
+    slow_ms" (default "120,12,60,4,2,20,100,800" — loops deliberately
+    exceeds the fleet's slot count so the health-aware scheduler cannot
+    fully route around the sick worker and the speculation plane
+    reliably has stragglers to hedge); objectives via
+    TPU_FAAS_BENCH_COMPOSED_SLO."""
+    import os
+    import threading as _threading
+
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.obs.attribution import CLASS_ENV, HIRES_ENV
+    from tpu_faas.obs.slo import SLO_ENV, parse_objectives
+    from tpu_faas.workloads import straggler_sleep
+
+    shape = os.environ.get(
+        "TPU_FAAS_BENCH_COMPOSED_SHAPE", "120,12,60,4,2,20,100,800"
+    )
+    (
+        n_int, n_loops, backlog, n_workers, n_procs, task_ms, batch_ms,
+        slow_ms,
+    ) = (int(x) for x in shape.split(","))
+    task_s, batch_s, slow_s = task_ms / 1e3, batch_ms / 1e3, slow_ms / 1e3
+    slo_spec = os.environ.get(
+        "TPU_FAAS_BENCH_COMPOSED_SLO", _COMPOSED_SLO_SPEC
+    )
+    p999_objective_s = next(
+        (
+            o.threshold_s
+            for o in parse_objectives(slo_spec)
+            if o.name == "int_p999"
+        ),
+        None,
+    )
+    saved = {k: os.environ.get(k) for k in (CLASS_ENV, HIRES_ENV, SLO_ENV)}
+    os.environ[CLASS_ENV] = "1"
+    os.environ[HIRES_ENV] = "1"
+    os.environ[SLO_ENV] = slo_spec
+    stack = None
+    try:
+        stack = _composed_stack(n_workers, n_procs, slow_s)
+        gw, disp, disp_thread, workers, handle = stack
+        time.sleep(1.5)  # workers register
+        fast = FaaSClient(gw.url, tenant="fast", trace=True)
+        bulk = FaaSClient(gw.url, tenant="bulk", trace=True)
+        fid = fast.register_payload(
+            "straggler_sleep", serialize(straggler_sleep)
+        )
+        # warmup outside the window: pool spawn + first dill decode on
+        # every worker (the sick one's delay is paid here once)
+        for h in fast.submit_many(
+            fid, [(((0.001,), {}))] * (n_workers * n_procs)
+        ):
+            h.result(timeout=120.0)
+        # the insult: a saturating batch backlog from the capped tenant
+        bulk_handles = bulk.submit_many(
+            fid,
+            [(((batch_s,), {}))] * backlog,
+            costs=[batch_s] * backlog,
+            slo_class="batch",
+        )
+        t0 = time.perf_counter()
+        int_lat: list[list[float]] = [[] for _ in range(n_loops)]
+        int_errs: list[str] = []
+        per_loop = max(1, n_int // n_loops)
+
+        def int_loop(i: int) -> None:
+            # closed loop: each iteration is one interactive RTT — the
+            # latency an interactive CALLER sees, not backlog drain
+            for _ in range(per_loop):
+                s = time.perf_counter()
+                try:
+                    fast.submit_with(
+                        fid,
+                        (task_s,),
+                        cost=task_s,
+                        speculative=True,
+                        slo_class="interactive",
+                    ).result(timeout=300.0)
+                    int_lat[i].append(time.perf_counter() - s)
+                except Exception as exc:
+                    int_errs.append(type(exc).__name__)
+
+        threads = [
+            _threading.Thread(target=int_loop, args=(i,), daemon=True)
+            for i in range(n_loops)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600.0)
+        int_run_s = time.perf_counter() - t0
+        # drain the batch class too (its percentiles + /slo need closes)
+        batch_done, batch_errs = 0, []
+        batch_lat: list[float] = []
+        for h in bulk_handles:
+            try:
+                h.result(timeout=300.0)
+                batch_lat.append(time.perf_counter() - t0)
+                batch_done += 1
+            except Exception as exc:
+                batch_errs.append(type(exc).__name__)
+        arr_i = np.asarray([v for lane in int_lat for v in lane])
+        arr_b = np.asarray(batch_lat) if batch_lat else np.asarray([0.0])
+
+        def _pcts(arr) -> dict:
+            return {
+                "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 1),
+                "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 1),
+                "p999_ms": round(float(np.percentile(arr, 99.9)) * 1e3, 1),
+                "mean_ms": round(float(arr.mean()) * 1e3, 1),
+            }
+
+        stats = disp.stats()
+        row = {
+            "config": "composed-slo",
+            "shape": {
+                "interactive": len(arr_i),
+                "loops": n_loops,
+                "batch_backlog": backlog,
+                "workers": n_workers,
+                "procs": n_procs,
+                "task_ms": task_ms,
+                "batch_ms": batch_ms,
+                "slow_ms": slow_ms,
+            },
+            "host_cores": os.cpu_count(),
+            "slo_spec": slo_spec,
+            "interactive": {
+                "completed": int(len(arr_i)),
+                "errors": int_errs,
+                "run_s": round(int_run_s, 2),
+                **_pcts(arr_i),
+            },
+            "batch": {
+                "completed": batch_done,
+                "errors": batch_errs,
+                **_pcts(arr_b),
+            },
+            "speculation": stats.get("speculation"),
+            "tenancy": stats.get("tenancy"),
+            "worker_health": stats.get("worker_health"),
+        }
+        row.update(_composed_scrapes(gw, disp))
+        planes = row.get("planes_live", {})
+        row["all_planes_live"] = bool(planes) and all(planes.values())
+        if p999_objective_s is not None and len(arr_i):
+            row["interactive_p999_objective_ms"] = p999_objective_s * 1e3
+            row["interactive_p999_held"] = (
+                float(np.percentile(arr_i, 99.9)) <= p999_objective_s
+            )
+        return row
+    finally:
+        if stack is not None:
+            _tail_teardown(*stack)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -3489,4 +3844,5 @@ CONFIGS = {
     "16": config_16_tenant_fairness,
     "17": config_17_batched_plane,
     "18": config_18_tail_hedging,
+    "19": config_19_composed_slo,
 }

@@ -279,6 +279,7 @@ class AsyncFaaSClient:
         idempotency_key: str | None = None,
         deadline: float | None = None,
         speculative: bool = False,
+        slo_class: str | None = None,
     ) -> AsyncTaskHandle:
         """submit() plus scheduling hints (mirrors the sync SDK): higher
         ``priority`` is admitted first under overload; ``cost`` is the
@@ -290,7 +291,10 @@ class AsyncFaaSClient:
         addresses the same task instead of running it twice; auto-minted
         unless auto_idempotency=False); ``speculative`` declares the task
         IDEMPOTENT and hedge-eligible (tpu_faas/spec) — only set it for
-        functions safe to execute more than once."""
+        functions safe to execute more than once; ``slo_class`` declares
+        the task's SLO class (interactive/batch/default,
+        obs/attribution.py) for per-class latency accounting when the
+        observability plane runs with TPU_FAAS_OBS_CLASS=1."""
         loop = asyncio.get_running_loop()
         payload = await loop.run_in_executor(
             None, lambda: pack_params(*args, **(kwargs or {}))
@@ -298,6 +302,8 @@ class AsyncFaaSClient:
         body: dict = {"function_id": function_id, "payload": payload}
         if priority is not None:
             body["priority"] = priority
+        if slo_class is not None:
+            body["slo_class"] = slo_class
         if cost is not None:
             body["cost"] = cost
         if timeout is not None:
@@ -332,6 +338,7 @@ class AsyncFaaSClient:
         idempotency_keys: list[str | None] | None = None,
         deadlines: list[float] | None = None,
         speculative: bool = False,
+        slo_class: str | None = None,
     ) -> list[AsyncTaskHandle]:
         # dill-packing thousands of payloads inline would stall the event
         # loop (and every concurrently polling handle) — do it in a worker
@@ -354,6 +361,10 @@ class AsyncFaaSClient:
             body["deadlines"] = deadlines
         if speculative:
             body["speculative"] = True
+        if slo_class is not None:
+            # one declared class for the whole batch, applied element-wise
+            # by the gateway (same wire contract as the sync SDK)
+            body["slo_class"] = slo_class
         if idempotency_keys is None and self.auto_idempotency:
             idempotency_keys = [uuid.uuid4().hex for _ in params_list]
         if idempotency_keys is not None:

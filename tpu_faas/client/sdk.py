@@ -363,6 +363,7 @@ class FaaSClient:
         trace_id: str | None = None,
         parent_span: str | None = None,
         speculative: bool = False,
+        slo_class: str | None = None,
     ) -> str:
         return self._execute(
             function_id,
@@ -375,6 +376,7 @@ class FaaSClient:
             trace_id=trace_id,
             parent_span=parent_span,
             speculative=speculative,
+            slo_class=slo_class,
         )["task_id"]
 
     def _execute(
@@ -389,6 +391,7 @@ class FaaSClient:
         trace_id: str | None = None,
         parent_span: str | None = None,
         speculative: bool = False,
+        slo_class: str | None = None,
     ) -> dict:
         """One submit; returns the gateway's parsed response body (the
         handle constructors read ``trace_id`` off it — present only when
@@ -397,6 +400,8 @@ class FaaSClient:
         body: dict = {"function_id": function_id, "payload": payload}
         if priority is not None:
             body["priority"] = priority
+        if slo_class is not None:
+            body["slo_class"] = slo_class
         if cost is not None:
             body["cost"] = cost
         if timeout is not None:
@@ -516,6 +521,7 @@ class FaaSClient:
         idempotency_key: str | None = None,
         deadline: float | None = None,
         speculative: bool = False,
+        slo_class: str | None = None,
     ) -> TaskHandle:
         """submit() plus scheduling hints. The hints can't ride submit()
         itself — its **kwargs belong to the remote function — so args/kwargs
@@ -535,7 +541,12 @@ class FaaSClient:
         and hedge-eligible — a dispatcher running --speculate-mult may race a
         replica against a straggling execution (tpu_faas/spec; exactly one
         result is ever delivered, the store's first-wins write arbitrates).
-        Only set it for functions safe to execute more than once."""
+        Only set it for functions safe to execute more than once.
+        ``slo_class``: the task's declared SLO class (``interactive``/
+        ``batch``/``default``, obs/attribution.py) — labels its latency
+        samples and attribution counters when the observability plane
+        runs with TPU_FAAS_OBS_CLASS=1; undeclared tasks default by
+        priority sign."""
         payload = pack_params(*args, **(kwargs or {}))
         body = self._execute(
             function_id,
@@ -546,6 +557,7 @@ class FaaSClient:
             idempotency_key=idempotency_key,
             deadline=deadline,
             speculative=speculative,
+            slo_class=slo_class,
         )
         return TaskHandle(self, body["task_id"], body.get("trace_id"))
 
@@ -559,6 +571,7 @@ class FaaSClient:
         idempotency_keys: list[str | None] | None = None,
         deadlines: list[float] | None = None,
         speculative: bool = False,
+        slo_class: str | None = None,
     ) -> list[TaskHandle]:
         """Batch submit over ONE HTTP call (+ one pipelined store round
         trip): ``params_list`` holds (args, kwargs) pairs. N single submits
@@ -586,6 +599,10 @@ class FaaSClient:
             # one flag for the whole batch: the idempotency promise is
             # per-call (tpu_faas/spec hedge eligibility)
             body["speculative"] = True
+        if slo_class is not None:
+            # one declared SLO class for the whole batch (the gateway
+            # applies it element-wise), matching the wire contract
+            body["slo_class"] = slo_class
         if idempotency_keys is None and self.auto_idempotency:
             idempotency_keys = [uuid.uuid4().hex for _ in params_list]
         if idempotency_keys is not None:

@@ -44,12 +44,14 @@ from tpu_faas.core.task import (
     FIELD_FN_DIGEST,
     FIELD_PARAMS,
     FIELD_PRIORITY,
+    FIELD_SLO_CLASS,
     FIELD_SPECULATIVE,
     FIELD_SUBMITTED_AT,
     FIELD_TENANT,
     FIELD_TIMEOUT,
     FIELD_TRACE_ID,
 )
+from tpu_faas.obs.attribution import class_of
 
 #: row lifecycle codes (the ``status`` column)
 STATUS_FREE = 0
@@ -100,6 +102,7 @@ class TaskColumns:
         self.fn_digest = np.empty(cap, dtype=object)
         self.trace_id = np.empty(cap, dtype=object)
         self.tenant = np.empty(cap, dtype=object)
+        self.slo_class = np.empty(cap, dtype=object)
         # numeric columns (nan = absent on the optional-hint floats)
         self.status = np.zeros(cap, dtype=np.int8)
         self.priority = np.zeros(cap, dtype=np.int32)
@@ -155,6 +158,7 @@ class TaskColumns:
         self.fn_digest[row] = None
         self.trace_id[row] = None
         self.tenant[row] = None
+        self.slo_class[row] = None
         self.status[row] = STATUS_FREE
         self.priority[row] = 0
         self.retries[row] = 0
@@ -209,6 +213,8 @@ class TaskColumns:
                 self.trace_id[row] = _to_str(v) or None
             elif f == FIELD_TENANT:
                 self.tenant[row] = _to_str(v) or None
+            elif f == FIELD_SLO_CLASS:
+                self.slo_class[row] = _to_str(v) or None
             elif f == FIELD_SPECULATIVE:
                 self.speculative[row] = v in ("1", b"1")
         self.fn_payload[row] = fn
@@ -346,6 +352,7 @@ class RowTask:
     fn_digest = _obj_prop("fn_digest")
     trace_id = _obj_prop("trace_id")
     tenant = _obj_prop("tenant")
+    slo_class = _obj_prop("slo_class")
     priority = _int_prop("priority")
     retries = _int_prop("retries")
     speculative = _bool_prop("speculative")
@@ -363,6 +370,12 @@ class RowTask:
     @property
     def attached(self) -> bool:
         return self._shadow is None
+
+    @property
+    def effective_class(self) -> str:
+        """PendingTask.effective_class verbatim: declared class wins,
+        else the priority sign decides."""
+        return class_of(self.slo_class, self.priority)
 
     @property
     def size_estimate(self) -> float:
@@ -435,6 +448,7 @@ class RowTask:
             "fn_digest": a.fn_digest[r],
             "trace_id": a.trace_id[r],
             "tenant": a.tenant[r],
+            "slo_class": a.slo_class[r],
             "priority": int(a.priority[r]),
             "retries": int(a.retries[r]),
             "speculative": bool(a.speculative[r]),
@@ -454,6 +468,7 @@ class RowTask:
         "fn_digest": None,
         "trace_id": None,
         "tenant": None,
+        "slo_class": None,
         "priority": 0,
         "retries": 0,
         "speculative": False,

@@ -155,6 +155,16 @@ FIELD_TRACE_PARENT = "trace_parent"
 #: coexist. Rides RECLAIM_FIELDS: a reclaimed task keeps its accounting.
 FIELD_TENANT = "tenant"
 
+#: SLO class (tpu_faas/obs/attribution.py): which latency class this task
+#: is judged under by the per-class tail accounting — one of the CLOSED
+#: vocabulary (interactive/batch/default; it becomes a histogram label).
+#: Written by the gateway ONLY when the client declared one (``X-SLO-Class``
+#: header / SDK ``slo_class=``); ABSENT otherwise — consumers derive the
+#: effective class from the priority sign, so the submit surface stays
+#: byte-identical for clients that never declare and legacy records need
+#: no migration. Off-vocabulary values degrade to ``default`` at read.
+FIELD_SLO_CLASS = "slo_class"
+
 #: Written (epoch seconds as str) with every RUNNING mark and refreshed
 #: periodically by the dispatcher that owns the task's worker. A RUNNING
 #: record whose lease has gone stale has no live owner left — its worker

@@ -27,11 +27,22 @@ def _install_stop_signals(dispatcher) -> None:
     poll timeout, so shutdown work in its ``finally`` (closing sockets,
     releasing multihost followers from their blocking collective via the
     stop broadcast) actually runs. A bare SIGTERM default would kill the
-    process mid-collective and strand every follower in the fleet."""
+    process mid-collective and strand every follower in the fleet.
+
+    SIGTERM additionally dumps the flight-recorder ring (obs/flightrec.py)
+    through the log before stopping — a killed dispatcher leaves its last
+    seconds of tick/hedge/shed context behind for the post-mortem."""
     import signal
 
     def handler(signum, frame):
         log.info("signal %d: stopping dispatcher", signum)
+        if signum == signal.SIGTERM:
+            rec = getattr(dispatcher, "flightrec", None)
+            if rec is not None:
+                try:
+                    log.warning("flightrec SIGTERM dump: %s", rec.dump_json())
+                except Exception:
+                    pass  # the dump must never block the shutdown
         dispatcher.stop()
 
     signal.signal(signal.SIGTERM, handler)

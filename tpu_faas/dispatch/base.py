@@ -43,6 +43,7 @@ from tpu_faas.core.task import (
     FIELD_PARAMS,
     FIELD_PRIORITY,
     FIELD_RECLAIMS,
+    FIELD_SLO_CLASS,
     FIELD_SPECULATIVE,
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
@@ -60,6 +61,8 @@ from tpu_faas.obs import (
     TaskTraceBook,
 )
 from tpu_faas.obs import metrics as obs_metrics
+from tpu_faas.obs.attribution import AttributionBook, class_of
+from tpu_faas.obs.flightrec import FlightRecorder
 from tpu_faas.obs.slo import (
     DEFAULT_DISPATCHER_OBJECTIVES,
     objectives_from_env,
@@ -100,6 +103,9 @@ RECLAIM_FIELDS = [
     # a reclaimed task keeps its hedge eligibility (tpu_faas/spec): the
     # client's idempotency declaration survives re-dispatch
     FIELD_SPECULATIVE,
+    # a reclaimed task keeps its SLO class (obs/attribution.py): its
+    # re-dispatch must attribute to the same latency class
+    FIELD_SLO_CLASS,
 ]
 
 
@@ -208,6 +214,10 @@ class PendingTask:
     #: client declared this task idempotent and hedge-eligible
     #: (FIELD_SPECULATIVE, tpu_faas/spec); False for every legacy producer
     speculative: bool = False
+    #: declared SLO class (FIELD_SLO_CLASS, obs/attribution.py); None
+    #: (legacy producers, undeclared submits) derives from the priority
+    #: sign at attribution time — see ``effective_class``
+    slo_class: str | None = None
     #: this PendingTask IS a hedge replica of an already-running original
     #: (host-constructed, never parsed from the store): it dispatches
     #: without an inflight-table entry and dies silently if its hedge
@@ -246,6 +256,12 @@ class PendingTask:
         if trace and self.trace_id:
             out["trace_id"] = self.trace_id
         return out
+
+    @property
+    def effective_class(self) -> str:
+        """The SLO class this task's latency is judged under: the
+        declared class, else the priority sign (obs/attribution.py)."""
+        return class_of(self.slo_class, self.priority)
 
     @property
     def size_estimate(self) -> float:
@@ -298,6 +314,7 @@ class PendingTask:
             trace_id=fields.get(FIELD_TRACE_ID) or None,
             tenant=fields.get(FIELD_TENANT) or None,
             speculative=fields.get(FIELD_SPECULATIVE) == "1",
+            slo_class=fields.get(FIELD_SLO_CLASS) or None,
         )
 
 
@@ -542,6 +559,16 @@ class TaskDispatcher:
             objectives_from_env(DEFAULT_DISPATCHER_OBJECTIVES),
             self.traces.stage_snapshot,
         )
+        #: per-plane attribution counters (obs/attribution.py): which
+        #: plane touched a task, keyed by its SLO class. Creates series
+        #: only when TPU_FAAS_OBS_CLASS is on — default exposition is
+        #: byte-identical without it.
+        self.attrib = AttributionBook(self.metrics)
+        #: bounded ring of structured events around the hot loop —
+        #: tick records, sheds, hedge/tenancy decisions from subclasses.
+        #: Always on: memory-only plus the /flightrec stats route; it
+        #: adds no metric series and no wire fields.
+        self.flightrec = FlightRecorder()
         self.metrics.register_collector(self.collect_metrics)
         #: express result lane (opt-in): > 0 makes every terminal write's
         #: RESULTS_CHANNEL announce carry status + result inline up to this
@@ -754,21 +781,35 @@ class TaskDispatcher:
         if self.batch_max >= 2 and _wm.CAP_BATCH in caps:
             ent = buf.get(wid)
             if ent is None:
-                ent = buf[wid] = (_wm.CAP_BIN in caps, [])
+                # third element: per-item SLO classes for the batch
+                # plane's attribution at flush time (None = label off)
+                ent = buf[wid] = (_wm.CAP_BIN in caps, [], [])
             ent[1].append(kw)
+            ent[2].append(
+                task.effective_class if self.attrib.enabled else None
+            )
             if len(ent[1]) >= self.batch_max:
                 buf.pop(wid)
-                self._flush_batch_frame(wid, ent[0], ent[1])
+                self._flush_batch_frame(wid, ent[0], ent[1], ent[2])
         else:
             self.send_wire(
                 wid, _wm.encode_for(_wm.CAP_BIN in caps, _wm.TASK, **kw)
             )
             self.m_task_frames.inc()
             self.m_batch_size.observe(1.0)
+            if self.attrib.enabled:
+                self.attrib.note("batch", "solo", task.effective_class)
 
-    def _flush_batch_frame(self, wid, bin_cap: bool, items: list) -> None:
+    def _flush_batch_frame(
+        self, wid, bin_cap: bool, items: list, classes: list | None = None
+    ) -> None:
         """One buffered worker's frame: a singleton stays a plain TASK
         (identical wire to the unbatched path), K > 1 ship as TASK_BATCH."""
+        if classes:
+            outcome = "solo" if len(items) == 1 else "bundle_rode"
+            for cls in classes:
+                if cls is not None:
+                    self.attrib.note("batch", outcome, cls)
         if len(items) == 1:
             self.send_wire(
                 wid, _wm.encode_for(bin_cap, _wm.TASK, **items[0])
@@ -790,9 +831,9 @@ class TaskDispatcher:
         heartbeat purge + reclaim."""
         first_err: BaseException | None = None
         while buf:
-            wid, (bin_cap, items) = buf.popitem()
+            wid, (bin_cap, items, classes) = buf.popitem()
             try:
-                self._flush_batch_frame(wid, bin_cap, items)
+                self._flush_batch_frame(wid, bin_cap, items, classes)
             except Exception as exc:
                 if first_err is None:
                     first_err = exc
@@ -1060,6 +1101,15 @@ class TaskDispatcher:
         if status == str(TaskStatus.EXPIRED):
             self.n_expired += 1
             self.m_expired.inc()
+            self.attrib.note(
+                "dispatch", "shed_expired", task.effective_class
+            )
+            self.flightrec.emit(
+                "queue_shed",
+                task_id=task.task_id,
+                trace_id=task.trace_id,
+                lateness_s=round(time.time() - task.deadline_at, 6),  # faas: allow(obs.wall-clock-latency)
+            )
             self.traces.finish(task.task_id, outcome="expired")
             self.log.info(
                 "shed task %s: queue deadline lapsed %.3fs ago",
@@ -1124,6 +1174,17 @@ class TaskDispatcher:
                 self._DRAIN_ALPHA * inst
                 + (1.0 - self._DRAIN_ALPHA) * self._drain_rate
             )
+        # the flight recorder's per-tick record rides the same 1 Hz gate:
+        # one ring append per publish period, never per serve iteration
+        self.flightrec.emit(
+            "tick",
+            pending=int(pending),
+            inflight=int(inflight),
+            capacity=int(capacity),
+            results=int(results),
+            drain_rate=round(self._drain_rate, 3),
+            **self._flightrec_tick_extra(),
+        )
         publish_snapshot(
             self.store,
             self.dispatcher_id,
@@ -1139,6 +1200,12 @@ class TaskDispatcher:
         # next attempt re-measures over the whole gap (rate stays honest)
         self._cap_published_at = now
         self._cap_results_at_publish = results
+
+    def _flightrec_tick_extra(self) -> dict:
+        """Extra fields for the flight recorder's per-tick record;
+        subclasses enrich (tpu-push adds the device dispatch count and
+        the serving tick backend)."""
+        return {}
 
     # -- intake ------------------------------------------------------------
     def enable_columnar(self, capacity: int) -> None:
@@ -1324,6 +1391,8 @@ class TaskDispatcher:
             self.traces.note(task.task_id, "submitted", ts=task.submitted_at)
         self.traces.note(task.task_id, "intake")
         self.traces.note_trace(task.task_id, task.trace_id)
+        if self.attrib.enabled:
+            self.traces.note_class(task.task_id, task.effective_class)
 
     def note_dispatch(self, task: PendingTask) -> None:
         """Timeline stamp at the moment a placement decision binds ``task``
@@ -1385,6 +1454,19 @@ class TaskDispatcher:
                     "outcome": record["outcome"],
                     "retries": record["retries"],
                 }
+            elif stage == "exec" and "hedge_launched" in events:
+                # speculation plane: a hedged task's timeline carries the
+                # race WINNER's window (the loser's late stamps land on a
+                # closed timeline and no-op), so tag it; the cancelled
+                # leg rides its own ``exec_replica`` span (tpu_push emits
+                # it at the loser-result site under a distinct field name
+                # — a second write to ``worker:exec`` would silently lose
+                # the span plane's first-write-wins HSETNX).
+                attrs = {"hedge": "winner"}
+                for leg in ("replica", "original", "promoted"):
+                    if f"hedge_won_{leg}" in events:
+                        attrs["winner_leg"] = leg
+                        break
             self.spans.emit_as(
                 process,
                 trace_id,
@@ -1540,8 +1622,12 @@ class TaskDispatcher:
                 # on the lane counter and the pinned occupancy gauge
                 task = PendingTask.from_fields(msg, _flat_dict(flat))
                 n_fallback += 1
+                self.attrib.note(
+                    "columnar", "fallback", task.effective_class
+                )
             else:
                 n_arena += 1
+                self.attrib.note("columnar", "arena", task.effective_class)
             self.note_graph_parent(msg, names)
             self._note_intake(task)
             if FIELD_DEPS in names:
@@ -1554,6 +1640,11 @@ class TaskDispatcher:
             self._m_intake_arena.inc(n_arena)
         if n_fallback:
             self._m_intake_fallback.inc(n_fallback)
+            self.flightrec.emit(
+                "arena_fallback",
+                n=n_fallback,
+                occupancy=int(arena.occupancy),
+            )
         self.m_arena_occupancy.set(float(arena.occupancy))
         return out
 
@@ -2242,6 +2333,9 @@ class TaskDispatcher:
           slowest tasks seen;
         - ``GET /slo`` — per-objective multi-window burn rates
           (obs/slo.py) over the stage histograms;
+        - ``GET /flightrec`` — the flight-recorder ring (obs/flightrec.py)
+          as JSON; ``?since=N`` polls incrementally from a prior cursor,
+          ``?limit=K`` keeps only the newest K matching events;
         - ``GET /healthz`` — liveness (always 200 while serving);
         - ``GET /readyz`` — readiness (503 while the store is down or
           this dispatcher is pointed at a non-writable replica/fenced
@@ -2274,6 +2368,24 @@ class TaskDispatcher:
                         return
                 elif self.path == "/slo":
                     body = json.dumps(dispatcher.slo.snapshot()).encode()
+                elif self.path == "/flightrec" or self.path.startswith(
+                    "/flightrec?"
+                ):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        since = int(q.get("since", ["0"])[0])
+                        limit = int(q.get("limit", ["0"])[0])
+                    except ValueError:
+                        self.send_error(400)
+                        return
+                    body = json.dumps(
+                        dispatcher.flightrec.snapshot(
+                            since=since, limit=limit
+                        ),
+                        default=str,
+                    ).encode()
                 elif self.path == "/stats":
                     body = json.dumps(dispatcher.stats()).encode()
                 elif self.path == "/metrics":

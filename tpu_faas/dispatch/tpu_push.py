@@ -365,6 +365,13 @@ class TpuPushDispatcher(TaskDispatcher):
         # the scrape)
         self._task_tenant_row: dict[str, int] = {}
         self._last_tenant_reload = 0.0
+        #: host view of the device deficit vector, read back at most once
+        #: per tick (attribution: a dispatch for a tenant carrying deficit
+        #: was fairness-boosted); None = not read this tick
+        self._tick_deficits = None
+        #: task ids already attributed cap_held (once per task, not per
+        #: tick it sat capped); pruned on dispatch/forget
+        self._cap_held_noted: set[str] = set()
         if self.tenancy is not None:
             self.m_tenant_dispatched = self.metrics.counter(
                 "tpu_faas_tasks_dispatched_total",
@@ -417,6 +424,20 @@ class TpuPushDispatcher(TaskDispatcher):
                 "LOSERS (the speculation plane's measured wasted work; "
                 "losers killed before their child started report none)",
             )
+            # tail-aware placement health (sched/state.py worker_health):
+            # hedge losses decay a row's multiplier, ticks recover it —
+            # this family summarizes the live vector. Exists iff the
+            # speculation plane is on (health only moves under hedging),
+            # so the default exposition stays byte-identical.
+            self.m_worker_health = self.metrics.gauge(
+                "tpu_faas_worker_health",
+                "Fleet worker-health multiplier summary (speculation "
+                "plane): min / mean over active rows, plus the count of "
+                "degraded rows (health < 1.0)",
+                ("stat",),
+            )
+            for stat in ("min", "mean", "degraded"):
+                self.m_worker_health.labels(stat=stat)
         #: RESULT store writes accumulated during a worker-message drain,
         #: flushed as ONE pipelined finish_task_many round per drain
         #: (drain_results_batched); None = unbatched mode, where _handle
@@ -812,7 +833,10 @@ class TpuPushDispatcher(TaskDispatcher):
 
     def _note_tenant_dispatch(self, task: PendingTask) -> None:
         """A task went on the wire: charge its tenant's inflight count
-        (what the in-tick caps enforce against) and the dispatch series."""
+        (what the in-tick caps enforce against) and the dispatch series.
+        When the class label is on, a dispatch for a tenant carrying a
+        positive device deficit is attributed fairness_boosted — the
+        plane's deficit carry is what admitted it ahead of FCFS order."""
         if self.tenancy is None:
             return
         row = self.tenancy.row_for(task.tenant)
@@ -821,6 +845,27 @@ class TpuPushDispatcher(TaskDispatcher):
         self.m_tenant_dispatched.labels(
             tenant=self.tenancy.label_for(task.tenant)
         ).inc()
+        if self.attrib.enabled:
+            self._cap_held_noted.discard(task.task_id)
+            if self._tenant_deficit(row) > 0.0:
+                self.attrib.note(
+                    "tenancy", "fairness_boosted", task.effective_class
+                )
+
+    def _tenant_deficit(self, row: int) -> float:
+        """This tick's device deficit for a tenant row; the vector is
+        read back lazily, at most once per tick (``_tick_deficits`` is
+        reset at tick start)."""
+        vec = self._tick_deficits
+        if vec is None:
+            try:
+                vec = self.arrays.tenant_deficits()
+            except Exception:
+                vec = None
+            if vec is None:
+                vec = ()
+            self._tick_deficits = vec
+        return float(vec[row]) if 0 <= row < len(vec) else 0.0
 
     def _tenant_task_done(self, task_id: str) -> None:
         """A task left the inflight table (result, reclaim, drop): release
@@ -846,6 +891,24 @@ class TpuPushDispatcher(TaskDispatcher):
         if now - self._last_tenant_reload < self._TENANT_RELOAD_PERIOD:
             return
         self._last_tenant_reload = now
+        # the flight recorder's tenant snapshot rides the same ~1 Hz gate:
+        # per-tenant inflight + the device deficit carry (bounded lists —
+        # the tenant table is capped at max_tenants)
+        ten = self.tenancy
+        self.flightrec.emit(
+            "tenant_deficits",
+            tenants=[ten.name_of(r) for r in range(ten.n_tenants)],
+            inflight=[int(ten.inflight[r]) for r in range(ten.n_tenants)],
+            deficits=(
+                None
+                if self._tick_deficits is None
+                or not len(self._tick_deficits)
+                else [
+                    round(float(d), 3)
+                    for d in list(self._tick_deficits)[: ten.n_tenants]
+                ]
+            ),
+        )
         if self.tenancy.maybe_reload(self.store):
             self.log.info(
                 "tenant config hot-reloaded from the store: %s",
@@ -902,6 +965,9 @@ class TpuPushDispatcher(TaskDispatcher):
                 # (one accounting site); the store fetch is skipped
                 spec.consider(task_id, int(a.inflight_worker[slot]), denom)
                 self.m_hedges.labels(outcome="suppressed_budget").inc()
+                self.flightrec.emit(
+                    "hedge", task_id=task_id, verdict="suppressed_budget"
+                )
                 continue
             orig_row = int(a.inflight_worker[slot])
             try:
@@ -914,12 +980,23 @@ class TpuPushDispatcher(TaskDispatcher):
                 return  # next tick re-flags; nothing mutated
             if pt is None or not pt.speculative:
                 continue  # vanished, or the record lost its declaration
-            if spec.consider(task_id, orig_row, denom) is None:
+            entry = spec.consider(task_id, orig_row, denom)
+            if entry is None:
                 continue
+            # stamp the class at launch: resolution attributes the race's
+            # outcome per class without re-reading the record
+            entry.cls = pt.effective_class
             pt.is_hedge = True
             pt.avoid_row = orig_row
             self.pending.append(pt)
             self.m_hedges.labels(outcome="launched").inc()
+            self.flightrec.emit(
+                "hedge",
+                task_id=task_id,
+                verdict="launched",
+                orig_row=orig_row,
+                trace_id=pt.trace_id,
+            )
             self.traces.note(task_id, "hedge_launched", count_dup=False)
             self.log.info(
                 "hedging straggler task %s (original on worker row %d)",
@@ -1015,6 +1092,8 @@ class TpuPushDispatcher(TaskDispatcher):
         self.m_hedges.labels(outcome="abandoned").inc()
         if not entry.dispatched:
             return
+        # a dispatched replica that never got to race is pure waste
+        self.attrib.note("speculation", "hedged_wasted", entry.cls)
         a = self.arrays
         if (
             kill
@@ -1053,7 +1132,14 @@ class TpuPushDispatcher(TaskDispatcher):
                 row_o if row_o is not None else entry.orig_row
             )
             self.m_hedges.labels(outcome="replica_won").inc()
+            self.attrib.note("speculation", "hedged_won", entry.cls)
+            self.flightrec.emit(
+                "hedge_resolved", task_id=task_id, winner="replica"
+            )
             self.traces.note(task_id, "hedge_resolved", count_dup=False)
+            # winner-leg stamp: _emit_trace_spans reads it off the closed
+            # record to tag the exec span with which leg won the race
+            self.traces.note(task_id, "hedge_won_replica", count_dup=False)
             if row_o is not None:
                 # loser slot reclaims immediately; the CANCEL kill frees
                 # the worker-side process (late/cancelled result arrives
@@ -1080,7 +1166,12 @@ class TpuPushDispatcher(TaskDispatcher):
                 task_id, winner="original", loser_row=entry.hedge_row
             )
             self.m_hedges.labels(outcome="original_won").inc()
+            self.attrib.note("speculation", "hedged_wasted", entry.cls)
+            self.flightrec.emit(
+                "hedge_resolved", task_id=task_id, winner="original"
+            )
             self.traces.note(task_id, "hedge_resolved", count_dup=False)
+            self.traces.note(task_id, "hedge_won_original", count_dup=False)
             if (
                 entry.hedge_wid is not None
                 and a.row_ids.get(entry.hedge_row) == entry.hedge_wid
@@ -1093,6 +1184,39 @@ class TpuPushDispatcher(TaskDispatcher):
                 self.tenancy.note_done(entry.tenant_row)
         # a result from NEITHER leg (an older zombie): leave the hedge
         # racing — first_wins already froze the record for everyone
+
+    def _emit_loser_span(self, wid: bytes, task_id: str, data: dict) -> None:
+        """The hedge race's CANCELLED leg reported its execution window:
+        persist it to the span plane so ``/trace`` shows both legs. The
+        loser's late RESULT is a first-wins no-op for the record and a
+        closed-timeline no-op for the stage histogram, so this window
+        would otherwise vanish — and it must ride its OWN stage name
+        (``exec_replica``): the winner already owns ``worker:exec``, and
+        the span store's first-write-wins HSETNX would silently drop a
+        second write to the same field."""
+        trace_id = data.get("trace_id")
+        started = data.get("started_at")
+        elapsed = data.get("elapsed")
+        if (
+            not trace_id
+            or not isinstance(started, (int, float))
+            or not isinstance(elapsed, (int, float))
+            or elapsed < 0
+        ):
+            return  # reference-era worker, or a pre-start kill (no window)
+        attrs = {"hedge": "loser", "outcome": "cancelled"}
+        row = self.arrays.worker_ids.get(wid)
+        if row is not None:
+            attrs["replica_row"] = int(row)
+        self.spans.emit_as(
+            "worker",
+            trace_id,
+            "exec_replica",
+            float(started),
+            float(started) + float(elapsed),
+            task_id=task_id,
+            **attrs,
+        )
 
     def _note_token(self, wid: bytes, data: dict) -> None:
         """Record the stable worker token a REGISTER/RECONNECT carries
@@ -1323,6 +1447,7 @@ class TpuPushDispatcher(TaskDispatcher):
             )
             if waste is not None:
                 self.m_hedge_waste.inc(waste)
+                self._emit_loser_span(wid, task_id, data)
         # suspicious = a second result is possible: sender is not the
         # task's current owner (zombie after a reclaim), the task was
         # reclaimed at least once on its way to this worker, or a hedge
@@ -1479,6 +1604,53 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.m_tenant_queue.labels(tenant=lbl).set(
                         depth.get(lbl, 0)
                     )
+        if self.spec is not None:
+            health = self._worker_health_summary()
+            if health is not None:
+                self.m_worker_health.labels(stat="min").set(health["min"])
+                self.m_worker_health.labels(stat="mean").set(health["mean"])
+                self.m_worker_health.labels(stat="degraded").set(
+                    health["degraded"]
+                )
+
+    def _worker_health_summary(self) -> dict | None:
+        """min/mean/degraded-count over ACTIVE rows of the tail-health
+        vector (sched/state.py). None when the vector is absent or a
+        stats-thread resize race tears the read (standard convention:
+        keep the previous scrape's value). An empty active fleet reads
+        as perfectly healthy."""
+        a = self.arrays
+        health = getattr(a, "worker_health", None)
+        if health is None:
+            return None
+        try:
+            active = np.asarray(a.worker_active, dtype=bool)
+            vec = np.asarray(health, dtype=np.float64)
+            n = min(len(active), len(vec))
+            hv = vec[:n][active[:n]]
+        except (RuntimeError, ValueError):
+            return None
+        if not hv.size:
+            return {"min": 1.0, "mean": 1.0, "degraded": 0, "n_active": 0}
+        return {
+            "min": round(float(hv.min()), 4),
+            "mean": round(float(hv.mean()), 4),
+            "degraded": int((hv < 1.0).sum()),
+            "n_active": int(hv.size),
+        }
+
+    def _flightrec_tick_extra(self) -> dict:
+        """tpu-push enrichment of the per-tick flight record: which
+        placement/tick kernel is serving and (resident) how many device
+        dispatches the last tick cost."""
+        a = self.arrays
+        return {
+            "placement": a.placement,
+            "tick_backend": getattr(a, "tick_backend", None),
+            "device_dispatches": getattr(
+                a, "device_dispatches_last_tick", None
+            ),
+        }
 
     def stats(self) -> dict:
         a = self.arrays
@@ -1557,6 +1729,11 @@ class TpuPushDispatcher(TaskDispatcher):
             # wasted-work ratio the budget bounds
             "speculation": (
                 None if self.spec is None else self.spec.stats()
+            ),
+            # tail-health block (None = speculation plane off): summary of
+            # the worker_health multipliers placement steers around
+            "worker_health": (
+                None if self.spec is None else self._worker_health_summary()
             ),
         }
 
@@ -1669,6 +1846,9 @@ class TpuPushDispatcher(TaskDispatcher):
             )
 
     def _tick_inner(self, intake: bool) -> int:
+        # attribution: last tick's deficit readback is stale now (covers
+        # the resident path too — it shares this entry)
+        self._tick_deficits = None
         if self.resident:
             return self._tick_resident(intake)
         a = self.arrays
@@ -2019,11 +2199,36 @@ class TpuPushDispatcher(TaskDispatcher):
         # queue back (they ride the next tick's placement as ghost rows)
         if straggler_idx is not None and len(straggler_idx):
             self._consider_hedges(straggler_idx)
+        self._note_cap_held()
         if self.arena is not None:
             # per-tick occupancy refresh: the dispatch hot path retires
             # rows without touching the gauge (see _retire_row)
             self.m_arena_occupancy.set(float(self.arena.occupancy))
         return sent
+
+    def _note_cap_held(self) -> None:
+        """Post-tick cap attribution: a task still pending whose tenant
+        sits AT its inflight ceiling was held by the tenancy plane's cap —
+        attributed once per task (the noted-set gate), not once per tick
+        it waits. Cheap exit when no tenant is capped or the class label
+        is off; the pending walk only runs while a cap actually binds."""
+        ten = self.tenancy
+        if ten is None or not self.attrib.enabled:
+            return
+        capped = {
+            row
+            for row in range(ten.n_tenants)
+            if ten.cap[row] and ten.inflight[row] >= ten.cap[row]
+        }
+        if not capped:
+            return
+        for t in self.pending:
+            if (
+                ten.row_for(t.tenant, register=False) in capped
+                and t.task_id not in self._cap_held_noted
+            ):
+                self._cap_held_noted.add(t.task_id)
+                self.attrib.note("tenancy", "cap_held", t.effective_class)
 
     def _finished_probe(self, task_ids: list[str]) -> set[str]:
         """One pipelined status read over ``task_ids``; returns the ids a
@@ -2217,6 +2422,7 @@ class TpuPushDispatcher(TaskDispatcher):
         self.task_retries.pop(task_id, None)
         self._task_digest.pop(task_id, None)
         self._result_rows.pop(task_id, None)
+        self._cap_held_noted.discard(task_id)
         self._tenant_task_done(task_id)
         # an outstanding hedge dies with the task (cancel/expire/zombie-
         # finish): CANCEL the replica if it is on the wire, reclaim its
@@ -2282,7 +2488,14 @@ class TpuPushDispatcher(TaskDispatcher):
             a.inflight_clear_slot(slot)
             self.spec.promote(task_id)
             self.m_hedges.labels(outcome="promoted").inc()
+            # the replica saved the task from its dead original: a win
+            # for the plane's attribution, same as a replica-first result
+            self.attrib.note("speculation", "hedged_won", entry.cls)
+            self.flightrec.emit(
+                "hedge_resolved", task_id=task_id, winner="promoted"
+            )
             self.traces.note(task_id, "hedge_resolved", count_dup=False)
+            self.traces.note(task_id, "hedge_won_promoted", count_dup=False)
             a.inflight_add(task_id, entry.hedge_row)
             # the purged original may be a STALLED-not-dead zombie that
             # still ships a result: the promoted replica's write must ride
@@ -2587,6 +2800,10 @@ class TpuPushDispatcher(TaskDispatcher):
         if not express_due:
             if hold is not None and now >= hold:
                 self._express_hold_until = None
+                self.flightrec.emit(
+                    "express_gate", verdict="window_expired",
+                    depth=len(self.pending),
+                )
                 return True, False
             return False, False
         if self.batch_window_s <= 0 or self.batch_max < 2:
@@ -2604,13 +2821,27 @@ class TpuPushDispatcher(TaskDispatcher):
         # a genuinely solo arrival pay the coalescing window
         depth = len(self.pending)
         if depth <= self._EXPRESS_FLUSH_DEPTH or depth >= self.batch_max:
+            if depth >= self.batch_max:
+                # full bundle: worth a ring record (the shallow immediate
+                # flush is the per-submit common path — deliberately NOT
+                # recorded, it would churn the ring at submit rate)
+                self.flightrec.emit(
+                    "express_gate", verdict="full_flush", depth=depth
+                )
             self._express_hold_until = None
             return True, True
         if hold is None:
             self._express_hold_until = now + self.batch_window_s
+            self.flightrec.emit(
+                "express_gate", verdict="hold_armed", depth=depth,
+                window_ms=round(self.batch_window_s * 1000.0, 3),
+            )
             return False, True
         if now >= hold:
             self._express_hold_until = None
+            self.flightrec.emit(
+                "express_gate", verdict="window_expired", depth=depth
+            )
             return True, True
         return False, True
 

@@ -107,6 +107,7 @@ from tpu_faas.core.task import (
     FIELD_PENDING_DEPS,
     FIELD_PRIORITY,
     FIELD_RESULT,
+    FIELD_SLO_CLASS,
     FIELD_SPECULATIVE,
     FIELD_STATUS,
     FIELD_SUBMITTED_AT,
@@ -122,6 +123,16 @@ from tpu_faas.tenancy import valid_tenant
 from tpu_faas.graph import GraphValidationError, validate_graph
 from tpu_faas.obs import REGISTRY, MetricsRegistry, SLOTracker, SpanSink
 from tpu_faas.obs import metrics as obs_metrics
+from tpu_faas.obs.attribution import (
+    SLO_CLASSES,
+    AttributionBook,
+    class_of,
+    class_of_fields,
+    latency_buckets,
+    normalize_class,
+)
+from tpu_faas.obs.flightrec import FlightRecorder
+from tpu_faas.obs.metrics import LATENCY_BUCKETS
 from tpu_faas.obs.slo import DEFAULT_GATEWAY_OBJECTIVES, objectives_from_env
 from tpu_faas.obs.tracectx import (
     TRACE_PREFIX,
@@ -395,6 +406,15 @@ class GatewayContext:
     trace: bool = False
 
     def __post_init__(self) -> None:
+        #: composed-SLO attribution plane (obs/attribution.py): the
+        #: tpu_faas_task_attrib_total family when TPU_FAAS_OBS_CLASS is
+        #: on, a no-op otherwise — also the flag the e2e histogram's
+        #: class label keys off
+        self.attrib = AttributionBook(self.metrics)
+        #: fleet flight recorder (obs/flightrec.py): bounded in-process
+        #: event ring behind GET /flightrec — pure memory, no exposition
+        #: or wire change, so it is unconditionally on
+        self.flightrec = FlightRecorder()
         self.m_requests = self.metrics.counter(
             "tpu_faas_gateway_requests_total",
             "HTTP requests served, by method+route (long-polls separated)",
@@ -511,10 +531,17 @@ class GatewayContext:
             "closing status, so shed (EXPIRED) and cancelled populations "
             "stay out of the completed-latency distribution the SLO "
             "layer judges",
-            ("phase", "terminal"),
+            ("phase", "terminal", "class")
+            if self.attrib.enabled
+            else ("phase", "terminal"),
+            buckets=latency_buckets(LATENCY_BUCKETS),
         )
         for phase in ("submit_to_finish", "submit_to_observe"):
-            self.m_e2e.labels(phase=phase, terminal="COMPLETED")
+            if self.attrib.enabled:
+                for cls in SLO_CLASSES:
+                    self.m_e2e.labels(phase, "COMPLETED", cls)
+            else:
+                self.m_e2e.labels(phase=phase, terminal="COMPLETED")
         self.m_result_served = self.metrics.counter(
             "tpu_faas_gateway_result_served_total",
             "Terminal result deliveries to clients (/result, "
@@ -569,18 +596,31 @@ class GatewayContext:
         if self.tracer is None:
             self.tracer = TickTracer(mirror=self.m_latency)
 
-    def _e2e_snapshot(self, phase: str):
+    def _e2e_snapshot(self, phase: str, cls: str | None = None):
         """SLO data source: (bucket uppers, counts) of one e2e phase —
         COMPLETED outcomes only, matching the dispatcher's stage_snapshot
         policy: a burst of deadline-shed EXPIRED tasks is intended
         overload behavior and must not burn the latency error budget,
-        and quick cancels must not dilute real violations."""
+        and quick cancels must not dilute real violations.
+
+        ``cls`` restricts to one SLO class; None against a class-blind
+        histogram (label off) — sum_counts matches positionally, so a
+        three-element match over two-label children would silently match
+        every class instead of one."""
+        if cls is not None:
+            if not self.attrib.enabled:
+                return None
+            return self.m_e2e.sum_counts((phase, "COMPLETED", cls))
         return self.m_e2e.sum_counts((phase, "COMPLETED"))
 
     _OBSERVED_CAP = 65536
 
     def note_result_observed(
-        self, task_id: str, fields: dict, observed_at: float | None = None
+        self,
+        task_id: str,
+        fields: dict,
+        observed_at: float | None = None,
+        source: str | None = None,
     ) -> None:
         """First terminal /result delivery for a task: observe the e2e
         latency phases and emit the ``observe`` span — the poll-gap
@@ -589,13 +629,18 @@ class GatewayContext:
         (spans). Non-blocking: spans go to the sink buffer.
         ``observed_at`` is the reply-time stamp the caller took BEFORE
         any telemetry store fetch — the observe phase must measure the
-        client's wait, not the measurement's own cost."""
+        client's wait, not the measurement's own cost.
+        ``source`` is how the FIRST delivery was served ("inline" from
+        the express lane's forwarded payload, "store" from a store read)
+        — folded into the attribution counters so the express plane's
+        percentile contribution is scrapeable per class."""
         first = task_id not in self._observed
         if first:
             self._observed[task_id] = True
             while len(self._observed) > self._OBSERVED_CAP:
                 self._observed.pop(next(iter(self._observed)))
         now = observed_at if observed_at is not None else time.time()
+        cls = class_of_fields(fields) if self.attrib.enabled else None
         submitted = finished = None
         try:
             submitted = float(fields[FIELD_SUBMITTED_AT])
@@ -605,15 +650,37 @@ class GatewayContext:
             finished = float(fields[FIELD_FINISHED_AT])
         except (KeyError, ValueError):
             pass
+        if first and cls is not None and source in ("inline", "store"):
+            self.attrib.note("express", source, cls)
+        if first:
+            # one ring event per task at its terminal delivery — the
+            # gateway-side join point for a post-incident /flightrec
+            # walk (joins to /trace via task_id)
+            self.flightrec.emit(
+                "result_delivery",
+                task_id=task_id,
+                source=source or "store",
+                status=str(fields.get(FIELD_STATUS) or "unknown"),
+                **({"cls": cls} if cls is not None else {}),
+            )
         if first and submitted is not None:
             terminal = str(fields.get(FIELD_STATUS) or "unknown")
-            if finished is not None:
+            if cls is not None:
+                if finished is not None:
+                    self.m_e2e.labels(
+                        "submit_to_finish", terminal, cls
+                    ).observe(max(0.0, finished - submitted))
+                self.m_e2e.labels("submit_to_observe", terminal, cls).observe(
+                    max(0.0, now - submitted)
+                )
+            else:
+                if finished is not None:
+                    self.m_e2e.labels(
+                        phase="submit_to_finish", terminal=terminal
+                    ).observe(max(0.0, finished - submitted))
                 self.m_e2e.labels(
-                    phase="submit_to_finish", terminal=terminal
-                ).observe(max(0.0, finished - submitted))
-            self.m_e2e.labels(
-                phase="submit_to_observe", terminal=terminal
-            ).observe(max(0.0, now - submitted))
+                    phase="submit_to_observe", terminal=terminal
+                ).observe(max(0.0, now - submitted))
         trace_id = fields.get(FIELD_TRACE_ID)
         if (
             first
@@ -730,7 +797,11 @@ SPAN_FLUSHER_KEY: web.AppKey["asyncio.Task"] = web.AppKey(
 
 
 def _admission_reject(
-    ctx: "GatewayContext", decision, what: str, n: int = 1
+    ctx: "GatewayContext",
+    decision,
+    what: str,
+    n: int = 1,
+    cls: str | None = None,
 ) -> web.Response:
     """Map an admission reject to the wire: retryable reasons are 429 +
     Retry-After; a batch larger than the quota bucket can EVER hold is a
@@ -739,6 +810,12 @@ def _admission_reject(
     keeps the reject counter in TASKS, same unit as the admit counter —
     a rejected 1000-task batch is 1000 rejected tasks, not one."""
     ctx.m_rejected.labels(reason=decision.reason).inc(n)
+    if cls is not None:
+        # the shed attribution bit: tasks that never ran, per class
+        ctx.attrib.note("admission", "shed", cls, n)
+    ctx.flightrec.emit(
+        "admission_shed", reason=decision.reason, what=what, n=n
+    )
     if decision.reason == "quota_exceeds_burst":
         return _json_error(
             400,
@@ -1057,6 +1134,7 @@ def make_app(
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/stats", stats)
     app.router.add_get("/slo", slo)
+    app.router.add_get("/flightrec", flightrec)
     app.router.add_get("/trace/{task_id}", trace_task)
 
     async def _start_wakeups(_app: web.Application) -> None:
@@ -1316,6 +1394,35 @@ _TENANT_400 = (
     "alphanumeric"
 )
 
+#: sentinel distinguishing "no declaration" (fine: class derived from the
+#: priority sign downstream) from "bad declaration" (400) — same shape as
+#: the tenant-header validation above, and for the same reason: the value
+#: becomes store-hash content and a metrics-label candidate
+_BAD_CLASS = object()
+
+_CLASS_400 = (
+    "X-SLO-Class (or 'slo_class') must be one of "
+    + "/".join(SLO_CLASSES)
+)
+
+
+def _slo_class_of(request: web.Request, body: dict | None = None):
+    """The declared SLO class: JSON body key ``slo_class`` (the SDK
+    kwarg's wire form) wins over the ``X-SLO-Class`` header; None when
+    neither is present; ``_BAD_CLASS`` for an off-vocabulary value —
+    declarations are validated (a typo'd class silently degrading to
+    ``default`` would un-judge the tasks the operator most cares about).
+    """
+    raw = None
+    if body is not None:
+        raw = body.get("slo_class")
+    if raw is None:
+        raw = request.headers.get("X-SLO-Class")
+    if raw is None:
+        return None
+    cls = normalize_class(raw)
+    return cls if cls is not None else _BAD_CLASS
+
 
 def _idempotent_task_id(function_id: str, key: str) -> str:
     """Deterministic task id for (function, idempotency key): a client that
@@ -1354,6 +1461,14 @@ async def execute_function(request: web.Request) -> web.Response:
         return _json_error(400, _TENANT_400)
     if tenant is not None:
         extra[FIELD_TENANT] = tenant
+    # SLO class (obs/attribution.py): written ONLY when declared — the
+    # record (and the submit wire) stays byte-identical for clients that
+    # never declare; consumers derive from the priority sign instead
+    slo_class = _slo_class_of(request, body)
+    if slo_class is _BAD_CLASS:
+        return _json_error(400, _CLASS_400)
+    if slo_class is not None:
+        extra[FIELD_SLO_CLASS] = slo_class
     # distributed trace context (obs/tracectx.py): client-supplied id
     # validated (it becomes a store key), or minted here for legacy
     # clients; ignored entirely while tracing is off
@@ -1389,7 +1504,12 @@ async def execute_function(request: web.Request) -> web.Response:
         request, n=1, priority=_priority_of(body.get("priority"))
     )
     if decision is not None and not decision.admitted:
-        return _admission_reject(ctx, decision, "submit")
+        return _admission_reject(
+            ctx,
+            decision,
+            "submit",
+            cls=class_of(slo_class, _priority_of(body.get("priority"))),
+        )
     ctx.m_admitted.inc()
     t_admit = time.time()
 
@@ -1616,10 +1736,18 @@ async def execute_batch(request: web.Request) -> web.Response:
     tenant = _tenant_of(request)
     if tenant is _BAD_TENANT:
         return _json_error(400, _TENANT_400)
+    # one declared SLO class per request (the header / body key), stamped
+    # on every member that has one — members without a declaration keep
+    # deriving from their own priority sign
+    slo_class = _slo_class_of(request, body)
+    if slo_class is _BAD_CLASS:
+        return _json_error(400, _CLASS_400)
     for e in extras:
         e[FIELD_SUBMITTED_AT] = submit_stamp
         if tenant is not None:
             e[FIELD_TENANT] = tenant
+        if slo_class is not None:
+            e[FIELD_SLO_CLASS] = slo_class
     # distributed trace context, batched: a parallel optional list of
     # client-minted ids; holes (and the whole list, for legacy clients)
     # are minted here. Ignored entirely while tracing is off.
@@ -1698,7 +1826,16 @@ async def execute_batch(request: web.Request) -> web.Response:
         ),
     )
     if decision is not None and not decision.admitted:
-        return _admission_reject(ctx, decision, "batch", n=len(payloads))
+        return _admission_reject(
+            ctx,
+            decision,
+            "batch",
+            n=len(payloads),
+            cls=class_of(
+                slo_class,
+                min((_priority_of(p) for p in (priorities or [0])), default=0),
+            ),
+        )
     ctx.m_admitted.inc(len(payloads))
     t_admit = time.time()
     fn_payload, fn_dig = await ctx.store_call(
@@ -1926,6 +2063,9 @@ async def execute_graph(request: web.Request) -> web.Response:
     tenant = _tenant_of(request)  # one tenant per graph (the header)
     if tenant is _BAD_TENANT:
         return _json_error(400, _TENANT_400)
+    slo_class = _slo_class_of(request, body)  # one class per graph, ditto
+    if slo_class is _BAD_CLASS:
+        return _json_error(400, _CLASS_400)
     extras: list[dict[str, str]] = []
     fids: list[str] = []
     for i, node in enumerate(nodes):
@@ -1948,6 +2088,8 @@ async def execute_graph(request: web.Request) -> web.Response:
         extra[FIELD_SUBMITTED_AT] = submit_stamp
         if tenant is not None:
             extra[FIELD_TENANT] = tenant
+        if slo_class is not None:
+            extra[FIELD_SLO_CLASS] = slo_class
         extras.append(extra)
         fids.append(fid)
     # admission AFTER validation, BEFORE store work; the graph decides
@@ -1960,7 +2102,16 @@ async def execute_graph(request: web.Request) -> web.Response:
         priority=min(_priority_of(n.get("priority")) for n in nodes),
     )
     if decision is not None and not decision.admitted:
-        return _admission_reject(ctx, decision, "graph", n=len(nodes))
+        return _admission_reject(
+            ctx,
+            decision,
+            "graph",
+            n=len(nodes),
+            cls=class_of(
+                slo_class,
+                min(_priority_of(n.get("priority")) for n in nodes),
+            ),
+        )
     ctx.m_admitted.inc(len(nodes))
     distinct = list(dict.fromkeys(fids))
     fn_keys = [_FUNCTION_PREFIX + f for f in distinct]
@@ -2078,7 +2229,7 @@ def _note_terminal_delivery(
     ctx.m_result_served.labels(source=source).inc()
     if task_id not in ctx._observed:
         t = loop.create_task(
-            _note_observed(ctx, task_id, status, time.time())
+            _note_observed(ctx, task_id, status, time.time(), source)
         )
         ctx._observe_tasks.add(t)
         t.add_done_callback(ctx._observe_tasks.discard)
@@ -2185,7 +2336,11 @@ async def get_result(request: web.Request) -> web.Response:
 
 
 async def _note_observed(
-    ctx: "GatewayContext", task_id: str, status: str, observed_at: float
+    ctx: "GatewayContext",
+    task_id: str,
+    status: str,
+    observed_at: float,
+    source: str | None = None,
 ) -> None:
     """First terminal /result delivery: feed the e2e latency histograms
     and the ``observe`` span (the poll-gap segment no dispatcher-local
@@ -2198,10 +2353,18 @@ async def _note_observed(
     if task_id in ctx._observed:
         return
     try:
-        submitted, finished, trace_id = await ctx.store_call(
-            ctx.store.hmget,
-            task_id,
-            [FIELD_SUBMITTED_AT, FIELD_FINISHED_AT, FIELD_TRACE_ID],
+        submitted, finished, trace_id, slo_class, priority = (
+            await ctx.store_call(
+                ctx.store.hmget,
+                task_id,
+                [
+                    FIELD_SUBMITTED_AT,
+                    FIELD_FINISHED_AT,
+                    FIELD_TRACE_ID,
+                    FIELD_SLO_CLASS,
+                    FIELD_PRIORITY,
+                ],
+            )
         )
     except Exception:
         return
@@ -2212,7 +2375,11 @@ async def _note_observed(
         fields[FIELD_FINISHED_AT] = finished
     if trace_id is not None:
         fields[FIELD_TRACE_ID] = trace_id
-    ctx.note_result_observed(task_id, fields, observed_at)
+    if slo_class is not None:
+        fields[FIELD_SLO_CLASS] = slo_class
+    if priority is not None:
+        fields[FIELD_PRIORITY] = priority
+    ctx.note_result_observed(task_id, fields, observed_at, source=source)
 
 
 #: /results/wait and /events accept at most this many task ids per call:
@@ -2666,6 +2833,20 @@ async def slo(request: web.Request) -> web.Response:
     return web.json_response(await _run_blocking(ctx.slo.snapshot))
 
 
+async def flightrec(request: web.Request) -> web.Response:
+    """The flight recorder's event ring as JSON (obs/flightrec.py):
+    ``?since=N`` returns only events newer than cursor N (pass the last
+    reply's ``cursor`` back to poll incrementally), ``?limit=K`` keeps
+    the NEWEST K. Pure in-memory read — no store traffic."""
+    ctx: GatewayContext = request.app[CTX_KEY]
+    try:
+        since = int(request.query.get("since", 0) or 0)
+        limit = int(request.query.get("limit", 0) or 0)
+    except ValueError:
+        return _json_error(400, "'since' and 'limit' must be integers")
+    return web.json_response(ctx.flightrec.snapshot(since=since, limit=limit))
+
+
 async def trace_task(request: web.Request) -> web.Response:
     """The assembled CROSS-PROCESS timeline of one task: gateway admit/
     create/observe spans, dispatcher intake-to-finalize spans, and the
@@ -2909,20 +3090,28 @@ def main(argv: list[str] | None = None) -> None:
         )
         breaker = True
     log.info("gateway on %s:%d (store %s)", ns.host, ns.port, ns.store)
-    web.run_app(
-        make_app(
-            store,
-            result_ttl=ns.result_ttl,
-            admission=admission,
-            breaker=breaker,
-            payload_plane=ns.payload_plane,
-            trace=ns.trace,
-            wait_safety_poll_s=ns.wait_safety_poll_s,
-        ),
-        host=ns.host,
-        port=ns.port,
-        print=None,
+    app = make_app(
+        store,
+        result_ttl=ns.result_ttl,
+        admission=admission,
+        breaker=breaker,
+        payload_plane=ns.payload_plane,
+        trace=ns.trace,
+        wait_safety_poll_s=ns.wait_safety_poll_s,
     )
+    ctx = app[CTX_KEY]
+
+    async def _dump_flightrec(_app: web.Application) -> None:
+        # SIGTERM lands here via aiohttp's graceful-exit path (run_app
+        # owns the signal handlers): the ring's last seconds go to the
+        # log before the process dies — CLI serve only, so embedded/test
+        # gateways shut down quietly
+        log.warning(
+            "flightrec shutdown dump: %s", ctx.flightrec.dump_json()
+        )
+
+    app.on_shutdown.append(_dump_flightrec)
+    web.run_app(app, host=ns.host, port=ns.port, print=None)
 
 
 if __name__ == "__main__":

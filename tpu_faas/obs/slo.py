@@ -27,6 +27,14 @@ Objectives are configurable via the ``TPU_FAAS_SLO`` environment variable:
 ``name=stage:threshold_s:target`` entries, comma-separated — e.g.
 ``TPU_FAAS_SLO="fast=total:0.25:0.99,queue=queue_wait:0.1:0.95"``.
 Exposed as ``tpu_faas_slo_*`` gauges and the ``/slo`` endpoints.
+
+**Per-class objectives** (the composed-SLO plane, obs/attribution.py):
+``name=stage@class:threshold_s:target`` restricts the objective to one
+SLO class — e.g. ``inter_p999=total@interactive:0.25:0.999``. The class
+must be in the closed vocabulary (startup error otherwise), and the data
+source must expose class-restricted reads (``TPU_FAAS_OBS_CLASS`` on):
+against a class-blind source the objective honestly reports
+``source_present=0`` instead of silently judging the aggregate.
 """
 
 from __future__ import annotations
@@ -38,6 +46,8 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass
+
+from tpu_faas.obs.attribution import SLO_CLASSES
 
 #: env var carrying operator objectives (see module docstring)
 SLO_ENV = "TPU_FAAS_SLO"
@@ -56,16 +66,24 @@ class Objective:
     threshold_s: float
     #: required good fraction, e.g. 0.99 for a p99 objective
     target: float
+    #: None judges the whole distribution; a class from the closed
+    #: vocabulary (obs/attribution.py) judges that class's slice only —
+    #: the source must support class-restricted reads
+    cls: str | None = None
 
     def __post_init__(self) -> None:
         if not (0.0 < self.target < 1.0):
             raise ValueError(f"target must be in (0, 1): {self.target}")
         if not (self.threshold_s > 0 and math.isfinite(self.threshold_s)):
             raise ValueError(f"threshold must be positive: {self.threshold_s}")
+        if self.cls is not None and self.cls not in SLO_CLASSES:
+            raise ValueError(
+                f"objective class {self.cls!r} not in {SLO_CLASSES}"
+            )
 
 
 def parse_objectives(spec: str) -> list[Objective]:
-    """``name=stage:threshold_s:target`` entries, comma-separated.
+    """``name=stage[@class]:threshold_s:target`` entries, comma-separated.
     Raises ValueError with the offending entry — a typo'd objective must
     fail loudly at startup, not silently monitor nothing."""
     out: list[Objective] = []
@@ -76,13 +94,20 @@ def parse_objectives(spec: str) -> list[Objective]:
         try:
             name, rest = entry.split("=", 1)
             stage, threshold, target = rest.split(":")
+            stage = stage.strip()
+            cls: str | None = None
+            if "@" in stage:
+                stage, cls = stage.split("@", 1)
+                stage, cls = stage.strip(), cls.strip()
             out.append(
-                Objective(name.strip(), stage.strip(), float(threshold), float(target))
+                Objective(
+                    name.strip(), stage, float(threshold), float(target), cls
+                )
             )
         except ValueError as exc:
             raise ValueError(
                 f"bad {SLO_ENV} entry {entry!r} "
-                "(want name=stage:threshold_s:target)"
+                "(want name=stage[@class]:threshold_s:target)"
             ) from exc
     return out
 
@@ -205,7 +230,16 @@ class SLOTracker:
     def _cumulative(self, o: Objective) -> tuple[int, int] | None:
         """(good, total) cumulative counts for one objective, or None when
         its stage has no data source yet."""
-        snap = self._source(o.stage)
+        if o.cls is None:
+            snap = self._source(o.stage)
+        else:
+            try:
+                snap = self._source(o.stage, cls=o.cls)
+            except TypeError:
+                # class-blind source (custom wiring, class label off):
+                # a per-class objective must NOT silently judge the
+                # aggregate distribution — report source-absent instead
+                snap = None
         if snap is None:
             return None
         uppers, counts = snap
@@ -291,14 +325,17 @@ class SLOTracker:
                         ),
                         "window_covered_s": round(cov, 1),
                     }
-                out["objectives"].append(
-                    {
-                        "name": o.name,
-                        "stage": o.stage,
-                        "threshold_s": o.threshold_s,
-                        "target": o.target,
-                        "source_present": self._seen[o.name],
-                        "windows": windows,
-                    }
-                )
+                obj = {
+                    "name": o.name,
+                    "stage": o.stage,
+                    "threshold_s": o.threshold_s,
+                    "target": o.target,
+                    "source_present": self._seen[o.name],
+                    "windows": windows,
+                }
+                if o.cls is not None:
+                    # keyed only when set: class-free configs keep their
+                    # pre-attribution /slo body
+                    obj["class"] = o.cls
+                out["objectives"].append(obj)
             return out

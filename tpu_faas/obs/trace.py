@@ -42,6 +42,14 @@ import threading
 import time
 from collections import deque
 
+from tpu_faas.obs.attribution import (
+    DEFAULT_CLASS,
+    SLO_CLASSES,
+    class_label_enabled,
+    latency_buckets,
+)
+from tpu_faas.obs.metrics import LATENCY_BUCKETS
+
 #: Canonical event order (also the order ``timeline()`` reports).
 EVENTS = (
     "submitted",
@@ -109,6 +117,7 @@ class TaskTraceBook:
         active_cap: int = 65536,
         recent_cap: int = 256,
         slowest_cap: int = 32,
+        class_enabled: bool | None = None,
     ) -> None:
         self._lock = threading.Lock()
         self._active: dict[str, dict[str, float]] = {}
@@ -117,6 +126,16 @@ class TaskTraceBook:
         #: record hands it to ``on_close`` so the span plane can key its
         #: cross-process writes
         self._trace_ids: dict[str, str] = {}
+        #: TPU_FAAS_OBS_CLASS: the stage histogram grows a ``class`` label
+        #: (obs/attribution.py vocabulary). Off (default) keeps labelnames,
+        #: child set and exposition byte-identical to the two-label form.
+        self.class_enabled = (
+            class_label_enabled() if class_enabled is None else class_enabled
+        )
+        #: task_id -> SLO class, same lifecycle as ``_trace_ids`` (popped
+        #: at finish/discard/eviction); only ever populated when the class
+        #: label is on
+        self._classes: dict[str, str] = {}
         self._recent: deque[dict] = deque(maxlen=recent_cap)
         self._completed: dict[str, dict] = {}
         self._active_cap = active_cap
@@ -137,7 +156,10 @@ class TaskTraceBook:
             "outcome (COMPLETED/FAILED/CANCELLED/EXPIRED and the "
             "dispatcher-side drop reasons), so shed populations don't "
             "pollute the completed-latency distribution",
-            ("stage", "terminal"),
+            ("stage", "terminal", "class")
+            if self.class_enabled
+            else ("stage", "terminal"),
+            buckets=latency_buckets(LATENCY_BUCKETS),
         )
         self._m_dup = registry.counter(
             "tpu_faas_trace_duplicate_events_total",
@@ -149,12 +171,20 @@ class TaskTraceBook:
         )
         # pre-create every stage child (for the common outcome): the scrape
         # shows the full stage catalog (at zero) before the first task
-        # completes
+        # completes. With the class label on, the catalog spans the closed
+        # class vocabulary too — explicit zeros per class.
         for stage in STAGES:
-            self._hist.labels(stage=stage, terminal="COMPLETED")
+            if self.class_enabled:
+                for cls in SLO_CLASSES:
+                    self._hist.labels(stage, "COMPLETED", cls)
+            else:
+                self._hist.labels(stage=stage, terminal="COMPLETED")
 
     def stage_snapshot(
-        self, stage: str, terminal: str | None = "COMPLETED"
+        self,
+        stage: str,
+        terminal: str | None = "COMPLETED",
+        cls: str | None = None,
     ) -> tuple[tuple[float, ...], list[int]] | None:
         """(bucket uppers, per-bucket counts) for one stage — the SLO
         tracker's data source. COMPLETED outcomes only by default: shed
@@ -162,7 +192,19 @@ class TaskTraceBook:
         error budget — shedding under overload is intended behavior, and
         counting quick cancels as "good" would dilute real violations.
         ``terminal=None`` sums across every outcome. None for an unknown
-        stage with no series yet."""
+        stage with no series yet.
+
+        ``cls`` restricts to one SLO class. With the class label OFF a
+        class-restricted read returns None — ``sum_counts`` matches
+        positionally against however many labels a child carries, so a
+        three-element match against two-label children would silently
+        match EVERY class; None keeps per-class objectives honestly
+        reporting source-absent instead of lying with aggregate counts.
+        """
+        if cls is not None:
+            if not self.class_enabled:
+                return None
+            return self._hist.sum_counts((stage, terminal, cls))
         return self._hist.sum_counts((stage, terminal))
 
     # -- recording ---------------------------------------------------------
@@ -202,6 +244,7 @@ class TaskTraceBook:
                     evicted = next(iter(self._active))
                     self._active.pop(evicted)
                     self._trace_ids.pop(evicted, None)
+                    self._classes.pop(evicted, None)
                 events = self._active[task_id] = {}
             duplicate = event in events
             events.setdefault(event, ts)
@@ -219,6 +262,17 @@ class TaskTraceBook:
         with self._lock:
             if task_id in self._active:
                 self._trace_ids.setdefault(task_id, trace_id)
+
+    def note_class(self, task_id: str, cls: str | None) -> None:
+        """Attach the task's SLO class to an open timeline (first write
+        wins). A no-op when the class label is off or the value is
+        outside the closed vocabulary — off-vocabulary garbage must never
+        become a label value."""
+        if not self.class_enabled or cls not in SLO_CLASSES:
+            return
+        with self._lock:
+            if task_id in self._active:
+                self._classes.setdefault(task_id, cls)
 
     def note_retry(self, task_id: str) -> None:
         with self._lock:
@@ -238,6 +292,7 @@ class TaskTraceBook:
         with self._lock:
             events = self._active.pop(task_id, None)
             trace_id = self._trace_ids.pop(task_id, None)
+            cls = self._classes.pop(task_id, DEFAULT_CLASS)
             if events is None:
                 return
             already_closed = task_id in self._completed
@@ -261,9 +316,11 @@ class TaskTraceBook:
                         stages[stage] = delta
         # histogram observes OUTSIDE the book lock (the child has its own)
         for stage, delta in stages.items():
-            self._hist.labels(stage=stage, terminal=str(outcome)).observe(
-                delta
-            )
+            if self.class_enabled:
+                child = self._hist.labels(stage, str(outcome), cls)
+            else:
+                child = self._hist.labels(stage=stage, terminal=str(outcome))
+            child.observe(delta)
         record = {
             "task_id": task_id,
             "trace_id": trace_id,
@@ -273,6 +330,8 @@ class TaskTraceBook:
             "stages": {k: round(v, 6) for k, v in stages.items()},
             "complete": all(e in events for e in EVENTS),
         }
+        if self.class_enabled:
+            record["slo_class"] = cls
         with self._lock:
             self.n_completed += 1
             if len(self._recent) == self._recent.maxlen:
@@ -296,6 +355,7 @@ class TaskTraceBook:
         with self._lock:
             self._active.pop(task_id, None)
             self._trace_ids.pop(task_id, None)
+            self._classes.pop(task_id, None)
 
     # -- inspection --------------------------------------------------------
     def timeline(self, task_id: str) -> dict | None:

@@ -50,6 +50,9 @@ class HedgeEntry:
     #: the replica's own tenant inflight charge (a hedge burns the
     #: tenant's share like any dispatch), released at resolution
     tenant_row: int | None = None
+    #: the task's SLO class (obs/attribution.py), stamped at launch so
+    #: resolution can attribute the outcome per class without a re-read
+    cls: str = "default"
 
     @property
     def dispatched(self) -> bool:
