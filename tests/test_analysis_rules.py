@@ -302,6 +302,70 @@ def test_protocol_waiting_vocabulary_clean(tmp_path):
     assert run_paths([pkg]) == []
 
 
+def test_protocol_quarantine_drain_terminal_fires(tmp_path):
+    """Quarantine is a ROUTING decision: any function named for the
+    quarantine plane that calls a terminal-status writer (store surface
+    or dispatcher wrapper) turns a health policy into task loss."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        class D:
+            def _quarantine_drain(self, store, tid):
+                store.finish_task(tid, TaskStatus.FAILED, "quarantined")
+
+            def quarantine_release(self, tid):
+                self.fail_task(tid, "worker was quarantined")
+        """,
+    )
+    assert hits(findings) == [
+        ("protocol.quarantine-drain-terminal", 5),
+        ("protocol.quarantine-drain-terminal", 8),
+    ]
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_protocol_quarantine_drain_clean(tmp_path):
+    """The drain path's legitimate bookkeeping (logs, flight recorder,
+    metrics, placement-cap math) stays clean — and terminal writes in
+    functions NOT on the quarantine path are untouched by this rule."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.core.task import TaskStatus
+
+        class D:
+            def _quarantine_drain(self, row):
+                self.log.warning("row %d quarantined", row)
+                self.flightrec.emit("quarantine", row=row, action="enter")
+                self.m_quarantined.labels(state="active").set(1)
+
+            def _handle_result(self, store, tid):
+                store.finish_task(tid, TaskStatus.COMPLETED, "r")
+        """,
+    )
+    assert findings == []
+
+
+def test_protocol_quarantine_banned_set_is_derived():
+    """The banned-call set follows the live TaskStore API (plus the
+    dispatcher's named terminal wrappers) — a renamed surface drops out
+    instead of rotting as a stale string."""
+    from tpu_faas.analysis.protocol import (
+        QUARANTINE_BANNED_CALLS,
+        TERMINAL_STORE_WRITERS,
+    )
+    from tpu_faas.store.base import TaskStore
+
+    assert {
+        "finish_task", "finish_task_many", "cancel_task", "expire_task"
+    } <= TERMINAL_STORE_WRITERS
+    for name in TERMINAL_STORE_WRITERS:
+        assert hasattr(TaskStore, name)
+    assert {"fail_task", "reclaim_or_fail"} <= QUARANTINE_BANNED_CALLS
+
+
 def test_protocol_clean_fixture(tmp_path):
     """The legal surface: conveniences with legal statuses, hset without
     lifecycle fields, publish on a non-lifecycle channel, dynamic statuses

@@ -1,0 +1,87 @@
+"""Unit tests for the shared jittered-exponential backoff policy
+(tpu_faas/utils/backoff.py) — the single retry schedule behind the SDK
+overload loops, the pull worker's blob fetch, and the replica link."""
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpu_faas.utils.backoff import Backoff, BackoffPolicy
+
+
+def test_base_grows_exponentially_to_cap():
+    p = BackoffPolicy(floor_s=0.25, factor=2.0, cap_s=30.0)
+    assert p.base(0) == 0.25
+    assert p.base(1) == 0.5
+    assert p.base(2) == 1.0
+    # 0.25 * 2**7 = 32 > cap
+    assert p.base(7) == 30.0
+    assert p.base(100) == 30.0
+
+
+def test_hint_is_a_lower_bound_not_a_ceiling():
+    p = BackoffPolicy(floor_s=0.25, factor=2.0, cap_s=30.0)
+    # server asked for more than the local schedule: honor it
+    assert p.base(0, hint=5.0) == 5.0
+    # local schedule has overtaken the hint: keep growing
+    assert p.base(6, hint=5.0) == 16.0
+
+
+def test_jitter_bounds_and_determinism():
+    p = BackoffPolicy(floor_s=1.0, factor=2.0, cap_s=30.0,
+                      jitter_lo=0.8, jitter_hi=1.3)
+    rng = random.Random(7)
+    for attempt in range(6):
+        base = p.base(attempt)
+        d = p.delay(attempt, rng=rng)
+        assert base * 0.8 <= d <= base * 1.3
+    # same seed -> same sequence
+    a = [p.delay(i, rng=random.Random(42)) for i in range(5)]
+    b = [p.delay(i, rng=random.Random(42)) for i in range(5)]
+    assert a == b
+
+
+def test_unit_jitter_is_identity():
+    p = BackoffPolicy(floor_s=0.3, jitter_lo=1.0, jitter_hi=1.0)
+    assert p.delay(0) == 0.3
+    assert p.delay(1) == 0.6
+
+
+def test_clamp_bounds_base_before_jitter():
+    p = BackoffPolicy(floor_s=10.0, cap_s=30.0, jitter_lo=1.0, jitter_hi=1.0)
+    assert p.delay(0, clamp=2.5) == 2.5
+    # a negative remaining budget clamps to zero, never negative
+    assert p.delay(0, clamp=-1.0) == 0.0
+    # jitter applies to the clamped value (may exceed the clamp by at
+    # most jitter_hi - documented call-site semantics)
+    pj = BackoffPolicy(floor_s=10.0, jitter_lo=1.2, jitter_hi=1.2)
+    assert pj.delay(0, clamp=2.0) == pytest.approx(2.4)
+
+
+def test_stateful_backoff_advances_and_resets():
+    bo = Backoff(BackoffPolicy(floor_s=0.5, factor=2.0, cap_s=8.0,
+                               jitter_lo=1.0, jitter_hi=1.0))
+    assert bo.peek() == 0.5
+    assert bo.next() == 0.5
+    assert bo.peek() == 1.0
+    assert bo.next() == 1.0
+    assert bo.next() == 2.0
+    bo.reset()
+    assert bo.next() == 0.5
+
+
+def test_call_site_policies_match_pre_refactor_constants():
+    """The refactor must not change the shipped schedules."""
+    from tpu_faas.client.aio import CONNECT_BACKOFF
+    from tpu_faas.client.sdk import OVERLOAD_BACKOFF
+    from tpu_faas.store.replication import ACK_PERIOD, RECONNECT_BACKOFF
+    from tpu_faas.worker.pull_worker import _BLOB_BACKOFF
+
+    assert (OVERLOAD_BACKOFF.floor_s, OVERLOAD_BACKOFF.factor,
+            OVERLOAD_BACKOFF.cap_s) == (0.25, 2.0, 30.0)
+    assert (OVERLOAD_BACKOFF.jitter_lo, OVERLOAD_BACKOFF.jitter_hi) == (0.8, 1.3)
+    assert (CONNECT_BACKOFF.floor_s, CONNECT_BACKOFF.factor) == (0.3, 2.0)
+    assert CONNECT_BACKOFF.jitter_lo == CONNECT_BACKOFF.jitter_hi == 1.0
+    assert RECONNECT_BACKOFF.floor_s == ACK_PERIOD
+    assert _BLOB_BACKOFF.cap_s == 1.0  # liveness-bounded: see pull_worker

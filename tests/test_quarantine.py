@@ -1,0 +1,356 @@
+"""Health-scored worker quarantine (tpu_faas/sched/health.py + the
+tpu-push wiring): book policy (enter/release/canary/floors/purge),
+the misfire/reclaim health producers and the id-keyed health memory,
+the worker_place_cap tick lane (mask + canary + fused-vs-impl parity +
+single-device guard), and the dispatcher-level enter -> drain ->
+canary -> release lifecycle on fake worker rows."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_faas.sched.health import HUGE_CAP, ENTER, PURGED, QuarantineBook
+from tpu_faas.sched.health import REFUSED, RELEASE
+from tpu_faas.sched.state import SchedulerArrays, scheduler_tick_impl
+
+
+# ---------------------------------------------------------------------------
+# QuarantineBook policy
+# ---------------------------------------------------------------------------
+def _book(t, W=4, **kw):
+    defaults = dict(
+        max_workers=W, enter_below=0.35, release_above=0.8,
+        release_streak=2, canary_period_s=2.0, min_live=1,
+        min_capacity_frac=0.5, clock=lambda: t[0],
+    )
+    defaults.update(kw)
+    return QuarantineBook(**defaults)
+
+
+def test_book_enter_canary_and_release_streak():
+    t = [100.0]
+    q = _book(t)
+    health = np.ones(4, np.float32)
+    active = np.ones(4, bool)
+    procs = np.full(4, 2, np.int32)
+    health[1] = 0.2
+    assert q.update(health, active, procs) == [(ENTER, 1)]
+    assert q.is_quarantined(1) and q.quarantined_rows == (1,)
+    assert q.entered_total == 1
+    # first place_cap after enter: the row is immediately due a canary
+    cap = q.place_cap()
+    assert cap[1] == 1 and q.canaries_total == 1
+    assert all(cap[r] == HUGE_CAP for r in (0, 2, 3))
+    # inside the canary period the ceiling is a hard 0 (drained)
+    assert q.place_cap()[1] == 0
+    t[0] += 2.5
+    assert q.place_cap()[1] == 1  # next probe due
+    # release requires the score above the bar for release_streak passes
+    health[1] = 0.9
+    assert q.update(health, active, procs) == []
+    # a re-poisoned score resets the streak
+    health[1] = 0.5
+    assert q.update(health, active, procs) == []
+    health[1] = 0.9
+    assert q.update(health, active, procs) == []
+    assert q.update(health, active, procs) == [(RELEASE, 1)]
+    assert not q.is_quarantined(1) and q.released_total == 1
+    assert (np.asarray(q.place_cap()) == HUGE_CAP).all()
+
+
+def test_book_floors_refuse_rather_than_strand():
+    t = [0.0]
+    q = _book(t, W=3)
+    health = np.full(3, 0.1, np.float32)  # whole fleet looks sick
+    active = np.ones(3, bool)
+    procs = np.full(3, 2, np.int32)
+    events = q.update(health, active, procs)
+    # min_capacity_frac=0.5 of 6 slots: only ONE row may be masked; the
+    # other two enters are refused and counted, never queued
+    assert sorted(k for k, _ in events) == [ENTER, REFUSED, REFUSED]
+    assert q.entered_total == 1 and q.refused_total == 2
+    assert len(q.quarantined_rows) == 1
+    # the capacity snapshot arithmetic the serve loop publishes: the
+    # quarantined worker's slots are unavailable, the refused ones count
+    avail = active & ~q.quarantined_mask()
+    assert int(np.where(avail, procs, 0).sum()) == 4
+
+
+def test_book_min_live_floor():
+    t = [0.0]
+    q = _book(t, W=2, min_live=2, min_capacity_frac=0.0)
+    health = np.asarray([0.1, 1.0], np.float32)
+    active = np.ones(2, bool)
+    procs = np.ones(2, np.int32)
+    # masking row 0 would leave only 1 live unquarantined < min_live=2
+    assert q.update(health, active, procs) == [(REFUSED, 0)]
+    assert not q.is_quarantined(0)
+
+
+def test_book_enters_sickest_first_within_floor_budget():
+    t = [0.0]
+    q = _book(t, W=4, min_capacity_frac=0.5)
+    health = np.asarray([0.3, 0.05, 1.0, 1.0], np.float32)
+    active = np.ones(4, bool)
+    procs = np.ones(4, np.int32)
+    events = q.update(health, active, procs)
+    # budget admits two of the two candidates here (2/4 left = 0.5);
+    # the sickest row transitions first
+    assert events[0] == (ENTER, 1)
+    assert (ENTER, 0) in events
+
+
+def test_book_purges_inactive_rows_without_release_accounting():
+    t = [0.0]
+    q = _book(t)
+    health = np.asarray([0.1, 1.0, 1.0, 1.0], np.float32)
+    active = np.ones(4, bool)
+    procs = np.ones(4, np.int32)
+    q.update(health, active, procs)
+    assert q.is_quarantined(0)
+    active[0] = False  # liveness purged the worker; row will recycle
+    events = q.update(health, active, procs)
+    assert (PURGED, 0) in events
+    # a purge is not a recovery: released_total stays 0 (the id-keyed
+    # health memory carries the penalty to the worker's next identity)
+    assert q.released_total == 0 and not q.is_quarantined(0)
+
+
+# ---------------------------------------------------------------------------
+# health producers + id-keyed memory (SchedulerArrays)
+# ---------------------------------------------------------------------------
+def _arrays(t, W=4):
+    return SchedulerArrays(
+        max_workers=W, max_pending=8, max_inflight=16, clock=lambda: t[0]
+    )
+
+
+def test_misfire_and_reclaim_decay_with_floor():
+    t = [100.0]
+    a = _arrays(t)
+    r0 = a.register(b"w0", 2)
+    a.note_misfire(r0)
+    assert a.worker_health[r0] == pytest.approx(a.MISFIRE_DECAY)
+    a.note_reclaim(r0)
+    assert a.worker_health[r0] == pytest.approx(
+        a.MISFIRE_DECAY * a.RECLAIM_DECAY
+    )
+    # a misfire burst is capped (one RESULT can report many respawns)
+    a.register(b"w1", 2)
+    a.note_misfire(1, n_new=100)
+    assert a.worker_health[1] >= a.HEALTH_FLOOR
+    for _ in range(50):
+        a.note_reclaim(r0)
+    assert a.worker_health[r0] == pytest.approx(a.HEALTH_FLOOR)
+    # inactive / out-of-range rows are ignored
+    a.deactivate(1)
+    a.note_misfire(1)
+    a.note_reclaim(-1)
+    a.note_reclaim(99)
+    assert a.worker_health[1] == pytest.approx(a.HEALTH_FLOOR, abs=0.3)
+
+
+def test_health_memory_survives_reregistration():
+    """Die-and-come-back must not launder the penalty: remember_health
+    stashes the score under the worker's stable identity at purge,
+    recall_health re-applies it (with elapsed-time recovery credit) when
+    that identity registers again — on whatever row it lands."""
+    t = [100.0]
+    a = _arrays(t)
+    r0 = a.register(b"flappy", 2)
+    for _ in range(5):
+        a.note_reclaim(r0)
+    sick = float(a.worker_health[r0])
+    a.remember_health(b"tok-1", r0)
+    a.deactivate(r0)
+    # re-register later on a fresh row: register() wipes to 1.0, recall
+    # re-applies the remembered penalty plus recovery for the absence
+    t[0] += a.HEALTH_RECOVERY_TAU
+    r_new = a.register(b"flappy2", 2)
+    assert a.worker_health[r_new] == 1.0
+    a.recall_health(b"tok-1", r_new)
+    expect = sick + (1.0 - sick) * (1.0 - math.exp(-1.0))
+    assert float(a.worker_health[r_new]) == pytest.approx(expect, abs=1e-3)
+    # the entry is consumed: a second recall is a no-op
+    a.worker_health[r_new] = 1.0
+    a.recall_health(b"tok-1", r_new)
+    assert a.worker_health[r_new] == 1.0
+
+
+def test_health_memory_skips_healthy_and_stays_bounded():
+    t = [100.0]
+    a = _arrays(t)
+    r0 = a.register(b"w0", 2)
+    a.remember_health(b"healthy", r0)  # score 1.0: nothing worth keeping
+    assert b"healthy" not in a.health_memory
+    a.note_reclaim(r0)
+    for i in range(a.HEALTH_MEMORY_MAX + 5):
+        a.remember_health(b"id-%d" % i, r0)
+    assert len(a.health_memory) == a.HEALTH_MEMORY_MAX
+
+
+# ---------------------------------------------------------------------------
+# worker_place_cap tick lane
+# ---------------------------------------------------------------------------
+def test_place_cap_masks_quarantined_and_canary_admits_one():
+    t = [100.0]
+    a = _arrays(t, W=3)
+    for i in range(3):
+        a.register(b"w%d" % i, 2)
+    a.tick(np.zeros(0, dtype=np.float32))  # seed prev_live
+    sizes = np.ones(3, dtype=np.float32)
+    cap = np.asarray([0, HUGE_CAP, HUGE_CAP], np.int32)
+    out = a.tick(sizes, worker_place_cap=cap)
+    asg = np.asarray(out.assignment)[:3]
+    assert (asg >= 0).all() and not (asg == 0).any()
+    # canary ceiling: exactly ONE task may land on the quarantined row
+    a2 = _arrays(t, W=3)
+    for i in range(3):
+        a2.register(b"v%d" % i, 2)
+    a2.tick(np.zeros(0, dtype=np.float32))
+    out = a2.tick(
+        sizes, worker_place_cap=np.asarray([1, 0, 0], np.int32)
+    )
+    asg = np.asarray(out.assignment)[:3]
+    assert int((asg == 0).sum()) == 1
+    assert int((asg >= 0).sum()) == 1  # everyone else is masked
+
+
+def test_place_cap_parity_fused_vs_impl():
+    """The jitted packed tick and the un-jitted scheduler_tick_impl twin
+    agree on placements under a ceiling (the PR 13/15 parity rule: every
+    optional lane proves its twin)."""
+    t = [100.0]
+    a = _arrays(t, W=3)
+    rows = [a.register(b"w%d" % i, 2) for i in range(3)]
+    a.tick(np.zeros(0, dtype=np.float32))
+    sizes = np.asarray([1.0, 1.0, 1.0, 1.0], np.float32)
+    cap = np.asarray([1, 0, HUGE_CAP], np.int32)
+    out_fused = a.tick(sizes, worker_place_cap=cap)
+    T = a.max_pending
+    padded = np.zeros(T, np.float32)
+    padded[:4] = sizes
+    valid = np.zeros(T, bool)
+    valid[:4] = True
+    out_impl = scheduler_tick_impl(
+        jnp.asarray(padded),
+        jnp.asarray(valid),
+        jnp.asarray(a.worker_speed),
+        jnp.asarray(a.worker_procs),
+        jnp.asarray(a.worker_active),
+        jnp.zeros(3, jnp.float32),
+        jnp.ones(3, bool),
+        jnp.asarray(np.asarray(a.inflight_worker, np.int32)),
+        jnp.float32(a.time_to_expire),
+        max_slots=a.max_slots,
+        worker_place_cap=jnp.asarray(cap),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_fused.assignment)[:4],
+        np.asarray(out_impl.assignment)[:4],
+    )
+    asg = np.asarray(out_impl.assignment)[:4]
+    assert int((asg == rows[0]).sum()) <= 1  # canary ceiling held
+    assert not (asg == rows[1]).any()        # drained row untouched
+
+
+def test_place_cap_refused_on_sharded_fleets():
+    t = [100.0]
+    a = _arrays(t, W=2)
+    a.register(b"w0", 2)
+    a.mesh = object()  # stand-in: the guard must fire before any tick
+    with pytest.raises(ValueError, match="single-device"):
+        a.tick(
+            np.ones(1, np.float32),
+            worker_place_cap=np.asarray([0, 0], np.int32),
+        )
+
+
+# ---------------------------------------------------------------------------
+# dispatcher lifecycle (fake worker rows, no sockets)
+# ---------------------------------------------------------------------------
+def _quarantine_dispatcher(clock, **kw):
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.store import MemoryStore
+
+    defaults = dict(
+        ip="127.0.0.1", port=0, store=MemoryStore(),
+        max_workers=8, max_pending=64, max_inflight=128, max_slots=2,
+        tick_period=0.01, time_to_expire=1000.0, clock=clock,
+        estimate_runtimes=False, quarantine=True,
+    )
+    defaults.update(kw)
+    return TpuPushDispatcher(**defaults)
+
+
+def test_dispatcher_quarantine_enter_drain_release():
+    t = [100.0]
+    disp = _quarantine_dispatcher(lambda: t[0])
+    try:
+        a = disp.arrays
+        rows = [a.register(b"w%d" % i, 2) for i in range(3)]
+        q = disp.quarantine
+        assert q is not None and disp._health_on
+        # sicken row 0 past the default enter bar (0.35)
+        for _ in range(6):
+            a.note_reclaim(rows[0])
+        disp.tick(intake=False)
+        assert q.is_quarantined(rows[0])
+        assert disp.stats()["quarantine"]["entered_total"] == 1
+        # recovery: long quiet absence snaps health back to 1.0; the
+        # release streak then drains over the next passes
+        t[0] += 100.0
+        for _ in range(q.release_streak + 1):
+            disp.tick(intake=False)
+        assert not q.is_quarantined(rows[0])
+        assert disp.stats()["quarantine"]["released_total"] == 1
+        # the lifecycle left a flight-recorder trail
+        kinds = [
+            (e["kind"], e.get("action"))
+            for e in disp.flightrec.snapshot()["events"]
+        ]
+        assert ("quarantine", "enter") in kinds
+        assert ("quarantine", "release") in kinds
+    finally:
+        disp.close()
+
+
+def test_dispatcher_quarantine_off_is_inert():
+    t = [100.0]
+    disp = _quarantine_dispatcher(lambda: t[0], quarantine=False)
+    try:
+        assert disp.quarantine is None
+        assert disp.stats()["quarantine"] is None
+        disp.tick(intake=False)  # no place_cap lane reaches the tick
+    finally:
+        disp.close()
+
+
+def test_dispatcher_quarantine_refused_on_sharded_modes():
+    t = [100.0]
+    with pytest.raises(ValueError, match="single-device"):
+        _quarantine_dispatcher(lambda: t[0], multihost="2/0/tcp://x:1")
+
+
+def test_dispatcher_misfire_delta_feeds_health():
+    t = [100.0]
+    disp = _quarantine_dispatcher(lambda: t[0])
+    try:
+        a = disp.arrays
+        row = a.register(b"w0", 2)
+        wid = a.row_ids[row]
+        disp.note_worker_misfires(wid, {"misfires": 2})
+        assert a.worker_health[row] == pytest.approx(a.MISFIRE_DECAY ** 2)
+        # cumulative counter: only the DELTA decays on the next report
+        disp.note_worker_misfires(wid, {"misfires": 3})
+        assert a.worker_health[row] == pytest.approx(a.MISFIRE_DECAY ** 3)
+        # replayed totals are not fresh evidence
+        disp.note_worker_misfires(wid, {"misfires": 3})
+        assert a.worker_health[row] == pytest.approx(a.MISFIRE_DECAY ** 3)
+    finally:
+        disp.close()

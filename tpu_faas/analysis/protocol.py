@@ -39,7 +39,14 @@ reach through the API used:
   (``raw-blob-write``): blobs are create-once content — writes must go
   through ``put_blob`` (setnx'd data field + TTL stamp), which the
   runtime monitor validates against the digest; deletes belong to the
-  gateway sweeper's reference-checked GC, whose key lists are dynamic.
+  gateway sweeper's reference-checked GC, whose key lists are dynamic;
+- a function on the quarantine drain path (any def whose name mentions
+  ``quarantine``) may never call a terminal-status writer
+  (``quarantine-drain-terminal``): quarantine is a ROUTING decision —
+  the masked worker's in-flight tasks are still live and must complete
+  or reclaim through the ordinary paths; a terminal write here turns a
+  health policy into task loss. The banned-call set is derived from the
+  live TaskStore API plus the dispatcher's named terminal wrappers.
 
 The legal-status sets are DERIVED from ``racecheck._LEGAL`` and
 ``TaskStatus`` at import time, not copied: if the protocol grows a status or
@@ -58,7 +65,12 @@ from tpu_faas.core.task import (
     FIELD_STATUS,
     TaskStatus,
 )
-from tpu_faas.store.base import BLOB_PREFIX, RESULTS_CHANNEL, TASKS_CHANNEL
+from tpu_faas.store.base import (
+    BLOB_PREFIX,
+    RESULTS_CHANNEL,
+    TASKS_CHANNEL,
+    TaskStore,
+)
 from tpu_faas.store.racecheck import _LEGAL
 
 #: All spellable statuses.
@@ -79,6 +91,25 @@ _STATUS_FIELD_STRINGS = frozenset({FIELD_STATUS, FIELD_RESULT})
 #: Channel spellings whose raw publish bypasses the store conveniences.
 _TASK_CHANNEL_NAMES = frozenset({"TASKS_CHANNEL", "RESULTS_CHANNEL"})
 _TASK_CHANNEL_STRINGS = frozenset({TASKS_CHANNEL, RESULTS_CHANNEL})
+
+#: Store surfaces that can stamp a terminal status — DERIVED by probing the
+#: candidate spellings against the live TaskStore API (a renamed or removed
+#: surface drops out automatically, like the legal-status sets above).
+_TERMINAL_WRITER_CANDIDATES = (
+    "finish_task", "finish_task_many", "cancel_task", "expire_task",
+)
+TERMINAL_STORE_WRITERS: frozenset[str] = frozenset(
+    n for n in _TERMINAL_WRITER_CANDIDATES if hasattr(TaskStore, n)
+)
+#: Dispatcher-side wrappers over those surfaces (dispatch/base.py fail_task
+#: and the FAIL branch of reclaim_or_fail) — named here rather than probed
+#: because importing the dispatch package would drag zmq into every
+#: analysis run.
+_DISPATCH_TERMINAL_WRAPPERS = frozenset({"fail_task", "reclaim_or_fail"})
+#: The quarantine drain path may call none of these.
+QUARANTINE_BANNED_CALLS: frozenset[str] = (
+    TERMINAL_STORE_WRITERS | _DISPATCH_TERMINAL_WRAPPERS
+)
 
 
 def _status_literal(node: ast.AST) -> str | None:
@@ -129,6 +160,10 @@ class ProtocolChecker(Checker):
     def check(self, module: Module) -> Iterable[Finding]:
         store_internal = _in_store_package(module)
         for node in ast.walk(module.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and "quarantine" in node.name:
+                yield from self._check_quarantine_drain(module, node)
             if not isinstance(node, ast.Call):
                 continue
             method = (
@@ -153,6 +188,37 @@ class ProtocolChecker(Checker):
                 yield from self._check_raw_publish(module, node)
 
     # -- individual rules --------------------------------------------------
+    def _check_quarantine_drain(
+        self, module: Module, fn: ast.FunctionDef
+    ) -> Iterator[Finding]:
+        """No terminal-status write may originate on the quarantine drain
+        path. A quarantined worker's in-flight tasks are still LIVE — they
+        complete on the worker or ride the ordinary liveness reclaim —
+        so any function named for the quarantine plane that calls a
+        terminal writer has turned a routing decision into task loss."""
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            method = (
+                node.func.attr
+                if isinstance(node.func, ast.Attribute)
+                else (node.func.id if isinstance(node.func, ast.Name) else None)
+            )
+            if method in QUARANTINE_BANNED_CALLS:
+                yield self.finding(
+                    module,
+                    node,
+                    "quarantine-drain-terminal",
+                    "error",
+                    f"{method} called inside quarantine-path function "
+                    f"{fn.name!r}: quarantine drain must never write a "
+                    f"terminal task status — the masked worker's in-flight "
+                    f"tasks are still live (they complete or reclaim "
+                    f"through the ordinary paths); a terminal write here "
+                    f"turns a health-routing decision into task loss "
+                    f"(banned: {', '.join(sorted(QUARANTINE_BANNED_CALLS))})",
+                )
+
     def _arg(self, call: ast.Call, index: int, keyword: str) -> ast.AST | None:
         if len(call.args) > index:
             return call.args[index]

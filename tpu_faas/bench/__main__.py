@@ -28,7 +28,9 @@ def main(argv: list[str] | None = None) -> None:
         "16 (tenant fairness: isolation + weighted shares), "
         "17 (batched data plane: TASK_BATCH/bundles vs per-task wire), "
         "18 (tail hedging: straggler speculation vs an injected sick "
-        "worker), or 'all'",
+        "worker), 19 (composed tail-SLO: every opt-in plane at once), "
+        "20 (chaos scenario: seeded fault plane + health-scored "
+        "quarantine), or 'all'",
     )
     ap.add_argument(
         "-m", "--mode", default="push",

@@ -3825,6 +3825,363 @@ def config_19_composed_slo() -> dict:
                 os.environ[k] = v
 
 
+# -- config 20: chaos scenario runner (fault plane + quarantine) -------------
+
+
+def _chaos_spawn_worker(n_procs: int, url: str, chaos_spec: str | None):
+    """One push-worker subprocess with an explicit per-process chaos spec.
+
+    ``cpu_worker_env()`` inherits the bench process's environment — which
+    config 20 arms with DISPATCHER-side chaos — so the worker's
+    TPU_FAAS_CHAOS is always overridden here: cleared for healthy
+    workers, set to the gray-failure spec for the victim."""
+    import subprocess
+    import sys as _sys
+
+    from tpu_faas.bench.harness import REPO, cpu_worker_env
+    from tpu_faas.chaos import ENV_VAR
+
+    env = cpu_worker_env()
+    env.pop(ENV_VAR, None)
+    if chaos_spec:
+        env[ENV_VAR] = chaos_spec
+    return subprocess.Popen(
+        [_sys.executable, "-m", "tpu_faas.worker.push_worker",
+         str(n_procs), url, "--hb", "--hb-period", "0.3"],
+        env=env, cwd=REPO, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _chaos_stack(
+    n_workers: int,
+    n_procs: int,
+    gray_ms: int,
+    gray_until_s: int,
+    seed: int,
+):
+    """Full real stack for the chaos lane: store server, gateway,
+    tpu-push with speculation AND quarantine on, N push-worker
+    subprocesses with worker 0 gray-failing (chaos exec.slow stalls its
+    intake for the first ``gray_until_s`` seconds of its life, then the
+    window closes and the worker is healthy again).
+
+    The caller must already hold TPU_FAAS_CHAOS armed with the
+    dispatcher-side spec — the store clients and the dispatcher wire
+    read it at construction."""
+    import threading as _threading
+
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+
+    handle = start_store_thread()
+    gw = start_gateway_thread(make_store(handle.url), admission=False)
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(handle.url),
+        max_workers=max(16, n_workers),
+        max_pending=1024,
+        max_inflight=1024,
+        max_slots=n_procs,
+        tick_period=0.005,
+        # liveness must NOT be what catches the gray worker: with a 60 s
+        # horizon the heartbeat path never fires inside the scenario, so
+        # a quarantine transition is provably the health plane's doing
+        time_to_expire=60.0,
+        # pin predictions to the client cost hints (config 18's rule):
+        # the injected stall is the one variable under test
+        estimate_runtimes=False,
+        speculate_mult=3.0,
+        speculate_max_frac=0.5,
+        speculate_min_s=0.02,
+        quarantine=True,
+        # bench-speed thresholds: two lost hedge races (0.8^2 = 0.64)
+        # put the gray row under the enter bar — the gray worker's
+        # stalled slots throttle how fast it can accumulate evidence, so
+        # the bar must be reachable from a handful of races; release
+        # needs the score back over 0.8
+        quarantine_enter=0.7,
+        quarantine_release=0.8,
+        quarantine_canary_s=0.5,
+    )
+    # instance shadow of the class constant: the production 30 s recovery
+    # tau would stretch this scenario past any CI budget; 3 s keeps the
+    # release transition inside the run without touching the policy
+    disp.arrays.HEALTH_RECOVERY_TAU = 3.0
+    disp_thread = _threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    gray_spec = (
+        f"seed={seed};exec.slow:ms={gray_ms}:p=1:until={gray_until_s}"
+    )
+    workers = [
+        _chaos_spawn_worker(n_procs, url, gray_spec if i == 0 else None)
+        for i in range(n_workers)
+    ]
+    return gw, disp, disp_thread, workers, handle, gray_spec
+
+
+def _chaos_scrapes(gw, disp) -> dict:
+    """Strict-grammar /metrics from every serving process: the chaos lane
+    requires the injection counter, the quarantine state family and the
+    health family on the dispatcher surface."""
+    import requests as _requests
+
+    from tpu_faas.obs.expofmt import parse_exposition, require_series
+
+    out: dict = {"scrape_ok": True, "missing": [], "error": ""}
+    try:
+        srv = disp.serve_stats(0)
+        port = srv.server_address[1]
+        dfams = parse_exposition(
+            _requests.get(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).text
+        )
+        out["missing"] = require_series(
+            dfams,
+            [
+                "tpu_faas_chaos_injected_total",
+                "tpu_faas_worker_quarantined",
+                "tpu_faas_worker_health",
+                "tpu_faas_dispatcher_hedges_total",
+                "tpu_faas_dispatcher_tasks_dispatched_total",
+            ],
+        )
+        fam = dfams.get("tpu_faas_chaos_injected_total")
+        out["scraped_injections"] = (
+            int(sum(s.value for s in fam.samples)) if fam else 0
+        )
+        qfam = dfams.get("tpu_faas_worker_quarantined")
+        out["quarantine_series"] = (
+            {s.labels["state"]: int(s.value) for s in qfam.samples}
+            if qfam
+            else {}
+        )
+        gfams = parse_exposition(
+            _requests.get(f"{gw.url}/metrics", timeout=10).text
+        )
+        out["missing"] += require_series(
+            gfams, ["tpu_faas_gateway_safety_poll_served_total"]
+        )
+        out["scrape_ok"] = not out["missing"]
+    except Exception as exc:
+        out["scrape_ok"] = False
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
+def config_20_chaos_quarantine() -> dict:
+    """Chaos scenario runner (config 20): the full real stack under a
+    seeded fault schedule, proving the PR's two halves together.
+
+    The schedule: worker 0 gray-fails — chaos ``exec.slow`` stalls its
+    intake thread per task while its heartbeats keep flowing — for the
+    first ``gray_until_s`` seconds of its life, then recovers. The
+    dispatcher process itself runs under chaos too (store round-trip
+    latency + held wire frames), so the control plane is exercised dirty,
+    not clean. Speculation hedges the stalled tasks; every lost race
+    decays the gray row's health score; the quarantine book trips, drains
+    the row (placement ceiling 0), probes it with canary tasks
+    (ceiling 1), and releases it once the window closes and the score
+    recovers.
+
+    Asserted: ZERO admitted-task loss (every submitted handle reaches a
+    result — the reclaim/hedge machinery absorbs every injection),
+    quarantine entered BEFORE any liveness purge (the gray worker's row
+    still active and its process alive at the enter transition — health
+    beat heartbeat lapse by design: the horizon is 60 s, the enter fires
+    in single-digit seconds), bounded recovery (a release transition
+    observed inside the run), and strict /metrics scrapes carrying the
+    injection counter and the quarantine state family.
+
+    Shape via TPU_FAAS_BENCH_CHAOS_SHAPE =
+    "tasks,workers,procs,task_ms,gray_ms,gray_until_s,seed"
+    (default "160,3,2,25,600,8,20")."""
+    import os
+    import threading as _threading
+
+    from tpu_faas import chaos as chaos_mod
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.core.serialize import serialize
+    from tpu_faas.workloads import straggler_sleep
+
+    shape = os.environ.get(
+        "TPU_FAAS_BENCH_CHAOS_SHAPE", "160,3,2,25,600,8,20"
+    )
+    (
+        n_tasks, n_workers, n_procs, task_ms, gray_ms, gray_until_s, seed,
+    ) = (int(x) for x in shape.split(","))
+    task_s = task_ms / 1e3
+    disp_spec = (
+        f"seed={seed};store.latency:ms=2:p=0.1,wire.delay:ms=5:p=0.05"
+    )
+    saved = os.environ.get(chaos_mod.ENV_VAR)
+    os.environ[chaos_mod.ENV_VAR] = disp_spec
+    chaos_mod._reset_for_tests()
+    stack = None
+    try:
+        stack = _chaos_stack(
+            n_workers, n_procs, gray_ms, gray_until_s, seed
+        )
+        gw, disp, disp_thread, workers, handle, gray_spec = stack[:6]
+        plan = chaos_mod.from_env()  # the serving process's armed plan
+        time.sleep(1.5)  # workers register (gray window is already open)
+        c = FaaSClient(gw.url)
+        fid = c.register_payload(
+            "straggler_sleep", serialize(straggler_sleep)
+        )
+        # warmup outside the window: pool spawn + first dill decode on
+        # every worker (the gray one's stall is paid per task here too)
+        for h in c.submit_many(
+            fid, [(((0.001,), {}))] * (n_workers * n_procs)
+        ):
+            h.result(timeout=120.0)
+        t0 = time.perf_counter()
+        handles = c.submit_many(
+            fid,
+            [(((task_s,), {}))] * n_tasks,
+            costs=[task_s] * n_tasks,
+            speculative=True,
+        )
+        # inf sentinel (config 18's rule): a lost task must poison the
+        # percentiles, never contribute a flattering 0.0
+        lat = [float("inf")] * n_tasks
+        errs: list[str] = []
+
+        def waiter(i, h):
+            try:
+                h.result(timeout=120.0)
+                lat[i] = time.perf_counter() - t0
+            except Exception as exc:
+                errs.append(f"{h.task_id}: {type(exc).__name__}")
+
+        threads = [
+            _threading.Thread(target=waiter, args=(i, h), daemon=True)
+            for i, h in enumerate(handles)
+        ]
+        for th in threads:
+            th.start()
+        # scenario observer: record the quarantine transitions as they
+        # happen, and keep a steady probe trickle flowing for the whole
+        # scenario — BEFORE the enter it is the placement pressure that
+        # re-fills the gray worker's slots as hedges resolve (each
+        # re-placement is another lost race, i.e. fresh health
+        # evidence); AFTER the enter it is what canary ticks carry
+        q = disp.quarantine
+        first_enter = first_release = None
+        active_at_enter: int | None = None
+        gray_alive_at_enter: bool | None = None
+        trickle: list = []
+        last_trickle = 0.0
+        deadline = t0 + gray_until_s + 25.0
+        while time.perf_counter() < deadline:
+            now = time.perf_counter()
+            if first_enter is None and q.entered_total > 0:
+                first_enter = now - t0
+                # the proof the ISSUE asks for: at the enter transition
+                # the gray worker is still a LIVE fleet member — no row
+                # purged, its process up — so quarantine beat liveness
+                active_at_enter = int(disp.arrays.worker_active.sum())
+                gray_alive_at_enter = workers[0].poll() is None
+            if first_enter is not None and q.released_total > 0:
+                first_release = now - t0
+                break
+            if now - last_trickle > 0.1:
+                trickle.append(
+                    c.submit_with(
+                        fid, (task_s,), cost=task_s, speculative=True
+                    )
+                )
+                last_trickle = now
+            time.sleep(0.05)
+        for th in threads:
+            th.join(timeout=130.0)
+        trickle_errs: list[str] = []
+        trickle_done = 0
+        for h in trickle:
+            try:
+                h.result(timeout=60.0)
+                trickle_done += 1
+            except Exception as exc:
+                trickle_errs.append(type(exc).__name__)
+        finished = np.asarray([v for v in lat if v != float("inf")])
+        if not len(finished):
+            finished = np.asarray([0.0])
+        qs = q.stats()
+        inj = {
+            f"{site}.{kind}": int(v)
+            for (site, kind), v in sorted(
+                (plan.counts if plan is not None else {}).items()
+            )
+        }
+        stats = disp.stats()
+        admitted = n_tasks + len(trickle)
+        completed = (n_tasks - len(errs)) + trickle_done
+        row = {
+            "config": "chaos-quarantine",
+            "shape": {
+                "tasks": n_tasks,
+                "workers": n_workers,
+                "procs": n_procs,
+                "task_ms": task_ms,
+                "gray_ms": gray_ms,
+                "gray_until_s": gray_until_s,
+                "seed": seed,
+            },
+            "host_cores": os.cpu_count(),
+            "chaos": {
+                "dispatcher_spec": disp_spec,
+                "gray_worker_spec": gray_spec,
+                "dispatcher_injections": inj,
+                "injected_total": int(sum(inj.values())),
+            },
+            "admitted": admitted,
+            "completed": completed,
+            "errors": errs + trickle_errs,
+            "zero_admitted_loss": completed == admitted,
+            "p50_ms": round(float(np.percentile(finished, 50)) * 1e3, 1),
+            "p99_ms": round(float(np.percentile(finished, 99)) * 1e3, 1),
+            "p999_ms": round(
+                float(np.percentile(finished, 99.9)) * 1e3, 1
+            ),
+            "quarantine": qs,
+            "quarantine_entered": qs["entered_total"] >= 1,
+            "quarantine_released": qs["released_total"] >= 1,
+            "time_to_quarantine_s": (
+                None if first_enter is None else round(first_enter, 2)
+            ),
+            "time_to_release_s": (
+                None if first_release is None else round(first_release, 2)
+            ),
+            "entered_before_liveness": bool(
+                gray_alive_at_enter and active_at_enter == n_workers
+            ),
+            "worker_health": stats.get("worker_health"),
+            "speculation": stats.get("speculation"),
+        }
+        row.update(_chaos_scrapes(gw, disp))
+        row["verdict_pass"] = bool(
+            row["zero_admitted_loss"]
+            and row["quarantine_entered"]
+            and row["quarantine_released"]
+            and row["entered_before_liveness"]
+            and row["chaos"]["injected_total"] > 0
+            and row["scrape_ok"]
+        )
+        return row
+    finally:
+        if stack is not None:
+            _tail_teardown(*stack[:5])
+        if saved is None:
+            os.environ.pop(chaos_mod.ENV_VAR, None)
+        else:
+            os.environ[chaos_mod.ENV_VAR] = saved
+        chaos_mod._reset_for_tests()
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -3845,4 +4202,5 @@ CONFIGS = {
     "17": config_17_batched_plane,
     "18": config_18_tail_hedging,
     "19": config_19_composed_slo,
+    "20": config_20_chaos_quarantine,
 }

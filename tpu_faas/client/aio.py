@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
-import random
 import uuid
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -21,6 +20,7 @@ from typing import Any, Callable
 import aiohttp
 
 from tpu_faas.client.sdk import (
+    OVERLOAD_BACKOFF,  # shared 429/503 schedule: sync and async must agree
     TaskCancelledError,
     TaskDependencyError,
     TaskExpiredError,
@@ -31,6 +31,15 @@ from tpu_faas.client.sdk import (
 )
 from tpu_faas.core.executor import pack_params
 from tpu_faas.obs.tracectx import new_trace_id
+from tpu_faas.utils.backoff import Backoff, BackoffPolicy
+
+#: Connection-establishment retries: deterministic doubling from 0.3 s
+#: (no jitter — these are budget-clamped by the caller's deadline, and
+#: a lone client reconnecting to a restarting gateway has no thundering
+#: herd to spread).
+CONNECT_BACKOFF = BackoffPolicy(
+    floor_s=0.3, factor=2.0, cap_s=30.0, jitter_lo=1.0, jitter_hi=1.0
+)
 
 
 @dataclass
@@ -167,42 +176,39 @@ class AsyncFaaSClient:
         give_up_at = (
             loop.time() + retry_budget if retry_budget is not None else None
         )
-        delay = 0.3
-        attempt = 0
-        overload_attempt = 0
-        floor = 0.25
+        connect_bo = Backoff(CONNECT_BACKOFF)
+        overload_bo = Backoff(OVERLOAD_BACKOFF)
         while True:
             try:
                 async with self.http.request(method, url, **kw) as r:
                     if (
                         retry_overload
                         and r.status in (429, 503)
-                        and overload_attempt < self.overload_retries
+                        and overload_bo.attempt < self.overload_retries
                     ):
-                        pause = max(_retry_after_s(r, floor), floor)
-                        if give_up_at is not None:
-                            pause = min(
-                                pause, max(0.0, give_up_at - loop.time())
-                            )
-                        overload_attempt += 1
-                        floor = min(floor * 2, 30.0)
                         await asyncio.sleep(
-                            pause * random.uniform(0.8, 1.3)
+                            overload_bo.next(
+                                hint=_retry_after_s(r, overload_bo.peek()),
+                                clamp=(
+                                    give_up_at - loop.time()
+                                    if give_up_at is not None
+                                    else None
+                                ),
+                            )
                         )
                         continue
                     yield r
                 return
             except aiohttp.ClientConnectorError:
-                if attempt >= self.connect_retries:
+                if connect_bo.attempt >= self.connect_retries:
                     raise
                 if give_up_at is not None:
                     remaining = give_up_at - loop.time()
                     if remaining <= 0:
                         raise
-                    delay = min(delay, remaining)
-                attempt += 1
-                await asyncio.sleep(delay)
-                delay *= 2
+                    await asyncio.sleep(connect_bo.next(clamp=remaining))
+                else:
+                    await asyncio.sleep(connect_bo.next())
 
     @property
     def http(self) -> aiohttp.ClientSession:

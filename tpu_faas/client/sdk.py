@@ -8,7 +8,6 @@ the raw wire format identical so hand-rolled clients interoperate.
 
 from __future__ import annotations
 
-import random
 import time
 import uuid
 import weakref
@@ -23,6 +22,7 @@ from tpu_faas.core.payload import payload_digest
 from tpu_faas.core.serialize import deserialize, serialize
 from tpu_faas.core.task import DEP_FAILED_PREFIX, TaskStatus
 from tpu_faas.obs.tracectx import new_trace_id
+from tpu_faas.utils.backoff import Backoff, BackoffPolicy
 
 
 class _FnMemo:
@@ -145,6 +145,13 @@ class TaskExpiredError(Exception):
 #: (or a misconfigured proxy) puts in Retry-After — an hour-scale header
 #: must not hang a submit() thread for an hour.
 _RETRY_AFTER_CAP_S = 30.0
+
+#: Overload (429/503) retry schedule, shared verbatim with the async
+#: SDK: 0.25 s floor doubling to a 30 s cap, multiplicative jitter so a
+#: rejected burst doesn't re-arrive as the same synchronized burst.
+OVERLOAD_BACKOFF = BackoffPolicy(
+    floor_s=0.25, factor=2.0, cap_s=30.0, jitter_lo=0.8, jitter_hi=1.3
+)
 
 
 def _retry_after_s(response, default: float) -> float:
@@ -325,21 +332,18 @@ class FaaSClient:
     def _post_submit(self, url: str, body: dict) -> requests.Response:
         """POST a submit with overload backoff: 429/503 replies are
         retried up to ``overload_retries`` times, sleeping the server's
-        ``Retry-After`` (or a growing local floor when absent) with
-        multiplicative jitter so a rejected burst doesn't re-arrive as
-        the same synchronized burst. Safe for submits because every
-        retried body carries an idempotency key (auto-minted when the
-        caller gave none) — the re-send addresses the same task record.
-        The final reject is returned (not raised): callers keep their
-        raise_for_status semantics."""
-        floor = 0.25
+        ``Retry-After`` (or the ``OVERLOAD_BACKOFF`` schedule when
+        absent) with multiplicative jitter. Safe for submits because
+        every retried body carries an idempotency key (auto-minted when
+        the caller gave none) — the re-send addresses the same task
+        record. The final reject is returned (not raised): callers keep
+        their raise_for_status semantics."""
+        bo = Backoff(OVERLOAD_BACKOFF)
         for attempt in range(self.overload_retries + 1):
             r = self.http.post(url, json=body)
             if r.status_code not in (429, 503) or attempt == self.overload_retries:
                 return r
-            pause = max(_retry_after_s(r, floor), floor)
-            time.sleep(pause * random.uniform(0.8, 1.3))
-            floor = min(floor * 2, 30.0)
+            time.sleep(bo.next(hint=_retry_after_s(r, bo.peek())))
         return r
 
     # -- raw endpoints (wire format identical to SURVEY §0.1) --------------

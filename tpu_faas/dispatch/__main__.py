@@ -312,6 +312,45 @@ def main(argv: list[str] | None = None) -> None:
         "never flagged however tight its prediction (scheduling jitter "
         "on tiny tasks must not hedge)",
     )
+    ap.add_argument(
+        "--quarantine", action="store_true",
+        help="tpu-push: turn on the quarantine plane (sched/health.py) — "
+        "a worker whose health score (decayed by hedge losses, pool-child "
+        "misfires and liveness reclaims) falls past the enter threshold "
+        "is drained (no new placements; in-flight tasks complete or "
+        "reclaim normally), probed with canary tasks, and released when "
+        "the score recovers. Hard floors (--quarantine-min-live / "
+        "--quarantine-min-capacity) refuse any quarantine that would "
+        "strand the fleet. Single-device batch-path feature (refused "
+        "with --mesh/--multihost/--resident)",
+    )
+    ap.add_argument(
+        "--quarantine-enter", type=float, default=0.35, metavar="H",
+        help="tpu-push --quarantine: quarantine a worker when its health "
+        "score drops below H",
+    )
+    ap.add_argument(
+        "--quarantine-release", type=float, default=0.8, metavar="H",
+        help="tpu-push --quarantine: release requires the score back "
+        "above H for 3 consecutive policy passes",
+    )
+    ap.add_argument(
+        "--quarantine-canary-s", type=float, default=2.0, metavar="S",
+        help="tpu-push --quarantine: seconds between canary probes on a "
+        "quarantined worker (its placement ceiling opens to 1 task for "
+        "one tick)",
+    )
+    ap.add_argument(
+        "--quarantine-min-live", type=int, default=1, metavar="N",
+        help="tpu-push --quarantine: hard floor — at least N active "
+        "workers stay unquarantined (a quarantine that would cross this "
+        "is refused and counted)",
+    )
+    ap.add_argument(
+        "--quarantine-min-capacity", type=float, default=0.5, metavar="F",
+        help="tpu-push --quarantine: hard floor — unquarantined workers "
+        "retain at least fraction F of registered fleet capacity",
+    )
     ns = ap.parse_args(argv)
     if ns.delay:
         time.sleep(ns.delay)
@@ -489,6 +528,12 @@ def main(argv: list[str] | None = None) -> None:
             speculate_mult=ns.speculate_mult,
             speculate_max_frac=ns.speculate_max_frac,
             speculate_min_s=ns.speculate_min_s,
+            quarantine=ns.quarantine,
+            quarantine_enter=ns.quarantine_enter,
+            quarantine_release=ns.quarantine_release,
+            quarantine_canary_s=ns.quarantine_canary_s,
+            quarantine_min_live=ns.quarantine_min_live,
+            quarantine_min_capacity=ns.quarantine_min_capacity,
             columnar=ns.columnar,
             arena_capacity=ns.arena_capacity,
             store_binbatch=ns.store_binbatch,

@@ -569,6 +569,17 @@ class TaskDispatcher:
         #: Always on: memory-only plus the /flightrec stats route; it
         #: adds no metric series and no wire fields.
         self.flightrec = FlightRecorder()
+        #: fault-injection seam on the worker wire (tpu_faas/chaos):
+        #: None when TPU_FAAS_CHAOS is unset — send_wire pays one
+        #: identity check and frames stay byte-identical. The shared
+        #: process plan binds this dispatcher's flight recorder so every
+        #: injection (wire AND store-client) joins the event ring.
+        from tpu_faas import chaos as _chaos
+
+        _plan = _chaos.from_env()
+        self._chaos_wire = _plan.wire() if _plan is not None else None
+        if _plan is not None:
+            _plan.bind_flightrec(self.flightrec)
         self.metrics.register_collector(self.collect_metrics)
         #: express result lane (opt-in): > 0 makes every terminal write's
         #: RESULTS_CHANNEL announce carry status + result inline up to this
@@ -759,8 +770,23 @@ class TaskDispatcher:
     # -- batched data plane (push-family send path) ------------------------
     def send_wire(self, wid, payload: bytes) -> None:
         """Put one framed message on the worker wire (push-family ROUTER
-        sockets; subclasses own ``self.socket``)."""
+        sockets; subclasses own ``self.socket``). The ONE dispatcher->
+        worker send point: the chaos plane's drop/dup/delay seam lives
+        here, so every frame class (TASK, CANCEL, BLOB_FILL, RECONNECT)
+        is injectable without per-site hooks."""
+        if self._chaos_wire is not None:
+            self._chaos_wire.send(
+                [wid, payload], self.socket.send_multipart
+            )
+            return
         self.socket.send_multipart([wid, payload])
+
+    def flush_chaos_wire(self) -> None:
+        """Release chaos-delayed frames whose hold expired (no-op unless
+        a wire.delay rule is armed); serve loops call this once per
+        iteration."""
+        if self._chaos_wire is not None:
+            self._chaos_wire.flush(self.socket.send_multipart)
 
     def send_task_frame(self, buf: dict, wid, caps, task, blob: bool) -> None:
         """Send — or buffer for a per-worker TASK_BATCH — one assignment.

@@ -516,6 +516,7 @@ class PushDispatcher(TaskDispatcher):
         last_renew = time.monotonic()
         try:
             while not self.stopping:
+                self.flush_chaos_wire()  # no-op unless wire.delay armed
                 events = dict(self.poller.poll(self.poll_timeout_ms))
                 if self.socket in events:
                     # bounded drain (base.drain_worker_messages): a

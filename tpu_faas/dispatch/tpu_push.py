@@ -92,6 +92,12 @@ class TpuPushDispatcher(TaskDispatcher):
         speculate_mult: float | None = None,
         speculate_max_frac: float = 0.1,
         speculate_min_s: float = 0.05,
+        quarantine: bool = False,
+        quarantine_enter: float = 0.35,
+        quarantine_release: float = 0.8,
+        quarantine_canary_s: float = 2.0,
+        quarantine_min_live: int = 1,
+        quarantine_min_capacity: float = 0.5,
         columnar: bool = False,
         arena_capacity: int | None = None,
         store_binbatch: bool = False,
@@ -143,6 +149,41 @@ class TpuPushDispatcher(TaskDispatcher):
                 min_runtime_s=speculate_min_s,
                 clock=clock,
             )
+        # -- quarantine plane (sched/health.py, ROADMAP item 7): ON iff the
+        # operator asked. The health SCORE machinery predates it (hedge
+        # losses, speculation plane); the plane adds the misfire/reclaim
+        # producers and the policy layer — rows past the enter threshold
+        # are placement-masked via an i32[W] ceiling the fused tick clamps
+        # worker_free with (0 = drained, 1 = canary probe), released when
+        # the score recovers. Hard floors make a fleet-stranding quarantine
+        # structurally refusable. Off = zero new work anywhere (no cap
+        # operand, the tick traces its pre-quarantine graph, exposition
+        # byte-identical). Single-device like tenancy/speculation.
+        self.quarantine = None
+        if quarantine:
+            if multihost or mesh_devices or resident:
+                raise ValueError(
+                    "--quarantine is a single-device batch-path feature "
+                    "(the placement ceiling lives in the local one-shot "
+                    "tick); mesh/multihost/resident fleets must run "
+                    "without it"
+                )
+            from tpu_faas.sched.health import QuarantineBook
+
+            self.quarantine = QuarantineBook(
+                max_workers=max_workers,
+                enter_below=quarantine_enter,
+                release_above=quarantine_release,
+                canary_period_s=quarantine_canary_s,
+                min_live=quarantine_min_live,
+                min_capacity_frac=quarantine_min_capacity,
+                clock=clock,
+            )
+        #: misfire/reclaim health producers run iff SOME consumer of the
+        #: score exists (speculation's tail-aware placement, or the
+        #: quarantine policy) — otherwise worker_health stays all-ones and
+        #: the cached device upload never fires
+        self._health_on = self.spec is not None or self.quarantine is not None
         self.tenancy = None
         if tenant_shares is not None or tenant_caps is not None:
             if multihost or mesh_devices:
@@ -424,20 +465,37 @@ class TpuPushDispatcher(TaskDispatcher):
                 "LOSERS (the speculation plane's measured wasted work; "
                 "losers killed before their child started report none)",
             )
-            # tail-aware placement health (sched/state.py worker_health):
-            # hedge losses decay a row's multiplier, ticks recover it —
-            # this family summarizes the live vector. Exists iff the
-            # speculation plane is on (health only moves under hedging),
-            # so the default exposition stays byte-identical.
+        # tail-aware placement health (sched/state.py worker_health):
+        # hedge losses / misfires / reclaims decay a row's multiplier,
+        # ticks recover it — this family summarizes the live vector.
+        # Exists iff a plane that moves the score is on (speculation or
+        # quarantine), so the default exposition stays byte-identical.
+        if self._health_on:
             self.m_worker_health = self.metrics.gauge(
                 "tpu_faas_worker_health",
-                "Fleet worker-health multiplier summary (speculation "
-                "plane): min / mean over active rows, plus the count of "
-                "degraded rows (health < 1.0)",
+                "Fleet worker-health multiplier summary (speculation/"
+                "quarantine planes): min / mean over active rows, plus "
+                "the count of degraded rows (health < 1.0)",
                 ("stat",),
             )
             for stat in ("min", "mean", "degraded"):
                 self.m_worker_health.labels(stat=stat)
+        # quarantine observability (plane-gated like the hedge families;
+        # the state vocabulary is fixed, cardinality bounded)
+        if self.quarantine is not None:
+            self.m_quarantined = self.metrics.gauge(
+                "tpu_faas_worker_quarantined",
+                "Quarantine plane counters, by state: active (rows "
+                "currently placement-masked), entered / released "
+                "(lifetime transitions), refused (enters blocked by the "
+                "capacity floors — sick rows left serving), canaries "
+                "(probe windows opened on quarantined rows)",
+                ("state",),
+            )
+            for state in (
+                "active", "entered", "released", "refused", "canaries"
+            ):
+                self.m_quarantined.labels(state=state)
         #: RESULT store writes accumulated during a worker-message drain,
         #: flushed as ONE pipelined finish_task_many round per drain
         #: (drain_results_batched); None = unbatched mode, where _handle
@@ -1238,6 +1296,14 @@ class TpuPushDispatcher(TaskDispatcher):
         if caps:
             self._wid_caps[wid] = caps
 
+    def _recall_health(self, wid: bytes, row: int) -> None:
+        """Re-apply a remembered health penalty to a (re-)registered row,
+        keyed by the same stable identity remember_health stashed under
+        (the worker token when it sent one, else the socket identity)."""
+        if self._health_on:
+            tok = self._wid_token.get(wid)
+            self.arrays.recall_health(tok.encode() if tok else wid, row)
+
     def _apply_learned_speed(self, wid: bytes, row: int) -> None:
         """Registration/reconnect re-applies the learned speed the plain
         register() just reset to 1.0 — looked up by the worker's STABLE
@@ -1321,16 +1387,16 @@ class TpuPushDispatcher(TaskDispatcher):
     # -- worker messages ---------------------------------------------------
     def _send_worker(self, wid: bytes, msg_type: str, **kw) -> None:
         """Send one message framed per the peer's negotiated capabilities
-        (binary for CAP_BIN workers, the reference ASCII contract else)."""
-        self.socket.send_multipart(
-            [
-                wid,
-                m.encode_for(
-                    m.CAP_BIN in self._wid_caps.get(wid, frozenset()),
-                    msg_type,
-                    **kw,
-                ),
-            ]
+        (binary for CAP_BIN workers, the reference ASCII contract else).
+        Routed through base.send_wire — the one send point the chaos
+        plane's wire seam covers."""
+        self.send_wire(
+            wid,
+            m.encode_for(
+                m.CAP_BIN in self._wid_caps.get(wid, frozenset()),
+                msg_type,
+                **kw,
+            ),
         )
 
     def _serve_blob_miss(self, wid: bytes, data: dict) -> None:
@@ -1359,6 +1425,7 @@ class TpuPushDispatcher(TaskDispatcher):
             row = a.register(wid, int(data["num_processes"]))
             self._note_token(wid, data)
             self._apply_learned_speed(wid, row)
+            self._recall_health(wid, row)
             self.log.info("worker registered: %r %s", wid, data)
             return
         if wid not in a.worker_ids:
@@ -1366,7 +1433,7 @@ class TpuPushDispatcher(TaskDispatcher):
             # a zero-capacity row is created so its heartbeats count
             row = a.register(wid, 0)
             self._apply_learned_speed(wid, row)
-            self.socket.send_multipart([wid, m.encode(m.RECONNECT)])
+            self.send_wire(wid, m.encode(m.RECONNECT))
             if msg_type not in (m.RECONNECT, m.RESULT, m.RESULT_BATCH):
                 return
         if msg_type == m.RESULT:
@@ -1394,6 +1461,7 @@ class TpuPushDispatcher(TaskDispatcher):
             row = a.reconnect(wid, int(data.get("free_processes", 0)))
             self._note_token(wid, data)
             self._apply_learned_speed(wid, row)
+            self._recall_health(wid, row)
         elif msg_type == m.DEREGISTER:
             # graceful drain: zero the row's capacity so placement skips it;
             # in-flight results keep arriving (the row stays live while it
@@ -1604,7 +1672,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     self.m_tenant_queue.labels(tenant=lbl).set(
                         depth.get(lbl, 0)
                     )
-        if self.spec is not None:
+        if self._health_on:
             health = self._worker_health_summary()
             if health is not None:
                 self.m_worker_health.labels(stat="min").set(health["min"])
@@ -1612,6 +1680,15 @@ class TpuPushDispatcher(TaskDispatcher):
                 self.m_worker_health.labels(stat="degraded").set(
                     health["degraded"]
                 )
+        if self.quarantine is not None:
+            q = self.quarantine
+            self.m_quarantined.labels(state="active").set(
+                len(q.quarantined_rows)
+            )
+            self.m_quarantined.labels(state="entered").set(q.entered_total)
+            self.m_quarantined.labels(state="released").set(q.released_total)
+            self.m_quarantined.labels(state="refused").set(q.refused_total)
+            self.m_quarantined.labels(state="canaries").set(q.canaries_total)
 
     def _worker_health_summary(self) -> dict | None:
         """min/mean/degraded-count over ACTIVE rows of the tail-health
@@ -1638,6 +1715,79 @@ class TpuPushDispatcher(TaskDispatcher):
             "degraded": int((hv < 1.0).sum()),
             "n_active": int(hv.size),
         }
+
+    # -- quarantine plane (sched/health.py) --------------------------------
+    def note_worker_misfires(self, sender: object, data: dict) -> None:
+        """Health producer on top of the base cumulative bookkeeping: the
+        DELTA of a worker's monotonic misfire counter decays its row's
+        health score — pool children dying under a worker is the gray-
+        failure signal that precedes a heartbeat lapse."""
+        prev = self.worker_misfires.get(sender, 0)
+        super().note_worker_misfires(sender, data)
+        if self._health_on:
+            delta = self.worker_misfires.get(sender, 0) - prev
+            if delta > 0:
+                row = self.arrays.worker_ids.get(sender)
+                if row is not None:
+                    self.arrays.note_misfire(int(row), delta)
+
+    def _quarantine_step(self) -> np.ndarray:
+        """One policy pass + the tick's placement ceiling. Runs inside the
+        tick (host-side, a few comparisons over [W]): recover the score
+        first — without the speculation plane nothing else calls
+        _recover_health — then let the book take its transitions."""
+        a, q = self.arrays, self.quarantine
+        a._recover_health(self.clock())
+        events = q.update(a.worker_health, a.worker_active, a.worker_procs)
+        for kind, row in events:
+            if kind == "enter":
+                self._quarantine_drain(row)
+            elif kind == "release":
+                self.log.warning(
+                    "worker row %d released from quarantine "
+                    "(health %.3f recovered)",
+                    row, float(a.worker_health[row]),
+                )
+                self.flightrec.emit(
+                    "quarantine", row=row, action="release",
+                    health=round(float(a.worker_health[row]), 4),
+                )
+            elif kind == "refused":
+                self.log.warning(
+                    "quarantine REFUSED for sick worker row %d (health "
+                    "%.3f): masking it would cross the capacity floors "
+                    "(min_live=%d, min_capacity_frac=%.2f)",
+                    row, float(a.worker_health[row]),
+                    q.min_live, q.min_capacity_frac,
+                )
+                self.flightrec.emit(
+                    "quarantine", row=row, action="refused",
+                    health=round(float(a.worker_health[row]), 4),
+                )
+        return q.place_cap()
+
+    def _quarantine_drain(self, row: int) -> None:
+        """ENTER-transition bookkeeping: the row stops receiving NEW work
+        (the place_cap ceiling masks it) while its in-flight tasks drain
+        through the ordinary result/reclaim paths. This path must never
+        write a terminal task status — a quarantined worker's tasks are
+        still live (they complete on the worker, or liveness reclaim
+        re-queues them); FAILing them here would turn a routing decision
+        into task loss. Enforced by the quarantine-drain static-analysis
+        rule (tpu_faas/analysis)."""
+        a = self.arrays
+        draining = int((np.asarray(a.inflight_worker) == row).sum())
+        self.log.warning(
+            "worker row %d quarantined (health %.3f, %d in flight "
+            "draining; canary every %.1fs)",
+            row, float(a.worker_health[row]),
+            draining, self.quarantine.canary_period_s,
+        )
+        self.flightrec.emit(
+            "quarantine", row=row, action="enter",
+            health=round(float(a.worker_health[row]), 4),
+            draining=draining,
+        )
 
     def _flightrec_tick_extra(self) -> dict:
         """tpu-push enrichment of the per-tick flight record: which
@@ -1730,10 +1880,15 @@ class TpuPushDispatcher(TaskDispatcher):
             "speculation": (
                 None if self.spec is None else self.spec.stats()
             ),
-            # tail-health block (None = speculation plane off): summary of
-            # the worker_health multipliers placement steers around
+            # tail-health block (None = no plane moves the score): summary
+            # of the worker_health multipliers placement steers around
             "worker_health": (
-                None if self.spec is None else self._worker_health_summary()
+                None if not self._health_on else self._worker_health_summary()
+            ),
+            # quarantine block (None = plane off): currently-masked rows,
+            # transition totals, and the policy knobs in force
+            "quarantine": (
+                None if self.quarantine is None else self.quarantine.stats()
             ),
         }
 
@@ -1977,6 +2132,16 @@ class TpuPushDispatcher(TaskDispatcher):
                     frontier_rows, a.max_pending
                 )
                 dep_edges = (child, undone)
+            # quarantine plane: run the policy pass and materialize the
+            # i32[W] placement ceiling. Built on EVERY tick while the
+            # plane is on (all-HUGE with nobody quarantined) — the lane is
+            # part of the jitted signature, and materializing it only at
+            # the first quarantine would recompile the tick MID-RUN, a
+            # serve-loop stall at the exact moment a gray-failing worker
+            # needs routing around (same reasoning as the avoids lane).
+            place_cap = None
+            if self.quarantine is not None:
+                place_cap = self._quarantine_step()
             # recompile detection BEFORE the call: the signature carries
             # everything that changes the jitted trace (padded dims,
             # placement, optional priority lane, the frontier's padded
@@ -1992,6 +2157,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     task_pref is not None,
                     tenants is not None,
                     avoids is not None,
+                    place_cap is not None,
                 ),
             )
             with self.tracer.span("device_tick"), self.profiler.tick_capture():
@@ -2002,6 +2168,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     task_pref=task_pref,
                     task_tenants=tenants,
                     task_avoid=avoids,
+                    worker_place_cap=place_cap,
                 )
 
             # reclaim in-flight tasks of dead workers (ahead of the queue)
@@ -2385,8 +2552,8 @@ class TpuPushDispatcher(TaskDispatcher):
 
         self.relay_kills(
             owner,
-            lambda wid, tid: self.socket.send_multipart(
-                [wid, m.encode(m.CANCEL, task_id=tid)]
+            lambda wid, tid: self.send_wire(
+                wid, m.encode(m.CANCEL, task_id=tid)
             ),
         )
 
@@ -2515,6 +2682,13 @@ class TpuPushDispatcher(TaskDispatcher):
             a.inflight_clear_slot(slot)
             self._forget_task_state(task_id)
         for slot, pt in reclaims:
+            if self._health_on:
+                # strongest health producer: the row lost a task WITH its
+                # worker. The row is usually purged this same pass, so the
+                # penalty's real audience is the id-keyed memory below.
+                r_row = int(a.inflight_worker[slot])
+                if r_row >= 0:
+                    a.note_reclaim(r_row)
             a.inflight_clear_slot(slot)
             # off the wire: release the tenant's inflight charge (the
             # re-dispatch charges it again); any hedge state dies with the
@@ -2541,6 +2715,16 @@ class TpuPushDispatcher(TaskDispatcher):
         for row in purged_rows:
             self.log.warning("purged worker row %d", int(row))
             wid_p = a.row_ids.get(int(row))
+            if self._health_on and wid_p is not None:
+                # stash the row's penalty under the worker's STABLE
+                # identity before the row recycles (register wipes row
+                # health to 1.0): a sick worker that dies and re-registers
+                # recalls it — with recovery credited for the absence —
+                # instead of laundering the score
+                tok = self._wid_token.get(wid_p)
+                a.remember_health(
+                    tok.encode() if tok else wid_p, int(row)
+                )
             a.deactivate(int(row))
             if wid_p is not None:
                 # a purged socket identity is never seen again; a zombie
@@ -2878,6 +3062,9 @@ class TpuPushDispatcher(TaskDispatcher):
             #: (express mode only; [] keeps the classic tick-cadence park)
             announce_fds: list[int] = []
             while not self.stopping:
+                # chaos-delayed frames whose hold expired go out first
+                # (no-op identity check unless wire.delay is armed)
+                self.flush_chaos_wire()
                 # a store outage must degrade the dispatcher (workers keep
                 # heartbeating, results buffer), never crash it — everything
                 # below retries next iteration once the store is back
@@ -2923,16 +3110,21 @@ class TpuPushDispatcher(TaskDispatcher):
                     # tiny hash read per second, applied in place
                     self._maybe_reload_tenant_conf()
                     # saturation signal for gateway admission control
-                    # (admission/signal.py): one tiny hash write per second
+                    # (admission/signal.py): one tiny hash write per second.
+                    # Quarantined rows' slots are NOT available capacity —
+                    # placement is masked off them, so advertising their
+                    # procs would have gateways admitting against workers
+                    # the tick refuses to use
                     a0 = self.arrays
+                    avail = a0.worker_active
+                    if self.quarantine is not None:
+                        avail = avail & ~self.quarantine.quarantined_mask()
                     self.maybe_publish_capacity(
                         pending=len(self.pending)
                         + len(self._resident_tasks),
                         inflight=a0.n_inflight,
                         capacity=int(
-                            np.where(
-                                a0.worker_active, a0.worker_procs, 0
-                            ).sum()
+                            np.where(avail, a0.worker_procs, 0).sum()
                         ),
                         results=self.n_results,
                     )

@@ -60,6 +60,7 @@ def _packed_tick(
     spec_min_s=None,  # f32 scalar absolute floor
     task_avoid_worker=None,  # i32[T] hedge anti-affinity row (-1 = none)
     worker_health=None,  # f32[W] tail-health multiplier on effective speed
+    worker_place_cap=None,  # i32[W] placement ceiling (quarantine plane)
     *,
     T: int,
     W: int,
@@ -116,6 +117,7 @@ def _packed_tick(
         spec_min_s=spec_min_s,
         task_avoid_worker=task_avoid_worker,
         worker_health=worker_health,
+        worker_place_cap=worker_place_cap,
     )
     if task_pref is not None:
         # data-locality exchange for graph children: prefer the worker
@@ -190,6 +192,7 @@ def scheduler_tick_impl(
     spec_min_s: jnp.ndarray | None = None,  # f32 scalar absolute floor
     task_avoid_worker: jnp.ndarray | None = None,  # i32[T] forbidden row
     worker_health: jnp.ndarray | None = None,  # f32[W] tail multiplier
+    worker_place_cap: jnp.ndarray | None = None,  # i32[W] placement ceiling
 ) -> TickOutput:
     # -- tail-aware placement feedback (speculation plane): a worker that
     # keeps LOSING hedge races is slow in a way its learned speed grade
@@ -201,6 +204,17 @@ def scheduler_tick_impl(
     # None (plane off, or resident tick) keeps the byte-identical trace.
     if worker_health is not None:
         worker_speed = worker_speed * worker_health
+    # -- quarantine plane (sched/health.py): a per-row placement CEILING.
+    # A quarantined row keeps its liveness state (heartbeats still
+    # refresh it; its in-flight tasks finish naturally) but its cap is 0
+    # — clamping free counts excludes it from every placement kernel AND
+    # the hedge fixup's re-placement in one move. A canary probe is
+    # cap 1 for one tick: exactly one task may land, whose outcome
+    # decides release. Healthy rows carry a huge cap (no-op clamp).
+    # None (plane off) keeps the byte-identical pre-quarantine trace —
+    # the same optional-lane contract as every plane above.
+    if worker_place_cap is not None:
+        worker_free = jnp.minimum(worker_free, worker_place_cap)
     # -- failure detection (reference purge_workers, device-side) ----------
     # ages, not absolute timestamps: hosts keep f64 monotonic clocks and
     # subtract before the device sees anything, so f32 quantization error is
@@ -432,6 +446,13 @@ class SchedulerArrays:
         #: resident tick keeps its pre-health state layout.
         self.worker_health = np.ones(W, dtype=np.float32)
         self._last_health_recover: float | None = None
+        #: id-keyed health memory (stable identity -> (health, stamp)):
+        #: register() wipes a recycled row's health to 1.0, so without
+        #: this a sick worker could launder its penalty by dying and
+        #: re-registering — purge remembers (remember_health), the
+        #: re-register recalls (recall_health) with time-based recovery
+        #: credited for the absence. Bounded FIFO (HEALTH_MEMORY_MAX).
+        self.health_memory: dict[bytes, tuple[float, float]] = {}
         self.worker_free = np.zeros(W, dtype=np.int32)
         self.worker_active = np.zeros(W, dtype=bool)
         # float64: absolute monotonic timestamps live host-side only; the
@@ -553,6 +574,16 @@ class SchedulerArrays:
     HEALTH_DECAY = 0.8
     HEALTH_FLOOR = 0.25
     HEALTH_RECOVERY_TAU = 30.0
+    #: misfires (pool children the worker had to respawn) are a weaker
+    #: signal per event than a lost hedge race; reclaims (a task taken
+    #: BACK from the worker because its heartbeat lapsed) are the
+    #: strongest — the worker demonstrably failed to return work
+    MISFIRE_DECAY = 0.85
+    RECLAIM_DECAY = 0.7
+    #: bound on the id-keyed health memory (each entry is ~100 bytes;
+    #: oldest-inserted evicts first — FIFO is fine for a bound this
+    #: loose, entries self-expire via recovery anyway)
+    HEALTH_MEMORY_MAX = 4096
 
     def note_hedge_loss(self, row: int) -> None:
         """The original placement on ``row`` LOST its hedge race: the worker
@@ -582,6 +613,63 @@ class SchedulerArrays:
         h = self.worker_health
         h += (np.float32(1.0) - h) * np.float32(alpha)
         np.copyto(h, np.float32(1.0), where=h > 0.999)
+
+    def _decay_health(self, row: int, factor: float) -> None:
+        if 0 <= row < len(self.worker_health) and self.worker_active[row]:
+            self.worker_health[row] = max(
+                self.HEALTH_FLOOR, float(self.worker_health[row]) * factor
+            )
+
+    def note_misfire(self, row: int, n_new: int = 1) -> None:
+        """``n_new`` fresh pool-child misfires were attributed to ``row``:
+        children that died mid-task and had to be respawned. A worker
+        whose children keep dying is gray-failing even when its results
+        (eventually) arrive — decay its health so placement steers away
+        before the failure graduates to a heartbeat lapse."""
+        if n_new > 0:
+            self._decay_health(row, self.MISFIRE_DECAY ** min(n_new, 8))
+
+    def note_reclaim(self, row: int) -> None:
+        """A task was reclaimed from ``row`` (its worker died holding it).
+        The row is usually about to be purged, so the penalty's real
+        audience is the id-keyed memory (remember_health) — a respawned
+        worker on the same box re-registers with this on its record."""
+        self._decay_health(row, self.RECLAIM_DECAY)
+
+    # -- id-keyed health memory (survives purge + re-register) -------------
+    def remember_health(self, ident: bytes, row: int) -> None:
+        """Stash ``row``'s health under a stable identity at purge time.
+        All-healthy rows are not worth remembering (recall would be a
+        no-op), and the dict is FIFO-bounded."""
+        if not ident or not (0 <= row < len(self.worker_health)):
+            return
+        h = float(self.worker_health[row])
+        if h >= 0.9999:
+            self.health_memory.pop(ident, None)
+            return
+        if (
+            len(self.health_memory) >= self.HEALTH_MEMORY_MAX
+            and ident not in self.health_memory
+        ):
+            self.health_memory.pop(next(iter(self.health_memory)))
+        self.health_memory[ident] = (h, self.clock())
+
+    def recall_health(self, ident: bytes, row: int) -> None:
+        """Re-apply a remembered penalty to a freshly (re-)registered row,
+        crediting exponential recovery for the time spent away — a
+        worker that was sick a minute ago re-registers merely bruised,
+        one sick an hour ago re-registers clean."""
+        if not ident:
+            return
+        entry = self.health_memory.pop(ident, None)
+        if entry is None or not (0 <= row < len(self.worker_health)):
+            return
+        h, stamp = entry
+        dt = max(0.0, self.clock() - stamp)
+        alpha = 1.0 - math.exp(-dt / self.HEALTH_RECOVERY_TAU)
+        h = h + (1.0 - h) * alpha
+        if h < 0.9999:
+            self.worker_health[row] = np.float32(h)
 
     # -- in-flight table ---------------------------------------------------
     @property
@@ -734,6 +822,7 @@ class SchedulerArrays:
         task_pref: np.ndarray | None = None,
         task_tenants: np.ndarray | None = None,
         task_avoid: np.ndarray | None = None,
+        worker_place_cap: np.ndarray | None = None,
     ) -> TickOutput:
         """Run the fused device step for the current pending batch.
 
@@ -771,6 +860,13 @@ class SchedulerArrays:
             raise ValueError(
                 "the speculation plane is single-device only; mesh/"
                 "multihost fleets run without straggler hedging"
+            )
+        if worker_place_cap is not None and (
+            self.multihost is not None or self.mesh is not None
+        ):
+            raise ValueError(
+                "the quarantine plane is single-device only; mesh/"
+                "multihost fleets run without placement ceilings"
             )
         if n > self.max_pending:
             raise ValueError(f"{n} pending > max_pending={self.max_pending}")
@@ -879,6 +975,16 @@ class SchedulerArrays:
                 av = np.full(T, -1, dtype=np.int32)
                 av[:n] = task_avoid
                 spec_kw["task_avoid_worker"] = jnp.asarray(av)
+            if worker_place_cap is not None:
+                # quarantine ceiling (sched/health.py): like the spec
+                # lanes, this operand must be passed EVERY tick once the
+                # plane is on — flapping None<->array would retrace the
+                # fused tick mid-run. The cached upload makes the steady
+                # state (all-healthy, all-huge caps) free.
+                spec_kw["worker_place_cap"] = self._cached_dev(
+                    "place_cap",
+                    np.asarray(worker_place_cap, dtype=np.int32),
+                )
             out = _packed_tick(
                 jnp.asarray(packed),
                 jnp.int32(n),

@@ -336,6 +336,13 @@ class RespStore(TaskStore):
         #: toward a plain Redis is byte-identical to before (the same
         #: contract as the single-endpoint no-handshake rule above).
         self._binbatch = bool(binbatch)
+        #: fault-injection seam (tpu_faas/chaos): None when
+        #: TPU_FAAS_CHAOS is unset — one identity check per round trip,
+        #: wire and exposition surfaces byte-identical
+        from tpu_faas import chaos as _chaos
+
+        _plan = _chaos.from_env()
+        self._chaos = _plan.store() if _plan is not None else None
         self._conn: _Conn | None = self._connect()
 
     @property
@@ -499,6 +506,12 @@ class RespStore(TaskStore):
                 # previous reconnect failed; retry it now (raises if the
                 # server is still down, leaving _conn None for next time)
                 self._conn = self._connect()
+            if self._chaos is not None:
+                # may sleep (latency) or raise ConnectionError (outage
+                # window) BEFORE the socket is touched — the injected
+                # outage must look like an unreachable store, not a
+                # desynchronized connection
+                self._chaos.before(str(parts[0]))
             try:
                 # deliberate I/O under lock: this lock EXISTS to serialize
                 # use of the one connection (RESP replies are positional)
@@ -546,6 +559,8 @@ class RespStore(TaskStore):
                 raise ConnectionError("store client is closed")
             if self._conn is None:
                 self._conn = self._connect()
+            if self._chaos is not None:
+                self._chaos.before("PIPELINE")
             conn = self._conn
             try:
                 # deliberate I/O under lock (see _command): one connection,
@@ -561,6 +576,16 @@ class RespStore(TaskStore):
                         out.append(conn.recv_reply(raw=_raw))  # faas: allow(locks.blocking-call-under-lock)
                     except resp.RespError as exc:
                         out.append(exc)
+                if self._chaos is not None and self._chaos.torn():
+                    # torn pipeline: every command APPLIED (replies were
+                    # read), but the caller sees the connection die before
+                    # learning so — the applied-but-reply-lost ambiguity
+                    # the no-retry contract above exists for. The handler
+                    # below tears the connection down for real.
+                    raise ConnectionError(
+                        "chaos: torn pipeline (commands applied, reply "
+                        "lost)"
+                    )
                 return out
             except (ConnectionError, TimeoutError):
                 conn.close()

@@ -52,6 +52,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from tpu_faas.store import resp, snapshot
+from tpu_faas.utils.backoff import Backoff, BackoffPolicy
 
 #: Commands that mutate store state — the set a replica refuses from
 #: ordinary clients, a fenced primary refuses from everyone, and a live
@@ -70,8 +71,18 @@ FENCED_ERR = "FENCED stale primary (superseded by a higher epoch)"
 ANNOUNCE_RING_SIZE = 10_000
 
 #: How often the replica link acks its applied offset back to the
-#: primary (seconds); also the reconnect backoff after a lost link.
+#: primary (seconds); also the reconnect backoff floor after a lost link.
 ACK_PERIOD = 0.5
+
+#: Reconnect schedule after a lost link: starts at the ack cadence and
+#: grows to a short cap — a replica hammering a dead primary every
+#: 0.5 s forever is wasted log noise, but the cap stays small so
+#: promotion-window resyncs (tests wait ~5 s) are never starved. The
+#: counter resets after any successful full sync, so a fresh outage on
+#: a previously-healthy link retries fast.
+RECONNECT_BACKOFF = BackoffPolicy(
+    floor_s=ACK_PERIOD, factor=2.0, cap_s=2.0, jitter_lo=0.9, jitter_hi=1.2
+)
 
 
 class AnnounceRing:
@@ -165,6 +176,7 @@ class ReplicaLink:
             self._task.cancel()
 
     async def run(self) -> None:
+        bo = Backoff(RECONNECT_BACKOFF)
         while not self._stopped:
             try:
                 await self._sync_and_tail()
@@ -178,11 +190,15 @@ class ReplicaLink:
                 # pre-HA server as the target) must retry-and-log, not
                 # silently kill the link task forever
             ) as exc:
+                if self.synced:
+                    # the link WAS up: this is a fresh outage, not one
+                    # more failure in a streak — retry fast again
+                    bo.reset()
                 self.synced = False
                 self.server.note_link_down(exc)
             if self._stopped:
                 return
-            await asyncio.sleep(ACK_PERIOD)
+            await asyncio.sleep(bo.next())
 
     async def _sync_and_tail(self) -> None:
         reader, writer = await asyncio.open_connection(self.host, self.port)

@@ -24,11 +24,17 @@ import zmq
 from tpu_faas.core.payload import PayloadLRU
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import TaskStatus
+from tpu_faas.utils.backoff import BackoffPolicy
 from tpu_faas.utils.logging import get_logger, log_ctx
 from tpu_faas.worker import messages as m
 from tpu_faas.worker.pool import FN_CACHE_HITS, FN_CACHE_MISSES, TaskPool
 
 log = get_logger("pull_worker")
+
+#: Blob-fetch retry schedule: gentle growth capped at 1 s — the loop is
+#: also this worker's liveness traffic during an outage, so sleeps must
+#: stay short enough that request-stamped last_seen never ages past tte.
+_BLOB_BACKOFF = BackoffPolicy(floor_s=0.2, factor=1.5, cap_s=1.0)
 
 
 class PullWorker:
@@ -71,6 +77,16 @@ class PullWorker:
         self.socket.connect(dispatcher_url)
         self._stopping = False
         self._draining = False
+        #: fault-injection seams (tpu_faas/chaos), None when
+        #: TPU_FAAS_CHAOS is unset. The REQ/REP lockstep constrains the
+        #: wire seam: only wire.delay is expressible here (as a blocking
+        #: sleep before the request) — drop would wedge the mandatory
+        #: recv, dup would desync reply correlation.
+        from tpu_faas import chaos as _chaos
+
+        _plan = _chaos.from_env()
+        self._chaos_wire = _plan.wire() if _plan is not None else None
+        self._chaos_exec = _plan.execution() if _plan is not None else None
 
     def stop(self) -> None:
         self._stopping = True
@@ -88,7 +104,15 @@ class PullWorker:
         too (``cancel_ids``): a pull worker cannot be pushed to, so the
         dispatcher piggy-backs kill requests for tasks THIS worker runs on
         whatever reply goes out next — TASK or WAIT. Returns the reply."""
-        self.socket.send(m.encode_for(self._peer_bin, msg_type, **data))
+        payload = m.encode_for(self._peer_bin, msg_type, **data)
+        if self._chaos_wire is not None:
+            # lockstep socket: delay-as-sleep only (see __init__)
+            self._chaos_wire.send(
+                payload, self.socket.send,
+                dup_ok=False, defer_ok=False, drop_ok=False,
+            )
+        else:
+            self.socket.send(payload)
         raw = self.socket.recv()
         if not self._peer_bin and m.is_binary(raw):
             self._peer_bin = True  # binary negotiation complete
@@ -154,6 +178,10 @@ class PullWorker:
                 return
         elif payload is not None and digest:
             self.fn_cache.put(digest, payload)
+        if self._chaos_exec is not None:
+            # slow / crash_before ahead of pool handoff (same seam shape
+            # as the push worker — see its _submit_task comment)
+            self._chaos_exec.before_task(reply["task_id"])
         self.pool.submit(
             reply["task_id"],
             payload,
@@ -164,11 +192,12 @@ class PullWorker:
 
     def _fetch_blob(self, digest: str, retries: int = 40) -> str | None:
         """One or more BLOB_MISS transactions; an EMPTY fill (dispatcher
-        store outage) backs off and retries — the budget (~35 s at the
-        default, sleeps capped at 1 s) rides out the store blips the rest
-        of the system parks through, since REQ/REP has no parked-task
-        structure to wait in asynchronously. ``missing`` (the blob is
-        gone from the store too) gives up immediately."""
+        store outage) backs off and retries — the ``_BLOB_BACKOFF``
+        budget (~37 s at the default, sleeps capped at 1 s) rides out
+        the store blips the rest of the system parks through, since
+        REQ/REP has no parked-task structure to wait in asynchronously.
+        ``missing`` (the blob is gone from the store too) gives up
+        immediately."""
         for attempt in range(retries):
             # worker_id rides along: pull-mode liveness is request-stamped
             # (demand IS the heartbeat), and during an outage this retry
@@ -196,7 +225,7 @@ class PullWorker:
                 return body
             if reply.get("missing"):
                 return None
-            time.sleep(min(0.2 * (attempt + 1), 1.0))  # dispatcher outage
+            time.sleep(_BLOB_BACKOFF.delay(attempt))  # dispatcher outage
         return None
 
     def run(self, max_tasks: int | None = None) -> int:
@@ -231,6 +260,11 @@ class PullWorker:
                     )
                     shipped += 1
                     last_transact = time.monotonic()
+                    if self._chaos_exec is not None:
+                        # crash_after: the result (and its mandatory
+                        # reply) is done — the worker dies holding
+                        # nothing the dispatcher hasn't seen
+                        self._chaos_exec.after_result(res.task_id)
                 # ask for work while slots are free
                 if not self._draining and self.pool.free > 0:
                     self._transact(m.READY, worker_id=self.worker_id)

@@ -107,6 +107,19 @@ class PushWorker:
         self.poller.register(self.pool.wakeup_fd, zmq.POLLIN)
         self._stopping = False
         self._draining = False
+        #: fault-injection seams (tpu_faas/chaos), None when
+        #: TPU_FAAS_CHAOS is unset: the wire seam wraps _send (drop/dup/
+        #: delay on this worker's frames — heartbeats included, which is
+        #: how gray network paths are modeled), the exec seam runs
+        #: before pool submission (slow / crash_before) and after
+        #: results ship (crash_after). REGISTER stays un-injected: it is
+        #: the instance's birth certificate, and a scenario that wants a
+        #: never-registering worker simply doesn't start one.
+        from tpu_faas import chaos as _chaos
+
+        _plan = _chaos.from_env()
+        self._chaos_wire = _plan.wire() if _plan is not None else None
+        self._chaos_exec = _plan.execution() if _plan is not None else None
 
     def stop(self) -> None:
         self._stopping = True
@@ -122,8 +135,15 @@ class PushWorker:
         """Frame per the negotiated state: binary once the dispatcher has
         proven (by sending one) that it decodes binary frames, ASCII until
         then — so a reference-style dispatcher never sees a frame it can't
-        decode."""
-        self.socket.send(m.encode_for(self._peer_bin, msg_type, **data))
+        decode. The one worker->dispatcher send point: the chaos wire
+        seam lives here (dup is safe — results are at-least-once and the
+        dispatcher's from_owner/terminal checks already tolerate
+        replays)."""
+        payload = m.encode_for(self._peer_bin, msg_type, **data)
+        if self._chaos_wire is not None:
+            self._chaos_wire.send(payload, self.socket.send)
+            return
+        self.socket.send(payload)
 
     def register(self) -> None:
         # REGISTER always rides the ASCII contract (first contact: the
@@ -181,6 +201,13 @@ class PushWorker:
             # later digest-only TASK (dispatcher upgraded mid-stream)
             # needs no fill round
             self.fn_cache.put(digest, payload)
+        if self._chaos_exec is not None:
+            # exec chaos (slow / crash_before) runs in the serve thread,
+            # ahead of pool handoff: a gray worker stalls its whole
+            # intake (the failure shape the health plane must catch),
+            # and a crash kills the WORKER — the dispatcher's liveness
+            # machinery reclaims, so no task reaches a terminal FAILED
+            self._chaos_exec.before_task(data["task_id"])
         if collect is not None:
             collect.append(
                 (
@@ -324,6 +351,11 @@ class PushWorker:
                 if trace_id:
                     item["trace_id"] = trace_id
                 self._send(m.RESULT, **item)
+        if self._chaos_exec is not None:
+            # crash_after fires once results are on the wire: the
+            # dispatcher must tolerate the purge racing already-shipped
+            # (possibly duplicated) results
+            self._chaos_exec.after_result(results[-1].task_id)
         return len(results)
 
     def _resend_stale_misses(self, now: float) -> None:
@@ -368,6 +400,9 @@ class PushWorker:
                     last_heartbeat = now  # the fix for reference :61-62
                 if self._awaiting:
                     self._resend_stale_misses(now)
+                if self._chaos_wire is not None:
+                    # chaos-delayed frames whose hold expired go out now
+                    self._chaos_wire.flush(self.socket.send)
                 events = dict(self.poller.poll(self.poll_timeout_ms))
                 if self.socket in events:
                     while True:
