@@ -1444,6 +1444,42 @@ def test_shard_declared_namespaces_are_clean(tmp_path):
     assert hits(findings) == []
 
 
+def test_shard_blobreq_namespace_is_declared(tmp_path):
+    """The result-blob plane's lazy-materialization claims
+    (``blobreq:<digest>``) are a declared ring-routed namespace: the
+    gateway spelling (``blobreq_key()`` helper, f-string head, and the
+    BLOBREQ_PREFIX constant) all resolve clean, while a near-miss
+    spelling outside the namespace still fires."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.store.base import BLOBREQ_PREFIX, blobreq_key
+
+        def f(store, digest):
+            store.setnx_field(blobreq_key(digest), "req_at", "1")
+            store.delete(f"blobreq:{digest}")
+            store.hget(BLOBREQ_PREFIX + digest, "req_at")
+            store.hset("blobrequest:" + digest, {"v": "1"})  # NOT declared
+        """,
+    )
+    assert hits(findings) == [("shard.undeclared-namespace", 7)]
+
+
+def test_shard_blobreq_mixed_batch_fires(tmp_path):
+    """A literal batch mixing a ring-routed blobreq claim with a
+    broadcast key is the exact coupling the rule exists to catch."""
+    findings = check(
+        tmp_path,
+        """\
+        from tpu_faas.store.base import DISPATCHERS_KEY
+
+        def f(store, digest):
+            store.delete_many([f"blobreq:{digest}", DISPATCHERS_KEY])
+        """,
+    )
+    assert hits(findings) == [("shard.mixed-routing-pipeline", 4)]
+
+
 def test_shard_mixed_routing_pipeline_fires(tmp_path):
     findings = check(
         tmp_path,
@@ -2729,7 +2765,7 @@ def test_planegate_real_tree_capability_map_pin():
         list(checker.check(m))
     assert list(checker.finalize()) == []
     assert set(checker.capabilities.values()) == {
-        "blob", "bin", "trace", "batch",
+        "blob", "bin", "trace", "batch", "rblob",
     }
     assert {
         "FIELD_TRACE_ID", "FIELD_TRACE_PARENT", "FIELD_FN_DIGEST",

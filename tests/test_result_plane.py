@@ -1,0 +1,772 @@
+"""Result data plane (--result-blobs): digest-form terminal writes and
+announces, the worker result cache + dep delivery, the dispatcher's
+reverse-pull machinery (child re-fills and store materialization for
+legacy readers), the byte-weighted parent-locality placement lane, and
+the off-plane byte-identical contract — unit through in-process e2e.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from tpu_faas.core.executor import pack_params
+from tpu_faas.core.payload import RESULT_BLOB_MIN_BYTES, payload_digest
+from tpu_faas.core.serialize import deserialize, serialize
+from tpu_faas.core.task import (
+    FIELD_CHILDREN,
+    FIELD_DEPS,
+    FIELD_PENDING_DEPS,
+    FIELD_RESULT,
+    FIELD_RESULT_DIGEST,
+    FIELD_RESULT_SIZE,
+    FIELD_STATUS,
+    TaskStatus,
+)
+from tpu_faas.gateway import start_gateway_thread
+from tpu_faas.store import MemoryStore
+from tpu_faas.store.base import (
+    BLOBREQ_AT_FIELD,
+    RESULT_DIGEST_PREFIX,
+    blobreq_key,
+    decode_result_announce,
+    decode_result_announce_full,
+    encode_result_announce,
+)
+from tpu_faas.store.sharding import ShardedStore
+from tpu_faas.worker import messages as m
+from tpu_faas.worker.push_worker import PushWorker
+from tpu_faas.workloads import big_result, merge_deps
+
+WAITING = str(TaskStatus.WAITING)
+QUEUED = str(TaskStatus.QUEUED)
+COMPLETED = str(TaskStatus.COMPLETED)
+
+
+# -- announce codec ----------------------------------------------------------
+
+
+def test_result_announce_digest_form_roundtrip():
+    d = payload_digest("BODY")
+    payload = encode_result_announce(
+        "t1", COMPLETED, "", result_digest=d, result_size=9000
+    )
+    assert payload.startswith(RESULT_DIGEST_PREFIX)
+    # body-oblivious consumers: wake-up with status, NO result (they
+    # re-read the record — an empty-string result here would be served
+    # as a real body by the express lane)
+    tid, status, result = decode_result_announce(payload)
+    assert (tid, status, result) == ("t1", COMPLETED, None)
+    # digest-aware consumers get the full tuple
+    full = decode_result_announce_full(payload)
+    assert full == ("t1", COMPLETED, None, d, 9000)
+
+
+def test_result_announce_legacy_forms_unchanged():
+    # id-only and inline express forms decode exactly as before
+    assert decode_result_announce("plain-id") == ("plain-id", None, None)
+    inline = encode_result_announce("t2", COMPLETED, "small", inline_max=64)
+    assert decode_result_announce(inline) == ("t2", COMPLETED, "small")
+    assert decode_result_announce_full(inline)[3] is None
+
+
+# -- store: digest-form terminal writes --------------------------------------
+
+
+def test_finish_task_digest_form_fields():
+    store = MemoryStore()
+    store.create_task("t1", "f", "p")
+    d = payload_digest("R" * 5000)
+    store.finish_task("t1", COMPLETED, "", result_digest=d, result_size=5000)
+    rec = store.hgetall("t1")
+    assert rec[FIELD_STATUS] == COMPLETED
+    assert rec[FIELD_RESULT] == ""
+    assert rec[FIELD_RESULT_DIGEST] == d
+    assert rec[FIELD_RESULT_SIZE] == "5000"
+
+
+def test_finish_task_many_mixed_digest_and_legacy_items():
+    store = MemoryStore()
+    for tid in ("a", "b"):
+        store.create_task(tid, "f", "p")
+    d = payload_digest("BIG")
+    store.finish_task_many(
+        [
+            ("a", COMPLETED, "", False, d, 3),
+            ("b", COMPLETED, "inline-body", False),
+        ]
+    )
+    assert store.hgetall("a")[FIELD_RESULT_DIGEST] == d
+    assert store.hgetall("a")[FIELD_RESULT] == ""
+    rec_b = store.hgetall("b")
+    assert rec_b[FIELD_RESULT] == "inline-body"
+    assert FIELD_RESULT_DIGEST not in rec_b
+
+
+def test_cross_shard_digest_record_and_blob():
+    """Satellite: the parent's task record, its result blob, and the
+    waiting child can all land on DIFFERENT shards — the digest form
+    routes each key independently (record by task id, blob/blobreq by
+    digest) and readers resolve across the ring."""
+    from tpu_faas.store.base import blob_key
+
+    mems = [MemoryStore() for _ in range(3)]
+    store = ShardedStore(mems)
+    # find ids/bodies spread over three distinct shards
+    parent = next(
+        f"p{i}" for i in range(300) if store.shard_of(f"p{i}") == 0
+    )
+    body, d = next(
+        (b, payload_digest(b))
+        for b in ("B" * 4200 + str(i) for i in range(300))
+        if store.shard_of(blob_key(payload_digest(b))) == 1
+    )
+    child = next(
+        f"c{i}" for i in range(300) if store.shard_of(f"c{i}") == 2
+    )
+    store.create_task(parent, "f", "p", extra_fields={FIELD_CHILDREN: child})
+    store.create_tasks(
+        [(child, "f", "p", {FIELD_DEPS: parent, FIELD_PENDING_DEPS: "1"})],
+        status=TaskStatus.WAITING,
+    )
+    store.finish_task(
+        parent, COMPLETED, "", result_digest=d, result_size=len(body)
+    )
+    # the digest-form record landed on the parent's ring shard, readable
+    # through the sharded facade
+    rec = store.hgetall(parent)
+    assert rec[FIELD_RESULT_DIGEST] == d and rec[FIELD_RESULT] == ""
+    # materialization (BLOB_MISS fill path writes via put_blob) routes by
+    # digest; the read resolves whatever shard it landed on
+    assert store.put_blob(d, body) is True
+    assert store.get_blob(d) == body
+    assert sum(1 for mem in mems if mem.get_blob(d) == body) == 1
+    # the blobreq claim key rides the same digest routing
+    store.setnx_field(blobreq_key(d), BLOBREQ_AT_FIELD, "1.0")
+    assert store.hget(blobreq_key(d), BLOBREQ_AT_FIELD) == "1.0"
+    store.delete(blobreq_key(d))
+    assert store.hget(blobreq_key(d), BLOBREQ_AT_FIELD) is None
+
+
+# -- frontier bookkeeping ----------------------------------------------------
+
+
+class _Task:
+    def __init__(self, tid):
+        self.task_id = tid
+
+
+def test_frontier_confirmed_parents_and_cleanup():
+    from tpu_faas.graph.frontier import GraphFrontier
+
+    g = GraphFrontier()
+    g.add(_Task("child"), ["p1", "p2", "p3"])
+    d = payload_digest("RES")
+    g.note_parent("p1", True, row=2, digest=d, size=4500)
+    g.note_parent("p2", True, row=5)  # store-resident parent: no digest
+    g.note_parent("p3", False, row=1)  # failed: never delivered
+    assert g.confirmed_parents("child") == [
+        ("p1", d, 4500),
+        ("p2", None, 0),
+    ]
+    # pop drops the edges and the now-unreferenced parent states
+    assert g.pop("child") is not None
+    assert g.confirmed_parents("child") == []
+    assert g._parent_state == {}
+
+
+def test_frontier_pref_arrays_weighs_holder_bytes():
+    from tpu_faas.graph.frontier import GraphFrontier
+
+    g = GraphFrontier()
+    g.add(_Task("c"), ["p1", "p2"])
+    d1, d2 = payload_digest("one"), payload_digest("two")
+    g.note_parent("p1", True, row=0, digest=d1, size=6000)
+    g.note_parent("p2", True, row=1, digest=d2, size=9000)
+    rows = {3: "c"}
+    # worker row 4 holds BOTH parents, row 7 only the bigger one
+    triplets = g.pref_arrays(
+        rows, 16, {d1: {4}, d2: {4, 7}}
+    )
+    assert triplets is not None
+    child, row, nbytes = triplets
+    acc = {
+        (int(c), int(r)): float(b)
+        for c, r, b in zip(child, row, nbytes)
+        if int(c) != 16
+    }
+    assert acc == {(3, 4): 15000.0, (3, 7): 9000.0}
+    # no digest-form parents anywhere -> None (jit signature stays off)
+    g2 = GraphFrontier()
+    g2.add(_Task("c"), ["p"])
+    g2.note_parent("p", True, row=0)
+    assert g2.pref_arrays({0: "c"}, 16, {}) is None
+
+
+# -- device lane: parent_pref ------------------------------------------------
+
+
+def test_parent_pref_scores_max_bytes_and_tie_breaks_low_row():
+    import jax.numpy as jnp
+
+    from tpu_faas.graph.frontier import pad_pref, parent_pref
+
+    T = 8
+    child, row, nbytes = pad_pref(
+        [2, 2, 5, 5], [3, 1, 6, 4], [100.0, 900.0, 500.0, 500.0], T
+    )
+    out = np.asarray(
+        parent_pref(
+            jnp.asarray(child), jnp.asarray(row), jnp.asarray(nbytes), T=T
+        )
+    )
+    assert out[2] == 1  # row 1 holds 900 > row 3's 100
+    assert out[5] == 4  # equal bytes: lowest row wins
+    assert all(out[i] == -1 for i in (0, 1, 3, 4, 6, 7))  # lane-free rows
+
+
+def test_parent_pref_xla_vs_pallas_interpret_parity():
+    """The _impl twin discipline: the same un-jitted body traced by XLA's
+    jit and inside a pallas_call (interpret mode on CPU CI) must produce
+    EXACTLY equal rows — any drift is a plumbing bug, exactly the
+    contract the solver kernels pin in test_sched_pallas.py."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from tpu_faas.graph.frontier import pad_pref, parent_pref_impl
+
+    T = 16
+    rng = np.random.default_rng(7)
+    lanes = 24
+    child = rng.integers(0, T, size=lanes).tolist()
+    row = rng.integers(0, 8, size=lanes).tolist()
+    nbytes = (rng.integers(0, 5, size=lanes) * 1024.0).tolist()
+    c, r, b = pad_pref(child, row, nbytes, T)
+
+    xla = np.asarray(
+        jax.jit(parent_pref_impl, static_argnames=("T",))(
+            jnp.asarray(c), jnp.asarray(r), jnp.asarray(b), T=T
+        )
+    )
+
+    def kernel(c_ref, r_ref, b_ref, o_ref):
+        o_ref[...] = parent_pref_impl(
+            c_ref[...], r_ref[...], b_ref[...], T=T
+        )
+
+    pallas = np.asarray(
+        pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((T,), jnp.int32),
+            interpret=True,
+        )(jnp.asarray(c), jnp.asarray(r), jnp.asarray(b))
+    )
+    assert (xla == pallas).all()
+
+
+def test_packed_tick_pref_lane_overrides_function_locality():
+    """Identical-placement pin for the composed lane: with the pref
+    triplets on, a ready child lands on the worker holding its parent's
+    result bytes even when function locality prefers another equal-speed
+    worker — and the pref-free call keeps its signature (None lanes)."""
+    import jax.numpy as jnp
+
+    from tpu_faas.graph.frontier import pad_pref
+    from tpu_faas.sched.state import _packed_tick
+
+    T, W = 8, 3
+    packed = np.zeros(T + 2 * W, dtype=np.float32)
+    packed[:T] = 1.0  # sizes
+    packed[T + W :] = 1.0  # one slot per worker: every worker gets a holder
+    common = dict(
+        n_valid=jnp.int32(3),
+        worker_speed=jnp.ones(W, jnp.float32),
+        worker_active=jnp.ones(W, dtype=bool),
+        prev_live=jnp.ones(W, dtype=bool),
+        inflight_worker=jnp.full(16, -1, jnp.int32),
+        time_to_expire=jnp.float32(60.0),
+        task_priority=None,
+        auction_price=None,
+    )
+    task_pref = np.full(T, -1, dtype=np.int32)
+    task_pref[0] = 1  # function locality: worker 1
+    base = _packed_tick(
+        jnp.asarray(packed),
+        *common.values(),
+        task_pref=jnp.asarray(task_pref),
+        T=T,
+        W=W,
+        max_slots=4,
+        placement="rank",
+    )
+    c, r, b = pad_pref([0], [2], [8192.0], T)  # result bytes: worker 2
+    pref = _packed_tick(
+        jnp.asarray(packed),
+        *common.values(),
+        task_pref=jnp.asarray(task_pref),
+        pref_child=jnp.asarray(c),
+        pref_row=jnp.asarray(r),
+        pref_bytes=jnp.asarray(b),
+        T=T,
+        W=W,
+        max_slots=4,
+        placement="rank",
+    )
+    # three equal tasks on three equal single-slot workers: the exchange
+    # can always swap task 0 onto its preferred row
+    assert int(np.asarray(base.assignment)[0]) == 1
+    assert int(np.asarray(pref.assignment)[0]) == 2
+
+
+# -- dispatcher: digest intake + reverse pulls --------------------------------
+
+
+def _mk_disp(**kw):
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+
+    defaults = dict(
+        ip="127.0.0.1",
+        port=0,
+        max_workers=64,
+        max_pending=256,
+        max_inflight=512,
+        tick_period=0.01,
+        recover_queued=False,
+        store=MemoryStore(),
+    )
+    defaults.update(kw)
+    return TpuPushDispatcher(**defaults)
+
+
+RBLOB_CAPS = ["blob", "bin", "batch", "rblob"]
+
+
+def _drain_announces(disp):
+    while disp.subscriber.get_message() is not None:
+        pass
+
+
+def test_result_blobs_requires_graph_frontier():
+    # frontier-less modes (resident/multihost/shared/mesh) refuse the
+    # plane at construction instead of silently never delivering deps
+    with pytest.raises(ValueError):
+        _mk_disp(result_blobs=True, shared=True)
+
+
+def test_digest_result_intake_and_dep_digest_dispatch():
+    """A digest-only RESULT writes the digest-form record, registers the
+    producer, and the waiting child's TASK frame ships dep_digests (no
+    body anywhere on the wire or in the store); the parent's own frame
+    carried rblob_min because its child was already waiting."""
+    disp = _mk_disp(result_blobs=True)
+    sent = []
+    orig = disp.send_task_frame
+
+    def spy(buf, wid, caps, task, blob, extra=None):
+        sent.append((task.task_id, extra))
+        return orig(buf, wid, caps, task, blob, extra)
+
+    disp.send_task_frame = spy
+    try:
+        store = disp.store
+        disp._handle(
+            b"w0", m.REGISTER, {"num_processes": 2, "caps": RBLOB_CAPS}
+        )
+        store.create_tasks(
+            [
+                (
+                    "child",
+                    "f",
+                    "p",
+                    {FIELD_DEPS: "parent", FIELD_PENDING_DEPS: "1"},
+                )
+            ],
+            status=TaskStatus.WAITING,
+        )
+        store.create_tasks([("parent", "f", "p", {FIELD_CHILDREN: "child"})])
+        disp.tick()
+        assert sent and sent[0][0] == "parent"
+        assert sent[0][1] == {"rblob_min": RESULT_BLOB_MIN_BYTES}
+        body = "R" * 6000
+        d = payload_digest(body)
+        disp._handle(
+            b"w0",
+            m.RESULT,
+            {
+                "task_id": "parent",
+                "status": COMPLETED,
+                "result_digest": d,
+                "result_size": len(body),
+            },
+        )
+        rec = store.hgetall("parent")
+        assert rec[FIELD_RESULT_DIGEST] == d and rec[FIELD_RESULT] == ""
+        assert disp._rblob_src[d] == b"w0"
+        assert d in disp._worker_rdigests[b"w0"]
+        assert store.get_status("child") == QUEUED
+        _drain_announces(disp)
+        disp.tick()
+        child_frames = [e for tid, e in sent if tid == "child"]
+        assert child_frames == [{"dep_digests": {"parent": d}}]
+        # zero result bytes round-tripped the store
+        assert disp.m_result_store_bytes.labels(dir="read").value == 0
+        assert disp.m_result_store_bytes.labels(dir="write").value == 0
+    finally:
+        disp.close()
+
+
+def test_reverse_pull_fans_out_to_worker_and_store():
+    """BLOB_MISS from a child worker AND a gateway blobreq for the same
+    digest: one pull to the producer, the FILL fans to the parked worker
+    and materializes into the store (request key deleted)."""
+    disp = _mk_disp(result_blobs=True)
+    wire = []
+    disp._send_worker = lambda wid, mt, **kw: wire.append((wid, mt, kw))
+    try:
+        body = "B" * 5000
+        d = payload_digest(body)
+        disp._handle(
+            b"prod", m.REGISTER, {"num_processes": 2, "caps": RBLOB_CAPS}
+        )
+        disp._handle(
+            b"cons", m.REGISTER, {"num_processes": 2, "caps": RBLOB_CAPS}
+        )
+        disp._rblob_note_producer(d, len(body), b"prod")
+        # a child worker misses, and a legacy reader asks via blobreq
+        disp._handle(b"cons", m.BLOB_MISS, {"digest": d})
+        disp.note_blobreq(d)
+        pulls = [w for w in wire if w[1] == m.BLOB_MISS]
+        assert pulls and all(w[0] == b"prod" for w in pulls)
+        assert disp._rblob_want[d] == [("worker", b"cons"), ("store", None)]
+        # the blobreq claim exists (gateway wrote it) — the fill clears it
+        disp.store.setnx_field(blobreq_key(d), BLOBREQ_AT_FIELD, "1.0")
+        disp._handle(b"prod", m.BLOB_FILL, {"digest": d, "data": body})
+        fills = [w for w in wire if w[1] == m.BLOB_FILL]
+        assert fills == [(b"cons", m.BLOB_FILL, {"digest": d, "data": body})]
+        assert d in disp._worker_rdigests[b"cons"]  # fill seeds the mirror
+        assert disp.store.get_blob(d) == body
+        assert disp.store.hget(blobreq_key(d), BLOBREQ_AT_FIELD) is None
+        assert disp.m_rblob_pulls.labels(outcome="filled").value == 1
+        assert (
+            disp.m_result_store_bytes.labels(dir="write").value == len(body)
+        )
+        assert d not in disp._rblob_want
+    finally:
+        disp.close()
+
+
+def test_reverse_pull_missing_body_fails_consumers():
+    disp = _mk_disp(result_blobs=True)
+    wire = []
+    disp._send_worker = lambda wid, mt, **kw: wire.append((wid, mt, kw))
+    try:
+        d = payload_digest("evicted")
+        disp._handle(
+            b"prod", m.REGISTER, {"num_processes": 2, "caps": RBLOB_CAPS}
+        )
+        disp._handle(
+            b"cons", m.REGISTER, {"num_processes": 2, "caps": RBLOB_CAPS}
+        )
+        disp._rblob_note_producer(d, 10, b"prod")
+        disp._handle(b"cons", m.BLOB_MISS, {"digest": d})
+        disp._handle(b"prod", m.BLOB_FILL, {"digest": d, "missing": True})
+        assert (b"cons", m.BLOB_FILL, {"digest": d, "missing": True}) in wire
+        assert d not in disp._rblob_src  # the source is forgotten
+        assert disp.m_rblob_pulls.labels(outcome="missing").value == 1
+        # a pull for a digest NO producer ever announced fails immediately
+        ghost = payload_digest("never")
+        disp._handle(b"cons", m.BLOB_MISS, {"digest": ghost})
+        assert (
+            b"cons",
+            m.BLOB_FILL,
+            {"digest": ghost, "missing": True},
+        ) in wire
+    finally:
+        disp.close()
+
+
+def test_reverse_pull_resend_sweep_and_reconnect_clears_mirror():
+    from tpu_faas.dispatch.tpu_push import _RBLOB_PULL_RESEND_S
+
+    disp = _mk_disp(result_blobs=True)
+    wire = []
+    disp._send_worker = lambda wid, mt, **kw: wire.append((wid, mt, kw))
+    now = [100.0]
+    disp.clock = lambda: now[0]
+    try:
+        d = payload_digest("slow")
+        disp._handle(
+            b"prod", m.REGISTER, {"num_processes": 2, "caps": RBLOB_CAPS}
+        )
+        disp._rblob_note_producer(d, 10, b"prod")
+        disp._rblob_pull(d, ("store", None))
+        assert len([w for w in wire if w[1] == m.BLOB_MISS]) == 1
+        disp._rblob_resend_sweep()  # too soon: no resend
+        assert len([w for w in wire if w[1] == m.BLOB_MISS]) == 1
+        now[0] += _RBLOB_PULL_RESEND_S + 0.1
+        disp._rblob_resend_sweep()
+        assert len([w for w in wire if w[1] == m.BLOB_MISS]) == 2
+        # a fresh-process RECONNECT (empty result cache) drops the mirror
+        assert disp._worker_rdigests.get(b"prod")
+        disp._handle(
+            b"prod",
+            m.RECONNECT,
+            {"free_processes": 2, "rcache_n": 0, "rcache_bytes": 0},
+        )
+        assert b"prod" not in disp._worker_rdigests
+    finally:
+        disp.close()
+
+
+def test_plane_off_frames_and_records_are_legacy_shaped():
+    """Both flags off: every TASK frame ships with extra=None (the wire
+    is byte-identical to the pre-plane dispatcher) and a full-body RESULT
+    writes the legacy record with no digest fields."""
+    disp = _mk_disp()
+    assert disp.result_blobs is False and disp.dep_results_on is False
+    sent = []
+    orig = disp.send_task_frame
+
+    def spy(buf, wid, caps, task, blob, extra=None):
+        sent.append((task.task_id, extra))
+        return orig(buf, wid, caps, task, blob, extra)
+
+    disp.send_task_frame = spy
+    try:
+        store = disp.store
+        disp._handle(
+            b"w0", m.REGISTER, {"num_processes": 2, "caps": RBLOB_CAPS}
+        )
+        store.create_tasks(
+            [
+                (
+                    "child",
+                    "f",
+                    "p",
+                    {FIELD_DEPS: "parent", FIELD_PENDING_DEPS: "1"},
+                )
+            ],
+            status=TaskStatus.WAITING,
+        )
+        store.create_tasks([("parent", "f", "p", {FIELD_CHILDREN: "child"})])
+        disp.tick()
+        # digest fields on the frame are ignored off-plane: a worker never
+        # sends them without rblob_min, but even a rogue one cannot flip
+        # the record into digest form
+        disp._handle(
+            b"w0",
+            m.RESULT,
+            {
+                "task_id": "parent",
+                "status": COMPLETED,
+                "result": "full-body",
+                "result_digest": payload_digest("x"),
+                "result_size": 1,
+            },
+        )
+        rec = store.hgetall("parent")
+        assert rec[FIELD_RESULT] == "full-body"
+        assert FIELD_RESULT_DIGEST not in rec
+        _drain_announces(disp)
+        disp.tick()
+        assert sent and all(extra is None for _tid, extra in sent)
+        assert not disp._rblob_src and not disp._result_meta
+    finally:
+        disp.close()
+
+
+# -- in-process e2e: worker digest ship, cache delivery, gateway read --------
+
+
+def _make_chain(store, n_kib=8, tag="mrg"):
+    """parent (big_result) -> child (merge_deps) directly in the store."""
+    store.create_tasks(
+        [
+            (
+                "child",
+                serialize(merge_deps),
+                pack_params(tag),
+                {FIELD_DEPS: "parent", FIELD_PENDING_DEPS: "1"},
+            )
+        ],
+        status=TaskStatus.WAITING,
+    )
+    store.create_tasks(
+        [
+            (
+                "parent",
+                serialize(big_result),
+                pack_params(n_kib),
+                {FIELD_CHILDREN: "child"},
+            )
+        ]
+    )
+
+
+def test_result_plane_e2e_chain_never_round_trips_store():
+    """Full in-process stack: TpuPushDispatcher(--result-blobs) + a real
+    PushWorker. The parent's 8 KiB result stays in the worker's result
+    cache (digest-form record, zero result store bytes in either
+    direction), the child consumes it via dep_digests from that cache,
+    and a legacy gateway reader then materializes the body on demand
+    through the reverse pull."""
+    store = MemoryStore()
+    disp = _mk_disp(result_blobs=True, store=store)
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    gw = start_gateway_thread(store)
+    _make_chain(store)
+    worker = PushWorker(
+        2,
+        f"tcp://127.0.0.1:{disp.port}",
+        heartbeat=True,
+        heartbeat_period=0.2,
+    )
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    try:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if store.get_status("child") == COMPLETED:
+                break
+            time.sleep(0.02)
+        status, child_result = store.get_result("child")
+        assert status == COMPLETED
+        # every parent byte arrived at the child (8 KiB body, 1 parent)
+        assert deserialize(child_result) == "mrg:1:8192"
+        # the parent record is digest-form: no body in the store
+        rec = store.hgetall("parent")
+        digest = rec[FIELD_RESULT_DIGEST]
+        assert rec[FIELD_RESULT] == "" and int(rec[FIELD_RESULT_SIZE]) > 4096
+        assert store.get_blob(digest) is None  # never materialized so far
+        assert worker.result_cache.hits >= 1  # dep served from the cache
+        assert disp.m_result_store_bytes.labels(dir="read").value == 0
+        # the only result body the store ever saw is the child's own tiny
+        # final answer (below the blob threshold — a leaf result is FOR
+        # the client, it must land); the parent's 8 KiB never wrote
+        assert (
+            0
+            < disp.m_result_store_bytes.labels(dir="write").value
+            < RESULT_BLOB_MIN_BYTES
+        )
+        # legacy reader: gateway /result materializes via the reverse pull
+        r = requests.get(f"{gw.url}/result/parent", timeout=10)
+        assert r.status_code == 200
+        body = r.json()["result"]
+        assert deserialize(body) == big_result(8)
+        assert store.get_blob(digest) == body  # now store-resident
+        assert disp.m_rblob_pulls.labels(outcome="filled").value >= 1
+    finally:
+        worker.stop()
+        wt.join(timeout=10)
+        gw.stop()
+        disp.stop()
+        t.join(timeout=10)
+        disp.close()
+
+
+def test_dep_results_control_lane_reads_bodies_from_store():
+    """--dep-results without --result-blobs: the store-mediated control
+    lane. The parent's full body lands in the store, and the child's
+    frame carries dep_results read back from it — the read the digest
+    path deletes, counted in result_store_bytes{dir=read}."""
+    store = MemoryStore()
+    disp = _mk_disp(dep_results=True, store=store)
+    assert disp.dep_results_on and not disp.result_blobs
+    t = threading.Thread(target=disp.start, daemon=True)
+    t.start()
+    _make_chain(store, tag="ctl")
+    worker = PushWorker(
+        2,
+        f"tcp://127.0.0.1:{disp.port}",
+        heartbeat=True,
+        heartbeat_period=0.2,
+    )
+    wt = threading.Thread(target=worker.run, daemon=True)
+    wt.start()
+    try:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if store.get_status("child") == COMPLETED:
+                break
+            time.sleep(0.02)
+        status, child_result = store.get_result("child")
+        assert status == COMPLETED
+        assert deserialize(child_result) == "ctl:1:8192"
+        rec = store.hgetall("parent")
+        assert rec[FIELD_RESULT] != ""  # full body in the store
+        assert FIELD_RESULT_DIGEST not in rec
+        assert disp.m_result_store_bytes.labels(dir="read").value >= 8192
+        assert worker.result_cache.hits == 0  # nothing rode the cache
+    finally:
+        worker.stop()
+        wt.join(timeout=10)
+        disp.stop()
+        t.join(timeout=10)
+        disp.close()
+
+
+def test_gateway_returns_410_when_body_unrecoverable():
+    """A digest-form record whose producer is gone (no dispatcher will
+    ever answer the blobreq): the gateway's bounded materialization poll
+    expires and the reader gets a permanent 410, not a hang."""
+    from tpu_faas.gateway import app as gw_app
+
+    store = MemoryStore()
+    store.create_task("t-gone", "f", "p")
+    d = payload_digest("lost-forever")
+    store.finish_task(
+        "t-gone", COMPLETED, "", result_digest=d, result_size=12
+    )
+    gw = start_gateway_thread(store)
+    old_wait = gw_app._BLOBREQ_WAIT_S
+    gw_app._BLOBREQ_WAIT_S = 0.3  # keep the test fast
+    try:
+        r = requests.get(f"{gw.url}/result/t-gone", timeout=10)
+        assert r.status_code == 410
+        # the request claim was left for the sweeper to age out
+        assert store.hget(blobreq_key(d), BLOBREQ_AT_FIELD) is not None
+    finally:
+        gw_app._BLOBREQ_WAIT_S = old_wait
+        gw.stop()
+
+
+def test_blob_gc_result_blobs_and_blobreq_aging():
+    """Satellite: the refcount-or-TTL sweep extends to result blobs — a
+    blob referenced by a digest-form record survives any staleness, an
+    orphaned one ages out, and stale blobreq claims are collected."""
+    from tpu_faas.gateway.app import _sweep_expired_results
+    from tpu_faas.store.base import BLOB_AT_FIELD, blob_key
+
+    store = MemoryStore()
+    now = time.time()
+    # blobs age at 4x the result TTL (a refill costs more than a stale
+    # record): 15 000 s > 4 * 3600, past both the blob and blobreq bars
+    old = repr(now - 15_000.0)
+    # referenced by a terminal digest-form record: kept however stale
+    d_ref = payload_digest("REFERENCED")
+    store.put_blob(d_ref, "REFERENCED")
+    store.hset(blob_key(d_ref), {BLOB_AT_FIELD: old})
+    store.create_task("t-done", "f", "p")
+    store.finish_task(
+        "t-done", COMPLETED, "", result_digest=d_ref, result_size=10
+    )
+    # orphaned result blob (its record was swept long ago): collected
+    d_orphan = payload_digest("ORPHANED")
+    store.put_blob(d_orphan, "ORPHANED")
+    store.hset(blob_key(d_orphan), {BLOB_AT_FIELD: old})
+    # stale + fresh blobreq claims
+    d_req = payload_digest("REQ")
+    store.setnx_field(blobreq_key(d_req), BLOBREQ_AT_FIELD, old)
+    d_req2 = payload_digest("REQ2")
+    store.setnx_field(blobreq_key(d_req2), BLOBREQ_AT_FIELD, repr(now))
+    _sweep_expired_results(store, ttl=3600.0, now=now)
+    assert store.get_blob(d_ref) == "REFERENCED"
+    assert store.get_blob(d_orphan) is None
+    assert store.hget(blobreq_key(d_req), BLOBREQ_AT_FIELD) is None
+    assert store.hget(blobreq_key(d_req2), BLOBREQ_AT_FIELD) is not None

@@ -52,6 +52,7 @@ from tpu_faas.analysis.protocol import _in_store_package
 #: drift silently.
 from tpu_faas.store.base import (
     BLOB_PREFIX,
+    BLOBREQ_PREFIX,
     DISPATCHERS_KEY,
     LEASE_CONF_KEY,
     LIVE_INDEX_KEY,
@@ -69,6 +70,10 @@ NAMESPACES: tuple[tuple[str, str, str], ...] = (
     (DISPATCHERS_KEY, "exact", "broadcast"),
     ("fleet:", "prefix", "broadcast"),
     (BLOB_PREFIX, "prefix", "routed"),  # blob:<sha256>
+    # blobreq:<sha256> — lazy-materialization request claims (result-blob
+    # plane): ring-routed by digest so a requesting gateway and the
+    # sweeper that ages the claim land on the same shard
+    (BLOBREQ_PREFIX, "prefix", "routed"),
     (TRACE_PREFIX, "prefix", "routed"),  # trace:<trace_id>
     ("function_digest:", "prefix", "routed"),
     ("dep_done:", "prefix", "routed"),  # per-edge claim fields
@@ -86,12 +91,14 @@ KNOWN_CONSTANTS: dict[str, str] = {
     "LEASE_CONF_KEY": LEASE_CONF_KEY,
     "DISPATCHERS_KEY": DISPATCHERS_KEY,
     "BLOB_PREFIX": BLOB_PREFIX,
+    "BLOBREQ_PREFIX": BLOBREQ_PREFIX,
     "TRACE_PREFIX": TRACE_PREFIX,
 }
 
 #: Key-building helpers whose result namespace is known by construction.
 _HELPER_PREFIXES: dict[str, str] = {
     "blob_key": BLOB_PREFIX,
+    "blobreq_key": BLOBREQ_PREFIX,
     "trace_key": TRACE_PREFIX,
     "dep_done_field": "dep_done:",
 }

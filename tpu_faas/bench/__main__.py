@@ -30,7 +30,8 @@ def main(argv: list[str] | None = None) -> None:
         "18 (tail hedging: straggler speculation vs an injected sick "
         "worker), 19 (composed tail-SLO: every opt-in plane at once), "
         "20 (chaos scenario: seeded fault plane + health-scored "
-        "quarantine), or 'all'",
+        "quarantine), 21 (graph data locality: result blobs vs "
+        "store-mediated deps), or 'all'",
     )
     ap.add_argument(
         "-m", "--mode", default="push",

@@ -4182,6 +4182,188 @@ def config_20_chaos_quarantine() -> dict:
         chaos_mod._reset_for_tests()
 
 
+def _graph_locality_leg(
+    result_blobs: bool,
+    width: int,
+    rounds: int,
+    n_workers: int,
+    n_procs: int,
+    n_kib: int,
+) -> dict:
+    """One graph-locality leg over the real stack: store server over TCP,
+    gateway, tpu-push dispatcher, in-process PushWorker threads (their
+    ``result_cache`` counters are the leg's cache-hit evidence — a
+    subprocess fleet would hide them). ``result_blobs=False`` is the
+    store-mediated CONTROL (--dep-results): parent bodies finish into
+    the store and the dispatcher reads them back per child. True is the
+    TREATMENT (--result-blobs): digest-only results, bodies riding
+    worker caches edge-to-edge."""
+    import threading as _threading
+
+    from tpu_faas.client import FaaSClient
+    from tpu_faas.dispatch.tpu_push import TpuPushDispatcher
+    from tpu_faas.gateway import start_gateway_thread
+    from tpu_faas.store.launch import make_store, start_store_thread
+    from tpu_faas.worker.push_worker import PushWorker
+    from tpu_faas.workloads import big_result, merge_deps, no_op
+
+    nodes_per_graph = width + 1
+    handle = start_store_thread()
+    gw = start_gateway_thread(make_store(handle.url))
+    disp = TpuPushDispatcher(
+        ip="127.0.0.1",
+        port=0,
+        store=make_store(handle.url),
+        max_workers=max(64, n_workers),
+        max_pending=max(256, 4 * nodes_per_graph * rounds),
+        max_inflight=4096,
+        max_slots=n_procs,
+        tick_period=0.005,
+        dep_results=not result_blobs,
+        result_blobs=result_blobs,
+    )
+    disp_thread = _threading.Thread(target=disp.start, daemon=True)
+    disp_thread.start()
+    url = f"tcp://127.0.0.1:{disp.port}"
+    workers = [
+        PushWorker(n_procs, url, heartbeat=True, heartbeat_period=0.5)
+        for _ in range(n_workers)
+    ]
+    worker_threads = [
+        _threading.Thread(target=w.run, daemon=True) for w in workers
+    ]
+    for t in worker_threads:
+        t.start()
+    client = FaaSClient(gw.url)
+    try:
+        time.sleep(1.0)  # workers register
+        # warmup outside the measured window (pool spawn + dill decode)
+        wfid = client.register(no_op)
+        for h in client.submit_many(
+            wfid, [((), {})] * (2 * n_procs * n_workers)
+        ):
+            h.result(timeout=120.0)
+        read0 = disp.m_result_store_bytes.labels(dir="read").value
+        write0 = disp.m_result_store_bytes.labels(dir="write").value
+        makespans: list[float] = []
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            g = client.graph()
+            parents = [
+                g.call(big_result, n_kib, seed=r * width + i)
+                for i in range(width)
+            ]
+            sink = g.call(merge_deps, f"r{r}", after=parents)
+            g.submit()
+            t_g = time.perf_counter()
+            merged = sink.result(timeout=300.0)
+            makespans.append(time.perf_counter() - t_g)
+            # correctness oracle: the sink saw every parent byte on BOTH
+            # lanes (merge_deps reports parent count + total chars)
+            assert merged == f"r{r}:{width}:{width * n_kib * 1024}", merged
+        leg_s = time.perf_counter() - t0
+        n_results = nodes_per_graph * rounds
+        read_b = disp.m_result_store_bytes.labels(dir="read").value - read0
+        write_b = (
+            disp.m_result_store_bytes.labels(dir="write").value - write0
+        )
+        return {
+            "completed": len(makespans),
+            "leg_s": round(leg_s, 3),
+            "makespan_p50_s": round(
+                float(np.percentile(makespans, 50)), 4
+            ),
+            "makespan_max_s": round(max(makespans), 4),
+            # the headline quantity: RESULT bytes that round-tripped the
+            # store, per graph node (control pays a write per parent
+            # body plus a read per delivered dep; the digest lane pays
+            # only the sink's small final answer)
+            "result_store_read_bytes": int(read_b),
+            "result_store_write_bytes": int(write_b),
+            "result_store_bytes_per_task": round(
+                (read_b + write_b) / max(n_results, 1), 1
+            ),
+            "worker_rcache_hits": sum(
+                w.result_cache.hits for w in workers
+            ),
+            "worker_rcache_misses": sum(
+                w.result_cache.misses for w in workers
+            ),
+            "rblob_pulls_filled": disp.m_rblob_pulls.labels(
+                outcome="filled"
+            ).value,
+            "frontier_dispatches": disp.n_frontier_dispatches,
+        }
+    finally:
+        for w in workers:
+            w.stop()
+        for t in worker_threads:
+            t.join(timeout=10)
+        disp.stop()
+        disp_thread.join(timeout=10)
+        gw.stop()
+        handle.stop()
+
+
+def config_21_graph_locality() -> dict:
+    """Graph data locality (config 21): the result data plane's headline
+    row — a map-reduce graph (``width`` parents each producing an
+    ``result_kib``-KiB body, one sink consuming them all, repeated
+    ``rounds`` times) run twice over the full real stack:
+
+    - **control leg** (--dep-results): parent results finish into the
+      store; the dispatcher reads every body back and ships it inline on
+      the sink's TASK frame. Every parent byte round-trips the store.
+    - **blobs leg** (--result-blobs): workers hash-and-hold large
+      results, records carry digests, and the sink's frame carries
+      ``dep_digests`` served from worker result caches — parent bytes
+      never touch the store.
+
+    Reported per leg: makespan percentiles, result store bytes per
+    graph node (read + write), worker result-cache hit counts, and the
+    reduction ratio the acceptance bar asserts (>= 5x on the default
+    shape). Shape via TPU_FAAS_BENCH_RBLOB_SHAPE=
+    "width,rounds,workers,procs,result_kib" (default "8,6,4,2,16"); the
+    CI graph-locality-smoke lane runs "4,3,2,2,8"."""
+    import os
+
+    shape = os.environ.get("TPU_FAAS_BENCH_RBLOB_SHAPE", "8,6,4,2,16")
+    width, rounds, n_workers, n_procs, n_kib = (
+        int(x) for x in shape.split(",")
+    )
+    control = _graph_locality_leg(
+        False, width, rounds, n_workers, n_procs, n_kib
+    )
+    blobs = _graph_locality_leg(
+        True, width, rounds, n_workers, n_procs, n_kib
+    )
+    return {
+        "config": "graph-locality",
+        "shape": {
+            "width": width,
+            "rounds": rounds,
+            "workers": n_workers,
+            "procs": n_procs,
+            "result_kib": n_kib,
+            "nodes": (width + 1) * rounds,
+        },
+        "control": control,
+        "blobs": blobs,
+        # acceptance headline: store result-bytes per graph node,
+        # store-mediated vs digest lane
+        "result_store_bytes_per_task_reduction_x": round(
+            control["result_store_bytes_per_task"]
+            / max(blobs["result_store_bytes_per_task"], 1e-9),
+            2,
+        ),
+        "makespan_p50_speedup_x": round(
+            control["makespan_p50_s"]
+            / max(blobs["makespan_p50_s"], 1e-9),
+            3,
+        ),
+    }
+
+
 CONFIGS = {
     "1": config_1_push_sleep,
     "2": config_2_pull_mixed,
@@ -4203,4 +4385,5 @@ CONFIGS = {
     "18": config_18_tail_hedging,
     "19": config_19_composed_slo,
     "20": config_20_chaos_quarantine,
+    "21": config_21_graph_locality,
 }

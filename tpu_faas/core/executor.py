@@ -56,6 +56,30 @@ def _cached_fn(ser_fn: str, fn_digest: str | None):
     return fn
 
 
+#: Child-side parent-result delivery (result-blob plane): while a graph
+#: child executes, the serialized results of its confirmed parents —
+#: shipped on the TASK frame as digests or bodies and resolved through
+#: the worker's result cache — sit here. Plain module global: a pool
+#: child executes one task at a time, and execute_fn scopes it to the
+#: call. None everywhere the plane is off, so flat tasks and legacy
+#: deployments never see it.
+_DEP_RESULTS: dict[str, str] | None = None
+
+
+def dep_results() -> dict[str, str]:
+    """The executing graph child's parent results, parent task id ->
+    SERIALIZED body; {} for flat tasks and delivery-off deployments.
+    Functions opt in by calling this — graph edges stay ordering-only
+    (examples/task_graphs.py) for everyone else."""
+    return dict(_DEP_RESULTS) if _DEP_RESULTS else {}
+
+
+def dep_values() -> dict[str, object]:
+    """:func:`dep_results` with every body deserialized — the convenient
+    form for fan-in consumers (``sum(dep_values().values())``-style)."""
+    return {pid: deserialize(body) for pid, body in dep_results().items()}
+
+
 class ExecutionResult(NamedTuple):
     task_id: str
     #: plain string, wire/store form: "COMPLETED" | "FAILED" | "CANCELLED"
@@ -108,6 +132,7 @@ def execute_fn(
     ser_params: str,
     timeout: float | None = None,
     fn_digest: str | None = None,
+    dep_results: dict[str, str] | None = None,
 ) -> ExecutionResult:
     """Execute one task; never raises.
 
@@ -126,8 +151,10 @@ def execute_fn(
     """
     import time
 
+    global _DEP_RESULTS
     t0_wall = time.time()
     t0 = time.perf_counter()
+    _DEP_RESULTS = dep_results
     try:
         res = _execute_guarded(task_id, ser_fn, ser_params, timeout, fn_digest)
     except TaskTimeout as exc:
@@ -148,6 +175,10 @@ def execute_fn(
         res = ExecutionResult(
             task_id, str(TaskStatus.CANCELLED), serialize(exc)
         )
+    finally:
+        # scope the delivery to this call: a later plane-off task in the
+        # same child must see {} from dep_results(), not stale parents
+        _DEP_RESULTS = None
     return res._replace(
         elapsed=time.perf_counter() - t0, started_at=t0_wall
     )

@@ -28,6 +28,15 @@ def payload_digest(payload: str) -> str:
     return hashlib.sha256(payload.encode("ascii", "replace")).hexdigest()
 
 
+#: Result-blob plane (``--result-blobs``): the default minimum result size
+#: that ships as a digest instead of a body. Below this the digest (64 hex
+#: chars) plus the bookkeeping costs more than the bytes it replaces; the
+#: default tracks the express lane's inline bound (store/base.py
+#: RESULT_INLINE_MAX_BYTES) so "small enough to inline" and "too small to
+#: blob" agree out of the box.
+RESULT_BLOB_MIN_BYTES = 4096
+
+
 class PayloadLRU:
     """Bounded digest -> payload cache, evicting least-recently-used.
 

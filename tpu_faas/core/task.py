@@ -110,6 +110,21 @@ FIELD_SPECULATIVE = "speculative"
 #: dispatch falls back per record, so the two populations mix freely on
 #: one store.
 FIELD_FN_DIGEST = "fn_digest"
+#: Content address (sha256 hex) of the task's serialized RESULT — the
+#: result-blob plane's mirror of FIELD_FN_DIGEST. Written by finish_task
+#: when a ``--result-blobs`` dispatcher records a digest-form result: the
+#: record's FIELD_RESULT may then be EMPTY, the bytes staying in the
+#: producing worker's result cache (and, once anything needed them, under
+#: the store's ``blob:<digest>`` key — lazy materialization,
+#: store/base.py BLOBREQ_ANNOUNCE_PREFIX). Absent on every legacy record
+#: and whenever the plane is off, so reference-style readers that only
+#: know FIELD_RESULT keep their contract byte for byte.
+FIELD_RESULT_DIGEST = "result_digest"
+#: Byte length of the digest-form result body (int as str), written in the
+#: same terminal write as FIELD_RESULT_DIGEST: readers and the placement
+#: tick's parent-locality lane can reason about result SIZE without
+#: materializing the bytes.
+FIELD_RESULT_SIZE = "result_size"
 
 #: Written by finish_task alongside every terminal write (epoch seconds as
 #: str) — lets the gateway's optional result-TTL sweeper age out consumed

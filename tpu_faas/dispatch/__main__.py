@@ -307,6 +307,32 @@ def main(argv: list[str] | None = None) -> None:
         "byte-identical to the default",
     )
     ap.add_argument(
+        "--result-blobs", action="store_true",
+        help="tpu-push: result data plane — workers with the rblob "
+        "capability hash large graph-consumed results and return "
+        "digest-only RESULT frames; bodies stay in per-worker result "
+        "caches and move worker-to-worker along graph edges "
+        "(dep_digests on TASK frames, misses re-filled via reverse "
+        "BLOB_MISS pulls from the producer), materializing into the "
+        "store only when a legacy reader asks. Implies --dep-results. "
+        "Single-device batch-path feature (needs the graph frontier); "
+        "off keeps every wire/store surface byte-identical",
+    )
+    ap.add_argument(
+        "--dep-results", action="store_true",
+        help="tpu-push: deliver confirmed parents' serialized results on "
+        "each graph child's TASK frame (executor.dep_results() in the "
+        "pool child). Without --result-blobs the bodies are read from "
+        "the store at dispatch — the store-mediated control lane the "
+        "result data plane is benched against",
+    )
+    ap.add_argument(
+        "--result-blob-min", type=int, default=None, metavar="B",
+        help="tpu-push --result-blobs: only COMPLETED results of at "
+        "least B bytes take the digest path (smaller ones ship inline "
+        "as always; default core/payload.RESULT_BLOB_MIN_BYTES)",
+    )
+    ap.add_argument(
         "--speculate-min-s", type=float, default=0.05, metavar="S",
         help="tpu-push: absolute floor — an execution under S seconds is "
         "never flagged however tight its prediction (scheduling jitter "
@@ -537,6 +563,9 @@ def main(argv: list[str] | None = None) -> None:
             columnar=ns.columnar,
             arena_capacity=ns.arena_capacity,
             store_binbatch=ns.store_binbatch,
+            result_blobs=ns.result_blobs,
+            dep_results=ns.dep_results,
+            result_blob_min=ns.result_blob_min,
         )
     if ns.mode == "tpu-push" and ns.multihost:
         # Lead-side failure containment: once the followers joined the

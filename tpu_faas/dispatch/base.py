@@ -30,7 +30,10 @@ from dataclasses import dataclass
 
 from tpu_faas.admission.signal import CapacitySnapshot, publish_snapshot
 from tpu_faas.core.columns import RowTask, TaskColumns
-from tpu_faas.core.payload import PayloadLRU
+from tpu_faas.core.payload import (
+    RESULT_BLOB_MIN_BYTES,
+    PayloadLRU,
+)
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import (
     FIELD_CHILDREN,
@@ -68,6 +71,7 @@ from tpu_faas.obs.slo import (
     objectives_from_env,
 )
 from tpu_faas.store.base import (
+    BLOBREQ_ANNOUNCE_PREFIX,
     CANCEL_ANNOUNCE_PREFIX,
     DISPATCHERS_KEY,
     KILL_ANNOUNCE_PREFIX,
@@ -456,6 +460,40 @@ class TaskDispatcher:
             "params — the spread vs tasks_dispatched_total IS the "
             "payload plane's wire saving",
         )
+        # -- result-blob plane (content-addressed RESULT bodies) -----------
+        #: ``--result-blobs``: workers ship large graph-consumed results
+        #: as digests (body stays in the producer's result cache) and the
+        #: store records the digest form — bodies materialize lazily via
+        #: reverse BLOB_MISS pulls. Off (default) keeps every wire and
+        #: store surface byte-identical.
+        self.result_blobs = False
+        #: ``--dep-results``: deliver parent result BODIES on graph
+        #: children's TASK frames (fetched from the store when not blob-
+        #: shipped — the store-mediated control the bench compares
+        #: against). --result-blobs implies the delivery lane.
+        self.dep_results_on = False
+        #: minimum completed-result size (bytes) that ships digest-only
+        self.result_blob_min = RESULT_BLOB_MIN_BYTES
+        self.m_result_store_bytes = self.metrics.counter(
+            "tpu_faas_dispatcher_result_store_bytes_total",
+            "Result-body bytes exchanged with the STORE, by direction: "
+            "dir=\"write\" terminal-write bodies (digest-form writes count "
+            "0), dir=\"read\" parent bodies fetched for --dep-results "
+            "delivery. write/results is the store-round-trip collapse the "
+            "result-blob bench asserts on",
+            ("dir",),
+        )
+        for d in ("write", "read"):
+            self.m_result_store_bytes.labels(dir=d)
+        self.m_rblob_pulls = self.metrics.counter(
+            "tpu_faas_dispatcher_result_blob_pulls_total",
+            "Reverse BLOB_MISS pulls sent to producer workers, by outcome "
+            "(filled = body arrived and was materialized, missing = the "
+            "producer's cache had evicted it)",
+            ("outcome",),
+        )
+        for oc in ("filled", "missing"):
+            self.m_rblob_pulls.labels(outcome=oc)
         # -- batched data plane (TASK_BATCH/RESULT_BATCH frames) -----------
         #: dispatcher-side batching knob: >= 2 groups a round's assignments
         #: into one TASK_BATCH frame per CAP_BATCH worker (push-family
@@ -616,8 +654,11 @@ class TaskDispatcher:
                 pass  # the serve loop's renewals will retry
         #: result writes that hit a store outage, replayed by
         #: flush_deferred_results() once the store is back — a worker's
-        #: finished result must survive a store restart, not evaporate
-        self.deferred_results: deque[tuple[str, str, str, bool]] = deque()
+        #: finished result must survive a store restart, not evaporate.
+        #: 4-tuples (task_id, status, result, first_wins), extended to
+        #: 6-tuples with (result_digest, result_size) for digest-form
+        #: writes (result-blob plane)
+        self.deferred_results: deque[tuple] = deque()
         #: announcements consumed from the subscription whose payload fetch
         #: hit an outage; re-tried before reading the bus again (the bus is
         #: fire-and-forget, so dropping a consumed announce loses the task)
@@ -788,7 +829,9 @@ class TaskDispatcher:
         if self._chaos_wire is not None:
             self._chaos_wire.flush(self.socket.send_multipart)
 
-    def send_task_frame(self, buf: dict, wid, caps, task, blob: bool) -> None:
+    def send_task_frame(
+        self, buf: dict, wid, caps, task, blob: bool, extra: dict | None = None
+    ) -> None:
         """Send — or buffer for a per-worker TASK_BATCH — one assignment.
 
         The batching gate is capability-negotiated AND operator-opted:
@@ -800,10 +843,15 @@ class TaskDispatcher:
         never exceeds the knob. Callers MUST drain the buffer with
         flush_task_frames before the send round's bookkeeping completes
         (put it in the finally: a buffered task is already tracked
-        in-flight, so its frame must reach the wire even on an abort)."""
+        in-flight, so its frame must reach the wire even on an abort).
+        ``extra`` merges additional per-task wire fields (result-blob
+        plane: rblob_min / dep_digests / dep_results); None adds nothing,
+        keeping the frame byte-identical."""
         kw = task.task_message_kwargs(
             blob=blob, trace=_wm.CAP_TRACE in caps
         )
+        if extra:
+            kw.update(extra)
         if self.batch_max >= 2 and _wm.CAP_BATCH in caps:
             ent = buf.get(wid)
             if ent is None:
@@ -932,6 +980,15 @@ class TaskDispatcher:
         serve loop can relay a CANCEL to the owning worker."""
         self.kill_requested = self._note(self.kill_requested, task_id)
 
+    def note_blobreq(self, digest: str) -> None:
+        """A ``!blobreq:<digest>`` materialization request arrived (a
+        reader hit a digest-form result record whose blob body is not in
+        the store). Default: ignore — only the push-family dispatcher
+        under ``--result-blobs`` can pull the body from a producer
+        worker's cache (tpu_push overrides). The gateway's bounded poll
+        then times the request out against the dead-producer failure
+        mode."""
+
     #: drain_control_messages stops parking announces past this backlog
     #: size — further messages stay in the transport buffer (exactly where
     #: they would sit without the control drain), so a saturated fleet
@@ -954,6 +1011,8 @@ class TaskDispatcher:
                 self.note_cancelled(msg[len(CANCEL_ANNOUNCE_PREFIX):])
             elif msg.startswith(KILL_ANNOUNCE_PREFIX):
                 self.note_kill(msg[len(KILL_ANNOUNCE_PREFIX):])
+            elif msg.startswith(BLOBREQ_ANNOUNCE_PREFIX):
+                self.note_blobreq(msg[len(BLOBREQ_ANNOUNCE_PREFIX):])
             else:
                 self._announce_backlog.append(msg)
 
@@ -1403,6 +1462,8 @@ class TaskDispatcher:
                 self.note_cancelled(msg[len(CANCEL_ANNOUNCE_PREFIX):])
             elif msg.startswith(KILL_ANNOUNCE_PREFIX):
                 self.note_kill(msg[len(KILL_ANNOUNCE_PREFIX):])
+            elif msg.startswith(BLOBREQ_ANNOUNCE_PREFIX):
+                self.note_blobreq(msg[len(BLOBREQ_ANNOUNCE_PREFIX):])
             else:
                 self.traces.note(msg, "announced")
                 msgs.append(msg)
@@ -1837,14 +1898,25 @@ class TaskDispatcher:
         self.store.set_status(task_id, TaskStatus.RUNNING, extra_fields=extra)
 
     def record_result(
-        self, task_id: str, status: str, result: str, first_wins: bool = False
+        self,
+        task_id: str,
+        status: str,
+        result: str,
+        first_wins: bool = False,
+        result_digest: str | None = None,
+        result_size: int = 0,
     ) -> None:
         """``first_wins=True`` on paths where a second result for the same
-        task is possible (zombie worker of a re-dispatched task)."""
+        task is possible (zombie worker of a re-dispatched task).
+        ``result_digest``/``result_size`` (result-blob plane): record the
+        DIGEST FORM — the record stores the digest instead of the body,
+        which stays in the producing worker's cache until pulled."""
         self.store.finish_task(
             task_id, status, result,
             first_wins=first_wins, inline_max=self.inline_result_max,
+            result_digest=result_digest, result_size=result_size,
         )
+        self.m_result_store_bytes.labels(dir="write").inc(len(result))
         self._note_finished(task_id, status)
         self.complete_deps_safe([(task_id, status)])
 
@@ -1895,20 +1967,22 @@ class TaskDispatcher:
         worker-message drain into one ``finish_task_many`` round (plus one
         status pre-read for the first_wins slice, on RESP backends). Items
         are (task_id, status, result, first_wins) — the deferred_results
-        tuple shape. A store outage defers EVERY item, order preserved,
+        tuple shape — optionally extended to 6-tuples with
+        (result_digest, result_size) for digest-form writes (result-blob
+        plane). A store outage defers EVERY item, order preserved,
         for flush_deferred_results to replay. Returns items written now."""
         if not items:
             return 0
+        items = list(items)
         try:
             self.store.finish_task_many(
-                list(items), inline_max=self.inline_result_max
+                items, inline_max=self.inline_result_max
             )
             self.note_store_up()
-            for task_id, status, _result, _fw in items:
-                self._note_finished(task_id, status)
-            self.complete_deps_safe(
-                [(tid, status) for tid, status, _r, _fw in items]
-            )
+            for it in items:
+                self.m_result_store_bytes.labels(dir="write").inc(len(it[2]))
+                self._note_finished(it[0], it[1])
+            self.complete_deps_safe([(it[0], it[1]) for it in items])
             return len(items)
         except STORE_OUTAGE_ERRORS as exc:
             # a mid-pipeline loss is ambiguous (a prefix may have applied);
@@ -1921,7 +1995,13 @@ class TaskDispatcher:
             return 0
 
     def record_result_safe(
-        self, task_id: str, status: str, result: str, first_wins: bool = False
+        self,
+        task_id: str,
+        status: str,
+        result: str,
+        first_wins: bool = False,
+        result_digest: str | None = None,
+        result_size: int = 0,
     ) -> bool:
         """Like record_result, but a store outage defers the write instead of
         raising: the result was already computed and received — losing it
@@ -1929,14 +2009,26 @@ class TaskDispatcher:
         never re-dispatched). Returns False when deferred."""
         try:
             # record_result closes the timeline + counts the result
-            self.record_result(task_id, status, result, first_wins=first_wins)
+            self.record_result(
+                task_id, status, result, first_wins=first_wins,
+                result_digest=result_digest, result_size=result_size,
+            )
             self.note_store_up()
             return True
         except STORE_OUTAGE_ERRORS as exc:
             # pause=0: this runs inside the worker-message drain loop, where
             # a per-message sleep would stall the fleet; backoff belongs to
-            # the outer serve loop
-            self.deferred_results.append((task_id, status, result, first_wins))
+            # the outer serve loop. Digest-form writes defer as 6-tuples;
+            # the classic 4-tuple shape is preserved for everything else.
+            if result_digest:
+                self.deferred_results.append(
+                    (task_id, status, result, first_wins,
+                     result_digest, result_size)
+                )
+            else:
+                self.deferred_results.append(
+                    (task_id, status, result, first_wins)
+                )
             self.note_store_outage(exc, pause=0)
             return False
 
@@ -1977,12 +2069,10 @@ class TaskDispatcher:
             except STORE_OUTAGE_ERRORS as exc:
                 self.note_store_outage(exc)
                 break
-            for task_id, status, _result, _fw in chunk:
+            for it in chunk:
                 self.deferred_results.popleft()
-                self._note_finished(task_id, status)
-            self.complete_deps_safe(
-                [(tid, status) for tid, status, _r, _fw in chunk]
-            )
+                self._note_finished(it[0], it[1])
+            self.complete_deps_safe([(it[0], it[1]) for it in chunk])
             n += len(chunk)
         if n:
             self.note_store_up()

@@ -25,16 +25,19 @@ SURVEY §5.4).
 from __future__ import annotations
 
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 import zmq
+
+from tpu_faas.core.payload import RESULT_BLOB_MIN_BYTES
 
 from tpu_faas.core.task import (
     FIELD_DEPS,
     FIELD_LEASE_AT,
     FIELD_PARAMS,
     FIELD_RECLAIMS,
+    FIELD_RESULT,
     FIELD_STATUS,
     TaskStatus,
     claim_field_for,
@@ -50,9 +53,19 @@ from tpu_faas.dispatch.base import (
 from tpu_faas.obs.profile import TickProfiler
 from tpu_faas.sched.estimator import RuntimeEstimator, fn_digest
 from tpu_faas.sched.state import SchedulerArrays
-from tpu_faas.store.base import LIVE_INDEX_KEY
+from tpu_faas.store.base import LIVE_INDEX_KEY, blobreq_key
 from tpu_faas.utils.logging import TickTracer, log_ctx
 from tpu_faas.worker import messages as m
+
+#: bound on the digest -> producer map of the result data plane; sized for
+#: ~an hour of graph results, evicting oldest-first (an evicted source only
+#: downgrades a reverse pull to "missing" — the durability story is the
+#: producer's cache, not this index)
+_RBLOB_SRC_CAP = 65536
+#: seconds before a parked reverse pull re-sends its BLOB_MISS (producer
+#: frame lost or worker mid-reconnect); mirrors the worker-side
+#: _MISS_RESEND_S cadence
+_RBLOB_PULL_RESEND_S = 2.0
 
 
 class TpuPushDispatcher(TaskDispatcher):
@@ -101,6 +114,9 @@ class TpuPushDispatcher(TaskDispatcher):
         columnar: bool = False,
         arena_capacity: int | None = None,
         store_binbatch: bool = False,
+        result_blobs: bool = False,
+        dep_results: bool = False,
+        result_blob_min: int | None = None,
     ) -> None:
         super().__init__(
             store_url=store_url, channel=channel, store=store, shared=shared,
@@ -398,6 +414,63 @@ class TpuPushDispatcher(TaskDispatcher):
             "WAITING graph nodes held in the device frontier (tpu-push "
             "batch path); 0 on flat workloads and frontier-less modes",
         )
+        # -- result data plane (ISSUE 20, opt-in): --result-blobs extends
+        # the content-addressed payload plane to RESULTS. Workers with
+        # CAP_RESULT_BLOB hash large graph-consumed results and send
+        # digest-only RESULT frames; bodies stay in the producer's
+        # byte-bounded result cache and move worker->worker on graph
+        # edges (dep_digests on the TASK frame), materializing into the
+        # store only when a legacy reader asks (note_blobreq reverse
+        # pull). --dep-results alone is the store-mediated control lane:
+        # parent BODIES are fetched from the store and shipped inline
+        # (dep_results on the TASK frame) with no digest machinery. Both
+        # off (default) = every wire/store surface byte-identical.
+        self.result_blobs = bool(result_blobs)
+        if self.result_blobs and self.graph is None:
+            raise ValueError(
+                "--result-blobs rides the graph frontier (batch path "
+                "only); resident/multihost/shared/mesh fleets must run "
+                "without it"
+            )
+        self.dep_results_on = bool(dep_results) or self.result_blobs
+        self.result_blob_min = (
+            RESULT_BLOB_MIN_BYTES
+            if result_blob_min is None
+            else max(1, int(result_blob_min))
+        )
+        #: confirmed parent task_id -> (result digest, size); populated
+        #: beside _result_rows (same lifetime: only while the frontier
+        #: holds waiting children of that parent)
+        self._result_meta: dict[str, tuple[str, int]] = {}
+        #: child task_id -> confirmed-parent dep plan captured when the
+        #: child left the frontier through an ADOPTION path (promotion
+        #: announce at intake, rescan reconciliation) instead of the act
+        #: loop's frontier branch — graph.pop() there drops the edge
+        #: list before dispatch ever sees it. Read (not popped) at frame
+        #: build so an outage-restored batch re-sends intact; cleared
+        #: when the child's own result lands or the task is forgotten.
+        self._adopted_dep_info: dict[
+            str, list[tuple[str, str | None, int]]
+        ] = {}
+        #: result digest -> socket identity of the PRODUCER (the worker
+        #: whose result cache authoritatively holds the body); bounded by
+        #: _RBLOB_SRC_CAP, evicted oldest-first — an evicted source only
+        #: costs a reverse pull falling back to "missing"
+        self._rblob_src: "OrderedDict[str, bytes]" = OrderedDict()
+        #: result digest -> body size in bytes (rides _rblob_src lifetime)
+        self._rblob_sizes: dict[str, int] = {}
+        #: socket identity -> result digests this dispatcher BELIEVES the
+        #: worker's result cache holds (producer inserts + served fills);
+        #: optimistic mirror — a wrong guess costs one BLOB_MISS round,
+        #: exactly like the fn-blob plane. Cleared when a RECONNECT
+        #: advertises rcache_n == 0 (worker restarted, cache gone).
+        self._worker_rdigests: dict[bytes, set[str]] = {}
+        #: digest -> parked consumers awaiting a reverse pull's BLOB_FILL:
+        #: ("worker", wid) re-fills a child worker's miss, ("store", None)
+        #: materializes for a legacy reader (gateway blobreq). Stamped
+        #: with the pull send time for the resend/timeout sweep.
+        self._rblob_want: dict[str, list[tuple[str, bytes | None]]] = {}
+        self._rblob_pull_sent: dict[str, float] = {}
         # -- per-tenant observability (tenancy plane only: the families
         # exist iff the plane is on, and label cardinality is BOUNDED by
         # the registered-tenant vocabulary — configured names get their
@@ -810,6 +883,9 @@ class TpuPushDispatcher(TaskDispatcher):
             ):
                 if status == str(TaskStatus.WAITING):
                     continue
+                if status == str(TaskStatus.QUEUED):
+                    # adoptable: carry the dep plan out of the frontier
+                    self._adopt_dep_info(tid)
                 t = self.graph.pop(tid)
                 if (
                     status == str(TaskStatus.QUEUED)
@@ -1374,8 +1450,10 @@ class TpuPushDispatcher(TaskDispatcher):
             return
         for pid, status in parents:
             row = self._result_rows.pop(pid, -1)
+            rdg, rsz = self._result_meta.pop(pid, (None, 0))
             self.graph.note_parent(
-                pid, status == str(TaskStatus.COMPLETED), row
+                pid, status == str(TaskStatus.COMPLETED), row,
+                digest=rdg, size=rsz,
             )
         for child in poisoned:
             if self.graph.pop(child) is not None:
@@ -1414,10 +1492,182 @@ class TpuPushDispatcher(TaskDispatcher):
             self.note_store_outage(exc, pause=0)
             return
         if payload is None:
+            # result data plane: a digest the store never saw may live in
+            # a producer's result cache — park the requester and pull the
+            # body worker->worker (the store round trip the plane exists
+            # to avoid). Unknown digests still answer missing=True.
+            if self.result_blobs and digest in self._rblob_src:
+                self._rblob_pull(digest, ("worker", wid))
+                return
             self._send_worker(wid, m.BLOB_FILL, digest=digest, missing=True)
             return
         self.m_blob_fills.inc()
         self._send_worker(wid, m.BLOB_FILL, digest=digest, data=payload)
+
+    # -- result data plane (reverse pulls) ---------------------------------
+    def _rblob_note_producer(
+        self, digest: str, size: int, wid: bytes
+    ) -> None:
+        """A digest-only RESULT landed: ``wid``'s result cache is now the
+        authoritative holder of the body. Bounded oldest-first."""
+        src = self._rblob_src
+        src[digest] = wid
+        src.move_to_end(digest)
+        self._rblob_sizes[digest] = int(size)
+        self._worker_rdigests.setdefault(wid, set()).add(digest)
+        while len(src) > _RBLOB_SRC_CAP:
+            old, _ = src.popitem(last=False)
+            self._rblob_sizes.pop(old, None)
+
+    def _rblob_pull(
+        self, digest: str, consumer: tuple[str, bytes | None]
+    ) -> None:
+        """Park a consumer on ``digest`` and (re)issue the dispatcher->
+        producer BLOB_MISS. Consumers: ("worker", wid) = a child worker's
+        cache miss to re-fill; ("store", None) = a legacy reader's
+        materialization request (gateway blobreq)."""
+        want = self._rblob_want.setdefault(digest, [])
+        if consumer not in want:
+            want.append(consumer)
+        src = self._rblob_src.get(digest)
+        if src is None:
+            self._rblob_fail(digest)
+            return
+        self._send_worker(src, m.BLOB_MISS, digest=digest)
+        self._rblob_pull_sent[digest] = self.clock()
+
+    def _rblob_fail(self, digest: str) -> None:
+        """No producer can serve ``digest`` anymore: answer every parked
+        consumer ``missing=True`` (workers FAIL their parked tasks; a
+        store request just never materializes and the gateway's bounded
+        poll returns 410)."""
+        self.m_rblob_pulls.labels(outcome="missing").inc()
+        for kind, cwid in self._rblob_want.pop(digest, ()):
+            if kind == "worker" and cwid is not None:
+                self._send_worker(
+                    cwid, m.BLOB_FILL, digest=digest, missing=True
+                )
+        self._rblob_pull_sent.pop(digest, None)
+
+    def _on_result_fill(self, wid: bytes, data: dict) -> None:
+        """A producer's BLOB_FILL answering a reverse pull: fan the body
+        out to parked child workers and/or materialize it into the store
+        for a legacy reader. ``missing=True`` (producer evicted the body)
+        fails the parked consumers and forgets the source."""
+        digest = data.get("digest")
+        if not isinstance(digest, str) or not digest:
+            return
+        body = data.get("data")
+        if data.get("missing") or not body:
+            if self._rblob_src.get(digest) == wid:
+                self._rblob_src.pop(digest, None)
+                self._rblob_sizes.pop(digest, None)
+            holdings = self._worker_rdigests.get(wid)
+            if holdings is not None:
+                holdings.discard(digest)
+            self._rblob_fail(digest)
+            return
+        self.m_rblob_pulls.labels(outcome="filled").inc()
+        consumers = self._rblob_want.pop(digest, [])
+        self._rblob_pull_sent.pop(digest, None)
+        for kind, cwid in consumers:
+            if kind == "worker" and cwid is not None:
+                self.m_blob_fills.inc()
+                self._send_worker(
+                    cwid, m.BLOB_FILL, digest=digest, data=body
+                )
+                # the fill seeds the consumer's result cache too
+                self._worker_rdigests.setdefault(cwid, set()).add(digest)
+        if any(kind == "store" for kind, _ in consumers):
+            try:
+                self.store.put_blob(digest, body)
+                self.m_result_store_bytes.labels(dir="write").inc(
+                    len(body)
+                )
+                # the request key's deletion is the gateway's signal that
+                # the blob (if it exists at all) is now readable
+                self.store.delete(blobreq_key(digest))
+            except STORE_OUTAGE_ERRORS as exc:
+                self.note_store_outage(exc, pause=0)
+
+    def note_blobreq(self, digest: str) -> None:
+        """A gateway asked for a result body only a producer's cache
+        holds (legacy reader hit a digest-form record): materialize it
+        into the store via a reverse pull."""
+        if not self.result_blobs:
+            return
+        self._rblob_pull(digest, ("store", None))
+
+    def _task_frame_extra(
+        self,
+        task,
+        caps: frozenset,
+        dep_info: list[tuple[str, str | None, int]] | None,
+    ) -> dict | None:
+        """Result-plane fields for one TASK frame (None = the frame is
+        byte-identical to the plane-off wire):
+
+        - ``rblob_min``: asks a CAP_RESULT_BLOB worker to hash-and-hold a
+          COMPLETED result >= this many bytes instead of shipping the
+          body — marked exactly on tasks with waiting graph children at
+          dispatch time (flat tasks keep the full-body RESULT).
+        - ``dep_digests``: parent_id -> result digest for digest-form
+          parents; the worker serves them from its result cache, missing
+          ones via BLOB_MISS (the dispatcher reverse-pulls the producer).
+        - ``dep_results``: parent_id -> serialized body for store-resident
+          parents (--dep-results control lane, and small results below
+          the blob threshold), read here and counted as result store-read
+          bytes — the round trip the digest path exists to delete."""
+        extra: dict = {}
+        if (
+            self.result_blobs
+            and m.CAP_RESULT_BLOB in caps
+            and self.graph is not None
+            and self.graph.has_waiting_children(task.task_id)
+        ):
+            extra["rblob_min"] = self.result_blob_min
+        if dep_info:
+            digests: dict[str, str] = {}
+            bodies: dict[str, str] = {}
+            rblob_ok = m.CAP_RESULT_BLOB in caps
+            for pid, dg, _sz in dep_info:
+                if dg is not None:
+                    # digest-form parent: deliverable only to a result-
+                    # blob-capable worker (a legacy child keeps the
+                    # ordering-only contract it always had)
+                    if rblob_ok:
+                        digests[pid] = dg
+                    continue
+                body = self.store.hmget(pid, [FIELD_RESULT])[0]
+                if body:
+                    bodies[pid] = body
+                    self.m_result_store_bytes.labels(dir="read").inc(
+                        len(body)
+                    )
+            if digests:
+                extra["dep_digests"] = digests
+            if bodies:
+                extra["dep_results"] = bodies
+        return extra or None
+
+    def _rblob_resend_sweep(self) -> None:
+        """Re-send reverse pulls whose BLOB_FILL never came (frame lost,
+        producer mid-reconnect) — the dispatcher-side mirror of the
+        workers' parked-miss resend timer."""
+        if not self._rblob_pull_sent:
+            return
+        now = self.clock()
+        for digest in [
+            d
+            for d, at in self._rblob_pull_sent.items()
+            if now - at >= _RBLOB_PULL_RESEND_S
+        ]:
+            src = self._rblob_src.get(digest)
+            if src is None:
+                self._rblob_fail(digest)
+            else:
+                self._send_worker(src, m.BLOB_MISS, digest=digest)
+                self._rblob_pull_sent[digest] = now
 
     def _handle(self, wid: bytes, msg_type: str, data: dict) -> None:
         a = self.arrays
@@ -1455,6 +1705,11 @@ class TpuPushDispatcher(TaskDispatcher):
             # payload-plane resolution request: any message is liveness
             a.heartbeat(wid)
             self._serve_blob_miss(wid, data)
+        elif msg_type == m.BLOB_FILL:
+            # result data plane: a producer answering this dispatcher's
+            # reverse pull — fan the body out to the parked consumers
+            a.heartbeat(wid)
+            self._on_result_fill(wid, data)
         elif msg_type == m.HEARTBEAT:
             a.heartbeat(wid)
         elif msg_type == m.RECONNECT:
@@ -1462,6 +1717,10 @@ class TpuPushDispatcher(TaskDispatcher):
             self._note_token(wid, data)
             self._apply_learned_speed(wid, row)
             self._recall_health(wid, row)
+            if self.result_blobs and int(data.get("rcache_n", -1)) == 0:
+                # the worker's result cache is empty (fresh process): any
+                # holdings this dispatcher mirrored for it are stale
+                self._worker_rdigests.pop(wid, None)
         elif msg_type == m.DEREGISTER:
             # graceful drain: zero the row's capacity so placement skips it;
             # in-flight results keep arriving (the row stays live while it
@@ -1523,18 +1782,42 @@ class TpuPushDispatcher(TaskDispatcher):
         suspicious = (
             not from_owner or task_id in self.task_retries or hedged
         )
+        # result data plane: a digest-only frame carries result_digest +
+        # result_size and NO body — record the producer as the body's
+        # holder and write the digest-form record (result field empty)
+        rdg = data.get("result_digest") if self.result_blobs else None
+        if isinstance(rdg, str) and rdg:
+            rsz = int(data.get("result_size", 0) or 0)
+            self._rblob_note_producer(rdg, rsz, wid)
+        else:
+            rdg, rsz = None, 0
+        result_body = data.get("result", "") if rdg is None else ""
+        if (
+            rdg is not None
+            and from_owner
+            and self.graph is not None
+            and self.graph.has_waiting_children(task_id)
+        ):
+            # stash the digest BEFORE the terminal write: the unbatched
+            # write runs the promotion plane synchronously, and
+            # note_deps_resolved must find the digest when it confirms
+            # the parent into the frontier (the batched drain defers the
+            # write past this whole method, so either order works there)
+            self._result_meta[task_id] = (rdg, rsz)
         if self._result_batch is not None:
             # batched drain (drain_results_batched): the terminal
             # write joins one pipelined finish_task_many flush after
             # the drain — first_wins rides each item, and intra-batch
             # ordering matches the per-message writes it replaces
+            item = (task_id, data["status"], result_body, suspicious)
             self._result_batch.append(
-                (task_id, data["status"], data["result"], suspicious)
+                item if rdg is None else item + (rdg, rsz)
             )
         else:
             self.record_result_safe(
-                task_id, data["status"], data["result"],
+                task_id, data["status"], result_body,
                 first_wins=suspicious,
+                result_digest=rdg, result_size=rsz,
             )
         self.n_results += 1
         # Only the current owner's result releases the in-flight slot:
@@ -1543,6 +1826,7 @@ class TpuPushDispatcher(TaskDispatcher):
         # since its own result would then find nothing to release).
         if from_owner:
             self.task_retries.pop(task_id, None)
+            self._adopted_dep_info.pop(task_id, None)
             self._tenant_task_done(task_id)
             row = a.inflight_done(task_id)
             if row is not None:
@@ -1554,7 +1838,8 @@ class TpuPushDispatcher(TaskDispatcher):
                 ):
                     # locality: this worker's payload cache now holds
                     # the parent's function — its row is the waiting
-                    # children's preferred placement
+                    # children's preferred placement (the result DIGEST
+                    # was stashed above, before the terminal write)
                     self._result_rows[task_id] = row
         else:
             self._task_digest.pop(task_id, None)
@@ -1818,6 +2103,14 @@ class TpuPushDispatcher(TaskDispatcher):
             "frontier_waiting": 0 if self.graph is None else len(self.graph),
             "frontier_dispatches": self.n_frontier_dispatches,
         }
+        if self.result_blobs:
+            base["graph"]["result_blobs"] = {
+                "known_digests": len(self._rblob_src),
+                "mirrored_holdings": sum(
+                    len(s) for s in self._worker_rdigests.values()
+                ),
+                "pulls_parked": len(self._rblob_want),
+            }
         return {
             **base,
             "backlog_est_s": (
@@ -1915,6 +2208,18 @@ class TpuPushDispatcher(TaskDispatcher):
                         getattr(self.store, "n_round_trips", 0) - rt0
                     )
 
+    def _adopt_dep_info(self, task_id: str) -> None:
+        """Capture a held child's confirmed-parent dep plan BEFORE an
+        adoption-path ``graph.pop()`` destroys the edge list. The common
+        route for a promoted child is NOT the act loop's frontier branch
+        but this one: its QUEUED promotion announce re-delivers it
+        through intake (or the rescan reconciles it), and without this
+        stash the child would dispatch with no dep delivery at all."""
+        if self.dep_results_on and self.graph is not None:
+            info = self.graph.confirmed_parents(task_id)
+            if info:
+                self._adopted_dep_info[task_id] = info
+
     def _intake_inner(self) -> None:
         room = self.arrays.max_pending - len(self.pending) - len(
             self._resident_tasks
@@ -1944,6 +2249,7 @@ class TpuPushDispatcher(TaskDispatcher):
             t = self._unclaimed.popleft()
             if fresh(t.task_id):
                 if self.graph is not None:
+                    self._adopt_dep_info(t.task_id)
                     self.graph.pop(t.task_id)
                 batch_ids.add(t.task_id)
                 batch.append(t)
@@ -1966,6 +2272,8 @@ class TpuPushDispatcher(TaskDispatcher):
                 # holds (its parent finished through another writer, or
                 # the promotion announce beat our confirmation): the
                 # QUEUED announce's fresh record wins, the held copy goes
+                # — but its confirmed-parent dep plan rides along
+                self._adopt_dep_info(t.task_id)
                 self.graph.pop(t.task_id)
             batch_ids.add(t.task_id)
             batch.append(t)
@@ -2126,12 +2434,30 @@ class TpuPushDispatcher(TaskDispatcher):
             # graph frontier: padded edge list + locality preference for
             # this tick's batch (None on flat workloads — the jitted tick
             # keeps its dependency-free signature)
-            dep_edges = task_pref = None
+            dep_edges = task_pref = pref_edges = None
             if frontier_rows:
                 child, undone, task_pref = self.graph.edge_arrays(
                     frontier_rows, a.max_pending
                 )
                 dep_edges = (child, undone)
+                # result data plane: byte-weighted parent locality. The
+                # digest -> worker-row holdings mirror inverts per tick
+                # (bounded by the mirrored-digest count, plane-gated);
+                # the scoring itself runs in the device step.
+                if self.result_blobs and self._worker_rdigests:
+                    holder_rows: dict[str, set[int]] = {}
+                    for hwid, digs in self._worker_rdigests.items():
+                        hrow = a.worker_ids.get(hwid)
+                        if hrow is None:
+                            continue
+                        for dg in digs:
+                            holder_rows.setdefault(dg, set()).add(
+                                int(hrow)
+                            )
+                    if holder_rows:
+                        pref_edges = self.graph.pref_arrays(
+                            frontier_rows, a.max_pending, holder_rows
+                        )
             # quarantine plane: run the policy pass and materialize the
             # i32[W] placement ceiling. Built on EVERY tick while the
             # plane is on (all-HUGE with nobody quarantined) — the lane is
@@ -2155,6 +2481,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     a.placement, prios is not None,
                     0 if dep_edges is None else len(dep_edges[0]),
                     task_pref is not None,
+                    0 if pref_edges is None else len(pref_edges[0]),
                     tenants is not None,
                     avoids is not None,
                     place_cap is not None,
@@ -2166,6 +2493,7 @@ class TpuPushDispatcher(TaskDispatcher):
                     task_priorities=prios,
                     dep_edges=dep_edges,
                     task_pref=task_pref,
+                    pref_edges=pref_edges,
                     task_tenants=tenants,
                     task_avoid=avoids,
                     worker_place_cap=place_cap,
@@ -2209,11 +2537,18 @@ class TpuPushDispatcher(TaskDispatcher):
                         # capacity — next tick recomputes
                         restore_from = idx + 1
                         continue
+                    dep_info = None
                     if idx in frontier_rows:
                         # the device mask admitted this node: every parent
                         # is confirmed complete, so its record is already
                         # QUEUED (promotion preceded confirmation) — it
                         # leaves the frontier and dispatches like any task
+                        if self.dep_results_on:
+                            # capture the dep-delivery plan BEFORE pop()
+                            # drops the edge list
+                            dep_info = self.graph.confirmed_parents(
+                                task.task_id
+                            )
                         self.graph.pop(task.task_id)
                         popped_frontier.add(idx)
                         if task.submitted_at is not None:
@@ -2225,6 +2560,15 @@ class TpuPushDispatcher(TaskDispatcher):
                         self.traces.note_trace(task.task_id, task.trace_id)
                         self.n_frontier_dispatches += 1
                         self.graph.n_frontier_dispatches += 1
+                    elif self.dep_results_on:
+                        # adoption path: the dep plan was captured when
+                        # intake/rescan popped the held copy. get(), not
+                        # pop() — an outage-restored batch re-dispatches
+                        # next tick and must find it again (cleared with
+                        # the child's result / _forget_task_state)
+                        dep_info = self._adopted_dep_info.get(
+                            task.task_id
+                        )
                     if task.retries and task.task_id in finished:
                         # reclaimed task finished meanwhile by its zombie
                         # worker: re-dispatching would regress the record
@@ -2286,6 +2630,12 @@ class TpuPushDispatcher(TaskDispatcher):
                         self._retire_row(task)
                         restore_from = idx + 1
                         continue
+                    # result plane: dep bodies materialize BEFORE any
+                    # bookkeeping too — an outage raise here restores the
+                    # task with no inflight entry to leak
+                    frame_extra = self._task_frame_extra(
+                        task, caps, dep_info
+                    )
                     try:
                         # reserve tracking BEFORE sending: a task on the
                         # wire but absent from the inflight table could
@@ -2299,7 +2649,9 @@ class TpuPushDispatcher(TaskDispatcher):
                         restore_from = idx + 1
                         continue
                     self.note_dispatch(task)
-                    self.send_task_frame(task_frames, wid, caps, task, blob)
+                    self.send_task_frame(
+                        task_frames, wid, caps, task, blob, frame_extra
+                    )
                     self.note_payload_sent(task, blob)
                     self.traces.note(
                         task.task_id, "sent", count_dup=task.retries == 0
@@ -2366,6 +2718,8 @@ class TpuPushDispatcher(TaskDispatcher):
         # queue back (they ride the next tick's placement as ghost rows)
         if straggler_idx is not None and len(straggler_idx):
             self._consider_hedges(straggler_idx)
+        if self.result_blobs:
+            self._rblob_resend_sweep()
         self._note_cap_held()
         if self.arena is not None:
             # per-tick occupancy refresh: the dispatch hot path retires
@@ -2589,6 +2943,8 @@ class TpuPushDispatcher(TaskDispatcher):
         self.task_retries.pop(task_id, None)
         self._task_digest.pop(task_id, None)
         self._result_rows.pop(task_id, None)
+        self._result_meta.pop(task_id, None)
+        self._adopted_dep_info.pop(task_id, None)
         self._cap_held_noted.discard(task_id)
         self._tenant_task_done(task_id)
         # an outstanding hedge dies with the task (cancel/expire/zombie-

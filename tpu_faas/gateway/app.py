@@ -107,6 +107,7 @@ from tpu_faas.core.task import (
     FIELD_PENDING_DEPS,
     FIELD_PRIORITY,
     FIELD_RESULT,
+    FIELD_RESULT_DIGEST,
     FIELD_SLO_CLASS,
     FIELD_SPECULATIVE,
     FIELD_STATUS,
@@ -144,10 +145,14 @@ from tpu_faas.obs.tracectx import (
 from tpu_faas.store.base import (
     BLOB_AT_FIELD,
     BLOB_PREFIX,
+    BLOBREQ_ANNOUNCE_PREFIX,
+    BLOBREQ_AT_FIELD,
+    BLOBREQ_PREFIX,
     LIVE_INDEX_KEY,
     RESULTS_CHANNEL,
     TASKS_CHANNEL,
     TaskStore,
+    blobreq_key,
     decode_result_announce,
 )
 from tpu_faas.store.launch import make_store
@@ -304,8 +309,12 @@ class _ResultWaiters:
     def _fire(self, payload: str) -> None:
         task_id, status, result = decode_result_announce(payload)
         for w in self._waiters.get(task_id, ()):
-            if status is not None:
-                w.inline[task_id] = (status, result or "")
+            # digest-form announces (result-blob plane, "!r2:") decode
+            # with status but NO result — wake only, so the delivery path
+            # re-reads the record and materializes the body; forwarding
+            # ("status", "") here would serve an empty result as real
+            if status is not None and result is not None:
+                w.inline[task_id] = (status, result)
             w.event.set()
 
     def fire_all(self) -> None:
@@ -888,10 +897,27 @@ def _sweep_stale_blobs(
     stale record — AND (b) nothing references it anymore: no
     function-registry record carries its digest and no LIVE task does.
     The reference set is recomputed from the records at sweep time, so
-    there is no persistent counter to corrupt. Returns keys to delete."""
+    there is no persistent counter to corrupt. Result blobs (--result-
+    blobs materializations) ride the same policy: a task record carrying
+    the digest in FIELD_RESULT_DIGEST — live OR terminal-but-unswept —
+    is a reference, so a digest-form record never outlives its readable
+    body. Stale ``blobreq:`` request keys (a materialization the
+    dispatcher never served — plane off, producer gone) age out at the
+    plain result TTL. Returns keys to delete."""
+    reqs_stale: list[str] = []
+    req_keys = [k for k in all_keys if k.startswith(BLOBREQ_PREFIX)]
+    if req_keys:
+        for key, stamp in zip(
+            req_keys, store.hget_many(req_keys, BLOBREQ_AT_FIELD)
+        ):
+            try:
+                if stamp is not None and now_f - float(stamp) > ttl:
+                    reqs_stale.append(key)
+            except ValueError:
+                continue
     blob_keys = [k for k in all_keys if k.startswith(BLOB_PREFIX)]
     if not blob_keys:
-        return []
+        return reqs_stale
     blob_ttl = 4 * ttl
     stamps = store.hget_many(blob_keys, BLOB_AT_FIELD)
     stale = []
@@ -902,7 +928,7 @@ def _sweep_stale_blobs(
         except ValueError:
             continue  # unparseable stamp: never collect
     if not stale:
-        return []
+        return reqs_stale
     referenced: set[str] = set()
     fn_keys = [k for k in all_keys if k.startswith(_FUNCTION_PREFIX)]
     if fn_keys:
@@ -914,7 +940,24 @@ def _sweep_stale_blobs(
         for d in store.hget_many(live_ids, FIELD_FN_DIGEST):
             if d:
                 referenced.add(d)
-    return [
+    # result-digest references over EVERY surviving task record (the
+    # live index only tracks pre-terminal tasks, but a terminal digest-
+    # form record is exactly the reader the materialized body serves)
+    record_keys = [
+        k
+        for k in all_keys
+        if not k.startswith(_FUNCTION_PREFIX)
+        and not k.startswith(BLOB_PREFIX)
+        and not k.startswith(BLOBREQ_PREFIX)
+        and not k.startswith(_FN_INDEX_PREFIX)
+        and not k.startswith(TRACE_PREFIX)
+        and k != LIVE_INDEX_KEY
+    ]
+    if record_keys:
+        for d in store.hget_many(record_keys, FIELD_RESULT_DIGEST):
+            if d:
+                referenced.add(d)
+    return reqs_stale + [
         k for k in stale if k[len(BLOB_PREFIX):] not in referenced
     ]
 
@@ -978,6 +1021,7 @@ def _sweep_expired_results(
         for k in all_keys
         if not k.startswith(_FUNCTION_PREFIX)
         and not k.startswith(BLOB_PREFIX)
+        and not k.startswith(BLOBREQ_PREFIX)
         and not k.startswith(_FN_INDEX_PREFIX)
         and not k.startswith(TRACE_PREFIX)
     ]
@@ -2214,6 +2258,69 @@ _WAIT_POLL_S = 0.5
 _WAIT_POLL_MAX_S = _WAIT_POLL_MAX_S_DEFAULT
 
 
+#: Lazy result materialization (result-blob plane, legacy readers): a
+#: digest-form task record stores FIELD_RESULT="" + FIELD_RESULT_DIGEST —
+#: the body lives only in the producing worker's result cache until a
+#: reader needs it. The gateway requests materialization by claiming
+#: ``blobreq:<digest>`` (setnx — one requester wins, the rest piggyback)
+#: and publishing ``!blobreq:<digest>`` on the tasks channel; the
+#: dispatcher reverse-pulls the producer and lands the body at
+#: ``blob:<digest>``. The poll below bounds how long a reader waits for
+#: that round-trip before declaring the body gone (producer evicted /
+#: worker restarted): 410, not a hang.
+_BLOBREQ_WAIT_S = 2.0
+_BLOBREQ_POLL_S = 0.1
+
+
+async def _materialize_result(
+    ctx: "GatewayContext",
+    task_id: str,
+    status: str | None,
+    result: str | None,
+) -> tuple[str | None, bool]:
+    """Resolve a digest-form terminal record to its result body.
+
+    Returns ``(result, ok)``. Pass-through (ok=True) when the record
+    already carries a body, isn't terminal, or never had a digest — the
+    plane-off path does zero extra store reads beyond one hmget only when
+    the fetched result was empty AND terminal (an empty COMPLETED body is
+    legal and rare; the hmget distinguishes it from digest form)."""
+    if result:
+        return result, True
+    try:
+        if status is None or not TaskStatus(status).is_terminal():
+            return result, True
+    except ValueError:
+        return result, True
+    digest = (
+        await ctx.store_call(ctx.store.hmget, task_id, [FIELD_RESULT_DIGEST])
+    )[0]
+    if not digest:
+        return result, True  # genuinely empty body, not digest form
+    body = await ctx.store_call(ctx.store.get_blob, digest)
+    if body is not None:
+        return body, True
+    # not materialized yet: claim the request key (idempotent across
+    # concurrent readers and gateways) and ask the dispatcher plane
+    await ctx.store_call(
+        ctx.store.setnx_field,
+        blobreq_key(digest),
+        BLOBREQ_AT_FIELD,
+        repr(time.time()),
+    )
+    await ctx.store_call(
+        ctx.store.publish, ctx.channel, BLOBREQ_ANNOUNCE_PREFIX + digest
+    )
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + _BLOBREQ_WAIT_S
+    while loop.time() < deadline and not ctx.stopping.is_set():
+        await asyncio.sleep(_BLOBREQ_POLL_S)
+        body = await ctx.store_call(ctx.store.get_blob, digest)
+        if body is not None:
+            return body, True
+    return None, False
+
+
 def _note_terminal_delivery(
     ctx: "GatewayContext",
     task_id: str,
@@ -2309,6 +2416,19 @@ async def get_result(request: web.Request) -> web.Response:
                 terminal = True  # unknown status string: reply, don't 500/hang
             if terminal or loop.time() >= deadline or ctx.stopping.is_set():
                 if terminal:
+                    result, ok = await _materialize_result(
+                        ctx, task_id, status, result
+                    )
+                    if not ok:
+                        # digest-form record whose body never materialized
+                        # (producer evicted it or left the fleet): the
+                        # record is authoritative about status, the body is
+                        # unrecoverable — permanent, not retryable
+                        return _json_error(
+                            410,
+                            f"result body for {task_id!r} is gone "
+                            "(result-blob expired before materialization)",
+                        )
                     if waiter is not None and woke_by_poll:
                         # the announce never woke us — the safety re-read
                         # found the terminal record (announce loss on the
@@ -2467,14 +2587,18 @@ class _ResultWatch:
                 )
                 for (tid, status), result in zip(term, results):
                     self.pending.discard(tid)
-                    out.append(
-                        (
-                            tid,
-                            status,
-                            result if isinstance(result, str) else "",
-                            "store",
+                    body = result if isinstance(result, str) else ""
+                    if not body:
+                        # digest-form record (result-blob plane) or a
+                        # genuinely empty body — _materialize_result tells
+                        # them apart; an unrecoverable blob delivers ""
+                        # (the multiplexed reply has no per-id 410 lane;
+                        # /result on the same id reports the 410)
+                        body, _ok = await _materialize_result(
+                            self.ctx, tid, status, body
                         )
-                    )
+                        body = body or ""
+                    out.append((tid, status, body, "store"))
         for tid, status, _result, source in out:
             if (
                 source == "store"

@@ -105,6 +105,62 @@ def locality_exchange(
     return out
 
 
+def parent_pref_impl(
+    pref_child: jnp.ndarray,  # i32[P] batch row per (child, holder) pair
+    pref_row: jnp.ndarray,  # i32[P] worker row holding parent-result bytes
+    pref_bytes: jnp.ndarray,  # f32[P] bytes that row holds for the child
+    *,
+    T: int,
+) -> jnp.ndarray:
+    """i32[T] preferred worker row per batch row (-1 none): the row
+    holding the MOST of the child's parent-result bytes, ties to the
+    lowest row. The result-data-plane sibling of the function-locality
+    pref: a child placed on a holder consumes its parents straight from
+    the worker's result cache (dep_digests on the TASK frame) instead of
+    round-tripping bodies through the store.
+
+    Un-jitted ``_impl`` per the solver-stack convention (PR 11/13/15):
+    the XLA path traces it under :data:`parent_pref`'s jit, the fused-
+    Pallas resident tick traces the same ops inside its one pallas_call —
+    scatter-max then masked scatter-min, both mode="drop" so pad lanes
+    (child = T, bytes = 0) fall out structurally."""
+    best = (
+        jnp.zeros(T, jnp.float32)
+        .at[pref_child]
+        .max(pref_bytes, mode="drop")
+    )
+    c = jnp.clip(pref_child, 0, T - 1)
+    win = (pref_bytes > 0.0) & (pref_bytes >= best[c])
+    BIG = jnp.int32(2**30)
+    row = (
+        jnp.full(T, BIG, jnp.int32)
+        .at[jnp.where(win, pref_child, T)]
+        .min(pref_row, mode="drop")
+    )
+    return jnp.where(row < BIG, row, jnp.int32(-1))
+
+
+parent_pref = partial(jax.jit, static_argnames=("T",))(parent_pref_impl)
+
+
+def pad_pref(
+    child: list[int], row: list[int], nbytes: list[float], T: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad the host (child, holder row, bytes) triplets to the next power
+    of two (bounded jit signatures, same discipline as :func:`pad_edges`)
+    with dropped lanes (child = T, row = 0, bytes = 0)."""
+    P = max(len(child), 1)
+    k = 1 << (P - 1).bit_length()
+    c = np.full(k, T, dtype=np.int32)
+    r = np.zeros(k, dtype=np.int32)
+    b = np.zeros(k, dtype=np.float32)
+    if child:
+        c[: len(child)] = child
+        r[: len(row)] = row
+        b[: len(nbytes)] = nbytes
+    return c, r, b
+
+
 def pad_edges(
     edge_child: list[int], edge_undone: list[int], T: int
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -138,9 +194,12 @@ class GraphFrontier:
         self.parents: dict[str, list[str]] = {}
         #: parent id -> waiting child ids (reverse index)
         self._children: dict[str, set[str]] = {}
-        #: parent id -> (ok, worker_row) once CONFIRMED terminal; kept only
-        #: while some waiting child still references the parent
-        self._parent_state: dict[str, tuple[bool, int]] = {}
+        #: parent id -> (ok, worker_row, result_digest, result_size) once
+        #: CONFIRMED terminal; kept only while some waiting child still
+        #: references the parent. digest/size are None/0 outside the
+        #: result data plane (--result-blobs) — the pref triplet builder
+        #: then has nothing to weigh and the byte-locality lane stays off.
+        self._parent_state: dict[str, tuple[bool, int, str | None, int]] = {}
         self.n_frontier_dispatches = 0
 
     def __len__(self) -> int:
@@ -161,12 +220,24 @@ class GraphFrontier:
     def has_waiting_children(self, parent_id: str) -> bool:
         return bool(self._children.get(parent_id))
 
-    def note_parent(self, parent_id: str, ok: bool, row: int = -1) -> None:
+    def note_parent(
+        self,
+        parent_id: str,
+        ok: bool,
+        row: int = -1,
+        digest: str | None = None,
+        size: int = 0,
+    ) -> None:
         """A parent's terminal write landed AND its complete_dep_many round
         succeeded: flip its edges. ``row`` is the worker row that returned
-        the result (the locality preference for ok parents)."""
+        the result (the locality preference for ok parents); ``digest``/
+        ``size`` identify the result body in the content-addressed plane
+        when the producer shipped digest-form (--result-blobs) — what the
+        byte-weighted pref lane scores children toward."""
         if self._children.get(parent_id):
-            self._parent_state[parent_id] = (bool(ok), int(row))
+            self._parent_state[parent_id] = (
+                bool(ok), int(row), digest, int(size),
+            )
 
     def pop(self, task_id: str):
         """Remove and return a held node (None if not held). Parent states
@@ -182,6 +253,20 @@ class GraphFrontier:
                     del self._children[pid]
                     self._parent_state.pop(pid, None)
         return task
+
+    def confirmed_parents(
+        self, task_id: str
+    ) -> list[tuple[str, str | None, int]]:
+        """(parent_id, result_digest, result_size) for every confirmed-OK
+        parent of a held node — the dispatch-time source of the child's
+        dep delivery (digest = None means the body lives in the store
+        record). Captured BEFORE pop(): popping drops the edge list."""
+        out: list[tuple[str, str | None, int]] = []
+        for pid in self.parents.get(task_id, ()):
+            state = self._parent_state.get(pid)
+            if state is not None and state[0]:
+                out.append((pid, state[2], state[3]))
+        return out
 
     def failed_parent_of(self, task_id: str) -> str | None:
         """A confirmed-failed parent of this node, if any — the host-side
@@ -218,3 +303,37 @@ class GraphFrontier:
                 any_pref = True
         child, undone = pad_edges(edge_child, edge_undone, T)
         return child, undone, (pref if any_pref else None)
+
+    def pref_arrays(
+        self,
+        rows: dict[int, str],
+        T: int,
+        holder_rows: dict[str, set[int]],
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
+        """Deduped, padded (pref_child, pref_row, pref_bytes) triplets for
+        :func:`parent_pref` — one lane per (batch row, candidate worker
+        row) pair, weighted by how many of the child's confirmed parents'
+        result bytes that worker's cache holds. ``holder_rows`` is the
+        dispatcher's digest -> worker-row mirror. None when no waiting
+        child has a digest-form parent held anywhere (the jitted tick
+        keeps its pref-free signature)."""
+        acc: dict[tuple[int, int], float] = {}
+        for row, tid in rows.items():
+            for pid in self.parents.get(tid, ()):
+                state = self._parent_state.get(pid)
+                if state is None or not state[0]:
+                    continue
+                digest, size = state[2], state[3]
+                if not digest or size <= 0:
+                    continue
+                for hrow in holder_rows.get(digest, ()):
+                    key = (row, int(hrow))
+                    acc[key] = acc.get(key, 0.0) + float(size)
+        if not acc:
+            return None
+        return pad_pref(
+            [k[0] for k in acc],
+            [k[1] for k in acc],
+            list(acc.values()),
+            T,
+        )

@@ -49,6 +49,9 @@ def _packed_tick(
     dep_edge_child=None,  # i32[E] batch row per graph edge (pad = T, dropped)
     dep_edge_undone=None,  # i32[E] 1 while the edge's parent is unconfirmed
     task_pref=None,  # i32[T] preferred worker row (graph locality), -1 none
+    pref_child=None,  # i32[P] batch row per (child, holder) pref lane
+    pref_row=None,  # i32[P] worker row holding parent-result bytes
+    pref_bytes=None,  # f32[P] bytes that row holds for the child
     task_tenant=None,  # i32[T] dense tenant rows (tenancy plane)
     tenant_share=None,  # f32[N]
     tenant_deficit=None,  # f32[N] device-carried between ticks
@@ -91,6 +94,23 @@ def _packed_tick(
 
         task_valid = task_valid & dep_ready_mask(
             dep_edge_child, dep_edge_undone, T=T
+        )
+    if pref_child is not None:
+        # result data plane (--result-blobs): byte-weighted parent
+        # locality — the segment-max over (child, holder) lanes runs in
+        # the SAME device step as placement, and where a child has held
+        # parent-result bytes it overrides the function-locality pref
+        # (strictly more informative: bytes that never round-trip the
+        # store beat a warm function cache). The un-jitted _impl is
+        # traced here directly so the XLA and fused-Pallas backends
+        # share one definition (graph/frontier.py).
+        from tpu_faas.graph.frontier import parent_pref_impl
+
+        byte_pref = parent_pref_impl(pref_child, pref_row, pref_bytes, T=T)
+        task_pref = (
+            byte_pref
+            if task_pref is None
+            else jnp.where(byte_pref >= 0, byte_pref, task_pref)
         )
     out = scheduler_tick(
         task_size,
@@ -820,6 +840,9 @@ class SchedulerArrays:
         task_priorities: np.ndarray | None = None,
         dep_edges: tuple[np.ndarray, np.ndarray] | None = None,
         task_pref: np.ndarray | None = None,
+        pref_edges: (
+            tuple[np.ndarray, np.ndarray, np.ndarray] | None
+        ) = None,
         task_tenants: np.ndarray | None = None,
         task_avoid: np.ndarray | None = None,
         worker_place_cap: np.ndarray | None = None,
@@ -834,13 +857,22 @@ class SchedulerArrays:
         (edge_child, edge_undone) pair — the in-tick segment-reduce masks
         rows with unconfirmed parents (see graph/frontier.py);
         ``task_pref`` (optional, i32[max_pending]) is the graph locality
-        preference applied by the post-placement exchange. Both are
+        preference applied by the post-placement exchange;
+        ``pref_edges`` (optional) is the result data plane's padded
+        (pref_child, pref_row, pref_bytes) triplet — the in-tick
+        segment-max scores children toward workers whose result caches
+        hold their parents' bytes (graph/frontier.parent_pref_impl),
+        overriding ``task_pref`` where it applies. All are
         single-device/packed-path features: the tpu-push dispatcher only
         enables its frontier there (mesh/multihost fleets ride the
         store-side promotion announces instead).
         """
         n = len(task_sizes)
-        if (dep_edges is not None or task_pref is not None) and (
+        if (
+            dep_edges is not None
+            or task_pref is not None
+            or pref_edges is not None
+        ) and (
             self.multihost is not None or self.mesh is not None
         ):
             raise ValueError(
@@ -1005,6 +1037,18 @@ class SchedulerArrays:
                 ),
                 task_pref=(
                     None if task_pref is None else jnp.asarray(task_pref)
+                ),
+                pref_child=(
+                    None if pref_edges is None
+                    else jnp.asarray(pref_edges[0])
+                ),
+                pref_row=(
+                    None if pref_edges is None
+                    else jnp.asarray(pref_edges[1])
+                ),
+                pref_bytes=(
+                    None if pref_edges is None
+                    else jnp.asarray(pref_edges[2])
                 ),
                 **tenant_kw,
                 **spec_kw,

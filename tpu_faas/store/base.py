@@ -33,6 +33,8 @@ from tpu_faas.core.task import (
     FIELD_PARAMS,
     FIELD_PENDING_DEPS,
     FIELD_RESULT,
+    FIELD_RESULT_DIGEST,
+    FIELD_RESULT_SIZE,
     FIELD_STATUS,
     TaskStatus,
     dep_done_field,
@@ -96,6 +98,14 @@ RESULTS_CHANNEL = "results"
 #: Reference-era consumers never see the form unless the operator enables
 #: it fleet-wide.
 RESULT_INLINE_PREFIX = "!r1:"
+#: Express digest form (result-blob plane, ``--result-blobs`` producers):
+#: the announce carries status + result DIGEST + size instead of the body
+#: ("<prefix><task_id>\\x00<status>\\x00<digest>\\x00<size>"). Produced
+#: only for digest-form terminal writes (FIELD_RESULT_DIGEST set, body
+#: empty), so off-plane announce bytes are untouched; a consumer that
+#: doesn't know the form treats the whole payload as an opaque id (its
+#: record probe finds nothing and skips, like any garbage announce).
+RESULT_DIGEST_PREFIX = "!r2:"
 #: Default inline-payload bound for express producers (the dispatcher's
 #: ``--express`` knob): results larger than this fall back to the id-only
 #: announce and the gateway's ordinary store read.
@@ -104,14 +114,32 @@ _RESULT_INLINE_SEP = "\x00"
 
 
 def encode_result_announce(
-    task_id: str, status: str, result: str, inline_max: int = 0
+    task_id: str,
+    status: str,
+    result: str,
+    inline_max: int = 0,
+    result_digest: str | None = None,
+    result_size: int = 0,
 ) -> str:
-    """The RESULTS_CHANNEL payload for one terminal write: the inline
-    express form when ``inline_max`` allows it, else the classic bare task
-    id. Oversized results — and any field that would collide with the
+    """The RESULTS_CHANNEL payload for one terminal write: the digest form
+    for digest-form writes (result-blob plane), the inline express form
+    when ``inline_max`` allows it, else the classic bare task id.
+    Oversized results — and any field that would collide with the
     framing — fall back to id-only rather than truncate: a wrong inline
     payload is worse than a store re-read."""
     status = str(status)
+    if (
+        result_digest
+        and not result
+        and _RESULT_INLINE_SEP not in task_id
+        and _RESULT_INLINE_SEP not in status
+        and _RESULT_INLINE_SEP not in result_digest
+    ):
+        return (
+            f"{RESULT_DIGEST_PREFIX}{task_id}{_RESULT_INLINE_SEP}"
+            f"{status}{_RESULT_INLINE_SEP}{result_digest}"
+            f"{_RESULT_INLINE_SEP}{int(result_size)}"
+        )
     if (
         inline_max > 0
         and len(result) <= inline_max
@@ -132,16 +160,40 @@ def decode_result_announce(
     """(task_id, status, result) of one RESULTS_CHANNEL payload; status and
     result are None for the classic id-only form (and for any malformed
     inline frame — the consumer then falls back to its store read, which is
-    always correct)."""
+    always correct). The digest form decodes to (task_id, status, None):
+    body-oblivious consumers get the wake-up and re-read the record."""
+    tid, status, result, _digest, _size = decode_result_announce_full(payload)
+    return tid, status, result
+
+
+def decode_result_announce_full(
+    payload: str,
+) -> tuple[str, str | None, str | None, str | None, int]:
+    """(task_id, status, result, result_digest, result_size) of one
+    RESULTS_CHANNEL payload — the digest-aware decode for consumers that
+    can materialize blobs (gateway result delivery). Classic id-only and
+    malformed frames decode with every optional part None, same fallback
+    contract as :func:`decode_result_announce`."""
+    if payload.startswith(RESULT_DIGEST_PREFIX):
+        parts = payload[len(RESULT_DIGEST_PREFIX):].split(
+            _RESULT_INLINE_SEP, 3
+        )
+        if len(parts) != 4 or not parts[0] or not parts[1] or not parts[2]:
+            return payload, None, None, None, 0
+        try:
+            size = int(parts[3])
+        except ValueError:
+            size = 0
+        return parts[0], parts[1], None, parts[2], size
     if not payload.startswith(RESULT_INLINE_PREFIX):
-        return payload, None, None
+        return payload, None, None, None, 0
     parts = payload[len(RESULT_INLINE_PREFIX):].split(_RESULT_INLINE_SEP, 2)
     if len(parts) != 3 or not parts[0] or not parts[1]:
         # malformed frame (foreign producer): treat the whole payload as an
         # opaque id — the consumer's record probe will find nothing and
         # skip, exactly like any garbage announce
-        return payload, None, None
-    return parts[0], parts[1], parts[2]
+        return payload, None, None, None, 0
+    return parts[0], parts[1], parts[2], None, 0
 
 #: Content-addressed payload namespace: one hash per payload body, keyed
 #: ``blob:<sha256>`` (core/payload.py payload_digest). Write-once by
@@ -167,6 +219,27 @@ def blob_key(digest: str) -> str:
     return BLOB_PREFIX + digest
 
 
+#: Materialize-request namespace (result-blob plane): a reader that needs
+#: the BODY of a digest-form result the store doesn't hold yet — a legacy
+#: /result consumer, mostly — claims ``blobreq:<digest>`` (setnx on the
+#: REQ_AT field, dedup across concurrent readers) and publishes
+#: "<BLOBREQ_ANNOUNCE_PREFIX><digest>" on the TASKS announce channel. The
+#: dispatcher that tracks a producer worker for the digest pulls the body
+#: off that worker's result cache (reverse BLOB_MISS/BLOB_FILL), writes
+#: the ``blob:<digest>`` record, and deletes the request key; the reader
+#: polls get_blob meanwhile. Plain ring-routed — every client spells the
+#: key identically, so the fleet shares one copy per digest. Stale
+#: requests (producer died with the only copy) are aged out by the blob
+#: sweeper.
+BLOBREQ_PREFIX = "blobreq:"
+#: epoch-seconds stamp of the materialize request (its only field)
+BLOBREQ_AT_FIELD = "req_at"
+
+
+def blobreq_key(digest: str) -> str:
+    return BLOBREQ_PREFIX + digest
+
+
 #: Control message on the TASKS announce channel: "<prefix><task_id>" tells
 #: dispatchers to drop the task from any pending structure they hold (the
 #: gateway publishes it only AFTER it actually wrote CANCELLED). Plain
@@ -181,6 +254,13 @@ CANCEL_ANNOUNCE_PREFIX = "!cancel:"
 #: no store write happens here — the record converges when the worker's
 #: result lands (or stays RUNNING if the task finished first).
 KILL_ANNOUNCE_PREFIX = "!kill:"
+#: Control message requesting lazy materialization of a result blob:
+#: "<prefix><digest>" asks whichever dispatcher tracks a live producer for
+#: the digest to pull the body off that worker's result cache and write
+#: the ``blob:<digest>`` record (see BLOBREQ_PREFIX). Best-effort like
+#: every announce — the requester keeps polling get_blob and times out to
+#: its documented failure mode if nobody can serve.
+BLOBREQ_ANNOUNCE_PREFIX = "!blobreq:"
 
 
 class Subscription(abc.ABC):
@@ -671,22 +751,27 @@ class TaskStore(abc.ABC):
 
     def finish_task_many(
         self,
-        items: list[tuple[str, TaskStatus | str, str, bool]],
+        items: list[tuple],
         inline_max: int = 0,
     ) -> None:
         """Batch finish_task, each item (task_id, status, result,
-        first_wins). Sequential per-item semantics are the contract —
-        including INTRA-batch first_wins: an earlier item's terminal write
-        freezes a later first_wins item for the same id, exactly as if the
-        items were applied one by one. Default: a loop; the RESP client
-        collapses the batch into one status pre-read for the first_wins
-        slice plus one pipelined write+announce round — the dispatcher's
-        result drain and its deferred-result replay ride this.
-        ``inline_max`` as in finish_task (express result lane)."""
-        for task_id, status, result, first_wins in items:
+        first_wins[, result_digest, result_size]) — the two optional
+        trailing elements are the result-blob plane's digest form (absent
+        or None on every legacy item). Sequential per-item semantics are
+        the contract — including INTRA-batch first_wins: an earlier item's
+        terminal write freezes a later first_wins item for the same id,
+        exactly as if the items were applied one by one. Default: a loop;
+        the RESP client collapses the batch into one status pre-read for
+        the first_wins slice plus one pipelined write+announce round — the
+        dispatcher's result drain and its deferred-result replay ride
+        this. ``inline_max`` as in finish_task (express result lane)."""
+        for item in items:
+            task_id, status, result, first_wins = item[:4]
             self.finish_task(
                 task_id, status, result,
                 first_wins=first_wins, inline_max=inline_max,
+                result_digest=item[4] if len(item) > 4 else None,
+                result_size=int(item[5]) if len(item) > 5 else 0,
             )
 
     def hset_many(self, items: list[tuple[str, Mapping[str, str]]]) -> None:
@@ -707,6 +792,8 @@ class TaskStore(abc.ABC):
         result: str,
         first_wins: bool = False,
         inline_max: int = 0,
+        result_digest: str | None = None,
+        result_size: int = 0,
     ) -> None:
         """Record a terminal status + serialized result in one write
         (reference task_dispatcher.py:153-156, 284-295).
@@ -731,27 +818,38 @@ class TaskStore(abc.ABC):
         inline up to that many result bytes (encode_result_announce) —
         oversized results fall back to the classic id-only payload. The
         record write above stays authoritative and still precedes the
-        announce."""
+        announce.
+
+        ``result_digest`` (result-blob plane): the digest form — the write
+        additionally records FIELD_RESULT_DIGEST/FIELD_RESULT_SIZE, and
+        ``result`` is typically EMPTY (the body stays in the producing
+        worker's cache until something materializes it); the announce then
+        carries the digest instead of a body. None (every legacy caller)
+        leaves the record and announce bytes untouched."""
         if first_wins and self._result_frozen(task_id):
             return
         now = repr(time.time())
-        self.hset(
-            task_id,
-            {
-                FIELD_STATUS: str(status),
-                # redundant status + stamp copies, same write: let a racing
-                # cancel that clobbers this terminal record restore it
-                # exactly (see cancel_task's post-write repair)
-                FIELD_FINAL_STATUS: str(status),
-                FIELD_FINAL_AT: now,
-                FIELD_RESULT: result,
-                FIELD_FINISHED_AT: now,
-            },
-        )
+        fields = {
+            FIELD_STATUS: str(status),
+            # redundant status + stamp copies, same write: let a racing
+            # cancel that clobbers this terminal record restore it
+            # exactly (see cancel_task's post-write repair)
+            FIELD_FINAL_STATUS: str(status),
+            FIELD_FINAL_AT: now,
+            FIELD_RESULT: result,
+            FIELD_FINISHED_AT: now,
+        }
+        if result_digest:
+            fields[FIELD_RESULT_DIGEST] = result_digest
+            fields[FIELD_RESULT_SIZE] = str(int(result_size))
+        self.hset(task_id, fields)
         self.hdel(LIVE_INDEX_KEY, task_id)
         self.publish(
             RESULTS_CHANNEL,
-            encode_result_announce(task_id, str(status), result, inline_max),
+            encode_result_announce(
+                task_id, str(status), result, inline_max,
+                result_digest=result_digest, result_size=result_size,
+            ),
         )
 
     def cancel_task(

@@ -611,38 +611,56 @@ class RespStore(TaskStore):
 
     @staticmethod
     def _finish_cmds(
-        task_id: str, status, result: str, now: str, inline_max: int = 0
+        task_id: str,
+        status,
+        result: str,
+        now: str,
+        inline_max: int = 0,
+        result_digest: str | None = None,
+        result_size: int = 0,
     ) -> list[tuple]:
         """The terminal-write command triple shared by finish_task and
         finish_task_many — ONE builder, so the single and batched forms can
         never desynchronize on the record contract. ``inline_max`` > 0
         (express lane) puts the status + result inline on the announce —
         SAME pipelined round, record write still first, so durability and
-        ordering are unchanged."""
+        ordering are unchanged. ``result_digest`` (result-blob plane)
+        appends the digest-form fields to the same HSET and switches the
+        announce to the digest form; None keeps the legacy commands byte
+        for byte."""
         from tpu_faas.core.task import (
             FIELD_FINAL_AT,
             FIELD_FINAL_STATUS,
             FIELD_FINISHED_AT,
             FIELD_RESULT,
+            FIELD_RESULT_DIGEST,
+            FIELD_RESULT_SIZE,
             FIELD_STATUS,
         )
 
+        hset: tuple = (
+            "HSET", task_id,
+            FIELD_STATUS, str(status),
+            # redundant stamps powering cancel_task's clobber repair
+            # (base.finish_task writes the same fields)
+            FIELD_FINAL_STATUS, str(status),
+            FIELD_FINAL_AT, now,
+            FIELD_RESULT, result,
+            FIELD_FINISHED_AT, now,
+        )
+        if result_digest:
+            hset = hset + (
+                FIELD_RESULT_DIGEST, result_digest,
+                FIELD_RESULT_SIZE, str(int(result_size)),
+            )
         return [
-            (
-                "HSET", task_id,
-                FIELD_STATUS, str(status),
-                # redundant stamps powering cancel_task's clobber repair
-                # (base.finish_task writes the same fields)
-                FIELD_FINAL_STATUS, str(status),
-                FIELD_FINAL_AT, now,
-                FIELD_RESULT, result,
-                FIELD_FINISHED_AT, now,
-            ),
+            hset,
             ("HDEL", LIVE_INDEX_KEY, task_id),  # drop from the live index
             (
                 "PUBLISH", RESULTS_CHANNEL,
                 encode_result_announce(
-                    task_id, str(status), result, inline_max
+                    task_id, str(status), result, inline_max,
+                    result_digest=result_digest, result_size=result_size,
                 ),
             ),
         ]
@@ -654,6 +672,8 @@ class RespStore(TaskStore):
         result: str,
         first_wins: bool = False,
         inline_max: int = 0,
+        result_digest: str | None = None,
+        result_size: int = 0,
     ) -> None:
         """Base semantics (terminal write + RESULTS_CHANNEL announce), but
         the write and the announce ride ONE pipelined round trip — the
@@ -662,7 +682,8 @@ class RespStore(TaskStore):
         if first_wins and self._result_frozen(task_id):
             return
         cmds = self._finish_cmds(
-            task_id, status, result, repr(time.time()), inline_max
+            task_id, status, result, repr(time.time()), inline_max,
+            result_digest=result_digest, result_size=result_size,
         )
         try:
             replies = self.pipeline(cmds)
@@ -909,9 +930,15 @@ class RespStore(TaskStore):
 
         if not items:
             return
-        if self._binbatch_on():
+        # digest-form items (result-blob plane, 6-tuples with a digest)
+        # carry fields the MFINISH wire has no slots for: the batch then
+        # takes the pipelined slow path below, which shares _finish_cmds
+        # with the single write. Legacy 4-tuple batches keep the one-command
+        # fast path untouched.
+        any_digest = any(len(it) > 4 and it[4] for it in items)
+        if self._binbatch_on() and not any_digest:
             flat: list[str] = []
-            for task_id, status, result, fw in items:
+            for task_id, status, result, fw in (it[:4] for it in items):
                 flat += [task_id, str(status), result, "1" if fw else "0"]
             try:
                 self._command(
@@ -922,7 +949,7 @@ class RespStore(TaskStore):
             except resp.RespError:
                 pass  # peer changed under us: slow path below
         fw_ids = list(
-            dict.fromkeys(t_id for t_id, _, _, fw in items if fw)
+            dict.fromkeys(it[0] for it in items if it[3])
         )
         frozen: set[str] = set()
         if fw_ids:
@@ -936,11 +963,16 @@ class RespStore(TaskStore):
         now = repr(time.time())
         cmds: list[tuple] = []
         written: set[str] = set()
-        for task_id, status, result, first_wins in items:
+        for item in items:
+            task_id, status, result, first_wins = item[:4]
             if first_wins and (task_id in written or task_id in frozen):
                 continue
             cmds.extend(
-                self._finish_cmds(task_id, status, result, now, inline_max)
+                self._finish_cmds(
+                    task_id, status, result, now, inline_max,
+                    result_digest=item[4] if len(item) > 4 else None,
+                    result_size=int(item[5]) if len(item) > 5 else 0,
+                )
             )
             written.add(task_id)
         if not cmds:

@@ -65,11 +65,13 @@ from typing import Callable, Mapping
 
 from tpu_faas.obs import REGISTRY
 from tpu_faas.store.base import (
+    BLOBREQ_ANNOUNCE_PREFIX,
     CANCEL_ANNOUNCE_PREFIX,
     DISPATCHERS_KEY,
     KILL_ANNOUNCE_PREFIX,
     LEASE_CONF_KEY,
     LIVE_INDEX_KEY,
+    RESULT_DIGEST_PREFIX,
     RESULT_INLINE_PREFIX,
     TASKS_CHANNEL,
     TENANT_CONF_KEY,
@@ -339,10 +341,16 @@ class ShardedStore(TaskStore):
         """The task id embedded in an announce payload (control prefixes
         stripped, express inline result frames decoded) — what publishes
         route by."""
-        for prefix in (CANCEL_ANNOUNCE_PREFIX, KILL_ANNOUNCE_PREFIX):
+        for prefix in (
+            CANCEL_ANNOUNCE_PREFIX,
+            KILL_ANNOUNCE_PREFIX,
+            BLOBREQ_ANNOUNCE_PREFIX,  # routes by digest, like the blob
+        ):
             if payload.startswith(prefix):
                 return payload[len(prefix):]
-        if payload.startswith(RESULT_INLINE_PREFIX):
+        if payload.startswith(RESULT_INLINE_PREFIX) or payload.startswith(
+            RESULT_DIGEST_PREFIX
+        ):
             return decode_result_announce(payload)[0]
         return payload
 
@@ -632,14 +640,20 @@ class ShardedStore(TaskStore):
         )
 
     def finish_task(
-        self, task_id, status, result, first_wins=False, inline_max=0
+        self, task_id, status, result, first_wins=False, inline_max=0,
+        result_digest=None, result_size=0,
     ):
         # wholesale delegation: the shard client's pipelined form (write +
         # index drop + announce in one round) — index and announce both
-        # live on the task's own shard by construction
+        # live on the task's own shard by construction. The digest form
+        # rides along untouched: the task record (and its digest FIELDS)
+        # route by task id, while the blob BODY the digest names routes by
+        # digest (put_blob/get_blob below) — by design on different shards
+        # for unrelated keys.
         self._stores[self.ring.shard_of(task_id)].finish_task(
             task_id, status, result,
             first_wins=first_wins, inline_max=inline_max,
+            result_digest=result_digest, result_size=result_size,
         )
 
     def finish_task_many(self, items, inline_max: int = 0) -> None:

@@ -90,6 +90,35 @@ dispatcher -> worker:
                gone from the store too, telling the worker to FAIL the
                parked tasks instead of waiting forever.
 
+result-blob plane ("rblob"-capable workers under ``--result-blobs``
+dispatchers only; every field below is absent otherwise):
+    TASK/TASK_BATCH elements may carry ``rblob_min`` (int, byte
+               threshold): proof the dispatcher decodes digest-form
+               results, and permission for THIS task's completed result to
+               ship digest-only when it is at least that large (the
+               dispatcher marks exactly the tasks whose results it knows
+               to be graph-consumed). They may also carry parent results
+               for graph children: ``dep_digests`` {parent_id: digest}
+               for bodies the target worker's result cache should already
+               hold (cache miss -> BLOB_MISS, exactly like fn blobs), and
+               ``dep_results`` {parent_id: body} for cold targets (also
+               the ``--dep-results`` store-mediated form). The worker
+               exposes resolved parent bodies to the executing function
+               via core/executor.py dep_results().
+    RESULT/RESULT_BATCH elements may carry ``result_digest`` (sha256 hex)
+               + ``result_size`` (int) INSTEAD of ``result``: the body
+               stays in the worker's result cache under that digest, and
+               the dispatcher records the digest-form terminal write. Only
+               COMPLETED results ever ship digest-only — failures always
+               carry their body (error payloads must stay materializable
+               without the producing worker).
+    BLOB_MISS  (dispatcher -> worker, the REVERSE direction) data: digest —
+               asks the worker for a result body its cache holds; the
+               worker answers with a BLOB_FILL (``missing=True`` when
+               evicted). This is how a digest-only result is materialized
+               into the store after the fact (lazy materialization for
+               legacy readers, child-worker cache misses).
+
 Framing: the reference contract is ASCII — base64(dill(message)) — and
 stays the default. Peers that BOTH understand the "bin" capability switch
 to raw binary frames (``_BIN_MAGIC`` + dill bytes, no base64: ~25% less
@@ -140,8 +169,20 @@ CAP_TRACE = "trace"
 #: ``--batch-max`` — batching off means the per-task wire is untouched
 #: even between capable peers.
 CAP_BATCH = "batch"
+#: result-blob plane: an rblob-capable worker keeps a byte-bounded RESULT
+#: cache keyed by content digest and, for tasks whose TASK frame carried
+#: ``rblob_min`` (the dispatcher's ``--result-blobs`` proof + threshold),
+#: ships completed results >= that size as DIGEST-ONLY RESULT frames
+#: (``result_digest``/``result_size``, no ``result`` body). It also
+#: resolves parent-result digests on child TASK frames (``dep_digests``)
+#: from that cache, and answers dispatcher->worker BLOB_MISS pulls from
+#: it (the reverse of the function-blob flow — the dispatcher
+#: materializes a body it never shipped). Negotiated like blob/bin/trace:
+#: no rblob advertisement, or ``--result-blobs`` off, leaves every frame
+#: byte-identical to the reference-era contract.
+CAP_RESULT_BLOB = "rblob"
 #: what a current-generation worker advertises
-WORKER_CAPS = (CAP_BLOB, CAP_BIN, CAP_TRACE, CAP_BATCH)
+WORKER_CAPS = (CAP_BLOB, CAP_BIN, CAP_TRACE, CAP_BATCH, CAP_RESULT_BLOB)
 
 #: binary-frame magic: never a valid first byte of the ASCII contract
 #: (base64's alphabet is [A-Za-z0-9+/=]), so one-byte sniffing is exact

@@ -60,17 +60,31 @@ _MISFIRES_TOTAL = REGISTRY.counter(
     "by resubmission (at-least-once executions)",
 )
 
-#: Parent-side payload-cache counters, shared by both worker kinds (they
-#: both import the pool): the operator-visible proof that steady state
-#: ships digests, not bodies.
-FN_CACHE_HITS = REGISTRY.counter(
-    "tpu_faas_worker_fn_cache_hits_total",
-    "Digest-shipped TASKs resolved from the worker's payload cache",
+#: Parent-side blob-cache counters, shared by both worker kinds (they
+#: both import the pool) and split by cache KIND — ``fn`` is the payload
+#: cache (digest-shipped TASK functions), ``result`` the result cache
+#: (digest-shipped parent results, ``--result-blobs``): the
+#: operator-visible proof that steady state ships digests, not bodies,
+#: with the two planes separately triageable.
+BLOB_CACHE_HITS = REGISTRY.counter(
+    "tpu_faas_worker_blob_cache_hit_total",
+    "Digest resolutions served from this worker's blob caches, by cache "
+    "kind (fn = payload cache, result = result cache)",
+    ("kind",),
 )
-FN_CACHE_MISSES = REGISTRY.counter(
-    "tpu_faas_worker_fn_cache_misses_total",
-    "Digest-shipped TASKs that needed a BLOB_MISS/BLOB_FILL round",
+BLOB_CACHE_MISSES = REGISTRY.counter(
+    "tpu_faas_worker_blob_cache_miss_total",
+    "Digest resolutions that needed a BLOB_MISS/BLOB_FILL round, by "
+    "cache kind (fn = payload cache, result = result cache)",
+    ("kind",),
 )
+#: the function-cache children, under their historical import names (both
+#: workers increment these on the TASK fn_digest path)
+FN_CACHE_HITS = BLOB_CACHE_HITS.labels(kind="fn")
+FN_CACHE_MISSES = BLOB_CACHE_MISSES.labels(kind="fn")
+#: the result-cache children (rblob workers, dep_digests resolution)
+RESULT_CACHE_HITS = BLOB_CACHE_HITS.labels(kind="result")
+RESULT_CACHE_MISSES = BLOB_CACHE_MISSES.labels(kind="result")
 
 #: Batched data plane (worker side): bundle sizes and pool IPC volume.
 #: ipc_total / tasks_total is the O(1)-pool-wakeups-per-bundle proof the
@@ -129,6 +143,7 @@ def _run_reported(
     ser_params: str,
     timeout: float | None,
     fn_digest: str | None = None,
+    dep_results: dict[str, str] | None = None,
 ) -> ExecutionResult:
     """execute_fn wrapped with start/end reporting + the cancel window.
 
@@ -152,7 +167,9 @@ def _run_reported(
                 _EVENTS.put(("start", task_id, os.getpid()))
             # interrupts DURING the call are handled inside execute_fn
             # itself (its except clauses return a CANCELLED result)
-            res = execute_fn(task_id, ser_fn, ser_params, timeout, fn_digest)
+            res = execute_fn(
+                task_id, ser_fn, ser_params, timeout, fn_digest, dep_results
+            )
         except TaskCancelledInterrupt as exc:
             if res is None:
                 # landed before execute_fn produced anything: a pre-start
@@ -188,7 +205,8 @@ def _run_bundle(items) -> list[ExecutionResult]:
     per-task contract (own timeout arm, own cancel window, own start/end
     events), so a mid-bundle force-cancel interrupts exactly the element
     the parent's event mirror says is running. ``items`` is a list of
-    (task_id, ser_fn, ser_params, timeout, fn_digest) tuples."""
+    (task_id, ser_fn, ser_params, timeout, fn_digest[, dep_results])
+    tuples."""
     return [_run_reported(*item) for item in items]
 
 
@@ -210,7 +228,9 @@ class TaskPool:
         #: the submitted payloads (so a misfired interrupt can resubmit),
         #: and which tasks a cancel was actually requested for
         self._futures: dict[str, Future] = {}
-        self._args: dict[str, tuple[str, str, float | None, str | None]] = {}
+        self._args: dict[
+            str, tuple[str, str, float | None, str | None, dict | None]
+        ] = {}
         #: bundle future -> member task ids (batched data plane): members
         #: share ONE future, so cancel() must never fut.cancel() a bundle
         #: (it would cancel the innocent siblings) — bundled pre-start
@@ -357,25 +377,31 @@ class TaskPool:
         param_payload: str,
         timeout: float | None = None,
         fn_digest: str | None = None,
+        dep_results: dict[str, str] | None = None,
     ) -> None:
         """``fn_digest`` (payload plane): content digest of ``fn_payload``,
         keying the child-side deserialized-function cache so a repeated
-        function pays dill decode once per child, not once per task."""
+        function pays dill decode once per child, not once per task.
+        ``dep_results`` (result-blob plane): the graph child's resolved
+        parent bodies {parent_id: serialized result}, exposed to the
+        executing function via core/executor.dep_results()."""
         try:
             fut = self._executor.submit(
                 _run_reported, task_id, fn_payload, param_payload, timeout,
-                fn_digest,
+                fn_digest, dep_results,
             )
         except BrokenProcessPool:
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = self._make()
             fut = self._executor.submit(
                 _run_reported, task_id, fn_payload, param_payload, timeout,
-                fn_digest,
+                fn_digest, dep_results,
             )
         fut.add_done_callback(lambda f, tid=task_id: self._on_done(tid, f))
         self._futures[task_id] = fut
-        self._args[task_id] = (fn_payload, param_payload, timeout, fn_digest)
+        self._args[task_id] = (
+            fn_payload, param_payload, timeout, fn_digest, dep_results
+        )
         self._busy += 1
         POOL_IPC.inc()
         BUNDLE_SIZE.observe(1.0)
@@ -383,7 +409,8 @@ class TaskPool:
     def submit_bundle(self, items) -> None:
         """Submit K tasks as ONE pool IPC message (batched data plane):
         ``items`` is a list of (task_id, fn_payload, param_payload,
-        timeout, fn_digest) tuples that execute sequentially in one child.
+        timeout, fn_digest[, dep_results]) tuples that execute
+        sequentially in one child.
         Every per-task semantic is preserved element-wise — own timeout,
         own cancel window (deferred-kill interrupts exactly the running
         element), own misfire repair — but the bundle costs one executor
@@ -403,10 +430,12 @@ class TaskPool:
             fut = self._executor.submit(_run_bundle, items)
         fut.add_done_callback(lambda f: self._on_done(_BUNDLE, f))
         self._bundle_members[fut] = [it[0] for it in items]
-        for task_id, fn_payload, param_payload, timeout, fn_digest in items:
+        for it in items:
+            task_id = it[0]
             self._futures[task_id] = fut
             self._args[task_id] = (
-                fn_payload, param_payload, timeout, fn_digest
+                it[1], it[2], it[3], it[4],
+                it[5] if len(it) > 5 else None,
             )
         self._busy += len(items)
         POOL_IPC.inc()

@@ -21,13 +21,19 @@ import uuid
 
 import zmq
 
-from tpu_faas.core.payload import PayloadLRU
+from tpu_faas.core.payload import PayloadLRU, payload_digest
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import TaskStatus
 from tpu_faas.utils.backoff import BackoffPolicy
 from tpu_faas.utils.logging import get_logger, log_ctx
 from tpu_faas.worker import messages as m
-from tpu_faas.worker.pool import FN_CACHE_HITS, FN_CACHE_MISSES, TaskPool
+from tpu_faas.worker.pool import (
+    FN_CACHE_HITS,
+    FN_CACHE_MISSES,
+    RESULT_CACHE_HITS,
+    RESULT_CACHE_MISSES,
+    TaskPool,
+)
 
 log = get_logger("pull_worker")
 
@@ -47,6 +53,7 @@ class PullWorker:
         keepalive_period: float = 1.0,
         caps: tuple[str, ...] = m.WORKER_CAPS,
         fn_cache_bytes: int = 256 * 1024 * 1024,
+        result_cache_bytes: int = 256 * 1024 * 1024,
     ) -> None:
         self.worker_id = str(uuid.uuid4())
         #: max silence while saturated before sending a WAIT-bound keepalive
@@ -60,6 +67,15 @@ class PullWorker:
         #: digest -> serialized body (parent-side codec cache; REQ/REP
         #: resolves misses synchronously with a BLOB_MISS transaction)
         self.fn_cache = PayloadLRU(fn_cache_bytes)
+        #: digest -> serialized RESULT body (result-blob plane): this
+        #: worker's own digest-shipped results plus dep-digest fills.
+        #: REQ/REP resolves result-digest misses synchronously, exactly
+        #: like fn blobs — there is no reverse-pull lane on this transport
+        #: (the dispatcher can only answer, never ask).
+        self.result_cache = PayloadLRU(result_cache_bytes)
+        #: task_id -> rblob_min from that task's TASK reply (per-task
+        #: digest-ship permission + threshold)
+        self._task_rblob: dict[str, int] = {}
         #: True after the dispatcher's first binary reply — sends switch
         self._peer_bin = False
         #: task_id -> distributed trace id (TASK ``trace_id``): stamped
@@ -178,6 +194,12 @@ class PullWorker:
                 return
         elif payload is not None and digest:
             self.fn_cache.put(digest, payload)
+        deps = self._resolve_deps(reply)
+        if deps is False:
+            return  # a parent body was unresolvable; the task FAILED above
+        rb = reply.get("rblob_min")
+        if isinstance(rb, int) and rb > 0 and m.CAP_RESULT_BLOB in self.caps:
+            self._task_rblob[reply["task_id"]] = rb
         if self._chaos_exec is not None:
             # slow / crash_before ahead of pool handoff (same seam shape
             # as the push worker — see its _submit_task comment)
@@ -188,9 +210,59 @@ class PullWorker:
             reply["param_payload"],
             timeout=reply.get("timeout"),
             fn_digest=digest,
+            dep_results=deps or None,
         )
 
-    def _fetch_blob(self, digest: str, retries: int = 40) -> str | None:
+    def _resolve_deps(self, reply: dict):
+        """Resolve a graph child's delivered parent results (result-blob
+        plane): ``dep_results`` bodies ride the reply as-is;
+        ``dep_digests`` hit the result cache, with misses fetched
+        SYNCHRONOUSLY via BLOB_MISS transactions like fn blobs (REQ/REP
+        has no parking structure). Returns the deps dict (None when the
+        task carries none) or False after FAILing the task on an
+        unresolvable parent body."""
+        bodies = reply.get("dep_results")
+        digests = reply.get("dep_digests")
+        if not bodies and not digests:
+            return None
+        deps: dict[str, str] = dict(bodies) if isinstance(bodies, dict) else {}
+        if isinstance(digests, dict):
+            for pid, dg in digests.items():
+                if not isinstance(dg, str) or not dg:
+                    continue
+                body = self.result_cache.get(dg)
+                if body is None:
+                    RESULT_CACHE_MISSES.inc()
+                    body = self._fetch_blob(dg, cache=self.result_cache)
+                else:
+                    RESULT_CACHE_HITS.inc()
+                if body is None:
+                    self._task_rblob.pop(reply["task_id"], None)
+                    fail_extra: dict = {}
+                    fail_trace = self._task_trace.pop(reply["task_id"], None)
+                    if fail_trace:
+                        fail_extra["trace_id"] = fail_trace
+                    self._transact(
+                        m.RESULT,
+                        worker_id=self.worker_id,
+                        task_id=reply["task_id"],
+                        status=str(TaskStatus.FAILED),
+                        result=serialize(
+                            RuntimeError(
+                                f"parent result blob {dg[:16]}... "
+                                "unresolvable at dispatch"
+                            )
+                        ),
+                        no_task=True,
+                        **fail_extra,
+                    )
+                    return False
+                deps[pid] = body
+        return deps
+
+    def _fetch_blob(
+        self, digest: str, retries: int = 40, cache: PayloadLRU | None = None
+    ) -> str | None:
         """One or more BLOB_MISS transactions; an EMPTY fill (dispatcher
         store outage) backs off and retries — the ``_BLOB_BACKOFF``
         budget (~37 s at the default, sleeps capped at 1 s) rides out
@@ -221,7 +293,9 @@ class PullWorker:
                 return None  # protocol surprise: treat as unresolvable
             body = reply.get("data")
             if isinstance(body, str):
-                self.fn_cache.put(digest, body)
+                (cache if cache is not None else self.fn_cache).put(
+                    digest, body
+                )
                 return body
             if reply.get("missing"):
                 return None
@@ -246,12 +320,30 @@ class PullWorker:
                     trace_id = self._task_trace.pop(res.task_id, None)
                     if trace_id:
                         extra_kw["trace_id"] = trace_id
+                    # digest-only ship (result-blob plane): COMPLETED
+                    # results >= the task's rblob_min marker keep their
+                    # body in the result cache and send the digest
+                    rb = self._task_rblob.pop(res.task_id, None)
+                    if (
+                        rb
+                        and res.status == str(TaskStatus.COMPLETED)
+                        and isinstance(res.result, str)
+                        and len(res.result) >= rb
+                    ):
+                        dg = payload_digest(res.result)
+                        self.result_cache.put(dg, res.result)
+                        body_kw: dict = {
+                            "result_digest": dg,
+                            "result_size": len(res.result),
+                        }
+                    else:
+                        body_kw = {"result": res.result}
                     self._transact(
                         m.RESULT,
                         worker_id=self.worker_id,
                         task_id=res.task_id,
                         status=res.status,
-                        result=res.result,
+                        **body_kw,
                         elapsed=res.elapsed,
                         started_at=res.started_at,
                         misfires=self.pool.n_misfires,

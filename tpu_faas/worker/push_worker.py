@@ -25,12 +25,18 @@ import uuid
 
 import zmq
 
-from tpu_faas.core.payload import PayloadLRU
+from tpu_faas.core.payload import PayloadLRU, payload_digest
 from tpu_faas.core.serialize import serialize
 from tpu_faas.core.task import TaskStatus
 from tpu_faas.utils.logging import get_logger, log_ctx
 from tpu_faas.worker import messages as m
-from tpu_faas.worker.pool import FN_CACHE_HITS, FN_CACHE_MISSES, TaskPool
+from tpu_faas.worker.pool import (
+    FN_CACHE_HITS,
+    FN_CACHE_MISSES,
+    RESULT_CACHE_HITS,
+    RESULT_CACHE_MISSES,
+    TaskPool,
+)
 
 log = get_logger("push_worker")
 
@@ -50,6 +56,7 @@ class PushWorker:
         token: str | None = None,
         caps: tuple[str, ...] = m.WORKER_CAPS,
         fn_cache_bytes: int = 256 * 1024 * 1024,
+        result_cache_bytes: int = 256 * 1024 * 1024,
     ) -> None:
         self.num_processes = num_processes
         #: stable identity for the estimator's speed grades: carried on
@@ -75,6 +82,19 @@ class PushWorker:
         #: core/executor.py). Filled by BLOB_FILLs and by inline payloads
         #: seen with a digest attached.
         self.fn_cache = PayloadLRU(fn_cache_bytes)
+        #: digest -> serialized RESULT body (result-blob plane): filled by
+        #: this worker's own completed results that shipped digest-only,
+        #: and by BLOB_FILLs answering a dep-digest miss. The dispatcher's
+        #: locality lane steers graph children here, and dispatcher->worker
+        #: BLOB_MISS pulls materialize store copies from it on demand.
+        self.result_cache = PayloadLRU(result_cache_bytes)
+        #: task_id -> rblob_min carried on that task's TASK frame: the
+        #: dispatcher's per-task proof + threshold that ITS completed
+        #: result may ship digest-only (set only for graph-consumed tasks)
+        self._task_rblob: dict[str, int] = {}
+        #: digest -> which cache a BLOB_FILL for it belongs to ("result"
+        #: for dep-digest misses; absent = "fn", the historical default)
+        self._miss_kind: dict[str, str] = {}
         #: task_id -> distributed trace id (TASK ``trace_id``, present only
         #: when this worker advertised CAP_TRACE to a tracing dispatcher):
         #: stamped into logs and echoed on the matching RESULT; entries
@@ -201,6 +221,14 @@ class PushWorker:
             # later digest-only TASK (dispatcher upgraded mid-stream)
             # needs no fill round
             self.fn_cache.put(digest, payload)
+        ok, deps = self._resolve_deps(data, from_fill)
+        if not ok:
+            return False
+        rb = data.get("rblob_min")
+        if isinstance(rb, int) and rb > 0 and m.CAP_RESULT_BLOB in self.caps:
+            # the dispatcher's per-task digest-ship permission: remember it
+            # until this task's result is framed
+            self._task_rblob[data["task_id"]] = rb
         if self._chaos_exec is not None:
             # exec chaos (slow / crash_before) runs in the serve thread,
             # ahead of pool handoff: a gray worker stalls its whole
@@ -209,15 +237,16 @@ class PushWorker:
             # machinery reclaims, so no task reaches a terminal FAILED
             self._chaos_exec.before_task(data["task_id"])
         if collect is not None:
-            collect.append(
-                (
-                    data["task_id"],
-                    payload,
-                    data["param_payload"],
-                    data.get("timeout"),
-                    digest,
-                )
+            item = (
+                data["task_id"],
+                payload,
+                data["param_payload"],
+                data.get("timeout"),
+                digest,
             )
+            # 6th element only when parents were delivered: flat tasks keep
+            # the historical 5-tuple shape
+            collect.append(item if deps is None else item + (deps,))
             return True
         self.pool.submit(
             data["task_id"],
@@ -225,8 +254,42 @@ class PushWorker:
             data["param_payload"],
             timeout=data.get("timeout"),
             fn_digest=digest,
+            dep_results=deps,
         )
         return True
+
+    def _resolve_deps(self, data: dict, from_fill: bool):
+        """Resolve a graph child's delivered parent results (result-blob
+        plane): ``dep_results`` bodies ride the frame as-is;
+        ``dep_digests`` hit the result cache, and the FIRST missing digest
+        parks the task (BLOB_MISS with kind=result) — fills re-resolve
+        incrementally, so a multi-miss child serializes its fetches (rare
+        by construction: the dispatcher only ships digests it believes
+        this cache already holds). Returns (ok, deps); ok False = parked.
+        """
+        bodies = data.get("dep_results")
+        digests = data.get("dep_digests")
+        if not bodies and not digests:
+            return True, None
+        deps: dict[str, str] = dict(bodies) if isinstance(bodies, dict) else {}
+        if isinstance(digests, dict):
+            for pid, dg in digests.items():
+                if not isinstance(dg, str) or not dg:
+                    continue
+                body = self.result_cache.get(dg)
+                if body is None:
+                    if not from_fill:
+                        RESULT_CACHE_MISSES.inc()
+                    self._miss_kind[dg] = "result"
+                    self._awaiting.setdefault(dg, []).append(data)
+                    if dg not in self._miss_sent:
+                        self._send(m.BLOB_MISS, digest=dg)
+                        self._miss_sent[dg] = time.monotonic()
+                    return False, None
+                if not from_fill:
+                    RESULT_CACHE_HITS.inc()
+                deps[pid] = body
+        return True, deps or None
 
     # -- batched data plane ------------------------------------------------
     def _on_task_batch(self, data: dict) -> None:
@@ -276,9 +339,12 @@ class PushWorker:
         digest = data.get("digest")
         if not isinstance(digest, str) or not digest:
             return
+        kind = self._miss_kind.get(digest, "fn")
         body = data.get("data")
         if isinstance(body, str):
-            self.fn_cache.put(digest, body)
+            cache = self.result_cache if kind == "result" else self.fn_cache
+            cache.put(digest, body)
+            self._miss_kind.pop(digest, None)
             self._miss_sent.pop(digest, None)
             for parked in self._awaiting.pop(digest, ()):
                 self._submit_task(parked, from_fill=True)
@@ -286,8 +352,11 @@ class PushWorker:
             # the blob is gone from the store too: nothing will ever fill
             # this digest — FAIL the parked tasks so their records
             # converge instead of waiting forever
+            what = "parent result" if kind == "result" else "function"
+            self._miss_kind.pop(digest, None)
             self._miss_sent.pop(digest, None)
             for parked in self._awaiting.pop(digest, ()):
+                self._task_rblob.pop(parked["task_id"], None)
                 extra: dict = {}
                 trace_id = self._task_trace.pop(parked["task_id"], None)
                 if trace_id:
@@ -298,7 +367,7 @@ class PushWorker:
                     status=str(TaskStatus.FAILED),
                     result=serialize(
                         RuntimeError(
-                            f"function blob {digest[:16]}... missing from "
+                            f"{what} blob {digest[:16]}... missing from "
                             "the store"
                         )
                     ),
@@ -309,14 +378,37 @@ class PushWorker:
 
     def _result_item(self, res) -> dict:
         """One result's wire fields (shared by the per-task RESULT form
-        and the RESULT_BATCH elements)."""
-        item = {
-            "task_id": res.task_id,
-            "status": res.status,
-            "result": res.result,
-            "elapsed": res.elapsed,
-            "started_at": res.started_at,
-        }
+        and the RESULT_BATCH elements). A COMPLETED result at least
+        ``rblob_min`` bytes whose TASK frame carried that marker ships
+        DIGEST-ONLY (result-blob plane): the body stays in the result
+        cache, keyed by content digest, until someone pulls it — failures
+        always carry their body (error payloads must stay materializable
+        without this worker)."""
+        rb = self._task_rblob.pop(res.task_id, None)
+        if (
+            rb
+            and res.status == str(TaskStatus.COMPLETED)
+            and isinstance(res.result, str)
+            and len(res.result) >= rb
+        ):
+            digest = payload_digest(res.result)
+            self.result_cache.put(digest, res.result)
+            item = {
+                "task_id": res.task_id,
+                "status": res.status,
+                "result_digest": digest,
+                "result_size": len(res.result),
+                "elapsed": res.elapsed,
+                "started_at": res.started_at,
+            }
+        else:
+            item = {
+                "task_id": res.task_id,
+                "status": res.status,
+                "result": res.result,
+                "elapsed": res.elapsed,
+                "started_at": res.started_at,
+            }
         trace_id = self._task_trace.pop(res.task_id, None)
         if trace_id:
             item["trace_id"] = trace_id
@@ -357,6 +449,22 @@ class PushWorker:
             # (possibly duplicated) results
             self._chaos_exec.after_result(results[-1].task_id)
         return len(results)
+
+    def _on_blob_pull(self, data: dict) -> None:
+        """Dispatcher->worker BLOB_MISS (result-blob plane, the REVERSE of
+        the function-blob flow): serve a result body out of the result
+        cache so the dispatcher can materialize it — into the store for a
+        legacy reader, or onward to a cache-cold child worker.
+        ``missing=True`` when the entry was evicted: the dispatcher
+        surfaces that as the documented result-gone failure mode."""
+        digest = data.get("digest")
+        if not isinstance(digest, str) or not digest:
+            return
+        body = self.result_cache.get(digest)
+        if body is not None:
+            self._send(m.BLOB_FILL, digest=digest, data=body)
+        else:
+            self._send(m.BLOB_FILL, digest=digest, missing=True)
 
     def _resend_stale_misses(self, now: float) -> None:
         for digest in list(self._awaiting):
@@ -422,6 +530,10 @@ class PushWorker:
                             self._on_task_batch(data)
                         elif msg_type == m.BLOB_FILL:
                             self._on_blob_fill(data)
+                        elif msg_type == m.BLOB_MISS:
+                            # reverse pull: the dispatcher wants a result
+                            # body this worker's cache holds
+                            self._on_blob_pull(data)
                         elif msg_type == m.CANCEL:
                             # force-cancel: interrupt mid-run or drop
                             # pre-start; the CANCELLED result ships via the
@@ -436,7 +548,19 @@ class PushWorker:
                                 )
                         elif msg_type == m.RECONNECT:
                             # a draining worker reports zero capacity: it
-                            # must not be handed new work
+                            # must not be handed new work. rblob workers
+                            # also advertise their result-cache occupancy:
+                            # rcache_n == 0 tells a (re)connecting
+                            # dispatcher to clear any stale holdings
+                            # mirror it kept for this worker (restart
+                            # detection for the locality lane).
+                            rc: dict = {}
+                            if m.CAP_RESULT_BLOB in self.caps:
+                                rc = {
+                                    "rcache_n": len(self.result_cache),
+                                    "rcache_bytes":
+                                        self.result_cache.n_bytes,
+                                }
                             self._send(
                                 m.RECONNECT,
                                 free_processes=(
@@ -445,6 +569,7 @@ class PushWorker:
                                 token=self.token,
                                 ephemeral=self.token_is_ephemeral,
                                 caps=list(self.caps),
+                                **rc,
                             )
                 shipped += self._ship_results(self.pool.drain())
                 if max_tasks is not None and shipped >= max_tasks:

@@ -62,6 +62,27 @@ def straggler_sleep(seconds: float) -> float:
     return seconds
 
 
+def big_result(n_kib: int = 8, seed: int = 0) -> str:
+    """Deterministic ``n_kib``-KiB result body — the result-data-plane
+    producer (bench map stage): the value itself is what rides the wire,
+    so correctness is checkable by re-running with the same args."""
+    rng = random.Random(seed)
+    return "".join(rng.choices(_ALPHABET, k=n_kib * 1024))
+
+
+def merge_deps(tag: str = "") -> str:
+    """Fan-in consumer for graph tasks: digests its parents' delivered
+    result bodies (``dep_values()`` — the result data plane's in-cache
+    delivery, or store-read bodies on the control lane) into a short
+    summary. Returns ``tag:<n_parents>:<total_chars>`` so tests and the
+    bench oracle can assert every parent body actually arrived."""
+    from tpu_faas.core.executor import dep_values
+
+    vals = dep_values()
+    total = sum(len(v) for v in vals.values() if isinstance(v, str))
+    return f"{tag}:{len(vals)}:{total}"
+
+
 def _params_no_op(n_tasks: int, size: int, rng: random.Random):
     return [((), {}) for _ in range(n_tasks)]
 
